@@ -1,0 +1,29 @@
+"""ai4e_tpu — a TPU-native model-serving API platform.
+
+A brand-new framework with the capabilities of the AI for Earth API Platform
+(reference: CSA-DanielVillamizar/AIforEarth-API-Platform), re-designed TPU-first:
+
+- ``taskstore``  — durable task state machine (created → running → completed/failed)
+  with per-endpoint status sets, the equivalent of the reference's Redis-backed
+  CacheManager (``ProcessManager/CacheManager/CacheConnectorUpsert.cs:40-213``).
+- ``broker``     — per-endpoint durable queues + dispatcher with 429 backpressure
+  and redelivery (``ProcessManager/BackendQueueProcessor/BackendQueueProcessor.cs:27-81``).
+- ``service``    — the in-container API service framework: sync/async endpoint
+  decorators, concurrency caps, health, draining
+  (``APIs/1.0/base-py/ai4e_service.py:44-213``).
+- ``gateway``    — edge router: task creation at the edge, ``/task/{id}`` polling,
+  sync pass-through (``APIManagement/request_policy.xml``).
+- ``runtime``    — the genuinely new layer: JAX device-mesh manager, micro-batcher
+  packing queued tasks into fixed-shape device batches, pjit-compiled model
+  execution, compile cache.
+- ``models``     — flagship model families (land-cover segmentation UNet,
+  ResNet-50 classifier, MegaDetector-style detector) in Flax.
+- ``ops``        — Pallas TPU kernels for hot ops.
+- ``parallel``   — mesh/sharding helpers, XLA collectives, ring attention for
+  long-context, multi-host utilities.
+- ``metrics``    — in-flight/queue-depth gauges feeding the autoscaler signal
+  (``ProcessManager/RequestReporter``).
+- ``train``      — fine-tuning support: sharded train step over a device mesh.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,17 @@
+from .app import APIService, EndpointSpec, TASK_ID_HEADER
+from .task_manager import (
+    HttpTaskManager,
+    LocalTaskManager,
+    TaskManagerBase,
+    next_endpoint_from,
+)
+
+__all__ = [
+    "APIService",
+    "EndpointSpec",
+    "TASK_ID_HEADER",
+    "HttpTaskManager",
+    "LocalTaskManager",
+    "TaskManagerBase",
+    "next_endpoint_from",
+]

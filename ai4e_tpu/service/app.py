@@ -1,0 +1,305 @@
+"""APIService — the in-container service shell.
+
+Re-design of the reference's Flask ``APIService``
+(``APIs/1.0/base-py/ai4e_service.py:44-213``) as an asyncio-native aiohttp app.
+Same semantics, different engine:
+
+- decorator-driven endpoint registration: ``api_sync_func`` / ``api_async_func``
+  (``ai4e_service.py:72-109``) with per-endpoint concurrency caps,
+  content-type and max-length limits, and a request-processing hook;
+- backpressure: a request over the endpoint's cap gets **503** so the broker
+  backs off and redelivers (``ai4e_service.py:116-133`` — the reference returns
+  503; our dispatcher treats 503 and 429 identically);
+- async endpoints create/adopt a task (reusing the ``taskId`` header when the
+  dispatcher already created it), kick the user function onto a worker, and
+  return the task id immediately (``ai4e_service.py:169-183``);
+- any user-function exception fails the task (``ai4e_service.py:185-211``);
+- graceful draining: SIGINT/SIGTERM flips ``is_terminating`` and all new
+  requests get 503 while in-flight work finishes (``ai4e_service.py:111-120``);
+- health check at ``GET {prefix}/`` and task polling at
+  ``GET {prefix}/task/{id}`` (``ai4e_service.py:59-70``);
+- ``GET /metrics`` Prometheus endpoint (replaces the RequestReporter POST loop,
+  ``ai4e_service.py:135-156``).
+
+Sync user functions run in a thread-pool executor; async (coroutine) user
+functions run on the event loop. On a TPU host the executor is where JAX
+dispatch happens — the event loop never blocks on device work.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from aiohttp import web
+
+from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from ..taskstore import InMemoryTaskStore
+from .task_manager import LocalTaskManager, TaskManagerBase
+
+log = logging.getLogger("ai4e_tpu.service")
+
+TASK_ID_HEADER = "taskId"  # set by the dispatcher (BackendQueueProcessor.cs:48-52)
+
+
+@dataclass
+class EndpointSpec:
+    func: Callable
+    api_path: str
+    methods: tuple[str, ...]
+    is_async: bool
+    maximum_concurrent_requests: int = 8
+    content_types: tuple[str, ...] = ()
+    content_max_length: int = 0  # 0 = unlimited
+    trace_name: str = ""
+    request_processing_function: Callable | None = None
+    # Mutated only from the event loop with no await between check and
+    # increment — that single-threadedness is the synchronization.
+    in_flight: int = 0
+
+
+class APIService:
+    def __init__(
+        self,
+        name: str,
+        prefix: str = "",
+        task_manager: TaskManagerBase | None = None,
+        metrics: MetricsRegistry | None = None,
+        executor_workers: int = 8,
+    ):
+        self.name = name
+        self.prefix = ("/" + prefix.strip("/")) if prefix.strip("/") else ""
+        if task_manager is None:
+            task_manager = LocalTaskManager(InMemoryTaskStore())
+        self.task_manager = task_manager
+        self.metrics = metrics or DEFAULT_REGISTRY
+        self.is_terminating = False
+        self.endpoints: dict[str, EndpointSpec] = {}
+        self.executor = ThreadPoolExecutor(max_workers=executor_workers,
+                                           thread_name_prefix=f"{name}-worker")
+        self._background: set[asyncio.Task] = set()
+
+        self._inflight = self.metrics.gauge(
+            "ai4e_inflight_requests", "In-flight requests per endpoint")
+        self._latency = self.metrics.histogram(
+            "ai4e_request_latency_seconds", "End-to-end endpoint latency")
+        self._http_total = self.metrics.counter(
+            "ai4e_http_requests_total", "HTTP responses by code")
+
+        self.app = web.Application(client_max_size=1024**3)
+        self.app.router.add_get(self.prefix + "/", self._health)
+        if self.prefix:
+            self.app.router.add_get(self.prefix, self._health)
+        self.app.router.add_get(self.prefix + "/task/{task_id}", self._task_status)
+        self.app.router.add_get("/metrics", self._metrics_endpoint)
+
+    # -- decorators (ai4e_service.py:103-109) ------------------------------
+
+    def api_async_func(self, api_path: str, methods=("POST",), **kw):
+        return self._api_func(api_path, methods, is_async=True, **kw)
+
+    def api_sync_func(self, api_path: str, methods=("POST",), **kw):
+        return self._api_func(api_path, methods, is_async=False, **kw)
+
+    def _api_func(self, api_path: str, methods, is_async: bool,
+                  maximum_concurrent_requests: int = 8,
+                  content_types=(), content_max_length: int = 0,
+                  trace_name: str = "", request_processing_function=None):
+        def deco(func):
+            spec = EndpointSpec(
+                func=func,
+                api_path=api_path if api_path.startswith("/") else "/" + api_path,
+                methods=tuple(m.upper() for m in methods),
+                is_async=is_async,
+                maximum_concurrent_requests=maximum_concurrent_requests,
+                content_types=tuple(content_types),
+                content_max_length=content_max_length,
+                trace_name=trace_name or api_path,
+                request_processing_function=request_processing_function,
+            )
+            self.endpoints[spec.api_path] = spec
+            route_path = self.prefix + spec.api_path
+            for method in spec.methods:
+                self.app.router.add_route(method, route_path,
+                                          self._make_handler(spec))
+            return func
+        return deco
+
+    # -- request admission (ai4e_service.py:116-133) -----------------------
+
+    def _admission_error(self, spec: EndpointSpec, request: web.Request):
+        if self.is_terminating:
+            return 503, "Service is shutting down."
+        if spec.in_flight >= spec.maximum_concurrent_requests:
+            return 503, "Too many requests; try again later."
+        if spec.content_types:
+            ctype = request.content_type or ""
+            if ctype not in spec.content_types:
+                return 401, f"Unsupported content type: {ctype}"
+        if spec.content_max_length and (request.content_length or 0) > spec.content_max_length:
+            return 413, "Payload too large."
+        return None
+
+    def _reserve(self, spec: EndpointSpec) -> None:
+        spec.in_flight += 1
+        self._inflight.inc(path=spec.api_path, service=self.name)
+
+    def _release(self, spec: EndpointSpec) -> None:
+        spec.in_flight -= 1
+        self._inflight.dec(path=spec.api_path, service=self.name)
+
+    def _make_handler(self, spec: EndpointSpec):
+        async def handler(request: web.Request) -> web.Response:
+            # Admission check + slot reservation happen with no await in
+            # between, so the per-endpoint cap holds under concurrency (the
+            # check would otherwise race across handlers suspended in
+            # request.read()).
+            err = self._admission_error(spec, request)
+            if err:
+                code, msg = err
+                self._http_total.inc(code=str(code), path=spec.api_path)
+                return web.Response(status=code, text=msg)
+            self._reserve(spec)
+
+            released_to_background = False
+            try:
+                if spec.request_processing_function is not None:
+                    kwargs = spec.request_processing_function(request)
+                    if asyncio.iscoroutine(kwargs):
+                        kwargs = await kwargs
+                    if kwargs is None:
+                        self._http_total.inc(code="400", path=spec.api_path)
+                        return web.Response(
+                            status=400, text="Unable to process request data.")
+                else:
+                    kwargs = {"body": await request.read(),
+                              "content_type": request.content_type}
+
+                if spec.is_async:
+                    resp = await self._run_async(spec, request, kwargs)
+                    released_to_background = True  # _execute_async releases
+                    return resp
+                return await self._run_sync(spec, request, kwargs)
+            finally:
+                if not released_to_background:
+                    self._release(spec)
+
+        return handler
+
+    # -- sync path (ai4e_service.py:158-167, 197-213) ----------------------
+
+    async def _run_sync(self, spec: EndpointSpec, request: web.Request,
+                        kwargs: dict) -> web.Response:
+        t0 = time.perf_counter()
+        try:
+            result = await self._invoke(spec.func, **kwargs)
+            resp = self._to_response(result)
+            self._http_total.inc(code=str(resp.status), path=spec.api_path)
+            return resp
+        except Exception as exc:  # noqa: BLE001
+            log.exception("sync endpoint %s failed", spec.api_path)
+            self._http_total.inc(code="500", path=spec.api_path)
+            return web.Response(status=500, text=f"Error: {exc}")
+        finally:
+            self._latency.observe(time.perf_counter() - t0, path=spec.api_path)
+
+    # -- async path (ai4e_service.py:169-213) ------------------------------
+
+    async def _run_async(self, spec: EndpointSpec, request: web.Request,
+                         kwargs: dict) -> web.Response:
+        incoming_task_id = request.headers.get(TASK_ID_HEADER, "") or None
+        endpoint = str(request.url)
+        task = await self.task_manager.add_task(
+            endpoint=endpoint, body=b"", task_id=incoming_task_id)
+        task_id = task["TaskId"]
+
+        # The reserved slot is held until the background execution finishes —
+        # the cap covers running tasks, not just open connections
+        # (ai4e_service.py:197-213 counts the worker thread the same way).
+        bg = asyncio.get_running_loop().create_task(
+            self._execute_async(spec, task_id, kwargs))
+        self._background.add(bg)
+        bg.add_done_callback(self._background.discard)
+
+        self._http_total.inc(code="200", path=spec.api_path)
+        return web.json_response({"TaskId": task_id, "Status": task.get("Status", "created")})
+
+    async def _execute_async(self, spec: EndpointSpec, task_id: str,
+                             kwargs: dict) -> None:
+        t0 = time.perf_counter()
+        try:
+            await self._invoke(spec.func, taskId=task_id, **kwargs)
+        except Exception as exc:  # noqa: BLE001
+            log.exception("async endpoint %s task %s failed", spec.api_path, task_id)
+            try:
+                await self.task_manager.fail_task(task_id, f"failed: {exc}")
+            except Exception:  # noqa: BLE001
+                log.exception("could not fail task %s", task_id)
+        finally:
+            self._release(spec)
+            self._latency.observe(time.perf_counter() - t0, path=spec.api_path)
+
+    async def _invoke(self, func: Callable, **kwargs) -> Any:
+        if asyncio.iscoroutinefunction(func):
+            return await func(**kwargs)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self.executor, lambda: func(**kwargs))
+
+    @staticmethod
+    def _to_response(result: Any) -> web.Response:
+        if isinstance(result, web.Response):
+            return result
+        if isinstance(result, (dict, list)):
+            return web.json_response(result)
+        if isinstance(result, bytes):
+            return web.Response(body=result)
+        return web.Response(text=str(result))
+
+    # -- built-in routes ---------------------------------------------------
+
+    async def _health(self, _: web.Request) -> web.Response:
+        if self.is_terminating:
+            return web.Response(status=503, text="Draining.")
+        return web.json_response({"service": self.name, "status": "healthy"})
+
+    async def _task_status(self, request: web.Request) -> web.Response:
+        status = await self.task_manager.get_task_status(
+            request.match_info["task_id"])
+        if status is None:
+            return web.Response(status=404, text="Task not found.")
+        return web.json_response(status)
+
+    async def _metrics_endpoint(self, _: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render_prometheus(),
+                            content_type="text/plain")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def begin_draining(self) -> None:
+        log.warning("draining: refusing new requests")
+        self.is_terminating = True
+
+    async def drain(self, timeout: float = 30.0) -> None:
+        """Refuse new work, then wait for in-flight async tasks — the drain
+        window the reference gets from is_terminating + worker threads
+        (ai4e_service.py:111-120)."""
+        self.is_terminating = True
+        if self._background:
+            await asyncio.wait(self._background, timeout=timeout)
+
+    def run(self, host: str = "0.0.0.0", port: int = 8081,
+            drain_timeout: float = 30.0) -> None:
+        """Serve until SIGINT/SIGTERM; aiohttp's runner owns the signal →
+        shutdown path, and our on_shutdown hook drains in-flight tasks before
+        the process exits."""
+
+        async def _on_shutdown(_app):
+            await self.drain(drain_timeout)
+
+        self.app.on_shutdown.append(_on_shutdown)
+        web.run_app(self.app, host=host, port=port,
+                    shutdown_timeout=drain_timeout)

@@ -1,0 +1,155 @@
+"""Task-manager facade used inside API services.
+
+Same contract as the reference's two-layer manager — the ``TaskManager`` facade
+(``APIs/1.0/base-py/task_management/api_task.py:8-38``) over
+``DistributedApiTaskManager`` (``APIs/1.0/Common/task_management/
+distributed_api_task.py:17-116``) — with two interchangeable backends:
+
+- ``LocalTaskManager``  — direct calls into an in-process ``InMemoryTaskStore``
+  (single-host deployments, tests);
+- ``HttpTaskManager``   — aiohttp client against the task-store service
+  (multi-host; the reference's CACHE_CONNECTOR_UPSERT_URI/GET_URI pattern,
+  ``distributed_api_task.py:14-15``).
+
+Both are async; sync user code goes through the service shell's executor.
+"""
+
+from __future__ import annotations
+
+import json
+from urllib.parse import urlparse
+
+import aiohttp
+
+from ..taskstore import APITask, InMemoryTaskStore, TaskNotFound, TaskStatus
+
+
+class TaskManagerBase:
+    """AddTask / UpdateTaskStatus / CompleteTask / FailTask / AddPipelineTask /
+    GetTaskStatus — the five verbs every AI4E service uses."""
+
+    async def add_task(self, endpoint: str, body: bytes, task_id: str | None = None,
+                       publish: bool = False) -> dict:
+        """Create a task — or, when ``task_id`` is supplied (the dispatcher
+        already created it and passed the ``taskId`` header), just fetch it
+        (``api_task.py:12-20``)."""
+        if task_id:
+            status = await self.get_task_status(task_id)
+            if status is not None:
+                return status
+        return await self._upsert(APITask(
+            task_id=task_id or "", endpoint=endpoint, body=body, publish=publish,
+        ))
+
+    async def update_task_status(self, task_id: str, status: str) -> dict:
+        return await self._update(task_id, status)
+
+    async def complete_task(self, task_id: str, status: str = "completed") -> dict:
+        return await self._update(task_id, status)
+
+    async def fail_task(self, task_id: str, status: str = "failed") -> dict:
+        return await self._update(task_id, status)
+
+    async def add_pipeline_task(self, task_id: str, next_endpoint: str,
+                                body: bytes = b"") -> dict:
+        """Hand the task to the next API in an ensemble: rewrite Endpoint,
+        republish; an empty body triggers original-body replay downstream
+        (``distributed_api_task.py:67-100``)."""
+        return await self._upsert(APITask(
+            task_id=task_id,
+            endpoint=next_endpoint,
+            body=body,
+            status=TaskStatus.CREATED,
+            backend_status=TaskStatus.CREATED,
+            publish=True,
+        ))
+
+    async def get_task_status(self, task_id: str) -> dict | None:
+        raise NotImplementedError
+
+    async def _upsert(self, task: APITask) -> dict:
+        raise NotImplementedError
+
+    async def _update(self, task_id: str, status: str) -> dict:
+        raise NotImplementedError
+
+
+class LocalTaskManager(TaskManagerBase):
+    def __init__(self, store: InMemoryTaskStore):
+        self.store = store
+
+    async def get_task_status(self, task_id: str) -> dict | None:
+        try:
+            return self.store.get(task_id).to_dict()
+        except TaskNotFound:
+            return None
+
+    async def _upsert(self, task: APITask) -> dict:
+        # Distinguish create vs. pipeline transition the way the store does.
+        return self.store.upsert(task).to_dict()
+
+    async def _update(self, task_id: str, status: str) -> dict:
+        return self.store.update_status(task_id, status).to_dict()
+
+
+class HttpTaskManager(TaskManagerBase):
+    """Client for the task-store HTTP service (``taskstore.http``)."""
+
+    def __init__(self, base_url: str, session: aiohttp.ClientSession | None = None):
+        self.base_url = base_url.rstrip("/")
+        self._session = session
+
+    async def _get_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def get_task_status(self, task_id: str) -> dict | None:
+        session = await self._get_session()
+        async with session.get(
+            f"{self.base_url}/v1/taskstore/task", params={"taskId": task_id}
+        ) as resp:
+            if resp.status != 200:
+                return None
+            return await resp.json()
+
+    async def _upsert(self, task: APITask) -> dict:
+        payload = task.to_dict()
+        payload["Body"] = task.body.decode("utf-8", errors="surrogateescape")
+        payload["PublishToGrid"] = task.publish
+        session = await self._get_session()
+        async with session.post(
+            f"{self.base_url}/v1/taskstore/upsert", data=json.dumps(payload)
+        ) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    async def _update(self, task_id: str, status: str) -> dict:
+        # Atomic server-side transition — no GET-then-POST race
+        # (unlike the reference's _UpdateTaskStatus, distributed_api_task.py:29-56).
+        payload = {
+            "TaskId": task_id,
+            "Status": status,
+            "BackendStatus": TaskStatus.canonical(status),
+        }
+        session = await self._get_session()
+        async with session.post(
+            f"{self.base_url}/v1/taskstore/update", data=json.dumps(payload)
+        ) as resp:
+            resp.raise_for_status()
+            if resp.status != 200:  # 204 = task unknown to the store
+                raise KeyError(f"task not found: {task_id}")
+            return await resp.json()
+
+
+def next_endpoint_from(current_endpoint: str, version: str, organization: str,
+                       api: str) -> str:
+    """Build the next pipeline stage's endpoint from the current one —
+    ``scheme://host/{version}/{org}/{api}`` (``distributed_api_task.py:74-75``)."""
+    parsed = urlparse(current_endpoint)
+    base = f"{parsed.scheme}://{parsed.netloc}" if parsed.scheme else ""
+    return f"{base}/{version}/{organization}/{api}"

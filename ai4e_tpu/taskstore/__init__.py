@@ -1,0 +1,12 @@
+from .task import APITask, TaskStatus, endpoint_path, new_task_id
+from .store import InMemoryTaskStore, JournaledTaskStore, TaskNotFound
+
+__all__ = [
+    "APITask",
+    "TaskStatus",
+    "endpoint_path",
+    "new_task_id",
+    "InMemoryTaskStore",
+    "JournaledTaskStore",
+    "TaskNotFound",
+]

@@ -1,0 +1,75 @@
+"""HTTP facade over the task store.
+
+The reference exposes the CacheManager as two Azure Functions —
+``CacheConnectorUpsert`` (POST task JSON) and ``CacheConnectorGet``
+(``GET ?taskId=``) — that every other component calls over HTTPS
+(``ProcessManager/CacheManager/CacheConnectorUpsert.cs:40``,
+``CacheConnectorGet.cs:26-74``). This module is the same surface as an aiohttp
+app, so services on other hosts can share one task store:
+
+- ``POST /v1/taskstore/upsert``   — create/transition a task (task JSON body)
+- ``POST /v1/taskstore/update``   — atomic status-only transition by TaskId
+  (fixes the read-modify-write race SURVEY.md §5 flags in the reference's
+  ``distributed_api_task.py:29-56``)
+- ``GET  /v1/taskstore/task?taskId=…`` — poll a task (204 if absent)
+- ``GET  /v1/taskstore/depths``   — per-endpoint status-set depths (autoscale signal)
+"""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+from .store import InMemoryTaskStore, TaskNotFound
+from .task import APITask
+
+
+def make_app(store: InMemoryTaskStore) -> web.Application:
+    app = web.Application()
+
+    async def upsert(request: web.Request) -> web.Response:
+        try:
+            payload = json.loads(await request.read() or b"{}")
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        task = APITask.from_dict(payload)
+        # Existing-task transition if a TaskId was supplied and known; otherwise
+        # create (CacheConnectorUpsert.cs decides the same way, :90-108).
+        task = store.upsert(task)
+        return web.json_response(store.get(task.task_id).to_dict())
+
+    async def update(request: web.Request) -> web.Response:
+        try:
+            payload = json.loads(await request.read() or b"{}")
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        task_id = payload.get("TaskId", "")
+        status = payload.get("Status", "")
+        if not task_id or not status:
+            return web.json_response({"error": "TaskId and Status required"}, status=400)
+        try:
+            task = store.update_status(task_id, status, payload.get("BackendStatus"))
+        except TaskNotFound:
+            return web.Response(status=204)
+        return web.json_response(task.to_dict())
+
+    async def get_task(request: web.Request) -> web.Response:
+        task_id = request.query.get("taskId") or request.match_info.get("task_id", "")
+        if not task_id:
+            return web.json_response({"error": "taskId required"}, status=400)
+        try:
+            task = store.get(task_id)
+        except TaskNotFound:
+            return web.Response(status=204)  # CacheConnectorGet.cs:65
+        return web.json_response(task.to_dict())
+
+    async def depths(_: web.Request) -> web.Response:
+        return web.json_response(store.depths())
+
+    app.router.add_post("/v1/taskstore/upsert", upsert)
+    app.router.add_post("/v1/taskstore/update", update)
+    app.router.add_get("/v1/taskstore/task", get_task)
+    app.router.add_get("/v1/taskstore/task/{task_id}", get_task)
+    app.router.add_get("/v1/taskstore/depths", depths)
+    return app

@@ -1,0 +1,231 @@
+"""Task state store — the platform's core state machine.
+
+Equivalent of the reference's CacheManager over Azure Redis
+(``ProcessManager/CacheManager/CacheConnectorUpsert.cs:40-213`` /
+``CacheConnectorGet.cs:26-74``), re-designed as a library with pluggable
+backends instead of an Azure Function over a remote Redis:
+
+- ``upsert`` creates a task (new GUID) or transitions an existing one, updating
+  per-endpoint, per-status ordered sets scored by epoch seconds and removing the
+  task from its prior status set (mirrors the Redis MULTI transaction at
+  ``CacheConnectorUpsert.cs:125-170``). All of that happens under one lock here —
+  the transactionality the reference got from Redis MULTI.
+- the original request body is stored per task and replayed when a pipeline
+  stage re-publishes the task with an empty body
+  (``CacheConnectorUpsert.cs:144-176`` reads ``{taskId}_ORIG``).
+- when a task is upserted with ``publish=True`` the store hands it to a
+  publisher (the broker); a publish failure rolls the task to ``failed`` in the
+  same breath (``CacheConnectorUpsert.cs:183-199``).
+- ``JournaledTaskStore`` adds crash-durability via an append-only JSONL journal
+  (replaces Redis persistence): on restart, replaying the journal rebuilds the
+  exact store state so queued tasks survive worker crashes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Iterable
+
+from .task import APITask, TaskStatus, new_task_id
+
+Publisher = Callable[[APITask], None]
+
+
+class TaskNotFound(KeyError):
+    pass
+
+
+class InMemoryTaskStore:
+    """Thread-safe in-process task store.
+
+    Used directly by tests and single-process deployments; the HTTP task-store
+    service (``taskstore.http``) wraps one of these, and multi-host deployments
+    talk to that service the way reference services talk to the CacheConnector
+    functions.
+    """
+
+    def __init__(self, publisher: Publisher | None = None):
+        self._lock = threading.RLock()
+        self._tasks: dict[str, APITask] = {}
+        self._orig_bodies: dict[str, bytes] = {}
+        # (endpoint_path, canonical_status) -> {task_id: score}; insertion
+        # ordered + scored like the reference's Redis sorted sets.
+        self._sets: dict[tuple[str, str], dict[str, float]] = {}
+        self._publisher = publisher
+
+    def set_publisher(self, publisher: Publisher | None) -> None:
+        self._publisher = publisher
+
+    # -- core state machine ------------------------------------------------
+
+    def upsert(self, task: APITask) -> APITask:
+        """Create or transition a task; returns the stored record.
+
+        Semantics of ``CacheConnectorUpsert.TaskRun``:
+        - no existing record → create (fresh GUID unless one was supplied);
+          non-empty body is remembered as the original body for pipeline replay;
+        - existing record → status transition; an empty body on a *publishing*
+          upsert is a subsequent pipeline call and replays the original body;
+        - status-set bookkeeping: remove from prior set, add to new set scored
+          by now;
+        - ``publish=True`` → hand to the broker; on broker failure the task is
+          marked failed instead of raising to the caller.
+        """
+        with self._lock:
+            prev = self._tasks.get(task.task_id)
+            if prev is None:
+                if not task.task_id:
+                    task.task_id = new_task_id()
+                if task.body:
+                    self._orig_bodies[task.task_id] = task.body
+            else:
+                if not task.body and task.publish:
+                    # Subsequent pipeline call: replay the original body
+                    # (CacheConnectorUpsert.cs:144-176).
+                    task.body = self._orig_bodies.get(task.task_id, b"")
+                self._remove_from_set(prev)
+            task.timestamp = time.time()
+            self._tasks[task.task_id] = task
+            self._add_to_set(task)
+            publisher = self._publisher if task.publish else None
+
+        if publisher is not None:
+            try:
+                publisher(task)
+            except Exception as exc:  # noqa: BLE001 — any publish failure fails the task
+                self.update_status(
+                    task.task_id,
+                    f"failed - could not publish task: {exc}",
+                    backend_status=TaskStatus.FAILED,
+                )
+        return task
+
+    def update_status(
+        self, task_id: str, status: str, backend_status: str | None = None
+    ) -> APITask:
+        """Atomic status transition by id — no read-modify-write race (the
+        reference's ``_UpdateTaskStatus`` GET-then-POST at
+        ``distributed_api_task.py:29-56`` is racy; SURVEY.md §5 flags it)."""
+        with self._lock:
+            prev = self._tasks.get(task_id)
+            if prev is None:
+                raise TaskNotFound(task_id)
+            task = prev.with_status(status, backend_status)
+            task.publish = False
+            self._remove_from_set(prev)
+            self._tasks[task_id] = task
+            self._add_to_set(task)
+            return task
+
+    def get(self, task_id: str) -> APITask:
+        with self._lock:
+            task = self._tasks.get(task_id)
+            if task is None:
+                raise TaskNotFound(task_id)
+            return task
+
+    def get_original_body(self, task_id: str) -> bytes:
+        with self._lock:
+            return self._orig_bodies.get(task_id, b"")
+
+    # -- status-set queries (queue-depth metrics, QueueLogger.cs:21-47) ----
+
+    def set_len(self, endpoint_path: str, status: str) -> int:
+        with self._lock:
+            return len(self._sets.get((endpoint_path, status), {}))
+
+    def set_members(self, endpoint_path: str, status: str) -> list[str]:
+        with self._lock:
+            members = self._sets.get((endpoint_path, status), {})
+            return sorted(members, key=members.__getitem__)
+
+    def endpoints(self) -> list[str]:
+        with self._lock:
+            return sorted({path for path, _ in self._sets})
+
+    def depths(self) -> dict[str, dict[str, int]]:
+        """Per-endpoint per-status depths — the autoscaling signal
+        (``TaskQueueLogger.cs:19-27`` logs ``_created`` depth every 30 s)."""
+        with self._lock:
+            out: dict[str, dict[str, int]] = {}
+            for (path, status), members in self._sets.items():
+                out.setdefault(path, {s: 0 for s in TaskStatus.ALL})[status] = len(members)
+            return out
+
+    # -- internals ---------------------------------------------------------
+
+    def _add_to_set(self, task: APITask) -> None:
+        key = (task.endpoint_path, task.canonical_status)
+        self._sets.setdefault(key, {})[task.task_id] = task.timestamp
+
+    def _remove_from_set(self, task: APITask) -> None:
+        key = (task.endpoint_path, task.canonical_status)
+        members = self._sets.get(key)
+        if members is not None:
+            members.pop(task.task_id, None)
+
+    def snapshot(self) -> Iterable[APITask]:
+        with self._lock:
+            return list(self._tasks.values())
+
+
+class JournaledTaskStore(InMemoryTaskStore):
+    """InMemoryTaskStore + append-only JSONL journal for crash recovery.
+
+    Plays the durability role Redis plays in the reference: a restarted store
+    replays the journal and resumes with identical task state, so a crashed
+    worker's tasks are still present for redelivery (SURVEY.md §5
+    checkpoint/resume).
+    """
+
+    def __init__(self, journal_path: str, publisher: Publisher | None = None):
+        super().__init__(publisher)
+        self._journal_path = journal_path
+        self._journal_lock = threading.Lock()
+        if os.path.exists(journal_path):
+            self._replay()
+        self._journal = open(journal_path, "a", encoding="utf-8")  # noqa: SIM115
+
+    def _replay(self) -> None:
+        with open(self._journal_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                task = APITask.from_dict(rec)
+                task.body = bytes.fromhex(rec.get("BodyHex", ""))
+                task.publish = False  # never re-publish on replay; broker re-seeds
+                super().upsert(task)
+                orig = rec.get("OrigHex")
+                if orig:
+                    self._orig_bodies[task.task_id] = bytes.fromhex(orig)
+
+    def _log(self, task: APITask) -> None:
+        rec = task.to_dict()
+        rec["BodyHex"] = task.body.hex()
+        orig = self._orig_bodies.get(task.task_id)
+        if orig is not None:
+            rec["OrigHex"] = orig.hex()
+        with self._journal_lock:
+            self._journal.write(json.dumps(rec) + "\n")
+            self._journal.flush()
+
+    def upsert(self, task: APITask) -> APITask:
+        task = super().upsert(task)
+        self._log(self.get(task.task_id))
+        return task
+
+    def update_status(
+        self, task_id: str, status: str, backend_status: str | None = None
+    ) -> APITask:
+        task = super().update_status(task_id, status, backend_status)
+        self._log(task)
+        return task
+
+    def close(self) -> None:
+        with self._journal_lock:
+            self._journal.close()

@@ -1,0 +1,118 @@
+"""Task record and status model.
+
+The task record is the platform's only durable state: status, endpoint, and the
+original request body persist outside workers so any replica can resume a task by
+TaskId. Record shape mirrors the reference's ``APITask``
+(``ProcessManager/Classes/APITask.cs:10-29``): TaskId, Timestamp, Status,
+BackendStatus, Endpoint, Body, PublishToGrid, with a derived EndpointPath.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field, replace
+from urllib.parse import urlparse
+
+
+class TaskStatus:
+    """Canonical lifecycle states (``CacheConnectorUpsert.cs:133-142`` keeps one
+    sorted set per endpoint per state with exactly these names)."""
+
+    CREATED = "created"
+    RUNNING = "running"
+    COMPLETED = "completed"
+    FAILED = "failed"
+
+    ALL = (CREATED, RUNNING, COMPLETED, FAILED)
+    TERMINAL = (COMPLETED, FAILED)
+
+    @staticmethod
+    def canonical(status: str) -> str:
+        """Map a free-form status string onto its lifecycle bucket.
+
+        The reference lets services write arbitrary status strings (e.g.
+        "Awaiting service availability…", "completed - 3 animals found") but
+        buckets them into the four sets by substring match
+        (``CacheConnectorUpsert.cs:111-123``).
+        """
+        s = (status or "").lower()
+        for canon in (TaskStatus.FAILED, TaskStatus.COMPLETED, TaskStatus.RUNNING):
+            if canon in s:
+                return canon
+        return TaskStatus.CREATED
+
+
+def new_task_id() -> str:
+    """GUID task ids, as in ``CacheConnectorUpsert.cs:99``."""
+    return str(uuid.uuid4())
+
+
+def endpoint_path(endpoint: str) -> str:
+    """Derived endpoint path, e.g. ``http://host/v1/landcover/classify`` →
+    ``/v1/landcover/classify`` (``APITask.cs`` EndpointPath)."""
+    if not endpoint:
+        return ""
+    if "://" in endpoint:
+        return urlparse(endpoint).path or "/"
+    return endpoint if endpoint.startswith("/") else "/" + endpoint
+
+
+@dataclass
+class APITask:
+    """A single unit of asynchronous work."""
+
+    task_id: str = field(default_factory=new_task_id)
+    timestamp: float = field(default_factory=time.time)
+    status: str = TaskStatus.CREATED
+    backend_status: str = TaskStatus.CREATED
+    endpoint: str = ""
+    body: bytes = b""
+    content_type: str = "application/json"
+    publish: bool = False  # PublishToGrid: enqueue onto the transport on upsert
+
+    @property
+    def endpoint_path(self) -> str:
+        return endpoint_path(self.endpoint)
+
+    @property
+    def canonical_status(self) -> str:
+        return TaskStatus.canonical(self.status)
+
+    def to_dict(self) -> dict:
+        """Wire shape returned to clients polling ``GET /task/{taskId}``
+        (``CacheConnectorGet.cs:26-74`` returns the task JSON verbatim)."""
+        return {
+            "TaskId": self.task_id,
+            "Timestamp": self.timestamp,
+            "Status": self.status,
+            "BackendStatus": self.backend_status,
+            "Endpoint": self.endpoint,
+            "ContentType": self.content_type,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "APITask":
+        body = d.get("Body", b"")
+        if isinstance(body, str):
+            # Inverse of the client's surrogateescape decode — binary bodies
+            # (JPEGs etc.) survive the JSON round trip.
+            body = body.encode("utf-8", errors="surrogateescape")
+        return cls(
+            task_id=d.get("TaskId") or d.get("Uuid") or new_task_id(),
+            timestamp=float(d.get("Timestamp") or time.time()),
+            status=d.get("Status", TaskStatus.CREATED),
+            backend_status=d.get("BackendStatus", TaskStatus.CREATED),
+            endpoint=d.get("Endpoint", ""),
+            body=body,
+            content_type=d.get("ContentType", "application/json"),
+            publish=bool(d.get("PublishToGrid", False)),
+        )
+
+    def with_status(self, status: str, backend_status: str | None = None) -> "APITask":
+        return replace(
+            self,
+            status=status,
+            backend_status=backend_status if backend_status is not None else status,
+            timestamp=time.time(),
+        )

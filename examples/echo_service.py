@@ -1,0 +1,43 @@
+"""Echo API — the platform's CPU smoke-test service (BASELINE.json config #1,
+the analogue of the reference's base-py example API).
+
+Run:  python examples/echo_service.py [port]
+Then: curl -X POST localhost:8081/v1/echo/echo -d '{"hello":"world"}'
+      curl -X POST localhost:8081/v1/echo/echo-async -d '{"x":1}'   → {"TaskId": …}
+      curl localhost:8081/v1/echo/task/<TaskId>
+"""
+
+import asyncio
+import sys
+import time
+
+from ai4e_tpu.service import APIService
+
+
+def main() -> None:
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 8081
+    svc = APIService("echo", prefix="v1/echo")
+
+    @svc.api_sync_func("/echo", maximum_concurrent_requests=4)
+    def echo(body, content_type):
+        return {"echo": body.decode("utf-8", errors="replace")}
+
+    @svc.api_sync_func("/slow", maximum_concurrent_requests=1)
+    def slow(body, content_type):
+        time.sleep(2)
+        return {"slow": "done"}
+
+    @svc.api_async_func("/echo-async")
+    def echo_async(taskId, body, content_type):
+        async def drive():
+            await svc.task_manager.update_task_status(taskId, "running")
+            await asyncio.sleep(0.5)  # pretend to be a long inference
+            await svc.task_manager.complete_task(
+                taskId, f"completed - echoed {len(body)} bytes")
+        asyncio.run(drive())
+
+    svc.run(port=port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; all sharding tests run against
+``--xla_force_host_platform_device_count=8`` (the cluster-simulator gap
+SURVEY.md §4 flags in the reference, fixed here). The environment's TPU plugin
+forces ``jax_platforms`` via config at interpreter start, so the env var alone
+is not enough — we override the config before any backend initializes.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

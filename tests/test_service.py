@@ -1,0 +1,361 @@
+"""Service-shell tests: sync/async endpoints, 503 backpressure, content-type /
+size limits, draining, task polling — the semantics of
+``APIs/1.0/base-py/ai4e_service.py:72-213``."""
+
+import asyncio
+import json
+import threading
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.service import APIService, LocalTaskManager
+from ai4e_tpu.taskstore import InMemoryTaskStore
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_service(**kw):
+    store = InMemoryTaskStore()
+    svc = APIService("test-svc", prefix="v1/test",
+                     task_manager=LocalTaskManager(store), **kw)
+    return svc, store
+
+
+async def client_for(svc):
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    return client
+
+
+class TestSyncPath:
+    def test_echo_roundtrip(self):
+        svc, _ = make_service()
+
+        @svc.api_sync_func("/echo")
+        def echo(body, content_type):
+            return {"echo": body.decode()}
+
+        async def main():
+            client = await client_for(svc)
+            try:
+                resp = await client.post("/v1/test/echo", data=b"hello")
+                assert resp.status == 200
+                assert (await resp.json()) == {"echo": "hello"}
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_sync_error_returns_500(self):
+        svc, _ = make_service()
+
+        @svc.api_sync_func("/boom")
+        def boom(body, content_type):
+            raise ValueError("bad input")
+
+        async def main():
+            client = await client_for(svc)
+            try:
+                resp = await client.post("/v1/test/boom", data=b"x")
+                assert resp.status == 500
+                assert "bad input" in await resp.text()
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_content_type_enforcement_401(self):
+        # ai4e_service.py:126-129 returns 401 on unsupported content type.
+        svc, _ = make_service()
+
+        @svc.api_sync_func("/typed", content_types=("application/json",))
+        def typed(body, content_type):
+            return "ok"
+
+        async def main():
+            client = await client_for(svc)
+            try:
+                bad = await client.post("/v1/test/typed", data=b"x",
+                                        headers={"Content-Type": "text/csv"})
+                assert bad.status == 401
+                good = await client.post("/v1/test/typed", data=b"{}",
+                                         headers={"Content-Type": "application/json"})
+                assert good.status == 200
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_payload_too_large_413(self):
+        svc, _ = make_service()
+
+        @svc.api_sync_func("/small", content_max_length=10)
+        def small(body, content_type):
+            return "ok"
+
+        async def main():
+            client = await client_for(svc)
+            try:
+                resp = await client.post("/v1/test/small", data=b"x" * 100)
+                assert resp.status == 413
+            finally:
+                await client.close()
+
+        run(main())
+
+
+class TestBackpressure:
+    def test_concurrency_cap_returns_503(self):
+        # ai4e_service.py:122-125: over the per-endpoint cap → 503 so the
+        # dispatcher backs off and redelivers.
+        svc, _ = make_service()
+        release = threading.Event()
+
+        @svc.api_sync_func("/slow", maximum_concurrent_requests=1)
+        def slow(body, content_type):
+            release.wait(timeout=10)
+            return "done"
+
+        async def main():
+            client = await client_for(svc)
+            try:
+                first = asyncio.ensure_future(
+                    client.post("/v1/test/slow", data=b"a"))
+                for _ in range(100):
+                    if svc.endpoints["/slow"].in_flight >= 1:
+                        break
+                    await asyncio.sleep(0.01)
+                second = await client.post("/v1/test/slow", data=b"b")
+                assert second.status == 503
+                release.set()
+                resp1 = await first
+                assert resp1.status == 200
+            finally:
+                release.set()
+                await client.close()
+
+        run(main())
+
+    def test_draining_returns_503(self):
+        svc, _ = make_service()
+
+        @svc.api_sync_func("/ep")
+        def ep(body, content_type):
+            return "ok"
+
+        async def main():
+            client = await client_for(svc)
+            try:
+                svc.begin_draining()
+                resp = await client.post("/v1/test/ep", data=b"x")
+                assert resp.status == 503
+                health = await client.get("/v1/test/")
+                assert health.status == 503
+            finally:
+                await client.close()
+
+        run(main())
+
+
+class TestAsyncPath:
+    def test_async_returns_task_id_and_completes(self):
+        svc, store = make_service()
+        done = threading.Event()
+
+        @svc.api_async_func("/detect")
+        def detect(taskId, body, content_type):
+            # user code drives the task through its lifecycle
+            asyncio.run(svc.task_manager.update_task_status(taskId, "running"))
+            asyncio.run(svc.task_manager.complete_task(
+                taskId, "completed - 2 animals"))
+            done.set()
+
+        async def main():
+            client = await client_for(svc)
+            try:
+                resp = await client.post("/v1/test/detect", data=b"img")
+                assert resp.status == 200
+                task_id = (await resp.json())["TaskId"]
+                assert task_id
+                assert done.wait(timeout=10)
+                for _ in range(100):
+                    poll = await client.get(f"/v1/test/task/{task_id}")
+                    body = await poll.json()
+                    if "completed" in body["Status"]:
+                        break
+                    await asyncio.sleep(0.05)
+                assert "completed" in body["Status"]
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_async_exception_fails_task(self):
+        # ai4e_service.py:185-211 — user exception → FailTask.
+        svc, store = make_service()
+
+        @svc.api_async_func("/bad")
+        def bad(taskId, body, content_type):
+            raise RuntimeError("model OOM")
+
+        async def main():
+            client = await client_for(svc)
+            try:
+                resp = await client.post("/v1/test/bad", data=b"x")
+                task_id = (await resp.json())["TaskId"]
+                for _ in range(100):
+                    poll = await client.get(f"/v1/test/task/{task_id}")
+                    body = await poll.json()
+                    if "failed" in body["Status"]:
+                        break
+                    await asyncio.sleep(0.05)
+                assert "failed" in body["Status"]
+                assert "model OOM" in body["Status"]
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_dispatcher_task_id_header_is_adopted(self):
+        # api_task.py:12-20 — when the dispatcher passes taskId, no new task.
+        svc, store = make_service()
+        seen = {}
+
+        @svc.api_async_func("/adopt")
+        def adopt(taskId, body, content_type):
+            seen["taskId"] = taskId
+
+        async def main():
+            existing = store.upsert(
+                __import__("ai4e_tpu.taskstore", fromlist=["APITask"]).APITask(
+                    endpoint="http://x/v1/test/adopt", body=b"img"))
+            client = await client_for(svc)
+            try:
+                resp = await client.post("/v1/test/adopt", data=b"img",
+                                         headers={"taskId": existing.task_id})
+                body = await resp.json()
+                assert body["TaskId"] == existing.task_id
+                for _ in range(100):
+                    if "taskId" in seen:
+                        break
+                    await asyncio.sleep(0.02)
+                assert seen["taskId"] == existing.task_id
+            finally:
+                await client.close()
+
+        run(main())
+
+
+class TestBuiltins:
+    def test_health(self):
+        svc, _ = make_service()
+
+        async def main():
+            client = await client_for(svc)
+            try:
+                resp = await client.get("/v1/test/")
+                assert resp.status == 200
+                assert (await resp.json())["status"] == "healthy"
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_metrics_endpoint(self):
+        svc, _ = make_service()
+
+        @svc.api_sync_func("/m")
+        def m(body, content_type):
+            return "ok"
+
+        async def main():
+            client = await client_for(svc)
+            try:
+                await client.post("/v1/test/m", data=b"x")
+                resp = await client.get("/metrics")
+                text = await resp.text()
+                assert "ai4e_http_requests_total" in text
+                assert "ai4e_request_latency_seconds" in text
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_unknown_task_404(self):
+        svc, _ = make_service()
+
+        async def main():
+            client = await client_for(svc)
+            try:
+                resp = await client.get("/v1/test/task/nope")
+                assert resp.status == 404
+            finally:
+                await client.close()
+
+        run(main())
+
+
+class TestAdmissionRace:
+    def test_cap_enforced_before_body_read(self):
+        # Regression: the cap check and slot reservation must be atomic —
+        # concurrent requests suspended in request.read() must not all pass
+        # the in_flight==0 check.
+        svc, _ = make_service()
+        started = threading.Event()
+        release = threading.Event()
+        entered = []
+
+        @svc.api_sync_func("/gated", maximum_concurrent_requests=1)
+        def gated(body, content_type):
+            entered.append(1)
+            started.set()
+            release.wait(timeout=10)
+            return "ok"
+
+        async def main():
+            client = await client_for(svc)
+            try:
+                futs = [asyncio.ensure_future(
+                    client.post("/v1/test/gated", data=b"x" * 10000))
+                    for _ in range(5)]
+                await asyncio.sleep(0.3)
+                release.set()
+                resps = await asyncio.gather(*futs)
+                codes = sorted(r.status for r in resps)
+                assert codes.count(503) >= 3  # most must be rejected
+                assert codes.count(200) >= 1
+                assert len(entered) <= 2  # never 5 concurrent entries
+            finally:
+                release.set()
+                await client.close()
+
+        run(main())
+
+
+class TestPrometheusFormat:
+    def test_single_type_line_per_metric(self):
+        svc, _ = make_service()
+
+        @svc.api_sync_func("/a")
+        def a(body, content_type):
+            return "ok"
+
+        @svc.api_sync_func("/b")
+        def b(body, content_type):
+            return "ok"
+
+        async def main():
+            client = await client_for(svc)
+            try:
+                await client.post("/v1/test/a", data=b"x")
+                await client.post("/v1/test/b", data=b"x")
+                text = await (await client.get("/metrics")).text()
+                type_lines = [l for l in text.splitlines()
+                              if l.startswith("# TYPE ai4e_http_requests_total ")]
+                assert len(type_lines) == 1
+            finally:
+                await client.close()
+
+        run(main())

@@ -1,0 +1,159 @@
+"""Unit tests for the task state machine — the test pyramid base SURVEY.md §4
+says the reference lacks (created→running→completed/failed transitions +
+sorted-set bookkeeping mirroring ``CacheConnectorUpsert.cs:133-142``)."""
+
+import threading
+
+import pytest
+
+from ai4e_tpu.taskstore import (
+    APITask,
+    InMemoryTaskStore,
+    JournaledTaskStore,
+    TaskNotFound,
+    TaskStatus,
+)
+
+
+def make_task(**kw):
+    defaults = dict(endpoint="http://host/v1/landcover/classify", body=b'{"x":1}')
+    defaults.update(kw)
+    return APITask(**defaults)
+
+
+class TestLifecycle:
+    def test_create_assigns_id_and_created_status(self):
+        store = InMemoryTaskStore()
+        t = store.upsert(make_task())
+        assert t.task_id
+        got = store.get(t.task_id)
+        assert got.status == TaskStatus.CREATED
+        assert got.endpoint_path == "/v1/landcover/classify"
+
+    def test_full_transition_chain(self):
+        store = InMemoryTaskStore()
+        t = store.upsert(make_task())
+        path = t.endpoint_path
+        assert store.set_members(path, "created") == [t.task_id]
+
+        store.update_status(t.task_id, "running - model executing")
+        assert store.set_len(path, "created") == 0
+        assert store.set_members(path, "running") == [t.task_id]
+        assert store.get(t.task_id).canonical_status == TaskStatus.RUNNING
+
+        store.update_status(t.task_id, "completed - 3 animals found")
+        assert store.set_len(path, "running") == 0
+        assert store.set_members(path, "completed") == [t.task_id]
+
+    def test_failure_transition(self):
+        store = InMemoryTaskStore()
+        t = store.upsert(make_task())
+        store.update_status(t.task_id, "failed: boom")
+        assert store.get(t.task_id).canonical_status == TaskStatus.FAILED
+        assert store.set_len(t.endpoint_path, "failed") == 1
+
+    def test_update_unknown_task_raises(self):
+        with pytest.raises(TaskNotFound):
+            InMemoryTaskStore().update_status("nope", "running")
+
+    def test_get_unknown_task_raises(self):
+        with pytest.raises(TaskNotFound):
+            InMemoryTaskStore().get("nope")
+
+    def test_status_canonicalisation(self):
+        assert TaskStatus.canonical("Awaiting service availability") == "created"
+        assert TaskStatus.canonical("task failed - oom") == "failed"
+        assert TaskStatus.canonical("Completed.") == "completed"
+        assert TaskStatus.canonical("running (batch 2/5)") == "running"
+
+
+class TestSortedSets:
+    def test_members_ordered_by_score(self):
+        store = InMemoryTaskStore()
+        ids = [store.upsert(make_task()).task_id for _ in range(5)]
+        assert store.set_members("/v1/landcover/classify", "created") == ids
+
+    def test_depths_per_endpoint(self):
+        store = InMemoryTaskStore()
+        store.upsert(make_task())
+        t2 = store.upsert(make_task(endpoint="http://host/v1/detector"))
+        store.update_status(t2.task_id, "running")
+        d = store.depths()
+        assert d["/v1/landcover/classify"]["created"] == 1
+        assert d["/v1/detector"]["running"] == 1
+        assert d["/v1/detector"]["created"] == 0
+
+
+class TestPublish:
+    def test_publish_true_invokes_publisher(self):
+        published = []
+        store = InMemoryTaskStore(publisher=published.append)
+        t = store.upsert(make_task(publish=True))
+        assert [p.task_id for p in published] == [t.task_id]
+
+    def test_publish_false_does_not_invoke(self):
+        published = []
+        store = InMemoryTaskStore(publisher=published.append)
+        store.upsert(make_task(publish=False))
+        assert published == []
+
+    def test_publish_failure_fails_task(self):
+        # CacheConnectorUpsert.cs:183-199 — broker down must not lose the task
+        # silently; it rolls to failed.
+        def boom(_):
+            raise RuntimeError("broker down")
+
+        store = InMemoryTaskStore(publisher=boom)
+        t = store.upsert(make_task(publish=True))
+        assert store.get(t.task_id).canonical_status == TaskStatus.FAILED
+
+    def test_pipeline_replays_original_body(self):
+        # CacheConnectorUpsert.cs:144-176: empty body on a publishing upsert of
+        # an existing task replays {taskId}_ORIG.
+        published = []
+        store = InMemoryTaskStore(publisher=published.append)
+        t = store.upsert(make_task(body=b"ORIGINAL", publish=True))
+        hop = APITask(
+            task_id=t.task_id, endpoint="http://host/v1/classifier", body=b"", publish=True
+        )
+        store.upsert(hop)
+        assert published[-1].body == b"ORIGINAL"
+        assert store.get(t.task_id).endpoint_path == "/v1/classifier"
+
+
+class TestConcurrency:
+    def test_parallel_transitions_keep_sets_consistent(self):
+        store = InMemoryTaskStore()
+        tasks = [store.upsert(make_task()) for _ in range(50)]
+
+        def flip(t):
+            store.update_status(t.task_id, "running")
+            store.update_status(t.task_id, "completed")
+
+        threads = [threading.Thread(target=flip, args=(t,)) for t in tasks]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        path = tasks[0].endpoint_path
+        assert store.set_len(path, "created") == 0
+        assert store.set_len(path, "running") == 0
+        assert store.set_len(path, "completed") == 50
+
+
+class TestJournal:
+    def test_restart_replays_state(self, tmp_path):
+        journal = str(tmp_path / "tasks.jsonl")
+        store = JournaledTaskStore(journal)
+        t1 = store.upsert(make_task(body=b"abc"))
+        t2 = store.upsert(make_task())
+        store.update_status(t1.task_id, "completed")
+        store.close()
+
+        revived = JournaledTaskStore(journal)
+        assert revived.get(t1.task_id).canonical_status == TaskStatus.COMPLETED
+        assert revived.get(t2.task_id).canonical_status == TaskStatus.CREATED
+        assert revived.get_original_body(t1.task_id) == b"abc"
+        path = t1.endpoint_path
+        assert revived.set_len(path, "completed") == 1
+        assert revived.set_len(path, "created") == 1

@@ -1,0 +1,152 @@
+"""HTTP task-store service + HttpTaskManager client tests — the multi-host
+path (services on other hosts sharing one store, the reference's
+CACHE_CONNECTOR_*_URI pattern)."""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.service.task_manager import HttpTaskManager
+from ai4e_tpu.taskstore import InMemoryTaskStore
+from ai4e_tpu.taskstore.http import make_app
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def manager_for(store):
+    client = TestClient(TestServer(make_app(store)))
+    await client.start_server()
+    tm = HttpTaskManager(str(client.make_url("")), session=client.session)
+    return client, tm
+
+
+class TestHttpTaskManager:
+    def test_add_and_poll(self):
+        store = InMemoryTaskStore()
+
+        async def main():
+            client, tm = await manager_for(store)
+            try:
+                task = await tm.add_task("http://h/v1/api", b'{"x":1}')
+                got = await tm.get_task_status(task["TaskId"])
+                assert got["Status"] == "created"
+                assert store.get(task["TaskId"]).body == b'{"x":1}'
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_binary_body_survives_json_roundtrip(self):
+        # JPEG magic bytes are not valid UTF-8; surrogateescape must carry
+        # them through the JSON wire format intact.
+        store = InMemoryTaskStore()
+        payload = b"\xff\xd8\xff\xe0\x00\x10JFIF\x00"
+
+        async def main():
+            client, tm = await manager_for(store)
+            try:
+                task = await tm.add_task("http://h/v1/api", payload)
+                assert store.get(task["TaskId"]).body == payload
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_status_updates_are_atomic_server_side(self):
+        store = InMemoryTaskStore()
+
+        async def main():
+            client, tm = await manager_for(store)
+            try:
+                task = await tm.add_task("http://h/v1/api", b"x")
+                tid = task["TaskId"]
+                await tm.update_task_status(tid, "running")
+                await tm.complete_task(tid, "completed - ok")
+                got = await tm.get_task_status(tid)
+                assert got["Status"] == "completed - ok"
+                assert store.get(tid).endpoint == "http://h/v1/api"  # preserved
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_update_unknown_task_raises_keyerror(self):
+        store = InMemoryTaskStore()
+
+        async def main():
+            client, tm = await manager_for(store)
+            try:
+                with pytest.raises(KeyError):
+                    await tm.update_task_status("no-such-task", "running")
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_get_unknown_task_returns_none(self):
+        store = InMemoryTaskStore()
+
+        async def main():
+            client, tm = await manager_for(store)
+            try:
+                assert await tm.get_task_status("missing") is None
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_content_type_preserved_over_wire(self):
+        store = InMemoryTaskStore()
+
+        async def main():
+            client, tm = await manager_for(store)
+            try:
+                from ai4e_tpu.taskstore import APITask
+                task = APITask(endpoint="http://h/v1/api", body=b"\x00\x01",
+                               content_type="image/jpeg")
+                result = await tm._upsert(task)
+                assert store.get(result["TaskId"]).content_type == "image/jpeg"
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_pipeline_over_http(self):
+        store = InMemoryTaskStore()
+        published = []
+        store.set_publisher(published.append)
+
+        async def main():
+            client, tm = await manager_for(store)
+            try:
+                task = await tm.add_task("http://h/v1/detector", b"IMG",
+                                         publish=True)
+                tid = task["TaskId"]
+                await tm.add_pipeline_task(tid, "http://h/v1/classifier")
+                assert published[-1].body == b"IMG"  # original body replayed
+                assert store.get(tid).endpoint_path == "/v1/classifier"
+            finally:
+                await client.close()
+
+        run(main())
+
+
+class TestDepthsEndpoint:
+    def test_depths(self):
+        store = InMemoryTaskStore()
+
+        async def main():
+            client, tm = await manager_for(store)
+            try:
+                await tm.add_task("http://h/v1/api", b"a")
+                await tm.add_task("http://h/v1/api", b"b")
+                resp = await client.get("/v1/taskstore/depths")
+                depths = await resp.json()
+                assert depths["/v1/api"]["created"] == 2
+            finally:
+                await client.close()
+
+        run(main())
