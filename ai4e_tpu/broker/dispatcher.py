@@ -1,0 +1,185 @@
+"""Dispatcher — drains endpoint queues and pushes tasks to backend services.
+
+The reference runs one Service-Bus-triggered function app per endpoint queue
+(``ProcessManager/BackendQueueProcessor/BackendQueueProcessor.cs:27-81``) that
+POSTs the task body to the backend URI with a ``taskId`` header and implements
+backpressure-aware retry:
+
+- backend 429 (or our 503) — backend at its concurrency cap — update the task
+  to "Awaiting service availability", wait ``retry_delay``, abandon the message
+  so the broker redelivers (``BackendQueueProcessor.cs:54-64``);
+- other failures — complete the message (no redelivery) and fail the task
+  (``:65-70``);
+- success — complete; the backend drives the task's status from there.
+
+Delivery is serial per queue by default (``BackendQueueProcessor/host.json:3-12``
+pins prefetch=1, maxConcurrentCalls=1) — here that's ``concurrency=1`` —
+but unlike the reference the concurrency is configurable per dispatcher, which
+is how request-level fan-out to a pool of TPU workers scales.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import aiohttp
+
+from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from ..service.task_manager import TaskManagerBase
+from ..taskstore import TaskStatus
+from .queue import InMemoryBroker, Message
+
+log = logging.getLogger("ai4e_tpu.dispatcher")
+
+# Backend saturation signals: the reference checks 429 TooManyRequests
+# (BackendQueueProcessor.cs:54); our service shell emits 503 for the same
+# condition (ai4e_service.py:122-125 does too) — treat both as backpressure.
+BACKPRESSURE_CODES = (429, 503)
+AWAITING_STATUS = "Awaiting service availability"
+
+
+class Dispatcher:
+    """Drains one endpoint queue, POSTing each task to ``backend_uri``."""
+
+    def __init__(
+        self,
+        broker: InMemoryBroker,
+        queue_name: str,
+        backend_uri: str,
+        task_manager: TaskManagerBase,
+        retry_delay: float = 60.0,
+        concurrency: int = 1,
+        request_timeout: float = 300.0,
+        metrics: MetricsRegistry | None = None,
+    ):
+        self.broker = broker
+        self.queue_name = queue_name
+        self.backend_uri = backend_uri
+        self.task_manager = task_manager
+        self.retry_delay = retry_delay
+        self.concurrency = concurrency
+        self.request_timeout = request_timeout
+        self.metrics = metrics or DEFAULT_REGISTRY
+        self._dispatched = self.metrics.counter(
+            "ai4e_dispatch_total", "Dispatch attempts by outcome")
+        self._stop = asyncio.Event()
+        self._workers: list[asyncio.Task] = []
+        self._session: aiohttp.ClientSession | None = None
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.request_timeout))
+        self._workers = [
+            asyncio.get_running_loop().create_task(self._run(i))
+            for i in range(self.concurrency)
+        ]
+
+    async def stop(self) -> None:
+        self._stop.set()
+        for w in self._workers:
+            w.cancel()
+        await asyncio.gather(*self._workers, return_exceptions=True)
+        if self._session is not None:
+            await self._session.close()
+
+    async def _run(self, worker_idx: int) -> None:
+        while not self._stop.is_set():
+            msg = await self.broker.receive(self.queue_name, timeout=1.0)
+            if msg is None:
+                continue
+            try:
+                await self._dispatch_one(msg)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — dispatcher must never die
+                log.exception("dispatch of task %s crashed; redelivering", msg.task_id)
+                if not self.broker.abandon(msg):
+                    self._dispatched.inc(outcome="dead_letter", queue=self.queue_name)
+                    await self._try_update(
+                        msg.task_id, "failed - delivery attempts exhausted",
+                        TaskStatus.FAILED)
+
+    async def _dispatch_one(self, msg: Message) -> None:
+        try:
+            async with self._session.post(
+                self.backend_uri,
+                data=msg.body,
+                headers={"taskId": msg.task_id},
+            ) as resp:
+                status = resp.status
+                await resp.read()
+        except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+            # Backend unreachable — treat like saturation: the pod may be
+            # restarting; broker patience (max deliveries) bounds total retry.
+            log.warning("backend %s unreachable (%s); will redeliver",
+                        self.backend_uri, exc)
+            await self._backpressure(msg)
+            return
+
+        if 200 <= status < 300:
+            self.broker.complete(msg)
+            self._dispatched.inc(outcome="delivered", queue=self.queue_name)
+        elif status in BACKPRESSURE_CODES:
+            await self._backpressure(msg)
+        else:
+            # Permanent failure: complete (no redelivery) + fail the task
+            # (BackendQueueProcessor.cs:65-70).
+            self.broker.complete(msg)
+            self._dispatched.inc(outcome="failed", queue=self.queue_name)
+            await self._try_update(
+                msg.task_id,
+                f"failed - backend returned {status}",
+                TaskStatus.FAILED,
+            )
+
+    async def _backpressure(self, msg: Message) -> None:
+        self._dispatched.inc(outcome="backpressure", queue=self.queue_name)
+        await self._try_update(msg.task_id, AWAITING_STATUS, TaskStatus.CREATED)
+        await asyncio.sleep(self.retry_delay)
+        if not self.broker.abandon(msg):
+            # Dead-lettered: out of delivery budget.
+            self._dispatched.inc(outcome="dead_letter", queue=self.queue_name)
+            await self._try_update(
+                msg.task_id, "failed - delivery attempts exhausted",
+                TaskStatus.FAILED)
+
+    async def _try_update(self, task_id: str, status: str, backend: str) -> None:
+        try:
+            await self.task_manager.update_task_status(task_id, status,
+                                                       backend_status=backend)
+        except Exception:  # noqa: BLE001
+            log.exception("could not update task %s to %r", task_id, status)
+
+
+class DispatcherPool:
+    """One dispatcher per registered endpoint — the analogue of deploying one
+    BackendQueueProcessor function app per queue path
+    (``deploy_backend_queue_function.sh:17-130``), minus the ops overhead:
+    registration is a dict entry, not a deployment."""
+
+    def __init__(self, broker: InMemoryBroker, task_manager: TaskManagerBase,
+                 retry_delay: float = 60.0, concurrency: int = 1):
+        self.broker = broker
+        self.task_manager = task_manager
+        self.retry_delay = retry_delay
+        self.concurrency = concurrency
+        self.dispatchers: dict[str, Dispatcher] = {}
+
+    def register(self, queue_name: str, backend_uri: str,
+                 retry_delay: float | None = None,
+                 concurrency: int | None = None) -> Dispatcher:
+        d = Dispatcher(
+            self.broker, queue_name, backend_uri, self.task_manager,
+            retry_delay=self.retry_delay if retry_delay is None else retry_delay,
+            concurrency=self.concurrency if concurrency is None else concurrency,
+        )
+        self.dispatchers[queue_name] = d
+        return d
+
+    async def start(self) -> None:
+        for d in self.dispatchers.values():
+            await d.start()
+
+    async def stop(self) -> None:
+        await asyncio.gather(*(d.stop() for d in self.dispatchers.values()))

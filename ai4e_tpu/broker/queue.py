@@ -1,0 +1,200 @@
+"""Per-endpoint durable message queues with lease/redelivery semantics.
+
+Replaces Azure Service Bus / Event Grid as the platform's async transport
+(``ProcessManager/CacheManager/CacheConnectorUpsert.cs:263-303`` publishes one
+message per task to a queue named after the endpoint;
+``InfrastructureDeployment/deploy_servicebus_queue.sh:28-42`` provisions one
+queue per API path with max delivery count 1440). Semantics preserved:
+
+- one logical queue per endpoint path;
+- at-least-once delivery: a consumer *leases* a message (``receive``), then
+  either ``complete``s it (done) or ``abandon``s it (redeliver — the
+  reference's 429 path, ``BackendQueueProcessor.cs:54-64``);
+- a lease that expires without complete/abandon is redelivered too (crashed
+  dispatcher);
+- per-message delivery count; past ``max_delivery_count`` the message is
+  dead-lettered and a callback can fail the task.
+
+The implementation is asyncio-native. The interface is deliberately small so
+the C++ broker core (``native/``) can slot in behind the same methods.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable
+
+from ..taskstore import endpoint_path as canonical_path
+
+
+@dataclass
+class Message:
+    task_id: str
+    endpoint: str
+    body: bytes = b""
+    enqueued_at: float = field(default_factory=time.time)
+    delivery_count: int = 0
+    seq: int = 0
+    lease_expires: float = 0.0
+
+    @property
+    def queue_name(self) -> str:
+        return canonical_path(self.endpoint)
+
+
+DeadLetterHandler = Callable[[Message], Awaitable[None]]
+
+
+class EndpointQueue:
+    """Single endpoint's FIFO with leases. Not thread-safe — event-loop only."""
+
+    def __init__(self, name: str, max_delivery_count: int = 1440,
+                 lease_seconds: float = 300.0):
+        self.name = name
+        self.max_delivery_count = max_delivery_count
+        self.lease_seconds = lease_seconds
+        self._ready: list[Message] = []
+        self._leased: dict[int, Message] = {}
+        self._waiters: list[asyncio.Future] = []
+        self.dead_letters: list[Message] = []
+
+    def __len__(self) -> int:
+        return len(self._ready)
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._leased)
+
+    def _wake_one(self) -> None:
+        while self._waiters:
+            fut = self._waiters.pop(0)
+            if not fut.done():
+                fut.set_result(None)
+                return
+
+    def put(self, msg: Message) -> None:
+        self._ready.append(msg)
+        self._wake_one()
+
+    async def receive(self, timeout: float | None = None) -> Message | None:
+        """Lease the next message; None on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            self._reap_expired_leases()
+            if self._ready:
+                msg = self._ready.pop(0)
+                msg.delivery_count += 1
+                msg.lease_expires = time.time() + self.lease_seconds
+                self._leased[msg.seq] = msg
+                return msg
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._waiters.append(fut)
+            try:
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                await asyncio.wait_for(fut, remaining)
+            except asyncio.TimeoutError:
+                if fut in self._waiters:
+                    self._waiters.remove(fut)
+                return None
+
+    def complete(self, msg: Message) -> None:
+        if self._leased.pop(msg.seq, None) is None:
+            # Lease expired mid-processing and the reaper requeued the
+            # message; retract it so a successfully-processed message is not
+            # delivered again.
+            self._ready = [m for m in self._ready if m.seq != msg.seq]
+
+    def abandon(self, msg: Message) -> bool:
+        """Return the message for redelivery. False (dead-lettered) once the
+        delivery count is exhausted — ≈24 h of patience at the reference's
+        60 s retry delay (setup_env.sh:65,74)."""
+        if self._leased.pop(msg.seq, None) is None:
+            # Lease already expired: the reaper has requeued (or
+            # dead-lettered) the message; re-appending here would duplicate
+            # delivery and double-burn the delivery budget.
+            return not any(m.seq == msg.seq for m in self.dead_letters)
+        if msg.delivery_count >= self.max_delivery_count:
+            self.dead_letters.append(msg)
+            return False
+        self._ready.append(msg)
+        self._wake_one()
+        return True
+
+    def _reap_expired_leases(self) -> None:
+        now = time.time()
+        expired = [m for m in self._leased.values() if m.lease_expires <= now]
+        for msg in expired:
+            del self._leased[msg.seq]
+            if msg.delivery_count >= self.max_delivery_count:
+                self.dead_letters.append(msg)
+            else:
+                self._ready.append(msg)
+
+
+class InMemoryBroker:
+    """Queue manager: one ``EndpointQueue`` per endpoint path.
+
+    ``publish`` is the store's publisher hook (the reference couples them the
+    same way: CacheConnectorUpsert publishes on upsert,
+    ``CacheConnectorUpsert.cs:178-202``). Thread-safe on the publish side:
+    sync callers (the store runs publishers under its lock on arbitrary
+    threads) hand off to the loop via ``call_soon_threadsafe``.
+    """
+
+    def __init__(self, max_delivery_count: int = 1440,
+                 lease_seconds: float = 300.0):
+        self.max_delivery_count = max_delivery_count
+        self.lease_seconds = lease_seconds
+        self._queues: dict[str, EndpointQueue] = {}
+        self._seq = itertools.count(1)
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+    def bind_loop(self, loop: asyncio.AbstractEventLoop | None = None) -> None:
+        self._loop = loop or asyncio.get_event_loop()
+
+    def queue(self, name: str) -> EndpointQueue:
+        q = self._queues.get(name)
+        if q is None:
+            q = self._queues[name] = EndpointQueue(
+                name, self.max_delivery_count, self.lease_seconds)
+        return q
+
+    def queue_names(self) -> list[str]:
+        return sorted(self._queues)
+
+    def depths(self) -> dict[str, int]:
+        return {name: len(q) for name, q in self._queues.items()}
+
+    # -- publish side ------------------------------------------------------
+
+    def publish(self, task) -> None:
+        """Store publisher hook: enqueue a dispatch message for the task.
+
+        Callable from any thread; the enqueue itself happens on the broker's
+        event loop.
+        """
+        msg = Message(task_id=task.task_id, endpoint=task.endpoint,
+                      body=task.body, seq=next(self._seq))
+        loop = self._loop
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if loop is None or loop is running:
+            self.queue(msg.queue_name).put(msg)
+        else:
+            loop.call_soon_threadsafe(self.queue(msg.queue_name).put, msg)
+
+    # -- consume side ------------------------------------------------------
+
+    async def receive(self, queue_name: str, timeout: float | None = None) -> Message | None:
+        return await self.queue(queue_name).receive(timeout)
+
+    def complete(self, msg: Message) -> None:
+        self.queue(msg.queue_name).complete(msg)
+
+    def abandon(self, msg: Message) -> bool:
+        return self.queue(msg.queue_name).abandon(msg)

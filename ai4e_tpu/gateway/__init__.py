@@ -1,0 +1,3 @@
+from .router import Gateway, Route
+
+__all__ = ["Gateway", "Route"]

@@ -1,0 +1,148 @@
+"""Gateway — the platform's front door.
+
+Re-design of the reference's Azure API Management layer (L1). The APIM inbound
+policy for an async API builds a task record at the edge and returns the
+TaskId synchronously while the transport delivers the work
+(``APIManagement/request_policy.xml:3-36``); sync APIs pass straight through to
+the cluster ingress (``request_backend_policy.xml:1-16``); task polling hits
+the store (``task_management_policy.xml:1-18``). Here those three policies are
+one aiohttp app with a programmatic route table instead of az-CLI-deployed XML
+(``APIManagement/create_async_api_management_api.sh:52-80``).
+
+Routes:
+- ``POST {route.prefix}/…``  (async) → upsert task {Status: created, Endpoint,
+  Body, publish: True} → broker; respond 200 with the task JSON immediately;
+- ``ANY  {route.prefix}/…``  (sync)  → reverse-proxy to the backend;
+- ``GET  /v1/taskmanagement/task/{taskId}`` → task record (404 unknown);
+- ``GET  /metrics``, ``GET /healthz``.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+import aiohttp
+from aiohttp import web
+
+from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from ..taskstore import APITask, InMemoryTaskStore, TaskNotFound
+from ..utils.http import SessionHolder
+
+log = logging.getLogger("ai4e_tpu.gateway")
+
+
+@dataclass
+class Route:
+    """One published API. ``prefix`` is the public path; async routes create
+    tasks, sync routes proxy to ``backend_uri`` (VirtualService rewrite
+    semantics, ``APIs/Charts/templates/routing.yml:1-28``)."""
+
+    prefix: str
+    mode: str  # "sync" | "async"
+    backend_uri: str = ""  # sync: proxy target; async: recorded task endpoint
+
+
+class Gateway:
+    def __init__(self, store: InMemoryTaskStore,
+                 metrics: MetricsRegistry | None = None):
+        self.store = store
+        self.metrics = metrics or DEFAULT_REGISTRY
+        self.routes: list[Route] = []
+        self._requests = self.metrics.counter(
+            "ai4e_gateway_requests_total", "Gateway requests by route/outcome")
+        self._sessions = SessionHolder()
+
+        self.app = web.Application(client_max_size=1024**3)
+        self.app.router.add_get("/v1/taskmanagement/task/{task_id}", self._task)
+        self.app.router.add_get("/healthz", self._health)
+        self.app.router.add_get("/metrics", self._metrics)
+        self.app.on_cleanup.append(self._cleanup)
+
+    def add_async_route(self, prefix: str, task_endpoint: str) -> None:
+        """Register an async API: requests become tasks addressed to
+        ``task_endpoint`` (the backend route the dispatcher will POST to)."""
+        route = Route(prefix=prefix.rstrip("/"), mode="async",
+                      backend_uri=task_endpoint)
+        self.routes.append(route)
+        self.app.router.add_post(route.prefix, self._make_async_handler(route))
+        self.app.router.add_post(route.prefix + "/{tail:.*}",
+                                 self._make_async_handler(route))
+
+    def add_sync_route(self, prefix: str, backend_uri: str) -> None:
+        route = Route(prefix=prefix.rstrip("/"), mode="sync",
+                      backend_uri=backend_uri.rstrip("/"))
+        self.routes.append(route)
+        handler = self._make_sync_handler(route)
+        for pattern in (route.prefix, route.prefix + "/{tail:.*}"):
+            self.app.router.add_route("*", pattern, handler)
+
+    # -- async: edge task creation (request_policy.xml:8-28) ---------------
+
+    def _make_async_handler(self, route: Route):
+        async def handler(request: web.Request) -> web.Response:
+            body = await request.read()
+            task = self.store.upsert(APITask(
+                endpoint=route.backend_uri,
+                body=body,
+                content_type=request.content_type or "application/json",
+                publish=True,
+            ))
+            stored = self.store.get(task.task_id)
+            outcome = "failed" if stored.canonical_status == "failed" else "created"
+            self._requests.inc(route=route.prefix, outcome=outcome)
+            return web.json_response(stored.to_dict())
+
+        return handler
+
+    # -- sync: reverse proxy (request_backend_policy.xml:1-6) --------------
+
+    def _make_sync_handler(self, route: Route):
+        async def handler(request: web.Request) -> web.Response:
+            tail = request.match_info.get("tail", "")
+            target = route.backend_uri + (("/" + tail) if tail else "")
+            if request.query_string:
+                target += "?" + request.query_string
+            body = await request.read()
+            session = await self._get_session()
+            try:
+                async with session.request(
+                    request.method, target, data=body,
+                    headers={k: v for k, v in request.headers.items()
+                             if k.lower() not in ("host", "content-length")},
+                ) as resp:
+                    payload = await resp.read()
+                    self._requests.inc(route=route.prefix, outcome=str(resp.status))
+                    return web.Response(
+                        status=resp.status, body=payload,
+                        content_type=resp.content_type)
+            except aiohttp.ClientError as exc:
+                self._requests.inc(route=route.prefix, outcome="unreachable")
+                return web.Response(status=502, text=f"Backend unreachable: {exc}")
+
+        return handler
+
+    # -- task polling (task_management_policy.xml:3-7) ---------------------
+
+    async def _task(self, request: web.Request) -> web.Response:
+        try:
+            task = self.store.get(request.match_info["task_id"])
+        except TaskNotFound:
+            return web.Response(status=404, text="Task not found.")
+        return web.json_response(task.to_dict())
+
+    async def _health(self, _: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy", "routes": len(self.routes)})
+
+    async def _metrics(self, _: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render_prometheus(),
+                            content_type="text/plain")
+
+    async def _get_session(self) -> aiohttp.ClientSession:
+        return await self._sessions.get()
+
+    async def _cleanup(self, _app) -> None:
+        await self._sessions.close()
+
+    def run(self, host: str = "0.0.0.0", port: int = 8080) -> None:
+        web.run_app(self.app, host=host, port=port)

@@ -1,0 +1,114 @@
+"""Single-process platform assembly — store + broker + dispatchers + gateway.
+
+The reference wires its components together with 15 bash deployment scripts
+(``InfrastructureDeployment/deploy_infrastructure.sh:5-38``); this module is
+the same wiring as code, used by tests, local development, and single-host
+deployments. Multi-host deployments run the pieces separately (taskstore HTTP
+service + broker + gateway) — see ``deploy/``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+from .broker import DispatcherPool, InMemoryBroker
+from .gateway import Gateway
+from .metrics import DEFAULT_REGISTRY, MetricsRegistry
+from .service import APIService, LocalTaskManager
+from .taskstore import InMemoryTaskStore, JournaledTaskStore, endpoint_path
+
+
+@dataclass
+class PlatformConfig:
+    retry_delay: float = 60.0       # dispatcher backoff on 429/503 (setup_env.sh:74)
+    max_delivery_count: int = 1440  # broker patience (setup_env.sh:65)
+    dispatcher_concurrency: int = 1  # serial per queue (host.json:5-9)
+    journal_path: str | None = None  # None → pure in-memory store
+    lease_seconds: float = 300.0
+
+
+class LocalPlatform:
+    """Everything the async path needs, in one event loop.
+
+    Usage::
+
+        platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+        svc = platform.make_service("megadetector", prefix="v1/camera-trap")
+        ... register endpoints on svc ...
+        platform.publish_async_api("/v1/camera-trap/detect",
+                                   backend_uri="http://127.0.0.1:8083/v1/camera-trap/detect")
+        await platform.start()
+    """
+
+    def __init__(self, config: PlatformConfig | None = None,
+                 metrics: MetricsRegistry | None = None):
+        self.config = config or PlatformConfig()
+        self.metrics = metrics or DEFAULT_REGISTRY
+        if self.config.journal_path:
+            self.store = JournaledTaskStore(self.config.journal_path)
+        else:
+            self.store = InMemoryTaskStore()
+        self.broker = InMemoryBroker(
+            max_delivery_count=self.config.max_delivery_count,
+            lease_seconds=self.config.lease_seconds)
+        self.store.set_publisher(self.broker.publish)
+        self.task_manager = LocalTaskManager(self.store)
+        self.dispatchers = DispatcherPool(
+            self.broker, self.task_manager,
+            retry_delay=self.config.retry_delay,
+            concurrency=self.config.dispatcher_concurrency)
+        self.gateway = Gateway(self.store, metrics=self.metrics)
+        self.services: list[APIService] = []
+        self._started = False
+
+    # -- assembly ----------------------------------------------------------
+
+    def make_service(self, name: str, prefix: str = "") -> APIService:
+        svc = APIService(name, prefix=prefix,
+                         task_manager=self.task_manager, metrics=self.metrics)
+        self.services.append(svc)
+        return svc
+
+    def publish_async_api(self, public_prefix: str, backend_uri: str,
+                          retry_delay: float | None = None,
+                          concurrency: int | None = None) -> None:
+        """Register an async API end-to-end: gateway route + dispatcher for
+        its queue (the reference needs an APIM operation + a Service Bus queue
+        + a function app per API; here it's one call)."""
+        self.gateway.add_async_route(public_prefix, backend_uri)
+        self.dispatchers.register(endpoint_path(backend_uri), backend_uri,
+                                  retry_delay=retry_delay,
+                                  concurrency=concurrency)
+
+    def publish_sync_api(self, public_prefix: str, backend_uri: str) -> None:
+        self.gateway.add_sync_route(public_prefix, backend_uri)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self.broker.bind_loop(asyncio.get_running_loop())
+        await self.dispatchers.start()
+        self._reseed_unfinished()
+        self._started = True
+
+    def _reseed_unfinished(self) -> None:
+        """Re-enqueue tasks restored from the journal in a non-terminal state
+        — the redelivery the reference gets from Service Bus persistence
+        (autoComplete:false, BackendQueueProcessor/host.json:7): a crashed
+        worker's task is dispatched again on platform restart. Only
+        journal-*restored* tasks are re-seeded; tasks created in this process
+        already have their broker message."""
+        restored = getattr(self.store, "replayed_task_ids", None)
+        if not restored:
+            return
+        for task in self.store.unfinished_tasks():
+            if task.task_id in restored:
+                self.broker.publish(task)
+
+    async def stop(self) -> None:
+        if self._started:
+            await self.dispatchers.stop()
+            self._started = False
+        for svc in self.services:
+            await svc.drain(timeout=5.0)

@@ -22,6 +22,7 @@ from urllib.parse import urlparse
 import aiohttp
 
 from ..taskstore import APITask, InMemoryTaskStore, TaskNotFound, TaskStatus
+from ..utils.http import SessionHolder
 
 
 class TaskManagerBase:
@@ -41,14 +42,15 @@ class TaskManagerBase:
             task_id=task_id or "", endpoint=endpoint, body=body, publish=publish,
         ))
 
-    async def update_task_status(self, task_id: str, status: str) -> dict:
-        return await self._update(task_id, status)
+    async def update_task_status(self, task_id: str, status: str,
+                                 backend_status: str | None = None) -> dict:
+        return await self._update(task_id, status, backend_status)
 
     async def complete_task(self, task_id: str, status: str = "completed") -> dict:
-        return await self._update(task_id, status)
+        return await self._update(task_id, status, TaskStatus.COMPLETED)
 
     async def fail_task(self, task_id: str, status: str = "failed") -> dict:
-        return await self._update(task_id, status)
+        return await self._update(task_id, status, TaskStatus.FAILED)
 
     async def add_pipeline_task(self, task_id: str, next_endpoint: str,
                                 body: bytes = b"") -> dict:
@@ -70,7 +72,8 @@ class TaskManagerBase:
     async def _upsert(self, task: APITask) -> dict:
         raise NotImplementedError
 
-    async def _update(self, task_id: str, status: str) -> dict:
+    async def _update(self, task_id: str, status: str,
+                      backend_status: str | None = None) -> dict:
         raise NotImplementedError
 
 
@@ -88,8 +91,9 @@ class LocalTaskManager(TaskManagerBase):
         # Distinguish create vs. pipeline transition the way the store does.
         return self.store.upsert(task).to_dict()
 
-    async def _update(self, task_id: str, status: str) -> dict:
-        return self.store.update_status(task_id, status).to_dict()
+    async def _update(self, task_id: str, status: str,
+                      backend_status: str | None = None) -> dict:
+        return self.store.update_status(task_id, status, backend_status).to_dict()
 
 
 class HttpTaskManager(TaskManagerBase):
@@ -97,16 +101,13 @@ class HttpTaskManager(TaskManagerBase):
 
     def __init__(self, base_url: str, session: aiohttp.ClientSession | None = None):
         self.base_url = base_url.rstrip("/")
-        self._session = session
+        self._holder = SessionHolder(session)
 
     async def _get_session(self) -> aiohttp.ClientSession:
-        if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession()
-        return self._session
+        return await self._holder.get()
 
     async def close(self) -> None:
-        if self._session is not None and not self._session.closed:
-            await self._session.close()
+        await self._holder.close()
 
     async def get_task_status(self, task_id: str) -> dict | None:
         session = await self._get_session()
@@ -128,13 +129,14 @@ class HttpTaskManager(TaskManagerBase):
             resp.raise_for_status()
             return await resp.json()
 
-    async def _update(self, task_id: str, status: str) -> dict:
+    async def _update(self, task_id: str, status: str,
+                      backend_status: str | None = None) -> dict:
         # Atomic server-side transition — no GET-then-POST race
         # (unlike the reference's _UpdateTaskStatus, distributed_api_task.py:29-56).
         payload = {
             "TaskId": task_id,
             "Status": status,
-            "BackendStatus": TaskStatus.canonical(status),
+            "BackendStatus": backend_status or TaskStatus.canonical(status),
         }
         session = await self._get_session()
         async with session.post(

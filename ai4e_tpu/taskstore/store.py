@@ -29,6 +29,8 @@ import threading
 import time
 from typing import Callable, Iterable
 
+from dataclasses import replace
+
 from .task import APITask, TaskStatus, new_task_id
 
 Publisher = Callable[[APITask], None]
@@ -75,21 +77,7 @@ class InMemoryTaskStore:
           marked failed instead of raising to the caller.
         """
         with self._lock:
-            prev = self._tasks.get(task.task_id)
-            if prev is None:
-                if not task.task_id:
-                    task.task_id = new_task_id()
-                if task.body:
-                    self._orig_bodies[task.task_id] = task.body
-            else:
-                if not task.body and task.publish:
-                    # Subsequent pipeline call: replay the original body
-                    # (CacheConnectorUpsert.cs:144-176).
-                    task.body = self._orig_bodies.get(task.task_id, b"")
-                self._remove_from_set(prev)
-            task.timestamp = time.time()
-            self._tasks[task.task_id] = task
-            self._add_to_set(task)
+            task = self._apply_upsert(task)
             publisher = self._publisher if task.publish else None
 
         if publisher is not None:
@@ -103,6 +91,26 @@ class InMemoryTaskStore:
                 )
         return task
 
+    def _apply_upsert(self, task: APITask) -> APITask:
+        """State mutation for upsert. Caller holds ``self._lock``; subclasses
+        extend this to journal atomically with the mutation."""
+        prev = self._tasks.get(task.task_id)
+        if prev is None:
+            if not task.task_id:
+                task.task_id = new_task_id()
+            if task.body:
+                self._orig_bodies[task.task_id] = task.body
+        else:
+            if not task.body and task.publish:
+                # Subsequent pipeline call: replay the original body
+                # (CacheConnectorUpsert.cs:144-176).
+                task.body = self._orig_bodies.get(task.task_id, b"")
+            self._remove_from_set(prev)
+        task.timestamp = time.time()
+        self._tasks[task.task_id] = task
+        self._add_to_set(task)
+        return task
+
     def update_status(
         self, task_id: str, status: str, backend_status: str | None = None
     ) -> APITask:
@@ -110,15 +118,21 @@ class InMemoryTaskStore:
         reference's ``_UpdateTaskStatus`` GET-then-POST at
         ``distributed_api_task.py:29-56`` is racy; SURVEY.md §5 flags it)."""
         with self._lock:
-            prev = self._tasks.get(task_id)
-            if prev is None:
-                raise TaskNotFound(task_id)
-            task = prev.with_status(status, backend_status)
-            task.publish = False
-            self._remove_from_set(prev)
-            self._tasks[task_id] = task
-            self._add_to_set(task)
-            return task
+            return self._apply_update(task_id, status, backend_status)
+
+    def _apply_update(
+        self, task_id: str, status: str, backend_status: str | None
+    ) -> APITask:
+        """State mutation for update. Caller holds ``self._lock``."""
+        prev = self._tasks.get(task_id)
+        if prev is None:
+            raise TaskNotFound(task_id)
+        task = prev.with_status(status, backend_status)
+        task.publish = False
+        self._remove_from_set(prev)
+        self._tasks[task_id] = task
+        self._add_to_set(task)
+        return task
 
     def get(self, task_id: str) -> APITask:
         with self._lock:
@@ -171,6 +185,20 @@ class InMemoryTaskStore:
         with self._lock:
             return list(self._tasks.values())
 
+    def unfinished_tasks(self) -> list[APITask]:
+        """Tasks in a non-terminal state (created/awaiting/running) — what a
+        restarted platform must re-dispatch. Bodies are restored from the
+        original-body record so redelivery carries the real payload."""
+        with self._lock:
+            out = []
+            for task in self._tasks.values():
+                if task.canonical_status in TaskStatus.TERMINAL:
+                    continue
+                if not task.body:
+                    task = replace(task, body=self._orig_bodies.get(task.task_id, b""))
+                out.append(task)
+            return out
+
 
 class JournaledTaskStore(InMemoryTaskStore):
     """InMemoryTaskStore + append-only JSONL journal for crash recovery.
@@ -184,9 +212,11 @@ class JournaledTaskStore(InMemoryTaskStore):
     def __init__(self, journal_path: str, publisher: Publisher | None = None):
         super().__init__(publisher)
         self._journal_path = journal_path
-        self._journal_lock = threading.Lock()
+        self._journal = None  # gate journaling off during replay
+        self.replayed_task_ids: set[str] = set()
         if os.path.exists(journal_path):
             self._replay()
+            self.replayed_task_ids = set(self._tasks)
         self._journal = open(journal_path, "a", encoding="utf-8")  # noqa: SIM115
 
     def _replay(self) -> None:
@@ -198,34 +228,40 @@ class JournaledTaskStore(InMemoryTaskStore):
                 rec = json.loads(line)
                 task = APITask.from_dict(rec)
                 task.body = bytes.fromhex(rec.get("BodyHex", ""))
-                task.publish = False  # never re-publish on replay; broker re-seeds
+                # Don't re-publish during replay — LocalPlatform.start()
+                # re-seeds the broker from unfinished_tasks() afterwards.
+                task.publish = False
                 super().upsert(task)
                 orig = rec.get("OrigHex")
                 if orig:
                     self._orig_bodies[task.task_id] = bytes.fromhex(orig)
 
     def _log(self, task: APITask) -> None:
+        # Called with self._lock held (from _apply_*): journal order is
+        # exactly mutation order, so replay reconstructs the true final state.
+        if self._journal is None:
+            return
         rec = task.to_dict()
         rec["BodyHex"] = task.body.hex()
         orig = self._orig_bodies.get(task.task_id)
         if orig is not None:
             rec["OrigHex"] = orig.hex()
-        with self._journal_lock:
-            self._journal.write(json.dumps(rec) + "\n")
-            self._journal.flush()
+        self._journal.write(json.dumps(rec) + "\n")
+        self._journal.flush()
 
-    def upsert(self, task: APITask) -> APITask:
-        task = super().upsert(task)
-        self._log(self.get(task.task_id))
+    def _apply_upsert(self, task: APITask) -> APITask:
+        task = super()._apply_upsert(task)
+        self._log(task)
         return task
 
-    def update_status(
-        self, task_id: str, status: str, backend_status: str | None = None
+    def _apply_update(
+        self, task_id: str, status: str, backend_status: str | None
     ) -> APITask:
-        task = super().update_status(task_id, status, backend_status)
+        task = super()._apply_update(task_id, status, backend_status)
         self._log(task)
         return task
 
     def close(self) -> None:
-        with self._journal_lock:
-            self._journal.close()
+        with self._lock:
+            if self._journal is not None:
+                self._journal.close()

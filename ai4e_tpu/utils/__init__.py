@@ -1,0 +1,3 @@
+from .http import SessionHolder
+
+__all__ = ["SessionHolder"]

@@ -1,0 +1,35 @@
+"""Shared aiohttp client-session management."""
+
+from __future__ import annotations
+
+import asyncio
+
+import aiohttp
+
+
+class SessionHolder:
+    """Lazily-created, recreate-if-closed ClientSession with a creation guard
+    so concurrent first calls can't leak an extra session."""
+
+    def __init__(self, session: aiohttp.ClientSession | None = None,
+                 timeout: float | None = None):
+        self._session = session
+        self._timeout = timeout
+        self._create_lock: asyncio.Lock | None = None
+
+    async def get(self) -> aiohttp.ClientSession:
+        if self._session is not None and not self._session.closed:
+            return self._session
+        if self._create_lock is None:
+            self._create_lock = asyncio.Lock()
+        async with self._create_lock:
+            if self._session is None or self._session.closed:
+                kw = {}
+                if self._timeout is not None:
+                    kw["timeout"] = aiohttp.ClientTimeout(total=self._timeout)
+                self._session = aiohttp.ClientSession(**kw)
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()

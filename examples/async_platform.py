@@ -1,0 +1,52 @@
+"""Full async platform in one process tree: gateway + task store + broker +
+dispatcher + a fake-inference backend service.
+
+Run:  python examples/async_platform.py [gateway_port] [backend_port]
+Then: TID=$(curl -s -X POST localhost:8080/v1/camera-trap/detect -d @image.jpg | jq -r .TaskId)
+      curl localhost:8080/v1/taskmanagement/task/$TID      # created → running → completed
+"""
+
+import asyncio
+import sys
+import time
+
+from aiohttp import web
+
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+
+
+async def main() -> None:
+    gw_port = int(sys.argv[1]) if len(sys.argv) > 1 else 8080
+    be_port = int(sys.argv[2]) if len(sys.argv) > 2 else 8083
+
+    platform = LocalPlatform(PlatformConfig(retry_delay=0.5))
+    svc = platform.make_service("detector", prefix="v1/detector")
+
+    @svc.api_async_func("/detect", maximum_concurrent_requests=2)
+    def detect(taskId, body, content_type):
+        async def drive():
+            await platform.task_manager.update_task_status(
+                taskId, "running - detector scoring image")
+            time.sleep(1.0)  # pretend long inference
+            await platform.task_manager.complete_task(
+                taskId, f"completed - scored {len(body)} bytes")
+        asyncio.run(drive())
+
+    backend_uri = f"http://127.0.0.1:{be_port}/v1/detector/detect"
+    platform.publish_async_api("/v1/camera-trap/detect", backend_uri)
+
+    svc_runner = web.AppRunner(svc.app)
+    await svc_runner.setup()
+    await web.TCPSite(svc_runner, "127.0.0.1", be_port).start()
+
+    gw_runner = web.AppRunner(platform.gateway.app)
+    await gw_runner.setup()
+    await web.TCPSite(gw_runner, "127.0.0.1", gw_port).start()
+
+    await platform.start()
+    print(f"gateway on :{gw_port}, backend on :{be_port}", flush=True)
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,245 @@
+"""End-to-end async-path tests over the whole platform: gateway → task store →
+broker → dispatcher → backend service → status poll — SURVEY.md §3.1's call
+stack in one event loop, plus pipelining (§3.4)."""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.service import next_endpoint_from
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def poll_until(client, task_id, predicate, tries=200, delay=0.02):
+    body = None
+    for _ in range(tries):
+        resp = await client.get(f"/v1/taskmanagement/task/{task_id}")
+        body = await resp.json()
+        if predicate(body):
+            return body
+        await asyncio.sleep(delay)
+    return body
+
+
+class TestAsyncE2E:
+    def test_full_async_lifecycle(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            svc = platform.make_service("detector", prefix="v1/detector")
+
+            @svc.api_async_func("/detect")
+            def detect(taskId, body, content_type):
+                asyncio.run(_work(taskId, body))
+
+            async def _work(task_id, body):
+                await platform.task_manager.update_task_status(task_id, "running")
+                await platform.task_manager.complete_task(
+                    task_id, f"completed - {len(body)} bytes scored")
+
+            svc_client = await serve(svc.app)
+            backend_uri = str(svc_client.make_url("/v1/detector/detect"))
+            platform.publish_async_api("/v1/camera-trap/detect", backend_uri)
+            gw_client = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                resp = await gw_client.post("/v1/camera-trap/detect",
+                                            data=b"JPEGDATA")
+                assert resp.status == 200
+                created = await resp.json()
+                task_id = created["TaskId"]
+                assert created["Status"] == "created"
+
+                final = await poll_until(
+                    gw_client, task_id, lambda b: "completed" in b["Status"])
+                assert final["Status"] == "completed - 8 bytes scored"
+            finally:
+                await platform.stop()
+                await gw_client.close()
+                await svc_client.close()
+
+        run(main())
+
+    def test_backpressure_serializes_saturated_backend(self):
+        # A cap-1 backend with N queued tasks: every task completes
+        # eventually; dispatcher retries on 503 instead of dropping.
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            svc = platform.make_service("slow", prefix="v1/slow")
+            import threading
+            gate = threading.Semaphore(1)
+
+            @svc.api_async_func("/work", maximum_concurrent_requests=1)
+            def work(taskId, body, content_type):
+                with gate:
+                    import time as _t
+                    _t.sleep(0.05)
+                asyncio.run(platform.task_manager.complete_task(
+                    taskId, "completed"))
+
+            svc_client = await serve(svc.app)
+            backend_uri = str(svc_client.make_url("/v1/slow/work"))
+            platform.publish_async_api("/v1/public/work", backend_uri)
+            gw_client = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                ids = []
+                for _ in range(5):
+                    resp = await gw_client.post("/v1/public/work", data=b"x")
+                    ids.append((await resp.json())["TaskId"])
+                for tid in ids:
+                    final = await poll_until(
+                        gw_client, tid,
+                        lambda b: "completed" in b["Status"], tries=400)
+                    assert "completed" in final["Status"], final
+            finally:
+                await platform.stop()
+                await gw_client.close()
+                await svc_client.close()
+
+        run(main())
+
+    def test_pipeline_two_stage(self):
+        # §3.4: detector hands the task to the classifier under one TaskId;
+        # stage 2 receives the ORIGINAL body (replayed by the store).
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            seen = {}
+
+            det = platform.make_service("det", prefix="v1/det")
+            cls = platform.make_service("cls", prefix="v1/cls")
+
+            @det.api_async_func("/detect")
+            def detect(taskId, body, content_type):
+                async def _s():
+                    await platform.task_manager.update_task_status(
+                        taskId, "running - detector")
+                    nxt = next_endpoint_from(cls_backend, "v1", "cls", "classify")
+                    await platform.task_manager.add_pipeline_task(taskId, cls_backend)
+                asyncio.run(_s())
+
+            @cls.api_async_func("/classify")
+            def classify(taskId, body, content_type):
+                seen["stage2_body"] = body
+                asyncio.run(platform.task_manager.complete_task(
+                    taskId, "completed - classified"))
+
+            det_client = await serve(det.app)
+            cls_client = await serve(cls.app)
+            det_backend = str(det_client.make_url("/v1/det/detect"))
+            cls_backend = str(cls_client.make_url("/v1/cls/classify"))
+            platform.publish_async_api("/v1/pipeline/detect", det_backend)
+            platform.dispatchers.register("/v1/cls/classify", cls_backend)
+            gw_client = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                resp = await gw_client.post("/v1/pipeline/detect",
+                                            data=b"ORIGINAL-IMG")
+                task_id = (await resp.json())["TaskId"]
+                final = await poll_until(
+                    gw_client, task_id, lambda b: "completed" in b["Status"],
+                    tries=400)
+                assert final["Status"] == "completed - classified"
+                assert final["TaskId"] == task_id  # same task across stages
+                assert seen["stage2_body"] == b"ORIGINAL-IMG"
+            finally:
+                await platform.stop()
+                await gw_client.close()
+                await det_client.close()
+                await cls_client.close()
+
+        run(main())
+
+    def test_sync_proxy_route(self):
+        async def main():
+            platform = LocalPlatform()
+            svc = platform.make_service("echo", prefix="v1/echo")
+
+            @svc.api_sync_func("/echo")
+            def echo(body, content_type):
+                return {"echo": body.decode()}
+
+            svc_client = await serve(svc.app)
+            platform.publish_sync_api(
+                "/v1/public/echo", str(svc_client.make_url("/v1/echo/echo")))
+            gw_client = await serve(platform.gateway.app)
+            try:
+                resp = await gw_client.post("/v1/public/echo", data=b"hi")
+                assert resp.status == 200
+                assert (await resp.json()) == {"echo": "hi"}
+            finally:
+                await gw_client.close()
+                await svc_client.close()
+
+        run(main())
+
+    def test_gateway_404_on_unknown_task(self):
+        async def main():
+            platform = LocalPlatform()
+            gw_client = await serve(platform.gateway.app)
+            try:
+                resp = await gw_client.get("/v1/taskmanagement/task/ghost")
+                assert resp.status == 404
+            finally:
+                await gw_client.close()
+
+        run(main())
+
+
+class TestCrashRecovery:
+    def test_journaled_platform_redispatches_unfinished_tasks(self, tmp_path=None):
+        # A task accepted before a crash must be dispatched after restart —
+        # the durability the reference gets from Service Bus + Redis.
+        import tempfile, os
+        journal = os.path.join(tempfile.mkdtemp(), "tasks.jsonl")
+
+        async def before_crash():
+            platform = LocalPlatform(PlatformConfig(journal_path=journal))
+            platform.gateway.add_async_route(
+                "/v1/public/work", "http://127.0.0.1:1/v1/svc/work")
+            gw = await serve(platform.gateway.app)
+            try:
+                resp = await gw.post("/v1/public/work", data=b"PAYLOAD")
+                tid = (await resp.json())["TaskId"]
+            finally:
+                await gw.close()
+            platform.store.close()
+            return tid  # platform never started: broker message dies with it
+
+        task_id = run(before_crash())
+
+        async def after_restart():
+            platform = LocalPlatform(PlatformConfig(
+                journal_path=journal, retry_delay=0.05))
+            svc = platform.make_service("svc", prefix="v1/svc")
+
+            @svc.api_async_func("/work")
+            def work(taskId, body, content_type):
+                assert body == b"PAYLOAD"
+                asyncio.run(platform.task_manager.complete_task(
+                    taskId, "completed - recovered"))
+
+            svc_client = await serve(svc.app)
+            platform.publish_async_api(
+                "/v1/public/work", str(svc_client.make_url("/v1/svc/work")))
+            gw = await serve(platform.gateway.app)
+            await platform.start()   # re-seeds journal-restored tasks
+            try:
+                final = await poll_until(
+                    gw, task_id, lambda b: "completed" in b["Status"], tries=400)
+                assert final["Status"] == "completed - recovered"
+            finally:
+                await platform.stop()
+                await gw.close()
+                await svc_client.close()
+
+        run(after_restart())
