@@ -26,6 +26,7 @@ import logging
 import aiohttp
 
 from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from ..utils.http import SessionHolder
 from ..service.task_manager import TaskManagerBase
 from ..taskstore import TaskStatus
 from .queue import InMemoryBroker, Message
@@ -65,11 +66,9 @@ class Dispatcher:
             "ai4e_dispatch_total", "Dispatch attempts by outcome")
         self._stop = asyncio.Event()
         self._workers: list[asyncio.Task] = []
-        self._session: aiohttp.ClientSession | None = None
+        self._sessions = SessionHolder(timeout=request_timeout)
 
     async def start(self) -> None:
-        self._session = aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=self.request_timeout))
         self._workers = [
             asyncio.get_running_loop().create_task(self._run(i))
             for i in range(self.concurrency)
@@ -80,8 +79,7 @@ class Dispatcher:
         for w in self._workers:
             w.cancel()
         await asyncio.gather(*self._workers, return_exceptions=True)
-        if self._session is not None:
-            await self._session.close()
+        await self._sessions.close()
 
     async def _run(self, worker_idx: int) -> None:
         while not self._stop.is_set():
@@ -100,12 +98,33 @@ class Dispatcher:
                         msg.task_id, "failed - delivery attempts exhausted",
                         TaskStatus.FAILED)
 
+    def _target_for(self, msg: Message) -> str:
+        """Dispatch target: the *registered* backend URI (fresh host — a
+        journal-restored task may carry a stale one) with the task endpoint's
+        operation tail and query grafted on, so the exact call the client
+        made is reproduced (request_policy.xml:15 records the original URI;
+        BackendQueueProcessor posts to per-queue config)."""
+        from urllib.parse import urlparse
+        parsed = urlparse(msg.endpoint)
+        path = parsed.path if "://" in msg.endpoint else msg.endpoint.split("?")[0]
+        base = self.queue_name.rstrip("/")
+        target = self.backend_uri
+        if path != base and path.startswith(base + "/"):
+            target = self.backend_uri.rstrip("/") + path[len(base):]
+        query = parsed.query if "://" in msg.endpoint else ""
+        if query:
+            target += "?" + query
+        return target
+
     async def _dispatch_one(self, msg: Message) -> None:
+        target = self._target_for(msg)
+        session = await self._sessions.get()
         try:
-            async with self._session.post(
-                self.backend_uri,
+            async with session.post(
+                target,
                 data=msg.body,
-                headers={"taskId": msg.task_id},
+                headers={"taskId": msg.task_id,
+                         "Content-Type": msg.content_type},
             ) as resp:
                 status = resp.status
                 await resp.read()
@@ -113,7 +132,7 @@ class Dispatcher:
             # Backend unreachable — treat like saturation: the pod may be
             # restarting; broker patience (max deliveries) bounds total retry.
             log.warning("backend %s unreachable (%s); will redeliver",
-                        self.backend_uri, exc)
+                        target, exc)
             await self._backpressure(msg)
             return
 

@@ -23,9 +23,10 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import threading
 import time
 from dataclasses import dataclass, field
-from typing import Awaitable, Callable
+from typing import Callable
 
 from ..taskstore import endpoint_path as canonical_path
 
@@ -35,31 +36,41 @@ class Message:
     task_id: str
     endpoint: str
     body: bytes = b""
+    content_type: str = "application/json"
     enqueued_at: float = field(default_factory=time.time)
     delivery_count: int = 0
     seq: int = 0
     lease_expires: float = 0.0
-
-    @property
-    def queue_name(self) -> str:
-        return canonical_path(self.endpoint)
+    queue_name: str = ""  # resolved by the broker at publish time
 
 
-DeadLetterHandler = Callable[[Message], Awaitable[None]]
+DeadLetterHandler = Callable[[Message], None]
 
 
 class EndpointQueue:
     """Single endpoint's FIFO with leases. Not thread-safe — event-loop only."""
 
     def __init__(self, name: str, max_delivery_count: int = 1440,
-                 lease_seconds: float = 300.0):
+                 lease_seconds: float = 300.0,
+                 dead_letter_handler: DeadLetterHandler | None = None):
         self.name = name
         self.max_delivery_count = max_delivery_count
         self.lease_seconds = lease_seconds
+        self.dead_letter_handler = dead_letter_handler
         self._ready: list[Message] = []
         self._leased: dict[int, Message] = {}
         self._waiters: list[asyncio.Future] = []
         self.dead_letters: list[Message] = []
+
+    def _dead_letter(self, msg: Message) -> None:
+        self.dead_letters.append(msg)
+        if self.dead_letter_handler is not None:
+            try:
+                self.dead_letter_handler(msg)
+            except Exception:  # noqa: BLE001 — dead-lettering must not throw
+                import logging
+                logging.getLogger("ai4e_tpu.broker").exception(
+                    "dead-letter handler failed for task %s", msg.task_id)
 
     def __len__(self) -> int:
         return len(self._ready)
@@ -117,7 +128,7 @@ class EndpointQueue:
             # delivery and double-burn the delivery budget.
             return not any(m.seq == msg.seq for m in self.dead_letters)
         if msg.delivery_count >= self.max_delivery_count:
-            self.dead_letters.append(msg)
+            self._dead_letter(msg)
             return False
         self._ready.append(msg)
         self._wake_one()
@@ -129,19 +140,25 @@ class EndpointQueue:
         for msg in expired:
             del self._leased[msg.seq]
             if msg.delivery_count >= self.max_delivery_count:
-                self.dead_letters.append(msg)
+                self._dead_letter(msg)
             else:
                 self._ready.append(msg)
 
 
 class InMemoryBroker:
-    """Queue manager: one ``EndpointQueue`` per endpoint path.
+    """Queue manager: one ``EndpointQueue`` per registered endpoint path.
 
     ``publish`` is the store's publisher hook (the reference couples them the
     same way: CacheConnectorUpsert publishes on upsert,
-    ``CacheConnectorUpsert.cs:178-202``). Thread-safe on the publish side:
-    sync callers (the store runs publishers under its lock on arbitrary
-    threads) hand off to the loop via ``call_soon_threadsafe``.
+    ``CacheConnectorUpsert.cs:178-202``). The store calls publishers *after*
+    releasing its own lock, on whatever thread ran the upsert — so the queue
+    map is guarded by a lock here and the enqueue itself is handed to the
+    broker's event loop via ``call_soon_threadsafe``.
+
+    Routing: a task whose endpoint path extends a registered queue's path
+    (operation tails, query params) lands on the longest-prefix-matching
+    queue — mirroring the reference's one-queue-per-API (not per-operation)
+    layout (``deploy_servicebus_queue.sh:28-42``).
     """
 
     def __init__(self, max_delivery_count: int = 1440,
@@ -149,24 +166,48 @@ class InMemoryBroker:
         self.max_delivery_count = max_delivery_count
         self.lease_seconds = lease_seconds
         self._queues: dict[str, EndpointQueue] = {}
+        self._queues_lock = threading.Lock()
         self._seq = itertools.count(1)
         self._loop: asyncio.AbstractEventLoop | None = None
+        self._dead_letter_handler: DeadLetterHandler | None = None
 
     def bind_loop(self, loop: asyncio.AbstractEventLoop | None = None) -> None:
         self._loop = loop or asyncio.get_event_loop()
 
+    def set_dead_letter_handler(self, handler: DeadLetterHandler | None) -> None:
+        """Callback for messages that exhaust their delivery budget in any
+        path (explicit abandon or lease-expiry reaping) — the platform wires
+        this to fail the task so it never sits non-terminal forever."""
+        self._dead_letter_handler = handler
+        with self._queues_lock:
+            for q in self._queues.values():
+                q.dead_letter_handler = handler
+
     def queue(self, name: str) -> EndpointQueue:
-        q = self._queues.get(name)
-        if q is None:
-            q = self._queues[name] = EndpointQueue(
-                name, self.max_delivery_count, self.lease_seconds)
-        return q
+        with self._queues_lock:
+            q = self._queues.get(name)
+            if q is None:
+                q = self._queues[name] = EndpointQueue(
+                    name, self.max_delivery_count, self.lease_seconds,
+                    dead_letter_handler=self._dead_letter_handler)
+            return q
 
     def queue_names(self) -> list[str]:
-        return sorted(self._queues)
+        with self._queues_lock:
+            return sorted(self._queues)
 
     def depths(self) -> dict[str, int]:
-        return {name: len(q) for name, q in self._queues.items()}
+        with self._queues_lock:
+            return {name: len(q) for name, q in self._queues.items()}
+
+    def resolve_queue_name(self, endpoint: str) -> str:
+        """Longest registered queue path that prefixes the endpoint path;
+        falls back to the exact path (a queue is created on demand)."""
+        path = canonical_path(endpoint)
+        with self._queues_lock:
+            candidates = [n for n in self._queues
+                          if path == n or path.startswith(n.rstrip("/") + "/")]
+        return max(candidates, key=len) if candidates else path
 
     # -- publish side ------------------------------------------------------
 
@@ -177,7 +218,11 @@ class InMemoryBroker:
         event loop.
         """
         msg = Message(task_id=task.task_id, endpoint=task.endpoint,
-                      body=task.body, seq=next(self._seq))
+                      body=task.body,
+                      content_type=getattr(task, "content_type",
+                                           "application/json"),
+                      seq=next(self._seq),
+                      queue_name=self.resolve_queue_name(task.endpoint))
         loop = self._loop
         try:
             running = asyncio.get_running_loop()

@@ -82,8 +82,18 @@ class Gateway:
     def _make_async_handler(self, route: Route):
         async def handler(request: web.Request) -> web.Response:
             body = await request.read()
+            # Record the full target: base backend URI + operation tail +
+            # query, so the dispatcher can reproduce the exact call (the
+            # reference stores the original request URI as Endpoint,
+            # request_policy.xml:15).
+            endpoint = route.backend_uri
+            tail = request.match_info.get("tail", "")
+            if tail:
+                endpoint = endpoint.rstrip("/") + "/" + tail
+            if request.query_string:
+                endpoint += "?" + request.query_string
             task = self.store.upsert(APITask(
-                endpoint=route.backend_uri,
+                endpoint=endpoint,
                 body=body,
                 content_type=request.content_type or "application/json",
                 publish=True,

@@ -1,0 +1,18 @@
+from .detector import CenterNetDetector, create_detector, decode_detections
+from .resnet import ResNet, create_resnet50
+from .unet import UNet, create_unet, segment_logits_to_classes
+from .vit import TP_RULES as VIT_TP_RULES, ViT, create_vit
+
+__all__ = [
+    "CenterNetDetector",
+    "create_detector",
+    "decode_detections",
+    "ResNet",
+    "create_resnet50",
+    "UNet",
+    "create_unet",
+    "segment_logits_to_classes",
+    "ViT",
+    "VIT_TP_RULES",
+    "create_vit",
+]

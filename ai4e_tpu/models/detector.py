@@ -1,0 +1,107 @@
+"""Camera-trap animal detector (BASELINE.json config #3, the MegaDetector
+slot).
+
+The reference's camera-trap detection API is an opaque TF-1.9 GPU container
+(``APIs/Charts/camera-trap/detection-async/prod-values.yaml:35-36``). Here the
+detector is an anchor-free center-point model (CenterNet-style): a conv
+backbone feeds three dense heads — center heatmap, box size, center offset.
+Decoding is top-k over the heatmap, entirely in XLA-friendly ops (no
+data-dependent shapes: fixed ``max_detections`` with a score mask), so the
+whole forward + decode jits into one TPU program.
+
+Classes follow MegaDetector: animal / person / vehicle.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+NUM_CLASSES = 3  # animal, person, vehicle
+MAX_DETECTIONS = 64
+
+
+class _Stage(nn.Module):
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, (3, 3), (2, 2), padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=min(32, self.features), dtype=self.dtype)(x)
+        x = nn.gelu(x)
+        x = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=min(32, self.features), dtype=self.dtype)(x)
+        return nn.gelu(x)
+
+
+class CenterNetDetector(nn.Module):
+    """Backbone stride 8; heads at 1/8 resolution."""
+
+    num_classes: int = NUM_CLASSES
+    widths: tuple = (64, 128, 256)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # x: (B, H, W, 3) in [0,1]
+        x = x.astype(self.dtype)
+        for w in self.widths:
+            x = _Stage(w, self.dtype)(x)
+        feat = nn.Conv(256, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        feat = nn.gelu(feat)
+        heatmap = nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32,
+                          bias_init=nn.initializers.constant(-2.19))(feat)
+        wh = nn.Conv(2, (1, 1), dtype=jnp.float32)(feat)
+        offset = nn.Conv(2, (1, 1), dtype=jnp.float32)(feat)
+        return {"heatmap": heatmap, "wh": wh, "offset": offset}
+
+
+def _nms_heatmap(heat: jnp.ndarray) -> jnp.ndarray:
+    """3x3 max-pool peak NMS: keep only local maxima (CenterNet's trick —
+    replaces box NMS with a pooling op that XLA fuses for free)."""
+    pooled = nn.max_pool(heat, (3, 3), strides=(1, 1), padding="SAME")
+    return jnp.where(jnp.abs(pooled - heat) < 1e-6, heat, -jnp.inf)
+
+
+def decode_detections(outputs: dict, stride: int = 8,
+                      max_detections: int = MAX_DETECTIONS) -> dict:
+    """Heatmap → fixed-size detection set. Static shapes: always returns
+    ``max_detections`` rows; invalid rows carry score 0.
+
+    Returns dict of (B, K, 4) boxes [y0, x0, y1, x1] in input pixels,
+    (B, K) scores, (B, K) class ids.
+    """
+    heat = jax.nn.sigmoid(outputs["heatmap"])
+    heat = _nms_heatmap(heat)
+    b, h, w, c = heat.shape
+    flat = heat.reshape(b, h * w * c)
+    scores, idx = jax.lax.top_k(flat, max_detections)
+    cls = idx % c
+    pix = idx // c
+    ys = (pix // w).astype(jnp.float32)
+    xs = (pix % w).astype(jnp.float32)
+
+    batch_ix = jnp.arange(b)[:, None]
+    wh = outputs["wh"][batch_ix, pix // w, pix % w]          # (B, K, 2)
+    offset = outputs["offset"][batch_ix, pix // w, pix % w]  # (B, K, 2)
+
+    cy = (ys + offset[..., 0]) * stride
+    cx = (xs + offset[..., 1]) * stride
+    bh = jnp.abs(wh[..., 0]) * stride
+    bw = jnp.abs(wh[..., 1]) * stride
+    boxes = jnp.stack([cy - bh / 2, cx - bw / 2, cy + bh / 2, cx + bw / 2],
+                      axis=-1)
+    scores = jnp.where(jnp.isfinite(scores), scores, 0.0)
+    return {"boxes": boxes, "scores": scores, "classes": cls}
+
+
+def create_detector(rng=None, image_size: int = 512,
+                    num_classes: int = NUM_CLASSES):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = CenterNetDetector(num_classes=num_classes)
+    params = model.init(rng, jnp.zeros((1, image_size, image_size, 3)))
+    return model, params

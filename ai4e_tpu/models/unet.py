@@ -1,0 +1,85 @@
+"""Land-cover semantic segmentation UNet — the platform's flagship model.
+
+The reference serves land-cover segmentation as an opaque TF-1.9 GPU container
+(``APIManagement/create_sync_api_management_api.sh:38-92`` registers its
+classify/tile operations; the model itself lives outside the repo). Here the
+model is a first-class JAX citizen: a compact UNet whose shapes are chosen for
+the MXU — channel counts in multiples of 128, bfloat16 activations, NHWC
+layout (TPU-native conv layout), static shapes per tile bucket.
+
+Classes follow the AI4E land-cover API: water / forest / field / impervious.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+NUM_CLASSES = 4
+TILE = 256  # default tile edge (the land-cover API's unit of work)
+
+
+class ConvBlock(nn.Module):
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        for _ in range(2):
+            x = nn.Conv(self.features, (3, 3), padding="SAME",
+                        dtype=self.dtype, use_bias=False)(x)
+            # GroupNorm over channels: batch-size independent (serving batches
+            # vary by bucket) and fuses well under XLA.
+            x = nn.GroupNorm(num_groups=min(32, self.features),
+                             dtype=self.dtype)(x)
+            x = nn.gelu(x)
+        return x
+
+
+class UNet(nn.Module):
+    """Encoder-decoder with skip connections.
+
+    ``widths`` start at 64 and stay in MXU-friendly multiples; downsampling by
+    strided conv (cheaper than pool+conv on TPU), upsampling by
+    ``jax.image.resize`` + 1x1 conv (avoids checkerboard transposed convs and
+    keeps XLA fusion simple).
+    """
+
+    num_classes: int = NUM_CLASSES
+    widths: tuple = (64, 128, 256, 512)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # x: (B, H, W, 3) float32 in [0, 1]
+        x = x.astype(self.dtype)
+        skips = []
+        for i, w in enumerate(self.widths):
+            x = ConvBlock(w, self.dtype)(x)
+            if i < len(self.widths) - 1:
+                skips.append(x)
+                x = nn.Conv(w, (3, 3), strides=(2, 2), padding="SAME",
+                            dtype=self.dtype, use_bias=False)(x)
+        for w, skip in zip(reversed(self.widths[:-1]), reversed(skips)):
+            b, h, s, c = skip.shape
+            x = jax.image.resize(x, (x.shape[0], h, s, x.shape[3]), "nearest")
+            x = nn.Conv(w, (1, 1), dtype=self.dtype, use_bias=False)(x)
+            x = jnp.concatenate([x, skip], axis=-1)
+            x = ConvBlock(w, self.dtype)(x)
+        logits = nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32)(x)
+        return logits  # (B, H, W, num_classes), float32 for stable softmax
+
+
+def create_unet(rng=None, tile: int = TILE, num_classes: int = NUM_CLASSES,
+                widths: tuple = (64, 128, 256, 512)):
+    """Init a UNet and return (model, params)."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = UNet(num_classes=num_classes, widths=widths)
+    params = model.init(rng, jnp.zeros((1, tile, tile, 3), jnp.float32))
+    return model, params
+
+
+def segment_logits_to_classes(logits: jnp.ndarray) -> jnp.ndarray:
+    """Per-pixel argmax → uint8 class map (the API's response payload)."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.uint8)

@@ -1,0 +1,111 @@
+"""Vision Transformer species classifier — the tensor/sequence-parallel
+flagship.
+
+The reference's species-classification slot is an opaque container; beyond
+ResNet-50 (``resnet.py``) this ViT exists to exercise the parallelism the
+platform treats as first-class (SURVEY.md §2 inventory): its dense dimensions
+carry tensor-parallel sharding rules (``TP_RULES``) and its token dimension is
+the sequence axis ring attention shards for long-context serving
+(``parallel/ring_attention.py``).
+
+Sharding rules follow the standard megatron split: attention QKV and MLP-up
+column-split on ``tp``, attention-out and MLP-down row-split, so each block
+needs exactly one psum on the residual — XLA inserts it from the specs.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# param-path substring → PartitionSpec (consumed by parallel.shard_params)
+TP_RULES = {
+    "attn/qkv/kernel": P(None, "tp"),
+    "attn/out/kernel": P("tp", None),
+    "mlp/up/kernel": P(None, "tp"),
+    "mlp/down/kernel": P("tp", None),
+}
+
+
+class Attention(nn.Module):
+    dim: int
+    heads: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, n, d = x.shape
+        qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype,
+                       name="qkv")(x)
+        q, k, v = jnp.split(qkv.reshape(b, n, 3, self.heads,
+                                        self.dim // self.heads), 3, axis=2)
+        q, k, v = (t.squeeze(2).transpose(0, 2, 1, 3) for t in (q, k, v))
+        scale = (self.dim // self.heads) ** -0.5
+        attn = jax.nn.softmax((q @ k.transpose(0, 1, 3, 2)) * scale, axis=-1)
+        out = (attn @ v).transpose(0, 2, 1, 3).reshape(b, n, self.dim)
+        return nn.Dense(self.dim, dtype=self.dtype, name="out")(out)
+
+
+class Mlp(nn.Module):
+    dim: int
+    expansion: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.dim * self.expansion, dtype=self.dtype, name="up")(x)
+        x = nn.gelu(x)
+        return nn.Dense(self.dim, dtype=self.dtype, name="down")(x)
+
+
+class Block(nn.Module):
+    dim: int
+    heads: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + Attention(self.dim, self.heads, self.dtype,
+                          name="attn")(nn.LayerNorm(dtype=self.dtype)(x))
+        x = x + Mlp(self.dim, dtype=self.dtype,
+                    name="mlp")(nn.LayerNorm(dtype=self.dtype)(x))
+        return x
+
+
+class ViT(nn.Module):
+    num_classes: int = 1000
+    patch: int = 16
+    dim: int = 384
+    depth: int = 6
+    heads: int = 6
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # x: (B, H, W, 3)
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.dim, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), name="embed",
+                    dtype=self.dtype)(x)
+        b, h, w, d = x.shape
+        x = x.reshape(b, h * w, d)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, h * w, d), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = Block(self.dim, self.heads, self.dtype, name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x.mean(axis=1)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def create_vit(rng=None, num_classes: int = 1000, image_size: int = 224,
+               patch: int = 16, dim: int = 384, depth: int = 6,
+               heads: int = 6):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = ViT(num_classes=num_classes, patch=patch, dim=dim, depth=depth,
+                heads=heads)
+    params = model.init(rng, jnp.zeros((1, image_size, image_size, 3)))
+    return model, params

@@ -1,0 +1,23 @@
+from .sharding import (
+    AXES,
+    MeshSpec,
+    batch_sharding,
+    init_distributed,
+    make_mesh,
+    pad_to_multiple,
+    replicated,
+    shard_params,
+    spec_for_param,
+)
+
+__all__ = [
+    "AXES",
+    "MeshSpec",
+    "batch_sharding",
+    "init_distributed",
+    "make_mesh",
+    "pad_to_multiple",
+    "replicated",
+    "shard_params",
+    "spec_for_param",
+]

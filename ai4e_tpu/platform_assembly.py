@@ -87,10 +87,30 @@ class LocalPlatform:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> None:
-        self.broker.bind_loop(asyncio.get_running_loop())
+        loop = asyncio.get_running_loop()
+        self.broker.bind_loop(loop)
+
+        def on_dead_letter(msg) -> None:
+            # Runs on the event loop (queues are loop-bound); fail the task
+            # asynchronously so it never sits non-terminal after its message
+            # is gone.
+            loop.create_task(self._fail_dead_letter(msg.task_id))
+
+        self.broker.set_dead_letter_handler(on_dead_letter)
         await self.dispatchers.start()
         self._reseed_unfinished()
         self._started = True
+
+    async def _fail_dead_letter(self, task_id: str) -> None:
+        try:
+            task = self.store.get(task_id)
+            if task.canonical_status not in ("completed", "failed"):
+                await self.task_manager.fail_task(
+                    task_id, "failed - delivery attempts exhausted")
+        except Exception:  # noqa: BLE001 — best-effort terminal transition
+            import logging
+            logging.getLogger("ai4e_tpu.platform").exception(
+                "could not fail dead-lettered task %s", task_id)
 
     def _reseed_unfinished(self) -> None:
         """Re-enqueue tasks restored from the journal in a non-terminal state

@@ -1,0 +1,12 @@
+from .batcher import BatcherSaturated, MicroBatcher
+from .registry import ModelRuntime, ServableModel, enable_compilation_cache
+from .worker import InferenceWorker
+
+__all__ = [
+    "BatcherSaturated",
+    "MicroBatcher",
+    "ModelRuntime",
+    "ServableModel",
+    "InferenceWorker",
+    "enable_compilation_cache",
+]

@@ -1,0 +1,200 @@
+"""Micro-batcher — packs queued requests into dense fixed-shape TPU batches.
+
+THE architectural divergence from the reference (SURVEY.md §7 hard parts #1):
+the reference dispatches one task per HTTP POST to a GPU container; a TPU mesh
+wants large dense batches. The batcher sits between the request path and the
+device:
+
+- requests arrive one at a time (``submit`` returns a future);
+- a flusher drains the pending queue whenever the device is free, taking up to
+  ``max_bucket`` examples — under load the batch grows toward the biggest
+  bucket (adaptive batching), idle requests leave at batch 1 with
+  ``max_wait_ms`` bounding added latency;
+- the batch is padded to the smallest compiled bucket (no recompiles, static
+  shapes) and run on the mesh via a single executor thread (one TPU program
+  at a time — the device is the serial resource);
+- outputs fan back out to per-request futures; per-example postprocess errors
+  fail only that request (failure isolation: one bad image fails one task,
+  never the batch).
+
+Backpressure: ``pending_count`` over ``max_pending`` → ``submit`` raises
+``BatcherSaturated`` and the service returns 503, which the dispatcher already
+treats as backpressure — the queue-depth-vs-device-utilisation translation of
+the reference's per-replica thread cap (SURVEY.md §7 hard part #2).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from .registry import ModelRuntime
+
+log = logging.getLogger("ai4e_tpu.batcher")
+
+
+class BatcherSaturated(RuntimeError):
+    pass
+
+
+@dataclass
+class _Pending:
+    example: np.ndarray
+    future: asyncio.Future
+    enqueued: float = field(default_factory=time.perf_counter)
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        runtime: ModelRuntime,
+        max_wait_ms: float = 5.0,
+        max_pending: int = 256,
+        metrics: MetricsRegistry | None = None,
+    ):
+        self.runtime = runtime
+        self.max_wait = max_wait_ms / 1000.0
+        self.max_pending = max_pending
+        self.metrics = metrics or DEFAULT_REGISTRY
+        self._pending: dict[str, list[_Pending]] = {}
+        self._wakeup: asyncio.Event = asyncio.Event()
+        self._stop = False
+        self._flusher: asyncio.Task | None = None
+        # One device-feeding thread: TPU programs serialise anyway; a single
+        # thread keeps dispatch order deterministic and the loop unblocked.
+        self._executor = ThreadPoolExecutor(max_workers=1,
+                                            thread_name_prefix="tpu-batcher")
+        self._batch_size_hist = self.metrics.histogram(
+            "ai4e_batch_size", "Executed batch sizes",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, float("inf")))
+        self._batch_latency = self.metrics.histogram(
+            "ai4e_batch_exec_seconds", "Device execution time per batch")
+        self._queue_wait = self.metrics.histogram(
+            "ai4e_batch_queue_wait_seconds", "Request wait before batching")
+        self._pending_gauge = self.metrics.gauge(
+            "ai4e_batcher_pending", "Requests waiting for a batch slot")
+
+    # -- request side ------------------------------------------------------
+
+    @property
+    def pending_count(self) -> int:
+        return sum(len(v) for v in self._pending.values())
+
+    async def submit(self, model_name: str, example: np.ndarray):
+        """Queue one example; resolves to that example's postprocessed result."""
+        if self._stop:
+            raise RuntimeError("batcher stopped")
+        if self.pending_count >= self.max_pending:
+            raise BatcherSaturated(
+                f"batcher at {self.pending_count}/{self.max_pending} pending")
+        servable = self.runtime.models[model_name]
+        expected = tuple(servable.input_shape)
+        if tuple(example.shape) != expected:
+            raise ValueError(
+                f"bad input shape {example.shape}, expected {expected}")
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.setdefault(model_name, []).append(_Pending(example, fut))
+        self._pending_gauge.set(self.pending_count)
+        self._wakeup.set()
+        return await fut
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._stop = False
+        self._flusher = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stop = True
+        self._wakeup.set()
+        if self._flusher is not None:
+            await self._flusher
+        self._executor.shutdown(wait=True)
+
+    # -- flusher -----------------------------------------------------------
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._stop:
+            if self.pending_count == 0:
+                self._wakeup.clear()
+                try:
+                    await asyncio.wait_for(self._wakeup.wait(), timeout=0.5)
+                except asyncio.TimeoutError:
+                    continue
+            # Brief accumulation window: let more requests join the batch.
+            if self.max_wait > 0:
+                first = min((p[0].enqueued for p in self._pending.values() if p),
+                            default=time.perf_counter())
+                window = self.max_wait - (time.perf_counter() - first)
+                if window > 0 and self._max_queue_len() < self._largest_bucket():
+                    await asyncio.sleep(window)
+            for model_name in list(self._pending):
+                batch = self._take_batch(model_name)
+                if batch:
+                    await self._execute(loop, model_name, batch)
+
+    def _max_queue_len(self) -> int:
+        return max((len(v) for v in self._pending.values()), default=0)
+
+    def _largest_bucket(self) -> int:
+        return max((m.max_bucket for m in self.runtime.models.values()),
+                   default=1)
+
+    def _take_batch(self, model_name: str) -> list[_Pending]:
+        queue = self._pending.get(model_name, [])
+        if not queue:
+            return []
+        servable = self.runtime.models[model_name]
+        take = min(len(queue), servable.max_bucket)
+        batch, self._pending[model_name] = queue[:take], queue[take:]
+        self._pending_gauge.set(self.pending_count)
+        return batch
+
+    async def _execute(self, loop, model_name: str,
+                       batch: list[_Pending]) -> None:
+        servable = self.runtime.models[model_name]
+        n = len(batch)
+        bucket = servable.bucket_for(n)
+        now = time.perf_counter()
+        for p in batch:
+            self._queue_wait.observe(now - p.enqueued, model=model_name)
+
+        padded = np.zeros((bucket, *servable.input_shape),
+                          servable.input_dtype)
+        for i, p in enumerate(batch):
+            padded[i] = p.example
+
+        t0 = time.perf_counter()
+        try:
+            outputs = await loop.run_in_executor(
+                self._executor, self.runtime.run_batch, model_name, padded)
+        except Exception as exc:  # noqa: BLE001 — device failure fails the batch
+            log.exception("batch execution failed for %s", model_name)
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(exc)
+            return
+        self._batch_latency.observe(time.perf_counter() - t0, model=model_name)
+        self._batch_size_hist.observe(n, model=model_name)
+
+        for i, p in enumerate(batch):
+            if p.future.done():
+                continue
+            try:
+                example_out = _tree_index(outputs, i)
+                p.future.set_result(servable.postprocess(example_out))
+            except Exception as exc:  # noqa: BLE001 — isolate per-example failure
+                p.future.set_exception(exc)
+
+
+def _tree_index(outputs, i: int):
+    """Slice example ``i`` out of a pytree of batched arrays."""
+    import jax
+    return jax.tree_util.tree_map(lambda a: a[i], outputs)

@@ -1,0 +1,138 @@
+"""Model registry — servable JAX models with bucketed compiled programs.
+
+The reference's "model registry" is a container registry: each model API is an
+opaque Docker image lazy-loading weights at startup (``APIs/Charts/templates/
+async-gpu/templates/deployment.yaml:14-55``). Here a servable is code+params
+in-process: an apply function compiled per (batch-bucket) shape onto the
+device mesh, with explicit warmup (the compile-time management SURVEY.md §7
+lists as a hard part — containers lazy-load; TPU programs must precompile).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger("ai4e_tpu.runtime")
+
+Preprocess = Callable[[bytes, str], np.ndarray]
+Postprocess = Callable[[Any], Any]
+
+
+@dataclass
+class ServableModel:
+    """One deployable model API.
+
+    - ``apply_fn(params, batch) -> outputs``: pure function of a dense batch;
+    - ``preprocess(body, content_type) -> example``: request payload → one
+      example array of ``input_shape`` (raises ValueError on bad input — that
+      fails one task, never a batch);
+    - ``postprocess(example_outputs) -> result``: one example's slice of the
+      outputs → JSON-able result.
+    - ``batch_buckets``: allowed batch sizes, ascending. Requests are padded
+      up to the smallest fitting bucket so XLA compiles exactly
+      ``len(batch_buckets)`` programs per model.
+    """
+
+    name: str
+    apply_fn: Callable
+    params: Any
+    input_shape: tuple[int, ...]
+    preprocess: Preprocess
+    postprocess: Postprocess
+    batch_buckets: tuple[int, ...] = (1, 2, 4, 8)
+    input_dtype: Any = np.float32
+    version: str = "1.0"
+    _compiled: dict[int, Callable] = field(default_factory=dict, repr=False)
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.batch_buckets:
+            if b >= n:
+                return b
+        return self.batch_buckets[-1]
+
+    @property
+    def max_bucket(self) -> int:
+        return self.batch_buckets[-1]
+
+
+class ModelRuntime:
+    """Owns the mesh, compiled programs, and parameter placement.
+
+    This is the slot where the reference's CUDA-container black box becomes a
+    first-class runtime: ``jit`` with a batch sharding over the mesh's data
+    axes; XLA lays matmuls/convs onto the MXU and inserts ICI collectives for
+    any model-parallel params.
+    """
+
+    def __init__(self, mesh: Mesh | None = None, donate_batch: bool = False):
+        from ..parallel.sharding import make_mesh
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.models: dict[str, ServableModel] = {}
+        self._donate = donate_batch
+
+    @property
+    def data_axis_size(self) -> int:
+        return (self.mesh.shape["dp"] * self.mesh.shape["fsdp"])
+
+    def register(self, servable: ServableModel,
+                 param_sharding_rules: dict | None = None) -> ServableModel:
+        """Place params on the mesh and build per-bucket compiled fns."""
+        from ..parallel.sharding import pad_to_multiple, shard_params
+        servable.params = shard_params(servable.params, self.mesh,
+                                       param_sharding_rules)
+        # SPMD constraint: every batch bucket must divide evenly over the
+        # data axes, so buckets round up to mesh multiples (on 1 chip they
+        # stay as configured; on a v5e-4 dp mesh they become multiples of 4).
+        servable.batch_buckets = tuple(sorted({
+            pad_to_multiple(b, self.data_axis_size)
+            for b in servable.batch_buckets}))
+        batch_sharding = NamedSharding(
+            self.mesh, P(("dp", "fsdp"), *([None] * len(servable.input_shape))))
+
+        fn = jax.jit(
+            servable.apply_fn,
+            in_shardings=(None, batch_sharding),
+            donate_argnums=(1,) if self._donate else (),
+        )
+        for bucket in servable.batch_buckets:
+            servable._compiled[bucket] = fn
+        self.models[servable.name] = servable
+        return servable
+
+    def warmup(self, names: list[str] | None = None) -> dict[str, float]:
+        """Precompile every (model, bucket) program. Returns compile seconds
+        per model — exported as a metric so pod-start latency is visible."""
+        times: dict[str, float] = {}
+        for name, servable in self.models.items():
+            if names is not None and name not in names:
+                continue
+            t0 = time.perf_counter()
+            for bucket in servable.batch_buckets:
+                dummy = np.zeros((bucket, *servable.input_shape),
+                                 servable.input_dtype)
+                out = servable._compiled[bucket](servable.params, dummy)
+                jax.block_until_ready(out)
+            times[name] = time.perf_counter() - t0
+            log.info("warmup %s: %d buckets in %.1fs", name,
+                     len(servable.batch_buckets), times[name])
+        return times
+
+    def run_batch(self, name: str, batch: np.ndarray):
+        """Execute one padded batch; blocking (call from an executor)."""
+        servable = self.models[name]
+        out = servable._compiled[batch.shape[0]](servable.params, batch)
+        return jax.device_get(out)
+
+
+def enable_compilation_cache(path: str = "/tmp/ai4e_tpu_xla_cache") -> None:
+    """Persistent XLA compilation cache: pod restarts skip recompiles (the
+    warmup-at-start requirement in SURVEY.md §7 hard parts)."""
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -57,6 +57,10 @@ class EndpointSpec:
     content_max_length: int = 0  # 0 = unlimited
     trace_name: str = ""
     request_processing_function: Callable | None = None
+    # Extra admission predicate (no awaits): return (code, message) to refuse
+    # the request, None to admit. Used e.g. to 503 when the TPU batcher is
+    # saturated so the dispatcher backs off before a task is even adopted.
+    admission_check: Callable | None = None
     # Mutated only from the event loop with no await between check and
     # increment — that single-threadedness is the synchronization.
     in_flight: int = 0
@@ -108,7 +112,8 @@ class APIService:
     def _api_func(self, api_path: str, methods, is_async: bool,
                   maximum_concurrent_requests: int = 8,
                   content_types=(), content_max_length: int = 0,
-                  trace_name: str = "", request_processing_function=None):
+                  trace_name: str = "", request_processing_function=None,
+                  admission_check=None):
         def deco(func):
             spec = EndpointSpec(
                 func=func,
@@ -120,6 +125,7 @@ class APIService:
                 content_max_length=content_max_length,
                 trace_name=trace_name or api_path,
                 request_processing_function=request_processing_function,
+                admission_check=admission_check,
             )
             self.endpoints[spec.api_path] = spec
             route_path = self.prefix + spec.api_path
@@ -142,6 +148,10 @@ class APIService:
                 return 401, f"Unsupported content type: {ctype}"
         if spec.content_max_length and (request.content_length or 0) > spec.content_max_length:
             return 413, "Payload too large."
+        if spec.admission_check is not None:
+            refusal = spec.admission_check()
+            if refusal is not None:
+                return refusal
         return None
 
     def _reserve(self, spec: EndpointSpec) -> None:

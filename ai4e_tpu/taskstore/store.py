@@ -53,6 +53,7 @@ class InMemoryTaskStore:
         self._lock = threading.RLock()
         self._tasks: dict[str, APITask] = {}
         self._orig_bodies: dict[str, bytes] = {}
+        self._results: dict[str, tuple[bytes, str]] = {}
         # (endpoint_path, canonical_status) -> {task_id: score}; insertion
         # ordered + scored like the reference's Redis sorted sets.
         self._sets: dict[tuple[str, str], dict[str, float]] = {}
@@ -145,6 +146,20 @@ class InMemoryTaskStore:
         with self._lock:
             return self._orig_bodies.get(task_id, b"")
 
+    # -- results (the reference delegates results to external blob storage;
+    # here they're first-class, keyed like {taskId}_RESULT) -----------------
+
+    def set_result(self, task_id: str, result: bytes,
+                   content_type: str = "application/json") -> None:
+        with self._lock:
+            if task_id not in self._tasks:
+                raise TaskNotFound(task_id)
+            self._results[task_id] = (result, content_type)
+
+    def get_result(self, task_id: str) -> tuple[bytes, str] | None:
+        with self._lock:
+            return self._results.get(task_id)
+
     # -- status-set queries (queue-depth metrics, QueueLogger.cs:21-47) ----
 
     def set_len(self, endpoint_path: str, status: str) -> int:
@@ -213,6 +228,7 @@ class JournaledTaskStore(InMemoryTaskStore):
         super().__init__(publisher)
         self._journal_path = journal_path
         self._journal = None  # gate journaling off during replay
+        self._closed = False
         self.replayed_task_ids: set[str] = set()
         if os.path.exists(journal_path):
             self._replay()
@@ -250,6 +266,7 @@ class JournaledTaskStore(InMemoryTaskStore):
         self._journal.flush()
 
     def _apply_upsert(self, task: APITask) -> APITask:
+        self._check_open()
         task = super()._apply_upsert(task)
         self._log(task)
         return task
@@ -257,11 +274,19 @@ class JournaledTaskStore(InMemoryTaskStore):
     def _apply_update(
         self, task_id: str, status: str, backend_status: str | None
     ) -> APITask:
+        self._check_open()
         task = super()._apply_update(task_id, status, backend_status)
         self._log(task)
         return task
 
+    def _check_open(self) -> None:
+        # Refuse BEFORE mutating: a write after close() must not leave memory
+        # and journal divergent (reads stay available during shutdown).
+        if self._closed:
+            raise RuntimeError("task store is closed")
+
     def close(self) -> None:
         with self._lock:
-            if self._journal is not None:
+            if not self._closed and self._journal is not None:
                 self._journal.close()
+            self._closed = True

@@ -1,0 +1,216 @@
+"""Platform benchmark — async land-cover segmentation through the full stack.
+
+Measures BASELINE.json's north-star metric: async inference requests/second
+(+ p50 task latency) for the land-cover segmentation tile API, end-to-end
+through gateway → task store → broker → dispatcher → worker → micro-batcher →
+device, on whatever accelerator ``jax.devices()`` provides.
+
+Baseline anchor: the reference publishes no numbers (BASELINE.md), so the
+anchor is an NC6s_v3 (1× V100) estimate for an equivalent UNet segmentation
+container served one-request-per-POST (the reference's dispatch model —
+no cross-request batching, ~10 ms/tile device time + per-request HTTP/task
+overhead): ~40 tiles/s. ``vs_baseline`` = measured / 40.0, so the BASELINE.md
+target (≥4× NC6s_v3) is met when vs_baseline ≥ 4.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "req/s", "vs_baseline": N, ...extras}
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import io
+import json
+import sys
+import time
+
+import numpy as np
+
+NC6_V100_TILES_PER_SEC = 40.0
+TILE = 256
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_platform(args):
+    from aiohttp import web  # noqa: F401 — ensure aiohttp present early
+
+    from ai4e_tpu.models import create_unet, segment_logits_to_classes
+    from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+    from ai4e_tpu.runtime import (
+        InferenceWorker,
+        MicroBatcher,
+        ModelRuntime,
+        ServableModel,
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+    model, params = create_unet(tile=TILE)
+
+    def preprocess(body, content_type):
+        arr = np.load(io.BytesIO(body))
+        if arr.shape != (TILE, TILE, 3):
+            raise ValueError(f"bad tile shape {arr.shape}")
+        return arr.astype(np.float32)
+
+    def postprocess(logits):
+        classes = np.asarray(segment_logits_to_classes(logits[None])[0])
+        # Return the per-class pixel histogram (the payload clients act on);
+        # the full class map would be returned as PNG in production.
+        values, counts = np.unique(classes, return_counts=True)
+        return {int(v): int(c) for v, c in zip(values, counts)}
+
+    servable = ServableModel(
+        name="landcover",
+        apply_fn=model.apply,
+        params=params,
+        input_shape=(TILE, TILE, 3),
+        preprocess=preprocess,
+        postprocess=postprocess,
+        batch_buckets=tuple(args.buckets),
+    )
+
+    platform = LocalPlatform(PlatformConfig(
+        retry_delay=0.05, dispatcher_concurrency=args.dispatcher_concurrency))
+    runtime = ModelRuntime()
+    runtime.register(servable)
+    t0 = time.perf_counter()
+    runtime.warmup()
+    log(f"warmup (compile) took {time.perf_counter() - t0:.1f}s "
+        f"for buckets {servable.batch_buckets}")
+    batcher = MicroBatcher(runtime, max_wait_ms=args.max_wait_ms,
+                           max_pending=args.concurrency * 4)
+    worker = InferenceWorker("landcover-svc", runtime, batcher,
+                             task_manager=platform.task_manager,
+                             prefix="v1/landcover", store=platform.store)
+    worker.serve_model(servable, sync_path="/classify",
+                       async_path="/classify-async",
+                       maximum_concurrent_requests=args.concurrency * 4)
+    return platform, worker, batcher
+
+
+async def run_bench(args) -> dict:
+    from aiohttp import ClientSession, web
+
+    platform, worker, batcher = build_platform(args)
+
+    be_runner = web.AppRunner(worker.service.app)
+    await be_runner.setup()
+    be_site = web.TCPSite(be_runner, "127.0.0.1", 0)
+    await be_site.start()
+    be_port = be_runner.addresses[0][1]
+
+    platform.publish_async_api(
+        "/v1/landcover/classify-async",
+        f"http://127.0.0.1:{be_port}/v1/landcover/classify-async")
+
+    gw_runner = web.AppRunner(platform.gateway.app)
+    await gw_runner.setup()
+    gw_site = web.TCPSite(gw_runner, "127.0.0.1", 0)
+    await gw_site.start()
+    gw_port = gw_runner.addresses[0][1]
+
+    await batcher.start()
+    await platform.start()
+
+    rng = np.random.default_rng(0)
+    tile = rng.uniform(size=(TILE, TILE, 3)).astype(np.float32)
+    buf = io.BytesIO()
+    np.save(buf, tile)
+    payload = buf.getvalue()
+
+    gw = f"http://127.0.0.1:{gw_port}"
+    latencies: list[float] = []
+    completed = 0
+    failed = 0
+
+    async def one_task(session: ClientSession) -> None:
+        nonlocal completed, failed
+        t0 = time.perf_counter()
+        async with session.post(f"{gw}/v1/landcover/classify-async",
+                                data=payload) as resp:
+            task = await resp.json()
+        task_id = task["TaskId"]
+        while True:
+            async with session.get(
+                    f"{gw}/v1/taskmanagement/task/{task_id}") as resp:
+                record = await resp.json()
+            status = record["Status"]
+            if "completed" in status:
+                latencies.append(time.perf_counter() - t0)
+                completed += 1
+                return
+            if "failed" in status:
+                failed += 1
+                return
+            await asyncio.sleep(0.005)
+
+    async def client_loop(session, stop_at):
+        while time.perf_counter() < stop_at:
+            await one_task(session)
+
+    async with ClientSession() as session:
+        # warm the full path once
+        await one_task(session)
+        latencies.clear(); completed = 0; failed = 0
+
+        start = time.perf_counter()
+        stop_at = start + args.duration
+        await asyncio.gather(*[client_loop(session, stop_at)
+                               for _ in range(args.concurrency)])
+        elapsed = time.perf_counter() - start
+
+    await platform.stop()
+    await batcher.stop()
+    await gw_runner.cleanup()
+    await be_runner.cleanup()
+
+    lat = np.sort(np.asarray(latencies)) if latencies else np.asarray([0.0])
+    throughput = completed / elapsed
+    return {
+        "metric": "async_landcover_seg_throughput",
+        "value": round(throughput, 2),
+        "unit": "req/s",
+        "vs_baseline": round(throughput / NC6_V100_TILES_PER_SEC, 2),
+        "p50_latency_ms": round(float(lat[len(lat) // 2]) * 1000, 1),
+        "p95_latency_ms": round(float(lat[int(len(lat) * 0.95) - 1]) * 1000, 1),
+        "completed": completed,
+        "failed": failed,
+        "duration_s": round(elapsed, 1),
+        "concurrency": args.concurrency,
+        "device": _device_kind(),
+    }
+
+
+def _device_kind() -> str:
+    import jax
+    d = jax.devices()[0]
+    return f"{d.platform}:{getattr(d, 'device_kind', '?')}x{jax.device_count()}"
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--duration", type=float, default=20.0)
+    parser.add_argument("--concurrency", type=int, default=64)
+    parser.add_argument("--max-wait-ms", type=float, default=3.0)
+    parser.add_argument("--dispatcher-concurrency", type=int, default=8)
+    parser.add_argument("--buckets", type=int, nargs="+", default=[1, 4, 16])
+    parser.add_argument("--cpu", action="store_true",
+                        help="force CPU (debug runs)")
+    args = parser.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    log(f"devices: {jax.devices()}")
+
+    result = asyncio.run(run_bench(args))
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

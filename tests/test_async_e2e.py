@@ -243,3 +243,69 @@ class TestCrashRecovery:
                 await svc_client.close()
 
         run(after_restart())
+
+
+class TestOperationTails:
+    def test_tail_and_query_reach_backend(self):
+        # A POST to {prefix}/op?x=1 must reach the backend's /op route with
+        # the query intact, not the bare registered URI.
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            svc = platform.make_service("multi", prefix="v1/multi")
+            seen = {}
+
+            @svc.api_async_func("/work/opB")
+            def op_b(taskId, body, content_type):
+                seen["op"] = "B"
+                asyncio.run(platform.task_manager.complete_task(
+                    taskId, "completed - opB"))
+
+            @svc.api_async_func("/work")
+            def base(taskId, body, content_type):
+                seen["op"] = "base"
+                asyncio.run(platform.task_manager.complete_task(
+                    taskId, "completed - base"))
+
+            svc_client = await serve(svc.app)
+            platform.publish_async_api(
+                "/v1/public/work", str(svc_client.make_url("/v1/multi/work")))
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                resp = await gw.post("/v1/public/work/opB?conf=0.9", data=b"x")
+                tid = (await resp.json())["TaskId"]
+                final = await poll_until(
+                    gw, tid, lambda b: "completed" in b["Status"], tries=400)
+                assert final["Status"] == "completed - opB"
+                assert seen["op"] == "B"
+            finally:
+                await platform.stop()
+                await gw.close()
+                await svc_client.close()
+
+        run(main())
+
+
+class TestDeadLetterHandler:
+    def test_reaped_dead_letter_fails_task(self):
+        # retry_delay > lease: reaper dead-letters while dispatcher sleeps;
+        # the platform's handler must still fail the task.
+        async def main():
+            platform = LocalPlatform(PlatformConfig(
+                retry_delay=0.3, max_delivery_count=1, lease_seconds=0.05))
+            platform.gateway.add_async_route(
+                "/v1/public/never", "http://127.0.0.1:1/v1/never")
+            platform.dispatchers.register("/v1/never", "http://127.0.0.1:1/v1/never")
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                resp = await gw.post("/v1/public/never", data=b"x")
+                tid = (await resp.json())["TaskId"]
+                final = await poll_until(
+                    gw, tid, lambda b: "failed" in b["Status"], tries=400)
+                assert "failed" in final["Status"], final
+            finally:
+                await platform.stop()
+                await gw.close()
+
+        run(main())

@@ -1,0 +1,136 @@
+"""The minimum end-to-end slice (SURVEY.md §7 build step 4): a real Flax model
+(tiny UNet) served through gateway → broker → dispatcher → InferenceWorker →
+MicroBatcher → mesh-sharded pjit call → task store result."""
+
+import asyncio
+import io
+import json
+
+import numpy as np
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.models import create_unet, segment_logits_to_classes
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.runtime import InferenceWorker, MicroBatcher, ModelRuntime, ServableModel
+
+TILE = 32
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def npy_bytes(arr):
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+def make_unet_servable():
+    model, params = create_unet(tile=TILE, widths=(16, 32))
+
+    def preprocess(body, content_type):
+        arr = np.load(io.BytesIO(body))
+        if arr.shape != (TILE, TILE, 3):
+            raise ValueError(f"expected ({TILE},{TILE},3), got {arr.shape}")
+        return arr.astype(np.float32)
+
+    def postprocess(logits):
+        classes = segment_logits_to_classes(logits[None])[0]
+        values, counts = np.unique(np.asarray(classes), return_counts=True)
+        return {"class_histogram": {int(v): int(c) for v, c in
+                                    zip(values, counts)},
+                "shape": list(classes.shape)}
+
+    return ServableModel(
+        name="landcover",
+        apply_fn=model.apply,
+        params=params,
+        input_shape=(TILE, TILE, 3),
+        preprocess=preprocess,
+        postprocess=postprocess,
+        batch_buckets=(8,),
+    )
+
+
+class TestInferenceE2E:
+    def test_sync_and_async_inference(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            runtime = ModelRuntime()
+            runtime.register(make_unet_servable())
+            runtime.warmup()
+            batcher = MicroBatcher(runtime, max_wait_ms=5)
+            worker = InferenceWorker(
+                "landcover-svc", runtime, batcher,
+                task_manager=platform.task_manager, prefix="v1/landcover",
+                store=platform.store)
+            worker.serve_model(runtime.models["landcover"],
+                               sync_path="/classify",
+                               async_path="/classify-async")
+            await batcher.start()
+
+            svc_client = await serve(worker.service.app)
+            platform.publish_sync_api(
+                "/v1/landcover/classify",
+                str(svc_client.make_url("/v1/landcover/classify")))
+            platform.publish_async_api(
+                "/v1/landcover/classify-async",
+                str(svc_client.make_url("/v1/landcover/classify-async")))
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                tile = np.random.default_rng(0).uniform(
+                    size=(TILE, TILE, 3)).astype(np.float32)
+
+                # -- sync path through the gateway proxy
+                resp = await gw.post("/v1/landcover/classify",
+                                     data=npy_bytes(tile))
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["shape"] == [TILE, TILE]
+                assert sum(body["class_histogram"].values()) == TILE * TILE
+
+                # -- async path: task through broker → dispatcher → worker
+                resp = await gw.post("/v1/landcover/classify-async",
+                                     data=npy_bytes(tile))
+                task_id = (await resp.json())["TaskId"]
+                final = None
+                for _ in range(400):
+                    poll = await gw.get(f"/v1/taskmanagement/task/{task_id}")
+                    final = await poll.json()
+                    if "completed" in final["Status"] or "failed" in final["Status"]:
+                        break
+                    await asyncio.sleep(0.02)
+                assert "completed" in final["Status"], final
+
+                # result payload stored on the task
+                result = platform.store.get_result(task_id)
+                assert result is not None
+                parsed = json.loads(result[0])
+                assert sum(parsed["class_histogram"].values()) == TILE * TILE
+
+                # -- bad payload fails its task only
+                resp = await gw.post("/v1/landcover/classify-async",
+                                     data=b"not-an-npy")
+                bad_id = (await resp.json())["TaskId"]
+                for _ in range(400):
+                    poll = await gw.get(f"/v1/taskmanagement/task/{bad_id}")
+                    bad = await poll.json()
+                    if "failed" in bad["Status"]:
+                        break
+                    await asyncio.sleep(0.02)
+                assert "failed - bad input" in bad["Status"]
+            finally:
+                await platform.stop()
+                await batcher.stop()
+                await gw.close()
+                await svc_client.close()
+
+        run(main())

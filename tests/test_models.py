@@ -1,0 +1,91 @@
+"""Model-family tests (tiny configs for CPU CI): forward shapes, dtype policy,
+and detector decoding semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ai4e_tpu.models import (
+    create_detector,
+    create_resnet50,
+    create_unet,
+    decode_detections,
+    segment_logits_to_classes,
+)
+from ai4e_tpu.models.resnet import ResNet
+from ai4e_tpu.models.unet import UNet
+
+
+class TestUNet:
+    def test_forward_shape_and_dtype(self):
+        model, params = create_unet(tile=64, widths=(16, 32))
+        x = jnp.zeros((2, 64, 64, 3))
+        logits = model.apply(params, x)
+        assert logits.shape == (2, 64, 64, 4)
+        assert logits.dtype == jnp.float32  # head kept in f32
+
+    def test_class_map(self):
+        model, params = create_unet(tile=32, widths=(16, 32))
+        logits = model.apply(params, jnp.ones((1, 32, 32, 3)))
+        classes = segment_logits_to_classes(logits)
+        assert classes.shape == (1, 32, 32)
+        assert classes.dtype == jnp.uint8
+        assert int(classes.max()) < 4
+
+    def test_jit_compiles_once_per_shape(self):
+        model, params = create_unet(tile=32, widths=(16, 32))
+        fn = jax.jit(model.apply)
+        fn(params, jnp.zeros((1, 32, 32, 3)))
+        fn(params, jnp.zeros((1, 32, 32, 3)))  # cache hit, no error
+
+
+class TestResNet:
+    def test_forward_shape(self):
+        model = ResNet(stage_sizes=(1, 1), num_classes=10, width=8)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 32, 32, 3)))
+        logits = model.apply(variables, jnp.zeros((3, 32, 32, 3)))
+        assert logits.shape == (3, 10)
+        assert logits.dtype == jnp.float32
+
+
+class TestDetector:
+    def test_forward_and_decode(self):
+        model, params = create_detector(image_size=64)
+        outputs = model.apply(params, jnp.zeros((2, 64, 64, 3)))
+        assert outputs["heatmap"].shape == (2, 8, 8, 3)  # stride 8
+        dets = decode_detections(outputs, max_detections=16)
+        assert dets["boxes"].shape == (2, 16, 4)
+        assert dets["scores"].shape == (2, 16)
+        assert dets["classes"].shape == (2, 16)
+
+    def test_decode_finds_planted_peak(self):
+        # Hand-build outputs with one hot center; decode must recover it.
+        h = w = 8
+        heat = np.full((1, h, w, 3), -10.0, np.float32)
+        heat[0, 4, 5, 1] = 10.0  # strong person (class 1) at cell (4, 5)
+        outputs = {
+            "heatmap": jnp.asarray(heat),
+            "wh": jnp.ones((1, h, w, 2)) * 2.0,
+            "offset": jnp.zeros((1, h, w, 2)),
+        }
+        dets = decode_detections(outputs, stride=8, max_detections=4)
+        assert int(dets["classes"][0, 0]) == 1
+        assert float(dets["scores"][0, 0]) > 0.99
+        cy = (dets["boxes"][0, 0, 0] + dets["boxes"][0, 0, 2]) / 2
+        cx = (dets["boxes"][0, 0, 1] + dets["boxes"][0, 0, 3]) / 2
+        assert float(cy) == 4 * 8 and float(cx) == 5 * 8
+
+    def test_peak_nms_suppresses_neighbours(self):
+        h = w = 8
+        heat = np.full((1, h, w, 1), -10.0, np.float32)
+        heat[0, 4, 4, 0] = 10.0
+        heat[0, 4, 5, 0] = 9.0  # adjacent, weaker → must be suppressed
+        outputs = {
+            "heatmap": jnp.asarray(heat),
+            "wh": jnp.ones((1, h, w, 2)),
+            "offset": jnp.zeros((1, h, w, 2)),
+        }
+        dets = decode_detections(outputs, max_detections=2)
+        assert float(dets["scores"][0, 0]) > 0.99
+        assert float(dets["scores"][0, 1]) < 0.01  # masked to ~0

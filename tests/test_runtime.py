@@ -1,0 +1,184 @@
+"""Runtime tests: mesh construction, servable registration/warmup over the
+8-device CPU mesh, and micro-batcher semantics (adaptive batching, padding,
+failure isolation, saturation backpressure)."""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ai4e_tpu.parallel import MeshSpec, make_mesh
+from ai4e_tpu.runtime import BatcherSaturated, MicroBatcher, ModelRuntime, ServableModel
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _double_servable(buckets=(1, 2, 4, 8), shape=(4,)):
+    """Trivial servable: doubles its input; postprocess sums."""
+    return ServableModel(
+        name="double",
+        apply_fn=lambda params, batch: batch * params["scale"],
+        params={"scale": jnp.asarray(2.0)},
+        input_shape=shape,
+        preprocess=lambda body, ct: np.frombuffer(body, np.float32),
+        postprocess=lambda out: {"sum": float(np.asarray(out).sum())},
+        batch_buckets=buckets,
+    )
+
+
+class TestMesh:
+    def test_default_mesh_all_dp(self):
+        mesh = make_mesh()
+        assert mesh.shape["dp"] == 8
+        assert mesh.shape["tp"] == 1
+
+    def test_auto_spec_tp(self):
+        spec = MeshSpec.auto(8, model_parallel=2)
+        assert (spec.dp, spec.tp) == (4, 2)
+        mesh = make_mesh(spec)
+        assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+
+    def test_bad_spec_raises(self):
+        with pytest.raises(ValueError):
+            MeshSpec.auto(8, model_parallel=3)
+        with pytest.raises(ValueError):
+            make_mesh(MeshSpec(dp=3))
+
+
+class TestModelRuntime:
+    def test_register_warmup_run(self):
+        runtime = ModelRuntime()
+        servable = runtime.register(_double_servable())
+        times = runtime.warmup()
+        assert times["double"] > 0
+        out = runtime.run_batch("double", np.ones((8, 4), np.float32))
+        np.testing.assert_allclose(out, 2.0 * np.ones((8, 4)))
+
+    def test_bucket_selection(self):
+        s = _double_servable(buckets=(1, 2, 4, 8))
+        assert s.bucket_for(1) == 1
+        assert s.bucket_for(3) == 4
+        assert s.bucket_for(8) == 8
+        assert s.bucket_for(99) == 8  # clamped to max
+
+
+class TestMicroBatcher:
+    def test_single_request_roundtrip(self):
+        async def main():
+            runtime = ModelRuntime()
+            runtime.register(_double_servable())
+            batcher = MicroBatcher(runtime, max_wait_ms=1)
+            await batcher.start()
+            try:
+                result = await batcher.submit(
+                    "double", np.asarray([1, 2, 3, 4], np.float32))
+                assert result == {"sum": 20.0}  # 2*(1+2+3+4)
+            finally:
+                await batcher.stop()
+
+        run(main())
+
+    def test_concurrent_requests_are_batched(self):
+        async def main():
+            runtime = ModelRuntime()
+            runtime.register(_double_servable())
+            batcher = MicroBatcher(runtime, max_wait_ms=20)
+            await batcher.start()
+            try:
+                results = await asyncio.gather(*[
+                    batcher.submit("double",
+                                   np.full((4,), i, np.float32))
+                    for i in range(8)
+                ])
+                for i, r in enumerate(results):
+                    assert r == {"sum": 2.0 * i * 4}
+                # Adaptive batching actually batched (not 8 singles).
+                sizes = batcher._batch_size_hist
+                assert sizes.quantile(1.0, model="double") >= 2
+            finally:
+                await batcher.stop()
+
+        run(main())
+
+    def test_bad_shape_rejected_immediately(self):
+        async def main():
+            runtime = ModelRuntime()
+            runtime.register(_double_servable())
+            batcher = MicroBatcher(runtime, max_wait_ms=1)
+            await batcher.start()
+            try:
+                with pytest.raises(ValueError):
+                    await batcher.submit("double", np.zeros((5,), np.float32))
+            finally:
+                await batcher.stop()
+
+        run(main())
+
+    def test_per_example_postprocess_failure_isolated(self):
+        async def main():
+            runtime = ModelRuntime()
+            s = _double_servable()
+
+            def post(out):
+                arr = np.asarray(out)
+                if arr[0] < 0:
+                    raise ValueError("negative!")
+                return {"sum": float(arr.sum())}
+
+            s.postprocess = post
+            runtime.register(s)
+            batcher = MicroBatcher(runtime, max_wait_ms=20)
+            await batcher.start()
+            try:
+                goods = [batcher.submit("double", np.ones((4,), np.float32))
+                         for _ in range(3)]
+                bad = batcher.submit("double", -np.ones((4,), np.float32))
+                results = await asyncio.gather(*goods, bad,
+                                               return_exceptions=True)
+                assert [r for r in results[:3]] == [{"sum": 8.0}] * 3
+                assert isinstance(results[3], ValueError)  # only the bad one
+            finally:
+                await batcher.stop()
+
+        run(main())
+
+    def test_saturation_raises(self):
+        async def main():
+            runtime = ModelRuntime()
+            runtime.register(_double_servable())
+            batcher = MicroBatcher(runtime, max_wait_ms=1000, max_pending=2)
+            # NOT started: requests pile up in pending
+            f1 = asyncio.ensure_future(
+                batcher.submit("double", np.ones((4,), np.float32)))
+            f2 = asyncio.ensure_future(
+                batcher.submit("double", np.ones((4,), np.float32)))
+            await asyncio.sleep(0.01)
+            with pytest.raises(BatcherSaturated):
+                await batcher.submit("double", np.ones((4,), np.float32))
+            f1.cancel(); f2.cancel()
+
+        run(main())
+
+    def test_padding_not_leaked_into_results(self):
+        # 3 requests on buckets (1,2,4,8) → bucket 4, one padded row; padded
+        # row must never surface as a result.
+        async def main():
+            runtime = ModelRuntime()
+            runtime.register(_double_servable())
+            batcher = MicroBatcher(runtime, max_wait_ms=20)
+            await batcher.start()
+            try:
+                results = await asyncio.gather(*[
+                    batcher.submit("double", np.full((4,), 5, np.float32))
+                    for _ in range(3)
+                ])
+                assert results == [{"sum": 40.0}] * 3
+            finally:
+                await batcher.stop()
+
+        run(main())
