@@ -105,15 +105,14 @@ class Dispatcher:
         made is reproduced (request_policy.xml:15 records the original URI;
         BackendQueueProcessor posts to per-queue config)."""
         from urllib.parse import urlparse
-        parsed = urlparse(msg.endpoint)
-        path = parsed.path if "://" in msg.endpoint else msg.endpoint.split("?")[0]
+        parsed = urlparse(msg.endpoint)  # handles bare paths too
+        path = parsed.path
         base = self.queue_name.rstrip("/")
         target = self.backend_uri
         if path != base and path.startswith(base + "/"):
             target = self.backend_uri.rstrip("/") + path[len(base):]
-        query = parsed.query if "://" in msg.endpoint else ""
-        if query:
-            target += "?" + query
+        if parsed.query:
+            target += "?" + parsed.query
         return target
 
     async def _dispatch_one(self, msg: Message) -> None:

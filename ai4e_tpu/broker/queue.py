@@ -183,6 +183,11 @@ class InMemoryBroker:
             for q in self._queues.values():
                 q.dead_letter_handler = handler
 
+    def register_queue(self, name: str) -> None:
+        """Pre-create a queue so prefix routing can target it (parity with
+        the native broker's explicit registration)."""
+        self.queue(name)
+
     def queue(self, name: str) -> EndpointQueue:
         with self._queues_lock:
             q = self._queues.get(name)

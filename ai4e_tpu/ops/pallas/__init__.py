@@ -1,0 +1,13 @@
+from .image_preprocess import normalize_image
+from .seg_postprocess import (
+    class_histogram,
+    fused_seg_postprocess,
+    segmentation_argmax,
+)
+
+__all__ = [
+    "normalize_image",
+    "class_histogram",
+    "fused_seg_postprocess",
+    "segmentation_argmax",
+]

@@ -1,0 +1,57 @@
+"""Pallas TPU kernel: fused uint8 image normalization.
+
+Input path hot op: clients send uint8 pixels; shipping uint8 to the device and
+normalizing on-chip cuts host→device transfer 4× versus sending float32 (HBM
+and interconnect bandwidth are the serving bottleneck, not FLOPs). The kernel
+fuses cast → scale → mean/std normalization in one VMEM pass.
+
+Mean/std are per-channel scalars; with C small (3) they are passed as (1, C)
+arrays and broadcast on the VPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _normalize_kernel(img_ref, mean_ref, std_ref, out_ref):
+    # img_ref: (1, TH, W, C) uint8; out (1, TH, W, C) float32
+    x = img_ref[0].astype(jnp.float32) * (1.0 / 255.0)
+    mean = mean_ref[0]  # (C,)
+    std = std_ref[0]
+    out_ref[0] = (x - mean[None, None, :]) / std[None, None, :]
+
+
+def normalize_image(images: jax.Array, mean=None, std=None,
+                    tile_h: int = 64, interpret: bool | None = None) -> jax.Array:
+    """(B, H, W, C) uint8 → (B, H, W, C) float32 in normalized range."""
+    b, h, w, c = images.shape
+    if images.dtype != jnp.uint8:
+        raise ValueError(f"expected uint8 input, got {images.dtype}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    tile_h = min(tile_h, h)
+    if h % tile_h:
+        raise ValueError(f"H={h} not divisible by tile_h={tile_h}")
+    mean = jnp.asarray([0.0] * c if mean is None else mean, jnp.float32)
+    std = jnp.asarray([1.0] * c if std is None else std, jnp.float32)
+
+    return pl.pallas_call(
+        _normalize_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h, w, c), jnp.float32),
+        grid=(b, h // tile_h),
+        in_specs=[
+            pl.BlockSpec((1, tile_h, w, c), lambda i, j: (i, j, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, tile_h, w, c), lambda i, j: (i, j, 0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(images, mean[None], std[None])

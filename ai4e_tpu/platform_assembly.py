@@ -26,6 +26,7 @@ class PlatformConfig:
     dispatcher_concurrency: int = 1  # serial per queue (host.json:5-9)
     journal_path: str | None = None  # None → pure in-memory store
     lease_seconds: float = 300.0
+    native_broker: bool = False      # C++ broker core (native/broker_core.cpp)
 
 
 class LocalPlatform:
@@ -49,9 +50,15 @@ class LocalPlatform:
             self.store = JournaledTaskStore(self.config.journal_path)
         else:
             self.store = InMemoryTaskStore()
-        self.broker = InMemoryBroker(
-            max_delivery_count=self.config.max_delivery_count,
-            lease_seconds=self.config.lease_seconds)
+        if self.config.native_broker:
+            from .broker.native import NativeBroker
+            self.broker = NativeBroker(
+                max_delivery_count=self.config.max_delivery_count,
+                lease_seconds=self.config.lease_seconds)
+        else:
+            self.broker = InMemoryBroker(
+                max_delivery_count=self.config.max_delivery_count,
+                lease_seconds=self.config.lease_seconds)
         self.store.set_publisher(self.broker.publish)
         self.task_manager = LocalTaskManager(self.store)
         self.dispatchers = DispatcherPool(
@@ -77,7 +84,9 @@ class LocalPlatform:
         its queue (the reference needs an APIM operation + a Service Bus queue
         + a function app per API; here it's one call)."""
         self.gateway.add_async_route(public_prefix, backend_uri)
-        self.dispatchers.register(endpoint_path(backend_uri), backend_uri,
+        queue_name = endpoint_path(backend_uri)
+        self.broker.register_queue(queue_name)
+        self.dispatchers.register(queue_name, backend_uri,
                                   retry_delay=retry_delay,
                                   concurrency=concurrency)
 
@@ -132,3 +141,5 @@ class LocalPlatform:
             self._started = False
         for svc in self.services:
             await svc.drain(timeout=5.0)
+        if hasattr(self.broker, "close"):
+            self.broker.close()

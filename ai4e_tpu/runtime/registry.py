@@ -49,7 +49,7 @@ class ServableModel:
     batch_buckets: tuple[int, ...] = (1, 2, 4, 8)
     input_dtype: Any = np.float32
     version: str = "1.0"
-    _compiled: dict[int, Callable] = field(default_factory=dict, repr=False)
+    _compiled: Callable | None = field(default=None, repr=False)
 
     def bucket_for(self, n: int) -> int:
         for b in self.batch_buckets:
@@ -96,13 +96,11 @@ class ModelRuntime:
         batch_sharding = NamedSharding(
             self.mesh, P(("dp", "fsdp"), *([None] * len(servable.input_shape))))
 
-        fn = jax.jit(
+        servable._compiled = jax.jit(
             servable.apply_fn,
             in_shardings=(None, batch_sharding),
             donate_argnums=(1,) if self._donate else (),
         )
-        for bucket in servable.batch_buckets:
-            servable._compiled[bucket] = fn
         self.models[servable.name] = servable
         return servable
 
@@ -117,7 +115,7 @@ class ModelRuntime:
             for bucket in servable.batch_buckets:
                 dummy = np.zeros((bucket, *servable.input_shape),
                                  servable.input_dtype)
-                out = servable._compiled[bucket](servable.params, dummy)
+                out = servable._compiled(servable.params, dummy)
                 jax.block_until_ready(out)
             times[name] = time.perf_counter() - t0
             log.info("warmup %s: %d buckets in %.1fs", name,
@@ -127,7 +125,7 @@ class ModelRuntime:
     def run_batch(self, name: str, batch: np.ndarray):
         """Execute one padded batch; blocking (call from an executor)."""
         servable = self.models[name]
-        out = servable._compiled[batch.shape[0]](servable.params, batch)
+        out = servable._compiled(servable.params, batch)
         return jax.device_get(out)
 
 

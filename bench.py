@@ -38,7 +38,8 @@ def log(msg: str) -> None:
 def build_platform(args):
     from aiohttp import web  # noqa: F401 — ensure aiohttp present early
 
-    from ai4e_tpu.models import create_unet, segment_logits_to_classes
+    from ai4e_tpu.models import create_unet
+    from ai4e_tpu.ops.pallas import fused_seg_postprocess
     from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
     from ai4e_tpu.runtime import (
         InferenceWorker,
@@ -57,16 +58,21 @@ def build_platform(args):
             raise ValueError(f"bad tile shape {arr.shape}")
         return arr.astype(np.float32)
 
-    def postprocess(logits):
-        classes = np.asarray(segment_logits_to_classes(logits[None])[0])
-        # Return the per-class pixel histogram (the payload clients act on);
-        # the full class map would be returned as PNG in production.
-        values, counts = np.unique(classes, return_counts=True)
-        return {int(v): int(c) for v, c in zip(values, counts)}
+    def apply_fn(p, batch):
+        # Argmax fused on-device (Pallas kernel): the device returns 1-byte
+        # class ids + counts, not 4-byte logits — 16× less device→host
+        # traffic on the serving hot path.
+        return fused_seg_postprocess(model.apply(p, batch))
+
+    def postprocess(out):
+        counts = np.asarray(out["counts"])
+        # Per-class pixel histogram (the payload clients act on); the class
+        # map itself would be PNG-encoded in production.
+        return {int(c): int(n) for c, n in enumerate(counts) if n}
 
     servable = ServableModel(
         name="landcover",
-        apply_fn=model.apply,
+        apply_fn=apply_fn,
         params=params,
         input_shape=(TILE, TILE, 3),
         preprocess=preprocess,
