@@ -116,17 +116,29 @@ class Dispatcher:
         return target
 
     async def _dispatch_one(self, msg: Message) -> None:
+        from ..observability import get_tracer
         target = self._target_for(msg)
         session = await self._sessions.get()
+        tracer = get_tracer()
         try:
-            async with session.post(
-                target,
-                data=msg.body,
-                headers={"taskId": msg.task_id,
-                         "Content-Type": msg.content_type},
-            ) as resp:
-                status = resp.status
-                await resp.read()
+            # One span per delivery attempt, keyed by TaskId; the injected
+            # x-b3 headers parent the backend's endpoint span to this one,
+            # so a task's dispatch → execution is a single trace.
+            with tracer.span("dispatch", task_id=msg.task_id,
+                             queue=self.queue_name,
+                             attempt=msg.delivery_count) as span:
+                headers = {"taskId": msg.task_id,
+                           "Content-Type": msg.content_type,
+                           **tracer.headers()}
+                async with session.post(
+                    target, data=msg.body, headers=headers,
+                ) as resp:
+                    status = resp.status
+                    await resp.read()
+                span.attrs["http_status"] = status
+                if not (200 <= status < 300 or status in BACKPRESSURE_CODES):
+                    span.status = "error"
+                    span.error = f"backend returned {status}"
         except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
             # Backend unreachable — treat like saturation: the pod may be
             # restarting; broker patience (max deliveries) bounds total retry.

@@ -1,0 +1,151 @@
+"""Checkpoint / resume — durable model state via Orbax.
+
+The reference has **no** model or job checkpointing (SURVEY.md §5): its only
+durable state is the task record in Redis — a crashed worker's message is
+redelivered and any replica resumes the task by TaskId
+(``ProcessManager/BackendQueueProcessor/host.json:7`` autoComplete:false,
+``CacheConnectorUpsert.cs:158`` original-body persistence). Model weights live
+frozen inside opaque containers.
+
+The TPU build keeps that task-level durability (``taskstore.JournaledTaskStore``)
+and adds the layer the reference couldn't have:
+
+- **serving**: workers restore servable params from a checkpoint at pod start
+  (``load_params`` with the model's init tree) instead of baking weights into
+  images — the
+  model-distribution slot the reference fills with ``docker push``
+  (``APIs/DistributedImages/python-dist.dockerfile:1-11``);
+- **training**: ``CheckpointManager`` save/restore of params + opt state +
+  step, so fine-tuning survives preemption (TPU pods are preemptible; the
+  reference's AKS GPU pools assumed long-lived nodes).
+
+Orbax handles sharded arrays natively: on restore, arrays are placed directly
+onto the mesh via the target tree's shardings — no host-memory detour on
+multi-host slices.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+log = logging.getLogger("ai4e_tpu.checkpoint")
+
+
+def save_params(path: str, params: Any) -> None:
+    """Write a single params pytree (serving checkpoint). ``path`` must be
+    absolute; an existing checkpoint at the path is replaced."""
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params, force=True)
+    ckptr.wait_until_finished()
+    ckptr.close()
+
+
+def load_params(path: str, like: Any | None = None) -> Any:
+    """Restore a params pytree. With ``like`` (a pytree of arrays or
+    ShapeDtypeStructs, possibly sharded), arrays restore to its shapes,
+    dtypes, and shardings — pass the model's init tree to land params
+    directly on the mesh."""
+    ckptr = ocp.StandardCheckpointer()
+    if like is not None:
+        target = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=getattr(a, "sharding", None)),
+            like)
+        out = ckptr.restore(path, target)
+    else:
+        out = ckptr.restore(path)
+    ckptr.close()
+    return out
+
+
+class CheckpointManager:
+    """Rolling train-state checkpoints: params + optimizer state + step.
+
+    Thin policy layer over ``orbax.CheckpointManager``: keep the latest
+    ``max_to_keep``, save every ``save_interval_steps``, resume from the
+    newest on restart. The task journal plays the same role for tasks; this
+    plays it for weights.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+            ),
+        )
+
+    def save(self, step: int, params: Any, opt_state: Any | None = None,
+             extra: dict | None = None) -> bool:
+        """Save (respecting the save-interval policy). Returns True if a
+        checkpoint was actually written."""
+        items = {"params": ocp.args.StandardSave(params)}
+        if opt_state is not None:
+            items["opt_state"] = ocp.args.StandardSave(opt_state)
+        if extra:
+            items["extra"] = ocp.args.JsonSave(extra)
+        saved = self._mgr.save(step, args=ocp.args.Composite(**items))
+        return bool(saved)
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, params_like: Any, opt_state_like: Any | None = None,
+                step: int | None = None) -> dict:
+        """Restore the given (or latest) step onto the templates' shardings.
+        Returns {"step", "params", "opt_state"?, "extra"?}."""
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint to restore")
+
+        def as_struct(tree):
+            return jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(
+                    np.shape(a), a.dtype,
+                    sharding=getattr(a, "sharding", None)), tree)
+
+        items = {"params": ocp.args.StandardRestore(as_struct(params_like))}
+        if opt_state_like is not None:
+            items["opt_state"] = ocp.args.StandardRestore(
+                as_struct(opt_state_like))
+        saved_items = self._mgr.item_metadata(step)
+        if saved_items is not None and "extra" in saved_items:
+            items["extra"] = ocp.args.JsonRestore()
+        restored = self._mgr.restore(step, args=ocp.args.Composite(**items))
+        out = {"step": step, "params": restored["params"]}
+        if opt_state_like is not None:
+            out["opt_state"] = restored["opt_state"]
+        if "extra" in items:
+            out["extra"] = restored["extra"]
+        return out
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def save_trainer(mgr: CheckpointManager, trainer, step: int) -> bool:
+    """Checkpoint a ``train.Trainer``'s full state."""
+    return mgr.save(step, trainer.params, trainer.opt_state)
+
+
+def resume_trainer(mgr: CheckpointManager, trainer) -> int:
+    """Restore the newest checkpoint into a ``train.Trainer`` in place;
+    returns the restored step (0 if nothing to restore)."""
+    try:
+        restored = mgr.restore(trainer.params, trainer.opt_state)
+    except FileNotFoundError:
+        return 0
+    trainer.params = restored["params"]
+    trainer.opt_state = restored["opt_state"]
+    return restored["step"]

@@ -1,0 +1,225 @@
+"""Typed configuration with environment-variable overrides.
+
+The reference configures everything through two untyped tiers — bash variables
+in ``InfrastructureDeployment/setup_env.sh:1-82`` at deploy time, and raw
+``getenv`` reads scattered through the code at runtime
+(``APIs/1.0/base-py/ai4e_service.py:19-22``, ``APIs/1.0/Common/task_management/
+distributed_api_task.py:14-15``, ``ProcessManager/Libraries/RedisConnection.cs:24-27``)
+— with secrets pasted into Helm values files
+(``APIs/Charts/camera-trap/detection-async/prod-values.yaml:41-46``).
+
+Here the same two tiers are typed: dataclass sections with defaults (the
+deploy-time tier) and an ``AI4E_<SECTION>_<FIELD>`` environment override for
+every field (the runtime tier). Values are parsed per the field's declared
+type, so a malformed override fails loudly at startup instead of deep inside a
+request. No secret material is ever written by the framework; anything
+secret-shaped stays an env var end to end.
+
+Usage::
+
+    cfg = FrameworkConfig.from_env()            # defaults + AI4E_* overrides
+    cfg.observability.apply()                   # tracer sampling/export sink
+    platform = LocalPlatform(cfg.to_platform_config())
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import typing
+from dataclasses import dataclass, field, fields
+
+_TRUE = frozenset({"1", "true", "yes", "on"})
+_FALSE = frozenset({"0", "false", "no", "off", ""})
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _parse(raw: str, typ, name: str):
+    """Parse an env string per the declared field type."""
+    origin = typing.get_origin(typ)
+    if origin is typing.Union:  # Optional[X] — "" means None
+        args = [a for a in typing.get_args(typ) if a is not type(None)]
+        if raw == "":
+            return None
+        return _parse(raw, args[0], name)
+    if typ is bool:
+        low = raw.strip().lower()
+        if low in _TRUE:
+            return True
+        if low in _FALSE:
+            return False
+        raise ConfigError(f"{name}: {raw!r} is not a boolean")
+    if typ is int:
+        try:
+            return int(raw)
+        except ValueError as e:
+            raise ConfigError(f"{name}: {raw!r} is not an int") from e
+    if typ is float:
+        try:
+            return float(raw)
+        except ValueError as e:
+            raise ConfigError(f"{name}: {raw!r} is not a float") from e
+    if origin in (tuple, list):
+        item_t = (typing.get_args(typ) or (str,))[0]
+        if item_t is Ellipsis:
+            item_t = str
+        items = [s.strip() for s in raw.split(",") if s.strip()]
+        parsed = [_parse(s, item_t, name) for s in items]
+        return tuple(parsed) if origin is tuple else parsed
+    return raw
+
+
+def section_from_env(cls, env: typing.Mapping[str, str] | None = None,
+                     prefix: str = "AI4E_", **overrides):
+    """Build a config dataclass from defaults + ``{prefix}{FIELD}`` env vars.
+
+    Explicit ``overrides`` win over env, env wins over defaults — the same
+    precedence the reference gets from Helm values overriding chart defaults
+    (``APIs/Charts/templates/async-gpu/templates/deployment.yaml:23-63``).
+    """
+    env = os.environ if env is None else env
+    kwargs = {}
+    hints = typing.get_type_hints(cls)
+    known = {prefix + f.name.upper(): f.name for f in fields(cls)}
+    for key, name in known.items():
+        if name in overrides:
+            kwargs[name] = overrides[name]
+        elif key in env:
+            kwargs[name] = _parse(env[key], hints[name], key)
+    # A prefixed-but-unknown variable is a misspelled field, the most common
+    # operator error — fail loudly instead of silently keeping the default.
+    unknown = [k for k in env if k.startswith(prefix) and k not in known]
+    if unknown:
+        raise ConfigError(
+            f"unknown config variable(s) {sorted(unknown)}; "
+            f"valid: {sorted(known)}")
+    return cls(**kwargs)
+
+
+def _env_section(prefix: str):
+    """Class decorator: attach ``from_env`` with the section's prefix."""
+    def deco(cls):
+        cls = dataclass(cls)
+        cls._env_prefix = prefix
+
+        def from_env(inner_cls, env=None, **overrides):
+            return section_from_env(inner_cls, env=env, prefix=prefix,
+                                    **overrides)
+
+        cls.from_env = classmethod(from_env)
+        return cls
+    return deco
+
+
+@_env_section("AI4E_PLATFORM_")
+class PlatformSection:
+    """Transport/task-fabric knobs (setup_env.sh:65-74 tier)."""
+    retry_delay: float = 60.0        # dispatcher backoff on 429/503 (s)
+    max_delivery_count: int = 1440   # broker patience (setup_env.sh:65)
+    dispatcher_concurrency: int = 1  # serial per queue (host.json:5-9)
+    journal_path: typing.Optional[str] = None
+    lease_seconds: float = 300.0
+    native_broker: bool = False
+
+    def to_platform_config(self):
+        from .platform_assembly import PlatformConfig
+        return PlatformConfig(
+            retry_delay=self.retry_delay,
+            max_delivery_count=self.max_delivery_count,
+            dispatcher_concurrency=self.dispatcher_concurrency,
+            journal_path=self.journal_path,
+            lease_seconds=self.lease_seconds,
+            native_broker=self.native_broker,
+        )
+
+
+@_env_section("AI4E_SERVICE_")
+class ServiceSection:
+    """In-container service shell knobs (ai4e_service.py:19-22 tier)."""
+    host: str = "0.0.0.0"
+    port: int = 8081
+    executor_workers: int = 8
+    drain_timeout: float = 30.0
+
+
+@_env_section("AI4E_RUNTIME_")
+class RuntimeSection:
+    """TPU runtime knobs — no reference analogue (containers were opaque)."""
+    batch_max_wait_ms: float = 5.0
+    batch_max_pending: int = 256
+    buckets: typing.Tuple[int, ...] = (1, 8, 32, 64)
+    compile_cache_dir: str = "/tmp/ai4e_tpu_xla_cache"
+    checkpoint_dir: typing.Optional[str] = None
+    donate_batch: bool = False
+    # mesh axes; 0 = infer from device count
+    dp: int = 0
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+
+@_env_section("AI4E_GATEWAY_")
+class GatewaySection:
+    """Edge router knobs (APIManagement tier). The upsert/get URIs are the
+    CACHE_CONNECTOR_UPSERT_URI / _GET_URI pattern (distributed_api_task.py:14-15)."""
+    host: str = "0.0.0.0"
+    port: int = 8080
+    taskstore_upsert_uri: typing.Optional[str] = None
+    taskstore_get_uri: typing.Optional[str] = None
+
+
+@_env_section("AI4E_OBSERVABILITY_")
+class ObservabilitySection:
+    """Tracing/metrics knobs (OCAGENT_TRACE_EXPORTER_ENDPOINT analogue,
+    prod-values.yaml:29)."""
+    trace_enabled: bool = True
+    trace_sample_rate: float = 1.0   # App Insights sampled 50 items/s (host.json:5-8)
+    trace_export_path: typing.Optional[str] = None  # JSONL span log; None → log only
+    queue_depth_interval: float = 30.0      # TaskQueueLogger.cs:19 (30 s)
+    process_depth_interval: float = 300.0   # TaskProcessLogger.cs:21 (5 min)
+
+    def apply(self) -> None:
+        """Install these settings on the process tracer (components without
+        explicit tracer settings follow it live)."""
+        from .observability import JsonlExporter, configure_tracer
+        rate = self.trace_sample_rate if self.trace_enabled else 0.0
+        exporter = (JsonlExporter(self.trace_export_path)
+                    if self.trace_export_path else None)
+        configure_tracer(exporter=exporter, sample_rate=rate)
+
+
+@dataclass
+class FrameworkConfig:
+    """The whole platform's config tree."""
+    platform: PlatformSection = field(default_factory=PlatformSection)
+    service: ServiceSection = field(default_factory=ServiceSection)
+    runtime: RuntimeSection = field(default_factory=RuntimeSection)
+    gateway: GatewaySection = field(default_factory=GatewaySection)
+    observability: ObservabilitySection = field(
+        default_factory=ObservabilitySection)
+
+    @classmethod
+    def from_env(cls, env: typing.Mapping[str, str] | None = None
+                 ) -> "FrameworkConfig":
+        return cls(
+            platform=PlatformSection.from_env(env),
+            service=ServiceSection.from_env(env),
+            runtime=RuntimeSection.from_env(env),
+            gateway=GatewaySection.from_env(env),
+            observability=ObservabilitySection.from_env(env),
+        )
+
+    def to_platform_config(self):
+        """The fully-wired ``PlatformConfig``: transport knobs from the
+        platform section, depth-logger intervals from observability."""
+        pc = self.platform.to_platform_config()
+        pc.queue_depth_interval = self.observability.queue_depth_interval
+        pc.process_depth_interval = self.observability.process_depth_interval
+        return pc
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)

@@ -19,6 +19,7 @@ Routes:
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from dataclasses import dataclass
 
@@ -52,6 +53,10 @@ class Gateway:
         self._requests = self.metrics.counter(
             "ai4e_gateway_requests_total", "Gateway requests by route/outcome")
         self._sessions = SessionHolder()
+        # task_id -> {(loop, Event)} long-poll waiters (see _task).
+        self._waiters: dict[str, set] = {}
+        if hasattr(store, "add_listener"):
+            store.add_listener(self._on_task_change)
 
         self.app = web.Application(client_max_size=1024**3)
         self.app.router.add_get("/v1/taskmanagement/task/{task_id}", self._task)
@@ -92,12 +97,16 @@ class Gateway:
                 endpoint = endpoint.rstrip("/") + "/" + tail
             if request.query_string:
                 endpoint += "?" + request.query_string
-            task = self.store.upsert(APITask(
-                endpoint=endpoint,
-                body=body,
-                content_type=request.content_type or "application/json",
-                publish=True,
-            ))
+            from ..observability import get_tracer
+            with get_tracer().span("create_task", route=route.prefix,
+                                   headers=request.headers) as span:
+                task = self.store.upsert(APITask(
+                    endpoint=endpoint,
+                    body=body,
+                    content_type=request.content_type or "application/json",
+                    publish=True,
+                ))
+                span.task_id = task.task_id
             stored = self.store.get(task.task_id)
             outcome = "failed" if stored.canonical_status == "failed" else "created"
             self._requests.inc(route=route.prefix, outcome=outcome)
@@ -134,12 +143,71 @@ class Gateway:
 
     # -- task polling (task_management_policy.xml:3-7) ---------------------
 
+    MAX_LONG_POLL = 60.0
+
     async def _task(self, request: web.Request) -> web.Response:
+        """Task status; ``?wait=SECONDS`` long-polls until the task reaches a
+        terminal state (or the wait expires) instead of making the client
+        spin on 5 ms GETs — the reference's polling contract
+        (``GET /task/{taskId}``) with the poll storm removed. Event-driven:
+        the store's change listener wakes exactly the waiters for that task.
+        """
+        task_id = request.match_info["task_id"]
         try:
-            task = self.store.get(request.match_info["task_id"])
+            task = self.store.get(task_id)
         except TaskNotFound:
             return web.Response(status=404, text="Task not found.")
+
+        wait = 0.0
+        if "wait" in request.query:
+            try:
+                wait = min(float(request.query["wait"]), self.MAX_LONG_POLL)
+            except ValueError:
+                return web.Response(status=400, text="Bad wait parameter.")
+
+        if wait > 0 and task.canonical_status not in ("completed", "failed"):
+            # Register the waiter BEFORE the re-read so a transition between
+            # re-read and wait() still sets the event (no lost wakeup).
+            event = self._waiter_for(task_id)
+            try:
+                task = self.store.get(task_id)
+                if task.canonical_status not in ("completed", "failed"):
+                    try:
+                        await asyncio.wait_for(event.wait(), timeout=wait)
+                    except asyncio.TimeoutError:
+                        pass
+                    task = self.store.get(task_id)
+            finally:
+                self._drop_waiter(task_id, event)
         return web.json_response(task.to_dict())
+
+    # Waiter bookkeeping is copy-on-write (sets are replaced, never mutated):
+    # _on_task_change may iterate from any thread while the event loop
+    # registers/drops waiters, and an in-place add() during iteration would
+    # raise — swallowed by the store's _notify — losing the wakeup.
+
+    def _waiter_for(self, task_id: str) -> asyncio.Event:
+        event = asyncio.Event()
+        self._waiters[task_id] = self._waiters.get(task_id, frozenset()) | {
+            (asyncio.get_running_loop(), event)}
+        return event
+
+    def _drop_waiter(self, task_id: str, event: asyncio.Event) -> None:
+        entries = self._waiters.get(task_id)
+        if entries:
+            remaining = frozenset(e for e in entries if e[1] is not event)
+            if remaining:
+                self._waiters[task_id] = remaining
+            else:
+                del self._waiters[task_id]
+
+    def _on_task_change(self, task) -> None:
+        """Store listener — may fire from any thread; wake that task's
+        long-poll waiters on terminal transitions."""
+        if task.canonical_status not in ("completed", "failed"):
+            return
+        for loop, event in self._waiters.get(task.task_id, frozenset()):
+            loop.call_soon_threadsafe(event.set)
 
     async def _health(self, _: web.Request) -> web.Response:
         return web.json_response({"status": "healthy", "routes": len(self.routes)})

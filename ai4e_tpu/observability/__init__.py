@@ -1,0 +1,43 @@
+"""Observability — distributed tracing keyed by TaskId, depth loggers.
+
+The reference's three tracing mechanisms (SURVEY.md §5): OpenCensus spans
+around every endpoint (``APIs/1.0/base-py/ai4e_service.py:158-178``), Istio
+mixer x-b3 header mapping into App Insights
+(``Cluster/monitoring/application-insights-istio-adapter/configuration.yaml:10-13``),
+and ad-hoc Stopwatch latency (``CacheConnectorUpsert.cs:162-201``). Here one
+tracer covers all three: in-process spans, x-b3 header propagation across the
+gateway → dispatcher → service hops, and span durations exported as metrics —
+every span carrying the TaskId so a task's life is one trace.
+"""
+
+from .tracing import (
+    InMemoryExporter,
+    JsonlExporter,
+    LogExporter,
+    Span,
+    TRACE_HEADER,
+    SPAN_HEADER,
+    PARENT_HEADER,
+    SAMPLED_HEADER,
+    Tracer,
+    configure_tracer,
+    device_trace,
+    get_tracer,
+)
+from .depth_logger import DepthLogger
+
+__all__ = [
+    "DepthLogger",
+    "InMemoryExporter",
+    "JsonlExporter",
+    "LogExporter",
+    "Span",
+    "TRACE_HEADER",
+    "SPAN_HEADER",
+    "PARENT_HEADER",
+    "SAMPLED_HEADER",
+    "Tracer",
+    "configure_tracer",
+    "device_trace",
+    "get_tracer",
+]

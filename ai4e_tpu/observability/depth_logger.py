@@ -1,0 +1,90 @@
+"""Periodic queue-depth gauges — the autoscaling signal's source.
+
+The reference runs two timer functions against the Redis status sets:
+``TaskQueueLogger`` every 30 s logging each endpoint's ``_created`` depth
+(tasks awaiting dispatch, ``ProcessManager/TaskProcessLogger/TaskQueueLogger.cs:19-27``)
+and ``TaskProcessLogger`` every 5 min logging ``_running/_completed/_failed``
+depths (``TaskProcessLogger.cs:21-31``), both via ``QueueLogger``'s scan of
+``*_{status}`` keys (``ProcessManager/Libraries/QueueLogger.cs:21-47``). Those
+metrics feed App Insights → the k8s metrics adapter → the HPA (§3.5).
+
+Here both timers are one asyncio component writing to the in-process metrics
+registry; the autoscaler (``runtime.autoscaler``) and the ``/metrics``
+endpoints read the same gauges.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from ..taskstore import TaskStatus
+
+log = logging.getLogger("ai4e_tpu.depth")
+
+
+class DepthLogger:
+    """Samples per-endpoint task depths from a store into gauges.
+
+    ``queue_interval`` covers the awaiting (= ``created``) depth — the scaling
+    signal needs to be fresh (30 s in the reference); ``process_interval``
+    covers the running/completed/failed totals (5 min — they only trend).
+    """
+
+    def __init__(self, store, metrics: MetricsRegistry | None = None,
+                 queue_interval: float = 30.0,
+                 process_interval: float = 300.0):
+        self.store = store
+        self.metrics = metrics or DEFAULT_REGISTRY
+        self.queue_interval = queue_interval
+        self.process_interval = process_interval
+        self._depth = self.metrics.gauge(
+            "ai4e_task_depth", "Tasks per endpoint per status")
+        self._tasks: list[asyncio.Task] = []
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample_queue_depth(self) -> dict[str, int]:
+        """Awaiting-dispatch depth per endpoint (TaskQueueLogger.cs:20-27)."""
+        out = {}
+        for path, by_status in self.store.depths().items():
+            n = by_status.get(TaskStatus.CREATED, 0)
+            self._depth.set(float(n), endpoint=path, status=TaskStatus.CREATED)
+            out[path] = n
+        return out
+
+    def sample_process_depths(self) -> dict[str, dict[str, int]]:
+        """Running/completed/failed depths (TaskProcessLogger.cs:22-31)."""
+        all_depths = self.store.depths()
+        for path, by_status in all_depths.items():
+            for status in (TaskStatus.RUNNING, TaskStatus.COMPLETED,
+                           TaskStatus.FAILED):
+                self._depth.set(float(by_status.get(status, 0)),
+                                endpoint=path, status=status)
+        return all_depths
+
+    # -- timers ------------------------------------------------------------
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._tick(self.queue_interval,
+                                        self.sample_queue_depth)),
+            loop.create_task(self._tick(self.process_interval,
+                                        self.sample_process_depths)),
+        ]
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+
+    async def _tick(self, interval: float, sample) -> None:
+        while True:
+            try:
+                sample()
+            except Exception:  # noqa: BLE001 — telemetry must not die
+                log.exception("depth sample failed")
+            await asyncio.sleep(interval)

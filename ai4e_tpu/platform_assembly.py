@@ -27,6 +27,8 @@ class PlatformConfig:
     journal_path: str | None = None  # None → pure in-memory store
     lease_seconds: float = 300.0
     native_broker: bool = False      # C++ broker core (native/broker_core.cpp)
+    queue_depth_interval: float = 30.0    # TaskQueueLogger.cs:19
+    process_depth_interval: float = 300.0  # TaskProcessLogger.cs:21
 
 
 class LocalPlatform:
@@ -66,6 +68,11 @@ class LocalPlatform:
             retry_delay=self.config.retry_delay,
             concurrency=self.config.dispatcher_concurrency)
         self.gateway = Gateway(self.store, metrics=self.metrics)
+        from .observability import DepthLogger
+        self.depth_logger = DepthLogger(
+            self.store, metrics=self.metrics,
+            queue_interval=self.config.queue_depth_interval,
+            process_interval=self.config.process_depth_interval)
         self.services: list[APIService] = []
         self._started = False
 
@@ -107,6 +114,7 @@ class LocalPlatform:
 
         self.broker.set_dead_letter_handler(on_dead_letter)
         await self.dispatchers.start()
+        await self.depth_logger.start()
         self._reseed_unfinished()
         self._started = True
 
@@ -138,6 +146,7 @@ class LocalPlatform:
     async def stop(self) -> None:
         if self._started:
             await self.dispatchers.stop()
+            await self.depth_logger.stop()
             self._started = False
         for svc in self.services:
             await svc.drain(timeout=5.0)

@@ -74,6 +74,7 @@ class APIService:
         task_manager: TaskManagerBase | None = None,
         metrics: MetricsRegistry | None = None,
         executor_workers: int = 8,
+        tracer=None,
     ):
         self.name = name
         self.prefix = ("/" + prefix.strip("/")) if prefix.strip("/") else ""
@@ -81,6 +82,11 @@ class APIService:
             task_manager = LocalTaskManager(InMemoryTaskStore())
         self.task_manager = task_manager
         self.metrics = metrics or DEFAULT_REGISTRY
+        if tracer is None:
+            from ..observability import Tracer
+            # No explicit exporter/sample_rate → follows configure_tracer live.
+            tracer = Tracer(name, metrics=self.metrics)
+        self.tracer = tracer
         self.is_terminating = False
         self.endpoints: dict[str, EndpointSpec] = {}
         self.executor = ThreadPoolExecutor(max_workers=executor_workers,
@@ -206,7 +212,11 @@ class APIService:
                         kwargs: dict) -> web.Response:
         t0 = time.perf_counter()
         try:
-            result = await self._invoke(spec.func, **kwargs)
+            # Span per endpoint execution (ai4e_service.py:158-167 wraps the
+            # sync path in tracer.span); inbound x-b3 headers parent it.
+            with self.tracer.span(spec.trace_name, headers=request.headers,
+                                  path=spec.api_path):
+                result = await self._invoke(spec.func, **kwargs)
             resp = self._to_response(result)
             self._http_total.inc(code=str(resp.status), path=spec.api_path)
             return resp
@@ -230,8 +240,14 @@ class APIService:
         # The reserved slot is held until the background execution finishes —
         # the cap covers running tasks, not just open connections
         # (ai4e_service.py:197-213 counts the worker thread the same way).
+        from ..observability import PARENT_HEADER, SAMPLED_HEADER, SPAN_HEADER, TRACE_HEADER
+        parent_headers = {
+            k: request.headers[k]
+            for k in (TRACE_HEADER, SPAN_HEADER, PARENT_HEADER, SAMPLED_HEADER)
+            if k in request.headers
+        }
         bg = asyncio.get_running_loop().create_task(
-            self._execute_async(spec, task_id, kwargs))
+            self._execute_async(spec, task_id, kwargs, parent_headers))
         self._background.add(bg)
         bg.add_done_callback(self._background.discard)
 
@@ -239,10 +255,15 @@ class APIService:
         return web.json_response({"TaskId": task_id, "Status": task.get("Status", "created")})
 
     async def _execute_async(self, spec: EndpointSpec, task_id: str,
-                             kwargs: dict) -> None:
+                             kwargs: dict,
+                             parent_headers: dict | None = None) -> None:
         t0 = time.perf_counter()
         try:
-            await self._invoke(spec.func, taskId=task_id, **kwargs)
+            # The span keyed by TaskId covers the whole background execution
+            # (the worker-thread hot loop, ai4e_service.py:169-183).
+            with self.tracer.span(spec.trace_name, task_id=task_id,
+                                  headers=parent_headers, path=spec.api_path):
+                await self._invoke(spec.func, taskId=task_id, **kwargs)
         except Exception as exc:  # noqa: BLE001
             log.exception("async endpoint %s task %s failed", spec.api_path, task_id)
             try:

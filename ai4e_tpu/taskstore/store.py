@@ -58,9 +58,25 @@ class InMemoryTaskStore:
         # ordered + scored like the reference's Redis sorted sets.
         self._sets: dict[tuple[str, str], dict[str, float]] = {}
         self._publisher = publisher
+        # Change listeners (e.g. the gateway's long-poll waiters). Called
+        # outside the lock, after every state transition, possibly from any
+        # thread — listeners must be cheap and thread-safe.
+        self._listeners: list[Callable[[APITask], None]] = []
 
     def set_publisher(self, publisher: Publisher | None) -> None:
         self._publisher = publisher
+
+    def add_listener(self, listener: Callable[[APITask], None]) -> None:
+        self._listeners.append(listener)
+
+    def _notify(self, task: APITask) -> None:
+        for listener in self._listeners:
+            try:
+                listener(task)
+            except Exception:  # noqa: BLE001 — observers must not break the store
+                import logging
+                logging.getLogger("ai4e_tpu.taskstore").exception(
+                    "task listener failed for %s", task.task_id)
 
     # -- core state machine ------------------------------------------------
 
@@ -81,6 +97,7 @@ class InMemoryTaskStore:
             task = self._apply_upsert(task)
             publisher = self._publisher if task.publish else None
 
+        self._notify(task)
         if publisher is not None:
             try:
                 publisher(task)
@@ -119,7 +136,9 @@ class InMemoryTaskStore:
         reference's ``_UpdateTaskStatus`` GET-then-POST at
         ``distributed_api_task.py:29-56`` is racy; SURVEY.md §5 flags it)."""
         with self._lock:
-            return self._apply_update(task_id, status, backend_status)
+            task = self._apply_update(task_id, status, backend_status)
+        self._notify(task)
+        return task
 
     def _apply_update(
         self, task_id: str, status: str, backend_status: str | None

@@ -19,7 +19,15 @@ async def main() -> None:
     gw_port = int(sys.argv[1]) if len(sys.argv) > 1 else 8080
     be_port = int(sys.argv[2]) if len(sys.argv) > 2 else 8083
 
-    platform = LocalPlatform(PlatformConfig(retry_delay=0.5))
+    # Boot from typed config: defaults + AI4E_* env overrides (e.g.
+    # AI4E_OBSERVABILITY_TRACE_EXPORT_PATH=/tmp/spans.jsonl for a span log,
+    # AI4E_PLATFORM_RETRY_DELAY=0.1 for faster redelivery).
+    from ai4e_tpu.config import FrameworkConfig
+    cfg = FrameworkConfig.from_env()
+    cfg.observability.apply()
+    pc = cfg.to_platform_config()
+    pc.retry_delay = min(pc.retry_delay, 0.5)  # demo-friendly redelivery
+    platform = LocalPlatform(pc)
     svc = platform.make_service("detector", prefix="v1/detector")
 
     @svc.api_async_func("/detect", maximum_concurrent_requests=2)

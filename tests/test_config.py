@@ -1,0 +1,110 @@
+"""Typed config: defaults, env overrides, precedence, parse failures."""
+
+import pytest
+
+from ai4e_tpu.config import (
+    ConfigError,
+    FrameworkConfig,
+    PlatformSection,
+    RuntimeSection,
+    section_from_env,
+)
+
+
+class TestSections:
+    def test_defaults_match_reference_capacity_values(self):
+        cfg = FrameworkConfig.from_env(env={})
+        # setup_env.sh:65,74 / host.json:5-9
+        assert cfg.platform.retry_delay == 60.0
+        assert cfg.platform.max_delivery_count == 1440
+        assert cfg.platform.dispatcher_concurrency == 1
+        # TaskQueueLogger.cs:19 / TaskProcessLogger.cs:21
+        assert cfg.observability.queue_depth_interval == 30.0
+        assert cfg.observability.process_depth_interval == 300.0
+
+    def test_env_overrides_parse_types(self):
+        env = {
+            "AI4E_PLATFORM_RETRY_DELAY": "0.25",
+            "AI4E_PLATFORM_MAX_DELIVERY_COUNT": "7",
+            "AI4E_PLATFORM_NATIVE_BROKER": "true",
+            "AI4E_PLATFORM_JOURNAL_PATH": "/tmp/j.jsonl",
+            "AI4E_RUNTIME_BUCKETS": "2, 4,16",
+            "AI4E_RUNTIME_CHECKPOINT_DIR": "",
+        }
+        cfg = FrameworkConfig.from_env(env=env)
+        assert cfg.platform.retry_delay == 0.25
+        assert cfg.platform.max_delivery_count == 7
+        assert cfg.platform.native_broker is True
+        assert cfg.platform.journal_path == "/tmp/j.jsonl"
+        assert cfg.runtime.buckets == (2, 4, 16)
+        assert cfg.runtime.checkpoint_dir is None  # "" → None for Optional
+
+    def test_explicit_overrides_beat_env(self):
+        env = {"AI4E_PLATFORM_RETRY_DELAY": "9.0"}
+        sec = PlatformSection.from_env(env=env, retry_delay=0.1)
+        assert sec.retry_delay == 0.1
+
+    def test_bool_forms(self):
+        for raw, want in [("1", True), ("Yes", True), ("on", True),
+                          ("0", False), ("false", False), ("", False)]:
+            sec = PlatformSection.from_env(
+                env={"AI4E_PLATFORM_NATIVE_BROKER": raw})
+            assert sec.native_broker is want, raw
+
+    def test_malformed_value_fails_loudly(self):
+        with pytest.raises(ConfigError, match="AI4E_PLATFORM_RETRY_DELAY"):
+            PlatformSection.from_env(
+                env={"AI4E_PLATFORM_RETRY_DELAY": "soon"})
+        with pytest.raises(ConfigError, match="not a boolean"):
+            PlatformSection.from_env(
+                env={"AI4E_PLATFORM_NATIVE_BROKER": "maybe"})
+
+    def test_to_platform_config_round_trip(self):
+        sec = PlatformSection.from_env(
+            env={"AI4E_PLATFORM_RETRY_DELAY": "0.5"})
+        pc = sec.to_platform_config()
+        assert pc.retry_delay == 0.5
+        assert pc.max_delivery_count == 1440
+
+    def test_misspelled_field_fails_loudly(self):
+        with pytest.raises(ConfigError, match="AI4E_PLATFORM_MAX_DELIVERY"):
+            PlatformSection.from_env(
+                env={"AI4E_PLATFORM_MAX_DELIVERY": "7"})  # _COUNT missing
+
+    def test_generic_helper_ignores_unrelated_env(self):
+        sec = section_from_env(RuntimeSection,
+                               env={"AI4E_PLATFORM_RETRY_DELAY": "1"},
+                               prefix="AI4E_RUNTIME_")
+        assert sec == RuntimeSection()
+
+    def test_real_environ_default(self, monkeypatch):
+        monkeypatch.setenv("AI4E_SERVICE_PORT", "9999")
+        cfg = FrameworkConfig.from_env()
+        assert cfg.service.port == 9999
+
+    def test_to_dict_serialisable(self):
+        import json
+        json.dumps(FrameworkConfig.from_env(env={}).to_dict())
+
+    def test_observability_overrides_reach_platform_config(self):
+        cfg = FrameworkConfig.from_env(env={
+            "AI4E_OBSERVABILITY_QUEUE_DEPTH_INTERVAL": "5",
+            "AI4E_OBSERVABILITY_PROCESS_DEPTH_INTERVAL": "60",
+        })
+        pc = cfg.to_platform_config()
+        assert pc.queue_depth_interval == 5.0
+        assert pc.process_depth_interval == 60.0
+
+    def test_observability_apply_configures_tracer(self, tmp_path):
+        from ai4e_tpu.observability import configure_tracer, get_tracer
+        cfg = FrameworkConfig.from_env(env={
+            "AI4E_OBSERVABILITY_TRACE_ENABLED": "0",
+            "AI4E_OBSERVABILITY_TRACE_EXPORT_PATH":
+                str(tmp_path / "spans.jsonl"),
+        })
+        try:
+            cfg.observability.apply()
+            assert get_tracer().sample_rate == 0.0
+            assert get_tracer().exporter is not None
+        finally:
+            configure_tracer(exporter=None, sample_rate=None)

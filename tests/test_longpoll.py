@@ -1,0 +1,131 @@
+"""Store change listeners + gateway long-poll (``GET /task/{id}?wait=``)."""
+
+import asyncio
+import time
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.taskstore import APITask, InMemoryTaskStore, TaskStatus
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+class TestStoreListeners:
+    def test_listener_sees_every_transition(self):
+        store = InMemoryTaskStore()
+        seen = []
+        store.add_listener(lambda t: seen.append((t.task_id, t.status)))
+        task = store.upsert(APITask(endpoint="http://x/v1/a", body=b"b"))
+        store.update_status(task.task_id, "running", TaskStatus.RUNNING)
+        store.update_status(task.task_id, "completed", TaskStatus.COMPLETED)
+        assert [s for _, s in seen] == ["created", "running", "completed"]
+        assert all(tid == task.task_id for tid, _ in seen)
+
+    def test_listener_exception_does_not_break_store(self):
+        store = InMemoryTaskStore()
+
+        def bad(_):
+            raise RuntimeError("observer bug")
+
+        store.add_listener(bad)
+        task = store.upsert(APITask(endpoint="http://x/v1/a", body=b"b"))
+        assert store.get(task.task_id).status == "created"
+
+
+class TestGatewayLongPoll:
+    def _platform(self):
+        return LocalPlatform(PlatformConfig(retry_delay=0.05))
+
+    def test_wait_returns_early_on_completion(self):
+        async def main():
+            platform = self._platform()
+            svc = platform.make_service("slow", prefix="v1/slow")
+
+            @svc.api_async_func("/work")
+            async def work(taskId=None, body=None, content_type=None):
+                await asyncio.sleep(0.15)
+                await svc.task_manager.complete_task(taskId)
+
+            svc_client = await serve(svc.app)
+            backend = str(svc_client.make_url("/v1/slow/work"))
+            platform.publish_async_api("/v1/public/work", backend)
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                resp = await gw.post("/v1/public/work", data=b"x")
+                tid = (await resp.json())["TaskId"]
+                t0 = time.perf_counter()
+                resp = await gw.get(f"/v1/taskmanagement/task/{tid}",
+                                    params={"wait": "10"})
+                waited = time.perf_counter() - t0
+                body = await resp.json()
+                # One long-poll returned the terminal state, well before the
+                # 10 s wait bound, and without spin-polling.
+                assert "completed" in body["Status"]
+                assert waited < 5.0
+            finally:
+                await platform.stop()
+                await gw.close()
+                await svc_client.close()
+
+        run(main())
+
+    def test_wait_times_out_with_current_status(self):
+        async def main():
+            platform = self._platform()
+            # No dispatcher/backend — the task stays "created".
+            gw = await serve(platform.gateway.app)
+            task = platform.store.upsert(
+                APITask(endpoint="http://x/v1/never", body=b"x"))
+            try:
+                t0 = time.perf_counter()
+                resp = await gw.get(f"/v1/taskmanagement/task/{task.task_id}",
+                                    params={"wait": "0.2"})
+                waited = time.perf_counter() - t0
+                body = await resp.json()
+                assert body["Status"] == "created"
+                assert 0.15 <= waited < 2.0
+                assert platform.gateway._waiters == {}  # waiter cleaned up
+            finally:
+                await gw.close()
+
+        run(main())
+
+    def test_bad_wait_param_is_400(self):
+        async def main():
+            platform = self._platform()
+            gw = await serve(platform.gateway.app)
+            task = platform.store.upsert(
+                APITask(endpoint="http://x/v1/a", body=b"x"))
+            try:
+                resp = await gw.get(f"/v1/taskmanagement/task/{task.task_id}",
+                                    params={"wait": "soon"})
+                assert resp.status == 400
+            finally:
+                await gw.close()
+
+        run(main())
+
+    def test_zero_wait_is_plain_get(self):
+        async def main():
+            platform = self._platform()
+            gw = await serve(platform.gateway.app)
+            task = platform.store.upsert(
+                APITask(endpoint="http://x/v1/a", body=b"x"))
+            try:
+                resp = await gw.get(f"/v1/taskmanagement/task/{task.task_id}")
+                assert (await resp.json())["Status"] == "created"
+                assert platform.gateway._waiters == {}
+            finally:
+                await gw.close()
+
+        run(main())

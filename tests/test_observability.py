@@ -1,0 +1,246 @@
+"""Tracing: span lifecycle, propagation, sampling, exporters; depth logger."""
+
+import asyncio
+import json
+
+import pytest
+
+from ai4e_tpu.metrics import MetricsRegistry
+from ai4e_tpu.observability import (
+    InMemoryExporter,
+    JsonlExporter,
+    SAMPLED_HEADER,
+    SPAN_HEADER,
+    TRACE_HEADER,
+    DepthLogger,
+    Tracer,
+    device_trace,
+)
+from ai4e_tpu.taskstore import APITask, InMemoryTaskStore, TaskStatus
+
+
+@pytest.fixture
+def exporter():
+    return InMemoryExporter()
+
+
+@pytest.fixture
+def tracer(exporter):
+    return Tracer("test-svc", exporter=exporter, metrics=MetricsRegistry())
+
+
+class TestSpans:
+    def test_root_span_exported_with_ids(self, tracer, exporter):
+        with tracer.span("work", task_id="t-1", foo="bar"):
+            pass
+        (s,) = exporter.spans
+        assert s.name == "work" and s.service == "test-svc"
+        assert s.task_id == "t-1" and s.attrs == {"foo": "bar"}
+        assert len(s.trace_id) == 32 and len(s.span_id) == 16
+        assert s.parent_id is None and s.status == "ok"
+        assert s.duration >= 0
+
+    def test_nested_spans_share_trace(self, tracer, exporter):
+        with tracer.span("outer"):
+            with tracer.span("inner"):
+                pass
+        inner, outer = exporter.spans  # inner closes first
+        assert inner.trace_id == outer.trace_id
+        assert inner.parent_id == outer.span_id
+
+    def test_error_recorded_and_reraised(self, tracer, exporter):
+        with pytest.raises(ValueError):
+            with tracer.span("boom"):
+                raise ValueError("nope")
+        (s,) = exporter.spans
+        assert s.status == "error" and "ValueError: nope" in s.error
+
+    def test_header_propagation_across_tracers(self, exporter):
+        a = Tracer("svc-a", exporter=exporter, metrics=MetricsRegistry())
+        b = Tracer("svc-b", exporter=exporter, metrics=MetricsRegistry())
+        with a.span("upstream"):
+            headers = a.headers()
+            assert set(headers) == {TRACE_HEADER, SPAN_HEADER, SAMPLED_HEADER}
+        with b.span("downstream", headers=headers):
+            pass
+        up = exporter.spans[0]
+        down = next(s for s in exporter.spans if s.name == "downstream")
+        assert down.trace_id == up.trace_id
+        assert down.parent_id == up.span_id
+
+    def test_contextvar_isolation_across_asyncio_tasks(self, tracer, exporter):
+        async def leg(name):
+            with tracer.span(name):
+                await asyncio.sleep(0.01)
+
+        async def main():
+            await asyncio.gather(leg("a"), leg("b"))
+
+        asyncio.run(main())
+        a, b = exporter.spans
+        assert a.trace_id != b.trace_id  # parallel tasks don't nest
+        assert a.parent_id is None and b.parent_id is None
+
+    def test_sampling_deterministic_and_inherited(self, exporter):
+        t = Tracer("s", exporter=exporter, sample_rate=0.0,
+                   metrics=MetricsRegistry())
+        with t.span("dropped"):
+            with t.span("child"):
+                pass
+        assert exporter.spans == []
+        # unsampled context still propagates for downstream consistency
+        t2 = Tracer("s2", exporter=exporter, metrics=MetricsRegistry())
+        with t2.span("kept", headers={TRACE_HEADER: "ab" * 16,
+                                      SAMPLED_HEADER: "0"}):
+            pass
+        assert exporter.spans == []  # sampled=0 inherited from headers
+
+    def test_rate_zero_beats_inherited_sampled_header(self, exporter):
+        """trace_enabled=0 must hold even behind a B3 mesh that stamps
+        x-b3-sampled:1 on every request."""
+        t = Tracer("s", exporter=exporter, sample_rate=0.0,
+                   metrics=MetricsRegistry())
+        with t.span("in", headers={TRACE_HEADER: "cd" * 16,
+                                   SAMPLED_HEADER: "1"}):
+            pass
+        assert exporter.spans == []
+
+    def test_span_duration_metric(self, exporter):
+        reg = MetricsRegistry()
+        t = Tracer("s", exporter=exporter, metrics=reg)
+        with t.span("timed"):
+            pass
+        hist = reg.histogram("ai4e_span_seconds")
+        assert hist.quantile(0.5, name="timed", service="s") >= 0
+
+    def test_component_tracers_follow_global_reconfigure(self, exporter):
+        """Tracers built without explicit settings (the service/gateway/
+        dispatcher default) pick up configure_tracer() made AFTER their
+        construction."""
+        from ai4e_tpu.observability import configure_tracer
+        t = Tracer("late-bound", metrics=MetricsRegistry())
+        try:
+            configure_tracer(exporter=exporter)
+            with t.span("work"):
+                pass
+            assert [s.name for s in exporter.spans] == ["work"]
+            configure_tracer(sample_rate=0.0)
+            with t.span("dropped"):
+                pass
+            assert len(exporter.spans) == 1
+        finally:
+            configure_tracer(exporter=None, sample_rate=None)
+
+    def test_jsonl_exporter_round_trip(self, tmp_path):
+        path = str(tmp_path / "spans.jsonl")
+        exp = JsonlExporter(path)
+        t = Tracer("s", exporter=exp, metrics=MetricsRegistry())
+        with t.span("a", task_id="t-9"):
+            pass
+        exp.close()
+        (line,) = open(path).read().splitlines()
+        d = json.loads(line)
+        assert d["name"] == "a" and d["task_id"] == "t-9"
+
+    def test_device_trace_noop_without_profiler(self):
+        with device_trace("batch"):
+            x = 1 + 1
+        assert x == 2
+
+
+class TestDepthLogger:
+    def _store_with_tasks(self):
+        store = InMemoryTaskStore()
+        t1 = store.upsert(APITask(endpoint="http://x/v1/api", body=b"1"))
+        store.upsert(APITask(endpoint="http://x/v1/api", body=b"2"))
+        store.update_status(t1.task_id, "running", TaskStatus.RUNNING)
+        return store
+
+    def test_sample_queue_depth(self):
+        store = self._store_with_tasks()
+        reg = MetricsRegistry()
+        dl = DepthLogger(store, metrics=reg)
+        depths = dl.sample_queue_depth()
+        assert depths == {"/v1/api": 1}
+        g = reg.gauge("ai4e_task_depth")
+        assert g.value(endpoint="/v1/api", status=TaskStatus.CREATED) == 1.0
+
+    def test_sample_process_depths(self):
+        store = self._store_with_tasks()
+        reg = MetricsRegistry()
+        dl = DepthLogger(store, metrics=reg)
+        dl.sample_process_depths()
+        g = reg.gauge("ai4e_task_depth")
+        assert g.value(endpoint="/v1/api", status=TaskStatus.RUNNING) == 1.0
+        assert g.value(endpoint="/v1/api", status=TaskStatus.COMPLETED) == 0.0
+
+    def test_timers_run_and_stop(self):
+        store = self._store_with_tasks()
+        reg = MetricsRegistry()
+        dl = DepthLogger(store, metrics=reg,
+                         queue_interval=0.01, process_interval=0.01)
+
+        async def main():
+            await dl.start()
+            await asyncio.sleep(0.05)
+            await dl.stop()
+
+        asyncio.run(main())
+        g = reg.gauge("ai4e_task_depth")
+        assert g.value(endpoint="/v1/api", status=TaskStatus.CREATED) == 1.0
+        assert dl._tasks == []
+
+
+class TestEndToEndTrace:
+    def test_async_path_emits_taskid_keyed_spans(self):
+        """gateway create_task → dispatcher dispatch → service endpoint spans
+        all carry the same TaskId; dispatch parents the endpoint span."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from ai4e_tpu.observability import configure_tracer, get_tracer
+        from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+
+        exporter = InMemoryExporter()
+        old = get_tracer().exporter
+        configure_tracer(exporter=exporter)
+        try:
+            async def main():
+                platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+                svc = platform.make_service("echo", prefix="v1/echo")
+
+                @svc.api_async_func("/run")
+                async def run(taskId=None, body=None, content_type=None):
+                    await svc.task_manager.complete_task(taskId)
+
+                server = TestServer(svc.app)
+                await server.start_server()
+                backend = f"http://127.0.0.1:{server.port}/v1/echo/run"
+                platform.publish_async_api("/v1/echo/run", backend_uri=backend)
+                await platform.start()
+
+                gw = TestServer(platform.gateway.app)
+                await gw.start_server()
+                async with TestClient(gw) as client:
+                    resp = await client.post("/v1/echo/run", data=b"{}")
+                    task_id = (await resp.json())["TaskId"]
+                    for _ in range(100):
+                        r = await client.get(
+                            f"/v1/taskmanagement/task/{task_id}")
+                        if (await r.json())["Status"] == "completed":
+                            break
+                        await asyncio.sleep(0.02)
+                await platform.stop()
+                await server.close()
+                return task_id
+
+            task_id = asyncio.run(main())
+        finally:
+            configure_tracer(exporter=old)
+
+        spans = exporter.by_task(task_id)
+        names = {s.name for s in spans}
+        assert "dispatch" in names and "/run" in names
+        dispatch = next(s for s in spans if s.name == "dispatch")
+        endpoint = next(s for s in spans if s.name == "/run")
+        assert endpoint.trace_id == dispatch.trace_id
+        assert endpoint.parent_id == dispatch.span_id
