@@ -205,13 +205,21 @@ class FrameworkConfig:
     @classmethod
     def from_env(cls, env: typing.Mapping[str, str] | None = None
                  ) -> "FrameworkConfig":
-        return cls(
-            platform=PlatformSection.from_env(env),
-            service=ServiceSection.from_env(env),
-            runtime=RuntimeSection.from_env(env),
-            gateway=GatewaySection.from_env(env),
-            observability=ObservabilitySection.from_env(env),
-        )
+        sections = {f.name: typing.get_type_hints(cls)[f.name]
+                    for f in fields(cls)}
+        # Per-section checks only catch misspelled *fields*; a misspelled
+        # *section* ("AI4E_OBSERVABILTY_...") matches no section prefix and
+        # would silently keep every default — catch it here.
+        env_map = os.environ if env is None else env
+        prefixes = tuple(s._env_prefix for s in sections.values())
+        unknown = [k for k in env_map
+                   if k.startswith("AI4E_") and not k.startswith(prefixes)]
+        if unknown:
+            raise ConfigError(
+                f"unknown config section in variable(s) {sorted(unknown)}; "
+                f"valid section prefixes: {sorted(prefixes)}")
+        return cls(**{name: sec.from_env(env)
+                      for name, sec in sections.items()})
 
     def to_platform_config(self):
         """The fully-wired ``PlatformConfig``: transport knobs from the

@@ -40,7 +40,18 @@ class InferenceWorker:
     def serve_model(self, servable: ServableModel,
                     sync_path: str | None = None,
                     async_path: str | None = None,
-                    maximum_concurrent_requests: int = 64) -> None:
+                    maximum_concurrent_requests: int = 64,
+                    pipeline_to=None) -> None:
+        """Expose a servable on sync + async endpoints.
+
+        ``pipeline_to`` makes this servable a *pipeline stage* (the composite
+        ensembles of ``distributed_api_task.py:67-100``): a callable
+        ``(result) -> (next_endpoint, body_bytes) | None`` evaluated after
+        inference on the async path. A tuple hands the task — same TaskId —
+        to the next API via AddPipelineTask; ``None`` means "nothing to hand
+        off" and the stage completes the task itself (e.g. a detector that
+        found no animals skips the classifier).
+        """
         name = servable.name
         sync_path = sync_path or f"/{name}"
         async_path = async_path or f"/{name}-async"
@@ -89,6 +100,22 @@ class InferenceWorker:
                 endpoint = (current or {}).get("Endpoint", async_path)
                 await tm.add_pipeline_task(taskId, endpoint)
                 return
+            if pipeline_to is not None:
+                handoff = pipeline_to(result)
+                if handoff is not None:
+                    next_endpoint, next_body = handoff
+                    if self.store is not None:
+                        # Keep the stage's intermediate output retrievable
+                        # under the same TaskId while the task moves on.
+                        self.store.set_result(
+                            taskId, json.dumps(_jsonable(result)).encode(),
+                            stage=_name)
+                    await tm.update_task_status(
+                        taskId, f"running - {_name} handing off to "
+                                f"{next_endpoint}")
+                    await tm.add_pipeline_task(taskId, next_endpoint,
+                                               body=next_body)
+                    return
             if self.store is not None:
                 self.store.set_result(
                     taskId, json.dumps(_jsonable(result)).encode())

@@ -123,6 +123,12 @@ class InMemoryTaskStore:
                 # Subsequent pipeline call: replay the original body
                 # (CacheConnectorUpsert.cs:144-176).
                 task.body = self._orig_bodies.get(task.task_id, b"")
+            elif task.body and task.publish:
+                # Pipeline handoff with a fresh payload (e.g. detector crops
+                # for the classifier): that payload is now the task's replay
+                # body — a later empty-body requeue of the new stage must get
+                # the stage's own input, not stage 1's.
+                self._orig_bodies[task.task_id] = task.body
             self._remove_from_set(prev)
         task.timestamp = time.time()
         self._tasks[task.task_id] = task
@@ -169,15 +175,24 @@ class InMemoryTaskStore:
     # here they're first-class, keyed like {taskId}_RESULT) -----------------
 
     def set_result(self, task_id: str, result: bytes,
-                   content_type: str = "application/json") -> None:
+                   content_type: str = "application/json",
+                   stage: str | None = None) -> None:
+        """Store a task's result payload. ``stage`` stores an intermediate
+        pipeline-stage result (keyed ``{taskId}:{stage}``) without touching
+        the final result — so each stage of a composite API leaves its output
+        retrievable under the shared TaskId, analogous to the reference
+        keeping ``{taskId}_ORIG`` alongside the task (``CacheConnectorUpsert.cs:158``)."""
+        key = task_id if stage is None else f"{task_id}:{stage}"
         with self._lock:
             if task_id not in self._tasks:
                 raise TaskNotFound(task_id)
-            self._results[task_id] = (result, content_type)
+            self._results[key] = (result, content_type)
 
-    def get_result(self, task_id: str) -> tuple[bytes, str] | None:
+    def get_result(self, task_id: str,
+                   stage: str | None = None) -> tuple[bytes, str] | None:
+        key = task_id if stage is None else f"{task_id}:{stage}"
         with self._lock:
-            return self._results.get(task_id)
+            return self._results.get(key)
 
     # -- status-set queries (queue-depth metrics, QueueLogger.cs:21-47) ----
 

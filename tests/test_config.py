@@ -71,6 +71,12 @@ class TestSections:
             PlatformSection.from_env(
                 env={"AI4E_PLATFORM_MAX_DELIVERY": "7"})  # _COUNT missing
 
+    def test_misspelled_section_fails_loudly(self):
+        from ai4e_tpu.config import FrameworkConfig
+        with pytest.raises(ConfigError, match="AI4E_OBSERVABILTY_TRACE"):
+            FrameworkConfig.from_env(
+                env={"AI4E_OBSERVABILTY_TRACE_ENABLED": "0"})  # typo'd section
+
     def test_generic_helper_ignores_unrelated_env(self):
         sec = section_from_env(RuntimeSection,
                                env={"AI4E_PLATFORM_RETRY_DELAY": "1"},

@@ -1,0 +1,228 @@
+"""Composite pipeline API end-to-end (BASELINE.json config #5): camera-trap
+detector → species classifier under ONE TaskId.
+
+Mirrors the reference's ensemble flow (SURVEY.md §3.4): stage 1 runs
+inference, calls AddPipelineTask to rewrite the task's Endpoint and republish
+(``distributed_api_task.py:67-100``); the store treats the upsert as a
+pipeline transition (``CacheConnectorUpsert.cs:144-176``), the broker
+redelivers to stage 2's dispatcher, and stage 2's AddTask sees the taskId
+header and adopts the existing task (``api_task.py:12-20``).
+"""
+
+import asyncio
+import io
+import json
+
+import jax
+import numpy as np
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.models import CenterNetDetector, decode_detections
+from ai4e_tpu.models.resnet import ResNet
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.runtime import InferenceWorker, MicroBatcher, ModelRuntime, ServableModel
+
+IMG = 64          # detector input
+CROP = 32         # classifier input
+SPECIES = ["deer", "boar", "fox", "lynx"]
+
+
+def npy_bytes(arr):
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def make_detector_servable():
+    model = CenterNetDetector(widths=(16, 32, 32))
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, IMG, IMG, 3), np.float32))
+
+    def apply_fn(p, batch):
+        return decode_detections(model.apply(p, batch), max_detections=8)
+
+    def preprocess(body, content_type):
+        arr = np.load(io.BytesIO(body))
+        if arr.shape != (IMG, IMG, 3):
+            raise ValueError(f"expected ({IMG},{IMG},3), got {arr.shape}")
+        return arr.astype(np.float32)
+
+    def postprocess(out):
+        return {"boxes": np.asarray(out["boxes"]).tolist(),
+                "scores": np.asarray(out["scores"]).tolist(),
+                "classes": np.asarray(out["classes"]).tolist()}
+
+    return ServableModel(name="detector", apply_fn=apply_fn, params=params,
+                         input_shape=(IMG, IMG, 3), preprocess=preprocess,
+                         postprocess=postprocess, batch_buckets=(4,))
+
+
+def make_classifier_servable():
+    model = ResNet(stage_sizes=(1, 1), num_classes=len(SPECIES), width=8)
+    variables = model.init(jax.random.PRNGKey(1),
+                           np.zeros((1, CROP, CROP, 3), np.float32))
+
+    def preprocess(body, content_type):
+        arr = np.load(io.BytesIO(body))
+        if arr.shape != (CROP, CROP, 3):
+            raise ValueError(f"expected ({CROP},{CROP},3), got {arr.shape}")
+        return arr.astype(np.float32)
+
+    def postprocess(logits):
+        probs = np.exp(logits - logits.max())
+        probs = probs / probs.sum()
+        top = int(np.argmax(probs))
+        return {"species": SPECIES[top], "confidence": float(probs[top])}
+
+    return ServableModel(name="classifier", apply_fn=model.apply,
+                         params=variables, input_shape=(CROP, CROP, 3),
+                         preprocess=preprocess, postprocess=postprocess,
+                         batch_buckets=(4,))
+
+
+class TestPipelineE2E:
+    def test_detector_to_classifier_single_task_id(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            runtime = ModelRuntime()
+            runtime.register(make_detector_servable())
+            runtime.register(make_classifier_servable())
+            runtime.warmup()
+            batcher = MicroBatcher(runtime, max_wait_ms=5)
+
+            worker = InferenceWorker(
+                "camera-trap", runtime, batcher,
+                task_manager=platform.task_manager, prefix="v1/camera-trap",
+                store=platform.store)
+
+            classify_uri_cell = []  # filled once the server has a port
+
+            def crop_top_detection(result):
+                # Hand the top-scoring detection to the classifier; the crop
+                # rides in the pipeline body (a real deployment would pass a
+                # blob reference).
+                crop = np.zeros((CROP, CROP, 3), np.float32)
+                return classify_uri_cell[0], npy_bytes(crop)
+
+            worker.serve_model(runtime.models["detector"],
+                               async_path="/detect-async",
+                               pipeline_to=crop_top_detection)
+            worker.serve_model(runtime.models["classifier"],
+                               async_path="/classify-async")
+            await batcher.start()
+
+            svc_server = TestServer(worker.service.app)
+            await svc_server.start_server()
+            base = f"http://127.0.0.1:{svc_server.port}"
+            classify_uri = f"{base}/v1/camera-trap/classify-async"
+            classify_uri_cell.append(classify_uri)
+            svc_client = TestClient(svc_server)
+            platform.publish_async_api(
+                "/v1/camera-trap/detect-async",
+                f"{base}/v1/camera-trap/detect-async")
+            platform.publish_async_api(
+                "/v1/camera-trap/classify-async", classify_uri)
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                image = np.random.default_rng(0).uniform(
+                    size=(IMG, IMG, 3)).astype(np.float32)
+                resp = await gw.post("/v1/camera-trap/detect-async",
+                                     data=npy_bytes(image))
+                task_id = (await resp.json())["TaskId"]
+
+                final = None
+                for _ in range(600):
+                    poll = await gw.get(f"/v1/taskmanagement/task/{task_id}")
+                    final = await poll.json()
+                    if ("completed" in final["Status"]
+                            or "failed" in final["Status"]):
+                        break
+                    await asyncio.sleep(0.02)
+
+                # One TaskId traversed both stages and completed.
+                assert "completed" in final["Status"], final
+                assert final["TaskId"] == task_id
+                # Endpoint was rewritten to the classifier by the handoff.
+                assert "classify-async" in final["Endpoint"], final
+
+                # Final result is the classifier's; the detector's
+                # intermediate output is retrievable under the same TaskId.
+                result = platform.store.get_result(task_id)
+                parsed = json.loads(result[0])
+                assert parsed["species"] in SPECIES
+                assert 0.0 < parsed["confidence"] <= 1.0
+                stage1 = platform.store.get_result(task_id, stage="detector")
+                assert stage1 is not None
+                det = json.loads(stage1[0])
+                assert len(det["scores"]) == 8
+
+                # Status-set bookkeeping: task sits in exactly one terminal
+                # set, under the final (classifier) endpoint path.
+                from ai4e_tpu.taskstore import endpoint_path
+                cls_path = endpoint_path(classify_uri)
+                assert task_id in platform.store.set_members(
+                    cls_path, "completed")
+            finally:
+                await platform.stop()
+                await batcher.stop()
+                await gw.close()
+                await svc_client.close()
+
+        asyncio.run(main())
+
+    def test_pipeline_stage_completes_when_no_handoff(self):
+        """pipeline_to → None means the stage finishes the task itself."""
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            runtime = ModelRuntime()
+            runtime.register(make_detector_servable())
+            runtime.warmup()
+            batcher = MicroBatcher(runtime, max_wait_ms=5)
+            worker = InferenceWorker(
+                "camera-trap", runtime, batcher,
+                task_manager=platform.task_manager, prefix="v1/camera-trap",
+                store=platform.store)
+            worker.serve_model(runtime.models["detector"],
+                               async_path="/detect-async",
+                               pipeline_to=lambda result: None)
+            await batcher.start()
+            svc_server = TestServer(worker.service.app)
+            await svc_server.start_server()
+            base = f"http://127.0.0.1:{svc_server.port}"
+            svc_client = TestClient(svc_server)
+            platform.publish_async_api(
+                "/v1/camera-trap/detect-async",
+                f"{base}/v1/camera-trap/detect-async")
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                image = np.zeros((IMG, IMG, 3), np.float32)
+                resp = await gw.post("/v1/camera-trap/detect-async",
+                                     data=npy_bytes(image))
+                task_id = (await resp.json())["TaskId"]
+                final = None
+                for _ in range(600):
+                    poll = await gw.get(f"/v1/taskmanagement/task/{task_id}")
+                    final = await poll.json()
+                    if ("completed" in final["Status"]
+                            or "failed" in final["Status"]):
+                        break
+                    await asyncio.sleep(0.02)
+                assert "completed" in final["Status"], final
+                assert "detect-async" in final["Endpoint"]
+                result = platform.store.get_result(task_id)
+                assert result is not None
+            finally:
+                await platform.stop()
+                await batcher.stop()
+                await gw.close()
+                await svc_client.close()
+
+        asyncio.run(main())

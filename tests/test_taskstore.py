@@ -120,6 +120,21 @@ class TestPublish:
         assert published[-1].body == b"ORIGINAL"
         assert store.get(t.task_id).endpoint_path == "/v1/classifier"
 
+    def test_handoff_body_becomes_replay_body(self):
+        # A handoff WITH a payload (detector passes crops to the classifier)
+        # re-bases the replay body: a later empty-body requeue of the new
+        # stage must get the stage's own input, not stage 1's.
+        published = []
+        store = InMemoryTaskStore(publisher=published.append)
+        t = store.upsert(make_task(body=b"STAGE1-IMAGE", publish=True))
+        store.upsert(APITask(task_id=t.task_id,
+                             endpoint="http://host/v1/classifier",
+                             body=b"CROPS", publish=True))
+        store.upsert(APITask(task_id=t.task_id,
+                             endpoint="http://host/v1/classifier",
+                             body=b"", publish=True))
+        assert published[-1].body == b"CROPS"
+
 
 class TestConcurrency:
     def test_parallel_transitions_keep_sets_consistent(self):
