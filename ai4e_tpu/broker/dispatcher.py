@@ -69,10 +69,11 @@ class Dispatcher:
         self._sessions = SessionHolder(timeout=request_timeout)
 
     async def start(self) -> None:
-        self._workers = [
-            asyncio.get_running_loop().create_task(self._run(i))
-            for i in range(self.concurrency)
-        ]
+        # Top up, never replace: set_concurrency may have spawned loops
+        # already, and replacing the list would orphan them past stop().
+        loop = asyncio.get_running_loop()
+        while len(self._workers) < self.concurrency:
+            self._workers.append(loop.create_task(self._run(len(self._workers))))
 
     async def stop(self) -> None:
         self._stop.set()
@@ -80,6 +81,23 @@ class Dispatcher:
             w.cancel()
         await asyncio.gather(*self._workers, return_exceptions=True)
         await self._sessions.close()
+
+    def set_concurrency(self, n: int) -> None:
+        """Live-resize the delivery loop count — the scale surface the
+        autoscaler drives (the reference scales *pod replicas* via HPA,
+        ``autoscaler.yaml:11-21``; here request-level fan-out is dispatcher
+        loops feeding the shared micro-batcher, SURVEY.md §2 parallelism
+        table row 1)."""
+        n = max(0, n)
+        if n == len(self._workers):
+            return
+        loop = asyncio.get_running_loop()
+        while len(self._workers) < n:
+            self._workers.append(
+                loop.create_task(self._run(len(self._workers))))
+        while len(self._workers) > n:
+            self._workers.pop().cancel()
+        self.concurrency = n
 
     async def _run(self, worker_idx: int) -> None:
         while not self._stop.is_set():
@@ -89,6 +107,9 @@ class Dispatcher:
             try:
                 await self._dispatch_one(msg)
             except asyncio.CancelledError:
+                # Scale-down / shutdown mid-dispatch: hand the message back
+                # now rather than waiting out the lease.
+                self.broker.abandon(msg)
                 raise
             except Exception:  # noqa: BLE001 — dispatcher must never die
                 log.exception("dispatch of task %s crashed; redelivering", msg.task_id)

@@ -205,8 +205,8 @@ class FrameworkConfig:
     @classmethod
     def from_env(cls, env: typing.Mapping[str, str] | None = None
                  ) -> "FrameworkConfig":
-        sections = {f.name: typing.get_type_hints(cls)[f.name]
-                    for f in fields(cls)}
+        hints = typing.get_type_hints(cls)
+        sections = {f.name: hints[f.name] for f in fields(cls)}
         # Per-section checks only catch misspelled *fields*; a misspelled
         # *section* ("AI4E_OBSERVABILTY_...") matches no section prefix and
         # would silently keep every default — catch it here.

@@ -74,6 +74,7 @@ class LocalPlatform:
             queue_interval=self.config.queue_depth_interval,
             process_interval=self.config.process_depth_interval)
         self.services: list[APIService] = []
+        self.autoscalers: list = []
         self._started = False
 
     # -- assembly ----------------------------------------------------------
@@ -86,16 +87,27 @@ class LocalPlatform:
 
     def publish_async_api(self, public_prefix: str, backend_uri: str,
                           retry_delay: float | None = None,
-                          concurrency: int | None = None) -> None:
+                          concurrency: int | None = None,
+                          autoscale=None,
+                          autoscale_interval: float = 5.0) -> None:
         """Register an async API end-to-end: gateway route + dispatcher for
         its queue (the reference needs an APIM operation + a Service Bus queue
-        + a function app per API; here it's one call)."""
+        + a function app per API; here it's one call). Passing an
+        ``AutoscalePolicy`` as ``autoscale`` attaches the HPA-style control
+        loop (the reference's per-API ``autoscaler.yaml``) to the
+        dispatcher's delivery fan-out."""
         self.gateway.add_async_route(public_prefix, backend_uri)
         queue_name = endpoint_path(backend_uri)
         self.broker.register_queue(queue_name)
-        self.dispatchers.register(queue_name, backend_uri,
-                                  retry_delay=retry_delay,
-                                  concurrency=concurrency)
+        dispatcher = self.dispatchers.register(queue_name, backend_uri,
+                                               retry_delay=retry_delay,
+                                               concurrency=concurrency)
+        if autoscale is not None:
+            from .scaling import AutoscaleController, DispatcherScaleTarget
+            self.autoscalers.append(AutoscaleController(
+                self.store, queue_name, DispatcherScaleTarget(dispatcher),
+                policy=autoscale, interval=autoscale_interval,
+                metrics=self.metrics))
 
     def publish_sync_api(self, public_prefix: str, backend_uri: str) -> None:
         self.gateway.add_sync_route(public_prefix, backend_uri)
@@ -115,6 +127,8 @@ class LocalPlatform:
         self.broker.set_dead_letter_handler(on_dead_letter)
         await self.dispatchers.start()
         await self.depth_logger.start()
+        for scaler in self.autoscalers:
+            await scaler.start()
         self._reseed_unfinished()
         self._started = True
 
@@ -145,6 +159,8 @@ class LocalPlatform:
 
     async def stop(self) -> None:
         if self._started:
+            for scaler in self.autoscalers:
+                await scaler.stop()
             await self.dispatchers.stop()
             await self.depth_logger.stop()
             self._started = False

@@ -1,0 +1,15 @@
+from .autoscaler import (
+    AutoscaleController,
+    AutoscalePolicy,
+    DispatcherScaleTarget,
+    HPADecider,
+    ScaleTarget,
+)
+
+__all__ = [
+    "AutoscaleController",
+    "AutoscalePolicy",
+    "DispatcherScaleTarget",
+    "HPADecider",
+    "ScaleTarget",
+]

@@ -1,0 +1,171 @@
+"""Autoscaler — the reference's HPA feedback loop, in-framework.
+
+The reference closes its scaling loop across four external systems
+(SURVEY.md §3.5): request counters and queue depths flow to App Insights
+(``CurrentProcessingUpsert.cs:100-106``, ``QueueLogger.cs:21-47``), the
+azure-k8s-metrics-adapter republishes them as k8s custom metrics
+(``deploy_custom_metrics_adapter.sh:6-52``), an HPA per API divides the
+metric by a per-replica target (``APIs/Charts/templates/async-gpu/
+autoscaler.yaml:11-21`` — 1-10 replicas, queue-depth target 1), and the
+cluster autoscaler grows node pools (``deploy_aks.sh:99-109``).
+
+Here the loop is one in-process controller: the scaling signal is the task
+store's per-endpoint ``created`` depth (the same ``{path}_created`` sorted
+set the reference scrapes) plus in-flight counts, the decision rule is the
+k8s HPA algorithm (proportional scaling with a tolerance dead-band and a
+scale-down stabilization window), and the actuator is a ``ScaleTarget`` —
+live dispatcher-loop fan-out for single-host serving, or a callback that
+resizes worker processes / requests TPU slices in a real deployment.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import time
+from dataclasses import dataclass
+from typing import Callable, Protocol
+
+from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+
+log = logging.getLogger("ai4e_tpu.autoscaler")
+
+
+@dataclass
+class AutoscalePolicy:
+    """HPA-shaped policy (autoscaler.yaml:11-21 uses min 1 / max 10 /
+    queue-depth target 1)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_per_replica: float = 1.0   # targetAverageValue
+    tolerance: float = 0.1            # k8s HPA default dead-band (10%)
+    stabilization_seconds: float = 30.0  # scale-down damping window
+
+
+class HPADecider:
+    """The k8s HPA decision rule: ``desired = ceil(current * metric /
+    (replicas * target))`` with a tolerance dead-band, clamped to
+    [min, max]; scale-down takes the *maximum* recommendation over the
+    stabilization window so a transient dip never kills replicas."""
+
+    def __init__(self, policy: AutoscalePolicy,
+                 clock: Callable[[], float] = time.monotonic):
+        self.policy = policy
+        self._clock = clock
+        self._recommendations: list[tuple[float, int]] = []
+
+    def desired(self, current_replicas: int, metric_value: float) -> int:
+        p = self.policy
+        current_replicas = max(current_replicas, 1)
+        ratio = metric_value / (current_replicas * p.target_per_replica)
+        if abs(ratio - 1.0) <= p.tolerance:
+            raw = current_replicas
+        else:
+            raw = math.ceil(current_replicas * ratio)
+        raw = min(max(raw, p.min_replicas), p.max_replicas)
+
+        now = self._clock()
+        self._recommendations.append((now, raw))
+        horizon = now - p.stabilization_seconds
+        self._recommendations = [(t, r) for t, r in self._recommendations
+                                 if t >= horizon]
+        if raw < current_replicas:
+            # Scale-down stabilization: act on the window's max.
+            raw = min(max(r for _, r in self._recommendations),
+                      current_replicas)
+        return raw
+
+
+class ScaleTarget(Protocol):
+    """An actuator the controller drives."""
+
+    @property
+    def replicas(self) -> int: ...
+
+    def scale_to(self, n: int) -> None: ...
+
+
+class DispatcherScaleTarget:
+    """Scales a dispatcher's delivery-loop count — the single-host stand-in
+    for pod replicas: more loops = more tasks in flight feeding the
+    micro-batcher = bigger device batches."""
+
+    def __init__(self, dispatcher):
+        self.dispatcher = dispatcher
+
+    @property
+    def replicas(self) -> int:
+        return self.dispatcher.concurrency
+
+    def scale_to(self, n: int) -> None:
+        self.dispatcher.set_concurrency(n)
+
+
+class AutoscaleController:
+    """Periodic control loop: signal → HPA decision → actuator.
+
+    ``signal`` defaults to queue pressure for the endpoint: tasks waiting in
+    the ``created`` state set plus tasks being processed (``running``) —
+    the reference's scaling metric pair (``TaskQueueLogger.cs:19-27`` depth
+    + ``CURRENT_REQUESTS`` in-flight counter) collapsed into one number.
+    """
+
+    def __init__(self, store, endpoint_path: str, target: ScaleTarget,
+                 policy: AutoscalePolicy | None = None,
+                 interval: float = 5.0,
+                 signal: Callable[[], float] | None = None,
+                 metrics: MetricsRegistry | None = None):
+        self.store = store
+        self.endpoint_path = endpoint_path
+        self.target = target
+        self.policy = policy or AutoscalePolicy()
+        self.interval = interval
+        self.signal = signal or self._default_signal
+        self.decider = HPADecider(self.policy)
+        metrics = metrics or DEFAULT_REGISTRY
+        self._replica_gauge = metrics.gauge(
+            "ai4e_autoscale_replicas", "Actuated replica count per endpoint")
+        self._signal_gauge = metrics.gauge(
+            "ai4e_autoscale_signal", "Scaling signal value per endpoint")
+        self._task: asyncio.Task | None = None
+
+    def _default_signal(self) -> float:
+        return (self.store.set_len(self.endpoint_path, "created")
+                + self.store.set_len(self.endpoint_path, "running"))
+
+    def tick(self) -> int:
+        """One control step (sync; also called by the async loop)."""
+        value = float(self.signal())
+        current = self.target.replicas
+        desired = self.decider.desired(current, value)
+        self._signal_gauge.set(value, endpoint=self.endpoint_path)
+        if desired != current:
+            log.info("autoscale %s: signal=%.1f replicas %d -> %d",
+                     self.endpoint_path, value, current, desired)
+            self.target.scale_to(desired)
+        self._replica_gauge.set(self.target.replicas,
+                                endpoint=self.endpoint_path)
+        return desired
+
+    async def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — control loop must survive
+                log.exception("autoscale tick failed for %s",
+                              self.endpoint_path)
