@@ -1,0 +1,216 @@
+"""Component launchers — ``python -m ai4e_tpu <component>``.
+
+The reference deploys its components as separately-provisioned Azure
+resources wired by 15 bash scripts (``InfrastructureDeployment/
+deploy_infrastructure.sh:5-38``); here each node of a multi-host deployment
+runs one launcher, configured by ``AI4E_*`` env vars (the typed sections in
+``config.py``) plus a JSON spec file:
+
+- ``control-plane --routes routes.json`` — gateway + task store (HTTP
+  surface included) + broker + dispatchers + autoscalers in one process:
+  the APIM + CacheManager + Service Bus + function-app tier.
+- ``worker --models models.json`` — a TPU inference node: model runtime +
+  micro-batcher + service shell, task state via HttpTaskManager against
+  the control plane (the AKS model-container tier).
+
+Spec formats (JSON):
+
+routes.json::
+
+    {"apis": [{"prefix": "/v1/landcover/classify-async",
+               "backend": "http://worker:8081/v1/landcover/classify-async",
+               "mode": "async",             // or "sync"
+               "autoscale": {"max_replicas": 8},   // optional
+               "concurrency": 4}]}          // optional
+
+models.json::
+
+    {"models": [{"family": "unet", "name": "landcover", "tile": 256,
+                 "buckets": [1, 16, 64],
+                 "sync_path": "/classify",
+                 "async_path": "/classify-async"}],
+     "prefix": "v1/landcover"}
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import signal
+
+from .config import FrameworkConfig
+
+log = logging.getLogger("ai4e_tpu.cli")
+
+
+def load_spec(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def build_control_plane(config: FrameworkConfig, routes: dict):
+    """Assemble the control-plane process; returns the wired platform (its
+    gateway app also carries the task-store HTTP surface)."""
+    from .platform_assembly import LocalPlatform
+    from .scaling import AutoscalePolicy
+    from .taskstore.http import make_app as make_taskstore_app
+
+    platform = LocalPlatform(config.to_platform_config())
+    # The task-store HTTP surface rides on the gateway app — one
+    # control-plane port serves the CACHE_CONNECTOR_*_URI endpoints remote
+    # workers use (distributed_api_task.py:14-15 pattern).
+    make_taskstore_app(platform.store, app=platform.gateway.app)
+    for api in routes.get("apis", []):
+        mode = api.get("mode", "async")
+        if mode == "sync":
+            platform.publish_sync_api(api["prefix"], api["backend"])
+            continue
+        autoscale = api.get("autoscale")
+        platform.publish_async_api(
+            api["prefix"], api["backend"],
+            retry_delay=api.get("retry_delay"),
+            concurrency=api.get("concurrency"),
+            autoscale=AutoscalePolicy(**autoscale) if autoscale else None)
+    return platform
+
+
+def build_worker(config: FrameworkConfig, models: dict):
+    """Assemble a worker process; returns (worker, batcher, task_manager)."""
+    from .runtime import (
+        InferenceWorker,
+        MicroBatcher,
+        ModelRuntime,
+        build_servable,
+        enable_compilation_cache,
+    )
+    from .service.task_manager import (
+        HttpResultStore,
+        HttpTaskManager,
+        LocalTaskManager,
+    )
+
+    rt = config.runtime
+    enable_compilation_cache(rt.compile_cache_dir)
+    runtime = ModelRuntime(donate_batch=rt.donate_batch)
+
+    store_base = models.get("taskstore") or config.gateway.taskstore_get_uri
+    if store_base:
+        task_manager = HttpTaskManager(store_base)
+        store = HttpResultStore(store_base)
+    else:
+        # Standalone worker (dev): own in-memory store.
+        from .taskstore import InMemoryTaskStore
+        store = InMemoryTaskStore()
+        task_manager = LocalTaskManager(store)
+
+    batcher = MicroBatcher(runtime, max_wait_ms=rt.batch_max_wait_ms,
+                           max_pending=rt.batch_max_pending)
+    worker = InferenceWorker(
+        models.get("service_name", "tpu-worker"), runtime, batcher,
+        task_manager=task_manager, prefix=models.get("prefix", "v1"),
+        store=store)
+    for spec in models.get("models", []):
+        spec = dict(spec)
+        family = spec.pop("family")
+        sync_path = spec.pop("sync_path", None)
+        async_path = spec.pop("async_path", None)
+        cap = spec.pop("maximum_concurrent_requests", 64)
+        servable = build_servable(family, **spec)
+        runtime.register(servable)
+        worker.serve_model(servable, sync_path=sync_path,
+                           async_path=async_path,
+                           maximum_concurrent_requests=cap)
+    runtime.warmup()
+    return worker, batcher, task_manager
+
+
+async def run_control_plane(config: FrameworkConfig, routes: dict) -> None:
+    from aiohttp import web
+
+    platform = build_control_plane(config, routes)
+    runner = web.AppRunner(platform.gateway.app)
+    await runner.setup()
+    site = web.TCPSite(runner, config.gateway.host, config.gateway.port)
+    await site.start()
+    await platform.start()
+    log.info("control plane on %s:%s (%d routes)", config.gateway.host,
+             config.gateway.port, len(platform.gateway.routes))
+    try:
+        await _wait_for_termination()
+    finally:
+        await platform.stop()
+        await runner.cleanup()
+
+
+async def run_worker(config: FrameworkConfig, models: dict) -> None:
+    from aiohttp import web
+
+    worker, batcher, task_manager = build_worker(config, models)
+    await batcher.start()
+    runner = web.AppRunner(worker.service.app)
+    await runner.setup()
+    site = web.TCPSite(runner, config.service.host, config.service.port)
+    await site.start()
+    log.info("worker on %s:%s serving %s", config.service.host,
+             config.service.port, list(worker.runtime.models))
+    try:
+        await _wait_for_termination()
+    finally:
+        await worker.service.drain(timeout=config.service.drain_timeout)
+        await batcher.stop()
+        if hasattr(task_manager, "close"):
+            await task_manager.close()
+        if hasattr(worker.store, "close"):
+            await worker.store.close()
+        await runner.cleanup()
+
+
+async def _wait_for_termination() -> None:
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    log.info("termination signal; draining")
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    parser = argparse.ArgumentParser(prog="ai4e_tpu")
+    sub = parser.add_subparsers(dest="component", required=True)
+
+    cp = sub.add_parser("control-plane",
+                        help="gateway + task store + broker + dispatchers")
+    cp.add_argument("--routes", required=True, help="routes.json path")
+    cp.add_argument("--port", type=int, default=None)
+
+    wk = sub.add_parser("worker", help="TPU inference worker")
+    wk.add_argument("--models", required=True, help="models.json path")
+    wk.add_argument("--port", type=int, default=None)
+
+    args = parser.parse_args(argv)
+    config = FrameworkConfig.from_env()
+    config.observability.apply()
+    if config.runtime.platform:
+        # Must be a config update, not an env var: the TPU plugin force-sets
+        # jax_platforms at import, so AI4E_RUNTIME_PLATFORM=cpu is how a
+        # CPU-only node (e.g. the control plane) opts out of device init.
+        import jax
+        jax.config.update("jax_platforms", config.runtime.platform)
+
+    if args.component == "control-plane":
+        if args.port is not None:
+            config.gateway.port = args.port
+        asyncio.run(run_control_plane(config, load_spec(args.routes)))
+    elif args.component == "worker":
+        if args.port is not None:
+            config.service.port = args.port
+        asyncio.run(run_worker(config, load_spec(args.models)))
+
+
+if __name__ == "__main__":
+    main()

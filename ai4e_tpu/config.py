@@ -148,6 +148,7 @@ class ServiceSection:
 @_env_section("AI4E_RUNTIME_")
 class RuntimeSection:
     """TPU runtime knobs — no reference analogue (containers were opaque)."""
+    platform: typing.Optional[str] = None  # pin jax_platforms (e.g. "cpu")
     batch_max_wait_ms: float = 5.0
     batch_max_pending: int = 256
     buckets: typing.Tuple[int, ...] = (1, 8, 32, 64)

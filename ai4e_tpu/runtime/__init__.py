@@ -1,12 +1,15 @@
 from .batcher import BatcherSaturated, MicroBatcher
+from .families import FAMILIES, build_servable
 from .registry import ModelRuntime, ServableModel, enable_compilation_cache
 from .worker import InferenceWorker
 
 __all__ = [
     "BatcherSaturated",
+    "FAMILIES",
     "MicroBatcher",
     "ModelRuntime",
     "ServableModel",
     "InferenceWorker",
+    "build_servable",
     "enable_compilation_cache",
 ]

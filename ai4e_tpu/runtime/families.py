@@ -1,0 +1,191 @@
+"""Model-family factories — config-driven servable construction.
+
+The reference publishes a model by baking it into a container image and
+writing a Helm values file naming that image (``APIs/Charts/camera-trap/
+detection-async/prod-values.yaml``). Here a *family* + kwargs in a worker
+config produces a ready ``ServableModel``: the framework owns preprocess
+(npy payload decoding), the jittable forward, and postprocess, so a
+deployment file can say ``{"family": "unet", "tile": 256}`` and get the
+land-cover API.
+
+Families: ``echo`` (the base-py smoke API), ``unet`` (land-cover
+segmentation), ``resnet`` (species classification), ``detector``
+(camera-trap MegaDetector slot), ``vit`` (classification with
+tensor-parallel sharding rules).
+"""
+
+from __future__ import annotations
+
+import io
+
+import jax
+import numpy as np
+
+from .registry import ServableModel
+
+
+def _npy_preprocess(shape: tuple, dtype=np.float32):
+    def preprocess(body: bytes, content_type: str):
+        arr = np.load(io.BytesIO(body))
+        if arr.shape != shape:
+            raise ValueError(f"expected {shape}, got {arr.shape}")
+        return arr.astype(dtype)
+    return preprocess
+
+
+def build_echo(name: str = "echo", size: int = 16, buckets=(8,),
+               **_) -> ServableModel:
+    """Identity model — the reference's base-py echo API
+    (``APIs/1.0/base-py/runserver.py`` role): proves the full transport
+    without model weight."""
+    import jax.numpy as jnp
+
+    def apply_fn(params, batch):
+        return jnp.asarray(batch) * params["scale"]
+
+    return ServableModel(
+        name=name, apply_fn=apply_fn, params={"scale": np.float32(1.0)},
+        input_shape=(size,), preprocess=_npy_preprocess((size,)),
+        postprocess=lambda out: {"echo": np.asarray(out).tolist()},
+        batch_buckets=tuple(buckets))
+
+
+def build_unet(name: str = "landcover", tile: int = 256,
+               widths=(32, 64, 128), num_classes: int = 8, buckets=(1, 16, 64),
+               fused_postprocess: bool = True, **_) -> ServableModel:
+    """Land-cover segmentation (BASELINE.json config #2)."""
+    from ..models import create_unet
+    from ..ops.pallas import fused_seg_postprocess, normalize_image
+
+    model, params = create_unet(tile=tile, widths=tuple(widths),
+                                num_classes=num_classes)
+
+    if fused_postprocess:
+        def apply_fn(p, batch):
+            x = normalize_image(batch)
+            return fused_seg_postprocess(model.apply(p, x))
+
+        def postprocess(out):
+            counts = np.asarray(out["counts"])
+            return {"class_histogram":
+                    {int(c): int(n) for c, n in enumerate(counts) if n}}
+
+        input_dtype = np.uint8
+        preprocess = _npy_preprocess((tile, tile, 3), np.uint8)
+    else:
+        from ..models import segment_logits_to_classes
+
+        def apply_fn(p, batch):
+            return model.apply(p, batch)
+
+        def postprocess(logits):
+            classes = np.asarray(segment_logits_to_classes(logits[None])[0])
+            values, counts = np.unique(classes, return_counts=True)
+            return {"class_histogram":
+                    {int(v): int(c) for v, c in zip(values, counts)}}
+
+        input_dtype = np.float32
+        preprocess = _npy_preprocess((tile, tile, 3))
+
+    return ServableModel(
+        name=name, apply_fn=apply_fn, params=params,
+        input_shape=(tile, tile, 3), input_dtype=input_dtype,
+        preprocess=preprocess, postprocess=postprocess,
+        batch_buckets=tuple(buckets))
+
+
+def build_resnet(name: str = "classifier", image_size: int = 224,
+                 num_classes: int = 1000, stage_sizes=(3, 4, 6, 3),
+                 width: int = 64, labels: list | None = None,
+                 buckets=(1, 16, 64), **_) -> ServableModel:
+    """Batched species classification (BASELINE.json config #4)."""
+    from ..models.resnet import ResNet
+
+    model = ResNet(stage_sizes=tuple(stage_sizes), num_classes=num_classes,
+                   width=width)
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, image_size, image_size, 3),
+                                    np.float32))
+
+    def postprocess(logits):
+        logits = np.asarray(logits, np.float64)
+        probs = np.exp(logits - logits.max())
+        probs /= probs.sum()
+        top = int(np.argmax(probs))
+        return {"class_id": top,
+                "label": labels[top] if labels else str(top),
+                "confidence": float(probs[top])}
+
+    return ServableModel(
+        name=name, apply_fn=model.apply, params=variables,
+        input_shape=(image_size, image_size, 3),
+        preprocess=_npy_preprocess((image_size, image_size, 3)),
+        postprocess=postprocess, batch_buckets=tuple(buckets))
+
+
+def build_detector(name: str = "megadetector", image_size: int = 512,
+                   widths=(64, 128, 256), max_detections: int = 64,
+                   score_threshold: float = 0.2, buckets=(1, 8, 16),
+                   **_) -> ServableModel:
+    """Camera-trap detection (BASELINE.json config #3, MegaDetector slot)."""
+    from ..models import CenterNetDetector, decode_detections
+
+    model = CenterNetDetector(widths=tuple(widths))
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, image_size, image_size, 3), np.float32))
+
+    def apply_fn(p, batch):
+        return decode_detections(model.apply(p, batch),
+                                 max_detections=max_detections)
+
+    def postprocess(out):
+        scores = np.asarray(out["scores"])
+        keep = scores >= score_threshold
+        return {"detections": [
+            {"box": np.asarray(out["boxes"])[i].tolist(),
+             "score": float(scores[i]),
+             "class_id": int(np.asarray(out["classes"])[i])}
+            for i in np.nonzero(keep)[0]]}
+
+    return ServableModel(
+        name=name, apply_fn=apply_fn, params=params,
+        input_shape=(image_size, image_size, 3),
+        preprocess=_npy_preprocess((image_size, image_size, 3)),
+        postprocess=postprocess, batch_buckets=tuple(buckets))
+
+
+def build_vit(name: str = "vit", image_size: int = 224, patch: int = 16,
+              dim: int = 384, depth: int = 12, heads: int = 6,
+              num_classes: int = 1000, buckets=(1, 16, 64), **_
+              ) -> ServableModel:
+    from ..models import create_vit
+
+    model, params = create_vit(image_size=image_size, patch=patch, dim=dim,
+                               depth=depth, heads=heads,
+                               num_classes=num_classes)
+
+    def postprocess(logits):
+        top = int(np.argmax(np.asarray(logits)))
+        return {"class_id": top}
+
+    return ServableModel(
+        name=name, apply_fn=model.apply, params=params,
+        input_shape=(image_size, image_size, 3),
+        preprocess=_npy_preprocess((image_size, image_size, 3)),
+        postprocess=postprocess, batch_buckets=tuple(buckets))
+
+
+FAMILIES = {
+    "echo": build_echo,
+    "unet": build_unet,
+    "resnet": build_resnet,
+    "detector": build_detector,
+    "vit": build_vit,
+}
+
+
+def build_servable(family: str, **kwargs) -> ServableModel:
+    if family not in FAMILIES:
+        raise ValueError(
+            f"unknown model family {family!r}; valid: {sorted(FAMILIES)}")
+    return FAMILIES[family](**kwargs)

@@ -10,6 +10,7 @@ stores the result on the task store.
 
 from __future__ import annotations
 
+import inspect
 import json
 import logging
 
@@ -104,23 +105,33 @@ class InferenceWorker:
                 handoff = pipeline_to(result)
                 if handoff is not None:
                     next_endpoint, next_body = handoff
-                    if self.store is not None:
-                        # Keep the stage's intermediate output retrievable
-                        # under the same TaskId while the task moves on.
-                        self.store.set_result(
-                            taskId, json.dumps(_jsonable(result)).encode(),
-                            stage=_name)
+                    # Keep the stage's intermediate output retrievable
+                    # under the same TaskId while the task moves on.
+                    await self._store_result(
+                        taskId, json.dumps(_jsonable(result)).encode(),
+                        stage=_name)
                     await tm.update_task_status(
                         taskId, f"running - {_name} handing off to "
                                 f"{next_endpoint}")
                     await tm.add_pipeline_task(taskId, next_endpoint,
                                                body=next_body)
                     return
-            if self.store is not None:
-                self.store.set_result(
-                    taskId, json.dumps(_jsonable(result)).encode())
+            await self._store_result(
+                taskId, json.dumps(_jsonable(result)).encode())
             await tm.complete_task(
                 taskId, f"completed - {_summarise(result)}")
+
+
+    async def _store_result(self, task_id: str, payload: bytes,
+                            stage: str | None = None) -> None:
+        """Works with both the in-process store (sync ``set_result``) and
+        ``HttpResultStore`` (coroutine) — a remote worker stores results on
+        the control plane's task store."""
+        if self.store is None:
+            return
+        res = self.store.set_result(task_id, payload, stage=stage)
+        if inspect.isawaitable(res):
+            await res
 
 
 def _jsonable(obj):

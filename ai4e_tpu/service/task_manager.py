@@ -96,10 +96,11 @@ class LocalTaskManager(TaskManagerBase):
         return self.store.update_status(task_id, status, backend_status).to_dict()
 
 
-class HttpTaskManager(TaskManagerBase):
-    """Client for the task-store HTTP service (``taskstore.http``)."""
+class _HttpStoreClient:
+    """Shared plumbing for clients of the task-store HTTP service."""
 
-    def __init__(self, base_url: str, session: aiohttp.ClientSession | None = None):
+    def __init__(self, base_url: str,
+                 session: aiohttp.ClientSession | None = None):
         self.base_url = base_url.rstrip("/")
         self._holder = SessionHolder(session)
 
@@ -108,6 +109,10 @@ class HttpTaskManager(TaskManagerBase):
 
     async def close(self) -> None:
         await self._holder.close()
+
+
+class HttpTaskManager(_HttpStoreClient, TaskManagerBase):
+    """Client for the task-store HTTP service (``taskstore.http``)."""
 
     async def get_task_status(self, task_id: str) -> dict | None:
         session = await self._get_session()
@@ -146,6 +151,47 @@ class HttpTaskManager(TaskManagerBase):
             if resp.status != 200:  # 204 = task unknown to the store
                 raise KeyError(f"task not found: {task_id}")
             return await resp.json()
+
+
+class HttpResultStore(_HttpStoreClient):
+    """Result read/write against the task-store HTTP service — gives remote
+    workers the same ``set_result``/``get_result`` surface the in-process
+    store offers (methods are coroutines; the worker awaits either form)."""
+
+    async def set_result(self, task_id: str, result: bytes,
+                         content_type: str = "application/json",
+                         stage: str | None = None) -> None:
+        params = {"taskId": task_id}
+        if stage:
+            params["stage"] = stage
+        session = await self._get_session()
+        async with session.post(
+            f"{self.base_url}/v1/taskstore/result", params=params,
+            data=result, headers={"Content-Type": content_type},
+        ) as resp:
+            if resp.status == 404:
+                # Store no longer knows the task (e.g. control plane
+                # restarted without a journal) — surface the drop; the
+                # subsequent complete_task will fail loudly too.
+                import logging
+                logging.getLogger("ai4e_tpu.task_manager").warning(
+                    "result for unknown task %s dropped by store", task_id)
+                return
+            resp.raise_for_status()
+
+    async def get_result(self, task_id: str,
+                         stage: str | None = None
+                         ) -> tuple[bytes, str] | None:
+        params = {"taskId": task_id}
+        if stage:
+            params["stage"] = stage
+        session = await self._get_session()
+        async with session.get(
+            f"{self.base_url}/v1/taskstore/result", params=params,
+        ) as resp:
+            if resp.status != 200:
+                return None
+            return await resp.read(), resp.content_type
 
 
 def next_endpoint_from(current_endpoint: str, version: str, organization: str,

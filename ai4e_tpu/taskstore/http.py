@@ -25,8 +25,13 @@ from .store import InMemoryTaskStore, TaskNotFound
 from .task import APITask
 
 
-def make_app(store: InMemoryTaskStore) -> web.Application:
-    app = web.Application()
+def make_app(store: InMemoryTaskStore,
+             app: web.Application | None = None) -> web.Application:
+    """Build the task-store surface; pass ``app`` to attach the routes to an
+    existing application (e.g. the gateway's, so one control-plane port
+    serves both)."""
+    if app is None:
+        app = web.Application()
 
     async def upsert(request: web.Request) -> web.Response:
         try:
@@ -67,9 +72,39 @@ def make_app(store: InMemoryTaskStore) -> web.Application:
     async def depths(_: web.Request) -> web.Response:
         return web.json_response(store.depths())
 
+    async def put_result(request: web.Request) -> web.Response:
+        task_id = request.query.get("taskId", "")
+        if not task_id:
+            return web.json_response({"error": "taskId required"}, status=400)
+        body = await request.read()
+        try:
+            store.set_result(task_id, body,
+                             content_type=request.content_type
+                             or "application/json",
+                             stage=request.query.get("stage") or None)
+        except TaskNotFound:
+            # Unknown task must be an error, not a silent 204: the worker
+            # treats 2xx as "stored".
+            return web.json_response({"error": f"unknown task {task_id}"},
+                                     status=404)
+        return web.json_response({"ok": True})
+
+    async def get_result(request: web.Request) -> web.Response:
+        task_id = request.query.get("taskId", "")
+        if not task_id:
+            return web.json_response({"error": "taskId required"}, status=400)
+        found = store.get_result(task_id,
+                                 stage=request.query.get("stage") or None)
+        if found is None:
+            return web.Response(status=204)
+        body, content_type = found
+        return web.Response(body=body, content_type=content_type)
+
     app.router.add_post("/v1/taskstore/upsert", upsert)
     app.router.add_post("/v1/taskstore/update", update)
     app.router.add_get("/v1/taskstore/task", get_task)
     app.router.add_get("/v1/taskstore/task/{task_id}", get_task)
     app.router.add_get("/v1/taskstore/depths", depths)
+    app.router.add_post("/v1/taskstore/result", put_result)
+    app.router.add_get("/v1/taskstore/result", get_result)
     return app

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Tear everything down (reference: Cleanup/remove_deployment.sh:9-11 deletes
+# the three resource groups).
+set -euo pipefail
+cd "$(dirname "$0")"
+source ./setup_env.sh
+
+gcloud container clusters delete "$CLUSTER_NAME" --zone "$ZONE" \
+    --project "$PROJECT_ID" --quiet || true
+gcloud artifacts repositories delete "$PREFIX" --location "$REGION" \
+    --project "$PROJECT_ID" --quiet || true
+echo "==> removed cluster and registry"

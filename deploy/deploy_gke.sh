@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# GKE cluster + node pools (reference: deploy_aks.sh:26-152 — AKS + autoscaled
+# CPU/GPU pools + NVIDIA device plugin; GKE's TPU device plugin is built in).
+set -euo pipefail
+cd "$(dirname "$0")"
+source ./setup_env.sh
+
+if ! gcloud container clusters describe "$CLUSTER_NAME" --zone "$ZONE" \
+        --project "$PROJECT_ID" >/dev/null 2>&1; then
+    echo "==> creating cluster $CLUSTER_NAME"
+    gcloud container clusters create "$CLUSTER_NAME" \
+        --project "$PROJECT_ID" --zone "$ZONE" --network "$NETWORK" \
+        --cluster-version "$GKE_VERSION" \
+        --num-nodes 1 --machine-type "$CPU_MACHINE_TYPE" \
+        --enable-autoscaling --min-nodes "$CPU_POOL_MIN" --max-nodes "$CPU_POOL_MAX" \
+        --gateway-api=standard \
+        --enable-managed-prometheus
+fi
+
+# TPU v5e pool — the NC6s_v3 GPU pool analogue (deploy_aks.sh:99-109): taint
+# keeps non-TPU workloads off (reference taints sku=gpu:NoSchedule,
+# setup_env.sh:42); autoscaling bounds mirror the pool min/max arrays.
+if ! gcloud container node-pools describe "$TPU_POOL_NAME" \
+        --cluster "$CLUSTER_NAME" --zone "$ZONE" \
+        --project "$PROJECT_ID" >/dev/null 2>&1; then
+    echo "==> creating TPU pool $TPU_POOL_NAME"
+    gcloud container node-pools create "$TPU_POOL_NAME" \
+        --project "$PROJECT_ID" --zone "$ZONE" --cluster "$CLUSTER_NAME" \
+        --machine-type "$TPU_MACHINE_TYPE" \
+        --tpu-topology "$TPU_TOPOLOGY" \
+        --enable-autoscaling --min-nodes "$TPU_POOL_MIN" --max-nodes "$TPU_POOL_MAX" \
+        --node-taints "$TPU_TAINT"
+fi
+
+gcloud container clusters get-credentials "$CLUSTER_NAME" --zone "$ZONE" \
+    --project "$PROJECT_ID"
+echo "==> cluster ready"

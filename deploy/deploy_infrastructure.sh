@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Master orchestrator (reference: InfrastructureDeployment/deploy_infrastructure.sh:5-38).
+# Rerunnable after partial failure; every step checks its own preconditions.
+set -euo pipefail
+cd "$(dirname "$0")"
+source ./setup_env.sh
+
+echo "==> prerequisites (APIs, artifact registry)"
+gcloud services enable container.googleapis.com artifactregistry.googleapis.com \
+    monitoring.googleapis.com --project "$PROJECT_ID"
+gcloud artifacts repositories describe "$PREFIX" --location "$REGION" \
+    --project "$PROJECT_ID" >/dev/null 2>&1 || \
+gcloud artifacts repositories create "$PREFIX" --repository-format=docker \
+    --location "$REGION" --project "$PROJECT_ID"
+
+echo "==> cluster + node pools"
+./deploy_gke.sh
+
+echo "==> images"
+for target in control-plane worker; do
+    docker build -f "docker/Dockerfile.${target}" -t \
+        "${REGISTRY}/${target}:${IMAGE_TAG}" ../
+    docker push "${REGISTRY}/${target}:${IMAGE_TAG}"
+done
+
+echo "==> platform charts"
+ENV_SUBST='${REGISTRY} ${IMAGE_TAG} ${QUEUE_RETRY_DELAY_SECONDS} ${MAX_DELIVERY_COUNT} ${TASK_JOURNAL_PATH}'
+kubectl create configmap ai4e-routes --from-file=routes.json=specs/routes.json \
+    --dry-run=client -o yaml | kubectl apply -f -
+kubectl create configmap ai4e-models --from-file=models.json=specs/models.json \
+    --dry-run=client -o yaml | kubectl apply -f -
+kubectl create configmap ai4e-models-cpu --from-file=models.json=specs/models-cpu.json \
+    --dry-run=client -o yaml | kubectl apply -f -
+for chart in control-plane worker-tpu worker-cpu hpa; do
+    envsubst "$ENV_SUBST" < "charts/${chart}.yaml" | kubectl apply -f -
+done
+
+if [ "$DEPLOY_ROUTING" = true ]; then
+    echo "==> routing (Gateway API)"
+    envsubst "$ENV_SUBST" < charts/routing.yaml | kubectl apply -f -
+fi
+
+if [ "$DEPLOY_MONITORING" = true ]; then
+    echo "==> monitoring"
+    ./deploy_monitoring.sh
+fi
+
+echo "==> done. Gateway address:"
+kubectl get gateway ai4e-gateway -o jsonpath='{.status.addresses[0].value}' || true

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Monitoring — the App Insights + Istio mixer adapter + azure-k8s-metrics-
+# adapter tier (Cluster/monitoring/, deploy_custom_metrics_adapter.sh:6-52)
+# becomes: Managed Prometheus scrape of the framework's /metrics + the
+# Stackdriver custom-metrics adapter so the HPA can consume the queue-depth
+# gauge.
+set -euo pipefail
+cd "$(dirname "$0")"
+source ./setup_env.sh
+
+kubectl apply -f - <<'EOF'
+apiVersion: monitoring.googleapis.com/v1
+kind: PodMonitoring
+metadata:
+  name: ai4e-metrics
+spec:
+  selector:
+    matchExpressions:
+      - {key: app, operator: In, values: [ai4e-control-plane, ai4e-worker-tpu, ai4e-worker-cpu]}
+  endpoints:
+    - port: http
+      path: /metrics
+      interval: 30s
+EOF
+
+# Custom-metrics adapter (HPA external metrics from Managed Prometheus).
+kubectl apply -f https://raw.githubusercontent.com/GoogleCloudPlatform/k8s-stackdriver/master/custom-metrics-stackdriver-adapter/deploy/production/adapter_new_resource_model.yaml
+
+echo "==> monitoring wired: /metrics -> Managed Prometheus -> HPA external metric"

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# THE deployment config (the reference's InfrastructureDeployment/setup_env.sh:1-82
+# role). Everything below is consumed by the deploy_*.sh scripts; runtime
+# behavior is configured separately via AI4E_* env vars (see ai4e_tpu/config.py)
+# injected through the charts.
+
+# -- project -----------------------------------------------------------------
+export PROJECT_ID="my-gcp-project"
+export REGION="us-central2"            # TPU v5e regions: us-central2, us-west4, ...
+export ZONE="${REGION}-b"
+export PREFIX="ai4e"                   # resource-name prefix (reference: INFRASTRUCTURE_PREFIX)
+
+# -- cluster -----------------------------------------------------------------
+export CLUSTER_NAME="${PREFIX}-cluster"
+export GKE_VERSION="latest"
+export NETWORK="default"
+
+# CPU pool (control plane + sync-cpu APIs) — reference default pool
+# Standard_E8s_v3 1-3 nodes (setup_env.sh:35-39).
+export CPU_POOL_NAME="cpu-pool"
+export CPU_MACHINE_TYPE="e2-standard-8"
+export CPU_POOL_MIN=1
+export CPU_POOL_MAX=3
+
+# TPU pool — replaces the NC6s_v3 GPU pool (deploy_aks.sh:99-109). One
+# v5e-4 host per node; taint mirrors the reference's sku=gpu:NoSchedule.
+export TPU_POOL_NAME="tpu-v5e-pool"
+export TPU_MACHINE_TYPE="ct5lp-hightpu-4t"   # 4-chip TPU v5e host
+export TPU_TOPOLOGY="2x2"
+export TPU_POOL_MIN=1
+export TPU_POOL_MAX=4
+export TPU_TAINT="tpu=present:NoSchedule"
+
+# -- images ------------------------------------------------------------------
+export REGISTRY="${REGION}-docker.pkg.dev/${PROJECT_ID}/${PREFIX}"
+export IMAGE_TAG="1.0"
+
+# -- feature flags (reference setup_env.sh:12-20) ----------------------------
+export DEPLOY_MONITORING=true
+export DEPLOY_ROUTING=true
+
+# -- transport / task-fabric knobs (reference setup_env.sh:65-74) ------------
+# These become AI4E_* env on the control plane.
+export QUEUE_RETRY_DELAY_SECONDS=60
+export MAX_DELIVERY_COUNT=1440
+export TASK_JOURNAL_PATH="/var/lib/ai4e/tasks.jsonl"   # durable task log (PV)

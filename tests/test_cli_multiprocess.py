@@ -1,0 +1,127 @@
+"""Multi-process integration: control plane and worker as SEPARATE OS
+processes wired only by HTTP — the multi-host topology SURVEY.md §4 says the
+reference never had a test for (its components only ever met in production
+Azure). Worker task state flows through HttpTaskManager → task-store HTTP
+surface; results through HttpResultStore."""
+
+import io
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_http(url: str, timeout: float = 30.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2):
+                return
+        except Exception:
+            time.sleep(0.2)
+    raise TimeoutError(f"{url} never came up")
+
+
+def http_json(url: str, data: bytes | None = None) -> dict:
+    req = urllib.request.Request(url, data=data)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture
+def spec_dir(tmp_path):
+    return tmp_path
+
+
+class TestMultiProcess:
+    def test_task_flows_across_processes(self, spec_dir):
+        cp_port, wk_port = free_port(), free_port()
+        cp_base = f"http://127.0.0.1:{cp_port}"
+        wk_base = f"http://127.0.0.1:{wk_port}"
+
+        models = {
+            "service_name": "echo-worker",
+            "prefix": "v1/echo",
+            "taskstore": cp_base,
+            "models": [{"family": "echo", "name": "echo", "size": 16,
+                        "buckets": [4], "sync_path": "/run",
+                        "async_path": "/run-async"}],
+        }
+        routes = {"apis": [
+            {"prefix": "/v1/echo/run-async",
+             "backend": f"{wk_base}/v1/echo/run-async",
+             "concurrency": 2, "retry_delay": 0.1},
+            {"prefix": "/v1/echo/run",
+             "backend": f"{wk_base}/v1/echo/run", "mode": "sync"},
+        ]}
+        (spec_dir / "models.json").write_text(json.dumps(models))
+        (spec_dir / "routes.json").write_text(json.dumps(routes))
+
+        env = dict(os.environ,
+                   AI4E_RUNTIME_PLATFORM="cpu",
+                   AI4E_PLATFORM_RETRY_DELAY="0.1",
+                   PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+        procs = []
+        try:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "ai4e_tpu", "control-plane",
+                 "--routes", str(spec_dir / "routes.json"),
+                 "--port", str(cp_port)],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT))
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "ai4e_tpu", "worker",
+                 "--models", str(spec_dir / "models.json"),
+                 "--port", str(wk_port)],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT))
+
+            wait_http(f"{cp_base}/healthz", timeout=30)
+            wait_http(f"{wk_base}/v1/echo/", timeout=60)
+
+            payload = io.BytesIO()
+            np.save(payload, np.arange(16, dtype=np.float32))
+            payload = payload.getvalue()
+
+            # Sync across the gateway proxy → worker process.
+            sync = http_json(f"{cp_base}/v1/echo/run", data=payload)
+            assert sync["echo"][:3] == [0.0, 1.0, 2.0]
+
+            # Async: gateway creates the task; dispatcher POSTs to the other
+            # process; worker updates status over HTTP; result lands on the
+            # control plane's store.
+            task = http_json(f"{cp_base}/v1/echo/run-async", data=payload)
+            task_id = task["TaskId"]
+            final = http_json(
+                f"{cp_base}/v1/taskmanagement/task/{task_id}?wait=30")
+            assert "completed" in final["Status"], final
+
+            with urllib.request.urlopen(
+                    f"{cp_base}/v1/taskstore/result?taskId={task_id}",
+                    timeout=10) as resp:
+                result = json.loads(resp.read())
+            assert result["echo"][:3] == [0.0, 1.0, 2.0]
+
+            # Worker draining: SIGTERM → exits cleanly.
+            procs[1].send_signal(signal.SIGTERM)
+            assert procs[1].wait(timeout=15) == 0
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)

@@ -1,0 +1,58 @@
+"""Deploy-spec validation: the shipped specs in deploy/specs/ must actually
+assemble against the CLI builders, and every family factory must produce a
+well-formed servable (cheap configs — no big model init here)."""
+
+import json
+import os
+
+import numpy as np
+
+from ai4e_tpu.cli import build_control_plane
+from ai4e_tpu.config import FrameworkConfig
+from ai4e_tpu.runtime import FAMILIES, build_servable
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPECS = os.path.join(REPO, "deploy", "specs")
+
+
+class TestDeploySpecs:
+    def test_routes_spec_assembles_control_plane(self):
+        with open(os.path.join(SPECS, "routes.json")) as f:
+            routes = json.load(f)
+        config = FrameworkConfig()
+        config.platform.retry_delay = 0.1
+        platform = build_control_plane(config, routes)
+        # Every async API got a dispatcher + queue; autoscale specs attached.
+        async_apis = [a for a in routes["apis"] if a.get("mode") != "sync"]
+        assert len(platform.dispatchers.dispatchers) == len(async_apis)
+        with_scaler = [a for a in async_apis if a.get("autoscale")]
+        assert len(platform.autoscalers) == len(with_scaler)
+        # Task-store HTTP surface rides the gateway app.
+        paths = {r.resource.canonical for r in platform.gateway.app.router.routes()}
+        assert "/v1/taskstore/upsert" in paths
+        assert "/v1/taskstore/result" in paths
+
+    def test_models_spec_families_are_known(self):
+        with open(os.path.join(SPECS, "models.json")) as f:
+            models = json.load(f)
+        for spec in models["models"]:
+            assert spec["family"] in FAMILIES, spec
+
+    def test_every_family_builds_and_runs_tiny(self):
+        tiny = {
+            "echo": dict(size=8, buckets=(2,)),
+            "unet": dict(tile=16, widths=(8, 16), buckets=(2,),
+                         fused_postprocess=False),
+            "resnet": dict(image_size=16, stage_sizes=(1,), width=8,
+                           num_classes=4, buckets=(2,)),
+            "detector": dict(image_size=32, widths=(8, 8, 8),
+                             max_detections=4, buckets=(2,)),
+            "vit": dict(image_size=16, patch=8, dim=16, depth=1, heads=2,
+                        num_classes=4, buckets=(2,)),
+        }
+        for family, kwargs in tiny.items():
+            servable = build_servable(family, name=f"t-{family}", **kwargs)
+            batch = np.zeros((2, *servable.input_shape),
+                             servable.input_dtype)
+            out = servable.apply_fn(servable.params, batch)
+            assert out is not None, family
