@@ -1,4 +1,5 @@
 from .dispatcher import AWAITING_STATUS, BACKPRESSURE_CODES, Dispatcher, DispatcherPool
+from .push import PushEvent, PushTopic, SubscriptionError, WebhookDispatcher
 from .queue import EndpointQueue, InMemoryBroker, Message
 
 __all__ = [
@@ -9,4 +10,8 @@ __all__ = [
     "EndpointQueue",
     "InMemoryBroker",
     "Message",
+    "PushEvent",
+    "PushTopic",
+    "SubscriptionError",
+    "WebhookDispatcher",
 ]

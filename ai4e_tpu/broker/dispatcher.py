@@ -36,8 +36,30 @@ log = logging.getLogger("ai4e_tpu.dispatcher")
 # Backend saturation signals: the reference checks 429 TooManyRequests
 # (BackendQueueProcessor.cs:54); our service shell emits 503 for the same
 # condition (ai4e_service.py:122-125 does too) — treat both as backpressure.
+# Shared by both transports (queue dispatcher here, push webhook in
+# ``broker.push``) so they classify backend responses identically.
 BACKPRESSURE_CODES = (429, 503)
 AWAITING_STATUS = "Awaiting service availability"
+
+
+def rebase_endpoint(endpoint: str, base_path: str, backend_uri: str) -> str:
+    """Graft ``endpoint``'s operation tail and query onto ``backend_uri``.
+
+    The task records the original request URI as its Endpoint
+    (``request_policy.xml:15``); dispatch targets the *registered* backend
+    (fresh host) with the endpoint's tail/query grafted on so the exact call
+    the client made is reproduced. One rule for both transports.
+    """
+    from urllib.parse import urlparse
+    parsed = urlparse(endpoint)  # handles bare paths too
+    path = parsed.path
+    base = base_path.rstrip("/")
+    target = backend_uri
+    if path != base and path.startswith(base + "/"):
+        target = backend_uri.rstrip("/") + path[len(base):]
+    if parsed.query:
+        target += "?" + parsed.query
+    return target
 
 
 class Dispatcher:
@@ -122,19 +144,8 @@ class Dispatcher:
     def _target_for(self, msg: Message) -> str:
         """Dispatch target: the *registered* backend URI (fresh host — a
         journal-restored task may carry a stale one) with the task endpoint's
-        operation tail and query grafted on, so the exact call the client
-        made is reproduced (request_policy.xml:15 records the original URI;
-        BackendQueueProcessor posts to per-queue config)."""
-        from urllib.parse import urlparse
-        parsed = urlparse(msg.endpoint)  # handles bare paths too
-        path = parsed.path
-        base = self.queue_name.rstrip("/")
-        target = self.backend_uri
-        if path != base and path.startswith(base + "/"):
-            target = self.backend_uri.rstrip("/") + path[len(base):]
-        if parsed.query:
-            target += "?" + parsed.query
-        return target
+        operation tail and query grafted on (``rebase_endpoint``)."""
+        return rebase_endpoint(msg.endpoint, self.queue_name, self.backend_uri)
 
     async def _dispatch_one(self, msg: Message) -> None:
         from ..observability import get_tracer

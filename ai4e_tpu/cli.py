@@ -12,6 +12,8 @@ runs one launcher, configured by ``AI4E_*`` env vars (the typed sections in
 - ``worker --models models.json`` — a TPU inference node: model runtime +
   micro-batcher + service shell, task state via HttpTaskManager against
   the control plane (the AKS model-container tier).
+- ``reporter`` — cross-replica in-flight request counter (the reference's
+  RequestReporter function app, ``deploy_request_reporter_function.sh``).
 
 Spec formats (JSON):
 
@@ -28,7 +30,8 @@ models.json::
     {"models": [{"family": "unet", "name": "landcover", "tile": 256,
                  "buckets": [1, 16, 64],
                  "sync_path": "/classify",
-                 "async_path": "/classify-async"}],
+                 "async_path": "/classify-async",
+                 "batch": {"max_items": 512}}],   // optional batch API
      "prefix": "v1/landcover"}
 """
 
@@ -105,23 +108,35 @@ def build_worker(config: FrameworkConfig, models: dict):
         store = InMemoryTaskStore()
         task_manager = LocalTaskManager(store)
 
+    reporter = None
+    if config.service.reporter_uri:
+        # Cross-replica in-flight reporting (REQUEST_REPORTER_URI pattern,
+        # ai4e_service.py:21,135-146).
+        from .metrics import ProcessingReporterClient
+        reporter = ProcessingReporterClient(config.service.reporter_uri,
+                                            cluster=config.service.cluster)
+
     batcher = MicroBatcher(runtime, max_wait_ms=rt.batch_max_wait_ms,
                            max_pending=rt.batch_max_pending)
     worker = InferenceWorker(
         models.get("service_name", "tpu-worker"), runtime, batcher,
         task_manager=task_manager, prefix=models.get("prefix", "v1"),
-        store=store)
+        store=store, reporter=reporter)
     for spec in models.get("models", []):
         spec = dict(spec)
         family = spec.pop("family")
         sync_path = spec.pop("sync_path", None)
         async_path = spec.pop("async_path", None)
         cap = spec.pop("maximum_concurrent_requests", 64)
+        batch = spec.pop("batch", None)  # true | {serve_batch kwargs}
         servable = build_servable(family, **spec)
         runtime.register(servable)
         worker.serve_model(servable, sync_path=sync_path,
                            async_path=async_path,
                            maximum_concurrent_requests=cap)
+        if batch:
+            worker.serve_batch(servable,
+                               **(batch if isinstance(batch, dict) else {}))
     runtime.warmup()
     return worker, batcher, task_manager
 
@@ -160,10 +175,31 @@ async def run_worker(config: FrameworkConfig, models: dict) -> None:
     finally:
         await worker.service.drain(timeout=config.service.drain_timeout)
         await batcher.stop()
+        if worker.service.reporter is not None:
+            await worker.service.reporter.close()
         if hasattr(task_manager, "close"):
             await task_manager.close()
         if hasattr(worker.store, "close"):
             await worker.store.close()
+        await runner.cleanup()
+
+
+async def run_reporter(config: FrameworkConfig, port: int | None) -> None:
+    """Standalone request-reporter node (the reference deploys it as its own
+    function app, ``deploy_request_reporter_function.sh``)."""
+    from aiohttp import web
+
+    from .metrics import RequestReporterService
+
+    svc = RequestReporterService()
+    runner = web.AppRunner(svc.app)
+    await runner.setup()
+    site = web.TCPSite(runner, config.service.host, port or 8085)
+    await site.start()
+    log.info("request reporter on %s:%s", config.service.host, port or 8085)
+    try:
+        await _wait_for_termination()
+    finally:
         await runner.cleanup()
 
 
@@ -192,6 +228,10 @@ def main(argv=None) -> None:
     wk.add_argument("--models", required=True, help="models.json path")
     wk.add_argument("--port", type=int, default=None)
 
+    rp = sub.add_parser("reporter",
+                        help="cross-replica in-flight request reporter")
+    rp.add_argument("--port", type=int, default=None)
+
     args = parser.parse_args(argv)
     config = FrameworkConfig.from_env()
     config.observability.apply()
@@ -210,6 +250,8 @@ def main(argv=None) -> None:
         if args.port is not None:
             config.service.port = args.port
         asyncio.run(run_worker(config, load_spec(args.models)))
+    elif args.component == "reporter":
+        asyncio.run(run_reporter(config, args.port))
 
 
 if __name__ == "__main__":

@@ -117,22 +117,28 @@ def _env_section(prefix: str):
 @_env_section("AI4E_PLATFORM_")
 class PlatformSection:
     """Transport/task-fabric knobs (setup_env.sh:65-74 tier)."""
+    transport: str = "queue"         # TRANSPORT_TYPE (setup_env.sh:11): queue | push
     retry_delay: float = 60.0        # dispatcher backoff on 429/503 (s)
     max_delivery_count: int = 1440   # broker patience (setup_env.sh:65)
     dispatcher_concurrency: int = 1  # serial per queue (host.json:5-9)
     journal_path: typing.Optional[str] = None
     lease_seconds: float = 300.0
     native_broker: bool = False
+    push_ttl_seconds: float = 300.0  # event TTL 5 min (deploy_event_grid_subscription.sh:37)
+    push_max_attempts: int = 3       # max delivery attempts (same line)
 
     def to_platform_config(self):
         from .platform_assembly import PlatformConfig
         return PlatformConfig(
+            transport=self.transport,
             retry_delay=self.retry_delay,
             max_delivery_count=self.max_delivery_count,
             dispatcher_concurrency=self.dispatcher_concurrency,
             journal_path=self.journal_path,
             lease_seconds=self.lease_seconds,
             native_broker=self.native_broker,
+            push_ttl_seconds=self.push_ttl_seconds,
+            push_max_attempts=self.push_max_attempts,
         )
 
 
@@ -143,6 +149,10 @@ class ServiceSection:
     port: int = 8081
     executor_workers: int = 8
     drain_timeout: float = 30.0
+    # Cross-replica in-flight reporter (REQUEST_REPORTER_URI +
+    # SERVICE_CLUSTER in ai4e_service.py:21,135-146); None disables.
+    reporter_uri: typing.Optional[str] = None
+    cluster: str = "local"
 
 
 @_env_section("AI4E_RUNTIME_")

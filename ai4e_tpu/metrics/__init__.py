@@ -6,6 +6,11 @@ from .registry import (
     MetricsRegistry,
     Timer,
 )
+from .reporter import (
+    ProcessingCounters,
+    ProcessingReporterClient,
+    RequestReporterService,
+)
 
 __all__ = [
     "DEFAULT_REGISTRY",
@@ -13,5 +18,8 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "ProcessingCounters",
+    "ProcessingReporterClient",
+    "RequestReporterService",
     "Timer",
 ]

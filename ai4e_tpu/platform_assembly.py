@@ -21,6 +21,7 @@ from .taskstore import InMemoryTaskStore, JournaledTaskStore, endpoint_path
 
 @dataclass
 class PlatformConfig:
+    transport: str = "queue"        # "queue" | "push" (setup_env.sh:11 TRANSPORT_TYPE)
     retry_delay: float = 60.0       # dispatcher backoff on 429/503 (setup_env.sh:74)
     max_delivery_count: int = 1440  # broker patience (setup_env.sh:65)
     dispatcher_concurrency: int = 1  # serial per queue (host.json:5-9)
@@ -29,6 +30,9 @@ class PlatformConfig:
     native_broker: bool = False      # C++ broker core (native/broker_core.cpp)
     queue_depth_interval: float = 30.0    # TaskQueueLogger.cs:19
     process_depth_interval: float = 300.0  # TaskProcessLogger.cs:21
+    # push-transport delivery policy (deploy_event_grid_subscription.sh:37)
+    push_ttl_seconds: float = 300.0
+    push_max_attempts: int = 3
 
 
 class LocalPlatform:
@@ -52,21 +56,41 @@ class LocalPlatform:
             self.store = JournaledTaskStore(self.config.journal_path)
         else:
             self.store = InMemoryTaskStore()
-        if self.config.native_broker:
-            from .broker.native import NativeBroker
-            self.broker = NativeBroker(
-                max_delivery_count=self.config.max_delivery_count,
-                lease_seconds=self.config.lease_seconds)
-        else:
-            self.broker = InMemoryBroker(
-                max_delivery_count=self.config.max_delivery_count,
-                lease_seconds=self.config.lease_seconds)
-        self.store.set_publisher(self.broker.publish)
         self.task_manager = LocalTaskManager(self.store)
-        self.dispatchers = DispatcherPool(
-            self.broker, self.task_manager,
-            retry_delay=self.config.retry_delay,
-            concurrency=self.config.dispatcher_concurrency)
+        self.broker = None
+        self.dispatchers = None
+        self.topic = None
+        self.webhook = None
+        self._webhook_runner = None
+        if self.config.transport == "push":
+            from .broker.push import PushTopic, WebhookDispatcher
+            self.topic = PushTopic(
+                ttl_seconds=self.config.push_ttl_seconds,
+                max_attempts=self.config.push_max_attempts,
+                retry_delay=self.config.retry_delay,
+                metrics=self.metrics)
+            self.webhook = WebhookDispatcher(self.task_manager,
+                                             metrics=self.metrics)
+            self.store.set_publisher(self.topic.publish)
+        elif self.config.transport == "queue":
+            if self.config.native_broker:
+                from .broker.native import NativeBroker
+                self.broker = NativeBroker(
+                    max_delivery_count=self.config.max_delivery_count,
+                    lease_seconds=self.config.lease_seconds)
+            else:
+                self.broker = InMemoryBroker(
+                    max_delivery_count=self.config.max_delivery_count,
+                    lease_seconds=self.config.lease_seconds)
+            self.store.set_publisher(self.broker.publish)
+            self.dispatchers = DispatcherPool(
+                self.broker, self.task_manager,
+                retry_delay=self.config.retry_delay,
+                concurrency=self.config.dispatcher_concurrency)
+        else:
+            raise ValueError(
+                f"unknown transport {self.config.transport!r}; "
+                "expected 'queue' or 'push'")
         self.gateway = Gateway(self.store, metrics=self.metrics)
         from .observability import DepthLogger
         self.depth_logger = DepthLogger(
@@ -98,6 +122,14 @@ class LocalPlatform:
         dispatcher's delivery fan-out."""
         self.gateway.add_async_route(public_prefix, backend_uri)
         queue_name = endpoint_path(backend_uri)
+        if self.config.transport == "push":
+            if autoscale is not None or retry_delay is not None or concurrency is not None:
+                raise ValueError(
+                    "autoscale/retry_delay/concurrency are queue-transport "
+                    "knobs; push retry policy is topic-wide "
+                    "(PlatformConfig.retry_delay/push_max_attempts)")
+            self.webhook.add_route(queue_name, backend_uri)
+            return
         self.broker.register_queue(queue_name)
         dispatcher = self.dispatchers.register(queue_name, backend_uri,
                                                retry_delay=retry_delay,
@@ -116,21 +148,46 @@ class LocalPlatform:
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
-        self.broker.bind_loop(loop)
+        if self.config.transport == "push":
+            await self._start_push(loop)
+        else:
+            self.broker.bind_loop(loop)
 
-        def on_dead_letter(msg) -> None:
-            # Runs on the event loop (queues are loop-bound); fail the task
-            # asynchronously so it never sits non-terminal after its message
-            # is gone.
-            loop.create_task(self._fail_dead_letter(msg.task_id))
+            def on_dead_letter(msg) -> None:
+                # Runs on the event loop (queues are loop-bound); fail the
+                # task asynchronously so it never sits non-terminal after its
+                # message is gone.
+                loop.create_task(self._fail_dead_letter(msg.task_id))
 
-        self.broker.set_dead_letter_handler(on_dead_letter)
-        await self.dispatchers.start()
+            self.broker.set_dead_letter_handler(on_dead_letter)
+            await self.dispatchers.start()
         await self.depth_logger.start()
         for scaler in self.autoscalers:
             await scaler.start()
         self._reseed_unfinished()
         self._started = True
+
+    async def _start_push(self, loop: asyncio.AbstractEventLoop) -> None:
+        """Push transport: serve the webhook dispatcher app, then validate
+        the topic → webhook subscription (the reference's Event Grid
+        subscription handshake, ``deploy_event_grid_subscription.sh``). The
+        webhook runs on its own port so the topic→webhook leg is a real HTTP
+        hop, exactly as process-separable as the reference's Functions."""
+        from aiohttp import web as aioweb
+        self.topic.bind_loop(loop)
+
+        def on_dead_letter(event) -> None:
+            loop.create_task(self._fail_dead_letter(event.id))
+
+        self.topic.set_dead_letter_handler(on_dead_letter)
+        runner = aioweb.AppRunner(self.webhook.app)
+        await runner.setup()
+        site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        self._webhook_runner = runner
+        await self.topic.subscribe(
+            "backend-webhook", f"http://127.0.0.1:{port}/api/events")
 
     async def _fail_dead_letter(self, task_id: str) -> None:
         try:
@@ -153,18 +210,28 @@ class LocalPlatform:
         restored = getattr(self.store, "replayed_task_ids", None)
         if not restored:
             return
+        publish = (self.topic.publish if self.config.transport == "push"
+                   else self.broker.publish)
         for task in self.store.unfinished_tasks():
             if task.task_id in restored:
-                self.broker.publish(task)
+                publish(task)
 
     async def stop(self) -> None:
         if self._started:
             for scaler in self.autoscalers:
                 await scaler.stop()
-            await self.dispatchers.stop()
+            if self.dispatchers is not None:
+                await self.dispatchers.stop()
             await self.depth_logger.stop()
             self._started = False
+        # Push resources clean up even after a failed start() (e.g. the
+        # subscription handshake raised after the webhook site was bound).
+        if self.topic is not None:
+            await self.topic.aclose()
+        if self._webhook_runner is not None:
+            await self._webhook_runner.cleanup()
+            self._webhook_runner = None
         for svc in self.services:
             await svc.drain(timeout=5.0)
-        if hasattr(self.broker, "close"):
+        if self.broker is not None and hasattr(self.broker, "close"):
             self.broker.close()

@@ -31,12 +31,13 @@ class InferenceWorker:
     def __init__(self, name: str, runtime: ModelRuntime, batcher: MicroBatcher,
                  task_manager: TaskManagerBase | None = None,
                  prefix: str = "v1", metrics: MetricsRegistry | None = None,
-                 store=None):
+                 store=None, reporter=None):
         self.runtime = runtime
         self.batcher = batcher
         self.store = store
         self.service = APIService(name, prefix=prefix,
-                                  task_manager=task_manager, metrics=metrics)
+                                  task_manager=task_manager, metrics=metrics,
+                                  reporter=reporter)
 
     def serve_model(self, servable: ServableModel,
                     sync_path: str | None = None,
@@ -121,6 +122,120 @@ class InferenceWorker:
             await tm.complete_task(
                 taskId, f"completed - {_summarise(result)}")
 
+
+    def serve_batch(self, servable: ServableModel,
+                    sync_path: str | None = None,
+                    async_path: str | None = None,
+                    max_items: int = 1024,
+                    submit_concurrency: int = 64,
+                    progress_every: float = 2.0,
+                    maximum_concurrent_requests: int = 8) -> None:
+        """Expose a *batch* API for a servable: one request carries a stack of
+        N examples (npy array of shape ``(N, *input_shape)``), the platform
+        fans them into the micro-batcher and aggregates the results.
+
+        The reference's batch APIs (``APIs/Projects/camera-trap/
+        batch-detection-async.dockerfile``) are long-running tasks over many
+        images inside one container; here the stack rides the same device
+        batching as everything else — a 1000-image batch task and single-image
+        requests interleave on the mesh. Per-image failure isolation: a bad
+        image yields an ``error`` entry at its index, never failing the stack
+        (SURVEY.md §7 hard part #1). The async path reports incremental
+        progress ("running - k/N"), the reference's long-task status contract
+        (``ai4e_service.py:180-213``).
+        """
+        import asyncio
+        import io
+
+        name = servable.name
+        sync_path = sync_path or f"/{name}-batch"
+        async_path = async_path or f"/{name}-batch-async"
+        item_shape = tuple(servable.input_shape)
+
+        def _decode_stack(body: bytes) -> np.ndarray:
+            arr = np.load(io.BytesIO(body))
+            if arr.ndim != len(item_shape) + 1 or tuple(arr.shape[1:]) != item_shape:
+                raise ValueError(
+                    f"expected stack (N, {', '.join(map(str, item_shape))}), "
+                    f"got {arr.shape}")
+            if len(arr) == 0:
+                raise ValueError("empty batch")
+            if len(arr) > max_items:
+                raise ValueError(f"batch of {len(arr)} exceeds max {max_items}")
+            return arr.astype(servable.input_dtype, copy=False)
+
+        async def _run_stack(stack: np.ndarray, on_progress=None) -> list:
+            results: list = [None] * len(stack)
+            done = 0
+            queue: asyncio.Queue[int] = asyncio.Queue()
+            for i in range(len(stack)):
+                queue.put_nowait(i)
+
+            async def _puller():
+                nonlocal done
+                while True:
+                    try:
+                        i = queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        return
+                    while True:
+                        try:
+                            out = await self.batcher.submit(
+                                name, np.asarray(stack[i]))
+                            results[i] = {"index": i, "result": _jsonable(out)}
+                            break
+                        except BatcherSaturated:
+                            # Throttle, don't fail: the stack shares the
+                            # device with interactive traffic.
+                            await asyncio.sleep(0.05)
+                        except Exception as exc:  # noqa: BLE001 — isolate the image
+                            results[i] = {"index": i, "error": str(exc)}
+                            break
+                    done += 1
+                    if on_progress is not None:
+                        await on_progress(done, len(stack))
+
+            pullers = min(submit_concurrency, len(stack))
+            await asyncio.gather(*(_puller() for _ in range(pullers)))
+            return results
+
+        @self.service.api_sync_func(
+            sync_path, maximum_concurrent_requests=maximum_concurrent_requests)
+        async def _sync_batch(body, content_type):
+            stack = _decode_stack(body)
+            results = await _run_stack(stack)
+            failed = sum(1 for r in results if "error" in r)
+            return {"count": len(results), "failed": failed, "items": results}
+
+        @self.service.api_async_func(
+            async_path, maximum_concurrent_requests=maximum_concurrent_requests)
+        async def _async_batch(taskId, body, content_type):
+            tm = self.service.task_manager
+            try:
+                stack = _decode_stack(body)
+            except Exception as exc:  # noqa: BLE001 — bad payload fails this task only
+                await tm.fail_task(taskId, f"failed - bad input: {exc}")
+                return
+            total = len(stack)
+            await tm.update_task_status(
+                taskId, f"running - {name} batch 0/{total}")
+            last = {"t": 0.0}
+
+            async def on_progress(k, n):
+                import time as _t
+                now = _t.monotonic()
+                if now - last["t"] >= progress_every or k == n:
+                    last["t"] = now
+                    await tm.update_task_status(
+                        taskId, f"running - {name} batch {k}/{n}")
+
+            results = await _run_stack(stack, on_progress)
+            failed = sum(1 for r in results if "error" in r)
+            await self._store_result(taskId, json.dumps(
+                {"count": total, "failed": failed, "items": results}).encode())
+            await tm.complete_task(
+                taskId,
+                f"completed - {total} images, {failed} failed")
 
     async def _store_result(self, task_id: str, payload: bytes,
                             stage: str | None = None) -> None:

@@ -1,5 +1,7 @@
 from .app import APIService, EndpointSpec, TASK_ID_HEADER
+from .sync_client import SyncTaskManager
 from .task_manager import (
+    HttpResultStore,
     HttpTaskManager,
     LocalTaskManager,
     TaskManagerBase,
@@ -10,8 +12,10 @@ __all__ = [
     "APIService",
     "EndpointSpec",
     "TASK_ID_HEADER",
+    "HttpResultStore",
     "HttpTaskManager",
     "LocalTaskManager",
+    "SyncTaskManager",
     "TaskManagerBase",
     "next_endpoint_from",
 ]

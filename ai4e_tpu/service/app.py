@@ -75,6 +75,7 @@ class APIService:
         metrics: MetricsRegistry | None = None,
         executor_workers: int = 8,
         tracer=None,
+        reporter=None,
     ):
         self.name = name
         self.prefix = ("/" + prefix.strip("/")) if prefix.strip("/") else ""
@@ -87,6 +88,7 @@ class APIService:
             # No explicit exporter/sample_rate → follows configure_tracer live.
             tracer = Tracer(name, metrics=self.metrics)
         self.tracer = tracer
+        self.reporter = reporter  # ProcessingReporterClient | None
         self.is_terminating = False
         self.endpoints: dict[str, EndpointSpec] = {}
         self.executor = ThreadPoolExecutor(max_workers=executor_workers,
@@ -163,10 +165,16 @@ class APIService:
     def _reserve(self, spec: EndpointSpec) -> None:
         spec.in_flight += 1
         self._inflight.inc(path=spec.api_path, service=self.name)
+        if self.reporter is not None:
+            # Cross-replica aggregated counter (ai4e_service.py:148-151 POSTs
+            # the same delta to REQUEST_REPORTER_URI); fire-and-forget.
+            self.reporter.report(self.prefix + spec.api_path, increment=1)
 
     def _release(self, spec: EndpointSpec) -> None:
         spec.in_flight -= 1
         self._inflight.dec(path=spec.api_path, service=self.name)
+        if self.reporter is not None:
+            self.reporter.report(self.prefix + spec.api_path, decrement=1)
 
     def _make_handler(self, spec: EndpointSpec):
         async def handler(request: web.Request) -> web.Response:

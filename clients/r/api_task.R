@@ -1,0 +1,138 @@
+# Task-manager client for R model services — AI4E-TPU platform.
+#
+# Port parity with the reference's R task manager
+# (APIs/1.0/base-r/task_management/api_task.R:7-120, crul-based) re-targeted
+# at this platform's task-store HTTP surface (ai4e_tpu/taskstore/http.py):
+#
+#   POST {base}/v1/taskstore/upsert   — create / pipeline-republish a task
+#   POST {base}/v1/taskstore/update   — atomic status transition
+#   GET  {base}/v1/taskstore/task?taskId=…
+#   POST {base}/v1/taskstore/result?taskId=…
+#
+# The same six verbs as the Python managers: AddTask / UpdateTaskStatus /
+# CompleteTask / FailTask / AddPipelineTask / GetTaskStatus. Synchronous
+# (httr), matching how R plumber endpoints run one request per worker.
+#
+# Usage:
+#   source("api_task.R")
+#   tm <- TaskManager$new(Sys.getenv("AI4E_GATEWAY_TASKSTORE_UPSERT_URI",
+#                                    "http://taskstore:8090"))
+#   status <- tm$AddTask(endpoint = "/v1/myorg/myapi", body = raw_payload)
+#   tm$UpdateTaskStatus(status$TaskId, "running - 10% complete")
+#   tm$CompleteTask(status$TaskId, "completed")
+#
+# NOTE: this environment has no R toolchain, so this client ships untested;
+# it is exercised against the same HTTP contract the tested Python
+# SyncTaskManager (ai4e_tpu/service/sync_client.py) uses.
+
+library(httr)
+library(jsonlite)
+
+TaskManager <- setRefClass(
+  "TaskManager",
+  fields = list(
+    base_url = "character",
+    timeout_s = "numeric"
+  ),
+  methods = list(
+    initialize = function(base_url = "http://127.0.0.1:8090",
+                          timeout_s = 60) {
+      base_url <<- sub("/+$", "", base_url)
+      timeout_s <<- timeout_s
+    },
+
+    .post_json = function(path, payload) {
+      resp <- httr::POST(
+        paste0(base_url, path),
+        body = jsonlite::toJSON(payload, auto_unbox = TRUE, null = "null"),
+        httr::content_type_json(),
+        httr::timeout(timeout_s)
+      )
+      if (httr::status_code(resp) == 204) return(NULL)
+      if (httr::status_code(resp) != 200) {
+        stop(sprintf("task store returned HTTP %d for %s",
+                     httr::status_code(resp), path))
+      }
+      jsonlite::fromJSON(httr::content(resp, as = "text", encoding = "UTF-8"))
+    },
+
+    # AddTask: create a task — or, when the dispatcher already created it and
+    # passed the taskId header, just fetch it (api_task.R:14-32 reference
+    # semantics).
+    AddTask = function(endpoint, body = "", task_id = NULL,
+                       publish = FALSE) {
+      if (!is.null(task_id) && nzchar(task_id)) {
+        existing <- GetTaskStatus(task_id)
+        if (!is.null(existing)) return(existing)
+      }
+      .post_json("/v1/taskstore/upsert", list(
+        TaskId = if (is.null(task_id)) "" else task_id,
+        Endpoint = endpoint,
+        Status = "created",
+        BackendStatus = "created",
+        Body = if (is.raw(body)) rawToChar(body) else as.character(body),
+        PublishToGrid = publish
+      ))
+    },
+
+    UpdateTaskStatus = function(task_id, status, backend_status = NULL) {
+      result <- .post_json("/v1/taskstore/update", list(
+        TaskId = task_id,
+        Status = status,
+        BackendStatus = backend_status
+      ))
+      if (is.null(result)) stop(sprintf("task not found: %s", task_id))
+      result
+    },
+
+    CompleteTask = function(task_id, status = "completed") {
+      UpdateTaskStatus(task_id, status, backend_status = "completed")
+    },
+
+    FailTask = function(task_id, status = "failed") {
+      UpdateTaskStatus(task_id, status, backend_status = "failed")
+    },
+
+    # AddPipelineTask: hand the task to the next API under the same TaskId;
+    # an empty body makes the store replay the original request body to the
+    # next stage (api_task.R:58-89 reference semantics).
+    AddPipelineTask = function(task_id, next_endpoint, body = "") {
+      .post_json("/v1/taskstore/upsert", list(
+        TaskId = task_id,
+        Endpoint = next_endpoint,
+        Status = "created",
+        BackendStatus = "created",
+        Body = if (is.raw(body)) rawToChar(body) else as.character(body),
+        PublishToGrid = TRUE
+      ))
+    },
+
+    GetTaskStatus = function(task_id) {
+      resp <- httr::GET(
+        paste0(base_url, "/v1/taskstore/task"),
+        query = list(taskId = task_id),
+        httr::timeout(timeout_s)
+      )
+      if (httr::status_code(resp) != 200) return(NULL)
+      jsonlite::fromJSON(httr::content(resp, as = "text", encoding = "UTF-8"))
+    },
+
+    SetTaskResult = function(task_id, result,
+                             content_type = "application/json",
+                             stage = NULL) {
+      query <- list(taskId = task_id)
+      if (!is.null(stage)) query$stage <- stage
+      resp <- httr::POST(
+        paste0(base_url, "/v1/taskstore/result"),
+        query = query,
+        body = result,
+        httr::content_type(content_type),
+        httr::timeout(timeout_s)
+      )
+      if (httr::status_code(resp) >= 300) {
+        stop(sprintf("set_result failed: HTTP %d", httr::status_code(resp)))
+      }
+      invisible(NULL)
+    }
+  )
+)

@@ -1,0 +1,183 @@
+"""Batch-API tests — one request carrying a stack of N images, fanned through
+the shared micro-batcher (the reference's batch APIs,
+``APIs/Projects/camera-trap/batch-detection-async.dockerfile``), with
+per-image failure isolation and incremental progress status."""
+
+import asyncio
+import io
+import json
+
+import numpy as np
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.metrics import MetricsRegistry
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.runtime import InferenceWorker, MicroBatcher, ModelRuntime, ServableModel
+
+SIZE = 8
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def npy_bytes(arr):
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+def make_square_servable(name="square"):
+    import jax.numpy as jnp
+
+    def apply_fn(params, batch):
+        return jnp.asarray(batch) ** 2
+
+    def postprocess(out):
+        total = float(np.asarray(out).sum())
+        if total > 1e6:
+            # Poison pill for the failure-isolation test.
+            raise ValueError("example overflow")
+        return {"sum_sq": total}
+
+    return ServableModel(
+        name=name, apply_fn=apply_fn, params={},
+        input_shape=(SIZE,), preprocess=lambda b, c: np.load(io.BytesIO(b)),
+        postprocess=postprocess, batch_buckets=(4, 16))
+
+
+def build_worker(platform):
+    runtime = ModelRuntime()
+    servable = make_square_servable()
+    runtime.register(servable)
+    runtime.warmup()
+    batcher = MicroBatcher(runtime, max_wait_ms=1, max_pending=32,
+                           metrics=MetricsRegistry())
+    worker = InferenceWorker("square-svc", runtime, batcher,
+                             task_manager=platform.task_manager,
+                             prefix="v1/square", store=platform.store,
+                             metrics=MetricsRegistry())
+    worker.serve_batch(servable, max_items=64, progress_every=0.0)
+    return worker, batcher
+
+
+class TestBatchSync:
+    def test_stack_scored_in_one_request(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            worker, batcher = build_worker(platform)
+            await batcher.start()
+            client = await serve(worker.service.app)
+            try:
+                stack = np.arange(3 * SIZE, dtype=np.float32).reshape(3, SIZE)
+                resp = await client.post("/v1/square/square-batch",
+                                         data=npy_bytes(stack))
+                assert resp.status == 200
+                out = await resp.json()
+                assert out["count"] == 3 and out["failed"] == 0
+                # Order preserved: item i is the i-th row's sum of squares.
+                for i, item in enumerate(out["items"]):
+                    assert item["index"] == i
+                    expect = float((stack[i] ** 2).sum())
+                    assert abs(item["result"]["sum_sq"] - expect) < 1e-3
+            finally:
+                await batcher.stop()
+                await client.close()
+
+        run(main())
+
+    def test_bad_stack_shape_rejected(self):
+        async def main():
+            platform = LocalPlatform()
+            worker, batcher = build_worker(platform)
+            await batcher.start()
+            client = await serve(worker.service.app)
+            try:
+                bad = np.zeros((3, SIZE + 1), np.float32)
+                resp = await client.post("/v1/square/square-batch",
+                                         data=npy_bytes(bad))
+                assert resp.status == 500 or resp.status == 400
+            finally:
+                await batcher.stop()
+                await client.close()
+
+        run(main())
+
+
+class TestBatchAsync:
+    def test_async_batch_with_failure_isolation(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            worker, batcher = build_worker(platform)
+            await batcher.start()
+            svc_client = await serve(worker.service.app)
+            platform.publish_async_api(
+                "/v1/public/square-batch",
+                str(svc_client.make_url("/v1/square/square-batch-async")))
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                stack = np.ones((10, SIZE), np.float32)
+                stack[4] = 1e4  # poison: postprocess raises for this image
+                resp = await gw.post("/v1/public/square-batch",
+                                     data=npy_bytes(stack))
+                tid = (await resp.json())["TaskId"]
+                final = None
+                for _ in range(400):
+                    r = await gw.get(f"/v1/taskmanagement/task/{tid}")
+                    final = await r.json()
+                    if "completed" in final["Status"] or "failed" in final["Status"]:
+                        break
+                    await asyncio.sleep(0.02)
+                assert final["Status"] == "completed - 10 images, 1 failed", final
+
+                payload, _ctype = platform.store.get_result(tid)
+                out = json.loads(payload)
+                assert out["count"] == 10 and out["failed"] == 1
+                assert "error" in out["items"][4]
+                assert all("result" in out["items"][i]
+                           for i in range(10) if i != 4)
+            finally:
+                await platform.stop()
+                await batcher.stop()
+                await gw.close()
+                await svc_client.close()
+
+        run(main())
+
+    def test_async_bad_payload_fails_task(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            worker, batcher = build_worker(platform)
+            await batcher.start()
+            svc_client = await serve(worker.service.app)
+            platform.publish_async_api(
+                "/v1/public/square-batch",
+                str(svc_client.make_url("/v1/square/square-batch-async")))
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                resp = await gw.post("/v1/public/square-batch",
+                                     data=b"not-an-npy")
+                tid = (await resp.json())["TaskId"]
+                final = None
+                for _ in range(400):
+                    r = await gw.get(f"/v1/taskmanagement/task/{tid}")
+                    final = await r.json()
+                    if "failed" in final["Status"]:
+                        break
+                    await asyncio.sleep(0.02)
+                assert "failed - bad input" in final["Status"], final
+            finally:
+                await platform.stop()
+                await batcher.stop()
+                await gw.close()
+                await svc_client.close()
+
+        run(main())

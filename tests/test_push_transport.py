@@ -1,0 +1,296 @@
+"""Push (webhook) transport tests — the reference's `eventgrid` TRANSPORT_TYPE
+(``deploy_infrastructure.sh:13-27``): topic publish → HTTP push to the webhook
+dispatcher → backend POST, with subscription-validation handshake
+(``BackendWebhook.cs:47-55``), 429 pass-through retry (``:69-72``), and the
+TTL/max-attempts delivery policy (``deploy_event_grid_subscription.sh:37``)."""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.broker.push import (
+    PushTopic,
+    SubscriptionError,
+    VALIDATION_EVENT,
+    WebhookDispatcher,
+)
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.service import LocalTaskManager
+from ai4e_tpu.taskstore import InMemoryTaskStore
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def poll_until(client, task_id, predicate, tries=400, delay=0.02):
+    body = None
+    for _ in range(tries):
+        resp = await client.get(f"/v1/taskmanagement/task/{task_id}")
+        body = await resp.json()
+        if predicate(body):
+            return body
+        await asyncio.sleep(delay)
+    return body
+
+
+class TestHandshake:
+    def test_webhook_echoes_validation_code(self):
+        async def main():
+            store = InMemoryTaskStore()
+            webhook = WebhookDispatcher(LocalTaskManager(store))
+            client = await serve(webhook.app)
+            try:
+                resp = await client.post("/api/events", json=[{
+                    "EventType": VALIDATION_EVENT, "ValidationCode": "c0de"}])
+                assert resp.status == 200
+                assert (await resp.json()) == {"validationResponse": "c0de"}
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_subscribe_rejects_bad_echo(self):
+        async def main():
+            async def bad_handler(request):
+                return web.json_response({"validationResponse": "WRONG"})
+
+            app = web.Application()
+            app.router.add_post("/api/events", bad_handler)
+            client = await serve(app)
+            topic = PushTopic()
+            try:
+                with pytest.raises(SubscriptionError):
+                    await topic.subscribe(
+                        "bad", str(client.make_url("/api/events")))
+                assert topic._subscriptions == []
+            finally:
+                await topic.aclose()
+                await client.close()
+
+        run(main())
+
+
+class TestPushE2E:
+    def test_full_async_lifecycle_over_push(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(
+                transport="push", retry_delay=0.05))
+            svc = platform.make_service("detector", prefix="v1/detector")
+
+            @svc.api_async_func("/detect")
+            def detect(taskId, body, content_type):
+                asyncio.run(platform.task_manager.complete_task(
+                    taskId, f"completed - {len(body)} bytes scored"))
+
+            svc_client = await serve(svc.app)
+            backend_uri = str(svc_client.make_url("/v1/detector/detect"))
+            platform.publish_async_api("/v1/camera-trap/detect", backend_uri)
+            gw_client = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                resp = await gw_client.post("/v1/camera-trap/detect",
+                                            data=b"JPEGDATA")
+                assert resp.status == 200
+                created = await resp.json()
+                assert created["Status"] == "created"
+                final = await poll_until(
+                    gw_client, created["TaskId"],
+                    lambda b: "completed" in b["Status"])
+                assert final["Status"] == "completed - 8 bytes scored"
+            finally:
+                await platform.stop()
+                await gw_client.close()
+                await svc_client.close()
+
+        run(main())
+
+    def test_backpressure_retries_via_topic(self):
+        # Saturated (cap-1) backend: webhook passes 429/503 back to the topic,
+        # whose backoff schedule retries the delivery until it lands.
+        async def main():
+            platform = LocalPlatform(PlatformConfig(
+                transport="push", retry_delay=0.05,
+                push_max_attempts=50))
+            svc = platform.make_service("slow", prefix="v1/slow")
+            import threading
+            gate = threading.Semaphore(1)
+
+            @svc.api_async_func("/work", maximum_concurrent_requests=1)
+            def work(taskId, body, content_type):
+                with gate:
+                    import time as _t
+                    _t.sleep(0.05)
+                asyncio.run(platform.task_manager.complete_task(
+                    taskId, "completed"))
+
+            svc_client = await serve(svc.app)
+            platform.publish_async_api(
+                "/v1/public/work", str(svc_client.make_url("/v1/slow/work")))
+            gw_client = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                ids = []
+                for _ in range(4):
+                    resp = await gw_client.post("/v1/public/work", data=b"x")
+                    ids.append((await resp.json())["TaskId"])
+                for tid in ids:
+                    final = await poll_until(
+                        gw_client, tid, lambda b: "completed" in b["Status"])
+                    assert "completed" in final["Status"], final
+            finally:
+                await platform.stop()
+                await gw_client.close()
+                await svc_client.close()
+
+        run(main())
+
+    def test_exhausted_delivery_fails_task(self):
+        # Unreachable backend: after max_attempts the event dead-letters and
+        # the platform fails the task (terminal, not stuck non-terminal).
+        async def main():
+            platform = LocalPlatform(PlatformConfig(
+                transport="push", retry_delay=0.02, push_max_attempts=2))
+            platform.publish_async_api(
+                "/v1/public/never", "http://127.0.0.1:1/v1/never")
+            gw_client = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                resp = await gw_client.post("/v1/public/never", data=b"x")
+                tid = (await resp.json())["TaskId"]
+                final = await poll_until(
+                    gw_client, tid, lambda b: "failed" in b["Status"])
+                assert "failed" in final["Status"], final
+            finally:
+                await platform.stop()
+                await gw_client.close()
+
+        run(main())
+
+    def test_unroutable_subject_fails_task(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(transport="push"))
+            # Route registered on the gateway only — the webhook has no
+            # backend mapping for it.
+            platform.gateway.add_async_route(
+                "/v1/public/ghost", "http://127.0.0.1:1/v1/ghost/run")
+            gw_client = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                resp = await gw_client.post("/v1/public/ghost", data=b"x")
+                tid = (await resp.json())["TaskId"]
+                final = await poll_until(
+                    gw_client, tid, lambda b: "failed" in b["Status"])
+                assert "no backend route" in final["Status"], final
+            finally:
+                await platform.stop()
+                await gw_client.close()
+
+        run(main())
+
+    def test_pipeline_over_push(self):
+        # §3.4 pipelining rides the push transport too: stage-1 republishes
+        # under the same TaskId; the webhook routes stage-2 to its backend and
+        # the store replays the original body.
+        async def main():
+            platform = LocalPlatform(PlatformConfig(
+                transport="push", retry_delay=0.05))
+            seen = {}
+            det = platform.make_service("det", prefix="v1/det")
+            cls = platform.make_service("cls", prefix="v1/cls")
+
+            @det.api_async_func("/detect")
+            def detect(taskId, body, content_type):
+                asyncio.run(platform.task_manager.add_pipeline_task(
+                    taskId, cls_backend))
+
+            @cls.api_async_func("/classify")
+            def classify(taskId, body, content_type):
+                seen["stage2_body"] = body
+                asyncio.run(platform.task_manager.complete_task(
+                    taskId, "completed - classified"))
+
+            det_client = await serve(det.app)
+            cls_client = await serve(cls.app)
+            det_backend = str(det_client.make_url("/v1/det/detect"))
+            cls_backend = str(cls_client.make_url("/v1/cls/classify"))
+            platform.publish_async_api("/v1/pipeline/detect", det_backend)
+            platform.webhook.add_route("/v1/cls/classify", cls_backend)
+            gw_client = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                resp = await gw_client.post("/v1/pipeline/detect",
+                                            data=b"ORIGINAL-IMG")
+                tid = (await resp.json())["TaskId"]
+                final = await poll_until(
+                    gw_client, tid, lambda b: "completed" in b["Status"])
+                assert final["Status"] == "completed - classified"
+                assert seen["stage2_body"] == b"ORIGINAL-IMG"
+            finally:
+                await platform.stop()
+                await gw_client.close()
+                await det_client.close()
+                await cls_client.close()
+
+        run(main())
+
+
+class TestPreStartBuffering:
+    def test_task_accepted_before_start_is_delivered(self):
+        # The gateway may accept a task before platform.start() completes the
+        # subscription handshake; the topic buffers and flushes — the same
+        # contract as the queue broker (which buffers pre-bind).
+        async def main():
+            platform = LocalPlatform(PlatformConfig(
+                transport="push", retry_delay=0.05))
+            svc = platform.make_service("svc", prefix="v1/svc")
+
+            @svc.api_async_func("/work")
+            def work(taskId, body, content_type):
+                asyncio.run(platform.task_manager.complete_task(
+                    taskId, "completed - buffered"))
+
+            svc_client = await serve(svc.app)
+            platform.publish_async_api(
+                "/v1/public/work", str(svc_client.make_url("/v1/svc/work")))
+            gw_client = await serve(platform.gateway.app)
+            try:
+                # POST BEFORE start(): no subscription exists yet.
+                resp = await gw_client.post("/v1/public/work", data=b"x")
+                created = await resp.json()
+                assert created["Status"] == "created", created
+                await platform.start()
+                final = await poll_until(
+                    gw_client, created["TaskId"],
+                    lambda b: "completed" in b["Status"])
+                assert final["Status"] == "completed - buffered"
+            finally:
+                await platform.stop()
+                await gw_client.close()
+                await svc_client.close()
+
+        run(main())
+
+
+class TestConfigPlumbing:
+    def test_transport_type_from_env(self):
+        from ai4e_tpu.config import FrameworkConfig
+        cfg = FrameworkConfig.from_env({
+            "AI4E_PLATFORM_TRANSPORT": "push",
+            "AI4E_PLATFORM_PUSH_MAX_ATTEMPTS": "7",
+        })
+        pc = cfg.to_platform_config()
+        assert pc.transport == "push"
+        assert pc.push_max_attempts == 7
+
+    def test_unknown_transport_rejected(self):
+        with pytest.raises(ValueError, match="unknown transport"):
+            LocalPlatform(PlatformConfig(transport="carrier-pigeon"))

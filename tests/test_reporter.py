@@ -1,0 +1,166 @@
+"""RequestReporter tests — the cross-replica in-flight counter
+(``ProcessManager/RequestReporter/CurrentProcessingUpsert.cs:26-113`` /
+``CurrentProcessingGet.cs:27-78``) and the in-service fire-and-forget client
+(``ai4e_service.py:135-156``)."""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.metrics import MetricsRegistry, ProcessingCounters
+from ai4e_tpu.metrics.reporter import (
+    ProcessingReporterClient,
+    RequestReporterService,
+)
+from ai4e_tpu.service import APIService
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+class TestCounters:
+    def test_adjust_and_value(self):
+        c = ProcessingCounters(MetricsRegistry())
+        assert c.adjust("gpu", "/v1/detect", increment=1) == 1
+        assert c.adjust("gpu", "/v1/detect", increment=1) == 2
+        assert c.adjust("gpu", "/v1/detect", decrement=1) == 1
+        assert c.value("gpu", "/v1/detect") == 1
+        assert c.value("gpu", "/v1/other") == 0
+
+    def test_gauge_export(self):
+        reg = MetricsRegistry()
+        c = ProcessingCounters(reg)
+        c.adjust("gpu", "/v1/detect", increment=3)
+        text = reg.render_prometheus()
+        assert "ai4e_current_requests" in text
+        assert "3" in text
+
+
+class TestReporterService:
+    def test_upsert_and_get_roundtrip(self):
+        async def main():
+            svc = RequestReporterService(metrics=MetricsRegistry())
+            client = await serve(svc.app)
+            try:
+                resp = await client.post("/v1/processing", json={
+                    "Cluster": "gpu", "Path": "/v1/detect",
+                    "IncrementBy": 2, "DecrementBy": 0})
+                assert resp.status == 200
+                assert (await resp.json())["CurrentRequests"] == 2
+
+                resp = await client.get(
+                    "/v1/processing",
+                    params={"cluster": "gpu", "path": "/v1/detect"})
+                assert (await resp.json())["CurrentRequests"] == 2
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_missing_path_rejected(self):
+        async def main():
+            svc = RequestReporterService(metrics=MetricsRegistry())
+            client = await serve(svc.app)
+            try:
+                resp = await client.post("/v1/processing", json={"Cluster": "x"})
+                assert resp.status == 400
+                resp = await client.get("/v1/processing")
+                assert resp.status == 400
+            finally:
+                await client.close()
+
+        run(main())
+
+
+class TestServiceIntegration:
+    def test_service_reports_cross_replica_counts(self):
+        # Two replicas of the same API reporting to one reporter: the
+        # aggregated counter sees the sum — the signal the reference's HPA
+        # custom metric scales on (appinsights-metric.yaml:1-7).
+        async def main():
+            reporter_svc = RequestReporterService(metrics=MetricsRegistry())
+            rep_client_http = await serve(reporter_svc.app)
+            uri = str(rep_client_http.make_url("/"))
+
+            import threading
+            release = threading.Event()
+            replicas, clients = [], []
+            for i in range(2):
+                reporter = ProcessingReporterClient(uri, cluster="tpu")
+                svc = APIService(f"echo{i}", prefix="v1/echo",
+                                 metrics=MetricsRegistry(), reporter=reporter)
+
+                @svc.api_sync_func("/run")
+                def handler(body, content_type):
+                    release.wait(timeout=5.0)
+                    return {"ok": True}
+
+                replicas.append((svc, reporter))
+                clients.append(await serve(svc.app))
+
+            try:
+                # One in-flight request per replica, held open by the event.
+                posts = [asyncio.create_task(c.post("/v1/echo/run", data=b"x"))
+                         for c in clients]
+                # Wait for the increments to land on the reporter.
+                for _ in range(100):
+                    await asyncio.sleep(0.02)
+                    if reporter_svc.counters.value("tpu", "/v1/echo/run") == 2:
+                        break
+                assert reporter_svc.counters.value("tpu", "/v1/echo/run") == 2
+
+                release.set()
+                for p in posts:
+                    resp = await p
+                    assert resp.status == 200
+                for svc, reporter in replicas:
+                    await reporter.drain()
+                assert reporter_svc.counters.value("tpu", "/v1/echo/run") == 0
+            finally:
+                release.set()
+                for svc, reporter in replicas:
+                    await reporter.close()
+                for c in clients:
+                    await c.close()
+                await rep_client_http.close()
+
+        run(main())
+
+    def test_dead_reporter_does_not_break_requests(self):
+        async def main():
+            reporter = ProcessingReporterClient("http://127.0.0.1:1",
+                                                cluster="tpu")
+            svc = APIService("echo", prefix="v1/echo",
+                             metrics=MetricsRegistry(), reporter=reporter)
+
+            @svc.api_sync_func("/run")
+            def handler(body, content_type):
+                return {"ok": True}
+
+            client = await serve(svc.app)
+            try:
+                resp = await client.post("/v1/echo/run", data=b"x")
+                assert resp.status == 200
+            finally:
+                await reporter.close()
+                await client.close()
+
+        run(main())
+
+
+class TestConfig:
+    def test_reporter_config_from_env(self):
+        from ai4e_tpu.config import FrameworkConfig
+        cfg = FrameworkConfig.from_env({
+            "AI4E_SERVICE_REPORTER_URI": "http://reporter:9000",
+            "AI4E_SERVICE_CLUSTER": "tpu-v5e",
+        })
+        assert cfg.service.reporter_uri == "http://reporter:9000"
+        assert cfg.service.cluster == "tpu-v5e"

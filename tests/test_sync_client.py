@@ -1,0 +1,115 @@
+"""SyncTaskManager tests — the blocking (worker-thread) task client, parity
+with the reference's synchronous manager
+(``Containers/Common/task_management/distributed_api_task.py:12-86``)."""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.service import SyncTaskManager
+from ai4e_tpu.taskstore import InMemoryTaskStore
+from ai4e_tpu.taskstore.http import make_app
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve_store(store):
+    client = TestClient(TestServer(make_app(store)))
+    await client.start_server()
+    return client
+
+
+async def in_thread(fn, *args, **kwargs):
+    """Run the blocking client call off-loop so the server (on this loop)
+    can answer it — how user model code calls it from worker threads."""
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, lambda: fn(*args, **kwargs))
+
+
+class TestSyncTaskManager:
+    def test_lifecycle(self):
+        async def main():
+            store = InMemoryTaskStore()
+            http = await serve_store(store)
+            tm = SyncTaskManager(str(http.make_url("/")))
+            try:
+                created = await in_thread(tm.add_task, "/v1/org/api",
+                                          b"PAYLOAD")
+                tid = created["TaskId"]
+                assert created["Status"] == "created"
+
+                updated = await in_thread(tm.update_task_status, tid,
+                                          "running - 50%")
+                assert updated["Status"] == "running - 50%"
+
+                done = await in_thread(tm.complete_task, tid,
+                                       "completed - scored")
+                assert done["BackendStatus"] == "completed"
+                assert (await in_thread(tm.get_task_status, tid)
+                        )["Status"] == "completed - scored"
+            finally:
+                await http.close()
+
+        run(main())
+
+    def test_add_task_reuses_dispatcher_task_id(self):
+        # taskId header present → fetch, don't create (api_task.py:12-20).
+        async def main():
+            store = InMemoryTaskStore()
+            http = await serve_store(store)
+            tm = SyncTaskManager(str(http.make_url("/")))
+            try:
+                first = await in_thread(tm.add_task, "/v1/a", b"x")
+                again = await in_thread(tm.add_task, "/v1/a", b"y",
+                                        first["TaskId"])
+                assert again["TaskId"] == first["TaskId"]
+                assert len(list(store.snapshot())) == 1
+            finally:
+                await http.close()
+
+        run(main())
+
+    def test_pipeline_and_results(self):
+        async def main():
+            store = InMemoryTaskStore()
+            http = await serve_store(store)
+            tm = SyncTaskManager(str(http.make_url("/")))
+            try:
+                created = await in_thread(tm.add_task, "/v1/det", b"IMG")
+                tid = created["TaskId"]
+                handed = await in_thread(tm.add_pipeline_task, tid, "/v1/cls")
+                assert handed["TaskId"] == tid
+                # Empty pipeline body → original replayed by the store.
+                assert store.get(tid).body == b"IMG"
+
+                await in_thread(tm.set_result, tid, b'{"species": "lynx"}')
+                got = await in_thread(tm.get_result, tid)
+                assert got == b'{"species": "lynx"}'
+
+                await in_thread(tm.set_result, tid, b"crops",
+                                "application/octet-stream", "detector")
+                assert (await in_thread(tm.get_result, tid, "detector")
+                        ) == b"crops"
+            finally:
+                await http.close()
+
+        run(main())
+
+    def test_unknown_task_errors(self):
+        async def main():
+            store = InMemoryTaskStore()
+            http = await serve_store(store)
+            tm = SyncTaskManager(str(http.make_url("/")))
+            try:
+                assert (await in_thread(tm.get_task_status, "ghost")) is None
+                try:
+                    await in_thread(tm.update_task_status, "ghost", "running")
+                    raise AssertionError("expected KeyError")
+                except KeyError:
+                    pass
+            finally:
+                await http.close()
+
+        run(main())
