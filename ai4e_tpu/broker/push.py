@@ -319,13 +319,18 @@ class WebhookDispatcher:
             envelope = [envelope]
 
         worst_status = 200
+        validation_code = None
         for rec in envelope:
             if rec.get("EventType") == VALIDATION_EVENT:
-                # Handshake: echo the code (BackendWebhook.cs:47-55).
-                return web.json_response(
-                    {"validationResponse": rec.get("ValidationCode", "")})
+                # Handshake (BackendWebhook.cs:47-55). Don't short-circuit:
+                # a mixed envelope's task events must still be forwarded, or
+                # the publisher would see 200 and never redeliver them.
+                validation_code = rec.get("ValidationCode", "")
+                continue
             status = await self._forward(PushEvent.from_wire(rec))
             worst_status = max(worst_status, status)
+        if worst_status == 200 and validation_code is not None:
+            return web.json_response({"validationResponse": validation_code})
         return web.Response(status=worst_status)
 
     async def _forward(self, event: PushEvent) -> int:

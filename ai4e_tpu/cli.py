@@ -65,7 +65,15 @@ def build_control_plane(config: FrameworkConfig, routes: dict):
     # control-plane port serves the CACHE_CONNECTOR_*_URI endpoints remote
     # workers use (distributed_api_task.py:14-15 pattern).
     make_taskstore_app(platform.store, app=platform.gateway.app)
-    for api in routes.get("apis", []):
+    # Typed API definitions ({org, api, backend_host, ...}) render to route
+    # entries via the registration customizer (gateway/registration.py) —
+    # both spec styles can coexist in one routes.json.
+    rendered = []
+    if routes.get("definitions"):
+        from .gateway.registration import ApiDefinition, routes_from_definitions
+        defs = [ApiDefinition.from_dict(r) for r in routes["definitions"]]
+        rendered = routes_from_definitions(defs)["apis"]
+    for api in [*routes.get("apis", []), *rendered]:
         mode = api.get("mode", "async")
         if mode == "sync":
             platform.publish_sync_api(api["prefix"], api["backend"])
@@ -96,6 +104,10 @@ def build_worker(config: FrameworkConfig, models: dict):
 
     rt = config.runtime
     enable_compilation_cache(rt.compile_cache_dir)
+    # Multi-host slice: JAX_COORDINATOR_ADDRESS et al. initialise the DCN
+    # plane (no-op single-process); the default mesh then spans every host.
+    from .parallel import init_distributed
+    init_distributed()
     runtime = ModelRuntime(donate_batch=rt.donate_batch)
 
     store_base = models.get("taskstore") or config.gateway.taskstore_get_uri
@@ -138,6 +150,16 @@ def build_worker(config: FrameworkConfig, models: dict):
             worker.serve_batch(servable,
                                **(batch if isinstance(batch, dict) else {}))
     runtime.warmup()
+
+    import jax
+    if jax.process_count() > 1:
+        # Multi-host serving (SURVEY.md §7 hard part #3): the primary's
+        # batcher broadcasts each batch so every process enters the same
+        # compiled call; followers mirror in follower_loop (run_worker).
+        from .parallel.multihost import MultihostRuntime
+        mh = MultihostRuntime(runtime)
+        worker.runtime = mh
+        batcher.runtime = mh
     return worker, batcher, task_manager
 
 
@@ -163,6 +185,16 @@ async def run_worker(config: FrameworkConfig, models: dict) -> None:
     from aiohttp import web
 
     worker, batcher, task_manager = build_worker(config, models)
+
+    import jax
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        # Follower host of a pod slice: no HTTP surface — mirror the
+        # primary's batch executions until it shuts us down.
+        log.info("follower %d/%d: entering mirror loop",
+                 jax.process_index(), jax.process_count())
+        await asyncio.to_thread(worker.runtime.follower_loop)
+        return
+
     await batcher.start()
     runner = web.AppRunner(worker.service.app)
     await runner.setup()
@@ -175,6 +207,8 @@ async def run_worker(config: FrameworkConfig, models: dict) -> None:
     finally:
         await worker.service.drain(timeout=config.service.drain_timeout)
         await batcher.stop()
+        if jax.process_count() > 1:
+            worker.runtime.shutdown_followers()
         if worker.service.reporter is not None:
             await worker.service.reporter.close()
         if hasattr(task_manager, "close"):

@@ -1,0 +1,101 @@
+"""API registration — publishing a model API onto the platform edge.
+
+The reference registers an API with ~250 lines of az-CLI: policy templates
+filled by ``api_management_customizer.py`` (backend URL splicing at
+``api_management_customizer.py:4-44``) and ``az rest`` PUTs creating the API,
+its operations, and per-operation policies
+(``APIManagement/create_sync_api_management_api.sh:38-92``,
+``create_async_api_management_api.sh:52-80``). Here the same act is a typed
+``ApiDefinition`` rendered into gateway routes — declarative registration
+replacing imperative deployment.
+
+The public URL shape is the reference's ``/{version}/{organization}/{api}``
+(the pipeline hand-off builds exactly that shape,
+``distributed_api_task.py:74-75``), with operations as path tails under it
+(the landcover example registers ``classify/classifybyextent/tile/
+tilebyextent`` ops under one API, ``create_sync_api_management_api.sh:38-92``)
+— tails ride the gateway/dispatcher tail-grafting, so operations need no
+individual registration.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ApiDefinition:
+    """One published API: who owns it, what it's called, where it runs."""
+
+    organization: str            # e.g. "camera-trap"
+    api: str                     # e.g. "detection"
+    backend_host: str            # worker base, e.g. "http://worker:8081"
+    version: str = "v1"
+    mode: str = "async"          # "sync" | "async"
+    operations: tuple = ()       # documented op tails (informational)
+    backend_path: str = ""       # path on the worker; default /{version}/{api}
+    # queue-transport dispatch knobs (publish_async_api passthrough)
+    concurrency: int | None = None
+    retry_delay: float | None = None
+    autoscale: dict | None = None
+
+    @property
+    def public_prefix(self) -> str:
+        return f"/{self.version}/{self.organization}/{self.api}"
+
+    @property
+    def backend_uri(self) -> str:
+        path = self.backend_path or f"/{self.version}/{self.api}"
+        return self.backend_host.rstrip("/") + path
+
+    @classmethod
+    def from_dict(cls, rec: dict) -> "ApiDefinition":
+        rec = dict(rec)
+        if "operations" in rec:
+            rec["operations"] = tuple(rec["operations"])
+        return cls(**rec)
+
+
+def routes_from_definitions(defs: list[ApiDefinition]) -> dict:
+    """Render definitions to the control plane's ``routes.json`` shape —
+    the customizer step: templates + concrete addresses → deployable spec
+    (``api_management_customizer.py:13-30`` splices the ingress IP the same
+    way)."""
+    apis = []
+    for d in defs:
+        entry: dict = {"prefix": d.public_prefix, "backend": d.backend_uri,
+                       "mode": d.mode}
+        if d.concurrency is not None:
+            entry["concurrency"] = d.concurrency
+        if d.retry_delay is not None:
+            entry["retry_delay"] = d.retry_delay
+        if d.autoscale is not None:
+            entry["autoscale"] = d.autoscale
+        apis.append(entry)
+    return {"apis": apis}
+
+
+def register_definitions(platform, defs: list[ApiDefinition]) -> None:
+    """Publish definitions directly onto a ``LocalPlatform`` — the in-process
+    equivalent of running the registration scripts against APIM."""
+    for d in defs:
+        if d.mode == "sync":
+            platform.publish_sync_api(d.public_prefix, d.backend_uri)
+            continue
+        autoscale = None
+        if d.autoscale is not None:
+            from ..scaling import AutoscalePolicy
+            autoscale = AutoscalePolicy(**d.autoscale)
+        platform.publish_async_api(
+            d.public_prefix, d.backend_uri,
+            retry_delay=d.retry_delay, concurrency=d.concurrency,
+            autoscale=autoscale)
+
+
+def load_definitions(path: str) -> list[ApiDefinition]:
+    """Load an ``apis.json``: ``{"apis": [{organization, api, backend_host,
+    ...}, ...]}``."""
+    with open(path, encoding="utf-8") as f:
+        spec = json.load(f)
+    return [ApiDefinition.from_dict(rec) for rec in spec.get("apis", [])]

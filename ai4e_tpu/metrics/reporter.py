@@ -57,7 +57,10 @@ class ProcessingCounters:
                increment: int = 0, decrement: int = 0) -> int:
         delta = increment - decrement
         with self._lock:
-            value = self._values.get((cluster, path), 0) + delta
+            # Floor at zero: after a reporter restart, in-flight requests'
+            # decrements would otherwise drive the load signal permanently
+            # negative — a transient undercount is the bounded failure mode.
+            value = max(0, self._values.get((cluster, path), 0) + delta)
             self._values[(cluster, path)] = value
         self._gauge.set(value, cluster=cluster, path=path)
         return value

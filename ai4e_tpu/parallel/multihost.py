@@ -1,0 +1,147 @@
+"""Multi-host serving bridge — request ingestion on host 0, SPMD on all hosts.
+
+SURVEY.md §7 hard part #3: a TPU pod slice spans hosts and every process must
+enter the same ``pjit`` calls in the same order, but only host 0 fronts the
+gateway/broker. The reference has no analogue (its NCCL-equivalent plane was
+HTTPS+queues between single-GPU containers, SURVEY.md §5 "distributed
+communication backend"); this is the genuinely-new data plane.
+
+Design (the jax.distributed idiom):
+
+- every process calls ``init_distributed`` (``parallel.sharding``) so
+  ``jax.devices()`` spans the slice, then builds the same ``Mesh``;
+- the **primary** (process 0) runs the platform stack (gateway, broker,
+  batcher). Its batcher executes through ``MultihostRuntime.run_batch`` which
+  first *broadcasts* a work descriptor (model index + real batch) over DCN
+  (``multihost_utils.broadcast_one_to_all``), then enters the model's
+  compiled call — which every process enters too;
+- **followers** run ``follower_loop()``: block on the same broadcast, enter
+  the same call, loop. A sentinel descriptor shuts them down;
+- outputs come back replicated (inference outputs are small — class ids,
+  boxes, counts), so the primary reads results locally with no gather on the
+  response path.
+
+The broadcast rides XLA's collectives; there is no bespoke socket protocol —
+the "communication backend" is jax.distributed + XLA over ICI/DCN exactly as
+a TPU-native design should be.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+
+log = logging.getLogger("ai4e_tpu.multihost")
+
+_SHUTDOWN = -1
+# Fixed-rank shape header so the control broadcast is always the same shape
+# (broadcast_one_to_all requires identical pytree structure on every host).
+_MAX_RANK = 8
+
+
+def is_primary() -> bool:
+    return jax.process_index() == 0
+
+
+class MultihostRuntime:
+    """Wraps a ``ModelRuntime`` so batch execution is SPMD across hosts.
+
+    Single-host (``jax.process_count() == 1``) it is a transparent
+    pass-through — the batcher uses one code path everywhere.
+    """
+
+    def __init__(self, runtime):
+        self.runtime = runtime
+        # Stable model ordering shared by all hosts: registration order.
+        self._names = list(runtime.models)
+
+    # Pass-throughs so the micro-batcher (and launcher logging) can treat
+    # this exactly like a ModelRuntime.
+    @property
+    def models(self):
+        return self.runtime.models
+
+    @property
+    def mesh(self):
+        return self.runtime.mesh
+
+    def _model_index(self, name: str) -> int:
+        try:
+            return self._names.index(name)
+        except ValueError:
+            self._names = list(self.runtime.models)
+            return self._names.index(name)
+
+    # -- primary side (called by the micro-batcher's executor thread) -------
+
+    def run_batch(self, model_name: str, batch: np.ndarray):
+        if jax.process_count() == 1:
+            return self.runtime.run_batch(model_name, batch)
+        if not is_primary():
+            raise RuntimeError(
+                "run_batch on a follower host — followers run follower_loop()")
+        self._broadcast_descriptor(self._model_index(model_name), batch)
+        _ = self._broadcast_batch(batch)
+        return self.runtime.run_batch(model_name, batch)
+
+    def shutdown_followers(self) -> None:
+        if jax.process_count() > 1 and is_primary():
+            self._broadcast_descriptor(_SHUTDOWN, None)
+
+    # -- follower side -------------------------------------------------------
+
+    def follower_loop(self) -> None:
+        """Run on every non-primary process: mirror the primary's batch
+        executions until the shutdown sentinel arrives."""
+        assert not is_primary(), "primary must not enter follower_loop"
+        while True:
+            model_idx, shape, dtype = self._receive_descriptor()
+            if model_idx == _SHUTDOWN:
+                log.info("follower %d: shutdown", jax.process_index())
+                return
+            batch = self._broadcast_batch(
+                np.zeros(shape, dtype))  # payload comes from the broadcast
+            name = self._names[model_idx]
+            self.runtime.run_batch(name, batch)
+
+    # -- wire (XLA collectives over DCN) ------------------------------------
+
+    def _broadcast_descriptor(self, model_idx: int, batch) -> None:
+        from jax.experimental import multihost_utils
+        header = np.full((2 + _MAX_RANK,), 0, np.int32)
+        header[0] = model_idx
+        if batch is not None:
+            header[1] = _dtype_code(batch.dtype)
+            rank = batch.ndim
+            header[2:2 + rank] = batch.shape
+        multihost_utils.broadcast_one_to_all(header)
+
+    def _receive_descriptor(self):
+        from jax.experimental import multihost_utils
+        header = np.asarray(multihost_utils.broadcast_one_to_all(
+            np.zeros((2 + _MAX_RANK,), np.int32)))
+        model_idx = int(header[0])
+        if model_idx == _SHUTDOWN:
+            return model_idx, None, None
+        shape = tuple(int(d) for d in header[2:] if d > 0)
+        return model_idx, shape, _code_dtype(int(header[1]))
+
+    def _broadcast_batch(self, batch: np.ndarray) -> np.ndarray:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.broadcast_one_to_all(batch))
+
+
+_DTYPES = [np.float32, np.float16, np.uint8, np.int32, np.int8]
+
+
+def _dtype_code(dtype) -> int:
+    for i, d in enumerate(_DTYPES):
+        if np.dtype(dtype) == np.dtype(d):
+            return i
+    raise ValueError(f"unsupported broadcast dtype {dtype}")
+
+
+def _code_dtype(code: int):
+    return np.dtype(_DTYPES[code])

@@ -224,14 +224,17 @@ class LocalPlatform:
                 await self.dispatchers.stop()
             await self.depth_logger.stop()
             self._started = False
-        # Push resources clean up even after a failed start() (e.g. the
-        # subscription handshake raised after the webhook site was bound).
+        for svc in self.services:
+            await svc.drain(timeout=5.0)
+        # Transport teardown AFTER service drain: a draining async task may
+        # still hand off a pipeline stage, which must publish — the queue
+        # broker stays open until here too. (Push cleanup also runs when
+        # start() failed mid-way, e.g. a handshake error after the webhook
+        # site was bound.)
         if self.topic is not None:
             await self.topic.aclose()
         if self._webhook_runner is not None:
             await self._webhook_runner.cleanup()
             self._webhook_runner = None
-        for svc in self.services:
-            await svc.drain(timeout=5.0)
         if self.broker is not None and hasattr(self.broker, "close"):
             self.broker.close()

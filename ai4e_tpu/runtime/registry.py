@@ -50,6 +50,7 @@ class ServableModel:
     input_dtype: Any = np.float32
     version: str = "1.0"
     _compiled: Callable | None = field(default=None, repr=False)
+    _batch_sharding: Any = field(default=None, repr=False)
 
     def bucket_for(self, n: int) -> int:
         for b in self.batch_buckets:
@@ -71,11 +72,19 @@ class ModelRuntime:
     any model-parallel params.
     """
 
-    def __init__(self, mesh: Mesh | None = None, donate_batch: bool = False):
+    def __init__(self, mesh: Mesh | None = None, donate_batch: bool = False,
+                 replicate_outputs: bool | None = None):
         from ..parallel.sharding import make_mesh
         self.mesh = mesh if mesh is not None else make_mesh()
         self.models: dict[str, ServableModel] = {}
         self._donate = donate_batch
+        # Multi-host (mesh spans processes): outputs must come back fully
+        # replicated so every process — in particular the primary serving
+        # results — can read them without a cross-host gather on the response
+        # path (inference outputs are small). Single-host: XLA's choice.
+        if replicate_outputs is None:
+            replicate_outputs = jax.process_count() > 1
+        self._replicate_outputs = replicate_outputs
 
     @property
     def data_axis_size(self) -> int:
@@ -95,10 +104,14 @@ class ModelRuntime:
             for b in servable.batch_buckets}))
         batch_sharding = NamedSharding(
             self.mesh, P(("dp", "fsdp"), *([None] * len(servable.input_shape))))
+        servable._batch_sharding = batch_sharding
 
         servable._compiled = jax.jit(
             servable.apply_fn,
             in_shardings=(None, batch_sharding),
+            # A single sharding as out_shardings applies to every output leaf.
+            out_shardings=(NamedSharding(self.mesh, P())
+                           if self._replicate_outputs else None),
             donate_argnums=(1,) if self._donate else (),
         )
         self.models[servable.name] = servable
@@ -115,8 +128,8 @@ class ModelRuntime:
             for bucket in servable.batch_buckets:
                 dummy = np.zeros((bucket, *servable.input_shape),
                                  servable.input_dtype)
-                out = servable._compiled(servable.params, dummy)
-                jax.block_until_ready(out)
+                # Through run_batch so multi-host input conversion applies.
+                self.run_batch(name, dummy)
             times[name] = time.perf_counter() - t0
             log.info("warmup %s: %d buckets in %.1fs", name,
                      len(servable.batch_buckets), times[name])
@@ -125,6 +138,12 @@ class ModelRuntime:
     def run_batch(self, name: str, batch: np.ndarray):
         """Execute one padded batch; blocking (call from an executor)."""
         servable = self.models[name]
+        if jax.process_count() > 1 and isinstance(batch, np.ndarray):
+            # Every process holds the identical full batch (broadcast by
+            # MultihostRuntime); carve out this process's shards to form the
+            # global device array the multi-host jit requires.
+            batch = jax.make_array_from_process_local_data(
+                servable._batch_sharding, batch, global_shape=batch.shape)
         out = servable._compiled(servable.params, batch)
         return jax.device_get(out)
 

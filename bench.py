@@ -206,6 +206,24 @@ def _device_kind() -> str:
     return f"{d.platform}:{getattr(d, 'device_kind', '?')}x{jax.device_count()}"
 
 
+def probe_accelerator(timeout_s: float) -> bool:
+    """Time-boxed subprocess probe: can the default backend actually compile
+    and run anything? The axon TPU tunnel can enumerate devices yet hang
+    indefinitely in compilation when degraded — a hung bench records nothing,
+    so on probe failure we fall back to CPU and say so in the JSON."""
+    import subprocess
+    code = ("import jax, jax.numpy as jnp;"
+            "x = jnp.ones((128, 128));"
+            "(x @ x).block_until_ready();"
+            "print('PROBE_OK')")
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, timeout=timeout_s)
+        return b"PROBE_OK" in res.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--duration", type=float, default=20.0)
@@ -215,10 +233,16 @@ def main() -> None:
     parser.add_argument("--buckets", type=int, nargs="+", default=[1, 16, 64])
     parser.add_argument("--cpu", action="store_true",
                         help="force CPU (debug runs)")
+    parser.add_argument("--probe-timeout", type=float, default=240.0,
+                        help="seconds before declaring the accelerator dead")
     args = parser.parse_args()
 
     import jax
     if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    elif not probe_accelerator(args.probe_timeout):
+        log(f"accelerator probe failed after {args.probe_timeout}s; "
+            "falling back to CPU (device field will say so)")
         jax.config.update("jax_platforms", "cpu")
     log(f"devices: {jax.devices()}")
 

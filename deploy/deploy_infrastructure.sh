@@ -24,7 +24,7 @@ for target in control-plane worker; do
 done
 
 echo "==> platform charts"
-ENV_SUBST='${REGISTRY} ${IMAGE_TAG} ${QUEUE_RETRY_DELAY_SECONDS} ${MAX_DELIVERY_COUNT} ${TASK_JOURNAL_PATH}'
+ENV_SUBST='${REGISTRY} ${IMAGE_TAG} ${TRANSPORT_TYPE} ${QUEUE_RETRY_DELAY_SECONDS} ${MAX_DELIVERY_COUNT} ${PUSH_TTL_SECONDS} ${PUSH_MAX_ATTEMPTS} ${TASK_JOURNAL_PATH} ${REPORTER_PORT} ${SERVICE_CLUSTER}'
 kubectl create configmap ai4e-routes --from-file=routes.json=specs/routes.json \
     --dry-run=client -o yaml | kubectl apply -f -
 kubectl create configmap ai4e-models --from-file=models.json=specs/models.json \
@@ -34,6 +34,11 @@ kubectl create configmap ai4e-models-cpu --from-file=models.json=specs/models-cp
 for chart in control-plane worker-tpu worker-cpu hpa; do
     envsubst "$ENV_SUBST" < "charts/${chart}.yaml" | kubectl apply -f -
 done
+
+if [ "${DEPLOY_REPORTER:-true}" = true ]; then
+    echo "==> request reporter (deploy_request_reporter_function.sh analogue)"
+    envsubst "$ENV_SUBST" < charts/reporter.yaml | kubectl apply -f -
+fi
 
 if [ "$DEPLOY_ROUTING" = true ]; then
     echo "==> routing (Gateway API)"

@@ -41,6 +41,17 @@ export DEPLOY_ROUTING=true
 
 # -- transport / task-fabric knobs (reference setup_env.sh:65-74) ------------
 # These become AI4E_* env on the control plane.
+# TRANSPORT_TYPE (reference setup_env.sh:11): "queue" = durable per-endpoint
+# queues drained by dispatchers; "push" = topic pushes events to the webhook
+# dispatcher (the Event Grid mode) with TTL/max-attempts delivery policy.
+export TRANSPORT_TYPE="queue"
 export QUEUE_RETRY_DELAY_SECONDS=60
 export MAX_DELIVERY_COUNT=1440
+export PUSH_TTL_SECONDS=300            # deploy_event_grid_subscription.sh:37 (TTL 5 min)
+export PUSH_MAX_ATTEMPTS=3             # same line (3 delivery attempts)
 export TASK_JOURNAL_PATH="/var/lib/ai4e/tasks.jsonl"   # durable task log (PV)
+
+# -- request reporter (reference deploy_request_reporter_function.sh) --------
+export DEPLOY_REPORTER=true
+export REPORTER_PORT=8085
+export SERVICE_CLUSTER="${PREFIX}-tpu"   # dimension on the in-flight counter

@@ -1,0 +1,44 @@
+"""Subprocess body for the multi-host serving test: N jax.distributed
+processes over CPU, primary broadcasts batches, followers mirror
+(``parallel/multihost.py``). Run: multihost_proc.py <proc_id> <nprocs> <port>.
+"""
+
+import os
+import sys
+
+proc_id, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=nprocs,
+                           process_id=proc_id)
+assert jax.process_count() == nprocs, jax.process_count()
+
+import numpy as np  # noqa: E402
+
+from ai4e_tpu.parallel import MeshSpec, make_mesh  # noqa: E402
+from ai4e_tpu.parallel.multihost import MultihostRuntime, is_primary  # noqa: E402
+from ai4e_tpu.runtime import ModelRuntime  # noqa: E402
+from ai4e_tpu.runtime.families import build_echo  # noqa: E402
+
+# Global dp mesh over every device of every process.
+mesh = make_mesh(MeshSpec(dp=jax.device_count()))
+runtime = ModelRuntime(mesh=mesh)
+runtime.register(build_echo(size=4, buckets=(jax.device_count(),)))
+mh = MultihostRuntime(runtime)
+
+if is_primary():
+    n = jax.device_count()
+    batch = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    out = np.asarray(mh.run_batch("echo", batch))
+    np.testing.assert_allclose(out, batch, rtol=1e-6)
+    out2 = np.asarray(mh.run_batch("echo", batch * 3))
+    np.testing.assert_allclose(out2, batch * 3, rtol=1e-6)
+    mh.shutdown_followers()
+    print("PRIMARY_OK", flush=True)
+else:
+    mh.follower_loop()
+    print("FOLLOWER_OK", flush=True)

@@ -1,0 +1,129 @@
+"""Multi-host serving bridge: real jax.distributed processes (CPU backend)
+exercising primary-ingest → broadcast → SPMD execution
+(``parallel/multihost.py``; SURVEY.md §7 hard part #3 — the reference never
+had a multi-node test, §4)."""
+
+import io
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "tests", "helpers", "multihost_proc.py")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestMultihostServing:
+    def test_two_process_broadcast_and_mirror(self):
+        port = free_port()
+        env = dict(os.environ, PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        env.pop("JAX_PLATFORMS", None)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, SCRIPT, str(i), "2", str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+            for i in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=120)
+                outs.append((p.returncode, out.decode(), err.decode()))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for rc, out, err in outs:
+            assert rc == 0, f"proc failed rc={rc}\nstdout={out}\nstderr={err}"
+        assert "PRIMARY_OK" in outs[0][1]
+        assert "FOLLOWER_OK" in outs[1][1]
+
+
+class TestMultihostWorkerCLI:
+    def test_primary_serves_follower_mirrors(self, tmp_path):
+        """Full launcher path: two `python -m ai4e_tpu worker` processes on a
+        shared jax.distributed CPU slice; an HTTP request to the primary runs
+        a broadcast batch on all hosts."""
+        coord_port, wk_port = free_port(), free_port()
+        models = {"service_name": "echo-mh", "prefix": "v1/echo",
+                  "models": [{"family": "echo", "name": "echo", "size": 8,
+                              "buckets": [4], "sync_path": "/echo",
+                              "async_path": "/echo-async"}]}
+        spec = tmp_path / "models.json"
+        spec.write_text(json.dumps(models))
+
+        def env_for(i):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                                + " --xla_force_host_platform_device_count=2").strip()
+            env["AI4E_RUNTIME_PLATFORM"] = "cpu"
+            env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{coord_port}"
+            env["JAX_NUM_PROCESSES"] = "2"
+            env["JAX_PROCESS_ID"] = str(i)
+            return env
+
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "ai4e_tpu", "worker",
+                 "--models", str(spec), "--port", str(wk_port)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                env=env_for(i), cwd=REPO)
+            for i in range(2)
+        ]
+        try:
+            base = f"http://127.0.0.1:{wk_port}"
+            deadline = time.time() + 90
+            up = False
+            while time.time() < deadline:
+                if any(p.poll() is not None for p in procs):
+                    break
+                try:
+                    with urllib.request.urlopen(f"{base}/v1/echo/", timeout=2):
+                        up = True
+                        break
+                except Exception:
+                    time.sleep(0.5)
+            assert up, _drain(procs)
+
+            buf = io.BytesIO()
+            np.save(buf, np.arange(8, dtype=np.float32))
+            req = urllib.request.Request(f"{base}/v1/echo/echo",
+                                         data=buf.getvalue())
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.loads(resp.read())
+            assert out["echo"] == [float(i) for i in range(8)], out
+
+            procs[0].send_signal(signal.SIGTERM)
+            for p in procs:
+                p.wait(timeout=30)
+            assert all(p.returncode == 0 for p in procs), _drain(procs)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
+
+
+def _drain(procs) -> str:
+    notes = []
+    for i, p in enumerate(procs):
+        if p.poll() is None:
+            notes.append(f"proc{i}: still running")
+        else:
+            out = p.stdout.read().decode() if p.stdout else ""
+            notes.append(f"proc{i}: rc={p.returncode}\n{out[-3000:]}")
+    return "\n".join(notes)

@@ -1,0 +1,111 @@
+"""API-registration customizer tests — typed ApiDefinition → gateway routes
+(the reference's api_management_customizer.py:4-44 +
+create_*_api_management_api.sh registration flow as code)."""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.gateway import (
+    ApiDefinition,
+    load_definitions,
+    register_definitions,
+    routes_from_definitions,
+)
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+class TestDefinitionShapes:
+    def test_public_prefix_matches_reference_url_shape(self):
+        # /{version}/{organization}/{api} — the shape AddPipelineTask builds
+        # (distributed_api_task.py:74-75).
+        d = ApiDefinition(organization="camera-trap", api="detection",
+                          backend_host="http://worker:8081")
+        assert d.public_prefix == "/v1/camera-trap/detection"
+        assert d.backend_uri == "http://worker:8081/v1/detection"
+
+    def test_backend_path_override(self):
+        d = ApiDefinition(organization="org", api="seg",
+                          backend_host="http://w:1/",
+                          backend_path="/v1/landcover/classify-async")
+        assert d.backend_uri == "http://w:1/v1/landcover/classify-async"
+
+    def test_routes_rendering(self):
+        defs = [
+            ApiDefinition(organization="o", api="a",
+                          backend_host="http://w:1", concurrency=4,
+                          autoscale={"max_replicas": 8}),
+            ApiDefinition(organization="o", api="b",
+                          backend_host="http://w:1", mode="sync"),
+        ]
+        spec = routes_from_definitions(defs)
+        assert spec["apis"][0] == {
+            "prefix": "/v1/o/a", "backend": "http://w:1/v1/a",
+            "mode": "async", "concurrency": 4,
+            "autoscale": {"max_replicas": 8}}
+        assert spec["apis"][1]["mode"] == "sync"
+
+    def test_load_definitions(self, tmp_path):
+        p = tmp_path / "apis.json"
+        p.write_text(json.dumps({"apis": [
+            {"organization": "o", "api": "a", "backend_host": "http://w:1",
+             "operations": ["classify", "tile"]}]}))
+        defs = load_definitions(str(p))
+        assert defs[0].operations == ("classify", "tile")
+
+
+class TestRegisterOnPlatform:
+    def test_async_definition_served_end_to_end(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            svc = platform.make_service("det", prefix="v1/detection")
+
+            @svc.api_async_func("/detect")
+            def detect(taskId, body, content_type):
+                asyncio.run(platform.task_manager.complete_task(
+                    taskId, "completed - registered"))
+
+            svc_client = await serve(svc.app)
+            register_definitions(platform, [ApiDefinition(
+                organization="camera-trap", api="detection",
+                backend_host=str(svc_client.make_url("")).rstrip("/"),
+                backend_path="/v1/detection/detect")])
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                resp = await gw.post("/v1/camera-trap/detection", data=b"x")
+                tid = (await resp.json())["TaskId"]
+                final = None
+                for _ in range(200):
+                    r = await gw.get(f"/v1/taskmanagement/task/{tid}")
+                    final = await r.json()
+                    if "completed" in final["Status"]:
+                        break
+                    await asyncio.sleep(0.02)
+                assert final["Status"] == "completed - registered"
+            finally:
+                await platform.stop()
+                await gw.close()
+                await svc_client.close()
+
+        run(main())
+
+    def test_definitions_key_in_control_plane_spec(self):
+        from ai4e_tpu.cli import build_control_plane
+        from ai4e_tpu.config import FrameworkConfig
+
+        platform = build_control_plane(FrameworkConfig(), {
+            "definitions": [{"organization": "o", "api": "a",
+                             "backend_host": "http://w:1"}]})
+        assert any(r.prefix == "/v1/o/a" for r in platform.gateway.routes)
