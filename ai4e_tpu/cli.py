@@ -31,7 +31,8 @@ models.json::
                  "buckets": [1, 16, 64],
                  "sync_path": "/classify",
                  "async_path": "/classify-async",
-                 "batch": {"max_items": 512}}],   // optional batch API
+                 "batch": {"max_items": 512},     // optional batch API
+                 "checkpoint": "/ckpts/landcover"}],  // optional weights
      "prefix": "v1/landcover"}
 """
 
@@ -65,15 +66,14 @@ def build_control_plane(config: FrameworkConfig, routes: dict):
     # control-plane port serves the CACHE_CONNECTOR_*_URI endpoints remote
     # workers use (distributed_api_task.py:14-15 pattern).
     make_taskstore_app(platform.store, app=platform.gateway.app)
-    # Typed API definitions ({org, api, backend_host, ...}) render to route
-    # entries via the registration customizer (gateway/registration.py) —
-    # both spec styles can coexist in one routes.json.
-    rendered = []
+    # Typed API definitions ({org, api, backend_host, ...}) publish through
+    # the registration customizer (gateway/registration.py) — one publish
+    # code path; both spec styles can coexist in one routes.json.
     if routes.get("definitions"):
-        from .gateway.registration import ApiDefinition, routes_from_definitions
-        defs = [ApiDefinition.from_dict(r) for r in routes["definitions"]]
-        rendered = routes_from_definitions(defs)["apis"]
-    for api in [*routes.get("apis", []), *rendered]:
+        from .gateway.registration import ApiDefinition, register_definitions
+        register_definitions(platform, [ApiDefinition.from_dict(r)
+                                        for r in routes["definitions"]])
+    for api in routes.get("apis", []):
         mode = api.get("mode", "async")
         if mode == "sync":
             platform.publish_sync_api(api["prefix"], api["backend"])
@@ -141,7 +141,14 @@ def build_worker(config: FrameworkConfig, models: dict):
         async_path = spec.pop("async_path", None)
         cap = spec.pop("maximum_concurrent_requests", 64)
         batch = spec.pop("batch", None)  # true | {serve_batch kwargs}
+        checkpoint = spec.pop("checkpoint", None)
         servable = build_servable(family, **spec)
+        if checkpoint:
+            # Restore real weights at pod start (SURVEY.md §5: the slot the
+            # reference fills by baking weights into container images).
+            from .checkpoint import load_params
+            servable.params = load_params(checkpoint, like=servable.params)
+            log.info("restored %s params from %s", servable.name, checkpoint)
         runtime.register(servable)
         worker.serve_model(servable, sync_path=sync_path,
                            async_path=async_path,

@@ -43,11 +43,27 @@ log = logging.getLogger("ai4e_tpu.reporter")
 
 class ProcessingCounters:
     """Thread-safe counter table — the Redis ``StringIncrement`` role
-    (``CurrentProcessingUpsert.cs:103``)."""
+    (``CurrentProcessingUpsert.cs:103``).
 
-    def __init__(self, metrics: MetricsRegistry | None = None):
-        self._values: dict[tuple[str, str], int] = {}
+    Robustness against the two realistic failure modes of a fire-and-forget
+    delta stream:
+
+    - the raw sum is kept UNclamped so a decrement that overtakes its
+      increment (independent async POSTs can reorder) nets back to zero —
+      clamping the stored value would convert each reorder into permanent
+      +1 drift of the autoscaling signal;
+    - *reads* clamp at zero and treat counters idle for ``stale_after``
+      seconds as zero, so a reporter restart mid-flight (raw sum goes
+      negative forever) or lost decrements (raw sum stuck positive) both
+      decay to a correct quiescent signal instead of permanently skewing
+      the HPA input.
+    """
+
+    def __init__(self, metrics: MetricsRegistry | None = None,
+                 stale_after: float = 600.0):
+        self._values: dict[tuple[str, str], tuple[int, float]] = {}
         self._lock = threading.Lock()
+        self.stale_after = stale_after
         self.metrics = metrics or DEFAULT_REGISTRY
         self._gauge = self.metrics.gauge(
             "ai4e_current_requests",
@@ -55,23 +71,31 @@ class ProcessingCounters:
 
     def adjust(self, cluster: str, path: str,
                increment: int = 0, decrement: int = 0) -> int:
+        import time
         delta = increment - decrement
+        now = time.monotonic()
         with self._lock:
-            # Floor at zero: after a reporter restart, in-flight requests'
-            # decrements would otherwise drive the load signal permanently
-            # negative — a transient undercount is the bounded failure mode.
-            value = max(0, self._values.get((cluster, path), 0) + delta)
-            self._values[(cluster, path)] = value
+            raw, ts = self._values.get((cluster, path), (0, now))
+            if now - ts > self.stale_after:
+                raw = 0  # stale residue (lost deltas / restart skew)
+            raw += delta
+            self._values[(cluster, path)] = (raw, now)
+        value = max(0, raw)
         self._gauge.set(value, cluster=cluster, path=path)
         return value
 
     def value(self, cluster: str, path: str) -> int:
+        import time
         with self._lock:
-            return self._values.get((cluster, path), 0)
+            raw, ts = self._values.get((cluster, path), (0, time.monotonic()))
+        if time.monotonic() - ts > self.stale_after:
+            return 0
+        return max(0, raw)
 
     def snapshot(self) -> dict[tuple[str, str], int]:
         with self._lock:
-            return dict(self._values)
+            keys = list(self._values)
+        return {k: self.value(*k) for k in keys}
 
 
 class RequestReporterService:

@@ -56,6 +56,11 @@ class MultihostRuntime:
         self.runtime = runtime
         # Stable model ordering shared by all hosts: registration order.
         self._names = list(runtime.models)
+        # The batcher may pipeline two batches on separate executor threads;
+        # followers replay broadcasts strictly in order, so the primary's
+        # descriptor+batch+execute sequence must be serialised.
+        import threading
+        self._order_lock = threading.Lock()
 
     # Pass-throughs so the micro-batcher (and launcher logging) can treat
     # this exactly like a ModelRuntime.
@@ -68,11 +73,15 @@ class MultihostRuntime:
         return self.runtime.mesh
 
     def _model_index(self, name: str) -> int:
+        # No refresh-on-miss: followers' name tables are frozen at
+        # construction, so a model registered after the wrap could never be
+        # resolved consistently across hosts — fail fast on the primary.
         try:
             return self._names.index(name)
         except ValueError:
-            self._names = list(self.runtime.models)
-            return self._names.index(name)
+            raise KeyError(
+                f"model {name!r} registered after MultihostRuntime was "
+                "built; register every model before wrapping") from None
 
     # -- primary side (called by the micro-batcher's executor thread) -------
 
@@ -82,13 +91,15 @@ class MultihostRuntime:
         if not is_primary():
             raise RuntimeError(
                 "run_batch on a follower host — followers run follower_loop()")
-        self._broadcast_descriptor(self._model_index(model_name), batch)
-        _ = self._broadcast_batch(batch)
-        return self.runtime.run_batch(model_name, batch)
+        with self._order_lock:
+            self._broadcast_descriptor(self._model_index(model_name), batch)
+            _ = self._broadcast_batch(batch)
+            return self.runtime.run_batch(model_name, batch)
 
     def shutdown_followers(self) -> None:
         if jax.process_count() > 1 and is_primary():
-            self._broadcast_descriptor(_SHUTDOWN, None)
+            with self._order_lock:
+                self._broadcast_descriptor(_SHUTDOWN, None)
 
     # -- follower side -------------------------------------------------------
 
@@ -104,7 +115,15 @@ class MultihostRuntime:
             batch = self._broadcast_batch(
                 np.zeros(shape, dtype))  # payload comes from the broadcast
             name = self._names[model_idx]
-            self.runtime.run_batch(name, batch)
+            try:
+                self.runtime.run_batch(name, batch)
+            except Exception:  # noqa: BLE001 — mirror the primary's policy
+                # The primary catches the same device failure and keeps
+                # serving (MicroBatcher._execute); a follower that died here
+                # would leave the next broadcast waiting on a missing
+                # participant and hang the whole slice.
+                log.exception("follower %d: batch for %s failed; continuing",
+                              jax.process_index(), name)
 
     # -- wire (XLA collectives over DCN) ------------------------------------
 

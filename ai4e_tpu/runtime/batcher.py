@@ -66,10 +66,14 @@ class MicroBatcher:
         self._wakeup: asyncio.Event = asyncio.Event()
         self._stop = False
         self._flusher: asyncio.Task | None = None
-        # One device-feeding thread: TPU programs serialise anyway; a single
-        # thread keeps dispatch order deterministic and the loop unblocked.
-        self._executor = ThreadPoolExecutor(max_workers=1,
+        # Two device-feeding threads + a 2-slot window: the device still
+        # serialises compute, but batch N+1's host work (padding, dispatch,
+        # result transfer) overlaps batch N's device time instead of waiting
+        # on its device_get — classic double buffering.
+        self._executor = ThreadPoolExecutor(max_workers=2,
                                             thread_name_prefix="tpu-batcher")
+        self._window = asyncio.Semaphore(2)
+        self._inflight_execs: set[asyncio.Task] = set()
         self._batch_size_hist = self.metrics.histogram(
             "ai4e_batch_size", "Executed batch sizes",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, float("inf")))
@@ -115,6 +119,9 @@ class MicroBatcher:
         self._wakeup.set()
         if self._flusher is not None:
             await self._flusher
+        if self._inflight_execs:
+            await asyncio.gather(*self._inflight_execs,
+                                 return_exceptions=True)
         self._executor.shutdown(wait=True)
 
     # -- flusher -----------------------------------------------------------
@@ -138,7 +145,18 @@ class MicroBatcher:
             for model_name in list(self._pending):
                 batch = self._take_batch(model_name)
                 if batch:
-                    await self._execute(loop, model_name, batch)
+                    # Bounded pipelining: admit the batch into the 2-slot
+                    # window and keep draining — don't wait for its results.
+                    await self._window.acquire()
+                    task = loop.create_task(
+                        self._execute(loop, model_name, batch))
+                    self._inflight_execs.add(task)
+
+                    def _done(t: asyncio.Task) -> None:
+                        self._inflight_execs.discard(t)
+                        self._window.release()
+
+                    task.add_done_callback(_done)
 
     def _max_queue_len(self) -> int:
         return max((len(v) for v in self._pending.values()), default=0)

@@ -38,6 +38,24 @@ class InferenceWorker:
         self.service = APIService(name, prefix=prefix,
                                   task_manager=task_manager, metrics=metrics,
                                   reporter=reporter)
+        self._served: dict[str, dict] = {}  # model -> endpoint listing
+        self.service.app.router.add_get(self.service.prefix + "/models",
+                                        self._list_models)
+
+    async def _list_models(self, _request):
+        """Model-registry introspection — what the reference delegates to its
+        container registry + values files, queryable live here."""
+        from aiohttp import web
+        out = []
+        for name, s in self.runtime.models.items():
+            out.append({
+                "name": name, "version": s.version,
+                "input_shape": list(s.input_shape),
+                "input_dtype": str(np.dtype(s.input_dtype)),
+                "batch_buckets": list(s.batch_buckets),
+                "endpoints": self._served.get(name, {}),
+            })
+        return web.json_response({"models": out})
 
     def serve_model(self, servable: ServableModel,
                     sync_path: str | None = None,
@@ -57,6 +75,9 @@ class InferenceWorker:
         name = servable.name
         sync_path = sync_path or f"/{name}"
         async_path = async_path or f"/{name}-async"
+        self._served.setdefault(name, {}).update({
+            "sync": self.service.prefix + sync_path,
+            "async": self.service.prefix + async_path})
 
         def _saturation_check():
             # Admission-time backpressure: refuse BEFORE adopting a task so
@@ -150,6 +171,9 @@ class InferenceWorker:
         name = servable.name
         sync_path = sync_path or f"/{name}-batch"
         async_path = async_path or f"/{name}-batch-async"
+        self._served.setdefault(name, {}).update(
+            batch_sync=self.service.prefix + sync_path,
+            batch_async=self.service.prefix + async_path)
         item_shape = tuple(servable.input_shape)
 
         def _decode_stack(body: bytes) -> np.ndarray:

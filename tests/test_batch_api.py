@@ -181,3 +181,48 @@ class TestBatchAsync:
                 await svc_client.close()
 
         run(main())
+
+
+class TestPipelinedExecution:
+    def test_many_concurrent_submits_all_resolve_correctly(self):
+        """Double-buffered batcher (2-slot window): results still fan out to
+        the right futures under sustained concurrent load."""
+        async def main():
+            platform = LocalPlatform()
+            worker, batcher = build_worker(platform)
+            await batcher.start()
+            try:
+                gate = asyncio.Semaphore(24)  # stay under max_pending=32
+
+                async def one(i):
+                    x = np.full((SIZE,), float(i % 7), np.float32)
+                    async with gate:
+                        out = await batcher.submit("square", x)
+                    expect = float((x ** 2).sum())
+                    assert abs(out["sum_sq"] - expect) < 1e-3, (i, out)
+
+                await asyncio.gather(*(one(i) for i in range(120)))
+            finally:
+                await batcher.stop()
+
+        run(main())
+
+
+class TestModelListing:
+    def test_models_endpoint_lists_registry(self):
+        async def main():
+            platform = LocalPlatform()
+            worker, batcher = build_worker(platform)
+            client = await serve(worker.service.app)
+            try:
+                resp = await client.get("/v1/square/models")
+                assert resp.status == 200
+                listing = (await resp.json())["models"]
+                assert listing[0]["name"] == "square"
+                assert listing[0]["batch_buckets"]
+                eps = listing[0]["endpoints"]
+                assert eps["batch_sync"] == "/v1/square/square-batch"
+            finally:
+                await client.close()
+
+        run(main())

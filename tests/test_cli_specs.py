@@ -56,3 +56,28 @@ class TestDeploySpecs:
                              servable.input_dtype)
             out = servable.apply_fn(servable.params, batch)
             assert out is not None, family
+
+
+class TestCheckpointLoading:
+    def test_worker_spec_restores_checkpoint_weights(self, tmp_path):
+        """A model spec's "checkpoint" restores saved params at worker build
+        (SURVEY.md §5 serving-checkpoint slot): the echo servable's scale
+        comes from the checkpoint, not the family default."""
+        from ai4e_tpu.checkpoint import save_params
+        from ai4e_tpu.cli import build_worker
+
+        ckpt = str(tmp_path / "echo-ckpt")
+        save_params(ckpt, {"scale": np.float32(3.0)})
+
+        config = FrameworkConfig()
+        worker, batcher, _tm = build_worker(config, {
+            "service_name": "w", "prefix": "v1/echo",
+            "models": [{"family": "echo", "name": "echo", "size": 4,
+                        "buckets": [2], "checkpoint": ckpt}]})
+        servable = worker.runtime.models["echo"]
+        assert float(np.asarray(servable.params["scale"])) == 3.0
+        bucket = servable.bucket_for(2)  # buckets round up to mesh multiples
+        out = worker.runtime.run_batch(
+            "echo", np.ones((bucket, 4), np.float32))
+        np.testing.assert_allclose(np.asarray(out),
+                                   3.0 * np.ones((bucket, 4)))
