@@ -87,6 +87,34 @@ def build_control_plane(config: FrameworkConfig, routes: dict):
     return platform
 
 
+def _declarative_handoff(spec: dict | None):
+    """Translate a model spec's ``pipeline_to`` into a handoff callable —
+    composite APIs as deployment data (the reference composes ensembles in
+    code via AddPipelineTask, ``distributed_api_task.py:67-100``).
+
+    ``{"endpoint": "/v1/models/classify-async",   # next stage's backend route
+       "when_nonempty": "detections"}             # optional gate on the result
+
+    An empty handoff body makes the store replay the task's ORIGINAL payload
+    to the next stage (``CacheConnectorUpsert.cs:144-176`` semantics), so a
+    detector can gate a classifier on the same image. When the gate field is
+    empty/absent the stage completes the task itself.
+    """
+    if not spec:
+        return None
+    endpoint = spec["endpoint"]
+    gate = spec.get("when_nonempty")
+
+    def pipeline_to(result):
+        if gate is not None:
+            value = result.get(gate) if isinstance(result, dict) else None
+            if not value:
+                return None  # nothing to hand off — stage completes the task
+        return endpoint, b""  # empty body → original-body replay downstream
+
+    return pipeline_to
+
+
 def build_worker(config: FrameworkConfig, models: dict):
     """Assemble a worker process; returns (worker, batcher, task_manager)."""
     from .runtime import (
@@ -142,6 +170,7 @@ def build_worker(config: FrameworkConfig, models: dict):
         cap = spec.pop("maximum_concurrent_requests", 64)
         batch = spec.pop("batch", None)  # true | {serve_batch kwargs}
         checkpoint = spec.pop("checkpoint", None)
+        pipeline_spec = spec.pop("pipeline_to", None)
         servable = build_servable(family, **spec)
         if checkpoint:
             # Restore real weights at pod start (SURVEY.md §5: the slot the
@@ -152,7 +181,8 @@ def build_worker(config: FrameworkConfig, models: dict):
         runtime.register(servable)
         worker.serve_model(servable, sync_path=sync_path,
                            async_path=async_path,
-                           maximum_concurrent_requests=cap)
+                           maximum_concurrent_requests=cap,
+                           pipeline_to=_declarative_handoff(pipeline_spec))
         if batch:
             worker.serve_batch(servable,
                                **(batch if isinstance(batch, dict) else {}))

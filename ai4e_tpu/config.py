@@ -126,6 +126,11 @@ class PlatformSection:
     native_broker: bool = False
     push_ttl_seconds: float = 300.0  # event TTL 5 min (deploy_event_grid_subscription.sh:37)
     push_max_attempts: int = 3       # max delivery attempts (same line)
+    # Stuck-task watchdog (taskstore/reaper.py): rescue tasks stuck in
+    # "running" after a worker died post-adoption. None disables.
+    reaper_running_timeout: typing.Optional[float] = None
+    reaper_interval: float = 30.0
+    reaper_max_requeues: int = 3
 
     def to_platform_config(self):
         from .platform_assembly import PlatformConfig
@@ -139,6 +144,9 @@ class PlatformSection:
             native_broker=self.native_broker,
             push_ttl_seconds=self.push_ttl_seconds,
             push_max_attempts=self.push_max_attempts,
+            reaper_running_timeout=self.reaper_running_timeout,
+            reaper_interval=self.reaper_interval,
+            reaper_max_requeues=self.reaper_max_requeues,
         )
 
 

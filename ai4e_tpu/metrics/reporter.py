@@ -31,6 +31,7 @@ import asyncio
 import json
 import logging
 import threading
+import time
 
 import aiohttp
 from aiohttp import web
@@ -71,7 +72,6 @@ class ProcessingCounters:
 
     def adjust(self, cluster: str, path: str,
                increment: int = 0, decrement: int = 0) -> int:
-        import time
         delta = increment - decrement
         now = time.monotonic()
         with self._lock:
@@ -85,7 +85,6 @@ class ProcessingCounters:
         return value
 
     def value(self, cluster: str, path: str) -> int:
-        import time
         with self._lock:
             raw, ts = self._values.get((cluster, path), (0, time.monotonic()))
         if time.monotonic() - ts > self.stale_after:

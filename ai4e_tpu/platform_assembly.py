@@ -33,6 +33,10 @@ class PlatformConfig:
     # push-transport delivery policy (deploy_event_grid_subscription.sh:37)
     push_ttl_seconds: float = 300.0
     push_max_attempts: int = 3
+    # stuck-task watchdog (taskstore/reaper.py); None disables
+    reaper_running_timeout: float | None = None
+    reaper_interval: float = 30.0
+    reaper_max_requeues: int = 3
 
 
 class LocalPlatform:
@@ -92,6 +96,15 @@ class LocalPlatform:
                 f"unknown transport {self.config.transport!r}; "
                 "expected 'queue' or 'push'")
         self.gateway = Gateway(self.store, metrics=self.metrics)
+        self.reaper = None
+        if self.config.reaper_running_timeout is not None:
+            from .taskstore.reaper import TaskReaper
+            self.reaper = TaskReaper(
+                self.store, self.task_manager,
+                running_timeout=self.config.reaper_running_timeout,
+                interval=self.config.reaper_interval,
+                max_requeues=self.config.reaper_max_requeues,
+                metrics=self.metrics)
         from .observability import DepthLogger
         self.depth_logger = DepthLogger(
             self.store, metrics=self.metrics,
@@ -162,6 +175,8 @@ class LocalPlatform:
             self.broker.set_dead_letter_handler(on_dead_letter)
             await self.dispatchers.start()
         await self.depth_logger.start()
+        if self.reaper is not None:
+            await self.reaper.start()
         for scaler in self.autoscalers:
             await scaler.start()
         self._reseed_unfinished()
@@ -222,6 +237,8 @@ class LocalPlatform:
                 await scaler.stop()
             if self.dispatchers is not None:
                 await self.dispatchers.stop()
+            if self.reaper is not None:
+                await self.reaper.stop()
             await self.depth_logger.stop()
             self._started = False
         for svc in self.services:

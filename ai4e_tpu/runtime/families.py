@@ -33,6 +33,38 @@ def _npy_preprocess(shape: tuple, dtype=np.float32):
     return preprocess
 
 
+def _image_preprocess(shape: tuple, dtype=np.float32):
+    """Payload decoder for (H, W, 3) models: ``image/*`` content types are
+    decoded + resized with PIL (the reference's camera-trap APIs take camera
+    JPEGs, e.g. ``APIs/Charts/camera-trap/detection-async``); anything else
+    is treated as a raw npy array of the exact input shape. A broken image
+    raises ValueError → fails that one task, never a batch."""
+    h, w, _ = shape
+
+    def preprocess(body: bytes, content_type: str):
+        if content_type and content_type.startswith("image/"):
+            try:
+                from PIL import Image
+            except ImportError as exc:  # pragma: no cover - PIL is baked in
+                raise ValueError("image payloads need Pillow") from exc
+            try:
+                img = Image.open(io.BytesIO(body))
+                img = img.convert("RGB").resize((w, h), Image.BILINEAR)
+            except Exception as exc:  # noqa: BLE001 — bad image fails one task
+                raise ValueError(f"undecodable image: {exc}") from exc
+            arr = np.asarray(img, np.uint8)
+            if np.dtype(dtype) == np.uint8:
+                return arr
+            # Float models get [0, 1] — the conventional image scaling.
+            return arr.astype(np.float32) / 255.0
+        arr = np.load(io.BytesIO(body))
+        if arr.shape != shape:
+            raise ValueError(f"expected {shape}, got {arr.shape}")
+        return arr.astype(dtype)
+
+    return preprocess
+
+
 def build_echo(name: str = "echo", size: int = 16, buckets=(8,),
                **_) -> ServableModel:
     """Identity model — the reference's base-py echo API
@@ -71,7 +103,7 @@ def build_unet(name: str = "landcover", tile: int = 256,
                     {int(c): int(n) for c, n in enumerate(counts) if n}}
 
         input_dtype = np.uint8
-        preprocess = _npy_preprocess((tile, tile, 3), np.uint8)
+        preprocess = _image_preprocess((tile, tile, 3), np.uint8)
     else:
         from ..models import segment_logits_to_classes
 
@@ -85,7 +117,7 @@ def build_unet(name: str = "landcover", tile: int = 256,
                     {int(v): int(c) for v, c in zip(values, counts)}}
 
         input_dtype = np.float32
-        preprocess = _npy_preprocess((tile, tile, 3))
+        preprocess = _image_preprocess((tile, tile, 3))
 
     return ServableModel(
         name=name, apply_fn=apply_fn, params=params,
@@ -119,7 +151,7 @@ def build_resnet(name: str = "classifier", image_size: int = 224,
     return ServableModel(
         name=name, apply_fn=model.apply, params=variables,
         input_shape=(image_size, image_size, 3),
-        preprocess=_npy_preprocess((image_size, image_size, 3)),
+        preprocess=_image_preprocess((image_size, image_size, 3)),
         postprocess=postprocess, batch_buckets=tuple(buckets))
 
 
@@ -150,7 +182,7 @@ def build_detector(name: str = "megadetector", image_size: int = 512,
     return ServableModel(
         name=name, apply_fn=apply_fn, params=params,
         input_shape=(image_size, image_size, 3),
-        preprocess=_npy_preprocess((image_size, image_size, 3)),
+        preprocess=_image_preprocess((image_size, image_size, 3)),
         postprocess=postprocess, batch_buckets=tuple(buckets))
 
 
@@ -171,7 +203,7 @@ def build_vit(name: str = "vit", image_size: int = 224, patch: int = 16,
     return ServableModel(
         name=name, apply_fn=model.apply, params=params,
         input_shape=(image_size, image_size, 3),
-        preprocess=_npy_preprocess((image_size, image_size, 3)),
+        preprocess=_image_preprocess((image_size, image_size, 3)),
         postprocess=postprocess, batch_buckets=tuple(buckets))
 
 

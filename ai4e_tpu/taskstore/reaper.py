@@ -1,0 +1,118 @@
+"""Task reaper — failure detection for stuck tasks.
+
+SURVEY.md §5 (failure detection): the reference's recovery story ends at the
+broker — a message not yet acknowledged is redelivered
+(``BackendQueueProcessor/host.json:7`` autoComplete:false), but a task whose
+worker crashed AFTER adopting it (200 to the dispatcher, then the pod died
+mid-inference) sits in ``running`` forever; nothing in the reference watches
+for that. The journal keeps the task's original body durable
+(``CacheConnectorUpsert.cs:158`` equivalent), so recovery is possible — this
+component adds the missing detector.
+
+``TaskReaper`` periodically scans the store's non-terminal tasks:
+
+- a task in ``running`` longer than ``running_timeout`` is *orphaned*: the
+  reaper republishes it (empty body → the store replays the original body,
+  the transport redelivers to a healthy replica) under the same TaskId — the
+  resume-by-TaskId behavior SURVEY.md §5 describes, now automatic;
+- after ``max_requeues`` rescues the task is failed instead — a task that
+  keeps killing workers must reach a terminal state, not cycle forever (the
+  broker's max-delivery-count plays this role one layer down);
+- tasks in ``created``/``awaiting`` are the transport's responsibility
+  (lease expiry / redelivery) and are left alone.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from .store import InMemoryTaskStore
+from .task import TaskStatus
+
+log = logging.getLogger("ai4e_tpu.reaper")
+
+
+class TaskReaper:
+    def __init__(self, store: InMemoryTaskStore, task_manager,
+                 running_timeout: float = 600.0,
+                 interval: float = 30.0,
+                 max_requeues: int = 3,
+                 metrics: MetricsRegistry | None = None):
+        self.store = store
+        self.task_manager = task_manager
+        self.running_timeout = running_timeout
+        self.interval = interval
+        self.max_requeues = max_requeues
+        self.metrics = metrics or DEFAULT_REGISTRY
+        self._reaped = self.metrics.counter(
+            "ai4e_reaper_actions_total", "Stuck-task rescues by outcome")
+        self._requeues: dict[str, int] = {}
+        self._task: asyncio.Task | None = None
+        self._stop = asyncio.Event()
+
+    async def start(self) -> None:
+        self._stop.clear()
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._task is not None:
+            await self._task
+            self._task = None
+
+    async def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await asyncio.wait_for(self._stop.wait(), timeout=self.interval)
+                return
+            except asyncio.TimeoutError:
+                pass
+            try:
+                await self.sweep()
+            except Exception:  # noqa: BLE001 — the watchdog must not die
+                log.exception("reaper sweep failed")
+
+    async def sweep(self) -> int:
+        """One scan; returns the number of tasks acted on."""
+        now = time.time()
+        acted = 0
+        for task in self.store.snapshot():
+            if task.canonical_status != TaskStatus.RUNNING:
+                if task.canonical_status in TaskStatus.TERMINAL:
+                    self._requeues.pop(task.task_id, None)
+                continue
+            age = now - task.timestamp
+            if age < self.running_timeout:
+                continue
+            count = self._requeues.get(task.task_id, 0)
+            # Conditional transitions: the task may have completed between
+            # the snapshot and this action — a terminal task must never be
+            # resurrected or overwritten (store.requeue_if/update_status_if
+            # re-check atomically under the store lock).
+            if count >= self.max_requeues:
+                done = self.store.update_status_if(
+                    task.task_id, TaskStatus.RUNNING,
+                    f"failed - no progress after {count} rescues",
+                    backend_status=TaskStatus.FAILED)
+                if done is None:
+                    continue
+                log.warning("task %s stuck running after %d rescues; failed",
+                            task.task_id, count)
+                self._reaped.inc(outcome="failed")
+            else:
+                # Empty body → original-body replay; same endpoint; the
+                # transport redelivers to any healthy replica.
+                requeued = self.store.requeue_if(task.task_id,
+                                                 TaskStatus.RUNNING)
+                if requeued is None:
+                    continue
+                log.warning("task %s running %.0fs with no progress; "
+                            "republished (rescue %d/%d)", task.task_id, age,
+                            count + 1, self.max_requeues)
+                self._requeues[task.task_id] = count + 1
+                self._reaped.inc(outcome="requeued")
+            acted += 1
+        return acted

@@ -52,7 +52,12 @@ class InMemoryTaskStore:
     def __init__(self, publisher: Publisher | None = None):
         self._lock = threading.RLock()
         self._tasks: dict[str, APITask] = {}
-        self._orig_bodies: dict[str, bytes] = {}
+        # task_id -> (body, content_type): the replay record. Content type
+        # rides along because republishes (pipeline handoff, saturation
+        # requeue, reaper rescue) must redeliver the original payload with
+        # its original type — a JPEG replayed as application/json would be
+        # undecodable downstream.
+        self._orig_bodies: dict[str, tuple[bytes, str]] = {}
         self._results: dict[str, tuple[bytes, str]] = {}
         # (endpoint_path, canonical_status) -> {task_id: score}; insertion
         # ordered + scored like the reference's Redis sorted sets.
@@ -98,16 +103,20 @@ class InMemoryTaskStore:
             publisher = self._publisher if task.publish else None
 
         self._notify(task)
-        if publisher is not None:
-            try:
-                publisher(task)
-            except Exception as exc:  # noqa: BLE001 — any publish failure fails the task
-                self.update_status(
-                    task.task_id,
-                    f"failed - could not publish task: {exc}",
-                    backend_status=TaskStatus.FAILED,
-                )
+        self._publish_after(task, publisher)
         return task
+
+    def _publish_after(self, task: APITask, publisher: Publisher | None) -> None:
+        if publisher is None:
+            return
+        try:
+            publisher(task)
+        except Exception as exc:  # noqa: BLE001 — any publish failure fails the task
+            self.update_status(
+                task.task_id,
+                f"failed - could not publish task: {exc}",
+                backend_status=TaskStatus.FAILED,
+            )
 
     def _apply_upsert(self, task: APITask) -> APITask:
         """State mutation for upsert. Caller holds ``self._lock``; subclasses
@@ -117,18 +126,19 @@ class InMemoryTaskStore:
             if not task.task_id:
                 task.task_id = new_task_id()
             if task.body:
-                self._orig_bodies[task.task_id] = task.body
+                self._orig_bodies[task.task_id] = (task.body, task.content_type)
         else:
             if not task.body and task.publish:
-                # Subsequent pipeline call: replay the original body
-                # (CacheConnectorUpsert.cs:144-176).
-                task.body = self._orig_bodies.get(task.task_id, b"")
+                # Subsequent pipeline call: replay the original body + its
+                # content type (CacheConnectorUpsert.cs:144-176).
+                task.body, task.content_type = self._orig_bodies.get(
+                    task.task_id, (b"", task.content_type))
             elif task.body and task.publish:
                 # Pipeline handoff with a fresh payload (e.g. detector crops
                 # for the classifier): that payload is now the task's replay
                 # body — a later empty-body requeue of the new stage must get
                 # the stage's own input, not stage 1's.
-                self._orig_bodies[task.task_id] = task.body
+                self._orig_bodies[task.task_id] = (task.body, task.content_type)
             self._remove_from_set(prev)
         task.timestamp = time.time()
         self._tasks[task.task_id] = task
@@ -160,6 +170,39 @@ class InMemoryTaskStore:
         self._add_to_set(task)
         return task
 
+    # -- atomic conditional transitions (the reaper's rescue path: a sweep
+    # decision taken from a snapshot must not clobber a task that reached a
+    # terminal state in the meantime) ---------------------------------------
+
+    def requeue_if(self, task_id: str, expected_status: str) -> APITask | None:
+        """Republish the task (empty body → original replay) iff its
+        canonical status is still ``expected_status``; None otherwise."""
+        with self._lock:
+            current = self._tasks.get(task_id)
+            if current is None or current.canonical_status != expected_status:
+                return None
+            task = self._apply_upsert(APITask(
+                task_id=task_id, endpoint=current.endpoint, body=b"",
+                status=TaskStatus.CREATED, backend_status=TaskStatus.CREATED,
+                content_type=current.content_type, publish=True))
+            publisher = self._publisher if task.publish else None
+        self._notify(task)
+        self._publish_after(task, publisher)
+        return task
+
+    def update_status_if(self, task_id: str, expected_status: str,
+                         status: str,
+                         backend_status: str | None = None) -> APITask | None:
+        """Status transition iff the canonical status is still
+        ``expected_status``; None otherwise."""
+        with self._lock:
+            current = self._tasks.get(task_id)
+            if current is None or current.canonical_status != expected_status:
+                return None
+            task = self._apply_update(task_id, status, backend_status)
+        self._notify(task)
+        return task
+
     def get(self, task_id: str) -> APITask:
         with self._lock:
             task = self._tasks.get(task_id)
@@ -169,7 +212,7 @@ class InMemoryTaskStore:
 
     def get_original_body(self, task_id: str) -> bytes:
         with self._lock:
-            return self._orig_bodies.get(task_id, b"")
+            return self._orig_bodies.get(task_id, (b"", ""))[0]
 
     # -- results (the reference delegates results to external blob storage;
     # here they're first-class, keyed like {taskId}_RESULT) -----------------
@@ -244,7 +287,9 @@ class InMemoryTaskStore:
                 if task.canonical_status in TaskStatus.TERMINAL:
                     continue
                 if not task.body:
-                    task = replace(task, body=self._orig_bodies.get(task.task_id, b""))
+                    body, ctype = self._orig_bodies.get(
+                        task.task_id, (b"", task.content_type))
+                    task = replace(task, body=body, content_type=ctype)
                 out.append(task)
             return out
 
@@ -284,7 +329,9 @@ class JournaledTaskStore(InMemoryTaskStore):
                 super().upsert(task)
                 orig = rec.get("OrigHex")
                 if orig:
-                    self._orig_bodies[task.task_id] = bytes.fromhex(orig)
+                    self._orig_bodies[task.task_id] = (
+                        bytes.fromhex(orig),
+                        rec.get("OrigContentType", "application/json"))
 
     def _log(self, task: APITask) -> None:
         # Called with self._lock held (from _apply_*): journal order is
@@ -295,7 +342,8 @@ class JournaledTaskStore(InMemoryTaskStore):
         rec["BodyHex"] = task.body.hex()
         orig = self._orig_bodies.get(task.task_id)
         if orig is not None:
-            rec["OrigHex"] = orig.hex()
+            rec["OrigHex"] = orig[0].hex()
+            rec["OrigContentType"] = orig[1]
         self._journal.write(json.dumps(rec) + "\n")
         self._journal.flush()
 

@@ -81,3 +81,83 @@ class TestCheckpointLoading:
             "echo", np.ones((bucket, 4), np.float32))
         np.testing.assert_allclose(np.asarray(out),
                                    3.0 * np.ones((bucket, 4)))
+
+
+class TestDeclarativePipeline:
+    def test_handoff_gating(self):
+        from ai4e_tpu.cli import _declarative_handoff
+
+        assert _declarative_handoff(None) is None
+        h = _declarative_handoff({"endpoint": "/v1/next",
+                                  "when_nonempty": "detections"})
+        assert h({"detections": []}) is None
+        assert h({"detections": [1]}) == ("/v1/next", b"")
+        ungated = _declarative_handoff({"endpoint": "/v1/next"})
+        assert ungated({"anything": 0}) == ("/v1/next", b"")
+
+    def test_spec_driven_two_stage_pipeline_e2e(self):
+        """models.json "pipeline_to" composes two servables of one worker
+        into a composite API: stage 1 hands off under the same TaskId and
+        stage 2 receives the ORIGINAL body via store replay."""
+        import asyncio
+        import io
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from ai4e_tpu.cli import build_worker as cli_build_worker
+        from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            config = FrameworkConfig()
+            worker, batcher, _tm = cli_build_worker(config, {
+                "service_name": "combo", "prefix": "v1/combo",
+                "models": [
+                    {"family": "echo", "name": "stage1", "size": 4,
+                     "buckets": [2], "async_path": "/stage1-async",
+                     "pipeline_to": {"endpoint": "/v1/combo/stage2-async",
+                                     "when_nonempty": "echo"}},
+                    {"family": "echo", "name": "stage2", "size": 4,
+                     "buckets": [2], "async_path": "/stage2-async"},
+                ]})
+            # Worker stands alone (own store); wire the platform's store in.
+            worker.service.task_manager = platform.task_manager
+            worker.store = platform.store
+            await batcher.start()
+            svc_client = await serve_app(worker.service.app)
+            base = str(svc_client.make_url("")).rstrip("/")
+            platform.publish_async_api(
+                "/v1/public/combo", base + "/v1/combo/stage1-async")
+            platform.dispatchers.register(
+                "/v1/combo/stage2-async", base + "/v1/combo/stage2-async")
+            gw = await serve_app(platform.gateway.app)
+            await platform.start()
+            try:
+                buf = io.BytesIO()
+                np.save(buf, np.ones(4, np.float32))
+                resp = await gw.post("/v1/public/combo", data=buf.getvalue())
+                tid = (await resp.json())["TaskId"]
+                final = None
+                for _ in range(400):
+                    r = await gw.get(f"/v1/taskmanagement/task/{tid}")
+                    final = await r.json()
+                    if ("completed" in final["Status"]
+                            or "failed" in final["Status"]):
+                        break
+                    await asyncio.sleep(0.02)
+                assert "completed" in final["Status"], final
+                # Stage-1's intermediate output is retrievable by stage name.
+                staged = platform.store.get_result(tid, stage="stage1")
+                assert staged is not None
+            finally:
+                await platform.stop()
+                await batcher.stop()
+                await gw.close()
+                await svc_client.close()
+
+        async def serve_app(app):
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            return client
+
+        asyncio.run(main())

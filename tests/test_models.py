@@ -89,3 +89,54 @@ class TestDetector:
         dets = decode_detections(outputs, max_detections=2)
         assert float(dets["scores"][0, 0]) > 0.99
         assert float(dets["scores"][0, 1]) < 0.01  # masked to ~0
+
+
+class TestImagePayloads:
+    def test_jpeg_payload_decodes_and_infers(self):
+        """image/* content types decode via PIL and resize to the model's
+        input shape — the reference's camera-trap APIs accept camera JPEGs."""
+        import io as _io
+
+        import numpy as _np
+        from PIL import Image
+
+        from ai4e_tpu.runtime.families import _image_preprocess
+
+        img = Image.fromarray(
+            _np.random.default_rng(0).integers(
+                0, 255, (300, 400, 3), _np.uint8))
+        buf = _io.BytesIO()
+        img.save(buf, format="JPEG")
+
+        pre_u8 = _image_preprocess((64, 64, 3), _np.uint8)
+        arr = pre_u8(buf.getvalue(), "image/jpeg")
+        assert arr.shape == (64, 64, 3) and arr.dtype == _np.uint8
+
+        pre_f32 = _image_preprocess((64, 64, 3))
+        arr = pre_f32(buf.getvalue(), "image/jpeg")
+        assert arr.dtype == _np.float32
+        assert 0.0 <= float(arr.min()) and float(arr.max()) <= 1.0
+
+    def test_broken_image_raises_value_error(self):
+        import numpy as _np
+        import pytest as _pytest
+
+        from ai4e_tpu.runtime.families import _image_preprocess
+
+        pre = _image_preprocess((64, 64, 3))
+        with _pytest.raises(ValueError, match="undecodable"):
+            pre(b"not-a-jpeg", "image/jpeg")
+
+    def test_npy_path_still_validates_shape(self):
+        import io as _io
+
+        import numpy as _np
+        import pytest as _pytest
+
+        from ai4e_tpu.runtime.families import _image_preprocess
+
+        pre = _image_preprocess((8, 8, 3))
+        buf = _io.BytesIO()
+        _np.save(buf, _np.zeros((9, 8, 3), _np.float32))
+        with _pytest.raises(ValueError, match="expected"):
+            pre(buf.getvalue(), "application/octet-stream")

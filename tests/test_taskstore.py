@@ -172,3 +172,55 @@ class TestJournal:
         path = t1.endpoint_path
         assert revived.set_len(path, "completed") == 1
         assert revived.set_len(path, "created") == 1
+
+
+class TestContentTypeReplay:
+    def test_pipeline_replay_restores_original_content_type(self):
+        """A JPEG task republished with an empty body must replay both the
+        original bytes AND image/jpeg — replaying as application/json would
+        make the image preprocess undecodable downstream."""
+        from ai4e_tpu.taskstore import APITask, InMemoryTaskStore
+
+        store = InMemoryTaskStore()
+        published = []
+        store.set_publisher(lambda t: published.append(
+            (t.body, t.content_type)))
+        task = store.upsert(APITask(endpoint="/v1/detect", body=b"\xff\xd8JPG",
+                                    content_type="image/jpeg", publish=True))
+        # Pipeline republish (empty body): replay body + content type.
+        store.upsert(APITask(task_id=task.task_id, endpoint="/v1/classify",
+                             body=b"", publish=True))
+        assert published[-1] == (b"\xff\xd8JPG", "image/jpeg")
+
+    def test_unfinished_tasks_restore_content_type(self):
+        from ai4e_tpu.taskstore import APITask, InMemoryTaskStore
+
+        store = InMemoryTaskStore()
+        task = store.upsert(APITask(endpoint="/v1/detect", body=b"IMG",
+                                    content_type="image/png"))
+        store.update_status(task.task_id, "running")
+        # Simulate the journal-restore path (body emptied on the record).
+        store._tasks[task.task_id].body = b""
+        restored = store.unfinished_tasks()
+        assert restored[0].body == b"IMG"
+        assert restored[0].content_type == "image/png"
+
+    def test_journal_round_trips_orig_content_type(self, tmp_path):
+        import os
+
+        from ai4e_tpu.taskstore import APITask, JournaledTaskStore
+
+        path = os.path.join(str(tmp_path), "j.jsonl")
+        store = JournaledTaskStore(path)
+        task = store.upsert(APITask(endpoint="/v1/detect", body=b"RAWJPG",
+                                    content_type="image/jpeg"))
+        store.close()
+
+        store2 = JournaledTaskStore(path)
+        published = []
+        store2.set_publisher(lambda t: published.append(
+            (t.body, t.content_type)))
+        store2.upsert(APITask(task_id=task.task_id, endpoint="/v1/next",
+                              body=b"", publish=True))
+        assert published == [(b"RAWJPG", "image/jpeg")]
+        store2.close()
