@@ -115,6 +115,29 @@ def _declarative_handoff(spec: dict | None):
     return pipeline_to
 
 
+def _mesh_from_config(rt):
+    """Build the serving mesh from the runtime section's axis sizes
+    (AI4E_RUNTIME_DP/FSDP/TP/SP/EP). All defaults (dp=0, rest=1) → None →
+    ModelRuntime's all-devices data-parallel default."""
+    axes = dict(fsdp=rt.fsdp, tp=rt.tp, sp=rt.sp, ep=rt.ep)
+    if rt.dp <= 0 and all(v <= 1 for v in axes.values()):
+        return None
+    import jax
+
+    from .parallel import MeshSpec, make_mesh
+    denom = max(1, rt.fsdp) * max(1, rt.tp) * max(1, rt.sp) * max(1, rt.ep)
+    if rt.dp <= 0:
+        if jax.device_count() % denom:
+            raise ValueError(
+                f"{jax.device_count()} devices not divisible by "
+                f"fsdp*tp*sp*ep={denom} (AI4E_RUNTIME_* axis sizes)")
+        dp = jax.device_count() // denom
+    else:
+        dp = rt.dp
+    return make_mesh(MeshSpec(dp=dp, **{k: max(1, v)
+                                        for k, v in axes.items()}))
+
+
 def build_worker(config: FrameworkConfig, models: dict):
     """Assemble a worker process; returns (worker, batcher, task_manager)."""
     from .runtime import (
@@ -136,7 +159,8 @@ def build_worker(config: FrameworkConfig, models: dict):
     # plane (no-op single-process); the default mesh then spans every host.
     from .parallel import init_distributed
     init_distributed()
-    runtime = ModelRuntime(donate_batch=rt.donate_batch)
+    runtime = ModelRuntime(mesh=_mesh_from_config(rt),
+                           donate_batch=rt.donate_batch)
 
     store_base = models.get("taskstore") or config.gateway.taskstore_get_uri
     if store_base:
@@ -171,6 +195,9 @@ def build_worker(config: FrameworkConfig, models: dict):
         batch = spec.pop("batch", None)  # true | {serve_batch kwargs}
         checkpoint = spec.pop("checkpoint", None)
         pipeline_spec = spec.pop("pipeline_to", None)
+        # Families that build mesh-aware compute (seqformer's sp attention)
+        # receive the serving mesh; the rest ignore it via their **_ sink.
+        spec.setdefault("mesh", runtime.mesh)
         servable = build_servable(family, **spec)
         if checkpoint:
             # Restore real weights at pod start (SURVEY.md §5: the slot the

@@ -1,5 +1,6 @@
 from .detector import CenterNetDetector, create_detector, decode_detections
 from .resnet import ResNet, create_resnet50
+from .seqformer import SeqFormer, attention_for, create_seqformer
 from .unet import UNet, create_unet, segment_logits_to_classes
 from .vit import TP_RULES as VIT_TP_RULES, ViT, create_vit
 
@@ -9,6 +10,9 @@ __all__ = [
     "decode_detections",
     "ResNet",
     "create_resnet50",
+    "SeqFormer",
+    "attention_for",
+    "create_seqformer",
     "UNet",
     "create_unet",
     "segment_logits_to_classes",

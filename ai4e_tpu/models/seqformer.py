@@ -1,0 +1,140 @@
+"""SeqFormer — long-context transformer encoder served with sequence
+parallelism.
+
+The reference has no sequence dimension anywhere (SURVEY.md §5 long-context:
+its unit of work is one image tile); this model family fills the long-context
+slot the TPU framework treats as first-class. Inputs are long feature
+sequences — e.g. embedded acoustic-monitoring or satellite time series — of
+shape ``(S, input_dim)`` with S in the tens of thousands; attention over them
+is computed with **ring attention** (K/V blocks rotating over the mesh's
+``sp`` axis via ``ppermute``) or **Ulysses all-to-all**
+(``parallel/ring_attention.py``), so a sequence's O(S²) attention is sharded
+S/n-per-device and the activations never materialise full S×S scores.
+
+The attention strategy is injected as a plain callable: ``create_seqformer``
+picks ring/Ulysses over the given mesh when its ``sp`` axis is >1 and plain
+full attention otherwise, so the same module serves single-chip and
+sequence-parallel deployments.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SeqAttention(nn.Module):
+    dim: int
+    heads: int
+    attn_fn: Callable  # (q, k, v) -> o, all (B, H, S, D)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        head_dim = self.dim // self.heads
+        qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype,
+                       name="qkv")(x)
+        qkv = qkv.reshape(b, s, 3, self.heads, head_dim)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        o = self.attn_fn(q, k, v)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, self.dim)
+        return nn.Dense(d, use_bias=False, dtype=self.dtype, name="out")(o)
+
+
+class SeqBlock(nn.Module):
+    dim: int
+    heads: int
+    attn_fn: Callable
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + SeqAttention(self.dim, self.heads, self.attn_fn,
+                             dtype=self.dtype, name="attn")(nn.LayerNorm()(x))
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(self.dim * self.mlp_ratio, dtype=self.dtype,
+                     name="mlp_up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")(h)
+        return x + h
+
+
+class SeqFormer(nn.Module):
+    """Encoder over (B, S, input_dim) → (B, num_classes)."""
+
+    seq_len: int
+    input_dim: int
+    dim: int = 128
+    depth: int = 2
+    heads: int = 8
+    num_classes: int = 16
+    attn_fn: Callable = None  # injected; None → full attention
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from ..parallel.ring_attention import reference_attention
+        attn_fn = self.attn_fn or reference_attention
+        h = nn.Dense(self.dim, dtype=self.dtype, name="embed")(x)
+        pos = self.param("pos_emb", nn.initializers.normal(0.02),
+                         (1, self.seq_len, self.dim))
+        h = h + pos.astype(self.dtype)
+        for i in range(self.depth):
+            h = SeqBlock(self.dim, self.heads, attn_fn, dtype=self.dtype,
+                         name=f"block{i}")(h)
+        h = nn.LayerNorm()(h.mean(axis=1))  # pool over the sequence
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(h)
+
+
+def attention_for(mesh=None, strategy: str = "auto", causal: bool = False,
+                  batch_axes=("dp", "fsdp")) -> Callable:
+    """Pick the attention implementation for a mesh.
+
+    ``auto`` → ring when the mesh's sp axis is >1, else full attention;
+    ``ring`` / ``ulysses`` force the parallel paths; ``full`` forces plain.
+    """
+    from ..parallel.ring_attention import (
+        reference_attention,
+        ring_attention,
+        ulysses_attention,
+    )
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    if strategy == "auto":
+        strategy = "ring" if sp > 1 else "full"
+    if strategy == "full":
+        return partial(reference_attention, causal=causal)
+    if mesh is None or sp <= 1:
+        raise ValueError(f"{strategy} attention needs a mesh with sp > 1")
+    fn = {"ring": ring_attention, "ulysses": ulysses_attention}[strategy]
+    return partial(fn, mesh=mesh, causal=causal, batch_axes=batch_axes)
+
+
+def create_seqformer(rng=None, seq_len: int = 4096, input_dim: int = 64,
+                     dim: int = 128, depth: int = 2, heads: int = 8,
+                     num_classes: int = 16, mesh=None,
+                     attention: str = "auto", causal: bool = False):
+    """Build model + params. With a sequence-parallel mesh the sequence must
+    divide the sp axis size (static shapes — SPMD)."""
+    if mesh is not None:
+        sp = mesh.shape.get("sp", 1)
+        if seq_len % max(sp, 1):
+            raise ValueError(f"seq_len {seq_len} not divisible by sp={sp}")
+    model = SeqFormer(seq_len=seq_len, input_dim=input_dim, dim=dim,
+                      depth=depth, heads=heads, num_classes=num_classes,
+                      attn_fn=attention_for(mesh, attention, causal))
+    # Init with a param-free stub attention (identity on q — same output
+    # shape): the strategy carries no params, so the tree is identical, and
+    # init neither materialises O(S²) scores for long sequences nor gets
+    # constrained to the mesh's dp size by the batch-1 forward.
+    init_model = model.clone(attn_fn=lambda q, k, v: q)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    params = init_model.init(rng,
+                             np.zeros((1, seq_len, input_dim), np.float32))
+    return model, params

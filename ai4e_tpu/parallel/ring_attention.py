@@ -45,9 +45,12 @@ def reference_attention(q, k, v, causal: bool = False):
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
+                          vary_axes: tuple = ()):
     """Per-device body under shard_map: q/k/v are the local seq shards
-    (B, H, S/n, D)."""
+    (B, H, S/n, D). ``vary_axes`` lists every manual axis the inputs vary
+    over (the sp axis plus any batch axes) — the scan carry init must be
+    marked varying over all of them to match the collective-produced carry."""
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
@@ -89,21 +92,30 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     o0 = jnp.zeros_like(q)
     # pvary: mark device-constant initial carries as axis-varying so the scan
     # carry type matches its (collective-produced, varying) outputs.
-    m0 = jax.lax.pvary(jnp.full((*q.shape[:3], 1), -jnp.inf, q.dtype),
-                       (axis_name,))
-    l0 = jax.lax.pvary(jnp.zeros((*q.shape[:3], 1), q.dtype), (axis_name,))
+    vary = vary_axes or (axis_name,)
+    m0 = jax.lax.pvary(jnp.full((*q.shape[:3], 1), -jnp.inf, q.dtype), vary)
+    l0 = jax.lax.pvary(jnp.zeros((*q.shape[:3], 1), q.dtype), vary)
     (o, m, l, _, _), _ = jax.lax.scan(
         step, (o0, m0, l0, k, v), jnp.arange(n))
     return o / jnp.maximum(l, 1e-30)
 
 
 def ring_attention(q, k, v, mesh: Mesh, causal: bool = False,
-                   axis_name: str = "sp"):
+                   axis_name: str = "sp", batch_axes=None):
     """Sequence-parallel attention: inputs sharded (B, H, S@sp, D) on
-    ``mesh``; output sharded the same way."""
-    spec = P(None, None, axis_name, None)
+    ``mesh``; output sharded the same way. ``batch_axes`` names mesh axes the
+    batch dim is already sharded over (e.g. ``("dp", "fsdp")`` inside the
+    serving runtime) so entering the shard_map doesn't force a gather."""
+    spec = P(batch_axes, None, axis_name, None)
+    if batch_axes is None:
+        vary = (axis_name,)
+    elif isinstance(batch_axes, str):
+        vary = (batch_axes, axis_name)
+    else:
+        vary = (*batch_axes, axis_name)
     fn = shard_map(
-        partial(_ring_attention_local, axis_name=axis_name, causal=causal),
+        partial(_ring_attention_local, axis_name=axis_name, causal=causal,
+                vary_axes=vary),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
@@ -126,12 +138,12 @@ def _ulysses_local(q, k, v, axis_name: str, causal: bool):
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, causal: bool = False,
-                      axis_name: str = "sp"):
+                      axis_name: str = "sp", batch_axes=None):
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style)."""
     n = mesh.shape[axis_name]
     if q.shape[1] % n:
         raise ValueError(f"heads {q.shape[1]} not divisible by sp={n}")
-    spec = P(None, None, axis_name, None)
+    spec = P(batch_axes, None, axis_name, None)
     fn = shard_map(
         partial(_ulysses_local, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)

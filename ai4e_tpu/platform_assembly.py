@@ -100,7 +100,7 @@ class LocalPlatform:
         if self.config.reaper_running_timeout is not None:
             from .taskstore.reaper import TaskReaper
             self.reaper = TaskReaper(
-                self.store, self.task_manager,
+                self.store,
                 running_timeout=self.config.reaper_running_timeout,
                 interval=self.config.reaper_interval,
                 max_requeues=self.config.reaper_max_requeues,

@@ -207,12 +207,42 @@ def build_vit(name: str = "vit", image_size: int = 224, patch: int = 16,
         postprocess=postprocess, batch_buckets=tuple(buckets))
 
 
+def build_seqformer(name: str = "longcontext", seq_len: int = 4096,
+                    input_dim: int = 64, dim: int = 128, depth: int = 2,
+                    heads: int = 8, num_classes: int = 16,
+                    attention: str = "auto", causal: bool = False,
+                    buckets=(1, 8), mesh=None, **_) -> ServableModel:
+    """Long-context sequence classification (SURVEY.md §5 long-context slot):
+    attention over the (S, input_dim) payload runs ring/Ulysses
+    sequence-parallel over the mesh's sp axis when it has one."""
+    from ..models.seqformer import create_seqformer
+
+    model, params = create_seqformer(
+        seq_len=seq_len, input_dim=input_dim, dim=dim, depth=depth,
+        heads=heads, num_classes=num_classes, mesh=mesh, attention=attention,
+        causal=causal)
+
+    def postprocess(logits):
+        logits = np.asarray(logits, np.float64)
+        probs = np.exp(logits - logits.max())
+        probs /= probs.sum()
+        top = int(np.argmax(probs))
+        return {"class_id": top, "confidence": float(probs[top])}
+
+    return ServableModel(
+        name=name, apply_fn=model.apply, params=params,
+        input_shape=(seq_len, input_dim),
+        preprocess=_npy_preprocess((seq_len, input_dim)),
+        postprocess=postprocess, batch_buckets=tuple(buckets))
+
+
 FAMILIES = {
     "echo": build_echo,
     "unet": build_unet,
     "resnet": build_resnet,
     "detector": build_detector,
     "vit": build_vit,
+    "seqformer": build_seqformer,
 }
 
 

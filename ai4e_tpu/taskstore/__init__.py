@@ -10,6 +10,3 @@ __all__ = [
     "JournaledTaskStore",
     "TaskNotFound",
 ]
-from .reaper import TaskReaper  # noqa: E402  (reaper imports ..metrics)
-
-__all__.append("TaskReaper")

@@ -36,13 +36,12 @@ log = logging.getLogger("ai4e_tpu.reaper")
 
 
 class TaskReaper:
-    def __init__(self, store: InMemoryTaskStore, task_manager,
+    def __init__(self, store: InMemoryTaskStore,
                  running_timeout: float = 600.0,
                  interval: float = 30.0,
                  max_requeues: int = 3,
                  metrics: MetricsRegistry | None = None):
         self.store = store
-        self.task_manager = task_manager
         self.running_timeout = running_timeout
         self.interval = interval
         self.max_requeues = max_requeues
@@ -76,14 +75,24 @@ class TaskReaper:
                 log.exception("reaper sweep failed")
 
     async def sweep(self) -> int:
-        """One scan; returns the number of tasks acted on."""
+        """One scan; returns the number of tasks acted on. Cost is
+        O(running tasks), not O(all tasks ever): the per-endpoint RUNNING
+        status sets (the reference's ``{path}_running`` sorted sets) are the
+        index, so terminal history is never touched."""
         now = time.time()
         acted = 0
-        for task in self.store.snapshot():
-            if task.canonical_status != TaskStatus.RUNNING:
-                if task.canonical_status in TaskStatus.TERMINAL:
-                    self._requeues.pop(task.task_id, None)
-                continue
+        running: list = []
+        for path in self.store.endpoints():
+            for task_id in self.store.set_members(path, TaskStatus.RUNNING):
+                try:
+                    running.append(self.store.get(task_id))
+                except KeyError:
+                    continue
+        running_ids = {t.task_id for t in running}
+        # Rescue budgets of tasks that left RUNNING are no longer needed.
+        self._requeues = {tid: c for tid, c in self._requeues.items()
+                          if tid in running_ids}
+        for task in running:
             age = now - task.timestamp
             if age < self.running_timeout:
                 continue

@@ -29,7 +29,7 @@ class TestSweep:
             tm = LocalTaskManager(store)
             task = store.upsert(APITask(endpoint="/v1/x", body=b"B"))
             store.update_status(task.task_id, "running")
-            reaper = TaskReaper(store, tm, running_timeout=60.0)
+            reaper = TaskReaper(store, running_timeout=60.0)
             assert await reaper.sweep() == 0
             assert "running" in store.get(task.task_id).status
 
@@ -47,7 +47,7 @@ class TestSweep:
             # Make it look old.
             store._tasks[task.task_id].timestamp -= 1000
 
-            reaper = TaskReaper(store, tm, running_timeout=60.0)
+            reaper = TaskReaper(store, running_timeout=60.0)
             assert await reaper.sweep() == 1
             assert republished == [(task.task_id, b"ORIG")]
             assert store.get(task.task_id).canonical_status == TaskStatus.CREATED
@@ -60,7 +60,7 @@ class TestSweep:
             tm = LocalTaskManager(store)
             store.set_publisher(lambda t: None)
             task = store.upsert(APITask(endpoint="/v1/x", body=b"B"))
-            reaper = TaskReaper(store, tm, running_timeout=60.0,
+            reaper = TaskReaper(store, running_timeout=60.0,
                                 max_requeues=2)
             for rescue in range(2):
                 store.update_status(task.task_id, "running")
@@ -83,7 +83,7 @@ class TestSweep:
             tm = LocalTaskManager(store)
             store.set_publisher(lambda t: None)
             task = store.upsert(APITask(endpoint="/v1/x", body=b"B"))
-            reaper = TaskReaper(store, tm, running_timeout=60.0)
+            reaper = TaskReaper(store, running_timeout=60.0)
             store.update_status(task.task_id, "running")
             store._tasks[task.task_id].timestamp -= 1000
             await reaper.sweep()
@@ -158,7 +158,7 @@ class TestNoResurrection:
             task = store.upsert(APITask(endpoint="/v1/x", body=b"B"))
             store.update_status(task.task_id, "running")
             store._tasks[task.task_id].timestamp -= 1000
-            reaper = TaskReaper(store, tm, running_timeout=60.0)
+            reaper = TaskReaper(store, running_timeout=60.0)
             # Simulate completion in the snapshot->action window.
             snapshot = store.snapshot()
             store.update_status(task.task_id, "completed - raced")

@@ -1,0 +1,95 @@
+"""Long-context serving tests — SeqFormer with ring/Ulysses sequence
+parallelism over the mesh's sp axis (``models/seqformer.py``; the long-context
+slot SURVEY.md §5 marks absent in the reference)."""
+
+import io
+
+import jax
+import numpy as np
+import pytest
+
+from ai4e_tpu.models import create_seqformer
+from ai4e_tpu.parallel import MeshSpec, make_mesh
+from ai4e_tpu.runtime import ModelRuntime, build_servable
+
+S, F = 256, 16
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    return make_mesh(MeshSpec(dp=2, sp=4))
+
+
+class TestCorrectness:
+    def test_ring_matches_full_attention(self, sp_mesh):
+        """Same params, same input: sequence-parallel attention must produce
+        the same logits as plain full attention."""
+        model_sp, params = create_seqformer(
+            seq_len=S, input_dim=F, dim=32, depth=2, heads=4, num_classes=8,
+            mesh=sp_mesh, attention="ring")
+        model_full, _ = create_seqformer(
+            seq_len=S, input_dim=F, dim=32, depth=2, heads=4, num_classes=8,
+            attention="full")
+        x = np.random.default_rng(0).standard_normal((2, S, F)).astype(np.float32)
+        got = np.asarray(model_sp.apply(params, x))
+        expected = np.asarray(model_full.apply(params, x))
+        np.testing.assert_allclose(got, expected, rtol=2e-2, atol=2e-2)
+
+    def test_ulysses_matches_full_attention(self, sp_mesh):
+        model_sp, params = create_seqformer(
+            seq_len=S, input_dim=F, dim=32, depth=1, heads=4, num_classes=8,
+            mesh=sp_mesh, attention="ulysses")
+        model_full, _ = create_seqformer(
+            seq_len=S, input_dim=F, dim=32, depth=1, heads=4, num_classes=8,
+            attention="full")
+        x = np.random.default_rng(1).standard_normal((2, S, F)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(model_sp.apply(params, x)),
+            np.asarray(model_full.apply(params, x)), rtol=2e-2, atol=2e-2)
+
+    def test_seq_len_must_divide_sp(self, sp_mesh):
+        with pytest.raises(ValueError, match="not divisible"):
+            create_seqformer(seq_len=S + 1, input_dim=F, mesh=sp_mesh,
+                             attention="ring")
+
+    def test_parallel_attention_requires_sp_mesh(self):
+        with pytest.raises(ValueError, match="sp > 1"):
+            create_seqformer(seq_len=S, input_dim=F, attention="ring")
+
+
+class TestServing:
+    def test_family_serves_on_sp_mesh(self, sp_mesh):
+        """The seqformer family registers on a dp×sp mesh and scores a long
+        sequence end-to-end through the runtime."""
+        runtime = ModelRuntime(mesh=sp_mesh)
+        servable = build_servable(
+            "seqformer", name="longcontext", seq_len=S, input_dim=F, dim=32,
+            depth=1, heads=4, num_classes=8, buckets=(2,), mesh=sp_mesh)
+        runtime.register(servable)
+        runtime.warmup()
+
+        seq = np.random.default_rng(2).standard_normal((S, F)).astype(np.float32)
+        buf = io.BytesIO()
+        np.save(buf, seq)
+        example = servable.preprocess(buf.getvalue(), "application/octet-stream")
+        bucket = servable.bucket_for(1)
+        batch = np.zeros((bucket, S, F), np.float32)
+        batch[0] = example
+        out = runtime.run_batch("longcontext", batch)
+        result = servable.postprocess(
+            jax.tree_util.tree_map(lambda a: a[0], out))
+        assert 0 <= result["class_id"] < 8
+        assert 0.0 < result["confidence"] <= 1.0
+
+
+class TestMeshFromConfig:
+    def test_env_axes_build_mesh(self):
+        from ai4e_tpu.cli import _mesh_from_config
+        from ai4e_tpu.config import RuntimeSection
+
+        rt = RuntimeSection(sp=4)
+        mesh = _mesh_from_config(rt)
+        assert mesh.shape["sp"] == 4
+        assert mesh.shape["dp"] == jax.device_count() // 4
+
+        assert _mesh_from_config(RuntimeSection()) is None
