@@ -97,19 +97,28 @@ def attention_for(mesh=None, strategy: str = "auto", causal: bool = False,
                   batch_axes=("dp", "fsdp")) -> Callable:
     """Pick the attention implementation for a mesh.
 
-    ``auto`` → ring when the mesh's sp axis is >1, else full attention;
-    ``ring`` / ``ulysses`` force the parallel paths; ``full`` forces plain.
+    ``auto`` → ring when the mesh's sp axis is >1, else the fused flash
+    kernel; ``ring`` / ``ulysses`` force the parallel paths; ``flash``
+    forces the single-device Pallas kernel (``ops/pallas/flash_attention``);
+    ``full`` forces plain materialised attention (the correctness oracle).
     """
+    from ..ops.pallas import flash_attention
     from ..parallel.ring_attention import (
         reference_attention,
         ring_attention,
         ulysses_attention,
     )
+    valid = ("auto", "ring", "ulysses", "flash", "full")
+    if strategy not in valid:
+        raise ValueError(f"unknown attention strategy {strategy!r}; "
+                         f"valid: {valid}")
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
     if strategy == "auto":
-        strategy = "ring" if sp > 1 else "full"
+        strategy = "ring" if sp > 1 else "flash"
     if strategy == "full":
         return partial(reference_attention, causal=causal)
+    if strategy == "flash":
+        return partial(flash_attention, causal=causal)
     if mesh is None or sp <= 1:
         raise ValueError(f"{strategy} attention needs a mesh with sp > 1")
     fn = {"ring": ring_attention, "ulysses": ulysses_attention}[strategy]

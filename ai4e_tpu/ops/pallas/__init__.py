@@ -1,3 +1,4 @@
+from .flash_attention import flash_attention
 from .image_preprocess import normalize_image
 from .seg_postprocess import (
     class_histogram,
@@ -6,6 +7,7 @@ from .seg_postprocess import (
 )
 
 __all__ = [
+    "flash_attention",
     "normalize_image",
     "class_histogram",
     "fused_seg_postprocess",

@@ -1,0 +1,139 @@
+"""Pallas TPU kernel: fused flash attention (online-softmax, no S×S scores).
+
+The long-context path's hottest op. Plain attention materialises a
+(S_q, S_k) float32 score matrix per (batch, head) — at S=16k that is 1 GB
+per head and pure HBM traffic. This kernel streams K/V blocks through VMEM
+with a running max/denominator (the same online softmax the ring step uses
+across devices, here applied across blocks within one device), so the score
+matrix never exists: HBM traffic drops from O(S²) to O(S·D) and the two
+matmuls land on the MXU back-to-back.
+
+Role in the stack (``models/seqformer.py`` / ``parallel/ring_attention.py``):
+
+- single-device long-context serving: ``attention_for(..., "flash")`` (the
+  ``auto`` default off sequence-parallel meshes);
+- inside Ulysses, each device attends over the full gathered sequence with
+  1/n of the heads — that inner call is exactly this kernel's shape.
+
+Layout (pallas_guide.md): grid is (B·H, S_q/block_q, S_k/block_k) — the K
+dimension is a *grid* axis, not a whole-S_k VMEM block, so VMEM holds only
+(block_q, D) + (block_k, D) tiles plus the (block_q, D) accumulator
+regardless of sequence length (S=32k works in the same footprint as S=1k).
+TPU grids execute sequentially with the rightmost axis fastest, so the
+accumulator/max/denominator live in VMEM scratch carried across the k-axis
+steps; the output block is written on the last k step. D rides the 128-lane
+axis; block_q rides sublanes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-but-finite: avoids (-inf) - (-inf) NaNs in the kernel
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *,
+                  n_k_blocks: int, causal: bool, scale: float):
+    # q_ref/out_ref: (1, block_q, D); k_ref/v_ref: (1, block_k, D);
+    # scratch: acc (block_q, D), m/l (block_q, 1) — carried across the
+    # sequential k-axis grid steps.
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    block_k = k_ref.shape[1]
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    kb = k_ref[0].astype(jnp.float32)
+    vb = v_ref[0].astype(jnp.float32)
+    scores = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)  # (bq, bk) on the MXU
+    if causal:
+        q_pos = (pl.program_id(1) * block_q
+                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0))
+        k_pos = (ik * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+
+    m_prev, l_prev = m_ref[...], l_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+    p = jnp.exp(scores - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_ref[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    m_ref[...] = m_new
+    acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+        p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_k_blocks - 1)
+    def _finish():
+        out_ref[0] = (acc_ref[...]
+                      / jnp.maximum(l_ref[...], 1e-30)).astype(out_ref.dtype)
+
+
+def _dividing_block(s: int, target: int) -> int:
+    """Largest block size ≤ target that divides s (static shapes: the grid
+    must tile the sequence exactly)."""
+    for b in range(min(target, s), 0, -1):
+        if s % b == 0:
+            return b
+    return 1
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None,
+                    mesh=None, batch_axes=None):
+    """Fused attention: q (B, H, S_q, D), k/v (B, H, S_k, D) → (B, H, S_q, D).
+
+    Block sizes round DOWN to divisors of the sequence lengths, so any length
+    works (prime lengths degrade toward block 1 — pad such sequences).
+    ``interpret`` defaults to True off-TPU (CPU CI runs the pallas
+    interpreter; on device it compiles to Mosaic). ``mesh``/``batch_axes``
+    are accepted (and ignored) so ``attention_for`` can treat this as a
+    drop-in strategy alongside ring/Ulysses.
+    """
+    del mesh, batch_axes
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    if causal and s_q != s_k:
+        raise ValueError("causal flash attention expects S_q == S_k")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = _dividing_block(s_q, block_q)
+    block_k = _dividing_block(s_k, block_k)
+    n_k_blocks = s_k // block_k
+
+    def run(q3, k3, v3):
+        # Collapsed (B·H, S, D) — one grid row per (batch, head).
+        return pl.pallas_call(
+            partial(_flash_kernel, n_k_blocks=n_k_blocks, causal=causal,
+                    scale=d ** -0.5),
+            grid=(q3.shape[0], s_q // block_q, n_k_blocks),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+                pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+                pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda bh, iq, ik: (bh, iq, 0)),
+            out_shape=jax.ShapeDtypeStruct((q3.shape[0], s_q, d), q3.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q3, k3, v3)
+
+    out = run(q.reshape(b * h, s_q, d), k.reshape(b * h, s_k, d),
+              v.reshape(b * h, s_k, d))
+    return out.reshape(b, h, s_q, d)

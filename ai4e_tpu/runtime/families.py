@@ -65,6 +65,21 @@ def _image_preprocess(shape: tuple, dtype=np.float32):
     return preprocess
 
 
+def _classification_postprocess(labels: list | None = None):
+    """Softmax + argmax → {class_id, label?, confidence} — shared by every
+    classifier family."""
+    def postprocess(logits):
+        logits = np.asarray(logits, np.float64)
+        probs = np.exp(logits - logits.max())
+        probs /= probs.sum()
+        top = int(np.argmax(probs))
+        out = {"class_id": top, "confidence": float(probs[top])}
+        if labels:
+            out["label"] = labels[top]
+        return out
+    return postprocess
+
+
 def build_echo(name: str = "echo", size: int = 16, buckets=(8,),
                **_) -> ServableModel:
     """Identity model — the reference's base-py echo API

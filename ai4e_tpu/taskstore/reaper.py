@@ -89,9 +89,19 @@ class TaskReaper:
                 except KeyError:
                     continue
         running_ids = {t.task_id for t in running}
-        # Rescue budgets of tasks that left RUNNING are no longer needed.
-        self._requeues = {tid: c for tid, c in self._requeues.items()
-                          if tid in running_ids}
+        # Release rescue budgets only on TERMINAL outcomes: a rescued task
+        # waiting in CREATED (redelivery pending) must keep its count, or
+        # max_requeues could never trip and a poison task would cycle forever.
+        for tid in list(self._requeues):
+            if tid in running_ids:
+                continue
+            try:
+                status = self.store.get(tid).canonical_status
+            except KeyError:
+                del self._requeues[tid]
+                continue
+            if status in TaskStatus.TERMINAL:
+                del self._requeues[tid]
         for task in running:
             age = now - task.timestamp
             if age < self.running_timeout:

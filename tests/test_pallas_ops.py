@@ -68,3 +68,68 @@ class TestNormalizeImage:
     def test_rejects_float_input(self):
         with pytest.raises(ValueError):
             normalize_image(jnp.zeros((1, 32, 128, 3), jnp.float32))
+
+
+class TestFlashAttention:
+    def _qkv(self, b=2, h=3, s=256, d=32, seed=0):
+        import numpy as _np
+        rng = _np.random.default_rng(seed)
+        mk = lambda: rng.standard_normal((b, h, s, d)).astype(_np.float32)
+        return mk(), mk(), mk()
+
+    def test_matches_reference(self):
+        import numpy as _np
+
+        from ai4e_tpu.ops.pallas import flash_attention
+        from ai4e_tpu.parallel.ring_attention import reference_attention
+
+        q, k, v = self._qkv()
+        got = flash_attention(q, k, v, block_q=64, block_k=64)
+        expected = reference_attention(q, k, v)
+        _np.testing.assert_allclose(_np.asarray(got), _np.asarray(expected),
+                                    rtol=2e-4, atol=2e-5)
+
+    def test_causal_matches_reference(self):
+        import numpy as _np
+
+        from ai4e_tpu.ops.pallas import flash_attention
+        from ai4e_tpu.parallel.ring_attention import reference_attention
+
+        q, k, v = self._qkv(seed=1)
+        got = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+        expected = reference_attention(q, k, v, causal=True)
+        _np.testing.assert_allclose(_np.asarray(got), _np.asarray(expected),
+                                    rtol=2e-4, atol=2e-5)
+
+    def test_cross_attention_shapes(self):
+        # S_q != S_k (non-causal): decoder-style cross attention.
+        import numpy as _np
+
+        from ai4e_tpu.ops.pallas import flash_attention
+        from ai4e_tpu.parallel.ring_attention import reference_attention
+
+        rng = _np.random.default_rng(2)
+        q = rng.standard_normal((1, 2, 64, 16)).astype(_np.float32)
+        k = rng.standard_normal((1, 2, 192, 16)).astype(_np.float32)
+        v = rng.standard_normal((1, 2, 192, 16)).astype(_np.float32)
+        got = flash_attention(q, k, v, block_q=32, block_k=64)
+        _np.testing.assert_allclose(
+            _np.asarray(got), _np.asarray(reference_attention(q, k, v)),
+            rtol=2e-4, atol=2e-5)
+
+    def test_seqformer_flash_strategy_matches_full(self):
+        import numpy as _np
+
+        from ai4e_tpu.models import create_seqformer
+
+        model_flash, params = create_seqformer(
+            seq_len=256, input_dim=16, dim=32, depth=1, heads=4,
+            num_classes=8, attention="flash")
+        model_full, _ = create_seqformer(
+            seq_len=256, input_dim=16, dim=32, depth=1, heads=4,
+            num_classes=8, attention="full")
+        x = _np.random.default_rng(3).standard_normal(
+            (2, 256, 16)).astype(_np.float32)
+        _np.testing.assert_allclose(
+            _np.asarray(model_flash.apply(params, x)),
+            _np.asarray(model_full.apply(params, x)), rtol=2e-2, atol=2e-2)
