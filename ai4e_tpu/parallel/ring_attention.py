@@ -27,7 +27,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 def reference_attention(q, k, v, causal: bool = False):
@@ -90,11 +90,13 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
         return (o_new, m_new, l_new, k_next, v_next), None
 
     o0 = jnp.zeros_like(q)
-    # pvary: mark device-constant initial carries as axis-varying so the scan
-    # carry type matches its (collective-produced, varying) outputs.
+    # Mark device-constant initial carries as axis-varying so the scan carry
+    # type matches its (collective-produced, varying) outputs.
     vary = vary_axes or (axis_name,)
-    m0 = jax.lax.pvary(jnp.full((*q.shape[:3], 1), -jnp.inf, q.dtype), vary)
-    l0 = jax.lax.pvary(jnp.zeros((*q.shape[:3], 1), q.dtype), vary)
+    m0 = jax.lax.pcast(jnp.full((*q.shape[:3], 1), -jnp.inf, q.dtype), vary,
+                       to="varying")
+    l0 = jax.lax.pcast(jnp.zeros((*q.shape[:3], 1), q.dtype), vary,
+                       to="varying")
     (o, m, l, _, _), _ = jax.lax.scan(
         step, (o0, m0, l0, k, v), jnp.arange(n))
     return o / jnp.maximum(l, 1e-30)

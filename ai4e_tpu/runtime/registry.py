@@ -150,6 +150,31 @@ class ModelRuntime:
 
 def enable_compilation_cache(path: str = "/tmp/ai4e_tpu_xla_cache") -> None:
     """Persistent XLA compilation cache: pod restarts skip recompiles (the
-    warmup-at-start requirement in SURVEY.md §7 hard parts)."""
+    warmup-at-start requirement in SURVEY.md §7 hard parts).
+
+    XLA:CPU entries are AOT machine code whose cache key does NOT include the
+    host's CPU features — an entry compiled on another machine loads with a
+    "could lead to SIGILL" warning. The cache dir is therefore keyed by the
+    host's CPU identity (machine arch + feature flags). Same-host processes —
+    the case that matters: prewarm subprocess → bench, pod restarts — still
+    share the cache. Keying unconditionally (rather than only for the CPU
+    backend) avoids initializing a JAX backend here, which would break
+    ``jax.distributed.initialize`` for callers like ``cli.build_worker`` that
+    enable the cache before bringing up the multi-host data plane.
+    """
+    import hashlib
+    import platform
+    ident = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            # x86 spells it "flags", aarch64 "Features"
+            ident += next((l for l in f
+                           if l.lower().startswith(("flags", "features"))), "")
+    except OSError:
+        pass
+    # Key *inside* the configured dir so an operator-mounted persistent
+    # volume at ``path`` still holds the cache across pod restarts.
+    import os
+    path = os.path.join(path, hashlib.sha1(ident.encode()).hexdigest()[:12])
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,101 @@
+"""Multi-device Trainer coverage: tensor-parallel + fsdp sharding.
+
+Round-1 gap: the only dp×fsdp×tp exercise lived in the driver's
+``dryrun_multichip`` gate; the suite itself never ran the Trainer on a
+multi-device mesh. These tests keep that path covered fast (<30s total on the
+virtual 8-device CPU mesh) and assert the actual shard layouts, mirroring the
+megatron-style split of `ai4e_tpu/models/vit.py` TP_RULES.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ai4e_tpu.models import VIT_TP_RULES, create_vit
+from ai4e_tpu.models.vit import ViT
+from ai4e_tpu.parallel import MeshSpec, make_mesh
+from ai4e_tpu.train import Trainer, cross_entropy_loss
+
+
+def _batch(mesh, n=4, image=16, classes=4):
+    images = jax.device_put(
+        np.random.default_rng(0).uniform(size=(n, image, image, 3))
+        .astype(np.float32),
+        NamedSharding(mesh, P(("dp", "fsdp"))))
+    labels = jax.device_put(np.arange(n, dtype=np.int32) % classes,
+                            NamedSharding(mesh, P(("dp", "fsdp"))))
+    return images, labels
+
+
+class TestTrainerTensorParallel:
+    def test_dp_tp_step_shards_params(self):
+        mesh = make_mesh(MeshSpec(dp=2, tp=2), devices=jax.devices()[:4])
+        model, params = create_vit(image_size=16, patch=8, dim=32, depth=1,
+                                   heads=2, num_classes=4)
+        with mesh:
+            trainer = Trainer(model.apply, params, mesh,
+                              loss_fn=cross_entropy_loss,
+                              tp_rules=VIT_TP_RULES)
+            images, labels = _batch(mesh)
+            loss = trainer.train_step(images, labels)
+        assert np.isfinite(loss)
+
+        p = trainer.params["params"]["block0"]
+        qkv = p["attn"]["qkv"]["kernel"]
+        assert qkv.sharding.spec == P(None, "tp")
+        assert qkv.sharding.shard_shape(qkv.shape)[-1] == qkv.shape[-1] // 2
+        out = p["attn"]["out"]["kernel"]
+        assert out.sharding.spec == P("tp")  # trailing Nones normalized away
+        assert out.sharding.shard_shape(out.shape)[0] == out.shape[0] // 2
+        # optimizer state inherits the param shardings (optax tree maps
+        # under jit preserve placement)
+        mu_qkv = trainer.opt_state[0].mu["params"]["block0"]["attn"]["qkv"][
+            "kernel"]
+        assert mu_qkv.sharding.spec == P(None, "tp")
+
+    def test_dp_fsdp_tp_step_runs(self):
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2),
+                         devices=jax.devices()[:8])
+        model, params = create_vit(image_size=16, patch=8, dim=32, depth=1,
+                                   heads=2, num_classes=4)
+        with mesh:
+            trainer = Trainer(model.apply, params, mesh,
+                              loss_fn=cross_entropy_loss,
+                              tp_rules=VIT_TP_RULES)
+            images, labels = _batch(mesh, n=8)
+            first = trainer.train_step(images, labels)
+            second = trainer.train_step(images, labels)
+        assert np.isfinite(first) and np.isfinite(second)
+        # optimizing the same batch twice must reduce its loss
+        assert second < first
+
+    def test_tp_matches_single_device(self):
+        """TP is a layout change, not a math change: one train step on a
+        dp=1,tp=2 mesh must produce the same loss as single-device, up to
+        float tolerance."""
+        model = ViT(num_classes=4, patch=8, dim=32, depth=1, heads=2,
+                    dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 16, 16, 3)))
+        images = np.random.default_rng(1).uniform(
+            size=(4, 16, 16, 3)).astype(np.float32)
+        labels = np.asarray([0, 1, 2, 3], np.int32)
+
+        losses = {}
+        for name, spec, tp_rules in [
+            ("single", MeshSpec(dp=1), None),
+            ("tp", MeshSpec(tp=2), VIT_TP_RULES),
+        ]:
+            mesh = make_mesh(spec, devices=jax.devices()[:spec.size])
+            with mesh:
+                # train_step donates param buffers — each trainer needs its
+                # own copy of the init tree
+                trainer = Trainer(model.apply,
+                                  jax.tree.map(jnp.array, params), mesh,
+                                  loss_fn=cross_entropy_loss,
+                                  tp_rules=tp_rules)
+                losses[name] = [trainer.train_step(images, labels)
+                                for _ in range(2)]
+        np.testing.assert_allclose(losses["single"], losses["tp"],
+                                   rtol=2e-5)
