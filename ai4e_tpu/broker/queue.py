@@ -25,6 +25,7 @@ import asyncio
 import itertools
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -57,13 +58,23 @@ class EndpointQueue:
         self.max_delivery_count = max_delivery_count
         self.lease_seconds = lease_seconds
         self.dead_letter_handler = dead_letter_handler
-        self._ready: list[Message] = []
+        self._ready: deque[Message] = deque()
+        # Seqs logically ready (mirrors _ready minus retractions): a message
+        # completed after its lease expired (the reaper already requeued it)
+        # is retracted by dropping its seq here and skipping it lazily at
+        # receive() — no deque rebuild, every hot operation stays O(1), and
+        # a retract is only possible for a seq that IS logically ready, so
+        # depth accounting can never drift (a double-complete after
+        # redelivery is a no-op, not a phantom retraction).
+        self._ready_seqs: set[int] = set()
         self._leased: dict[int, Message] = {}
-        self._waiters: list[asyncio.Future] = []
+        self._waiters: deque[asyncio.Future] = deque()
         self.dead_letters: list[Message] = []
+        self._dead_seqs: set[int] = set()
 
     def _dead_letter(self, msg: Message) -> None:
         self.dead_letters.append(msg)
+        self._dead_seqs.add(msg.seq)
         if self.dead_letter_handler is not None:
             try:
                 self.dead_letter_handler(msg)
@@ -73,7 +84,10 @@ class EndpointQueue:
                     "dead-letter handler failed for task %s", msg.task_id)
 
     def __len__(self) -> int:
-        return len(self._ready)
+        return len(self._ready_seqs)
+
+    def _dead_letter_has(self, seq: int) -> bool:
+        return seq in self._dead_seqs
 
     @property
     def in_flight(self) -> int:
@@ -81,13 +95,14 @@ class EndpointQueue:
 
     def _wake_one(self) -> None:
         while self._waiters:
-            fut = self._waiters.pop(0)
+            fut = self._waiters.popleft()
             if not fut.done():
                 fut.set_result(None)
                 return
 
     def put(self, msg: Message) -> None:
         self._ready.append(msg)
+        self._ready_seqs.add(msg.seq)
         self._wake_one()
 
     async def receive(self, timeout: float | None = None) -> Message | None:
@@ -95,8 +110,11 @@ class EndpointQueue:
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             self._reap_expired_leases()
-            if self._ready:
-                msg = self._ready.pop(0)
+            while self._ready:
+                msg = self._ready.popleft()
+                if msg.seq not in self._ready_seqs:  # retracted (see __init__)
+                    continue
+                self._ready_seqs.discard(msg.seq)
                 msg.delivery_count += 1
                 msg.lease_expires = time.time() + self.lease_seconds
                 self._leased[msg.seq] = msg
@@ -114,9 +132,11 @@ class EndpointQueue:
     def complete(self, msg: Message) -> None:
         if self._leased.pop(msg.seq, None) is None:
             # Lease expired mid-processing and the reaper requeued the
-            # message; retract it so a successfully-processed message is not
-            # delivered again.
-            self._ready = [m for m in self._ready if m.seq != msg.seq]
+            # message; retract it (drop from the logically-ready set) so a
+            # successfully-processed message is not delivered again. If the
+            # message was already re-leased or dead-lettered the seq is not
+            # in the set and this is a no-op.
+            self._ready_seqs.discard(msg.seq)
 
     def abandon(self, msg: Message) -> bool:
         """Return the message for redelivery. False (dead-lettered) once the
@@ -126,12 +146,11 @@ class EndpointQueue:
             # Lease already expired: the reaper has requeued (or
             # dead-lettered) the message; re-appending here would duplicate
             # delivery and double-burn the delivery budget.
-            return not any(m.seq == msg.seq for m in self.dead_letters)
+            return not self._dead_letter_has(msg.seq)
         if msg.delivery_count >= self.max_delivery_count:
             self._dead_letter(msg)
             return False
-        self._ready.append(msg)
-        self._wake_one()
+        self.put(msg)
         return True
 
     def _reap_expired_leases(self) -> None:
@@ -143,6 +162,7 @@ class EndpointQueue:
                 self._dead_letter(msg)
             else:
                 self._ready.append(msg)
+                self._ready_seqs.add(msg.seq)
 
 
 class InMemoryBroker:

@@ -201,8 +201,16 @@ def build_worker(config: FrameworkConfig, models: dict):
         servable = build_servable(family, **spec)
         if checkpoint:
             # Restore real weights at pod start (SURVEY.md §5: the slot the
-            # reference fills by baking weights into container images).
+            # reference fills by baking weights into container images;
+            # ai4e_tpu.train.make_checkpoints produces them). Relative paths
+            # resolve under runtime.checkpoint_dir (AI4E_RUNTIME_CHECKPOINT_DIR,
+            # the chart's volume mount) or the working directory — orbax
+            # requires absolute paths.
+            import os
             from .checkpoint import load_params
+            if not os.path.isabs(checkpoint):
+                checkpoint = os.path.abspath(os.path.join(
+                    rt.checkpoint_dir or ".", checkpoint))
             servable.params = load_params(checkpoint, like=servable.params)
             log.info("restored %s params from %s", servable.name, checkpoint)
         runtime.register(servable)

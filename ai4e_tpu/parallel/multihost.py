@@ -6,29 +6,41 @@ gateway/broker. The reference has no analogue (its NCCL-equivalent plane was
 HTTPS+queues between single-GPU containers, SURVEY.md §5 "distributed
 communication backend"); this is the genuinely-new data plane.
 
-Design (the jax.distributed idiom):
+Design (the jax.distributed idiom), v2 — sharded ingestion:
 
 - every process calls ``init_distributed`` (``parallel.sharding``) so
   ``jax.devices()`` spans the slice, then builds the same ``Mesh``;
 - the **primary** (process 0) runs the platform stack (gateway, broker,
-  batcher). Its batcher executes through ``MultihostRuntime.run_batch`` which
-  first *broadcasts* a work descriptor (model index + real batch) over DCN
-  (``multihost_utils.broadcast_one_to_all``), then enters the model's
-  compiled call — which every process enters too;
-- **followers** run ``follower_loop()``: block on the same broadcast, enter
-  the same call, loop. A sentinel descriptor shuts them down;
+  batcher). Its batcher executes through ``MultihostRuntime.run_batch``:
+  it stages each follower's *own rows* of the batch on a host-local shard
+  feed, broadcasts a small fixed-size work descriptor (model index, sequence
+  number, shape) over DCN (``multihost_utils.broadcast_one_to_all``), then
+  enters the model's compiled call — which every process enters too;
+- **followers** run ``follower_loop()``: block on the descriptor, fetch only
+  the rows their addressable devices own from the primary's feed (an HTTP GET
+  over the control network — batch/N bytes, not the whole batch), assemble
+  the global device array with ``jax.make_array_from_single_device_arrays``,
+  and enter the same call. A sentinel descriptor shuts them down;
 - outputs come back replicated (inference outputs are small — class ids,
   boxes, counts), so the primary reads results locally with no gather on the
   response path.
 
-The broadcast rides XLA's collectives; there is no bespoke socket protocol —
-the "communication backend" is jax.distributed + XLA over ICI/DCN exactly as
-a TPU-native design should be.
+Why not ``multihost_utils.broadcast_one_to_all`` for the payload (the v1
+design): that replicates the *full* batch to every host through a collective
+— O(N x batch) traffic serialized behind host 0, exactly the "must not
+serialize on DCN" failure mode SURVEY.md §7 hard part #3 calls out. Since
+only host 0 ingests requests, batch bytes must leave host 0 once; the feed
+ships each follower only its shard (sum = one batch, the minimum), the
+fetches run in parallel, and the collective carries 13 ints. The descriptor
+broadcast still rides XLA's collectives, which also keeps the SPMD program
+order aligned across processes.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
+import time
 
 import jax
 import numpy as np
@@ -39,10 +51,110 @@ _SHUTDOWN = -1
 # Fixed-rank shape header so the control broadcast is always the same shape
 # (broadcast_one_to_all requires identical pytree structure on every host).
 _MAX_RANK = 8
+# Staged shards older than this many sequence numbers are pruned (a follower
+# that died mid-fetch must not leak primary memory forever).
+_FEED_WINDOW = 8
 
 
 def is_primary() -> bool:
     return jax.process_index() == 0
+
+
+class _ShardFeed:
+    """Host-local HTTP server on the primary staging per-follower batch rows.
+
+    One GET per (sequence, process): ``/shard/{seq}/{proc}`` -> raw bytes.
+    Entries live until ``_FEED_WINDOW`` newer batches have been staged, so a
+    retried fetch (dropped connection) still succeeds.
+    """
+
+    def __init__(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        feed = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                parts = self.path.strip("/").split("/")
+                payload = None
+                if len(parts) == 3 and parts[0] == "shard":
+                    with feed._lock:
+                        payload = feed._staged.get(
+                            (int(parts[1]), int(parts[2])))
+                if payload is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._staged: dict[tuple[int, int], bytes] = {}
+        self._lock = threading.Lock()
+        self._server = ThreadingHTTPServer(("0.0.0.0", 0), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="ai4e-shard-feed", daemon=True)
+        self._thread.start()
+
+    def stage(self, seq: int, proc: int, payload: bytes) -> None:
+        with self._lock:
+            self._staged[(seq, proc)] = payload
+            for key in [k for k in self._staged if k[0] <= seq - _FEED_WINDOW]:
+                del self._staged[key]
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _fetch(url: str, timeout_s: float = 60.0) -> bytes:
+    """GET with retry — the shard is staged before the descriptor broadcast,
+    so 404 only means a transient reordering/hiccup, not absence."""
+    import urllib.error
+    import urllib.request
+
+    deadline = time.monotonic() + timeout_s
+    delay = 0.02
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.read()
+        except (urllib.error.URLError, OSError) as e:
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"shard fetch {url} failed: {e}") from e
+            time.sleep(delay)
+            delay = min(delay * 2, 0.5)
+
+
+def _dim0_range(idx, global_shape) -> tuple[int, int]:
+    s0 = idx[0] if idx else slice(None)
+    start = s0.start if s0.start is not None else 0
+    stop = s0.stop if s0.stop is not None else global_shape[0]
+    return int(start), int(stop)
+
+
+def _rows_by_process(sharding, global_shape) -> dict[int, list[tuple[int, int]]]:
+    """dim-0 row ranges each process's devices own, deduped and sorted.
+
+    Batch shardings split only the leading dim (``registry.py`` shards
+    ``P(("dp","fsdp"), None...)``); replicated axes (tp/sp/ep) make several
+    devices own identical ranges — deduped here so a host never receives the
+    same rows twice.
+    """
+    per: dict[int, set] = {}
+    for d, idx in sharding.devices_indices_map(global_shape).items():
+        for s in idx[1:]:
+            assert s.start in (None, 0) and s.stop in (None,) + tuple(
+                global_shape[1:]), (
+                f"non-batch dim sharded in {idx}; shard feed only splits dim 0")
+        per.setdefault(d.process_index, set()).add(
+            _dim0_range(idx, global_shape))
+    return {p: sorted(v) for p, v in per.items()}
 
 
 class MultihostRuntime:
@@ -58,9 +170,20 @@ class MultihostRuntime:
         self._names = list(runtime.models)
         # The batcher may pipeline two batches on separate executor threads;
         # followers replay broadcasts strictly in order, so the primary's
-        # descriptor+batch+execute sequence must be serialised.
-        import threading
+        # stage+descriptor+execute sequence must be serialised.
         self._order_lock = threading.Lock()
+        self._seq = 0
+        self._plans: dict[tuple[str, tuple], dict] = {}
+        self._feed = None
+        self._feed_url = None
+        # Observability for the "don't serialize on DCN" requirement:
+        # bytes the primary shipped for the last batch / in total, and the
+        # last ingest (stage+descriptor or fetch+assemble) wall seconds.
+        self.last_egress_bytes = 0
+        self.total_egress_bytes = 0
+        self.last_ingest_s = 0.0
+        if jax.process_count() > 1:
+            self._open_feed()
 
     # Pass-throughs so the micro-batcher (and launcher logging) can treat
     # this exactly like a ModelRuntime.
@@ -71,6 +194,31 @@ class MultihostRuntime:
     @property
     def mesh(self):
         return self.runtime.mesh
+
+    def _open_feed(self) -> None:
+        """Primary opens the shard feed; everyone learns its address via one
+        construction-time collective (port + advertise IP as int32s)."""
+        import os
+        import socket
+
+        from jax.experimental import multihost_utils
+
+        addr = np.zeros((5,), np.int32)
+        if is_primary():
+            self._feed = _ShardFeed()
+            ip = os.environ.get("AI4E_FEED_ADVERTISE_IP")
+            if not ip:
+                try:
+                    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                        s.connect(("8.8.8.8", 80))  # no packet sent (UDP)
+                        ip = s.getsockname()[0]
+                except OSError:
+                    ip = "127.0.0.1"
+            addr[0] = self._feed.port
+            addr[1:5] = [int(o) for o in ip.split(".")]
+        addr = np.asarray(multihost_utils.broadcast_one_to_all(addr))
+        self._feed_url = (f"http://{addr[1]}.{addr[2]}.{addr[3]}.{addr[4]}"
+                          f":{addr[0]}")
 
     def _model_index(self, name: str) -> int:
         # No refresh-on-miss: followers' name tables are frozen at
@@ -83,6 +231,24 @@ class MultihostRuntime:
                 f"model {name!r} registered after MultihostRuntime was "
                 "built; register every model before wrapping") from None
 
+    def _plan(self, name: str, global_shape: tuple):
+        key = (name, tuple(global_shape))
+        if key not in self._plans:
+            sharding = self.runtime.models[name]._batch_sharding
+            self._plans[key] = _rows_by_process(sharding, global_shape)
+        return self._plans[key]
+
+    def _assemble(self, name: str, global_shape, dtype, rows_lookup):
+        """Build the global device array from this process's rows only."""
+        sharding = self.runtime.models[name]._batch_sharding
+        arrays = []
+        amap = sharding.addressable_devices_indices_map(tuple(global_shape))
+        for d, idx in amap.items():
+            start, stop = _dim0_range(idx, global_shape)
+            arrays.append(jax.device_put(rows_lookup(start, stop), d))
+        return jax.make_array_from_single_device_arrays(
+            tuple(global_shape), sharding, arrays)
+
     # -- primary side (called by the micro-batcher's executor thread) -------
 
     def run_batch(self, model_name: str, batch: np.ndarray):
@@ -91,15 +257,34 @@ class MultihostRuntime:
         if not is_primary():
             raise RuntimeError(
                 "run_batch on a follower host — followers run follower_loop()")
+        batch = np.ascontiguousarray(batch)
         with self._order_lock:
-            self._broadcast_descriptor(self._model_index(model_name), batch)
-            _ = self._broadcast_batch(batch)
-            return self.runtime.run_batch(model_name, batch)
+            t0 = time.perf_counter()
+            self._seq += 1
+            plan = self._plan(model_name, batch.shape)
+            egress = 0
+            for proc, ranges in plan.items():
+                if proc == jax.process_index():
+                    continue
+                payload = np.concatenate(
+                    [batch[a:b] for a, b in ranges]).tobytes()
+                self._feed.stage(self._seq, proc, payload)
+                egress += len(payload)
+            self.last_egress_bytes = egress
+            self.total_egress_bytes += egress
+            self._broadcast_descriptor(
+                self._model_index(model_name), self._seq, batch)
+            garr = self._assemble(model_name, batch.shape, batch.dtype,
+                                  lambda a, b: batch[a:b])
+            self.last_ingest_s = time.perf_counter() - t0
+            return self.runtime.run_batch(model_name, garr)
 
     def shutdown_followers(self) -> None:
         if jax.process_count() > 1 and is_primary():
             with self._order_lock:
-                self._broadcast_descriptor(_SHUTDOWN, None)
+                self._broadcast_descriptor(_SHUTDOWN, 0, None)
+                if self._feed is not None:
+                    self._feed.shutdown()
 
     # -- follower side -------------------------------------------------------
 
@@ -107,14 +292,52 @@ class MultihostRuntime:
         """Run on every non-primary process: mirror the primary's batch
         executions until the shutdown sentinel arrives."""
         assert not is_primary(), "primary must not enter follower_loop"
+        me = jax.process_index()
         while True:
-            model_idx, shape, dtype = self._receive_descriptor()
+            model_idx, seq, shape, dtype = self._receive_descriptor()
             if model_idx == _SHUTDOWN:
-                log.info("follower %d: shutdown", jax.process_index())
+                log.info("follower %d: shutdown", me)
                 return
-            batch = self._broadcast_batch(
-                np.zeros(shape, dtype))  # payload comes from the broadcast
+            t0 = time.perf_counter()
             name = self._names[model_idx]
+            ranges = self._plan(name, shape).get(me, [])
+            try:
+                raw = (_fetch(f"{self._feed_url}/shard/{seq}/{me}")
+                       if ranges else b"")
+                rows = np.frombuffer(raw, dtype).reshape(-1, *shape[1:])
+                offsets = {}
+                at = 0
+                for a, b in ranges:
+                    offsets[(a, b)] = at
+                    at += b - a
+                if at != rows.shape[0]:
+                    raise RuntimeError(
+                        f"feed sent {rows.shape[0]} rows, plan wants {at}")
+            except Exception:  # noqa: BLE001 — a dead fetch must NOT desync
+                # Every process must still enter the same compiled call or
+                # the primary's next collective waits on a missing
+                # participant and the whole slice deadlocks. Degrade to a
+                # zeros shard: this follower's rows of THIS batch come back
+                # wrong (surfaced loudly here; the affected tasks fail or
+                # mis-score), but the slice lives and the next batch heals.
+                log.exception(
+                    "follower %d: shard fetch for %s seq %d failed; running "
+                    "with a ZEROS shard to keep the slice in lockstep — "
+                    "results for this batch's local rows are invalid",
+                    me, name, seq)
+                rows = np.zeros((sum(b - a for a, b in ranges), *shape[1:]),
+                                dtype)
+                offsets, at = {}, 0
+                for a, b in ranges:
+                    offsets[(a, b)] = at
+                    at += b - a
+
+            def lookup(a, b):
+                o = offsets[(a, b)]
+                return rows[o:o + (b - a)]
+
+            batch = self._assemble(name, shape, dtype, lookup)
+            self.last_ingest_s = time.perf_counter() - t0
             try:
                 self.runtime.run_batch(name, batch)
             except Exception:  # noqa: BLE001 — mirror the primary's policy
@@ -123,33 +346,30 @@ class MultihostRuntime:
                 # would leave the next broadcast waiting on a missing
                 # participant and hang the whole slice.
                 log.exception("follower %d: batch for %s failed; continuing",
-                              jax.process_index(), name)
+                              me, name)
 
-    # -- wire (XLA collectives over DCN) ------------------------------------
+    # -- wire (descriptor: XLA collective; payload: shard feed) --------------
 
-    def _broadcast_descriptor(self, model_idx: int, batch) -> None:
+    def _broadcast_descriptor(self, model_idx: int, seq: int, batch) -> None:
         from jax.experimental import multihost_utils
-        header = np.full((2 + _MAX_RANK,), 0, np.int32)
+        header = np.full((3 + _MAX_RANK,), 0, np.int32)
         header[0] = model_idx
+        header[1] = seq
         if batch is not None:
-            header[1] = _dtype_code(batch.dtype)
+            header[2] = _dtype_code(batch.dtype)
             rank = batch.ndim
-            header[2:2 + rank] = batch.shape
+            header[3:3 + rank] = batch.shape
         multihost_utils.broadcast_one_to_all(header)
 
     def _receive_descriptor(self):
         from jax.experimental import multihost_utils
         header = np.asarray(multihost_utils.broadcast_one_to_all(
-            np.zeros((2 + _MAX_RANK,), np.int32)))
+            np.zeros((3 + _MAX_RANK,), np.int32)))
         model_idx = int(header[0])
         if model_idx == _SHUTDOWN:
-            return model_idx, None, None
-        shape = tuple(int(d) for d in header[2:] if d > 0)
-        return model_idx, shape, _code_dtype(int(header[1]))
-
-    def _broadcast_batch(self, batch: np.ndarray) -> np.ndarray:
-        from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.broadcast_one_to_all(batch))
+            return model_idx, 0, None, None
+        shape = tuple(int(d) for d in header[3:] if d > 0)
+        return model_idx, int(header[1]), shape, _code_dtype(int(header[2]))
 
 
 _DTYPES = [np.float32, np.float16, np.uint8, np.int32, np.int8]

@@ -139,9 +139,11 @@ class ModelRuntime:
         """Execute one padded batch; blocking (call from an executor)."""
         servable = self.models[name]
         if jax.process_count() > 1 and isinstance(batch, np.ndarray):
-            # Every process holds the identical full batch (broadcast by
-            # MultihostRuntime); carve out this process's shards to form the
-            # global device array the multi-host jit requires.
+            # A raw numpy batch on a multi-host slice means every process
+            # holds the identical full array (warmup dummies); carve out this
+            # process's shards to form the global device array the multi-host
+            # jit requires. Serving batches arrive pre-assembled as global
+            # jax.Arrays from MultihostRuntime's sharded ingestion.
             batch = jax.make_array_from_process_local_data(
                 servable._batch_sharding, batch, global_shape=batch.shape)
         out = servable._compiled(servable.params, batch)

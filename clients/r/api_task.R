@@ -21,9 +21,11 @@
 #   tm$UpdateTaskStatus(status$TaskId, "running - 10% complete")
 #   tm$CompleteTask(status$TaskId, "completed")
 #
-# NOTE: this environment has no R toolchain, so this client ships untested;
-# it is exercised against the same HTTP contract the tested Python
-# SyncTaskManager (ai4e_tpu/service/sync_client.py) uses.
+# NOTE: this environment has no R toolchain, so this client is validated at
+# the wire level instead of executed: tests/test_r_client_contract.py replays
+# the exact requests each verb below emits (captured as fixtures in
+# tests/fixtures/r_client_wire.json, with line cites back into this file)
+# against the real task-store service. Surface drift fails that test.
 
 library(httr)
 library(jsonlite)

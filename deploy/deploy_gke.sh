@@ -34,4 +34,13 @@ fi
 
 gcloud container clusters get-credentials "$CLUSTER_NAME" --zone "$ZONE" \
     --project "$PROJECT_ID"
+
+# Weights volume (the reference bakes weights into container images,
+# prod-values.yaml:35-36; here they ship as data the worker chart mounts at
+# AI4E_CHECKPOINT_DIR). Populate once from a machine with the repo:
+#   python -m ai4e_tpu.train.make_checkpoints --out checkpoints
+#   kubectl cp checkpoints <a worker pod>:/var/lib/ai4e-checkpoints
+# or bake them into the PD image your provisioner clones.
+kubectl apply -f charts/checkpoints-pvc.yaml
+
 echo "==> cluster ready"

@@ -91,8 +91,10 @@ class TestMultiProcess:
                 env=env, stdout=subprocess.DEVNULL,
                 stderr=subprocess.STDOUT))
 
-            wait_http(f"{cp_base}/healthz", timeout=30)
-            wait_http(f"{wk_base}/v1/echo/", timeout=60)
+            wait_http(f"{cp_base}/healthz", timeout=60)
+            # Generous: worker start pays jit warmup, and a loaded CI host
+            # (parallel compile jobs) can stretch it well past 60s.
+            wait_http(f"{wk_base}/v1/echo/", timeout=150)
 
             payload = io.BytesIO()
             np.save(payload, np.arange(16, dtype=np.float32))

@@ -133,3 +133,25 @@ class TestFlashAttention:
         _np.testing.assert_allclose(
             _np.asarray(model_flash.apply(params, x)),
             _np.asarray(model_full.apply(params, x)), rtol=2e-2, atol=2e-2)
+
+
+class TestValidationHarness:
+    def test_validate_kernels_in_interpreter(self):
+        """The on-device validation harness (ops/pallas/validate.py — run by
+        bench.py on real TPU) must itself be correct: same checks under the
+        pallas interpreter pass, and the VMEM accounting stays in budget."""
+        from ai4e_tpu.ops.pallas.validate import (
+            VMEM_BUDGET_BYTES,
+            flash_attention_vmem_bytes,
+            validate_kernels,
+        )
+
+        results = validate_kernels(interpret=True)
+        assert results["all_ok"], results
+        for name in ("flash_attention", "segmentation_argmax",
+                     "normalize_image"):
+            assert results[name]["vmem_bytes"] <= VMEM_BUDGET_BYTES
+        # The flash kernel's footprint depends only on block sizes and head
+        # dim — never sequence length (the k-axis is a grid axis) — so even
+        # the largest serving config (d=128) fits comfortably.
+        assert flash_attention_vmem_bytes(128, 128, 128) <= VMEM_BUDGET_BYTES

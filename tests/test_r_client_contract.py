@@ -1,0 +1,99 @@
+"""R task-manager client contract test (VERDICT r1 missing #3).
+
+This environment has no R toolchain, so ``clients/r/api_task.R`` cannot be
+executed directly. Instead the exact HTTP requests the R client emits —
+method, path, query, content type, jsonlite-serialised body (auto_unbox,
+NULL -> null) — are captured as fixtures (``tests/fixtures/r_client_wire.json``,
+each entry citing the api_task.R lines it mirrors) and replayed against the
+real task-store service (``ai4e_tpu/taskstore/http.py``). If the store's
+surface drifts from what the R code sends/expects, this fails.
+"""
+
+import asyncio
+import json
+import os
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.taskstore import InMemoryTaskStore
+from ai4e_tpu.taskstore.http import make_app
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "r_client_wire.json")
+
+
+def _sub(value, captures):
+    if isinstance(value, str):
+        for key, got in captures.items():
+            value = value.replace("{%s}" % key, got)
+        return value
+    if isinstance(value, dict):
+        return {k: _sub(v, captures) for k, v in value.items()}
+    return value
+
+
+class TestRClientContract:
+    def test_replay_r_wire_requests(self):
+        asyncio.run(self._replay())
+
+    async def _replay(self):
+        with open(FIXTURES) as f:
+            spec = json.load(f)
+
+        published = []
+        store = InMemoryTaskStore(publisher=published.append)
+        client = TestClient(TestServer(make_app(store)))
+        await client.start_server()
+        captures: dict[str, str] = {}
+        try:
+            for req in spec["requests"]:
+                name = req["name"]
+                path = req["path"]
+                query = _sub(req.get("query", {}), captures)
+                if req["method"] == "GET":
+                    resp = await client.get(path, params=query)
+                else:
+                    if "json" in req:
+                        body = json.dumps(_sub(req["json"], captures))
+                    else:
+                        body = req["raw_body"]
+                    resp = await client.post(
+                        path, params=query, data=body.encode(),
+                        headers={"Content-Type": req["content_type"]})
+                expect = req["expect"]
+                assert resp.status == expect["status"], (
+                    f"{name}: HTTP {resp.status} != {expect['status']} "
+                    f"({await resp.text()})")
+                if resp.status == 200 and path != "/v1/taskstore/result":
+                    doc = await resp.json()
+                    for field, want in _sub(
+                            expect.get("fields", {}), captures).items():
+                        assert doc.get(field) == want, (
+                            f"{name}: {field}={doc.get(field)!r} != {want!r}")
+                    if "capture" in expect:
+                        captures[expect["capture"]] = doc["TaskId"]
+                await resp.release()
+
+            # Cross-request invariants the R client relies on:
+            # AddTask-with-taskId created nothing new (api_task.R:64-67) —
+            # exactly two tasks exist (TID and TID2).
+            assert len({captures["TID"], captures["TID2"]}) == 2
+            depths = store.depths()
+            total = sum(sum(d.values()) for d in depths.values())
+            assert total == 2, depths
+
+            # The result SetTaskResult stored is retrievable verbatim.
+            found = store.get_result(captures["TID"])
+            assert found is not None
+            body, content_type = found
+            assert json.loads(body) == {"detections": []}
+            assert content_type == "application/json"
+
+            # AddPipelineTask republished under the SAME TaskId with the
+            # ORIGINAL body replayed (api_task.R:96-108 / the reference's
+            # CacheConnectorUpsert.cs:144-176 {taskId}_ORIG semantics).
+            assert [t.task_id for t in published] == [captures["TID2"]] * 2
+            assert published[1].endpoint == "/v1/rorg/classifier"
+            assert published[1].body == published[0].body != b""
+        finally:
+            await client.close()
