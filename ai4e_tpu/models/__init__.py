@@ -1,4 +1,5 @@
 from .detector import CenterNetDetector, create_detector, decode_detections
+from .moe import MOE_EP_RULES, MoEClassifier, create_moe
 from .resnet import ResNet, create_resnet50
 from .seqformer import SeqFormer, attention_for, create_seqformer
 from .unet import UNet, create_unet, segment_logits_to_classes
@@ -8,6 +9,9 @@ __all__ = [
     "CenterNetDetector",
     "create_detector",
     "decode_detections",
+    "MOE_EP_RULES",
+    "MoEClassifier",
+    "create_moe",
     "ResNet",
     "create_resnet50",
     "SeqFormer",

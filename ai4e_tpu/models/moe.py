@@ -1,0 +1,145 @@
+"""Mixture-of-Experts sequence classifier — the expert-parallel (``ep``)
+model family.
+
+The reference has no MoE (or any model internals — containers are opaque);
+this family exists so the mesh's ``ep`` axis (``parallel/sharding.py`` AXES)
+is exercised by a real servable, the same way seqformer exercises ``sp``.
+
+Design (TPU-first):
+
+- **Routing** is top-1 token-choice, computed as a dense one-hot combine —
+  every expert runs over every token and the gate zeroes the losers. That is
+  E× the FLOPs of capacity-based dispatch, but it is fully static (no
+  data-dependent shapes, no token dropping, bitwise deterministic), which is
+  what XLA wants; at serving-size expert counts (4-16) the MXU is still the
+  bottleneck and the win is sharding, not sparsity.
+- **Expert parallelism**: expert weight tensors are (E, D, H) with
+  ``P("ep", None, None)`` — each ep shard holds E/ep experts and computes
+  only their einsum slices; the token-combine contraction reduces over E, so
+  XLA inserts one ``psum`` over ``ep`` per MoE layer (ICI traffic: one (B,
+  S, D) activation — the standard MoE all-reduce pattern).
+- Everything else (attention, norms) replicates over ``ep``, so the family
+  composes with dp/fsdp/tp exactly like the dense families.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+# Param-path rules for shard_params: expert-major tensors over ep.
+MOE_EP_RULES = {
+    "moe/up": P("ep", None, None),
+    "moe/down": P("ep", None, None),
+}
+
+
+class MoEFFN(nn.Module):
+    dim: int
+    num_experts: int
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):  # (B, S, D)
+        hidden = self.dim * self.mlp_ratio
+        # Router in float32: gate ordering must not wobble with bf16 noise.
+        logits = nn.Dense(self.num_experts, dtype=jnp.float32,
+                          name="router")(x.astype(jnp.float32))
+        gates = jax.nn.softmax(logits, axis=-1)            # (B, S, E)
+        top = jnp.argmax(gates, axis=-1)                   # (B, S)
+        dispatch = (jax.nn.one_hot(top, self.num_experts, dtype=jnp.float32)
+                    * jnp.max(gates, axis=-1, keepdims=True))
+
+        up = self.param("up", nn.initializers.lecun_normal(),
+                        (self.num_experts, self.dim, hidden))
+        down = self.param("down", nn.initializers.lecun_normal(),
+                          (self.num_experts, hidden, self.dim))
+        xb = x.astype(self.dtype)
+        # e is sharded over ep: each shard computes its experts' slices...
+        h = jnp.einsum("bsd,edh->bseh", xb, up.astype(self.dtype))
+        h = nn.gelu(h)
+        out = jnp.einsum("bseh,ehd->bsed", h, down.astype(self.dtype))
+        # ...and this contraction reduces over e → one psum over ep.
+        y = jnp.einsum("bsed,bse->bsd", out.astype(jnp.float32), dispatch)
+        return y.astype(x.dtype), top
+
+
+class MoEBlock(nn.Module):
+    dim: int
+    heads: int
+    num_experts: int
+    attn_fn: Callable
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from .seqformer import SeqAttention
+        x = x + SeqAttention(self.dim, self.heads, self.attn_fn,
+                             dtype=self.dtype, name="attn")(nn.LayerNorm()(x))
+        h, top = MoEFFN(self.dim, self.num_experts, dtype=self.dtype,
+                        name="moe")(nn.LayerNorm()(x))
+        return x + h, top
+
+
+class MoEClassifier(nn.Module):
+    """(B, S, input_dim) → (B, num_classes) with MoE FFNs."""
+
+    seq_len: int
+    input_dim: int
+    dim: int = 128
+    depth: int = 2
+    heads: int = 8
+    num_experts: int = 8
+    num_classes: int = 16
+    attn_fn: Callable = None
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from ..parallel.ring_attention import reference_attention
+        attn_fn = self.attn_fn or reference_attention
+        h = nn.Dense(self.dim, dtype=self.dtype, name="embed")(x)
+        pos = self.param("pos_emb", nn.initializers.normal(0.02),
+                         (1, self.seq_len, self.dim))
+        h = h + pos.astype(self.dtype)
+        for i in range(self.depth):
+            h, _ = MoEBlock(self.dim, self.heads, self.num_experts, attn_fn,
+                            dtype=self.dtype, name=f"block{i}")(h)
+        h = nn.LayerNorm()(h.mean(axis=1))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(h)
+
+
+def create_moe(rng=None, seq_len: int = 1024, input_dim: int = 64,
+               dim: int = 128, depth: int = 2, heads: int = 8,
+               num_experts: int = 8, num_classes: int = 16, mesh=None,
+               attention: str = "flash"):
+    """Build model + params; on a mesh with ep > 1 the expert tensors are
+    placed with ``MOE_EP_RULES`` so serving/training shard the expert dim.
+
+    ``num_experts`` must divide by the mesh's ep size (static SPMD shapes).
+    """
+    from .seqformer import attention_for
+
+    if mesh is not None:
+        ep = mesh.shape.get("ep", 1)
+        if num_experts % max(ep, 1):
+            raise ValueError(
+                f"num_experts {num_experts} not divisible by ep={ep}")
+    model = MoEClassifier(
+        seq_len=seq_len, input_dim=input_dim, dim=dim, depth=depth,
+        heads=heads, num_experts=num_experts, num_classes=num_classes,
+        attn_fn=attention_for(mesh, attention))
+    init_model = model.clone(attn_fn=lambda q, k, v: q)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    params = init_model.init(rng,
+                             np.zeros((1, seq_len, input_dim), np.float32))
+    if mesh is not None and mesh.shape.get("ep", 1) > 1:
+        from ..parallel.sharding import shard_params
+        params = shard_params(params, mesh, MOE_EP_RULES)
+    return model, params

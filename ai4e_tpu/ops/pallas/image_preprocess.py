@@ -38,9 +38,17 @@ def normalize_image(images: jax.Array, mean=None, std=None,
         raise ValueError(f"expected uint8 input, got {images.dtype}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    # Largest divisor of H within the target keeps the grid exact for
+    # non-multiple-of-64 sizes (224 → 56, 512 → 64) — but never below the
+    # 8-sublane minimum Mosaic tiles f32 at: a prime-ish H would otherwise
+    # silently degrade to (1, W·C) blocks and fail/crawl on device.
     tile_h = min(tile_h, h)
+    while h % tile_h and tile_h > 8:
+        tile_h -= 1
     if h % tile_h:
-        raise ValueError(f"H={h} not divisible by tile_h={tile_h}")
+        raise ValueError(
+            f"H={h} has no tile divisor >= 8; pad the image height "
+            "(e.g. to a multiple of 8) before normalize_image")
     mean = jnp.asarray([0.0] * c if mean is None else mean, jnp.float32)
     std = jnp.asarray([1.0] * c if std is None else std, jnp.float32)
 

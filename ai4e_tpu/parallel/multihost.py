@@ -68,13 +68,23 @@ class _ShardFeed:
     retried fetch (dropped connection) still succeeds.
     """
 
-    def __init__(self):
+    def __init__(self, token: bytes):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         feed = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
+                # Bearer-token gate: the feed serves raw request payloads,
+                # and binds wide so followers reach it over DCN — anything
+                # without the slice's construction-time token gets 403.
+                import hmac
+                if not hmac.compare_digest(
+                        self.headers.get("X-AI4E-Feed-Token", ""),
+                        feed.token_str):
+                    self.send_response(403)
+                    self.end_headers()
+                    return
                 parts = self.path.strip("/").split("/")
                 payload = None
                 if len(parts) == 3 and parts[0] == "shard":
@@ -93,6 +103,7 @@ class _ShardFeed:
             def log_message(self, *a):  # quiet
                 pass
 
+        self.token_str = token.hex()
         self._staged: dict[tuple[int, int], bytes] = {}
         self._lock = threading.Lock()
         self._server = ThreadingHTTPServer(("0.0.0.0", 0), Handler)
@@ -112,7 +123,7 @@ class _ShardFeed:
         self._server.server_close()
 
 
-def _fetch(url: str, timeout_s: float = 60.0) -> bytes:
+def _fetch(url: str, token: str, timeout_s: float = 60.0) -> bytes:
     """GET with retry — the shard is staged before the descriptor broadcast,
     so 404 only means a transient reordering/hiccup, not absence."""
     import urllib.error
@@ -122,7 +133,9 @@ def _fetch(url: str, timeout_s: float = 60.0) -> bytes:
     delay = 0.02
     while True:
         try:
-            with urllib.request.urlopen(url, timeout=10) as resp:
+            req = urllib.request.Request(
+                url, headers={"X-AI4E-Feed-Token": token})
+            with urllib.request.urlopen(req, timeout=10) as resp:
                 return resp.read()
         except (urllib.error.URLError, OSError) as e:
             if time.monotonic() >= deadline:
@@ -196,16 +209,18 @@ class MultihostRuntime:
         return self.runtime.mesh
 
     def _open_feed(self) -> None:
-        """Primary opens the shard feed; everyone learns its address via one
-        construction-time collective (port + advertise IP as int32s)."""
+        """Primary opens the shard feed; everyone learns its address and the
+        feed's bearer token via one construction-time collective (port +
+        advertise IP + 16 token bytes as int32s)."""
         import os
         import socket
 
         from jax.experimental import multihost_utils
 
-        addr = np.zeros((5,), np.int32)
+        addr = np.zeros((21,), np.int32)
         if is_primary():
-            self._feed = _ShardFeed()
+            token = os.urandom(16)
+            self._feed = _ShardFeed(token)
             ip = os.environ.get("AI4E_FEED_ADVERTISE_IP")
             if not ip:
                 try:
@@ -216,9 +231,11 @@ class MultihostRuntime:
                     ip = "127.0.0.1"
             addr[0] = self._feed.port
             addr[1:5] = [int(o) for o in ip.split(".")]
+            addr[5:21] = np.frombuffer(token, np.uint8)
         addr = np.asarray(multihost_utils.broadcast_one_to_all(addr))
         self._feed_url = (f"http://{addr[1]}.{addr[2]}.{addr[3]}.{addr[4]}"
                           f":{addr[0]}")
+        self._feed_token = bytes(addr[5:21].astype(np.uint8)).hex()
 
     def _model_index(self, name: str) -> int:
         # No refresh-on-miss: followers' name tables are frozen at
@@ -301,15 +318,16 @@ class MultihostRuntime:
             t0 = time.perf_counter()
             name = self._names[model_idx]
             ranges = self._plan(name, shape).get(me, [])
+            offsets = {}
+            at = 0
+            for a, b in ranges:
+                offsets[(a, b)] = at
+                at += b - a
             try:
-                raw = (_fetch(f"{self._feed_url}/shard/{seq}/{me}")
+                raw = (_fetch(f"{self._feed_url}/shard/{seq}/{me}",
+                              self._feed_token)
                        if ranges else b"")
                 rows = np.frombuffer(raw, dtype).reshape(-1, *shape[1:])
-                offsets = {}
-                at = 0
-                for a, b in ranges:
-                    offsets[(a, b)] = at
-                    at += b - a
                 if at != rows.shape[0]:
                     raise RuntimeError(
                         f"feed sent {rows.shape[0]} rows, plan wants {at}")
@@ -325,12 +343,7 @@ class MultihostRuntime:
                     "with a ZEROS shard to keep the slice in lockstep — "
                     "results for this batch's local rows are invalid",
                     me, name, seq)
-                rows = np.zeros((sum(b - a for a, b in ranges), *shape[1:]),
-                                dtype)
-                offsets, at = {}, 0
-                for a, b in ranges:
-                    offsets[(a, b)] = at
-                    at += b - a
+                rows = np.zeros((at, *shape[1:]), dtype)
 
             def lookup(a, b):
                 o = offsets[(a, b)]

@@ -60,6 +60,10 @@ def _image_preprocess(shape: tuple, dtype=np.float32):
         arr = np.load(io.BytesIO(body))
         if arr.shape != shape:
             raise ValueError(f"expected {shape}, got {arr.shape}")
+        if np.dtype(dtype) == np.uint8 and arr.dtype != np.uint8:
+            # Float [0,1] payload to a uint8-ingesting model: scale, don't
+            # truncate (astype alone would zero the image).
+            return np.clip(np.round(arr * 255.0), 0, 255).astype(np.uint8)
         return arr.astype(dtype)
 
     return preprocess
@@ -144,8 +148,16 @@ def build_unet(name: str = "landcover", tile: int = 256,
 def build_resnet(name: str = "classifier", image_size: int = 224,
                  num_classes: int = 1000, stage_sizes=(3, 4, 6, 3),
                  width: int = 64, labels: list | None = None,
-                 buckets=(1, 16, 64), **_) -> ServableModel:
-    """Batched species classification (BASELINE.json config #4)."""
+                 buckets=(1, 16, 64), fused_normalize: bool = True,
+                 **_) -> ServableModel:
+    """Batched species classification (BASELINE.json config #4).
+
+    ``fused_normalize`` (default): clients ship uint8 pixels — 4x less
+    transfer + host copy than float32 — and the cast/scale to [0,1] runs
+    on-device in one VMEM pass (``ops/pallas/normalize_image``), the same
+    ingestion design as the landcover bench path. Weights are unaffected
+    (normalization reproduces the float input the model trained on).
+    """
     from ..models.resnet import ResNet
 
     model = ResNet(stage_sizes=tuple(stage_sizes), num_classes=num_classes,
@@ -163,18 +175,37 @@ def build_resnet(name: str = "classifier", image_size: int = 224,
                 "label": labels[top] if labels else str(top),
                 "confidence": float(probs[top])}
 
+    apply_fn, input_dtype = _maybe_fused_uint8(model.apply, fused_normalize)
     return ServableModel(
-        name=name, apply_fn=model.apply, params=variables,
-        input_shape=(image_size, image_size, 3),
-        preprocess=_image_preprocess((image_size, image_size, 3)),
+        name=name, apply_fn=apply_fn, params=variables,
+        input_shape=(image_size, image_size, 3), input_dtype=input_dtype,
+        preprocess=_image_preprocess((image_size, image_size, 3),
+                                     input_dtype),
         postprocess=postprocess, batch_buckets=tuple(buckets))
+
+
+def _maybe_fused_uint8(apply_fn, fused: bool):
+    """uint8-ingestion wrapper: on-device normalize to [0,1] before the
+    model (ops/pallas/normalize_image); returns (apply_fn, input_dtype)."""
+    if not fused:
+        return apply_fn, np.float32
+    from ..ops.pallas import normalize_image
+
+    def fused_apply(p, batch):
+        return apply_fn(p, normalize_image(batch))
+
+    return fused_apply, np.uint8
 
 
 def build_detector(name: str = "megadetector", image_size: int = 512,
                    widths=(64, 128, 256), max_detections: int = 64,
                    score_threshold: float = 0.2, buckets=(1, 8, 16),
-                   **_) -> ServableModel:
-    """Camera-trap detection (BASELINE.json config #3, MegaDetector slot)."""
+                   fused_normalize: bool = True, **_) -> ServableModel:
+    """Camera-trap detection (BASELINE.json config #3, MegaDetector slot).
+
+    ``fused_normalize``: uint8 ingestion + on-device [0,1] scaling (see
+    ``build_resnet``) — a camera-trap JPEG pipeline ships bytes, not floats.
+    """
     from ..models import CenterNetDetector, decode_detections
 
     model = CenterNetDetector(widths=tuple(widths))
@@ -194,10 +225,12 @@ def build_detector(name: str = "megadetector", image_size: int = 512,
              "class_id": int(np.asarray(out["classes"])[i])}
             for i in np.nonzero(keep)[0]]}
 
+    apply_fn, input_dtype = _maybe_fused_uint8(apply_fn, fused_normalize)
     return ServableModel(
         name=name, apply_fn=apply_fn, params=params,
-        input_shape=(image_size, image_size, 3),
-        preprocess=_image_preprocess((image_size, image_size, 3)),
+        input_shape=(image_size, image_size, 3), input_dtype=input_dtype,
+        preprocess=_image_preprocess((image_size, image_size, 3),
+                                     input_dtype),
         postprocess=postprocess, batch_buckets=tuple(buckets))
 
 
@@ -251,6 +284,29 @@ def build_seqformer(name: str = "longcontext", seq_len: int = 4096,
         postprocess=postprocess, batch_buckets=tuple(buckets))
 
 
+def build_moe(name: str = "moe", seq_len: int = 1024, input_dim: int = 64,
+              dim: int = 128, depth: int = 2, heads: int = 8,
+              num_experts: int = 8, num_classes: int = 16,
+              attention: str = "flash", buckets=(1, 8), mesh=None,
+              **_) -> ServableModel:
+    """Mixture-of-Experts sequence classification — the expert-parallel
+    family: expert tensors shard over the mesh's ``ep`` axis
+    (``models/moe.py``), composing with dp/fsdp exactly like seqformer's sp."""
+    from ..models.moe import create_moe
+
+    model, params = create_moe(
+        seq_len=seq_len, input_dim=input_dim, dim=dim, depth=depth,
+        heads=heads, num_experts=num_experts, num_classes=num_classes,
+        mesh=mesh, attention=attention)
+
+    return ServableModel(
+        name=name, apply_fn=model.apply, params=params,
+        input_shape=(seq_len, input_dim),
+        preprocess=_npy_preprocess((seq_len, input_dim)),
+        postprocess=_classification_postprocess(),
+        batch_buckets=tuple(buckets))
+
+
 FAMILIES = {
     "echo": build_echo,
     "unet": build_unet,
@@ -258,6 +314,7 @@ FAMILIES = {
     "detector": build_detector,
     "vit": build_vit,
     "seqformer": build_seqformer,
+    "moe": build_moe,
 }
 
 

@@ -78,6 +78,23 @@ def _load_or_train_checkpoint(name: str, ckpt_dir: str, like,
     return params, meta
 
 
+def _manifest_kwargs(ckpt_dir: str, name: str) -> dict:
+    """Servable kwargs recorded by the checkpoint factory for ``name``;
+    recipe defaults when the manifest is absent."""
+    import os
+
+    path = os.path.join(ckpt_dir, "MANIFEST.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            manifest = json.load(f)
+        if name in manifest:
+            return dict(manifest[name].get("kwargs", {}))
+    from ai4e_tpu.train.make_checkpoints import SPECIES_LABELS
+    return ({"widths": [64, 128, 256]} if name == "megadetector" else
+            {"stage_sizes": [2, 2, 2], "width": 32, "num_classes": 8,
+             "labels": SPECIES_LABELS})
+
+
 def _build_servable(args):
     """The measured servable + its request payload builder."""
     import os
@@ -96,23 +113,24 @@ def _build_servable(args):
                                    dtype=np.uint8)
     else:
         from ai4e_tpu.runtime import build_servable
-        if args.model == "megadetector":
-            servable = build_servable(
-                "detector", name="megadetector", image_size=512,
-                buckets=tuple(args.buckets))
-            shape = (512, 512, 3)
-        else:
-            servable = build_servable(
-                "resnet", name="species", image_size=224, num_classes=8,
-                stage_sizes=(2, 2, 2), width=32,
-                labels=["lion", "zebra", "elephant", "giraffe", "leopard",
-                        "okapi", "rhino", "buffalo"],
-                buckets=tuple(args.buckets))
-            shape = (224, 224, 3)
+
+        # Servable kwargs come from the checkpoint factory's MANIFEST (the
+        # exact tree the weights restore into); fall back to the factory's
+        # recipe defaults when no manifest exists yet (it will be written by
+        # the required=True training below).
+        family = "detector" if args.model == "megadetector" else "resnet"
+        kwargs = _manifest_kwargs(args.checkpoint_dir, args.model)
+        image_size = 512 if args.model == "megadetector" else 224
+        servable = build_servable(
+            family, name=args.model, image_size=image_size,
+            buckets=tuple(args.buckets), **kwargs)
+        shape = (image_size, image_size, 3)
         servable.params, meta = _load_or_train_checkpoint(
             args.model, args.checkpoint_dir, servable.params, required=True)
         rng = np.random.default_rng(0)
-        payload_arr = rng.uniform(size=shape).astype(np.float32)
+        # uint8 wire format (families' fused_normalize ingestion): 4x less
+        # payload than float32, normalized on-device.
+        payload_arr = rng.integers(0, 256, size=shape, dtype=np.uint8)
     buf = io.BytesIO()
     np.save(buf, payload_arr)
     return servable, buf.getvalue(), meta
@@ -282,7 +300,6 @@ async def run_bench(args) -> dict:
         except Exception as exc:  # noqa: BLE001 — report, don't kill the bench
             pallas_meta["pallas_tpu"] = {"all_ok": False, "error": str(exc)}
 
-    return {
     return {
         "metric": cfg["metric"],
         "value": round(throughput, 2),

@@ -32,6 +32,9 @@ class TestRecipesLearn:
         servable.params = load_params(entry["path"], like=servable.params)
 
         img, lab = species_batch(np.random.default_rng(99), 16, 64)
+        # The family ingests uint8 (fused on-device normalize back to the
+        # [0,1] floats the recipe trained on) — the production wire format.
+        img = np.clip(np.round(img * 255), 0, 255).astype(np.uint8)
         logits = np.asarray(servable.apply_fn(servable.params, img))
         acc = float((np.argmax(logits, -1) == lab).mean())
         assert acc >= 0.85, f"restored weights only {acc} on held-out data"
