@@ -60,13 +60,19 @@ def _image_preprocess(shape: tuple, dtype=np.float32):
         arr = np.load(io.BytesIO(body))
         if arr.shape != shape:
             raise ValueError(f"expected {shape}, got {arr.shape}")
-        if np.dtype(dtype) == np.uint8 and arr.dtype != np.uint8:
-            # Float [0,1] payload to a uint8-ingesting model: scale, don't
-            # truncate (astype alone would zero the image).
-            return np.clip(np.round(arr * 255.0), 0, 255).astype(np.uint8)
-        return arr.astype(dtype)
+        return cast_image_payload(arr, dtype)
 
     return preprocess
+
+
+def cast_image_payload(arr: np.ndarray, dtype) -> np.ndarray:
+    """Cast a decoded image payload to the servable's input dtype. Float
+    [0,1] arrays headed for a uint8-ingesting model are SCALED, not
+    truncated (a bare astype would zero the image) — shared by the
+    single-request and batch-stack decode paths."""
+    if np.dtype(dtype) == np.uint8 and arr.dtype != np.uint8:
+        return np.clip(np.round(arr * 255.0), 0, 255).astype(np.uint8)
+    return arr.astype(dtype, copy=False)
 
 
 def _classification_postprocess(labels: list | None = None):
@@ -292,7 +298,7 @@ def build_moe(name: str = "moe", seq_len: int = 1024, input_dim: int = 64,
     """Mixture-of-Experts sequence classification — the expert-parallel
     family: expert tensors shard over the mesh's ``ep`` axis
     (``models/moe.py``), composing with dp/fsdp exactly like seqformer's sp."""
-    from ..models.moe import create_moe
+    from ..models.moe import MOE_EP_RULES, create_moe
 
     model, params = create_moe(
         seq_len=seq_len, input_dim=input_dim, dim=dim, depth=depth,
@@ -304,7 +310,10 @@ def build_moe(name: str = "moe", seq_len: int = 1024, input_dim: int = 64,
         input_shape=(seq_len, input_dim),
         preprocess=_npy_preprocess((seq_len, input_dim)),
         postprocess=_classification_postprocess(),
-        batch_buckets=tuple(buckets))
+        batch_buckets=tuple(buckets),
+        # ModelRuntime.register re-places every param on its mesh; the rules
+        # ride along so expert sharding survives registration.
+        param_sharding_rules=MOE_EP_RULES)
 
 
 FAMILIES = {

@@ -49,6 +49,10 @@ class ServableModel:
     batch_buckets: tuple[int, ...] = (1, 2, 4, 8)
     input_dtype: Any = np.float32
     version: str = "1.0"
+    # Param-path → PartitionSpec rules applied at register() — how a family
+    # declares model-parallel placement (e.g. MoE experts over ep) that must
+    # survive the runtime's own param placement.
+    param_sharding_rules: dict | None = None
     _compiled: Callable | None = field(default=None, repr=False)
     _batch_sharding: Any = field(default=None, repr=False)
 
@@ -94,8 +98,9 @@ class ModelRuntime:
                  param_sharding_rules: dict | None = None) -> ServableModel:
         """Place params on the mesh and build per-bucket compiled fns."""
         from ..parallel.sharding import pad_to_multiple, shard_params
-        servable.params = shard_params(servable.params, self.mesh,
-                                       param_sharding_rules)
+        rules = (param_sharding_rules if param_sharding_rules is not None
+                 else servable.param_sharding_rules)
+        servable.params = shard_params(servable.params, self.mesh, rules)
         # SPMD constraint: every batch bucket must divide evenly over the
         # data axes, so buckets round up to mesh multiples (on 1 chip they
         # stay as configured; on a v5e-4 dp mesh they become multiples of 4).

@@ -186,7 +186,8 @@ class InferenceWorker:
                 raise ValueError("empty batch")
             if len(arr) > max_items:
                 raise ValueError(f"batch of {len(arr)} exceeds max {max_items}")
-            return arr.astype(servable.input_dtype, copy=False)
+            from .families import cast_image_payload
+            return cast_image_payload(arr, servable.input_dtype)
 
         async def _run_stack(stack: np.ndarray, on_progress=None) -> list:
             results: list = [None] * len(stack)

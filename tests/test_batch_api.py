@@ -226,3 +226,63 @@ class TestModelListing:
                 await client.close()
 
         run(main())
+
+
+class TestUint8StackDecode:
+    def test_float_stack_to_uint8_servable_is_scaled_not_truncated(self):
+        """uint8-ingesting families (fused_normalize): a float [0,1] stack
+        must be scaled to [0,255] at decode — a bare astype would zero every
+        image and serve confident garbage with HTTP 200."""
+        from ai4e_tpu.runtime.families import cast_image_payload
+
+        stack = np.random.default_rng(0).uniform(
+            0.2, 1.0, (4, 8, 8, 3)).astype(np.float32)
+        out = cast_image_payload(stack, np.uint8)
+        assert out.dtype == np.uint8
+        assert out.mean() > 50, "float stack was truncated to zeros"
+        np.testing.assert_allclose(out / 255.0, stack, atol=1 / 255)
+        # uint8 payloads pass through untouched; float targets unchanged.
+        u8 = (stack * 255).astype(np.uint8)
+        assert cast_image_payload(u8, np.uint8) is u8 or np.array_equal(
+            cast_image_payload(u8, np.uint8), u8)
+        assert cast_image_payload(stack, np.float32).dtype == np.float32
+
+    def test_batch_endpoint_decodes_float_stack_for_uint8_model(self):
+        """End-to-end through serve_batch: float stack → uint8 model →
+        non-degenerate results."""
+        from ai4e_tpu.runtime import build_servable
+        from ai4e_tpu.service.task_manager import LocalTaskManager
+        from ai4e_tpu.taskstore import InMemoryTaskStore
+
+        servable = build_servable(
+            "resnet", name="cls", image_size=16, stage_sizes=(1,), width=8,
+            num_classes=4, buckets=(4,))
+        assert servable.input_dtype == np.uint8  # fused_normalize default
+
+        async def main():
+            runtime = ModelRuntime()
+            runtime.register(servable)
+            batcher = MicroBatcher(runtime, max_wait_ms=1.0)
+            store = InMemoryTaskStore()
+            worker = InferenceWorker(
+                "w", runtime, batcher, task_manager=LocalTaskManager(store),
+                prefix="v1/w", store=store,
+                metrics=MetricsRegistry())
+            worker.serve_batch(servable, sync_path="/cls-batch")
+            await batcher.start()
+            client = await serve(worker.service.app)
+            try:
+                stack = np.random.default_rng(1).uniform(
+                    size=(3, 16, 16, 3)).astype(np.float32)
+                resp = await client.post("/v1/w/cls-batch",
+                                         data=npy_bytes(stack))
+                assert resp.status == 200, await resp.text()
+                doc = await resp.json()
+                assert doc["count"] == 3 and doc["failed"] == 0, doc
+                for item in doc["items"]:
+                    assert "class_id" in item["result"], item
+            finally:
+                await client.close()
+                await batcher.stop()
+
+        run(main())

@@ -66,6 +66,12 @@ class TestMoEServing:
             depth=1, heads=2, num_experts=8, num_classes=4,
             attention="full", buckets=(2,), mesh=mesh)
         runtime.register(servable)
+        # register() re-places params on its mesh — the expert sharding must
+        # SURVIVE it (rules ride on the servable), or "expert parallel"
+        # would silently serve fully-replicated experts.
+        up = runtime.models["moe"].params["params"]["block0"]["moe"]["up"]
+        assert "ep" in str(up.sharding.spec), up.sharding
+        assert up.sharding.shard_shape(up.shape)[0] == up.shape[0] // 4
         batch = np.random.default_rng(1).standard_normal(
             (servable.batch_buckets[0], SEQ, DIM_IN)).astype(np.float32)
         out = np.asarray(runtime.run_batch("moe", batch))
