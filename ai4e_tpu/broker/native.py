@@ -13,8 +13,6 @@ from __future__ import annotations
 import asyncio
 import ctypes
 import logging
-import os
-import subprocess
 from concurrent.futures import ThreadPoolExecutor
 
 from ..taskstore import endpoint_path as canonical_path
@@ -22,7 +20,6 @@ from .queue import DeadLetterHandler, Message
 
 log = logging.getLogger("ai4e_tpu.broker.native")
 
-_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _SO_NAME = "libbroker_core.so"
 
 
@@ -41,15 +38,8 @@ class _MessageView(ctypes.Structure):
 
 def build_library(force: bool = False) -> str:
     """Compile the broker core if the .so is missing/stale; returns its path."""
-    src = os.path.abspath(os.path.join(_NATIVE_DIR, "broker_core.cpp"))
-    out = os.path.abspath(os.path.join(_NATIVE_DIR, _SO_NAME))
-    if (not force and os.path.exists(out)
-            and os.path.getmtime(out) >= os.path.getmtime(src)):
-        return out
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", out]
-    log.info("building native broker core: %s", " ".join(cmd))
-    subprocess.run(cmd, check=True, capture_output=True)
-    return out
+    from ..utils.native_build import build_native_library
+    return build_native_library("broker_core.cpp", _SO_NAME, force=force)
 
 
 def _load():

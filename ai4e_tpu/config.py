@@ -124,6 +124,7 @@ class PlatformSection:
     journal_path: typing.Optional[str] = None
     lease_seconds: float = 300.0
     native_broker: bool = False
+    native_store: bool = False
     push_ttl_seconds: float = 300.0  # event TTL 5 min (deploy_event_grid_subscription.sh:37)
     push_max_attempts: int = 3       # max delivery attempts (same line)
     # Stuck-task watchdog (taskstore/reaper.py): rescue tasks stuck in
@@ -142,6 +143,7 @@ class PlatformSection:
             journal_path=self.journal_path,
             lease_seconds=self.lease_seconds,
             native_broker=self.native_broker,
+            native_store=self.native_store,
             push_ttl_seconds=self.push_ttl_seconds,
             push_max_attempts=self.push_max_attempts,
             reaper_running_timeout=self.reaper_running_timeout,

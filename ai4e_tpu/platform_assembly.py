@@ -28,6 +28,7 @@ class PlatformConfig:
     journal_path: str | None = None  # None → pure in-memory store
     lease_seconds: float = 300.0
     native_broker: bool = False      # C++ broker core (native/broker_core.cpp)
+    native_store: bool = False       # C++ task-store core (native/taskstore_core.cpp)
     queue_depth_interval: float = 30.0    # TaskQueueLogger.cs:19
     process_depth_interval: float = 300.0  # TaskProcessLogger.cs:21
     # push-transport delivery policy (deploy_event_grid_subscription.sh:37)
@@ -57,7 +58,14 @@ class LocalPlatform:
         self.config = config or PlatformConfig()
         self.metrics = metrics or DEFAULT_REGISTRY
         if self.config.journal_path:
+            if self.config.native_store:
+                raise ValueError(
+                    "native_store has no journal; use journal_path with the "
+                    "Python store or native_store without durability")
             self.store = JournaledTaskStore(self.config.journal_path)
+        elif self.config.native_store:
+            from .taskstore.native import NativeTaskStore
+            self.store = NativeTaskStore()
         else:
             self.store = InMemoryTaskStore()
         self.task_manager = LocalTaskManager(self.store)

@@ -303,16 +303,33 @@ class JournaledTaskStore(InMemoryTaskStore):
     checkpoint/resume).
     """
 
-    def __init__(self, journal_path: str, publisher: Publisher | None = None):
+    def __init__(self, journal_path: str, publisher: Publisher | None = None,
+                 compact_every: int = 5000):
         super().__init__(publisher)
         self._journal_path = journal_path
         self._journal = None  # gate journaling off during replay
         self._closed = False
+        # Auto-compaction: status transitions append forever, so a
+        # long-running store's journal (and restart replay time) would grow
+        # without bound. Once ``compact_every`` records accumulate beyond
+        # the live-task count, the journal is rewritten as one record per
+        # task under the lock (atomic tmp+rename) — Redis AOF-rewrite's
+        # role, sized so compaction cost amortizes to ~zero per write.
+        self._compact_every = compact_every
+        self._records = 0
         self.replayed_task_ids: set[str] = set()
         if os.path.exists(journal_path):
             self._replay()
             self.replayed_task_ids = set(self._tasks)
-        self._journal = open(journal_path, "a", encoding="utf-8")  # noqa: SIM115
+            # Same heuristic as runtime auto-compaction: only rewrite when
+            # the journal is meaningfully bloated — a strictly-greater test
+            # would rewrite (and fsync) the whole journal on nearly every
+            # restart for a negligible win.
+            if self._records > 2 * max(len(self._tasks), 1):
+                self._compact_locked()
+        if self._journal is None:
+            self._journal = open(journal_path, "a",  # noqa: SIM115
+                                 encoding="utf-8")
 
     def _replay(self) -> None:
         with open(self._journal_path, encoding="utf-8") as f:
@@ -321,31 +338,105 @@ class JournaledTaskStore(InMemoryTaskStore):
                 if not line:
                     continue
                 rec = json.loads(line)
+                self._records += 1
+                if rec.get("Slim"):
+                    # Transition record: body/orig state is untouched (they
+                    # ride only on upserts), exactly like the live mutation;
+                    # the journaled timestamp is kept so set scores replay
+                    # faithfully.
+                    prev = self._tasks.get(rec["TaskId"])
+                    if prev is None:
+                        continue  # compacted-away predecessor
+                    task = prev.with_status(rec["Status"],
+                                            rec.get("BackendStatus"))
+                    task.publish = False
+                    task.timestamp = float(rec.get("Timestamp")
+                                           or task.timestamp)
+                    self._remove_from_set(prev)
+                    self._tasks[task.task_id] = task
+                    self._add_to_set(task)
+                    continue
                 task = APITask.from_dict(rec)
                 task.body = bytes.fromhex(rec.get("BodyHex", ""))
                 # Don't re-publish during replay — LocalPlatform.start()
                 # re-seeds the broker from unfinished_tasks() afterwards.
                 task.publish = False
                 super().upsert(task)
+                # Keep the journaled timestamp (upsert stamps "now"):
+                # set scores and the reaper's stuck-task age clock must
+                # survive restarts, not reset to replay time.
+                stored = self._tasks[task.task_id]
+                ts = float(rec.get("Timestamp") or stored.timestamp)
+                stored.timestamp = ts
+                self._sets[(stored.endpoint_path,
+                            stored.canonical_status)][stored.task_id] = ts
                 orig = rec.get("OrigHex")
                 if orig:
                     self._orig_bodies[task.task_id] = (
                         bytes.fromhex(orig),
                         rec.get("OrigContentType", "application/json"))
 
-    def _log(self, task: APITask) -> None:
+    def _log(self, task: APITask, slim: bool = False) -> None:
         # Called with self._lock held (from _apply_*): journal order is
         # exactly mutation order, so replay reconstructs the true final state.
         if self._journal is None:
             return
         rec = task.to_dict()
-        rec["BodyHex"] = task.body.hex()
-        orig = self._orig_bodies.get(task.task_id)
-        if orig is not None:
-            rec["OrigHex"] = orig[0].hex()
-            rec["OrigContentType"] = orig[1]
+        if slim:
+            # Status transitions never change body/orig — journaling them
+            # again would append the (hex-doubled) payload on EVERY
+            # transition, ~8x the necessary bytes for a 4-transition task.
+            rec["Slim"] = True
+        else:
+            rec["BodyHex"] = task.body.hex()
+            orig = self._orig_bodies.get(task.task_id)
+            if orig is not None:
+                rec["OrigHex"] = orig[0].hex()
+                rec["OrigContentType"] = orig[1]
         self._journal.write(json.dumps(rec) + "\n")
         self._journal.flush()
+        self._records += 1
+        if (self._records >= self._compact_every
+                and self._records > 2 * len(self._tasks)):
+            self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        """Rewrite the journal as one full record per live task. Caller holds
+        ``self._lock`` (or is still single-threaded in __init__). The tmp
+        file is written COMPLETELY before the live journal is touched — a
+        failed rewrite (disk full) leaves the old journal open and valid."""
+        tmp = self._journal_path + ".compact"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                for task in self._tasks.values():
+                    rec = task.to_dict()
+                    rec["BodyHex"] = task.body.hex()
+                    orig = self._orig_bodies.get(task.task_id)
+                    if orig is not None:
+                        rec["OrigHex"] = orig[0].hex()
+                        rec["OrigContentType"] = orig[1]
+                    f.write(json.dumps(rec) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        if self._journal is not None:
+            self._journal.close()
+        os.replace(tmp, self._journal_path)  # atomic swap
+        self._records = len(self._tasks)
+        self._journal = open(self._journal_path, "a",  # noqa: SIM115
+                             encoding="utf-8")
+
+    def compact(self) -> None:
+        """Force a journal rewrite (operational hook; auto-compaction covers
+        normal operation)."""
+        with self._lock:
+            self._check_open()
+            self._compact_locked()
 
     def _apply_upsert(self, task: APITask) -> APITask:
         self._check_open()
@@ -358,7 +449,7 @@ class JournaledTaskStore(InMemoryTaskStore):
     ) -> APITask:
         self._check_open()
         task = super()._apply_update(task_id, status, backend_status)
-        self._log(task)
+        self._log(task, slim=True)
         return task
 
     def _check_open(self) -> None:

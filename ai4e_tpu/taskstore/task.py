@@ -50,12 +50,15 @@ def new_task_id() -> str:
 
 def endpoint_path(endpoint: str) -> str:
     """Derived endpoint path, e.g. ``http://host/v1/landcover/classify`` →
-    ``/v1/landcover/classify`` (``APITask.cs`` EndpointPath)."""
+    ``/v1/landcover/classify`` (``APITask.cs`` EndpointPath). Query strings
+    and fragments never reach the set key — for bare paths as well as full
+    URLs, so ``/v1/api?x=1`` and ``http://h/v1/api?x=1`` bucket together."""
     if not endpoint:
         return ""
     if "://" in endpoint:
         return urlparse(endpoint).path or "/"
-    return endpoint if endpoint.startswith("/") else "/" + endpoint
+    path = endpoint if endpoint.startswith("/") else "/" + endpoint
+    return path.split("?", 1)[0].split("#", 1)[0] or "/"
 
 
 @dataclass

@@ -1,0 +1,223 @@
+"""Native task-store core parity (native/taskstore_core.cpp via
+ai4e_tpu/taskstore/native.py): the C++ engine must honor the same
+CacheConnectorUpsert contract the Python store implements — create/
+transition, status-set bookkeeping, ORIG replay, publish-failure rollback,
+conditional transitions — plus drive the full async platform end-to-end as a
+drop-in (PlatformConfig(native_store=True))."""
+
+import threading
+
+import pytest
+
+from ai4e_tpu.taskstore import APITask, TaskNotFound, TaskStatus
+from ai4e_tpu.taskstore.native import NativeTaskStore
+
+
+def make_task(endpoint="http://h/v1/api/op", body=b"", **kw):
+    return APITask(task_id="", endpoint=endpoint, body=body, **kw)
+
+
+class TestStateMachineParity:
+    def test_create_assigns_guid_and_created_status(self):
+        store = NativeTaskStore()
+        t = store.upsert(make_task())
+        assert len(t.task_id) == 36 and t.task_id.count("-") == 4
+        assert t.canonical_status == TaskStatus.CREATED
+        assert store.get(t.task_id).task_id == t.task_id
+
+    def test_full_transition_chain_and_sets(self):
+        store = NativeTaskStore()
+        t = store.upsert(make_task(body=b"img"))
+        path = t.endpoint_path
+        assert store.set_len(path, "created") == 1
+        store.update_status(t.task_id, "running - inference")
+        assert store.set_len(path, "created") == 0
+        assert store.set_len(path, "running") == 1
+        done = store.update_status(t.task_id, "completed - 3 found",
+                                   backend_status="completed")
+        assert done.backend_status == "completed"
+        assert store.set_len(path, "running") == 0
+        assert store.set_len(path, "completed") == 1
+        assert store.depths()[path]["completed"] == 1
+
+    def test_unknown_task_raises(self):
+        store = NativeTaskStore()
+        with pytest.raises(TaskNotFound):
+            store.get("nope")
+        with pytest.raises(TaskNotFound):
+            store.update_status("nope", "running")
+
+    def test_pipeline_replays_original_body_and_content_type(self):
+        store = NativeTaskStore()
+        published = []
+        store.set_publisher(lambda t: published.append(
+            (t.endpoint, t.body, t.content_type)))
+        t = store.upsert(APITask(endpoint="/v1/detect", body=b"\xff\xd8JPG",
+                                 content_type="image/jpeg", publish=True))
+        store.upsert(APITask(task_id=t.task_id, endpoint="/v1/classify",
+                             body=b"", publish=True))
+        assert published == [
+            ("/v1/detect", b"\xff\xd8JPG", "image/jpeg"),
+            ("/v1/classify", b"\xff\xd8JPG", "image/jpeg"),
+        ]
+        # Same TaskId, endpoint rewritten, created again.
+        assert store.get(t.task_id).endpoint == "/v1/classify"
+        assert store.set_len("/v1/classify", "created") == 1
+        assert store.set_len("/v1/detect", "created") == 0
+
+    def test_handoff_body_becomes_new_replay_body(self):
+        store = NativeTaskStore()
+        published = []
+        store.set_publisher(lambda t: published.append(t.body))
+        t = store.upsert(APITask(endpoint="/v1/a", body=b"stage1",
+                                 publish=True))
+        store.upsert(APITask(task_id=t.task_id, endpoint="/v1/b",
+                             body=b"crops", publish=True))
+        store.upsert(APITask(task_id=t.task_id, endpoint="/v1/b",
+                             body=b"", publish=True))  # requeue of stage 2
+        assert published == [b"stage1", b"crops", b"crops"]
+
+    def test_publish_failure_fails_task(self):
+        store = NativeTaskStore()
+
+        def boom(task):
+            raise RuntimeError("broker down")
+
+        store.set_publisher(boom)
+        t = store.upsert(make_task(body=b"x", publish=True))
+        assert store.get(t.task_id).canonical_status == TaskStatus.FAILED
+        assert "could not publish" in store.get(t.task_id).status
+
+    def test_conditional_transitions(self):
+        store = NativeTaskStore()
+        t = store.upsert(make_task(body=b"x"))
+        store.update_status(t.task_id, "running")
+        # Condition no longer holds → None, state untouched.
+        assert store.update_status_if(t.task_id, "created", "failed") is None
+        assert store.get(t.task_id).canonical_status == "running"
+        # Condition holds → transition.
+        out = store.update_status_if(t.task_id, "running", "completed")
+        assert out is not None
+        assert store.get(t.task_id).canonical_status == "completed"
+
+    def test_requeue_if_replays_body(self):
+        store = NativeTaskStore()
+        published = []
+        store.set_publisher(lambda t: published.append(t.body))
+        t = store.upsert(make_task(body=b"payload", publish=True))
+        store.update_status(t.task_id, "running")
+        assert store.requeue_if(t.task_id, "completed") is None  # stale view
+        rescued = store.requeue_if(t.task_id, "running")
+        assert rescued is not None
+        assert rescued.canonical_status == "created"
+        assert published == [b"payload", b"payload"]
+
+    def test_results_with_stages(self):
+        store = NativeTaskStore()
+        t = store.upsert(make_task())
+        store.set_result(t.task_id, b'{"n":1}')
+        store.set_result(t.task_id, b"stage-out", stage="detector",
+                         content_type="application/x-npy")
+        assert store.get_result(t.task_id) == (b'{"n":1}', "application/json")
+        assert store.get_result(t.task_id, stage="detector") == (
+            b"stage-out", "application/x-npy")
+        assert store.get_result("missing") is None
+        with pytest.raises(TaskNotFound):
+            store.set_result("missing", b"x")
+
+    def test_unfinished_tasks_restore_bodies(self):
+        store = NativeTaskStore()
+        t1 = store.upsert(make_task(body=b"A", endpoint="/v1/x"))
+        t2 = store.upsert(make_task(body=b"B", endpoint="/v1/x"))
+        store.update_status(t1.task_id, "running")
+        store.update_status(t2.task_id, "completed")
+        unfinished = store.unfinished_tasks()
+        assert [u.task_id for u in unfinished] == [t1.task_id]
+        assert unfinished[0].body == b"A"
+
+    def test_parallel_transitions_keep_sets_consistent(self):
+        store = NativeTaskStore()
+        tasks = [store.upsert(make_task(body=b"x")) for _ in range(40)]
+        path = tasks[0].endpoint_path
+
+        def churn(task):
+            store.update_status(task.task_id, "running")
+            store.update_status(task.task_id, "completed")
+
+        threads = [threading.Thread(target=churn, args=(t,)) for t in tasks]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert store.set_len(path, "completed") == 40
+        assert store.set_len(path, "created") == 0
+        assert store.set_len(path, "running") == 0
+
+
+class TestNativeStorePlatformE2E:
+    def test_async_task_flow_on_native_store(self):
+        """Full gateway → native store → broker → dispatcher → service round
+        trip, mirroring test_async_e2e but with the C++ state machine."""
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+        from ai4e_tpu.service import APIService
+
+        async def main():
+            platform = LocalPlatform(PlatformConfig(
+                retry_delay=0.05, native_store=True))
+            svc = APIService("echo", task_manager=platform.task_manager,
+                             prefix="v1/echo")
+
+            @svc.api_async_func("/run")
+            def run(taskId, body, content_type):
+                asyncio.run(platform.task_manager.complete_task(
+                    taskId, f"completed - echoed {len(body)} bytes"))
+
+            svc_client = TestClient(TestServer(svc.app))
+            await svc_client.start_server()
+            base = str(svc_client.make_url("")).rstrip("/")
+            platform.publish_async_api("/v1/echo/run",
+                                       base + "/v1/echo/run")
+            gw = TestClient(TestServer(platform.gateway.app))
+            await gw.start_server()
+            await platform.start()
+            try:
+                resp = await gw.post("/v1/echo/run", data=b"hello")
+                tid = (await resp.json())["TaskId"]
+                # Long-poll: exercises the gateway's store listener riding
+                # the native store's notify path.
+                r = await gw.get(f"/v1/taskmanagement/task/{tid}",
+                                 params={"wait": "10"})
+                final = await r.json()
+                assert "completed" in final["Status"], final
+                assert "5 bytes" in final["Status"]
+            finally:
+                await platform.stop()
+                await gw.close()
+                await svc_client.close()
+
+        asyncio.run(main())
+
+
+class TestEndpointPathParity:
+    def test_query_and_fragment_stripped_like_python(self):
+        """Set keys must match the Python store's urlparse().path — query
+        strings leaking into keys would split one endpoint's depth metrics."""
+        from ai4e_tpu.taskstore.task import endpoint_path as py_path
+
+        store = NativeTaskStore()
+        cases = [
+            "http://h:8080/v1/org/api?profile=1&x=2",
+            "http://h/v1/org/api#frag",
+            "/v1/org/api?y=3",
+            "v1/org/api",
+            "http://h",
+        ]
+        for ep in cases:
+            t = store.upsert(APITask(task_id="", endpoint=ep, body=b"x"))
+            expected = py_path(ep) or "/"
+            assert store.set_len(expected, "created") >= 1, (ep, expected)
+            assert store.get(t.task_id).endpoint == ep

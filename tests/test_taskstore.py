@@ -224,3 +224,90 @@ class TestContentTypeReplay:
                               body=b"", publish=True))
         assert published == [(b"RAWJPG", "image/jpeg")]
         store2.close()
+
+
+class TestJournalGrowth:
+    def test_transitions_journal_slim_records(self, tmp_path):
+        """Status transitions must not re-append the (hex-doubled) payload:
+        a big-bodied task with many transitions journals its body exactly
+        once."""
+        import os
+
+        journal = str(tmp_path / "slim.jsonl")
+        store = JournaledTaskStore(journal)
+        body = b"\xab" * 50_000
+        t = store.upsert(make_task(body=body))
+        base = os.path.getsize(journal)
+        assert base > len(body)  # create record carries the body (hex)
+        for i in range(10):
+            store.update_status(t.task_id, f"running - step {i}")
+        store.update_status(t.task_id, "completed")
+        growth = os.path.getsize(journal) - base
+        assert growth < 5_000, (
+            f"transitions appended {growth}B — bodies are riding updates")
+        store.close()
+
+        revived = JournaledTaskStore(journal)
+        assert revived.get(t.task_id).canonical_status == TaskStatus.COMPLETED
+        assert revived.get_original_body(t.task_id) == body
+        revived.close()
+
+    def test_compaction_shrinks_and_preserves_state(self, tmp_path):
+        import os
+
+        journal = str(tmp_path / "compact.jsonl")
+        store = JournaledTaskStore(journal)
+        tasks = [store.upsert(make_task(body=b"payload-%d" % i))
+                 for i in range(5)]
+        for t in tasks:
+            for k in range(20):
+                store.update_status(t.task_id, f"running - {k}")
+            store.update_status(t.task_id, "completed")
+        before = os.path.getsize(journal)
+        store.compact()
+        after = os.path.getsize(journal)
+        assert after < before
+        # One record per live task.
+        with open(journal) as f:
+            assert sum(1 for line in f if line.strip()) == len(tasks)
+        store.close()
+
+        revived = JournaledTaskStore(journal)
+        for i, t in enumerate(tasks):
+            assert revived.get(t.task_id).canonical_status == "completed"
+            assert revived.get_original_body(t.task_id) == b"payload-%d" % i
+        revived.close()
+
+    def test_auto_compaction_bounds_journal(self, tmp_path):
+        journal = str(tmp_path / "auto.jsonl")
+        store = JournaledTaskStore(journal, compact_every=50)
+        t = store.upsert(make_task(body=b"x"))
+        for i in range(300):
+            store.update_status(t.task_id, f"running - {i}")
+        # 300 transitions with compact_every=50: the journal was rewritten,
+        # so record count stays far below the mutation count.
+        with open(journal) as f:
+            lines = sum(1 for line in f if line.strip())
+        assert lines <= 60, lines
+        store.close()
+
+        revived = JournaledTaskStore(journal)
+        assert "299" in revived.get(t.task_id).status
+        revived.close()
+
+    def test_replay_compacts_bloated_journal_at_open(self, tmp_path):
+        import os
+
+        journal = str(tmp_path / "open.jsonl")
+        store = JournaledTaskStore(journal)  # default threshold: no runtime compaction
+        t = store.upsert(make_task(body=b"y"))
+        for i in range(40):
+            store.update_status(t.task_id, f"running - {i}")
+        store.close()
+        bloated = os.path.getsize(journal)
+
+        revived = JournaledTaskStore(journal)  # open-time compaction
+        assert os.path.getsize(journal) < bloated
+        assert "39" in revived.get(t.task_id).status
+        assert revived.get_original_body(t.task_id) == b"y"
+        revived.close()
