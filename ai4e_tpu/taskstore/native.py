@@ -16,7 +16,7 @@ import ctypes
 import logging
 from typing import Callable, Iterable
 
-from .store import Publisher, TaskNotFound
+from .store import Publisher, StoreSideEffects, TaskNotFound
 from .task import APITask, TaskStatus
 
 log = logging.getLogger("ai4e_tpu.taskstore.native")
@@ -103,8 +103,10 @@ def _buf(data: bytes):
             if data else None)
 
 
-class NativeTaskStore:
-    """InMemoryTaskStore-compatible facade over the C++ engine."""
+class NativeTaskStore(StoreSideEffects):
+    """InMemoryTaskStore-compatible facade over the C++ engine. Listener +
+    publish-failure plumbing is the shared ``StoreSideEffects`` — identical
+    semantics to the Python store, no drift."""
 
     def __init__(self, publisher: Publisher | None = None):
         self._lib = get_lib()
@@ -117,21 +119,6 @@ class NativeTaskStore:
             self._lib.tsc_destroy(self._handle)
         except Exception:  # noqa: BLE001
             pass
-
-    # -- wrapper plumbing --------------------------------------------------
-
-    def set_publisher(self, publisher: Publisher | None) -> None:
-        self._publisher = publisher
-
-    def add_listener(self, listener: Callable[[APITask], None]) -> None:
-        self._listeners.append(listener)
-
-    def _notify(self, task: APITask) -> None:
-        for listener in self._listeners:
-            try:
-                listener(task)
-            except Exception:  # noqa: BLE001 — observers must not break the store
-                log.exception("task listener failed for %s", task.task_id)
 
     def _consume(self, view) -> APITask | None:
         if not view:
@@ -153,17 +140,6 @@ class NativeTaskStore:
         self._lib.tsc_free_view(view)
         return task
 
-    def _publish_after(self, task: APITask) -> None:
-        if self._publisher is None or not task.publish:
-            return
-        try:
-            self._publisher(task)
-        except Exception as exc:  # noqa: BLE001 — publish failure fails the task
-            self.update_status(
-                task.task_id,
-                f"failed - could not publish task: {exc}",
-                backend_status=TaskStatus.FAILED)
-
     # -- core state machine (InMemoryTaskStore surface) --------------------
 
     def upsert(self, task: APITask) -> APITask:
@@ -172,8 +148,12 @@ class NativeTaskStore:
             task.status.encode(), task.backend_status.encode(),
             _buf(task.body), len(task.body), task.content_type.encode(),
             1 if task.publish else 0))
+        # Snapshot the publisher at transition time (the Python store does
+        # this under its lock) so a concurrent set_publisher cannot route
+        # this task to a broker the decision wasn't made against.
+        publisher = self._publisher if stored.publish else None
         self._notify(stored)
-        self._publish_after(stored)
+        self._publish_after(stored, publisher)
         return stored
 
     def update_status(self, task_id: str, status: str,
@@ -202,8 +182,9 @@ class NativeTaskStore:
             self._handle, task_id.encode(), expected_status.encode()))
         if task is None:
             return None
+        publisher = self._publisher if task.publish else None
         self._notify(task)
-        self._publish_after(task)
+        self._publish_after(task, publisher)
         return task
 
     def get(self, task_id: str) -> APITask:

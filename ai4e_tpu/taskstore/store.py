@@ -40,7 +40,48 @@ class TaskNotFound(KeyError):
     pass
 
 
-class InMemoryTaskStore:
+class StoreSideEffects:
+    """Listener + publish side-effect plumbing shared by every store
+    implementation (Python and native): transitions notify observers (e.g.
+    the gateway's long-poll waiters) outside any lock, and a publish failure
+    rolls the task to failed (``CacheConnectorUpsert.cs:183-199``)."""
+
+    _publisher: Publisher | None
+    _listeners: list
+
+    def set_publisher(self, publisher: Publisher | None) -> None:
+        self._publisher = publisher
+
+    def add_listener(self, listener: Callable[["APITask"], None]) -> None:
+        self._listeners.append(listener)
+
+    def _notify(self, task: "APITask") -> None:
+        for listener in self._listeners:
+            try:
+                listener(task)
+            except Exception:  # noqa: BLE001 — observers must not break the store
+                import logging
+                logging.getLogger("ai4e_tpu.taskstore").exception(
+                    "task listener failed for %s", task.task_id)
+
+    def _publish_after(self, task: "APITask",
+                      publisher: Publisher | None) -> None:
+        if publisher is None:
+            return
+        try:
+            publisher(task)
+        except Exception as exc:  # noqa: BLE001 — any publish failure fails the task
+            self.update_status(
+                task.task_id,
+                f"failed - could not publish task: {exc}",
+                backend_status=TaskStatus.FAILED,
+            )
+
+    def update_status(self, task_id, status, backend_status=None):
+        raise NotImplementedError
+
+
+class InMemoryTaskStore(StoreSideEffects):
     """Thread-safe in-process task store.
 
     Used directly by tests and single-process deployments; the HTTP task-store
@@ -65,23 +106,9 @@ class InMemoryTaskStore:
         self._publisher = publisher
         # Change listeners (e.g. the gateway's long-poll waiters). Called
         # outside the lock, after every state transition, possibly from any
-        # thread — listeners must be cheap and thread-safe.
+        # thread — listeners must be cheap and thread-safe
+        # (StoreSideEffects._notify).
         self._listeners: list[Callable[[APITask], None]] = []
-
-    def set_publisher(self, publisher: Publisher | None) -> None:
-        self._publisher = publisher
-
-    def add_listener(self, listener: Callable[[APITask], None]) -> None:
-        self._listeners.append(listener)
-
-    def _notify(self, task: APITask) -> None:
-        for listener in self._listeners:
-            try:
-                listener(task)
-            except Exception:  # noqa: BLE001 — observers must not break the store
-                import logging
-                logging.getLogger("ai4e_tpu.taskstore").exception(
-                    "task listener failed for %s", task.task_id)
 
     # -- core state machine ------------------------------------------------
 
@@ -105,18 +132,6 @@ class InMemoryTaskStore:
         self._notify(task)
         self._publish_after(task, publisher)
         return task
-
-    def _publish_after(self, task: APITask, publisher: Publisher | None) -> None:
-        if publisher is None:
-            return
-        try:
-            publisher(task)
-        except Exception as exc:  # noqa: BLE001 — any publish failure fails the task
-            self.update_status(
-                task.task_id,
-                f"failed - could not publish task: {exc}",
-                backend_status=TaskStatus.FAILED,
-            )
 
     def _apply_upsert(self, task: APITask) -> APITask:
         """State mutation for upsert. Caller holds ``self._lock``; subclasses
@@ -388,48 +403,67 @@ class JournaledTaskStore(InMemoryTaskStore):
             # transition, ~8x the necessary bytes for a 4-transition task.
             rec["Slim"] = True
         else:
-            rec["BodyHex"] = task.body.hex()
-            orig = self._orig_bodies.get(task.task_id)
-            if orig is not None:
-                rec["OrigHex"] = orig[0].hex()
-                rec["OrigContentType"] = orig[1]
+            rec = self._full_record(task)
         self._journal.write(json.dumps(rec) + "\n")
         self._journal.flush()
         self._records += 1
         if (self._records >= self._compact_every
                 and self._records > 2 * len(self._tasks)):
-            self._compact_locked()
+            # The append above already made this mutation durable; a failed
+            # rewrite (disk full) must not surface as an error for — or
+            # skip the notify/publish of — a transition that succeeded.
+            try:
+                self._compact_locked()
+            except OSError:
+                import logging
+                logging.getLogger("ai4e_tpu.taskstore").exception(
+                    "journal auto-compaction failed; continuing on the "
+                    "append-only journal")
+
+    def _full_record(self, task: APITask) -> dict:
+        """The journal's full (non-slim) record shape — one source of truth
+        for appends and compaction rewrites."""
+        rec = task.to_dict()
+        rec["BodyHex"] = task.body.hex()
+        orig = self._orig_bodies.get(task.task_id)
+        if orig is not None:
+            rec["OrigHex"] = orig[0].hex()
+            rec["OrigContentType"] = orig[1]
+        return rec
 
     def _compact_locked(self) -> None:
         """Rewrite the journal as one full record per live task. Caller holds
-        ``self._lock`` (or is still single-threaded in __init__). The tmp
-        file is written COMPLETELY before the live journal is touched — a
-        failed rewrite (disk full) leaves the old journal open and valid."""
+        ``self._lock`` (or is still single-threaded in __init__). Failure at
+        ANY point leaves the store on a valid journal: the replacement file
+        is fully written and its handle opened before the atomic rename, and
+        the old handle is closed only after the swap succeeds."""
         tmp = self._journal_path + ".compact"
+        new_journal = None
         try:
             with open(tmp, "w", encoding="utf-8") as f:
                 for task in self._tasks.values():
-                    rec = task.to_dict()
-                    rec["BodyHex"] = task.body.hex()
-                    orig = self._orig_bodies.get(task.task_id)
-                    if orig is not None:
-                        rec["OrigHex"] = orig[0].hex()
-                        rec["OrigContentType"] = orig[1]
-                    f.write(json.dumps(rec) + "\n")
+                    f.write(json.dumps(self._full_record(task)) + "\n")
                 f.flush()
                 os.fsync(f.fileno())
+            # Open the append handle on the tmp file BEFORE the rename: the
+            # handle follows the inode, so after os.replace it IS the live
+            # journal — no window where a failed reopen leaves a handle
+            # pointing at an unlinked file.
+            new_journal = open(tmp, "a", encoding="utf-8")  # noqa: SIM115
+            os.replace(tmp, self._journal_path)  # atomic swap
         except OSError:
+            if new_journal is not None:
+                new_journal.close()
             try:
                 os.unlink(tmp)
             except OSError:
                 pass
             raise
-        if self._journal is not None:
-            self._journal.close()
-        os.replace(tmp, self._journal_path)  # atomic swap
+        old = self._journal
+        self._journal = new_journal
         self._records = len(self._tasks)
-        self._journal = open(self._journal_path, "a",  # noqa: SIM115
-                             encoding="utf-8")
+        if old is not None:
+            old.close()
 
     def compact(self) -> None:
         """Force a journal rewrite (operational hook; auto-compaction covers

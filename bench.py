@@ -52,6 +52,11 @@ CONFIGS = {
     # Anchor: the reference's serial two-stage dispatch of a V100 detector
     # (~10/s) then classifier — the detector dominates, ~8 composite/s.
     "pipeline": {"anchor": 8.0, "metric": "async_pipeline_throughput"},
+    # Long-context sequence classification (SURVEY.md §5 long-context slot,
+    # no reference analogue): SeqFormer with the fused flash-attention
+    # Pallas kernel on the serving path. Anchor: a V100 transformer encoder
+    # at S=4k served one-per-POST, ~50 seq/s.
+    "longcontext": {"anchor": 50.0, "metric": "async_longcontext_throughput"},
 }
 
 
@@ -116,6 +121,16 @@ def _build_servable(args):
         rng = np.random.default_rng(0)
         payload_arr = rng.integers(0, 256, size=(TILE, TILE, 3),
                                    dtype=np.uint8)
+    elif args.model == "longcontext":
+        from ai4e_tpu.runtime import build_servable
+        servable = build_servable(
+            "seqformer", name="longcontext", seq_len=args.seq_len,
+            input_dim=64, dim=256, depth=4, heads=8, num_classes=16,
+            attention="flash", buckets=tuple(args.buckets))
+        rng = np.random.default_rng(0)
+        payload_arr = rng.standard_normal(
+            (args.seq_len, 64)).astype(np.float32)
+        meta = {"seq_len": args.seq_len, "attention": "flash"}
     else:
         from ai4e_tpu.runtime import build_servable
 
@@ -488,6 +503,7 @@ def _forward_argv(args) -> list[str]:
             "--dispatcher-concurrency", str(args.dispatcher_concurrency),
             "--model", args.model,
             "--checkpoint-dir", args.checkpoint_dir,
+            "--seq-len", str(args.seq_len),
             "--buckets", *[str(b) for b in args.buckets]]
 
 
@@ -504,6 +520,8 @@ def main() -> None:
                         help="measurement config (BASELINE.json #2/#3/#4)")
     parser.add_argument("--checkpoint-dir", default="checkpoints",
                         help="trained weights (ai4e_tpu.train.make_checkpoints)")
+    parser.add_argument("--seq-len", type=int, default=4096,
+                        help="sequence length for --model longcontext")
     parser.add_argument("--cpu", action="store_true",
                         help="force CPU (debug runs)")
     parser.add_argument("--probe-timeout", type=float, default=60.0,
@@ -520,8 +538,8 @@ def main() -> None:
         # Detector tiles are 4x the pixels of the others — bucket 64 would
         # spend HBM on padding the queue rarely fills.
         args.buckets = {"landcover": [1, 16, 64], "megadetector": [1, 8],
-                        "species": [1, 16, 64],
-                        "pipeline": [1, 8]}[args.model]
+                        "species": [1, 16, 64], "pipeline": [1, 8],
+                        "longcontext": [1, 4]}[args.model]
 
     if args.inner or args.prewarm:
         import jax

@@ -62,9 +62,12 @@ std::string endpoint_path(const std::string& endpoint) {
   if (scheme == std::string::npos) {
     path = endpoint[0] == '/' ? endpoint : "/" + endpoint;
   } else {
-    auto path_start = endpoint.find('/', scheme + 3);
-    if (path_start == std::string::npos) return "/";
-    path = endpoint.substr(path_start);
+    // The path starts at the first '/' AFTER the authority — a '/' inside
+    // the query/fragment of a host-only URL ("http://h?next=/a") is NOT a
+    // path (urlparse gives "", i.e. "/").
+    auto mark = endpoint.find_first_of("/?#", scheme + 3);
+    if (mark == std::string::npos || endpoint[mark] != '/') return "/";
+    path = endpoint.substr(mark);
   }
   auto cut = path.find_first_of("?#");
   if (cut != std::string::npos) path = path.substr(0, cut);

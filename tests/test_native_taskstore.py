@@ -215,9 +215,42 @@ class TestEndpointPathParity:
             "/v1/org/api?y=3",
             "v1/org/api",
             "http://h",
+            "http://h?next=/a",
+            "http://h#f/rag",
         ]
         for ep in cases:
             t = store.upsert(APITask(task_id="", endpoint=ep, body=b"x"))
             expected = py_path(ep) or "/"
             assert store.set_len(expected, "created") >= 1, (ep, expected)
             assert store.get(t.task_id).endpoint == ep
+
+
+class TestReaperOnNativeStore:
+    def test_stuck_task_rescued_from_cpp_store(self):
+        """TaskReaper drives the native store through its conditional
+        transitions (requeue_if / update_status_if) — the sweep path must
+        work identically on the C++ engine."""
+        import asyncio
+
+        from ai4e_tpu.taskstore.reaper import TaskReaper
+
+        async def main():
+            store = NativeTaskStore()
+            republished = []
+            store.set_publisher(lambda t: republished.append(
+                (t.task_id, t.body)))
+            task = store.upsert(make_task(body=b"ORIG", endpoint="/v1/x"))
+            store.update_status(task.task_id, "running")
+            await asyncio.sleep(0.15)
+
+            reaper = TaskReaper(store, running_timeout=0.1)
+            assert await reaper.sweep() == 1
+            assert republished == [(task.task_id, b"ORIG")]
+            assert store.get(task.task_id).canonical_status == "created"
+            # A completed task is never clobbered by a stale sweep view.
+            store.update_status(task.task_id, "completed")
+            await asyncio.sleep(0.15)
+            assert await reaper.sweep() == 0
+            assert store.get(task.task_id).canonical_status == "completed"
+
+        asyncio.run(main())
