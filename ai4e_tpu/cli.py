@@ -79,6 +79,15 @@ def build_control_plane(config: FrameworkConfig, routes: dict):
             platform.publish_sync_api(api["prefix"], api["backend"])
             continue
         autoscale = api.get("autoscale")
+        if api.get("internal"):
+            # Pipeline-stage backend: transport consumer only, no public
+            # gateway route (tasks arrive via handoff republish).
+            platform.register_internal_route(
+                api["backend"],
+                retry_delay=api.get("retry_delay"),
+                concurrency=api.get("concurrency"),
+                autoscale=AutoscalePolicy(**autoscale) if autoscale else None)
+            continue
         platform.publish_async_api(
             api["prefix"], api["backend"],
             retry_delay=api.get("retry_delay"),
@@ -99,10 +108,23 @@ def _declarative_handoff(spec: dict | None):
     to the next stage (``CacheConnectorUpsert.cs:144-176`` semantics), so a
     detector can gate a classifier on the same image. When the gate field is
     empty/absent the stage completes the task itself.
+
+    ``"payload": "crops"`` instead ships the detector's CROPS to the next
+    stage's batch endpoint (``runtime/handoffs.crops_handoff``) — tune with
+    ``crop_size`` / ``max_crops`` / ``min_score``:
+
+    ``{"endpoint": "/v1/models/classify-species-batch-async",
+       "payload": "crops", "crop_size": 224, "max_crops": 16}``
     """
     if not spec:
         return None
     endpoint = spec["endpoint"]
+    if spec.get("payload") == "crops":
+        from .runtime.handoffs import crops_handoff
+        return crops_handoff(endpoint,
+                             crop_size=spec.get("crop_size", 224),
+                             max_crops=spec.get("max_crops", 16),
+                             min_score=spec.get("min_score"))
     gate = spec.get("when_nonempty")
 
     def pipeline_to(result):

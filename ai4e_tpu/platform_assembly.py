@@ -142,6 +142,20 @@ class LocalPlatform:
         loop (the reference's per-API ``autoscaler.yaml``) to the
         dispatcher's delivery fan-out."""
         self.gateway.add_async_route(public_prefix, backend_uri)
+        self.register_internal_route(backend_uri, retry_delay=retry_delay,
+                                     concurrency=concurrency,
+                                     autoscale=autoscale,
+                                     autoscale_interval=autoscale_interval)
+
+    def register_internal_route(self, backend_uri: str,
+                                retry_delay: float | None = None,
+                                concurrency: int | None = None,
+                                autoscale=None,
+                                autoscale_interval: float = 5.0) -> None:
+        """Transport consumer for a backend WITHOUT a public gateway route —
+        internal pipeline stages (e.g. the classifier batch endpoint a
+        detector's crops handoff targets) are reachable only by republished
+        tasks, never by clients."""
         queue_name = endpoint_path(backend_uri)
         if self.config.transport == "push":
             if autoscale is not None or retry_delay is not None or concurrency is not None:

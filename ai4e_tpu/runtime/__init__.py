@@ -1,5 +1,6 @@
 from .batcher import BatcherSaturated, MicroBatcher
 from .families import FAMILIES, build_servable
+from .handoffs import crops_handoff
 from .registry import ModelRuntime, ServableModel, enable_compilation_cache
 from .worker import InferenceWorker
 
@@ -11,5 +12,6 @@ __all__ = [
     "ServableModel",
     "InferenceWorker",
     "build_servable",
+    "crops_handoff",
     "enable_compilation_cache",
 ]

@@ -67,11 +67,22 @@ class InferenceWorker:
         ``pipeline_to`` makes this servable a *pipeline stage* (the composite
         ensembles of ``distributed_api_task.py:67-100``): a callable
         ``(result) -> (next_endpoint, body_bytes) | None`` evaluated after
-        inference on the async path. A tuple hands the task — same TaskId —
-        to the next API via AddPipelineTask; ``None`` means "nothing to hand
-        off" and the stage completes the task itself (e.g. a detector that
-        found no animals skips the classifier).
+        inference on the async path. A two-argument callable additionally
+        receives the stage's decoded input example — payload-shaping
+        handoffs (``handoffs.crops_handoff`` shipping detector crops to the
+        classifier) need the image, not just the JSON result. A tuple hands
+        the task — same TaskId — to the next API via AddPipelineTask;
+        ``None`` means "nothing to hand off" and the stage completes the
+        task itself (e.g. a detector that found no animals skips the
+        classifier).
         """
+        if pipeline_to is not None:
+            params = [
+                p for p in inspect.signature(pipeline_to).parameters.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+            handoff_wants_example = len(params) >= 2
+        else:
+            handoff_wants_example = False
         name = servable.name
         sync_path = sync_path or f"/{name}"
         async_path = async_path or f"/{name}-async"
@@ -124,7 +135,8 @@ class InferenceWorker:
                 await tm.add_pipeline_task(taskId, endpoint)
                 return
             if pipeline_to is not None:
-                handoff = pipeline_to(result)
+                handoff = (pipeline_to(result, example)
+                           if handoff_wants_example else pipeline_to(result))
                 if handoff is not None:
                     next_endpoint, next_body = handoff
                     # Keep the stage's intermediate output retrievable

@@ -332,6 +332,7 @@ class JournaledTaskStore(InMemoryTaskStore):
         # role, sized so compaction cost amortizes to ~zero per write.
         self._compact_every = compact_every
         self._records = 0
+        self._next_compact_at = compact_every
         self.replayed_task_ids: set[str] = set()
         if os.path.exists(journal_path):
             self._replay()
@@ -407,11 +408,14 @@ class JournaledTaskStore(InMemoryTaskStore):
         self._journal.write(json.dumps(rec) + "\n")
         self._journal.flush()
         self._records += 1
-        if (self._records >= self._compact_every
+        if (self._records >= self._next_compact_at
                 and self._records > 2 * len(self._tasks)):
             # The append above already made this mutation durable; a failed
             # rewrite (disk full) must not surface as an error for — or
-            # skip the notify/publish of — a transition that succeeded.
+            # skip the notify/publish of — a transition that succeeded. And
+            # it must not retry on the very next write (a full O(tasks)
+            # rewrite per transition while the disk is already under
+            # pressure): back off a full compaction interval either way.
             try:
                 self._compact_locked()
             except OSError:
@@ -419,6 +423,7 @@ class JournaledTaskStore(InMemoryTaskStore):
                 logging.getLogger("ai4e_tpu.taskstore").exception(
                     "journal auto-compaction failed; continuing on the "
                     "append-only journal")
+            self._next_compact_at = self._records + self._compact_every
 
     def _full_record(self, task: APITask) -> dict:
         """The journal's full (non-slim) record shape — one source of truth

@@ -114,7 +114,9 @@ class TestAutoscaleE2E:
                 for i in range(12):
                     await platform.task_manager.add_task(
                         backend, body=b"x", publish=True)
-                for _ in range(200):
+                # Generous poll: a loaded 1-core CI host can stall the
+                # event loop well past the controller's nominal cadence.
+                for _ in range(600):
                     if dispatcher.concurrency >= 6:
                         break
                     await asyncio.sleep(0.02)
@@ -123,7 +125,7 @@ class TestAutoscaleE2E:
                 # Unblock; queue drains; after stabilization it scales back
                 # to min.
                 release.set()
-                for _ in range(400):
+                for _ in range(1000):
                     if dispatcher.concurrency == 1 and inflight == 0:
                         break
                     await asyncio.sleep(0.02)

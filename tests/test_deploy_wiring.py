@@ -78,6 +78,40 @@ class TestHPAMetricWiring:
                         f"{sorted(scraped)} — HPA metric would be empty")
 
 
+class TestPipelineStageWiring:
+    def test_every_pipeline_target_has_a_transport_consumer(self):
+        """models.json pipeline_to endpoints are reachable only through the
+        transport — if routes.json registers no dispatcher for a stage's
+        backend path, handed-off tasks land on a queue nobody consumes and
+        sit in 'created' forever."""
+        import json as _json
+
+        from ai4e_tpu.cli import build_control_plane
+        from ai4e_tpu.config import FrameworkConfig
+        from ai4e_tpu.taskstore.task import endpoint_path
+
+        with open(os.path.join(REPO, "deploy", "specs", "models.json")) as f:
+            models = _json.load(f)
+        with open(os.path.join(REPO, "deploy", "specs", "routes.json")) as f:
+            routes = _json.load(f)
+        config = FrameworkConfig()
+        config.platform.retry_delay = 0.1
+        platform = build_control_plane(config, routes)
+        consumed = set(platform.dispatchers.dispatchers)
+        for spec in models["models"]:
+            target = (spec.get("pipeline_to") or {}).get("endpoint")
+            if target:
+                assert endpoint_path(target) in consumed, (
+                    f"{spec['name']} hands off to {target} but no routes.json "
+                    f"entry consumes that path (have: {sorted(consumed)})")
+        # Internal stages must not get a public gateway route.
+        gateway_paths = {r["prefix"] for r in routes["apis"]
+                         if not r.get("internal")}
+        for r in routes["apis"]:
+            if r.get("internal"):
+                assert "prefix" not in r or r["prefix"] not in gateway_paths
+
+
 class TestTLSGateway:
     def test_https_listener_mirrors_reference_secure_tier(self):
         docs = load_docs(os.path.join(CHARTS, "routing-tls.yaml"))
