@@ -389,6 +389,19 @@ async def run_bench(args) -> dict:
     throughput = completed / elapsed
     cfg = CONFIGS[args.model]
 
+    # Batching efficiency — THE design thesis vs the reference's
+    # one-request-per-POST dispatch: average examples per device batch,
+    # aggregated across every model the batcher fed (pipeline runs feed two).
+    batch_meta = {}
+    n_batches, n_examples = 0, 0.0
+    for _, _, _labels, data in batcher.metrics.histogram(
+            "ai4e_batch_size", "").collect():
+        n_batches += int(data["count"])
+        n_examples += float(data["sum"])
+    if n_batches:
+        batch_meta = {"device_batches": n_batches,
+                      "avg_batch_size": round(n_examples / n_batches, 2)}
+
     # On real hardware the bench doubles as the Pallas kernel-validation
     # artifact: Mosaic-compiled (interpret=False) kernels vs XLA oracles +
     # VMEM-budget assertions (ops/pallas/validate.py).
@@ -415,6 +428,7 @@ async def run_bench(args) -> dict:
         "concurrency": args.concurrency,
         "device": _device_kind(),
         **build_meta,
+        **batch_meta,
         **pallas_meta,
     }
 

@@ -111,6 +111,39 @@ class TestPipelineStageWiring:
             if r.get("internal"):
                 assert "prefix" not in r or r["prefix"] not in gateway_paths
 
+    def test_crops_handoff_size_matches_downstream_input(self):
+        """A crops handoff ships (N, crop_size, crop_size, 3) stacks; the
+        target model's batch decode rejects anything but its own
+        (image_size, image_size, 3) — a drifted spec would fail 100% of
+        pipelined traffic at runtime, so pin the agreement here."""
+        import json as _json
+
+        from ai4e_tpu.taskstore.task import endpoint_path
+
+        with open(os.path.join(REPO, "deploy", "specs", "models.json")) as f:
+            models = _json.load(f)
+        by_batch_path = {}
+        for spec in models["models"]:
+            batch = spec.get("batch") or {}
+            path = batch.get("async_path")
+            if path:
+                prefix = "/" + models.get("prefix", "v1").strip("/")
+                by_batch_path[prefix + path] = spec
+        for spec in models["models"]:
+            pt = spec.get("pipeline_to") or {}
+            if pt.get("payload") != "crops":
+                continue
+            target = by_batch_path.get(endpoint_path(pt["endpoint"]))
+            assert target is not None, (
+                f"{spec['name']} ships crops to {pt['endpoint']} but no "
+                "model exposes that batch endpoint")
+            crop = pt.get("crop_size", 224)
+            want = target.get("image_size", 224)
+            assert crop == want, (
+                f"{spec['name']} crops at {crop}px but {target['name']} "
+                f"ingests {want}px — every handed-off stack would be "
+                "rejected at decode")
+
 
 class TestTLSGateway:
     def test_https_listener_mirrors_reference_secure_tier(self):
