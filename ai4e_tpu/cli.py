@@ -44,7 +44,7 @@ import json
 import logging
 import signal
 
-from .config import FrameworkConfig
+from .config import ConfigError, FrameworkConfig
 
 log = logging.getLogger("ai4e_tpu.cli")
 
@@ -62,6 +62,16 @@ def build_control_plane(config: FrameworkConfig, routes: dict):
     from .taskstore.http import make_app as make_taskstore_app
 
     platform = LocalPlatform(config.to_platform_config())
+    if config.gateway.api_keys is not None:
+        # APIM front-door parity: published APIs require a subscription key.
+        keys = {k.strip() for k in config.gateway.api_keys.split(",")
+                if k.strip()}
+        if not keys:
+            # Fail CLOSED: a set-but-empty keys value means the operator
+            # wanted auth; silently running open would invert that intent.
+            raise ConfigError(
+                "AI4E_GATEWAY_API_KEYS is set but contains no keys")
+        platform.gateway.set_api_keys(keys)
     # The task-store HTTP surface rides on the gateway app — one
     # control-plane port serves the CACHE_CONNECTOR_*_URI endpoints remote
     # workers use (distributed_api_task.py:14-15 pattern).
@@ -186,8 +196,9 @@ def build_worker(config: FrameworkConfig, models: dict):
 
     store_base = models.get("taskstore") or config.gateway.taskstore_get_uri
     if store_base:
-        task_manager = HttpTaskManager(store_base)
-        store = HttpResultStore(store_base)
+        key = config.service.taskstore_api_key
+        task_manager = HttpTaskManager(store_base, api_key=key)
+        store = HttpResultStore(store_base, api_key=key)
     else:
         # Standalone worker (dev): own in-memory store.
         from .taskstore import InMemoryTaskStore

@@ -163,6 +163,9 @@ class ServiceSection:
     # SERVICE_CLUSTER in ai4e_service.py:21,135-146); None disables.
     reporter_uri: typing.Optional[str] = None
     cluster: str = "local"
+    # Subscription key the worker attaches to task-store calls when the
+    # control plane runs with gateway api_keys (same secret).
+    taskstore_api_key: typing.Optional[str] = None
 
 
 @_env_section("AI4E_RUNTIME_")
@@ -191,6 +194,10 @@ class GatewaySection:
     port: int = 8080
     taskstore_upsert_uri: typing.Optional[str] = None
     taskstore_get_uri: typing.Optional[str] = None
+    # Comma-separated subscription keys; set → every published API and
+    # /v1/taskmanagement call must carry one (Ocp-Apim-Subscription-Key or
+    # X-Api-Key header) — the reference's APIM front-door contract.
+    api_keys: typing.Optional[str] = None
 
 
 @_env_section("AI4E_OBSERVABILITY_")

@@ -46,7 +46,8 @@ class Route:
 
 class Gateway:
     def __init__(self, store: InMemoryTaskStore,
-                 metrics: MetricsRegistry | None = None):
+                 metrics: MetricsRegistry | None = None,
+                 api_keys: set[str] | None = None):
         self.store = store
         self.metrics = metrics or DEFAULT_REGISTRY
         self.routes: list[Route] = []
@@ -55,14 +56,45 @@ class Gateway:
         self._sessions = SessionHolder()
         # task_id -> {(loop, Event)} long-poll waiters (see _task).
         self._waiters: dict[str, set] = {}
+        # Subscription-key auth (the reference's APIM front door requires
+        # Ocp-Apim-Subscription-Key on every published API). None → open.
+        self._api_keys = set(api_keys) if api_keys else None
         if hasattr(store, "add_listener"):
             store.add_listener(self._on_task_change)
 
-        self.app = web.Application(client_max_size=1024**3)
+        self.app = web.Application(client_max_size=1024**3,
+                                   middlewares=[self._auth_middleware])
         self.app.router.add_get("/v1/taskmanagement/task/{task_id}", self._task)
         self.app.router.add_get("/healthz", self._health)
         self.app.router.add_get("/metrics", self._metrics)
         self.app.on_cleanup.append(self._cleanup)
+
+    def set_api_keys(self, keys: set[str] | None) -> None:
+        """Enable (or clear) subscription-key auth on the public surface."""
+        self._api_keys = set(keys) if keys else None
+
+    @web.middleware
+    async def _auth_middleware(self, request: web.Request, handler):
+        """Subscription-key gate — the APIM front-door behavior (every
+        reference API call carries ``Ocp-Apim-Subscription-Key``). When keys
+        are set, EVERYTHING on this app except health/metrics requires one —
+        including the task-store surface when it rides this port (an open
+        ``/v1/taskstore/*`` beside a keyed public API would hand out the
+        same task data the 401 just protected); workers attach the key via
+        ``AI4E_SERVICE_TASKSTORE_API_KEY``.
+        """
+        if self._api_keys is not None:
+            if request.path not in ("/healthz", "/metrics"):
+                key = (request.headers.get("Ocp-Apim-Subscription-Key")
+                       or request.headers.get("X-Api-Key"))
+                if key not in self._api_keys:
+                    # Constant label: the path is attacker-chosen and would
+                    # grow metric cardinality without bound.
+                    self._requests.inc(route="unauthorized", outcome="401")
+                    return web.json_response(
+                        {"error": "missing or invalid subscription key"},
+                        status=401)
+        return await handler(request)
 
     def add_async_route(self, prefix: str, task_endpoint: str) -> None:
         """Register an async API: requests become tasks addressed to

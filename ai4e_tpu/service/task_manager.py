@@ -97,12 +97,22 @@ class LocalTaskManager(TaskManagerBase):
 
 
 class _HttpStoreClient:
-    """Shared plumbing for clients of the task-store HTTP service."""
+    """Shared plumbing for clients of the task-store HTTP service.
+
+    ``api_key`` rides as a default ``Ocp-Apim-Subscription-Key`` header on
+    every request — required when the control plane runs with gateway
+    subscription keys (the task-store surface on that port is keyed too;
+    set ``AI4E_SERVICE_TASKSTORE_API_KEY`` on workers). Ignored when the
+    caller passes its own ``session``.
+    """
 
     def __init__(self, base_url: str,
-                 session: aiohttp.ClientSession | None = None):
+                 session: aiohttp.ClientSession | None = None,
+                 api_key: str | None = None):
         self.base_url = base_url.rstrip("/")
-        self._holder = SessionHolder(session)
+        headers = ({"Ocp-Apim-Subscription-Key": api_key}
+                   if api_key else None)
+        self._holder = SessionHolder(session, headers=headers)
 
     async def _get_session(self) -> aiohttp.ClientSession:
         return await self._holder.get()

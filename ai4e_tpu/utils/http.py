@@ -12,9 +12,11 @@ class SessionHolder:
     so concurrent first calls can't leak an extra session."""
 
     def __init__(self, session: aiohttp.ClientSession | None = None,
-                 timeout: float | None = None):
+                 timeout: float | None = None,
+                 headers: dict[str, str] | None = None):
         self._session = session
         self._timeout = timeout
+        self._headers = headers
         self._create_lock: asyncio.Lock | None = None
 
     async def get(self) -> aiohttp.ClientSession:
@@ -27,6 +29,8 @@ class SessionHolder:
                 kw = {}
                 if self._timeout is not None:
                     kw["timeout"] = aiohttp.ClientTimeout(total=self._timeout)
+                if self._headers:
+                    kw["headers"] = dict(self._headers)
                 self._session = aiohttp.ClientSession(**kw)
         return self._session
 

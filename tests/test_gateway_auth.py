@@ -1,0 +1,109 @@
+"""Gateway subscription-key auth — the reference's APIM front door requires
+``Ocp-Apim-Subscription-Key`` on every published API call; here it's an
+opt-in middleware (AI4E_GATEWAY_API_KEYS) gating the public surface while
+health/metrics and the cluster-internal task-store surface stay open."""
+
+import asyncio
+import io
+
+import numpy as np
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+class TestGatewayAuth:
+    def test_key_required_on_published_apis_and_polling(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            platform.gateway.set_api_keys({"good-key"})
+            platform.publish_async_api("/v1/api/run",
+                                       "http://127.0.0.1:1/v1/api/run")
+            gw = await serve(platform.gateway.app)
+            try:
+                # No key → 401; wrong key → 401.
+                r = await gw.post("/v1/api/run", data=b"x")
+                assert r.status == 401
+                r = await gw.post("/v1/api/run", data=b"x",
+                                  headers={"X-Api-Key": "bad"})
+                assert r.status == 401
+
+                # Reference header name works; task created.
+                r = await gw.post(
+                    "/v1/api/run", data=b"x",
+                    headers={"Ocp-Apim-Subscription-Key": "good-key"})
+                assert r.status == 200
+                tid = (await r.json())["TaskId"]
+
+                # Polling is part of the public surface: keyless 401,
+                # keyed 200.
+                r = await gw.get(f"/v1/taskmanagement/task/{tid}")
+                assert r.status == 401
+                r = await gw.get(f"/v1/taskmanagement/task/{tid}",
+                                 headers={"X-Api-Key": "good-key"})
+                assert r.status == 200
+
+                # Operational + cluster-internal surfaces stay open.
+                assert (await gw.get("/healthz")).status == 200
+                assert (await gw.get("/metrics")).status == 200
+            finally:
+                await gw.close()
+
+        run(main())
+
+    def test_taskstore_surface_keyed_and_workers_attach_key(self):
+        """When keys are set, the task-store surface riding the same port is
+        keyed TOO (an open /v1/taskstore/* beside a keyed public API would
+        hand out the very task data the 401 protects); workers reach it by
+        attaching the key (HttpTaskManager(api_key=...) —
+        AI4E_SERVICE_TASKSTORE_API_KEY)."""
+        from ai4e_tpu.service.task_manager import HttpTaskManager
+        from ai4e_tpu.taskstore.http import make_app
+
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            platform.gateway.set_api_keys({"k"})
+            make_app(platform.store, app=platform.gateway.app)
+            gw = await serve(platform.gateway.app)
+            try:
+                # Keyless store access is refused — no side door.
+                r = await gw.post("/v1/taskstore/upsert",
+                                  json={"Endpoint": "/v1/x", "Body": "b"})
+                assert r.status == 401
+
+                tm = HttpTaskManager(str(gw.make_url("")), api_key="k")
+                task = await tm.add_task("/v1/x", b"payload")
+                assert task["Status"] == "created"
+                got = await tm.get_task_status(task["TaskId"])
+                assert got["TaskId"] == task["TaskId"]
+                await tm.close()
+            finally:
+                await gw.close()
+
+        run(main())
+
+    def test_no_keys_configured_means_open(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            platform.publish_async_api("/v1/open/run",
+                                       "http://127.0.0.1:1/v1/open/run")
+            gw = await serve(platform.gateway.app)
+            try:
+                buf = io.BytesIO()
+                np.save(buf, np.zeros(2, np.float32))
+                r = await gw.post("/v1/open/run", data=buf.getvalue())
+                assert r.status == 200
+            finally:
+                await gw.close()
+
+        run(main())
