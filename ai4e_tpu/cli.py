@@ -196,7 +196,10 @@ def build_worker(config: FrameworkConfig, models: dict):
 
     store_base = models.get("taskstore") or config.gateway.taskstore_get_uri
     if store_base:
-        key = config.service.taskstore_api_key
+        # The chart mounts the gateway's comma-separated "keys" secret entry
+        # directly; the worker authenticates with the first key.
+        key = (config.service.taskstore_api_key or "").split(",")[0].strip() \
+            or None
         task_manager = HttpTaskManager(store_base, api_key=key)
         store = HttpResultStore(store_base, api_key=key)
     else:

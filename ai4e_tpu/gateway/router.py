@@ -159,8 +159,14 @@ class Gateway:
             try:
                 async with session.request(
                     request.method, target, data=body,
+                    # Strip hop headers AND the gateway credential: a sync
+                    # backend (arbitrary URI, possibly third-party) must
+                    # never see the subscription key it could replay against
+                    # the keyed public surface.
                     headers={k: v for k, v in request.headers.items()
-                             if k.lower() not in ("host", "content-length")},
+                             if k.lower() not in (
+                                 "host", "content-length",
+                                 "ocp-apim-subscription-key", "x-api-key")},
                 ) as resp:
                     payload = await resp.read()
                     self._requests.inc(route=route.prefix, outcome=str(resp.status))

@@ -7,12 +7,15 @@ is exercised by a real servable, the same way seqformer exercises ``sp``.
 
 Design (TPU-first):
 
-- **Routing** is top-1 token-choice, computed as a dense one-hot combine —
-  every expert runs over every token and the gate zeroes the losers. That is
-  E× the FLOPs of capacity-based dispatch, but it is fully static (no
-  data-dependent shapes, no token dropping, bitwise deterministic), which is
-  what XLA wants; at serving-size expert counts (4-16) the MXU is still the
-  bottleneck and the win is sharding, not sparsity.
+- **Routing** is top-1 token-choice with two static dispatch strategies
+  (``MoEFFN.dispatch``): ``dense`` — every expert runs every token, the gate
+  zeroes the losers (E× FLOPs, zero bookkeeping, bitwise deterministic;
+  right for small E where the win is sharding) — and ``capacity`` — the
+  GShard/Switch production shape: grouped tokens, per-group static expert
+  capacity, cumsum slot assignment (no sorts, no dynamic shapes), FFN cost
+  ~``capacity_factor·T`` token-passes, overflow tokens dropped to the
+  residual. Both compile to fixed shapes; XLA never sees data-dependent
+  control flow.
 - **Expert parallelism**: expert weight tensors are (E, D, H) with
   ``P("ep", None, None)`` — each ep shard holds E/ep experts and computes
   only their einsum slices; the token-combine contraction reduces over E, so
@@ -40,9 +43,25 @@ MOE_EP_RULES = {
 
 
 class MoEFFN(nn.Module):
+    """Top-1 token-choice MoE FFN with two dispatch strategies:
+
+    - ``dense`` — every expert runs every token, gate zeroes the losers.
+      E× the FLOPs, zero bookkeeping, bitwise deterministic; right for
+      small E where the win is sharding, not sparsity.
+    - ``capacity`` — the production MoE shape (GShard/Switch style): each
+      expert processes at most ``C = ceil(T/E · capacity_factor)`` tokens,
+      gathered with a static one-hot dispatch tensor (cumsum position
+      assignment — no sorts, no dynamic shapes). FFN FLOPs drop from
+      ``E·T`` to ``E·C ≈ capacity_factor·T`` token-passes; overflow tokens
+      are dropped (their residual branch passes through unchanged).
+    Expert tensors shard over ``ep`` either way.
+    """
+
     dim: int
     num_experts: int
     mlp_ratio: int = 4
+    dispatch: str = "dense"
+    capacity_factor: float = 1.25
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -53,21 +72,66 @@ class MoEFFN(nn.Module):
                           name="router")(x.astype(jnp.float32))
         gates = jax.nn.softmax(logits, axis=-1)            # (B, S, E)
         top = jnp.argmax(gates, axis=-1)                   # (B, S)
-        dispatch = (jax.nn.one_hot(top, self.num_experts, dtype=jnp.float32)
-                    * jnp.max(gates, axis=-1, keepdims=True))
+        top_gate = jnp.max(gates, axis=-1)                 # (B, S)
 
         up = self.param("up", nn.initializers.lecun_normal(),
                         (self.num_experts, self.dim, hidden))
         down = self.param("down", nn.initializers.lecun_normal(),
                           (self.num_experts, hidden, self.dim))
-        xb = x.astype(self.dtype)
-        # e is sharded over ep: each shard computes its experts' slices...
-        h = jnp.einsum("bsd,edh->bseh", xb, up.astype(self.dtype))
-        h = nn.gelu(h)
-        out = jnp.einsum("bseh,ehd->bsed", h, down.astype(self.dtype))
-        # ...and this contraction reduces over e → one psum over ep.
-        y = jnp.einsum("bsed,bse->bsd", out.astype(jnp.float32), dispatch)
+
+        if self.dispatch == "capacity":
+            y = self._capacity_dispatch(x, top, top_gate, up, down)
+        else:
+            onehot = (jax.nn.one_hot(top, self.num_experts,
+                                     dtype=jnp.float32)
+                      * top_gate[..., None])
+            xb = x.astype(self.dtype)
+            # e is sharded over ep: each shard computes its experts...
+            h = jnp.einsum("bsd,edh->bseh", xb, up.astype(self.dtype))
+            h = nn.gelu(h)
+            out = jnp.einsum("bseh,ehd->bsed", h, down.astype(self.dtype))
+            # ...and this contraction reduces over e → one psum over ep.
+            y = jnp.einsum("bsed,bse->bsd", out.astype(jnp.float32), onehot)
         return y.astype(x.dtype), top
+
+    GROUP = 128  # GShard-style group size: dispatch cost is linear in T
+                 # (~GROUP·cf·T elements), never quadratic
+
+    def _capacity_dispatch(self, x, top, top_gate, up, down):
+        b, s, d = x.shape
+        e = self.num_experts
+        # Tokens are dispatched in fixed-size GROUPS with per-group capacity
+        # (the GShard (G, S_g, E, C) shape): the one-hot dispatch/combine
+        # tensors cost G·S_g·E·C = T·S_g·cf elements — linear in T for the
+        # fixed S_g — where a flat-T dispatch would be cf·T² and dwarf the
+        # expert matmuls it's routing for.
+        sg = min(s, self.GROUP)
+        while s % sg:
+            sg -= 1
+        g = (b * s) // sg
+        cap = max(1, int(np.ceil(sg / e * self.capacity_factor)))
+
+        xg = x.reshape(g, sg, d)
+        oh = jax.nn.one_hot(top.reshape(g, sg), e,
+                            dtype=jnp.float32)             # (G, Sg, E)
+        # Static position assignment: the k-th token of a group routed to an
+        # expert takes slot k-1; slots >= cap overflow (dropped — residual
+        # carries the token). cumsum replaces a sort: order is arrival order.
+        pos = (jnp.cumsum(oh, axis=1) * oh).sum(-1) - 1.0  # (G, Sg)
+        slot = jnp.where(pos < cap, pos, cap).astype(jnp.int32)
+        slot_oh = jax.nn.one_hot(slot, cap + 1,
+                                 dtype=jnp.float32)[..., :cap]  # (G, Sg, C)
+        dispatch = oh[..., None] * slot_oh[..., None, :]   # (G, Sg, E, C)
+
+        # Gather per-expert token blocks; e shards over ep, so each shard
+        # builds + runs only its experts' (G, C, D) blocks on the MXU.
+        de = dispatch.astype(self.dtype)
+        xe = jnp.einsum("gsec,gsd->gecd", de, xg.astype(self.dtype))
+        h = nn.gelu(jnp.einsum("gecd,edh->gech", xe, up.astype(self.dtype)))
+        oe = jnp.einsum("gech,ehd->gecd", h, down.astype(self.dtype))
+        combine = dispatch * top_gate.reshape(g, sg)[..., None, None]
+        y = jnp.einsum("gsec,gecd->gsd", combine, oe.astype(jnp.float32))
+        return y.reshape(b, s, d)
 
 
 class MoEBlock(nn.Module):
@@ -75,6 +139,8 @@ class MoEBlock(nn.Module):
     heads: int
     num_experts: int
     attn_fn: Callable
+    dispatch: str = "dense"
+    capacity_factor: float = 1.25
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -82,8 +148,9 @@ class MoEBlock(nn.Module):
         from .seqformer import SeqAttention
         x = x + SeqAttention(self.dim, self.heads, self.attn_fn,
                              dtype=self.dtype, name="attn")(nn.LayerNorm()(x))
-        h, top = MoEFFN(self.dim, self.num_experts, dtype=self.dtype,
-                        name="moe")(nn.LayerNorm()(x))
+        h, top = MoEFFN(self.dim, self.num_experts, dispatch=self.dispatch,
+                        capacity_factor=self.capacity_factor,
+                        dtype=self.dtype, name="moe")(nn.LayerNorm()(x))
         return x + h, top
 
 
@@ -98,6 +165,8 @@ class MoEClassifier(nn.Module):
     num_experts: int = 8
     num_classes: int = 16
     attn_fn: Callable = None
+    dispatch: str = "dense"
+    capacity_factor: float = 1.25
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -110,6 +179,8 @@ class MoEClassifier(nn.Module):
         h = h + pos.astype(self.dtype)
         for i in range(self.depth):
             h, _ = MoEBlock(self.dim, self.heads, self.num_experts, attn_fn,
+                            dispatch=self.dispatch,
+                            capacity_factor=self.capacity_factor,
                             dtype=self.dtype, name=f"block{i}")(h)
         h = nn.LayerNorm()(h.mean(axis=1))
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(h)
@@ -118,11 +189,13 @@ class MoEClassifier(nn.Module):
 def create_moe(rng=None, seq_len: int = 1024, input_dim: int = 64,
                dim: int = 128, depth: int = 2, heads: int = 8,
                num_experts: int = 8, num_classes: int = 16, mesh=None,
-               attention: str = "flash"):
+               attention: str = "flash", dispatch: str = "dense",
+               capacity_factor: float = 1.25):
     """Build model + params; on a mesh with ep > 1 the expert tensors are
     placed with ``MOE_EP_RULES`` so serving/training shard the expert dim.
 
     ``num_experts`` must divide by the mesh's ep size (static SPMD shapes).
+    ``dispatch``: "dense" or "capacity" (see ``MoEFFN``).
     """
     from .seqformer import attention_for
 
@@ -131,10 +204,13 @@ def create_moe(rng=None, seq_len: int = 1024, input_dim: int = 64,
         if num_experts % max(ep, 1):
             raise ValueError(
                 f"num_experts {num_experts} not divisible by ep={ep}")
+    if dispatch not in ("dense", "capacity"):
+        raise ValueError(f"unknown dispatch {dispatch!r}")
     model = MoEClassifier(
         seq_len=seq_len, input_dim=input_dim, dim=dim, depth=depth,
         heads=heads, num_experts=num_experts, num_classes=num_classes,
-        attn_fn=attention_for(mesh, attention))
+        attn_fn=attention_for(mesh, attention), dispatch=dispatch,
+        capacity_factor=capacity_factor)
     init_model = model.clone(attn_fn=lambda q, k, v: q)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     params = init_model.init(rng,

@@ -293,17 +293,20 @@ def build_seqformer(name: str = "longcontext", seq_len: int = 4096,
 def build_moe(name: str = "moe", seq_len: int = 1024, input_dim: int = 64,
               dim: int = 128, depth: int = 2, heads: int = 8,
               num_experts: int = 8, num_classes: int = 16,
-              attention: str = "flash", buckets=(1, 8), mesh=None,
+              attention: str = "flash", dispatch: str = "dense",
+              capacity_factor: float = 1.25, buckets=(1, 8), mesh=None,
               **_) -> ServableModel:
     """Mixture-of-Experts sequence classification — the expert-parallel
     family: expert tensors shard over the mesh's ``ep`` axis
-    (``models/moe.py``), composing with dp/fsdp exactly like seqformer's sp."""
+    (``models/moe.py``), composing with dp/fsdp exactly like seqformer's sp.
+    ``dispatch="capacity"`` serves the GShard-style static-capacity path."""
     from ..models.moe import MOE_EP_RULES, create_moe
 
     model, params = create_moe(
         seq_len=seq_len, input_dim=input_dim, dim=dim, depth=depth,
         heads=heads, num_experts=num_experts, num_classes=num_classes,
-        mesh=mesh, attention=attention)
+        mesh=mesh, attention=attention, dispatch=dispatch,
+        capacity_factor=capacity_factor)
 
     return ServableModel(
         name=name, apply_fn=model.apply, params=params,

@@ -107,3 +107,42 @@ class TestGatewayAuth:
                 await gw.close()
 
         run(main())
+
+
+class TestProxyCredentialStripping:
+    def test_sync_backend_never_sees_the_subscription_key(self):
+        """The sync reverse-proxy must strip the gateway credential before
+        forwarding — an arbitrary (possibly third-party) backend could
+        otherwise harvest and replay it against the keyed surface."""
+        from aiohttp import web
+
+        async def main():
+            seen = {}
+
+            async def backend(request):
+                seen.update(request.headers)
+                return web.json_response({"ok": True})
+
+            app = web.Application()
+            app.router.add_post("/v1/b/run", backend)
+            be = await serve(app)
+
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            platform.gateway.set_api_keys({"secret-key"})
+            platform.publish_sync_api(
+                "/v1/b/run", str(be.make_url("")).rstrip("/") + "/v1/b/run")
+            gw = await serve(platform.gateway.app)
+            try:
+                r = await gw.post(
+                    "/v1/b/run", data=b"x",
+                    headers={"Ocp-Apim-Subscription-Key": "secret-key",
+                             "X-Custom": "kept"})
+                assert r.status == 200
+                assert "Ocp-Apim-Subscription-Key" not in seen
+                assert "X-Api-Key" not in seen
+                assert seen.get("X-Custom") == "kept"
+            finally:
+                await gw.close()
+                await be.close()
+
+        run(main())
