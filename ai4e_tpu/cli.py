@@ -197,9 +197,13 @@ def build_worker(config: FrameworkConfig, models: dict):
     store_base = models.get("taskstore") or config.gateway.taskstore_get_uri
     if store_base:
         # The chart mounts the gateway's comma-separated "keys" secret entry
-        # directly; the worker authenticates with the first key.
-        key = (config.service.taskstore_api_key or "").split(",")[0].strip() \
-            or None
+        # directly; the worker authenticates with the first NON-EMPTY key
+        # (same filtering as the gateway's parse — a leading comma must not
+        # silently leave the worker keyless against a keyed store).
+        key = next(
+            (k.strip()
+             for k in (config.service.taskstore_api_key or "").split(",")
+             if k.strip()), None)
         task_manager = HttpTaskManager(store_base, api_key=key)
         store = HttpResultStore(store_base, api_key=key)
     else:

@@ -81,7 +81,7 @@ class MoEFFN(nn.Module):
 
         if self.dispatch == "capacity":
             y = self._capacity_dispatch(x, top, top_gate, up, down)
-        else:
+        elif self.dispatch == "dense":
             onehot = (jax.nn.one_hot(top, self.num_experts,
                                      dtype=jnp.float32)
                       * top_gate[..., None])
@@ -92,6 +92,11 @@ class MoEFFN(nn.Module):
             out = jnp.einsum("bseh,ehd->bsed", h, down.astype(self.dtype))
             # ...and this contraction reduces over e → one psum over ep.
             y = jnp.einsum("bsed,bse->bsd", out.astype(jnp.float32), onehot)
+        else:
+            # Validate where the field is consumed, not only in create_moe:
+            # a typo'd strategy must not silently run the dense path.
+            raise ValueError(f"unknown MoE dispatch {self.dispatch!r}; "
+                             "expected 'dense' or 'capacity'")
         return y.astype(x.dtype), top
 
     GROUP = 128  # GShard-style group size: dispatch cost is linear in T
@@ -108,6 +113,13 @@ class MoEFFN(nn.Module):
         sg = min(s, self.GROUP)
         while s % sg:
             sg -= 1
+        if s > 8 and sg < 8:
+            # A prime-ish sequence length would collapse to one-token
+            # groups: capacity becomes vacuous (cap >= 1 drops nothing) and
+            # the dispatch overhead exceeds the dense path it should beat.
+            raise ValueError(
+                f"seq_len {s} has no group divisor >= 8; pad the sequence "
+                "(e.g. to a multiple of 128) for capacity dispatch")
         g = (b * s) // sg
         cap = max(1, int(np.ceil(sg / e * self.capacity_factor)))
 

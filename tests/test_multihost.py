@@ -26,21 +26,21 @@ def free_port() -> int:
 
 
 class TestMultihostServing:
-    def test_two_process_broadcast_and_mirror(self):
+    def _run_procs(self, nprocs: int, timeout: float = 180.0):
         port = free_port()
         env = dict(os.environ, PYTHONPATH=REPO + os.pathsep
                    + os.environ.get("PYTHONPATH", ""))
         env.pop("JAX_PLATFORMS", None)
         procs = [
             subprocess.Popen(
-                [sys.executable, SCRIPT, str(i), "2", str(port)],
+                [sys.executable, SCRIPT, str(i), str(nprocs), str(port)],
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
-            for i in range(2)
+            for i in range(nprocs)
         ]
         outs = []
         try:
             for p in procs:
-                out, err = p.communicate(timeout=120)
+                out, err = p.communicate(timeout=timeout)
                 outs.append((p.returncode, out.decode(), err.decode()))
         finally:
             for p in procs:
@@ -49,7 +49,17 @@ class TestMultihostServing:
         for rc, out, err in outs:
             assert rc == 0, f"proc failed rc={rc}\nstdout={out}\nstderr={err}"
         assert "PRIMARY_OK" in outs[0][1]
-        assert "FOLLOWER_OK" in outs[1][1]
+        for i in range(1, nprocs):
+            assert "FOLLOWER_OK" in outs[i][1]
+
+    def test_two_process_broadcast_and_mirror(self):
+        self._run_procs(2)
+
+    def test_four_process_sharded_ingestion(self):
+        """4 jax.distributed processes: every follower fetches only ITS
+        quarter of the batch (egress assert in multihost_proc.py scales as
+        (nprocs-1)/nprocs) and all stay in SPMD lockstep."""
+        self._run_procs(4)
 
 
 class TestMultihostWorkerCLI:
