@@ -221,7 +221,8 @@ def build_worker(config: FrameworkConfig, models: dict):
                                             cluster=config.service.cluster)
 
     batcher = MicroBatcher(runtime, max_wait_ms=rt.batch_max_wait_ms,
-                           max_pending=rt.batch_max_pending)
+                           max_pending=rt.batch_max_pending,
+                           pipeline_depth=rt.batch_pipeline_depth)
     worker = InferenceWorker(
         models.get("service_name", "tpu-worker"), runtime, batcher,
         task_manager=task_manager, prefix=models.get("prefix", "v1"),

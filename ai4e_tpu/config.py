@@ -174,6 +174,11 @@ class RuntimeSection:
     platform: typing.Optional[str] = None  # pin jax_platforms (e.g. "cpu")
     batch_max_wait_ms: float = 5.0
     batch_max_pending: int = 256
+    # In-flight device batches (MicroBatcher pipeline window). 2 = double
+    # buffering, right for a locally-attached chip; raise to ~6 when the
+    # host↔device link is long-fat (remote-attached TPU) so transfers of
+    # several batches overlap.
+    batch_pipeline_depth: int = 2
     buckets: typing.Tuple[int, ...] = (1, 8, 32, 64)
     compile_cache_dir: str = "/tmp/ai4e_tpu_xla_cache"
     checkpoint_dir: typing.Optional[str] = None

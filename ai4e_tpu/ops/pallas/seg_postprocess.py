@@ -78,8 +78,14 @@ def class_histogram(classmap: jax.Array, num_classes: int) -> jax.Array:
 
 
 def fused_seg_postprocess(logits: jax.Array,
-                          interpret: bool | None = None) -> dict:
-    """Full API postprocess: class map + per-class counts."""
+                          interpret: bool | None = None,
+                          with_classmap: bool = True) -> dict:
+    """Full API postprocess: per-class counts, plus the uint8 class map when
+    ``with_classmap``. Histogram-only APIs pass False so the map never leaves
+    the device — the counts are B·C int32s, ~4000× less device→host traffic
+    than the map (which itself is 16× less than the logits)."""
     classmap = segmentation_argmax(logits, interpret=interpret)
     counts = class_histogram(classmap, logits.shape[-1])
-    return {"classmap": classmap, "counts": counts}
+    if with_classmap:
+        return {"classmap": classmap, "counts": counts}
+    return {"counts": counts}

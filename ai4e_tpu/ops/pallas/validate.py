@@ -68,8 +68,17 @@ def validate_kernels(interpret: bool = False) -> dict:
     err = float(np.max(np.abs(got - want)))
     vmem = flash_attention_vmem_bytes(128, 128, d)
     assert vmem <= VMEM_BUDGET_BYTES, f"flash attention VMEM {vmem}"
+    # Tolerance is set by the arithmetic of the executing backend, not the
+    # kernel (or the interpret flag — interpret-mode jnp ops still run on
+    # the default device): at DEFAULT precision the TPU MXU truncates f32
+    # matmul operands to bf16 (~8 mantissa bits), so vs the f64-exact numpy
+    # oracle the attention output carries ~4e-3 absolute error at these
+    # scales (r2 measured 2.5e-3 on v5e, identically under interpret=True).
+    # CPU runs true f32 (~1e-6) and keeps the tight bound so CPU CI still
+    # catches sub-1e-2 kernel-logic regressions.
+    tol = 1e-2 if jax.default_backend() == "tpu" else 1e-4
     results["flash_attention"] = {
-        "ok": bool(err < 2e-3), "max_err": round(err, 6), "vmem_bytes": vmem}
+        "ok": bool(err < tol), "max_err": round(err, 6), "vmem_bytes": vmem}
 
     # segmentation argmax vs jnp.argmax — the land-cover serving shape.
     bb, hh, ww, cc = 2, 256, 256, 4

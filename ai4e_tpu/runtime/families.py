@@ -75,6 +75,19 @@ def cast_image_payload(arr: np.ndarray, dtype) -> np.ndarray:
     return arr.astype(dtype, copy=False)
 
 
+def encode_classmap_png(classmap: np.ndarray) -> str:
+    """(H, W) uint8 class ids → base64 PNG string (grayscale, lossless;
+    pixel value == class id) — the classified-tile payload of the
+    reference's land-cover API."""
+    import base64
+
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(classmap.astype(np.uint8), mode="L").save(buf, "PNG")
+    return base64.b64encode(buf.getvalue()).decode("ascii")
+
+
 def _classification_postprocess(labels: list | None = None):
     """Softmax + argmax → {class_id, label?, confidence} — shared by every
     classifier family."""
@@ -109,8 +122,17 @@ def build_echo(name: str = "echo", size: int = 16, buckets=(8,),
 
 def build_unet(name: str = "landcover", tile: int = 256,
                widths=(32, 64, 128), num_classes: int = 8, buckets=(1, 16, 64),
-               fused_postprocess: bool = True, **_) -> ServableModel:
-    """Land-cover segmentation (BASELINE.json config #2)."""
+               fused_postprocess: bool = True,
+               return_classmap: bool = False, **_) -> ServableModel:
+    """Land-cover segmentation (BASELINE.json config #2).
+
+    ``return_classmap`` adds the classified tile itself to the response as a
+    base64 PNG (the reference's land-cover APIs return classified tiles, not
+    just statistics). Off by default: the histogram API then fetches only
+    B·C int32 counts from the device — on a remote-attached TPU the uint8
+    map would otherwise dominate the device→host link (H·W bytes/example vs
+    ~32).
+    """
     from ..models import create_unet
     from ..ops.pallas import fused_seg_postprocess, normalize_image
 
@@ -120,12 +142,17 @@ def build_unet(name: str = "landcover", tile: int = 256,
     if fused_postprocess:
         def apply_fn(p, batch):
             x = normalize_image(batch)
-            return fused_seg_postprocess(model.apply(p, x))
+            return fused_seg_postprocess(model.apply(p, x),
+                                         with_classmap=return_classmap)
 
         def postprocess(out):
             counts = np.asarray(out["counts"])
-            return {"class_histogram":
-                    {int(c): int(n) for c, n in enumerate(counts) if n}}
+            result = {"class_histogram":
+                      {int(c): int(n) for c, n in enumerate(counts) if n}}
+            if return_classmap:
+                result["classmap_png"] = encode_classmap_png(
+                    np.asarray(out["classmap"]))
+            return result
 
         input_dtype = np.uint8
         preprocess = _image_preprocess((tile, tile, 3), np.uint8)
@@ -138,8 +165,11 @@ def build_unet(name: str = "landcover", tile: int = 256,
         def postprocess(logits):
             classes = np.asarray(segment_logits_to_classes(logits[None])[0])
             values, counts = np.unique(classes, return_counts=True)
-            return {"class_histogram":
-                    {int(v): int(c) for v, c in zip(values, counts)}}
+            result = {"class_histogram":
+                      {int(v): int(c) for v, c in zip(values, counts)}}
+            if return_classmap:  # same response contract as the fused path
+                result["classmap_png"] = encode_classmap_png(classes)
+            return result
 
         input_dtype = np.float32
         preprocess = _image_preprocess((tile, tile, 3))

@@ -184,4 +184,8 @@ def enable_compilation_cache(path: str = "/tmp/ai4e_tpu_xla_cache") -> None:
     import os
     path = os.path.join(path, hashlib.sha1(ident.encode()).hexdigest()[:12])
     jax.config.update("jax_compilation_cache_dir", path)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # Persist everything, including sub-second programs: on a remote-attached
+    # TPU every compile is a server round trip (PALLAS_AXON_REMOTE_COMPILE),
+    # so even trivial reshape/convert programs cost ~0.5-1 s each on a cold
+    # process — a dozen of them is half the warmup. Disk cost is a few KB.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)

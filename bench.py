@@ -204,7 +204,8 @@ def build_platform(args):
         retry_delay=0.05, dispatcher_concurrency=args.dispatcher_concurrency))
     runtime = ModelRuntime()
     batcher = MicroBatcher(runtime, max_wait_ms=args.max_wait_ms,
-                           max_pending=args.concurrency * 4)
+                           max_pending=args.concurrency * 4,
+                           pipeline_depth=args.pipeline_depth)
     worker = InferenceWorker(f"{args.model}-svc", runtime, batcher,
                              task_manager=platform.task_manager,
                              prefix=f"v1/{args.model}", store=platform.store)
@@ -267,11 +268,14 @@ def _build_landcover(args):
 
     def apply_fn(p, batch):
         # Clients ship uint8 tiles (4× less transfer + Python copy cost than
-        # float32); normalization is fused on-device (Pallas kernel), and
-        # argmax is fused on-device too — the device returns 1-byte class
-        # ids + counts, not 4-byte logits: 16× less device→host traffic.
+        # float32); normalization is fused on-device (Pallas kernel), argmax
+        # is fused on-device, and only the B×C int32 histogram leaves the
+        # device — the response payload is the histogram, so fetching the
+        # class map too would spend H·W bytes/example of device→host
+        # bandwidth on data the response never contains (measured 420 ms per
+        # 64-batch on a remote-attached TPU).
         x = normalize_image(batch)
-        return fused_seg_postprocess(model.apply(p, x))
+        return fused_seg_postprocess(model.apply(p, x), with_classmap=False)
 
     def postprocess(out):
         counts = np.asarray(out["counts"])
@@ -510,10 +514,24 @@ def _run_boxed(extra_argv: list[str], timeout_s: float,
     return None, "failed"
 
 
+def _clamp_for_cpu(args) -> None:
+    """Size a CPU run so it finishes promptly: XLA:CPU sustains ~0.5 req/s
+    on the UNet, so the tunnel-tuned defaults (448 in-flight clients, 400 ms
+    accumulation, depth-6 pipelining, 64-buckets) only stretch the drain
+    (r1: 233 s at 128 clients)."""
+    args.concurrency = min(args.concurrency, 16)
+    args.pipeline_depth = min(args.pipeline_depth, 2)  # CPU compute serialises
+    # With 16 clients the largest bucket rarely fills, so a long accumulation
+    # window would just stale-wait every flush.
+    args.max_wait_ms = min(args.max_wait_ms, 5.0)
+    args.buckets = [b for b in args.buckets if b <= 16] or [1, 8]
+
+
 def _forward_argv(args) -> list[str]:
     return ["--duration", str(args.duration),
             "--concurrency", str(args.concurrency),
             "--max-wait-ms", str(args.max_wait_ms),
+            "--pipeline-depth", str(args.pipeline_depth),
             "--dispatcher-concurrency", str(args.dispatcher_concurrency),
             "--model", args.model,
             "--checkpoint-dir", args.checkpoint_dir,
@@ -524,9 +542,23 @@ def _forward_argv(args) -> list[str]:
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--duration", type=float, default=20.0)
-    parser.add_argument("--concurrency", type=int, default=128)
-    parser.add_argument("--max-wait-ms", type=float, default=3.0)
-    parser.add_argument("--dispatcher-concurrency", type=int, default=16)
+    # Enough in-flight clients to keep pipeline_depth × max-bucket examples
+    # in the batcher (6 × 64 = 384) with headroom for tasks mid-transport.
+    parser.add_argument("--concurrency", type=int, default=448)
+    # Accumulation window: long enough that 64-buckets actually fill at the
+    # measured arrival rate (3 ms shipped ~21-example batches and left 2.5×
+    # throughput on the table; 400 ms fills to ~50 AND cuts p50 latency —
+    # full buckets amortize the per-batch tunnel round trip).
+    parser.add_argument("--max-wait-ms", type=float, default=400.0)
+    # In-flight device batches. The axon-tunnel TPU needs ~6 concurrent
+    # streams to fill its long-fat host↔device link (measured 42→108
+    # tiles/s from 1→6); a locally-attached chip only needs 2.
+    parser.add_argument("--pipeline-depth", type=int, default=6)
+    # Must exceed concurrency: the worker's async endpoint holds the
+    # dispatcher's POST until inference completes, so dispatcher concurrency
+    # caps how many examples can sit in the micro-batcher — at 16 the
+    # 64-bucket could never fill (r1 measured avg_batch_size 19.5).
+    parser.add_argument("--dispatcher-concurrency", type=int, default=512)
     parser.add_argument("--buckets", type=int, nargs="+", default=None,
                         help="batch buckets (default per model)")
     parser.add_argument("--model", choices=sorted(CONFIGS),
@@ -571,9 +603,13 @@ def main() -> None:
     # Subprocess boxing matters because a degraded tunnel hangs inside C++
     # RPCs that in-process signal handling cannot interrupt.
     if args.cpu:
-        # Explicit CPU debug run: user's exact parameters, inline, unboxed.
+        # Explicit CPU debug run: inline, unboxed, but sized for XLA:CPU —
+        # the defaults are tuned for the TPU tunnel (448 clients, 400 ms
+        # window) and would stretch a 20 s CPU bench into a multi-minute
+        # drain. Pass explicit flags to override the clamps.
         import jax
         jax.config.update("jax_platforms", "cpu")
+        _clamp_for_cpu(args)
         print(json.dumps(asyncio.run(run_bench(args))), flush=True)
         return
 
@@ -605,8 +641,7 @@ def main() -> None:
         # sustains ~0.5 req/s on this UNet, so big buckets and 128 in-flight
         # clients only stretch the tail (r1: 233s drain).
         meta["fallback"] = "cpu"
-        args.concurrency = min(args.concurrency, 16)
-        args.buckets = [b for b in args.buckets if b <= 16] or [1, 8]
+        _clamp_for_cpu(args)
         result, _ = _run_boxed(["--inner", "--cpu", *_forward_argv(args)],
                                args.stage_timeout, "bench-cpu")
         if result is None:  # last resort: inline, let the driver time it

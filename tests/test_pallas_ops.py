@@ -41,6 +41,57 @@ class TestSegArgmax:
         assert out["counts"].shape == (2, 4)
         assert np.asarray(out["counts"]).sum() == 2 * 64 * 128
 
+    def test_postprocess_counts_only(self):
+        """with_classmap=False keeps the map on-device: counts must still
+        match the full variant's, and the map key must be absent (nothing
+        for run_batch's device_get to fetch)."""
+        rng = np.random.default_rng(4)
+        logits = jnp.asarray(rng.standard_normal((2, 64, 128, 4)), jnp.float32)
+        full = fused_seg_postprocess(logits)
+        slim = fused_seg_postprocess(logits, with_classmap=False)
+        assert set(slim) == {"counts"}
+        np.testing.assert_array_equal(np.asarray(slim["counts"]),
+                                      np.asarray(full["counts"]))
+
+    def test_unet_family_classmap_png_roundtrip(self):
+        """return_classmap=True responses carry the classified tile as a
+        lossless PNG whose pixels reproduce the histogram (the reference's
+        land-cover APIs return classified tiles, not just statistics)."""
+        import base64
+        import io
+
+        from PIL import Image
+
+        from ai4e_tpu.runtime import build_servable
+
+        servable = build_servable("unet", name="lc-png", tile=32,
+                                  widths=[8, 16], buckets=(2,),
+                                  return_classmap=True)
+        batch = np.random.default_rng(5).integers(
+            0, 256, (2, 32, 32, 3), np.uint8)
+        out = servable.apply_fn(servable.params, jnp.asarray(batch))
+        result = servable.postprocess(
+            {k: np.asarray(v)[0] for k, v in out.items()})
+        png = base64.b64decode(result["classmap_png"])
+        decoded = np.asarray(Image.open(io.BytesIO(png)))
+        assert decoded.shape == (32, 32)
+        values, counts = np.unique(decoded, return_counts=True)
+        assert {int(v): int(c) for v, c in zip(values, counts)} == \
+            result["class_histogram"]
+
+    def test_unet_family_default_keeps_map_on_device(self):
+        from ai4e_tpu.runtime import build_servable
+
+        servable = build_servable("unet", name="lc-slim", tile=32,
+                                  widths=[8, 16], buckets=(2,))
+        batch = np.zeros((2, 32, 32, 3), np.uint8)
+        out = servable.apply_fn(servable.params, jnp.asarray(batch))
+        assert set(out) == {"counts"}
+        result = servable.postprocess(
+            {k: np.asarray(v)[0] for k, v in out.items()})
+        assert "classmap_png" not in result
+        assert sum(result["class_histogram"].values()) == 32 * 32
+
 
 class TestClassHistogram:
     def test_counts(self):

@@ -105,6 +105,49 @@ class TestMicroBatcher:
 
         run(main())
 
+    def test_pipeline_depth_overlaps_batches(self):
+        """pipeline_depth N admits N batches in flight concurrently (the
+        remote-attached-TPU tuning knob: fill the long-fat link); results
+        still fan back correctly and depth < 1 is rejected."""
+        async def main():
+            import threading
+
+            runtime = ModelRuntime()
+            s = _double_servable()
+            in_flight = {"now": 0, "max": 0}
+            lock = threading.Lock()
+            inner = s.apply_fn
+
+            def tracked(p, b):
+                with lock:
+                    in_flight["now"] += 1
+                    in_flight["max"] = max(in_flight["max"], in_flight["now"])
+                import time as _t
+                _t.sleep(0.05)  # hold the slot so batches overlap
+                with lock:
+                    in_flight["now"] -= 1
+                return inner(p, b)
+
+            s.apply_fn = tracked
+            runtime.register(s)
+            runtime.models["double"]._compiled = tracked  # bypass jit timing
+            batcher = MicroBatcher(runtime, max_wait_ms=0, pipeline_depth=3)
+            await batcher.start()
+            try:
+                results = await asyncio.gather(*[
+                    batcher.submit("double", np.full((4,), i, np.float32))
+                    for i in range(12)])
+                for i, r in enumerate(results):
+                    assert r == {"sum": 2.0 * i * 4}
+                assert in_flight["max"] >= 2, in_flight
+                assert in_flight["max"] <= 3, in_flight
+            finally:
+                await batcher.stop()
+
+        run(main())
+        with pytest.raises(ValueError):
+            MicroBatcher(ModelRuntime(), pipeline_depth=0)
+
     def test_bad_shape_rejected_immediately(self):
         async def main():
             runtime = ModelRuntime()
