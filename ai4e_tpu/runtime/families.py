@@ -25,11 +25,23 @@ from .registry import ServableModel
 
 
 def _npy_preprocess(shape: tuple, dtype=np.float32):
+    dtype = np.dtype(dtype)
+
     def preprocess(body: bytes, content_type: str):
         arr = np.load(io.BytesIO(body))
         if arr.shape != shape:
             raise ValueError(f"expected {shape}, got {arr.shape}")
-        return arr.astype(dtype)
+        out = arr.astype(dtype, copy=False)
+        # A narrowing cast (f32 payload → f16 wire) maps |x| > dtype-max to
+        # inf, which would surface as NaN scores instead of an error — fail
+        # this one task loudly at the door.
+        if (np.issubdtype(dtype, np.floating)
+                and np.dtype(dtype).itemsize < arr.dtype.itemsize
+                and not np.isfinite(out).all()):
+            raise ValueError(
+                f"payload exceeds {dtype} range (max |x| "
+                f"{float(np.max(np.abs(arr)))})")
+        return out
     return preprocess
 
 
@@ -295,11 +307,24 @@ def build_seqformer(name: str = "longcontext", seq_len: int = 4096,
                     input_dim: int = 64, dim: int = 128, depth: int = 2,
                     heads: int = 8, num_classes: int = 16,
                     attention: str = "auto", causal: bool = False,
-                    buckets=(1, 8), mesh=None, **_) -> ServableModel:
+                    buckets=(1, 8), mesh=None,
+                    wire_dtype: str = "float16", **_) -> ServableModel:
     """Long-context sequence classification (SURVEY.md §5 long-context slot):
     attention over the (S, input_dim) payload runs ring/Ulysses
-    sequence-parallel over the mesh's sp axis when it has one."""
+    sequence-parallel over the mesh's sp axis when it has one.
+
+    ``wire_dtype`` (float16 default, float32 accepted): the batch is carried
+    to the device in this dtype. Sequences are the fattest payload of any
+    family (S·D floats/example — 1 MB at S=4096 f32), the model computes in
+    bfloat16 regardless, and f16's 10 mantissa bits exceed bf16's 7, so
+    half-precision wire halves client payload + host→device bytes without
+    touching the math. Clients may ship f32 or f16 npy; both are cast (a
+    payload outside f16 range fails that task at preprocess)."""
     from ..models.seqformer import create_seqformer
+
+    wdt = np.dtype(wire_dtype)
+    if wdt not in (np.dtype(np.float16), np.dtype(np.float32)):
+        raise ValueError(f"wire_dtype must be float16/float32, got {wire_dtype}")
 
     model, params = create_seqformer(
         seq_len=seq_len, input_dim=input_dim, dim=dim, depth=depth,
@@ -315,8 +340,8 @@ def build_seqformer(name: str = "longcontext", seq_len: int = 4096,
 
     return ServableModel(
         name=name, apply_fn=model.apply, params=params,
-        input_shape=(seq_len, input_dim),
-        preprocess=_npy_preprocess((seq_len, input_dim)),
+        input_shape=(seq_len, input_dim), input_dtype=wdt,
+        preprocess=_npy_preprocess((seq_len, input_dim), wdt),
         postprocess=postprocess, batch_buckets=tuple(buckets))
 
 

@@ -73,13 +73,52 @@ class TestServing:
         np.save(buf, seq)
         example = servable.preprocess(buf.getvalue(), "application/octet-stream")
         bucket = servable.bucket_for(1)
-        batch = np.zeros((bucket, S, F), np.float32)
+        # Build the batch in the servable's wire dtype (f16 by default) so
+        # this exercises the program the production batcher actually runs.
+        batch = np.zeros((bucket, S, F), servable.input_dtype)
         batch[0] = example
         out = runtime.run_batch("longcontext", batch)
         result = servable.postprocess(
             jax.tree_util.tree_map(lambda a: a[0], out))
         assert 0 <= result["class_id"] < 8
         assert 0.0 < result["confidence"] <= 1.0
+
+
+class TestWireDtype:
+    def test_f16_wire_default_casts_and_matches_f32(self):
+        """The family's half-precision wire (its default) must accept f32
+        client payloads, carry f16 examples, and score within bf16 noise of
+        the f32-wire variant — the model computes bf16 either way."""
+        kw = dict(seq_len=64, input_dim=8, dim=16, depth=1, heads=2,
+                  num_classes=4, buckets=(1,), attention="full")
+        f16 = build_servable("seqformer", name="lc16", **kw)
+        f32 = build_servable("seqformer", name="lc32", wire_dtype="float32",
+                             **kw)
+        assert np.dtype(f16.input_dtype) == np.float16
+        seq = np.random.default_rng(3).standard_normal((64, 8)).astype(
+            np.float32)
+        buf = io.BytesIO(); np.save(buf, seq)
+        ex = f16.preprocess(buf.getvalue(), "application/octet-stream")
+        assert ex.dtype == np.float16
+        a = np.asarray(f16.apply_fn(f16.params, ex[None].astype(np.float16)))
+        b = np.asarray(f32.apply_fn(f16.params, seq[None]))
+        np.testing.assert_allclose(a, b, rtol=5e-2, atol=5e-2)
+
+    def test_bad_wire_dtype_rejected(self):
+        with pytest.raises(ValueError):
+            build_servable("seqformer", name="bad", seq_len=64, input_dim=8,
+                           wire_dtype="int8")
+
+    def test_out_of_f16_range_payload_fails_that_task(self):
+        """A narrowing f32→f16 cast must not silently turn 1e38 into inf
+        (NaN scores downstream) — preprocess raises, failing one task."""
+        sv = build_servable("seqformer", name="lcrange", seq_len=64,
+                            input_dim=8, dim=16, depth=1, heads=2,
+                            num_classes=4, buckets=(1,), attention="full")
+        seq = np.zeros((64, 8), np.float32); seq[0, 0] = 1e38
+        buf = io.BytesIO(); np.save(buf, seq)
+        with pytest.raises(ValueError, match="range"):
+            sv.preprocess(buf.getvalue(), "application/octet-stream")
 
 
 class TestMeshFromConfig:
