@@ -24,6 +24,23 @@ import numpy as np
 from .registry import ServableModel
 
 
+def _finite_narrow_cast(arr: np.ndarray, dtype: np.dtype) -> np.ndarray:
+    """Cast a float payload to a narrower float wire dtype, failing loudly:
+    a bare astype maps |x| > dtype-max to inf, which would surface
+    downstream as NaN scores instead of an error for this one task."""
+    out = arr.astype(dtype, copy=False)
+    if (np.issubdtype(dtype, np.floating)
+            and np.issubdtype(arr.dtype, np.floating)
+            and np.dtype(dtype).itemsize < arr.dtype.itemsize
+            and not np.isfinite(out).all()):
+        if np.isnan(arr).any():
+            raise ValueError("payload contains NaN")
+        raise ValueError(
+            f"payload exceeds {np.dtype(dtype)} range (max |x| "
+            f"{float(np.nanmax(np.abs(arr)))})")
+    return out
+
+
 def _npy_preprocess(shape: tuple, dtype=np.float32):
     dtype = np.dtype(dtype)
 
@@ -31,17 +48,7 @@ def _npy_preprocess(shape: tuple, dtype=np.float32):
         arr = np.load(io.BytesIO(body))
         if arr.shape != shape:
             raise ValueError(f"expected {shape}, got {arr.shape}")
-        out = arr.astype(dtype, copy=False)
-        # A narrowing cast (f32 payload → f16 wire) maps |x| > dtype-max to
-        # inf, which would surface as NaN scores instead of an error — fail
-        # this one task loudly at the door.
-        if (np.issubdtype(dtype, np.floating)
-                and np.dtype(dtype).itemsize < arr.dtype.itemsize
-                and not np.isfinite(out).all()):
-            raise ValueError(
-                f"payload exceeds {dtype} range (max |x| "
-                f"{float(np.max(np.abs(arr)))})")
-        return out
+        return _finite_narrow_cast(arr, dtype)
     return preprocess
 
 
@@ -78,13 +85,14 @@ def _image_preprocess(shape: tuple, dtype=np.float32):
 
 
 def cast_image_payload(arr: np.ndarray, dtype) -> np.ndarray:
-    """Cast a decoded image payload to the servable's input dtype. Float
-    [0,1] arrays headed for a uint8-ingesting model are SCALED, not
-    truncated (a bare astype would zero the image) — shared by the
-    single-request and batch-stack decode paths."""
+    """Cast a decoded payload to the servable's input dtype. Float [0,1]
+    arrays headed for a uint8-ingesting model are SCALED, not truncated (a
+    bare astype would zero the image); float→narrower-float goes through the
+    finite-cast guard — shared by the single-request and batch-stack decode
+    paths."""
     if np.dtype(dtype) == np.uint8 and arr.dtype != np.uint8:
         return np.clip(np.round(arr * 255.0), 0, 255).astype(np.uint8)
-    return arr.astype(dtype, copy=False)
+    return _finite_narrow_cast(arr, np.dtype(dtype))
 
 
 def encode_classmap_png(classmap: np.ndarray) -> str:

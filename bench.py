@@ -382,11 +382,31 @@ async def run_bench(args) -> dict:
                 "single-stage task")
         latencies.clear(); completed = 0; failed = 0
 
+        # Ramp: run load untimed until the pipeline is in steady state (the
+        # cold start — empty queues, small batches, compile-cache touches —
+        # otherwise lands inside the measured window and costs ~20% of a
+        # 20 s run). The measurement window opens at the ramp mark:
+        # throughput = completions inside the window / window length.
+        # In-flight work at the open and close of the window cancels to
+        # first order (same clients, same steady state).
         start = time.perf_counter()
-        stop_at = start + args.duration
-        await asyncio.gather(*[client_loop(session, stop_at)
+        stop_at = start + args.ramp + args.duration
+        ramp_mark: dict = {}
+
+        async def _open_window():
+            await asyncio.sleep(args.ramp)
+            ramp_mark["t"] = time.perf_counter()
+            ramp_mark["completed"] = completed
+            ramp_mark["failed"] = failed
+            ramp_mark["n_lat"] = len(latencies)
+
+        await asyncio.gather(_open_window(),
+                             *[client_loop(session, stop_at)
                                for _ in range(args.concurrency)])
-        elapsed = time.perf_counter() - start
+        elapsed = time.perf_counter() - ramp_mark["t"]
+        completed -= ramp_mark["completed"]
+        failed -= ramp_mark["failed"]
+        latencies = latencies[ramp_mark["n_lat"]:]
 
     await platform.stop()
     await batcher.stop()
@@ -400,15 +420,26 @@ async def run_bench(args) -> dict:
     # Batching efficiency — THE design thesis vs the reference's
     # one-request-per-POST dispatch: average examples per device batch,
     # aggregated across every model the batcher fed (pipeline runs feed two).
+    def _hist_totals(name: str) -> tuple[int, float]:
+        count, total = 0, 0.0
+        for _, _, _labels, data in batcher.metrics.histogram(
+                name, "").collect():
+            count += int(data["count"])
+            total += float(data["sum"])
+        return count, total
+
     batch_meta = {}
-    n_batches, n_examples = 0, 0.0
-    for _, _, _labels, data in batcher.metrics.histogram(
-            "ai4e_batch_size", "").collect():
-        n_batches += int(data["count"])
-        n_examples += float(data["sum"])
+    n_batches, n_examples = _hist_totals("ai4e_batch_size")
     if n_batches:
         batch_meta = {"device_batches": n_batches,
                       "avg_batch_size": round(n_examples / n_batches, 2)}
+        # Per-batch wall time as seen by run_batch (h2d + compute + result
+        # fetch), aggregated across every served model. Together with
+        # avg_batch_size this separates "what the device+link can do" from
+        # end-to-end task throughput.
+        ex_n, ex_sum = _hist_totals("ai4e_batch_exec_seconds")
+        if ex_n:
+            batch_meta["batch_exec_avg_ms"] = round(1000 * ex_sum / ex_n, 1)
 
     # On real hardware the bench doubles as the Pallas kernel-validation
     # artifact: Mosaic-compiled (interpret=False) kernels vs XLA oracles +
@@ -528,11 +559,13 @@ def _clamp_for_cpu(args) -> None:
     # With 16 clients the largest bucket rarely fills, so a long accumulation
     # window would just stale-wait every flush.
     args.max_wait_ms = min(args.max_wait_ms, 5.0)
+    args.ramp = min(args.ramp, 2.0)  # ~0.5 req/s: a long ramp measures nothing
     args.buckets = [b for b in args.buckets if b <= 16] or [1, 8]
 
 
 def _forward_argv(args) -> list[str]:
     return ["--duration", str(args.duration),
+            "--ramp", str(args.ramp),
             "--concurrency", str(args.concurrency),
             "--max-wait-ms", str(args.max_wait_ms),
             "--pipeline-depth", str(args.pipeline_depth),
@@ -546,6 +579,9 @@ def _forward_argv(args) -> list[str]:
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--duration", type=float, default=20.0)
+    parser.add_argument("--ramp", type=float, default=6.0,
+                        help="untimed steady-state ramp before the measured "
+                             "window opens")
     # Enough in-flight clients to keep pipeline_depth × max-bucket examples
     # in the batcher (6 × 64 = 384) with headroom for tasks mid-transport.
     # Default is per model (None → see below): the composite config gets

@@ -119,6 +119,16 @@ class TestWireDtype:
         buf = io.BytesIO(); np.save(buf, seq)
         with pytest.raises(ValueError, match="range"):
             sv.preprocess(buf.getvalue(), "application/octet-stream")
+        # NaN is reported as NaN, not as a bogus magnitude overflow.
+        seq[0, 0] = np.nan
+        buf = io.BytesIO(); np.save(buf, seq)
+        with pytest.raises(ValueError, match="NaN"):
+            sv.preprocess(buf.getvalue(), "application/octet-stream")
+        # The batch-stack decode path shares the guard (worker.serve_batch
+        # decodes via cast_image_payload).
+        from ai4e_tpu.runtime.families import cast_image_payload
+        with pytest.raises(ValueError, match="NaN"):
+            cast_image_payload(seq[None], np.float16)
 
 
 class TestMeshFromConfig:
