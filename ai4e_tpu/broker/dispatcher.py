@@ -88,7 +88,9 @@ class Dispatcher:
             "ai4e_dispatch_total", "Dispatch attempts by outcome")
         self._stop = asyncio.Event()
         self._workers: list[asyncio.Task] = []
-        self._sessions = SessionHolder(timeout=request_timeout)
+        # In-flight POSTs are bounded by the worker-loop count (see
+        # set_concurrency), so the pool must not add a lower cap.
+        self._sessions = SessionHolder(timeout=request_timeout, limit=0)
 
     async def start(self) -> None:
         # Top up, never replace: set_concurrency may have spawned loops

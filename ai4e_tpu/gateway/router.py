@@ -53,7 +53,8 @@ class Gateway:
         self.routes: list[Route] = []
         self._requests = self.metrics.counter(
             "ai4e_gateway_requests_total", "Gateway requests by route/outcome")
-        self._sessions = SessionHolder()
+        # Proxy fan-out is bounded by inbound connections, not the pool.
+        self._sessions = SessionHolder(limit=0)
         # task_id -> {(loop, Event)} long-poll waiters (see _task).
         self._waiters: dict[str, set] = {}
         # Subscription-key auth (the reference's APIM front door requires

@@ -28,7 +28,11 @@ def _finite_narrow_cast(arr: np.ndarray, dtype: np.dtype) -> np.ndarray:
     """Cast a float payload to a narrower float wire dtype, failing loudly:
     a bare astype maps |x| > dtype-max to inf, which would surface
     downstream as NaN scores instead of an error for this one task."""
-    out = arr.astype(dtype, copy=False)
+    with np.errstate(over="ignore", invalid="ignore"):
+        # The guard below is the error surface — the cast's own overflow
+        # RuntimeWarning would pre-empt it under -W error and spam logs
+        # otherwise.
+        out = arr.astype(dtype, copy=False)
     if (np.issubdtype(dtype, np.floating)
             and np.issubdtype(arr.dtype, np.floating)
             and np.dtype(dtype).itemsize < arr.dtype.itemsize

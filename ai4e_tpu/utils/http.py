@@ -13,10 +13,18 @@ class SessionHolder:
 
     def __init__(self, session: aiohttp.ClientSession | None = None,
                  timeout: float | None = None,
-                 headers: dict[str, str] | None = None):
+                 headers: dict[str, str] | None = None,
+                 limit: int | None = None):
+        """``limit``: max concurrent connections for the lazily-created
+        session (0 = unbounded). None keeps aiohttp's default of 100 —
+        components whose in-flight request count is bounded elsewhere (the
+        dispatcher's worker loops, the gateway's inbound connections) pass 0
+        so a 100-connection pool doesn't silently cap a concurrency knob
+        set higher."""
         self._session = session
         self._timeout = timeout
         self._headers = headers
+        self._limit = limit
         self._create_lock: asyncio.Lock | None = None
 
     async def get(self) -> aiohttp.ClientSession:
@@ -31,6 +39,8 @@ class SessionHolder:
                     kw["timeout"] = aiohttp.ClientTimeout(total=self._timeout)
                 if self._headers:
                     kw["headers"] = dict(self._headers)
+                if self._limit is not None:
+                    kw["connector"] = aiohttp.TCPConnector(limit=self._limit)
                 self._session = aiohttp.ClientSession(**kw)
         return self._session
 

@@ -43,6 +43,11 @@ TILE = 256
 # NC6s_v3 one-request-per-POST anchors (see module docstring) and the
 # request payload dtype per measurement config.
 CONFIGS = {
+    # base-py echo (BASELINE config #1, the CPU transport smoke): no model
+    # weight — measures the platform path itself. Anchor: the reference's
+    # Flask dev-server echo served one-request-per-POST on a DS2_v2,
+    # ~200 req/s.
+    "echo": {"anchor": 200.0, "metric": "async_echo_throughput"},
     "landcover": {"anchor": 40.0, "metric": "async_landcover_seg_throughput"},
     "megadetector": {"anchor": 10.0,
                      "metric": "async_megadetector_throughput"},
@@ -100,15 +105,23 @@ def _manifest_kwargs(ckpt_dir: str, name: str) -> dict:
         if name in manifest:
             return dict(manifest[name].get("kwargs", {}))
     from ai4e_tpu.train.make_checkpoints import SPECIES_LABELS
-    return ({"widths": [64, 128, 256]} if name == "megadetector" else
-            {"stage_sizes": [2, 2, 2], "width": 32, "num_classes": 8,
-             "labels": SPECIES_LABELS})
+    return {"megadetector": {"widths": [64, 128, 256]},
+            "landcover": {"widths": [64, 128, 256, 512], "num_classes": 4},
+            "species": {"stage_sizes": [2, 2, 2], "width": 32,
+                        "num_classes": 8, "labels": SPECIES_LABELS}}[name]
 
 
 def _build_servable(args):
     """The measured servable + its request payload builder."""
     import os
 
+    if args.model == "echo":
+        from ai4e_tpu.runtime import build_servable
+        servable = build_servable("echo", name="echo", size=16,
+                                  buckets=tuple(args.buckets))
+        buf = io.BytesIO()
+        np.save(buf, np.arange(16, dtype=np.float32))
+        return servable, buf.getvalue(), {}
     if args.model == "landcover":
         servable = _build_landcover(args)
         # Headline config serves trained weights too when available (the
@@ -256,47 +269,16 @@ def build_platform(args):
 
 
 def _build_landcover(args):
-    from ai4e_tpu.models import create_unet
-    from ai4e_tpu.ops.pallas import fused_seg_postprocess, normalize_image
-    from ai4e_tpu.runtime import ServableModel
+    # The production family, not a bench-local fork: uint8 tile ingestion
+    # with fused on-device normalize + argmax + histogram, counts-only
+    # device outputs (return_classmap defaults False — the response is the
+    # histogram, and fetching the H·W map cost 420 ms per 64-batch of
+    # device→host bandwidth on a remote-attached TPU).
+    from ai4e_tpu.runtime import build_servable
 
-    model, params = create_unet(tile=TILE)
-
-    def preprocess(body, content_type):
-        arr = np.load(io.BytesIO(body))
-        if arr.shape != (TILE, TILE, 3):
-            raise ValueError(f"bad tile shape {arr.shape}")
-        if arr.dtype != np.uint8:
-            raise ValueError(f"expected uint8 tile, got {arr.dtype}")
-        return arr
-
-    def apply_fn(p, batch):
-        # Clients ship uint8 tiles (4× less transfer + Python copy cost than
-        # float32); normalization is fused on-device (Pallas kernel), argmax
-        # is fused on-device, and only the B×C int32 histogram leaves the
-        # device — the response payload is the histogram, so fetching the
-        # class map too would spend H·W bytes/example of device→host
-        # bandwidth on data the response never contains (measured 420 ms per
-        # 64-batch on a remote-attached TPU).
-        x = normalize_image(batch)
-        return fused_seg_postprocess(model.apply(p, x), with_classmap=False)
-
-    def postprocess(out):
-        counts = np.asarray(out["counts"])
-        # Per-class pixel histogram (the payload clients act on); the class
-        # map itself would be PNG-encoded in production.
-        return {int(c): int(n) for c, n in enumerate(counts) if n}
-
-    return ServableModel(
-        name="landcover",
-        apply_fn=apply_fn,
-        params=params,
-        input_shape=(TILE, TILE, 3),
-        input_dtype=np.uint8,
-        preprocess=preprocess,
-        postprocess=postprocess,
-        batch_buckets=tuple(args.buckets),
-    )
+    return build_servable("unet", name="landcover", tile=TILE,
+                          buckets=tuple(args.buckets),
+                          **_manifest_kwargs(args.checkpoint_dir, "landcover"))
 
 
 async def run_bench(args) -> dict:
@@ -313,6 +295,12 @@ async def run_bench(args) -> dict:
 
     platform.publish_async_api(
         api_path, f"http://127.0.0.1:{be_port}{api_path}")
+    if args.model != "pipeline":
+        # Sync mode (BASELINE configs #1/#2): gateway reverse-proxies the
+        # worker's sync endpoint; same batcher underneath.
+        sync_public = f"/v1/{args.model}/classify"
+        platform.publish_sync_api(
+            sync_public, f"http://127.0.0.1:{be_port}{sync_public}")
     for path in extra_paths:  # internal pipeline stages: dispatcher only
         platform.dispatchers.register(path, f"http://127.0.0.1:{be_port}{path}")
 
@@ -355,13 +343,40 @@ async def run_bench(args) -> dict:
                 failed += 1
                 return
 
+    sync_public = f"/v1/{args.model}/classify"
+
+    async def one_task_sync(session: ClientSession) -> None:
+        nonlocal completed, failed
+        t0 = time.perf_counter()
+        while True:
+            async with session.post(f"{gw}{sync_public}", data=payload,
+                                    headers={"Content-Type": content_type}
+                                    ) as resp:
+                if resp.status == 503:  # admission backpressure: retry
+                    await asyncio.sleep(0.05)
+                    continue
+                await resp.read()
+                if resp.status == 200:
+                    latencies.append(time.perf_counter() - t0)
+                    completed += 1
+                else:
+                    failed += 1
+                return
+
+    run_one = one_task_sync if args.mode == "sync" else one_task
+
     async def client_loop(session, stop_at):
         while time.perf_counter() < stop_at:
-            await one_task(session)
+            await run_one(session)
 
-    async with ClientSession() as session:
+    # The client pool must admit every in-flight request (aiohttp's default
+    # connector caps at 100 connections — below --concurrency — and sync
+    # mode holds a connection for the whole inference).
+    import aiohttp
+    async with ClientSession(
+            connector=aiohttp.TCPConnector(limit=0)) as session:
         # warm the full path once
-        await one_task(session)
+        await run_one(session)
         if args.model == "pipeline":
             # The composite must have traversed BOTH stages — a gate that
             # never fires would silently measure a one-stage task. Stage-1's
@@ -453,10 +468,14 @@ async def run_bench(args) -> dict:
         except Exception as exc:  # noqa: BLE001 — report, don't kill the bench
             pallas_meta["pallas_tpu"] = {"all_ok": False, "error": str(exc)}
 
+    metric = cfg["metric"]
+    if args.mode == "sync":
+        metric = metric.replace("async_", "sync_", 1)
     return {
-        "metric": cfg["metric"],
+        "metric": metric,
         "value": round(throughput, 2),
         "unit": "req/s",
+        "mode": args.mode,
         "vs_baseline": round(throughput / cfg["anchor"], 2),
         "baseline_anchor": cfg["anchor"],
         "p50_latency_ms": round(float(lat[len(lat) // 2]) * 1000, 1),
@@ -554,13 +573,16 @@ def _clamp_for_cpu(args) -> None:
     on the UNet, so the tunnel-tuned defaults (448 in-flight clients, 400 ms
     accumulation, depth-6 pipelining, 64-buckets) only stretch the drain
     (r1: 233 s at 128 clients)."""
-    args.concurrency = min(args.concurrency, 16)
+    # echo has no device work — CPU IS its intended backend (config #1);
+    # only the slow-model sizings apply.
+    args.concurrency = min(args.concurrency, 64 if args.model == "echo" else 16)
     args.pipeline_depth = min(args.pipeline_depth, 2)  # CPU compute serialises
-    # With 16 clients the largest bucket rarely fills, so a long accumulation
+    # With few clients the largest bucket rarely fills, so a long accumulation
     # window would just stale-wait every flush.
     args.max_wait_ms = min(args.max_wait_ms, 5.0)
     args.ramp = min(args.ramp, 2.0)  # ~0.5 req/s: a long ramp measures nothing
-    args.buckets = [b for b in args.buckets if b <= 16] or [1, 8]
+    if args.model != "echo":
+        args.buckets = [b for b in args.buckets if b <= 16] or [1, 8]
 
 
 def _forward_argv(args) -> list[str]:
@@ -571,6 +593,7 @@ def _forward_argv(args) -> list[str]:
             "--pipeline-depth", str(args.pipeline_depth),
             "--dispatcher-concurrency", str(args.dispatcher_concurrency),
             "--model", args.model,
+            "--mode", args.mode,
             "--checkpoint-dir", args.checkpoint_dir,
             "--seq-len", str(args.seq_len),
             "--buckets", *[str(b) for b in args.buckets]]
@@ -607,7 +630,11 @@ def main() -> None:
                         help="batch buckets (default per model)")
     parser.add_argument("--model", choices=sorted(CONFIGS),
                         default="landcover",
-                        help="measurement config (BASELINE.json #2/#3/#4)")
+                        help="measurement config (BASELINE.json #1-#5)")
+    parser.add_argument("--mode", choices=("async", "sync"), default="async",
+                        help="async = task path (gateway→store→broker→worker);"
+                             " sync = gateway reverse proxy to the worker's"
+                             " sync endpoint (BASELINE configs #1/#2)")
     parser.add_argument("--checkpoint-dir", default="checkpoints",
                         help="trained weights (ai4e_tpu.train.make_checkpoints)")
     parser.add_argument("--seq-len", type=int, default=4096,
@@ -624,6 +651,8 @@ def main() -> None:
     parser.add_argument("--prewarm", action="store_true",
                         help="(internal) compile bucket programs and exit")
     args = parser.parse_args()
+    if args.mode == "sync" and args.model == "pipeline":
+        parser.error("the composite pipeline is async-only (task handoffs)")
     if args.concurrency is None:
         args.concurrency = {"pipeline": 160}.get(args.model, 448)
     if args.buckets is None:
@@ -631,7 +660,7 @@ def main() -> None:
         # spend HBM on padding the queue rarely fills.
         args.buckets = {"landcover": [1, 16, 64], "megadetector": [1, 8],
                         "species": [1, 16, 64], "pipeline": [1, 8],
-                        "longcontext": [1, 4]}[args.model]
+                        "longcontext": [1, 4], "echo": [1, 64]}[args.model]
 
     if args.inner or args.prewarm:
         import jax
