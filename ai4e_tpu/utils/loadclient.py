@@ -1,0 +1,146 @@
+"""Shared closed-loop load-measurement client.
+
+Used by ``bench.py`` (in-proc platform) and ``examples/loadgen.py`` (any
+live deployment): N clients each keep exactly one request in flight against
+an async task route (POST → long-poll ``/task/{id}``) or a sync route
+(POST → response), with an untimed steady-state ramp before the measured
+window opens.
+
+Error tolerance is the point of sharing this: a non-503 error response, an
+undecodable body, a vanished task (404 after the reaper), or a transport
+error counts as one failed request and the run continues — a load tool
+pointed at a production topology must survive exactly the conditions it
+creates.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+async def run_closed_loop(
+    session,
+    *,
+    post_url: str,
+    payload: bytes,
+    headers: dict,
+    mode: str = "async",
+    status_url_for=None,
+    concurrency: int = 64,
+    duration: float = 20.0,
+    ramp: float = 5.0,
+    task_timeout: float = 120.0,
+    poll_wait: float = 30.0,
+) -> dict:
+    """Drive ``post_url`` closed-loop; returns window stats.
+
+    ``status_url_for(task_id) -> url`` is required in async mode.
+    Returns ``{"value", "p50_latency_ms", "p95_latency_ms", "completed",
+    "failed", "duration_s"}`` where value is completions/second inside the
+    measurement window that opens after ``ramp`` seconds.
+    """
+    import aiohttp
+
+    if mode == "async" and status_url_for is None:
+        raise ValueError("async mode needs status_url_for")
+
+    latencies: list[float] = []
+    completed = 0
+    failed = 0
+
+    async def one_async() -> None:
+        nonlocal completed, failed
+        t0 = time.perf_counter()
+        try:
+            async with session.post(post_url, data=payload,
+                                    headers=headers) as resp:
+                if resp.status == 503:  # admission backpressure: not a failure
+                    await asyncio.sleep(0.05)
+                    return
+                task = await resp.json()
+            task_id = task["TaskId"]
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError,
+                KeyError, TypeError):
+            failed += 1
+            return
+        deadline = t0 + task_timeout
+        while True:
+            try:
+                async with session.get(status_url_for(task_id),
+                                       params={"wait": str(int(poll_wait))},
+                                       headers=headers) as resp:
+                    if resp.status == 404:  # reaped/expired task
+                        failed += 1
+                        return
+                    record = await resp.json()
+                status = record["Status"]
+            except (aiohttp.ClientError, asyncio.TimeoutError, ValueError,
+                    KeyError, TypeError):
+                failed += 1
+                return
+            if "completed" in status:
+                latencies.append(time.perf_counter() - t0)
+                completed += 1
+                return
+            if "failed" in status:
+                failed += 1
+                return
+            if time.perf_counter() > deadline:  # stuck task: don't hang the run
+                failed += 1
+                return
+
+    async def one_sync() -> None:
+        nonlocal completed, failed
+        t0 = time.perf_counter()
+        while True:
+            try:
+                async with session.post(post_url, data=payload,
+                                        headers=headers) as resp:
+                    if resp.status == 503:
+                        await asyncio.sleep(0.05)
+                        continue
+                    await resp.read()
+                    ok = resp.status == 200
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                ok = False
+            if ok:
+                latencies.append(time.perf_counter() - t0)
+                completed += 1
+            else:
+                failed += 1
+            return
+
+    one = one_sync if mode == "sync" else one_async
+
+    async def client_loop(stop_at: float) -> None:
+        while time.perf_counter() < stop_at:
+            await one()
+
+    # Ramp: run load untimed until the pipeline is in steady state (cold
+    # start — empty queues, small batches, cache touches — would otherwise
+    # land inside the measured window). In-flight work at the open and
+    # close of the window cancels to first order.
+    mark: dict = {}
+
+    async def open_window() -> None:
+        await asyncio.sleep(ramp)
+        mark.update(t=time.perf_counter(), completed=completed,
+                    failed=failed, n_lat=len(latencies))
+
+    stop_at = time.perf_counter() + ramp + duration
+    await asyncio.gather(open_window(),
+                         *[client_loop(stop_at) for _ in range(concurrency)])
+    elapsed = time.perf_counter() - mark["t"]
+
+    window_lat = sorted(latencies[mark["n_lat"]:]) or [0.0]
+    n = completed - mark["completed"]
+    return {
+        "value": round(n / elapsed, 2),
+        "p50_latency_ms": round(window_lat[len(window_lat) // 2] * 1000, 1),
+        "p95_latency_ms": round(
+            window_lat[max(0, int(len(window_lat) * 0.95) - 1)] * 1000, 1),
+        "completed": n,
+        "failed": failed - mark["failed"],
+        "duration_s": round(elapsed, 1),
+    }

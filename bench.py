@@ -314,60 +314,12 @@ async def run_bench(args) -> dict:
     await platform.start()
 
     gw = f"http://127.0.0.1:{gw_port}"
-    latencies: list[float] = []
-    completed = 0
-    failed = 0
-
-    async def one_task(session: ClientSession) -> None:
-        nonlocal completed, failed
-        t0 = time.perf_counter()
-        async with session.post(f"{gw}{api_path}", data=payload,
-                                headers={"Content-Type": content_type}
-                                ) as resp:
-            task = await resp.json()
-        task_id = task["TaskId"]
-        while True:
-            # Long-poll: the gateway holds the GET until the task reaches a
-            # terminal state (event-driven), so each task costs ~1 poll
-            # instead of a 5 ms GET storm.
-            async with session.get(
-                    f"{gw}/v1/taskmanagement/task/{task_id}",
-                    params={"wait": "30"}) as resp:
-                record = await resp.json()
-            status = record["Status"]
-            if "completed" in status:
-                latencies.append(time.perf_counter() - t0)
-                completed += 1
-                return
-            if "failed" in status:
-                failed += 1
-                return
-
     sync_public = f"/v1/{args.model}/classify"
+    post_url = (f"{gw}{sync_public}" if args.mode == "sync"
+                else f"{gw}{api_path}")
+    headers = {"Content-Type": content_type}
 
-    async def one_task_sync(session: ClientSession) -> None:
-        nonlocal completed, failed
-        t0 = time.perf_counter()
-        while True:
-            async with session.post(f"{gw}{sync_public}", data=payload,
-                                    headers={"Content-Type": content_type}
-                                    ) as resp:
-                if resp.status == 503:  # admission backpressure: retry
-                    await asyncio.sleep(0.05)
-                    continue
-                await resp.read()
-                if resp.status == 200:
-                    latencies.append(time.perf_counter() - t0)
-                    completed += 1
-                else:
-                    failed += 1
-                return
-
-    run_one = one_task_sync if args.mode == "sync" else one_task
-
-    async def client_loop(session, stop_at):
-        while time.perf_counter() < stop_at:
-            await run_one(session)
+    from ai4e_tpu.utils.loadclient import run_closed_loop
 
     # The client pool must admit every in-flight request (aiohttp's default
     # connector caps at 100 connections — below --concurrency — and sync
@@ -375,15 +327,21 @@ async def run_bench(args) -> dict:
     import aiohttp
     async with ClientSession(
             connector=aiohttp.TCPConnector(limit=0)) as session:
-        # warm the full path once
-        await run_one(session)
+        # warm the full path once (long-poll on the async route)
+        async with session.post(post_url, data=payload,
+                                headers=headers) as resp:
+            warm = await resp.json() if args.mode == "async" else None
+        if args.mode == "async":
+            async with session.get(
+                    f"{gw}/v1/taskmanagement/task/{warm['TaskId']}",
+                    params={"wait": "30"}) as resp:
+                await resp.json()
         if args.model == "pipeline":
             # The composite must have traversed BOTH stages — a gate that
             # never fires would silently measure a one-stage task. Stage-1's
             # intermediate result is stored under the detector's name.
             async with session.post(f"{gw}{api_path}", data=payload,
-                                    headers={"Content-Type": content_type}
-                                    ) as resp:
+                                    headers=headers) as resp:
                 probe_tid = (await resp.json())["TaskId"]
             async with session.get(
                     f"{gw}/v1/taskmanagement/task/{probe_tid}",
@@ -395,41 +353,23 @@ async def run_bench(args) -> dict:
             assert staged is not None, (
                 "pipeline handoff never fired — bench would measure a "
                 "single-stage task")
-        latencies.clear(); completed = 0; failed = 0
 
-        # Ramp: run load untimed until the pipeline is in steady state (the
-        # cold start — empty queues, small batches, compile-cache touches —
-        # otherwise lands inside the measured window and costs ~20% of a
-        # 20 s run). The measurement window opens at the ramp mark:
-        # throughput = completions inside the window / window length.
-        # In-flight work at the open and close of the window cancels to
-        # first order (same clients, same steady state).
-        start = time.perf_counter()
-        stop_at = start + args.ramp + args.duration
-        ramp_mark: dict = {}
-
-        async def _open_window():
-            await asyncio.sleep(args.ramp)
-            ramp_mark["t"] = time.perf_counter()
-            ramp_mark["completed"] = completed
-            ramp_mark["failed"] = failed
-            ramp_mark["n_lat"] = len(latencies)
-
-        await asyncio.gather(_open_window(),
-                             *[client_loop(session, stop_at)
-                               for _ in range(args.concurrency)])
-        elapsed = time.perf_counter() - ramp_mark["t"]
-        completed -= ramp_mark["completed"]
-        failed -= ramp_mark["failed"]
-        latencies = latencies[ramp_mark["n_lat"]:]
+        # Closed loop with a steady-state ramp before the measured window
+        # (shared with examples/loadgen.py — ai4e_tpu/utils/loadclient.py).
+        window = await run_closed_loop(
+            session,
+            post_url=post_url, payload=payload, headers=headers,
+            mode=args.mode,
+            status_url_for=lambda tid: f"{gw}/v1/taskmanagement/task/{tid}",
+            concurrency=args.concurrency, duration=args.duration,
+            ramp=args.ramp)
 
     await platform.stop()
     await batcher.stop()
     await gw_runner.cleanup()
     await be_runner.cleanup()
 
-    lat = np.sort(np.asarray(latencies)) if latencies else np.asarray([0.0])
-    throughput = completed / elapsed
+    throughput = window["value"]
     cfg = CONFIGS[args.model]
 
     # Batching efficiency — THE design thesis vs the reference's
@@ -478,11 +418,8 @@ async def run_bench(args) -> dict:
         "mode": args.mode,
         "vs_baseline": round(throughput / cfg["anchor"], 2),
         "baseline_anchor": cfg["anchor"],
-        "p50_latency_ms": round(float(lat[len(lat) // 2]) * 1000, 1),
-        "p95_latency_ms": round(float(lat[int(len(lat) * 0.95) - 1]) * 1000, 1),
-        "completed": completed,
-        "failed": failed,
-        "duration_s": round(elapsed, 1),
+        **{k: window[k] for k in ("p50_latency_ms", "p95_latency_ms",
+                                  "completed", "failed", "duration_s")},
         "concurrency": args.concurrency,
         "device": _device_kind(),
         **build_meta,
