@@ -91,6 +91,9 @@ class MicroBatcher:
             "ai4e_batch_queue_wait_seconds", "Request wait before batching")
         self._pending_gauge = self.metrics.gauge(
             "ai4e_batcher_pending", "Requests waiting for a batch slot")
+        self._inflight_gauge = self.metrics.gauge(
+            "ai4e_batcher_inflight_batches",
+            "Device batches currently in the pipeline window")
 
     # -- request side ------------------------------------------------------
 
@@ -168,9 +171,11 @@ class MicroBatcher:
                 task = loop.create_task(
                     self._execute(loop, model_name, batch))
                 self._inflight_execs.add(task)
+                self._inflight_gauge.set(len(self._inflight_execs))
 
                 def _done(t: asyncio.Task) -> None:
                     self._inflight_execs.discard(t)
+                    self._inflight_gauge.set(len(self._inflight_execs))
                     self._window.release()
 
                 task.add_done_callback(_done)

@@ -90,10 +90,15 @@ async def run_closed_loop(
                 failed += 1
                 return
 
+    # Set before the clients launch; one_sync's backpressure-retry loop must
+    # observe the same deadline as the client loops or sustained 503s would
+    # spin past the end of the run and hang the gather.
+    deadlines = {"stop_at": float("inf")}
+
     async def one_sync() -> None:
         nonlocal completed, failed
         t0 = time.perf_counter()
-        while True:
+        while time.perf_counter() < deadlines["stop_at"]:
             try:
                 async with session.post(post_url, data=payload,
                                         headers=headers) as resp:
@@ -110,6 +115,7 @@ async def run_closed_loop(
             else:
                 failed += 1
             return
+        # Run ended while backpressured: neither completed nor failed.
 
     one = one_sync if mode == "sync" else one_async
 
@@ -129,6 +135,7 @@ async def run_closed_loop(
                     failed=failed, n_lat=len(latencies))
 
     stop_at = time.perf_counter() + ramp + duration
+    deadlines["stop_at"] = stop_at
     await asyncio.gather(open_window(),
                          *[client_loop(stop_at) for _ in range(concurrency)])
     elapsed = time.perf_counter() - mark["t"]

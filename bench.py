@@ -327,15 +327,23 @@ async def run_bench(args) -> dict:
     import aiohttp
     async with ClientSession(
             connector=aiohttp.TCPConnector(limit=0)) as session:
-        # warm the full path once (long-poll on the async route)
+        # warm the full path once — to a TERMINAL state on the async route
+        # (first inference can out-wait a single 30 s long-poll on cold
+        # hardware, and a "warm" run that is still compiling would land the
+        # stall inside the measured window).
         async with session.post(post_url, data=payload,
                                 headers=headers) as resp:
             warm = await resp.json() if args.mode == "async" else None
         if args.mode == "async":
-            async with session.get(
-                    f"{gw}/v1/taskmanagement/task/{warm['TaskId']}",
-                    params={"wait": "30"}) as resp:
-                await resp.json()
+            warm_deadline = time.perf_counter() + 300
+            while time.perf_counter() < warm_deadline:
+                async with session.get(
+                        f"{gw}/v1/taskmanagement/task/{warm['TaskId']}",
+                        params={"wait": "30"}) as resp:
+                    record = await resp.json()
+                if ("completed" in record["Status"]
+                        or "failed" in record["Status"]):
+                    break
         if args.model == "pipeline":
             # The composite must have traversed BOTH stages — a gate that
             # never fires would silently measure a one-stage task. Stage-1's

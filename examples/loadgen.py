@@ -41,13 +41,20 @@ async def run(args) -> dict:
 
     async with aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(limit=0)) as session:
-        # Fail fast on a bad URL/key before launching the fleet.
-        async with session.post(f"{args.gateway}{args.path}", data=payload,
-                                headers=headers) as resp:
-            if resp.status >= 400:
-                raise SystemExit(
-                    f"warm request failed: {resp.status} "
-                    f"{(await resp.read())[:200]!r}")
+        # Fail fast on a bad URL/key before launching the fleet — but a 503
+        # is backpressure (the deployment may already be under load), not a
+        # configuration error: retry briefly, then let the closed loop deal.
+        for _ in range(20):
+            async with session.post(f"{args.gateway}{args.path}",
+                                    data=payload, headers=headers) as resp:
+                if resp.status == 503:
+                    await asyncio.sleep(0.25)
+                    continue
+                if resp.status >= 400:
+                    raise SystemExit(
+                        f"warm request failed: {resp.status} "
+                        f"{(await resp.read())[:200]!r}")
+                break
         window = await run_closed_loop(
             session,
             post_url=f"{args.gateway}{args.path}",

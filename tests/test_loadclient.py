@@ -1,0 +1,143 @@
+"""The shared closed-loop measurement client (utils/loadclient.py — used by
+bench.py and examples/loadgen.py) against a live aiohttp app that exhibits
+the production failure modes it must survive: 503 backpressure, error
+responses, non-JSON bodies, vanished (404) tasks, and tasks stuck
+non-terminal. A load tool pointed at a deployment must record these as
+failures and keep running, never crash or hang."""
+
+import asyncio
+import itertools
+import json
+
+import pytest
+from aiohttp import ClientSession, TCPConnector, web
+
+from ai4e_tpu.utils.loadclient import run_closed_loop
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _serve(app):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, runner.addresses[0][1]
+
+
+class TestSyncMode:
+    def test_mixed_outcomes_counted_not_raised(self):
+        """200s count completed; 500s and non-JSON error bodies count
+        failed; 503 is backpressure (retried, never a failure)."""
+        outcomes = itertools.cycle([200, 500, 503, 200])
+
+        async def main():
+            async def handler(request):
+                status = next(outcomes)
+                if status == 503:
+                    return web.Response(status=503, text="busy")
+                if status == 500:
+                    return web.Response(status=500, text="boom not json")
+                return web.json_response({"ok": True})
+
+            app = web.Application()
+            app.router.add_post("/api", handler)
+            runner, port = await _serve(app)
+            try:
+                async with ClientSession(
+                        connector=TCPConnector(limit=0)) as session:
+                    window = await run_closed_loop(
+                        session, post_url=f"http://127.0.0.1:{port}/api",
+                        payload=b"x", headers={}, mode="sync",
+                        concurrency=4, duration=0.8, ramp=0.2)
+            finally:
+                await runner.cleanup()
+            return window
+
+        window = run(main())
+        assert window["completed"] > 0
+        assert window["failed"] > 0
+        assert window["p50_latency_ms"] >= 0
+
+    def test_connection_error_is_a_failure_not_a_crash(self):
+        async def main():
+            async with ClientSession(
+                    connector=TCPConnector(limit=0)) as session:
+                # Nothing listens on this port: every request errors.
+                return await run_closed_loop(
+                    session, post_url="http://127.0.0.1:9/never",
+                    payload=b"x", headers={}, mode="sync",
+                    concurrency=2, duration=0.5, ramp=0.1)
+
+        window = run(main())
+        assert window["completed"] == 0
+        assert window["failed"] > 0
+
+
+class TestAsyncMode:
+    def _app(self, *, task_status):
+        """Task API: POST creates a task, GET reports ``task_status``."""
+        counter = itertools.count()
+
+        async def post(request):
+            return web.json_response({"TaskId": str(next(counter))})
+
+        async def status(request):
+            st = task_status(request.match_info["tid"])
+            if st is None:
+                return web.Response(status=404, text="Task not found.")
+            return web.json_response({"TaskId": request.match_info["tid"],
+                                      "Status": st})
+
+        app = web.Application()
+        app.router.add_post("/api", post)
+        app.router.add_get("/task/{tid}", status)
+        return app
+
+    def _drive(self, app, **kw):
+        async def main():
+            runner, port = await _serve(app)
+            try:
+                async with ClientSession(
+                        connector=TCPConnector(limit=0)) as session:
+                    return await run_closed_loop(
+                        session, post_url=f"http://127.0.0.1:{port}/api",
+                        payload=b"x", headers={}, mode="async",
+                        status_url_for=lambda tid:
+                            f"http://127.0.0.1:{port}/task/{tid}",
+                        concurrency=3, duration=0.8, ramp=0.2, **kw)
+            finally:
+                await runner.cleanup()
+
+        return run(main())
+
+    def test_completed_and_failed_tasks_counted(self):
+        window = self._drive(self._app(
+            task_status=lambda tid: "completed - done" if int(tid) % 2
+            else "failed - bad"))
+        assert window["completed"] > 0
+        assert window["failed"] > 0
+
+    def test_vanished_task_404_is_a_failure_not_a_crash(self):
+        window = self._drive(self._app(task_status=lambda tid: None))
+        assert window["completed"] == 0
+        assert window["failed"] > 0
+
+    def test_stuck_task_hits_deadline_instead_of_hanging(self):
+        window = self._drive(
+            self._app(task_status=lambda tid: "running - forever"),
+            task_timeout=0.3, poll_wait=0.1)
+        assert window["completed"] == 0
+        assert window["failed"] > 0
+
+    def test_requires_status_url(self):
+        async def main():
+            async with ClientSession() as session:
+                with pytest.raises(ValueError):
+                    await run_closed_loop(session, post_url="http://x",
+                                          payload=b"", headers={},
+                                          mode="async")
+
+        run(main())
