@@ -90,32 +90,27 @@ async def run_closed_loop(
                 failed += 1
                 return
 
-    # Set before the clients launch; one_sync's backpressure-retry loop must
-    # observe the same deadline as the client loops or sustained 503s would
-    # spin past the end of the run and hang the gather.
-    deadlines = {"stop_at": float("inf")}
-
     async def one_sync() -> None:
+        # 503 backpressure: sleep briefly and return (neither completed nor
+        # failed) — client_loop re-enters until the run deadline, same as
+        # one_async, so sustained backpressure can never outlive the run.
         nonlocal completed, failed
         t0 = time.perf_counter()
-        while time.perf_counter() < deadlines["stop_at"]:
-            try:
-                async with session.post(post_url, data=payload,
-                                        headers=headers) as resp:
-                    if resp.status == 503:
-                        await asyncio.sleep(0.05)
-                        continue
-                    await resp.read()
-                    ok = resp.status == 200
-            except (aiohttp.ClientError, asyncio.TimeoutError):
-                ok = False
-            if ok:
-                latencies.append(time.perf_counter() - t0)
-                completed += 1
-            else:
-                failed += 1
-            return
-        # Run ended while backpressured: neither completed nor failed.
+        try:
+            async with session.post(post_url, data=payload,
+                                    headers=headers) as resp:
+                if resp.status == 503:
+                    await asyncio.sleep(0.05)
+                    return
+                await resp.read()
+                ok = resp.status == 200
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            ok = False
+        if ok:
+            latencies.append(time.perf_counter() - t0)
+            completed += 1
+        else:
+            failed += 1
 
     one = one_sync if mode == "sync" else one_async
 
@@ -135,7 +130,6 @@ async def run_closed_loop(
                     failed=failed, n_lat=len(latencies))
 
     stop_at = time.perf_counter() + ramp + duration
-    deadlines["stop_at"] = stop_at
     await asyncio.gather(open_window(),
                          *[client_loop(stop_at) for _ in range(concurrency)])
     elapsed = time.perf_counter() - mark["t"]
