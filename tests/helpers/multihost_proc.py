@@ -21,13 +21,20 @@ import numpy as np  # noqa: E402
 
 from ai4e_tpu.parallel import MeshSpec, make_mesh  # noqa: E402
 from ai4e_tpu.parallel.multihost import MultihostRuntime, is_primary  # noqa: E402
-from ai4e_tpu.runtime import ModelRuntime  # noqa: E402
+from ai4e_tpu.runtime import ModelRuntime, build_servable  # noqa: E402
 from ai4e_tpu.runtime.families import build_echo  # noqa: E402
 
-# Global dp mesh over every device of every process.
+# Global dp mesh over every device of every process. Two servables so the
+# bridge is exercised with both wire dtypes: f32 (echo) and the seqformer
+# family's f16 default (the descriptor carries the dtype code; followers
+# must reassemble half-precision shards byte-exactly).
 mesh = make_mesh(MeshSpec(dp=jax.device_count()))
 runtime = ModelRuntime(mesh=mesh)
 runtime.register(build_echo(size=4, buckets=(jax.device_count(),)))
+runtime.register(build_servable(
+    "seqformer", name="lc16", seq_len=16, input_dim=8, dim=16, depth=1,
+    heads=2, num_classes=4, attention="full",
+    buckets=(jax.device_count(),)))
 mh = MultihostRuntime(runtime)
 
 if is_primary():
@@ -46,6 +53,16 @@ if is_primary():
         mh.last_egress_bytes, expected)
     assert mh.last_egress_bytes < batch.nbytes
     assert 0.0 < mh.last_ingest_s < 5.0, mh.last_ingest_s
+    # f16 wire through the bridge: half-precision shards reassemble and
+    # score; egress stays rows-owned-only at 2 bytes/element.
+    seqs = np.random.default_rng(0).standard_normal(
+        (n, 16, 8)).astype(np.float16)
+    logits = np.asarray(mh.run_batch("lc16", seqs))
+    assert logits.shape == (n, 4), logits.shape
+    assert np.isfinite(logits).all()
+    expected = seqs.nbytes * (nprocs - 1) // nprocs
+    assert mh.last_egress_bytes == expected, (
+        mh.last_egress_bytes, expected)
     mh.shutdown_followers()
     print("PRIMARY_OK", flush=True)
 else:
