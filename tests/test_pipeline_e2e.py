@@ -226,3 +226,82 @@ class TestPipelineE2E:
                 await svc_client.close()
 
         asyncio.run(main())
+
+    def test_three_stage_chain_replays_original_body_at_every_hop(self):
+        """Ensembles are arbitrary-depth: A→B→C under one TaskId, each hop
+        handing off with an EMPTY body so the store's original-body replay
+        (the ``{taskId}_ORIG`` mechanism, ``CacheConnectorUpsert.cs:144-176``)
+        must deliver the client's original payload to every stage — proven by
+        each stage's recorded result echoing the same values."""
+        async def main():
+            from ai4e_tpu.runtime import build_servable
+
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            runtime = ModelRuntime()
+            for st in ("a", "b", "c"):
+                runtime.register(build_servable(
+                    "echo", name=st, size=4, buckets=(4,)))
+            runtime.warmup()
+            batcher = MicroBatcher(runtime, max_wait_ms=5)
+            worker = InferenceWorker(
+                "chain", runtime, batcher,
+                task_manager=platform.task_manager, prefix="v1/chain",
+                store=platform.store)
+
+            base_cell = []
+            worker.serve_model(
+                runtime.models["a"], async_path="/a-async",
+                pipeline_to=lambda r: (f"{base_cell[0]}/v1/chain/b-async",
+                                       b""))
+            worker.serve_model(
+                runtime.models["b"], async_path="/b-async",
+                pipeline_to=lambda r: (f"{base_cell[0]}/v1/chain/c-async",
+                                       b""))
+            worker.serve_model(runtime.models["c"], async_path="/c-async")
+            await batcher.start()
+
+            svc_server = TestServer(worker.service.app)
+            await svc_server.start_server()
+            base = f"http://127.0.0.1:{svc_server.port}"
+            base_cell.append(base)
+            svc_client = TestClient(svc_server)
+            platform.publish_async_api("/v1/chain/a-async",
+                                       f"{base}/v1/chain/a-async")
+            for st in ("b", "c"):
+                platform.dispatchers.register(
+                    f"/v1/chain/{st}-async", f"{base}/v1/chain/{st}-async")
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                payload = np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)
+                resp = await gw.post("/v1/chain/a-async",
+                                     data=npy_bytes(payload))
+                task_id = (await resp.json())["TaskId"]
+                final = None
+                for _ in range(600):
+                    poll = await gw.get(f"/v1/taskmanagement/task/{task_id}")
+                    final = await poll.json()
+                    if ("completed" in final["Status"]
+                            or "failed" in final["Status"]):
+                        break
+                    await asyncio.sleep(0.02)
+                assert "completed" in final["Status"], final
+                assert "c-async" in final["Endpoint"], final
+
+                # Every stage saw the ORIGINAL payload (empty handoff body →
+                # ORIG replay at both hops), and each stage's result is
+                # retrievable under the one TaskId.
+                want = payload.tolist()
+                for st in ("a", "b"):
+                    staged = platform.store.get_result(task_id, stage=st)
+                    assert staged is not None, f"stage {st} missing"
+                    assert json.loads(staged[0])["echo"] == want, st
+                assert json.loads(
+                    platform.store.get_result(task_id)[0])["echo"] == want
+            finally:
+                await platform.stop()
+                await batcher.stop()
+                await gw.close()
+                await svc_client.close()
+
+        asyncio.run(main())
