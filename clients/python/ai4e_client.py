@@ -1,0 +1,151 @@
+"""Caller-side Python SDK for the platform's public gateway surface.
+
+The reference documents its caller workflow as raw HTTP — POST the API,
+read the ``TaskId``, poll ``GET /taskmanagement/task/{id}``
+(``/root/reference/README.md:24``, ``APIManagement/request_policy.xml:25-28``)
+— and ships client *libraries* only for in-container service code. This is
+the missing caller half: submit/poll/wait for async task APIs, plain
+request/response for sync APIs, subscription-key auth, long-poll aware.
+
+Blocking and stdlib-only (urllib), mirroring ``clients/r/api_task.R`` for R
+callers, so notebooks and scripts need no extra dependencies:
+
+    from ai4e_client import AI4EClient, TaskFailed
+
+    client = AI4EClient("http://gateway:8080", api_key="...")
+    task_id = client.submit("/v1/landcover/classify-async", tile_bytes)
+    record = client.wait(task_id)           # long-polls to a terminal state
+    result = client.result(record)          # parsed JSON result, if stored
+    out = client.call_sync("/v1/landcover/classify", tile_bytes)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+DEFAULT_CONTENT_TYPE = "application/octet-stream"
+
+
+class TaskFailed(RuntimeError):
+    """The task reached a failed terminal state; ``record`` holds it."""
+
+    def __init__(self, record: dict):
+        super().__init__(record.get("Status", "failed"))
+        self.record = record
+
+
+class TaskTimeout(TimeoutError):
+    """The task did not reach a terminal state within the wait budget."""
+
+
+class AI4EClient:
+    def __init__(self, gateway: str, api_key: str | None = None,
+                 timeout: float = 60.0):
+        self.gateway = gateway.rstrip("/")
+        self.timeout = timeout
+        self._headers = {}
+        if api_key:
+            # The reference's APIM front door header, preserved verbatim.
+            self._headers["Ocp-Apim-Subscription-Key"] = api_key
+
+    # -- transport ---------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: bytes | None = None,
+                 content_type: str | None = None,
+                 timeout: float | None = None):
+        headers = dict(self._headers)
+        if content_type:
+            headers["Content-Type"] = content_type
+        req = urllib.request.Request(self.gateway + path, data=body,
+                                     headers=headers, method=method)
+        return urllib.request.urlopen(
+            req, timeout=self.timeout if timeout is None else timeout)
+
+    # -- async task API ----------------------------------------------------
+
+    def submit(self, path: str, payload: bytes,
+               content_type: str = DEFAULT_CONTENT_TYPE) -> str:
+        """POST an async API; returns the TaskId the gateway created."""
+        with self._request("POST", path, payload, content_type) as resp:
+            record = json.loads(resp.read())
+        return record["TaskId"]
+
+    def status(self, task_id: str, wait: float = 0) -> dict:
+        """One status read. ``wait`` > 0 long-polls: the gateway holds the
+        GET until the task reaches a terminal state or the wait expires."""
+        path = f"/v1/taskmanagement/task/{urllib.parse.quote(task_id)}"
+        if wait > 0:  # gateway accepts fractional seconds
+            path += f"?wait={wait}"
+        with self._request("GET", path,
+                           timeout=self.timeout + wait) as resp:
+            return json.loads(resp.read())
+
+    def wait(self, task_id: str, timeout: float = 300.0,
+             poll_wait: float = 30.0) -> dict:
+        """Block until the task is terminal. Returns the completed record;
+        raises ``TaskFailed`` on a failed task, ``TaskTimeout`` on budget
+        exhaustion."""
+        deadline = time.monotonic() + timeout
+        while True:
+            record = self.status(
+                task_id,
+                wait=max(1.0, min(poll_wait, deadline - time.monotonic())))
+            # Match the platform's own status bucketing
+            # (taskstore.TaskStatus.canonical): case-insensitive, "failed"
+            # tested first — a status containing both words (e.g. a batch
+            # "completed - N images, M failed") is bucketed failed there
+            # and must be here too.
+            status = record.get("Status", "").lower()
+            if "failed" in status:
+                raise TaskFailed(record)
+            if "completed" in status:
+                return record
+            if time.monotonic() >= deadline:
+                raise TaskTimeout(f"task {task_id} not terminal "
+                                  f"after {timeout}s: {status!r}")
+
+    def result(self, record_or_task_id, stage: str | None = None):
+        """Fetch the stored result payload for a task (None if nothing is
+        stored). ``stage`` retrieves an intermediate pipeline stage's result
+        by model name. Accepts a record or a TaskId. Served by the task
+        store mounted on the control-plane port (``taskstore/http.py``)."""
+        task_id = (record_or_task_id.get("TaskId")
+                   if isinstance(record_or_task_id, dict)
+                   else record_or_task_id)
+        query = {"taskId": task_id}
+        if stage:
+            query["stage"] = stage
+        path = "/v1/taskstore/result?" + urllib.parse.urlencode(query)
+        with self._request("GET", path) as resp:
+            if resp.status == 204:
+                return None
+            body = resp.read()
+            content_type = resp.headers.get_content_type()
+        if content_type == "application/json":
+            return json.loads(body)
+        return body
+
+    def run(self, path: str, payload: bytes,
+            content_type: str = DEFAULT_CONTENT_TYPE,
+            timeout: float = 300.0) -> object | None:
+        """submit → wait → result in one call."""
+        record = self.wait(self.submit(path, payload, content_type),
+                           timeout=timeout)
+        return self.result(record)
+
+    # -- sync API ----------------------------------------------------------
+
+    def call_sync(self, path: str, payload: bytes,
+                  content_type: str = DEFAULT_CONTENT_TYPE) -> object:
+        """POST a sync API; returns the parsed JSON response (raw bytes if
+        the response is not JSON)."""
+        with self._request("POST", path, payload, content_type) as resp:
+            body = resp.read()
+        try:
+            return json.loads(body)
+        except ValueError:
+            return body

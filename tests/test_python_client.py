@@ -1,0 +1,156 @@
+"""Caller-side Python SDK (clients/python/ai4e_client.py) against a live
+platform: submit → long-poll wait → result, sync call, failure and auth
+surfaces — the caller workflow the reference documents as raw HTTP
+(``README.md:24``), packaged."""
+
+import asyncio
+import importlib.util
+import io
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+from aiohttp import web
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "ai4e_client", os.path.join(REPO, "clients", "python", "ai4e_client.py"))
+ai4e_client = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(ai4e_client)
+
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig  # noqa: E402
+from ai4e_tpu.runtime import (  # noqa: E402
+    InferenceWorker,
+    MicroBatcher,
+    ModelRuntime,
+    build_servable,
+)
+
+
+def npy_bytes(arr) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+class _PlatformThread:
+    """Full platform (gateway+store+broker+worker, echo API) on a background
+    event loop, so the blocking stdlib client can be driven from the test
+    thread exactly as a real caller would."""
+
+    def __init__(self, api_keys: str | None = None):
+        self.api_keys = api_keys
+        self.port = None
+        self._stop = None
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def __enter__(self):
+        self._thread.start()
+        assert self._ready.wait(30), "platform failed to start"
+        return self
+
+    def __exit__(self, *exc):
+        self._loop.call_soon_threadsafe(self._stop.set)
+        self._thread.join(timeout=30)
+
+    def _run(self):
+        asyncio.run(self._main())
+
+    async def _main(self):
+        self._loop = asyncio.get_running_loop()
+        self._stop = asyncio.Event()
+        platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+        if self.api_keys is not None:
+            platform.gateway.set_api_keys({self.api_keys})
+        # Production control planes mount the task-store HTTP surface on the
+        # gateway port (cli.py build_control_plane) — mirror that so
+        # client.result() hits /v1/taskstore/result like a real deployment.
+        from ai4e_tpu.taskstore.http import make_app as make_taskstore_app
+        make_taskstore_app(platform.store, app=platform.gateway.app)
+        runtime = ModelRuntime()
+        servable = build_servable("echo", name="echo", size=4, buckets=(4,))
+
+        def failing_preprocess(body, content_type):
+            arr = np.load(io.BytesIO(body))
+            if arr.shape != (4,):
+                raise ValueError(f"expected (4,), got {arr.shape}")
+            return arr.astype(np.float32)
+
+        servable.preprocess = failing_preprocess
+        runtime.register(servable)
+        runtime.warmup()
+        batcher = MicroBatcher(runtime, max_wait_ms=2)
+        worker = InferenceWorker("echo-svc", runtime, batcher,
+                                 task_manager=platform.task_manager,
+                                 prefix="v1/echo", store=platform.store)
+        worker.serve_model(servable, sync_path="/echo",
+                           async_path="/echo-async")
+        await batcher.start()
+
+        be = web.AppRunner(worker.service.app)
+        await be.setup()
+        be_site = web.TCPSite(be, "127.0.0.1", 0)
+        await be_site.start()
+        be_port = be.addresses[0][1]
+        platform.publish_async_api(
+            "/v1/echo/echo-async", f"http://127.0.0.1:{be_port}/v1/echo/echo-async")
+        platform.publish_sync_api(
+            "/v1/echo/echo", f"http://127.0.0.1:{be_port}/v1/echo/echo")
+        gw = web.AppRunner(platform.gateway.app)
+        await gw.setup()
+        gw_site = web.TCPSite(gw, "127.0.0.1", 0)
+        await gw_site.start()
+        self.port = gw.addresses[0][1]
+        await platform.start()
+        self._ready.set()
+        await self._stop.wait()
+        await platform.stop()
+        await batcher.stop()
+        await gw.cleanup()
+        await be.cleanup()
+
+
+class TestPythonClient:
+    def test_async_submit_wait_result_and_sync_call(self):
+        with _PlatformThread() as pt:
+            client = ai4e_client.AI4EClient(f"http://127.0.0.1:{pt.port}")
+            payload = npy_bytes(np.asarray([1, 2, 3, 4], np.float32))
+
+            task_id = client.submit("/v1/echo/echo-async", payload)
+            record = client.wait(task_id, timeout=60, poll_wait=5)
+            assert "completed" in record["Status"]
+            assert record["TaskId"] == task_id
+            assert client.result(record) == {"echo": [1.0, 2.0, 3.0, 4.0]}
+            # run() = submit+wait+result in one call
+            assert client.run("/v1/echo/echo-async", payload,
+                              timeout=60) == {"echo": [1.0, 2.0, 3.0, 4.0]}
+            # sync API through the gateway proxy
+            assert client.call_sync("/v1/echo/echo", payload) == {
+                "echo": [1.0, 2.0, 3.0, 4.0]}
+
+    def test_failed_task_raises_with_record(self):
+        with _PlatformThread() as pt:
+            client = ai4e_client.AI4EClient(f"http://127.0.0.1:{pt.port}")
+            bad = npy_bytes(np.zeros(7, np.float32))  # wrong shape
+            task_id = client.submit("/v1/echo/echo-async", bad)
+            with pytest.raises(ai4e_client.TaskFailed) as exc:
+                client.wait(task_id, timeout=60, poll_wait=5)
+            assert "failed" in exc.value.record["Status"]
+
+    def test_subscription_key_required_and_accepted(self):
+        import urllib.error
+
+        with _PlatformThread(api_keys="sekrit") as pt:
+            payload = npy_bytes(np.asarray([1, 2, 3, 4], np.float32))
+            anon = ai4e_client.AI4EClient(f"http://127.0.0.1:{pt.port}")
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                anon.submit("/v1/echo/echo-async", payload)
+            assert exc.value.code == 401
+            keyed = ai4e_client.AI4EClient(f"http://127.0.0.1:{pt.port}",
+                                           api_key="sekrit")
+            record = keyed.wait(keyed.submit("/v1/echo/echo-async", payload),
+                                timeout=60, poll_wait=5)
+            assert "completed" in record["Status"]
