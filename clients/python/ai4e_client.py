@@ -142,10 +142,10 @@ class AI4EClient:
     def call_sync(self, path: str, payload: bytes,
                   content_type: str = DEFAULT_CONTENT_TYPE) -> object:
         """POST a sync API; returns the parsed JSON response (raw bytes if
-        the response is not JSON)."""
+        the response is not JSON — keyed off the Content-Type header, same
+        as ``result``, so a text body that happens to parse isn't coerced)."""
         with self._request("POST", path, payload, content_type) as resp:
             body = resp.read()
-        try:
-            return json.loads(body)
-        except ValueError:
-            return body
+            if resp.headers.get_content_type() == "application/json":
+                return json.loads(body)
+        return body

@@ -148,6 +148,37 @@ class TestMicroBatcher:
         with pytest.raises(ValueError):
             MicroBatcher(ModelRuntime(), pipeline_depth=0)
 
+    def test_device_failure_fails_batch_but_not_batcher(self):
+        """A device-level execution failure (run_batch raising) must fail
+        every request in THAT batch and release the pipeline-window slot —
+        later batches run normally on the same batcher."""
+        async def main():
+            runtime = ModelRuntime()
+            s = _double_servable()
+            runtime.register(s)
+            inner = runtime.models["double"]._compiled
+
+            def flaky(p, b):
+                if float(np.asarray(b)[0][0]) < 0:  # poisoned batch marker
+                    raise RuntimeError("device exploded")
+                return inner(p, b)
+
+            runtime.models["double"]._compiled = flaky
+            batcher = MicroBatcher(runtime, max_wait_ms=0, pipeline_depth=2)
+            await batcher.start()
+            try:
+                with pytest.raises(RuntimeError, match="device exploded"):
+                    await batcher.submit(
+                        "double", np.full((4,), -1.0, np.float32))
+                # The window slot came back: a healthy batch still runs.
+                ok = await batcher.submit(
+                    "double", np.full((4,), 2.0, np.float32))
+                assert ok == {"sum": 16.0}
+            finally:
+                await batcher.stop()
+
+        run(main())
+
     def test_bad_shape_rejected_immediately(self):
         async def main():
             runtime = ModelRuntime()
