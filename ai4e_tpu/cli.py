@@ -23,6 +23,7 @@ routes.json::
                "backend": "http://worker:8081/v1/landcover/classify-async",
                "mode": "async",             // or "sync"
                "autoscale": {"max_replicas": 8},   // optional
+               "max_body_bytes": 67108864,  // optional edge payload cap
                "concurrency": 4}]}          // optional
 
 models.json::
@@ -72,6 +73,7 @@ def build_control_plane(config: FrameworkConfig, routes: dict):
             raise ConfigError(
                 "AI4E_GATEWAY_API_KEYS is set but contains no keys")
         platform.gateway.set_api_keys(keys)
+    platform.gateway.max_body_bytes = config.gateway.max_body_bytes
     # The task-store HTTP surface rides on the gateway app — one
     # control-plane port serves the CACHE_CONNECTOR_*_URI endpoints remote
     # workers use (distributed_api_task.py:14-15 pattern).
@@ -86,7 +88,8 @@ def build_control_plane(config: FrameworkConfig, routes: dict):
     for api in routes.get("apis", []):
         mode = api.get("mode", "async")
         if mode == "sync":
-            platform.publish_sync_api(api["prefix"], api["backend"])
+            platform.publish_sync_api(api["prefix"], api["backend"],
+                                      max_body_bytes=api.get("max_body_bytes"))
             continue
         autoscale = api.get("autoscale")
         if api.get("internal"):
@@ -102,7 +105,8 @@ def build_control_plane(config: FrameworkConfig, routes: dict):
             api["prefix"], api["backend"],
             retry_delay=api.get("retry_delay"),
             concurrency=api.get("concurrency"),
-            autoscale=AutoscalePolicy(**autoscale) if autoscale else None)
+            autoscale=AutoscalePolicy(**autoscale) if autoscale else None,
+            max_body_bytes=api.get("max_body_bytes"))
     return platform
 
 

@@ -203,6 +203,9 @@ class GatewaySection:
     # /v1/taskmanagement call must carry one (Ocp-Apim-Subscription-Key or
     # X-Api-Key header) — the reference's APIM front-door contract.
     api_keys: typing.Optional[str] = None
+    # Edge payload cap (bytes) for published APIs: oversized POSTs are
+    # refused with 413 before any task/ORIG body is stored. 0 = unlimited.
+    max_body_bytes: int = 134217728
 
 
 @_env_section("AI4E_OBSERVABILITY_")

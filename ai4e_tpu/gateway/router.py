@@ -42,12 +42,20 @@ class Route:
     prefix: str
     mode: str  # "sync" | "async"
     backend_uri: str = ""  # sync: proxy target; async: recorded task endpoint
+    # None = use the gateway's cap at request time; 0 = explicitly unlimited.
+    max_body_bytes: int | None = None
 
 
 class Gateway:
     def __init__(self, store: InMemoryTaskStore,
                  metrics: MetricsRegistry | None = None,
-                 api_keys: set[str] | None = None):
+                 api_keys: set[str] | None = None,
+                 max_body_bytes: int = 128 * 1024 * 1024):
+        # Edge payload cap (the reference enforces limits at APIM, before
+        # anything is stored): an async POST over the limit is refused with
+        # 413 BEFORE a task (and its journaled ORIG body) is created;
+        # per-route overrides via add_*_route(max_body_bytes=...).
+        self.max_body_bytes = max_body_bytes
         self.store = store
         self.metrics = metrics or DEFAULT_REGISTRY
         self.routes: list[Route] = []
@@ -63,7 +71,10 @@ class Gateway:
         if hasattr(store, "add_listener"):
             store.add_listener(self._on_task_change)
 
-        self.app = web.Application(client_max_size=1024**3,
+        # aiohttp's own cap is effectively disabled: _read_limited enforces
+        # the per-route edge cap incrementally (bounded buffering), and an
+        # explicit 0 (unlimited) must actually mean unlimited.
+        self.app = web.Application(client_max_size=1024**4,
                                    middlewares=[self._auth_middleware])
         self.app.router.add_get("/v1/taskmanagement/task/{task_id}", self._task)
         self.app.router.add_get("/healthz", self._health)
@@ -97,19 +108,24 @@ class Gateway:
                         status=401)
         return await handler(request)
 
-    def add_async_route(self, prefix: str, task_endpoint: str) -> None:
+    def add_async_route(self, prefix: str, task_endpoint: str,
+                        max_body_bytes: int | None = None) -> None:
         """Register an async API: requests become tasks addressed to
-        ``task_endpoint`` (the backend route the dispatcher will POST to)."""
+        ``task_endpoint`` (the backend route the dispatcher will POST to).
+        ``max_body_bytes``: per-route edge cap (None → the gateway's)."""
         route = Route(prefix=prefix.rstrip("/"), mode="async",
-                      backend_uri=task_endpoint)
+                      backend_uri=task_endpoint,
+                      max_body_bytes=max_body_bytes)
         self.routes.append(route)
         self.app.router.add_post(route.prefix, self._make_async_handler(route))
         self.app.router.add_post(route.prefix + "/{tail:.*}",
                                  self._make_async_handler(route))
 
-    def add_sync_route(self, prefix: str, backend_uri: str) -> None:
+    def add_sync_route(self, prefix: str, backend_uri: str,
+                       max_body_bytes: int | None = None) -> None:
         route = Route(prefix=prefix.rstrip("/"), mode="sync",
-                      backend_uri=backend_uri.rstrip("/"))
+                      backend_uri=backend_uri.rstrip("/"),
+                      max_body_bytes=max_body_bytes)
         self.routes.append(route)
         handler = self._make_sync_handler(route)
         for pattern in (route.prefix, route.prefix + "/{tail:.*}"):
@@ -117,9 +133,46 @@ class Gateway:
 
     # -- async: edge task creation (request_policy.xml:8-28) ---------------
 
+    def _route_limit(self, route: Route) -> int:
+        """The route's effective edge cap, resolved at request time so a
+        gateway-level cap set after routes were registered still applies."""
+        return (self.max_body_bytes if route.max_body_bytes is None
+                else route.max_body_bytes)
+
+    async def _read_limited(self, request: web.Request,
+                            route: Route) -> bytes | None:
+        """Body within the route's edge cap, else None (→ 413). Checks the
+        declared length first (cheap refusal), then reads the stream
+        INCREMENTALLY and aborts the moment the running total exceeds the
+        cap — a chunked body with no declared length must never buffer more
+        than limit+chunk bytes of gateway memory."""
+        limit = self._route_limit(route)
+        if not limit:
+            return await request.read()
+        if (request.content_length or 0) > limit:
+            return None
+        chunks: list[bytes] = []
+        total = 0
+        while True:
+            chunk = await request.content.readany()
+            if not chunk:
+                return b"".join(chunks)
+            total += len(chunk)
+            if total > limit:
+                return None
+            chunks.append(chunk)
+
+    def _payload_too_large(self, route: Route) -> web.Response:
+        self._requests.inc(route=route.prefix, outcome="413")
+        return web.Response(
+            status=413,
+            text=f"Payload exceeds {self._route_limit(route)} bytes.")
+
     def _make_async_handler(self, route: Route):
         async def handler(request: web.Request) -> web.Response:
-            body = await request.read()
+            body = await self._read_limited(request, route)
+            if body is None:
+                return self._payload_too_large(route)
             # Record the full target: base backend URI + operation tail +
             # query, so the dispatcher can reproduce the exact call (the
             # reference stores the original request URI as Endpoint,
@@ -155,7 +208,9 @@ class Gateway:
             target = route.backend_uri + (("/" + tail) if tail else "")
             if request.query_string:
                 target += "?" + request.query_string
-            body = await request.read()
+            body = await self._read_limited(request, route)
+            if body is None:
+                return self._payload_too_large(route)
             session = await self._get_session()
             try:
                 async with session.request(

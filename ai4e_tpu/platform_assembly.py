@@ -134,14 +134,16 @@ class LocalPlatform:
                           retry_delay: float | None = None,
                           concurrency: int | None = None,
                           autoscale=None,
-                          autoscale_interval: float = 5.0) -> None:
+                          autoscale_interval: float = 5.0,
+                          max_body_bytes: int | None = None) -> None:
         """Register an async API end-to-end: gateway route + dispatcher for
         its queue (the reference needs an APIM operation + a Service Bus queue
         + a function app per API; here it's one call). Passing an
         ``AutoscalePolicy`` as ``autoscale`` attaches the HPA-style control
         loop (the reference's per-API ``autoscaler.yaml``) to the
         dispatcher's delivery fan-out."""
-        self.gateway.add_async_route(public_prefix, backend_uri)
+        self.gateway.add_async_route(public_prefix, backend_uri,
+                                     max_body_bytes=max_body_bytes)
         self.register_internal_route(backend_uri, retry_delay=retry_delay,
                                      concurrency=concurrency,
                                      autoscale=autoscale,
@@ -176,8 +178,10 @@ class LocalPlatform:
                 policy=autoscale, interval=autoscale_interval,
                 metrics=self.metrics))
 
-    def publish_sync_api(self, public_prefix: str, backend_uri: str) -> None:
-        self.gateway.add_sync_route(public_prefix, backend_uri)
+    def publish_sync_api(self, public_prefix: str, backend_uri: str,
+                         max_body_bytes: int | None = None) -> None:
+        self.gateway.add_sync_route(public_prefix, backend_uri,
+                                    max_body_bytes=max_body_bytes)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -146,3 +146,81 @@ class TestProxyCredentialStripping:
                 await be.close()
 
         run(main())
+
+
+class TestEdgePayloadCap:
+    def test_oversized_async_post_is_413_before_task_creation(self):
+        """The edge cap refuses oversized bodies with 413 BEFORE a task (and
+        its journaled ORIG body) exists — the reference enforces payload
+        limits at APIM, not after storage."""
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            platform.gateway.max_body_bytes = 1024
+            platform.publish_async_api("/v1/api/run", "http://backend/run")
+            gw = await serve(platform.gateway.app)
+            try:
+                resp = await gw.post("/v1/api/run", data=b"x" * 2048)
+                assert resp.status == 413
+                # Nothing was stored: the endpoint's created-set is empty.
+                assert not platform.store.set_members("backendrun", "created")
+                under = await gw.post("/v1/api/run", data=b"x" * 512)
+                assert under.status == 200
+                assert "TaskId" in await under.json()
+            finally:
+                await gw.close()
+
+        run(main())
+
+    def test_chunked_body_aborts_at_the_cap_not_after_buffering(self):
+        """A chunked POST carries no Content-Length, so the cap must be
+        enforced while STREAMING — the gateway may buffer at most
+        ~limit+chunk bytes, never the whole body."""
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            platform.gateway.max_body_bytes = 1024
+            platform.publish_async_api("/v1/api/run", "http://backend/run")
+            gw = await serve(platform.gateway.app)
+            try:
+                async def chunks():
+                    for _ in range(64):  # 64 KiB total, 1 KiB cap
+                        yield b"x" * 1024
+                resp = await gw.post("/v1/api/run", data=chunks())
+                assert resp.status == 413
+                assert not platform.store.set_members("backendrun", "created")
+            finally:
+                await gw.close()
+
+        run(main())
+
+    def test_sync_proxy_refuses_oversized_and_route_override_wins(self):
+        async def main():
+            from aiohttp import web
+
+            seen = []
+
+            async def backend(request):
+                seen.append(len(await request.read()))
+                return web.json_response({"ok": True})
+
+            be_app = web.Application()
+            be_app.router.add_post("/run", backend)
+            be = await serve(be_app)
+
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            platform.gateway.max_body_bytes = 1024
+            platform.gateway.add_sync_route(
+                "/v1/sync/run",
+                f"http://127.0.0.1:{be.port}/run",
+                max_body_bytes=4096)  # per-route override > gateway default
+            gw = await serve(platform.gateway.app)
+            try:
+                ok = await gw.post("/v1/sync/run", data=b"x" * 2048)
+                assert ok.status == 200, ok.status  # override admits 2 KiB
+                too_big = await gw.post("/v1/sync/run", data=b"x" * 8192)
+                assert too_big.status == 413
+                assert seen == [2048]  # the oversized body never reached it
+            finally:
+                await gw.close()
+                await be.close()
+
+        run(main())
