@@ -218,6 +218,7 @@ def build_platform(args):
 
     enable_compilation_cache()
     platform = LocalPlatform(PlatformConfig(
+        transport=args.transport,
         retry_delay=0.05, dispatcher_concurrency=args.dispatcher_concurrency))
     runtime = ModelRuntime()
     batcher = MicroBatcher(runtime, max_wait_ms=args.max_wait_ms,
@@ -301,8 +302,8 @@ async def run_bench(args) -> dict:
         sync_public = f"/v1/{args.model}/classify"
         platform.publish_sync_api(
             sync_public, f"http://127.0.0.1:{be_port}{sync_public}")
-    for path in extra_paths:  # internal pipeline stages: dispatcher only
-        platform.dispatchers.register(path, f"http://127.0.0.1:{be_port}{path}")
+    for path in extra_paths:  # internal pipeline stages: transport consumer only
+        platform.register_internal_route(f"http://127.0.0.1:{be_port}{path}")
 
     gw_runner = web.AppRunner(platform.gateway.app)
     await gw_runner.setup()
@@ -424,6 +425,7 @@ async def run_bench(args) -> dict:
         "value": round(throughput, 2),
         "unit": "req/s",
         "mode": args.mode,
+        "transport": args.transport,
         "vs_baseline": round(throughput / cfg["anchor"], 2),
         "baseline_anchor": cfg["anchor"],
         **{k: window[k] for k in ("p50_latency_ms", "p95_latency_ms",
@@ -539,6 +541,7 @@ def _forward_argv(args) -> list[str]:
             "--dispatcher-concurrency", str(args.dispatcher_concurrency),
             "--model", args.model,
             "--mode", args.mode,
+            "--transport", args.transport,
             "--checkpoint-dir", args.checkpoint_dir,
             "--seq-len", str(args.seq_len),
             "--buckets", *[str(b) for b in args.buckets]]
@@ -580,6 +583,12 @@ def main() -> None:
                         help="async = task path (gateway→store→broker→worker);"
                              " sync = gateway reverse proxy to the worker's"
                              " sync endpoint (BASELINE configs #1/#2)")
+    parser.add_argument("--transport", choices=("queue", "push"),
+                        default="queue",
+                        help="async transport under measurement: durable "
+                             "queues + dispatchers (Service Bus analogue) or "
+                             "topic push (Event Grid analogue) — the "
+                             "reference's TRANSPORT_TYPE switch")
     parser.add_argument("--checkpoint-dir", default="checkpoints",
                         help="trained weights (ai4e_tpu.train.make_checkpoints)")
     parser.add_argument("--seq-len", type=int, default=4096,
