@@ -48,6 +48,7 @@ class _Pending:
     example: np.ndarray
     future: asyncio.Future
     enqueued: float = field(default_factory=time.perf_counter)
+    priority: int = 0  # 0 = interactive, higher = background
 
 
 class MicroBatcher:
@@ -58,10 +59,23 @@ class MicroBatcher:
         max_pending: int = 256,
         metrics: MetricsRegistry | None = None,
         pipeline_depth: int = 2,
+        interactive_reserve: float = 0.25,
+        priority_aging_s: float = 2.0,
     ):
         self.runtime = runtime
         self.max_wait = max_wait_ms / 1000.0
         self.max_pending = max_pending
+        # Priority isolation is enforced at BOTH gates:
+        # - admission: background submits saturate at (1 - reserve) of the
+        #   queue, so stacks can never eat the whole cap and 503 interactive
+        #   traffic out of the batcher;
+        # - batch cut: interactive-first, but a background item's effective
+        #   priority decays by 1 class per ``priority_aging_s`` waited, so
+        #   sustained interactive load delays stacks boundedly instead of
+        #   starving them (0 disables aging → strict priority).
+        self._background_cap = max(1, int(max_pending
+                                          * (1.0 - interactive_reserve)))
+        self.priority_aging_s = priority_aging_s
         self.metrics = metrics or DEFAULT_REGISTRY
         self._pending: dict[str, list[_Pending]] = {}
         self._wakeup: asyncio.Event = asyncio.Event()
@@ -101,20 +115,31 @@ class MicroBatcher:
     def pending_count(self) -> int:
         return sum(len(v) for v in self._pending.values())
 
-    async def submit(self, model_name: str, example: np.ndarray):
-        """Queue one example; resolves to that example's postprocessed result."""
+    async def submit(self, model_name: str, example: np.ndarray,
+                     priority: int = 0):
+        """Queue one example; resolves to that example's postprocessed result.
+
+        ``priority`` 0 is interactive (default); higher values are
+        background classes (the batch API submits at 1). Every device batch
+        is filled interactive-first, so a long background stack shares the
+        device without queueing ahead of interactive latency — the
+        isolation the reference gets only from separate container pools.
+        """
         if self._stop:
             raise RuntimeError("batcher stopped")
-        if self.pending_count >= self.max_pending:
+        cap = self.max_pending if priority <= 0 else self._background_cap
+        if self.pending_count >= cap:
             raise BatcherSaturated(
-                f"batcher at {self.pending_count}/{self.max_pending} pending")
+                f"batcher at {self.pending_count}/{cap} pending "
+                f"(priority {priority})")
         servable = self.runtime.models[model_name]
         expected = tuple(servable.input_shape)
         if tuple(example.shape) != expected:
             raise ValueError(
                 f"bad input shape {example.shape}, expected {expected}")
         fut = asyncio.get_running_loop().create_future()
-        self._pending.setdefault(model_name, []).append(_Pending(example, fut))
+        self._pending.setdefault(model_name, []).append(
+            _Pending(example, fut, priority=priority))
         self._pending_gauge.set(self.pending_count)
         self._wakeup.set()
         return await fut
@@ -193,7 +218,23 @@ class MicroBatcher:
             return []
         servable = self.runtime.models[model_name]
         take = min(len(queue), servable.max_bucket)
-        batch, self._pending[model_name] = queue[:take], queue[take:]
+        if take < len(queue):
+            # Cut interactive-first: a background stack never queues ahead
+            # of fresh interactive requests when the batch can't hold
+            # everyone — but waiting decays a class per priority_aging_s so
+            # nothing starves. Within a class the aged key preserves
+            # oldest-first. Full drains skip the sort.
+            now = time.perf_counter()
+            aging = self.priority_aging_s
+
+            def effective(p: _Pending) -> float:
+                if aging <= 0:
+                    return float(p.priority)
+                return p.priority - (now - p.enqueued) / aging
+
+            queue = sorted(queue, key=effective)
+        batch, rest = queue[:take], queue[take:]
+        self._pending[model_name] = rest
         self._pending_gauge.set(self.pending_count)
         return batch
 

@@ -217,8 +217,11 @@ class InferenceWorker:
                         return
                     while True:
                         try:
+                            # Background priority: the stack shares device
+                            # batches with interactive traffic but never
+                            # queues ahead of it.
                             out = await self.batcher.submit(
-                                name, np.asarray(stack[i]))
+                                name, np.asarray(stack[i]), priority=1)
                             results[i] = {"index": i, "result": _jsonable(out)}
                             break
                         except BatcherSaturated:

@@ -148,6 +148,95 @@ class TestMicroBatcher:
         with pytest.raises(ValueError):
             MicroBatcher(ModelRuntime(), pipeline_depth=0)
 
+    def test_interactive_priority_jumps_background_backlog(self):
+        """With a background backlog deeper than one bucket, an interactive
+        submit must ride the NEXT device batch, not wait for the whole
+        backlog to drain (batch-API stacks submit at priority 1)."""
+        async def main():
+            runtime = ModelRuntime()
+            runtime.register(_double_servable(buckets=(8,)))
+            batcher = MicroBatcher(runtime, max_wait_ms=0, pipeline_depth=1)
+            order: list[str] = []
+
+            async def tagged(tag, prio, value):
+                await batcher.submit("double",
+                                     np.full((4,), value, np.float32),
+                                     priority=prio)
+                order.append(tag)
+
+            await batcher.start()
+            try:
+                jobs = [asyncio.create_task(tagged(f"bg{i}", 1, float(i)))
+                        for i in range(24)]  # 3 full buckets of background
+                await asyncio.sleep(0)  # let them enqueue
+                vip = asyncio.create_task(tagged("vip", 0, 99.0))
+                await asyncio.gather(vip, *jobs)
+                # The interactive request finished within the first two
+                # batches' worth of completions, never behind all 24.
+                assert "vip" in order[:16], order
+            finally:
+                await batcher.stop()
+
+        run(main())
+
+    def test_background_admission_headroom_keeps_interactive_alive(self):
+        """Background submits saturate at (1 - reserve) of max_pending, so a
+        flood of stack items can never 503 interactive traffic out of the
+        batcher; aged background items still win a slot eventually."""
+        async def main():
+            runtime = ModelRuntime()
+            runtime.register(_double_servable(buckets=(8,)))
+            batcher = MicroBatcher(runtime, max_wait_ms=0, pipeline_depth=1,
+                                   max_pending=16, interactive_reserve=0.25)
+            # Don't start the flusher: queue state must stay put.
+            bg = []
+            for i in range(12):  # background cap = 12 of 16
+                fut = asyncio.ensure_future(batcher.submit(
+                    "double", np.full((4,), float(i), np.float32),
+                    priority=1))
+                await asyncio.sleep(0)
+                bg.append(fut)
+            with pytest.raises(BatcherSaturated):
+                await batcher.submit("double", np.zeros((4,), np.float32),
+                                     priority=1)
+            # Interactive still admitted in the reserved headroom.
+            vip = asyncio.ensure_future(batcher.submit(
+                "double", np.full((4,), 9.0, np.float32)))
+            await asyncio.sleep(0)
+            assert batcher.pending_count == 13
+            await batcher.start()
+            results = await asyncio.gather(vip, *bg)
+            assert results[0] == {"sum": 72.0}
+            await batcher.stop()
+
+        run(main())
+
+    def test_aged_background_item_beats_fresh_interactive(self):
+        """Strict priority would starve background under sustained
+        interactive load; after priority_aging_s of waiting a background
+        item outranks a just-arrived interactive one in the cut."""
+        import time as _t
+
+        from ai4e_tpu.runtime.batcher import _Pending
+
+        async def main():
+            runtime = ModelRuntime()
+            runtime.register(_double_servable(buckets=(8,)))
+            batcher = MicroBatcher(runtime, max_wait_ms=0,
+                                   priority_aging_s=0.5)
+            loop = asyncio.get_running_loop()
+            old_bg = _Pending(np.zeros((4,), np.float32),
+                              loop.create_future(), priority=1)
+            old_bg.enqueued = _t.perf_counter() - 1.0  # waited 2 classes
+            fresh = [
+                _Pending(np.zeros((4,), np.float32), loop.create_future())
+                for _ in range(9)]
+            batcher._pending["double"] = [old_bg, *fresh]
+            cut = batcher._take_batch("double")
+            assert old_bg in cut, "aged background item was starved"
+
+        run(main())
+
     def test_device_failure_fails_batch_but_not_batcher(self):
         """A device-level execution failure (run_batch raising) must fail
         every request in THAT batch and release the pipeline-window slot —
