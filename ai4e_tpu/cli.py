@@ -226,7 +226,9 @@ def build_worker(config: FrameworkConfig, models: dict):
 
     batcher = MicroBatcher(runtime, max_wait_ms=rt.batch_max_wait_ms,
                            max_pending=rt.batch_max_pending,
-                           pipeline_depth=rt.batch_pipeline_depth)
+                           pipeline_depth=rt.batch_pipeline_depth,
+                           interactive_reserve=rt.batch_interactive_reserve,
+                           priority_aging_s=rt.batch_priority_aging_s)
     worker = InferenceWorker(
         models.get("service_name", "tpu-worker"), runtime, batcher,
         task_manager=task_manager, prefix=models.get("prefix", "v1"),

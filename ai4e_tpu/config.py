@@ -179,6 +179,12 @@ class RuntimeSection:
     # host↔device link is long-fat (remote-attached TPU) so transfers of
     # several batches overlap.
     batch_pipeline_depth: int = 2
+    # Priority-class batching (batch-API stacks run at background priority):
+    # fraction of batch_max_pending reserved for interactive admissions, and
+    # the seconds of waiting that promote a background item one class
+    # (0 = strict priority).
+    batch_interactive_reserve: float = 0.25
+    batch_priority_aging_s: float = 2.0
     buckets: typing.Tuple[int, ...] = (1, 8, 32, 64)
     compile_cache_dir: str = "/tmp/ai4e_tpu_xla_cache"
     checkpoint_dir: typing.Optional[str] = None
