@@ -76,8 +76,11 @@ def build_control_plane(config: FrameworkConfig, routes: dict):
     platform.gateway.max_body_bytes = config.gateway.max_body_bytes
     # The task-store HTTP surface rides on the gateway app — one
     # control-plane port serves the CACHE_CONNECTOR_*_URI endpoints remote
-    # workers use (distributed_api_task.py:14-15 pattern).
-    make_taskstore_app(platform.store, app=platform.gateway.app)
+    # workers use (distributed_api_task.py:14-15 pattern). It enforces the
+    # gateway's edge cap itself: the app's aiohttp cap is disabled.
+    make_taskstore_app(platform.store, app=platform.gateway.app,
+                       max_body_bytes=config.gateway.max_body_bytes,
+                       max_result_bytes=config.gateway.max_result_bytes)
     # Typed API definitions ({org, api, backend_host, ...}) publish through
     # the registration customizer (gateway/registration.py) — one publish
     # code path; both spec styles can coexist in one routes.json.

@@ -132,6 +132,10 @@ class PlatformSection:
     reaper_running_timeout: typing.Optional[float] = None
     reaper_interval: float = 30.0
     reaper_max_requeues: int = 3
+    # Object-store result offload (assign_storage_auth_to_aks.sh:9-17 slot):
+    # results >= threshold bytes land under result_dir instead of store memory.
+    result_dir: typing.Optional[str] = None
+    result_offload_threshold: int = 1048576
 
     def to_platform_config(self):
         from .platform_assembly import PlatformConfig
@@ -149,6 +153,8 @@ class PlatformSection:
             reaper_running_timeout=self.reaper_running_timeout,
             reaper_interval=self.reaper_interval,
             reaper_max_requeues=self.reaper_max_requeues,
+            result_dir=self.result_dir,
+            result_offload_threshold=self.result_offload_threshold,
         )
 
 
@@ -212,6 +218,9 @@ class GatewaySection:
     # Edge payload cap (bytes) for published APIs: oversized POSTs are
     # refused with 413 before any task/ORIG body is stored. 0 = unlimited.
     max_body_bytes: int = 134217728
+    # Separate cap for result uploads on the task-store surface — batch
+    # results are routinely larger than request bodies. 0 = unlimited.
+    max_result_bytes: int = 1073741824
 
 
 @_env_section("AI4E_OBSERVABILITY_")

@@ -141,26 +141,9 @@ class Gateway:
 
     async def _read_limited(self, request: web.Request,
                             route: Route) -> bytes | None:
-        """Body within the route's edge cap, else None (→ 413). Checks the
-        declared length first (cheap refusal), then reads the stream
-        INCREMENTALLY and aborts the moment the running total exceeds the
-        cap — a chunked body with no declared length must never buffer more
-        than limit+chunk bytes of gateway memory."""
-        limit = self._route_limit(route)
-        if not limit:
-            return await request.read()
-        if (request.content_length or 0) > limit:
-            return None
-        chunks: list[bytes] = []
-        total = 0
-        while True:
-            chunk = await request.content.readany()
-            if not chunk:
-                return b"".join(chunks)
-            total += len(chunk)
-            if total > limit:
-                return None
-            chunks.append(chunk)
+        """Body within the route's edge cap, else None (→ 413)."""
+        from ..utils.http import read_body_limited
+        return await read_body_limited(request, self._route_limit(route))
 
     def _payload_too_large(self, route: Route) -> web.Response:
         self._requests.inc(route=route.prefix, outcome="413")

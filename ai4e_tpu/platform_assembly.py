@@ -38,6 +38,11 @@ class PlatformConfig:
     reaper_running_timeout: float | None = None
     reaper_interval: float = 30.0
     reaper_max_requeues: int = 3
+    # Object-store slot for large results (assign_storage_auth_to_aks.sh:9-17):
+    # results >= the threshold are written under result_dir (a local dir, PD,
+    # or GCS FUSE mount) instead of store memory. None dir disables offload.
+    result_dir: str | None = None
+    result_offload_threshold: int = 1024 * 1024
 
 
 class LocalPlatform:
@@ -57,17 +62,30 @@ class LocalPlatform:
                  metrics: MetricsRegistry | None = None):
         self.config = config or PlatformConfig()
         self.metrics = metrics or DEFAULT_REGISTRY
+        result_backend = None
+        if self.config.result_dir:
+            from .taskstore.results import FileResultBackend
+            result_backend = FileResultBackend(self.config.result_dir)
+        result_kwargs = dict(
+            result_backend=result_backend,
+            result_offload_threshold=(self.config.result_offload_threshold
+                                      if result_backend else None))
         if self.config.journal_path:
             if self.config.native_store:
                 raise ValueError(
                     "native_store has no journal; use journal_path with the "
                     "Python store or native_store without durability")
-            self.store = JournaledTaskStore(self.config.journal_path)
+            self.store = JournaledTaskStore(self.config.journal_path,
+                                            **result_kwargs)
         elif self.config.native_store:
             from .taskstore.native import NativeTaskStore
+            if result_backend is not None:
+                raise ValueError(
+                    "result_dir offload requires the Python store "
+                    "(the native store keeps results in its own memory)")
             self.store = NativeTaskStore()
         else:
-            self.store = InMemoryTaskStore()
+            self.store = InMemoryTaskStore(**result_kwargs)
         self.task_manager = LocalTaskManager(self.store)
         self.broker = None
         self.dispatchers = None

@@ -139,6 +139,14 @@ class ModelRuntime:
                 if names is None or name in names]
 
         compile_s = 0.0
+        if parallel and not jax.config.jax_compilation_cache_dir:
+            # AOT lower().compile() does NOT seed the jit dispatch cache —
+            # only the persistent compilation cache carries its work over to
+            # the run_batch pass. Without one, parallel mode would compile
+            # every program twice; serial is strictly better then.
+            log.warning("warmup: persistent compilation cache not enabled "
+                        "(enable_compilation_cache()); using serial warmup")
+            parallel = False
         if parallel and jax.process_count() == 1:
             from concurrent.futures import ThreadPoolExecutor
 

@@ -273,9 +273,12 @@ class InferenceWorker:
             failed = sum(1 for r in results if "error" in r)
             await self._store_result(taskId, json.dumps(
                 {"count": total, "failed": failed, "items": results}).encode())
+            # Never put the word "failed" in this terminal status: canonical
+            # bucketing (TaskStatus.canonical) and SDK wait() test "failed"
+            # first, so "completed - N images, 0 failed" would land every
+            # successful batch task in the failed set.
             await tm.complete_task(
-                taskId,
-                f"completed - {total} images, {failed} failed")
+                taskId, f"completed - {total} images, {failed} errors")
 
     async def _store_result(self, task_id: str, payload: bytes,
                             stage: str | None = None) -> None:

@@ -1,5 +1,6 @@
-from .task import APITask, TaskStatus, endpoint_path, new_task_id
+from .results import FileResultBackend, ResultBackend
 from .store import InMemoryTaskStore, JournaledTaskStore, TaskNotFound
+from .task import APITask, TaskStatus, endpoint_path, new_task_id
 
 __all__ = [
     "APITask",
@@ -9,4 +10,6 @@ __all__ = [
     "InMemoryTaskStore",
     "JournaledTaskStore",
     "TaskNotFound",
+    "FileResultBackend",
+    "ResultBackend",
 ]

@@ -26,16 +26,35 @@ from .task import APITask
 
 
 def make_app(store: InMemoryTaskStore,
-             app: web.Application | None = None) -> web.Application:
+             app: web.Application | None = None,
+             max_body_bytes: int = 128 * 1024 * 1024,
+             max_result_bytes: int | None = None) -> web.Application:
     """Build the task-store surface; pass ``app`` to attach the routes to an
     existing application (e.g. the gateway's, so one control-plane port
-    serves both)."""
+    serves both). ``max_body_bytes`` caps task/transition write bodies on
+    this surface (0 = unlimited): the gateway app it often rides on disables
+    aiohttp's own cap (its published routes enforce per-route edge caps
+    incrementally), so these handlers must bound their own buffering.
+    ``max_result_bytes`` caps result uploads separately — batch results are
+    the payloads the offload backend exists for and are routinely larger
+    than request bodies; None defaults to 8× the body cap."""
     if app is None:
         app = web.Application()
+    if max_result_bytes is None:
+        max_result_bytes = 8 * max_body_bytes
+
+    from ..utils.http import read_body_limited
+
+    def too_large(limit: int) -> web.Response:
+        return web.json_response(
+            {"error": f"body exceeds {limit} bytes"}, status=413)
 
     async def upsert(request: web.Request) -> web.Response:
+        raw = await read_body_limited(request, max_body_bytes)
+        if raw is None:
+            return too_large(max_body_bytes)
         try:
-            payload = json.loads(await request.read() or b"{}")
+            payload = json.loads(raw or b"{}")
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid JSON"}, status=400)
         task = APITask.from_dict(payload)
@@ -45,8 +64,11 @@ def make_app(store: InMemoryTaskStore,
         return web.json_response(store.get(task.task_id).to_dict())
 
     async def update(request: web.Request) -> web.Response:
+        raw = await read_body_limited(request, max_body_bytes)
+        if raw is None:
+            return too_large(max_body_bytes)
         try:
-            payload = json.loads(await request.read() or b"{}")
+            payload = json.loads(raw or b"{}")
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid JSON"}, status=400)
         task_id = payload.get("TaskId", "")
@@ -76,7 +98,9 @@ def make_app(store: InMemoryTaskStore,
         task_id = request.query.get("taskId", "")
         if not task_id:
             return web.json_response({"error": "taskId required"}, status=400)
-        body = await request.read()
+        body = await read_body_limited(request, max_result_bytes)
+        if body is None:
+            return too_large(max_result_bytes)
         try:
             store.set_result(task_id, body,
                              content_type=request.content_type

@@ -1,0 +1,82 @@
+"""Pluggable result backends — the object-storage slot for large outputs.
+
+The reference grants its model containers blob-storage access so batch jobs
+can write big outputs outside the task record
+(``APIs/helpers/assign_storage_auth_to_aks.sh:9-17`` assigns Storage Blob Data
+Contributor to the AKS identity). Here the same slot is a small interface the
+task store routes large results through instead of holding them in memory:
+
+- ``FileResultBackend`` — filesystem-rooted implementation. Locally that's a
+  directory; in a GKE deployment the root is a mounted GCS FUSE volume or PD,
+  which is exactly how the charts mount the checkpoint store
+  (``deploy/charts/checkpoints-pvc.yaml``). A native GCS client would be a
+  third implementation of the same two methods; the store doesn't care.
+
+Keys are ``{task_id}`` or ``{task_id}:{stage}``; the backend maps them to
+filesystem-safe names itself.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class ResultBackend:
+    """Interface: durable blob storage for task results."""
+
+    def put(self, key: str, data: bytes, content_type: str) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> tuple[bytes, str] | None:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+
+class FileResultBackend(ResultBackend):
+    """Results as files under a root directory (local dir, PD mount, or GCS
+    FUSE mount). Each result is two files: ``{name}.bin`` (payload) and
+    ``{name}.meta`` (content type), written tmp+rename so a crashed write
+    never leaves a half-result readable."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _name(self, key: str) -> str:
+        # Injective escaping: task ids are GUID hex but the stage suffix is
+        # free-form ("/", ":", ...); a lossy substitution would let two
+        # stages collide on one file and silently overwrite each other.
+        from urllib.parse import quote
+        return quote(key, safe="")
+
+    def put(self, key: str, data: bytes, content_type: str) -> None:
+        name = self._name(key)
+        for suffix, payload in ((".bin", data),
+                                (".meta", content_type.encode())):
+            tmp = os.path.join(self.root, name + suffix + ".tmp")
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self.root, name + suffix))
+
+    def get(self, key: str) -> tuple[bytes, str] | None:
+        name = self._name(key)
+        try:
+            with open(os.path.join(self.root, name + ".bin"), "rb") as f:
+                data = f.read()
+            with open(os.path.join(self.root, name + ".meta"), "rb") as f:
+                content_type = f.read().decode()
+        except FileNotFoundError:
+            return None
+        return data, content_type
+
+    def delete(self, key: str) -> None:
+        name = self._name(key)
+        for suffix in (".bin", ".meta"):
+            try:
+                os.unlink(os.path.join(self.root, name + suffix))
+            except FileNotFoundError:
+                pass

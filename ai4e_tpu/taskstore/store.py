@@ -90,7 +90,9 @@ class InMemoryTaskStore(StoreSideEffects):
     functions.
     """
 
-    def __init__(self, publisher: Publisher | None = None):
+    def __init__(self, publisher: Publisher | None = None,
+                 result_backend=None,
+                 result_offload_threshold: int | None = None):
         self._lock = threading.RLock()
         self._tasks: dict[str, APITask] = {}
         # task_id -> (body, content_type): the replay record. Content type
@@ -99,7 +101,13 @@ class InMemoryTaskStore(StoreSideEffects):
         # its original type — a JPEG replayed as application/json would be
         # undecodable downstream.
         self._orig_bodies: dict[str, tuple[bytes, str]] = {}
-        self._results: dict[str, tuple[bytes, str]] = {}
+        # key -> (payload, content_type); payload None means the bytes live
+        # in the result backend (the blob-storage slot,
+        # assign_storage_auth_to_aks.sh:9-17) — only the pointer is held here,
+        # so completed-task memory doesn't grow with large batch outputs.
+        self._results: dict[str, tuple[bytes | None, str]] = {}
+        self._result_backend = result_backend
+        self._result_offload_threshold = result_offload_threshold
         # (endpoint_path, canonical_status) -> {task_id: score}; insertion
         # ordered + scored like the reference's Redis sorted sets.
         self._sets: dict[tuple[str, str], dict[str, float]] = {}
@@ -241,16 +249,66 @@ class InMemoryTaskStore(StoreSideEffects):
         retrievable under the shared TaskId, analogous to the reference
         keeping ``{taskId}_ORIG`` alongside the task (``CacheConnectorUpsert.cs:158``)."""
         key = task_id if stage is None else f"{task_id}:{stage}"
-        with self._lock:
-            if task_id not in self._tasks:
-                raise TaskNotFound(task_id)
-            self._results[key] = (result, content_type)
+        offload = (self._result_backend is not None
+                   and self._result_offload_threshold is not None
+                   and len(result) >= self._result_offload_threshold)
+        if offload:
+            # Write the blob BEFORE taking the lock (it may be slow storage)
+            # and before the pointer becomes visible — a reader that sees the
+            # pointer must always find the blob.
+            self._result_backend.put(key, result, content_type)
+        try:
+            with self._lock:
+                if task_id not in self._tasks:
+                    raise TaskNotFound(task_id)
+                self._apply_set_result(key, None if offload else result,
+                                       content_type)
+        except Exception:
+            if offload:
+                # The pointer never became visible (unknown/reaped task,
+                # closed store): reap the just-written blob or it leaks on
+                # the mount forever.
+                self._delete_blob(key)
+            raise
+
+    def _apply_set_result(self, key: str, result: bytes | None,
+                          content_type: str) -> None:
+        """Result mutation (``result is None`` = offloaded pointer). Caller
+        holds ``self._lock``; the journaled subclass extends this."""
+        prev = self._results.get(key)
+        self._results[key] = (result, content_type)
+        if (prev is not None and prev[0] is None and result is not None):
+            # An inline value superseded an offloaded pointer — the stale
+            # blob is unreachable now; delete it. (Pointer→pointer rewrites
+            # overwrite the same blob file in put().)
+            self._delete_blob(key)
+
+    def _delete_blob(self, key: str) -> None:
+        if self._result_backend is None:
+            return
+        try:
+            self._result_backend.delete(key)
+        except Exception:  # noqa: BLE001 — cleanup must not mask the result path
+            import logging
+            logging.getLogger("ai4e_tpu.taskstore").exception(
+                "could not delete result blob %s", key)
 
     def get_result(self, task_id: str,
                    stage: str | None = None) -> tuple[bytes, str] | None:
         key = task_id if stage is None else f"{task_id}:{stage}"
         with self._lock:
-            return self._results.get(key)
+            found = self._results.get(key)
+        if found is None:
+            return None
+        body, content_type = found
+        if body is None:  # offloaded — fetch from the backend outside the lock
+            if self._result_backend is None:
+                return None  # unreachable after replay's fail-fast; be safe
+            fetched = self._result_backend.get(key)
+            if fetched is None:
+                return None
+            return fetched
+        return body, content_type
 
     # -- status-set queries (queue-depth metrics, QueueLogger.cs:21-47) ----
 
@@ -319,8 +377,10 @@ class JournaledTaskStore(InMemoryTaskStore):
     """
 
     def __init__(self, journal_path: str, publisher: Publisher | None = None,
-                 compact_every: int = 5000):
-        super().__init__(publisher)
+                 compact_every: int = 5000, result_backend=None,
+                 result_offload_threshold: int | None = None):
+        super().__init__(publisher, result_backend=result_backend,
+                         result_offload_threshold=result_offload_threshold)
         self._journal_path = journal_path
         self._journal = None  # gate journaling off during replay
         self._closed = False
@@ -341,7 +401,7 @@ class JournaledTaskStore(InMemoryTaskStore):
             # the journal is meaningfully bloated — a strictly-greater test
             # would rewrite (and fsync) the whole journal on nearly every
             # restart for a negligible win.
-            if self._records > 2 * max(len(self._tasks), 1):
+            if self._records > 2 * max(self._live_records(), 1):
                 self._compact_locked()
         if self._journal is None:
             self._journal = open(journal_path, "a",  # noqa: SIM115
@@ -355,6 +415,23 @@ class JournaledTaskStore(InMemoryTaskStore):
                     continue
                 rec = json.loads(line)
                 self._records += 1
+                if rec.get("Result"):
+                    # Result record: inline payload as hex, or an offloaded
+                    # pointer whose bytes are durable in the backend itself.
+                    if rec.get("Offloaded") and self._result_backend is None:
+                        # Fail FAST: replaying the pointer without a backend
+                        # would serve "completed, no result" — restore the
+                        # store's result_dir config instead.
+                        raise RuntimeError(
+                            f"journal references offloaded result "
+                            f"{rec['Key']!r} but no result backend is "
+                            f"configured (set result_dir to the same mount "
+                            f"it was written to)")
+                    body = (None if rec.get("Offloaded")
+                            else bytes.fromhex(rec.get("ResultHex", "")))
+                    self._results[rec["Key"]] = (
+                        body, rec.get("ContentType", "application/json"))
+                    continue
                 if rec.get("Slim"):
                     # Transition record: body/orig state is untouched (they
                     # ride only on upserts), exactly like the live mutation;
@@ -405,11 +482,17 @@ class JournaledTaskStore(InMemoryTaskStore):
             rec["Slim"] = True
         else:
             rec = self._full_record(task)
+        self._append(rec)
+
+    def _append(self, rec: dict) -> None:
+        # Called with self._lock held; shared by task and result records.
+        if self._journal is None:
+            return
         self._journal.write(json.dumps(rec) + "\n")
         self._journal.flush()
         self._records += 1
         if (self._records >= self._next_compact_at
-                and self._records > 2 * len(self._tasks)):
+                and self._records > 2 * self._live_records()):
             # The append above already made this mutation durable; a failed
             # rewrite (disk full) must not surface as an error for — or
             # skip the notify/publish of — a transition that succeeded. And
@@ -437,17 +520,23 @@ class JournaledTaskStore(InMemoryTaskStore):
         return rec
 
     def _compact_locked(self) -> None:
-        """Rewrite the journal as one full record per live task. Caller holds
-        ``self._lock`` (or is still single-threaded in __init__). Failure at
-        ANY point leaves the store on a valid journal: the replacement file
-        is fully written and its handle opened before the atomic rename, and
-        the old handle is closed only after the swap succeeds."""
+        """Rewrite the journal as one full record per live task (+ one per
+        result). Caller holds ``self._lock`` (or is still single-threaded in
+        __init__). Failure at ANY point leaves the store on a valid journal:
+        the replacement file is fully written and its handle opened before
+        the atomic rename, and the old handle is closed only after the swap
+        succeeds."""
         tmp = self._journal_path + ".compact"
         new_journal = None
         try:
             with open(tmp, "w", encoding="utf-8") as f:
                 for task in self._tasks.values():
                     f.write(json.dumps(self._full_record(task)) + "\n")
+                # Tasks first, then results — replay applies them in file
+                # order and a result's task record must already exist.
+                for key, (body, ctype) in self._results.items():
+                    f.write(json.dumps(self._result_record(
+                        key, body, ctype)) + "\n")
                 f.flush()
                 os.fsync(f.fileno())
             # Open the append handle on the tmp file BEFORE the rename: the
@@ -466,7 +555,7 @@ class JournaledTaskStore(InMemoryTaskStore):
             raise
         old = self._journal
         self._journal = new_journal
-        self._records = len(self._tasks)
+        self._records = len(self._tasks) + len(self._results)
         if old is not None:
             old.close()
 
@@ -476,6 +565,33 @@ class JournaledTaskStore(InMemoryTaskStore):
         with self._lock:
             self._check_open()
             self._compact_locked()
+
+    def _live_records(self) -> int:
+        """Journal records a fully-compacted journal would hold — the
+        bloat denominator for the compaction heuristics."""
+        return len(self._tasks) + len(self._results)
+
+    def _result_record(self, key: str, body: bytes | None,
+                       content_type: str) -> dict:
+        rec = {"Result": True, "Key": key, "ContentType": content_type}
+        if body is None:
+            # Offloaded: the payload is durable in the result backend; the
+            # journal carries only the pointer (no hex-doubling of large
+            # blobs — offload exists precisely to keep them out of memory
+            # and out of the journal).
+            rec["Offloaded"] = True
+        else:
+            rec["ResultHex"] = body.hex()
+        return rec
+
+    def _apply_set_result(self, key: str, result: bytes | None,
+                          content_type: str) -> None:
+        # Journal the result so a completed task survives restart WITH its
+        # payload — without this a replayed task would report completed
+        # while its result is gone (a worse lie than losing the task).
+        self._check_open()
+        super()._apply_set_result(key, result, content_type)
+        self._append(self._result_record(key, result, content_type))
 
     def _apply_upsert(self, task: APITask) -> APITask:
         self._check_open()

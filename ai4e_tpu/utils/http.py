@@ -1,10 +1,34 @@
-"""Shared aiohttp client-session management."""
+"""Shared aiohttp client-session management and capped body reads."""
 
 from __future__ import annotations
 
 import asyncio
 
 import aiohttp
+
+
+async def read_body_limited(request, limit: int) -> bytes | None:
+    """Request body within ``limit`` bytes, else None (callers answer 413).
+    0 = unlimited. Checks the declared length first (cheap refusal), then
+    reads the stream INCREMENTALLY and aborts the moment the running total
+    exceeds the cap — a chunked body with no declared length must never
+    buffer more than limit+chunk bytes. Shared by the gateway's edge caps
+    and the task-store surface (both ride apps whose aiohttp cap is
+    disabled)."""
+    if not limit:
+        return await request.read()
+    if (request.content_length or 0) > limit:
+        return None
+    chunks: list[bytes] = []
+    total = 0
+    while True:
+        chunk = await request.content.readany()
+        if not chunk:
+            return b"".join(chunks)
+        total += len(chunk)
+        if total > limit:
+            return None
+        chunks.append(chunk)
 
 
 class SessionHolder:

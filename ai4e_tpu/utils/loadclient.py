@@ -79,12 +79,15 @@ async def run_closed_loop(
                     KeyError, TypeError):
                 failed += 1
                 return
+            # "failed" FIRST — the platform's canonical bucketing
+            # (TaskStatus.canonical) tests it first, so a status carrying
+            # both words counts the same here as in the store's sets.
+            if "failed" in status:
+                failed += 1
+                return
             if "completed" in status:
                 latencies.append(time.perf_counter() - t0)
                 completed += 1
-                return
-            if "failed" in status:
-                failed += 1
                 return
             if time.perf_counter() > deadline:  # stuck task: don't hang the run
                 failed += 1
@@ -123,25 +126,39 @@ async def run_closed_loop(
     # land inside the measured window). In-flight work at the open and
     # close of the window cancels to first order.
     mark: dict = {}
+    close: dict = {}
 
     async def open_window() -> None:
         await asyncio.sleep(ramp)
         mark.update(t=time.perf_counter(), completed=completed,
                     failed=failed, n_lat=len(latencies))
 
-    stop_at = time.perf_counter() + ramp + duration
-    await asyncio.gather(open_window(),
-                         *[client_loop(stop_at) for _ in range(concurrency)])
-    elapsed = time.perf_counter() - mark["t"]
+    async def close_window() -> None:
+        # Snapshot AT stop_at, not after the drain: gather() returns only
+        # once every in-flight request resolves, and a single stuck task
+        # would stretch the denominator by up to task_timeout with no
+        # completions — deflating throughput several-fold.
+        await asyncio.sleep(ramp + duration)
+        close.update(t=time.perf_counter(), completed=completed,
+                     failed=failed, n_lat=len(latencies))
 
-    window_lat = sorted(latencies[mark["n_lat"]:]) or [0.0]
-    n = completed - mark["completed"]
+    stop_at = time.perf_counter() + ramp + duration
+    await asyncio.gather(open_window(), close_window(),
+                         *[client_loop(stop_at) for _ in range(concurrency)])
+    elapsed = close["t"] - mark["t"]
+
+    window_lat = sorted(latencies[mark["n_lat"]:close["n_lat"]]) or [0.0]
+    n = close["completed"] - mark["completed"]
+
+    def pctl(q: float) -> float:
+        return round(window_lat[max(0, int(len(window_lat) * q) - 1)] * 1000, 1)
+
     return {
         "value": round(n / elapsed, 2),
         "p50_latency_ms": round(window_lat[len(window_lat) // 2] * 1000, 1),
-        "p95_latency_ms": round(
-            window_lat[max(0, int(len(window_lat) * 0.95) - 1)] * 1000, 1),
+        "p95_latency_ms": pctl(0.95),
+        "p99_latency_ms": pctl(0.99),
         "completed": n,
-        "failed": failed - mark["failed"],
+        "failed": close["failed"] - mark["failed"],
         "duration_s": round(elapsed, 1),
     }

@@ -311,3 +311,154 @@ class TestJournalGrowth:
         assert "39" in revived.get(t.task_id).status
         assert revived.get_original_body(t.task_id) == b"y"
         revived.close()
+
+
+class TestDurableResults:
+    """VERDICT r2 #4: completed tasks must survive restart WITH their results,
+    and large results must route to the object-store slot instead of store
+    memory (the reference's blob-storage role,
+    ``APIs/helpers/assign_storage_auth_to_aks.sh:9-17``)."""
+
+    def test_results_survive_restart(self, tmp_path):
+        journal = str(tmp_path / "r.jsonl")
+        store = JournaledTaskStore(journal)
+        t = store.upsert(make_task())
+        store.update_status(t.task_id, "completed - done")
+        store.set_result(t.task_id, b'{"animals": 3}')
+        store.set_result(t.task_id, b"stage-out", stage="detector")
+        store.close()
+
+        revived = JournaledTaskStore(journal)
+        assert revived.get(t.task_id).canonical_status == "completed"
+        assert revived.get_result(t.task_id) == (b'{"animals": 3}',
+                                                 "application/json")
+        assert revived.get_result(t.task_id, stage="detector") == (
+            b"stage-out", "application/json")
+        revived.close()
+
+    def test_large_result_offloads_to_backend(self, tmp_path):
+        from ai4e_tpu.taskstore import FileResultBackend
+
+        backend = FileResultBackend(str(tmp_path / "blobs"))
+        store = InMemoryTaskStore(result_backend=backend,
+                                  result_offload_threshold=1024)
+        t = store.upsert(make_task())
+        big = b"\x42" * 4096
+        store.set_result(t.task_id, big, content_type="application/octet-stream")
+        # Memory holds only the pointer; the payload is in the backend.
+        assert store._results[t.task_id][0] is None
+        assert backend.get(t.task_id) == (big, "application/octet-stream")
+        # The read surface is unchanged.
+        assert store.get_result(t.task_id) == (big, "application/octet-stream")
+
+    def test_small_result_stays_inline(self, tmp_path):
+        from ai4e_tpu.taskstore import FileResultBackend
+
+        backend = FileResultBackend(str(tmp_path / "blobs"))
+        store = InMemoryTaskStore(result_backend=backend,
+                                  result_offload_threshold=1024)
+        t = store.upsert(make_task())
+        store.set_result(t.task_id, b"tiny")
+        assert store._results[t.task_id][0] == b"tiny"
+        assert backend.get(t.task_id) is None
+
+    def test_offloaded_result_survives_restart(self, tmp_path):
+        from ai4e_tpu.taskstore import FileResultBackend
+
+        journal = str(tmp_path / "r.jsonl")
+        blobs = str(tmp_path / "blobs")
+        store = JournaledTaskStore(journal,
+                                   result_backend=FileResultBackend(blobs),
+                                   result_offload_threshold=1024)
+        t = store.upsert(make_task())
+        big = b"\x7f" * 8192
+        store.set_result(t.task_id, big, content_type="image/png")
+        store.close()
+        # The journal holds a pointer, not the blob (no hex-doubling).
+        import os
+        assert os.path.getsize(journal) < 4096
+
+        revived = JournaledTaskStore(journal,
+                                     result_backend=FileResultBackend(blobs),
+                                     result_offload_threshold=1024)
+        assert revived.get_result(t.task_id) == (big, "image/png")
+        revived.close()
+
+    def test_compaction_preserves_results(self, tmp_path):
+        journal = str(tmp_path / "c.jsonl")
+        store = JournaledTaskStore(journal)
+        t = store.upsert(make_task())
+        store.set_result(t.task_id, b"keep me")
+        for i in range(20):
+            store.update_status(t.task_id, f"running - {i}")
+        store.compact()
+        store.close()
+
+        revived = JournaledTaskStore(journal)
+        assert revived.get_result(t.task_id) == (b"keep me",
+                                                 "application/json")
+        revived.close()
+
+    def test_stage_key_is_filesystem_safe(self, tmp_path):
+        from ai4e_tpu.taskstore import FileResultBackend
+
+        backend = FileResultBackend(str(tmp_path / "blobs"))
+        store = InMemoryTaskStore(result_backend=backend,
+                                  result_offload_threshold=0)
+        t = store.upsert(make_task())
+        store.set_result(t.task_id, b"x" * 10, stage="v1/detect")
+        assert store.get_result(t.task_id, stage="v1/detect") == (
+            b"x" * 10, "application/json")
+
+    def test_unknown_task_offload_leaves_no_orphan_blob(self, tmp_path):
+        import os
+
+        from ai4e_tpu.taskstore import FileResultBackend
+
+        blobs = str(tmp_path / "blobs")
+        store = InMemoryTaskStore(result_backend=FileResultBackend(blobs),
+                                  result_offload_threshold=0)
+        with pytest.raises(TaskNotFound):
+            store.set_result("no-such-task", b"x" * 64)
+        assert os.listdir(blobs) == []
+
+    def test_distinct_stage_keys_do_not_collide(self, tmp_path):
+        from ai4e_tpu.taskstore import FileResultBackend
+
+        backend = FileResultBackend(str(tmp_path / "blobs"))
+        store = InMemoryTaskStore(result_backend=backend,
+                                  result_offload_threshold=0)
+        t = store.upsert(make_task())
+        store.set_result(t.task_id, b"slash", stage="x/y")
+        store.set_result(t.task_id, b"under", stage="x_y")
+        assert store.get_result(t.task_id, stage="x/y")[0] == b"slash"
+        assert store.get_result(t.task_id, stage="x_y")[0] == b"under"
+
+    def test_inline_rewrite_deletes_stale_blob(self, tmp_path):
+        import os
+
+        from ai4e_tpu.taskstore import FileResultBackend
+
+        blobs = str(tmp_path / "blobs")
+        store = InMemoryTaskStore(result_backend=FileResultBackend(blobs),
+                                  result_offload_threshold=100)
+        t = store.upsert(make_task())
+        store.set_result(t.task_id, b"B" * 200)      # offloaded
+        assert len(os.listdir(blobs)) == 2
+        store.set_result(t.task_id, b"small")        # superseded inline
+        assert os.listdir(blobs) == []
+        assert store.get_result(t.task_id)[0] == b"small"
+
+    def test_replay_of_offloaded_pointer_without_backend_fails_fast(
+            self, tmp_path):
+        from ai4e_tpu.taskstore import FileResultBackend
+
+        journal = str(tmp_path / "j.jsonl")
+        store = JournaledTaskStore(
+            journal, result_backend=FileResultBackend(str(tmp_path / "b")),
+            result_offload_threshold=0)
+        t = store.upsert(make_task())
+        store.set_result(t.task_id, b"blob-bytes")
+        store.close()
+        with pytest.raises(RuntimeError, match="offloaded result"):
+            JournaledTaskStore(journal)  # no backend configured

@@ -150,3 +150,51 @@ class TestDepthsEndpoint:
                 await client.close()
 
         run(main())
+
+
+class TestBodyCap:
+    """ADVICE r2 (medium): the taskstore surface often rides the gateway app,
+    whose aiohttp cap is disabled — these handlers must bound their own
+    buffering and refuse oversized writes with 413."""
+
+    def test_oversized_result_rejected(self):
+        store = InMemoryTaskStore()
+
+        async def main():
+            client = TestClient(TestServer(make_app(store,
+                                                    max_body_bytes=1024,
+                                                    max_result_bytes=2048)))
+            await client.start_server()
+            try:
+                t = store.upsert(
+                    __import__("ai4e_tpu.taskstore", fromlist=["APITask"])
+                    .APITask(endpoint="http://h/v1/api", body=b"x"))
+                resp = await client.post(
+                    f"/v1/taskstore/result?taskId={t.task_id}",
+                    data=b"\x00" * 4096)
+                assert resp.status == 413
+                assert store.get_result(t.task_id) is None
+                # Within the cap still works.
+                resp = await client.post(
+                    f"/v1/taskstore/result?taskId={t.task_id}", data=b"ok")
+                assert resp.status == 200
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_oversized_upsert_rejected(self):
+        store = InMemoryTaskStore()
+
+        async def main():
+            client = TestClient(TestServer(make_app(store,
+                                                    max_body_bytes=512)))
+            await client.start_server()
+            try:
+                resp = await client.post("/v1/taskstore/upsert",
+                                         data=b"{" + b" " * 2048 + b"}")
+                assert resp.status == 413
+            finally:
+                await client.close()
+
+        run(main())
