@@ -74,6 +74,19 @@ def build_control_plane(config: FrameworkConfig, routes: dict):
                 "AI4E_GATEWAY_API_KEYS is set but contains no keys")
         platform.gateway.set_api_keys(keys)
     platform.gateway.max_body_bytes = config.gateway.max_body_bytes
+    if config.gateway.rate_limit_rps or config.gateway.rate_limits:
+        from .gateway.ratelimit import (RateLimit, RateLimiter,
+                                        parse_rate_limits)
+        per_key = parse_rate_limits(config.gateway.rate_limits or "")
+        if config.gateway.rate_limit_rps:
+            default = RateLimit(rps=config.gateway.rate_limit_rps,
+                                burst=config.gateway.rate_limit_burst)
+        else:
+            # Only per-key limits were given: keys without one stay
+            # unlimited (a very high default bucket).
+            default = RateLimit(rps=1e9)
+        platform.gateway.set_rate_limiter(RateLimiter(default,
+                                                      per_key=per_key))
     # The task-store HTTP surface rides on the gateway app — one
     # control-plane port serves the CACHE_CONNECTOR_*_URI endpoints remote
     # workers use (distributed_api_task.py:14-15 pattern). It enforces the

@@ -221,6 +221,12 @@ class GatewaySection:
     # Separate cap for result uploads on the task-store surface — batch
     # results are routinely larger than request bodies. 0 = unlimited.
     max_result_bytes: int = 1073741824
+    # Per-key request-rate throttle on the published surface (the APIM
+    # product-throttling slot). 0 disables; burst 0 → 2×rps.
+    rate_limit_rps: float = 0.0
+    rate_limit_burst: float = 0.0
+    # Per-key overrides: "key=rps[:burst],..." (gateway/ratelimit.py).
+    rate_limits: typing.Optional[str] = None
 
 
 @_env_section("AI4E_OBSERVABILITY_")

@@ -1,0 +1,104 @@
+"""Per-subscription-key rate limiting — the APIM product-throttling slot.
+
+The reference publishes its APIs behind Azure API Management subscriptions;
+APIM products carry request-rate throttling per subscription key alongside
+the key auth itself. The gateway here had the auth
+(``gateway/router.py`` subscription-key middleware) but any valid key got
+unlimited rate. This module is the missing throttle: a token bucket per key,
+refilled continuously, answering 429 + ``Retry-After`` when drained — the
+same contract the platform's own backpressure uses everywhere else
+(dispatcher 429 handling, ``BackendQueueProcessor.cs:54-64``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class RateLimit:
+    """``rps`` sustained requests/second; ``burst`` bucket capacity (how far
+    above the sustained rate a key may spike)."""
+
+    rps: float
+    burst: float = 0.0
+
+    def __post_init__(self):
+        if self.rps <= 0:
+            raise ValueError(f"rps must be positive, got {self.rps}")
+        if self.burst <= 0:
+            self.burst = max(2.0 * self.rps, 1.0)
+
+
+class RateLimiter:
+    """Token buckets keyed by subscription key (or any caller identity).
+
+    Single-threaded by design: the gateway's middleware calls ``allow`` on
+    the event loop with no awaits in between, so no lock is needed. Buckets
+    are created lazily per key and pruned when idle long enough to be full
+    again (bounded memory under key churn).
+    """
+
+    def __init__(self, default: RateLimit,
+                 per_key: dict[str, RateLimit] | None = None,
+                 clock=time.monotonic):
+        self.default = default
+        self.per_key = dict(per_key or {})
+        self._clock = clock
+        # key -> [tokens, last_refill_ts]
+        self._buckets: dict[str, list[float]] = {}
+        self._last_prune = clock()
+
+    def limit_for(self, key: str) -> RateLimit:
+        return self.per_key.get(key, self.default)
+
+    def allow(self, key: str) -> tuple[bool, float]:
+        """Take one token from ``key``'s bucket. Returns ``(allowed,
+        retry_after_seconds)`` — ``retry_after`` is 0 when allowed, else the
+        time until one token accrues (the ``Retry-After`` header value)."""
+        limit = self.limit_for(key)
+        now = self._clock()
+        if now - self._last_prune > 60.0:
+            self._prune(now)
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            bucket = self._buckets[key] = [limit.burst, now]
+        tokens, last = bucket
+        tokens = min(limit.burst, tokens + (now - last) * limit.rps)
+        if tokens >= 1.0:
+            bucket[0] = tokens - 1.0
+            bucket[1] = now
+            return True, 0.0
+        bucket[0] = tokens
+        bucket[1] = now
+        return False, (1.0 - tokens) / limit.rps
+
+    def _prune(self, now: float) -> None:
+        """Drop buckets idle long enough to be full — indistinguishable from
+        fresh ones, so dropping them changes nothing but memory."""
+        self._last_prune = now
+        full_after = {key: (self.limit_for(key).burst
+                            / self.limit_for(key).rps)
+                      for key in self._buckets}
+        self._buckets = {
+            key: bucket for key, bucket in self._buckets.items()
+            if now - bucket[1] < full_after[key]}
+
+
+def parse_rate_limits(spec: str) -> dict[str, RateLimit]:
+    """Parse per-key overrides from config: ``key=rps[:burst],...``
+    (e.g. ``"partner-key=50:100,free-tier=2"``)."""
+    out: dict[str, RateLimit] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, rate = part.partition("=")
+        if not key or not rate:
+            raise ValueError(f"bad rate-limit entry {part!r}; "
+                             "expected key=rps[:burst]")
+        rps, _, burst = rate.partition(":")
+        out[key.strip()] = RateLimit(rps=float(rps),
+                                     burst=float(burst) if burst else 0.0)
+    return out

@@ -68,6 +68,8 @@ class Gateway:
         # Subscription-key auth (the reference's APIM front door requires
         # Ocp-Apim-Subscription-Key on every published API). None → open.
         self._api_keys = set(api_keys) if api_keys else None
+        # Per-key rate limiting (APIM product throttling); None → unlimited.
+        self._rate_limiter = None
         if hasattr(store, "add_listener"):
             store.add_listener(self._on_task_change)
 
@@ -85,6 +87,15 @@ class Gateway:
         """Enable (or clear) subscription-key auth on the public surface."""
         self._api_keys = set(keys) if keys else None
 
+    def set_rate_limiter(self, limiter) -> None:
+        """Enable (or clear with None) per-key request-rate throttling on
+        the published surface — the APIM product-throttling slot
+        (``gateway/ratelimit.py``). Applies to published APIs and task
+        polling; NOT to the internal task-store surface riding this app
+        (throttling workers' status updates would stall the data plane the
+        limiter is protecting)."""
+        self._rate_limiter = limiter
+
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
         """Subscription-key gate — the APIM front-door behavior (every
@@ -95,17 +106,34 @@ class Gateway:
         same task data the 401 just protected); workers attach the key via
         ``AI4E_SERVICE_TASKSTORE_API_KEY``.
         """
-        if self._api_keys is not None:
-            if request.path not in ("/healthz", "/metrics"):
-                key = (request.headers.get("Ocp-Apim-Subscription-Key")
-                       or request.headers.get("X-Api-Key"))
-                if key not in self._api_keys:
-                    # Constant label: the path is attacker-chosen and would
-                    # grow metric cardinality without bound.
-                    self._requests.inc(route="unauthorized", outcome="401")
-                    return web.json_response(
-                        {"error": "missing or invalid subscription key"},
-                        status=401)
+        exempt = (request.path in ("/healthz", "/metrics"))
+        key = (request.headers.get("Ocp-Apim-Subscription-Key")
+               or request.headers.get("X-Api-Key"))
+        if self._api_keys is not None and not exempt:
+            if key not in self._api_keys:
+                # Constant label: the path is attacker-chosen and would
+                # grow metric cardinality without bound.
+                self._requests.inc(route="unauthorized", outcome="401")
+                return web.json_response(
+                    {"error": "missing or invalid subscription key"},
+                    status=401)
+        if (self._rate_limiter is not None and not exempt
+                and not request.path.startswith("/v1/taskstore/")):
+            # Bucket by the subscription key ONLY when auth validated it
+            # (above) — with auth off the header is attacker-chosen and
+            # rotating it would mint a fresh bucket per request; bucket by
+            # caller address instead.
+            identity = (key if self._api_keys is not None
+                        else (request.remote or "anonymous"))
+            allowed, retry_after = self._rate_limiter.allow(identity)
+            if not allowed:
+                import math
+                self._requests.inc(route="throttled", outcome="429")
+                return web.json_response(
+                    {"error": "rate limit exceeded"}, status=429,
+                    # RFC 7231 delta-seconds: integer, minimum 1.
+                    headers={"Retry-After":
+                             str(max(1, math.ceil(retry_after)))})
         return await handler(request)
 
     def add_async_route(self, prefix: str, task_endpoint: str,

@@ -269,8 +269,17 @@ class MultihostRuntime:
     # -- primary side (called by the micro-batcher's executor thread) -------
 
     def run_batch(self, model_name: str, batch: np.ndarray):
+        return self.run_batch_report(model_name, batch)[0]
+
+    def run_batch_report(self, model_name: str, batch: np.ndarray
+                         ) -> tuple[object, frozenset]:
+        """Execute one batch; returns ``(outputs, poisoned_rows)`` where
+        ``poisoned_rows`` are global dim-0 indices whose results are invalid
+        because a follower degraded (fetch failure → zeros shard, or a
+        follower-local execution failure). The batcher fails exactly those
+        tasks instead of serving confidently wrong results (VERDICT r2 #5)."""
         if jax.process_count() == 1:
-            return self.runtime.run_batch(model_name, batch)
+            return self.runtime.run_batch(model_name, batch), frozenset()
         if not is_primary():
             raise RuntimeError(
                 "run_batch on a follower host — followers run follower_loop()")
@@ -294,7 +303,20 @@ class MultihostRuntime:
             garr = self._assemble(model_name, batch.shape, batch.dtype,
                                   lambda a, b: batch[a:b])
             self.last_ingest_s = time.perf_counter() - t0
-            return self.runtime.run_batch(model_name, garr)
+            try:
+                out = self.runtime.run_batch(model_name, garr)
+            finally:
+                # The health gather must run even when the primary's own
+                # execution raised: followers enter it unconditionally, and
+                # a primary that skipped it would leave the slice's
+                # collectives misaligned from here on.
+                flags = self._gather_poison(0)
+            poisoned: set[int] = set()
+            for proc, flag in enumerate(flags):
+                if flag:
+                    for a, b in plan.get(proc, []):
+                        poisoned.update(range(a, b))
+            return out, frozenset(poisoned)
 
     def shutdown_followers(self) -> None:
         if jax.process_count() > 1 and is_primary():
@@ -323,6 +345,7 @@ class MultihostRuntime:
             for a, b in ranges:
                 offsets[(a, b)] = at
                 at += b - a
+            poisoned = 0
             try:
                 raw = (_fetch(f"{self._feed_url}/shard/{seq}/{me}",
                               self._feed_token)
@@ -335,15 +358,17 @@ class MultihostRuntime:
                 # Every process must still enter the same compiled call or
                 # the primary's next collective waits on a missing
                 # participant and the whole slice deadlocks. Degrade to a
-                # zeros shard: this follower's rows of THIS batch come back
-                # wrong (surfaced loudly here; the affected tasks fail or
-                # mis-score), but the slice lives and the next batch heals.
+                # zeros shard — the slice lives — and report the poison on
+                # the post-batch health gather so the primary FAILS this
+                # follower's rows instead of serving zeros-scored results
+                # (VERDICT r2 #5).
                 log.exception(
                     "follower %d: shard fetch for %s seq %d failed; running "
                     "with a ZEROS shard to keep the slice in lockstep — "
-                    "results for this batch's local rows are invalid",
+                    "reporting these rows poisoned",
                     me, name, seq)
                 rows = np.zeros((at, *shape[1:]), dtype)
+                poisoned = 1
 
             def lookup(a, b):
                 o = offsets[(a, b)]
@@ -357,9 +382,25 @@ class MultihostRuntime:
                 # The primary catches the same device failure and keeps
                 # serving (MicroBatcher._execute); a follower that died here
                 # would leave the next broadcast waiting on a missing
-                # participant and hang the whole slice.
+                # participant and hang the whole slice. Its local rows are
+                # garbage though — say so on the health gather.
                 log.exception("follower %d: batch for %s failed; continuing",
                               me, name)
+                poisoned = 1
+            self._gather_poison(poisoned)
+
+    # -- post-batch health gather -------------------------------------------
+
+    def _gather_poison(self, my_flag: int) -> np.ndarray:
+        """All-gather one int per process after every batch: 1 = this
+        process's local rows are invalid (fetch degraded to zeros, or local
+        execution failed). Costs one tiny DCN collective per batch — the
+        price of never returning confidently wrong results. Returns the
+        per-process flags, indexed by process id."""
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            np.asarray([my_flag], np.int32))
+        return np.asarray(flags).reshape(-1)
 
     # -- wire (descriptor: XLA collective; payload: shard feed) --------------
 

@@ -253,9 +253,18 @@ class MicroBatcher:
             padded[i] = p.example
 
         t0 = time.perf_counter()
+        # run_batch_report surfaces rows a degraded follower invalidated
+        # (multihost zeros-shard path); plain run_batch is the fallback for
+        # duck-typed runtimes without one.
+        runner = getattr(self.runtime, "run_batch_report", None)
         try:
-            outputs = await loop.run_in_executor(
-                self._executor, self.runtime.run_batch, model_name, padded)
+            if runner is not None:
+                outputs, poisoned = await loop.run_in_executor(
+                    self._executor, runner, model_name, padded)
+            else:
+                outputs = await loop.run_in_executor(
+                    self._executor, self.runtime.run_batch, model_name, padded)
+                poisoned = frozenset()
         except Exception as exc:  # noqa: BLE001 — device failure fails the batch
             log.exception("batch execution failed for %s", model_name)
             for p in batch:
@@ -264,6 +273,18 @@ class MicroBatcher:
             return
         self._batch_latency.observe(time.perf_counter() - t0, model=model_name)
         self._batch_size_hist.observe(n, model=model_name)
+        if poisoned:
+            # Fail exactly the affected tasks — their rows ran on a zeros
+            # shard (or a failed follower) and any "result" would be a
+            # confidently wrong answer; the batch's other rows are good.
+            log.error("batch for %s: %d of %d rows poisoned by a degraded "
+                      "host; failing those tasks", model_name,
+                      sum(1 for i in range(n) if i in poisoned), n)
+            for i, p in enumerate(batch):
+                if i in poisoned and not p.future.done():
+                    p.future.set_exception(RuntimeError(
+                        "result invalidated: a worker host degraded while "
+                        "executing this row's shard"))
 
         # Per-example postprocess runs on the executor, not the event loop:
         # a heavy postprocess (e.g. PNG-encoding 64 class maps) would

@@ -198,6 +198,15 @@ class ModelRuntime:
         out = servable._compiled(servable.params, batch)
         return jax.device_get(out)
 
+    def run_batch_report(self, name: str, batch: np.ndarray
+                         ) -> tuple[object, frozenset]:
+        """``run_batch`` plus a poisoned-rows report — uniform surface with
+        ``MultihostRuntime.run_batch_report`` so the batcher can fail exactly
+        the rows a degraded follower invalidated. A single-runtime execution
+        has no partial-degrade mode: the set is always empty (a device
+        failure raises and fails the whole batch)."""
+        return self.run_batch(name, batch), frozenset()
+
 
 def enable_compilation_cache(path: str = "/tmp/ai4e_tpu_xla_cache") -> None:
     """Persistent XLA compilation cache: pod restarts skip recompiles (the

@@ -1,0 +1,148 @@
+"""Per-key rate limiting — the APIM product-throttling slot (VERDICT r2 #9):
+token bucket per subscription key, 429 + Retry-After on exhaustion, internal
+task-store surface exempt."""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.gateway.ratelimit import (RateLimit, RateLimiter,
+                                        parse_rate_limits)
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestTokenBucket:
+    def test_burst_then_throttle_then_refill(self):
+        clock = FakeClock()
+        rl = RateLimiter(RateLimit(rps=10, burst=3), clock=clock)
+        assert [rl.allow("k")[0] for _ in range(3)] == [True] * 3
+        allowed, retry = rl.allow("k")
+        assert not allowed and retry > 0
+        clock.t += 0.1  # one token accrues at 10 rps
+        assert rl.allow("k")[0]
+        assert not rl.allow("k")[0]
+
+    def test_retry_after_predicts_next_token(self):
+        clock = FakeClock()
+        rl = RateLimiter(RateLimit(rps=2, burst=1), clock=clock)
+        assert rl.allow("k")[0]
+        _, retry = rl.allow("k")
+        clock.t += retry
+        assert rl.allow("k")[0]
+
+    def test_keys_have_independent_buckets(self):
+        clock = FakeClock()
+        rl = RateLimiter(RateLimit(rps=1, burst=1), clock=clock)
+        assert rl.allow("a")[0]
+        assert not rl.allow("a")[0]
+        assert rl.allow("b")[0]  # b unaffected by a's exhaustion
+
+    def test_per_key_override(self):
+        clock = FakeClock()
+        rl = RateLimiter(RateLimit(rps=1, burst=1),
+                         per_key={"vip": RateLimit(rps=100, burst=5)},
+                         clock=clock)
+        assert [rl.allow("vip")[0] for _ in range(5)] == [True] * 5
+        assert rl.allow("free")[0]
+        assert not rl.allow("free")[0]
+
+    def test_idle_buckets_pruned(self):
+        clock = FakeClock()
+        rl = RateLimiter(RateLimit(rps=10, burst=2), clock=clock)
+        for i in range(100):
+            rl.allow(f"key-{i}")
+        clock.t += 120.0  # all buckets refill; prune interval passed
+        rl.allow("fresh")
+        assert len(rl._buckets) == 1
+
+    def test_parse_rate_limits(self):
+        limits = parse_rate_limits("partner=50:100, free=2")
+        assert limits["partner"].rps == 50 and limits["partner"].burst == 100
+        assert limits["free"].rps == 2 and limits["free"].burst == 4.0
+
+    def test_parse_rejects_malformed(self):
+        import pytest
+        with pytest.raises(ValueError):
+            parse_rate_limits("no-rate")
+        with pytest.raises(ValueError):
+            RateLimit(rps=0)
+
+
+class TestGatewayThrottle:
+    def test_429_with_retry_after_and_taskstore_exempt(self):
+        from ai4e_tpu.taskstore.http import make_app
+
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            platform.gateway.set_api_keys({"good-key"})
+            platform.gateway.set_rate_limiter(
+                RateLimiter(RateLimit(rps=0.5, burst=2)))
+            platform.publish_async_api("/v1/api/run",
+                                       "http://127.0.0.1:1/v1/api/run")
+            make_app(platform.store, app=platform.gateway.app)
+            gw = await serve(platform.gateway.app)
+            hdr = {"X-Api-Key": "good-key"}
+            try:
+                r1 = await gw.post("/v1/api/run", data=b"x", headers=hdr)
+                r2 = await gw.post("/v1/api/run", data=b"x", headers=hdr)
+                assert (r1.status, r2.status) == (200, 200)
+                r3 = await gw.post("/v1/api/run", data=b"x", headers=hdr)
+                assert r3.status == 429
+                assert float(r3.headers["Retry-After"]) > 0
+                # 401 wins over 429: an invalid key is refused, not counted.
+                r = await gw.post("/v1/api/run", data=b"x",
+                                  headers={"X-Api-Key": "bad"})
+                assert r.status == 401
+                # The worker-facing task-store surface is NOT throttled.
+                tid = (await r1.json())["TaskId"]
+                for _ in range(10):
+                    r = await gw.get(f"/v1/taskstore/task?taskId={tid}",
+                                     headers=hdr)
+                    assert r.status == 200
+                # Health/metrics stay exempt as ever.
+                assert (await gw.get("/healthz")).status == 200
+            finally:
+                await gw.close()
+
+        run(main())
+
+    def test_unkeyed_gateway_buckets_by_remote_addr(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            platform.gateway.set_rate_limiter(
+                RateLimiter(RateLimit(rps=0.5, burst=1)))
+            platform.publish_async_api("/v1/api/run",
+                                       "http://127.0.0.1:1/v1/api/run")
+            gw = await serve(platform.gateway.app)
+            try:
+                assert (await gw.post("/v1/api/run", data=b"x")).status == 200
+                # Rotating an (unvalidated) key header must NOT mint fresh
+                # buckets — with auth off the identity is the caller address.
+                r = await gw.post("/v1/api/run", data=b"x",
+                                  headers={"X-Api-Key": "made-up-2"})
+                assert r.status == 429
+                # RFC 7231 delta-seconds: integer, >= 1.
+                assert r.headers["Retry-After"].isdigit()
+                assert int(r.headers["Retry-After"]) >= 1
+            finally:
+                await gw.close()
+
+        run(main())

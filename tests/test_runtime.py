@@ -345,3 +345,43 @@ class TestMicroBatcher:
                 await batcher.stop()
 
         run(main())
+
+
+class TestPoisonedRows:
+    """VERDICT r2 #5 (batcher leg): rows a degraded host invalidated must
+    FAIL their tasks while the batch's other rows complete normally."""
+
+    def test_poisoned_rows_fail_only_those_tasks(self):
+        async def main():
+            runtime = ModelRuntime()
+            runtime.register(_double_servable())
+            orig = runtime.run_batch_report
+
+            def report(name, batch):
+                out, _ = orig(name, batch)
+                return out, frozenset({1})  # row 1's host degraded
+
+            runtime.run_batch_report = report
+            batcher = MicroBatcher(runtime, max_wait_ms=30)
+            await batcher.start()
+            try:
+                futs = [asyncio.ensure_future(batcher.submit(
+                            "double", np.full((4,), float(i + 1), np.float32)))
+                        for i in range(3)]
+                results = await asyncio.gather(*futs, return_exceptions=True)
+                assert results[0] == {"sum": 8.0}
+                assert isinstance(results[1], RuntimeError)
+                assert "invalidated" in str(results[1])
+                assert results[2] == {"sum": 24.0}
+            finally:
+                await batcher.stop()
+
+        run(main())
+
+    def test_single_runtime_report_is_clean(self):
+        runtime = ModelRuntime()
+        runtime.register(_double_servable())
+        out, poisoned = runtime.run_batch_report(
+            "double", np.ones((8, 4), np.float32))
+        assert poisoned == frozenset()
+        np.testing.assert_allclose(np.asarray(out), 2.0)
