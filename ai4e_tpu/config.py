@@ -236,16 +236,36 @@ class ObservabilitySection:
     trace_enabled: bool = True
     trace_sample_rate: float = 1.0   # App Insights sampled 50 items/s (host.json:5-8)
     trace_export_path: typing.Optional[str] = None  # JSONL span log; None → log only
+    # OTLP/HTTP traces URL of a collector (deploy/charts/otel-collector.yaml
+    # serves http://ai4e-otel-collector:4318/v1/traces) — the deployable
+    # span sink, parity with the reference's Istio→App Insights adapter.
+    trace_otlp_endpoint: typing.Optional[str] = None
     queue_depth_interval: float = 30.0      # TaskQueueLogger.cs:19 (30 s)
     process_depth_interval: float = 300.0   # TaskProcessLogger.cs:21 (5 min)
 
     def apply(self) -> None:
         """Install these settings on the process tracer (components without
         explicit tracer settings follow it live)."""
-        from .observability import JsonlExporter, configure_tracer
+        from .observability import (FanoutExporter, JsonlExporter,
+                                    configure_tracer)
         rate = self.trace_sample_rate if self.trace_enabled else 0.0
-        exporter = (JsonlExporter(self.trace_export_path)
-                    if self.trace_export_path else None)
+        exporters = []
+        if self.trace_export_path:
+            exporters.append(JsonlExporter(self.trace_export_path))
+        if self.trace_otlp_endpoint:
+            from .observability.otlp import OtlpHttpExporter
+            exporters.append(OtlpHttpExporter(self.trace_otlp_endpoint))
+        exporter = None
+        if len(exporters) == 1:
+            exporter = exporters[0]
+        elif exporters:
+            exporter = FanoutExporter(exporters)
+        if exporter is not None and hasattr(exporter, "close"):
+            # Flush buffered spans at process exit (the OTLP exporter holds
+            # up to flush_interval of them) — the shutdown-time spans are
+            # usually the interesting ones.
+            import atexit
+            atexit.register(exporter.close)
         configure_tracer(exporter=exporter, sample_rate=rate)
 
 

@@ -11,6 +11,7 @@ every span carrying the TaskId so a task's life is one trace.
 """
 
 from .tracing import (
+    FanoutExporter,
     InMemoryExporter,
     JsonlExporter,
     LogExporter,
@@ -28,6 +29,7 @@ from .depth_logger import DepthLogger
 
 __all__ = [
     "DepthLogger",
+    "FanoutExporter",
     "InMemoryExporter",
     "JsonlExporter",
     "LogExporter",

@@ -109,6 +109,33 @@ class JsonlExporter:
             self._fh.close()
 
 
+class FanoutExporter:
+    """Ship every span to several sinks (e.g. a local JSONL log AND the
+    OTLP collector); one sink failing must not starve the others."""
+
+    def __init__(self, exporters):
+        self.exporters = list(exporters)
+
+    def export(self, span: Span) -> None:
+        for exporter in self.exporters:
+            try:
+                exporter.export(span)
+            except Exception:  # noqa: BLE001 — telemetry must not break serving
+                log.exception("span export failed in %s",
+                              type(exporter).__name__)
+
+    def close(self) -> None:
+        for exporter in self.exporters:
+            close = getattr(exporter, "close", None)
+            if close is None:
+                continue
+            try:
+                close()
+            except Exception:  # noqa: BLE001 — one sink must not starve the rest
+                log.exception("exporter close failed in %s",
+                              type(exporter).__name__)
+
+
 class InMemoryExporter:
     """Test sink."""
 

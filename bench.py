@@ -392,6 +392,10 @@ async def run_bench(args) -> dict:
             total += float(data["sum"])
         return count, total
 
+    def _counter_total(name: str) -> float:
+        return sum(v for _, _, _labels, v in
+                   batcher.metrics.counter(name, "").collect())
+
     batch_meta = {}
     n_batches, n_examples = _hist_totals("ai4e_batch_size")
     if n_batches:
@@ -404,6 +408,27 @@ async def run_bench(args) -> dict:
         ex_n, ex_sum = _hist_totals("ai4e_batch_exec_seconds")
         if ex_n:
             batch_meta["batch_exec_avg_ms"] = round(1000 * ex_sum / ex_n, 1)
+        # Link accounting (VERDICT r2 #3): actual h2d/d2h bytes per request
+        # (padding included) — on a remote-attached TPU these bound
+        # throughput at ~link_bandwidth / h2d_bytes_per_req.
+        h2d, d2h = (_counter_total("ai4e_batch_h2d_bytes_total"),
+                    _counter_total("ai4e_batch_d2h_bytes_total"))
+        if n_examples:
+            batch_meta["h2d_bytes_per_req"] = round(h2d / n_examples)
+            batch_meta["d2h_bytes_per_req"] = round(d2h / n_examples)
+        batch_meta["wire_bytes_per_req"] = len(payload)
+
+    # Link-independent device capability (VERDICT r2 #3): time the compiled
+    # program on an already-on-device batch (no h2d per iteration, outputs
+    # left on device) — what the chip would sustain if the host link weren't
+    # the cap. Runs after the window, device idle.
+    capability_meta = {}
+    try:
+        capability_meta["device_capability"] = {
+            name: _measure_device_capability(servable)
+            for name, servable in batcher.runtime.models.items()}
+    except Exception as exc:  # noqa: BLE001 — report, don't kill the bench
+        capability_meta["device_capability_error"] = str(exc)
 
     # On real hardware the bench doubles as the Pallas kernel-validation
     # artifact: Mosaic-compiled (interpret=False) kernels vs XLA oracles +
@@ -429,13 +454,43 @@ async def run_bench(args) -> dict:
         "vs_baseline": round(throughput / cfg["anchor"], 2),
         "baseline_anchor": cfg["anchor"],
         **{k: window[k] for k in ("p50_latency_ms", "p95_latency_ms",
-                                  "completed", "failed", "duration_s")},
+                                  "p99_latency_ms", "completed", "failed",
+                                  "duration_s")},
         "concurrency": args.concurrency,
         "device": _device_kind(),
         **build_meta,
         **batch_meta,
+        **capability_meta,
         **pallas_meta,
     }
+
+
+def _measure_device_capability(servable, iters: int = 12,
+                               min_seconds: float = 0.5) -> dict:
+    """Requests/second the chip sustains with the input already resident on
+    device and outputs left there — the link-independent ceiling. Iterations
+    are launched without per-call blocking (one sync at the end) so dispatch
+    RTT on a remote-attached device pipelines away."""
+    import jax
+
+    servable_bucket = servable.max_bucket
+    x = jax.device_put(
+        np.zeros((servable_bucket, *servable.input_shape),
+                 servable.input_dtype),
+        servable._batch_sharding)
+    jax.block_until_ready(servable._compiled(servable.params, x))  # warm
+    t0 = time.perf_counter()
+    done = 0
+    while True:
+        outs = [servable._compiled(servable.params, x) for _ in range(iters)]
+        jax.block_until_ready(outs)
+        done += iters
+        elapsed = time.perf_counter() - t0
+        if elapsed >= min_seconds:
+            break
+    return {"req_s": round(servable_bucket * done / elapsed, 2),
+            "bucket": servable_bucket,
+            "exec_ms_per_batch": round(1000 * elapsed / done, 2)}
 
 
 def _device_kind() -> str:

@@ -26,4 +26,14 @@ EOF
 # Custom-metrics adapter (HPA external metrics from Managed Prometheus).
 kubectl apply -f https://raw.githubusercontent.com/GoogleCloudPlatform/k8s-stackdriver/master/custom-metrics-stackdriver-adapter/deploy/production/adapter_new_resource_model.yaml
 
-echo "==> monitoring wired: /metrics -> Managed Prometheus -> HPA external metric"
+# Trace sink: OTLP collector -> Cloud Trace (the reference's Istio mixer ->
+# App Insights adapter tier, configuration.yaml:9-84). Components already
+# export to it via AI4E_OBSERVABILITY_TRACE_OTLP_ENDPOINT in their charts.
+kubectl apply -f charts/otel-collector.yaml
+# Cloud Trace write access for the collector (workload identity / node SA).
+gcloud projects add-iam-policy-binding "${PROJECT_ID}" \
+    --member="serviceAccount:${NODE_SERVICE_ACCOUNT}" \
+    --role="roles/cloudtrace.agent" --condition=None >/dev/null || \
+    echo "WARN: could not grant roles/cloudtrace.agent; spans will not land in Cloud Trace"
+
+echo "==> monitoring wired: /metrics -> Managed Prometheus -> HPA external metric; spans -> otel collector -> Cloud Trace"

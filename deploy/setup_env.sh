@@ -7,6 +7,10 @@
 # -- project -----------------------------------------------------------------
 export PROJECT_ID="my-gcp-project"
 export REGION="us-central2"            # TPU v5e regions: us-central2, us-west4, ...
+# Service account the node pools run as (Cloud Trace write for the otel
+# collector rides it). The GCE default is {PROJECT_NUMBER}-compute@...; set
+# yours explicitly:
+export NODE_SERVICE_ACCOUNT="REPLACE_PROJECT_NUMBER-compute@developer.gserviceaccount.com"
 export ZONE="${REGION}-b"
 export PREFIX="ai4e"                   # resource-name prefix (reference: INFRASTRUCTURE_PREFIX)
 

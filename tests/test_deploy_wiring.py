@@ -170,3 +170,70 @@ class TestTLSGateway:
                         if r["metadata"]["name"] == "ai4e-http-redirect")
         f = redirect["spec"]["rules"][0]["filters"][0]
         assert f["requestRedirect"]["scheme"] == "https"
+
+
+class TestTraceSinkWiring:
+    """VERDICT r2 #8: spans need somewhere to land in a real deployment —
+    the collector chart, the components' exporter env, and the config field
+    must agree end to end."""
+
+    def _component_endpoints(self):
+        out = {}
+        for chart in ("control-plane.yaml", "worker-tpu.yaml",
+                      "worker-cpu.yaml"):
+            for doc in load_docs(os.path.join(CHARTS, chart)):
+                if doc.get("kind") != "Deployment":
+                    continue
+                for c in doc["spec"]["template"]["spec"]["containers"]:
+                    for env in c.get("env", []):
+                        if env["name"] == ("AI4E_OBSERVABILITY_"
+                                           "TRACE_OTLP_ENDPOINT"):
+                            out[chart] = env["value"]
+        return out
+
+    def test_every_platform_component_exports_to_the_collector(self):
+        endpoints = self._component_endpoints()
+        assert set(endpoints) == {"control-plane.yaml", "worker-tpu.yaml",
+                                  "worker-cpu.yaml"}, endpoints
+        assert len(set(endpoints.values())) == 1, (
+            f"components disagree on the collector endpoint: {endpoints}")
+
+    def test_endpoint_reaches_the_collector_service(self):
+        from urllib.parse import urlparse
+
+        endpoint = next(iter(self._component_endpoints().values()))
+        url = urlparse(endpoint)
+        assert url.path == "/v1/traces"  # the OTLP/HTTP traces route
+
+        docs = load_docs(os.path.join(CHARTS, "otel-collector.yaml"))
+        services = [d for d in docs if d.get("kind") == "Service"]
+        assert services, "otel-collector.yaml lost its Service"
+        svc = services[0]
+        assert svc["metadata"]["name"] == url.hostname, (
+            f"exporter targets {url.hostname}, service is "
+            f"{svc['metadata']['name']}")
+        ports = [p["port"] for p in svc["spec"]["ports"]]
+        assert url.port in ports, (url.port, ports)
+
+        # The collector's OTLP http receiver must listen on the port the
+        # Service targets.
+        config = [d for d in docs if d.get("kind") == "ConfigMap"][0]
+        collector_cfg = yaml.safe_load(config["data"]["config.yaml"])
+        receiver = collector_cfg["receivers"]["otlp"]["protocols"]["http"]
+        target_ports = [p["targetPort"] for p in svc["spec"]["ports"]]
+        assert str(target_ports[0]) in receiver["endpoint"], (
+            receiver, target_ports)
+        # And the pipeline actually exports somewhere queryable.
+        pipeline = collector_cfg["service"]["pipelines"]["traces"]
+        assert "otlp" in pipeline["receivers"]
+        assert any(e.startswith("googlecloud") for e in pipeline["exporters"])
+
+    def test_env_var_is_a_real_config_field(self):
+        """The chart env name must parse through the typed config — a typo'd
+        section/field would make every pod crash at startup."""
+        from ai4e_tpu.config import ObservabilitySection
+
+        section = ObservabilitySection.from_env(
+            {"AI4E_OBSERVABILITY_TRACE_OTLP_ENDPOINT":
+             "http://ai4e-otel-collector:4318/v1/traces"})
+        assert section.trace_otlp_endpoint.endswith("/v1/traces")

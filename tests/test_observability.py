@@ -244,3 +244,138 @@ class TestEndToEndTrace:
         endpoint = next(s for s in spans if s.name == "/run")
         assert endpoint.trace_id == dispatch.trace_id
         assert endpoint.parent_id == dispatch.span_id
+
+
+class TestOtlpExporter:
+    """OTLP/HTTP span sink (VERDICT r2 #8): spans batch to a collector as
+    ExportTraceServiceRequest JSON; a dead collector never blocks serving."""
+
+    def _collector(self):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        received = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                received.append((self.path, _json.loads(body)))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        import threading
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return server, received
+
+    def test_spans_land_as_otlp_json(self):
+        from ai4e_tpu.observability.otlp import OtlpHttpExporter
+        from ai4e_tpu.observability.tracing import Tracer
+
+        server, received = self._collector()
+        try:
+            exporter = OtlpHttpExporter(
+                f"http://127.0.0.1:{server.server_address[1]}/v1/traces",
+                flush_interval=0.1)
+            tracer = Tracer("svc-a", exporter=exporter)
+            with tracer.span("create_task", task_id="tid-1", route="/v1/x"):
+                pass
+            with tracer.span("boom", task_id="tid-2"):
+                try:
+                    raise ValueError("nope")
+                except ValueError:
+                    pass
+            exporter.close()
+            assert exporter.exported == 2 and exporter.export_errors == 0
+            path, body = received[0]
+            assert path == "/v1/traces"
+            resource = body["resourceSpans"][0]
+            svc_attr = resource["resource"]["attributes"][0]
+            assert svc_attr == {"key": "service.name",
+                                "value": {"stringValue": "svc-a"}}
+            spans = resource["scopeSpans"][0]["spans"]
+            assert len(spans) == 2
+            first = spans[0]
+            assert len(first["traceId"]) == 32 and len(first["spanId"]) == 16
+            attrs = {a["key"]: a["value"]["stringValue"]
+                     for a in first["attributes"]}
+            assert attrs["ai4e.task_id"] == "tid-1"
+            assert attrs["route"] == "/v1/x"
+            assert int(first["endTimeUnixNano"]) >= int(
+                first["startTimeUnixNano"])
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_error_span_carries_otlp_error_status(self):
+        from ai4e_tpu.observability.otlp import span_to_otlp
+        from ai4e_tpu.observability.tracing import Span
+
+        span = Span(name="n", service="s", trace_id="ab" * 16,
+                    span_id="cd" * 8, status="error", error="KeyError: x",
+                    start=100.0, duration=0.5)
+        otlp = span_to_otlp(span)
+        assert otlp["status"] == {"code": 2, "message": "KeyError: x"}
+
+    def test_dead_collector_drops_batches_without_raising(self):
+        from ai4e_tpu.observability.otlp import OtlpHttpExporter
+        from ai4e_tpu.observability.tracing import Span
+
+        exporter = OtlpHttpExporter("http://127.0.0.1:1/v1/traces",
+                                    flush_interval=0.05, timeout=0.2)
+        for i in range(5):
+            exporter.export(Span(name=f"s{i}", service="s",
+                                 trace_id="ab" * 16, span_id="cd" * 8))
+        exporter.close()
+        assert exporter.export_errors >= 1
+        assert exporter.exported == 0
+
+    def test_overflow_sheds_oldest(self):
+        from ai4e_tpu.observability.otlp import OtlpHttpExporter
+        from ai4e_tpu.observability.tracing import Span
+
+        exporter = OtlpHttpExporter("http://127.0.0.1:1/v1/traces",
+                                    flush_interval=30.0, max_queue=3,
+                                    max_batch=100, timeout=0.2)
+        for i in range(5):
+            exporter.export(Span(name=f"s{i}", service="s",
+                                 trace_id="ab" * 16, span_id="cd" * 8))
+        assert exporter.dropped == 2
+        names = [s.name for s in exporter._queue]
+        assert names == ["s2", "s3", "s4"]  # oldest shed first
+        exporter.close()
+
+    def test_fanout_survives_one_sink_failing(self):
+        from ai4e_tpu.observability import (FanoutExporter, InMemoryExporter,
+                                            Span)
+
+        class Broken:
+            def export(self, span):
+                raise RuntimeError("sink down")
+
+        good = InMemoryExporter()
+        fan = FanoutExporter([Broken(), good])
+        fan.export(Span(name="n", service="s", trace_id="t", span_id="i"))
+        assert len(good.spans) == 1
+
+    def test_ids_normalized_to_otlp_widths(self):
+        """Client-supplied B3 ids (64-bit or garbage) must not poison the
+        whole OTLP batch — ids normalize to exactly 32/16 hex chars."""
+        from ai4e_tpu.observability.otlp import span_to_otlp
+        from ai4e_tpu.observability.tracing import Span
+
+        b3_64bit = span_to_otlp(Span(name="n", service="s",
+                                     trace_id="0123456789abcdef",
+                                     span_id="cd" * 8))
+        assert b3_64bit["traceId"] == "0" * 16 + "0123456789abcdef"
+        garbage = span_to_otlp(Span(name="n", service="s",
+                                    trace_id="not-hex-at-all!",
+                                    span_id="also bad",
+                                    parent_id="bad too"))
+        for key, width in (("traceId", 32), ("spanId", 16),
+                           ("parentSpanId", 16)):
+            v = garbage[key]
+            assert len(v) == width and int(v, 16) >= 0, (key, v)
