@@ -219,6 +219,9 @@ def build_platform(args):
     enable_compilation_cache()
     platform = LocalPlatform(PlatformConfig(
         transport=args.transport,
+        native_store=args.fabric == "native",
+        native_broker=(args.fabric == "native"
+                       and args.transport == "queue"),
         retry_delay=0.05, dispatcher_concurrency=args.dispatcher_concurrency))
     runtime = ModelRuntime()
     batcher = MicroBatcher(runtime, max_wait_ms=args.max_wait_ms,
@@ -451,6 +454,7 @@ async def run_bench(args) -> dict:
         "unit": "req/s",
         "mode": args.mode,
         "transport": args.transport,
+        "fabric": args.fabric,
         "vs_baseline": round(throughput / cfg["anchor"], 2),
         "baseline_anchor": cfg["anchor"],
         **{k: window[k] for k in ("p50_latency_ms", "p95_latency_ms",
@@ -576,8 +580,12 @@ def _clamp_for_cpu(args) -> None:
     accumulation, depth-6 pipelining, 64-buckets) only stretch the drain
     (r1: 233 s at 128 clients)."""
     # echo has no device work — CPU IS its intended backend (config #1);
-    # only the slow-model sizings apply.
-    args.concurrency = min(args.concurrency, 64 if args.model == "echo" else 16)
+    # only the slow-model sizings apply. An EXPLICIT --concurrency wins:
+    # saturation runs (--fabric comparisons) exist to push past the
+    # comfortable defaults.
+    if not getattr(args, "explicit_concurrency", False):
+        args.concurrency = min(args.concurrency,
+                               64 if args.model == "echo" else 16)
     args.pipeline_depth = min(args.pipeline_depth, 2)  # CPU compute serialises
     # With few clients the largest bucket rarely fills, so a long accumulation
     # window would just stale-wait every flush.
@@ -597,6 +605,7 @@ def _forward_argv(args) -> list[str]:
             "--model", args.model,
             "--mode", args.mode,
             "--transport", args.transport,
+            "--fabric", args.fabric,
             "--checkpoint-dir", args.checkpoint_dir,
             "--seq-len", str(args.seq_len),
             "--buckets", *[str(b) for b in args.buckets]]
@@ -645,6 +654,12 @@ def main() -> None:
                              "queues + dispatchers (Service Bus analogue) or "
                              "topic push (Event Grid analogue) — the "
                              "reference's TRANSPORT_TYPE switch")
+    parser.add_argument("--fabric", choices=("python", "native"),
+                        default="python",
+                        help="task-fabric cores under measurement: Python "
+                             "store/broker or the C++ twins (native/"
+                             "taskstore_core.cpp, broker_core.cpp) — the "
+                             "control-plane saturation comparison")
     parser.add_argument("--checkpoint-dir", default="checkpoints",
                         help="trained weights (ai4e_tpu.train.make_checkpoints)")
     parser.add_argument("--seq-len", type=int, default=4096,
@@ -663,6 +678,7 @@ def main() -> None:
     args = parser.parse_args()
     if args.mode == "sync" and args.model == "pipeline":
         parser.error("the composite pipeline is async-only (task handoffs)")
+    args.explicit_concurrency = args.concurrency is not None
     if args.concurrency is None:
         args.concurrency = {"pipeline": 160}.get(args.model, 448)
     if args.buckets is None:
