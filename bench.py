@@ -411,6 +411,23 @@ async def run_bench(args) -> dict:
         ex_n, ex_sum = _hist_totals("ai4e_batch_exec_seconds")
         if ex_n:
             batch_meta["batch_exec_avg_ms"] = round(1000 * ex_sum / ex_n, 1)
+        # Tail decomposition (VERDICT r2 #6): a p95/p99 task latency far
+        # above Little's-law mean is either device/link stalls (exec p99
+        # blows up — tunnel weather) or admission/queueing inequity (queue
+        # wait p99 blows up, exec steady). Bucket upper-edge quantiles,
+        # worst across served models.
+        def _hist_p99_ms(name: str) -> float | None:
+            hist = batcher.metrics.histogram(name, "")
+            worst = max((hist.quantile(0.99, model=m)
+                         for m in batcher.runtime.models), default=0.0)
+            return round(1000 * worst, 1) if worst else None
+
+        for key, hist_name in (
+                ("batch_exec_p99_ms", "ai4e_batch_exec_seconds"),
+                ("batch_queue_wait_p99_ms", "ai4e_batch_queue_wait_seconds")):
+            p99 = _hist_p99_ms(hist_name)
+            if p99 is not None:
+                batch_meta[key] = p99
         # Link accounting (VERDICT r2 #3): actual h2d/d2h bytes per request
         # (padding included) — on a remote-attached TPU these bound
         # throughput at ~link_bandwidth / h2d_bytes_per_req.
