@@ -1,0 +1,45 @@
+#!/bin/bash
+# Run the full BASELINE config matrix on the TPU, archiving one JSON per
+# config (VERDICT r2 #2). Priority order: headline first, then the configs
+# r2 never measured on TPU. Each bench.py invocation probes the tunnel and
+# time-boxes its stages itself; if a run lands on CPU fallback we stop —
+# the tunnel died and the remaining runs would just archive fallbacks.
+#
+# Usage: scripts/run_tpu_matrix.sh [outdir]   (default bench_results/r3-tpu)
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-bench_results/r3-tpu}"
+mkdir -p "$OUT"
+STAMP=$(date +%H%M%S)
+
+run_one() {
+    local name="$1"; shift
+    local file="$OUT/${STAMP}_${name}.json"
+    if [ -s "$file" ]; then
+        echo "== $name already captured ($file)" >&2
+        return 0
+    fi
+    echo "== $name: python bench.py $* ==" >&2
+    python bench.py "$@" 2>>"$OUT/${STAMP}_${name}.log" | tail -1 > "$file"
+    if [ ! -s "$file" ]; then
+        echo "== $name produced no JSON; stopping matrix" >&2
+        return 1
+    fi
+    local device
+    device=$(python -c "import json;print(json.load(open('$file')).get('device',''))" 2>/dev/null)
+    echo "== $name -> $(cat "$file" | head -c 200)" >&2
+    case "$device" in
+        tpu*) return 0 ;;
+        *) echo "== $name landed on '$device' (tunnel died?); stopping" >&2
+           return 1 ;;
+    esac
+}
+
+run_one landcover       --model landcover                          || exit 1
+run_one pipeline        --model pipeline                           || exit 1
+run_one longcontext     --model longcontext                        || exit 1
+run_one landcover_sync  --model landcover --mode sync              || exit 1
+run_one landcover_push  --model landcover --transport push         || exit 1
+run_one megadetector16  --model megadetector --buckets 1 8 16      || exit 1
+run_one species         --model species                            || exit 1
+echo "== matrix complete: $(ls "$OUT"/${STAMP}_*.json | wc -l) JSONs in $OUT ==" >&2
