@@ -161,3 +161,21 @@ class TestDeclarativePipeline:
             return client
 
         asyncio.run(main())
+
+
+class TestCliRateLimitWiring:
+    def test_rate_limit_env_installs_limiter(self):
+        config = FrameworkConfig.from_env({
+            "AI4E_GATEWAY_RATE_LIMIT_RPS": "10",
+            "AI4E_GATEWAY_RATE_LIMITS": "vip=100:200",
+        })
+        platform = build_control_plane(config, {"apis": []})
+        limiter = platform.gateway._rate_limiter
+        assert limiter is not None
+        assert limiter.default.rps == 10 and limiter.default.burst == 20
+        assert limiter.per_key["vip"].rps == 100
+        assert limiter.per_key["vip"].burst == 200
+
+    def test_no_rate_limit_env_means_unlimited(self):
+        platform = build_control_plane(FrameworkConfig(), {"apis": []})
+        assert platform.gateway._rate_limiter is None
