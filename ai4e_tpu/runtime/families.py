@@ -147,7 +147,8 @@ def build_echo(name: str = "echo", size: int = 16, buckets=(8,),
 def build_unet(name: str = "landcover", tile: int = 256,
                widths=(32, 64, 128), num_classes: int = 8, buckets=(1, 16, 64),
                fused_postprocess: bool = True,
-               return_classmap: bool = False, **_) -> ServableModel:
+               return_classmap: bool = False,
+               wire: str = "rgb8", **_) -> ServableModel:
     """Land-cover segmentation (BASELINE.json config #2).
 
     ``return_classmap`` adds the classified tile itself to the response as a
@@ -156,12 +157,49 @@ def build_unet(name: str = "landcover", tile: int = 256,
     B·C int32 counts from the device — on a remote-attached TPU the uint8
     map would otherwise dominate the device→host link (H·W bytes/example vs
     ~32).
+
+    ``wire`` selects the host→device batch encoding: ``rgb8`` (raw uint8
+    pixels, 3 B/px) or ``yuv420`` (planar JPEG-convention YCbCr with 2×2
+    chroma, 1.5 B/px — halves the h2d bytes that bound throughput on a
+    remote-attached device; reconstruction fuses into the first conv on
+    device, ``ops/yuv.py``). Single-request clients ship the same image/npy
+    payloads either way (conversion is host-side); batch-STACK clients must
+    ship stacks matching the servable's flat input shape, so stack-fed
+    deployments (batch APIs, crops-handoff targets) stay on ``rgb8``.
     """
     from ..models import create_unet
     from ..ops.pallas import fused_seg_postprocess, normalize_image
 
+    if wire not in ("rgb8", "yuv420"):
+        raise ValueError(f"wire must be rgb8|yuv420, got {wire!r}")
+    if wire == "yuv420" and not fused_postprocess:
+        raise ValueError("wire='yuv420' requires the fused uint8 path")
+
     model, params = create_unet(tile=tile, widths=tuple(widths),
                                 num_classes=num_classes)
+
+    def fused_postprocess_fn(out):
+        # One response contract for every fused ingestion wire.
+        counts = np.asarray(out["counts"])
+        result = {"class_histogram":
+                  {int(c): int(n) for c, n in enumerate(counts) if n}}
+        if return_classmap:
+            result["classmap_png"] = encode_classmap_png(
+                np.asarray(out["classmap"]))
+        return result
+
+    if wire == "yuv420":
+        def on_normalized(p, x):
+            return fused_seg_postprocess(model.apply(p, x),
+                                         with_classmap=return_classmap)
+
+        apply_fn, preprocess, input_shape = _yuv_ingestion(
+            on_normalized, tile, tile)
+        return ServableModel(
+            name=name, apply_fn=apply_fn, params=params,
+            input_shape=input_shape, input_dtype=np.uint8,
+            preprocess=preprocess, postprocess=fused_postprocess_fn,
+            batch_buckets=tuple(buckets))
 
     if fused_postprocess:
         def apply_fn(p, batch):
@@ -169,15 +207,7 @@ def build_unet(name: str = "landcover", tile: int = 256,
             return fused_seg_postprocess(model.apply(p, x),
                                          with_classmap=return_classmap)
 
-        def postprocess(out):
-            counts = np.asarray(out["counts"])
-            result = {"class_histogram":
-                      {int(c): int(n) for c, n in enumerate(counts) if n}}
-            if return_classmap:
-                result["classmap_png"] = encode_classmap_png(
-                    np.asarray(out["classmap"]))
-            return result
-
+        postprocess = fused_postprocess_fn
         input_dtype = np.uint8
         preprocess = _image_preprocess((tile, tile, 3), np.uint8)
     else:
@@ -209,7 +239,7 @@ def build_resnet(name: str = "classifier", image_size: int = 224,
                  num_classes: int = 1000, stage_sizes=(3, 4, 6, 3),
                  width: int = 64, labels: list | None = None,
                  buckets=(1, 16, 64), fused_normalize: bool = True,
-                 **_) -> ServableModel:
+                 wire: str = "rgb8", **_) -> ServableModel:
     """Batched species classification (BASELINE.json config #4).
 
     ``fused_normalize`` (default): clients ship uint8 pixels — 4x less
@@ -217,6 +247,10 @@ def build_resnet(name: str = "classifier", image_size: int = 224,
     on-device in one VMEM pass (``ops/pallas/normalize_image``), the same
     ingestion design as the landcover bench path. Weights are unaffected
     (normalization reproduces the float input the model trained on).
+
+    ``wire="yuv420"`` goes further: planar 4:2:0 chroma on the wire (half
+    the h2d bytes again; ``ops/yuv.py``). Opt-in — flat input shape, so
+    batch-stack callers (e.g. the crops handoff) must stay on ``rgb8``.
     """
     from ..models.resnet import ResNet
 
@@ -234,6 +268,17 @@ def build_resnet(name: str = "classifier", image_size: int = 224,
         return {"class_id": top,
                 "label": labels[top] if labels else str(top),
                 "confidence": float(probs[top])}
+
+    if wire == "yuv420":
+        apply_fn, preprocess, input_shape = _yuv_ingestion(
+            model.apply, image_size, image_size)
+        return ServableModel(
+            name=name, apply_fn=apply_fn, params=variables,
+            input_shape=input_shape, input_dtype=np.uint8,
+            preprocess=preprocess, postprocess=postprocess,
+            batch_buckets=tuple(buckets))
+    if wire != "rgb8":
+        raise ValueError(f"wire must be rgb8|yuv420, got {wire!r}")
 
     apply_fn, input_dtype = _maybe_fused_uint8(model.apply, fused_normalize)
     return ServableModel(
@@ -257,14 +302,42 @@ def _maybe_fused_uint8(apply_fn, fused: bool):
     return fused_apply, np.uint8
 
 
+def _yuv_ingestion(apply_on_normalized, h: int, w: int):
+    """YUV 4:2:0 wire for an (H, W, 3) model whose ``apply_on_normalized``
+    consumes [0,1] float RGB: clients ship the usual image/npy payloads, the
+    host converts to planar 4:2:0 (half the h2d bytes of raw uint8 RGB), the
+    device reconstructs fused into the model's first op (``ops/yuv.py``).
+    Returns (apply_fn, preprocess, input_shape) for a flat uint8 servable."""
+    from ..ops.yuv import rgb_to_yuv420, yuv420_nbytes, yuv420_to_rgb
+
+    if h % 2 or w % 2:
+        # Fail at BUILD time: an odd size would construct fine and then die
+        # in preprocess on every request.
+        raise ValueError(f"wire='yuv420' needs even dims, got {h}x{w}")
+    rgb_pre = _image_preprocess((h, w, 3), np.uint8)
+
+    def preprocess(body: bytes, content_type: str):
+        return rgb_to_yuv420(rgb_pre(body, content_type))
+
+    def apply_fn(p, batch):
+        return apply_on_normalized(p, yuv420_to_rgb(batch, h, w))
+
+    return apply_fn, preprocess, (yuv420_nbytes(h, w),)
+
+
 def build_detector(name: str = "megadetector", image_size: int = 512,
                    widths=(64, 128, 256), max_detections: int = 64,
                    score_threshold: float = 0.2, buckets=(1, 8, 16),
-                   fused_normalize: bool = True, **_) -> ServableModel:
+                   fused_normalize: bool = True,
+                   wire: str = "rgb8", **_) -> ServableModel:
     """Camera-trap detection (BASELINE.json config #3, MegaDetector slot).
 
     ``fused_normalize``: uint8 ingestion + on-device [0,1] scaling (see
     ``build_resnet``) — a camera-trap JPEG pipeline ships bytes, not floats.
+    ``wire="yuv420"``: planar 4:2:0 on the wire, halving h2d bytes again —
+    the detector ships the fattest tiles of any family (H·W·3 at 512²), so
+    this is where a bandwidth-bound link gains the most. Opt-in; the crops
+    handoff and batch stacks need ``rgb8``.
     """
     from ..models import CenterNetDetector, decode_detections
 
@@ -272,7 +345,7 @@ def build_detector(name: str = "megadetector", image_size: int = 512,
     params = model.init(jax.random.PRNGKey(0),
                         np.zeros((1, image_size, image_size, 3), np.float32))
 
-    def apply_fn(p, batch):
+    def raw_apply(p, batch):
         return decode_detections(model.apply(p, batch),
                                  max_detections=max_detections)
 
@@ -285,7 +358,18 @@ def build_detector(name: str = "megadetector", image_size: int = 512,
              "class_id": int(np.asarray(out["classes"])[i])}
             for i in np.nonzero(keep)[0]]}
 
-    apply_fn, input_dtype = _maybe_fused_uint8(apply_fn, fused_normalize)
+    if wire == "yuv420":
+        apply_fn, preprocess, input_shape = _yuv_ingestion(
+            raw_apply, image_size, image_size)
+        return ServableModel(
+            name=name, apply_fn=apply_fn, params=params,
+            input_shape=input_shape, input_dtype=np.uint8,
+            preprocess=preprocess, postprocess=postprocess,
+            batch_buckets=tuple(buckets))
+    if wire != "rgb8":
+        raise ValueError(f"wire must be rgb8|yuv420, got {wire!r}")
+
+    apply_fn, input_dtype = _maybe_fused_uint8(raw_apply, fused_normalize)
     return ServableModel(
         name=name, apply_fn=apply_fn, params=params,
         input_shape=(image_size, image_size, 3), input_dtype=input_dtype,

@@ -131,6 +131,7 @@ def _build_servable(args):
         servable.params, meta = _load_or_train_checkpoint(
             "landcover", args.checkpoint_dir, servable.params,
             required=False)
+        meta["wire"] = args.wire
         rng = np.random.default_rng(0)
         payload_arr = rng.integers(0, 256, size=(TILE, TILE, 3),
                                    dtype=np.uint8)
@@ -160,10 +161,11 @@ def _build_servable(args):
         image_size = 512 if args.model == "megadetector" else 224
         servable = build_servable(
             family, name=args.model, image_size=image_size,
-            buckets=tuple(args.buckets), **kwargs)
+            buckets=tuple(args.buckets), wire=args.wire, **kwargs)
         shape = (image_size, image_size, 3)
         servable.params, meta = _load_or_train_checkpoint(
             args.model, args.checkpoint_dir, servable.params, required=True)
+        meta["wire"] = args.wire
         rng = np.random.default_rng(0)
         # uint8 wire format (families' fused_normalize ingestion): 4x less
         # payload than float32, normalized on-device.
@@ -281,7 +283,7 @@ def _build_landcover(args):
     from ai4e_tpu.runtime import build_servable
 
     return build_servable("unet", name="landcover", tile=TILE,
-                          buckets=tuple(args.buckets),
+                          buckets=tuple(args.buckets), wire=args.wire,
                           **_manifest_kwargs(args.checkpoint_dir, "landcover"))
 
 
@@ -625,6 +627,7 @@ def _forward_argv(args) -> list[str]:
             "--fabric", args.fabric,
             "--checkpoint-dir", args.checkpoint_dir,
             "--seq-len", str(args.seq_len),
+            "--wire", args.wire,
             "--buckets", *[str(b) for b in args.buckets]]
 
 
@@ -681,6 +684,10 @@ def main() -> None:
                         help="trained weights (ai4e_tpu.train.make_checkpoints)")
     parser.add_argument("--seq-len", type=int, default=4096,
                         help="sequence length for --model longcontext")
+    parser.add_argument("--wire", choices=("rgb8", "yuv420"), default="rgb8",
+                        help="h2d encoding for the image configs (landcover/"
+                             "megadetector/species): raw uint8 or YUV 4:2:0 "
+                             "planes (halves host->device bytes; ops/yuv.py)")
     parser.add_argument("--cpu", action="store_true",
                         help="force CPU (debug runs)")
     parser.add_argument("--probe-timeout", type=float, default=60.0,

@@ -36,10 +36,13 @@ run_one() {
 }
 
 run_one landcover       --model landcover                          || exit 1
+run_one landcover_yuv   --model landcover --wire yuv420            || exit 1
 run_one pipeline        --model pipeline                           || exit 1
 run_one longcontext     --model longcontext                        || exit 1
 run_one landcover_sync  --model landcover --mode sync              || exit 1
 run_one landcover_push  --model landcover --transport push         || exit 1
 run_one megadetector16  --model megadetector --buckets 1 8 16      || exit 1
 run_one species         --model species                            || exit 1
+run_one megadet_yuv     --model megadetector --buckets 1 8 16 --wire yuv420 || exit 1
+run_one species_yuv     --model species --wire yuv420              || exit 1
 echo "== matrix complete: $(ls "$OUT"/${STAMP}_*.json | wc -l) JSONs in $OUT ==" >&2
