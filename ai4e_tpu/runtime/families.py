@@ -170,10 +170,7 @@ def build_unet(name: str = "landcover", tile: int = 256,
     from ..models import create_unet
     from ..ops.pallas import fused_seg_postprocess, normalize_image
 
-    if wire not in ("rgb8", "yuv420"):
-        raise ValueError(f"wire must be rgb8|yuv420, got {wire!r}")
-    if wire == "yuv420" and not fused_postprocess:
-        raise ValueError("wire='yuv420' requires the fused uint8 path")
+    _check_wire(wire, fused_postprocess, "fused_postprocess")
 
     model, params = create_unet(tile=tile, widths=tuple(widths),
                                 num_classes=num_classes)
@@ -193,13 +190,8 @@ def build_unet(name: str = "landcover", tile: int = 256,
             return fused_seg_postprocess(model.apply(p, x),
                                          with_classmap=return_classmap)
 
-        apply_fn, preprocess, input_shape = _yuv_ingestion(
-            on_normalized, tile, tile)
-        return ServableModel(
-            name=name, apply_fn=apply_fn, params=params,
-            input_shape=input_shape, input_dtype=np.uint8,
-            preprocess=preprocess, postprocess=fused_postprocess_fn,
-            batch_buckets=tuple(buckets))
+        return _yuv_servable(name, params, on_normalized, tile, tile,
+                             fused_postprocess_fn, buckets)
 
     if fused_postprocess:
         def apply_fn(p, batch):
@@ -269,16 +261,10 @@ def build_resnet(name: str = "classifier", image_size: int = 224,
                 "label": labels[top] if labels else str(top),
                 "confidence": float(probs[top])}
 
+    _check_wire(wire, fused_normalize, "fused_normalize")
     if wire == "yuv420":
-        apply_fn, preprocess, input_shape = _yuv_ingestion(
-            model.apply, image_size, image_size)
-        return ServableModel(
-            name=name, apply_fn=apply_fn, params=variables,
-            input_shape=input_shape, input_dtype=np.uint8,
-            preprocess=preprocess, postprocess=postprocess,
-            batch_buckets=tuple(buckets))
-    if wire != "rgb8":
-        raise ValueError(f"wire must be rgb8|yuv420, got {wire!r}")
+        return _yuv_servable(name, variables, model.apply,
+                             image_size, image_size, postprocess, buckets)
 
     apply_fn, input_dtype = _maybe_fused_uint8(model.apply, fused_normalize)
     return ServableModel(
@@ -302,12 +288,24 @@ def _maybe_fused_uint8(apply_fn, fused: bool):
     return fused_apply, np.uint8
 
 
-def _yuv_ingestion(apply_on_normalized, h: int, w: int):
-    """YUV 4:2:0 wire for an (H, W, 3) model whose ``apply_on_normalized``
-    consumes [0,1] float RGB: clients ship the usual image/npy payloads, the
-    host converts to planar 4:2:0 (half the h2d bytes of raw uint8 RGB), the
-    device reconstructs fused into the model's first op (``ops/yuv.py``).
-    Returns (apply_fn, preprocess, input_shape) for a flat uint8 servable."""
+def _check_wire(wire: str, fused: bool, fused_flag: str) -> None:
+    """Uniform wire validation for the image families: unknown wire values
+    and the yuv420-without-fused-ingestion conflict both fail at build time
+    (yuv reconstruction IS the fused ingestion — disabling it while asking
+    for the yuv wire is contradictory, not overridable)."""
+    if wire not in ("rgb8", "yuv420"):
+        raise ValueError(f"wire must be rgb8|yuv420, got {wire!r}")
+    if wire == "yuv420" and not fused:
+        raise ValueError(f"wire='yuv420' requires {fused_flag}=True")
+
+
+def _yuv_servable(name: str, params, apply_on_normalized, h: int, w: int,
+                  postprocess, buckets) -> ServableModel:
+    """YUV 4:2:0 wire servable for an (H, W, 3) model whose
+    ``apply_on_normalized`` consumes [0,1] float RGB: clients ship the usual
+    image/npy payloads, the host converts to planar 4:2:0 (half the h2d
+    bytes of raw uint8 RGB), the device reconstructs fused into the model's
+    first op (``ops/yuv.py``). One construction point for every family."""
     from ..ops.yuv import rgb_to_yuv420, yuv420_nbytes, yuv420_to_rgb
 
     if h % 2 or w % 2:
@@ -322,7 +320,11 @@ def _yuv_ingestion(apply_on_normalized, h: int, w: int):
     def apply_fn(p, batch):
         return apply_on_normalized(p, yuv420_to_rgb(batch, h, w))
 
-    return apply_fn, preprocess, (yuv420_nbytes(h, w),)
+    return ServableModel(
+        name=name, apply_fn=apply_fn, params=params,
+        input_shape=(yuv420_nbytes(h, w),), input_dtype=np.uint8,
+        preprocess=preprocess, postprocess=postprocess,
+        batch_buckets=tuple(buckets))
 
 
 def build_detector(name: str = "megadetector", image_size: int = 512,
@@ -358,16 +360,10 @@ def build_detector(name: str = "megadetector", image_size: int = 512,
              "class_id": int(np.asarray(out["classes"])[i])}
             for i in np.nonzero(keep)[0]]}
 
+    _check_wire(wire, fused_normalize, "fused_normalize")
     if wire == "yuv420":
-        apply_fn, preprocess, input_shape = _yuv_ingestion(
-            raw_apply, image_size, image_size)
-        return ServableModel(
-            name=name, apply_fn=apply_fn, params=params,
-            input_shape=input_shape, input_dtype=np.uint8,
-            preprocess=preprocess, postprocess=postprocess,
-            batch_buckets=tuple(buckets))
-    if wire != "rgb8":
-        raise ValueError(f"wire must be rgb8|yuv420, got {wire!r}")
+        return _yuv_servable(name, params, raw_apply,
+                             image_size, image_size, postprocess, buckets)
 
     apply_fn, input_dtype = _maybe_fused_uint8(raw_apply, fused_normalize)
     return ServableModel(

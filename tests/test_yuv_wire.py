@@ -204,3 +204,38 @@ class TestDetectorYuvWire:
         with pytest.raises(ValueError, match="even"):
             build_servable("detector", image_size=63, wire="yuv420",
                            widths=[8], buckets=(1,))
+
+
+class TestNativeCodecParity:
+    def test_native_matches_numpy_within_one_lsb(self):
+        """The C++ encoder (native/yuv_codec.cpp) must reproduce the numpy
+        reference within 1 LSB on every plane (exact-half rounding is the
+        only permitted divergence)."""
+        from ai4e_tpu.ops.yuv import _get_native_encode, _rgb_to_yuv420_numpy
+
+        if _get_native_encode() is None:
+            import pytest
+            pytest.skip("native codec did not build in this environment")
+        rng = np.random.default_rng(123)
+        for h, w in ((64, 64), (128, 64), (2, 2)):
+            img = rng.integers(0, 256, (h, w, 3), np.uint8)
+            a = rgb_to_yuv420(img).astype(int)
+            b = _rgb_to_yuv420_numpy(img).astype(int)
+            assert np.abs(a - b).max() <= 1, (h, w)
+
+    def test_yuv_requires_fused_ingestion_everywhere(self):
+        import pytest
+
+        from ai4e_tpu.runtime import build_servable
+        for family, flag in (("unet", "fused_postprocess"),
+                             ("resnet", "fused_normalize"),
+                             ("detector", "fused_normalize")):
+            with pytest.raises(ValueError, match=flag):
+                build_servable(family, wire="yuv420", **{flag: False})
+
+    def test_codec_rejects_non_uint8_and_non_rgb(self):
+        import pytest
+        with pytest.raises(ValueError, match="uint8"):
+            rgb_to_yuv420(np.zeros((64, 64, 3), np.float32))
+        with pytest.raises(ValueError, match="uint8"):
+            rgb_to_yuv420(np.zeros((64, 64, 4), np.uint8))
