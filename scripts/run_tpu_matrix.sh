@@ -10,17 +10,22 @@ set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-bench_results/r3-tpu}"
 mkdir -p "$OUT"
-STAMP=$(date +%H%M%S)
 
 run_one() {
+    # Stable per-config filenames so an interrupted matrix RESUMES: configs
+    # whose JSON already exists (with a tpu device) are skipped.
     local name="$1"; shift
-    local file="$OUT/${STAMP}_${name}.json"
-    if [ -s "$file" ]; then
-        echo "== $name already captured ($file)" >&2
+    local file="$OUT/${name}.json"
+    if [ -s "$file" ] && python - "$file" <<'PY'
+import json, sys
+sys.exit(0 if json.load(open(sys.argv[1])).get("device", "").startswith("tpu") else 1)
+PY
+    then
+        echo "== $name already captured on TPU ($file)" >&2
         return 0
     fi
     echo "== $name: python bench.py $* ==" >&2
-    python bench.py "$@" 2>>"$OUT/${STAMP}_${name}.log" | tail -1 > "$file"
+    python bench.py "$@" 2>>"$OUT/${name}.log" | tail -1 > "$file"
     if [ ! -s "$file" ]; then
         echo "== $name produced no JSON; stopping matrix" >&2
         return 1
@@ -45,4 +50,4 @@ run_one megadetector16  --model megadetector --buckets 1 8 16      || exit 1
 run_one species         --model species                            || exit 1
 run_one megadet_yuv     --model megadetector --buckets 1 8 16 --wire yuv420 || exit 1
 run_one species_yuv     --model species --wire yuv420              || exit 1
-echo "== matrix complete: $(ls "$OUT"/${STAMP}_*.json | wc -l) JSONs in $OUT ==" >&2
+echo "== matrix complete: $(ls "$OUT"/*.json | wc -l) JSONs in $OUT ==" >&2
