@@ -105,6 +105,25 @@ def _rgb_to_yuv420_numpy(arr: np.ndarray) -> np.ndarray:
     return out
 
 
+def yuv420_to_rgb_numpy(flat: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Host-side inverse: flat planes → (H, W, 3) uint8 RGB — for consumers
+    that need the image back on the HOST (e.g. a pipeline crops handoff
+    cropping a yuv-wire detector's input). Same math as the device inverse."""
+    flat = np.asarray(flat, np.uint8)
+    n = h * w
+    q = (h // 2) * (w // 2)
+    y = flat[:n].reshape(h, w).astype(np.float32)
+    cb = flat[n:n + q].reshape(h // 2, w // 2).astype(np.float32) - 128.0
+    cr = flat[n + q:].reshape(h // 2, w // 2).astype(np.float32) - 128.0
+    cb = np.repeat(np.repeat(cb, 2, axis=0), 2, axis=1)
+    cr = np.repeat(np.repeat(cr, 2, axis=0), 2, axis=1)
+    r = y + 1.402 * cr
+    g = y - 0.344136 * cb - 0.714136 * cr
+    b = y + 1.772 * cb
+    rgb = np.stack([r, g, b], axis=-1)
+    return np.clip(np.round(rgb), 0, 255).astype(np.uint8)
+
+
 def yuv420_to_rgb(flat, h: int, w: int):
     """Device-side inverse: (B, yuv420_nbytes) uint8 → (B, H, W, 3) float32
     in [0, 1]. Chroma upsamples nearest (what fast JPEG decoders do); the

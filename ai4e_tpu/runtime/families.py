@@ -162,10 +162,9 @@ def build_unet(name: str = "landcover", tile: int = 256,
     pixels, 3 B/px) or ``yuv420`` (planar JPEG-convention YCbCr with 2×2
     chroma, 1.5 B/px — halves the h2d bytes that bound throughput on a
     remote-attached device; reconstruction fuses into the first conv on
-    device, ``ops/yuv.py``). Single-request clients ship the same image/npy
-    payloads either way (conversion is host-side); batch-STACK clients must
-    ship stacks matching the servable's flat input shape, so stack-fed
-    deployments (batch APIs, crops-handoff targets) stay on ``rgb8``.
+    device, ``ops/yuv.py``). Clients ship the same payloads either way:
+    single requests as image/npy, batch stacks as (N, H, W, 3) — stack
+    items convert to planes at ingestion (``stack_adapter``).
     """
     from ..models import create_unet
     from ..ops.pallas import fused_seg_postprocess, normalize_image
@@ -241,8 +240,8 @@ def build_resnet(name: str = "classifier", image_size: int = 224,
     (normalization reproduces the float input the model trained on).
 
     ``wire="yuv420"`` goes further: planar 4:2:0 chroma on the wire (half
-    the h2d bytes again; ``ops/yuv.py``). Opt-in — flat input shape, so
-    batch-stack callers (e.g. the crops handoff) must stay on ``rgb8``.
+    the h2d bytes again; ``ops/yuv.py``). Opt-in; batch stacks and the
+    crops handoff keep shipping (N, H, W, 3) — items convert at ingestion.
     """
     from ..models.resnet import ResNet
 
@@ -306,7 +305,8 @@ def _yuv_servable(name: str, params, apply_on_normalized, h: int, w: int,
     image/npy payloads, the host converts to planar 4:2:0 (half the h2d
     bytes of raw uint8 RGB), the device reconstructs fused into the model's
     first op (``ops/yuv.py``). One construction point for every family."""
-    from ..ops.yuv import rgb_to_yuv420, yuv420_nbytes, yuv420_to_rgb
+    from ..ops.yuv import (rgb_to_yuv420, yuv420_nbytes, yuv420_to_rgb,
+                           yuv420_to_rgb_numpy)
 
     if h % 2 or w % 2:
         # Fail at BUILD time: an odd size would construct fine and then die
@@ -324,7 +324,14 @@ def _yuv_servable(name: str, params, apply_on_normalized, h: int, w: int,
         name=name, apply_fn=apply_fn, params=params,
         input_shape=(yuv420_nbytes(h, w),), input_dtype=np.uint8,
         preprocess=preprocess, postprocess=postprocess,
-        batch_buckets=tuple(buckets))
+        batch_buckets=tuple(buckets),
+        # Batch stacks keep shipping (N, H, W, 3); each item converts to
+        # planes at ingestion (serve_batch).
+        stack_item_shape=(h, w, 3), stack_item_dtype=np.uint8,
+        stack_adapter=rgb_to_yuv420,
+        # Host consumers of the preprocessed example (a crops handoff
+        # cropping this stage's input) get the RGB image back.
+        example_decoder=lambda flat: yuv420_to_rgb_numpy(flat, h, w))
 
 
 def build_detector(name: str = "megadetector", image_size: int = 512,
@@ -338,8 +345,8 @@ def build_detector(name: str = "megadetector", image_size: int = 512,
     ``build_resnet``) — a camera-trap JPEG pipeline ships bytes, not floats.
     ``wire="yuv420"``: planar 4:2:0 on the wire, halving h2d bytes again —
     the detector ships the fattest tiles of any family (H·W·3 at 512²), so
-    this is where a bandwidth-bound link gains the most. Opt-in; the crops
-    handoff and batch stacks need ``rgb8``.
+    this is where a bandwidth-bound link gains the most. Opt-in; batch
+    stacks keep shipping (N, H, W, 3) — items convert at ingestion.
     """
     from ..models import CenterNetDetector, decode_detections
 

@@ -53,6 +53,18 @@ class ServableModel:
     # declares model-parallel placement (e.g. MoE experts over ep) that must
     # survive the runtime's own param placement.
     param_sharding_rules: dict | None = None
+    # Batch-STACK ingestion for servables whose device input shape differs
+    # from the natural payload shape (e.g. the yuv420 wire's flat planes):
+    # stacks arrive as (N, *stack_item_shape) in stack_item_dtype and each
+    # item passes through stack_adapter to become an input_shape example.
+    # None = stacks match input_shape directly.
+    stack_item_shape: tuple[int, ...] | None = None
+    stack_item_dtype: Any = None
+    stack_adapter: Callable | None = None
+    # Inverse for HOST consumers of a preprocessed example (pipeline
+    # handoffs crop the stage's input image): example → natural image.
+    # None = the example already is the natural payload.
+    example_decoder: Callable | None = None
     _compiled: Callable | None = field(default=None, repr=False)
     _batch_sharding: Any = field(default=None, repr=False)
 

@@ -48,13 +48,22 @@ class InferenceWorker:
         from aiohttp import web
         out = []
         for name, s in self.runtime.models.items():
-            out.append({
+            entry = {
                 "name": name, "version": s.version,
                 "input_shape": list(s.input_shape),
                 "input_dtype": str(np.dtype(s.input_dtype)),
                 "batch_buckets": list(s.batch_buckets),
                 "endpoints": self._served.get(name, {}),
-            })
+            }
+            if s.stack_item_shape is not None:
+                # The batch-STACK contract when it differs from the device
+                # input shape (wire-encoded servables): clients discover the
+                # shape stacks must ship in, not the on-device layout.
+                entry["stack_item_shape"] = list(s.stack_item_shape)
+                entry["stack_item_dtype"] = str(np.dtype(
+                    s.stack_item_dtype if s.stack_item_dtype is not None
+                    else s.input_dtype))
+            out.append(entry)
         return web.json_response({"models": out})
 
     def serve_model(self, servable: ServableModel,
@@ -135,8 +144,15 @@ class InferenceWorker:
                 await tm.add_pipeline_task(taskId, endpoint)
                 return
             if pipeline_to is not None:
-                handoff = (pipeline_to(result, example)
-                           if handoff_wants_example else pipeline_to(result))
+                if handoff_wants_example:
+                    # Handoffs consume the natural image; wire-encoded
+                    # servables (yuv420 flat planes) decode it back first.
+                    img = (_servable.example_decoder(example)
+                           if _servable.example_decoder is not None
+                           else example)
+                    handoff = pipeline_to(result, img)
+                else:
+                    handoff = pipeline_to(result)
                 if handoff is not None:
                     next_endpoint, next_body = handoff
                     # Keep the stage's intermediate output retrievable
@@ -164,8 +180,11 @@ class InferenceWorker:
                     progress_every: float = 2.0,
                     maximum_concurrent_requests: int = 8) -> None:
         """Expose a *batch* API for a servable: one request carries a stack of
-        N examples (npy array of shape ``(N, *input_shape)``), the platform
-        fans them into the micro-batcher and aggregates the results.
+        N examples (npy array of shape ``(N, *stack_item_shape)`` — which is
+        ``input_shape`` unless the servable declares a wire adapter, e.g.
+        yuv420 servables take ``(N, H, W, 3)`` stacks and convert each item
+        at ingestion), the platform fans them into the micro-batcher and
+        aggregates the results.
 
         The reference's batch APIs (``APIs/Projects/camera-trap/
         batch-detection-async.dockerfile``) are long-running tasks over many
@@ -186,7 +205,15 @@ class InferenceWorker:
         self._served.setdefault(name, {}).update(
             batch_sync=self.service.prefix + sync_path,
             batch_async=self.service.prefix + async_path)
-        item_shape = tuple(servable.input_shape)
+        # Stacks arrive in the servable's natural payload shape; servables
+        # whose device input differs (yuv420's flat planes) declare the
+        # stack shape + a per-item adapter, so batch clients and the crops
+        # handoff keep shipping plain (N, H, W, 3) arrays on every wire.
+        item_shape = tuple(servable.stack_item_shape
+                           or servable.input_shape)
+        item_dtype = (servable.stack_item_dtype
+                      if servable.stack_item_dtype is not None
+                      else servable.input_dtype)
 
         def _decode_stack(body: bytes) -> np.ndarray:
             arr = np.load(io.BytesIO(body))
@@ -199,7 +226,10 @@ class InferenceWorker:
             if len(arr) > max_items:
                 raise ValueError(f"batch of {len(arr)} exceeds max {max_items}")
             from .families import cast_image_payload
-            return cast_image_payload(arr, servable.input_dtype)
+            arr = cast_image_payload(arr, item_dtype)
+            if servable.stack_adapter is not None:
+                arr = np.stack([servable.stack_adapter(x) for x in arr])
+            return arr
 
         async def _run_stack(stack: np.ndarray, on_progress=None) -> list:
             results: list = [None] * len(stack)
@@ -242,7 +272,10 @@ class InferenceWorker:
         @self.service.api_sync_func(
             sync_path, maximum_concurrent_requests=maximum_concurrent_requests)
         async def _sync_batch(body, content_type):
-            stack = _decode_stack(body)
+            # Off the event loop: decoding + per-item wire conversion of a
+            # 1000-image stack is seconds of numpy work that must not stall
+            # the interactive traffic the priority classes protect.
+            stack = await asyncio.to_thread(_decode_stack, body)
             results = await _run_stack(stack)
             failed = sum(1 for r in results if "error" in r)
             return {"count": len(results), "failed": failed, "items": results}
@@ -252,7 +285,7 @@ class InferenceWorker:
         async def _async_batch(taskId, body, content_type):
             tm = self.service.task_manager
             try:
-                stack = _decode_stack(body)
+                stack = await asyncio.to_thread(_decode_stack, body)
             except Exception as exc:  # noqa: BLE001 — bad payload fails this task only
                 await tm.fail_task(taskId, f"failed - bad input: {exc}")
                 return

@@ -239,3 +239,14 @@ class TestNativeCodecParity:
             rgb_to_yuv420(np.zeros((64, 64, 3), np.float32))
         with pytest.raises(ValueError, match="uint8"):
             rgb_to_yuv420(np.zeros((64, 64, 4), np.uint8))
+
+
+class TestHostInverse:
+    def test_numpy_inverse_matches_device_inverse(self):
+        from ai4e_tpu.ops.yuv import yuv420_to_rgb_numpy
+
+        img = _smooth_image(seed=9)
+        flat = rgb_to_yuv420(img)
+        host = yuv420_to_rgb_numpy(flat, 64, 64).astype(np.float32)
+        device = np.asarray(yuv420_to_rgb(flat[None], 64, 64))[0] * 255.0
+        assert np.abs(host - device).max() <= 1.0  # rounding only
