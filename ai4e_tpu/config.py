@@ -132,6 +132,9 @@ class PlatformSection:
     reaper_running_timeout: typing.Optional[float] = None
     reaper_interval: float = 30.0
     reaper_max_requeues: int = 3
+    # Terminal-history retention (s): evict completed/failed tasks older
+    # than this (memory/journal bound); unset keeps history forever.
+    reaper_terminal_retention: typing.Optional[float] = None
     # Object-store result offload (assign_storage_auth_to_aks.sh:9-17 slot):
     # results >= threshold bytes land under result_dir instead of store memory.
     result_dir: typing.Optional[str] = None
@@ -153,6 +156,7 @@ class PlatformSection:
             reaper_running_timeout=self.reaper_running_timeout,
             reaper_interval=self.reaper_interval,
             reaper_max_requeues=self.reaper_max_requeues,
+            reaper_terminal_retention=self.reaper_terminal_retention,
             result_dir=self.result_dir,
             result_offload_threshold=self.result_offload_threshold,
         )

@@ -38,6 +38,9 @@ class PlatformConfig:
     reaper_running_timeout: float | None = None
     reaper_interval: float = 30.0
     reaper_max_requeues: int = 3
+    # Terminal-history retention (seconds): completed/failed tasks older
+    # than this are evicted (memory + journal bound); None keeps forever.
+    reaper_terminal_retention: float | None = None
     # Object-store slot for large results (assign_storage_auth_to_aks.sh:9-17):
     # results >= the threshold are written under result_dir (a local dir, PD,
     # or GCS FUSE mount) instead of store memory. None dir disables offload.
@@ -83,6 +86,12 @@ class LocalPlatform:
                 raise ValueError(
                     "result_dir offload requires the Python store "
                     "(the native store keeps results in its own memory)")
+            if self.config.reaper_terminal_retention is not None:
+                # Fail loudly: a retention knob that silently never evicts
+                # is exactly the OOM it exists to prevent.
+                raise ValueError(
+                    "reaper_terminal_retention requires the Python store "
+                    "(the native store has no eviction)")
             self.store = NativeTaskStore()
         else:
             self.store = InMemoryTaskStore(**result_kwargs)
@@ -123,13 +132,15 @@ class LocalPlatform:
                 "expected 'queue' or 'push'")
         self.gateway = Gateway(self.store, metrics=self.metrics)
         self.reaper = None
-        if self.config.reaper_running_timeout is not None:
+        if (self.config.reaper_running_timeout is not None
+                or self.config.reaper_terminal_retention is not None):
             from .taskstore.reaper import TaskReaper
             self.reaper = TaskReaper(
                 self.store,
                 running_timeout=self.config.reaper_running_timeout,
                 interval=self.config.reaper_interval,
                 max_requeues=self.config.reaper_max_requeues,
+                terminal_retention=self.config.reaper_terminal_retention,
                 metrics=self.metrics)
         from .observability import DepthLogger
         self.depth_logger = DepthLogger(

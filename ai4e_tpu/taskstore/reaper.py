@@ -37,14 +37,21 @@ log = logging.getLogger("ai4e_tpu.reaper")
 
 class TaskReaper:
     def __init__(self, store: InMemoryTaskStore,
-                 running_timeout: float = 600.0,
+                 running_timeout: float | None = 600.0,
                  interval: float = 30.0,
                  max_requeues: int = 3,
+                 terminal_retention: float | None = None,
                  metrics: MetricsRegistry | None = None):
+        """``running_timeout`` None disables the stuck-task rescue;
+        ``terminal_retention`` (seconds) evicts completed/failed history
+        older than that — record, original body, results, offloaded blobs
+        — bounding store memory and journal size over a long deployment
+        (the Redis-expiry role; None keeps history forever)."""
         self.store = store
         self.running_timeout = running_timeout
         self.interval = interval
         self.max_requeues = max_requeues
+        self.terminal_retention = terminal_retention
         self.metrics = metrics or DEFAULT_REGISTRY
         self._reaped = self.metrics.counter(
             "ai4e_reaper_actions_total", "Stuck-task rescues by outcome")
@@ -75,12 +82,25 @@ class TaskReaper:
                 log.exception("reaper sweep failed")
 
     async def sweep(self) -> int:
-        """One scan; returns the number of tasks acted on. Cost is
-        O(running tasks), not O(all tasks ever): the per-endpoint RUNNING
-        status sets (the reference's ``{path}_running`` sorted sets) are the
-        index, so terminal history is never touched."""
+        """One scan; returns the number of tasks acted on. The rescue pass
+        costs O(running tasks) via the per-endpoint RUNNING status sets
+        (the reference's ``{path}_running`` sorted sets); when
+        ``terminal_retention`` is set, an eviction pass additionally scans
+        (and prunes) the terminal sets — O(terminal history), which the
+        eviction itself keeps bounded."""
         now = time.time()
         acted = 0
+        if self.terminal_retention is not None:
+            evict = getattr(self.store, "evict_terminal_older_than", None)
+            if evict is not None:
+                evicted = evict(self.terminal_retention)
+                if evicted:
+                    log.info("evicted %d terminal tasks older than %.0fs",
+                             evicted, self.terminal_retention)
+                    self._reaped.inc(evicted, outcome="evicted")
+                    acted += evicted
+        if self.running_timeout is None:
+            return acted
         running: list = []
         for path in self.store.endpoints():
             for task_id in self.store.set_members(path, TaskStatus.RUNNING):

@@ -233,6 +233,56 @@ class InMemoryTaskStore(StoreSideEffects):
                 raise TaskNotFound(task_id)
             return task
 
+    # -- retention (terminal-history eviction) ------------------------------
+
+    def evict_terminal_older_than(self, age_s: float) -> int:
+        """Remove terminal (completed/failed) tasks older than ``age_s``
+        seconds — record, status-set entry, original body, results, and any
+        offloaded blobs. Without this a long-running store's memory and
+        journal grow with every task ever finished (the reference leans on
+        Redis eviction/expiry for the same role). Returns tasks evicted.
+        Cost is O(terminal history), which this very mechanism keeps
+        bounded at ~(completion rate × retention). Set scores are NOT
+        assumed monotone — journal compaction rewrites tasks in creation
+        order, so a full scan is the only correct victim collection."""
+        cutoff = time.time() - age_s
+        blob_keys: list[str] = []
+        with self._lock:
+            victims = []
+            for (path, status), members in self._sets.items():
+                if status not in TaskStatus.TERMINAL:
+                    continue
+                victims.extend(task_id for task_id, score in members.items()
+                               if score < cutoff)
+            for task_id in victims:
+                blob_keys.extend(self._apply_evict(task_id))
+        # Backend I/O OUTSIDE the lock (a GCS/PD delete is a network round
+        # trip; thousands of victims on a first sweep must not stall every
+        # store operation). Crash-ordering: the journaled subclass appends
+        # the Evict record inside _apply_evict, i.e. BEFORE these deletes —
+        # a crash in between leaks blobs harmlessly instead of replaying a
+        # completed task whose offloaded result is gone.
+        for key in blob_keys:
+            self._delete_blob(key)
+        return len(victims)
+
+    def _apply_evict(self, task_id: str) -> list[str]:
+        """Forget one task entirely; returns offloaded-result keys whose
+        blobs the CALLER must delete (outside the lock). Caller holds
+        ``self._lock``; the journaled subclass extends this."""
+        task = self._tasks.pop(task_id, None)
+        if task is None:
+            return []
+        self._remove_from_set(task)
+        self._orig_bodies.pop(task_id, None)
+        blob_keys = []
+        for key in [k for k in self._results
+                    if k == task_id or k.startswith(task_id + ":")]:
+            body, _ctype = self._results.pop(key)
+            if body is None:
+                blob_keys.append(key)
+        return blob_keys
+
     def get_original_body(self, task_id: str) -> bytes:
         with self._lock:
             return self._orig_bodies.get(task_id, (b"", ""))[0]
@@ -432,6 +482,14 @@ class JournaledTaskStore(InMemoryTaskStore):
                     self._results[rec["Key"]] = (
                         body, rec.get("ContentType", "application/json"))
                     continue
+                if rec.get("Evict"):
+                    # Journal is None during replay, so the subclass's
+                    # append is a no-op — this just forgets the task. Blob
+                    # deletes re-run too: a crash between the Evict append
+                    # and the original deletes leaked them; replay cleans up.
+                    for key in self._apply_evict(rec["TaskId"]):
+                        self._delete_blob(key)
+                    continue
                 if rec.get("Slim"):
                     # Transition record: body/orig state is untouched (they
                     # ride only on upserts), exactly like the live mutation;
@@ -592,6 +650,14 @@ class JournaledTaskStore(InMemoryTaskStore):
         self._check_open()
         super()._apply_set_result(key, result, content_type)
         self._append(self._result_record(key, result, content_type))
+
+    def _apply_evict(self, task_id: str) -> list[str]:
+        if task_id not in self._tasks:
+            return []
+        self._check_open()
+        blob_keys = super()._apply_evict(task_id)
+        self._append({"Evict": True, "TaskId": task_id})
+        return blob_keys
 
     def _apply_upsert(self, task: APITask) -> APITask:
         self._check_open()

@@ -462,3 +462,150 @@ class TestDurableResults:
         store.close()
         with pytest.raises(RuntimeError, match="offloaded result"):
             JournaledTaskStore(journal)  # no backend configured
+
+
+class TestTerminalEviction:
+    """Terminal-history retention: a long-running store must not grow
+    forever with finished tasks (the Redis-expiry role the reference's
+    store leans on)."""
+
+    def _finish(self, store, body=b"payload", result=None):
+        t = store.upsert(make_task(body=body))
+        store.update_status(t.task_id, "completed - done")
+        if result is not None:
+            store.set_result(t.task_id, result)
+        return t
+
+    def test_evicts_old_terminal_keeps_young_and_running(self):
+        import time as _time
+
+        store = InMemoryTaskStore()
+        old = self._finish(store, result=b"r1")
+        running = store.upsert(make_task())
+        store.update_status(running.task_id, "running - inference")
+        # Age the finished task's set score artificially.
+        path = old.endpoint_path
+        store._sets[(path, "completed")][old.task_id] = _time.time() - 1000
+        store._tasks[old.task_id].timestamp = _time.time() - 1000
+        young = self._finish(store, result=b"r2")
+
+        assert store.evict_terminal_older_than(500) == 1
+        with pytest.raises(TaskNotFound):
+            store.get(old.task_id)
+        assert store.get_result(old.task_id) is None
+        assert store.get_original_body(old.task_id) == b""
+        assert store.set_len(path, "completed") == 1  # young survives
+        assert store.get(young.task_id).canonical_status == "completed"
+        assert store.get(running.task_id).canonical_status == "running"
+
+    def test_eviction_deletes_offloaded_blobs(self, tmp_path):
+        import os
+        import time as _time
+
+        from ai4e_tpu.taskstore import FileResultBackend
+
+        blobs = str(tmp_path / "blobs")
+        store = InMemoryTaskStore(result_backend=FileResultBackend(blobs),
+                                  result_offload_threshold=0)
+        t = self._finish(store, result=b"blob-bytes" * 10)
+        assert len(os.listdir(blobs)) == 2
+        store._sets[(t.endpoint_path, "completed")][t.task_id] = (
+            _time.time() - 1000)
+        assert store.evict_terminal_older_than(500) == 1
+        assert os.listdir(blobs) == []
+
+    def test_eviction_survives_restart_and_shrinks_journal(self, tmp_path):
+        import os
+        import time as _time
+
+        journal = str(tmp_path / "e.jsonl")
+        store = JournaledTaskStore(journal)
+        tasks = [self._finish(store, body=b"x" * 500, result=b"y" * 500)
+                 for _ in range(5)]
+        for t in tasks[:4]:
+            store._sets[(t.endpoint_path, "completed")][t.task_id] = (
+                _time.time() - 1000)
+        assert store.evict_terminal_older_than(500) == 4
+        store.compact()
+        compacted = os.path.getsize(journal)
+        store.close()
+
+        revived = JournaledTaskStore(journal)
+        for t in tasks[:4]:
+            with pytest.raises(TaskNotFound):
+                revived.get(t.task_id)
+        assert revived.get(tasks[4].task_id).canonical_status == "completed"
+        assert revived.get_result(tasks[4].task_id) == (
+            b"y" * 500, "application/json")
+        # The journal holds ~1 task (~3.4 kB with hex-doubled body/orig/
+        # result), not 5 (~17 kB).
+        assert compacted < 6000, compacted
+        revived.close()
+
+    def test_evict_records_replay_without_compaction(self, tmp_path):
+        import time as _time
+
+        journal = str(tmp_path / "r.jsonl")
+        store = JournaledTaskStore(journal)
+        t = self._finish(store)
+        store._sets[(t.endpoint_path, "completed")][t.task_id] = (
+            _time.time() - 1000)
+        assert store.evict_terminal_older_than(500) == 1
+        store.close()  # no compaction: journal = upsert + slim + evict
+
+        revived = JournaledTaskStore(journal)
+        with pytest.raises(TaskNotFound):
+            revived.get(t.task_id)
+        revived.close()
+
+    def test_reaper_drives_eviction(self):
+        import time as _time
+
+        from ai4e_tpu.taskstore.reaper import TaskReaper
+
+        async def main():
+            store = InMemoryTaskStore()
+            t = self._finish(store)
+            store._sets[(t.endpoint_path, "completed")][t.task_id] = (
+                _time.time() - 1000)
+            reaper = TaskReaper(store, running_timeout=None,
+                                terminal_retention=500)
+            acted = await reaper.sweep()
+            assert acted == 1
+            with pytest.raises(TaskNotFound):
+                store.get(t.task_id)
+
+        import asyncio
+        asyncio.run(main())
+
+    def test_eviction_is_order_independent(self, tmp_path):
+        """Journal compaction rewrites tasks in CREATION order, so terminal
+        sets are not score-monotone after a restart — an old task sitting
+        behind a young one must still evict (review repro, r3)."""
+        import time as _time
+
+        journal = str(tmp_path / "o.jsonl")
+        store = JournaledTaskStore(journal)
+        a = self._finish(store)  # created first...
+        b = self._finish(store)
+        path = a.endpoint_path
+        # ...but A completed recently while B completed long ago (age both
+        # the set score and the record timestamp — compaction persists the
+        # latter).
+        store._sets[(path, "completed")][b.task_id] = _time.time() - 10000
+        store._tasks[b.task_id].timestamp = _time.time() - 10000
+        store.compact()  # rewrite in creation order: A (young) before B (old)
+        store.close()
+
+        revived = JournaledTaskStore(journal)
+        assert revived.evict_terminal_older_than(5000) == 1
+        with pytest.raises(TaskNotFound):
+            revived.get(b.task_id)
+        assert revived.get(a.task_id).canonical_status == "completed"
+        revived.close()
+
+    def test_native_store_with_retention_refused(self):
+        from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+        with pytest.raises(ValueError, match="eviction"):
+            LocalPlatform(PlatformConfig(native_store=True,
+                                         reaper_terminal_retention=60.0))
