@@ -256,7 +256,12 @@ def train_megadetector(steps: int = 150, image_size: int = 128,
     acc = hits / max(total, 1)
     log.info("megadetector eval detection-acc %.3f (%d/%d)", acc, hits, total)
     return {"params": tr.params, "eval": {"detection_accuracy": round(acc, 4)},
-            "family": "detector", "kwargs": {"widths": list(widths)}}
+            "family": "detector",
+            # image_size rides in kwargs so SERVING happens at the trained
+            # resolution: CenterNet features degrade off-scale (measured
+            # 1.0 @128 → 0.5 @512 for 128-trained weights), so the size is
+            # part of the weights' contract, not a free deployment knob.
+            "kwargs": {"widths": list(widths), "image_size": image_size}}
 
 
 def train_species(steps: int = 80, image_size: int = 64, batch: int = 16,
@@ -287,8 +292,12 @@ def train_species(steps: int = 80, image_size: int = 64, batch: int = 16,
     log.info("species eval acc %.3f", acc)
     return {"params": tr.params, "eval": {"accuracy": round(acc, 4)},
             "family": "resnet",
+            # image_size in kwargs: BatchNorm statistics and the receptive
+            # field do NOT transfer across serving sizes (measured 1.0 @64
+            # → 0.12 @224 for 64-trained weights) — serve at the trained
+            # resolution.
             "kwargs": {"stage_sizes": list(stage_sizes), "width": width,
-                       "num_classes": num_classes,
+                       "num_classes": num_classes, "image_size": image_size,
                        "labels": SPECIES_LABELS}}
 
 
@@ -322,6 +331,33 @@ def make_checkpoint(name: str, out_dir: str, min_eval: float = MIN_EVAL,
     return entry
 
 
+# Production training sizes = the serving sizes in deploy/specs/models.json.
+# Accuracy does not transfer across input sizes (species measured 1.0@64 →
+# 0.12@224 with 64-trained weights), so every full (non --fast) training —
+# the CLI's and the bench's train-on-the-spot path — goes through these.
+FULL_OVERRIDES = {
+    "megadetector": {"image_size": 512},
+    "species": {"image_size": 224, "steps": 120},
+}
+
+
+def train_full(name: str, out_dir: str) -> dict:
+    """Train ``name`` at production size and RECORD it in the manifest —
+    the single entry point for producing a servable checkpoint outside CI
+    (serving reads image_size from the manifest; a checkpoint without a
+    manifest entry would be served at the wrong resolution)."""
+    entry = make_checkpoint(name, out_dir, **FULL_OVERRIDES.get(name, {}))
+    manifest_path = os.path.join(out_dir, "MANIFEST.json")
+    manifest = {}
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    manifest[name] = entry
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f, indent=2)
+    return entry
+
+
 def main(argv=None) -> None:
     import argparse
 
@@ -346,8 +382,10 @@ def main(argv=None) -> None:
         # (jax.default_backend()) hangs when the tunnel is degraded.
         jax.config.update("jax_platforms", args.platform)
 
+    # Full (default) runs train at the PRODUCTION serving sizes
+    # (FULL_OVERRIDES); --fast keeps the recipes' small defaults for CI.
     fast = ({"landcover": {"steps": 60}, "megadetector": {"steps": 80},
-             "species": {"steps": 65}} if args.fast else {})
+             "species": {"steps": 65}} if args.fast else FULL_OVERRIDES)
     os.makedirs(args.out, exist_ok=True)
     manifest_path = os.path.join(args.out, "MANIFEST.json")
     manifest = {}

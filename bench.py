@@ -83,19 +83,22 @@ def _load_or_train_checkpoint(name: str, ckpt_dir: str, like,
     if not os.path.isdir(path):
         if not required:
             return like, {"checkpoint": "none"}
-        from ai4e_tpu.train.make_checkpoints import make_checkpoint
+        # train_full (not bare make_checkpoint): trains at the production
+        # serving size AND records it in the manifest — a recipe-default
+        # 64px training served at 224 would score chance.
+        from ai4e_tpu.train.make_checkpoints import train_full
         log(f"no checkpoint at {path}; training {name} now")
         t0 = time.perf_counter()
-        make_checkpoint(name, ckpt_dir)
+        train_full(name, ckpt_dir)
         meta["trained_at_bench_s"] = round(time.perf_counter() - t0, 1)
     params = load_params(path, like=like)
     meta["checkpoint"] = path
     return params, meta
 
 
-def _manifest_kwargs(ckpt_dir: str, name: str) -> dict:
-    """Servable kwargs recorded by the checkpoint factory for ``name``;
-    recipe defaults when the manifest is absent."""
+def _manifest_kwargs(ckpt_dir: str, name: str) -> tuple[dict, bool]:
+    """``(kwargs, from_manifest)`` for ``name``: the factory's recorded
+    servable kwargs, or recipe defaults when no manifest entry exists."""
     import os
 
     path = os.path.join(ckpt_dir, "MANIFEST.json")
@@ -103,12 +106,27 @@ def _manifest_kwargs(ckpt_dir: str, name: str) -> dict:
         with open(path) as f:
             manifest = json.load(f)
         if name in manifest:
-            return dict(manifest[name].get("kwargs", {}))
+            return dict(manifest[name].get("kwargs", {})), True
     from ai4e_tpu.train.make_checkpoints import SPECIES_LABELS
     return {"megadetector": {"widths": [64, 128, 256]},
             "landcover": {"widths": [64, 128, 256, 512], "num_classes": 4},
             "species": {"stage_sizes": [2, 2, 2], "width": 32,
-                        "num_classes": 8, "labels": SPECIES_LABELS}}[name]
+                        "num_classes": 8, "labels": SPECIES_LABELS}}[name], False
+
+
+def _serving_size(kwargs: dict, from_manifest: bool, name: str) -> int:
+    """The size to BUILD and SERVE at — always the size the weights were
+    (or will be) trained at:
+    - manifest records image_size → that;
+    - manifest entry predates the record → the old factory's training size
+      (serving 128-trained detector weights at 512 scores ~chance);
+    - no manifest at all → the production size train_full is about to
+      train at."""
+    migration_fallback = {"megadetector": 128, "species": 64}
+    production = {"megadetector": 512, "species": 224}
+    if "image_size" in kwargs:
+        return kwargs.pop("image_size")
+    return (migration_fallback if from_manifest else production)[name]
 
 
 def _build_servable(args):
@@ -157,8 +175,12 @@ def _build_servable(args):
         # recipe defaults when no manifest exists yet (it will be written by
         # the required=True training below).
         family = "detector" if args.model == "megadetector" else "resnet"
-        kwargs = _manifest_kwargs(args.checkpoint_dir, args.model)
-        image_size = 512 if args.model == "megadetector" else 224
+        kwargs, from_manifest = _manifest_kwargs(args.checkpoint_dir,
+                                                 args.model)
+        # Serving size = TRAINED size: accuracy does not transfer across
+        # input sizes for these families — a 64-trained classifier scores
+        # chance at 224 (_serving_size resolves every manifest state).
+        image_size = _serving_size(kwargs, from_manifest, args.model)
         servable = build_servable(
             family, name=args.model, image_size=image_size,
             buckets=tuple(args.buckets), wire=args.wire, **kwargs)
@@ -166,6 +188,7 @@ def _build_servable(args):
         servable.params, meta = _load_or_train_checkpoint(
             args.model, args.checkpoint_dir, servable.params, required=True)
         meta["wire"] = args.wire
+        meta["image_size"] = image_size
         rng = np.random.default_rng(0)
         # uint8 wire format (families' fused_normalize ingestion): 4x less
         # payload than float32, normalized on-device.
@@ -184,19 +207,24 @@ def _build_pipeline_servables(args):
     from ai4e_tpu.runtime import build_servable
     from ai4e_tpu.train.make_checkpoints import detector_batch
 
+    det_kwargs, det_mf = _manifest_kwargs(args.checkpoint_dir, "megadetector")
+    det_size = _serving_size(det_kwargs, det_mf, "megadetector")
     det = build_servable(
-        "detector", name="megadetector", image_size=128,
-        score_threshold=0.15, buckets=tuple(args.buckets),
-        **_manifest_kwargs(args.checkpoint_dir, "megadetector"))
+        "detector", name="megadetector", image_size=det_size,
+        score_threshold=0.15, buckets=tuple(args.buckets), **det_kwargs)
     det.params, m1 = _load_or_train_checkpoint(
         "megadetector", args.checkpoint_dir, det.params, required=True)
+    sp_kwargs, sp_mf = _manifest_kwargs(args.checkpoint_dir, "species")
+    sp_size = _serving_size(sp_kwargs, sp_mf, "species")
     sp = build_servable(
-        "resnet", name="species", image_size=224, buckets=tuple(args.buckets),
-        **_manifest_kwargs(args.checkpoint_dir, "species"))
+        "resnet", name="species", image_size=sp_size,
+        buckets=tuple(args.buckets), **sp_kwargs)
     sp.params, m2 = _load_or_train_checkpoint(
         "species", args.checkpoint_dir, sp.params, required=True)
 
-    img, _ = detector_batch(np.random.default_rng(0), 1, 128)
+    # Probe scene at the detector's trained size (the handoff gate fires at
+    # the resolution the weights know).
+    img, _ = detector_batch(np.random.default_rng(0), 1, det_size)
     from PIL import Image
     buf = io.BytesIO()
     Image.fromarray(
@@ -282,9 +310,10 @@ def _build_landcover(args):
     # device→host bandwidth on a remote-attached TPU).
     from ai4e_tpu.runtime import build_servable
 
+    kwargs, _from_manifest = _manifest_kwargs(args.checkpoint_dir, "landcover")
     return build_servable("unet", name="landcover", tile=TILE,
                           buckets=tuple(args.buckets), wire=args.wire,
-                          **_manifest_kwargs(args.checkpoint_dir, "landcover"))
+                          **kwargs)
 
 
 async def run_bench(args) -> dict:

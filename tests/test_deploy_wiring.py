@@ -237,3 +237,37 @@ class TestTraceSinkWiring:
             {"AI4E_OBSERVABILITY_TRACE_OTLP_ENDPOINT":
              "http://ai4e-otel-collector:4318/v1/traces"})
         assert section.trace_otlp_endpoint.endswith("/v1/traces")
+
+
+class TestCheckpointServingSizeWiring:
+    def test_models_spec_serves_at_trained_sizes(self):
+        """Accuracy does not transfer across input sizes (a 64-trained
+        classifier scores chance at 224 — r3 finding), so the deploy spec's
+        image_size must equal the checkpoint's trained size recorded in the
+        factory MANIFEST."""
+        import json
+
+        import pytest
+
+        manifest_path = os.path.join(REPO, "checkpoints", "MANIFEST.json")
+        if not os.path.exists(manifest_path):
+            pytest.skip("no checkpoint manifest (fresh clone — produced by "
+                        "ai4e_tpu.train.make_checkpoints)")
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        with open(os.path.join(REPO, "deploy", "specs", "models.json")) as f:
+            models = json.load(f)
+        by_ckpt = {m.get("checkpoint"): m for m in models["models"]}
+        checked = 0
+        for name in ("species", "megadetector"):
+            trained = manifest[name]["kwargs"].get("image_size")
+            if trained is None:
+                # Pre-migration manifest entry (factory run before the
+                # image_size record existed) — retraining will cover it.
+                continue
+            checked += 1
+            served = by_ckpt[name].get("image_size")
+            assert served == trained, (
+                f"{name}: models.json serves at {served}, trained at "
+                f"{trained}")
+        assert checked >= 1, "no manifest entry records image_size"

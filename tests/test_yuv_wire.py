@@ -124,10 +124,16 @@ class TestTrainedModelFidelity:
         from ai4e_tpu.runtime import ModelRuntime, build_servable
         from ai4e_tpu.train.make_checkpoints import species_batch
 
+        import json
+
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         ckpt = os.path.join(repo, "checkpoints", "species")
-        kwargs = dict(image_size=64, stage_sizes=[2, 2, 2], width=32,
-                      num_classes=8, buckets=(8,))
+        manifest = json.load(open(os.path.join(repo, "checkpoints",
+                                               "MANIFEST.json")))
+        kwargs = {k: v for k, v in manifest["species"]["kwargs"].items()
+                  if k != "labels"}
+        size = kwargs.pop("image_size", 64)
+        kwargs.update(image_size=size, buckets=(8,))
         rgb = build_servable("resnet", name="sp-rgb", **kwargs)
         yuv = build_servable("resnet", name="sp-yuv", wire="yuv420", **kwargs)
         rgb.params = load_params(ckpt, like=rgb.params)
@@ -136,7 +142,7 @@ class TestTrainedModelFidelity:
         runtime.register(rgb)
         runtime.register(yuv)
 
-        img, labels = species_batch(np.random.default_rng(42), 8, 64)
+        img, labels = species_batch(np.random.default_rng(42), 8, size)
         batch_u8 = np.clip(np.round(img * 255), 0, 255).astype(np.uint8)
         flat = np.stack([rgb_to_yuv420(x) for x in batch_u8])
 
@@ -161,11 +167,16 @@ class TestDetectorYuvWire:
         from ai4e_tpu.runtime import ModelRuntime, build_servable
         from ai4e_tpu.train.make_checkpoints import detector_batch
 
+        import json
+
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         ckpt = os.path.join(repo, "checkpoints", "megadetector")
-        size = 128
-        kwargs = dict(image_size=size, widths=[64, 128, 256], buckets=(8,),
-                      score_threshold=0.2)
+        manifest = json.load(open(os.path.join(repo, "checkpoints",
+                                               "MANIFEST.json")))
+        mk = dict(manifest["megadetector"]["kwargs"])
+        size = mk.pop("image_size", 128)
+        kwargs = dict(image_size=size, buckets=(8,),
+                      score_threshold=0.2, **mk)
         rgb = build_servable("detector", name="det-rgb", **kwargs)
         yuv = build_servable("detector", name="det-yuv", wire="yuv420",
                              **kwargs)
