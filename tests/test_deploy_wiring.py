@@ -258,16 +258,12 @@ class TestCheckpointServingSizeWiring:
         with open(os.path.join(REPO, "deploy", "specs", "models.json")) as f:
             models = json.load(f)
         by_ckpt = {m.get("checkpoint"): m for m in models["models"]}
-        checked = 0
         for name in ("species", "megadetector"):
             trained = manifest[name]["kwargs"].get("image_size")
-            if trained is None:
-                # Pre-migration manifest entry (factory run before the
-                # image_size record existed) — retraining will cover it.
-                continue
-            checked += 1
+            assert trained is not None, (
+                f"{name} manifest predates the image_size record — retrain "
+                "with the current factory (train_full)")
             served = by_ckpt[name].get("image_size")
             assert served == trained, (
                 f"{name}: models.json serves at {served}, trained at "
                 f"{trained}")
-        assert checked >= 1, "no manifest entry records image_size"

@@ -186,27 +186,29 @@ class TestDetectorYuvWire:
         runtime.register(rgb)
         runtime.register(yuv)
 
-        img, _targets = detector_batch(np.random.default_rng(5), 8, size)
+        from ai4e_tpu.train.make_checkpoints import detection_accuracy
+
+        img, targets = detector_batch(np.random.default_rng(5), 8, size)
         batch_u8 = np.clip(np.round(img * 255), 0, 255).astype(np.uint8)
         flat = np.stack([rgb_to_yuv420(x) for x in batch_u8])
         out_rgb = runtime.run_batch("det-rgb", batch_u8)
         out_yuv = runtime.run_batch("det-yuv", flat)
 
-        found = 0
-        for i in range(8):
-            d1 = rgb.postprocess(
-                {k: np.asarray(v[i]) for k, v in out_rgb.items()})["detections"]
-            d2 = yuv.postprocess(
-                {k: np.asarray(v[i]) for k, v in out_yuv.items()})["detections"]
-            assert len(d1) == len(d2), (i, d1, d2)
-            found += len(d1)
-            for a, b in zip(d1, d2):
-                assert a["class_id"] == b["class_id"]
-                # Box regression sees a few px of chroma-subsampling jitter
-                # (measured ~2.4 px worst on 128 px scenes); detection
-                # identity (count + class) must be exact.
-                np.testing.assert_allclose(a["box"], b["box"], atol=5.0)
-        assert found > 0, "trained detector found nothing — scene bug"
+        # Ground-truth accuracy via the factory's OWN shipped-checkpoint
+        # criterion (shared helper — pairwise set comparison would be
+        # unstable: a 0.917 model's borderline detections enter/leave the
+        # top-k under any 1-LSB input change; the claim under test is that
+        # the codec doesn't cost detection ABILITY). wh tolerance covers
+        # the regression heads: a yuv ingestion bug that distorts box
+        # extents fails here even with centers intact.
+        rgb_hits, total = detection_accuracy(out_rgb, targets,
+                                             wh_rel_tolerance=0.5)
+        yuv_hits, _ = detection_accuracy(out_yuv, targets,
+                                         wh_rel_tolerance=0.5)
+        assert total > 0, "scene generator produced no objects"
+        assert rgb_hits >= 0.8 * total, (rgb_hits, total)  # checkpoint real
+        # The yuv wire may flip at most one borderline object vs rgb.
+        assert yuv_hits >= rgb_hits - 1, (yuv_hits, rgb_hits, total)
 
     def test_odd_size_rejected_at_build_time(self):
         import pytest
