@@ -35,8 +35,22 @@ PY
     echo "== $name -> $(cat "$file" | head -c 200)" >&2
     case "$device" in
         tpu*) return 0 ;;
-        *) echo "== $name landed on '$device' (tunnel died?); stopping" >&2
-           return 1 ;;
+        *)
+            # CPU fallback: either the tunnel died (stop — the remaining
+            # configs would all archive fallbacks) or just THIS config
+            # overran its stage box (continue — one heavy config must not
+            # forfeit the rest of the matrix). One probe decides.
+            echo "== $name landed on '$device'; probing the tunnel" >&2
+            if timeout 150 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((64,64)); (x @ x).block_until_ready()
+assert jax.devices()[0].platform != 'cpu'
+print('PROBE_OK')" 2>/dev/null | grep -q PROBE_OK; then
+                echo "== tunnel alive; $name kept its fallback, continuing" >&2
+                return 0
+            fi
+            echo "== tunnel dead; stopping matrix" >&2
+            return 1 ;;
     esac
 }
 
