@@ -44,9 +44,17 @@ class TaskTimeout(TimeoutError):
 
 class AI4EClient:
     def __init__(self, gateway: str, api_key: str | None = None,
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, retries: int = 4,
+                 retry_backoff: float = 1.0):
+        """``retries``: transparent retries of backpressure responses —
+        429 (per-key rate limit, honoring the gateway's ``Retry-After``
+        delta-seconds) and 503 (admission backpressure) — with exponential
+        backoff when no Retry-After is given. 0 disables (the raw
+        HTTPError surfaces)."""
         self.gateway = gateway.rstrip("/")
         self.timeout = timeout
+        self.retries = retries
+        self.retry_backoff = retry_backoff
         self._headers = {}
         if api_key:
             # The reference's APIM front door header, preserved verbatim.
@@ -60,10 +68,33 @@ class AI4EClient:
         headers = dict(self._headers)
         if content_type:
             headers["Content-Type"] = content_type
-        req = urllib.request.Request(self.gateway + path, data=body,
-                                     headers=headers, method=method)
-        return urllib.request.urlopen(
-            req, timeout=self.timeout if timeout is None else timeout)
+        attempt = 0
+        per_try = self.timeout if timeout is None else timeout
+        # Retry sleeps stay INSIDE the caller's time budget: a wait(
+        # timeout=10) must not block for minutes because status polls are
+        # being throttled with a long Retry-After.
+        deadline = time.monotonic() + per_try
+        while True:
+            req = urllib.request.Request(self.gateway + path, data=body,
+                                         headers=headers, method=method)
+            try:
+                return urllib.request.urlopen(req, timeout=per_try)
+            except urllib.error.HTTPError as exc:
+                if exc.code not in (429, 503) or attempt >= self.retries:
+                    raise
+                retry_after = exc.headers.get("Retry-After")
+                try:
+                    delay = float(retry_after) if retry_after else 0.0
+                except ValueError:
+                    delay = 0.0
+                if delay <= 0:
+                    delay = self.retry_backoff * (2 ** attempt)
+                delay = min(delay, 60.0)
+                if time.monotonic() + delay >= deadline:
+                    raise  # budget exhausted — surface the backpressure
+                exc.close()
+                time.sleep(delay)
+                attempt += 1
 
     # -- async task API ----------------------------------------------------
 

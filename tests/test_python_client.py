@@ -154,3 +154,85 @@ class TestPythonClient:
             record = keyed.wait(keyed.submit("/v1/echo/echo-async", payload),
                                 timeout=60, poll_wait=5)
             assert "completed" in record["Status"]
+
+
+class TestBackpressureRetry:
+    @staticmethod
+    def _stub_server(script):
+        """Context manager: HTTP server answering POSTs from ``script`` —
+        a list of (status, headers, body) consumed in order (the last entry
+        repeats) — yielding (base_url, call_times)."""
+        import contextlib
+        import http.server
+        import time as _time
+
+        calls = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                calls.append(_time.monotonic())
+                status, headers, body = script[min(len(calls) - 1,
+                                                   len(script) - 1)]
+                self.send_response(status)
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                if body:
+                    self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        @contextlib.contextmanager
+        def running():
+            srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            try:
+                yield f"http://127.0.0.1:{srv.server_address[1]}", calls
+            finally:
+                srv.shutdown()
+                srv.server_close()
+
+        return running()
+
+    def test_429_retried_honoring_retry_after(self):
+        """SDK transparently retries throttled requests: two 429s with
+        Retry-After, then success — caller sees only the result."""
+        import json as _json
+
+        ok = (200, {"Content-Type": "application/json"},
+              _json.dumps({"TaskId": "t-1"}).encode())
+        throttle = (429, {"Retry-After": "1"}, b"")
+        with self._stub_server([throttle, throttle, ok]) as (url, calls):
+            client = ai4e_client.AI4EClient(url)
+            assert client.submit("/v1/api/run", b"x") == "t-1"
+            assert len(calls) == 3
+            # Retry-After honored: >=1s between attempts.
+            assert calls[1] - calls[0] >= 0.9
+            assert calls[2] - calls[1] >= 0.9
+
+    def test_retries_exhausted_surfaces_429(self):
+        import urllib.error
+
+        with self._stub_server([(429, {"Retry-After": "1"}, b"")]) as (url, _):
+            client = ai4e_client.AI4EClient(url, retries=1)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                client.submit("/v1/api/run", b"x")
+            assert err.value.code == 429
+
+    def test_retry_sleeps_respect_the_time_budget(self):
+        """A long Retry-After must not stretch a short-budget call: the
+        429 surfaces once the next sleep would cross the deadline."""
+        import time as _time
+        import urllib.error
+
+        with self._stub_server([(429, {"Retry-After": "60"}, b"")]) as (url, _):
+            client = ai4e_client.AI4EClient(url, timeout=2.0, retries=4)
+            t0 = _time.monotonic()
+            with pytest.raises(urllib.error.HTTPError) as err:
+                client.submit("/v1/api/run", b"x")
+            assert err.value.code == 429
+            assert _time.monotonic() - t0 < 2.0  # no 60s sleep happened
