@@ -19,6 +19,17 @@ import asyncio
 import time
 
 
+def _backoff(resp) -> float:
+    """Sleep for a backpressure response: Retry-After when the server sent
+    one (capped at 2 s — a closed-loop client that idles longer just
+    under-measures), else a short yield."""
+    retry_after = resp.headers.get("Retry-After")
+    try:
+        return min(float(retry_after), 2.0) if retry_after else 0.05
+    except ValueError:
+        return 0.05
+
+
 async def run_closed_loop(
     session,
     *,
@@ -55,8 +66,12 @@ async def run_closed_loop(
         try:
             async with session.post(post_url, data=payload,
                                     headers=headers) as resp:
-                if resp.status == 503:  # admission backpressure: not a failure
-                    await asyncio.sleep(0.05)
+                if resp.status in (503, 429):
+                    # Backpressure (admission 503 / per-key throttle 429):
+                    # not a failure — yield briefly and re-enter. The client
+                    # honors Retry-After when present, capped so one long
+                    # hint can't idle the closed loop past the window.
+                    await asyncio.sleep(_backoff(resp))
                     return
                 task = await resp.json()
             task_id = task["TaskId"]
@@ -102,8 +117,8 @@ async def run_closed_loop(
         try:
             async with session.post(post_url, data=payload,
                                     headers=headers) as resp:
-                if resp.status == 503:
-                    await asyncio.sleep(0.05)
+                if resp.status in (503, 429):
+                    await asyncio.sleep(_backoff(resp))
                     return
                 await resp.read()
                 ok = resp.status == 200

@@ -141,3 +141,37 @@ class TestAsyncMode:
                                           mode="async")
 
         run(main())
+
+
+class TestThrottleBackpressure:
+    def test_429_is_backpressure_not_failure(self):
+        """A rate-limited deployment throttles the load tool; throttled
+        requests re-enter (honoring a capped Retry-After), never counting
+        as failures."""
+        import itertools as _it
+
+        outcomes = _it.cycle([429, 200, 200])
+
+        async def main():
+            async def handler(request):
+                if next(outcomes) == 429:
+                    return web.Response(status=429, text="slow down",
+                                        headers={"Retry-After": "1"})
+                return web.json_response({"ok": True})
+
+            app = web.Application()
+            app.router.add_post("/api", handler)
+            runner, port = await _serve(app)
+            try:
+                async with ClientSession(
+                        connector=TCPConnector(limit=0)) as session:
+                    return await run_closed_loop(
+                        session, post_url=f"http://127.0.0.1:{port}/api",
+                        payload=b"x", headers={}, mode="sync",
+                        concurrency=4, duration=1.0, ramp=0.2)
+            finally:
+                await runner.cleanup()
+
+        window = run(main())
+        assert window["completed"] > 0
+        assert window["failed"] == 0  # throttling is not failure
