@@ -54,6 +54,7 @@ export MAX_DELIVERY_COUNT=1440
 export PUSH_TTL_SECONDS=300            # deploy_event_grid_subscription.sh:37 (TTL 5 min)
 export PUSH_MAX_ATTEMPTS=3             # same line (3 delivery attempts)
 export TASK_JOURNAL_PATH="/var/lib/ai4e/tasks.jsonl"   # durable task log (PV)
+export RATE_LIMIT_RPS="0"   # per-subscription-key throttle; 0 = unlimited
 
 # -- request reporter (reference deploy_request_reporter_function.sh) --------
 export DEPLOY_REPORTER=true

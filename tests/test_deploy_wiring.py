@@ -267,3 +267,48 @@ class TestCheckpointServingSizeWiring:
             assert served == trained, (
                 f"{name}: models.json serves at {served}, trained at "
                 f"{trained}")
+
+
+class TestChartEnvNames:
+    def test_every_chart_env_var_is_a_real_config_field(self):
+        """A typo'd AI4E_* name in a chart makes every pod crash at startup
+        (FrameworkConfig.from_env rejects unknown variables) — catch it at
+        review time instead. Validates NAMES only; values are deploy-time
+        ${TEMPLATE} substitutions."""
+        import glob
+
+        from ai4e_tpu import config as cfg
+        from ai4e_tpu.config import FrameworkConfig
+
+        valid = set()
+        import dataclasses
+        for f in dataclasses.fields(FrameworkConfig):
+            section = f.default_factory()
+            prefix = type(section)._env_prefix
+            for sf in dataclasses.fields(section):
+                valid.add(prefix + sf.name.upper())
+        # Non-config env the components read directly.
+        valid |= {"AI4E_FEED_ADVERTISE_IP"}
+
+        def docs_with_placeholders(path):
+            # Deploy-time ${VARS} make some charts invalid YAML until
+            # substitution — replace with a dummy scalar for parsing.
+            with open(path) as f:
+                text = re.sub(r"\$\{[A-Z_]+\}", "0", f.read())
+            return [d for d in yaml.safe_load_all(text) if d]
+
+        seen = 0
+        for chart in glob.glob(os.path.join(CHARTS, "*.yaml")):
+            for doc in docs_with_placeholders(chart):
+                if doc.get("kind") != "Deployment":
+                    continue
+                for c in doc["spec"]["template"]["spec"]["containers"]:
+                    for env in c.get("env", []):
+                        name = env["name"]
+                        if not name.startswith("AI4E_"):
+                            continue
+                        seen += 1
+                        assert name in valid, (
+                            f"{os.path.basename(chart)}: {name} is not a "
+                            f"config field (valid: {sorted(valid)})")
+        assert seen >= 10  # the charts really do carry the config tier
