@@ -17,6 +17,7 @@ app, so services on other hosts can share one task store:
 
 from __future__ import annotations
 
+import asyncio
 import json
 
 from aiohttp import web
@@ -117,12 +118,40 @@ def make_app(store: InMemoryTaskStore,
         task_id = request.query.get("taskId", "")
         if not task_id:
             return web.json_response({"error": "taskId required"}, status=400)
-        found = store.get_result(task_id,
-                                 stage=request.query.get("stage") or None)
+        opener = getattr(store, "open_result", None)
+        if opener is None:  # stores without streaming (native): buffer
+            found = store.get_result(task_id,
+                                     stage=request.query.get("stage") or None)
+            if found is None:
+                return web.Response(status=204)
+            body, content_type = found
+            return web.Response(body=body, content_type=content_type)
+        found = opener(task_id, stage=request.query.get("stage") or None)
         if found is None:
             return web.Response(status=204)
-        body, content_type = found
-        return web.Response(body=body, content_type=content_type)
+        fh, content_type, size = found
+        # Stream in chunks: an offloaded multi-MB batch output must not
+        # buffer whole in server memory per concurrent download.
+        resp = web.StreamResponse(
+            headers={"Content-Type": content_type,
+                     "Content-Length": str(size)})
+        try:
+            # prepare() inside the handle's try: a client that drops the
+            # connection here must not leak the blob fd.
+            await resp.prepare(request)
+            loop = asyncio.get_running_loop()
+            while True:
+                # Reads off the event loop: on a GCS-FUSE-backed root each
+                # read is a network syscall, and blocking here would stall
+                # every concurrent request on the shared port.
+                chunk = await loop.run_in_executor(None, fh.read, 256 * 1024)
+                if not chunk:
+                    break
+                await resp.write(chunk)
+        finally:
+            fh.close()
+        await resp.write_eof()
+        return resp
 
     app.router.add_post("/v1/taskstore/upsert", upsert)
     app.router.add_post("/v1/taskstore/update", update)

@@ -33,6 +33,17 @@ class ResultBackend:
     def delete(self, key: str) -> None:
         raise NotImplementedError
 
+    def open(self, key: str):
+        """Streaming read: ``(file_like, content_type, size)`` or None.
+        Default adapts ``get`` (in-memory); file backends override with a
+        real handle so multi-MB results never buffer whole."""
+        found = self.get(key)
+        if found is None:
+            return None
+        import io
+        data, content_type = found
+        return io.BytesIO(data), content_type, len(data)
+
 
 class FileResultBackend(ResultBackend):
     """Results as files under a root directory (local dir, PD mount, or GCS
@@ -80,3 +91,14 @@ class FileResultBackend(ResultBackend):
                 os.unlink(os.path.join(self.root, name + suffix))
             except FileNotFoundError:
                 pass
+
+    def open(self, key: str):
+        name = self._name(key)
+        try:
+            with open(os.path.join(self.root, name + ".meta"), "rb") as f:
+                content_type = f.read().decode()
+            fh = open(os.path.join(self.root, name + ".bin"), "rb")  # noqa: SIM115
+        except FileNotFoundError:
+            return None
+        size = os.fstat(fh.fileno()).st_size
+        return fh, content_type, size

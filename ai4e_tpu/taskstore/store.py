@@ -360,6 +360,24 @@ class InMemoryTaskStore(StoreSideEffects):
             return fetched
         return body, content_type
 
+    def open_result(self, task_id: str, stage: str | None = None):
+        """Streaming accessor: ``(file_like, content_type, size)`` or None.
+        Offloaded results stream straight from the backend (a multi-MB
+        batch output never buffers whole in store/server memory); inline
+        results adapt through BytesIO so callers have ONE read path."""
+        key = task_id if stage is None else f"{task_id}:{stage}"
+        with self._lock:
+            found = self._results.get(key)
+        if found is None:
+            return None
+        body, content_type = found
+        if body is None:
+            if self._result_backend is None:
+                return None
+            return self._result_backend.open(key)
+        import io
+        return io.BytesIO(body), content_type, len(body)
+
     # -- status-set queries (queue-depth metrics, QueueLogger.cs:21-47) ----
 
     def set_len(self, endpoint_path: str, status: str) -> int:

@@ -198,3 +198,43 @@ class TestBodyCap:
                 await client.close()
 
         run(main())
+
+
+class TestStreamingResults:
+    def test_offloaded_result_streams_with_length(self, tmp_path):
+        """Large (offloaded) results stream from the blob backend in chunks
+        — never buffered whole in server memory — with an honest
+        Content-Length; inline results ride the same path."""
+        from ai4e_tpu.taskstore import APITask, FileResultBackend
+
+        store = InMemoryTaskStore(
+            result_backend=FileResultBackend(str(tmp_path / "blobs")),
+            result_offload_threshold=1024)
+
+        async def main():
+            client = TestClient(TestServer(make_app(store)))
+            await client.start_server()
+            try:
+                t = store.upsert(APITask(endpoint="http://h/v1/api",
+                                         body=b"x"))
+                big = bytes(range(256)) * 4096  # 1 MiB, offloaded
+                store.set_result(t.task_id, big,
+                                 content_type="application/octet-stream")
+                resp = await client.get(
+                    f"/v1/taskstore/result?taskId={t.task_id}")
+                assert resp.status == 200
+                assert resp.headers["Content-Length"] == str(len(big))
+                assert await resp.read() == big
+
+                store.set_result(t.task_id, b"tiny", stage="s")  # inline
+                resp = await client.get(
+                    f"/v1/taskstore/result?taskId={t.task_id}&stage=s")
+                assert await resp.read() == b"tiny"
+                # Absent results still 204 through the streaming path.
+                resp = await client.get(
+                    "/v1/taskstore/result?taskId=" + t.task_id + "&stage=no")
+                assert resp.status == 204
+            finally:
+                await client.close()
+
+        run(main())
