@@ -226,10 +226,30 @@ def build_worker(config: FrameworkConfig, models: dict):
              if k.strip()), None)
         task_manager = HttpTaskManager(store_base, api_key=key)
         store = HttpResultStore(store_base, api_key=key)
+        if config.service.result_dir:
+            # Direct-to-storage results: large outputs write to the shared
+            # result mount (same root the control plane serves via
+            # AI4E_PLATFORM_RESULT_DIR) and only a pointer crosses the
+            # control network — the reference's containers-write-to-blob
+            # architecture.
+            from .service.task_manager import DirectResultStore
+            store = DirectResultStore(
+                config.service.result_dir, store,
+                threshold=config.service.result_offload_threshold)
     else:
-        # Standalone worker (dev): own in-memory store.
+        # Standalone worker (dev): own in-memory store. result_dir becomes
+        # the store's OWN offload backend (no control plane to register
+        # pointers with — DirectResultStore would be a wrapper around a
+        # backend-less store and every large result would be refused).
         from .taskstore import InMemoryTaskStore
-        store = InMemoryTaskStore()
+        result_backend = None
+        threshold = None
+        if config.service.result_dir:
+            from .taskstore.results import FileResultBackend
+            result_backend = FileResultBackend(config.service.result_dir)
+            threshold = config.service.result_offload_threshold
+        store = InMemoryTaskStore(result_backend=result_backend,
+                                  result_offload_threshold=threshold)
         task_manager = LocalTaskManager(store)
 
     reporter = None

@@ -176,6 +176,11 @@ class ServiceSection:
     # Subscription key the worker attaches to task-store calls when the
     # control plane runs with gateway api_keys (same secret).
     taskstore_api_key: typing.Optional[str] = None
+    # Direct-to-storage results: large outputs write to this shared mount
+    # (the SAME root the control plane serves via AI4E_PLATFORM_RESULT_DIR)
+    # and only a pointer registration crosses the control network.
+    result_dir: typing.Optional[str] = None
+    result_offload_threshold: int = 1048576
 
 
 @_env_section("AI4E_RUNTIME_")

@@ -189,6 +189,28 @@ class HttpResultStore(_HttpStoreClient):
                 return
             resp.raise_for_status()
 
+    async def set_result_ref(self, task_id: str,
+                             content_type: str = "application/json",
+                             stage: str | None = None) -> None:
+        """Register a blob already written to the shared result backend
+        (direct-to-storage workers) — tiny JSON instead of the payload."""
+        payload = {"TaskId": task_id, "ContentType": content_type}
+        if stage:
+            payload["Stage"] = stage
+        session = await self._get_session()
+        async with session.post(
+            f"{self.base_url}/v1/taskstore/result-ref",
+            data=json.dumps(payload),
+        ) as resp:
+            if resp.status == 404:
+                import logging
+                logging.getLogger("ai4e_tpu.task_manager").warning(
+                    "result ref for unknown task %s dropped by store",
+                    task_id)
+                return False  # caller may reap the orphaned blob
+            resp.raise_for_status()
+            return True
+
     async def get_result(self, task_id: str,
                          stage: str | None = None
                          ) -> tuple[bytes, str] | None:
@@ -202,6 +224,70 @@ class HttpResultStore(_HttpStoreClient):
             if resp.status != 200:
                 return None
             return await resp.read(), resp.content_type
+
+
+class DirectResultStore:
+    """Worker-side direct-to-storage results — the reference's
+    blob-access slot (containers write outputs straight to storage,
+    ``APIs/helpers/assign_storage_auth_to_aks.sh:9-17``): payloads at or
+    over ``threshold`` bytes write to the SHARED result mount under the
+    canonical key and only a pointer registration crosses the control
+    network; smaller results fall through to the wrapped store. The mount
+    must be the same root the control plane serves
+    (``AI4E_PLATFORM_RESULT_DIR``) — a mis-mount surfaces as a 409 on
+    registration, never as a dangling pointer."""
+
+    def __init__(self, root: str, inner, threshold: int = 1024 * 1024):
+        from ..taskstore.results import FileResultBackend
+
+        self.backend = FileResultBackend(root)
+        self.inner = inner
+        self.threshold = threshold
+
+    async def set_result(self, task_id: str, result: bytes,
+                         content_type: str = "application/json",
+                         stage: str | None = None) -> None:
+        import asyncio
+        import inspect
+
+        if len(result) >= self.threshold:
+            key = task_id if stage is None else f"{task_id}:{stage}"
+            # Blob write off the event loop (shared mounts are slow I/O),
+            # BEFORE the pointer registration.
+            await asyncio.to_thread(self.backend.put, key, result,
+                                    content_type)
+            try:
+                res = self.inner.set_result_ref(task_id, content_type,
+                                                stage=stage)
+                if inspect.isawaitable(res):
+                    res = await res
+            except Exception:
+                # Registration failed: reap the just-written blob or it
+                # leaks on the shared mount forever.
+                await asyncio.to_thread(self.backend.delete, key)
+                raise
+            if res is False:  # store dropped the ref (unknown task)
+                await asyncio.to_thread(self.backend.delete, key)
+            return
+        res = self.inner.set_result(task_id, result, content_type,
+                                    stage=stage)
+        if inspect.isawaitable(res):
+            await res
+
+    async def get_result(self, task_id: str, stage: str | None = None):
+        import inspect
+
+        res = self.inner.get_result(task_id, stage=stage)
+        return await res if inspect.isawaitable(res) else res
+
+    async def close(self) -> None:
+        import inspect
+
+        close = getattr(self.inner, "close", None)
+        if close is not None:
+            res = close()
+            if inspect.isawaitable(res):
+                await res
 
 
 def next_endpoint_from(current_endpoint: str, version: str, organization: str,

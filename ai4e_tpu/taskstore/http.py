@@ -158,6 +158,44 @@ def make_app(store: InMemoryTaskStore,
     app.router.add_get("/v1/taskstore/task", get_task)
     app.router.add_get("/v1/taskstore/task/{task_id}", get_task)
     app.router.add_get("/v1/taskstore/depths", depths)
+    async def put_result_ref(request: web.Request) -> web.Response:
+        """Register a direct-to-storage result: the worker wrote the blob to
+        the shared backend itself; only this tiny pointer crosses the
+        control network (the reference's containers-write-to-blob-storage
+        architecture)."""
+        raw = await read_body_limited(request, max_body_bytes)
+        if raw is None:
+            return too_large(max_body_bytes)
+        try:
+            payload = json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        task_id = payload.get("TaskId", "")
+        if not task_id:
+            return web.json_response({"error": "TaskId required"}, status=400)
+        register = getattr(store, "set_result_ref", None)
+        if register is None:  # e.g. the native store: no ref support
+            return web.json_response(
+                {"error": "store does not support result refs"}, status=400)
+        try:
+            store.set_result_ref(
+                task_id,
+                content_type=payload.get("ContentType")
+                or "application/json",
+                stage=payload.get("Stage") or None)
+        except TaskNotFound:
+            return web.json_response({"error": f"unknown task {task_id}"},
+                                     status=404)
+        except FileNotFoundError as exc:
+            # Pointer before blob — a registration race or a mis-mounted
+            # worker; 409 so the worker fails loudly instead of serving a
+            # dangling pointer.
+            return web.json_response({"error": str(exc)}, status=409)
+        except RuntimeError as exc:  # store has no backend configured
+            return web.json_response({"error": str(exc)}, status=400)
+        return web.json_response({"ok": True})
+
     app.router.add_post("/v1/taskstore/result", put_result)
+    app.router.add_post("/v1/taskstore/result-ref", put_result_ref)
     app.router.add_get("/v1/taskstore/result", get_result)
     return app

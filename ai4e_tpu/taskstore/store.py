@@ -360,6 +360,31 @@ class InMemoryTaskStore(StoreSideEffects):
             return fetched
         return body, content_type
 
+    def set_result_ref(self, task_id: str,
+                       content_type: str = "application/json",
+                       stage: str | None = None) -> None:
+        """Register a result the caller ALREADY wrote to the shared backend
+        under the canonical key — the direct-to-storage worker path (the
+        reference gives its containers blob-storage access so outputs never
+        transit the control plane, ``assign_storage_auth_to_aks.sh:9-17``).
+        The blob's existence is verified BEFORE the pointer becomes visible:
+        a reader that sees the pointer must always find the blob."""
+        if self._result_backend is None:
+            raise RuntimeError(
+                "no result backend configured (set result_dir) — cannot "
+                "register a direct-to-storage result")
+        key = task_id if stage is None else f"{task_id}:{stage}"
+        found = self._result_backend.open(key)
+        if found is None:
+            raise FileNotFoundError(
+                f"result blob {key!r} not present in the backend — write "
+                "it before registering the pointer")
+        found[0].close()
+        with self._lock:
+            if task_id not in self._tasks:
+                raise TaskNotFound(task_id)
+            self._apply_set_result(key, None, content_type)
+
     def open_result(self, task_id: str, stage: str | None = None):
         """Streaming accessor: ``(file_like, content_type, size)`` or None.
         Offloaded results stream straight from the backend (a multi-MB

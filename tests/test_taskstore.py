@@ -609,3 +609,55 @@ class TestTerminalEviction:
         with pytest.raises(ValueError, match="eviction"):
             LocalPlatform(PlatformConfig(native_store=True,
                                          reaper_terminal_retention=60.0))
+
+
+class TestDirectToStorageResults:
+    """The reference's containers write batch outputs straight to blob
+    storage (assign_storage_auth_to_aks.sh) — here workers write the shared
+    result mount and register only a pointer."""
+
+    def test_ref_registers_existing_blob(self, tmp_path):
+        from ai4e_tpu.taskstore import FileResultBackend
+
+        backend = FileResultBackend(str(tmp_path / "blobs"))
+        store = InMemoryTaskStore(result_backend=backend,
+                                  result_offload_threshold=10**9)
+        t = store.upsert(make_task())
+        backend.put(t.task_id, b"worker-wrote-this", "application/json")
+        store.set_result_ref(t.task_id)
+        assert store.get_result(t.task_id) == (b"worker-wrote-this",
+                                               "application/json")
+
+    def test_ref_without_blob_refused(self, tmp_path):
+        from ai4e_tpu.taskstore import FileResultBackend
+
+        store = InMemoryTaskStore(
+            result_backend=FileResultBackend(str(tmp_path / "b")))
+        t = store.upsert(make_task())
+        with pytest.raises(FileNotFoundError):
+            store.set_result_ref(t.task_id)
+
+    def test_ref_without_backend_refused(self):
+        store = InMemoryTaskStore()
+        t = store.upsert(make_task())
+        with pytest.raises(RuntimeError, match="backend"):
+            store.set_result_ref(t.task_id)
+
+    def test_journaled_ref_survives_restart(self, tmp_path):
+        from ai4e_tpu.taskstore import FileResultBackend
+
+        journal = str(tmp_path / "j.jsonl")
+        blobs = str(tmp_path / "blobs")
+        backend = FileResultBackend(blobs)
+        store = JournaledTaskStore(journal, result_backend=backend)
+        t = store.upsert(make_task())
+        backend.put(t.task_id, b"direct" * 100, "application/octet-stream")
+        store.set_result_ref(t.task_id,
+                             content_type="application/octet-stream")
+        store.close()
+
+        revived = JournaledTaskStore(journal,
+                                     result_backend=FileResultBackend(blobs))
+        assert revived.get_result(t.task_id) == (
+            b"direct" * 100, "application/octet-stream")
+        revived.close()

@@ -238,3 +238,98 @@ class TestStreamingResults:
                 await client.close()
 
         run(main())
+
+
+class TestDirectResultStore:
+    def test_worker_writes_blob_registers_pointer_store_serves(
+            self, tmp_path):
+        """Full direct-to-storage loop over HTTP: the worker-side
+        DirectResultStore writes the shared mount and POSTs only a ref; the
+        control-plane store then streams the blob to pollers."""
+        from ai4e_tpu.service.task_manager import (DirectResultStore,
+                                                   HttpResultStore)
+        from ai4e_tpu.taskstore import APITask, FileResultBackend
+
+        root = str(tmp_path / "shared")
+        store = InMemoryTaskStore(result_backend=FileResultBackend(root))
+
+        async def main():
+            client = TestClient(TestServer(make_app(store)))
+            await client.start_server()
+            direct = DirectResultStore(
+                root, HttpResultStore(str(client.make_url("")),
+                                      session=client.session),
+                threshold=64)
+            try:
+                t = store.upsert(APITask(endpoint="http://h/v1/api",
+                                         body=b"x"))
+                big = b"\x5a" * 4096
+                await direct.set_result(t.task_id, big,
+                                        "application/octet-stream")
+                # The payload never crossed the HTTP surface; the store
+                # serves it from the shared root.
+                resp = await client.get(
+                    f"/v1/taskstore/result?taskId={t.task_id}")
+                assert await resp.read() == big
+                assert resp.headers["Content-Type"] == (
+                    "application/octet-stream")
+                # Small results still upload inline.
+                await direct.set_result(t.task_id, b"tiny", stage="s")
+                got = await direct.get_result(t.task_id, stage="s")
+                assert got == (b"tiny", "application/octet-stream") or \
+                    got[0] == b"tiny"
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_ref_for_missing_blob_is_409(self, tmp_path):
+        from ai4e_tpu.taskstore import APITask, FileResultBackend
+
+        store = InMemoryTaskStore(
+            result_backend=FileResultBackend(str(tmp_path / "b")))
+
+        async def main():
+            client = TestClient(TestServer(make_app(store)))
+            await client.start_server()
+            try:
+                t = store.upsert(APITask(endpoint="http://h/v1/api",
+                                         body=b"x"))
+                import json as _json
+                resp = await client.post(
+                    "/v1/taskstore/result-ref",
+                    data=_json.dumps({"TaskId": t.task_id}))
+                assert resp.status == 409
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_dropped_ref_reaps_the_orphan_blob(self, tmp_path):
+        """Control plane no longer knows the task (restart/eviction): the
+        worker's blob must be reaped, not left on the shared mount forever."""
+        import os
+
+        from ai4e_tpu.service.task_manager import (DirectResultStore,
+                                                   HttpResultStore)
+
+        root = str(tmp_path / "shared")
+        store = InMemoryTaskStore(result_backend=None)
+
+        async def main():
+            from ai4e_tpu.taskstore import FileResultBackend
+            served = InMemoryTaskStore(
+                result_backend=FileResultBackend(root))
+            client = TestClient(TestServer(make_app(served)))
+            await client.start_server()
+            direct = DirectResultStore(
+                root, HttpResultStore(str(client.make_url("")),
+                                      session=client.session),
+                threshold=8)
+            try:
+                await direct.set_result("no-such-task", b"B" * 64)
+                assert os.listdir(root) == []  # orphan reaped
+            finally:
+                await client.close()
+
+        run(main())
