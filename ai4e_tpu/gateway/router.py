@@ -282,6 +282,10 @@ class Gateway:
                     except asyncio.TimeoutError:
                         pass
                     task = self.store.get(task_id)
+            except TaskNotFound:
+                # Retention evicted the task mid-wait (tight retention
+                # config) — answer like any unknown task, not with a 500.
+                return web.Response(status=404, text="Task not found.")
             finally:
                 self._drop_waiter(task_id, event)
         return web.json_response(task.to_dict())

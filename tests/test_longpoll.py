@@ -129,3 +129,45 @@ class TestGatewayLongPoll:
                 await gw.close()
 
         run(main())
+
+
+class TestEvictionDuringLongPoll:
+    def test_task_evicted_mid_wait_is_404_not_500(self):
+        """A tight terminal-retention config can evict a task while a
+        long-poll waiter sleeps on it — the poller gets the same 404 an
+        unknown task gets."""
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from ai4e_tpu.gateway import Gateway
+        from ai4e_tpu.taskstore import APITask, InMemoryTaskStore
+
+        async def main():
+            store = InMemoryTaskStore()
+            gw = Gateway(store)
+            client = TestClient(TestServer(gw.app))
+            await client.start_server()
+            try:
+                t = store.upsert(APITask(endpoint="http://h/v1/api",
+                                         body=b"x"))
+
+                async def evict_soon():
+                    await asyncio.sleep(0.2)
+                    # complete (wakes the waiter) then evict before the
+                    # waiter's re-read.
+                    with store._lock:
+                        store._apply_evict(t.task_id)
+                    for _loop, event in gw._waiters.get(t.task_id,
+                                                        frozenset()):
+                        _loop.call_soon_threadsafe(event.set)
+
+                asyncio.ensure_future(evict_soon())
+                resp = await client.get(
+                    f"/v1/taskmanagement/task/{t.task_id}",
+                    params={"wait": "5"})
+                assert resp.status == 404
+            finally:
+                await client.close()
+
+        asyncio.run(main())
