@@ -298,8 +298,12 @@ class FrameworkConfig:
         # would silently keep every default — catch it here.
         env_map = os.environ if env is None else env
         prefixes = tuple(s._env_prefix for s in sections.values())
+        # AI4E_FAULT_* is the fault-injection namespace (e.g.
+        # AI4E_FAULT_FETCH_FAIL_NTHS, parallel/multihost.py) — read directly
+        # by the failure paths under test, never part of the typed config.
         unknown = [k for k in env_map
-                   if k.startswith("AI4E_") and not k.startswith(prefixes)]
+                   if k.startswith("AI4E_") and not k.startswith(prefixes)
+                   and not k.startswith("AI4E_FAULT_")]
         if unknown:
             raise ConfigError(
                 f"unknown config section in variable(s) {sorted(unknown)}; "

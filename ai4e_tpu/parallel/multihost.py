@@ -60,6 +60,15 @@ def is_primary() -> bool:
     return jax.process_index() == 0
 
 
+def _fault_fetch_nths() -> frozenset[int]:
+    """Fault-injection knob: 1-based shard-fetch ordinals this follower
+    should fail (comma-separated in AI4E_FAULT_FETCH_FAIL_NTHS). Empty in
+    production; tests use it to drive the degradation path end to end."""
+    import os
+    raw = os.environ.get("AI4E_FAULT_FETCH_FAIL_NTHS", "")
+    return frozenset(int(s) for s in raw.split(",") if s.strip())
+
+
 class _ShardFeed:
     """Host-local HTTP server on the primary staging per-follower batch rows.
 
@@ -195,6 +204,7 @@ class MultihostRuntime:
         self.last_egress_bytes = 0
         self.total_egress_bytes = 0
         self.last_ingest_s = 0.0
+        self._fetch_count = 0  # fault-injection ordinal (follower side)
         if jax.process_count() > 1:
             self._open_feed()
 
@@ -347,6 +357,15 @@ class MultihostRuntime:
                 at += b - a
             poisoned = 0
             try:
+                self._fetch_count += 1
+                if self._fetch_count in _fault_fetch_nths():
+                    # Fault injection (SURVEY.md §5 — the reference has
+                    # none): AI4E_FAULT_FETCH_FAIL_NTHS="2,5" makes this
+                    # follower's 2nd and 5th shard fetches fail, driving
+                    # the zeros-shard + poison-report path in real
+                    # multi-process tests.
+                    raise RuntimeError(
+                        f"injected fetch fault #{self._fetch_count}")
                 raw = (_fetch(f"{self._feed_url}/shard/{seq}/{me}",
                               self._feed_token)
                        if ranges else b"")
