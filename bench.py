@@ -211,14 +211,15 @@ def _build_pipeline_servables(args):
     det_size = _serving_size(det_kwargs, det_mf, "megadetector")
     det = build_servable(
         "detector", name="megadetector", image_size=det_size,
-        score_threshold=0.15, buckets=tuple(args.buckets), **det_kwargs)
+        score_threshold=0.15, buckets=tuple(args.buckets), wire=args.wire,
+        **det_kwargs)
     det.params, m1 = _load_or_train_checkpoint(
         "megadetector", args.checkpoint_dir, det.params, required=True)
     sp_kwargs, sp_mf = _manifest_kwargs(args.checkpoint_dir, "species")
     sp_size = _serving_size(sp_kwargs, sp_mf, "species")
     sp = build_servable(
         "resnet", name="species", image_size=sp_size,
-        buckets=tuple(args.buckets), **sp_kwargs)
+        buckets=tuple(args.buckets), wire=args.wire, **sp_kwargs)
     sp.params, m2 = _load_or_train_checkpoint(
         "species", args.checkpoint_dir, sp.params, required=True)
 
@@ -231,7 +232,8 @@ def _build_pipeline_servables(args):
         np.clip(np.round(img[0] * 255), 0, 255).astype(np.uint8)
     ).save(buf, "JPEG", quality=92)
     meta = {"detector_checkpoint": m1.get("checkpoint"),
-            "species_checkpoint": m2.get("checkpoint")}
+            "species_checkpoint": m2.get("checkpoint"),
+            "wire": args.wire}
     return det, sp, buf.getvalue(), meta
 
 

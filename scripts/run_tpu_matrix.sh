@@ -64,4 +64,5 @@ run_one megadetector16  --model megadetector --buckets 1 8 16      || exit 1
 run_one species         --model species                            || exit 1
 run_one megadet_yuv     --model megadetector --buckets 1 8 16 --wire yuv420 || exit 1
 run_one species_yuv     --model species --wire yuv420              || exit 1
+run_one pipeline_yuv    --model pipeline --wire yuv420             || exit 1
 echo "== matrix complete: $(ls "$OUT"/*.json | wc -l) JSONs in $OUT ==" >&2
