@@ -283,11 +283,19 @@ def train_megadetector(steps: int = 150, image_size: int = 128,
         if step % 25 == 0:
             log.info("megadetector step %d loss %.4f", step, float(loss))
 
+    # Eval over several batches: one batch of 8 scenes holds only ~12
+    # objects, so a single borderline detection swings the measured accuracy
+    # by ~8% — enough to flip the convergence gate on backend numerics alone
+    # (observed 10/12 on TPU where CPU passed). ~48 objects is stable.
     eval_rng = np.random.default_rng(seed + 1)
-    img, targets = detector_batch(eval_rng, batch, image_size)
-    out = jax.jit(lambda p, x: decode_detections(model.apply(p, x)))(
-        tr.params, img)
-    hits, total = detection_accuracy(out, targets)
+    decode = jax.jit(lambda p, x: decode_detections(model.apply(p, x)))
+    hits = total = 0
+    for _ in range(4):
+        img, targets = detector_batch(eval_rng, batch, image_size)
+        out = decode(tr.params, img)
+        h, t = detection_accuracy(out, targets)
+        hits += h
+        total += t
     acc = hits / max(total, 1)
     log.info("megadetector eval detection-acc %.3f (%d/%d)", acc, hits, total)
     return {"params": tr.params, "eval": {"detection_accuracy": round(acc, 4)},
@@ -371,7 +379,10 @@ def make_checkpoint(name: str, out_dir: str, min_eval: float = MIN_EVAL,
 # 0.12@224 with 64-trained weights), so every full (non --fast) training —
 # the CLI's and the bench's train-on-the-spot path — goes through these.
 FULL_OVERRIDES = {
-    "megadetector": {"image_size": 512},
+    # 300 steps at 512: the 150-step default converged to the gate's edge
+    # (0.83-0.87 depending on backend numerics); doubling the schedule puts
+    # the eval comfortably above the 0.85 floor on both CPU and TPU.
+    "megadetector": {"image_size": 512, "steps": 300},
     "species": {"image_size": 224, "steps": 120},
 }
 
