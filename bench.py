@@ -715,10 +715,16 @@ def main() -> None:
                         help="trained weights (ai4e_tpu.train.make_checkpoints)")
     parser.add_argument("--seq-len", type=int, default=4096,
                         help="sequence length for --model longcontext")
-    parser.add_argument("--wire", choices=("rgb8", "yuv420"), default="rgb8",
+    parser.add_argument("--wire", choices=("rgb8", "yuv420"), default="yuv420",
                         help="h2d encoding for the image configs (landcover/"
                              "megadetector/species): raw uint8 or YUV 4:2:0 "
-                             "planes (halves host->device bytes; ops/yuv.py)")
+                             "planes (halves host->device bytes; ops/yuv.py). "
+                             "yuv420 is the default/production wire: it "
+                             "carries the same chroma content a JPEG source "
+                             "had, fidelity is test-gated against the trained "
+                             "checkpoints, and the r3 matrix measured it at "
+                             "1.39-1.68x the rgb8 throughput on the "
+                             "link-bound configs")
     parser.add_argument("--cpu", action="store_true",
                         help="force CPU (debug runs)")
     parser.add_argument("--probe-timeout", type=float, default=60.0,

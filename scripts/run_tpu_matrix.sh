@@ -54,14 +54,16 @@ print('PROBE_OK')" 2>/dev/null | grep -q PROBE_OK; then
     esac
 }
 
-run_one landcover       --model landcover                          || exit 1
+# Wires are explicit on every config: bench.py's default flipped to yuv420
+# (the production wire) in r3, and these archive names encode the wire.
+run_one landcover       --model landcover --wire rgb8              || exit 1
 run_one landcover_yuv   --model landcover --wire yuv420            || exit 1
-run_one pipeline        --model pipeline                           || exit 1
+run_one pipeline        --model pipeline --wire rgb8               || exit 1
 run_one longcontext     --model longcontext                        || exit 1
-run_one landcover_sync  --model landcover --mode sync              || exit 1
-run_one landcover_push  --model landcover --transport push         || exit 1
-run_one megadetector16  --model megadetector --buckets 1 8 16      || exit 1
-run_one species         --model species                            || exit 1
+run_one landcover_sync  --model landcover --mode sync --wire rgb8  || exit 1
+run_one landcover_push  --model landcover --transport push --wire rgb8 || exit 1
+run_one megadetector16  --model megadetector --buckets 1 8 16 --wire rgb8 || exit 1
+run_one species         --model species --wire rgb8                || exit 1
 run_one megadet_yuv     --model megadetector --buckets 1 8 16 --wire yuv420 || exit 1
 run_one species_yuv     --model species --wire yuv420              || exit 1
 run_one pipeline_yuv    --model pipeline --wire yuv420             || exit 1
