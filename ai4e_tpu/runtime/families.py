@@ -402,23 +402,52 @@ def build_vit(name: str = "vit", image_size: int = 224, patch: int = 16,
         postprocess=postprocess, batch_buckets=tuple(buckets))
 
 
+def _token_preprocess(seq_len: int, vocab_size: int):
+    """Payload decoder for token-id sequences: any integer npy of shape
+    (S,) in ``[0, vocab_size)``. Clients ship the narrowest integer dtype
+    they like (uint16 for vocabs ≤64k — 2 bytes/token on the HTTP wire);
+    the device batch is int32 either way. Out-of-range ids fail that one
+    task at preprocess, never the batch."""
+
+    def preprocess(body: bytes, content_type: str):
+        arr = np.load(io.BytesIO(body))
+        if arr.shape != (seq_len,):
+            raise ValueError(f"expected ({seq_len},), got {arr.shape}")
+        if not np.issubdtype(arr.dtype, np.integer):
+            raise ValueError(f"token payload must be integer, got {arr.dtype}")
+        if arr.size and (int(arr.min()) < 0 or int(arr.max()) >= vocab_size):
+            raise ValueError(
+                f"token ids must be in [0, {vocab_size}); got "
+                f"[{int(arr.min())}, {int(arr.max())}]")
+        return arr.astype(np.int32)
+    return preprocess
+
+
 def build_seqformer(name: str = "longcontext", seq_len: int = 4096,
                     input_dim: int = 64, dim: int = 128, depth: int = 2,
                     heads: int = 8, num_classes: int = 16,
                     attention: str = "auto", causal: bool = False,
                     buckets=(1, 8), mesh=None,
-                    wire_dtype: str = "float16", **_) -> ServableModel:
+                    wire_dtype: str = "float16",
+                    vocab_size: int | None = None, **_) -> ServableModel:
     """Long-context sequence classification (SURVEY.md §5 long-context slot):
-    attention over the (S, input_dim) payload runs ring/Ulysses
-    sequence-parallel over the mesh's sp axis when it has one.
+    attention over the payload runs ring/Ulysses sequence-parallel over the
+    mesh's sp axis when it has one.
 
-    ``wire_dtype`` (float16 default, float32 accepted): the batch is carried
-    to the device in this dtype. Sequences are the fattest payload of any
-    family (S·D floats/example — 1 MB at S=4096 f32), the model computes in
-    bfloat16 regardless, and f16's 10 mantissa bits exceed bf16's 7, so
-    half-precision wire halves client payload + host→device bytes without
-    touching the math. Clients may ship f32 or f16 npy; both are cast (a
-    payload outside f16 range fails that task at preprocess)."""
+    Two input contracts:
+
+    - ``vocab_size=N`` — **token mode, the production wire**: payload is an
+      (S,) integer npy of ids, embedded on-device (``nn.Embed``). 2
+      bytes/token on the wire vs 128 bytes/token of pre-embedded f16
+      features at D=64 — on a remote-attached chip this turns the family
+      from link-bound to compute-bound (r3: the feature wire saturated the
+      tunnel at 524 kB/request, 1.15× anchor).
+    - ``vocab_size=None`` — feature mode: (S, input_dim) float sequences,
+      e.g. embedded acoustic/satellite time series produced upstream.
+      ``wire_dtype`` (float16 default, float32 accepted) carries the batch:
+      the model computes bf16 regardless and f16's 10 mantissa bits exceed
+      bf16's 7, so the half wire halves bytes without touching the math.
+      Payloads outside f16 range fail that task at preprocess."""
     from ..models.seqformer import create_seqformer
 
     wdt = np.dtype(wire_dtype)
@@ -428,7 +457,7 @@ def build_seqformer(name: str = "longcontext", seq_len: int = 4096,
     model, params = create_seqformer(
         seq_len=seq_len, input_dim=input_dim, dim=dim, depth=depth,
         heads=heads, num_classes=num_classes, mesh=mesh, attention=attention,
-        causal=causal)
+        causal=causal, vocab_size=vocab_size)
 
     def postprocess(logits):
         logits = np.asarray(logits, np.float64)
@@ -437,10 +466,18 @@ def build_seqformer(name: str = "longcontext", seq_len: int = 4096,
         top = int(np.argmax(probs))
         return {"class_id": top, "confidence": float(probs[top])}
 
+    if vocab_size is not None:
+        input_shape: tuple = (seq_len,)
+        input_dtype = np.dtype(np.int32)
+        preprocess = _token_preprocess(seq_len, vocab_size)
+    else:
+        input_shape = (seq_len, input_dim)
+        input_dtype = wdt
+        preprocess = _npy_preprocess((seq_len, input_dim), wdt)
     return ServableModel(
         name=name, apply_fn=model.apply, params=params,
-        input_shape=(seq_len, input_dim), input_dtype=wdt,
-        preprocess=_npy_preprocess((seq_len, input_dim), wdt),
+        input_shape=input_shape, input_dtype=input_dtype,
+        preprocess=preprocess,
         postprocess=postprocess, batch_buckets=tuple(buckets))
 
 

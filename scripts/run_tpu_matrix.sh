@@ -59,7 +59,8 @@ print('PROBE_OK')" 2>/dev/null | grep -q PROBE_OK; then
 run_one landcover       --model landcover --wire rgb8              || exit 1
 run_one landcover_yuv   --model landcover --wire yuv420            || exit 1
 run_one pipeline        --model pipeline --wire rgb8               || exit 1
-run_one longcontext     --model longcontext                        || exit 1
+run_one longcontext     --model longcontext --seq-input features   || exit 1
+run_one longcontext_tok --model longcontext --seq-input tokens     || exit 1
 run_one landcover_sync  --model landcover --mode sync --wire rgb8  || exit 1
 run_one landcover_push  --model landcover --transport push --wire rgb8 || exit 1
 run_one megadetector16  --model megadetector --buckets 1 8 16 --wire rgb8 || exit 1
