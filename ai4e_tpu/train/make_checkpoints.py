@@ -344,10 +344,81 @@ def train_species(steps: int = 80, image_size: int = 64, batch: int = 16,
                        "labels": SPECIES_LABELS}}
 
 
+def longcontext_batch(rng: np.random.Generator, batch: int, seq_len: int,
+                      vocab_size: int, num_classes: int = 16):
+    """Marker-token classification: sequences of uniform-random background
+    ids with ~3% of positions overwritten by the label class's marker id
+    (the top ``num_classes`` ids of the vocab). The model must learn that
+    rare marker embeddings — not the background distribution — carry the
+    label: a long-context needle task solvable only through the embedding
+    table + attention, so trained weights are behaviorally distinguishable
+    from random init."""
+    markers = max(4, seq_len // 32)
+    toks = rng.integers(0, vocab_size - num_classes, (batch, seq_len))
+    labels = rng.integers(0, num_classes, (batch,))
+    for i in range(batch):
+        pos = rng.choice(seq_len, size=markers, replace=False)
+        toks[i, pos] = vocab_size - num_classes + labels[i]
+    return toks.astype(np.int32), labels.astype(np.int32)
+
+
+def train_longcontext(steps: int = 200, seq_len: int = 4096, batch: int = 8,
+                      seed: int = 0, dim: int = 256, depth: int = 4,
+                      heads: int = 2, vocab_size: int = 32768,
+                      num_classes: int = 16, attention: str = "full",
+                      serve_attention: str = "flash",
+                      lr: float = 1e-3) -> dict:
+    """SeqFormer (token mode) on the marker task at the SERVING geometry —
+    seq_len/vocab are baked into the parameter tree (pos_emb, Embed), so
+    unlike the fully-convolutional families the trained shape IS the
+    serving shape. Defaults = the bench/serving config (head_dim 128).
+
+    ``attention`` is the TRAINING strategy — "full" because the flash
+    Pallas kernel defines no autodiff rule; the strategy carries no params,
+    so the tree is identical and ``serve_attention`` (recorded in the
+    manifest kwargs) is what inference runs."""
+    import jax
+
+    from ..models.seqformer import create_seqformer
+    from .step import cross_entropy_loss
+
+    model, params = create_seqformer(
+        seq_len=seq_len, input_dim=64, dim=dim, depth=depth, heads=heads,
+        num_classes=num_classes, attention=attention, vocab_size=vocab_size)
+    tr = _trainer(model.apply, params, cross_entropy_loss, lr)
+    rng = np.random.default_rng(seed)
+    for step in range(steps):
+        toks, lab = longcontext_batch(rng, batch, seq_len, vocab_size,
+                                      num_classes)
+        loss = tr.train_step(toks, lab)
+        if step % 25 == 0:
+            log.info("longcontext step %d loss %.4f", step, float(loss))
+    eval_rng = np.random.default_rng(seed + 1)
+    apply = jax.jit(model.apply)
+    hits = total = 0
+    for _ in range(4):
+        toks, lab = longcontext_batch(eval_rng, 16, seq_len, vocab_size,
+                                      num_classes)
+        pred = np.argmax(np.asarray(apply(tr.params, toks)), -1)
+        hits += int((pred == lab).sum())
+        total += len(lab)
+    acc = hits / total
+    log.info("longcontext eval acc %.3f (%d/%d)", acc, hits, total)
+    return {"params": tr.params, "eval": {"accuracy": round(acc, 4)},
+            "family": "seqformer",
+            # Everything serving needs to rebuild the exact tree: seq_len
+            # and vocab_size are structural (pos_emb / Embed shapes).
+            "kwargs": {"seq_len": seq_len, "input_dim": 64, "dim": dim,
+                       "depth": depth, "heads": heads,
+                       "num_classes": num_classes, "vocab_size": vocab_size,
+                       "attention": serve_attention}}
+
+
 RECIPES = {
     "landcover": train_landcover,
     "megadetector": train_megadetector,
     "species": train_species,
+    "longcontext": train_longcontext,
 }
 
 # Eval floor every produced checkpoint must clear — proof the weights are
@@ -428,10 +499,27 @@ def main(argv=None) -> None:
         # (jax.default_backend()) hangs when the tunnel is degraded.
         jax.config.update("jax_platforms", args.platform)
 
+    if (not args.fast and args.platform == "cpu"
+            and "longcontext" in args.only):
+        # Full-geometry longcontext trains seq-4096 FULL attention (the
+        # flash kernel has no autodiff rule) — minutes on the TPU
+        # (--platform ''), hours of materialized 4096x4096 scores on one
+        # CPU core. Warn rather than refuse: the run is correct, just slow.
+        log.warning(
+            "full longcontext training on jax_platforms=cpu materializes "
+            "seq-4096 attention scores and can take hours; use "
+            "--platform '' (TPU) or --fast for the CI geometry")
     # Full (default) runs train at the PRODUCTION serving sizes
     # (FULL_OVERRIDES); --fast keeps the recipes' small defaults for CI.
     fast = ({"landcover": {"steps": 60}, "megadetector": {"steps": 80},
-             "species": {"steps": 65}} if args.fast else FULL_OVERRIDES)
+             "species": {"steps": 65},
+             # Small geometry + full (XLA) attention: the pallas kernel
+             # would run interpreted on CPU CI. attn carries no params, so
+             # the strategy is free to differ from serving.
+             "longcontext": {"steps": 160, "seq_len": 256, "dim": 32,
+                             "depth": 2, "heads": 2, "vocab_size": 512,
+                             "batch": 16, "attention": "full"}}
+            if args.fast else FULL_OVERRIDES)
     os.makedirs(args.out, exist_ok=True)
     manifest_path = os.path.join(args.out, "MANIFEST.json")
     manifest = {}

@@ -111,7 +111,8 @@ def _manifest_kwargs(ckpt_dir: str, name: str) -> tuple[dict, bool]:
     return {"megadetector": {"widths": [64, 128, 256]},
             "landcover": {"widths": [64, 128, 256, 512], "num_classes": 4},
             "species": {"stage_sizes": [2, 2, 2], "width": 32,
-                        "num_classes": 8, "labels": SPECIES_LABELS}}[name], False
+                        "num_classes": 8, "labels": SPECIES_LABELS},
+            "longcontext": {}}[name], False
 
 
 def _serving_size(kwargs: dict, from_manifest: bool, name: str) -> int:
@@ -161,20 +162,52 @@ def _build_servable(args):
         # heads=8/head_dim=32 geometry on v5e (52 -> 180 seq/s at depth 4,
         # batch 64) — attention FLOPs are identical, only the matmul tiling
         # changes. TPU-first model geometry, not a capacity change.
+        sf_kwargs = dict(seq_len=args.seq_len, input_dim=64, dim=256,
+                         depth=4, heads=2, num_classes=16,
+                         attention="flash", vocab_size=vocab)
+        ckpt_meta: dict = {"checkpoint": "none"}
+        use_ckpt = False
+        if tokens:
+            # Serve trained weights when the factory produced them AT THIS
+            # geometry: the token tree's seq_len/vocab are STRUCTURAL
+            # (pos_emb/Embed shapes), so a manifest whose seq_len differs
+            # from --seq-len (e.g. a --fast CI manifest at 256) must NOT
+            # silently shrink the measured config — the anchor is for the
+            # headline sequence length. Mismatch → random init, logged.
+            mf_kwargs, from_manifest = _manifest_kwargs(
+                args.checkpoint_dir, "longcontext")
+            if from_manifest and mf_kwargs.get("seq_len") == args.seq_len:
+                sf_kwargs.update(mf_kwargs)
+                vocab = sf_kwargs["vocab_size"]
+                use_ckpt = True
+            elif from_manifest:
+                log(f"longcontext manifest geometry (seq_len="
+                    f"{mf_kwargs.get('seq_len')}) != --seq-len "
+                    f"{args.seq_len}; serving random init at the CLI "
+                    "geometry")
         servable = build_servable(
-            "seqformer", name="longcontext", seq_len=args.seq_len,
-            input_dim=64, dim=256, depth=4, heads=2, num_classes=16,
-            attention="flash", buckets=tuple(args.buckets),
-            vocab_size=vocab)
+            "seqformer", name="longcontext", buckets=tuple(args.buckets),
+            **sf_kwargs)
+        if use_ckpt:
+            # Gated on the manifest entry (not bare dir existence): a
+            # checkpoint dir without its manifest record has unknown
+            # geometry, and for this family any drift is a shape mismatch
+            # at restore.
+            servable.params, ckpt_meta = _load_or_train_checkpoint(
+                "longcontext", args.checkpoint_dir, servable.params,
+                required=False)
         rng = np.random.default_rng(0)
         if tokens:
-            # Production wire: (S,) uint16 token ids, embedded on-device —
-            # 2 bytes/token vs the feature wire's 128 (f16 D=64), turning
-            # the link-bound config compute-bound on the remote tunnel.
+            # Production wire: (S,) narrow integer token ids, embedded
+            # on-device — 2 bytes/token (uint16, vocabs ≤64k) vs the
+            # feature wire's 128 (f16 D=64), turning the link-bound config
+            # compute-bound on the remote tunnel.
+            wire_dt = np.uint16 if vocab <= 2**16 else np.uint32
             payload_arr = rng.integers(0, vocab, size=(args.seq_len,),
-                                       dtype=np.uint16)
+                                       dtype=wire_dt)
             meta = {"seq_len": args.seq_len, "attention": "flash",
-                    "wire": "tokens-uint16", "vocab_size": vocab}
+                    "wire": f"tokens-{np.dtype(wire_dt).name}",
+                    "vocab_size": vocab, **ckpt_meta}
         else:
             # f16 feature wire (the family's default wire_dtype): halves
             # both the client payload and the host→device transfer vs f32;

@@ -68,6 +68,33 @@ class TestRecipesLearn:
         assert abs(acc - entry["eval"]["pixel_accuracy"]) < 1e-3, (
             acc, entry["eval"])
 
+    def test_longcontext_trains_and_restores_into_token_servable(
+            self, tmp_path):
+        # The marker-token task at toy geometry (the full recipe trains the
+        # serving shape on TPU — seq_len/vocab are structural there). A
+        # short schedule with a lowered gate proves trained-not-random +
+        # restore fidelity without the full convergence cost in CI.
+        kw = dict(seq_len=128, dim=32, depth=2, heads=2, vocab_size=256,
+                  batch=16, attention="full")
+        entry = make_checkpoint("longcontext", str(tmp_path), min_eval=0.5,
+                                steps=100, **kw)
+        assert entry["eval"]["accuracy"] >= 0.5
+        assert entry["kwargs"]["vocab_size"] == 256  # structural, recorded
+
+        servable = build_servable("seqformer", name="longcontext",
+                                  buckets=(4,), num_classes=16,
+                                  **{k: v for k, v in kw.items()
+                                     if k != "batch"})
+        random_params = servable.params
+        servable.params = load_params(entry["path"], like=servable.params)
+        from ai4e_tpu.train.make_checkpoints import longcontext_batch
+        toks, lab = longcontext_batch(np.random.default_rng(77), 16, 128, 256)
+        acc = float((np.argmax(np.asarray(
+            servable.apply_fn(servable.params, toks)), -1) == lab).mean())
+        rand = float((np.argmax(np.asarray(
+            servable.apply_fn(random_params, toks)), -1) == lab).mean())
+        assert acc >= 0.5 and acc > rand + 0.2, (acc, rand)
+
     def test_unconverged_training_is_refused(self, tmp_path):
         import pytest
 
