@@ -205,7 +205,8 @@ def _build_servable(args):
             wire_dt = np.uint16 if vocab <= 2**16 else np.uint32
             payload_arr = rng.integers(0, vocab, size=(args.seq_len,),
                                        dtype=wire_dt)
-            meta = {"seq_len": args.seq_len, "attention": "flash",
+            meta = {"seq_len": args.seq_len,
+                    "attention": sf_kwargs["attention"],
                     "wire": f"tokens-{np.dtype(wire_dt).name}",
                     "vocab_size": vocab, **ckpt_meta}
         else:
