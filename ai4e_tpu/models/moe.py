@@ -167,7 +167,10 @@ class MoEBlock(nn.Module):
 
 
 class MoEClassifier(nn.Module):
-    """(B, S, input_dim) → (B, num_classes) with MoE FFNs."""
+    """(B, S, input_dim) float features — or, with ``vocab_size`` set,
+    (B, S) integer token ids embedded on-device — → (B, num_classes) with
+    MoE FFNs. Token mode is the production wire (2 bytes/token), same
+    contract as the seqformer family."""
 
     seq_len: int
     input_dim: int
@@ -180,12 +183,17 @@ class MoEClassifier(nn.Module):
     dispatch: str = "dense"
     capacity_factor: float = 1.25
     dtype: jnp.dtype = jnp.bfloat16
+    vocab_size: int | None = None
 
     @nn.compact
     def __call__(self, x):
         from ..parallel.ring_attention import reference_attention
         attn_fn = self.attn_fn or reference_attention
-        h = nn.Dense(self.dim, dtype=self.dtype, name="embed")(x)
+        if self.vocab_size is not None:
+            h = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype,
+                         name="embed")(x)
+        else:
+            h = nn.Dense(self.dim, dtype=self.dtype, name="embed")(x)
         pos = self.param("pos_emb", nn.initializers.normal(0.02),
                          (1, self.seq_len, self.dim))
         h = h + pos.astype(self.dtype)
@@ -202,12 +210,13 @@ def create_moe(rng=None, seq_len: int = 1024, input_dim: int = 64,
                dim: int = 128, depth: int = 2, heads: int = 8,
                num_experts: int = 8, num_classes: int = 16, mesh=None,
                attention: str = "flash", dispatch: str = "dense",
-               capacity_factor: float = 1.25):
+               capacity_factor: float = 1.25, vocab_size: int | None = None):
     """Build model + params; on a mesh with ep > 1 the expert tensors are
     placed with ``MOE_EP_RULES`` so serving/training shard the expert dim.
 
     ``num_experts`` must divide by the mesh's ep size (static SPMD shapes).
-    ``dispatch``: "dense" or "capacity" (see ``MoEFFN``).
+    ``dispatch``: "dense" or "capacity" (see ``MoEFFN``). ``vocab_size``
+    switches the input contract to (B, S) token ids.
     """
     from .seqformer import attention_for
 
@@ -222,11 +231,12 @@ def create_moe(rng=None, seq_len: int = 1024, input_dim: int = 64,
         seq_len=seq_len, input_dim=input_dim, dim=dim, depth=depth,
         heads=heads, num_experts=num_experts, num_classes=num_classes,
         attn_fn=attention_for(mesh, attention), dispatch=dispatch,
-        capacity_factor=capacity_factor)
+        capacity_factor=capacity_factor, vocab_size=vocab_size)
     init_model = model.clone(attn_fn=lambda q, k, v: q)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    params = init_model.init(rng,
-                             np.zeros((1, seq_len, input_dim), np.float32))
+    init_x = (np.zeros((1, seq_len), np.int32) if vocab_size is not None
+              else np.zeros((1, seq_len, input_dim), np.float32))
+    params = init_model.init(rng, init_x)
     if mesh is not None and mesh.shape.get("ep", 1) > 1:
         from ..parallel.sharding import shard_params
         params = shard_params(params, mesh, MOE_EP_RULES)

@@ -423,6 +423,21 @@ def _token_preprocess(seq_len: int, vocab_size: int):
     return preprocess
 
 
+def _sequence_input_contract(seq_len: int, input_dim: int,
+                             vocab_size: int | None,
+                             feature_dtype=np.float32):
+    """``(input_shape, input_dtype, preprocess)`` for the sequence
+    families' shared wire contract: token ids when ``vocab_size`` is set,
+    float feature sequences otherwise. One helper so seqformer and moe
+    cannot drift."""
+    if vocab_size is not None:
+        return ((seq_len,), np.dtype(np.int32),
+                _token_preprocess(seq_len, vocab_size))
+    fdt = np.dtype(feature_dtype)
+    return ((seq_len, input_dim), fdt,
+            _npy_preprocess((seq_len, input_dim), fdt))
+
+
 def build_seqformer(name: str = "longcontext", seq_len: int = 4096,
                     input_dim: int = 64, dim: int = 128, depth: int = 2,
                     heads: int = 8, num_classes: int = 16,
@@ -466,14 +481,8 @@ def build_seqformer(name: str = "longcontext", seq_len: int = 4096,
         top = int(np.argmax(probs))
         return {"class_id": top, "confidence": float(probs[top])}
 
-    if vocab_size is not None:
-        input_shape: tuple = (seq_len,)
-        input_dtype = np.dtype(np.int32)
-        preprocess = _token_preprocess(seq_len, vocab_size)
-    else:
-        input_shape = (seq_len, input_dim)
-        input_dtype = wdt
-        preprocess = _npy_preprocess((seq_len, input_dim), wdt)
+    input_shape, input_dtype, preprocess = _sequence_input_contract(
+        seq_len, input_dim, vocab_size, feature_dtype=wdt)
     return ServableModel(
         name=name, apply_fn=model.apply, params=params,
         input_shape=input_shape, input_dtype=input_dtype,
@@ -486,23 +495,27 @@ def build_moe(name: str = "moe", seq_len: int = 1024, input_dim: int = 64,
               num_experts: int = 8, num_classes: int = 16,
               attention: str = "flash", dispatch: str = "dense",
               capacity_factor: float = 1.25, buckets=(1, 8), mesh=None,
-              **_) -> ServableModel:
+              vocab_size: int | None = None, **_) -> ServableModel:
     """Mixture-of-Experts sequence classification — the expert-parallel
     family: expert tensors shard over the mesh's ``ep`` axis
     (``models/moe.py``), composing with dp/fsdp exactly like seqformer's sp.
-    ``dispatch="capacity"`` serves the GShard-style static-capacity path."""
+    ``dispatch="capacity"`` serves the GShard-style static-capacity path.
+    ``vocab_size`` switches to the token-id wire (same contract as the
+    seqformer family: (S,) integer npy, embedded on-device)."""
     from ..models.moe import MOE_EP_RULES, create_moe
 
     model, params = create_moe(
         seq_len=seq_len, input_dim=input_dim, dim=dim, depth=depth,
         heads=heads, num_experts=num_experts, num_classes=num_classes,
         mesh=mesh, attention=attention, dispatch=dispatch,
-        capacity_factor=capacity_factor)
+        capacity_factor=capacity_factor, vocab_size=vocab_size)
 
+    input_shape, input_dtype, preprocess = _sequence_input_contract(
+        seq_len, input_dim, vocab_size)
     return ServableModel(
         name=name, apply_fn=model.apply, params=params,
-        input_shape=(seq_len, input_dim),
-        preprocess=_npy_preprocess((seq_len, input_dim)),
+        input_shape=input_shape, input_dtype=input_dtype,
+        preprocess=preprocess,
         postprocess=_classification_postprocess(),
         batch_buckets=tuple(buckets),
         # ModelRuntime.register re-places every param on its mesh; the rules

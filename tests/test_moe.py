@@ -129,3 +129,50 @@ class TestCapacityDispatch:
         with mesh:
             got = np.asarray(jax.jit(m2.apply)(p2, x))
         np.testing.assert_allclose(got, want, rtol=4e-2, atol=4e-2)
+
+
+class TestTokenMode:
+    """``vocab_size`` switches the family to (S,) token-id input with
+    on-device embedding — the same production wire as the seqformer family,
+    composed with expert parallelism."""
+
+    def test_token_forward_matches_across_ep_mesh(self):
+        toks = np.random.default_rng(5).integers(
+            0, 40, size=(4, SEQ), dtype=np.int32)
+        model_1d, params = create_moe(
+            seq_len=SEQ, input_dim=DIM_IN, dim=32, depth=1, heads=2,
+            num_experts=8, num_classes=4, attention="full", vocab_size=40)
+        want = np.asarray(jax.jit(model_1d.apply)(params, toks))
+
+        mesh = make_mesh(MeshSpec(dp=2, ep=4), devices=jax.devices()[:8])
+        model_ep, params_ep = create_moe(
+            seq_len=SEQ, input_dim=DIM_IN, dim=32, depth=1, heads=2,
+            num_experts=8, num_classes=4, attention="full", vocab_size=40,
+            mesh=mesh)
+        with mesh:
+            got = np.asarray(jax.jit(model_ep.apply)(params_ep, toks))
+        np.testing.assert_allclose(got, want, rtol=3e-2, atol=3e-2)
+
+    def test_token_servable_validates_and_scores(self):
+        import io
+
+        from ai4e_tpu.runtime import build_servable
+
+        sv = build_servable(
+            "moe", name="moetok", seq_len=SEQ, dim=32, depth=1, heads=2,
+            num_experts=8, num_classes=4, attention="full", buckets=(1,),
+            vocab_size=40)
+        assert sv.input_shape == (SEQ,)
+        assert np.dtype(sv.input_dtype) == np.int32
+        toks = np.random.default_rng(6).integers(
+            0, 40, size=(SEQ,), dtype=np.uint16)
+        buf = io.BytesIO(); np.save(buf, toks)
+        ex = sv.preprocess(buf.getvalue(), "application/octet-stream")
+        out = sv.postprocess(np.asarray(sv.apply_fn(sv.params, ex[None])[0]))
+        assert 0 <= out["class_id"] < 4
+        # Range violations fail the one task at preprocess.
+        import pytest
+        bad = np.full((SEQ,), 40, np.int64)
+        buf = io.BytesIO(); np.save(buf, bad)
+        with pytest.raises(ValueError, match=r"\[0, 40\)"):
+            sv.preprocess(buf.getvalue(), "application/octet-stream")
