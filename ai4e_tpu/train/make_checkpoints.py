@@ -362,6 +362,26 @@ def longcontext_batch(rng: np.random.Generator, batch: int, seq_len: int,
     return toks.astype(np.int32), labels.astype(np.int32)
 
 
+def _eval_marker_task(apply_fn, params, seq_len: int, vocab_size: int,
+                      num_classes: int, seed: int, rounds: int = 4,
+                      batch: int = 16) -> float:
+    """Held-out accuracy on the marker task — the shared eval protocol for
+    both sequence families (seed+1 convention, ~64 sequences so the gate is
+    stable against backend numerics)."""
+    import jax
+
+    eval_rng = np.random.default_rng(seed + 1)
+    apply = jax.jit(apply_fn)
+    hits = total = 0
+    for _ in range(rounds):
+        toks, lab = longcontext_batch(eval_rng, batch, seq_len, vocab_size,
+                                      num_classes)
+        pred = np.argmax(np.asarray(apply(params, toks)), -1)
+        hits += int((pred == lab).sum())
+        total += len(lab)
+    return hits / total
+
+
 def train_longcontext(steps: int = 200, seq_len: int = 4096, batch: int = 8,
                       seed: int = 0, dim: int = 256, depth: int = 4,
                       heads: int = 2, vocab_size: int = 32768,
@@ -393,17 +413,9 @@ def train_longcontext(steps: int = 200, seq_len: int = 4096, batch: int = 8,
         loss = tr.train_step(toks, lab)
         if step % 25 == 0:
             log.info("longcontext step %d loss %.4f", step, float(loss))
-    eval_rng = np.random.default_rng(seed + 1)
-    apply = jax.jit(model.apply)
-    hits = total = 0
-    for _ in range(4):
-        toks, lab = longcontext_batch(eval_rng, 16, seq_len, vocab_size,
-                                      num_classes)
-        pred = np.argmax(np.asarray(apply(tr.params, toks)), -1)
-        hits += int((pred == lab).sum())
-        total += len(lab)
-    acc = hits / total
-    log.info("longcontext eval acc %.3f (%d/%d)", acc, hits, total)
+    acc = _eval_marker_task(model.apply, tr.params, seq_len, vocab_size,
+                            num_classes, seed)
+    log.info("longcontext eval acc %.3f", acc)
     return {"params": tr.params, "eval": {"accuracy": round(acc, 4)},
             "family": "seqformer",
             # Everything serving needs to rebuild the exact tree: seq_len
@@ -414,11 +426,59 @@ def train_longcontext(steps: int = 200, seq_len: int = 4096, batch: int = 8,
                        "attention": serve_attention}}
 
 
+def train_moe(steps: int = 200, seq_len: int = 1024, batch: int = 16,
+              seed: int = 0, dim: int = 128, depth: int = 2, heads: int = 1,
+              num_experts: int = 8, vocab_size: int = 8192,
+              num_classes: int = 16, capacity_factor: float = 1.25,
+              attention: str = "full", serve_attention: str = "flash",
+              lr: float = 1e-3) -> dict:
+    """MoE classifier (token mode) on the same marker task as longcontext.
+
+    Trains with **dense dispatch** (every expert runs every token — smooth
+    gradients, bitwise deterministic) and **evaluates with the capacity
+    dispatch it will serve** (GShard-style static capacity): the parameter
+    tree is dispatch-independent, but overflow drops make capacity the
+    stricter eval, so the gate certifies the weights as actually served.
+    Attention trains "full" (the flash kernel has no autodiff rule) and
+    serves ``serve_attention`` — no params either way."""
+    from ..models.moe import create_moe
+    from .step import cross_entropy_loss
+
+    model, params = create_moe(
+        seq_len=seq_len, input_dim=64, dim=dim, depth=depth, heads=heads,
+        num_experts=num_experts, num_classes=num_classes,
+        attention=attention, dispatch="dense", vocab_size=vocab_size)
+    tr = _trainer(model.apply, params, cross_entropy_loss, lr)
+    rng = np.random.default_rng(seed)
+    for step in range(steps):
+        toks, lab = longcontext_batch(rng, batch, seq_len, vocab_size,
+                                      num_classes)
+        loss = tr.train_step(toks, lab)
+        if step % 25 == 0:
+            log.info("moe step %d loss %.4f", step, float(loss))
+    # Same module, capacity dispatch (plain attributes — no re-init).
+    serve_model = model.clone(dispatch="capacity",
+                              capacity_factor=capacity_factor)
+    acc = _eval_marker_task(serve_model.apply, tr.params, seq_len,
+                            vocab_size, num_classes, seed)
+    log.info("moe eval (capacity dispatch) acc %.3f", acc)
+    return {"params": tr.params, "eval": {"accuracy": round(acc, 4)},
+            "family": "moe",
+            "kwargs": {"seq_len": seq_len, "input_dim": 64, "dim": dim,
+                       "depth": depth, "heads": heads,
+                       "num_experts": num_experts,
+                       "num_classes": num_classes, "vocab_size": vocab_size,
+                       "dispatch": "capacity",
+                       "capacity_factor": capacity_factor,
+                       "attention": serve_attention}}
+
+
 RECIPES = {
     "landcover": train_landcover,
     "megadetector": train_megadetector,
     "species": train_species,
     "longcontext": train_longcontext,
+    "moe": train_moe,
 }
 
 # Eval floor every produced checkpoint must clear — proof the weights are
@@ -519,7 +579,9 @@ def main(argv=None) -> None:
              # the strategy is free to differ from serving.
              "longcontext": {"steps": 160, "seq_len": 256, "dim": 32,
                              "depth": 2, "heads": 2, "vocab_size": 512,
-                             "batch": 16, "attention": "full"}}
+                             "batch": 16, "attention": "full"},
+             "moe": {"steps": 160, "seq_len": 128, "dim": 32, "heads": 1,
+                     "num_experts": 4, "vocab_size": 256, "batch": 16}}
             if args.fast else FULL_OVERRIDES)
     os.makedirs(args.out, exist_ok=True)
     manifest_path = os.path.join(args.out, "MANIFEST.json")

@@ -267,14 +267,16 @@ class TestCheckpointServingSizeWiring:
             assert served == trained, (
                 f"{name}: models.json serves at {served}, trained at "
                 f"{trained}")
-        # The long-context family's geometry is STRUCTURAL (pos_emb/Embed
-        # shapes live in the tree): every kwarg the factory recorded must
-        # match the spec exactly or restore fails / serves garbage.
-        if "longcontext" in manifest and "longcontext" in by_ckpt:
-            for key, trained in manifest["longcontext"]["kwargs"].items():
-                served = by_ckpt["longcontext"].get(key)
+        # The sequence families' geometry is STRUCTURAL (pos_emb/Embed/
+        # expert shapes live in the tree): every kwarg the factory recorded
+        # must match the spec exactly or restore fails / serves garbage.
+        for name in ("longcontext", "moe"):
+            if name not in manifest or name not in by_ckpt:
+                continue
+            for key, trained in manifest[name]["kwargs"].items():
+                served = by_ckpt[name].get(key)
                 assert served == trained, (
-                    f"longcontext: models.json {key}={served}, trained "
+                    f"{name}: models.json {key}={served}, trained "
                     f"{trained}")
 
 

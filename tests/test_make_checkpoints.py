@@ -95,6 +95,31 @@ class TestRecipesLearn:
             servable.apply_fn(random_params, toks)), -1) == lab).mean())
         assert acc >= 0.5 and acc > rand + 0.2, (acc, rand)
 
+    def test_moe_trains_and_restores_under_capacity_dispatch(self, tmp_path):
+        # Trains dense, gates on the capacity dispatch it will serve — the
+        # param tree is dispatch-independent, so restore must reproduce the
+        # gated behavior through the capacity servable.
+        kw = dict(seq_len=128, dim=32, heads=1, num_experts=4,
+                  vocab_size=256, batch=16)
+        entry = make_checkpoint("moe", str(tmp_path), min_eval=0.5,
+                                steps=100, **kw)
+        assert entry["eval"]["accuracy"] >= 0.5
+        assert entry["kwargs"]["dispatch"] == "capacity"
+
+        servable = build_servable(
+            "moe", name="moe", seq_len=128, dim=32, heads=1, num_experts=4,
+            vocab_size=256, num_classes=16, dispatch="capacity",
+            attention="full", buckets=(4,))
+        random_params = servable.params
+        servable.params = load_params(entry["path"], like=servable.params)
+        from ai4e_tpu.train.make_checkpoints import longcontext_batch
+        toks, lab = longcontext_batch(np.random.default_rng(88), 16, 128, 256)
+        acc = float((np.argmax(np.asarray(
+            servable.apply_fn(servable.params, toks)), -1) == lab).mean())
+        rand = float((np.argmax(np.asarray(
+            servable.apply_fn(random_params, toks)), -1) == lab).mean())
+        assert acc >= 0.5 and acc > rand + 0.2, (acc, rand)
+
     def test_unconverged_training_is_refused(self, tmp_path):
         import pytest
 
