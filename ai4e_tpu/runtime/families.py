@@ -402,6 +402,21 @@ def build_vit(name: str = "vit", image_size: int = 224, patch: int = 16,
         postprocess=postprocess, batch_buckets=tuple(buckets))
 
 
+def _check_token_ids(arr: np.ndarray, vocab_size: int) -> None:
+    """THE token-id validation, shared by the single-item and batch-stack
+    wires so they cannot drift: integer dtype (floats would silently
+    truncate fractional ids) and range (the on-device Embed gather CLAMPS
+    out-of-bounds indices — XLA semantics — so an unchecked bad id scores
+    silently wrong instead of failing). Must run on the RAW payload,
+    before any cast: an int64 id ≥ 2³² wraps into range under int32."""
+    if not np.issubdtype(arr.dtype, np.integer):
+        raise ValueError(f"token payload must be integer, got {arr.dtype}")
+    if arr.size and (int(arr.min()) < 0 or int(arr.max()) >= vocab_size):
+        raise ValueError(
+            f"token ids must be in [0, {vocab_size}); got "
+            f"[{int(arr.min())}, {int(arr.max())}]")
+
+
 def _token_preprocess(seq_len: int, vocab_size: int):
     """Payload decoder for token-id sequences: any integer npy of shape
     (S,) in ``[0, vocab_size)``. Clients ship the narrowest integer dtype
@@ -413,12 +428,7 @@ def _token_preprocess(seq_len: int, vocab_size: int):
         arr = np.load(io.BytesIO(body))
         if arr.shape != (seq_len,):
             raise ValueError(f"expected ({seq_len},), got {arr.shape}")
-        if not np.issubdtype(arr.dtype, np.integer):
-            raise ValueError(f"token payload must be integer, got {arr.dtype}")
-        if arr.size and (int(arr.min()) < 0 or int(arr.max()) >= vocab_size):
-            raise ValueError(
-                f"token ids must be in [0, {vocab_size}); got "
-                f"[{int(arr.min())}, {int(arr.max())}]")
+        _check_token_ids(arr, vocab_size)
         return arr.astype(np.int32)
     return preprocess
 
@@ -426,16 +436,24 @@ def _token_preprocess(seq_len: int, vocab_size: int):
 def _sequence_input_contract(seq_len: int, input_dim: int,
                              vocab_size: int | None,
                              feature_dtype=np.float32):
-    """``(input_shape, input_dtype, preprocess)`` for the sequence
-    families' shared wire contract: token ids when ``vocab_size`` is set,
-    float feature sequences otherwise. One helper so seqformer and moe
-    cannot drift."""
+    """``(input_shape, input_dtype, preprocess, stack_kwargs)`` for the
+    sequence families' shared wire contract: token ids when ``vocab_size``
+    is set, float feature sequences otherwise. One helper so seqformer and
+    moe cannot drift.
+
+    Token mode's ``stack_kwargs`` install ``_check_token_ids`` as the
+    batch-stack validator — it runs on the RAW stack, before the decode
+    path's cast to the device dtype (a post-cast check would pass
+    wrapped-into-range ids). Value-level stack validation failing the
+    whole stack matches the image families' NaN behavior."""
     if vocab_size is not None:
         return ((seq_len,), np.dtype(np.int32),
-                _token_preprocess(seq_len, vocab_size))
+                _token_preprocess(seq_len, vocab_size),
+                {"stack_validator":
+                 lambda arr: _check_token_ids(arr, vocab_size)})
     fdt = np.dtype(feature_dtype)
     return ((seq_len, input_dim), fdt,
-            _npy_preprocess((seq_len, input_dim), fdt))
+            _npy_preprocess((seq_len, input_dim), fdt), {})
 
 
 def build_seqformer(name: str = "longcontext", seq_len: int = 4096,
@@ -481,13 +499,15 @@ def build_seqformer(name: str = "longcontext", seq_len: int = 4096,
         top = int(np.argmax(probs))
         return {"class_id": top, "confidence": float(probs[top])}
 
-    input_shape, input_dtype, preprocess = _sequence_input_contract(
-        seq_len, input_dim, vocab_size, feature_dtype=wdt)
+    input_shape, input_dtype, preprocess, stack_kwargs = (
+        _sequence_input_contract(seq_len, input_dim, vocab_size,
+                                 feature_dtype=wdt))
     return ServableModel(
         name=name, apply_fn=model.apply, params=params,
         input_shape=input_shape, input_dtype=input_dtype,
         preprocess=preprocess,
-        postprocess=postprocess, batch_buckets=tuple(buckets))
+        postprocess=postprocess, batch_buckets=tuple(buckets),
+        **stack_kwargs)
 
 
 def build_moe(name: str = "moe", seq_len: int = 1024, input_dim: int = 64,
@@ -510,8 +530,8 @@ def build_moe(name: str = "moe", seq_len: int = 1024, input_dim: int = 64,
         mesh=mesh, attention=attention, dispatch=dispatch,
         capacity_factor=capacity_factor, vocab_size=vocab_size)
 
-    input_shape, input_dtype, preprocess = _sequence_input_contract(
-        seq_len, input_dim, vocab_size)
+    input_shape, input_dtype, preprocess, stack_kwargs = (
+        _sequence_input_contract(seq_len, input_dim, vocab_size))
     return ServableModel(
         name=name, apply_fn=model.apply, params=params,
         input_shape=input_shape, input_dtype=input_dtype,
@@ -520,7 +540,7 @@ def build_moe(name: str = "moe", seq_len: int = 1024, input_dim: int = 64,
         batch_buckets=tuple(buckets),
         # ModelRuntime.register re-places every param on its mesh; the rules
         # ride along so expert sharding survives registration.
-        param_sharding_rules=MOE_EP_RULES)
+        param_sharding_rules=MOE_EP_RULES, **stack_kwargs)
 
 
 FAMILIES = {

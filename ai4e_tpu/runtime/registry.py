@@ -61,6 +61,10 @@ class ServableModel:
     stack_item_shape: tuple[int, ...] | None = None
     stack_item_dtype: Any = None
     stack_adapter: Callable | None = None
+    # Value-level validation of the RAW decoded stack, before any dtype
+    # cast (token servables reject floats / out-of-range ids here — a
+    # post-cast check would pass ids that wrapped into range).
+    stack_validator: Callable | None = None
     # Inverse for HOST consumers of a preprocessed example (pipeline
     # handoffs crop the stage's input image): example → natural image.
     # None = the example already is the natural payload.

@@ -225,6 +225,9 @@ class InferenceWorker:
                 raise ValueError("empty batch")
             if len(arr) > max_items:
                 raise ValueError(f"batch of {len(arr)} exceeds max {max_items}")
+            if servable.stack_validator is not None:
+                # Raw-value validation BEFORE the cast (see ServableModel).
+                servable.stack_validator(arr)
             from .families import cast_image_payload
             arr = cast_image_payload(arr, item_dtype)
             if servable.stack_adapter is not None:

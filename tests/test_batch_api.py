@@ -338,3 +338,78 @@ class TestYuvStack:
                 await client.close()
 
         run(main())
+
+
+class TestTokenStacks:
+    """Batch stacks for token servables: valid (N, S) id stacks score; a
+    stack holding any out-of-range id fails at decode (the value-level
+    whole-stack contract the image families' NaN guard sets) — without the
+    adapter the on-device Embed gather would CLAMP bad ids and silently
+    mis-score."""
+
+    def _worker(self, platform):
+        from ai4e_tpu.runtime import build_servable
+
+        runtime = ModelRuntime()
+        servable = build_servable(
+            "seqformer", name="lctok", seq_len=SIZE, dim=16, depth=1,
+            heads=2, num_classes=4, attention="full", vocab_size=10,
+            buckets=(4,))
+        runtime.register(servable)
+        runtime.warmup()
+        batcher = MicroBatcher(runtime, max_wait_ms=1, max_pending=32,
+                               metrics=MetricsRegistry())
+        worker = InferenceWorker("lctok-svc", runtime, batcher,
+                                 task_manager=platform.task_manager,
+                                 prefix="v1/lctok", store=platform.store,
+                                 metrics=MetricsRegistry())
+        worker.serve_batch(servable, max_items=16, progress_every=0.0)
+        return worker, batcher
+
+    def test_token_stack_scores_and_bad_ids_fail_loudly(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            worker, batcher = self._worker(platform)
+            await batcher.start()
+            client = await serve(worker.service.app)
+            try:
+                stack = np.random.default_rng(0).integers(
+                    0, 10, size=(3, SIZE), dtype=np.uint16)
+                resp = await client.post("/v1/lctok/lctok-batch",
+                                         data=npy_bytes(stack))
+                assert resp.status == 200
+                out = await resp.json()
+                assert out["count"] == 3 and out["failed"] == 0
+                for item in out["items"]:
+                    assert 0 <= item["result"]["class_id"] < 4
+
+                bad = stack.copy()
+                bad[1, 0] = 10  # == vocab_size: would clamp on device
+                resp = await client.post("/v1/lctok/lctok-batch",
+                                         data=npy_bytes(bad))
+                # Same surface as the shape guard (sync decode errors map
+                # to an error response, async fails the task).
+                assert resp.status in (400, 500)
+                assert "token ids" in (await resp.text())
+
+                # Validation runs on the RAW stack: an int64 id >= 2^32
+                # would wrap into range under a pre-validation int32 cast.
+                wrap = stack.astype(np.int64)
+                wrap[0, 0] = 2**32 + 3
+                resp = await client.post("/v1/lctok/lctok-batch",
+                                         data=npy_bytes(wrap))
+                assert resp.status in (400, 500)
+                assert "token ids" in (await resp.text())
+
+                # Float stacks are rejected like the single-item wire
+                # (truncation would silently rewrite fractional ids).
+                resp = await client.post(
+                    "/v1/lctok/lctok-batch",
+                    data=npy_bytes(stack.astype(np.float32)))
+                assert resp.status in (400, 500)
+                assert "integer" in (await resp.text())
+            finally:
+                await batcher.stop()
+                await client.close()
+
+        run(main())
