@@ -87,6 +87,16 @@ def build_control_plane(config: FrameworkConfig, routes: dict):
             default = RateLimit(rps=1e9)
         platform.gateway.set_rate_limiter(RateLimiter(default,
                                                       per_key=per_key))
+    if config.gateway.quota or config.gateway.quotas:
+        from .gateway.ratelimit import (QuotaTracker, parse_quota,
+                                        parse_quotas)
+        per_key_q = parse_quotas(config.gateway.quotas or "")
+        # default None: keys without a per-key quota are unlimited AND
+        # untracked (no per-identity window bookkeeping).
+        default_q = (parse_quota(config.gateway.quota)
+                     if config.gateway.quota else None)
+        platform.gateway.set_quota_tracker(QuotaTracker(default_q,
+                                                        per_key=per_key_q))
     # The task-store HTTP surface rides on the gateway app — one
     # control-plane port serves the CACHE_CONNECTOR_*_URI endpoints remote
     # workers use (distributed_api_task.py:14-15 pattern). It enforces the

@@ -236,6 +236,11 @@ class GatewaySection:
     rate_limit_burst: float = 0.0
     # Per-key overrides: "key=rps[:burst],..." (gateway/ratelimit.py).
     rate_limits: typing.Optional[str] = None
+    # Per-key request QUOTA (APIM product quota; 403 on exhaustion):
+    # default "N[/window_seconds]" (bare N = per hour); empty disables.
+    quota: typing.Optional[str] = None
+    # Per-key overrides: "key=N[/window_seconds],...".
+    quotas: typing.Optional[str] = None
 
 
 @_env_section("AI4E_OBSERVABILITY_")

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import math
 from dataclasses import dataclass
 
 import aiohttp
@@ -70,6 +71,8 @@ class Gateway:
         self._api_keys = set(api_keys) if api_keys else None
         # Per-key rate limiting (APIM product throttling); None → unlimited.
         self._rate_limiter = None
+        # Per-key request quotas (APIM product quota); None → unlimited.
+        self._quota_tracker = None
         if hasattr(store, "add_listener"):
             store.add_listener(self._on_task_change)
 
@@ -96,6 +99,13 @@ class Gateway:
         limiter is protecting)."""
         self._rate_limiter = limiter
 
+    def set_quota_tracker(self, tracker) -> None:
+        """Enable (or clear with None) per-key request QUOTAS — APIM's
+        longer-horizon product cap beside the rate throttle. Same scope as
+        the rate limiter; exhaustion answers 403 (APIM's quota status)
+        with Retry-After = the window reset."""
+        self._quota_tracker = tracker
+
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
         """Subscription-key gate — the APIM front-door behavior (every
@@ -117,23 +127,42 @@ class Gateway:
                 return web.json_response(
                     {"error": "missing or invalid subscription key"},
                     status=401)
-        if (self._rate_limiter is not None and not exempt
-                and not request.path.startswith("/v1/taskstore/")):
+        throttled = ((self._rate_limiter is not None
+                      or self._quota_tracker is not None)
+                     and not exempt
+                     and not request.path.startswith("/v1/taskstore/"))
+        if throttled:
             # Bucket by the subscription key ONLY when auth validated it
             # (above) — with auth off the header is attacker-chosen and
             # rotating it would mint a fresh bucket per request; bucket by
             # caller address instead.
             identity = (key if self._api_keys is not None
                         else (request.remote or "anonymous"))
-            allowed, retry_after = self._rate_limiter.allow(identity)
-            if not allowed:
-                import math
-                self._requests.inc(route="throttled", outcome="429")
-                return web.json_response(
-                    {"error": "rate limit exceeded"}, status=429,
-                    # RFC 7231 delta-seconds: integer, minimum 1.
-                    headers={"Retry-After":
-                             str(max(1, math.ceil(retry_after)))})
+            # Quota PEEK first (non-consuming): an exhausted key gets the
+            # 403 with its window-reset Retry-After without burning rate
+            # tokens it would need once the window rolls.
+            if self._quota_tracker is not None:
+                allowed, retry_after = self._quota_tracker.would_allow(
+                    identity)
+                if not allowed:
+                    self._requests.inc(route="throttled", outcome="403")
+                    return web.json_response(
+                        {"error": "quota exceeded"}, status=403,
+                        headers={"Retry-After":
+                                 str(max(1, math.ceil(retry_after)))})
+            if self._rate_limiter is not None:
+                allowed, retry_after = self._rate_limiter.allow(identity)
+                if not allowed:
+                    # A rate-refused request has consumed no quota (the
+                    # peek above doesn't count).
+                    self._requests.inc(route="throttled", outcome="429")
+                    return web.json_response(
+                        {"error": "rate limit exceeded"}, status=429,
+                        # RFC 7231 delta-seconds: integer, minimum 1.
+                        headers={"Retry-After":
+                                 str(max(1, math.ceil(retry_after)))})
+            if self._quota_tracker is not None:
+                self._quota_tracker.allow(identity)  # consume the unit
         return await handler(request)
 
     def add_async_route(self, prefix: str, task_endpoint: str,

@@ -146,3 +146,126 @@ class TestGatewayThrottle:
                 await gw.close()
 
         run(main())
+
+
+class TestQuota:
+    """Per-key request QUOTAS — APIM's longer-horizon product cap beside
+    the rate throttle: fixed windows, 403 + Retry-After on exhaustion."""
+
+    def test_window_exhausts_then_resets(self):
+        from ai4e_tpu.gateway.ratelimit import Quota, QuotaTracker
+
+        clock = FakeClock()
+        q = QuotaTracker(Quota(requests=3, window_seconds=60), clock=clock)
+        assert all(q.allow("k")[0] for _ in range(3))
+        allowed, retry = q.allow("k")
+        assert not allowed and 0 < retry <= 60
+        clock.t += retry  # window rolls — a fresh allowance
+        assert q.allow("k")[0]
+
+    def test_per_key_override_and_independence(self):
+        from ai4e_tpu.gateway.ratelimit import Quota, QuotaTracker
+
+        clock = FakeClock()
+        q = QuotaTracker(Quota(requests=1, window_seconds=60),
+                         per_key={"big": Quota(requests=5,
+                                               window_seconds=60)},
+                         clock=clock)
+        assert q.allow("small")[0] and not q.allow("small")[0]
+        assert all(q.allow("big")[0] for _ in range(5))
+        assert not q.allow("big")[0]
+
+    def test_parsers(self):
+        import pytest
+
+        from ai4e_tpu.gateway.ratelimit import parse_quota, parse_quotas
+
+        assert parse_quota("100").requests == 100
+        assert parse_quota("100").window_seconds == 3600.0
+        assert parse_quota("5/86400").window_seconds == 86400.0
+        out = parse_quotas("partner=100000/86400, free=10")
+        assert out["partner"].requests == 100000
+        assert out["free"].window_seconds == 3600.0
+        with pytest.raises(ValueError):
+            parse_quotas("nokey")
+        with pytest.raises(ValueError):
+            parse_quota("0")
+
+    def test_none_default_is_unlimited_and_untracked(self):
+        from ai4e_tpu.gateway.ratelimit import Quota, QuotaTracker
+
+        clock = FakeClock()
+        q = QuotaTracker(None, per_key={"metered": Quota(requests=1)},
+                         clock=clock)
+        for _ in range(50):
+            assert q.allow("some-client-ip")[0]
+        # Unquota'd identities leave no window bookkeeping behind.
+        assert "some-client-ip" not in q._windows
+        assert q.allow("metered")[0] and not q.allow("metered")[0]
+
+    def test_quota_refusal_consumes_no_rate_token(self):
+        """The 403 path must leave rate tokens intact: once the quota
+        window rolls, the client's accrued rate allowance still exists."""
+        from ai4e_tpu.gateway.ratelimit import Quota, QuotaTracker
+
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            platform.gateway.set_api_keys({"good-key"})
+            platform.gateway.set_rate_limiter(
+                RateLimiter(RateLimit(rps=0.001, burst=2)))
+            tracker = QuotaTracker(Quota(requests=1, window_seconds=3600))
+            platform.gateway.set_quota_tracker(tracker)
+            platform.publish_async_api("/v1/api/run",
+                                       "http://127.0.0.1:1/v1/api/run")
+            gw = await serve(platform.gateway.app)
+            hdr = {"X-Api-Key": "good-key"}
+            try:
+                assert (await gw.post("/v1/api/run", data=b"x",
+                                      headers=hdr)).status == 200
+                for _ in range(5):
+                    r = await gw.post("/v1/api/run", data=b"x", headers=hdr)
+                    assert r.status == 403
+                # 1 rate token spent on the 200; the 403s spent none.
+                assert platform.gateway._rate_limiter._buckets[
+                    "good-key"][0] >= 0.99
+            finally:
+                await gw.close()
+
+        run(main())
+
+    def test_gateway_403_after_quota_and_rate_refusals_dont_consume(self):
+        from ai4e_tpu.gateway.ratelimit import Quota, QuotaTracker
+
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            platform.gateway.set_api_keys({"good-key"})
+            # Rate: 1-token burst refilling slowly; quota: 2 per window.
+            platform.gateway.set_rate_limiter(
+                RateLimiter(RateLimit(rps=0.001, burst=1)))
+            platform.gateway.set_quota_tracker(
+                QuotaTracker(Quota(requests=2, window_seconds=3600)))
+            platform.publish_async_api("/v1/api/run",
+                                       "http://127.0.0.1:1/v1/api/run")
+            gw = await serve(platform.gateway.app)
+            hdr = {"X-Api-Key": "good-key"}
+            try:
+                r1 = await gw.post("/v1/api/run", data=b"x", headers=hdr)
+                assert r1.status == 200  # rate token + 1 quota unit
+                # Rate-refused requests must NOT consume quota.
+                for _ in range(3):
+                    r = await gw.post("/v1/api/run", data=b"x", headers=hdr)
+                    assert r.status == 429
+                # Refill one rate token; quota unit 2 of 2 is spent...
+                platform.gateway._rate_limiter._buckets["good-key"][0] = 1.0
+                assert (await gw.post("/v1/api/run", data=b"x",
+                                      headers=hdr)).status == 200
+                # ...so the NEXT rate-admitted request hits the quota: 403.
+                platform.gateway._rate_limiter._buckets["good-key"][0] = 1.0
+                r = await gw.post("/v1/api/run", data=b"x", headers=hdr)
+                assert r.status == 403
+                assert float(r.headers["Retry-After"]) > 0
+                assert "quota" in (await r.json())["error"]
+            finally:
+                await gw.close()
+
+        run(main())
