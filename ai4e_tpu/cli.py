@@ -234,6 +234,13 @@ def build_worker(config: FrameworkConfig, models: dict):
             (k.strip()
              for k in (config.service.taskstore_api_key or "").split(",")
              if k.strip()), None)
+        # A comma-separated value is the control-plane REPLICA SET
+        # (primary first, then standby — control-plane-standby.yaml): the
+        # store client rotates on connection failure / 503-not-primary so a
+        # failover needs no worker restart (_HttpStoreClient._request).
+        if isinstance(store_base, str) and "," in store_base:
+            store_base = [u.strip() for u in store_base.split(",")
+                          if u.strip()]
         task_manager = HttpTaskManager(store_base, api_key=key)
         store = HttpResultStore(store_base, api_key=key)
         if config.service.result_dir:

@@ -127,6 +127,7 @@ class PlatformSection:
     native_store: bool = False
     push_ttl_seconds: float = 300.0  # event TTL 5 min (deploy_event_grid_subscription.sh:37)
     push_max_attempts: int = 3       # max delivery attempts (same line)
+    push_window: int = 256           # concurrent in-flight deliveries
     # Stuck-task watchdog (taskstore/reaper.py): rescue tasks stuck in
     # "running" after a worker died post-adoption. None disables.
     reaper_running_timeout: typing.Optional[float] = None
@@ -139,6 +140,15 @@ class PlatformSection:
     # results >= threshold bytes land under result_dir instead of store memory.
     result_dir: typing.Optional[str] = None
     result_offload_threshold: int = 1048576
+    # Control-plane HA (taskstore/replication.py): primary URL to replicate
+    # from — set on the STANDBY replica (requires journal_path); a watchdog
+    # promotes it when the primary dies.
+    replicate_from: typing.Optional[str] = None
+    failover_interval: float = 2.0
+    failover_down_after: int = 3
+    # Subscription key for the primary's keyed control-plane port (the
+    # journal stream rides behind the gateway key middleware).
+    replicate_api_key: typing.Optional[str] = None
 
     def to_platform_config(self):
         from .platform_assembly import PlatformConfig
@@ -153,12 +163,19 @@ class PlatformSection:
             native_store=self.native_store,
             push_ttl_seconds=self.push_ttl_seconds,
             push_max_attempts=self.push_max_attempts,
+            push_window=self.push_window,
             reaper_running_timeout=self.reaper_running_timeout,
             reaper_interval=self.reaper_interval,
             reaper_max_requeues=self.reaper_max_requeues,
             reaper_terminal_retention=self.reaper_terminal_retention,
             result_dir=self.result_dir,
             result_offload_threshold=self.result_offload_threshold,
+            replicate_from=self.replicate_from,
+            failover_interval=self.failover_interval,
+            failover_down_after=self.failover_down_after,
+            replicate_api_key=next(
+                (k.strip() for k in (self.replicate_api_key or "").split(",")
+                 if k.strip()), None),
         )
 
 

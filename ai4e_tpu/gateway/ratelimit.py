@@ -174,8 +174,12 @@ class QuotaTracker:
 def parse_quota(spec: str) -> Quota:
     """``"N/seconds"`` or bare ``"N"`` (hour window)."""
     n, _, window = (spec or "").strip().partition("/")
-    return Quota(requests=int(n),
-                 window_seconds=float(window) if window else 3600.0)
+    try:
+        return Quota(requests=int(n),
+                     window_seconds=float(window) if window else 3600.0)
+    except ValueError:
+        raise ValueError(
+            f"bad quota spec {spec!r}; expected N[/window_seconds]") from None
 
 
 def parse_quotas(spec: str) -> dict[str, Quota]:

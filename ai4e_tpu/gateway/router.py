@@ -224,14 +224,26 @@ class Gateway:
             if request.query_string:
                 endpoint += "?" + request.query_string
             from ..observability import get_tracer
+            from ..taskstore import NotPrimaryError
             with get_tracer().span("create_task", route=route.prefix,
                                    headers=request.headers) as span:
-                task = self.store.upsert(APITask(
-                    endpoint=endpoint,
-                    body=body,
-                    content_type=request.content_type or "application/json",
-                    publish=True,
-                ))
+                try:
+                    task = self.store.upsert(APITask(
+                        endpoint=endpoint,
+                        body=body,
+                        content_type=request.content_type or "application/json",
+                        publish=True,
+                    ))
+                except NotPrimaryError:
+                    # Standby control plane: reads are served here, task
+                    # creation belongs to the primary — tell the client to
+                    # retry (the LB/DNS flips after failover promotion).
+                    self._requests.inc(route=route.prefix,
+                                       outcome="not_primary")
+                    return web.json_response(
+                        {"error": "standby replica; task creation is on "
+                                  "the primary"},
+                        status=503, headers={"Retry-After": "2"})
                 span.task_id = task.task_id
             stored = self.store.get(task.task_id)
             outcome = "failed" if stored.canonical_status == "failed" else "created"

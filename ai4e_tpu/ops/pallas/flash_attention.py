@@ -89,17 +89,28 @@ def _dividing_block(s: int, target: int) -> int:
     return 1
 
 
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
-                    block_k: int = 1024, interpret: bool | None = None,
+def default_blocks(d: int) -> tuple[int, int]:
+    """Default (block_q, block_k) for head_dim ``d``.
+
+    Tuned on TPU v5e at S=4096 D=128: 512/1024 measured 1.9x the 128/128
+    blocks (74 vs 138 ms at B·H=128) at a ~3.4 MB double-buffered VMEM
+    footprint (validate.py). VMEM cost scales linearly with D, so for
+    D > 128 the tiles shrink proportionally (floor 128 — the sublane/lane
+    minimum for fp32 tiling) to keep the footprint roughly constant rather
+    than inheriting 4-8x bigger tiles that could exceed VMEM."""
+    scale = max(1, d // 128)
+    return max(128, 512 // scale), max(128, 1024 // scale)
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int | None = None,
+                    block_k: int | None = None, interpret: bool | None = None,
                     mesh=None, batch_axes=None):
     """Fused attention: q (B, H, S_q, D), k/v (B, H, S_k, D) → (B, H, S_q, D).
 
     Block sizes round DOWN to divisors of the sequence lengths, so any length
     works (prime lengths degrade toward block 1 — pad such sequences).
-    Defaults tuned on TPU v5e at S=4096 D=128: 512/1024 measured 1.9x the
-    128/128 blocks (74 vs 138 ms at B·H=128) — bigger q/k tiles amortize
-    the per-block softmax rescale against the MXU matmuls, and the
-    double-buffered VMEM footprint stays ~3.4 MB (validate.py).
+    ``block_q``/``block_k`` default per head_dim via :func:`default_blocks`
+    (512/1024 at D≤128, shrinking for larger D to bound VMEM).
     ``interpret`` defaults to True off-TPU (CPU CI runs the pallas
     interpreter; on device it compiles to Mosaic). ``mesh``/``batch_axes``
     are accepted (and ignored) so ``attention_for`` can treat this as a
@@ -112,8 +123,9 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
         raise ValueError("causal flash attention expects S_q == S_k")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    block_q = _dividing_block(s_q, block_q)
-    block_k = _dividing_block(s_k, block_k)
+    dq, dk = default_blocks(d)
+    block_q = _dividing_block(s_q, block_q if block_q is not None else dq)
+    block_k = _dividing_block(s_k, block_k if block_k is not None else dk)
     n_k_blocks = s_k // block_k
 
     def run(q3, k3, v3):

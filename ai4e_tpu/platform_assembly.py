@@ -34,6 +34,7 @@ class PlatformConfig:
     # push-transport delivery policy (deploy_event_grid_subscription.sh:37)
     push_ttl_seconds: float = 300.0
     push_max_attempts: int = 3
+    push_window: int = 256          # concurrent in-flight deliveries
     # stuck-task watchdog (taskstore/reaper.py); None disables
     reaper_running_timeout: float | None = None
     reaper_interval: float = 30.0
@@ -46,6 +47,20 @@ class PlatformConfig:
     # or GCS FUSE mount) instead of store memory. None dir disables offload.
     result_dir: str | None = None
     result_offload_threshold: int = 1024 * 1024
+    # Control-plane HA (taskstore/replication.py): when set, this platform
+    # boots as a STANDBY — its store is a FollowerTaskStore tailing the
+    # primary's journal stream at this URL; a watchdog promotes it (and
+    # starts transport + re-seeds dispatch) when the primary dies. Requires
+    # journal_path. The availability slot managed Redis filled for the
+    # reference (deploy_cache_prerequisites.sh:15-31).
+    replicate_from: str | None = None
+    failover_interval: float = 2.0
+    failover_down_after: int = 3
+    # Subscription key for the journal stream when the primary's control
+    # plane runs keyed (the task-store surface rides the gateway app behind
+    # the key middleware — an unkeyed replicator would 401 forever and the
+    # standby would never sync).
+    replicate_api_key: str | None = None
 
 
 class LocalPlatform:
@@ -73,7 +88,17 @@ class LocalPlatform:
             result_backend=result_backend,
             result_offload_threshold=(self.config.result_offload_threshold
                                       if result_backend else None))
-        if self.config.journal_path:
+        if self.config.replicate_from:
+            if not self.config.journal_path:
+                raise ValueError(
+                    "replicate_from (standby mode) requires journal_path — "
+                    "the follower journals the absorbed stream")
+            if self.config.native_store:
+                raise ValueError("standby mode requires the Python store")
+            from .taskstore.store import FollowerTaskStore
+            self.store = FollowerTaskStore(self.config.journal_path,
+                                           **result_kwargs)
+        elif self.config.journal_path:
             if self.config.native_store:
                 raise ValueError(
                     "native_store has no journal; use journal_path with the "
@@ -107,6 +132,7 @@ class LocalPlatform:
                 ttl_seconds=self.config.push_ttl_seconds,
                 max_attempts=self.config.push_max_attempts,
                 retry_delay=self.config.retry_delay,
+                window=self.config.push_window,
                 metrics=self.metrics)
             self.webhook = WebhookDispatcher(self.task_manager,
                                              metrics=self.metrics)
@@ -149,6 +175,8 @@ class LocalPlatform:
             process_interval=self.config.process_depth_interval)
         self.services: list[APIService] = []
         self.autoscalers: list = []
+        self.replicator = None
+        self.watchdog = None
         self._started = False
 
     # -- assembly ----------------------------------------------------------
@@ -216,6 +244,36 @@ class LocalPlatform:
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
+        if self.config.replicate_from:
+            # Standby: tail the primary's journal, serve reads, refuse
+            # writes; the watchdog promotes us (and only then does the
+            # transport start — a standby must never double-dispatch tasks
+            # the primary is already delivering).
+            from .taskstore.replication import (FailoverWatchdog,
+                                                JournalReplicator)
+            self.replicator = JournalReplicator(
+                self.store, self.config.replicate_from,
+                api_key=self.config.replicate_api_key)
+            self.replicator.start()
+            self.watchdog = FailoverWatchdog(
+                self.replicator,
+                interval=self.config.failover_interval,
+                down_after=self.config.failover_down_after,
+                on_promote=self._on_promoted)
+            self.watchdog.start()
+            await self.depth_logger.start()
+            self._started = True
+            return
+        await self._start_transport(loop)
+        await self.depth_logger.start()
+        if self.reaper is not None:
+            await self.reaper.start()
+        for scaler in self.autoscalers:
+            await scaler.start()
+        self._reseed_unfinished()
+        self._started = True
+
+    async def _start_transport(self, loop: asyncio.AbstractEventLoop) -> None:
         if self.config.transport == "push":
             await self._start_push(loop)
         else:
@@ -229,13 +287,26 @@ class LocalPlatform:
 
             self.broker.set_dead_letter_handler(on_dead_letter)
             await self.dispatchers.start()
-        await self.depth_logger.start()
+
+    async def _on_promoted(self) -> None:
+        """Watchdog fired: this standby is now the primary. Start transport
+        + watchdogs and re-dispatch EVERY unfinished task (they arrived via
+        replication, so none has a broker message here) — exactly the
+        restart re-seed, with the replicated store as the journal."""
+        import logging
+        logging.getLogger("ai4e_tpu.platform").warning(
+            "promoted to primary; starting transport and re-seeding "
+            "%d unfinished tasks", len(self.store.unfinished_tasks()))
+        loop = asyncio.get_running_loop()
+        await self._start_transport(loop)
         if self.reaper is not None:
             await self.reaper.start()
         for scaler in self.autoscalers:
             await scaler.start()
-        self._reseed_unfinished()
-        self._started = True
+        publish = (self.topic.publish if self.config.transport == "push"
+                   else self.broker.publish)
+        for task in self.store.unfinished_tasks():
+            publish(task)
 
     async def _start_push(self, loop: asyncio.AbstractEventLoop) -> None:
         """Push transport: serve the webhook dispatcher app, then validate
@@ -287,6 +358,12 @@ class LocalPlatform:
                 publish(task)
 
     async def stop(self) -> None:
+        if self.watchdog is not None:
+            await self.watchdog.stop()
+            self.watchdog = None
+        if self.replicator is not None:
+            await self.replicator.aclose()
+            self.replicator = None
         if self._started:
             for scaler in self.autoscalers:
                 await scaler.stop()

@@ -184,13 +184,14 @@ def build_unet(name: str = "landcover", tile: int = 256,
                 np.asarray(out["classmap"]))
         return result
 
-    if wire == "yuv420":
+    if wire in ("yuv420", "dct"):
         def on_normalized(p, x):
             return fused_seg_postprocess(model.apply(p, x),
                                          with_classmap=return_classmap)
 
-        return _yuv_servable(name, params, on_normalized, tile, tile,
-                             fused_postprocess_fn, buckets)
+        build = _yuv_servable if wire == "yuv420" else _dct_servable
+        return build(name, params, on_normalized, tile, tile,
+                     fused_postprocess_fn, buckets)
 
     if fused_postprocess:
         def apply_fn(p, batch):
@@ -261,9 +262,10 @@ def build_resnet(name: str = "classifier", image_size: int = 224,
                 "confidence": float(probs[top])}
 
     _check_wire(wire, fused_normalize, "fused_normalize")
-    if wire == "yuv420":
-        return _yuv_servable(name, variables, model.apply,
-                             image_size, image_size, postprocess, buckets)
+    if wire in ("yuv420", "dct"):
+        build = _yuv_servable if wire == "yuv420" else _dct_servable
+        return build(name, variables, model.apply,
+                     image_size, image_size, postprocess, buckets)
 
     apply_fn, input_dtype = _maybe_fused_uint8(model.apply, fused_normalize)
     return ServableModel(
@@ -289,13 +291,13 @@ def _maybe_fused_uint8(apply_fn, fused: bool):
 
 def _check_wire(wire: str, fused: bool, fused_flag: str) -> None:
     """Uniform wire validation for the image families: unknown wire values
-    and the yuv420-without-fused-ingestion conflict both fail at build time
-    (yuv reconstruction IS the fused ingestion — disabling it while asking
-    for the yuv wire is contradictory, not overridable)."""
-    if wire not in ("rgb8", "yuv420"):
-        raise ValueError(f"wire must be rgb8|yuv420, got {wire!r}")
-    if wire == "yuv420" and not fused:
-        raise ValueError(f"wire='yuv420' requires {fused_flag}=True")
+    and the compressed-wire-without-fused-ingestion conflict both fail at
+    build time (wire reconstruction IS the fused ingestion — disabling it
+    while asking for a compressed wire is contradictory, not overridable)."""
+    if wire not in ("rgb8", "yuv420", "dct"):
+        raise ValueError(f"wire must be rgb8|yuv420|dct, got {wire!r}")
+    if wire in ("yuv420", "dct") and not fused:
+        raise ValueError(f"wire={wire!r} requires {fused_flag}=True")
 
 
 def _yuv_servable(name: str, params, apply_on_normalized, h: int, w: int,
@@ -334,6 +336,38 @@ def _yuv_servable(name: str, params, apply_on_normalized, h: int, w: int,
         example_decoder=lambda flat: yuv420_to_rgb_numpy(flat, h, w))
 
 
+def _dct_servable(name: str, params, apply_on_normalized, h: int, w: int,
+                  postprocess, buckets) -> ServableModel:
+    """DCT-truncation wire servable (``ops/dct.py``): clients ship the usual
+    image/npy payloads, the host packs quantized K×K DCT coefficients
+    (0.375 B/px — 4× less h2d than yuv420, 8× less than raw RGB), the
+    device decodes with dequant + per-block IDCT matmuls fused into the
+    model's first op. Same construction contract as ``_yuv_servable``."""
+    from ..ops.dct import (dct_nbytes, dct_to_rgb, dct_to_rgb_numpy,
+                           rgb_to_dct)
+
+    if h % 16 or w % 16:
+        # Fail at BUILD time (8-px luma blocks × 2× chroma subsampling).
+        raise ValueError(f"wire='dct' needs dims divisible by 16, "
+                         f"got {h}x{w}")
+    rgb_pre = _image_preprocess((h, w, 3), np.uint8)
+
+    def preprocess(body: bytes, content_type: str):
+        return rgb_to_dct(rgb_pre(body, content_type))
+
+    def apply_fn(p, batch):
+        return apply_on_normalized(p, dct_to_rgb(batch, h, w))
+
+    return ServableModel(
+        name=name, apply_fn=apply_fn, params=params,
+        input_shape=(dct_nbytes(h, w),), input_dtype=np.int8,
+        preprocess=preprocess, postprocess=postprocess,
+        batch_buckets=tuple(buckets),
+        stack_item_shape=(h, w, 3), stack_item_dtype=np.uint8,
+        stack_adapter=rgb_to_dct,
+        example_decoder=lambda flat: dct_to_rgb_numpy(flat, h, w))
+
+
 def build_detector(name: str = "megadetector", image_size: int = 512,
                    widths=(64, 128, 256), max_detections: int = 64,
                    score_threshold: float = 0.2, buckets=(1, 8, 16),
@@ -368,9 +402,10 @@ def build_detector(name: str = "megadetector", image_size: int = 512,
             for i in np.nonzero(keep)[0]]}
 
     _check_wire(wire, fused_normalize, "fused_normalize")
-    if wire == "yuv420":
-        return _yuv_servable(name, params, raw_apply,
-                             image_size, image_size, postprocess, buckets)
+    if wire in ("yuv420", "dct"):
+        build = _yuv_servable if wire == "yuv420" else _dct_servable
+        return build(name, params, raw_apply,
+                     image_size, image_size, postprocess, buckets)
 
     apply_fn, input_dtype = _maybe_fused_uint8(raw_apply, fused_normalize)
     return ServableModel(

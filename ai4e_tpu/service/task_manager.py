@@ -16,6 +16,7 @@ Both are async; sync user code goes through the service shell's executor.
 
 from __future__ import annotations
 
+import asyncio
 import json
 from urllib.parse import urlparse
 
@@ -99,23 +100,77 @@ class LocalTaskManager(TaskManagerBase):
 class _HttpStoreClient:
     """Shared plumbing for clients of the task-store HTTP service.
 
-    ``api_key`` rides as a default ``Ocp-Apim-Subscription-Key`` header on
-    every request — required when the control plane runs with gateway
-    subscription keys (the task-store surface on that port is keyed too;
-    set ``AI4E_SERVICE_TASKSTORE_API_KEY`` on workers). Ignored when the
+    ``base_url`` may be a single URL or a list — the control-plane replica
+    set (primary first; ``deploy/charts/control-plane-standby.yaml``). On a
+    connection failure or a 503 "not primary" the client rotates to the
+    next replica and retries, sticking with whichever answered (the role
+    the reference's RedisConnection retry policy + managed failover played,
+    ``RedisConnection.cs:18-19``). ``api_key`` rides as a default
+    ``Ocp-Apim-Subscription-Key`` header on every request — required when
+    the control plane runs with gateway subscription keys (the task-store
+    surface on that port is keyed too; set
+    ``AI4E_SERVICE_TASKSTORE_API_KEY`` on workers). Ignored when the
     caller passes its own ``session``.
     """
 
-    def __init__(self, base_url: str,
+    def __init__(self, base_url: str | list[str],
                  session: aiohttp.ClientSession | None = None,
-                 api_key: str | None = None):
-        self.base_url = base_url.rstrip("/")
+                 api_key: str | None = None,
+                 failover_cycles: int = 3, failover_delay: float = 0.5):
+        urls = [base_url] if isinstance(base_url, str) else list(base_url)
+        if not urls:
+            raise ValueError("at least one task-store URL is required")
+        self._endpoints = [u.rstrip("/") for u in urls]
+        self.base_url = self._endpoints[0]
+        self._failover_cycles = failover_cycles
+        self._failover_delay = failover_delay
         headers = ({"Ocp-Apim-Subscription-Key": api_key}
                    if api_key else None)
         self._holder = SessionHolder(session, headers=headers)
 
     async def _get_session(self) -> aiohttp.ClientSession:
         return await self._holder.get()
+
+    async def _request(self, method: str, path: str, **kwargs
+                       ) -> tuple[aiohttp.ClientResponse, bytes]:
+        """One store round trip with replica failover: try the active
+        endpoint, rotate on connection errors / timeouts / 503-not-primary.
+        With a single endpoint this is a plain request (no retry tax on the
+        common deployment). Returns ``(response, body)`` — the body is read
+        inside the request context (aiohttp refuses reads on a released
+        response) and the response object carries status/headers."""
+        session = await self._get_session()
+        last_exc: Exception | None = None
+        single = len(self._endpoints) == 1
+        cycles = 1 if single else self._failover_cycles
+        for cycle in range(cycles):
+            ordered = ([self.base_url]
+                       + [e for e in self._endpoints if e != self.base_url])
+            for base in ordered:
+                try:
+                    async with session.request(
+                            method, base + path, **kwargs) as resp:
+                        body = await resp.read()
+                    if resp.status == 503 and not single:
+                        # A follower replica refusing the write, or a
+                        # draining primary — rotate.
+                        last_exc = aiohttp.ClientResponseError(
+                            resp.request_info, (), status=503,
+                            message="replica not primary")
+                        continue
+                    self.base_url = base
+                    return resp, body
+                except (aiohttp.ClientConnectionError,
+                        asyncio.TimeoutError, OSError) as exc:
+                    last_exc = exc
+                    continue
+            if cycle + 1 < cycles:
+                # Every replica refused/unreachable: failover may be mid
+                # promotion (watchdog needs a few probe intervals) — wait
+                # one beat and re-cycle before giving up.
+                await asyncio.sleep(self._failover_delay)
+        assert last_exc is not None
+        raise last_exc
 
     async def close(self) -> None:
         await self._holder.close()
@@ -125,24 +180,20 @@ class HttpTaskManager(_HttpStoreClient, TaskManagerBase):
     """Client for the task-store HTTP service (``taskstore.http``)."""
 
     async def get_task_status(self, task_id: str) -> dict | None:
-        session = await self._get_session()
-        async with session.get(
-            f"{self.base_url}/v1/taskstore/task", params={"taskId": task_id}
-        ) as resp:
-            if resp.status != 200:
-                return None
-            return await resp.json()
+        resp, body = await self._request("GET", "/v1/taskstore/task",
+                                         params={"taskId": task_id})
+        if resp.status != 200:
+            return None
+        return json.loads(body)
 
     async def _upsert(self, task: APITask) -> dict:
         payload = task.to_dict()
         payload["Body"] = task.body.decode("utf-8", errors="surrogateescape")
         payload["PublishToGrid"] = task.publish
-        session = await self._get_session()
-        async with session.post(
-            f"{self.base_url}/v1/taskstore/upsert", data=json.dumps(payload)
-        ) as resp:
-            resp.raise_for_status()
-            return await resp.json()
+        resp, body = await self._request("POST", "/v1/taskstore/upsert",
+                                         data=json.dumps(payload))
+        resp.raise_for_status()
+        return json.loads(body)
 
     async def _update(self, task_id: str, status: str,
                       backend_status: str | None = None) -> dict:
@@ -153,14 +204,12 @@ class HttpTaskManager(_HttpStoreClient, TaskManagerBase):
             "Status": status,
             "BackendStatus": backend_status or TaskStatus.canonical(status),
         }
-        session = await self._get_session()
-        async with session.post(
-            f"{self.base_url}/v1/taskstore/update", data=json.dumps(payload)
-        ) as resp:
-            resp.raise_for_status()
-            if resp.status != 200:  # 204 = task unknown to the store
-                raise KeyError(f"task not found: {task_id}")
-            return await resp.json()
+        resp, body = await self._request("POST", "/v1/taskstore/update",
+                                         data=json.dumps(payload))
+        resp.raise_for_status()
+        if resp.status != 200:  # 204 = task unknown to the store
+            raise KeyError(f"task not found: {task_id}")
+        return json.loads(body)
 
 
 class HttpResultStore(_HttpStoreClient):
@@ -174,20 +223,18 @@ class HttpResultStore(_HttpStoreClient):
         params = {"taskId": task_id}
         if stage:
             params["stage"] = stage
-        session = await self._get_session()
-        async with session.post(
-            f"{self.base_url}/v1/taskstore/result", params=params,
-            data=result, headers={"Content-Type": content_type},
-        ) as resp:
-            if resp.status == 404:
-                # Store no longer knows the task (e.g. control plane
-                # restarted without a journal) — surface the drop; the
-                # subsequent complete_task will fail loudly too.
-                import logging
-                logging.getLogger("ai4e_tpu.task_manager").warning(
-                    "result for unknown task %s dropped by store", task_id)
-                return
-            resp.raise_for_status()
+        resp, _body = await self._request(
+            "POST", "/v1/taskstore/result", params=params,
+            data=result, headers={"Content-Type": content_type})
+        if resp.status == 404:
+            # Store no longer knows the task (e.g. control plane
+            # restarted without a journal) — surface the drop; the
+            # subsequent complete_task will fail loudly too.
+            import logging
+            logging.getLogger("ai4e_tpu.task_manager").warning(
+                "result for unknown task %s dropped by store", task_id)
+            return
+        resp.raise_for_status()
 
     async def set_result_ref(self, task_id: str,
                              content_type: str = "application/json",
@@ -197,19 +244,16 @@ class HttpResultStore(_HttpStoreClient):
         payload = {"TaskId": task_id, "ContentType": content_type}
         if stage:
             payload["Stage"] = stage
-        session = await self._get_session()
-        async with session.post(
-            f"{self.base_url}/v1/taskstore/result-ref",
-            data=json.dumps(payload),
-        ) as resp:
-            if resp.status == 404:
-                import logging
-                logging.getLogger("ai4e_tpu.task_manager").warning(
-                    "result ref for unknown task %s dropped by store",
-                    task_id)
-                return False  # caller may reap the orphaned blob
-            resp.raise_for_status()
-            return True
+        resp, _body = await self._request("POST", "/v1/taskstore/result-ref",
+                                          data=json.dumps(payload))
+        if resp.status == 404:
+            import logging
+            logging.getLogger("ai4e_tpu.task_manager").warning(
+                "result ref for unknown task %s dropped by store",
+                task_id)
+            return False  # caller may reap the orphaned blob
+        resp.raise_for_status()
+        return True
 
     async def get_result(self, task_id: str,
                          stage: str | None = None
@@ -217,13 +261,11 @@ class HttpResultStore(_HttpStoreClient):
         params = {"taskId": task_id}
         if stage:
             params["stage"] = stage
-        session = await self._get_session()
-        async with session.get(
-            f"{self.base_url}/v1/taskstore/result", params=params,
-        ) as resp:
-            if resp.status != 200:
-                return None
-            return await resp.read(), resp.content_type
+        resp, body = await self._request("GET", "/v1/taskstore/result",
+                                         params=params)
+        if resp.status != 200:
+            return None
+        return body, resp.content_type
 
 
 class DirectResultStore:

@@ -1,5 +1,11 @@
 from .results import FileResultBackend, ResultBackend
-from .store import InMemoryTaskStore, JournaledTaskStore, TaskNotFound
+from .store import (
+    FollowerTaskStore,
+    InMemoryTaskStore,
+    JournaledTaskStore,
+    NotPrimaryError,
+    TaskNotFound,
+)
 from .task import APITask, TaskStatus, endpoint_path, new_task_id
 
 __all__ = [
@@ -9,6 +15,8 @@ __all__ = [
     "new_task_id",
     "InMemoryTaskStore",
     "JournaledTaskStore",
+    "FollowerTaskStore",
+    "NotPrimaryError",
     "TaskNotFound",
     "FileResultBackend",
     "ResultBackend",

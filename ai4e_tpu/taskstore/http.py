@@ -13,16 +13,31 @@ app, so services on other hosts can share one task store:
   ``distributed_api_task.py:29-56``)
 - ``GET  /v1/taskstore/task?taskId=…`` — poll a task (204 if absent)
 - ``GET  /v1/taskstore/depths``   — per-endpoint status-set depths (autoscale signal)
+
+Journaled stores additionally serve the replication surface
+(``replication.py`` — the availability slot managed Redis filled for the
+reference):
+
+- ``GET  /v1/taskstore/journal?offset=&generation=&wait=`` — stream journal
+  bytes from ``offset`` (long-polls up to ``wait`` s when caught up); a
+  generation mismatch (the journal was compacted) restarts the reader at
+  offset 0 with ``X-Journal-Generation``/``X-Journal-Offset`` headers;
+- ``POST /v1/taskstore/promote`` — flip a follower replica to primary
+  (manual failover; the watchdog calls ``store.promote()`` directly).
+
+Mutations against a follower replica return 503 ``{"error": "not primary"}``
+so store clients fail over.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import os
 
 from aiohttp import web
 
-from .store import InMemoryTaskStore, TaskNotFound
+from .store import InMemoryTaskStore, NotPrimaryError, TaskNotFound
 from .task import APITask
 
 
@@ -50,6 +65,11 @@ def make_app(store: InMemoryTaskStore,
         return web.json_response(
             {"error": f"body exceeds {limit} bytes"}, status=413)
 
+    def not_primary() -> web.Response:
+        # 503 (not 4xx): the write is valid, THIS replica can't take it —
+        # clients with a replica list rotate to the primary (task_manager).
+        return web.json_response({"error": "not primary"}, status=503)
+
     async def upsert(request: web.Request) -> web.Response:
         raw = await read_body_limited(request, max_body_bytes)
         if raw is None:
@@ -61,7 +81,10 @@ def make_app(store: InMemoryTaskStore,
         task = APITask.from_dict(payload)
         # Existing-task transition if a TaskId was supplied and known; otherwise
         # create (CacheConnectorUpsert.cs decides the same way, :90-108).
-        task = store.upsert(task)
+        try:
+            task = store.upsert(task)
+        except NotPrimaryError:
+            return not_primary()
         return web.json_response(store.get(task.task_id).to_dict())
 
     async def update(request: web.Request) -> web.Response:
@@ -80,6 +103,8 @@ def make_app(store: InMemoryTaskStore,
             task = store.update_status(task_id, status, payload.get("BackendStatus"))
         except TaskNotFound:
             return web.Response(status=204)
+        except NotPrimaryError:
+            return not_primary()
         return web.json_response(task.to_dict())
 
     async def get_task(request: web.Request) -> web.Response:
@@ -112,6 +137,8 @@ def make_app(store: InMemoryTaskStore,
             # treats 2xx as "stored".
             return web.json_response({"error": f"unknown task {task_id}"},
                                      status=404)
+        except NotPrimaryError:
+            return not_primary()
         return web.json_response({"ok": True})
 
     async def get_result(request: web.Request) -> web.Response:
@@ -191,6 +218,8 @@ def make_app(store: InMemoryTaskStore,
             # worker; 409 so the worker fails loudly instead of serving a
             # dangling pointer.
             return web.json_response({"error": str(exc)}, status=409)
+        except NotPrimaryError:
+            return not_primary()
         except RuntimeError as exc:  # store has no backend configured
             return web.json_response({"error": str(exc)}, status=400)
         return web.json_response({"ok": True})
@@ -198,4 +227,82 @@ def make_app(store: InMemoryTaskStore,
     app.router.add_post("/v1/taskstore/result", put_result)
     app.router.add_post("/v1/taskstore/result-ref", put_result_ref)
     app.router.add_get("/v1/taskstore/result", get_result)
+
+    # -- replication surface (journaled stores only; replication.py) -------
+
+    journal_path = getattr(store, "_journal_path", None)
+    if journal_path is not None:
+        async def journal_stream(request: web.Request) -> web.Response:
+            """Serve raw journal bytes from ``offset`` for the follower's
+            tail loop. A generation mismatch — the journal was compacted and
+            byte offsets invalidated — restarts the reader at offset 0 of
+            the current file (which is a full state snapshot)."""
+            try:
+                offset = int(request.query.get("offset", "0"))
+                generation = int(request.query.get("generation", "-1"))
+                wait = min(float(request.query.get("wait", "0")), 55.0)
+                limit = min(int(request.query.get(
+                    "limit", str(4 * 1024 * 1024))), 64 * 1024 * 1024)
+            except ValueError:
+                return web.json_response({"error": "bad query"}, status=400)
+
+            deadline = asyncio.get_event_loop().time() + wait
+            while True:
+                # Snapshot generation + open under the store lock: compaction
+                # swaps the file under the same lock, so a handle opened here
+                # is consistent with the generation we report.
+                with store._lock:
+                    gen = store.journal_generation
+                    if generation != gen or offset < 0:
+                        served_from = 0
+                    else:
+                        served_from = offset
+                    try:
+                        fh = open(journal_path, "rb")
+                    except FileNotFoundError:
+                        fh = None
+                try:
+                    if fh is None:
+                        chunk = b""
+                        size = 0
+                    else:
+                        size = os.fstat(fh.fileno()).st_size
+                        if served_from > size:
+                            # Offset beyond the file without a generation
+                            # bump — only possible via truncation outside
+                            # the store; restart the reader.
+                            served_from = 0
+                        fh.seek(served_from)
+                        chunk = fh.read(limit)
+                finally:
+                    if fh is not None:
+                        fh.close()
+                if chunk or asyncio.get_event_loop().time() >= deadline:
+                    return web.Response(
+                        body=chunk,
+                        content_type="application/x-ndjson",
+                        headers={"X-Journal-Generation": str(gen),
+                                 "X-Journal-Offset": str(served_from),
+                                 "X-Journal-Size": str(size)})
+                # Coarse poll while caught up: replication lag tolerance is
+                # seconds, so 4 Hz keeps the per-follower open/fstat/lock
+                # cost negligible on the primary's event loop.
+                await asyncio.sleep(0.25)
+
+        async def promote(_: web.Request) -> web.Response:
+            promote_fn = getattr(store, "promote", None)
+            if promote_fn is None:
+                return web.json_response(
+                    {"error": "store is not a follower replica"}, status=400)
+            promote_fn()
+            return web.json_response({"ok": True, "role": "primary"})
+
+        async def role(_: web.Request) -> web.Response:
+            return web.json_response(
+                {"role": getattr(store, "role", "primary"),
+                 "generation": store.journal_generation})
+
+        app.router.add_get("/v1/taskstore/journal", journal_stream)
+        app.router.add_post("/v1/taskstore/promote", promote)
+        app.router.add_get("/v1/taskstore/role", role)
     return app

@@ -1,0 +1,230 @@
+"""Control-plane replication — journal streaming, follower sync, failover.
+
+The reference keeps task state in managed network Redis: any number of
+gateway/dispatcher/function instances reach it concurrently and Azure keeps
+it available behind a connection-retry policy
+(``ProcessManager/Libraries/RedisConnection.cs:12-38``,
+``InfrastructureDeployment/deploy_cache_prerequisites.sh:15-31``). This
+framework's store is an in-process state machine with a journal — durable
+(r3) but single-homed. This module adds the availability half:
+
+- the primary's HTTP surface streams its journal
+  (``GET /v1/taskstore/journal?offset=&generation=`` — ``http.py``);
+- ``JournalReplicator`` runs next to a ``FollowerTaskStore`` on the standby
+  replica, tailing that stream and absorbing each record, so the standby
+  holds the full task state (tasks, original bodies, results, status sets)
+  a beat behind the primary;
+- ``FailoverWatchdog`` probes the primary and, after ``down_after``
+  consecutive failures, promotes the follower — writes then flow to the
+  standby, and an ``on_promote`` hook lets the host process re-seed its
+  transport from ``unfinished_tasks()`` exactly like a restart does.
+
+Semantics and limits (stated, not hidden): replication is asynchronous —
+on failover the standby may lag by the last in-flight poll (bounded by the
+stream's long-poll turnaround, typically milliseconds); a lost tail means
+those tasks are re-created by clients, never half-applied (journal lines
+are absorbed whole). Split-brain fencing is the deployment's job: run ONE
+standby and keep the old primary out of rotation until re-seeded as a
+follower (``deploy/charts/control-plane-standby.yaml``) — the same posture
+as a Redis replica + sentinel promotion.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import aiohttp
+
+from ..utils.http import SessionHolder
+from .store import FollowerTaskStore
+
+log = logging.getLogger("ai4e_tpu.taskstore.replication")
+
+JOURNAL_PATH = "/v1/taskstore/journal"
+
+
+class JournalReplicator:
+    """Tail the primary's journal stream into a ``FollowerTaskStore``.
+
+    On (re)connect the follower is reset and resynced from offset 0: the
+    primary may have compacted while we were away (generation mismatch),
+    and local restart-compaction means our own byte count never equals the
+    primary's offset — a full resync is always correct, and the journal is
+    control-plane sized (it compacts to one record per live task). While
+    the primary is unreachable the follower simply holds its last state —
+    promotable at any moment.
+    """
+
+    def __init__(self, store: FollowerTaskStore, primary_url: str,
+                 poll_wait: float = 10.0, api_key: str | None = None,
+                 chunk_limit: int = 4 * 1024 * 1024):
+        self.store = store
+        self.primary_url = primary_url.rstrip("/")
+        self.poll_wait = poll_wait
+        self.chunk_limit = chunk_limit
+        headers = ({"Ocp-Apim-Subscription-Key": api_key}
+                   if api_key else None)
+        self._sessions = SessionHolder(headers=headers)
+        self._task: asyncio.Task | None = None
+        self._stopped = asyncio.Event()
+        # Exposed for tests/metrics: bytes applied and the primary's
+        # generation we are tracking. -1 = never connected.
+        self.offset = 0
+        self.generation = -1
+        self.synced = asyncio.Event()  # set once the first poll drains
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        buffer = b""
+        backoff = 0.5
+        while not self._stopped.is_set():
+            try:
+                session = await self._sessions.get()
+                params = {"offset": str(self.offset),
+                          "generation": str(self.generation),
+                          "wait": str(self.poll_wait),
+                          "limit": str(self.chunk_limit)}
+                async with session.get(
+                        self.primary_url + JOURNAL_PATH, params=params,
+                        timeout=aiohttp.ClientTimeout(
+                            total=self.poll_wait + 30)) as resp:
+                    if resp.status != 200:
+                        raise aiohttp.ClientError(
+                            f"journal stream returned {resp.status}")
+                    gen = int(resp.headers.get("X-Journal-Generation", "0"))
+                    served_from = int(resp.headers.get(
+                        "X-Journal-Offset", str(self.offset)))
+                    chunk = await resp.read()
+                if gen != self.generation or served_from != self.offset:
+                    # Generation change (primary compacted) or first
+                    # connect: full resync from the snapshot at offset 0.
+                    if self.generation != -1:
+                        log.info("journal generation %s -> %s; resyncing",
+                                 self.generation, gen)
+                    self.store.reset()
+                    buffer = b""
+                    self.generation = gen
+                    self.offset = served_from
+                    if served_from != 0:
+                        # Server always restarts mismatched readers at 0;
+                        # anything else is a contract violation.
+                        raise aiohttp.ClientError(
+                            f"journal reset served from offset {served_from}")
+                if chunk:
+                    buffer += chunk
+                    consumed = buffer.rfind(b"\n") + 1
+                    if consumed:
+                        lines = buffer[:consumed].decode("utf-8").splitlines()
+                        # Absorb off the event loop: applying a large resync
+                        # chunk is file+dict work that must not stall the
+                        # replica's serving loop.
+                        await asyncio.to_thread(self.store.absorb_lines, lines)
+                        buffer = buffer[consumed:]
+                    self.offset += len(chunk)
+                self.synced.set()
+                backoff = 0.5
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — keep tailing through outages
+                log.warning("journal stream from %s failed (%s); retrying",
+                            self.primary_url, exc)
+                self.generation = -1  # force clean resync on reconnect
+                try:
+                    await asyncio.wait_for(self._stopped.wait(), backoff)
+                except asyncio.TimeoutError:
+                    pass
+                backoff = min(backoff * 2, 10.0)
+
+    async def aclose(self) -> None:
+        await self.stop()
+        await self._sessions.close()
+
+
+class FailoverWatchdog:
+    """Promote the follower when the primary stops answering.
+
+    Probes ``GET {primary}/v1/taskstore/journal?offset=0&wait=0`` every
+    ``interval`` seconds; after ``down_after`` consecutive failures it stops
+    replication, promotes the store, and fires ``on_promote`` (the host
+    re-seeds dispatch from ``unfinished_tasks()``). The role the reference
+    delegated to Azure's managed-Redis availability, made explicit.
+    """
+
+    def __init__(self, replicator: JournalReplicator,
+                 interval: float = 2.0, down_after: int = 3,
+                 on_promote=None):
+        self.replicator = replicator
+        self.interval = interval
+        self.down_after = down_after
+        self.on_promote = on_promote
+        self.promoted = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self._stopped = asyncio.Event()
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+
+    async def _probe(self) -> bool:
+        try:
+            session = await self.replicator._sessions.get()
+            async with session.get(
+                    self.replicator.primary_url + JOURNAL_PATH,
+                    params={"offset": "0", "wait": "0", "limit": "1"},
+                    timeout=aiohttp.ClientTimeout(total=5.0)) as resp:
+                return resp.status == 200
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            return False
+
+    async def _run(self) -> None:
+        failures = 0
+        while not self._stopped.is_set():
+            try:
+                await asyncio.wait_for(self._stopped.wait(), self.interval)
+                return
+            except asyncio.TimeoutError:
+                pass
+            if not self.replicator.synced.is_set():
+                # Never synced since boot: promoting would crown an EMPTY
+                # store (e.g. both replicas rolling, standby ready first —
+                # the primary being briefly unreachable at our boot is not
+                # a failover). Wait for one full sync before arming.
+                continue
+            if await self._probe():
+                failures = 0
+                continue
+            failures += 1
+            if failures < self.down_after:
+                continue
+            log.warning("primary %s down after %d probes; promoting follower",
+                        self.replicator.primary_url, failures)
+            await self.replicator.stop()
+            self.replicator.store.promote()
+            if self.on_promote is not None:
+                res = self.on_promote()
+                if asyncio.iscoroutine(res):
+                    await res
+            self.promoted.set()
+            return

@@ -40,6 +40,11 @@ class TaskNotFound(KeyError):
     pass
 
 
+class NotPrimaryError(RuntimeError):
+    """A mutation reached a follower replica — only the primary accepts
+    writes (the HTTP surface maps this to 503 so store clients fail over)."""
+
+
 class StoreSideEffects:
     """Listener + publish side-effect plumbing shared by every store
     implementation (Python and native): transitions notify observers (e.g.
@@ -486,6 +491,11 @@ class JournaledTaskStore(InMemoryTaskStore):
         self._compact_every = compact_every
         self._records = 0
         self._next_compact_at = compact_every
+        # Bumped on every compaction rewrite: replication followers track
+        # (generation, byte offset) into the journal file, and a rewrite
+        # invalidates their offset — a generation mismatch tells them to
+        # resync from offset 0 (the compacted journal IS the full state).
+        self.journal_generation = 0
         self.replayed_task_ids: set[str] = set()
         if os.path.exists(journal_path):
             self._replay()
@@ -506,69 +516,80 @@ class JournaledTaskStore(InMemoryTaskStore):
                 line = line.strip()
                 if not line:
                     continue
-                rec = json.loads(line)
                 self._records += 1
-                if rec.get("Result"):
-                    # Result record: inline payload as hex, or an offloaded
-                    # pointer whose bytes are durable in the backend itself.
-                    if rec.get("Offloaded") and self._result_backend is None:
-                        # Fail FAST: replaying the pointer without a backend
-                        # would serve "completed, no result" — restore the
-                        # store's result_dir config instead.
-                        raise RuntimeError(
-                            f"journal references offloaded result "
-                            f"{rec['Key']!r} but no result backend is "
-                            f"configured (set result_dir to the same mount "
-                            f"it was written to)")
-                    body = (None if rec.get("Offloaded")
-                            else bytes.fromhex(rec.get("ResultHex", "")))
-                    self._results[rec["Key"]] = (
-                        body, rec.get("ContentType", "application/json"))
-                    continue
-                if rec.get("Evict"):
-                    # Journal is None during replay, so the subclass's
-                    # append is a no-op — this just forgets the task. Blob
-                    # deletes re-run too: a crash between the Evict append
-                    # and the original deletes leaked them; replay cleans up.
-                    for key in self._apply_evict(rec["TaskId"]):
-                        self._delete_blob(key)
-                    continue
-                if rec.get("Slim"):
-                    # Transition record: body/orig state is untouched (they
-                    # ride only on upserts), exactly like the live mutation;
-                    # the journaled timestamp is kept so set scores replay
-                    # faithfully.
-                    prev = self._tasks.get(rec["TaskId"])
-                    if prev is None:
-                        continue  # compacted-away predecessor
-                    task = prev.with_status(rec["Status"],
-                                            rec.get("BackendStatus"))
-                    task.publish = False
-                    task.timestamp = float(rec.get("Timestamp")
-                                           or task.timestamp)
-                    self._remove_from_set(prev)
-                    self._tasks[task.task_id] = task
-                    self._add_to_set(task)
-                    continue
-                task = APITask.from_dict(rec)
-                task.body = bytes.fromhex(rec.get("BodyHex", ""))
-                # Don't re-publish during replay — LocalPlatform.start()
-                # re-seeds the broker from unfinished_tasks() afterwards.
-                task.publish = False
-                super().upsert(task)
-                # Keep the journaled timestamp (upsert stamps "now"):
-                # set scores and the reaper's stuck-task age clock must
-                # survive restarts, not reset to replay time.
-                stored = self._tasks[task.task_id]
-                ts = float(rec.get("Timestamp") or stored.timestamp)
-                stored.timestamp = ts
-                self._sets[(stored.endpoint_path,
-                            stored.canonical_status)][stored.task_id] = ts
-                orig = rec.get("OrigHex")
-                if orig:
-                    self._orig_bodies[task.task_id] = (
-                        bytes.fromhex(orig),
-                        rec.get("OrigContentType", "application/json"))
+                self._apply_replay_record(json.loads(line))
+
+    def _apply_replay_record(self, rec: dict) -> "APITask | None":
+        """Apply ONE journal record to in-memory state — the replay step,
+        also the unit a replication follower applies per streamed line
+        (``replication.py``). Journaling is gated off in both cases
+        (``self._journal is None``), so applying never re-appends.
+
+        Returns the transitioned task for Slim records (the follower must
+        ``_notify`` its own long-poll waiters of replicated transitions —
+        the full-upsert branch already notifies via ``upsert``); None
+        otherwise."""
+        if rec.get("Result"):
+            # Result record: inline payload as hex, or an offloaded
+            # pointer whose bytes are durable in the backend itself.
+            if rec.get("Offloaded") and self._result_backend is None:
+                # Fail FAST: replaying the pointer without a backend
+                # would serve "completed, no result" — restore the
+                # store's result_dir config instead.
+                raise RuntimeError(
+                    f"journal references offloaded result "
+                    f"{rec['Key']!r} but no result backend is "
+                    f"configured (set result_dir to the same mount "
+                    f"it was written to)")
+            body = (None if rec.get("Offloaded")
+                    else bytes.fromhex(rec.get("ResultHex", "")))
+            self._results[rec["Key"]] = (
+                body, rec.get("ContentType", "application/json"))
+            return
+        if rec.get("Evict"):
+            # Journal is None during replay, so the subclass's
+            # append is a no-op — this just forgets the task. Blob
+            # deletes re-run too: a crash between the Evict append
+            # and the original deletes leaked them; replay cleans up.
+            for key in self._apply_evict(rec["TaskId"]):
+                self._delete_blob(key)
+            return
+        if rec.get("Slim"):
+            # Transition record: body/orig state is untouched (they
+            # ride only on upserts), exactly like the live mutation;
+            # the journaled timestamp is kept so set scores replay
+            # faithfully.
+            prev = self._tasks.get(rec["TaskId"])
+            if prev is None:
+                return None  # compacted-away predecessor
+            task = prev.with_status(rec["Status"],
+                                    rec.get("BackendStatus"))
+            task.publish = False
+            task.timestamp = float(rec.get("Timestamp")
+                                   or task.timestamp)
+            self._remove_from_set(prev)
+            self._tasks[task.task_id] = task
+            self._add_to_set(task)
+            return task
+        task = APITask.from_dict(rec)
+        task.body = bytes.fromhex(rec.get("BodyHex", ""))
+        # Don't re-publish during replay — LocalPlatform.start()
+        # re-seeds the broker from unfinished_tasks() afterwards.
+        task.publish = False
+        InMemoryTaskStore.upsert(self, task)
+        # Keep the journaled timestamp (upsert stamps "now"):
+        # set scores and the reaper's stuck-task age clock must
+        # survive restarts, not reset to replay time.
+        stored = self._tasks[task.task_id]
+        ts = float(rec.get("Timestamp") or stored.timestamp)
+        stored.timestamp = ts
+        self._sets[(stored.endpoint_path,
+                    stored.canonical_status)][stored.task_id] = ts
+        orig = rec.get("OrigHex")
+        if orig:
+            self._orig_bodies[task.task_id] = (
+                bytes.fromhex(orig),
+                rec.get("OrigContentType", "application/json"))
 
     def _log(self, task: APITask, slim: bool = False) -> None:
         # Called with self._lock held (from _apply_*): journal order is
@@ -657,6 +678,7 @@ class JournaledTaskStore(InMemoryTaskStore):
         old = self._journal
         self._journal = new_journal
         self._records = len(self._tasks) + len(self._results)
+        self.journal_generation += 1
         if old is not None:
             old.close()
 
@@ -726,4 +748,133 @@ class JournaledTaskStore(InMemoryTaskStore):
         with self._lock:
             if not self._closed and self._journal is not None:
                 self._journal.close()
+            self._closed = True
+
+
+class FollowerTaskStore(JournaledTaskStore):
+    """Replication follower — the control plane's availability story.
+
+    The reference's task state lives in managed network Redis that any
+    component reaches and Azure keeps available (``RedisConnection.cs:12-38``,
+    ``deploy_cache_prerequisites.sh:15-31``). This store gives a second
+    control-plane replica the same role: it tails the primary's journal
+    stream (``replication.py`` pulls ``GET /v1/taskstore/journal``), applies
+    each record to its own in-memory state, and appends the raw line to its
+    own journal file — byte-compatible with the primary's, so a follower
+    restart replays it with the ordinary ``JournaledTaskStore`` machinery.
+
+    While ``role == "follower"`` every external mutation raises
+    ``NotPrimaryError`` (the HTTP surface maps it to 503 so store clients
+    fail over to the primary); reads — task polls, results, depths — are
+    served locally, which also offloads read traffic from the primary.
+    ``promote()`` flips it to a live primary: the raw-append handle becomes
+    the journal and writes flow.
+    """
+
+    # Class-level defaults so the write fence is a no-op while
+    # super().__init__ replays the local journal (instance attrs land after).
+    role = "primary"
+    _absorbing = False
+
+    def __init__(self, journal_path: str, **kwargs):
+        super().__init__(journal_path, **kwargs)
+        # Demote: keep the append handle for raw absorbed lines, but gate
+        # self-journaling off (absorbed records are appended verbatim; the
+        # _log path must not double-write them).
+        self._raw = self._journal
+        self._journal = None
+        self._absorbing = False
+        self.role = "follower"
+
+    # -- replication feed ---------------------------------------------------
+
+    def absorb_lines(self, lines: list[str]) -> None:
+        """Apply journal lines streamed from the primary and append them
+        verbatim to the local journal (one flush per call, not per line).
+        Replicated Slim transitions notify this replica's own listeners
+        (gateway long-poll waiters on the standby must wake when a task
+        completes on the primary); full upserts already notify inside
+        ``upsert``."""
+        transitions: list[APITask] = []
+        with self._lock:
+            if self.role != "follower":
+                raise RuntimeError("absorb after promote — replication "
+                                   "must stop when the follower becomes "
+                                   "primary")
+            self._check_open()
+            self._absorbing = True
+            try:
+                for line in lines:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    task = self._apply_replay_record(json.loads(line))
+                    if task is not None:
+                        transitions.append(task)
+                    self._raw.write(line + "\n")
+                    self._records += 1
+            finally:
+                self._absorbing = False
+            self._raw.flush()
+        for task in transitions:
+            self._notify(task)
+
+    def reset(self) -> None:
+        """Discard all replicated state — the primary compacted (journal
+        generation changed), so the follower resyncs from offset 0 of the
+        rewritten file, which is a full state snapshot."""
+        with self._lock:
+            self._check_open()
+            self._tasks.clear()
+            self._orig_bodies.clear()
+            self._results.clear()
+            self._sets.clear()
+            self._records = 0
+            self._raw.close()
+            self._raw = open(self._journal_path, "w",  # noqa: SIM115
+                             encoding="utf-8")
+
+    def promote(self) -> None:
+        """Become the primary: accept writes, journal them normally. The
+        caller must stop the replication feed first (``absorb_lines``
+        refuses afterwards) and re-seed its transport from
+        ``unfinished_tasks()`` — exactly what a restarted platform does."""
+        with self._lock:
+            if self.role == "primary":
+                return
+            self.role = "primary"
+            self._journal = self._raw
+
+    # -- follower write fence ----------------------------------------------
+
+    def _check_writable(self) -> None:
+        if self.role == "follower" and not self._absorbing:
+            raise NotPrimaryError(
+                "store replica is a follower; writes go to the primary")
+
+    def _apply_upsert(self, task: APITask) -> APITask:
+        self._check_writable()
+        return super()._apply_upsert(task)
+
+    def _apply_update(self, task_id: str, status: str,
+                      backend_status: str | None) -> APITask:
+        self._check_writable()
+        return super()._apply_update(task_id, status, backend_status)
+
+    def _apply_set_result(self, key: str, result: bytes | None,
+                          content_type: str) -> None:
+        self._check_writable()
+        super()._apply_set_result(key, result, content_type)
+
+    def _apply_evict(self, task_id: str) -> list[str]:
+        self._check_writable()
+        return super()._apply_evict(task_id)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                if self.role == "follower" and self._raw is not None:
+                    self._raw.close()
+                elif self._journal is not None:
+                    self._journal.close()
             self._closed = True

@@ -69,6 +69,51 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+# Per-chip peak FLOP/s at the models' compute dtype (bfloat16 — every
+# family computes bf16, models/*.py) — the MFU denominator (VERDICT r3 #1).
+# Public chip specs; matched by device_kind prefix, longest first.
+PEAK_BF16_FLOPS = {
+    "TPU v6 lite": 918e12,  # v6e (Trillium)
+    "TPU v5 lite": 197e12,  # v5e — the target platform (BASELINE.md)
+    "TPU v5p": 459e12,
+    "TPU v5": 197e12,
+    "TPU v4": 275e12,
+    "TPU v3": 123e12,
+    "TPU v2": 45e12,
+}
+
+
+def _peak_flops_per_chip() -> float | None:
+    import jax
+    d = jax.devices()[0]
+    if d.platform != "tpu":
+        return None  # CPU fallback: no meaningful MFU denominator
+    kind = getattr(d, "device_kind", "")
+    for prefix in sorted(PEAK_BF16_FLOPS, key=len, reverse=True):
+        if kind.startswith(prefix):
+            return PEAK_BF16_FLOPS[prefix]
+    return None
+
+
+def _model_flops_per_batch(servable, bucket: int) -> float | None:
+    """FLOPs of one compiled batch execution, from XLA's own cost model
+    (``Compiled.cost_analysis()``) — the numerator for MFU. None when the
+    backend doesn't report (some CPU builds)."""
+    import jax
+    try:
+        dummy = jax.ShapeDtypeStruct((bucket, *servable.input_shape),
+                                     np.dtype(servable.input_dtype))
+        compiled = servable._compiled.lower(servable.params, dummy).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception as exc:  # noqa: BLE001 — accounting must not kill the bench
+        log(f"cost_analysis unavailable for {servable.name}: {exc}")
+        return None
+
+
 def _load_or_train_checkpoint(name: str, ckpt_dir: str, like,
                               required: bool) -> tuple[object, dict]:
     """Restore trained weights for ``name`` from ``ckpt_dir`` (producing them
@@ -130,6 +175,23 @@ def _serving_size(kwargs: dict, from_manifest: bool, name: str) -> int:
     return (migration_fallback if from_manifest else production)[name]
 
 
+def _servable_wire(args) -> str:
+    """The h2d wire the servable is BUILT with. ``--wire jpeg`` is a CLIENT
+    wire (camera-trap clients have JPEGs, ``families._image_preprocess``
+    decodes them host-side); the host→device leg then uses the best
+    compressed wire (yuv420 — JPEG's own chroma layout). h2d bytes are
+    reported separately from client wire bytes so the two links never get
+    conflated."""
+    return {"jpeg": "yuv420"}.get(args.wire, args.wire)
+
+
+def _encode_jpeg(arr: np.ndarray, quality: int = 85) -> bytes:
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=quality)
+    return buf.getvalue()
+
+
 def _build_servable(args):
     """The measured servable + its request payload builder."""
     import os
@@ -143,17 +205,24 @@ def _build_servable(args):
         return servable, buf.getvalue(), {}
     if args.model == "landcover":
         servable = _build_landcover(args)
-        # Headline config serves trained weights too when available (the
-        # factory's Voronoi land-class task), random init otherwise — device
-        # throughput is identical either way, so absence never skews r-to-r
-        # comparisons.
-        servable.params, meta = _load_or_train_checkpoint(
-            "landcover", args.checkpoint_dir, servable.params,
-            required=False)
+        # Headline config serves trained weights AT THE PRODUCTION TILE;
+        # a non-default --tile (the self-sizing CPU fallback) serves random
+        # init — the UNet is fully convolutional so weights would restore,
+        # but a fallback artifact must not imply trained-fidelity numbers.
+        if args.tile == TILE:
+            servable.params, meta = _load_or_train_checkpoint(
+                "landcover", args.checkpoint_dir, servable.params,
+                required=False)
+        else:
+            meta = {"checkpoint": "none (non-default tile)"}
         meta["wire"] = args.wire
+        meta["tile"] = args.tile
         rng = np.random.default_rng(0)
-        payload_arr = rng.integers(0, 256, size=(TILE, TILE, 3),
+        payload_arr = rng.integers(0, 256, size=(args.tile, args.tile, 3),
                                    dtype=np.uint8)
+        if args.wire == "jpeg":
+            return (servable, _encode_jpeg(payload_arr),
+                    dict(meta, content_type="image/jpeg"))
     elif args.model == "longcontext":
         from ai4e_tpu.runtime import build_servable
         tokens = args.seq_input == "tokens"
@@ -233,7 +302,7 @@ def _build_servable(args):
         image_size = _serving_size(kwargs, from_manifest, args.model)
         servable = build_servable(
             family, name=args.model, image_size=image_size,
-            buckets=tuple(args.buckets), wire=args.wire, **kwargs)
+            buckets=tuple(args.buckets), wire=_servable_wire(args), **kwargs)
         shape = (image_size, image_size, 3)
         servable.params, meta = _load_or_train_checkpoint(
             args.model, args.checkpoint_dir, servable.params, required=True)
@@ -243,6 +312,9 @@ def _build_servable(args):
         # uint8 wire format (families' fused_normalize ingestion): 4x less
         # payload than float32, normalized on-device.
         payload_arr = rng.integers(0, 256, size=shape, dtype=np.uint8)
+        if args.wire == "jpeg":
+            return (servable, _encode_jpeg(payload_arr),
+                    dict(meta, content_type="image/jpeg"))
     buf = io.BytesIO()
     np.save(buf, payload_arr)
     return servable, buf.getvalue(), meta
@@ -261,15 +333,15 @@ def _build_pipeline_servables(args):
     det_size = _serving_size(det_kwargs, det_mf, "megadetector")
     det = build_servable(
         "detector", name="megadetector", image_size=det_size,
-        score_threshold=0.15, buckets=tuple(args.buckets), wire=args.wire,
-        **det_kwargs)
+        score_threshold=0.15, buckets=tuple(args.buckets),
+        wire=_servable_wire(args), **det_kwargs)
     det.params, m1 = _load_or_train_checkpoint(
         "megadetector", args.checkpoint_dir, det.params, required=True)
     sp_kwargs, sp_mf = _manifest_kwargs(args.checkpoint_dir, "species")
     sp_size = _serving_size(sp_kwargs, sp_mf, "species")
     sp = build_servable(
         "resnet", name="species", image_size=sp_size,
-        buckets=tuple(args.buckets), wire=args.wire, **sp_kwargs)
+        buckets=tuple(args.buckets), wire=_servable_wire(args), **sp_kwargs)
     sp.params, m2 = _load_or_train_checkpoint(
         "species", args.checkpoint_dir, sp.params, required=True)
 
@@ -339,6 +411,7 @@ def build_platform(args):
                            maximum_concurrent_requests=args.concurrency * 4)
     else:
         servable, payload, ckpt_meta = _build_servable(args)
+        content_type = ckpt_meta.pop("content_type", content_type)
         runtime.register(servable)
         worker.serve_model(servable, sync_path="/classify",
                            async_path="/classify-async",
@@ -363,9 +436,9 @@ def _build_landcover(args):
     from ai4e_tpu.runtime import build_servable
 
     kwargs, _from_manifest = _manifest_kwargs(args.checkpoint_dir, "landcover")
-    return build_servable("unet", name="landcover", tile=TILE,
-                          buckets=tuple(args.buckets), wire=args.wire,
-                          **kwargs)
+    return build_servable("unet", name="landcover", tile=args.tile,
+                          buckets=tuple(args.buckets),
+                          wire=_servable_wire(args), **kwargs)
 
 
 async def run_bench(args) -> dict:
@@ -532,6 +605,37 @@ async def run_bench(args) -> dict:
             for name, servable in batcher.runtime.models.items()}
     except Exception as exc:  # noqa: BLE001 — report, don't kill the bench
         capability_meta["device_capability_error"] = str(exc)
+
+    # MFU accounting (VERDICT r3 #1): XLA-reported FLOPs per request and the
+    # fraction of chip peak the measured end-to-end throughput represents.
+    # device_capability carries the chip-side MFU (what the compiled program
+    # achieves); mfu_delivered is the platform-level figure (wire + control
+    # plane included) — the gap between them is the link/dispatch tax.
+    peak = _peak_flops_per_chip()
+    if peak is not None:
+        capability_meta["device_peak_bf16_flops"] = peak
+    flops_per_req_total = 0.0
+    for name, servable in batcher.runtime.models.items():
+        flops = _model_flops_per_batch(servable, servable.max_bucket)
+        if flops is None:
+            continue
+        per_req = flops / servable.max_bucket
+        flops_per_req_total += per_req
+        cap = capability_meta.get("device_capability", {}).get(name)
+        if cap is not None:
+            cap["flops_per_req"] = round(per_req)
+            cap["device_flops_per_s"] = round(per_req * cap["req_s"])
+            if peak:
+                cap["mfu"] = round(per_req * cap["req_s"] / peak, 4)
+    if flops_per_req_total:
+        # Pipeline runs feed two models; each task crosses both, so the
+        # per-request figure is the sum over served models.
+        capability_meta["model_flops_per_req"] = round(flops_per_req_total)
+        capability_meta["delivered_flops_per_s"] = round(
+            flops_per_req_total * throughput)
+        if peak:
+            capability_meta["mfu_delivered"] = round(
+                flops_per_req_total * throughput / peak, 4)
 
     # On real hardware the bench doubles as the Pallas kernel-validation
     # artifact: Mosaic-compiled (interpret=False) kernels vs XLA oracles +
@@ -707,6 +811,7 @@ def _forward_argv(args) -> list[str]:
             "--transport", args.transport,
             "--fabric", args.fabric,
             "--checkpoint-dir", args.checkpoint_dir,
+            "--tile", str(args.tile),
             "--seq-len", str(args.seq_len),
             "--seq-input", args.seq_input,
             "--wire", args.wire,
@@ -764,6 +869,10 @@ def main() -> None:
                              "control-plane saturation comparison")
     parser.add_argument("--checkpoint-dir", default="checkpoints",
                         help="trained weights (ai4e_tpu.train.make_checkpoints)")
+    parser.add_argument("--tile", type=int, default=TILE,
+                        help="landcover tile size (default 256 — the "
+                             "production/baseline tile; the CPU fallback "
+                             "self-sizes to 128)")
     parser.add_argument("--seq-len", type=int, default=4096,
                         help="sequence length for --model longcontext")
     parser.add_argument("--seq-input", choices=("tokens", "features"),
@@ -772,16 +881,19 @@ def main() -> None:
                              "on-device (production wire, 2 B/token) or "
                              "pre-embedded f16 feature sequences (128 "
                              "B/token at D=64)")
-    parser.add_argument("--wire", choices=("rgb8", "yuv420"), default="yuv420",
-                        help="h2d encoding for the image configs (landcover/"
-                             "megadetector/species): raw uint8 or YUV 4:2:0 "
-                             "planes (halves host->device bytes; ops/yuv.py). "
-                             "yuv420 is the default/production wire: it "
-                             "carries the same chroma content a JPEG source "
-                             "had, fidelity is test-gated against the trained "
-                             "checkpoints, and the r3 matrix measured it at "
-                             "1.39-1.68x the rgb8 throughput on the "
-                             "link-bound configs")
+    parser.add_argument("--wire",
+                        choices=("rgb8", "yuv420", "dct", "jpeg"),
+                        default="yuv420",
+                        help="wire for the image configs (landcover/"
+                             "megadetector/species/pipeline): rgb8 = raw "
+                             "uint8 (3 B/px); yuv420 = planar 4:2:0 h2d "
+                             "(1.5 B/px, ops/yuv.py — the r3 production "
+                             "wire); dct = quantized-DCT h2d (0.375 B/px, "
+                             "ops/dct.py — device decodes with MXU matmuls; "
+                             "fidelity-gated in tests/test_dct_wire.py); "
+                             "jpeg = CLIENT wire of real camera JPEGs "
+                             "(~0.3-1 B/px on the HTTP leg), host-decoded, "
+                             "h2d rides yuv420")
     parser.add_argument("--cpu", action="store_true",
                         help="force CPU (debug runs)")
     parser.add_argument("--probe-timeout", type=float, default=60.0,
@@ -861,10 +973,20 @@ def main() -> None:
         log(f"accelerator dead after {attempts} probes; CPU fallback")
 
     if result is None:
-        # Honest CPU fallback, sized so the run finishes promptly: XLA:CPU
-        # sustains ~0.5 req/s on this UNet, so big buckets and 128 in-flight
-        # clients only stretch the tail (r1: 233s drain).
+        # Honest, SELF-SIZING CPU fallback (VERDICT r3 weak #1: the r3
+        # fallback artifact ran the full 256px UNet on one core — 2
+        # completions in 20 s, noise). The fallback must still be a valid
+        # platform measurement: shrink the landcover tile to 128 (4x fewer
+        # pixels, ~2 req/s on XLA:CPU) and hold the measured window open
+        # >= 60 s so the artifact records hundreds of completions. The JSON
+        # carries fallback+tile so the number is never confused with the
+        # 256px anchor config.
         meta["fallback"] = "cpu"
+        if args.model == "landcover" and args.tile == TILE:
+            args.tile = 128
+        args.duration = max(args.duration, 60.0)
+        meta["fallback_config"] = {"tile": args.tile,
+                                   "duration_s": args.duration}
         # Point the reader at ALL archived real-accelerator evidence, from
         # any round's tunnel window (the tunnel can be dead at round end
         # yet alive mid-round — r2's artifact of record showed a CPU

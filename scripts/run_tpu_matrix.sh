@@ -1,14 +1,15 @@
 #!/bin/bash
 # Run the full BASELINE config matrix on the TPU, archiving one JSON per
-# config (VERDICT r2 #2). Priority order: headline first, then the configs
-# r2 never measured on TPU. Each bench.py invocation probes the tunnel and
+# config (VERDICT r2 #2). Priority order: headline + the r4 wire/transport
+# experiments first (VERDICT r3 #4/#5: concurrent push, dct/jpeg wires),
+# then the standing configs. Each bench.py invocation probes the tunnel and
 # time-boxes its stages itself; if a run lands on CPU fallback we stop —
 # the tunnel died and the remaining runs would just archive fallbacks.
 #
-# Usage: scripts/run_tpu_matrix.sh [outdir]   (default bench_results/r3-tpu)
+# Usage: scripts/run_tpu_matrix.sh [outdir]   (default bench_results/r4-tpu)
 set -u
 cd "$(dirname "$0")/.."
-OUT="${1:-bench_results/r3-tpu}"
+OUT="${1:-bench_results/r4-tpu}"
 mkdir -p "$OUT"
 
 run_one() {
@@ -54,18 +55,26 @@ print('PROBE_OK')" 2>/dev/null | grep -q PROBE_OK; then
     esac
 }
 
-# Wires are explicit on every config: bench.py's default flipped to yuv420
-# (the production wire) in r3, and these archive names encode the wire.
-run_one landcover       --model landcover --wire rgb8              || exit 1
+# r4 priority block: the VERDICT r3 perf experiments. Wires are explicit on
+# every config; archive names encode the wire.
 run_one landcover_yuv   --model landcover --wire yuv420            || exit 1
-run_one pipeline        --model pipeline --wire rgb8               || exit 1
-run_one longcontext     --model longcontext --seq-input features   || exit 1
+run_one landcover_dct   --model landcover --wire dct               || exit 1
+run_one species_dct     --model species --wire dct                 || exit 1
+run_one landcover_push_yuv --model landcover --transport push --wire yuv420 || exit 1
+run_one megadet_dct     --model megadetector --buckets 1 8 16 --wire dct || exit 1
+run_one landcover_jpeg  --model landcover --wire jpeg              || exit 1
+run_one species_jpeg    --model species --wire jpeg                || exit 1
+run_one species_yuv     --model species --wire yuv420              || exit 1
+run_one landcover_push_dct --model landcover --transport push --wire dct || exit 1
+# Standing configs (r3 parity set).
 run_one longcontext_tok --model longcontext --seq-input tokens     || exit 1
-run_one landcover_sync  --model landcover --mode sync --wire rgb8  || exit 1
+run_one pipeline_yuv    --model pipeline --wire yuv420             || exit 1
+run_one megadet_yuv     --model megadetector --buckets 1 8 16 --wire yuv420 || exit 1
+run_one landcover_sync  --model landcover --mode sync --wire yuv420 || exit 1
+run_one landcover       --model landcover --wire rgb8              || exit 1
+run_one species         --model species --wire rgb8                || exit 1
+run_one longcontext     --model longcontext --seq-input features   || exit 1
+run_one pipeline        --model pipeline --wire rgb8               || exit 1
 run_one landcover_push  --model landcover --transport push --wire rgb8 || exit 1
 run_one megadetector16  --model megadetector --buckets 1 8 16 --wire rgb8 || exit 1
-run_one species         --model species --wire rgb8                || exit 1
-run_one megadet_yuv     --model megadetector --buckets 1 8 16 --wire yuv420 || exit 1
-run_one species_yuv     --model species --wire yuv420              || exit 1
-run_one pipeline_yuv    --model pipeline --wire yuv420             || exit 1
 echo "== matrix complete: $(ls "$OUT"/*.json | wc -l) JSONs in $OUT ==" >&2

@@ -2,10 +2,24 @@
 # Probe the TPU tunnel every ~6 min; when it answers, capture a fresh
 # default-args bench rehearsal (the BENCH_r{N} config) and re-run the
 # matrix (resumable — completed cells are skipped). Log to the probe log.
+#
+# Single-instance: the whole loop runs under an flock on $OUT/.watcher.lock
+# so a re-armed watcher cannot race a still-running one. The rehearsal
+# capture goes to a temp file and only replaces default_rehearsal_latest.json
+# when it is non-empty valid JSON (a probe that passes but a bench that
+# fails must not clobber the last good capture).
 set -u
 cd "$(dirname "$0")/.."
-OUT="${1:-bench_results/r3-tpu}"
+OUT="${1:-bench_results/r4-tpu}"
+mkdir -p "$OUT"
 LOG="$OUT/probe_log.txt"
+
+exec 9>"$OUT/.watcher.lock"
+if ! flock -n 9; then
+    echo "[watcher] another instance holds $OUT/.watcher.lock — exiting" >&2
+    exit 1
+fi
+
 N=0
 while true; do
     N=$((N + 1))
@@ -15,7 +29,16 @@ x = jnp.ones((64,64)); (x @ x).block_until_ready()
 assert jax.devices()[0].platform != 'cpu'
 print('PROBE_OK')" 2>/dev/null | grep -q PROBE_OK; then
         echo "[watcher] probe $N at $(date +%H:%M:%S): TUNNEL UP — capturing" >> "$LOG"
-        python bench.py 2>"$OUT/rehearsal.err" | tail -1 > "$OUT/default_rehearsal_latest.json"
+        TMP="$OUT/.default_rehearsal.tmp"
+        python bench.py 2>"$OUT/rehearsal.err" | tail -1 > "$TMP"
+        if python -c "import json,sys; json.load(open(sys.argv[1]))" "$TMP" 2>/dev/null; then
+            mv "$TMP" "$OUT/default_rehearsal_latest.json"
+            cp "$OUT/default_rehearsal_latest.json" \
+               "$OUT/default_rehearsal_$(date +%m%d_%H%M).json"
+        else
+            echo "[watcher] rehearsal at $(date +%H:%M:%S) produced invalid JSON — kept last good" >> "$LOG"
+            rm -f "$TMP"
+        fi
         bash scripts/run_tpu_matrix.sh "$OUT" >> "$OUT/watcher_matrix.log" 2>&1
         echo "[watcher] capture pass done at $(date +%H:%M:%S)" >> "$LOG"
         sleep 1200   # don't hammer; re-verify in 20 min

@@ -1,0 +1,204 @@
+"""DCT-truncation host↔device wire (``ops/dct.py``): JPEG-grade h2d
+compression (0.375 B/px — 4× less than yuv420) whose device decode is dense
+linear algebra. Fidelity bar, same discipline as the yuv wire: the trained
+checkpoints must predict identically (species) / equivalently (detector)
+through the compressed wire, or the wire doesn't ship for that family."""
+
+import io
+
+import numpy as np
+
+from ai4e_tpu.ops.dct import (
+    dct_nbytes,
+    dct_to_rgb,
+    dct_to_rgb_numpy,
+    rgb_to_dct,
+)
+from tests.test_yuv_wire import _load_manifest, _smooth_image
+
+
+class TestCodec:
+    def test_sizes_eight_x_vs_rgb(self):
+        flat = rgb_to_dct(_smooth_image())
+        assert flat.shape == (dct_nbytes(64, 64),)
+        assert flat.dtype == np.int8
+        assert flat.nbytes * 8 == 64 * 64 * 3  # 0.375 B/px at K=4
+
+    def test_roundtrip_psnr_on_smooth_content(self):
+        img = _smooth_image()
+        back = dct_to_rgb_numpy(rgb_to_dct(img), 64, 64).astype(np.float32)
+        mse = float(np.mean((back - img.astype(np.float32)) ** 2))
+        psnr = 10 * np.log10(255.0 ** 2 / max(mse, 1e-9))
+        assert psnr > 30.0, f"PSNR {psnr:.1f} dB too low for smooth content"
+
+    def test_flat_blocks_are_near_lossless(self):
+        """Per-16×16-flat content (flat across BOTH the luma block grid and
+        the subsampled chroma's): only DC coefficients are nonzero, so
+        truncation costs nothing and the error is quantization-only."""
+        rng = np.random.default_rng(1)
+        blocks = rng.integers(30, 226, size=(4, 4, 3), dtype=np.uint8)
+        img = np.repeat(np.repeat(blocks, 16, axis=0), 16, axis=1)
+        back = dct_to_rgb_numpy(rgb_to_dct(img), 64, 64).astype(np.float32)
+        assert float(np.abs(back - img.astype(np.float32)).max()) <= 14.0
+
+    def test_output_range_and_dtype_device(self):
+        img = _smooth_image(seed=3)
+        out = np.asarray(dct_to_rgb(rgb_to_dct(img)[None], 64, 64))
+        assert out.dtype == np.float32
+        assert out.min() >= 0.0 and out.max() <= 1.0
+
+    def test_host_inverse_matches_device_inverse(self):
+        img = _smooth_image(seed=9)
+        flat = rgb_to_dct(img)
+        host = dct_to_rgb_numpy(flat, 64, 64).astype(np.float32)
+        device = np.asarray(dct_to_rgb(flat[None], 64, 64))[0] * 255.0
+        assert np.abs(host - device).max() <= 1.0  # rounding only
+
+    def test_bad_dims_rejected(self):
+        import pytest
+        with pytest.raises(ValueError, match="divisible by 16"):
+            rgb_to_dct(np.zeros((56, 64, 3), np.uint8))
+        with pytest.raises(ValueError, match="uint8"):
+            rgb_to_dct(np.zeros((64, 64, 3), np.float32))
+
+
+class TestUnetDctWire:
+    def test_servable_end_to_end_matches_rgb_path(self):
+        """Same weights, both wires: class histograms agree to within the
+        codec's boundary-pixel noise (land-cover content is large flat
+        regions — exactly where DCT truncation is nearly free)."""
+        from ai4e_tpu.runtime import ModelRuntime, build_servable
+
+        tile = 64
+        rgb = build_servable("unet", name="lc-rgb", tile=tile,
+                             widths=[8, 16], num_classes=4, buckets=(8,))
+        dct = build_servable("unet", name="lc-dct", tile=tile,
+                             widths=[8, 16], num_classes=4, buckets=(8,),
+                             wire="dct")
+        dct.params = rgb.params
+        runtime = ModelRuntime()
+        runtime.register(rgb)
+        runtime.register(dct)
+
+        rng = np.random.default_rng(7)
+        blocks = rng.integers(0, 255, size=(8, 8, 3), dtype=np.uint8)
+        img = np.repeat(np.repeat(blocks, 8, axis=0), 8, axis=1)
+        batch_rgb = np.repeat(img[None], 8, axis=0)
+        batch_dct = np.stack([rgb_to_dct(img)] * 8)
+
+        out_rgb = runtime.run_batch("lc-rgb", batch_rgb)
+        out_dct = runtime.run_batch("lc-dct", batch_dct)
+        c_rgb = np.asarray(out_rgb["counts"][0], np.int64)
+        c_dct = np.asarray(out_dct["counts"][0], np.int64)
+        total = tile * tile
+        disagreement = int(np.abs(c_rgb - c_dct).sum()) // 2
+        assert disagreement <= total * 0.05, (
+            f"{disagreement}/{total} pixels changed class", c_rgb, c_dct)
+
+    def test_preprocess_converts_npy_rgb_payload(self):
+        from ai4e_tpu.runtime import build_servable
+
+        servable = build_servable("unet", name="lc", tile=64,
+                                  widths=[8], num_classes=4, buckets=(1,),
+                                  wire="dct")
+        buf = io.BytesIO()
+        np.save(buf, _smooth_image())
+        flat = servable.preprocess(buf.getvalue(), "application/octet-stream")
+        assert flat.shape == servable.input_shape
+        assert flat.dtype == np.int8
+
+    def test_indivisible_size_rejected_at_build_time(self):
+        import pytest
+
+        from ai4e_tpu.runtime import build_servable
+        with pytest.raises(ValueError, match="divisible"):
+            build_servable("detector", image_size=56, wire="dct",
+                           widths=[8], buckets=(1,))
+
+    def test_dct_requires_fused_ingestion_everywhere(self):
+        import pytest
+
+        from ai4e_tpu.runtime import build_servable
+        for family, flag in (("unet", "fused_postprocess"),
+                             ("resnet", "fused_normalize"),
+                             ("detector", "fused_normalize")):
+            with pytest.raises(ValueError, match=flag):
+                build_servable(family, wire="dct", **{flag: False})
+
+
+class TestTrainedModelFidelity:
+    def test_species_checkpoint_classifies_identically_over_dct(self):
+        """The TRAINED species classifier must assign the same (correct)
+        labels through the dct wire as through rgb8 — the serving gate for
+        shipping the compressed wire on this family."""
+        import os
+
+        from ai4e_tpu.checkpoint import load_params
+        from ai4e_tpu.runtime import ModelRuntime, build_servable
+        from ai4e_tpu.train.make_checkpoints import species_batch
+
+        repo, manifest = _load_manifest()
+        ckpt = os.path.join(repo, "checkpoints", "species")
+        kwargs = {k: v for k, v in manifest["species"]["kwargs"].items()
+                  if k != "labels"}
+        size = kwargs.pop("image_size", 64)
+        kwargs.update(image_size=size, buckets=(8,))
+        rgb = build_servable("resnet", name="sp-rgb", **kwargs)
+        dct = build_servable("resnet", name="sp-dct", wire="dct", **kwargs)
+        rgb.params = load_params(ckpt, like=rgb.params)
+        dct.params = rgb.params
+        runtime = ModelRuntime()
+        runtime.register(rgb)
+        runtime.register(dct)
+
+        img, labels = species_batch(np.random.default_rng(42), 8, size)
+        batch_u8 = np.clip(np.round(img * 255), 0, 255).astype(np.uint8)
+        flat = np.stack([rgb_to_dct(x) for x in batch_u8])
+
+        out_rgb = np.argmax(np.asarray(runtime.run_batch("sp-rgb", batch_u8)),
+                            axis=-1)
+        out_dct = np.argmax(np.asarray(runtime.run_batch("sp-dct", flat)),
+                            axis=-1)
+        np.testing.assert_array_equal(out_rgb, labels)  # checkpoint is real
+        np.testing.assert_array_equal(out_dct, labels)  # dct wire costs nothing
+
+    def test_trained_detector_finds_same_animals_over_dct(self):
+        """TRAINED megadetector through the dct wire: same synthetic scenes,
+        equivalent above-threshold detections (the shipped-checkpoint
+        criterion, as in the yuv gate)."""
+        import os
+
+        from ai4e_tpu.checkpoint import load_params
+        from ai4e_tpu.runtime import ModelRuntime, build_servable
+        from ai4e_tpu.train.make_checkpoints import (detection_accuracy,
+                                                     detector_batch)
+
+        repo, manifest = _load_manifest()
+        ckpt = os.path.join(repo, "checkpoints", "megadetector")
+        mk = dict(manifest["megadetector"]["kwargs"])
+        size = mk.pop("image_size", 128)
+        kwargs = dict(image_size=size, buckets=(8,),
+                      score_threshold=0.2, **mk)
+        rgb = build_servable("detector", name="det-rgb", **kwargs)
+        dct = build_servable("detector", name="det-dct", wire="dct",
+                             **kwargs)
+        rgb.params = load_params(ckpt, like=rgb.params)
+        dct.params = rgb.params
+        runtime = ModelRuntime()
+        runtime.register(rgb)
+        runtime.register(dct)
+
+        img, targets = detector_batch(np.random.default_rng(5), 8, size)
+        batch_u8 = np.clip(np.round(img * 255), 0, 255).astype(np.uint8)
+        flat = np.stack([rgb_to_dct(x) for x in batch_u8])
+        out_rgb = runtime.run_batch("det-rgb", batch_u8)
+        out_dct = runtime.run_batch("det-dct", flat)
+
+        rgb_hits, total = detection_accuracy(out_rgb, targets,
+                                             wh_rel_tolerance=0.5)
+        dct_hits, _ = detection_accuracy(out_dct, targets,
+                                         wh_rel_tolerance=0.5)
+        assert total > 0, "scene generator produced no objects"
+        assert rgb_hits >= 0.8 * total, (rgb_hits, total)  # checkpoint real
+        # The dct wire may flip at most one borderline object vs rgb.
+        assert dct_hits >= rgb_hits - 1, (dct_hits, rgb_hits, total)
