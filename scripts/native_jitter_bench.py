@@ -1,0 +1,120 @@
+"""Native-fabric decision measurement (VERDICT r3 #8): does the C++ task
+store win ANY axis on this rig?
+
+r3 measured native ~13% SLOWER on raw 1-core throughput (ctypes marshalling
+tax, no second core to exploit GIL-free mutation —
+``bench_results/r3-cpu/fabric_saturation.json``). The remaining candidate
+axis is LATENCY JITTER under GIL contention: a serving control plane shares
+its process with pure-Python work (JSON encoding, payload staging, metrics),
+and a Python-store operation holds the GIL for its whole critical section —
+every 5 ms switch interval a spinning thread can preempt it mid-operation.
+The C++ store's mutation runs inside a ``ctypes.CDLL`` call, which RELEASES
+the GIL: the operation proceeds regardless of Python-thread contention.
+
+Measures upsert→running→completed→get cycles from one thread under
+{idle, N GIL-spinner threads} for both stores; reports per-op p50/p95/p99/
+max and prints ONE JSON line (archive: bench_results/r4-cpu/
+native_jitter.json). The decision rule in the artifact: native "wins" iff
+its contended p99 beats Python's by >= 1.5x — otherwise the README freezes
+the native cores.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+from ai4e_tpu.taskstore import APITask, InMemoryTaskStore  # noqa: E402
+
+
+def measure(store, n_ops: int = 3000) -> list[float]:
+    lat = []
+    for i in range(n_ops):
+        t0 = time.perf_counter()
+        task = store.upsert(APITask(endpoint="http://e/v1/m/run",
+                                    body=b"x" * 64))
+        store.update_status(task.task_id, "running", "running")
+        store.update_status(task.task_id, "completed", "completed")
+        store.get(task.task_id)
+        lat.append(time.perf_counter() - t0)
+    return lat
+
+
+def stats(lat: list[float]) -> dict:
+    s = sorted(lat)
+
+    def pct(q):
+        return round(s[min(len(s) - 1, int(len(s) * q))] * 1e6, 1)
+    return {"p50_us": pct(0.50), "p95_us": pct(0.95), "p99_us": pct(0.99),
+            "max_us": round(s[-1] * 1e6, 1), "ops": len(s)}
+
+
+def run_condition(store_factory, spinners: int) -> dict:
+    stop = threading.Event()
+
+    def spin():
+        # Pure-Python GIL-holding load — the serving host's own work
+        # (JSON escaping, dict churn) between the control plane's ops.
+        x = 0
+        while not stop.is_set():
+            for i in range(10_000):
+                x += i * i
+    threads = [threading.Thread(target=spin, daemon=True)
+               for _ in range(spinners)]
+    for t in threads:
+        t.start()
+    try:
+        store = store_factory()
+        measure(store, n_ops=300)  # warm caches/allocator outside the window
+        return stats(measure(store))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+
+def main() -> None:
+    results: dict = {"metric": "control_plane_op_jitter",
+                     "unit": "us/op-cycle",
+                     "op_cycle": "upsert+2x update_status+get",
+                     "switch_interval_s": sys.getswitchinterval()}
+    native_ok = True
+    try:
+        from ai4e_tpu.taskstore.native import NativeTaskStore
+        NativeTaskStore()
+    except Exception as exc:  # noqa: BLE001
+        native_ok = False
+        results["native_unavailable"] = str(exc)
+
+    conditions = [("idle", 0), ("gil_contended", 4)]
+    for label, spinners in conditions:
+        results[f"python_{label}"] = run_condition(InMemoryTaskStore,
+                                                   spinners)
+        print(f"python {label}: {results[f'python_{label}']}",
+              file=sys.stderr)
+        if native_ok:
+            from ai4e_tpu.taskstore.native import NativeTaskStore
+            results[f"native_{label}"] = run_condition(NativeTaskStore,
+                                                       spinners)
+            print(f"native {label}: {results[f'native_{label}']}",
+                  file=sys.stderr)
+
+    if native_ok:
+        py99 = results["python_gil_contended"]["p99_us"]
+        nat99 = results["native_gil_contended"]["p99_us"]
+        results["contended_p99_ratio_python_over_native"] = round(
+            py99 / max(nat99, 1e-9), 2)
+        results["native_win"] = py99 >= 1.5 * nat99
+        results["decision_rule"] = (
+            "native wins iff contended p99 >= 1.5x better than Python; "
+            "otherwise the native cores are FROZEN (kept + parity-tested, "
+            "not grown)")
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,7 @@ run_one landcover_jpeg  --model landcover --wire jpeg              || exit 1
 run_one species_jpeg    --model species --wire jpeg                || exit 1
 run_one species_yuv     --model species --wire yuv420              || exit 1
 run_one landcover_push_dct --model landcover --transport push --wire dct || exit 1
+run_one mixed           --model mixed --wire yuv420 --duration 30       || exit 1
 # Standing configs (r3 parity set).
 run_one longcontext_tok --model longcontext --seq-input tokens     || exit 1
 run_one pipeline_yuv    --model pipeline --wire yuv420             || exit 1
