@@ -59,6 +59,7 @@ print('PROBE_OK')" 2>/dev/null | grep -q PROBE_OK; then
 # every config; archive names encode the wire.
 run_one landcover_yuv   --model landcover --wire yuv420            || exit 1
 run_one landcover_dct   --model landcover --wire dct               || exit 1
+run_one landcover_dct128 --model landcover --wire dct --buckets 1 16 128 || exit 1
 run_one species_dct     --model species --wire dct                 || exit 1
 run_one landcover_push_yuv --model landcover --transport push --wire yuv420 || exit 1
 run_one megadet_dct     --model megadetector --buckets 1 8 16 --wire dct || exit 1
