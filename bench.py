@@ -386,7 +386,7 @@ def build_platform(args):
         native_broker=(args.fabric == "native"
                        and args.transport == "queue"),
         retry_delay=0.05, dispatcher_concurrency=args.dispatcher_concurrency))
-    runtime = ModelRuntime()
+    runtime = ModelRuntime(donate_batch=args.donate_batch)
     batcher = MicroBatcher(runtime, max_wait_ms=args.max_wait_ms,
                            max_pending=args.concurrency * 4,
                            pipeline_depth=args.pipeline_depth)
@@ -507,7 +507,7 @@ def _build_mixed(args):
                        and args.transport == "queue"),
         retry_delay=0.05,
         dispatcher_concurrency=args.dispatcher_concurrency))
-    runtime = ModelRuntime()
+    runtime = ModelRuntime(donate_batch=args.donate_batch)
     batcher = MicroBatcher(runtime, max_wait_ms=args.max_wait_ms,
                            max_pending=args.concurrency * 4,
                            pipeline_depth=args.pipeline_depth)
@@ -922,8 +922,9 @@ async def run_bench(args) -> dict:
     # the cap. Runs after the window, device idle.
     capability_meta = {}
     try:
+        donated = bool(getattr(batcher.runtime, "_donate", False))
         capability_meta["device_capability"] = {
-            name: _measure_device_capability(servable)
+            name: _measure_device_capability(servable, donated=donated)
             for name, servable in batcher.runtime.models.items()}
     except Exception as exc:  # noqa: BLE001 — report, don't kill the bench
         capability_meta["device_capability_error"] = str(exc)
@@ -981,6 +982,7 @@ async def run_bench(args) -> dict:
         "mode": args.mode,
         "transport": args.transport,
         "fabric": args.fabric,
+        **({"donate_batch": True} if args.donate_batch else {}),
         "vs_baseline": round(throughput / cfg["anchor"], 2),
         "baseline_anchor": cfg["anchor"],
         **{k: window[k] for k in ("p50_latency_ms", "p95_latency_ms",
@@ -996,23 +998,31 @@ async def run_bench(args) -> dict:
 
 
 def _measure_device_capability(servable, iters: int = 12,
-                               min_seconds: float = 0.5) -> dict:
+                               min_seconds: float = 0.5,
+                               donated: bool = False) -> dict:
     """Requests/second the chip sustains with the input already resident on
     device and outputs left there — the link-independent ceiling. Iterations
     are launched without per-call blocking (one sync at the end) so dispatch
-    RTT on a remote-attached device pipelines away."""
+    RTT on a remote-attached device pipelines away. Reuses the warmed
+    serving program; only a donating runtime (--donate-batch) forces a
+    fresh non-donating jit (reusing a donated buffer across iterations
+    would crash) — that one extra compile is the A/B's accepted cost."""
     import jax
 
     servable_bucket = servable.max_bucket
+    fn = (jax.jit(servable.apply_fn,
+                  in_shardings=(None, servable._batch_sharding))
+          if donated else
+          (lambda params, batch: servable._compiled(params, batch)))
     x = jax.device_put(
         np.zeros((servable_bucket, *servable.input_shape),
                  servable.input_dtype),
         servable._batch_sharding)
-    jax.block_until_ready(servable._compiled(servable.params, x))  # warm
+    jax.block_until_ready(fn(servable.params, x))  # warm
     t0 = time.perf_counter()
     done = 0
     while True:
-        outs = [servable._compiled(servable.params, x) for _ in range(iters)]
+        outs = [fn(servable.params, x) for _ in range(iters)]
         jax.block_until_ready(outs)
         done += iters
         elapsed = time.perf_counter() - t0
@@ -1138,6 +1148,7 @@ def _forward_argv(args) -> list[str]:
             "--dispatcher-concurrency", str(args.dispatcher_concurrency),
             "--model", args.model,
             "--mode", args.mode,
+            *(["--donate-batch"] if args.donate_batch else []),
             "--transport", args.transport,
             "--fabric", args.fabric,
             "--checkpoint-dir", args.checkpoint_dir,
@@ -1211,6 +1222,14 @@ def main() -> None:
     parser.add_argument("--stack-streams", type=int, default=2,
                         help="--model mixed: concurrent background stack "
                              "tasks")
+    parser.add_argument("--donate-batch", action="store_true",
+                        help="compile serving programs with input-batch "
+                             "donation. NOTE: none of the bench families "
+                             "can alias input to output (outputs are small "
+                             "histograms/logits, shapes never match), so "
+                             "this is an EARLY-FREE lever only — at most "
+                             "it trims peak HBM while outputs materialize; "
+                             "cheap to A/B in a window, expected ~neutral")
     parser.add_argument("--seq-len", type=int, default=4096,
                         help="sequence length for --model longcontext")
     parser.add_argument("--seq-input", choices=("tokens", "features"),
