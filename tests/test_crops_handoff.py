@@ -61,14 +61,24 @@ class TestCropsHandoff:
 
 
 class TestCropPipelineE2E:
-    def test_detector_crops_feed_classifier_batch_stage(self):
-        """Spec-driven detector→classifier-with-crops composite through the
-        cli builder: stage 1 detects (threshold 0 on random init → always
-        fires), hands a crop stack to stage 2's batch endpoint, which
-        completes the task with per-crop classifications."""
+    """Spec-driven detector→classifier-with-crops composite through the cli
+    builder, parametrized over the wire: stage 1 detects (threshold 0 on
+    random init → always fires), hands a crop stack to stage 2's batch
+    endpoint, which completes the task with per-crop classifications. On
+    the compressed wires the handoff receives the decoded RGB image back
+    (example_decoder) and the classifier's batch stage converts the crop
+    stack at ingestion (stack_adapter) — composite pipelines are
+    wire-agnostic end to end."""
+
+    import pytest as _pytest
+
+    @_pytest.mark.parametrize("wire", [None, "yuv420", "dct"])
+    def test_detector_crops_feed_classifier_batch_stage(self, wire):
         from ai4e_tpu.cli import build_worker
         from ai4e_tpu.config import FrameworkConfig
         from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+
+        wire_kw = {"wire": wire} if wire else {}
 
         async def main():
             platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
@@ -82,12 +92,12 @@ class TestCropPipelineE2E:
                      "pipeline_to": {
                          "endpoint": "/v1/crops/cls-batch-async",
                          "payload": "crops", "crop_size": 16,
-                         "max_crops": 3}},
+                         "max_crops": 3}, **wire_kw},
                     {"family": "resnet", "name": "cls", "image_size": 16,
                      "stage_sizes": [1], "width": 8, "num_classes": 4,
                      "buckets": [4],
                      "batch": {"async_path": "/cls-batch-async",
-                               "max_items": 8}},
+                               "max_items": 8}, **wire_kw},
                 ]})
             worker.service.task_manager = platform.task_manager
             worker.store = platform.store
@@ -118,77 +128,6 @@ class TestCropPipelineE2E:
                 # the classifier's per-crop batch output.
                 staged = platform.store.get_result(tid, stage="det")
                 assert staged is not None
-                dets = json.loads(staged[0])["detections"]
-                assert len(dets) >= 1
-                body, _ctype = platform.store.get_result(tid)
-                doc = json.loads(body)
-                assert doc["count"] == min(len(dets), 3)
-                for item in doc["items"]:
-                    assert "class_id" in item["result"]
-            finally:
-                await platform.stop()
-                await batcher.stop()
-                await gw.close()
-                await svc.close()
-
-        asyncio.run(main())
-
-
-class TestCropPipelineYuvWire:
-    def test_yuv_detector_feeds_yuv_classifier_batch_stage(self):
-        """Both stages on the yuv420 wire: the detector's handoff receives
-        the decoded RGB image back (example_decoder) and the classifier's
-        batch stage converts the crop stack at ingestion (stack_adapter) —
-        composite pipelines are wire-agnostic end to end."""
-        from ai4e_tpu.cli import build_worker
-        from ai4e_tpu.config import FrameworkConfig
-        from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
-
-        async def main():
-            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
-            worker, batcher, _tm = build_worker(FrameworkConfig(), {
-                "service_name": "crops", "prefix": "v1/crops",
-                "models": [
-                    {"family": "detector", "name": "det", "image_size": 64,
-                     "widths": [8, 8, 8], "score_threshold": 0.0,
-                     "max_detections": 4, "buckets": [1],
-                     "wire": "yuv420",
-                     "async_path": "/detect-async",
-                     "pipeline_to": {
-                         "endpoint": "/v1/crops/cls-batch-async",
-                         "payload": "crops", "crop_size": 16,
-                         "max_crops": 3}},
-                    {"family": "resnet", "name": "cls", "image_size": 16,
-                     "stage_sizes": [1], "width": 8, "num_classes": 4,
-                     "buckets": [4], "wire": "yuv420",
-                     "batch": {"async_path": "/cls-batch-async",
-                               "max_items": 8}},
-                ]})
-            worker.service.task_manager = platform.task_manager
-            worker.store = platform.store
-            await batcher.start()
-            svc = TestClient(TestServer(worker.service.app))
-            await svc.start_server()
-            base = str(svc.make_url("")).rstrip("/")
-            platform.publish_async_api("/v1/public/detect",
-                                       base + "/v1/crops/detect-async")
-            platform.dispatchers.register("/v1/crops/cls-batch-async",
-                                          base + "/v1/crops/cls-batch-async")
-            gw = TestClient(TestServer(platform.gateway.app))
-            await gw.start_server()
-            await platform.start()
-            try:
-                img = np.random.default_rng(0).integers(
-                    0, 256, (64, 64, 3), dtype=np.uint8)
-                buf = io.BytesIO()
-                np.save(buf, img)
-                resp = await gw.post("/v1/public/detect", data=buf.getvalue())
-                tid = (await resp.json())["TaskId"]
-                r = await gw.get(f"/v1/taskmanagement/task/{tid}",
-                                 params={"wait": "30"})
-                final = await r.json()
-                assert "completed" in final["Status"], final
-                staged = platform.store.get_result(tid, stage="det")
                 dets = json.loads(staged[0])["detections"]
                 assert len(dets) >= 1
                 body, _ctype = platform.store.get_result(tid)

@@ -170,6 +170,52 @@ class TestFollowerSync:
         run(main())
 
 
+class TestStandbyLongPoll:
+    def test_replicated_completion_wakes_standby_waiters(self, tmp_path):
+        """A client long-polling the STANDBY's gateway must wake when the
+        task completes on the PRIMARY: replicated Slim transitions fire the
+        follower's own listeners (absorb_lines → _notify), so standby reads
+        are first-class, not poll-until-timeout."""
+        async def main():
+            from ai4e_tpu.gateway.router import Gateway
+
+            primary = primary_store(tmp_path)
+            pri_client = await serve(make_app(primary))
+            follower = follower_store(tmp_path)
+            gw = Gateway(follower)
+            gw_client = await serve(gw.app)
+            repl = JournalReplicator(
+                follower, str(pri_client.make_url("")), poll_wait=0.2)
+            repl.start()
+            try:
+                t = primary.upsert(APITask(
+                    endpoint="http://edge/v1/e/run", body=b"x"))
+                ok = await wait_for(lambda: t.task_id in
+                                    {x.task_id for x in follower.snapshot()})
+                assert ok, "task never replicated to the standby"
+                waiter = asyncio.create_task(gw_client.get(
+                    f"/v1/taskmanagement/task/{t.task_id}",
+                    params={"wait": "20"}))
+                await asyncio.sleep(0.1)
+                t0 = asyncio.get_event_loop().time()
+                primary.update_status(t.task_id, "completed - done",
+                                      TaskStatus.COMPLETED)
+                resp = await asyncio.wait_for(waiter, timeout=10)
+                woke_after = asyncio.get_event_loop().time() - t0
+                body = await resp.json()
+                assert "completed" in body["Status"], body
+                # Event-driven wake, not the 20 s poll timeout.
+                assert woke_after < 5.0, woke_after
+            finally:
+                await repl.aclose()
+                await pri_client.close()
+                await gw_client.close()
+                primary.close()
+                follower.close()
+
+        run(main())
+
+
 class TestWriteFence:
     def test_follower_refuses_writes_until_promoted(self, tmp_path):
         follower = follower_store(tmp_path)
