@@ -33,31 +33,22 @@ _native_tried = False
 
 
 def _get_native_encode():
-    """ctypes handle to the C++ encoder (``native/yuv_codec.cpp``) — the
-    conversion runs per request on the serving host's core, and the numpy
-    version's channel-interleaved reductions cost ~2 ms per 256² tile where
-    the single-pass C++ loop costs ~0.2 ms. Falls back to numpy if the
-    toolchain can't build it (None)."""
+    """C++ encoder (``native/yuv_codec.cpp``) or None — the conversion runs
+    per request on the serving host's core, and the numpy version's
+    channel-interleaved reductions cost ~2 ms per 256² tile where the
+    single-pass C++ loop costs ~0.2 ms."""
     global _native_encode, _native_tried
     if _native_tried:
         return _native_encode
     _native_tried = True
-    try:
-        import ctypes
+    import ctypes
 
-        from ..utils.native_build import build_native_library
-        lib = ctypes.CDLL(build_native_library("yuv_codec.cpp",
-                                               "libyuv_codec.so"))
-        lib.yuv420_encode.restype = ctypes.c_int
-        lib.yuv420_encode.argtypes = [
-            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_uint8)]
-        _native_encode = lib.yuv420_encode
-    except Exception:  # noqa: BLE001 — numpy fallback keeps serving
-        import logging
-        logging.getLogger("ai4e_tpu.ops.yuv").exception(
-            "native yuv codec unavailable; using the numpy encoder")
-        _native_encode = None
+    from ..utils.native_build import load_native_function
+    _native_encode = load_native_function(
+        "yuv_codec.cpp", "libyuv_codec.so", "yuv420_encode",
+        restype=ctypes.c_int,
+        argtypes=[ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+                  ctypes.c_int, ctypes.POINTER(ctypes.c_uint8)])
     return _native_encode
 
 

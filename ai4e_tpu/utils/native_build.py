@@ -36,3 +36,24 @@ def build_native_library(src_name: str, so_name: str,
     log.info("building native core: %s", " ".join(cmd))
     subprocess.run(cmd, check=True, capture_output=True)
     return out
+
+
+def load_native_function(src_name: str, so_name: str, fn_name: str,
+                         restype, argtypes):
+    """Build-if-stale + CDLL + bind ONE function, or None when the
+    toolchain can't produce it (callers keep a pure-Python fallback) —
+    the shared loader for the per-request codecs (``ops/yuv.py``,
+    ``ops/dct.py``). CDLL releases the GIL during the foreign call, which
+    is what makes these codecs cheap on a serving host's event loop."""
+    try:
+        import ctypes
+
+        lib = ctypes.CDLL(build_native_library(src_name, so_name))
+        fn = getattr(lib, fn_name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+        return fn
+    except Exception:  # noqa: BLE001 — fallback keeps serving
+        log.exception("native %s unavailable; caller falls back to numpy",
+                      so_name)
+        return None
