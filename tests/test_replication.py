@@ -330,6 +330,77 @@ class TestStandbyPlatform:
         run(main())
 
 
+class TestMidPipelineFailover:
+    def test_handed_off_task_completes_on_promoted_standby(self, tmp_path):
+        """A composite task killed MID-PIPELINE survives: stage 1 completed
+        on the primary and republished the task to stage 2 (endpoint
+        rewrite + empty body), then the primary died. The promoted standby
+        must re-seed the stage-2 task WITH the replicated original body
+        (the ``{taskId}_ORIG`` replay, ``CacheConnectorUpsert.cs:144-176``)
+        so stage 2 receives the real payload."""
+        async def main():
+            from ai4e_tpu.platform_assembly import (LocalPlatform,
+                                                    PlatformConfig)
+
+            primary = primary_store(tmp_path)
+            pri_client = await serve(make_app(primary))
+
+            standby = LocalPlatform(PlatformConfig(
+                journal_path=str(tmp_path / "standby.jsonl"),
+                replicate_from=str(pri_client.make_url("")),
+                failover_interval=0.1, failover_down_after=2,
+                retry_delay=0.05))
+            svc = standby.make_service("cls", prefix="v1/cls")
+            stage2_bodies = []
+
+            @svc.api_async_func("/classify")
+            def classify(taskId, body, content_type):
+                stage2_bodies.append((body, content_type))
+                asyncio.run(standby.task_manager.complete_task(
+                    taskId, "completed - classified"))
+
+            svc_client = await serve(svc.app)
+            stage2_backend = str(svc_client.make_url("/v1/cls/classify"))
+            standby.publish_async_api("/v1/public/classify", stage2_backend)
+            await standby.start()
+            try:
+                # On the PRIMARY: stage-1 lifecycle up to the handoff.
+                t = primary.upsert(APITask(
+                    endpoint="http://edge/v1/det/detect",
+                    body=b"ORIGINAL-IMG", content_type="image/jpeg",
+                    publish=True))
+                primary.update_status(t.task_id, "running - det",
+                                      TaskStatus.RUNNING)
+                # Handoff: endpoint rewritten to stage 2, empty body →
+                # the store replays the original (same upsert the
+                # task manager's add_pipeline_task performs).
+                primary.upsert(APITask(
+                    task_id=t.task_id, endpoint=stage2_backend, body=b"",
+                    status=TaskStatus.CREATED,
+                    backend_status=TaskStatus.CREATED, publish=True))
+                ok = await wait_for(
+                    lambda: standby.store.get(t.task_id).endpoint
+                    == stage2_backend if t.task_id in
+                    {x.task_id for x in standby.store.snapshot()} else False)
+                assert ok, "handoff never replicated"
+
+                await pri_client.close()
+                primary.close()
+                await asyncio.wait_for(standby.watchdog.promoted.wait(),
+                                       timeout=10)
+
+                ok = await wait_for(
+                    lambda: "completed" in standby.store.get(t.task_id).status)
+                assert ok, standby.store.get(t.task_id).to_dict()
+                # Stage 2 received the ORIGINAL payload with its type.
+                assert stage2_bodies == [(b"ORIGINAL-IMG", "image/jpeg")]
+            finally:
+                await standby.stop()
+                await svc_client.close()
+
+        run(main())
+
+
 class TestKillTheStore:
     def test_tasks_survive_primary_death_and_complete_on_follower(
             self, tmp_path):
