@@ -1,0 +1,12 @@
+#!/bin/bash
+# Shared tunnel probe: exit 0 iff a non-CPU jax device answers a matmul
+# within the timeout. A dead tunnel hangs the full timeout, so callers'
+# probe cadence is timeout+sleep — keep the timeout as low as a slow
+# tunnel's first compile allows (~120s; see tpu_watcher.sh rationale).
+#
+# Usage: scripts/probe_tpu.sh [timeout_seconds]   (default 120)
+timeout "${1:-120}" python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((64,64)); (x @ x).block_until_ready()
+assert jax.devices()[0].platform != 'cpu'
+print('PROBE_OK')" 2>/dev/null | grep -q PROBE_OK

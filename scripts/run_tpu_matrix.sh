@@ -6,10 +6,10 @@
 # time-boxes its stages itself; if a run lands on CPU fallback we stop —
 # the tunnel died and the remaining runs would just archive fallbacks.
 #
-# Usage: scripts/run_tpu_matrix.sh [outdir]   (default bench_results/r4-tpu)
+# Usage: scripts/run_tpu_matrix.sh [outdir]   (default bench_results/r5-tpu)
 set -u
 cd "$(dirname "$0")/.."
-OUT="${1:-bench_results/r4-tpu}"
+OUT="${1:-bench_results/r5-tpu}"
 mkdir -p "$OUT"
 
 run_one() {
@@ -42,11 +42,7 @@ PY
             # overran its stage box (continue — one heavy config must not
             # forfeit the rest of the matrix). One probe decides.
             echo "== $name landed on '$device'; probing the tunnel" >&2
-            if timeout 150 python -c "
-import jax, jax.numpy as jnp
-x = jnp.ones((64,64)); (x @ x).block_until_ready()
-assert jax.devices()[0].platform != 'cpu'
-print('PROBE_OK')" 2>/dev/null | grep -q PROBE_OK; then
+            if bash scripts/probe_tpu.sh 120; then
                 echo "== tunnel alive; $name kept its fallback, continuing" >&2
                 return 0
             fi
@@ -63,8 +59,14 @@ run_one landcover_dct128 --model landcover --wire dct --buckets 1 16 128 || exit
 run_one species_dct     --model species --wire dct                 || exit 1
 run_one landcover_push_yuv --model landcover --transport push --wire yuv420 || exit 1
 run_one megadet_dct     --model megadetector --buckets 1 8 16 --wire dct || exit 1
-run_one landcover_jpeg  --model landcover --wire jpeg              || exit 1
-run_one species_jpeg    --model species --wire jpeg                || exit 1
+# The jpeg wire needs Pillow; on a host without it bench.py would die
+# mid-matrix and forfeit the remaining cells (ADVICE r4) — skip instead.
+if python -c "import PIL" 2>/dev/null; then
+    run_one landcover_jpeg  --model landcover --wire jpeg          || exit 1
+    run_one species_jpeg    --model species --wire jpeg            || exit 1
+else
+    echo "== PIL not importable; skipping jpeg wire cells" >&2
+fi
 run_one species_yuv     --model species --wire yuv420              || exit 1
 run_one landcover_push_dct --model landcover --transport push --wire dct || exit 1
 run_one mixed           --model mixed --wire yuv420 --duration 30       || exit 1
