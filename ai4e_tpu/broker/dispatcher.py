@@ -93,6 +93,12 @@ class Dispatcher:
         self._sessions = SessionHolder(timeout=request_timeout, limit=0)
 
     async def start(self) -> None:
+        # Restart-safe: a demoted-then-re-promoted control plane stops and
+        # later restarts its dispatchers (platform_assembly.demote_now) —
+        # clear the stop latch and drop finished workers so the top-up
+        # spawns live loops, not instant-exit ones.
+        self._stop.clear()
+        self._workers = [w for w in self._workers if not w.done()]
         # Top up, never replace: set_concurrency may have spawned loops
         # already, and replacing the list would orphan them past stop().
         loop = asyncio.get_running_loop()

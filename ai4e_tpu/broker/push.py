@@ -101,10 +101,19 @@ class PushEvent:
         )
 
     def to_headers(self) -> dict[str, str]:
-        """Binary-content-mode metadata (body ships raw as the HTTP body)."""
+        """Binary-content-mode metadata (body ships raw as the HTTP body).
+
+        The subject is an endpoint path + query string, which may contain
+        non-ASCII — and aiohttp refuses non-latin-1 header values, so an
+        unencoded subject would fail EVERY delivery attempt until the TTL
+        dead-letters a task the structured envelope could deliver fine.
+        Percent-encode it (RFC 8187 spirit); ``from_headers`` decodes, so
+        the round trip is exact for every subject including ones that
+        already contain ``%``."""
+        from urllib.parse import quote
         return {
             HDR_EVENT_ID: self.id,
-            HDR_EVENT_SUBJECT: self.subject,
+            HDR_EVENT_SUBJECT: quote(self.subject, safe="/:?=&"),
             HDR_EVENT_TYPE: self.event_type,
             HDR_EVENT_TIME: repr(self.event_time),
             "Content-Type": self.content_type or "application/octet-stream",
@@ -116,9 +125,10 @@ class PushEvent:
             event_time = float(headers.get(HDR_EVENT_TIME, ""))
         except ValueError:
             event_time = time.time()
+        from urllib.parse import unquote
         return cls(
             id=headers.get(HDR_EVENT_ID, ""),
-            subject=headers.get(HDR_EVENT_SUBJECT, ""),
+            subject=unquote(headers.get(HDR_EVENT_SUBJECT, "")),
             data=body,
             content_type=headers.get("Content-Type",
                                      "application/octet-stream"),

@@ -103,7 +103,12 @@ def build_control_plane(config: FrameworkConfig, routes: dict):
     # gateway's edge cap itself: the app's aiohttp cap is disabled.
     make_taskstore_app(platform.store, app=platform.gateway.app,
                        max_body_bytes=config.gateway.max_body_bytes,
-                       max_result_bytes=config.gateway.max_result_bytes)
+                       max_result_bytes=config.gateway.max_result_bytes,
+                       # Role flips over HTTP (promote/demote) must run the
+                       # platform's full sequence — replication torn down
+                       # before the store flip, transport started/stopped
+                       # around it — not a bare store flip.
+                       lifecycle=platform)
     # Typed API definitions ({org, api, backend_host, ...}) publish through
     # the registration customizer (gateway/registration.py) — one publish
     # code path; both spec styles can coexist in one routes.json.

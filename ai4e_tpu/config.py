@@ -149,6 +149,10 @@ class PlatformSection:
     # Subscription key for the primary's keyed control-plane port (the
     # journal stream rides behind the gateway key middleware).
     replicate_api_key: typing.Optional[str] = None
+    # This node's control-plane URL as peers reach it — after a promotion
+    # the fencing prober sends it in demote calls so the deposed primary
+    # rejoins the new primary automatically (split-brain fencing).
+    advertise_url: typing.Optional[str] = None
 
     def to_platform_config(self):
         from .platform_assembly import PlatformConfig
@@ -176,6 +180,7 @@ class PlatformSection:
             replicate_api_key=next(
                 (k.strip() for k in (self.replicate_api_key or "").split(",")
                  if k.strip()), None),
+            advertise_url=self.advertise_url,
         )
 
 

@@ -61,6 +61,12 @@ class PlatformConfig:
     # the key middleware — an unkeyed replicator would 401 forever and the
     # standby would never sync).
     replicate_api_key: str | None = None
+    # This node's control-plane URL as PEERS reach it. After a promotion
+    # the fencing prober includes it in demote calls so the deposed
+    # primary's platform rejoins the new primary as a follower
+    # automatically; unset, deposed peers are fenced (writes refused) but
+    # must be re-seeded by the deployment.
+    advertise_url: str | None = None
 
 
 class LocalPlatform:
@@ -103,8 +109,16 @@ class LocalPlatform:
                 raise ValueError(
                     "native_store has no journal; use journal_path with the "
                     "Python store or native_store without durability")
-            self.store = JournaledTaskStore(self.config.journal_path,
-                                            **result_kwargs)
+            # Born-primary FollowerTaskStore, not a plain JournaledTaskStore:
+            # behaviorally identical while primary, but carries the
+            # demote()/note_epoch() fence — so a journaled primary in an HA
+            # pair can be deposed by a promoted standby (split-brain
+            # fencing, VERDICT r4 #3) instead of silently accepting
+            # doomed writes.
+            from .taskstore.store import FollowerTaskStore
+            self.store = FollowerTaskStore(self.config.journal_path,
+                                           start_as_primary=True,
+                                           **result_kwargs)
         elif self.config.native_store:
             from .taskstore.native import NativeTaskStore
             if result_backend is not None:
@@ -127,16 +141,10 @@ class LocalPlatform:
         self.webhook = None
         self._webhook_runner = None
         if self.config.transport == "push":
-            from .broker.push import PushTopic, WebhookDispatcher
-            self.topic = PushTopic(
-                ttl_seconds=self.config.push_ttl_seconds,
-                max_attempts=self.config.push_max_attempts,
-                retry_delay=self.config.retry_delay,
-                window=self.config.push_window,
-                metrics=self.metrics)
-            self.webhook = WebhookDispatcher(self.task_manager,
-                                             metrics=self.metrics)
-            self.store.set_publisher(self.topic.publish)
+            # Webhook routes are recorded so a demoted-then-re-promoted
+            # node can rebuild the push transport (demote_now closes it).
+            self._push_routes: list[tuple[str, str]] = []
+            self._build_push()
         elif self.config.transport == "queue":
             if self.config.native_broker:
                 from .broker.native import NativeBroker
@@ -177,9 +185,30 @@ class LocalPlatform:
         self.autoscalers: list = []
         self.replicator = None
         self.watchdog = None
+        self.prober = None
+        self._transport_running = False
         self._started = False
 
     # -- assembly ----------------------------------------------------------
+
+    def _build_push(self) -> None:
+        """(Re)construct the push transport: topic + webhook dispatcher +
+        recorded routes, and point the store's publish hook at the new
+        topic. Called at assembly and again after a demotion closed the
+        previous topic (PushTopic.aclose is terminal — a re-promotion
+        needs a fresh one)."""
+        from .broker.push import PushTopic, WebhookDispatcher
+        self.topic = PushTopic(
+            ttl_seconds=self.config.push_ttl_seconds,
+            max_attempts=self.config.push_max_attempts,
+            retry_delay=self.config.retry_delay,
+            window=self.config.push_window,
+            metrics=self.metrics)
+        self.webhook = WebhookDispatcher(self.task_manager,
+                                         metrics=self.metrics)
+        for queue_name, backend_uri in self._push_routes:
+            self.webhook.add_route(queue_name, backend_uri)
+        self.store.set_publisher(self.topic.publish)
 
     def make_service(self, name: str, prefix: str = "") -> APIService:
         svc = APIService(name, prefix=prefix,
@@ -222,6 +251,7 @@ class LocalPlatform:
                     "autoscale/retry_delay/concurrency are queue-transport "
                     "knobs; push retry policy is topic-wide "
                     "(PlatformConfig.retry_delay/push_max_attempts)")
+            self._push_routes.append((queue_name, backend_uri))
             self.webhook.add_route(queue_name, backend_uri)
             return
         self.broker.register_queue(queue_name)
@@ -264,6 +294,14 @@ class LocalPlatform:
             await self.depth_logger.start()
             self._started = True
             return
+        if hasattr(self.store, "passive_fencing"):
+            # A primary with NO configured HA peer must not be demotable by
+            # a forged or stale X-Store-Epoch header — there is no standby
+            # to take over, so passive fencing evidence would only convert
+            # a bogus header into a total write outage. advertise_url is
+            # the HA-pair marker (both charts set it); the explicit
+            # /demote endpoint stays available either way.
+            self.store.passive_fencing = bool(self.config.advertise_url)
         await self._start_transport(loop)
         await self.depth_logger.start()
         if self.reaper is not None:
@@ -274,7 +312,12 @@ class LocalPlatform:
         self._started = True
 
     async def _start_transport(self, loop: asyncio.AbstractEventLoop) -> None:
+        self._transport_running = True
         if self.config.transport == "push":
+            if self.topic is None:
+                # A demotion closed the previous topic/webhook; a
+                # re-promotion (fail-back) rebuilds them.
+                self._build_push()
             await self._start_push(loop)
         else:
             self.broker.bind_loop(loop)
@@ -297,6 +340,16 @@ class LocalPlatform:
         logging.getLogger("ai4e_tpu.platform").warning(
             "promoted to primary; starting transport and re-seeding "
             "%d unfinished tasks", len(self.store.unfinished_tasks()))
+        # Release the replicator: the watchdog stopped its loop but the
+        # REFERENCE must clear too — demote_now gates auto-rejoin on
+        # `replicator is None`, and the /role endpoint's "replicating"
+        # field reads the same attribute (a stale object here would make a
+        # future fail-back silently skip rejoin). The watchdog reference
+        # stays: its run loop returns right after this hook, and its
+        # `promoted` event is part of the observable surface.
+        if self.replicator is not None:
+            await self.replicator.aclose()
+            self.replicator = None
         loop = asyncio.get_running_loop()
         await self._start_transport(loop)
         if self.reaper is not None:
@@ -307,6 +360,91 @@ class LocalPlatform:
                    else self.broker.publish)
         for task in self.store.unfinished_tasks():
             publish(task)
+        # Actively fence the deposed primary (split-brain closure): keep
+        # knocking on its door so it demotes — and rejoins us — the moment
+        # the partition heals, even if no client traffic ever reaches it.
+        if self.config.replicate_from:
+            from .taskstore.replication import FencingProber
+            self.prober = FencingProber(
+                self.store, self.config.replicate_from,
+                advertise_url=self.config.advertise_url,
+                api_key=self.config.replicate_api_key,
+                interval=self.config.failover_interval)
+            self.prober.start()
+
+    async def promote_now(self) -> None:
+        """Manual-failover entry (HTTP ``POST /v1/taskstore/promote`` routes
+        here via make_app's ``lifecycle``): the same sequence the watchdog
+        runs — replication torn down FIRST, so a racing poll can never
+        resync-wipe the newly-promoted primary (ADVICE r4 high)."""
+        if self.watchdog is not None:
+            await self.watchdog.stop()
+            self.watchdog = None
+        if self.replicator is not None:
+            await self.replicator.aclose()
+            self.replicator = None
+        if getattr(self.store, "role", "primary") == "primary":
+            return  # already primary — idempotent
+        self.store.promote()
+        await self._on_promoted()
+
+    async def demote_now(self, epoch: int, primary_url: str | None = None
+                         ) -> None:
+        """Fence this node out of the primary role (HTTP ``POST
+        /v1/taskstore/demote`` routes here). The store flip is first and
+        synchronous — writes refuse before this returns; raises
+        ``StaleEpochError`` (handler: 409) when the caller's epoch is not
+        newer. Then the primary-side machinery stops, and with
+        ``primary_url`` the node rejoins the new primary as a standby —
+        watchdog armed, so the pair can fail back."""
+        self.store.demote(epoch)
+        # Stop the primary-side machinery if it is still running. Keyed on
+        # actual transport state, not on the role at call time: a PASSIVE
+        # demotion (a client's epoch header flipped the bare store mid-
+        # request) leaves the platform's dispatchers running — the prober's
+        # follow-up demote call cleans that up here.
+        if self._transport_running:
+            import logging
+            logging.getLogger("ai4e_tpu.platform").warning(
+                "demoted at epoch %d (new primary: %s); stopping transport",
+                epoch, primary_url or "unknown")
+            self._transport_running = False
+            if self.prober is not None:
+                await self.prober.aclose()
+                self.prober = None
+            for scaler in self.autoscalers:
+                await scaler.stop()
+            if self.reaper is not None:
+                await self.reaper.stop()
+            if self.dispatchers is not None:
+                await self.dispatchers.stop()
+            if self.topic is not None:
+                # Push transport: in-flight deliveries drain; their result
+                # writes hit the store fence (NotPrimaryError → 503) and
+                # the new primary's re-seed owns redelivery. aclose is
+                # terminal, so drop the topic + webhook — a re-promotion
+                # rebuilds them (_start_transport → _build_push).
+                await self.topic.aclose()
+                self.topic = None
+                self.webhook = None
+                self.store.set_publisher(None)
+                if self._webhook_runner is not None:
+                    await self._webhook_runner.cleanup()
+                    self._webhook_runner = None
+        if primary_url and self.replicator is None:
+            from .taskstore.replication import (FailoverWatchdog,
+                                                JournalReplicator)
+            self.config.replicate_from = primary_url
+            self.replicator = JournalReplicator(
+                self.store, primary_url,
+                api_key=self.config.replicate_api_key)
+            self.replicator.start()
+            self.watchdog = FailoverWatchdog(
+                self.replicator,
+                interval=self.config.failover_interval,
+                down_after=self.config.failover_down_after,
+                on_promote=self._on_promoted)
+            self.watchdog.start()
 
     async def _start_push(self, loop: asyncio.AbstractEventLoop) -> None:
         """Push transport: serve the webhook dispatcher app, then validate
@@ -364,6 +502,9 @@ class LocalPlatform:
         if self.replicator is not None:
             await self.replicator.aclose()
             self.replicator = None
+        if self.prober is not None:
+            await self.prober.aclose()
+            self.prober = None
         if self._started:
             for scaler in self.autoscalers:
                 await scaler.stop()

@@ -127,6 +127,11 @@ class _HttpStoreClient:
         headers = ({"Ocp-Apim-Subscription-Key": api_key}
                    if api_key else None)
         self._holder = SessionHolder(session, headers=headers)
+        # Highest fencing epoch any replica has shown us (X-Store-Epoch).
+        # Echoed on every request: a client that has talked to the new
+        # primary carries the evidence that demotes a stale one
+        # (taskstore/replication.py module docs).
+        self.store_epoch = 0
 
     async def _get_session(self) -> aiohttp.ClientSession:
         return await self._holder.get()
@@ -148,12 +153,23 @@ class _HttpStoreClient:
                        + [e for e in self._endpoints if e != self.base_url])
             for base in ordered:
                 try:
+                    if self.store_epoch:
+                        headers = dict(kwargs.pop("headers", None) or {})
+                        headers.setdefault("X-Store-Epoch",
+                                           str(self.store_epoch))
+                        kwargs["headers"] = headers
                     async with session.request(
                             method, base + path, **kwargs) as resp:
                         body = await resp.read()
-                    if resp.status == 503 and not single:
-                        # A follower replica refusing the write, or a
-                        # draining primary — rotate.
+                    seen = resp.headers.get("X-Store-Epoch")
+                    if seen and seen.isdigit():
+                        self.store_epoch = max(self.store_epoch, int(seen))
+                    if (resp.status == 503 and not single
+                            and resp.headers.get("X-Not-Primary")):
+                        # A follower replica refusing the write — rotate.
+                        # A PLAIN 503 (overloaded/draining primary) is
+                        # returned to the caller: rotating on it would
+                        # stick reads to a lagging follower (ADVICE r4).
                         last_exc = aiohttp.ClientResponseError(
                             resp.request_info, (), status=503,
                             message="replica not primary")

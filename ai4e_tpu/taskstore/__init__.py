@@ -4,6 +4,7 @@ from .store import (
     InMemoryTaskStore,
     JournaledTaskStore,
     NotPrimaryError,
+    StaleEpochError,
     TaskNotFound,
 )
 from .task import APITask, TaskStatus, endpoint_path, new_task_id
@@ -17,6 +18,7 @@ __all__ = [
     "JournaledTaskStore",
     "FollowerTaskStore",
     "NotPrimaryError",
+    "StaleEpochError",
     "TaskNotFound",
     "FileResultBackend",
     "ResultBackend",

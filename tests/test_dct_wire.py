@@ -158,6 +158,106 @@ class TestNativeCodecParity:
         assert np.abs(a - b).max() <= 1
 
 
+class TestFidelityBoundary:
+    """VERDICT r4 #6: the color/shape tasks pass any truncation, so their
+    gates can't fail — these gates CAN. Class information lives in the
+    u∈{2,3} DCT bands (``species_fine_batch``): kept by the shipped K=4
+    wire, provably destroyed at K=2, crushed by 4×-coarser quantization."""
+
+    def test_texture_bands_survive_k4_not_k2(self):
+        # Pure codec property, checkpoint-free: an exact u=3 luma grating
+        # (period 16/3 px) must survive the shipped K=4 roundtrip with most
+        # of its amplitude, and be FLATTENED by K=2.
+        x = np.arange(64, dtype=np.float32)
+        wave = 0.2 * np.cos(np.pi * 3 * (2 * x + 1) / 16.0)
+        img01 = np.clip(0.45 + np.broadcast_to(wave[None, :], (64, 64)), 0, 1)
+        img = np.round(img01[..., None] * 255).astype(np.uint8)
+        img = np.repeat(img, 3, axis=-1)
+
+        def roundtrip_amplitude(k):
+            back = dct_to_rgb_numpy(rgb_to_dct(img, k=k), 64, 64, k=k)
+            row = back[32, :, 1].astype(np.float32)
+            return float(row.max() - row.min())
+
+        original = 0.4 * 255  # peak-to-peak of the grating
+        amp4 = roundtrip_amplitude(4)
+        amp2 = roundtrip_amplitude(2)
+        assert amp4 >= 0.6 * original, (amp4, original)
+        assert amp2 <= 0.15 * original, (
+            f"K=2 should flatten a u=3 grating; kept {amp2:.1f} of "
+            f"{original:.1f}")
+
+    def test_fine_texture_gate_has_measured_failure_boundary(self):
+        """The TRAINED fine-texture classifier through the wire: the
+        shipped K=4/q50 config passes its gate; K=2 and coarse
+        quantization demonstrably FAIL it — a gate with a measured
+        failure boundary instead of a saturated task's blind pass.
+
+        Measured boundary (r5, 32 held-out images, seed 43):
+
+        ====  =======  ========
+        k     quality  accuracy
+        ====  =======  ========
+        —     —        0.875     (direct; held-out eval 0.883)
+        4     50       0.875     (shipped wire: costs nothing)
+        3     50       0.531     (u=3 bands dropped)
+        2     50       0.063     (all texture bands dropped → chance)
+        4     10       0.781     (≈5× tables: faint classes eroding)
+        4     6        0.688     (≈8× tables: faint texture zeroed)
+        ====  =======  ========
+        """
+        import os
+
+        from ai4e_tpu.checkpoint import load_params
+        from ai4e_tpu.runtime import ModelRuntime, build_servable
+        from ai4e_tpu.train.make_checkpoints import species_fine_batch
+
+        repo, manifest = _load_manifest()
+        if "species_fine" not in manifest:
+            import pytest
+            pytest.skip("no species_fine checkpoint (run the factory with "
+                        "--only species_fine)")
+        ckpt = os.path.join(repo, "checkpoints", "species_fine")
+        kwargs = {k: v for k, v in manifest["species_fine"]["kwargs"].items()
+                  if k != "labels"}
+        size = kwargs.pop("image_size", 64)
+        kwargs.update(image_size=size, buckets=(32,))
+        rgb = build_servable("resnet", name="spf-rgb", **kwargs)
+        rgb.params = load_params(ckpt, like=rgb.params)
+        runtime = ModelRuntime()
+        runtime.register(rgb)
+
+        img, labels = species_fine_batch(np.random.default_rng(43), 32, size)
+        u8 = np.clip(np.round(img * 255), 0, 255).astype(np.uint8)
+
+        def accuracy(batch) -> float:
+            out = np.argmax(np.asarray(runtime.run_batch("spf-rgb", batch)),
+                            axis=-1)
+            return float((out == labels).mean())
+
+        def through_wire(k, quality=50) -> float:
+            back = np.stack([
+                np.clip(np.round(dct_to_rgb_numpy(
+                    rgb_to_dct(s, k=k, quality=quality), size, size,
+                    k=k, quality=quality)), 0, 255).astype(np.uint8)
+                for s in u8])
+            return accuracy(back)
+
+        direct = accuracy(u8)
+        k4 = through_wire(4)
+        k2 = through_wire(2)
+        coarse = through_wire(4, quality=6)
+        assert direct >= 0.80, f"checkpoint not competent: {direct}"
+        # Shipped config: the wire costs a sliver, not the task.
+        assert k4 >= direct - 0.06, (direct, k4)
+        # Failure boundary, truncation side: u≥2 bands gone → the 8 classes
+        # collapse to chance (0.125).
+        assert k2 <= 0.35, f"K=2 should break the gate; accuracy {k2}"
+        # Failure boundary, quantization side: ≈8× tables zero the faint
+        # classes' coefficients — the gate measurably degrades.
+        assert coarse <= direct - 0.10, (direct, coarse)
+
+
 class TestTrainedModelFidelity:
     def test_species_checkpoint_classifies_identically_over_dct(self):
         """The TRAINED species classifier must assign the same (correct)

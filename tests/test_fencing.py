@@ -1,0 +1,522 @@
+"""Split-brain fencing tests (VERDICT r4 #3 + ADVICE r4).
+
+The reference got the single-writer property from managed Redis — one
+writer, Azure's problem (``RedisConnection.cs:12-38``). Here it is code:
+promotion mints a journaled fencing epoch, every store response carries it
+(``X-Store-Epoch``), clients echo the highest epoch they have seen, and a
+primary that learns of a newer epoch self-demotes and refuses writes. The
+headline test is the partition e2e: the old primary is PARTITIONED (alive,
+not killed), the standby promotes, a write attempted against the old
+primary is REJECTED and lands on the true primary instead, and the old
+node rejoins as a follower automatically when the partition heals.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.service.task_manager import HttpTaskManager
+from ai4e_tpu.taskstore import (
+    APITask,
+    FollowerTaskStore,
+    NotPrimaryError,
+    StaleEpochError,
+)
+from ai4e_tpu.taskstore.http import make_app
+from ai4e_tpu.taskstore.replication import (
+    FailoverWatchdog,
+    FencingProber,
+    JournalReplicator,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return predicate()
+
+
+def make_partition_proxy(target_url: str, journal_budget: int | None = None):
+    """A togglable 'network' in front of ``target_url``: while
+    ``state['up']`` is False every request gets a 503 — what a partitioned
+    peer looks like to the watchdog's probe (non-200), the replicator
+    (stream error), and the fencing prober (no role answer).
+
+    ``journal_budget``: forward only that many journal DATA polls
+    (limit != 1; the watchdog's probes use limit=1) and then flip the
+    partition on — a deterministic 'primary died mid-initial-sync'."""
+    state = {"up": True, "journal_left": journal_budget}
+    target = target_url.rstrip("/")
+    session_holder = {}
+
+    async def forward(request: web.Request) -> web.Response:
+        if not state["up"]:
+            return web.Response(status=503, text="partitioned")
+        if (state["journal_left"] is not None
+                and "/journal" in request.path
+                and request.query.get("limit") != "1"):
+            if state["journal_left"] <= 0:
+                state["up"] = False
+                return web.Response(status=503, text="partitioned")
+            state["journal_left"] -= 1
+        session = session_holder.get("s")
+        if session is None or session.closed:
+            session = aiohttp.ClientSession()
+            session_holder["s"] = session
+        async with session.request(
+                request.method, target + request.path_qs,
+                data=await request.read(),
+                headers={k: v for k, v in request.headers.items()
+                         if k.startswith("X-")}) as resp:
+            body = await resp.read()
+            headers = {k: v for k, v in resp.headers.items()
+                       if k.startswith("X-")}
+            return web.Response(status=resp.status, body=body,
+                                headers=headers,
+                                content_type=resp.content_type)
+
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", forward)
+
+    async def close():
+        s = session_holder.get("s")
+        if s is not None:
+            await s.close()
+
+    return app, state, close
+
+
+class TestEpochLifecycle:
+    def test_promotion_mints_and_journals_the_epoch(self, tmp_path):
+        path = str(tmp_path / "f.jsonl")
+        store = FollowerTaskStore(path)
+        store.promote()
+        assert store.epoch == 1
+        store.close()
+        # The mint survives restart: a re-promotion can never reuse it.
+        store2 = FollowerTaskStore(path)
+        assert store2.epoch == 1
+        store2.promote()
+        assert store2.epoch == 2
+        store2.close()
+
+    def test_born_primary_accepts_writes_without_minting(self, tmp_path):
+        store = FollowerTaskStore(str(tmp_path / "p.jsonl"),
+                                  start_as_primary=True)
+        assert store.role == "primary"
+        assert store.epoch == 0  # boot is not a failover
+        t = store.upsert(APITask(endpoint="http://e/v1/x", body=b"b"))
+        assert store.get(t.task_id).task_id == t.task_id
+        store.close()
+
+    def test_demote_fences_writes_and_survives_restart(self, tmp_path):
+        path = str(tmp_path / "p.jsonl")
+        store = FollowerTaskStore(path, start_as_primary=True)
+        store.upsert(APITask(endpoint="http://e/v1/x", body=b"b"))
+        store.demote(epoch=3)
+        assert store.role == "follower"
+        assert store.epoch == 3
+        with pytest.raises(NotPrimaryError):
+            store.upsert(APITask(endpoint="http://e/v1/x", body=b"c"))
+        store.close()
+        # A rebooted deposed primary replays the fence: its next promotion
+        # mints PAST the epoch that deposed it.
+        store2 = FollowerTaskStore(path, start_as_primary=True)
+        assert store2.epoch == 3
+        store2.close()
+
+    def test_demote_with_stale_epoch_is_refused(self, tmp_path):
+        store = FollowerTaskStore(str(tmp_path / "p.jsonl"),
+                                  start_as_primary=True)
+        store.demote(epoch=5)
+        store.promote()  # mints 6
+        assert store.epoch == 6
+        with pytest.raises(StaleEpochError):
+            store.demote(epoch=6)  # equal is not newer
+        assert store.role == "primary"
+        store.close()
+
+    def test_note_epoch_self_demotes_only_on_newer(self, tmp_path):
+        store = FollowerTaskStore(str(tmp_path / "p.jsonl"),
+                                  start_as_primary=True)
+        store.note_epoch(0)
+        assert store.role == "primary"
+        store.note_epoch(2)
+        assert store.role == "follower"
+        assert store.epoch == 2
+        store.close()
+
+    def test_epoch_survives_compaction(self, tmp_path):
+        path = str(tmp_path / "f.jsonl")
+        store = FollowerTaskStore(path)
+        store.promote()
+        for i in range(4):
+            t = store.upsert(APITask(endpoint="http://e/v1/x",
+                                     body=b"b%d" % i))
+            store.update_status(t.task_id, "completed")
+        store.compact()
+        store.close()
+        store2 = FollowerTaskStore(path, start_as_primary=True)
+        assert store2.epoch == 1
+        store2.close()
+
+
+class TestResetRoleFence:
+    def test_reset_refuses_after_promotion(self, tmp_path):
+        # ADVICE r4 high: a replicator that kept running past a promotion
+        # must not be able to wipe the newly-promoted primary via the
+        # generation-resync path.
+        store = FollowerTaskStore(str(tmp_path / "f.jsonl"))
+        store.promote()
+        t = store.upsert(APITask(endpoint="http://e/v1/x", body=b"b"))
+        with pytest.raises(RuntimeError, match="reset after promote"):
+            store.reset()
+        assert store.get(t.task_id).task_id == t.task_id
+        store.close()
+
+    def test_http_promote_runs_full_lifecycle(self, tmp_path):
+        # ADVICE r4 high, second half: POST /promote with a platform
+        # lifecycle stops the replicator + watchdog BEFORE the flip and
+        # starts the transport — the exact watchdog sequence.
+        async def main():
+            pri = LocalPlatform(PlatformConfig(
+                journal_path=str(tmp_path / "pri.jsonl"), retry_delay=0.05))
+            pri_client = await serve(make_app(pri.store, lifecycle=pri))
+            await pri.start()
+            t = pri.store.upsert(APITask(endpoint="http://e/v1/x", body=b"b"))
+
+            stb = LocalPlatform(PlatformConfig(
+                journal_path=str(tmp_path / "stb.jsonl"),
+                replicate_from=str(pri_client.make_url("")),
+                failover_interval=0.05, failover_down_after=2,
+                retry_delay=0.05))
+            stb_client = await serve(make_app(stb.store, lifecycle=stb))
+            await stb.start()
+            try:
+                assert await wait_for(
+                    lambda: t.task_id in {x.task_id
+                                          for x in stb.store.unfinished_tasks()})
+                resp = await stb_client.post("/v1/taskstore/promote")
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["role"] == "primary"
+                assert data["epoch"] == 1
+                # Replication machinery is gone; transport is running; the
+                # replicated task was re-seeded for dispatch.
+                assert stb.replicator is None and stb.watchdog is None
+                assert stb._transport_running
+                # The store journal is live again: writes flow.
+                stb.store.update_status(t.task_id, "completed")
+            finally:
+                await stb.stop()
+                await pri.stop()
+                await stb_client.close()
+                await pri_client.close()
+
+        run(main())
+
+
+class TestSyncedMeansCaughtUp:
+    def test_watchdog_never_promotes_mid_initial_sync(self, tmp_path):
+        # ADVICE r4 medium: with a chunk limit far below the journal size,
+        # the first poll transfers an arbitrary snapshot PREFIX. If the
+        # primary dies right then, promotion must NOT arm — a follower
+        # holding half the tasks would be crowned. Partition the primary
+        # after the first chunk and assert the watchdog holds its fire.
+        async def main():
+            primary = FollowerTaskStore(str(tmp_path / "pri.jsonl"),
+                                        start_as_primary=True)
+            for i in range(20):
+                primary.upsert(APITask(endpoint="http://e/v1/x",
+                                       body=b"payload-%03d" % i))
+            pri_client = await serve(make_app(primary))
+            # The proxy forwards exactly ONE journal data poll, then
+            # partitions — deterministically "the primary died after the
+            # first 256-byte chunk of a 20-task snapshot".
+            proxy_app, net, close_proxy = make_partition_proxy(
+                str(pri_client.make_url("")), journal_budget=1)
+            proxy_client = await serve(proxy_app)
+
+            follower = FollowerTaskStore(str(tmp_path / "stb.jsonl"))
+            repl = JournalReplicator(follower,
+                                     str(proxy_client.make_url("")),
+                                     poll_wait=0.1, chunk_limit=256)
+            dog = FailoverWatchdog(repl, interval=0.05, down_after=2)
+            repl.start()
+            dog.start()
+            try:
+                assert await wait_for(lambda: repl.offset > 0)
+                assert not repl.synced.is_set(), (
+                    "a 256-byte chunk of a 20-task journal must not count "
+                    "as synced")
+                await asyncio.sleep(0.5)  # many watchdog intervals
+                assert not dog.promoted.is_set()
+                assert follower.role == "follower"
+                # Heal: replication catches up, and only now is the
+                # follower a legal promotion target.
+                net["journal_left"] = None
+                net["up"] = True
+                assert await wait_for(lambda: repl.synced.is_set())
+                assert await wait_for(
+                    lambda: len(follower.unfinished_tasks()) == 20)
+                net["up"] = False
+                assert await wait_for(lambda: dog.promoted.is_set())
+                assert follower.role == "primary"
+                assert len(follower.unfinished_tasks()) == 20
+            finally:
+                await dog.stop()
+                await repl.aclose()
+                await close_proxy()
+                await proxy_client.close()
+                await pri_client.close()
+                primary.close()
+                follower.close()
+
+        run(main())
+
+
+class TestClientRotation:
+    def test_plain_503_does_not_rotate_to_follower(self, tmp_path):
+        # ADVICE r4 low: only an X-Not-Primary 503 means "rotate"; an
+        # overloaded/draining primary's plain 503 must surface to the
+        # caller, not silently re-home reads to a lagging follower.
+        async def main():
+            overloaded = web.Application()
+
+            async def plain_503(_):
+                return web.json_response({"error": "draining"}, status=503)
+
+            overloaded.router.add_route("*", "/{tail:.*}", plain_503)
+            busy_client = await serve(overloaded)
+
+            follower = FollowerTaskStore(str(tmp_path / "f.jsonl"))
+            fol_client = await serve(make_app(follower))
+
+            mgr = HttpTaskManager([str(busy_client.make_url("")),
+                                   str(fol_client.make_url(""))])
+            try:
+                resp, _ = await mgr._request("GET", "/v1/taskstore/depths")
+                assert resp.status == 503
+                assert mgr.base_url == str(busy_client.make_url("")).rstrip("/")
+            finally:
+                await mgr.close()
+                await fol_client.close()
+                await busy_client.close()
+                follower.close()
+
+        run(main())
+
+
+class TestPartitionedPrimaryIsFenced:
+    def test_partitioned_primary_rejects_write_and_rejoins(self, tmp_path):
+        """The headline split-brain e2e (VERDICT r4 #3 'done' criteria):
+
+        1. HA pair running; standby mirrors the primary.
+        2. The primary is PARTITIONED from the standby — alive, serving,
+           its HTTP surface still open to clients.
+        3. The standby's watchdog promotes it (epoch 1) and its fencing
+           prober starts knocking on the old primary's door.
+        4. A client that has seen the new primary writes to the OLD
+           primary: the write is REJECTED (epoch header demotes it,
+           503-not-primary), and the client's rotation lands the write on
+           the true primary — rejected, not lost.
+        5. The partition heals: the prober's demote call (with the new
+           primary's URL) makes the old node rejoin as a follower
+           automatically and mirror the new primary's state.
+        """
+        async def main():
+            # -- 1. HA pair ------------------------------------------------
+            pri = LocalPlatform(PlatformConfig(
+                journal_path=str(tmp_path / "pri.jsonl"), retry_delay=0.05))
+            pri_client = await serve(make_app(pri.store, lifecycle=pri))
+            pri_url = str(pri_client.make_url("")).rstrip("/")
+            # advertise_url is the HA-pair marker: it arms passive fencing
+            # on this primary (a solo primary ignores epoch headers).
+            pri.config.advertise_url = pri_url
+            await pri.start()
+
+            proxy_app, net, close_proxy = make_partition_proxy(pri_url)
+            proxy_client = await serve(proxy_app)
+
+            stb = LocalPlatform(PlatformConfig(
+                journal_path=str(tmp_path / "stb.jsonl"),
+                replicate_from=str(proxy_client.make_url("")),
+                failover_interval=0.05, failover_down_after=2,
+                retry_delay=0.05))
+            stb_client = await serve(make_app(stb.store, lifecycle=stb))
+            stb_url = str(stb_client.make_url("")).rstrip("/")
+            stb.config.advertise_url = stb_url
+            await stb.start()
+
+            mgr = HttpTaskManager([stb_url, pri_url], failover_delay=0.05)
+            try:
+                t_before = pri.store.upsert(APITask(
+                    endpoint="http://e/v1/landcover/classify",
+                    body=b"tile-before"))
+                assert await wait_for(
+                    lambda: t_before.task_id in {
+                        x.task_id for x in stb.store.unfinished_tasks()})
+
+                # -- 2+3. partition; standby promotes ----------------------
+                net["up"] = False
+                assert await wait_for(
+                    lambda: stb.store.role == "primary", timeout=15.0)
+                assert stb.store.epoch == 1
+                # The watchdog promotion released the replicator ref —
+                # a later fail-back demote must see `replicator is None`
+                # or it would silently skip the auto-rejoin.
+                assert stb.replicator is None
+                # The old primary is alive and still believes it is primary
+                # — the dangerous window.
+                assert pri.store.role == "primary"
+                assert pri.store.epoch == 0
+
+                # -- 4. fenced write ---------------------------------------
+                # The client reads from the new primary (learns epoch 1)…
+                status = await mgr.get_task_status(t_before.task_id)
+                assert status is not None
+                assert mgr.store_epoch == 1
+                # …then client-side routing flaps back to the old primary.
+                mgr.base_url = pri_url
+                created = await mgr.add_task(
+                    "http://e/v1/landcover/classify",
+                    b"tile-during-split")
+                new_id = created["TaskId"]
+                # The epoch header demoted the old primary on contact: the
+                # write was rejected there and rotation landed it on the
+                # true primary.
+                assert pri.store.role == "follower"
+                assert pri.store.epoch == 1
+                assert stb.store.get(new_id).task_id == new_id
+                with pytest.raises(KeyError):
+                    # not in the deposed node's (stale) lineage
+                    pri.store.get(new_id)
+                # Direct writes to the deposed node now refuse loudly.
+                with pytest.raises(NotPrimaryError):
+                    pri.store.upsert(APITask(endpoint="http://e/v1/x",
+                                             body=b"doomed"))
+
+                # -- 5. heal; auto-rejoin ----------------------------------
+                net["up"] = True
+                assert await wait_for(
+                    lambda: pri.replicator is not None, timeout=15.0)
+                assert await wait_for(
+                    lambda: (new_id in {x.task_id
+                                        for x in pri.store.unfinished_tasks()}
+                             ), timeout=15.0)
+                assert pri.store.role == "follower"
+                # Full mirror of the new primary, fence intact.
+                assert (pri.store.get(new_id).to_dict()
+                        == stb.store.get(new_id).to_dict())
+                assert pri.store.epoch == 1
+            finally:
+                await mgr.close()
+                await stb.stop()
+                await pri.stop()
+                await close_proxy()
+                await proxy_client.close()
+                await stb_client.close()
+                await pri_client.close()
+
+        run(main())
+
+
+class TestPushTransportFailback:
+    def test_push_transport_rebuilds_after_demote_and_repromote(
+            self, tmp_path):
+        # PushTopic.aclose() is terminal — a demoted push-transport node
+        # must rebuild topic + webhook on re-promotion, or fail-back would
+        # crash the promotion with "push topic is closed".
+        async def main():
+            p = LocalPlatform(PlatformConfig(
+                transport="push", retry_delay=0.05,
+                journal_path=str(tmp_path / "p.jsonl")))
+            await p.start()
+            try:
+                await p.demote_now(epoch=1)
+                assert p.store.role == "follower"
+                assert p.topic is None and not p._transport_running
+                await p.promote_now()
+                assert p.store.role == "primary"
+                assert p.store.epoch == 2
+                assert p.topic is not None and p._transport_running
+                # The store's publish hook points at the NEW topic: an
+                # upsert publishes without raising.
+                t = p.store.upsert(APITask(endpoint="http://e/v1/x",
+                                           body=b"b"))
+                assert p.store.get(t.task_id).canonical_status == "created"
+            finally:
+                await p.stop()
+
+        run(main())
+
+
+class TestSoloPrimaryImmunity:
+    def test_forged_epoch_header_cannot_fence_a_solo_primary(self, tmp_path):
+        # A primary with no configured HA peer has no standby to take
+        # over: a forged/stale X-Store-Epoch header must NOT demote it
+        # (that would be a total write outage from one bogus request).
+        async def main():
+            solo = LocalPlatform(PlatformConfig(
+                journal_path=str(tmp_path / "solo.jsonl"), retry_delay=0.05))
+            client = await serve(make_app(solo.store, lifecycle=solo))
+            await solo.start()  # no advertise_url → passive fencing off
+            try:
+                resp = await client.post(
+                    "/v1/taskstore/upsert",
+                    json={"Endpoint": "http://e/v1/x", "Body": "b"},
+                    headers={"X-Store-Epoch": "999"})
+                assert resp.status == 200, await resp.text()
+                assert solo.store.role == "primary"
+                assert solo.store.epoch == 0
+            finally:
+                await solo.stop()
+                await client.close()
+
+        run(main())
+
+
+class TestFencingProber:
+    def test_prober_demotes_stale_primary_without_client_traffic(
+            self, tmp_path):
+        # Passive fencing needs a client to carry the epoch; the prober
+        # closes the window deterministically even on an idle system.
+        async def main():
+            stale = FollowerTaskStore(str(tmp_path / "stale.jsonl"),
+                                      start_as_primary=True)
+            stale_client = await serve(make_app(stale))
+
+            new = FollowerTaskStore(str(tmp_path / "new.jsonl"))
+            new.promote()  # epoch 1
+            prober = FencingProber(new, str(stale_client.make_url("")),
+                                   interval=0.05)
+            prober.start()
+            try:
+                assert await wait_for(lambda: prober.fenced.is_set())
+                assert stale.role == "follower"
+                assert stale.epoch == 1
+            finally:
+                await prober.aclose()
+                await stale_client.close()
+                stale.close()
+                new.close()
+
+        run(main())

@@ -284,6 +284,45 @@ class TestBinaryContentMode:
 
         run(main())
 
+    def test_non_latin1_subject_delivers(self):
+        # ADVICE r4: aiohttp refuses non-latin-1 header values, so an
+        # unencoded subject (endpoint + query with non-ASCII) would fail
+        # every binary-mode delivery until the TTL dead-letters the task.
+        # The subject header is percent-encoded; the round trip is exact —
+        # including for subjects that already contain '%'.
+        async def main():
+            received = {}
+
+            async def backend(request):
+                received["body"] = await request.read()
+                received["query"] = request.query_string
+                return web.Response(status=200)
+
+            app = web.Application()
+            app.router.add_post("/v1/m/score", backend)
+            be_client = await serve(app)
+            store = InMemoryTaskStore()
+            webhook = WebhookDispatcher(LocalTaskManager(store))
+            webhook.add_route("/v1/m/score",
+                              str(be_client.make_url("/v1/m/score")))
+            wh_client = await serve(webhook.app)
+            topic = PushTopic(retry_delay=0.02, ttl_seconds=2.0)
+            topic.bind_loop(asyncio.get_event_loop())
+            dead = []
+            topic.set_dead_letter_handler(lambda ev: dead.append(ev.id))
+            await topic.subscribe("wh", str(wh_client.make_url("/api/events")))
+            from ai4e_tpu.taskstore import APITask
+            task = store.upsert(APITask(
+                endpoint="http://edge/v1/m/score?región=añejo&pct=5%25",
+                body=b"payload"))
+            topic.publish(task)
+            await topic.drain(timeout=5.0)
+            assert received.get("body") == b"payload", (
+                "non-latin-1 subject never delivered")
+            assert dead == []
+
+        run(main())
+
     def test_structured_envelope_still_accepted(self):
         # External publishers (and the reference's Event Grid shape) POST
         # structured JSON envelopes; the webhook keeps accepting them.
