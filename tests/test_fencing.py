@@ -380,10 +380,11 @@ class TestPartitionedPrimaryIsFenced:
                 assert await wait_for(
                     lambda: stb.store.role == "primary", timeout=15.0)
                 assert stb.store.epoch == 1
-                # The watchdog promotion released the replicator ref —
+                # The watchdog promotion releases the replicator ref (a
+                # beat after the role flip — _on_promoted runs async) —
                 # a later fail-back demote must see `replicator is None`
                 # or it would silently skip the auto-rejoin.
-                assert stb.replicator is None
+                assert await wait_for(lambda: stb.replicator is None)
                 # The old primary is alive and still believes it is primary
                 # — the dangerous window.
                 assert pri.store.role == "primary"
