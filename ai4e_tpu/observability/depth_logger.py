@@ -41,6 +41,13 @@ class DepthLogger:
         self.process_interval = process_interval
         self._depth = self.metrics.gauge(
             "ai4e_task_depth", "Tasks per endpoint per status")
+        # HA visibility (stores with a replica role — FollowerTaskStore):
+        # alert on role flips and on a fencing epoch that disagrees across
+        # the pair (split-brain would show as two role=1 or epoch skew).
+        self._role = self.metrics.gauge(
+            "ai4e_store_role", "1 when this replica is the primary")
+        self._epoch = self.metrics.gauge(
+            "ai4e_store_epoch", "Fencing epoch of this store's lineage")
         self._tasks: list[asyncio.Task] = []
 
     # -- sampling ----------------------------------------------------------
@@ -52,6 +59,10 @@ class DepthLogger:
             n = by_status.get(TaskStatus.CREATED, 0)
             self._depth.set(float(n), endpoint=path, status=TaskStatus.CREATED)
             out[path] = n
+        role = getattr(self.store, "role", None)
+        if role is not None:
+            self._role.set(1.0 if role == "primary" else 0.0)
+            self._epoch.set(float(getattr(self.store, "epoch", 0)))
         return out
 
     def sample_process_depths(self) -> dict[str, dict[str, int]]:

@@ -283,7 +283,8 @@ class LocalPlatform:
                                                 JournalReplicator)
             self.replicator = JournalReplicator(
                 self.store, self.config.replicate_from,
-                api_key=self.config.replicate_api_key)
+                api_key=self.config.replicate_api_key,
+                metrics=self.metrics)
             self.replicator.start()
             self.watchdog = FailoverWatchdog(
                 self.replicator,
@@ -437,7 +438,8 @@ class LocalPlatform:
             self.config.replicate_from = primary_url
             self.replicator = JournalReplicator(
                 self.store, primary_url,
-                api_key=self.config.replicate_api_key)
+                api_key=self.config.replicate_api_key,
+                metrics=self.metrics)
             self.replicator.start()
             self.watchdog = FailoverWatchdog(
                 self.replicator,

@@ -68,11 +68,20 @@ class JournalReplicator:
 
     def __init__(self, store: FollowerTaskStore, primary_url: str,
                  poll_wait: float = 10.0, api_key: str | None = None,
-                 chunk_limit: int = 4 * 1024 * 1024):
+                 chunk_limit: int = 4 * 1024 * 1024, metrics=None):
         self.store = store
         self.primary_url = primary_url.rstrip("/")
         self.poll_wait = poll_wait
         self.chunk_limit = chunk_limit
+        if metrics is None:
+            from ..metrics import DEFAULT_REGISTRY
+            metrics = DEFAULT_REGISTRY
+        self._offset_gauge = metrics.gauge(
+            "ai4e_replication_offset_bytes",
+            "Journal bytes this follower has absorbed")
+        self._lag_gauge = metrics.gauge(
+            "ai4e_replication_lag_bytes",
+            "Primary journal bytes not yet absorbed (0 = caught up)")
         headers = ({"Ocp-Apim-Subscription-Key": api_key}
                    if api_key else None)
         self._sessions = SessionHolder(headers=headers)
@@ -163,6 +172,8 @@ class JournalReplicator:
                     # Caught up to the primary's journal as of this poll —
                     # only now is this follower a safe promotion target.
                     self.synced.set()
+                self._offset_gauge.set(float(self.offset))
+                self._lag_gauge.set(float(max(0, size - self.offset)))
                 backoff = 0.5
             except asyncio.CancelledError:
                 raise

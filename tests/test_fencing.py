@@ -495,6 +495,50 @@ class TestSoloPrimaryImmunity:
         run(main())
 
 
+class TestHaObservability:
+    def test_role_epoch_and_replication_lag_gauges(self, tmp_path):
+        # The HA machinery is alertable: role/epoch ride the depth
+        # logger's 30s tick, replication offset/lag ride the replicator's
+        # poll loop. Split-brain shows as two role=1 or epoch skew.
+        async def main():
+            from ai4e_tpu.metrics import MetricsRegistry
+            from ai4e_tpu.observability import DepthLogger
+
+            primary = FollowerTaskStore(str(tmp_path / "pri.jsonl"),
+                                        start_as_primary=True)
+            primary.upsert(APITask(endpoint="http://e/v1/x", body=b"b"))
+            pri_client = await serve(make_app(primary))
+            follower = FollowerTaskStore(str(tmp_path / "stb.jsonl"))
+            metrics = MetricsRegistry()
+            repl = JournalReplicator(follower,
+                                     str(pri_client.make_url("")),
+                                     poll_wait=0.1, metrics=metrics)
+            repl.start()
+            try:
+                assert await wait_for(lambda: repl.synced.is_set())
+                assert metrics.gauge(
+                    "ai4e_replication_offset_bytes").value() > 0
+                assert metrics.gauge(
+                    "ai4e_replication_lag_bytes").value() == 0.0
+                logger = DepthLogger(follower, metrics=metrics)
+                logger.sample_queue_depth()
+                assert metrics.gauge("ai4e_store_role").value() == 0.0
+                follower2 = DepthLogger(primary, metrics=metrics)
+                follower2.sample_queue_depth()
+                assert metrics.gauge("ai4e_store_role").value() == 1.0
+                primary.demote(epoch=7)
+                follower2.sample_queue_depth()
+                assert metrics.gauge("ai4e_store_role").value() == 0.0
+                assert metrics.gauge("ai4e_store_epoch").value() == 7.0
+            finally:
+                await repl.aclose()
+                await pri_client.close()
+                primary.close()
+                follower.close()
+
+        run(main())
+
+
 class TestFencingProber:
     def test_prober_demotes_stale_primary_without_client_traffic(
             self, tmp_path):
