@@ -8,6 +8,15 @@ across devices, here applied across blocks within one device), so the score
 matrix never exists: HBM traffic drops from O(S²) to O(S·D) and the two
 matmuls land on the MXU back-to-back.
 
+Differentiable (r5): a ``jax.custom_vjp`` with pallas backward kernels —
+the FlashAttention-2 recurrence. The forward saves only O and the per-row
+logsumexp (lane-replicated, the layout the TPU vector unit wants); the
+backward recomputes P = exp(S - lse) blockwise, so training never
+materialises the score matrix either. Before this, long-context TRAINING
+fell back to full attention (``train/make_checkpoints.py`` trained seq-4096
+against materialised 4096² scores); now the training plane matches the
+serving plane.
+
 Role in the stack (``models/seqformer.py`` / ``parallel/ring_attention.py``):
 
 - single-device long-context serving: ``attention_for(..., "flash")`` (the
@@ -37,14 +46,47 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30  # large-but-finite: avoids (-inf) - (-inf) NaNs in the kernel
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *,
-                  n_k_blocks: int, causal: bool, scale: float):
+# The logsumexp residual rides lane-replicated (the official TPU flash
+# kernel's layout): a (block_q,) per-row scalar broadcast across the
+# 128-lane axis, so stores/loads are plain vector ops, never a transpose.
+LANES = 128
+
+
+def _mask_causal(s, iq, ik, block_q: int, block_k: int):
+    """Set above-diagonal scores to NEG_INF for the (iq, ik) block pair —
+    the one mask construction shared by the forward and both backward
+    kernels."""
+    q_pos = (iq * block_q
+             + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0))
+    k_pos = (ik * block_k
+             + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+def _block_relevant(iq, ik, block_q: int, block_k: int):
+    """False iff the (iq, ik) block pair lies strictly above the causal
+    diagonal (its bottom-left corner is masked) — such blocks contribute
+    nothing and are skipped, halving causal work."""
+    return (iq + 1) * block_q - 1 >= ik * block_k
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, out_ref, *rest,
+                  n_k_blocks: int, causal: bool, scale: float,
+                  save_lse: bool):
     # q_ref/out_ref: (1, block_q, D); k_ref/v_ref: (1, block_k, D);
     # scratch: acc (block_q, D), m/l (block_q, 1) — carried across the
-    # sequential k-axis grid steps.
+    # sequential k-axis grid steps. With ``save_lse`` an extra
+    # (1, block_q, LANES) output carries m + log(l) for the backward.
+    if save_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        (acc_ref, m_ref, l_ref), lse_ref = rest, None
     block_q, d = q_ref.shape[1], q_ref.shape[2]
     block_k = k_ref.shape[1]
-    ik = pl.program_id(2)
+    # program_id must be read at the kernel's top level — inside a
+    # pl.when branch it escapes the pallas trace (interpret mode lowers
+    # the branch as plain XLA, where the primitive has no rule).
+    iq, ik = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ik == 0)
     def _init():
@@ -52,32 +94,133 @@ def _flash_kernel(q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref, *,
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    kb = k_ref[0].astype(jnp.float32)
-    vb = v_ref[0].astype(jnp.float32)
-    scores = jax.lax.dot_general(
-        q, kb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)  # (bq, bk) on the MXU
-    if causal:
-        q_pos = (pl.program_id(1) * block_q
-                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0))
-        k_pos = (ik * block_k
-                 + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
-        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (bq, bk) on the MXU
+        if causal:
+            scores = _mask_causal(scores, iq, ik, block_q, block_k)
 
-    m_prev, l_prev = m_ref[...], l_ref[...]
-    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
-    p = jnp.exp(scores - m_new)
-    corr = jnp.exp(m_prev - m_new)
-    l_ref[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-    m_ref[...] = m_new
-    acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-        p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing — skip
+        # their matmuls entirely (half the grid at S_q == S_k).
+        pl.when(_block_relevant(iq, ik, block_q, block_k))(_accumulate)
+    else:
+        _accumulate()
 
     @pl.when(ik == n_k_blocks - 1)
     def _finish():
         out_ref[0] = (acc_ref[...]
                       / jnp.maximum(l_ref[...], 1e-30)).astype(out_ref.dtype)
+        if lse_ref is not None:
+            lse = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
+            lse_ref[0] = jnp.broadcast_to(lse, (block_q, LANES))
+
+
+def _bwd_recompute(q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref,
+                   iq, ik, causal: bool, scale: float):
+    """Shared backward recompute — the FlashAttention-2 step both backward
+    kernels start from: P = exp(S − lse) rebuilt blockwise (exact softmax
+    probabilities; masked → 0) and dS = P ⊙ (dO·Vᵀ − Δ). Returns
+    ``(p, ds, q, do, kb)`` — dK/dV contract against q/do, dQ against kb."""
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    kb = k_ref[0].astype(jnp.float32)
+    vb = v_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, :1]  # (block_q, 1) from the lane-replicated block
+    di = di_ref[0][:, :1]
+
+    s = scale * jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)  # (bq, bk)
+    if causal:
+        s = _mask_causal(s, iq, ik, block_q, block_k)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    ds = p * (dp - di)
+    return p, ds, q, do, kb
+
+
+def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          n_q_blocks: int, causal: bool, scale: float):
+    """dK/dV: grid (B·H, S_k/block_k, S_q/block_q) — for a fixed k-block,
+    accumulate contributions from every q-block in VMEM scratch (the q axis
+    is the fast, sequential one), writing dk/dv on the last q step.
+    P is recomputed from the saved logsumexp — no score matrix in HBM."""
+    block_q, block_k = q_ref.shape[1], k_ref.shape[1]
+    ik, iq = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _accumulate():
+        p, ds, q, do, _ = _bwd_recompute(q_ref, do_ref, lse_ref, di_ref,
+                                         k_ref, v_ref, iq, ik, causal, scale)
+        # dV += Pᵀ·dO ; dK += scale·dSᵀ·Q
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[...] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_block_relevant(iq, ik, block_q, block_k))(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(iq == n_q_blocks - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref,
+                         dq_ref, dq_acc, *,
+                         n_k_blocks: int, causal: bool, scale: float):
+    """dQ: grid (B·H, S_q/block_q, S_k/block_k) — the forward's own grid
+    shape; accumulate over k-blocks, write dq on the last k step."""
+    block_q, block_k = q_ref.shape[1], k_ref.shape[1]
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def _accumulate():
+        _, ds, _, _, kb = _bwd_recompute(q_ref, do_ref, lse_ref, di_ref,
+                                         k_ref, v_ref, iq, ik, causal, scale)
+        dq_acc[...] += scale * jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_block_relevant(iq, ik, block_q, block_k))(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(ik == n_k_blocks - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _dividing_block(s: int, target: int) -> int:
@@ -102,10 +245,115 @@ def default_blocks(d: int) -> tuple[int, int]:
     return max(128, 512 // scale), max(128, 1024 // scale)
 
 
+def _forward_call(q3, k3, v3, causal: bool, block_q: int, block_k: int,
+                  interpret: bool, save_lse: bool):
+    """pallas_call for the forward on collapsed (B·H, S, D) operands;
+    returns ``out`` or ``(out, lse)`` (lse lane-replicated f32)."""
+    bh, s_q, d = q3.shape
+    s_k = k3.shape[1]
+    n_k_blocks = s_k // block_k
+    out_shape = jax.ShapeDtypeStruct((bh, s_q, d), q3.dtype)
+    out_spec = pl.BlockSpec((1, block_q, d), lambda b, iq, ik: (b, iq, 0))
+    out_shapes, out_specs = out_shape, out_spec
+    if save_lse:
+        out_shapes = (out_shape,
+                      jax.ShapeDtypeStruct((bh, s_q, LANES), jnp.float32))
+        out_specs = (out_spec,
+                     pl.BlockSpec((1, block_q, LANES),
+                                  lambda b, iq, ik: (b, iq, 0)))
+    return pl.pallas_call(
+        partial(_flash_kernel, n_k_blocks=n_k_blocks, causal=causal,
+                scale=d ** -0.5, save_lse=save_lse),
+        grid=(bh, s_q // block_q, n_k_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, iq, ik: (b, ik, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash3(q3, k3, v3, causal, block_q, block_k, interpret):
+    return _forward_call(q3, k3, v3, causal, block_q, block_k, interpret,
+                         save_lse=False)
+
+
+def _flash3_fwd(q3, k3, v3, causal, block_q, block_k, interpret):
+    out, lse = _forward_call(q3, k3, v3, causal, block_q, block_k, interpret,
+                             save_lse=True)
+    # Store one f32 per row (the lanes are replicas).
+    return out, (q3, k3, v3, out, lse[..., 0])
+
+
+def _flash3_bwd(causal, block_q, block_k, interpret, residuals, do):
+    q3, k3, v3, out, lse = residuals
+    bh, s_q, d = q3.shape
+    s_k = k3.shape[1]
+    scale = d ** -0.5
+    n_q_blocks, n_k_blocks = s_q // block_q, s_k // block_k
+    # Δ = rowsum(dO ⊙ O) — the softmax-jacobian correction, O(S·D)
+    # elementwise; computed here (XLA) and fed lane-replicated.
+    di = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    lse_r = jnp.broadcast_to(lse[..., None], (bh, s_q, LANES))
+    di_r = jnp.broadcast_to(di[..., None], (bh, s_q, LANES))
+
+    q_spec_by_q = pl.BlockSpec((1, block_q, d), lambda b, ik, iq: (b, iq, 0))
+    lm_spec_by_q = pl.BlockSpec((1, block_q, LANES),
+                                lambda b, ik, iq: (b, iq, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, ik, iq: (b, ik, 0))
+    dk3, dv3 = pl.pallas_call(
+        partial(_flash_bwd_dkv_kernel, n_q_blocks=n_q_blocks, causal=causal,
+                scale=scale),
+        grid=(bh, n_k_blocks, n_q_blocks),
+        in_specs=[q_spec_by_q, q_spec_by_q, lm_spec_by_q, lm_spec_by_q,
+                  kv_spec, kv_spec],
+        out_specs=(kv_spec, kv_spec),
+        out_shape=(jax.ShapeDtypeStruct((bh, s_k, d), k3.dtype),
+                   jax.ShapeDtypeStruct((bh, s_k, d), v3.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, do, lse_r, di_r, k3, v3)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, iq, ik: (b, iq, 0))
+    lm_spec = pl.BlockSpec((1, block_q, LANES),
+                           lambda b, iq, ik: (b, iq, 0))
+    kv_spec_by_k = pl.BlockSpec((1, block_k, d),
+                                lambda b, iq, ik: (b, ik, 0))
+    dq3 = pl.pallas_call(
+        partial(_flash_bwd_dq_kernel, n_k_blocks=n_k_blocks, causal=causal,
+                scale=scale),
+        grid=(bh, n_q_blocks, n_k_blocks),
+        in_specs=[q_spec, q_spec, lm_spec, lm_spec,
+                  kv_spec_by_k, kv_spec_by_k],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, do, lse_r, di_r, k3, v3)
+    return dq3, dk3, dv3
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
 def flash_attention(q, k, v, causal: bool = False, block_q: int | None = None,
                     block_k: int | None = None, interpret: bool | None = None,
                     mesh=None, batch_axes=None):
     """Fused attention: q (B, H, S_q, D), k/v (B, H, S_k, D) → (B, H, S_q, D).
+
+    Differentiable: ``jax.grad`` through this op runs the pallas backward
+    kernels (FlashAttention-2 recurrence — P recomputed from the saved
+    logsumexp, no S×S matrix in either pass).
 
     Block sizes round DOWN to divisors of the sequence lengths, so any length
     works (prime lengths degrade toward block 1 — pad such sequences).
@@ -126,30 +374,8 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int | None = None,
     dq, dk = default_blocks(d)
     block_q = _dividing_block(s_q, block_q if block_q is not None else dq)
     block_k = _dividing_block(s_k, block_k if block_k is not None else dk)
-    n_k_blocks = s_k // block_k
 
-    def run(q3, k3, v3):
-        # Collapsed (B·H, S, D) — one grid row per (batch, head).
-        return pl.pallas_call(
-            partial(_flash_kernel, n_k_blocks=n_k_blocks, causal=causal,
-                    scale=d ** -0.5),
-            grid=(q3.shape[0], s_q // block_q, n_k_blocks),
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-                pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
-                pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
-            ],
-            out_specs=pl.BlockSpec((1, block_q, d),
-                                   lambda bh, iq, ik: (bh, iq, 0)),
-            out_shape=jax.ShapeDtypeStruct((q3.shape[0], s_q, d), q3.dtype),
-            scratch_shapes=[
-                pltpu.VMEM((block_q, d), jnp.float32),
-                pltpu.VMEM((block_q, 1), jnp.float32),
-                pltpu.VMEM((block_q, 1), jnp.float32),
-            ],
-            interpret=interpret,
-        )(q3, k3, v3)
-
-    out = run(q.reshape(b * h, s_q, d), k.reshape(b * h, s_k, d),
-              v.reshape(b * h, s_k, d))
+    out = _flash3(q.reshape(b * h, s_q, d), k.reshape(b * h, s_k, d),
+                  v.reshape(b * h, s_k, d), causal, block_q, block_k,
+                  interpret)
     return out.reshape(b, h, s_q, d)

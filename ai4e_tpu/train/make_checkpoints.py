@@ -499,10 +499,13 @@ def train_longcontext(steps: int = 200, seq_len: int = 4096, batch: int = 8,
     unlike the fully-convolutional families the trained shape IS the
     serving shape. Defaults = the bench/serving config (head_dim 128).
 
-    ``attention`` is the TRAINING strategy — "full" because the flash
-    Pallas kernel defines no autodiff rule; the strategy carries no params,
-    so the tree is identical and ``serve_attention`` (recorded in the
-    manifest kwargs) is what inference runs."""
+    ``attention`` is the TRAINING strategy. The flash kernel is
+    differentiable (r5 custom_vjp — pass ``attention="flash"`` to train
+    without materialising S×S scores, the right choice on TPU); the CPU
+    default stays "full" because the pallas interpreter is slower than
+    XLA's materialised attention at CI geometry. The strategy carries no
+    params, so the tree is identical and ``serve_attention`` (recorded in
+    the manifest kwargs) is what inference runs."""
     import jax
 
     from ..models.seqformer import create_seqformer
@@ -545,8 +548,9 @@ def train_moe(steps: int = 200, seq_len: int = 1024, batch: int = 16,
     dispatch it will serve** (GShard-style static capacity): the parameter
     tree is dispatch-independent, but overflow drops make capacity the
     stricter eval, so the gate certifies the weights as actually served.
-    Attention trains "full" (the flash kernel has no autodiff rule) and
-    serves ``serve_attention`` — no params either way."""
+    Attention trains "full" (differentiable flash exists since r5, but
+    the CPU interpreter is slower than XLA full attention at this
+    geometry) and serves ``serve_attention`` — no params either way."""
     from ..models.moe import create_moe
     from .step import cross_entropy_loss
 
@@ -670,10 +674,11 @@ def main(argv=None) -> None:
 
     if (not args.fast and args.platform == "cpu"
             and "longcontext" in args.only):
-        # Full-geometry longcontext trains seq-4096 FULL attention (the
-        # flash kernel has no autodiff rule) — minutes on the TPU
-        # (--platform ''), hours of materialized 4096x4096 scores on one
-        # CPU core. Warn rather than refuse: the run is correct, just slow.
+        # Full-geometry longcontext on CPU trains seq-4096 FULL
+        # attention — hours of materialized 4096x4096 scores on one core.
+        # Warn rather than refuse: the run is correct, just slow. On the
+        # TPU (--platform '') pass attention="flash" via the recipe to
+        # train with the differentiable pallas kernel instead.
         log.warning(
             "full longcontext training on jax_platforms=cpu materializes "
             "seq-4096 attention scores and can take hours; use "
