@@ -58,6 +58,17 @@ while true; do
                     rm -f "$OUT/flash_tuner.json.tmp"
                 fi
             fi
+            if [ ! -s "$OUT/train_step.json" ]; then
+                # Train-step MFU + flash-vs-full before/after (r5). Like
+                # the tuner: JSONL by design, keep partial output.
+                timeout 900 python scripts/bench_train_step.py \
+                    > "$OUT/train_step.json.tmp" 2>"$OUT/train_step.err"
+                if [ -s "$OUT/train_step.json.tmp" ]; then
+                    mv "$OUT/train_step.json.tmp" "$OUT/train_step.json"
+                else
+                    rm -f "$OUT/train_step.json.tmp"
+                fi
+            fi
             if [ ! -s "$OUT/landcover_donate.json" ]; then
                 TMP="$OUT/.landcover_donate.tmp"
                 timeout 600 python bench.py --model landcover --wire yuv420 \

@@ -1,0 +1,127 @@
+"""``bench.py --wire auto`` resolution (the default-args wire policy).
+
+The driver's round-end artifact of record is ``python bench.py`` with
+default arguments; ``--wire auto`` makes that run ride the fastest wire the
+archive holds TPU-certified evidence for (e.g. the dct wire, once a tunnel
+window captures ``landcover_dct`` faster than ``landcover_yuv``), while
+staying on the r3-certified yuv420 wire when no such evidence exists.
+Evidence rules pinned here:
+
+- only ``device: tpu*`` captures certify (a CPU fallback JSON must never
+  decide the production wire);
+- rounds never mix (tunnel bandwidth shifts between rounds, so only
+  same-window captures are comparable) — the newest round whose certified
+  cells include the yuv420 fallback cell decides, so every decision is an
+  intra-round comparison;
+- the decision is recorded in the bench JSON (``wire_auto`` provenance).
+"""
+
+import importlib.util
+import json
+from pathlib import Path
+
+_spec = importlib.util.spec_from_file_location(
+    "bench", Path(__file__).resolve().parent.parent / "bench.py")
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def _write(root: Path, rdir: str, cell: str, device: str, value):
+    d = root / rdir
+    d.mkdir(parents=True, exist_ok=True)
+    (d / f"{cell}.json").write_text(json.dumps(
+        {"metric": "m", "value": value, "unit": "req/s", "device": device}))
+
+
+class TestResolveAutoWire:
+    def test_empty_archive_falls_back_to_yuv420(self, tmp_path):
+        wire, prov = bench.resolve_auto_wire("landcover", str(tmp_path))
+        assert wire == "yuv420"
+        assert prov["requested"] == "auto"
+        assert prov["decided_by"] == "default"
+
+    def test_certified_dct_beats_yuv(self, tmp_path):
+        _write(tmp_path, "r5-tpu", "landcover_yuv", "tpu:v5e", 170.8)
+        _write(tmp_path, "r5-tpu", "landcover_dct", "tpu:v5e", 500.0)
+        wire, prov = bench.resolve_auto_wire("landcover", str(tmp_path))
+        assert wire == "dct"
+        assert prov["decided_by"].endswith("landcover_dct.json")
+        assert prov["value"] == 500.0
+
+    def test_slower_dct_keeps_yuv(self, tmp_path):
+        _write(tmp_path, "r5-tpu", "landcover_yuv", "tpu:v5e", 170.8)
+        _write(tmp_path, "r5-tpu", "landcover_dct", "tpu:v5e", 120.0)
+        wire, _ = bench.resolve_auto_wire("landcover", str(tmp_path))
+        assert wire == "yuv420"
+
+    def test_cpu_capture_never_certifies(self, tmp_path):
+        _write(tmp_path, "r5-tpu", "landcover_dct", "cpu:cpux1", 999.0)
+        wire, prov = bench.resolve_auto_wire("landcover", str(tmp_path))
+        assert wire == "yuv420"
+        assert prov["decided_by"] == "default"
+
+    def test_rounds_do_not_mix(self, tmp_path):
+        # r4 certified a blazing dct cell, but r5 (newer) has evidence of
+        # its own — the newer round's regime decides, alone.
+        _write(tmp_path, "r4-tpu", "landcover_dct", "tpu:v5e", 900.0)
+        _write(tmp_path, "r5-tpu", "landcover_yuv", "tpu:v5e", 100.0)
+        wire, prov = bench.resolve_auto_wire("landcover", str(tmp_path))
+        assert wire == "yuv420"
+        assert "r5-tpu" in prov["decided_by"]
+
+    def test_older_round_decides_when_newer_is_empty(self, tmp_path):
+        _write(tmp_path, "r3-tpu", "landcover_yuv", "tpu:v5e", 170.8)
+        _write(tmp_path, "r3-tpu", "landcover", "tpu:v5e", 103.8)
+        (tmp_path / "r5-tpu").mkdir()  # probe log only, no captures
+        wire, prov = bench.resolve_auto_wire("landcover", str(tmp_path))
+        assert wire == "yuv420"
+        assert "r3-tpu" in prov["decided_by"]
+
+    def test_round_ordering_is_numeric(self, tmp_path):
+        _write(tmp_path, "r9-tpu", "species_yuv", "tpu:v5e", 100.0)
+        _write(tmp_path, "r10-tpu", "species_yuv", "tpu:v5e", 40.0)
+        _write(tmp_path, "r10-tpu", "species_dct", "tpu:v5e", 50.0)
+        wire, prov = bench.resolve_auto_wire("species", str(tmp_path))
+        assert wire == "dct"  # r10 > r9 despite lexicographic order
+        assert "r10-tpu" in prov["decided_by"]
+
+    def test_partial_window_cannot_promote_dct_alone(self, tmp_path):
+        # The matrix runs species_dct before species_yuv; a window dying
+        # between them leaves a round with dct evidence but no opponent.
+        # Such a round must neither promote dct nor shadow r3's complete
+        # comparison.
+        _write(tmp_path, "r5-tpu", "species_dct", "tpu:v5e", 999.0)
+        _write(tmp_path, "r3-tpu", "species_yuv", "tpu:v5e", 334.4)
+        _write(tmp_path, "r3-tpu", "species", "tpu:v5e", 240.9)
+        wire, prov = bench.resolve_auto_wire("species", str(tmp_path))
+        assert wire == "yuv420"
+        assert "r3-tpu" in prov["decided_by"]
+
+    def test_invalid_json_ignored(self, tmp_path):
+        d = tmp_path / "r5-tpu"
+        d.mkdir()
+        (d / "landcover_dct.json").write_text("{not json")
+        _write(tmp_path, "r5-tpu", "landcover_yuv", "tpu:v5e", 170.8)
+        wire, _ = bench.resolve_auto_wire("landcover", str(tmp_path))
+        assert wire == "yuv420"
+
+    def test_models_without_cells_pin_yuv420(self, tmp_path):
+        for model in ("mixed", "echo", "longcontext"):
+            wire, prov = bench.resolve_auto_wire(model, str(tmp_path))
+            assert wire == "yuv420"
+            assert prov["decided_by"] == "default"
+
+    def test_real_archive_resolves_today(self):
+        # Against the committed archive: r5 has no captures yet and r3
+        # certified landcover_yuv at 170.79 — auto must stay on yuv420
+        # until a window certifies something faster.
+        wire, prov = bench.resolve_auto_wire("landcover")
+        assert wire in ("yuv420", "dct")
+        if wire == "yuv420" and prov["decided_by"] != "default":
+            assert "landcover_yuv.json" in prov["decided_by"]
+
+    def test_megadetector_cells_use_matrix_names(self, tmp_path):
+        _write(tmp_path, "r5-tpu", "megadet_dct", "tpu:v5e", 80.0)
+        _write(tmp_path, "r5-tpu", "megadet_yuv", "tpu:v5e", 60.0)
+        wire, _ = bench.resolve_auto_wire("megadetector", str(tmp_path))
+        assert wire == "dct"
