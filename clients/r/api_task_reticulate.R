@@ -1,0 +1,54 @@
+# Reticulate task-manager shim — R model services that prefer to ride the
+# Python client instead of the native httr one (api_task.R).
+#
+# Reference parity: Containers/base-r/task_management/api_task.R:1-28 is a
+# thin reticulate wrapper over the reference's Python task manager; this is
+# the same idea over ai4e_tpu.service.sync_client.SyncTaskManager (blocking,
+# stdlib-only — no event loop to bridge, which is exactly why the sync
+# client is the reticulate target instead of the aiohttp HttpTaskManager).
+#
+# Prefer the native client (api_task.R) when you don't already embed Python:
+# it has no reticulate/ai4e_tpu install requirement. This shim exists for
+# services that call Python models via reticulate anyway and want ONE task
+# client, and it closes the reference's reticulate slot.
+#
+# Usage:
+#   source("api_task_reticulate.R")
+#   tm <- ReticulateTaskManager(Sys.getenv("AI4E_GATEWAY_TASKSTORE_UPSERT_URI",
+#                                          "http://taskstore:8090"))
+#   status <- tm$AddTask(endpoint = "/v1/myorg/myapi", body = raw_payload)
+#   tm$UpdateTaskStatus(status$TaskId, "running - 10% complete")
+#   tm$CompleteTask(status$TaskId, "completed")
+#
+# NOTE: this environment has no R toolchain; the shim is validated by
+# tests/test_r_client_contract.py::TestReticulateShim, which asserts every
+# Python symbol referenced below exists with the argument names used here.
+
+library(reticulate)
+
+ReticulateTaskManager <- function(base_url, timeout = 60.0) {
+  sync_client <- reticulate::import("ai4e_tpu.service.sync_client")
+  py <- sync_client$SyncTaskManager(base_url, timeout = timeout)
+  list(
+    # The reference's six verbs, PascalCase like both its R clients.
+    AddTask = function(endpoint, body = raw(0), task_id = NULL,
+                       publish = FALSE)
+      py$add_task(endpoint, body = body, task_id = task_id,
+                  publish = publish),
+    UpdateTaskStatus = function(task_id, status)
+      py$update_task_status(task_id, status),
+    CompleteTask = function(task_id, status = "completed")
+      py$complete_task(task_id, status),
+    FailTask = function(task_id, status = "failed")
+      py$fail_task(task_id, status),
+    AddPipelineTask = function(task_id, next_endpoint, body = raw(0))
+      py$add_pipeline_task(task_id, next_endpoint, body = body),
+    GetTaskStatus = function(task_id)
+      py$get_task_status(task_id),
+    SetResult = function(task_id, result,
+                         content_type = "application/json")
+      py$set_result(task_id, result, content_type = content_type),
+    GetResult = function(task_id)
+      py$get_result(task_id)
+  )
+}

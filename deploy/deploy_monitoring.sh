@@ -29,6 +29,9 @@ kubectl apply -f https://raw.githubusercontent.com/GoogleCloudPlatform/k8s-stack
 # Trace sink: OTLP collector -> Cloud Trace (the reference's Istio mixer ->
 # App Insights adapter tier, configuration.yaml:9-84). Components already
 # export to it via AI4E_OBSERVABILITY_TRACE_OTLP_ENDPOINT in their charts.
+# The collector pod names a ServiceAccount from rbac.yaml — apply it first
+# (idempotent) so this script also works standalone.
+envsubst '${OPERATOR_GROUP}' < charts/rbac.yaml | kubectl apply -f -
 kubectl apply -f charts/otel-collector.yaml
 # Cloud Trace write access for the collector (workload identity / node SA).
 gcloud projects add-iam-policy-binding "${PROJECT_ID}" \

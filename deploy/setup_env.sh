@@ -56,6 +56,11 @@ export PUSH_MAX_ATTEMPTS=3             # same line (3 delivery attempts)
 export TASK_JOURNAL_PATH="/var/lib/ai4e/tasks.jsonl"   # durable task log (PV)
 export RATE_LIMIT_RPS="0"   # per-subscription-key throttle; 0 = unlimited
 
+# -- RBAC (reference Cluster/policy/rbac_config.yaml slot, modernized) -------
+# Group bound to the read-only ai4e-viewer Role (charts/rbac.yaml); platform
+# pods themselves run with API-token automount OFF.
+export OPERATOR_GROUP="ai4e-operators@example.org"
+
 # -- request reporter (reference deploy_request_reporter_function.sh) --------
 export DEPLOY_REPORTER=true
 export REPORTER_PORT=8085

@@ -369,3 +369,59 @@ class TestChartEnvNames:
                             f"{os.path.basename(chart)}: {name} is not a "
                             f"config field (valid: {sorted(valid)})")
         assert seen >= 10  # the charts really do carry the config tier
+
+
+class TestRbacWiring:
+    """charts/rbac.yaml (the reference's Cluster/policy/rbac_config.yaml
+    slot, modernized): every Deployment must run as a ServiceAccount the
+    RBAC chart defines, with the API token unmounted (no platform pod talks
+    to the Kubernetes API), and the operator role must stay read-only —
+    the exact inverse of the tiller-era cluster-admin binding."""
+
+    DEPLOYMENT_CHARTS = ("worker-tpu.yaml", "worker-cpu.yaml",
+                         "control-plane.yaml", "control-plane-standby.yaml",
+                         "reporter.yaml", "otel-collector.yaml")
+
+    def _rbac_docs(self):
+        return load_docs(os.path.join(CHARTS, "rbac.yaml"))
+
+    def test_every_deployment_pinned_to_a_defined_serviceaccount(self):
+        accounts = {d["metadata"]["name"] for d in self._rbac_docs()
+                    if d.get("kind") == "ServiceAccount"}
+        for chart in self.DEPLOYMENT_CHARTS:
+            # reporter.yaml carries ${VAR} placeholders in flow mappings
+            # (valid only after deploy-time envsubst) — substitute a
+            # numeric dummy so yaml parses, as envsubst will.
+            with open(os.path.join(CHARTS, chart)) as f:
+                text = re.sub(r"\$\{\w+\}", "8085", f.read())
+            deployments = [d for d in yaml.safe_load_all(text)
+                           if d and d.get("kind") == "Deployment"]
+            assert deployments, chart
+            for dep in deployments:
+                pod = dep["spec"]["template"]["spec"]
+                sa = pod.get("serviceAccountName")
+                assert sa in accounts, (
+                    f"{chart}: serviceAccountName {sa!r} not in rbac.yaml")
+                assert pod.get("automountServiceAccountToken") is False, (
+                    f"{chart}: pod still mounts the k8s API token")
+
+    def test_serviceaccounts_disable_token_automount(self):
+        for doc in self._rbac_docs():
+            if doc.get("kind") == "ServiceAccount":
+                assert doc.get("automountServiceAccountToken") is False, (
+                    doc["metadata"]["name"])
+
+    def test_viewer_role_is_read_only_and_bound(self):
+        docs = self._rbac_docs()
+        (role,) = [d for d in docs if d.get("kind") == "Role"]
+        for rule in role["rules"]:
+            assert set(rule["verbs"]) <= {"get", "list", "watch"}, rule
+        (binding,) = [d for d in docs if d.get("kind") == "RoleBinding"]
+        assert binding["roleRef"]["name"] == role["metadata"]["name"]
+        # The subject is deploy-time templated from setup_env.sh.
+        assert binding["subjects"][0]["name"] == "${OPERATOR_GROUP}"
+        setup = open(os.path.join(REPO, "deploy", "setup_env.sh")).read()
+        assert "OPERATOR_GROUP" in setup
+        infra = open(os.path.join(
+            REPO, "deploy", "deploy_infrastructure.sh")).read()
+        assert "rbac.yaml" in infra and "${OPERATOR_GROUP}" in infra
