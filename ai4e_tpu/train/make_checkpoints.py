@@ -488,10 +488,28 @@ def _eval_marker_task(apply_fn, params, seq_len: int, vocab_size: int,
     return hits / total
 
 
+def resolve_train_attention(attention: str) -> str:
+    """``train-auto`` → the right TRAINING attention for the backend: the
+    differentiable pallas flash kernel on TPU (no S×S score matrix in
+    either pass — the r5 custom_vjp; gradient parity pinned by
+    ``test_pallas_ops.py::test_gradients_match_reference``), materialised
+    "full" attention on CPU, where the pallas interpreter is slower than
+    XLA at CI geometry. Any explicit strategy passes through untouched.
+    The strategy carries no params, so the trained tree is identical
+    either way."""
+    if attention != "train-auto":
+        return attention
+    import jax
+
+    resolved = "flash" if jax.default_backend() == "tpu" else "full"
+    log.info("train-auto attention resolved to %r", resolved)
+    return resolved
+
+
 def train_longcontext(steps: int = 200, seq_len: int = 4096, batch: int = 8,
                       seed: int = 0, dim: int = 256, depth: int = 4,
                       heads: int = 2, vocab_size: int = 32768,
-                      num_classes: int = 16, attention: str = "full",
+                      num_classes: int = 16, attention: str = "train-auto",
                       serve_attention: str = "flash",
                       lr: float = 1e-3) -> dict:
     """SeqFormer (token mode) on the marker task at the SERVING geometry —
@@ -499,18 +517,19 @@ def train_longcontext(steps: int = 200, seq_len: int = 4096, batch: int = 8,
     unlike the fully-convolutional families the trained shape IS the
     serving shape. Defaults = the bench/serving config (head_dim 128).
 
-    ``attention`` is the TRAINING strategy. The flash kernel is
-    differentiable (r5 custom_vjp — pass ``attention="flash"`` to train
-    without materialising S×S scores, the right choice on TPU); the CPU
-    default stays "full" because the pallas interpreter is slower than
-    XLA's materialised attention at CI geometry. The strategy carries no
-    params, so the tree is identical and ``serve_attention`` (recorded in
-    the manifest kwargs) is what inference runs."""
-    import jax
-
+    ``attention`` is the TRAINING strategy; the default ``train-auto``
+    resolves per backend: the differentiable flash kernel (r5 custom_vjp —
+    no S×S score matrix in either pass, gradient parity pinned by
+    ``test_pallas_ops.py::test_gradients_match_reference``) on TPU, where a
+    window-opened fresh clone trains checkpoints on the chip; materialised
+    "full" attention on CPU, where the pallas interpreter is slower than
+    XLA at CI geometry. The strategy carries no params, so the tree is
+    identical and ``serve_attention`` (recorded in the manifest kwargs) is
+    what inference runs."""
     from ..models.seqformer import create_seqformer
     from .step import cross_entropy_loss
 
+    attention = resolve_train_attention(attention)
     model, params = create_seqformer(
         seq_len=seq_len, input_dim=64, dim=dim, depth=depth, heads=heads,
         num_classes=num_classes, attention=attention, vocab_size=vocab_size)
@@ -539,7 +558,7 @@ def train_moe(steps: int = 200, seq_len: int = 1024, batch: int = 16,
               seed: int = 0, dim: int = 128, depth: int = 2, heads: int = 1,
               num_experts: int = 8, vocab_size: int = 8192,
               num_classes: int = 16, capacity_factor: float = 1.25,
-              attention: str = "full", serve_attention: str = "flash",
+              attention: str = "train-auto", serve_attention: str = "flash",
               lr: float = 1e-3) -> dict:
     """MoE classifier (token mode) on the same marker task as longcontext.
 
@@ -548,11 +567,13 @@ def train_moe(steps: int = 200, seq_len: int = 1024, batch: int = 16,
     dispatch it will serve** (GShard-style static capacity): the parameter
     tree is dispatch-independent, but overflow drops make capacity the
     stricter eval, so the gate certifies the weights as actually served.
-    Attention trains "full" (differentiable flash exists since r5, but
-    the CPU interpreter is slower than XLA full attention at this
-    geometry) and serves ``serve_attention`` — no params either way."""
+    ``attention`` resolves like the longcontext recipe's ``train-auto``
+    (flash on TPU, materialised full on CPU); serving runs
+    ``serve_attention`` — no params either way."""
     from ..models.moe import create_moe
     from .step import cross_entropy_loss
+
+    attention = resolve_train_attention(attention)
 
     model, params = create_moe(
         seq_len=seq_len, input_dim=64, dim=dim, depth=depth, heads=heads,
@@ -675,10 +696,11 @@ def main(argv=None) -> None:
     if (not args.fast and args.platform == "cpu"
             and "longcontext" in args.only):
         # Full-geometry longcontext on CPU trains seq-4096 FULL
-        # attention — hours of materialized 4096x4096 scores on one core.
-        # Warn rather than refuse: the run is correct, just slow. On the
-        # TPU (--platform '') pass attention="flash" via the recipe to
-        # train with the differentiable pallas kernel instead.
+        # attention (train-auto resolves to "full" off-TPU) — hours of
+        # materialized 4096x4096 scores on one core. Warn rather than
+        # refuse: the run is correct, just slow. On the TPU
+        # (--platform '') train-auto picks the differentiable pallas
+        # flash kernel by itself (resolve_train_attention).
         log.warning(
             "full longcontext training on jax_platforms=cpu materializes "
             "seq-4096 attention scores and can take hours; use "
@@ -688,12 +710,12 @@ def main(argv=None) -> None:
     fast = ({"landcover": {"steps": 60}, "landcover128": {"steps": 60},
              "megadetector": {"steps": 80},
              "species": {"steps": 65}, "species_fine": {"steps": 90},
-             # Small geometry + full (XLA) attention: the pallas kernel
-             # would run interpreted on CPU CI. attn carries no params, so
-             # the strategy is free to differ from serving.
+             # Small geometry; training attention comes from the recipes'
+             # train-auto default (resolve_train_attention: XLA full on
+             # CPU CI, flash on TPU) — one source of truth for the rule.
              "longcontext": {"steps": 160, "seq_len": 256, "dim": 32,
                              "depth": 2, "heads": 2, "vocab_size": 512,
-                             "batch": 16, "attention": "full"},
+                             "batch": 16},
              "moe": {"steps": 160, "seq_len": 128, "dim": 32, "heads": 1,
                      "num_experts": 4, "vocab_size": 256, "batch": 16}}
             if args.fast else FULL_OVERRIDES)

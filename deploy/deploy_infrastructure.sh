@@ -27,7 +27,7 @@ echo "==> platform charts"
 ENV_SUBST='${REGISTRY} ${IMAGE_TAG} ${TRANSPORT_TYPE} ${QUEUE_RETRY_DELAY_SECONDS} ${MAX_DELIVERY_COUNT} ${PUSH_TTL_SECONDS} ${PUSH_MAX_ATTEMPTS} ${TASK_JOURNAL_PATH} ${REPORTER_PORT} ${SERVICE_CLUSTER} ${OPERATOR_GROUP}'
 # RBAC first: every Deployment below names a ServiceAccount from rbac.yaml
 # (rbac_config.yaml slot, modernized — least privilege, no tiller/dashboard).
-envsubst "$ENV_SUBST" < charts/rbac.yaml | kubectl apply -f -
+envsubst "$RBAC_ENV_SUBST" < charts/rbac.yaml | kubectl apply -f -
 kubectl create configmap ai4e-routes --from-file=routes.json=specs/routes.json \
     --dry-run=client -o yaml | kubectl apply -f -
 kubectl create configmap ai4e-models --from-file=models.json=specs/models.json \

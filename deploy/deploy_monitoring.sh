@@ -31,7 +31,7 @@ kubectl apply -f https://raw.githubusercontent.com/GoogleCloudPlatform/k8s-stack
 # export to it via AI4E_OBSERVABILITY_TRACE_OTLP_ENDPOINT in their charts.
 # The collector pod names a ServiceAccount from rbac.yaml — apply it first
 # (idempotent) so this script also works standalone.
-envsubst '${OPERATOR_GROUP}' < charts/rbac.yaml | kubectl apply -f -
+envsubst "$RBAC_ENV_SUBST" < charts/rbac.yaml | kubectl apply -f -
 kubectl apply -f charts/otel-collector.yaml
 # Cloud Trace write access for the collector (workload identity / node SA).
 gcloud projects add-iam-policy-binding "${PROJECT_ID}" \

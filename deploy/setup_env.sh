@@ -60,6 +60,9 @@ export RATE_LIMIT_RPS="0"   # per-subscription-key throttle; 0 = unlimited
 # Group bound to the read-only ai4e-viewer Role (charts/rbac.yaml); platform
 # pods themselves run with API-token automount OFF.
 export OPERATOR_GROUP="ai4e-operators@example.org"
+# The one substitution list for charts/rbac.yaml — both deploy scripts apply
+# the manifest through this, so they can never apply diverging versions.
+export RBAC_ENV_SUBST='${OPERATOR_GROUP}'
 
 # -- request reporter (reference deploy_request_reporter_function.sh) --------
 export DEPLOY_REPORTER=true

@@ -5,6 +5,7 @@ labels, and the TLS gateway variant must mirror the reference's HTTPS tier
 metric path from /metrics -> Managed Prometheus -> HPA had never been
 checked end-to-end."""
 
+import glob
 import os
 import re
 
@@ -17,6 +18,16 @@ CHARTS = os.path.join(REPO, "deploy", "charts")
 def load_docs(path):
     with open(path) as f:
         return [d for d in yaml.safe_load_all(f) if d]
+
+
+def load_docs_templated(path):
+    """Charts carry deploy-time ${VARS} that make some of them invalid
+    YAML until envsubst (e.g. ${REPORTER_PORT} inside flow mappings) —
+    substitute a numeric dummy so parsing sees what envsubst will
+    produce."""
+    with open(path) as f:
+        text = re.sub(r"\$\{\w+\}", "8085", f.read())
+    return [d for d in yaml.safe_load_all(text) if d]
 
 
 class TestHPAMetricWiring:
@@ -332,7 +343,6 @@ class TestChartEnvNames:
         (FrameworkConfig.from_env rejects unknown variables) — catch it at
         review time instead. Validates NAMES only; values are deploy-time
         ${TEMPLATE} substitutions."""
-        import glob
 
         from ai4e_tpu import config as cfg
         from ai4e_tpu.config import FrameworkConfig
@@ -347,16 +357,9 @@ class TestChartEnvNames:
         # Non-config env the components read directly.
         valid |= {"AI4E_FEED_ADVERTISE_IP"}
 
-        def docs_with_placeholders(path):
-            # Deploy-time ${VARS} make some charts invalid YAML until
-            # substitution — replace with a dummy scalar for parsing.
-            with open(path) as f:
-                text = re.sub(r"\$\{[A-Z_]+\}", "0", f.read())
-            return [d for d in yaml.safe_load_all(text) if d]
-
         seen = 0
         for chart in glob.glob(os.path.join(CHARTS, "*.yaml")):
-            for doc in docs_with_placeholders(chart):
+            for doc in load_docs_templated(chart):
                 if doc.get("kind") != "Deployment":
                     continue
                 for c in doc["spec"]["template"]["spec"]["containers"]:
@@ -378,25 +381,19 @@ class TestRbacWiring:
     to the Kubernetes API), and the operator role must stay read-only —
     the exact inverse of the tiller-era cluster-admin binding."""
 
-    DEPLOYMENT_CHARTS = ("worker-tpu.yaml", "worker-cpu.yaml",
-                         "control-plane.yaml", "control-plane-standby.yaml",
-                         "reporter.yaml", "otel-collector.yaml")
-
     def _rbac_docs(self):
         return load_docs(os.path.join(CHARTS, "rbac.yaml"))
 
     def test_every_deployment_pinned_to_a_defined_serviceaccount(self):
         accounts = {d["metadata"]["name"] for d in self._rbac_docs()
                     if d.get("kind") == "ServiceAccount"}
-        for chart in self.DEPLOYMENT_CHARTS:
-            # reporter.yaml carries ${VAR} placeholders in flow mappings
-            # (valid only after deploy-time envsubst) — substitute a
-            # numeric dummy so yaml parses, as envsubst will.
-            with open(os.path.join(CHARTS, chart)) as f:
-                text = re.sub(r"\$\{\w+\}", "8085", f.read())
-            deployments = [d for d in yaml.safe_load_all(text)
-                           if d and d.get("kind") == "Deployment"]
-            assert deployments, chart
+        # EVERY chart, globbed: a future Deployment chart cannot silently
+        # bypass the token-less ServiceAccount posture.
+        deployment_total = 0
+        for chart in glob.glob(os.path.join(CHARTS, "*.yaml")):
+            deployments = [d for d in load_docs_templated(chart)
+                           if d.get("kind") == "Deployment"]
+            deployment_total += len(deployments)
             for dep in deployments:
                 pod = dep["spec"]["template"]["spec"]
                 sa = pod.get("serviceAccountName")
@@ -404,6 +401,7 @@ class TestRbacWiring:
                     f"{chart}: serviceAccountName {sa!r} not in rbac.yaml")
                 assert pod.get("automountServiceAccountToken") is False, (
                     f"{chart}: pod still mounts the k8s API token")
+        assert deployment_total >= 6  # the glob really found the charts
 
     def test_serviceaccounts_disable_token_automount(self):
         for doc in self._rbac_docs():
@@ -418,10 +416,17 @@ class TestRbacWiring:
             assert set(rule["verbs"]) <= {"get", "list", "watch"}, rule
         (binding,) = [d for d in docs if d.get("kind") == "RoleBinding"]
         assert binding["roleRef"]["name"] == role["metadata"]["name"]
-        # The subject is deploy-time templated from setup_env.sh.
+        # The subject is deploy-time templated: RBAC_ENV_SUBST (the one
+        # substitution list, setup_env.sh) must cover ${OPERATOR_GROUP},
+        # and BOTH deploy scripts must apply rbac.yaml through it —
+        # otherwise a script could kubectl-apply the literal placeholder
+        # as the RoleBinding subject.
         assert binding["subjects"][0]["name"] == "${OPERATOR_GROUP}"
         setup = open(os.path.join(REPO, "deploy", "setup_env.sh")).read()
-        assert "OPERATOR_GROUP" in setup
-        infra = open(os.path.join(
-            REPO, "deploy", "deploy_infrastructure.sh")).read()
-        assert "rbac.yaml" in infra and "${OPERATOR_GROUP}" in infra
+        (subst,) = re.findall(r"RBAC_ENV_SUBST='([^']*)'", setup)
+        assert "${OPERATOR_GROUP}" in subst
+        for script in ("deploy_infrastructure.sh", "deploy_monitoring.sh"):
+            body = open(os.path.join(REPO, "deploy", script)).read()
+            assert re.search(
+                r'envsubst "\$RBAC_ENV_SUBST" < charts/rbac\.yaml', body), (
+                f"{script} does not apply rbac.yaml via RBAC_ENV_SUBST")

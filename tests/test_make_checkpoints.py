@@ -145,3 +145,25 @@ class TestDeterminism:
 def jax_leaves(tree):
     import jax
     return jax.tree_util.tree_leaves(tree)
+
+
+class TestTrainAutoAttention:
+    """`train-auto` picks the training attention per backend: the
+    differentiable flash kernel on TPU (fresh-clone window training), XLA's
+    materialised attention on CPU CI. Explicit strategies pass through."""
+
+    def test_cpu_resolves_to_full(self):
+        from ai4e_tpu.train.make_checkpoints import resolve_train_attention
+        assert resolve_train_attention("train-auto") == "full"
+
+    def test_tpu_resolves_to_flash(self, monkeypatch):
+        import jax
+
+        from ai4e_tpu.train.make_checkpoints import resolve_train_attention
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert resolve_train_attention("train-auto") == "flash"
+
+    def test_explicit_strategy_passes_through(self):
+        from ai4e_tpu.train.make_checkpoints import resolve_train_attention
+        for strategy in ("full", "flash", "ring"):
+            assert resolve_train_attention(strategy) == strategy
