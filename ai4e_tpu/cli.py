@@ -443,7 +443,48 @@ def main(argv=None) -> None:
                         help="cross-replica in-flight request reporter")
     rp.add_argument("--port", type=int, default=None)
 
+    rd = sub.add_parser(
+        "redrive",
+        help="re-dispatch dead-lettered (or otherwise failed) tasks — the "
+             "Service Bus Explorer resubmit workflow, against the store's "
+             "ORIG replay")
+    rd.add_argument("--store", default="http://127.0.0.1:8080",
+                    help="control-plane URL (the task-store surface)")
+    rd.add_argument("--task-id", default=None,
+                    help="redrive ONE task (any failed state)")
+    rd.add_argument("--contains", default="delivery attempts exhausted",
+                    help="sweep filter on the failed Status prose; '' "
+                         "redrives every failed task")
+    rd.add_argument("--api-key", default=None,
+                    help="subscription key when the control plane runs "
+                         "with gateway keys")
+
     args = parser.parse_args(argv)
+
+    if args.component == "redrive":
+        # Pure HTTP client — no jax, no platform assembly.
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        if args.task_id:
+            payload: dict = {"TaskId": args.task_id}
+        else:
+            payload = {"Contains": args.contains}
+        req = urllib.request.Request(
+            args.store.rstrip("/") + "/v1/taskstore/redrive",
+            data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json",
+                     **({"Ocp-Apim-Subscription-Key": args.api_key}
+                        if args.api_key else {})},
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                print(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            print(exc.read().decode())
+            raise SystemExit(1)
+        return
     config = FrameworkConfig.from_env()
     config.observability.apply()
     if config.runtime.platform:

@@ -156,6 +156,68 @@ def make_app(store: InMemoryTaskStore,
             return not_primary()
         return web.json_response(task.to_dict())
 
+    async def redrive(request: web.Request) -> web.Response:
+        """Re-dispatch dead-lettered tasks — the ops surface the reference
+        outsourced to Azure Service Bus tooling (dead-letter queues are
+        inspected/resubmitted with Service Bus Explorer; here the body is
+        retained by the store's ORIG replay, so a redrive is just
+        ``requeue_if(task_id, "failed")``: flip back to created and
+        republish the original payload through the transport).
+
+        Body ``{"TaskId": ...}`` redrives one task (409 if it is not in a
+        failed state — completed/running tasks are never re-run). An empty
+        body sweeps: every failed task whose status prose contains
+        ``Contains`` (default "delivery attempts exhausted" — the exact
+        text the platform writes when a message exhausts its delivery
+        budget) is redriven. Pass ``{"Contains": ""}`` to redrive ALL
+        failed tasks, including ones that failed in model code."""
+        raw = await read_body_limited(request, max_body_bytes)
+        if raw is None:
+            return too_large(max_body_bytes)
+        try:
+            payload = json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        if not isinstance(payload, dict):
+            return web.json_response(
+                {"error": "body must be a JSON object"}, status=400)
+        if getattr(store, "role", "primary") == "follower":
+            # Refuse up front: an empty sweep would otherwise 200 on a
+            # follower (nothing to requeue → no write to fence), hiding
+            # from the operator that they redrove the wrong replica.
+            return not_primary()
+        try:
+            task_id = payload.get("TaskId")
+            if task_id:
+                task = store.requeue_if(task_id, "failed")
+                if task is None:
+                    try:
+                        current = store.get(task_id)
+                    except TaskNotFound:
+                        return web.json_response(
+                            {"error": "unknown task"}, status=404)
+                    return web.json_response(
+                        {"error": "task is not failed",
+                         "Status": current.status}, status=409)
+                return web.json_response(task.to_dict())
+            contains = payload.get("Contains",
+                                   "delivery attempts exhausted")
+            redriven = []
+            for ep in store.endpoints():
+                for tid in store.set_members(ep, "failed"):
+                    try:
+                        current = store.get(tid)
+                    except TaskNotFound:
+                        continue  # evicted between scan and fetch
+                    if contains and contains not in current.status:
+                        continue
+                    if store.requeue_if(tid, "failed") is not None:
+                        redriven.append(tid)
+        except NotPrimaryError:
+            return not_primary()
+        return web.json_response(
+            {"redriven": len(redriven), "task_ids": redriven})
+
     async def get_task(request: web.Request) -> web.Response:
         task_id = request.query.get("taskId") or request.match_info.get("task_id", "")
         if not task_id:
@@ -231,6 +293,7 @@ def make_app(store: InMemoryTaskStore,
 
     app.router.add_post("/v1/taskstore/upsert", stamped(upsert))
     app.router.add_post("/v1/taskstore/update", stamped(update))
+    app.router.add_post("/v1/taskstore/redrive", stamped(redrive))
     app.router.add_get("/v1/taskstore/task", stamped(get_task))
     app.router.add_get("/v1/taskstore/task/{task_id}", stamped(get_task))
     app.router.add_get("/v1/taskstore/depths", stamped(depths))

@@ -309,3 +309,69 @@ class TestDeadLetterHandler:
                 await gw.close()
 
         run(main())
+
+
+class TestRedriveRecovery:
+    def test_dead_lettered_task_redrives_to_recovered_backend(self):
+        """Ops loop the reference ran through Service Bus Explorer: backend
+        down → delivery budget exhausts → dead-letter fails the task →
+        operator fixes the backend → POST /v1/taskstore/redrive → the ORIG
+        body replays through the transport and the task completes."""
+        import socket
+
+        from ai4e_tpu.taskstore.http import make_app as make_taskstore_app
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        async def main():
+            platform = LocalPlatform(PlatformConfig(
+                retry_delay=0.05, max_delivery_count=1))
+            port = free_port()
+            backend_uri = f"http://127.0.0.1:{port}/v1/late/fix"
+            platform.publish_async_api("/v1/public/flaky", backend_uri)
+            make_taskstore_app(platform.store, app=platform.gateway.app)
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            svc_client = None
+            try:
+                # Backend down: connection refused burns the one delivery.
+                resp = await gw.post("/v1/public/flaky", data=b"ORIGBODY")
+                tid = (await resp.json())["TaskId"]
+                failed = await poll_until(
+                    gw, tid, lambda b: "failed" in b["Status"], tries=400)
+                assert "delivery attempts exhausted" in failed["Status"]
+
+                # Operator fixes the backend (same port the route targets).
+                svc = platform.make_service("late", prefix="v1/late")
+                seen = {}
+
+                @svc.api_async_func("/fix")
+                def fix(taskId, body, content_type):
+                    seen["body"] = body
+                    asyncio.run(platform.task_manager.complete_task(
+                        taskId, "completed - recovered"))
+
+                server = TestServer(svc.app, port=port)
+                svc_client = TestClient(server)
+                await svc_client.start_server()
+
+                resp = await gw.post("/v1/taskstore/redrive", json={})
+                body = await resp.json()
+                assert body == {"redriven": 1, "task_ids": [tid]}
+
+                final = await poll_until(
+                    gw, tid, lambda b: "completed" in b["Status"], tries=400)
+                assert final["Status"] == "completed - recovered"
+                assert seen["body"] == b"ORIGBODY"  # the ORIG replay
+            finally:
+                await platform.stop()
+                await gw.close()
+                if svc_client is not None:
+                    await svc_client.close()
+
+        run(main())

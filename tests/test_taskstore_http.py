@@ -333,3 +333,118 @@ class TestDirectResultStore:
                 await client.close()
 
         run(main())
+
+
+class TestRedrive:
+    """POST /v1/taskstore/redrive — the Service Bus Explorer resubmit
+    workflow (the reference outsourced dead-letter inspection/resubmission
+    to Azure tooling; here the store's ORIG replay makes a redrive a
+    conditional republish)."""
+
+    @staticmethod
+    async def _seed(store, status):
+        from ai4e_tpu.taskstore.task import APITask
+
+        task = store.upsert(APITask(task_id="", endpoint="http://h/v1/api",
+                                    body=b"payload", publish=False))
+        if status:
+            store.update_status(task.task_id, status)
+        return task.task_id
+
+    def test_sweep_redrives_dead_lettered_only(self):
+        store = InMemoryTaskStore()
+        published = []
+        store.set_publisher(published.append)
+
+        async def main():
+            dead = await self._seed(
+                store, "failed - delivery attempts exhausted")
+            model_err = await self._seed(store, "failed - model exploded")
+            done = await self._seed(store, "completed")
+            client = TestClient(TestServer(make_app(store)))
+            await client.start_server()
+            try:
+                resp = await client.post("/v1/taskstore/redrive", json={})
+                body = await resp.json()
+                assert resp.status == 200
+                assert body["redriven"] == 1
+                assert body["task_ids"] == [dead]
+                # Redriven: created again, ORIGINAL body republished.
+                assert store.get(dead).canonical_status == "created"
+                assert [m.task_id for m in published] == [dead]
+                assert published[0].body == b"payload"
+                # Untouched: a model failure and a completed task.
+                assert store.get(model_err).canonical_status == "failed"
+                assert store.get(done).canonical_status == "completed"
+
+                # Contains="" sweeps EVERY failed task.
+                resp = await client.post("/v1/taskstore/redrive",
+                                         json={"Contains": ""})
+                body = await resp.json()
+                assert body["task_ids"] == [model_err]
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_single_task_redrive_and_guards(self):
+        store = InMemoryTaskStore()
+        published = []
+        store.set_publisher(published.append)
+
+        async def main():
+            failed = await self._seed(store, "failed - model exploded")
+            done = await self._seed(store, "completed")
+            client = TestClient(TestServer(make_app(store)))
+            await client.start_server()
+            try:
+                # Explicit TaskId redrives any failed task (no prose filter).
+                resp = await client.post("/v1/taskstore/redrive",
+                                         json={"TaskId": failed})
+                assert resp.status == 200
+                assert (await resp.json())["Status"] == "created"
+                assert [m.task_id for m in published] == [failed]
+                # Never re-runs a completed task.
+                resp = await client.post("/v1/taskstore/redrive",
+                                         json={"TaskId": done})
+                assert resp.status == 409
+                # Unknown task is a 404, not a silent no-op.
+                resp = await client.post("/v1/taskstore/redrive",
+                                         json={"TaskId": "nope"})
+                assert resp.status == 404
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_follower_refuses_redrive(self, tmp_path):
+        from ai4e_tpu.taskstore.store import FollowerTaskStore
+
+        store = FollowerTaskStore(str(tmp_path / "j.jsonl"))
+        assert store.role == "follower"
+
+        async def main():
+            client = TestClient(TestServer(make_app(store)))
+            await client.start_server()
+            try:
+                resp = await client.post("/v1/taskstore/redrive", json={})
+                assert resp.status == 503
+                assert resp.headers.get("X-Not-Primary") == "1"
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_non_object_json_body_is_400(self):
+        store = InMemoryTaskStore()
+
+        async def main():
+            client = TestClient(TestServer(make_app(store)))
+            await client.start_server()
+            try:
+                resp = await client.post("/v1/taskstore/redrive", data=b"[]")
+                assert resp.status == 400
+            finally:
+                await client.close()
+
+        run(main())
