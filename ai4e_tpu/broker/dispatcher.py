@@ -146,7 +146,7 @@ class Dispatcher:
                 if not self.broker.abandon(msg):
                     self._dispatched.inc(outcome="dead_letter", queue=self.queue_name)
                     await self._try_update(
-                        msg.task_id, "failed - delivery attempts exhausted",
+                        msg.task_id, TaskStatus.DEAD_LETTER,
                         TaskStatus.FAILED)
 
     def _target_for(self, msg: Message) -> str:
@@ -211,7 +211,7 @@ class Dispatcher:
             # Dead-lettered: out of delivery budget.
             self._dispatched.inc(outcome="dead_letter", queue=self.queue_name)
             await self._try_update(
-                msg.task_id, "failed - delivery attempts exhausted",
+                msg.task_id, TaskStatus.DEAD_LETTER,
                 TaskStatus.FAILED)
 
     async def _try_update(self, task_id: str, status: str, backend: str) -> None:

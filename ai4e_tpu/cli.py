@@ -452,14 +452,64 @@ def main(argv=None) -> None:
                     help="control-plane URL (the task-store surface)")
     rd.add_argument("--task-id", default=None,
                     help="redrive ONE task (any failed state)")
-    rd.add_argument("--contains", default="delivery attempts exhausted",
+    from .taskstore.task import TaskStatus as _TS
+    rd.add_argument("--contains", default=_TS.DEAD_LETTER_PROSE,
                     help="sweep filter on the failed Status prose; '' "
                          "redrives every failed task")
     rd.add_argument("--api-key", default=None,
                     help="subscription key when the control plane runs "
                          "with gateway keys")
 
+    tr = sub.add_parser(
+        "trace",
+        help="render task/request span trees from the JSONL trace log — "
+             "the App Insights end-to-end transaction view, offline")
+    tr.add_argument("--export", default=None,
+                    help="span log path (default: the configured "
+                         "AI4E_OBSERVABILITY_TRACE_EXPORT_PATH)")
+    tr_sel = tr.add_mutually_exclusive_group()
+    tr_sel.add_argument("--task-id", default=None,
+                        help="render every trace this task traversed")
+    tr_sel.add_argument("--trace-id", default=None,
+                        help="render one trace")
+    tr.add_argument("--list", action="store_true", dest="list_traces",
+                    help="summarize recent traces instead of rendering")
+    tr.add_argument("--limit", type=int, default=20,
+                    help="--list: how many recent traces")
+
     args = parser.parse_args(argv)
+
+    if args.component == "trace":
+        # Pure log reader — no jax, no platform assembly.
+        from .observability.traceview import (load_spans, render_list,
+                                              render_trace, select_traces)
+        path = args.export
+        if path is None:
+            path = FrameworkConfig.from_env().observability.trace_export_path
+        if not path:
+            raise SystemExit(
+                "no span log: pass --export or set "
+                "AI4E_OBSERVABILITY_TRACE_EXPORT_PATH on the services")
+        try:
+            spans = load_spans(path)
+        except OSError as exc:
+            raise SystemExit(f"cannot read span log {path}: {exc}")
+        selected = select_traces(spans, task_id=args.task_id,
+                                 trace_id=args.trace_id)
+        if not selected and (args.task_id or args.trace_id):
+            # A filter that matches nothing must fail loudly in both
+            # modes — an empty --list reading as "zero-span traces" would
+            # mislead scripted callers.
+            raise SystemExit("no matching spans")
+        if args.list_traces:
+            # --list composes with the filters: summarize the SELECTED
+            # traces (all of them when no filter given).
+            print(render_list(selected, limit=args.limit))
+            return
+        if not selected:
+            raise SystemExit("no matching spans")
+        print(render_trace(selected))
+        return
 
     if args.component == "redrive":
         # Pure HTTP client — no jax, no platform assembly.
@@ -484,6 +534,8 @@ def main(argv=None) -> None:
         except urllib.error.HTTPError as exc:
             print(exc.read().decode())
             raise SystemExit(1)
+        except OSError as exc:  # URLError/TimeoutError are OSErrors
+            raise SystemExit(f"cannot reach {args.store}: {exc}")
         return
     config = FrameworkConfig.from_env()
     config.observability.apply()

@@ -16,7 +16,8 @@ from .broker import DispatcherPool, InMemoryBroker
 from .gateway import Gateway
 from .metrics import DEFAULT_REGISTRY, MetricsRegistry
 from .service import APIService, LocalTaskManager
-from .taskstore import InMemoryTaskStore, JournaledTaskStore, endpoint_path
+from .taskstore import (InMemoryTaskStore, JournaledTaskStore,
+                        TaskStatus, endpoint_path)
 
 
 @dataclass
@@ -475,7 +476,7 @@ class LocalPlatform:
             task = self.store.get(task_id)
             if task.canonical_status not in ("completed", "failed"):
                 await self.task_manager.fail_task(
-                    task_id, "failed - delivery attempts exhausted")
+                    task_id, TaskStatus.DEAD_LETTER)
         except Exception:  # noqa: BLE001 — best-effort terminal transition
             import logging
             logging.getLogger("ai4e_tpu.platform").exception(

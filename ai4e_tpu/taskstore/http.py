@@ -50,7 +50,7 @@ from aiohttp import web
 
 from .store import (InMemoryTaskStore, NotPrimaryError, StaleEpochError,
                     TaskNotFound)
-from .task import APITask
+from .task import APITask, TaskStatus
 
 
 def make_app(store: InMemoryTaskStore,
@@ -201,7 +201,7 @@ def make_app(store: InMemoryTaskStore,
                          "Status": current.status}, status=409)
                 return web.json_response(task.to_dict())
             contains = payload.get("Contains",
-                                   "delivery attempts exhausted")
+                                   TaskStatus.DEAD_LETTER_PROSE)
             redriven = []
             for ep in store.endpoints():
                 for tid in store.set_members(ep, "failed"):

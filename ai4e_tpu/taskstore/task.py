@@ -27,6 +27,13 @@ class TaskStatus:
     ALL = (CREATED, RUNNING, COMPLETED, FAILED)
     TERMINAL = (COMPLETED, FAILED)
 
+    # The exact prose the platform writes when a task's transport message
+    # exhausts its delivery budget (queue or push). The redrive surface's
+    # default sweep filter matches on DEAD_LETTER_PROSE — producers and
+    # that consumer must stay byte-identical, so both live here.
+    DEAD_LETTER_PROSE = "delivery attempts exhausted"
+    DEAD_LETTER = FAILED + " - " + DEAD_LETTER_PROSE
+
     @staticmethod
     def canonical(status: str) -> str:
         """Map a free-form status string onto its lifecycle bucket.

@@ -16,6 +16,11 @@ from ai4e_tpu.service import APIService
 
 def main() -> None:
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 8081
+    # Honor the observability env (AI4E_OBSERVABILITY_TRACE_EXPORT_PATH
+    # etc.) exactly like the production launchers, so the example's spans
+    # are viewable with `python -m ai4e_tpu trace`.
+    from ai4e_tpu.config import FrameworkConfig
+    FrameworkConfig.from_env().observability.apply()
     svc = APIService("echo", prefix="v1/echo")
 
     @svc.api_sync_func("/echo", maximum_concurrent_requests=4)

@@ -1,0 +1,101 @@
+"""The trace viewer (`python -m ai4e_tpu trace`) — the App Insights
+end-to-end transaction view rendered offline from the JSONL span log.
+
+Spans are generated through the REAL Tracer + JsonlExporter (not
+hand-written dicts), so a change to the span wire format that breaks the
+viewer breaks here first.
+"""
+
+import contextlib
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from ai4e_tpu.observability.tracing import JsonlExporter, Tracer
+from ai4e_tpu.observability.traceview import (load_spans, render_list,
+                                              render_trace, select_traces)
+
+REPO = str(Path(__file__).resolve().parent.parent)
+
+
+def _emit_pipeline_trace(path, task_id="task-123"):
+    """gateway → dispatch → infer (error) nested under one trace, plus an
+    unrelated second trace — the shape a pipelined request produces."""
+    tracer = Tracer("gateway", exporter=JsonlExporter(str(path)))
+    with tracer.span("create_task", task_id=task_id):
+        time.sleep(0.002)
+        dispatch_tracer = Tracer("control-plane",
+                                 exporter=tracer.exporter)
+        with dispatch_tracer.span("dispatch", task_id=task_id):
+            worker = Tracer("worker", exporter=tracer.exporter)
+            with contextlib.suppress(RuntimeError):
+                with worker.span("infer", task_id=task_id, model="unet"):
+                    raise RuntimeError("device poisoned")
+    other = Tracer("gateway", exporter=tracer.exporter)
+    with other.span("healthcheck"):
+        pass
+    tracer.exporter.close()
+
+
+class TestTraceView:
+    def test_select_by_task_returns_whole_trace(self, tmp_path):
+        log = tmp_path / "spans.jsonl"
+        _emit_pipeline_trace(log)
+        spans = load_spans(str(log))
+        assert len(spans) == 4
+        picked = select_traces(spans, task_id="task-123")
+        assert len(picked) == 3  # the healthcheck trace is excluded
+        assert len({s["trace_id"] for s in picked}) == 1
+
+    def test_render_tree_shape_and_error(self, tmp_path):
+        log = tmp_path / "spans.jsonl"
+        _emit_pipeline_trace(log)
+        text = render_trace(select_traces(load_spans(str(log)),
+                                          task_id="task-123"))
+        lines = text.splitlines()
+        assert lines[0].startswith("trace ")
+        assert "3 spans" in lines[0]
+        assert "task task-123" in lines[0]
+        assert "1 ERROR" in lines[0]
+        # Nesting: create_task roots, dispatch under it, infer under that.
+        assert "└─ create_task [gateway]" in lines[1]
+        assert "└─ dispatch [control-plane]" in lines[2]
+        assert lines[2].startswith("   ")
+        assert "└─ infer [worker]" in lines[3]
+        assert "ERROR: RuntimeError: device poisoned" in lines[3]
+        assert "model=unet" in lines[3]
+
+    def test_orphan_span_roots_and_garbage_lines_skipped(self, tmp_path):
+        log = tmp_path / "spans.jsonl"
+        _emit_pipeline_trace(log)
+        with open(log, "a") as fh:
+            fh.write("{truncated mid-wri\n")
+            fh.write('{"trace_id": "t-orphan", "span_id": "s1", '
+                     '"parent_id": "missing", "name": "late", '
+                     '"service": "w", "start": 1.0, "duration": 0.5}\n')
+        spans = load_spans(str(log))
+        text = render_trace(select_traces(spans, trace_id="t-orphan"))
+        assert "└─ late [w]" in text  # orphan renders as a root
+
+    def test_list_summarizes_most_recent_first(self, tmp_path):
+        log = tmp_path / "spans.jsonl"
+        _emit_pipeline_trace(log)
+        listing = render_list(load_spans(str(log)))
+        lines = listing.splitlines()
+        assert len(lines) == 2
+        # healthcheck started last → listed first.
+        assert "healthcheck" in lines[0]
+        assert "create_task" in lines[1] and "task task-123" in lines[1]
+
+    def test_cli_verb_renders_without_jax(self, tmp_path):
+        log = tmp_path / "spans.jsonl"
+        _emit_pipeline_trace(log)
+        out = subprocess.run(
+            [sys.executable, "-m", "ai4e_tpu", "trace",
+             "--export", str(log), "--task-id", "task-123"],
+            capture_output=True, text=True, timeout=60,
+            cwd=REPO)
+        assert out.returncode == 0, out.stderr
+        assert "└─ infer [worker]" in out.stdout
+        assert "ERROR" in out.stdout
