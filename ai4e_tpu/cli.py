@@ -317,6 +317,9 @@ def build_worker(config: FrameworkConfig, models: dict):
                 checkpoint = os.path.abspath(os.path.join(
                     rt.checkpoint_dir or ".", checkpoint))
             servable.params = load_params(checkpoint, like=servable.params)
+            # Recorded for the hot-reload endpoint (POST
+            # {prefix}/models/{name}/reload re-reads this path).
+            servable.checkpoint_path = checkpoint
             log.info("restored %s params from %s", servable.name, checkpoint)
         runtime.register(servable)
         worker.serve_model(servable, sync_path=sync_path,

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -49,6 +50,13 @@ class ServableModel:
     batch_buckets: tuple[int, ...] = (1, 2, 4, 8)
     input_dtype: Any = np.float32
     version: str = "1.0"
+    # Weights provenance for hot reload: the checkpoint this servable's
+    # params were restored from (None = init/in-memory weights), and a
+    # monotonic version bumped by every successful reload_params — the
+    # /models introspection exposes both so operators can confirm a
+    # rollout landed.
+    checkpoint_path: str | None = None
+    params_version: int = 1
     # Param-path → PartitionSpec rules applied at register() — how a family
     # declares model-parallel placement (e.g. MoE experts over ep) that must
     # survive the runtime's own param placement.
@@ -199,6 +207,36 @@ class ModelRuntime:
             log.info("warmup %s: %d buckets in %.1fs", name,
                      len(servable.batch_buckets), times[name])
         return times
+
+    def reload_params(self, name: str, new_params) -> "ServableModel":
+        """Hot-swap a registered servable's weights — zero-downtime model
+        update (the reference rolls whole containers for this,
+        ``APIs/Charts/templates/async-gpu``; here the jitted programs take
+        params as an ARGUMENT, so new weights need no recompile).
+
+        The new tree must match the current one exactly (structure, shapes,
+        dtypes) — reload updates weights, never architecture; a geometry
+        change is a new model spec + restart. The swap is a single attribute
+        assignment: in-flight batches already hold the old reference and
+        complete on it; every later ``run_batch`` picks up the new params.
+        """
+        from ..parallel.sharding import shard_params
+        servable = self.models[name]  # KeyError → caller's 404
+
+        def spec_of(tree):
+            return jax.tree.map(
+                lambda a: (tuple(a.shape), jnp.result_type(a).name), tree)
+
+        old_spec, new_spec = spec_of(servable.params), spec_of(new_params)
+        if old_spec != new_spec:
+            raise ValueError(
+                f"checkpoint tree does not match the served model: "
+                f"served {old_spec} vs reload {new_spec}")
+        placed = shard_params(new_params, self.mesh,
+                              servable.param_sharding_rules)
+        servable.params = placed
+        servable.params_version += 1
+        return servable
 
     def run_batch(self, name: str, batch: np.ndarray):
         """Execute one padded batch; blocking (call from an executor)."""

@@ -10,6 +10,7 @@ stores the result on the task store.
 
 from __future__ import annotations
 
+import asyncio
 import inspect
 import json
 import logging
@@ -39,8 +40,15 @@ class InferenceWorker:
                                   task_manager=task_manager, metrics=metrics,
                                   reporter=reporter)
         self._served: dict[str, dict] = {}  # model -> endpoint listing
+        # Serializes hot reloads: concurrent swaps would otherwise leave
+        # checkpoint_path/params_version reporting a different rollout
+        # than the params actually serving.
+        self._reload_lock = asyncio.Lock()
         self.service.app.router.add_get(self.service.prefix + "/models",
                                         self._list_models)
+        self.service.app.router.add_post(
+            self.service.prefix + "/models/{name}/reload",
+            self._reload_model)
 
     async def _list_models(self, _request):
         """Model-registry introspection — what the reference delegates to its
@@ -50,6 +58,8 @@ class InferenceWorker:
         for name, s in self.runtime.models.items():
             entry = {
                 "name": name, "version": s.version,
+                "params_version": s.params_version,
+                "checkpoint": s.checkpoint_path,
                 "input_shape": list(s.input_shape),
                 "input_dtype": str(np.dtype(s.input_dtype)),
                 "batch_buckets": list(s.batch_buckets),
@@ -65,6 +75,79 @@ class InferenceWorker:
                     else s.input_dtype))
             out.append(entry)
         return web.json_response({"models": out})
+
+    async def _reload_model(self, request):
+        """POST {prefix}/models/{name}/reload — hot-swap the model's weights
+        from its checkpoint (or a new one in the JSON body), no restart, no
+        recompile (``ModelRuntime.reload_params``). The reference updates a
+        model by building + rolling a new container image; here a retrained
+        checkpoint lands on the shared mount and this endpoint flips serving
+        to it between batches.
+
+        Body (optional): ``{"checkpoint": "/abs/or/relative/path"}`` —
+        relative paths resolve against the model's current checkpoint
+        directory. Errors: 404 unknown model, 400 no checkpoint known,
+        409 checkpoint tree mismatch, 501 on a multi-host slice (every
+        process would need the swap; roll replicas there instead)."""
+        import os
+
+        from aiohttp import web
+
+        import jax
+
+        name = request.match_info["name"]
+        servable = self.runtime.models.get(name)
+        if servable is None:
+            return web.json_response({"error": "unknown model"}, status=404)
+        if jax.process_count() > 1:
+            return web.json_response(
+                {"error": "hot reload is single-host; roll the replicas of "
+                          "a multi-host slice instead"}, status=501)
+        try:
+            payload = json.loads(await request.read() or b"{}")
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        if not isinstance(payload, dict):
+            return web.json_response(
+                {"error": "body must be a JSON object"}, status=400)
+        path = payload.get("checkpoint") or servable.checkpoint_path
+        if not path:
+            return web.json_response(
+                {"error": "model has no checkpoint to reload; pass "
+                          '{"checkpoint": ...}'}, status=400)
+        if not isinstance(path, str):
+            return web.json_response(
+                {"error": "checkpoint must be a string path"}, status=400)
+        if not os.path.isabs(path):
+            if not servable.checkpoint_path:
+                # No directory to resolve against — orbax would resolve
+                # it against the server CWD, a silent wrong place.
+                return web.json_response(
+                    {"error": "relative checkpoint path but the model has "
+                              "no recorded checkpoint directory; pass an "
+                              "absolute path"}, status=400)
+            path = os.path.abspath(os.path.join(
+                os.path.dirname(servable.checkpoint_path), path))
+
+        def load_and_swap():
+            from ..checkpoint import load_params
+            new_params = load_params(path, like=servable.params)
+            return self.runtime.reload_params(name, new_params)
+
+        async with self._reload_lock:
+            try:
+                # Off the event loop: orbax reads disk and device_puts.
+                await asyncio.to_thread(load_and_swap)
+            except ValueError as exc:
+                return web.json_response({"error": str(exc)}, status=409)
+            except Exception as exc:  # noqa: BLE001 — checkpoint IO surface
+                return web.json_response(
+                    {"error": f"reload failed: {type(exc).__name__}: "
+                              f"{exc}"}, status=400)
+            servable.checkpoint_path = path
+            return web.json_response(
+                {"model": name, "checkpoint": path,
+                 "params_version": servable.params_version})
 
     def serve_model(self, servable: ServableModel,
                     sync_path: str | None = None,
