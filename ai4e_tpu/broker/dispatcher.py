@@ -26,6 +26,7 @@ import logging
 import aiohttp
 
 from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from ..utils.backends import normalize_backends, pick_backend
 from ..utils.http import SessionHolder
 from ..service.task_manager import TaskManagerBase
 from ..taskstore import TaskStatus
@@ -63,22 +64,31 @@ def rebase_endpoint(endpoint: str, base_path: str, backend_uri: str) -> str:
 
 
 class Dispatcher:
-    """Drains one endpoint queue, POSTing each task to ``backend_uri``."""
+    """Drains one endpoint queue, POSTing each task to ``backend_uri`` —
+    or, with a weighted backend LIST, splitting deliveries across hosts
+    (canary rollouts; ``utils/backends.py``). Each delivery picks
+    independently, so a retried message may land on the other version —
+    desirable: a canary that 503s doesn't strand its tasks."""
 
     def __init__(
         self,
         broker: InMemoryBroker,
         queue_name: str,
-        backend_uri: str,
+        backend_uri,
         task_manager: TaskManagerBase,
         retry_delay: float = 60.0,
         concurrency: int = 1,
         request_timeout: float = 300.0,
         metrics: MetricsRegistry | None = None,
+        rng=None,
     ):
         self.broker = broker
         self.queue_name = queue_name
-        self.backend_uri = backend_uri
+        self.backends = normalize_backends(backend_uri)
+        # The primary (first) backend — what single-backend consumers and
+        # introspection read; weighted picks use the full set.
+        self.backend_uri = self.backends[0][0]
+        self._rng = rng
         self.task_manager = task_manager
         self.retry_delay = retry_delay
         self.concurrency = concurrency
@@ -150,10 +160,12 @@ class Dispatcher:
                         TaskStatus.FAILED)
 
     def _target_for(self, msg: Message) -> str:
-        """Dispatch target: the *registered* backend URI (fresh host — a
-        journal-restored task may carry a stale one) with the task endpoint's
-        operation tail and query grafted on (``rebase_endpoint``)."""
-        return rebase_endpoint(msg.endpoint, self.queue_name, self.backend_uri)
+        """Dispatch target: a *registered* backend URI (fresh host — a
+        journal-restored task may carry a stale one; weighted pick across a
+        canary set) with the task endpoint's operation tail and query
+        grafted on (``rebase_endpoint``)."""
+        base = pick_backend(self.backends, self._rng)
+        return rebase_endpoint(msg.endpoint, self.queue_name, base)
 
     async def _dispatch_one(self, msg: Message) -> None:
         from ..observability import get_tracer
@@ -236,7 +248,7 @@ class DispatcherPool:
         self.concurrency = concurrency
         self.dispatchers: dict[str, Dispatcher] = {}
 
-    def register(self, queue_name: str, backend_uri: str,
+    def register(self, queue_name: str, backend_uri,
                  retry_delay: float | None = None,
                  concurrency: int | None = None) -> Dispatcher:
         d = Dispatcher(

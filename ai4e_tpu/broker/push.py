@@ -44,6 +44,7 @@ from aiohttp import web
 
 from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
 from ..taskstore import TaskStatus, endpoint_path
+from ..utils.backends import normalize_backends, pick_backend
 from ..utils.http import SessionHolder
 from .dispatcher import AWAITING_STATUS, BACKPRESSURE_CODES, rebase_endpoint
 
@@ -347,7 +348,8 @@ class WebhookDispatcher:
         self.metrics = metrics or DEFAULT_REGISTRY
         self._forwarded = self.metrics.counter(
             "ai4e_webhook_forwards_total", "Webhook forwards by outcome")
-        self._routes: dict[str, str] = {}  # queue path prefix -> backend base URI
+        # queue path prefix -> weighted backend set (utils/backends.py)
+        self._routes: dict[str, list] = {}
         # In-flight bounded by the topic's delivery window, not a hidden
         # 100-connection client pool.
         self._sessions = SessionHolder(timeout=request_timeout, limit=0)
@@ -356,11 +358,13 @@ class WebhookDispatcher:
         self.app.router.add_get("/healthz", self._health)
         self.app.on_cleanup.append(self._cleanup)
 
-    def add_route(self, api_prefix: str, backend_uri: str) -> None:
-        """Map an API path prefix to the backend base URI it dispatches to —
-        the per-queue backend config of ``deploy_backend_queue_function.sh``,
-        as a dict entry."""
-        self._routes[endpoint_path(api_prefix)] = backend_uri
+    def add_route(self, api_prefix: str, backend_uri) -> None:
+        """Map an API path prefix to the backend it dispatches to — the
+        per-queue backend config of ``deploy_backend_queue_function.sh``,
+        as a dict entry. A weighted LIST splits deliveries across hosts
+        (canary; same semantics as the queue dispatcher)."""
+        self._routes[endpoint_path(api_prefix)] = normalize_backends(
+            backend_uri)
 
     def _target_for(self, subject: str) -> str | None:
         """Rebase the event subject onto the registered backend: longest
@@ -374,7 +378,7 @@ class WebhookDispatcher:
         if not candidates:
             return None
         base = max(candidates, key=len)
-        return rebase_endpoint(subject, base, self._routes[base])
+        return rebase_endpoint(subject, base, pick_backend(self._routes[base]))
 
     async def _handle(self, request: web.Request) -> web.Response:
         if HDR_EVENT_TYPE in request.headers:

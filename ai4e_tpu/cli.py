@@ -21,6 +21,9 @@ routes.json::
 
     {"apis": [{"prefix": "/v1/landcover/classify-async",
                "backend": "http://worker:8081/v1/landcover/classify-async",
+               // or a weighted canary set (same path, hosts differ):
+               // "backends": [{"uri": "http://fleet:8081/v1/...", "weight": 95},
+               //              {"uri": "http://canary:8081/v1/...", "weight": 5}],
                "mode": "async",             // or "sync"
                "autoscale": {"max_replicas": 8},   // optional
                "max_body_bytes": 67108864,  // optional edge payload cap
@@ -118,8 +121,13 @@ def build_control_plane(config: FrameworkConfig, routes: dict):
                                         for r in routes["definitions"]])
     for api in routes.get("apis", []):
         mode = api.get("mode", "async")
+        # "backend": one URI; "backends": weighted canary set
+        # ([{"uri": ..., "weight": N}, ...] — utils/backends.py). Presence
+        # check, not truthiness: an explicitly-empty "backends" must hit
+        # normalize_backends' clear error, not silently fall back.
+        backend = api["backends"] if "backends" in api else api["backend"]
         if mode == "sync":
-            platform.publish_sync_api(api["prefix"], api["backend"],
+            platform.publish_sync_api(api["prefix"], backend,
                                       max_body_bytes=api.get("max_body_bytes"))
             continue
         autoscale = api.get("autoscale")
@@ -127,13 +135,13 @@ def build_control_plane(config: FrameworkConfig, routes: dict):
             # Pipeline-stage backend: transport consumer only, no public
             # gateway route (tasks arrive via handoff republish).
             platform.register_internal_route(
-                api["backend"],
+                backend,
                 retry_delay=api.get("retry_delay"),
                 concurrency=api.get("concurrency"),
                 autoscale=AutoscalePolicy(**autoscale) if autoscale else None)
             continue
         platform.publish_async_api(
-            api["prefix"], api["backend"],
+            api["prefix"], backend,
             retry_delay=api.get("retry_delay"),
             concurrency=api.get("concurrency"),
             autoscale=AutoscalePolicy(**autoscale) if autoscale else None,

@@ -28,6 +28,7 @@ import aiohttp
 from aiohttp import web
 
 from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from ..utils.backends import normalize_backends, pick_backend
 from ..taskstore import APITask, InMemoryTaskStore, TaskNotFound
 from ..utils.http import SessionHolder
 
@@ -43,6 +44,9 @@ class Route:
     prefix: str
     mode: str  # "sync" | "async"
     backend_uri: str = ""  # sync: proxy target; async: recorded task endpoint
+    # Weighted backend set for sync routes (canary; utils/backends.py);
+    # [(backend_uri, 1.0)] for the plain single-backend case.
+    backends: list = None
     # None = use the gateway's cap at request time; 0 = explicitly unlimited.
     max_body_bytes: int | None = None
 
@@ -178,10 +182,13 @@ class Gateway:
         self.app.router.add_post(route.prefix + "/{tail:.*}",
                                  self._make_async_handler(route))
 
-    def add_sync_route(self, prefix: str, backend_uri: str,
+    def add_sync_route(self, prefix: str, backend_uri,
                        max_body_bytes: int | None = None) -> None:
+        backends = [(u.rstrip("/"), w)
+                    for u, w in normalize_backends(backend_uri)]
         route = Route(prefix=prefix.rstrip("/"), mode="sync",
-                      backend_uri=backend_uri.rstrip("/"),
+                      backend_uri=backends[0][0],
+                      backends=backends,
                       max_body_bytes=max_body_bytes)
         self.routes.append(route)
         handler = self._make_sync_handler(route)
@@ -257,7 +264,11 @@ class Gateway:
     def _make_sync_handler(self, route: Route):
         async def handler(request: web.Request) -> web.Response:
             tail = request.match_info.get("tail", "")
-            target = route.backend_uri + (("/" + tail) if tail else "")
+            # Weighted per-request pick over the route's backend set
+            # (single-backend routes skip the RNG) — Istio's weighted
+            # VirtualService subsets, at the gateway.
+            base = pick_backend(route.backends)
+            target = base + (("/" + tail) if tail else "")
             if request.query_string:
                 target += "?" + request.query_string
             body = await self._read_limited(request, route)

@@ -16,6 +16,7 @@ from .broker import DispatcherPool, InMemoryBroker
 from .gateway import Gateway
 from .metrics import DEFAULT_REGISTRY, MetricsRegistry
 from .service import APIService, LocalTaskManager
+from .utils.backends import Weighted, normalize_backends
 from .taskstore import (InMemoryTaskStore, JournaledTaskStore,
                         TaskStatus, endpoint_path)
 
@@ -144,7 +145,7 @@ class LocalPlatform:
         if self.config.transport == "push":
             # Webhook routes are recorded so a demoted-then-re-promoted
             # node can rebuild the push transport (demote_now closes it).
-            self._push_routes: list[tuple[str, str]] = []
+            self._push_routes: list[tuple[str, Weighted]] = []
             self._build_push()
         elif self.config.transport == "queue":
             if self.config.native_broker:
@@ -217,7 +218,7 @@ class LocalPlatform:
         self.services.append(svc)
         return svc
 
-    def publish_async_api(self, public_prefix: str, backend_uri: str,
+    def publish_async_api(self, public_prefix: str, backend_uri,
                           retry_delay: float | None = None,
                           concurrency: int | None = None,
                           autoscale=None,
@@ -228,15 +229,19 @@ class LocalPlatform:
         + a function app per API; here it's one call). Passing an
         ``AutoscalePolicy`` as ``autoscale`` attaches the HPA-style control
         loop (the reference's per-API ``autoscaler.yaml``) to the
-        dispatcher's delivery fan-out."""
-        self.gateway.add_async_route(public_prefix, backend_uri,
+        dispatcher's delivery fan-out. ``backend_uri`` may be a weighted
+        backend LIST (canary; ``utils/backends.py``) — the recorded task
+        Endpoint is the primary's (path identity is shared by
+        construction), deliveries split per the weights."""
+        backends = normalize_backends(backend_uri)
+        self.gateway.add_async_route(public_prefix, backends[0][0],
                                      max_body_bytes=max_body_bytes)
-        self.register_internal_route(backend_uri, retry_delay=retry_delay,
+        self.register_internal_route(backends, retry_delay=retry_delay,
                                      concurrency=concurrency,
                                      autoscale=autoscale,
                                      autoscale_interval=autoscale_interval)
 
-    def register_internal_route(self, backend_uri: str,
+    def register_internal_route(self, backend_uri,
                                 retry_delay: float | None = None,
                                 concurrency: int | None = None,
                                 autoscale=None,
@@ -244,8 +249,9 @@ class LocalPlatform:
         """Transport consumer for a backend WITHOUT a public gateway route —
         internal pipeline stages (e.g. the classifier batch endpoint a
         detector's crops handoff targets) are reachable only by republished
-        tasks, never by clients."""
-        queue_name = endpoint_path(backend_uri)
+        tasks, never by clients. Accepts a weighted backend list (canary)."""
+        backend_uri = normalize_backends(backend_uri)
+        queue_name = endpoint_path(backend_uri[0][0])
         if self.config.transport == "push":
             if autoscale is not None or retry_delay is not None or concurrency is not None:
                 raise ValueError(
@@ -266,7 +272,7 @@ class LocalPlatform:
                 policy=autoscale, interval=autoscale_interval,
                 metrics=self.metrics))
 
-    def publish_sync_api(self, public_prefix: str, backend_uri: str,
+    def publish_sync_api(self, public_prefix: str, backend_uri,
                          max_body_bytes: int | None = None) -> None:
         self.gateway.add_sync_route(public_prefix, backend_uri,
                                     max_body_bytes=max_body_bytes)

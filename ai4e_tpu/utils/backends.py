@@ -1,0 +1,75 @@
+"""Weighted backend sets — canary/blue-green traffic splitting.
+
+The reference's Istio VirtualService tier supports weighted subsets but its
+shipped routing never used them (``APIs/Charts/templates/routing.yml`` —
+plain ROUND_ROBIN to one Service); model rollouts were all-or-nothing image
+rolls. Here a route or dispatcher can name SEVERAL backends with weights —
+e.g. 95% of traffic to the fleet, 5% to one worker serving a candidate
+checkpoint — and every delivery picks independently. Combined with the
+worker's hot-reload endpoint this is the full rollout story: canary one
+replica, watch its per-model metrics, then reload the fleet.
+
+One rule keeps the task plane coherent: every backend of a set must share
+the same endpoint PATH (only hosts differ). The queue name, the recorded
+task ``Endpoint``, and the rebase rule (``rebase_endpoint``) are all
+path-derived, so a path mismatch would silently split a queue's identity.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable
+
+from ..taskstore.task import endpoint_path
+
+Weighted = list[tuple[str, float]]
+
+
+def normalize_backends(backend_uri: str | Iterable) -> Weighted:
+    """One backend URI, or an iterable of ``"uri"`` / ``{"uri", "weight"}``
+    / ``(uri, weight)`` entries → a validated ``[(uri, weight), ...]``.
+
+    Weights are relative (they need not sum to anything); an entry may be 0
+    (kept registered but receiving no traffic — the drained side of a
+    blue/green flip); at least one weight must be positive; every URI must
+    share one endpoint path."""
+    if isinstance(backend_uri, str):
+        return [(backend_uri, 1.0)]
+    if (isinstance(backend_uri, list) and backend_uri
+            and all(isinstance(e, tuple) and len(e) == 2
+                    and isinstance(e[0], str) and isinstance(e[1], float)
+                    for e in backend_uri)):
+        # Already normalized (every producer of this exact shape ran the
+        # validation below) — registration paths hand sets down through
+        # several layers and must not pay or drift on re-validation.
+        return backend_uri
+    out: Weighted = []
+    for entry in backend_uri:
+        if isinstance(entry, str):
+            uri, weight = entry, 1.0
+        elif isinstance(entry, dict):
+            uri, weight = entry["uri"], float(entry.get("weight", 1.0))
+        else:
+            uri, weight = entry[0], float(entry[1])
+        if weight < 0:
+            raise ValueError(f"negative backend weight for {uri!r}")
+        out.append((uri, weight))
+    if not out:
+        raise ValueError("backend list is empty")
+    if all(w == 0 for _, w in out):
+        raise ValueError("every backend has weight 0 — nothing can serve")
+    paths = {endpoint_path(u) for u, _ in out}
+    if len(paths) > 1:
+        raise ValueError(
+            "canary backends must share one endpoint path (only hosts may "
+            f"differ): got {sorted(paths)}")
+    return out
+
+
+def pick_backend(backends: Weighted, rng: random.Random | None = None) -> str:
+    """One weighted independent pick. Single-backend sets skip the RNG —
+    the common deployment pays nothing for the feature existing."""
+    if len(backends) == 1:
+        return backends[0][0]
+    uris, weights = zip(*backends)
+    return (rng or random).choices(uris, weights=weights, k=1)[0]
