@@ -154,7 +154,11 @@ class Dispatcher:
             except Exception:  # noqa: BLE001 — dispatcher must never die
                 log.exception("dispatch of task %s crashed; redelivering", msg.task_id)
                 if not self.broker.abandon(msg):
-                    self._dispatched.inc(outcome="dead_letter", queue=self.queue_name)
+                    # Lease-reaper path: no delivery was attempted here, so
+                    # there is no target host — empty label keeps the
+                    # series key set consistent with the delivery path.
+                    self._dispatched.inc(outcome="dead_letter",
+                                         queue=self.queue_name, backend="")
                     await self._try_update(
                         msg.task_id, TaskStatus.DEAD_LETTER,
                         TaskStatus.FAILED)
@@ -168,8 +172,14 @@ class Dispatcher:
         return rebase_endpoint(msg.endpoint, self.queue_name, base)
 
     async def _dispatch_one(self, msg: Message) -> None:
+        from urllib.parse import urlparse
+
         from ..observability import get_tracer
         target = self._target_for(msg)
+        # Per-backend outcome label: the canary loop is "watch the canary's
+        # error rate, then promote" — without the host dimension a canary's
+        # failures would vanish into the fleet's counter.
+        backend = urlparse(target).netloc
         session = await self._sessions.get()
         tracer = get_tracer()
         try:
@@ -196,32 +206,38 @@ class Dispatcher:
             # restarting; broker patience (max deliveries) bounds total retry.
             log.warning("backend %s unreachable (%s); will redeliver",
                         target, exc)
-            await self._backpressure(msg)
+            await self._backpressure(msg, backend=backend)
             return
 
         if 200 <= status < 300:
             self.broker.complete(msg)
-            self._dispatched.inc(outcome="delivered", queue=self.queue_name)
+            self._dispatched.inc(outcome="delivered", queue=self.queue_name,
+                                 backend=backend)
         elif status in BACKPRESSURE_CODES:
-            await self._backpressure(msg)
+            await self._backpressure(msg, backend=backend)
         else:
             # Permanent failure: complete (no redelivery) + fail the task
             # (BackendQueueProcessor.cs:65-70).
             self.broker.complete(msg)
-            self._dispatched.inc(outcome="failed", queue=self.queue_name)
+            self._dispatched.inc(outcome="failed", queue=self.queue_name,
+                                 backend=backend)
             await self._try_update(
                 msg.task_id,
                 f"failed - backend returned {status}",
                 TaskStatus.FAILED,
             )
 
-    async def _backpressure(self, msg: Message) -> None:
-        self._dispatched.inc(outcome="backpressure", queue=self.queue_name)
+    async def _backpressure(self, msg: Message, backend: str) -> None:
+        self._dispatched.inc(outcome="backpressure", queue=self.queue_name,
+                             backend=backend)
         await self._try_update(msg.task_id, AWAITING_STATUS, TaskStatus.CREATED)
         await asyncio.sleep(self.retry_delay)
         if not self.broker.abandon(msg):
-            # Dead-lettered: out of delivery budget.
-            self._dispatched.inc(outcome="dead_letter", queue=self.queue_name)
+            # Dead-lettered: out of delivery budget — the backend that was
+            # just attempted is the one whose failures spent it; a canary
+            # killing tasks must show in ITS per-backend series.
+            self._dispatched.inc(outcome="dead_letter", queue=self.queue_name,
+                                 backend=backend)
             await self._try_update(
                 msg.task_id, TaskStatus.DEAD_LETTER,
                 TaskStatus.FAILED)

@@ -420,6 +420,8 @@ class WebhookDispatcher:
                                    f"failed - no backend route for {event.subject}",
                                    TaskStatus.FAILED)
             return 200  # ack: retrying an unroutable event cannot help
+        from urllib.parse import urlparse
+        backend = urlparse(target).netloc  # canary observability dimension
         tracer = get_tracer()
         session = await self._sessions.get()
         try:
@@ -435,18 +437,18 @@ class WebhookDispatcher:
         except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
             # Backend unreachable — let the topic retry (pod may be starting).
             log.warning("webhook backend %s unreachable: %s", target, exc)
-            self._forwarded.inc(outcome="unreachable")
+            self._forwarded.inc(outcome="unreachable", backend=backend)
             return 429
         if 200 <= status < 300:
-            self._forwarded.inc(outcome="delivered")
+            self._forwarded.inc(outcome="delivered", backend=backend)
             return 200
         if status in BACKPRESSURE_CODES:
             # Saturated backend: mark awaiting, pass 429 through so the
             # topic's backoff schedule drives the retry (BackendWebhook.cs:69-72).
-            self._forwarded.inc(outcome="backpressure")
+            self._forwarded.inc(outcome="backpressure", backend=backend)
             await self._try_update(event.id, AWAITING_STATUS, TaskStatus.CREATED)
             return 429
-        self._forwarded.inc(outcome="failed")
+        self._forwarded.inc(outcome="failed", backend=backend)
         await self._try_update(event.id, f"failed - backend returned {status}",
                                TaskStatus.FAILED)
         return 200  # permanent failure: ack, no redelivery

@@ -186,3 +186,48 @@ class TestCanaryPushWebhook:
         assert targets["http://fleet:1/v1/split/run-async?x=1"] > 0
         assert targets["http://canary:1/v1/split/run-async?x=1"] > 0
         assert sum(targets.values()) == 60
+
+
+class TestCanaryObservability:
+    def test_dispatch_counter_carries_backend_label(self):
+        """The rollout loop is "watch the canary's error rate, then
+        promote" — the dispatch counter must break out by target host or a
+        canary's failures vanish into the fleet's numbers."""
+        from urllib.parse import urlparse
+
+        from ai4e_tpu.metrics import DEFAULT_REGISTRY
+
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            hits = {"A": []}
+            a = await _counting_service("A", hits, platform.task_manager)
+            a_uri = str(a.make_url("/v1/split/run-async"))
+            host = urlparse(a_uri).netloc
+            counter = DEFAULT_REGISTRY.counter(
+                "ai4e_dispatch_total", "Dispatch attempts by outcome")
+            before = counter.value(outcome="delivered",
+                                   queue="/v1/split/run-async", backend=host)
+            platform.publish_async_api("/v1/public/split", a_uri)
+            gw = await TestClient(TestServer(platform.gateway.app)).__aenter__()
+            await platform.start()
+            try:
+                for _ in range(3):
+                    await gw.post("/v1/public/split", data=b"x")
+                # Poll on the COUNTER: the backend handler returns before
+                # the dispatcher reads the response and increments, so
+                # polling on hits would race the third increment.
+                after = before
+                for _ in range(200):
+                    after = counter.value(outcome="delivered",
+                                          queue="/v1/split/run-async",
+                                          backend=host)
+                    if after - before >= 3:
+                        break
+                    await asyncio.sleep(0.02)
+                assert after - before == 3
+            finally:
+                await platform.stop()
+                await gw.close()
+                await a.close()
+
+        run(main())
