@@ -134,7 +134,11 @@ class PlatformSection:
     reaper_interval: float = 30.0
     reaper_max_requeues: int = 3
     # Terminal-history retention (s): evict completed/failed tasks older
-    # than this (memory/journal bound); unset keeps history forever.
+    # than this — the memory bound a sustained-traffic control plane needs
+    # (a 20-min 200 req/s soak grew an unevicted store ~12 MB/min). Unset
+    # = AUTO: 15 min on the Python store (bounds that workload's steady
+    # state at ~180 MB), off on the native store (which has no eviction).
+    # 0 = evict terminal tasks immediately; negative = keep forever.
     reaper_terminal_retention: typing.Optional[float] = None
     # Object-store result offload (assign_storage_auth_to_aks.sh:9-17 slot):
     # results >= threshold bytes land under result_dir instead of store memory.

@@ -43,6 +43,10 @@ class PlatformConfig:
     reaper_max_requeues: int = 3
     # Terminal-history retention (seconds): completed/failed tasks older
     # than this are evicted (memory + journal bound); None keeps forever.
+    # None = AUTO (15 min on the Python store, off on the native store);
+    # >=0 = explicit retention seconds (0 = evict terminal tasks
+    # immediately, the pre-r5 meaning, preserved); < 0 = explicitly keep
+    # history forever.
     reaper_terminal_retention: float | None = None
     # Object-store slot for large results (assign_storage_auth_to_aks.sh:9-17):
     # results >= the threshold are written under result_dir (a local dir, PD,
@@ -127,9 +131,12 @@ class LocalPlatform:
                 raise ValueError(
                     "result_dir offload requires the Python store "
                     "(the native store keeps results in its own memory)")
-            if self.config.reaper_terminal_retention is not None:
-                # Fail loudly: a retention knob that silently never evicts
-                # is exactly the OOM it exists to prevent.
+            ret = self.config.reaper_terminal_retention
+            if ret is not None and ret >= 0:
+                # Fail loudly on an EXPLICIT retention: a knob that
+                # silently never evicts is exactly the OOM it exists to
+                # prevent. (AUTO/None and negative opt-out both mean no
+                # eviction here — the native store has none.)
                 raise ValueError(
                     "reaper_terminal_retention requires the Python store "
                     "(the native store has no eviction)")
@@ -167,16 +174,29 @@ class LocalPlatform:
                 f"unknown transport {self.config.transport!r}; "
                 "expected 'queue' or 'push'")
         self.gateway = Gateway(self.store, metrics=self.metrics)
+        # Terminal-history retention: None = AUTO — 15 min on the Python
+        # store, sized to the soak evidence (unevicted terminal history
+        # grows ~12 MB/min at 200 req/s → AUTO bounds steady-state at
+        # ~180 MB, the level the retention-on soak measured flat;
+        # bench_results/r5-cpu/). 0 keeps its pre-r5 meaning (evict
+        # terminal tasks immediately); NEGATIVE opts out of eviction
+        # entirely. Nothing on the native store (no eviction support).
+        # Redis expiry played this role for the reference.
+        retention = self.config.reaper_terminal_retention
+        if retention is None and not self.config.native_store:
+            retention = 900.0
+        if retention is not None and retention < 0:
+            retention = None
         self.reaper = None
         if (self.config.reaper_running_timeout is not None
-                or self.config.reaper_terminal_retention is not None):
+                or retention is not None):
             from .taskstore.reaper import TaskReaper
             self.reaper = TaskReaper(
                 self.store,
                 running_timeout=self.config.reaper_running_timeout,
                 interval=self.config.reaper_interval,
                 max_requeues=self.config.reaper_max_requeues,
-                terminal_retention=self.config.reaper_terminal_retention,
+                terminal_retention=retention,
                 metrics=self.metrics)
         from .observability import DepthLogger
         self.depth_logger = DepthLogger(

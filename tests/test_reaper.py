@@ -182,3 +182,53 @@ class TestNoResurrection:
             assert store.get(task.task_id).canonical_status == TaskStatus.COMPLETED
 
         run(main())
+
+
+class TestAutoRetentionDefault:
+    """Terminal-history retention defaults (the 20-min soak finding: an
+    unevicted control plane grows ~12 MB/min at 200 req/s — scripts/soak.sh,
+    bench_results/r5-cpu/). None = AUTO (15 min on the Python store), 0
+    keeps its pre-AUTO evict-immediately meaning, negative opts out,
+    native store = no eviction support."""
+
+    def test_python_store_gets_auto_retention(self):
+        from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+        platform = LocalPlatform(PlatformConfig())
+        assert platform.reaper is not None
+        assert platform.reaper.terminal_retention == 900.0
+
+    def test_zero_keeps_its_evict_immediately_meaning(self):
+        # 0 predates the AUTO default and always meant "evict terminal
+        # tasks as soon as the sweep sees them" — the most aggressive
+        # valid bound. The opt-out is NEGATIVE, so old configs keep their
+        # behavior.
+        from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+        platform = LocalPlatform(
+            PlatformConfig(reaper_terminal_retention=0))
+        assert platform.reaper is not None
+        assert platform.reaper.terminal_retention == 0
+
+    def test_negative_opts_out(self):
+        from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+        platform = LocalPlatform(
+            PlatformConfig(reaper_terminal_retention=-1))
+        assert platform.reaper is None
+
+    def test_explicit_retention_respected(self):
+        from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+        platform = LocalPlatform(
+            PlatformConfig(reaper_terminal_retention=120.0))
+        assert platform.reaper.terminal_retention == 120.0
+
+    def test_native_store_auto_disables_explicit_raises(self):
+        import pytest
+
+        from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+        try:
+            platform = LocalPlatform(PlatformConfig(native_store=True))
+        except (ImportError, OSError):
+            pytest.skip("native store unavailable on this host")
+        assert platform.reaper is None  # AUTO silently off: no eviction
+        with pytest.raises(ValueError, match="requires the Python store"):
+            LocalPlatform(PlatformConfig(native_store=True,
+                                         reaper_terminal_retention=60.0))
