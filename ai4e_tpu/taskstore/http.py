@@ -132,6 +132,8 @@ def make_app(store: InMemoryTaskStore,
         # create (CacheConnectorUpsert.cs decides the same way, :90-108).
         try:
             task = store.upsert(task)
+        except ValueError as exc:  # reserved characters in a supplied TaskId
+            return web.json_response({"error": str(exc)}, status=400)
         except NotPrimaryError:
             return not_primary()
         return web.json_response(store.get(task.task_id).to_dict())

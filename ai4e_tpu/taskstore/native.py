@@ -143,6 +143,12 @@ class NativeTaskStore(StoreSideEffects):
     # -- core state machine (InMemoryTaskStore surface) --------------------
 
     def upsert(self, task: APITask) -> APITask:
+        if ":" in task.task_id:
+            # Same guard as the Python store: ':' is the result-key stage
+            # separator; see InMemoryTaskStore.upsert.
+            raise ValueError(
+                f"TaskId must not contain ':' (reserved as the result "
+                f"stage separator): {task.task_id!r}")
         stored = self._consume(self._lib.tsc_upsert(
             self._handle, task.task_id.encode(), task.endpoint.encode(),
             task.status.encode(), task.backend_status.encode(),

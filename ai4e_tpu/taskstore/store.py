@@ -117,6 +117,12 @@ class InMemoryTaskStore(StoreSideEffects):
         # assign_storage_auth_to_aks.sh:9-17) — only the pointer is held here,
         # so completed-task memory doesn't grow with large batch outputs.
         self._results: dict[str, tuple[bytes | None, str]] = {}
+        # task_id -> result keys owned by it ("{tid}" / "{tid}:{stage}"):
+        # eviction must be O(victim's results), not O(all results) — the
+        # 40-min soak wedged the store for minutes when each of ~6k
+        # victims scanned ~190k result keys under the lock
+        # (bench_results/r5-cpu/).
+        self._result_keys: dict[str, set[str]] = {}
         self._result_backend = result_backend
         self._result_offload_threshold = result_offload_threshold
         # (endpoint_path, canonical_status) -> {task_id: score}; insertion
@@ -143,7 +149,16 @@ class InMemoryTaskStore(StoreSideEffects):
           by now;
         - ``publish=True`` → hand to the broker; on broker failure the task is
           marked failed instead of raising to the caller.
+
+        Client-supplied TaskIds must not contain ``:`` — it is the result
+        namespace's stage separator (``{taskId}:{stage}`` keys), and an id
+        carrying one would alias another task's result keys (eviction
+        could then leak this task's results or destroy a neighbor's).
         """
+        if ":" in task.task_id:
+            raise ValueError(
+                f"TaskId must not contain ':' (reserved as the result "
+                f"stage separator): {task.task_id!r}")
         with self._lock:
             task = self._apply_upsert(task)
             publisher = self._publisher if task.publish else None
@@ -287,10 +302,11 @@ class InMemoryTaskStore(StoreSideEffects):
         self._remove_from_set(task)
         self._orig_bodies.pop(task_id, None)
         blob_keys = []
-        for key in [k for k in self._results
-                    if k == task_id or k.startswith(task_id + ":")]:
-            body, _ctype = self._results.pop(key)
-            if body is None:
+        # O(this task's results) via the key index — NEVER a scan of all
+        # results (each victim of a bulk eviction would pay O(history)).
+        for key in self._result_keys.pop(task_id, ()):
+            found = self._results.pop(key, None)
+            if found is not None and found[0] is None:
                 blob_keys.append(key)
         return blob_keys
 
@@ -338,6 +354,7 @@ class InMemoryTaskStore(StoreSideEffects):
         holds ``self._lock``; the journaled subclass extends this."""
         prev = self._results.get(key)
         self._results[key] = (result, content_type)
+        self._result_keys.setdefault(key.split(":", 1)[0], set()).add(key)
         if (prev is not None and prev[0] is None and result is not None):
             # An inline value superseded an offloaded pointer — the stale
             # blob is unreachable now; delete it. (Pointer→pointer rewrites
@@ -566,6 +583,8 @@ class JournaledTaskStore(InMemoryTaskStore):
                     else bytes.fromhex(rec.get("ResultHex", "")))
             self._results[rec["Key"]] = (
                 body, rec.get("ContentType", "application/json"))
+            self._result_keys.setdefault(
+                rec["Key"].split(":", 1)[0], set()).add(rec["Key"])
             return
         if rec.get("Evict"):
             # Journal is None during replay, so the subclass's
@@ -869,6 +888,7 @@ class FollowerTaskStore(JournaledTaskStore):
             self._tasks.clear()
             self._orig_bodies.clear()
             self._results.clear()
+            self._result_keys.clear()
             self._sets.clear()
             self._records = 0
             self._raw.close()

@@ -21,6 +21,19 @@ export AI4E_PLATFORM_RETRY_DELAY=0.2
 CP_PORT=18889
 WK_PORT=18890
 
+# A previous soak's control plane can outlive its SIGTERM by minutes if it
+# was wedged in store work when the trap fired (the signal lands when the
+# event loop breathes) — wait for the ports, then escalate to SIGKILL on
+# whatever still holds them.
+for port in "$CP_PORT" "$WK_PORT"; do
+    for _ in $(seq 1 30); do
+        ss -tln 2>/dev/null | grep -q ":${port} " || break
+        sleep 2
+    done
+    ss -tlnp 2>/dev/null | grep ":${port} " | grep -oP 'pid=\K[0-9]+' \
+        | head -1 | xargs -r kill -9
+done
+
 cat > "$OUT/routes.json" <<EOF
 {"apis": [{"prefix": "/v1/echo/run-async",
            "backend": "http://127.0.0.1:${WK_PORT}/v1/echo/run-async",
@@ -46,7 +59,7 @@ CP_PID=$!
 python -m ai4e_tpu worker --models "$OUT/models.json" \
     --port "$WK_PORT" > "$OUT/wk.log" 2>&1 &
 WK_PID=$!
-trap 'kill $CP_PID $WK_PID 2>/dev/null' EXIT
+trap 'kill $CP_PID $WK_PID 2>/dev/null; sleep 3; kill -9 $CP_PID $WK_PID 2>/dev/null' EXIT
 
 for _ in $(seq 1 120); do
     curl -sf "http://127.0.0.1:${CP_PORT}/healthz" >/dev/null 2>&1 && break

@@ -661,3 +661,44 @@ class TestDirectToStorageResults:
         assert revived.get_result(t.task_id) == (
             b"direct" * 100, "application/octet-stream")
         revived.close()
+
+
+class TestEvictionScales:
+    def test_bulk_eviction_is_linear_in_victims(self):
+        """Eviction must be O(victims' results), not O(victims × all
+        results): the 40-min soak wedged the control plane for minutes when
+        ~6k victims each scanned ~190k result keys under the store lock
+        (bench_results/r5-cpu/). 20k tasks-with-results evicted here in
+        well under the old quadratic path's ~40 s."""
+        import time as _time
+
+        from ai4e_tpu.taskstore import InMemoryTaskStore
+        from ai4e_tpu.taskstore.task import APITask
+
+        store = InMemoryTaskStore()
+        for i in range(20000):
+            t = store.upsert(APITask(task_id=f"t{i}", endpoint="http://h/v1/x",
+                                     body=b"b", status="completed",
+                                     backend_status="completed"))
+            store.set_result(t.task_id, b'{"ok":1}')
+        t0 = _time.perf_counter()
+        evicted = store.evict_terminal_older_than(0.0)
+        elapsed = _time.perf_counter() - t0
+        assert evicted == 20000
+        assert not store._results and not store._result_keys
+        assert elapsed < 10.0, f"bulk eviction took {elapsed:.1f}s"
+
+    def test_colon_task_ids_rejected(self):
+        """':' is the result-key stage separator — a client-supplied id
+        carrying one would alias another task's result namespace (the
+        eviction index derives the owner by splitting on ':'), so it is
+        refused at every write boundary."""
+        import pytest
+
+        from ai4e_tpu.taskstore import InMemoryTaskStore
+        from ai4e_tpu.taskstore.task import APITask
+
+        store = InMemoryTaskStore()
+        with pytest.raises(ValueError, match="must not contain"):
+            store.upsert(APITask(task_id="job:7", endpoint="http://h/v1/x",
+                                 body=b"b"))

@@ -448,3 +448,20 @@ class TestRedrive:
                 await client.close()
 
         run(main())
+
+    def test_colon_task_id_is_400_on_the_wire(self):
+        store = InMemoryTaskStore()
+
+        async def main():
+            client = TestClient(TestServer(make_app(store)))
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/v1/taskstore/upsert",
+                    json={"TaskId": "job:7", "Endpoint": "http://h/v1/x"})
+                assert resp.status == 400
+                assert "must not contain" in (await resp.json())["error"]
+            finally:
+                await client.close()
+
+        run(main())
