@@ -250,7 +250,11 @@ class Gateway:
                     return web.json_response(
                         {"error": "standby replica; task creation is on "
                                   "the primary"},
-                        status=503, headers={"Retry-After": "2"})
+                        status=503,
+                        # Same marker as the store surface: clients with a
+                        # replica list rotate ONLY on this header — a plain
+                        # overload 503 must never re-home them (ADVICE r4).
+                        headers={"Retry-After": "2", "X-Not-Primary": "1"})
                 span.task_id = task.task_id
             stored = self.store.get(task.task_id)
             outcome = "failed" if stored.canonical_status == "failed" else "created"

@@ -116,7 +116,19 @@ class _HttpStoreClient:
     def __init__(self, base_url: str | list[str],
                  session: aiohttp.ClientSession | None = None,
                  api_key: str | None = None,
-                 failover_cycles: int = 3, failover_delay: float = 0.5):
+                 failover_cycles: int = 10, failover_delay: float = 1.0):
+        """``failover_cycles``/``failover_delay`` size the replica-set
+        patience: with a list, a request gives the pair
+        ``cycles × delay`` (~9 s at the defaults) before surfacing an
+        error. It must COVER the watchdog's promotion window (default
+        detection alone is ``failover_down_after × failover_interval``
+        = 6 s) — the live failover drive measured tasks whose inference
+        SUCCEEDED being FailTask'd because a ~1.5 s patience expired
+        inside a ~2 s promotion (scripts/ha_failover_drive.py; 6 of 18k
+        tasks at even an aggressive 0.5 s watchdog). Giving up early
+        converts a transient window into a permanent task failure, so
+        patience errs long; single-endpoint deployments skip all of
+        this (no cycles, no delay)."""
         urls = [base_url] if isinstance(base_url, str) else list(base_url)
         if not urls:
             raise ValueError("at least one task-store URL is required")

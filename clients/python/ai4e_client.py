@@ -43,15 +43,29 @@ class TaskTimeout(TimeoutError):
 
 
 class AI4EClient:
-    def __init__(self, gateway: str, api_key: str | None = None,
+    def __init__(self, gateway: str | list, api_key: str | None = None,
                  timeout: float = 60.0, retries: int = 4,
                  retry_backoff: float = 1.0):
         """``retries``: transparent retries of backpressure responses —
         429 (per-key rate limit, honoring the gateway's ``Retry-After``
         delta-seconds) and 503 (admission backpressure) — with exponential
         backoff when no Retry-After is given. 0 disables (the raw
-        HTTPError surfaces)."""
-        self.gateway = gateway.rstrip("/")
+        HTTPError surfaces).
+
+        ``gateway`` may be a LIST of gateway URLs (the control-plane HA
+        pair, primary first): a dead replica (connection refused/reset)
+        or a backpressuring one (503 — a standby answers that until the
+        watchdog promotes it) rotates the client to the next, sticking
+        with whichever answered — the same rotation the in-cluster store
+        clients do, for callers that reach the pair directly instead of
+        through a load balancer/Service VIP. With one URL, connection
+        errors surface immediately (nothing to rotate to) and behavior is
+        unchanged."""
+        gateways = [gateway] if isinstance(gateway, str) else list(gateway)
+        if not gateways:
+            raise ValueError("at least one gateway URL is required")
+        self._gateways = [g.rstrip("/") for g in gateways]
+        self.gateway = self._gateways[0]  # active; sticky on success
         self.timeout = timeout
         self.retries = retries
         self.retry_backoff = retry_backoff
@@ -70,31 +84,80 @@ class AI4EClient:
             headers["Content-Type"] = content_type
         attempt = 0
         per_try = self.timeout if timeout is None else timeout
-        # Retry sleeps stay INSIDE the caller's time budget: a wait(
-        # timeout=10) must not block for minutes because status polls are
-        # being throttled with a long Retry-After.
+        # Retry sleeps AND replica attempts stay INSIDE the caller's time
+        # budget: a wait(timeout=10) must not block for minutes because
+        # status polls are throttled or a replica black-holes.
         deadline = time.monotonic() + per_try
         while True:
-            req = urllib.request.Request(self.gateway + path, data=body,
-                                         headers=headers, method=method)
-            try:
-                return urllib.request.urlopen(req, timeout=per_try)
-            except urllib.error.HTTPError as exc:
-                if exc.code not in (429, 503) or attempt >= self.retries:
-                    raise
-                retry_after = exc.headers.get("Retry-After")
+            # One pass over the replica set, active gateway first.
+            # Rotation semantics mirror the in-cluster store clients
+            # (ADVICE r4): ONLY a connection failure or a 503 carrying
+            # X-Not-Primary moves to the next replica. A plain 429/503 is
+            # backpressure from a HEALTHY gateway — fanning the same
+            # request out to the other replica would multiply load
+            # precisely when the system asked us to back off, so it ends
+            # the pass and its Retry-After governs the sleep.
+            ordered = ([self.gateway]
+                       + [g for g in self._gateways if g != self.gateway])
+            backpressure = None
+            not_primary = None
+            conn_error = None
+            for base in ordered:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break  # budget spent mid-pass (hung replica)
+                req = urllib.request.Request(base + path, data=body,
+                                             headers=headers, method=method)
+                try:
+                    resp = urllib.request.urlopen(
+                        req, timeout=min(per_try, remaining))
+                    self.gateway = base
+                    return resp
+                except urllib.error.HTTPError as exc:
+                    if exc.code == 503 and exc.headers.get("X-Not-Primary"):
+                        # Standby (or fenced ex-primary): try the peer.
+                        if not_primary is not None:
+                            not_primary.close()
+                        not_primary = exc
+                        continue
+                    if exc.code not in (429, 503):
+                        self.gateway = base  # it answered; it is the one
+                        raise
+                    backpressure = exc
+                    break  # backpressure: do NOT fan out to the peer
+                except (urllib.error.URLError, OSError) as exc:
+                    if len(ordered) == 1:
+                        raise  # nothing to rotate to — unchanged behavior
+                    conn_error = exc
+            # The real signal to surface/sleep on: explicit backpressure
+            # beats not-primary (which carries its own short Retry-After)
+            # beats a bare connection error.
+            signal = backpressure or not_primary
+            for extra in (backpressure, not_primary):
+                if extra is not None and extra is not signal:
+                    extra.close()
+            if attempt >= self.retries:
+                if signal is not None:
+                    raise signal
+                raise conn_error
+            delay = 0.0
+            if signal is not None:
+                retry_after = signal.headers.get("Retry-After")
                 try:
                     delay = float(retry_after) if retry_after else 0.0
                 except ValueError:
                     delay = 0.0
-                if delay <= 0:
-                    delay = self.retry_backoff * (2 ** attempt)
-                delay = min(delay, 60.0)
-                if time.monotonic() + delay >= deadline:
-                    raise  # budget exhausted — surface the backpressure
-                exc.close()
-                time.sleep(delay)
-                attempt += 1
+            if delay <= 0:
+                delay = self.retry_backoff * (2 ** attempt)
+            delay = min(delay, 60.0)
+            if time.monotonic() + delay >= deadline:
+                if signal is not None:
+                    raise signal  # budget exhausted
+                raise conn_error
+            if signal is not None:
+                signal.close()
+            time.sleep(delay)
+            attempt += 1
 
     # -- async task API ----------------------------------------------------
 

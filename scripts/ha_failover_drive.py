@@ -1,0 +1,211 @@
+"""Live HA drive: sustained caller traffic across a SIGKILL failover.
+
+Topology (real OS processes): primary + standby control planes (journal
+replication, watchdog, fencing pair) and one worker whose store client
+holds the replica set. Caller threads drive the PUBLIC surface through
+the SDK's gateway rotation (``AI4EClient([primary, standby])``) — submit
+→ long-poll wait → verify — while the primary is SIGKILLed mid-run.
+
+What "good" looks like (and what this measures, honestly):
+
+- tasks completed before the kill keep their results readable after it
+  (journal replication carries results);
+- the standby promotes within ~2 s (watchdog), re-seeds undelivered
+  tasks, and traffic continues with the SAME client objects — no
+  restarts anywhere;
+- the loss window is REPLICATION LAG, not a crash hole: a task whose
+  create record had not reached the standby when the primary died is
+  gone (async replication — the design tradeoff vs. the reference's
+  managed Redis). Such tasks surface as 404 on the surviving replica;
+  callers resubmit. The drive counts them (`lost_to_lag`) and resubmits
+  once; the count must be tiny (the replicator long-polls continuously).
+
+Usage: python scripts/ha_failover_drive.py [seconds] [outdir]
+Prints one JSON summary; exit 0 iff completions happened on BOTH sides
+of the kill, nothing failed, and every loss was recovered by resubmit.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import io
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_spec = importlib.util.spec_from_file_location(
+    "ai4e_client", os.path.join(REPO, "clients", "python", "ai4e_client.py"))
+ai4e_client = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(ai4e_client)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_http(url: str, timeout: float = 120.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2):
+                return
+        except Exception:
+            time.sleep(0.5)
+    raise TimeoutError(url)
+
+
+def main() -> int:
+    seconds = float(sys.argv[1]) if len(sys.argv) > 1 else 240.0
+    out = sys.argv[2] if len(sys.argv) > 2 else "/tmp/ha_drive"
+    os.makedirs(out, exist_ok=True)
+    p_port, s_port, w_port = free_port(), free_port(), free_port()
+    p_url, s_url = (f"http://127.0.0.1:{p_port}", f"http://127.0.0.1:{s_port}")
+
+    routes = {"apis": [{"prefix": "/v1/echo/run-async",
+                        "backend": f"http://127.0.0.1:{w_port}/v1/echo/run-async",
+                        "concurrency": 4, "retry_delay": 0.2}]}
+    models = {"service_name": "ha-echo", "prefix": "v1/echo",
+              "taskstore": f"{p_url},{s_url}",
+              "models": [{"family": "echo", "name": "echo", "size": 16,
+                          "buckets": [8], "async_path": "/run-async"}]}
+    with open(f"{out}/routes.json", "w") as f:
+        json.dump(routes, f)
+    with open(f"{out}/models.json", "w") as f:
+        json.dump(models, f)
+
+    env = dict(os.environ, AI4E_RUNTIME_PLATFORM="cpu",
+               AI4E_PLATFORM_RETRY_DELAY="0.2",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+
+    def spawn(name, extra_env, args):
+        log = open(f"{out}/{name}.log", "w")
+        return subprocess.Popen([sys.executable, "-m", "ai4e_tpu", *args],
+                                env={**env, **extra_env},
+                                stdout=log, stderr=subprocess.STDOUT)
+
+    primary = spawn("primary", {
+        "AI4E_PLATFORM_JOURNAL_PATH": f"{out}/pri.jsonl",
+        "AI4E_PLATFORM_ADVERTISE_URL": p_url,
+        "AI4E_PLATFORM_FAILOVER_INTERVAL": "0.5",
+    }, ["control-plane", "--routes", f"{out}/routes.json",
+        "--port", str(p_port)])
+    standby = spawn("standby", {
+        "AI4E_PLATFORM_JOURNAL_PATH": f"{out}/stb.jsonl",
+        "AI4E_PLATFORM_REPLICATE_FROM": p_url,
+        "AI4E_PLATFORM_ADVERTISE_URL": s_url,
+        "AI4E_PLATFORM_FAILOVER_INTERVAL": "0.5",
+    }, ["control-plane", "--routes", f"{out}/routes.json",
+        "--port", str(s_port)])
+    worker = spawn("worker", {}, ["worker", "--models", f"{out}/models.json",
+                                  "--port", str(w_port)])
+    procs = [primary, standby, worker]
+    try:
+        wait_http(f"{p_url}/healthz")
+        wait_http(f"{s_url}/healthz")
+        wait_http(f"http://127.0.0.1:{w_port}/v1/echo/")
+
+        import numpy as np
+        buf = io.BytesIO()
+        np.save(buf, np.arange(16, dtype=np.float32))
+        payload = buf.getvalue()
+
+        kill_at = time.time() + seconds * 0.4
+        deadline = time.time() + seconds
+        counts = {"completed_pre": 0, "completed_post": 0, "failed": 0,
+                  "lost_to_lag": 0, "recovered_by_resubmit": 0,
+                  "wait_timeout": 0, "submit_error": 0, "other_error": 0}
+        lock = threading.Lock()
+        killed = threading.Event()
+
+        def bump(key):
+            with lock:
+                counts[key] += 1
+
+        def caller():
+            client = ai4e_client.AI4EClient([p_url, s_url], timeout=20,
+                                            retries=4, retry_backoff=0.2)
+            while time.time() < deadline:
+                try:
+                    tid = client.submit("/v1/echo/run-async", payload)
+                except Exception:
+                    bump("submit_error")
+                    time.sleep(0.2)
+                    continue
+                resubmitted = False
+                while True:
+                    try:
+                        client.wait(tid, timeout=30)
+                        bump("completed_post" if killed.is_set()
+                             else "completed_pre")
+                        if resubmitted:
+                            bump("recovered_by_resubmit")
+                    except ai4e_client.TaskFailed:
+                        bump("failed")
+                    except ai4e_client.TaskTimeout:
+                        bump("wait_timeout")
+                    except urllib.error.HTTPError as exc:
+                        if exc.code == 404 and not resubmitted:
+                            # Replication lag ate the create record at the
+                            # kill boundary — resubmit, as a caller would.
+                            bump("lost_to_lag")
+                            try:
+                                tid = client.submit("/v1/echo/run-async",
+                                                    payload)
+                                resubmitted = True
+                                continue
+                            except Exception:
+                                bump("submit_error")
+                        else:
+                            bump("other_error")
+                    except Exception:
+                        bump("other_error")
+                    break
+
+        threads = [threading.Thread(target=caller, daemon=True)
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+
+        while time.time() < kill_at:
+            time.sleep(0.2)
+        primary.send_signal(signal.SIGKILL)
+        kill_wall = time.time()
+        killed.set()
+        for t in threads:
+            t.join(timeout=seconds + 120)
+
+        role = json.loads(urllib.request.urlopen(
+            f"{s_url}/v1/taskstore/role", timeout=10).read())
+        summary = {"drive_seconds": seconds,
+                   "killed_primary_at_s": round(kill_wall - (deadline - seconds), 1),
+                   "standby_role_after": role,
+                   **counts}
+        print(json.dumps(summary), flush=True)
+        with open(f"{out}/summary.json", "w") as f:
+            json.dump(summary, f, indent=1)
+        ok = (counts["completed_pre"] > 0 and counts["completed_post"] > 0
+              and counts["failed"] == 0 and counts["other_error"] == 0
+              and counts["lost_to_lag"] == counts["recovered_by_resubmit"]
+              and role.get("role") == "primary")
+        return 0 if ok else 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

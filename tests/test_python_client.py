@@ -156,47 +156,50 @@ class TestPythonClient:
             assert "completed" in record["Status"]
 
 
+def stub_server(script):
+    """Context manager: HTTP server answering POSTs from ``script`` —
+    a list of (status, headers, body) consumed in order (the last entry
+    repeats) — yielding (base_url, call_times). Shared by the
+    backpressure-retry and gateway-rotation tests."""
+    import contextlib
+    import http.server
+    import time as _time
+
+    calls = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            calls.append(_time.monotonic())
+            status, headers, body = script[min(len(calls) - 1,
+                                               len(script) - 1)]
+            self.send_response(status)
+            for k, v in headers.items():
+                self.send_header(k, v)
+            if body:
+                self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    @contextlib.contextmanager
+    def running():
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            yield f"http://127.0.0.1:{srv.server_address[1]}", calls
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    return running()
+
+
 class TestBackpressureRetry:
-    @staticmethod
-    def _stub_server(script):
-        """Context manager: HTTP server answering POSTs from ``script`` —
-        a list of (status, headers, body) consumed in order (the last entry
-        repeats) — yielding (base_url, call_times)."""
-        import contextlib
-        import http.server
-        import time as _time
-
-        calls = []
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def do_POST(self):
-                self.rfile.read(int(self.headers.get("Content-Length", 0)))
-                calls.append(_time.monotonic())
-                status, headers, body = script[min(len(calls) - 1,
-                                                   len(script) - 1)]
-                self.send_response(status)
-                for k, v in headers.items():
-                    self.send_header(k, v)
-                if body:
-                    self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                if body:
-                    self.wfile.write(body)
-
-            def log_message(self, *a):
-                pass
-
-        @contextlib.contextmanager
-        def running():
-            srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-            threading.Thread(target=srv.serve_forever, daemon=True).start()
-            try:
-                yield f"http://127.0.0.1:{srv.server_address[1]}", calls
-            finally:
-                srv.shutdown()
-                srv.server_close()
-
-        return running()
+    _stub_server = staticmethod(stub_server)
 
     def test_429_retried_honoring_retry_after(self):
         """SDK transparently retries throttled requests: two 429s with
@@ -236,3 +239,93 @@ class TestBackpressureRetry:
                 client.submit("/v1/api/run", b"x")
             assert err.value.code == 429
             assert _time.monotonic() - t0 < 2.0  # no 60s sleep happened
+
+
+OK = (200, {"Content-Type": "application/json"}, b'{"TaskId": "t1"}')
+NOT_PRIMARY = (503, {"X-Not-Primary": "1", "Retry-After": "1"},
+               b'{"error": "standby"}')
+
+
+class TestGatewayRotation:
+    """HA-pair client rotation — the store clients' replica-failover
+    semantics (ADVICE r4), on the caller SDK: rotate ONLY on connection
+    failure or an X-Not-Primary 503; plain backpressure never fans the
+    request out to the peer."""
+
+    def test_dead_primary_rotates_and_sticks(self):
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead = f"http://127.0.0.1:{s.getsockname()[1]}"
+        s.close()  # nothing listening
+        with stub_server([OK]) as (live, calls):
+            client = ai4e_client.AI4EClient([dead, live], retries=1)
+            assert client.submit("/v1/x/run-async", b"p") == "t1"
+            assert client.gateway == live  # sticky after rotation
+            assert client.submit("/v1/x/run-async", b"p") == "t1"
+            assert len(calls) == 2
+
+    def test_not_primary_503_rotates_within_one_cycle(self):
+        with stub_server([NOT_PRIMARY]) as (standby, standby_calls), \
+                stub_server([OK]) as (primary, primary_calls):
+            client = ai4e_client.AI4EClient([standby, primary], retries=1)
+            t0 = __import__("time").monotonic()
+            assert client.submit("/v1/x/run-async", b"p") == "t1"
+            # Rotation happened inside one pass — no Retry-After sleep.
+            assert __import__("time").monotonic() - t0 < 2.0
+            assert len(standby_calls) == 1 and len(primary_calls) == 1
+            assert client.gateway == primary
+
+    def test_plain_backpressure_does_not_fan_out(self):
+        # A healthy-but-throttling active gateway (429 + Retry-After) must
+        # NOT cause the request to also hit the peer, and ITS Retry-After
+        # governs the sleep — per-replica load discipline under throttle.
+        throttle = (429, {"Retry-After": "1"}, b"slow down")
+        with stub_server([throttle, OK]) as (active, active_calls), \
+                stub_server([OK]) as (peer, peer_calls):
+            client = ai4e_client.AI4EClient([active, peer], retries=2)
+            assert client.submit("/v1/x/run-async", b"p") == "t1"
+            assert len(active_calls) == 2  # throttled once, then served
+            assert len(peer_calls) == 0    # never fanned out
+            assert active_calls[1] - active_calls[0] >= 0.9  # Retry-After
+
+    def test_single_gateway_connection_error_raises_immediately(self):
+        import socket
+        import urllib.error
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead = f"http://127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        client = ai4e_client.AI4EClient(dead, retries=3)
+        with pytest.raises(urllib.error.URLError):
+            client.submit("/v1/x/run-async", b"p")
+
+    def test_non_backpressure_error_not_retried_across_replicas(self):
+        import urllib.error
+
+        bad = (404, {"Content-Type": "application/json"},
+               b'{"error": "no route"}')
+        with stub_server([bad]) as (a, a_calls), \
+                stub_server([OK]) as (b, b_calls):
+            client = ai4e_client.AI4EClient([a, b], retries=3)
+            with pytest.raises(urllib.error.HTTPError):
+                client.submit("/v1/x/run-async", b"p")
+            assert len(a_calls) == 1 and len(b_calls) == 0  # caller's bug
+
+    def test_failover_window_retries_then_recovers(self):
+        # Both replicas refuse during a promotion window (dead primary +
+        # not-yet-promoted standby), then the standby serves: the client
+        # rides its short Retry-After through the window.
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead = f"http://127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        with stub_server([NOT_PRIMARY, OK]) as (standby, calls):
+            client = ai4e_client.AI4EClient([dead, standby], retries=3,
+                                            retry_backoff=0.1)
+            assert client.submit("/v1/x/run-async", b"p") == "t1"
+            assert len(calls) == 2  # one refusal, then promoted + served

@@ -476,3 +476,24 @@ class TestKillTheStore:
                 follower.close()
 
         run(main())
+
+
+class TestStoreClientFailoverPatience:
+    def test_replica_patience_covers_default_promotion_window(self):
+        """The live failover drive measured tasks whose inference succeeded
+        being FailTask'd because the store client's replica patience
+        (~1.5 s) expired inside the promotion window; patience must cover
+        the DEFAULT watchdog's detection (failover_down_after ×
+        failover_interval = 6 s) with margin. Lowering these defaults is
+        a deliberate act, not a drive-by (scripts/ha_failover_drive.py,
+        bench_results/r5-cpu/ha_failover_drive.json)."""
+        from ai4e_tpu.config import PlatformSection
+        from ai4e_tpu.service.task_manager import HttpTaskManager
+
+        tm = HttpTaskManager(["http://a", "http://b"])
+        patience = tm._failover_cycles * tm._failover_delay
+        section = PlatformSection()
+        detection = section.failover_down_after * section.failover_interval
+        assert patience > detection + 2.0, (
+            f"replica patience {patience}s must exceed watchdog detection "
+            f"{detection}s plus promotion margin")
