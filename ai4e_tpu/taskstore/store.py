@@ -661,10 +661,14 @@ class JournaledTaskStore(InMemoryTaskStore):
             # it must not retry on the very next write (a full O(tasks)
             # rewrite per transition while the disk is already under
             # pressure): back off a full compaction interval either way.
+            import logging
+            before = self._records
             try:
                 self._compact_locked()
+                logging.getLogger("ai4e_tpu.taskstore").info(
+                    "journal compacted: %d -> %d records (generation %d)",
+                    before, self._records, self.journal_generation)
             except OSError:
-                import logging
                 logging.getLogger("ai4e_tpu.taskstore").exception(
                     "journal auto-compaction failed; continuing on the "
                     "append-only journal")
