@@ -81,9 +81,20 @@ class Dispatcher:
         request_timeout: float = 300.0,
         metrics: MetricsRegistry | None = None,
         rng=None,
+        result_cache=None,
+        result_store=None,
     ):
         self.broker = broker
         self.queue_name = queue_name
+        # Inference result cache (rescache/): a message whose task carries a
+        # cache key is checked against it BEFORE the backend POST — a
+        # redelivered/requeued/journal-restored task whose identical request
+        # already completed finishes here, never re-executing on device.
+        # ``result_store`` (duck-typed set_result, e.g. the platform's task
+        # store) receives the cached payload so the client's result fetch
+        # works exactly as on the execute path.
+        self.result_cache = result_cache
+        self.result_store = result_store
         self.backends = normalize_backends(backend_uri)
         # The primary (first) backend — what single-backend consumers and
         # introspection read; weighted picks use the full set.
@@ -175,6 +186,8 @@ class Dispatcher:
         from urllib.parse import urlparse
 
         from ..observability import get_tracer
+        if await self._complete_from_cache(msg):
+            return
         target = self._target_for(msg)
         # Per-backend outcome label: the canary loop is "watch the canary's
         # error rate, then promote" — without the host dimension a canary's
@@ -227,6 +240,44 @@ class Dispatcher:
                 TaskStatus.FAILED,
             )
 
+    async def _complete_from_cache(self, msg: Message) -> bool:
+        """Serve the task from the result cache instead of dispatching, when
+        its identical request already completed (rescache/). Covers the
+        windows the gateway's own lookup cannot: redeliveries, reaper
+        requeues, and journal-restored tasks re-seeded after a restart.
+        Bypassed requests carry no cache key and always dispatch."""
+        key = getattr(msg, "cache_key", "")
+        if self.result_cache is None or not key:
+            return False
+        # count=False: the gateway already recorded this request's outcome —
+        # a second count here would skew the edge hit ratio. Completions
+        # from this path stay visible as dispatch_total{outcome=cache_hit}.
+        found = self.result_cache.get(key, count=False)
+        if found is None:
+            return False
+        if self.result_store is None:
+            # Nowhere to put the payload: completing anyway would hand the
+            # client a terminal task whose result fetch returns nothing —
+            # a permanently lost output. Dispatch normally instead.
+            return False
+        payload, ctype = found
+        try:
+            res = self.result_store.set_result(msg.task_id, payload,
+                                               content_type=ctype)
+            import inspect
+            if inspect.isawaitable(res):
+                await res
+        except Exception:  # noqa: BLE001 — a lost result is a failed serve
+            log.exception("could not store cached result for task %s; "
+                          "dispatching instead", msg.task_id)
+            return False
+        self.broker.complete(msg)
+        self._dispatched.inc(outcome="cache_hit", queue=self.queue_name,
+                             backend="")
+        await self._try_update(msg.task_id, "completed - served from cache",
+                               TaskStatus.COMPLETED)
+        return True
+
     async def _backpressure(self, msg: Message, backend: str) -> None:
         self._dispatched.inc(outcome="backpressure", queue=self.queue_name,
                              backend=backend)
@@ -257,11 +308,14 @@ class DispatcherPool:
     registration is a dict entry, not a deployment."""
 
     def __init__(self, broker: InMemoryBroker, task_manager: TaskManagerBase,
-                 retry_delay: float = 60.0, concurrency: int = 1):
+                 retry_delay: float = 60.0, concurrency: int = 1,
+                 result_cache=None, result_store=None):
         self.broker = broker
         self.task_manager = task_manager
         self.retry_delay = retry_delay
         self.concurrency = concurrency
+        self.result_cache = result_cache
+        self.result_store = result_store
         self.dispatchers: dict[str, Dispatcher] = {}
 
     def register(self, queue_name: str, backend_uri,
@@ -271,6 +325,7 @@ class DispatcherPool:
             self.broker, queue_name, backend_uri, self.task_manager,
             retry_delay=self.retry_delay if retry_delay is None else retry_delay,
             concurrency=self.concurrency if concurrency is None else concurrency,
+            result_cache=self.result_cache, result_store=self.result_store,
         )
         self.dispatchers[queue_name] = d
         return d

@@ -43,6 +43,11 @@ class Message:
     seq: int = 0
     lease_expires: float = 0.0
     queue_name: str = ""  # resolved by the broker at publish time
+    # Result-cache provenance copied from the task (rescache/): lets the
+    # dispatcher serve a redelivery straight from the cache without a store
+    # round trip. "" = uncacheable/opted-out (the native broker's C struct
+    # has no slot for it — its messages always dispatch).
+    cache_key: str = ""
 
 
 DeadLetterHandler = Callable[[Message], None]
@@ -247,7 +252,8 @@ class InMemoryBroker:
                       content_type=getattr(task, "content_type",
                                            "application/json"),
                       seq=next(self._seq),
-                      queue_name=self.resolve_queue_name(task.endpoint))
+                      queue_name=self.resolve_queue_name(task.endpoint),
+                      cache_key=getattr(task, "cache_key", ""))
         loop = self._loop
         try:
             running = asyncio.get_running_loop()

@@ -295,10 +295,24 @@ def build_worker(config: FrameworkConfig, models: dict):
                            pipeline_depth=rt.batch_pipeline_depth,
                            interactive_reserve=rt.batch_interactive_reserve,
                            priority_aging_s=rt.batch_priority_aging_s)
+    admin_keys = None
+    if config.gateway.api_keys is not None:
+        # The reload surface is an operator action: gate it with the same
+        # front-door secret the gateway checks (the reference's APIM keys;
+        # the control plane reuses it for the taskstore too).
+        admin_keys = {k.strip() for k in config.gateway.api_keys.split(",")
+                      if k.strip()}
     worker = InferenceWorker(
         models.get("service_name", "tpu-worker"), runtime, batcher,
         task_manager=task_manager, prefix=models.get("prefix", "v1"),
-        store=store, reporter=reporter)
+        store=store, reporter=reporter,
+        # Hot-reload confinement (ADVICE r5): checkpoints must resolve
+        # under the configured checkpoint mount — without this, anyone who
+        # can reach the worker port could swap the served weights to any
+        # readable path. None (dev, no AI4E_RUNTIME_CHECKPOINT_DIR) keeps
+        # the open single-host behavior.
+        checkpoint_root=rt.checkpoint_dir,
+        admin_api_keys=admin_keys)
     for spec in models.get("models", []):
         spec = dict(spec)
         family = spec.pop("family")

@@ -157,6 +157,13 @@ class PlatformSection:
     # the fencing prober sends it in demote calls so the deposed primary
     # rejoins the new primary automatically (split-brain fencing).
     advertise_url: typing.Optional[str] = None
+    # Inference result cache + single-flight coalescing (docs/rescache.md).
+    # Off by default: enabling is a semantic statement that identical
+    # payloads may share results; per-request opt-out via X-Cache-Bypass.
+    result_cache: bool = False
+    cache_max_entries: int = 4096
+    cache_max_bytes: int = 268435456          # 256 MiB resident payloads
+    cache_ttl_seconds: typing.Optional[float] = 300.0
 
     def to_platform_config(self):
         from .platform_assembly import PlatformConfig
@@ -185,6 +192,10 @@ class PlatformSection:
                 (k.strip() for k in (self.replicate_api_key or "").split(",")
                  if k.strip()), None),
             advertise_url=self.advertise_url,
+            result_cache=self.result_cache,
+            cache_max_entries=self.cache_max_entries,
+            cache_max_bytes=self.cache_max_bytes,
+            cache_ttl_seconds=self.cache_ttl_seconds,
         )
 
 

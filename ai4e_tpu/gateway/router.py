@@ -28,8 +28,11 @@ import aiohttp
 from aiohttp import web
 
 from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from ..rescache.keys import (CACHE_STATUS_HEADER, cache_bypass_requested,
+                             request_key)
 from ..utils.backends import normalize_backends, pick_backend
-from ..taskstore import APITask, InMemoryTaskStore, TaskNotFound
+from ..taskstore import (APITask, InMemoryTaskStore, TaskNotFound, TaskStatus,
+                         endpoint_path)
 from ..utils.http import SessionHolder
 
 log = logging.getLogger("ai4e_tpu.gateway")
@@ -49,6 +52,12 @@ class Route:
     backends: list = None
     # None = use the gateway's cap at request time; 0 = explicitly unlimited.
     max_body_bytes: int | None = None
+    # Whether the result cache may serve/fill this route. False on weighted
+    # canary routes: the cache key hashes the shared endpoint path, not the
+    # chosen backend, so one backend's answer would be replayed to ALL of the
+    # split's traffic — mixing model versions and starving the canary's
+    # evaluation counters. Canary routes always execute (docs/rescache.md).
+    cacheable: bool = True
 
 
 class Gateway:
@@ -77,6 +86,14 @@ class Gateway:
         self._rate_limiter = None
         # Per-key request quotas (APIM product quota); None → unlimited.
         self._quota_tracker = None
+        # Inference result cache (``rescache/``); None → every request
+        # executes. Set via set_result_cache (platform assembly wires it).
+        self._result_cache = None
+        # Sync-path single flight: key -> Future resolving to the leader's
+        # (status, payload, content_type), or None when the leader errored.
+        # Event-loop objects, so they live here rather than in the
+        # thread-safe cache.
+        self._sync_inflight: dict = {}
         if hasattr(store, "add_listener"):
             store.add_listener(self._on_task_change)
 
@@ -102,6 +119,14 @@ class Gateway:
         (throttling workers' status updates would stall the data plane the
         limiter is protecting)."""
         self._rate_limiter = limiter
+
+    def set_result_cache(self, cache) -> None:
+        """Enable (or clear with None) the inference result cache +
+        single-flight coalescing on published APIs (``rescache/``). Every
+        cached route's response carries ``X-Cache: hit|miss|coalesced``
+        (``bypass`` when the request opted out via ``X-Cache-Bypass`` or
+        ``Cache-Control: no-cache``); uncached routes are unchanged."""
+        self._result_cache = cache
 
     def set_quota_tracker(self, tracker) -> None:
         """Enable (or clear with None) per-key request QUOTAS — APIM's
@@ -169,14 +194,21 @@ class Gateway:
                 self._quota_tracker.allow(identity)  # consume the unit
         return await handler(request)
 
-    def add_async_route(self, prefix: str, task_endpoint: str,
+    def add_async_route(self, prefix: str, task_endpoint,
                         max_body_bytes: int | None = None) -> None:
         """Register an async API: requests become tasks addressed to
-        ``task_endpoint`` (the backend route the dispatcher will POST to).
-        ``max_body_bytes``: per-route edge cap (None → the gateway's)."""
+        ``task_endpoint`` (the backend route the dispatcher will POST to —
+        a URI, or a weighted backend set whose primary becomes the recorded
+        endpoint). ``max_body_bytes``: per-route edge cap (None → the
+        gateway's). Cacheability is derived HERE, same as the sync route —
+        a weighted canary set must not share one cache entry across
+        backends serving different model versions, and a caller must not be
+        able to forget that."""
+        backends = normalize_backends(task_endpoint)
         route = Route(prefix=prefix.rstrip("/"), mode="async",
-                      backend_uri=task_endpoint,
-                      max_body_bytes=max_body_bytes)
+                      backend_uri=backends[0][0],
+                      max_body_bytes=max_body_bytes,
+                      cacheable=len(backends) == 1)
         self.routes.append(route)
         self.app.router.add_post(route.prefix, self._make_async_handler(route))
         self.app.router.add_post(route.prefix + "/{tail:.*}",
@@ -189,7 +221,10 @@ class Gateway:
         route = Route(prefix=prefix.rstrip("/"), mode="sync",
                       backend_uri=backends[0][0],
                       backends=backends,
-                      max_body_bytes=max_body_bytes)
+                      max_body_bytes=max_body_bytes,
+                      # A weighted canary set must not share one cache entry
+                      # across backends serving different model versions.
+                      cacheable=len(backends) == 1)
         self.routes.append(route)
         handler = self._make_sync_handler(route)
         for pattern in (route.prefix, route.prefix + "/{tail:.*}"):
@@ -232,14 +267,72 @@ class Gateway:
                 endpoint += "?" + request.query_string
             from ..observability import get_tracer
             from ..taskstore import NotPrimaryError
+            content_type = request.content_type or "application/json"
+
+            # Result-cache consult (rescache/): hit → terminal task served
+            # straight from the cache; identical request already in flight →
+            # hand back the SAME task record (single-flight coalescing, no
+            # second execution); miss → stamp the key on the task so the
+            # store listener fills the cache on completion.
+            cache = self._result_cache if route.cacheable else None
+            cache_key = ""
+            xcache = None
+            if cache is not None:
+                if cache_bypass_requested(request.headers):
+                    xcache = "bypass"
+                else:
+                    key = self._derive_cache_key(route, request, body,
+                                                 content_type)
+                    with get_tracer().span("cache_lookup", route=route.prefix,
+                                           headers=request.headers) as span:
+                        # count=False: the outcome is counted exactly once
+                        # below, when it is KNOWN — a lookup that ends up
+                        # coalescing must not also record a miss, or the
+                        # hit ratio understates the cache under duplicate
+                        # load (docs/METRICS.md: outcomes sum to requests).
+                        found = cache.get(key, count=False)
+                        leader = None if found else cache.leader_for(key)
+                        span.attrs["outcome"] = ("hit" if found
+                                                 else "coalesced" if leader
+                                                 else "miss")
+                    if found is not None:
+                        resp = self._serve_cached_task(
+                            route, endpoint, body, content_type, key, found)
+                        if resp is not None:
+                            cache.count_hit()
+                            return resp
+                        # Standby replica (cannot create the record): fall
+                        # through UNCOUNTED — the create path answers
+                        # not-primary below, and a request that neither
+                        # executed nor was served has no cache outcome
+                        # (docs/METRICS.md: outcomes sum to requests).
+                    else:
+                        if leader is not None:
+                            try:
+                                record = self.store.get(leader)
+                            except TaskNotFound:
+                                # Leader evicted mid-flight (tight
+                                # retention): clear the stale registration,
+                                # execute fresh.
+                                cache.release_inflight(key, leader)
+                            else:
+                                cache.count_coalesced()
+                                self._requests.inc(route=route.prefix,
+                                                   outcome="coalesced")
+                                return web.json_response(
+                                    record.to_dict(),
+                                    headers={CACHE_STATUS_HEADER: "coalesced"})
+                        cache_key = key
+                        xcache = "miss"
             with get_tracer().span("create_task", route=route.prefix,
                                    headers=request.headers) as span:
                 try:
                     task = self.store.upsert(APITask(
                         endpoint=endpoint,
                         body=body,
-                        content_type=request.content_type or "application/json",
+                        content_type=content_type,
                         publish=True,
+                        cache_key=cache_key,
                     ))
                 except NotPrimaryError:
                     # Standby control plane: reads are served here, task
@@ -256,49 +349,206 @@ class Gateway:
                         # overload 503 must never re-home them (ADVICE r4).
                         headers={"Retry-After": "2", "X-Not-Primary": "1"})
                 span.task_id = task.task_id
+            if cache is not None and xcache is not None:
+                # Miss/bypass recorded only NOW, after the record exists: a
+                # standby's NotPrimaryError 503 above must not count an
+                # outcome once per client retry (docs/METRICS.md: outcomes
+                # sum to answered requests). Hit/coalesced returned earlier.
+                (cache.count_miss if xcache == "miss"
+                 else cache.count_bypass)()
             stored = self.store.get(task.task_id)
+            if cache_key and stored.canonical_status not in TaskStatus.TERMINAL:
+                # This task is now the one execution owning the key; the
+                # store listener releases it on the terminal transition
+                # (rescache/wiring.py). A task that is ALREADY terminal here
+                # (synchronous publish failure) registers nothing.
+                cache.register_inflight(cache_key, task.task_id)
             outcome = "failed" if stored.canonical_status == "failed" else "created"
             self._requests.inc(route=route.prefix, outcome=outcome)
-            return web.json_response(stored.to_dict())
+            return web.json_response(
+                stored.to_dict(),
+                headers={CACHE_STATUS_HEADER: xcache} if xcache else None)
 
         return handler
+
+    def _derive_cache_key(self, route: Route, request: web.Request,
+                          body: bytes, content_type: str) -> str:
+        """Canonical result-cache key for a gateway request — the ONE
+        derivation both the async and the sync handler use, so the two
+        paths can never drift into separate key namespaces for the same
+        request (keys must also match what the dispatcher re-derives on
+        redelivery)."""
+        tail = request.match_info.get("tail", "")
+        return request_key(
+            endpoint_path(route.backend_uri), body, content_type,
+            extra=(tail + "?" + request.query_string
+                   if request.query_string else tail))
+
+    def _serve_cached_task(self, route: Route, endpoint: str, body: bytes,
+                           content_type: str, key: str,
+                           found: tuple) -> web.Response | None:
+        """Answer an async-path cache hit. A REAL task record is created —
+        already terminal, ``publish=False`` so it never touches the
+        transport — and the cached payload is stored as its result, so the
+        client contract (poll the TaskId, fetch ``/v1/taskstore/result``)
+        holds identically for hits and misses. ``durable=False``: this
+        response already carries the terminal record, so the record is
+        memory-only — a journaled store must not pay payload-sized journal
+        appends per duplicate request (the workload the cache exists for);
+        after a restart the TaskId 404s, same as zero-retention reaping.
+        Returns None when this replica cannot create records (standby) —
+        the caller falls through to the ordinary create path's not-primary
+        answer."""
+        from ..taskstore import NotPrimaryError
+        payload, ctype = found
+        try:
+            task = self.store.upsert(APITask(
+                endpoint=endpoint, body=body, content_type=content_type,
+                status="completed - served from cache",
+                backend_status=TaskStatus.COMPLETED,
+                publish=False, cache_key=key, durable=False))
+        except NotPrimaryError:
+            return None
+        try:
+            self.store.set_result(task.task_id, payload, ctype)
+        except TaskNotFound:
+            pass  # reaped already (zero-retention config); record answered
+        self._requests.inc(route=route.prefix, outcome="cache_hit")
+        return web.json_response(task.to_dict(),
+                                 headers={CACHE_STATUS_HEADER: "hit"})
 
     # -- sync: reverse proxy (request_backend_policy.xml:1-6) --------------
 
     def _make_sync_handler(self, route: Route):
         async def handler(request: web.Request) -> web.Response:
             tail = request.match_info.get("tail", "")
-            # Weighted per-request pick over the route's backend set
-            # (single-backend routes skip the RNG) — Istio's weighted
-            # VirtualService subsets, at the gateway.
-            base = pick_backend(route.backends)
-            target = base + (("/" + tail) if tail else "")
-            if request.query_string:
-                target += "?" + request.query_string
             body = await self._read_limited(request, route)
             if body is None:
                 return self._payload_too_large(route)
-            session = await self._get_session()
+
+            # Result cache on the sync proxy: POST-only (inference requests;
+            # GETs and friends pass through untouched). A hit answers from
+            # the cache; an identical request already proxying makes this
+            # one a single-flight subscriber — it awaits the leader's
+            # response instead of re-executing.
+            cache = self._result_cache if route.cacheable else None
+            key = None
+            fut = None  # set when THIS request is the single-flight leader
+            gen = 0  # family invalidation generation captured at leadership
+            bypassed = False
+            if cache is not None and request.method == "POST":
+                if cache_bypass_requested(request.headers):
+                    cache.count_bypass()
+                    bypassed = True
+                else:
+                    key = self._derive_cache_key(route, request, body,
+                                                 request.content_type or "")
+                    # count=False + explicit outcome below: one external
+                    # request, exactly one of hit/miss/coalesced.
+                    found = cache.get(key, count=False)
+                    if found is not None:
+                        cache.count_hit()
+                        self._requests.inc(route=route.prefix,
+                                           outcome="cache_hit")
+                        return web.Response(
+                            body=found[0], content_type=found[1],
+                            headers={CACHE_STATUS_HEADER: "hit"})
+                    waiting = self._sync_inflight.get(key)
+                    if waiting is not None:
+                        leader_fut, leader_gen = waiting
+                        settled = await leader_fut
+                        if (settled is not None
+                                and cache.generation(key) == leader_gen):
+                            status, payload, ctype = settled
+                            cache.count_coalesced()
+                            self._requests.inc(route=route.prefix,
+                                               outcome="coalesced")
+                            return web.Response(
+                                status=status, body=payload,
+                                content_type=ctype,
+                                headers={CACHE_STATUS_HEADER: "coalesced"})
+                        # Leader errored out, OR a checkpoint reload
+                        # invalidated the family after the leader captured
+                        # its generation — its execution used the OLD
+                        # weights and must not be adopted (the same
+                        # generation check that already guards the cache
+                        # fill, applied to coalescing). Proxy ourselves,
+                        # uncoalesced (no re-registration: an erroring
+                        # backend must not chain a convoy of waiters behind
+                        # each retry). This request executes: it is a miss.
+                        cache.count_miss()
+                        key = None
+                    else:
+                        fut = asyncio.get_running_loop().create_future()
+                        gen = cache.generation(key)
+                        self._sync_inflight[key] = (fut, gen)
+                        cache.count_miss()
+
+            # From the moment the leader future is registered, EVERY exit —
+            # backend errors, unexpected exceptions, the client
+            # disconnecting (aiohttp cancels the handler wherever it is
+            # suspended) — must run the finally below, or the unresolved
+            # future wedges every later identical request forever.
             try:
-                async with session.request(
-                    request.method, target, data=body,
-                    # Strip hop headers AND the gateway credential: a sync
-                    # backend (arbitrary URI, possibly third-party) must
-                    # never see the subscription key it could replay against
-                    # the keyed public surface.
-                    headers={k: v for k, v in request.headers.items()
-                             if k.lower() not in (
-                                 "host", "content-length",
-                                 "ocp-apim-subscription-key", "x-api-key")},
-                ) as resp:
-                    payload = await resp.read()
-                    self._requests.inc(route=route.prefix, outcome=str(resp.status))
-                    return web.Response(
-                        status=resp.status, body=payload,
-                        content_type=resp.content_type)
-            except aiohttp.ClientError as exc:
-                self._requests.inc(route=route.prefix, outcome="unreachable")
-                return web.Response(status=502, text=f"Backend unreachable: {exc}")
+                # Weighted per-request pick over the route's backend set
+                # (single-backend routes skip the RNG) — Istio's weighted
+                # VirtualService subsets, at the gateway.
+                base = pick_backend(route.backends)
+                target = base + (("/" + tail) if tail else "")
+                if request.query_string:
+                    target += "?" + request.query_string
+                session = await self._get_session()
+                try:
+                    async with session.request(
+                        request.method, target, data=body,
+                        # Strip hop headers AND the gateway credential: a sync
+                        # backend (arbitrary URI, possibly third-party) must
+                        # never see the subscription key it could replay
+                        # against the keyed public surface.
+                        headers={k: v for k, v in request.headers.items()
+                                 if k.lower() not in (
+                                     "host", "content-length",
+                                     "ocp-apim-subscription-key", "x-api-key")},
+                    ) as resp:
+                        payload = await resp.read()
+                        self._requests.inc(route=route.prefix,
+                                           outcome=str(resp.status))
+                        if fut is not None:
+                            # Only successes become cache entries — and only
+                            # when the family's invalidation generation still
+                            # matches the one captured at leadership (a
+                            # checkpoint reload mid-proxy means this result
+                            # came from the OLD weights; refuse the stale
+                            # fill). The waiters get whatever the backend
+                            # said regardless (it IS their request's
+                            # response — errors included).
+                            if resp.status == 200:
+                                cache.put(key, payload, resp.content_type,
+                                          if_generation=gen)
+                            fut.set_result((resp.status, payload,
+                                            resp.content_type))
+                        return web.Response(
+                            status=resp.status, body=payload,
+                            content_type=resp.content_type,
+                            # Same X-Cache contract as the async path
+                            # (docs/API.md): leader → miss, opted out →
+                            # bypass; a waiter-turned-executor (leader
+                            # errored) carries no header — it neither led
+                            # nor consulted the cache for its answer.
+                            headers=({CACHE_STATUS_HEADER: "miss"}
+                                     if fut is not None
+                                     else {CACHE_STATUS_HEADER: "bypass"}
+                                     if bypassed else None))
+                except aiohttp.ClientError as exc:
+                    self._requests.inc(route=route.prefix,
+                                       outcome="unreachable")
+                    return web.Response(status=502,
+                                        text=f"Backend unreachable: {exc}")
+            finally:
+                if fut is not None:
+                    self._sync_inflight.pop(key, None)
+                    if not fut.done():
+                        fut.set_result(None)  # waiters proxy themselves
 
         return handler
 

@@ -73,6 +73,19 @@ class PlatformConfig:
     # automatically; unset, deposed peers are fenced (writes refused) but
     # must be re-seeded by the deployment.
     advertise_url: str | None = None
+    # Inference result cache + single-flight coalescing (rescache/): the
+    # gateway answers repeat requests without dispatching, concurrent
+    # identical requests share ONE execution, and dispatchers complete
+    # redeliveries from the cache. Off by default — enabling it is a
+    # semantic statement that identical payloads may share results
+    # (docs/rescache.md; per-request opt-out via X-Cache-Bypass).
+    result_cache: bool = False
+    cache_max_entries: int = 4096
+    cache_max_bytes: int = 256 * 1024 * 1024
+    # Entry lifetime bound. In a single-process deployment the reload hook
+    # invalidates synchronously; TTL is the staleness backstop for caches
+    # that a remote worker's reload cannot reach. None = no TTL.
+    cache_ttl_seconds: float | None = 300.0
 
 
 class LocalPlatform:
@@ -144,6 +157,23 @@ class LocalPlatform:
         else:
             self.store = InMemoryTaskStore(**result_kwargs)
         self.task_manager = LocalTaskManager(self.store)
+        self.result_cache = None
+        if self.config.result_cache:
+            from .rescache import ResultCache, attach_store
+            self.result_cache = ResultCache(
+                max_entries=self.config.cache_max_entries,
+                max_bytes=self.config.cache_max_bytes,
+                ttl_s=self.config.cache_ttl_seconds,
+                metrics=self.metrics)
+            if hasattr(self.store, "add_listener"):
+                # The async path's fill point: the store's change feed
+                # copies results into the cache on terminal transitions and
+                # releases single-flight leaders (rescache/wiring.py).
+                # Every store qualifies — the native facade shares the
+                # StoreSideEffects listener plumbing and carries CacheKey
+                # in a Python-side sidecar (native.py) — the hasattr is
+                # only a guard for exotic store substitutes in tests.
+                attach_store(self.store, self.result_cache)
         self.broker = None
         self.dispatchers = None
         self.topic = None
@@ -168,12 +198,18 @@ class LocalPlatform:
             self.dispatchers = DispatcherPool(
                 self.broker, self.task_manager,
                 retry_delay=self.config.retry_delay,
-                concurrency=self.config.dispatcher_concurrency)
+                concurrency=self.config.dispatcher_concurrency,
+                result_cache=self.result_cache,
+                result_store=(self.store if self.result_cache is not None
+                              and hasattr(self.store, "set_result")
+                              else None))
         else:
             raise ValueError(
                 f"unknown transport {self.config.transport!r}; "
                 "expected 'queue' or 'push'")
         self.gateway = Gateway(self.store, metrics=self.metrics)
+        if self.result_cache is not None:
+            self.gateway.set_result_cache(self.result_cache)
         # Terminal-history retention: None = AUTO — 15 min on the Python
         # store, sized to the soak evidence (unevicted terminal history
         # grows ~12 MB/min at 200 req/s → AUTO bounds steady-state at
@@ -254,7 +290,9 @@ class LocalPlatform:
         Endpoint is the primary's (path identity is shared by
         construction), deliveries split per the weights."""
         backends = normalize_backends(backend_uri)
-        self.gateway.add_async_route(public_prefix, backends[0][0],
+        # The gateway derives cacheability from the backend set itself
+        # (weighted canary splits are uncacheable — Route.cacheable).
+        self.gateway.add_async_route(public_prefix, backends,
                                      max_body_bytes=max_body_bytes)
         self.register_internal_route(backends, retry_delay=retry_delay,
                                      concurrency=concurrency,

@@ -1,0 +1,23 @@
+"""Inference result cache + single-flight request coalescing.
+
+See ``docs/rescache.md`` for key derivation, invalidation-on-reload
+semantics, coalescing guarantees, and the opt-out header.
+"""
+
+from .cache import ResultCache
+from .keys import (BYPASS_HEADER, CACHE_STATUS_HEADER, cache_bypass_requested,
+                   canonical_payload, family_of, normalize_media_type,
+                   request_key)
+from .wiring import attach_store
+
+__all__ = [
+    "ResultCache",
+    "attach_store",
+    "request_key",
+    "canonical_payload",
+    "normalize_media_type",
+    "family_of",
+    "cache_bypass_requested",
+    "BYPASS_HEADER",
+    "CACHE_STATUS_HEADER",
+]

@@ -1,0 +1,100 @@
+"""Canonical request hashing — the cache's identity function.
+
+A cache entry's key must be stable across every wire spelling of the *same*
+inference request, and distinct for anything that could change the answer.
+The digest therefore covers four dimensions:
+
+- **family** — which servable/route answers the request (the worker uses the
+  model name; the gateway uses the backend endpoint path, which is also the
+  queue name — one invalidation namespace per rollout unit);
+- **checkpoint** — which weights answer it (the worker keys on
+  ``params_version`` so a hot reload naturally changes every key; the gateway
+  does not know the serving version and relies on the reload invalidation
+  hook instead — ``docs/rescache.md``);
+- **wire format** — the payload's media type (an identical byte string means
+  different things as ``image/jpeg`` vs ``application/x-npy``);
+- **normalized payload bytes** — JSON payloads are re-serialized with sorted
+  keys and canonical separators so ``{"a":1,"b":2}`` and
+  ``{ "b": 2, "a": 1 }`` collide; binary payloads hash as-is.
+
+Keys render as ``"{family}|{hexdigest}"`` so the family is recoverable for
+invalidation bookkeeping without a reverse index (families are endpoint
+paths or model names — neither may contain ``|``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+# Request header that opts a single request out of the result cache entirely
+# (no read, no store). ``Cache-Control: no-cache`` / ``no-store`` are honored
+# with the same meaning.
+BYPASS_HEADER = "X-Cache-Bypass"
+# Response header stamping the cache outcome: hit | miss | coalesced | bypass.
+CACHE_STATUS_HEADER = "X-Cache"
+
+
+def cache_bypass_requested(headers) -> bool:
+    """True when the request opted out of the cache (``X-Cache-Bypass`` set,
+    or a ``Cache-Control`` carrying no-cache/no-store). ``headers`` is any
+    case-insensitive mapping (aiohttp's CIMultiDict, urllib's message)."""
+    raw = (headers.get(BYPASS_HEADER) or "").strip().lower()
+    if raw and raw not in ("0", "false", "no", "off"):
+        # Explicit falsy values mean "do not bypass" — a middleware that
+        # normalizes boolean headers to "0" must not silently disable the
+        # cache for 100% of traffic.
+        return True
+    cc = (headers.get("Cache-Control") or "").lower()
+    return "no-cache" in cc or "no-store" in cc
+
+
+def normalize_media_type(content_type: str) -> str:
+    """Media type without parameters: ``application/json; charset=utf-8`` →
+    ``application/json`` (parameters never change the payload semantics the
+    cache cares about; charset differences show up in the bytes)."""
+    return (content_type or "").split(";", 1)[0].strip().lower()
+
+
+def canonical_payload(body: bytes, content_type: str = "") -> bytes:
+    """Payload bytes with wire-level noise removed.
+
+    JSON media types (``*/json`` and ``*+json``) re-serialize with sorted
+    keys and compact separators, so semantically identical documents hash
+    identically. Anything that fails to parse — or any binary wire — hashes
+    as the raw bytes (never raises)."""
+    media = normalize_media_type(content_type)
+    if media.endswith("/json") or media.endswith("+json"):
+        try:
+            return json.dumps(
+                json.loads(body.decode("utf-8")),
+                sort_keys=True, separators=(",", ":"),
+            ).encode("utf-8")
+        except (ValueError, UnicodeDecodeError):
+            return body
+    return body
+
+
+def request_key(family: str, payload: bytes, content_type: str = "",
+                checkpoint: str = "", extra: str = "") -> str:
+    """Stable digest over (family, checkpoint, wire format, normalized
+    payload[, extra]). ``extra`` carries request addressing that changes the
+    answer but lives outside the body — the gateway passes the operation
+    tail + query string (``?conf=0.9`` is a different request).
+
+    Fields are length-framed before hashing so no concatenation of values
+    can collide with a different split of the same bytes."""
+    h = hashlib.sha256()
+    for field in (family.encode("utf-8"),
+                  checkpoint.encode("utf-8"),
+                  normalize_media_type(content_type).encode("utf-8"),
+                  extra.encode("utf-8"),
+                  canonical_payload(payload, content_type)):
+        h.update(len(field).to_bytes(8, "big"))
+        h.update(field)
+    return f"{family}|{h.hexdigest()}"
+
+
+def family_of(key: str) -> str:
+    """The invalidation namespace a key belongs to."""
+    return key.rsplit("|", 1)[0]

@@ -18,6 +18,7 @@ import logging
 import numpy as np
 
 from ..metrics import MetricsRegistry
+from ..rescache.keys import cache_bypass_requested, request_key
 from ..service import APIService
 from ..service.task_manager import TaskManagerBase
 from .batcher import BatcherSaturated, MicroBatcher
@@ -32,10 +33,37 @@ class InferenceWorker:
     def __init__(self, name: str, runtime: ModelRuntime, batcher: MicroBatcher,
                  task_manager: TaskManagerBase | None = None,
                  prefix: str = "v1", metrics: MetricsRegistry | None = None,
-                 store=None, reporter=None):
+                 store=None, reporter=None, result_cache=None,
+                 checkpoint_root: str | None = None,
+                 admin_api_keys=None, cache_sync_path: bool = True):
+        import os
+
         self.runtime = runtime
         self.batcher = batcher
         self.store = store
+        # Inference result cache (rescache/): the sync path answers repeat
+        # requests from it (keyed on model + params_version + wire + body,
+        # so a reload's version bump alone already misses), and a checkpoint
+        # hot reload invalidates every family this worker serves — a stale
+        # result can never outlive a weight swap.
+        self.result_cache = result_cache
+        # False when a CACHING GATEWAY fronts this worker with the same
+        # ResultCache (combined-process assembly, bench): the proxy already
+        # answers hits and fills on response — a second worker-keyed entry
+        # per request would hold identical bytes twice against the byte
+        # budget and could never be hit by gateway traffic (the gateway
+        # answers from its own key first). The reload invalidation hook is
+        # unaffected — it needs only the cache reference.
+        self._cache_sync_path = cache_sync_path
+        # Hot-reload confinement (ADVICE r5): when set, reload checkpoints
+        # must resolve (realpath, symlinks included) under this directory —
+        # anything else answers 403. None preserves the open single-host
+        # behavior for dev/tests.
+        self._checkpoint_root = (os.path.realpath(checkpoint_root)
+                                 if checkpoint_root else None)
+        # API-key gate for the admin surface (reload): the same subscription
+        # keys the gateway's middleware checks; None → open.
+        self._admin_keys = set(admin_api_keys) if admin_api_keys else None
         self.service = APIService(name, prefix=prefix,
                                   task_manager=task_manager, metrics=metrics,
                                   reporter=reporter)
@@ -95,6 +123,15 @@ class InferenceWorker:
 
         import jax
 
+        if self._admin_keys is not None:
+            # Same header contract as the gateway's API-key middleware —
+            # weight swaps are an operator action, not an open endpoint.
+            key = (request.headers.get("Ocp-Apim-Subscription-Key")
+                   or request.headers.get("X-Api-Key"))
+            if key not in self._admin_keys:
+                return web.json_response(
+                    {"error": "missing or invalid subscription key"},
+                    status=401)
         name = request.match_info["name"]
         servable = self.runtime.models.get(name)
         if servable is None:
@@ -128,6 +165,19 @@ class InferenceWorker:
                               "absolute path"}, status=400)
             path = os.path.abspath(os.path.join(
                 os.path.dirname(servable.checkpoint_path), path))
+        if self._checkpoint_root is not None:
+            # Realpath-prefix confinement (ADVICE r5): the request body names
+            # a filesystem path — without this check anyone who can reach
+            # the worker port could swap the served weights to ANY readable
+            # checkpoint on disk ("../" traversal, absolute paths, symlink
+            # hops included).
+            real = os.path.realpath(path)
+            if not (real == self._checkpoint_root
+                    or real.startswith(self._checkpoint_root + os.sep)):
+                return web.json_response(
+                    {"error": "checkpoint path escapes the configured "
+                              "checkpoint directory"}, status=403)
+            path = real
 
         def load_and_swap():
             from ..checkpoint import load_params
@@ -145,6 +195,15 @@ class InferenceWorker:
                     {"error": f"reload failed: {type(exc).__name__}: "
                               f"{exc}"}, status=400)
             servable.checkpoint_path = path
+            if self.result_cache is not None:
+                # Invalidation-on-reload (rescache/): drop every cached
+                # result this model could have produced — the worker's own
+                # family (sync path) AND each endpoint path it serves (the
+                # gateway/dispatcher key namespace) — so a result computed
+                # on the old weights is unreachable from the moment the
+                # swap lands.
+                for family in (name, *self._served.get(name, {}).values()):
+                    self.result_cache.invalidate_family(family)
             return web.json_response(
                 {"model": name, "checkpoint": path,
                  "params_version": servable.params_version})
@@ -191,10 +250,39 @@ class InferenceWorker:
                 return 503, "Inference queue saturated; retry later."
             return None
 
+        async def _sync_request_kwargs(request):
+            # Default body/content_type extraction plus the cache opt-out:
+            # the handler signature has no request object, and the
+            # documented X-Cache-Bypass / Cache-Control: no-cache contract
+            # ("this request must execute; no cache read, no store") must
+            # hold at the worker's own cache too — the gateway's sync proxy
+            # forwards these headers verbatim.
+            return {"body": await request.read(),
+                    "content_type": request.content_type,
+                    "cache_bypass": cache_bypass_requested(request.headers)}
+
         @self.service.api_sync_func(
             sync_path, maximum_concurrent_requests=maximum_concurrent_requests,
-            admission_check=_saturation_check)
-        async def _sync(body, content_type, _name=name, _servable=servable):
+            admission_check=_saturation_check,
+            request_processing_function=_sync_request_kwargs)
+        async def _sync(body, content_type, cache_bypass=False, _name=name,
+                        _servable=servable):
+            # Worker-level result cache (rescache/): keyed on the model AND
+            # its params_version, so a hot reload's version bump alone makes
+            # every pre-swap entry unreachable (the reload hook additionally
+            # invalidates the family outright).
+            cache = (self.result_cache
+                     if self._cache_sync_path and not cache_bypass else None)
+            key = None
+            if cache is not None:
+                key = request_key(_name, body, content_type,
+                                  checkpoint=str(_servable.params_version))
+                # count=False: hit/miss outcomes are counted once, at the
+                # gateway edge — this inner lookup must not double-count a
+                # request the sync proxy already recorded.
+                found = cache.get(key, count=False)
+                if found is not None:
+                    return json.loads(found[0])
             example = _servable.preprocess(body, content_type)
             try:
                 result = await self.batcher.submit(_name, np.asarray(example))
@@ -202,7 +290,10 @@ class InferenceWorker:
                 from aiohttp import web
                 return web.Response(status=503,
                                     text="Inference queue saturated; retry.")
-            return _jsonable(result)
+            out = _jsonable(result)
+            if key is not None:
+                cache.put(key, json.dumps(out).encode(), "application/json")
+            return out
 
         @self.service.api_async_func(
             async_path, maximum_concurrent_requests=maximum_concurrent_requests,

@@ -113,6 +113,14 @@ class NativeTaskStore(StoreSideEffects):
         self._handle = self._lib.tsc_create()
         self._publisher = publisher
         self._listeners: list[Callable[[APITask], None]] = []
+        # Result-cache provenance sidecar (rescache/): the C++ record has no
+        # CacheKey field, but the store listener contract requires tasks to
+        # carry one — without it the cache never fills and single-flight
+        # registrations never release, so duplicate requests would coalesce
+        # onto a stale (possibly failed) record forever. Kept Python-side,
+        # keyed by TaskId; the native store has no Python-side retention
+        # reaper, so this map's growth tracks the store's own.
+        self._cache_keys: dict[str, str] = {}
 
     def __del__(self):  # pragma: no cover - interpreter teardown ordering
         try:
@@ -138,6 +146,7 @@ class NativeTaskStore(StoreSideEffects):
             publish=bool(v.publish),
         )
         self._lib.tsc_free_view(view)
+        task.cache_key = self._cache_keys.get(task.task_id, "")
         return task
 
     # -- core state machine (InMemoryTaskStore surface) --------------------
@@ -154,6 +163,13 @@ class NativeTaskStore(StoreSideEffects):
             task.status.encode(), task.backend_status.encode(),
             _buf(task.body), len(task.body), task.content_type.encode(),
             1 if task.publish else 0))
+        if task.cache_key:
+            # Keyed by the STORED id — the engine assigns the GUID for
+            # blank-id creates. An upsert WITHOUT a key keeps the original
+            # (the same inheritance the Python store applies across
+            # pipeline handoffs).
+            self._cache_keys[stored.task_id] = task.cache_key
+            stored.cache_key = task.cache_key
         # Snapshot the publisher at transition time (the Python store does
         # this under its lock) so a concurrent set_publisher cannot route
         # this task to a broker the decision wasn't made against.

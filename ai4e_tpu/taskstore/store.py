@@ -154,8 +154,12 @@ class InMemoryTaskStore(StoreSideEffects):
         namespace's stage separator (``{taskId}:{stage}`` keys), and an id
         carrying one would alias another task's result keys (eviction
         could then leak this task's results or destroy a neighbor's).
+        The guard runs on EXTERNAL write paths only (``_validates_task_ids``):
+        journal replay and follower absorb apply history as-is — a legacy
+        pre-guard journal must never crash-loop ``__init__._replay`` or
+        wedge a follower's absorb/retry loop at a fixed offset (ADVICE r5).
         """
-        if ":" in task.task_id:
+        if ":" in task.task_id and self._validates_task_ids():
             raise ValueError(
                 f"TaskId must not contain ':' (reserved as the result "
                 f"stage separator): {task.task_id!r}")
@@ -167,6 +171,13 @@ class InMemoryTaskStore(StoreSideEffects):
         self._publish_after(task, publisher)
         return task
 
+    def _validates_task_ids(self) -> bool:
+        """Whether upsert enforces input validation — True on every external
+        write path; the journaled subclass turns it off while replaying or
+        absorbing history (records that were already accepted once must
+        apply verbatim, or a restart/follower can never catch up)."""
+        return True
+
     def _apply_upsert(self, task: APITask) -> APITask:
         """State mutation for upsert. Caller holds ``self._lock``; subclasses
         extend this to journal atomically with the mutation."""
@@ -177,6 +188,19 @@ class InMemoryTaskStore(StoreSideEffects):
             if task.body:
                 self._orig_bodies[task.task_id] = (task.body, task.content_type)
         else:
+            if not task.cache_key:
+                # Cache provenance survives pipeline handoffs and requeues:
+                # the terminal result of the LAST stage is what the original
+                # request's cache key should resolve to (rescache/wiring.py).
+                task.cache_key = prev.cache_key
+            if not prev.durable:
+                # Memory-only stays memory-only: an external full upsert
+                # (facade records default durable=True) must not promote a
+                # cache-hit record into the journal — its create was never
+                # journaled, so replay would drop the slim transitions
+                # silently and compaction would write the very payload-sized
+                # records durable=False exists to prevent.
+                task.durable = False
             if not task.body and task.publish:
                 # Subsequent pipeline call: replay the original body + its
                 # content type (CacheConnectorUpsert.cs:144-176).
@@ -326,9 +350,17 @@ class InMemoryTaskStore(StoreSideEffects):
         retrievable under the shared TaskId, analogous to the reference
         keeping ``{taskId}_ORIG`` alongside the task (``CacheConnectorUpsert.cs:158``)."""
         key = task_id if stage is None else f"{task_id}:{stage}"
+        owner = self._tasks.get(task_id)
         offload = (self._result_backend is not None
                    and self._result_offload_threshold is not None
-                   and len(result) >= self._result_offload_threshold)
+                   and len(result) >= self._result_offload_threshold
+                   # Non-durable records (cache hits) are memory-only: their
+                   # results stay inline — per-hit blob writes would put
+                   # payload-sized I/O back on the exact path the cache
+                   # exists to avoid, and a restart would orphan the blobs
+                   # on the mount (no journaled record references them, so
+                   # no eviction ever deletes them).
+                   and (owner is None or owner.durable))
         if offload:
             # Write the blob BEFORE taking the lock (it may be slow storage)
             # and before the pointer becomes visible — a reader that sees the
@@ -497,6 +529,11 @@ class JournaledTaskStore(InMemoryTaskStore):
     checkpoint/resume).
     """
 
+    # Class-level default so _validates_task_ids is safe during __init__
+    # replay on this class too (FollowerTaskStore overrides per instance
+    # while absorbing).
+    _absorbing = False
+
     def __init__(self, journal_path: str, publisher: Publisher | None = None,
                  compact_every: int = 5000, result_backend=None,
                  result_offload_threshold: int | None = None):
@@ -634,7 +671,7 @@ class JournaledTaskStore(InMemoryTaskStore):
     def _log(self, task: APITask, slim: bool = False) -> None:
         # Called with self._lock held (from _apply_*): journal order is
         # exactly mutation order, so replay reconstructs the true final state.
-        if self._journal is None:
+        if self._journal is None or not task.durable:
             return
         rec = task.to_dict()
         if slim:
@@ -701,10 +738,17 @@ class JournaledTaskStore(InMemoryTaskStore):
                     # state, not history.
                     f.write(json.dumps({"Epoch": self.epoch}) + "\n")
                 for task in self._tasks.values():
+                    if not task.durable:
+                        # In-memory-only records (cache hits) must not be
+                        # promoted to durability by a rewrite.
+                        continue
                     f.write(json.dumps(self._full_record(task)) + "\n")
                 # Tasks first, then results — replay applies them in file
                 # order and a result's task record must already exist.
                 for key, (body, ctype) in self._results.items():
+                    owner = self._tasks.get(key.split(":", 1)[0])
+                    if owner is not None and not owner.durable:
+                        continue
                     f.write(json.dumps(self._result_record(
                         key, body, ctype)) + "\n")
                 f.flush()
@@ -763,14 +807,23 @@ class JournaledTaskStore(InMemoryTaskStore):
         # while its result is gone (a worse lie than losing the task).
         self._check_open()
         super()._apply_set_result(key, result, content_type)
+        owner = self._tasks.get(key.split(":", 1)[0])
+        if owner is not None and not owner.durable:
+            # The owning record never reached the journal; its result must
+            # not either (replay would otherwise restore an orphan result).
+            return
         self._append(self._result_record(key, result, content_type))
 
     def _apply_evict(self, task_id: str) -> list[str]:
         if task_id not in self._tasks:
             return []
         self._check_open()
+        # Capture before the pop: a non-durable record was never journaled,
+        # so journaling its eviction would only bloat the file.
+        durable = self._tasks[task_id].durable
         blob_keys = super()._apply_evict(task_id)
-        self._append({"Evict": True, "TaskId": task_id})
+        if durable:
+            self._append({"Evict": True, "TaskId": task_id})
         return blob_keys
 
     def _apply_upsert(self, task: APITask) -> APITask:
@@ -786,6 +839,14 @@ class JournaledTaskStore(InMemoryTaskStore):
         task = super()._apply_update(task_id, status, backend_status)
         self._log(task, slim=True)
         return task
+
+    def _validates_task_ids(self) -> bool:
+        # Journal replay runs before the append handle opens
+        # (``self._journal is None``) and follower absorb sets
+        # ``_absorbing`` — both apply already-accepted history and must
+        # never re-validate it (ADVICE r5: a legacy ':' TaskId would
+        # crash-loop replay / wedge absorb forever).
+        return self._journal is not None and not self._absorbing
 
     def _check_open(self) -> None:
         # Refuse BEFORE mutating: a write after close() must not leave memory
@@ -960,13 +1021,34 @@ class FollowerTaskStore(JournaledTaskStore):
     # /demote endpoint is unaffected — it is an operator/prober action.
     passive_fencing = True
 
+    # Plausibility bound on PASSIVE fencing evidence (ADVICE r5 #2): an
+    # unauthenticated X-Store-Epoch header may only demote us when it is
+    # within this many epochs of our own. Epochs advance by 1 per promotion,
+    # so a legitimate peer can realistically be at most a few ahead; a
+    # forged huge epoch would otherwise be ADOPTED as our own, propagate via
+    # honest clients' echoes, and depose the newly-promoted standby too — a
+    # one-request total write outage. Evidence beyond the bound is ignored
+    # (logged); genuinely large jumps go through the authenticated /demote
+    # path, which stays unbounded.
+    PASSIVE_EPOCH_BOUND = 8
+
     def note_epoch(self, epoch: int) -> None:
         """Ingest fencing evidence carried by ordinary traffic (the
         ``X-Store-Epoch`` request header, a journal-stream probe's epoch
         param): a higher epoch means a newer primary exists somewhere —
         self-demote before touching state. Cheap no-op on every request
-        where the epoch is not newer (the steady state)."""
+        where the epoch is not newer (the steady state). Evidence more than
+        ``PASSIVE_EPOCH_BOUND`` ahead of our own epoch is implausible from
+        an honest peer and is ignored (see the bound's comment)."""
         if not self.passive_fencing:
+            return
+        if epoch > self.epoch + self.PASSIVE_EPOCH_BOUND:
+            import logging
+            logging.getLogger("ai4e_tpu.taskstore").warning(
+                "ignoring implausible passive fencing epoch %d (ours is %d, "
+                "bound +%d); use the authenticated /demote path if this is "
+                "a real failover", epoch, self.epoch,
+                self.PASSIVE_EPOCH_BOUND)
             return
         if epoch > self.epoch and self.role == "primary":
             try:

@@ -80,6 +80,21 @@ class APITask:
     body: bytes = b""
     content_type: str = "application/json"
     publish: bool = False  # PublishToGrid: enqueue onto the transport on upsert
+    # Result-cache provenance (``rescache/``): the canonical request key the
+    # gateway derived for this task, or "" for uncacheable/opted-out
+    # requests. Rides the record (and the journal) so the store listener can
+    # fill the cache on the terminal transition, the dispatcher can serve a
+    # redelivery straight from the cache, and operators can see WHY a task
+    # says "completed - served from cache".
+    cache_key: str = ""
+    # Journal participation. False for records whose loss on restart is
+    # acceptable — cache-hit tasks, whose terminal record was already in the
+    # submit response: a JournaledTaskStore keeps them queryable in memory
+    # but never appends them (or their results) to the journal, so a high
+    # duplicate rate cannot turn "served from cache" into per-hit
+    # payload-sized fsync I/O. Process-local like ``publish`` — never on the
+    # wire or in the journal (a replayed record is durable by definition).
+    durable: bool = True
 
     @property
     def endpoint_path(self) -> str:
@@ -92,7 +107,7 @@ class APITask:
     def to_dict(self) -> dict:
         """Wire shape returned to clients polling ``GET /task/{taskId}``
         (``CacheConnectorGet.cs:26-74`` returns the task JSON verbatim)."""
-        return {
+        d = {
             "TaskId": self.task_id,
             "Timestamp": self.timestamp,
             "Status": self.status,
@@ -100,6 +115,11 @@ class APITask:
             "Endpoint": self.endpoint,
             "ContentType": self.content_type,
         }
+        if self.cache_key:
+            # Only when set: pre-cache records (and uncached tasks) keep the
+            # exact reference wire shape.
+            d["CacheKey"] = self.cache_key
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "APITask":
@@ -117,6 +137,7 @@ class APITask:
             body=body,
             content_type=d.get("ContentType", "application/json"),
             publish=bool(d.get("PublishToGrid", False)),
+            cache_key=d.get("CacheKey", ""),
         )
 
     def with_status(self, status: str, backend_status: str | None = None) -> "APITask":

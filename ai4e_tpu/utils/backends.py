@@ -41,8 +41,11 @@ def normalize_backends(backend_uri: str | Iterable) -> Weighted:
                     for e in backend_uri)):
         # Already normalized (every producer of this exact shape ran the
         # validation below) — registration paths hand sets down through
-        # several layers and must not pay or drift on re-validation.
-        return backend_uri
+        # several layers and must not pay or drift on re-validation. A COPY,
+        # never the caller's list object: the result is stored in live
+        # routes/dispatchers, and a caller mutating its own list after
+        # registration must not silently rewrite routing weights (ADVICE r5).
+        return list(backend_uri)
     out: Weighted = []
     for entry in backend_uri:
         if isinstance(entry, str):

@@ -43,10 +43,14 @@ async def run_closed_loop(
     ramp: float = 5.0,
     task_timeout: float = 120.0,
     poll_wait: float = 30.0,
+    post_url_for=None,
 ) -> dict:
     """Drive ``post_url`` closed-loop; returns window stats.
 
     ``status_url_for(task_id) -> url`` is required in async mode.
+    ``post_url_for() -> url`` (optional) picks the POST target per request —
+    the bench's duplicate-request mix rides this (identical requests POST
+    the bare route, unique ones carry a never-repeating query param).
     Returns ``{"value", "p50_latency_ms", "p95_latency_ms", "completed",
     "failed", "duration_s"}`` where value is completions/second inside the
     measurement window that opens after ``ramp`` seconds.
@@ -63,8 +67,9 @@ async def run_closed_loop(
     async def one_async() -> None:
         nonlocal completed, failed
         t0 = time.perf_counter()
+        url = post_url if post_url_for is None else post_url_for()
         try:
-            async with session.post(post_url, data=payload,
+            async with session.post(url, data=payload,
                                     headers=headers) as resp:
                 if resp.status in (503, 429):
                     # Backpressure (admission 503 / per-key throttle 429):
@@ -114,8 +119,9 @@ async def run_closed_loop(
         # one_async, so sustained backpressure can never outlive the run.
         nonlocal completed, failed
         t0 = time.perf_counter()
+        url = post_url if post_url_for is None else post_url_for()
         try:
-            async with session.post(post_url, data=payload,
+            async with session.post(url, data=payload,
                                     headers=headers) as resp:
                 if resp.status in (503, 429):
                     await asyncio.sleep(_backoff(resp))

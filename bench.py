@@ -487,14 +487,25 @@ def build_platform(args):
         native_store=args.fabric == "native",
         native_broker=(args.fabric == "native"
                        and args.transport == "queue"),
-        retry_delay=0.05, dispatcher_concurrency=args.dispatcher_concurrency))
+        retry_delay=0.05, dispatcher_concurrency=args.dispatcher_concurrency,
+        # --cache-hit-ratio > 0 enables the inference result cache +
+        # single-flight coalescing (rescache/) for the duplicate-mix run.
+        result_cache=getattr(args, "cache_hit_ratio", 0.0) > 0))
     runtime = ModelRuntime(donate_batch=args.donate_batch)
     batcher = MicroBatcher(runtime, max_wait_ms=args.max_wait_ms,
                            max_pending=args.concurrency * 4,
                            pipeline_depth=args.pipeline_depth)
     worker = InferenceWorker(f"{args.model}-svc", runtime, batcher,
                              task_manager=platform.task_manager,
-                             prefix=f"v1/{args.model}", store=platform.store)
+                             prefix=f"v1/{args.model}", store=platform.store,
+                             result_cache=platform.result_cache,
+                             # The platform gateway fronts this worker with
+                             # the SAME cache — its proxy layer answers and
+                             # fills; a worker-keyed duplicate per request
+                             # would double-count every payload against the
+                             # byte budget (reload invalidation still works).
+                             cache_sync_path=False,
+                             checkpoint_root=args.checkpoint_dir)
     content_type = "application/octet-stream"
     # Routes the gateway/dispatchers must know: [(public?, path)] — the
     # first is the API clients POST; the rest are internal stage backends.
@@ -946,15 +957,66 @@ async def run_bench(args) -> dict:
                 "pipeline handoff never fired — bench would measure a "
                 "single-stage task")
 
+        # Duplicate-request mix for the result cache (--cache-hit-ratio r):
+        # a share r of POSTs repeat the identical hot request (cacheable —
+        # first execution, then hits/coalesces), the rest carry a
+        # never-repeating query param, which the canonical request key
+        # includes — they always execute on device. Cache stats are
+        # snapshotted when the measured window opens so the cold ramp
+        # doesn't dilute the reported hit ratio.
+        cache = getattr(platform, "result_cache", None)
+        requested_ratio = getattr(args, "cache_hit_ratio", 0.0) or 0.0
+        post_url_for = None
+        if cache is not None and requested_ratio > 0:
+            import itertools
+            import random as _random
+            _rng = _random.Random(0)
+            _uniq = itertools.count()
+
+            def post_url_for():
+                if _rng.random() < requested_ratio:
+                    return post_url
+                return f"{post_url}?uniq={next(_uniq)}"
+
+        cache_mark: dict = {}
+
+        async def _snap_cache_at_window_open():
+            await asyncio.sleep(args.ramp)
+            if cache is not None:
+                cache_mark.update(cache.stats())
+
         # Closed loop with a steady-state ramp before the measured window
         # (shared with examples/loadgen.py — ai4e_tpu/utils/loadclient.py).
-        window = await run_closed_loop(
+        window, _ = await asyncio.gather(run_closed_loop(
             session,
             post_url=post_url, payload=payload, headers=headers,
             mode=args.mode,
             status_url_for=lambda tid: f"{gw}/v1/taskmanagement/task/{tid}",
             concurrency=args.concurrency, duration=args.duration,
-            ramp=args.ramp)
+            ramp=args.ramp, post_url_for=post_url_for),
+            _snap_cache_at_window_open())
+
+    cache_meta = {}
+    if cache is not None:
+        stats = cache.stats()
+        hits = stats["hits"] - cache_mark.get("hits", 0)
+        misses = stats["misses"] - cache_mark.get("misses", 0)
+        coalesced = stats["coalesced"] - cache_mark.get("coalesced", 0)
+        lookups = hits + misses
+        elapsed = max(window["duration_s"], 1e-9)
+        cache_meta["cache"] = {
+            "requested_hit_ratio": requested_ratio,
+            "hit_ratio": round(hits / lookups, 3) if lookups else 0.0,
+            "hits": int(hits),
+            "misses": int(misses),
+            "coalesced": int(coalesced),
+            # Requests answered without touching the device, per second of
+            # the measured window — read next to "value" (total req/s) and
+            # the device-side avg_batch_size/batch_exec figures.
+            "served_from_cache_req_s": round((hits + coalesced) / elapsed, 2),
+            "entries": stats["entries"],
+            "resident_bytes": stats["bytes"],
+        }
 
     await platform.stop()
     await batcher.stop()
@@ -1093,6 +1155,7 @@ async def run_bench(args) -> dict:
         "concurrency": args.concurrency,
         "device": _device_kind(),
         **build_meta,
+        **cache_meta,
         **batch_meta,
         **capability_meta,
         **pallas_meta,
@@ -1260,6 +1323,7 @@ def _forward_argv(args) -> list[str]:
             "--seq-len", str(args.seq_len),
             "--seq-input", args.seq_input,
             "--wire", args.wire,
+            "--cache-hit-ratio", str(args.cache_hit_ratio),
             "--buckets", *[str(b) for b in args.buckets]]
 
 
@@ -1355,6 +1419,15 @@ def main() -> None:
                              "h2d rides yuv420; auto (default) = fastest "
                              "TPU-certified wire in bench_results/r*-tpu "
                              "(resolve_auto_wire), yuv420 absent evidence")
+    parser.add_argument("--cache-hit-ratio", type=float, default=0.0,
+                        help="enable the inference result cache (rescache/) "
+                             "and drive a duplicate-request mix: this share "
+                             "of POSTs repeat one identical hot request "
+                             "(served from cache after the first "
+                             "execution), the rest are unique and always "
+                             "execute. The JSON gains a 'cache' block with "
+                             "the measured hit ratio and served-from-cache "
+                             "req/s. 0 (default) = cache off")
     parser.add_argument("--cpu", action="store_true",
                         help="force CPU (debug runs)")
     parser.add_argument("--probe-timeout", type=float, default=60.0,

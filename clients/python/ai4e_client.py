@@ -17,6 +17,16 @@ callers, so notebooks and scripts need no extra dependencies:
     record = client.wait(task_id)           # long-polls to a terminal state
     result = client.result(record)          # parsed JSON result, if stored
     out = client.call_sync("/v1/landcover/classify", tile_bytes)
+
+Result cache (gateway-side, ``docs/rescache.md``): when the platform runs
+with the inference result cache, ``submit``/``call_sync`` responses carry an
+``X-Cache: hit|miss|coalesced|bypass`` header — surfaced here as
+``client.last_cache_status`` after each call (None when the platform has no
+cache). A *hit* returns an already-completed task served from the cache; a
+*coalesced* submit returns the SAME TaskId as an identical in-flight request
+(both callers poll one execution). Pass ``no_cache=True`` to opt a request
+out (sends ``X-Cache-Bypass: 1`` — the request always executes and its
+result is not stored).
 """
 
 from __future__ import annotations
@@ -73,15 +83,22 @@ class AI4EClient:
         if api_key:
             # The reference's APIM front door header, preserved verbatim.
             self._headers["Ocp-Apim-Subscription-Key"] = api_key
+        # X-Cache of the most recent submit/call_sync response (None when
+        # the gateway runs without a result cache).
+        self.last_cache_status: str | None = None
 
     # -- transport ---------------------------------------------------------
 
     def _request(self, method: str, path: str, body: bytes | None = None,
                  content_type: str | None = None,
-                 timeout: float | None = None):
+                 timeout: float | None = None,
+                 no_cache: bool = False):
         headers = dict(self._headers)
         if content_type:
             headers["Content-Type"] = content_type
+        if no_cache:
+            # Per-request result-cache opt-out (rescache.keys.BYPASS_HEADER).
+            headers["X-Cache-Bypass"] = "1"
         attempt = 0
         per_try = self.timeout if timeout is None else timeout
         # Retry sleeps AND replica attempts stay INSIDE the caller's time
@@ -137,9 +154,7 @@ class AI4EClient:
                 if extra is not None and extra is not signal:
                     extra.close()
             if attempt >= self.retries:
-                if signal is not None:
-                    raise signal
-                raise conn_error
+                raise self._pass_error(signal, conn_error, per_try)
             delay = 0.0
             if signal is not None:
                 retry_after = signal.headers.get("Retry-After")
@@ -151,20 +166,38 @@ class AI4EClient:
                 delay = self.retry_backoff * (2 ** attempt)
             delay = min(delay, 60.0)
             if time.monotonic() + delay >= deadline:
-                if signal is not None:
-                    raise signal  # budget exhausted
-                raise conn_error
+                raise self._pass_error(signal, conn_error, per_try)
             if signal is not None:
                 signal.close()
             time.sleep(delay)
             attempt += 1
 
+    def _pass_error(self, signal, conn_error, per_try: float) -> BaseException:
+        """The error a finished (or budget-exhausted) replica pass surfaces:
+        the backpressure/not-primary response, else the captured connection
+        error, else — when the pass ended with NOTHING captured (the
+        deadline expired before any attempt, e.g. exactly after a retry
+        sleep) — a real TaskTimeout instead of ``raise None``'s TypeError."""
+        if signal is not None:
+            return signal
+        if conn_error is not None:
+            return conn_error
+        return TaskTimeout(
+            f"request budget ({per_try:.1f}s) exhausted before any gateway "
+            f"replied: {self._gateways}")
+
     # -- async task API ----------------------------------------------------
 
     def submit(self, path: str, payload: bytes,
-               content_type: str = DEFAULT_CONTENT_TYPE) -> str:
-        """POST an async API; returns the TaskId the gateway created."""
-        with self._request("POST", path, payload, content_type) as resp:
+               content_type: str = DEFAULT_CONTENT_TYPE,
+               no_cache: bool = False) -> str:
+        """POST an async API; returns the TaskId the gateway created (or the
+        in-flight identical request's TaskId when the gateway coalesced —
+        check ``last_cache_status``). ``no_cache=True`` bypasses the result
+        cache for this request."""
+        with self._request("POST", path, payload, content_type,
+                           no_cache=no_cache) as resp:
+            self.last_cache_status = resp.headers.get("X-Cache")
             record = json.loads(resp.read())
         return record["TaskId"]
 
@@ -234,11 +267,15 @@ class AI4EClient:
     # -- sync API ----------------------------------------------------------
 
     def call_sync(self, path: str, payload: bytes,
-                  content_type: str = DEFAULT_CONTENT_TYPE) -> object:
+                  content_type: str = DEFAULT_CONTENT_TYPE,
+                  no_cache: bool = False) -> object:
         """POST a sync API; returns the parsed JSON response (raw bytes if
         the response is not JSON — keyed off the Content-Type header, same
-        as ``result``, so a text body that happens to parse isn't coerced)."""
-        with self._request("POST", path, payload, content_type) as resp:
+        as ``result``, so a text body that happens to parse isn't coerced).
+        ``no_cache=True`` bypasses the result cache for this request."""
+        with self._request("POST", path, payload, content_type,
+                           no_cache=no_cache) as resp:
+            self.last_cache_status = resp.headers.get("X-Cache")
             body = resp.read()
             if resp.headers.get_content_type() == "application/json":
                 return json.loads(body)

@@ -1,0 +1,1138 @@
+"""Inference result cache + single-flight coalescing (``ai4e_tpu/rescache/``)
+and the round-5 ADVICE regressions that ride this PR.
+
+Covers the subsystem's acceptance surface end to end: canonical-key
+stability across equivalent payloads, LRU/TTL/byte-budget eviction,
+invalidation on checkpoint hot reload (a stale result can never outlive a
+weight swap), and the coalescing guarantee — N concurrent identical requests
+produce exactly ONE device execution (asserted via the runtime's batch-size
+metric) while every client receives the correct result. Plus the dispatcher's
+serve-a-redelivery-from-cache path, the gateway ``X-Cache`` header contract
+(hit/miss/coalesced/bypass), and regressions for the five ADVICE findings.
+"""
+
+import asyncio
+import importlib.util
+import io
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.metrics import MetricsRegistry
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.rescache import (ResultCache, attach_store, canonical_payload,
+                               family_of, request_key)
+from ai4e_tpu.runtime import (InferenceWorker, MicroBatcher, ModelRuntime,
+                              build_servable)
+from ai4e_tpu.taskstore import (APITask, FollowerTaskStore, InMemoryTaskStore,
+                                JournaledTaskStore, TaskStatus)
+from ai4e_tpu.utils.backends import normalize_backends, pick_backend
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "ai4e_client_rescache", os.path.join(REPO, "clients", "python",
+                                         "ai4e_client.py"))
+ai4e_client = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(ai4e_client)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def poll_until(client, task_id, predicate, tries=400, delay=0.02):
+    body = None
+    for _ in range(tries):
+        resp = await client.get(f"/v1/taskmanagement/task/{task_id}")
+        body = await resp.json()
+        if predicate(body):
+            return body
+        await asyncio.sleep(delay)
+    return body
+
+
+def npy_bytes(arr) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+# -- canonical request hashing -----------------------------------------------
+
+
+class TestRequestKey:
+    def test_json_equivalent_payloads_share_a_key(self):
+        a = request_key("/v1/x", b'{"a": 1, "b": [2, 3]}', "application/json")
+        b = request_key("/v1/x", b'{"b":[2,3],"a":1}',
+                        "application/json; charset=utf-8")
+        assert a == b
+
+    def test_semantically_different_json_differs(self):
+        a = request_key("/v1/x", b'{"a": 1}', "application/json")
+        b = request_key("/v1/x", b'{"a": 2}', "application/json")
+        assert a != b
+
+    def test_binary_payloads_hash_raw(self):
+        payload = npy_bytes(np.arange(4, dtype=np.float32))
+        a = request_key("/v1/x", payload, "application/octet-stream")
+        b = request_key("/v1/x", payload, "application/octet-stream")
+        c = request_key("/v1/x", payload + b"\0", "application/octet-stream")
+        assert a == b and a != c
+
+    def test_every_dimension_is_significant(self):
+        base = request_key("/v1/x", b"p", "application/octet-stream")
+        assert request_key("/v1/y", b"p", "application/octet-stream") != base
+        assert request_key("/v1/x", b"p", "image/jpeg") != base
+        assert request_key("/v1/x", b"p", "application/octet-stream",
+                           checkpoint="2") != base
+        assert request_key("/v1/x", b"p", "application/octet-stream",
+                           extra="op?conf=0.9") != base
+
+    def test_family_recoverable_from_key(self):
+        key = request_key("/v1/detect", b"p")
+        assert family_of(key) == "/v1/detect"
+
+    def test_invalid_json_falls_back_to_raw_bytes(self):
+        broken = b'{"a": '
+        assert canonical_payload(broken, "application/json") == broken
+
+
+# -- eviction ----------------------------------------------------------------
+
+
+class TestEviction:
+    def test_lru_entry_budget(self):
+        cache = ResultCache(max_entries=2, max_bytes=1 << 20,
+                            metrics=MetricsRegistry())
+        cache.put("f|a", b"1")
+        cache.put("f|b", b"2")
+        assert cache.get("f|a") is not None  # refresh a's recency
+        cache.put("f|c", b"3")
+        assert cache.peek("f|a") and cache.peek("f|c")
+        assert not cache.peek("f|b")  # the LRU victim
+
+    def test_byte_budget(self):
+        cache = ResultCache(max_entries=100, max_bytes=10,
+                            max_entry_bytes=10, metrics=MetricsRegistry())
+        cache.put("f|a", b"12345")
+        cache.put("f|b", b"12345")
+        cache.put("f|c", b"12345")  # 15 bytes resident -> evict oldest
+        assert not cache.peek("f|a")
+        assert cache.peek("f|b") and cache.peek("f|c")
+        assert cache.stats()["bytes"] == 10
+
+    def test_oversized_entry_refused(self):
+        cache = ResultCache(max_bytes=100, max_entry_bytes=4,
+                            metrics=MetricsRegistry())
+        assert cache.put("f|big", b"12345") is False
+        assert not cache.peek("f|big")
+
+    def test_ttl_expiry(self):
+        now = [0.0]
+        reg = MetricsRegistry()
+        cache = ResultCache(ttl_s=10.0, metrics=reg, clock=lambda: now[0])
+        cache.put("f|a", b"1")
+        now[0] = 9.9
+        assert cache.get("f|a") is not None
+        now[0] = 10.0
+        assert cache.get("f|a") is None  # expired, lazily dropped
+        assert cache.stats()["entries"] == 0
+        # Lazy expiry keeps the gauges honest too — a read-only lull must
+        # not leave /metrics reporting pre-TTL entries/bytes.
+        assert reg.gauge("ai4e_rescache_entries", "").value() == 0
+        assert reg.gauge("ai4e_rescache_bytes", "").value() == 0
+
+    def test_bypass_header_falsy_values_do_not_bypass(self):
+        from ai4e_tpu.rescache.keys import cache_bypass_requested
+        assert cache_bypass_requested({"X-Cache-Bypass": "1"})
+        assert cache_bypass_requested({"X-Cache-Bypass": "true"})
+        assert cache_bypass_requested({"Cache-Control": "no-cache"})
+        # Explicit falsy values mean "do not bypass".
+        for raw in ("0", "false", "no", "off", ""):
+            assert not cache_bypass_requested({"X-Cache-Bypass": raw})
+        assert not cache_bypass_requested({})
+
+    def test_invalidate_family_is_scoped(self):
+        cache = ResultCache(metrics=MetricsRegistry())
+        cache.put("fam1|a", b"1")
+        cache.put("fam1|b", b"2")
+        cache.put("fam2|c", b"3")
+        assert cache.invalidate_family("fam1") == 2
+        assert not cache.peek("fam1|a") and not cache.peek("fam1|b")
+        assert cache.peek("fam2|c")
+
+    def test_invalidate_family_clears_inflight(self):
+        cache = ResultCache(metrics=MetricsRegistry())
+        cache.register_inflight("fam1|a", "t1")
+        cache.register_inflight("fam2|b", "t2")
+        cache.invalidate_family("fam1")
+        assert cache.leader_for("fam1|a") is None
+        assert cache.leader_for("fam2|b") == "t2"
+
+
+class TestSingleFlightRegistry:
+    def test_register_leader_release(self):
+        cache = ResultCache(metrics=MetricsRegistry())
+        assert cache.register_inflight("f|k", "t1") is True
+        assert cache.register_inflight("f|k", "t2") is False  # t1 owns it
+        assert cache.leader_for("f|k") == "t1"
+        cache.release_inflight("f|k", "t2")  # stale release: no-op
+        assert cache.leader_for("f|k") == "t1"
+        cache.release_inflight("f|k", "t1")
+        assert cache.leader_for("f|k") is None
+
+
+# -- gateway async path e2e --------------------------------------------------
+
+
+async def _echo_platform(reg: MetricsRegistry):
+    """Platform + real runtime/batcher/worker serving the echo model on an
+    async route, with the result cache enabled. Returns
+    (platform, gw_client, svc_client, batcher, payload, public_path)."""
+    platform = LocalPlatform(PlatformConfig(retry_delay=0.05,
+                                            result_cache=True), metrics=reg)
+    servable = build_servable("echo", name="echo", size=8, buckets=(4,))
+    runtime = ModelRuntime()
+    runtime.register(servable)
+    batcher = MicroBatcher(runtime, max_wait_ms=1.0, metrics=reg)
+    worker = InferenceWorker("w", runtime, batcher,
+                             task_manager=platform.task_manager,
+                             prefix="v1/echo", store=platform.store,
+                             result_cache=platform.result_cache)
+    worker.serve_model(servable, async_path="/run-async")
+    await batcher.start()
+    svc_client = await serve(worker.service.app)
+    backend = str(svc_client.make_url("/v1/echo/run-async"))
+    platform.publish_async_api("/v1/public/run", backend)
+    gw_client = await serve(platform.gateway.app)
+    await platform.start()
+    payload = npy_bytes(np.arange(8, dtype=np.float32))
+    return platform, gw_client, svc_client, batcher, payload
+
+
+def _executed_examples(reg: MetricsRegistry) -> float:
+    total = 0.0
+    for _, _, _labels, data in reg.histogram("ai4e_batch_size", "").collect():
+        total += float(data["sum"])
+    return total
+
+
+class TestAsyncPathCaching:
+    def test_coalescing_one_execution_for_n_identical_requests(self):
+        """THE coalescing guarantee: N concurrent identical requests → one
+        device execution (runtime batch-size metric), every client a correct
+        completed record + result."""
+        async def main():
+            reg = MetricsRegistry()
+            (platform, gw, svc, batcher, payload) = await _echo_platform(reg)
+            try:
+                n = 5
+                posts = await asyncio.gather(*(
+                    gw.post("/v1/public/run", data=payload) for _ in range(n)))
+                records, xcache = [], []
+                for resp in posts:
+                    assert resp.status == 200
+                    xcache.append(resp.headers.get("X-Cache"))
+                    records.append(await resp.json())
+                # Exactly one execution owner; everyone else rode it.
+                assert xcache.count("miss") == 1, xcache
+                assert all(x in ("miss", "coalesced", "hit") for x in xcache)
+                # Coalesced submits share the leader's TaskId.
+                leader_id = records[xcache.index("miss")]["TaskId"]
+                for rec, x in zip(records, xcache):
+                    if x == "coalesced":
+                        assert rec["TaskId"] == leader_id
+                # Every client's task reaches completed with the right result.
+                expect = {"echo": [float(v) for v in range(8)]}
+                for rec in records:
+                    final = await poll_until(
+                        gw, rec["TaskId"],
+                        lambda b: "completed" in b["Status"])
+                    assert "completed" in final["Status"], final
+                    body, _ctype = platform.store.get_result(rec["TaskId"])
+                    assert json.loads(body) == expect
+                assert _executed_examples(reg) == 1.0
+
+                # A later identical request is a straight cache hit: a fresh,
+                # already-terminal task — still no second execution.
+                resp = await gw.post("/v1/public/run", data=payload)
+                assert resp.headers.get("X-Cache") == "hit"
+                rec = await resp.json()
+                assert rec["Status"] == "completed - served from cache"
+                assert rec["TaskId"] != leader_id
+                body, _ctype = platform.store.get_result(rec["TaskId"])
+                assert json.loads(body) == expect
+                assert _executed_examples(reg) == 1.0
+            finally:
+                await platform.stop()
+                await batcher.stop()
+                await gw.close()
+                await svc.close()
+
+        run(main())
+
+    def test_bypass_header_opts_out_and_executes(self):
+        async def main():
+            reg = MetricsRegistry()
+            (platform, gw, svc, batcher, payload) = await _echo_platform(reg)
+            try:
+                first = await gw.post("/v1/public/run", data=payload)
+                assert first.headers.get("X-Cache") == "miss"
+                await poll_until(gw, (await first.json())["TaskId"],
+                                 lambda b: "completed" in b["Status"])
+                assert _executed_examples(reg) == 1.0
+
+                resp = await gw.post("/v1/public/run", data=payload,
+                                     headers={"X-Cache-Bypass": "1"})
+                assert resp.headers.get("X-Cache") == "bypass"
+                rec = await resp.json()
+                assert rec["Status"] == "created"
+                await poll_until(gw, rec["TaskId"],
+                                 lambda b: "completed" in b["Status"])
+                # Opted out on both ends: executed again, and its result was
+                # not stored (no CacheKey on the task).
+                assert _executed_examples(reg) == 2.0
+                assert "CacheKey" not in rec
+            finally:
+                await platform.stop()
+                await batcher.stop()
+                await gw.close()
+                await svc.close()
+
+        run(main())
+
+    def test_different_payloads_do_not_share_results(self):
+        async def main():
+            reg = MetricsRegistry()
+            (platform, gw, svc, batcher, payload) = await _echo_platform(reg)
+            try:
+                other = npy_bytes(np.arange(8, dtype=np.float32) + 1.0)
+                r1 = await gw.post("/v1/public/run", data=payload)
+                r2 = await gw.post("/v1/public/run", data=other)
+                assert r2.headers.get("X-Cache") == "miss"  # distinct key
+                t1 = (await r1.json())["TaskId"]
+                t2 = (await r2.json())["TaskId"]
+                assert t1 != t2
+                await poll_until(gw, t1, lambda b: "completed" in b["Status"])
+                await poll_until(gw, t2, lambda b: "completed" in b["Status"])
+                b1, _ = platform.store.get_result(t1)
+                b2, _ = platform.store.get_result(t2)
+                assert json.loads(b1) != json.loads(b2)
+                assert _executed_examples(reg) == 2.0
+            finally:
+                await platform.stop()
+                await batcher.stop()
+                await gw.close()
+                await svc.close()
+
+        run(main())
+
+
+class TestDispatcherServeFromCache:
+    def test_redelivery_completes_from_cache_without_backend(self):
+        """A task whose identical request's result is already cached
+        completes at the DISPATCHER — the backend (dead here) is never
+        needed. Covers redeliveries/requeues/journal-restored tasks."""
+        async def main():
+            reg = MetricsRegistry()
+            platform = LocalPlatform(PlatformConfig(
+                retry_delay=0.05, result_cache=True), metrics=reg)
+            # Backend is a closed port: a plain dispatch can never succeed.
+            platform.publish_async_api("/v1/public/dead",
+                                       "http://127.0.0.1:1/v1/dead/x")
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                resp = await gw.post("/v1/public/dead", data=b"PAYLOAD")
+                assert resp.headers.get("X-Cache") == "miss"
+                tid = (await resp.json())["TaskId"]
+                key = platform.store.get(tid).cache_key
+                assert key
+                # The identical request's result lands in the cache (as if
+                # computed elsewhere); the next redelivery must serve it.
+                platform.result_cache.put(key, b'{"ok": 1}')
+                final = await poll_until(
+                    gw, tid, lambda b: "completed" in b["Status"])
+                assert final["Status"] == "completed - served from cache"
+                body, ctype = platform.store.get_result(tid)
+                assert json.loads(body) == {"ok": 1}
+                # Terminal transition released the single-flight leader.
+                assert platform.result_cache.leader_for(key) is None
+            finally:
+                await platform.stop()
+                await gw.close()
+
+        run(main())
+
+
+# -- invalidation on checkpoint hot reload -----------------------------------
+
+
+class TestInvalidationOnHotReload:
+    def test_reload_invalidates_and_serves_new_weights(self, tmp_path):
+        """A weight swap must make every pre-swap cached result unreachable:
+        the same request after reload returns the NEW model's answer."""
+        async def main():
+            reg = MetricsRegistry()
+            servable = build_servable("echo", name="echo", size=8,
+                                      buckets=(4,))
+            runtime = ModelRuntime()
+            runtime.register(servable)
+            batcher = MicroBatcher(runtime, max_wait_ms=1.0, metrics=reg)
+            cache = ResultCache(metrics=reg)
+            worker = InferenceWorker("w", runtime, batcher,
+                                     prefix="v1/echo", result_cache=cache,
+                                     checkpoint_root=str(tmp_path))
+            worker.serve_model(servable, sync_path="/run")
+            await batcher.start()
+            client = await serve(worker.service.app)
+            try:
+                from ai4e_tpu.checkpoint import save_params
+                ckpt = str(tmp_path / "echo_v2")
+                save_params(ckpt, {"scale": np.array(3.0, np.float32)})
+
+                payload = npy_bytes(np.arange(8, dtype=np.float32))
+                before = (await (await client.post(
+                    "/v1/echo/run", data=payload)).json())["echo"]
+                assert before[:3] == [0.0, 1.0, 2.0]
+                executed_once = _executed_examples(reg)
+                # Second identical request: served from the worker cache —
+                # no new device execution (worker-level lookups are
+                # deliberately uncounted in hit/miss, which belong to the
+                # gateway edge, so assert on the batch metric instead).
+                again = (await (await client.post(
+                    "/v1/echo/run", data=payload)).json())["echo"]
+                assert again == before
+                assert _executed_examples(reg) == executed_once
+                assert cache.stats()["entries"] == 1
+
+                resp = await client.post("/v1/echo/models/echo/reload",
+                                         json={"checkpoint": ckpt})
+                assert resp.status == 200, await resp.json()
+                # The family was invalidated with the swap.
+                assert cache.stats()["entries"] == 0
+
+                after = (await (await client.post(
+                    "/v1/echo/run", data=payload)).json())["echo"]
+                assert after[:3] == [0.0, 3.0, 6.0]  # new weights, not stale
+            finally:
+                await batcher.stop()
+                await client.close()
+
+        run(main())
+
+
+# -- ADVICE r5 regressions ---------------------------------------------------
+
+
+class TestReloadEndpointHardening:
+    """ADVICE r5: the hot-reload endpoint must confine checkpoint paths to
+    the configured root (realpath prefix) and honor the API-key gate."""
+
+    def test_traversal_path_rejected_403(self, tmp_path):
+        async def main():
+            servable = build_servable("echo", name="echo", size=8,
+                                      buckets=(4,))
+            runtime = ModelRuntime()
+            runtime.register(servable)
+            batcher = MicroBatcher(runtime, max_wait_ms=1.0,
+                                   metrics=MetricsRegistry())
+            root = tmp_path / "ckpts"
+            root.mkdir()
+            worker = InferenceWorker("w", runtime, batcher, prefix="v1/echo",
+                                     checkpoint_root=str(root))
+            worker.serve_model(servable, sync_path="/run")
+            await batcher.start()
+            client = await serve(worker.service.app)
+            try:
+                for evil in (str(root / ".." / "outside"), "/etc/passwd",
+                             str(root) + "_sibling/ckpt"):
+                    resp = await client.post(
+                        "/v1/echo/models/echo/reload",
+                        json={"checkpoint": evil})
+                    assert resp.status == 403, (evil, await resp.json())
+            finally:
+                await batcher.stop()
+                await client.close()
+
+        run(main())
+
+    def test_symlink_escape_rejected_403(self, tmp_path):
+        async def main():
+            servable = build_servable("echo", name="echo", size=8,
+                                      buckets=(4,))
+            runtime = ModelRuntime()
+            runtime.register(servable)
+            batcher = MicroBatcher(runtime, max_wait_ms=1.0,
+                                   metrics=MetricsRegistry())
+            root = tmp_path / "ckpts"
+            root.mkdir()
+            outside = tmp_path / "outside"
+            outside.mkdir()
+            (root / "link").symlink_to(outside)
+            worker = InferenceWorker("w", runtime, batcher, prefix="v1/echo",
+                                     checkpoint_root=str(root))
+            worker.serve_model(servable, sync_path="/run")
+            await batcher.start()
+            client = await serve(worker.service.app)
+            try:
+                resp = await client.post(
+                    "/v1/echo/models/echo/reload",
+                    json={"checkpoint": str(root / "link" / "ckpt")})
+                assert resp.status == 403
+            finally:
+                await batcher.stop()
+                await client.close()
+
+        run(main())
+
+    def test_api_key_gate(self, tmp_path):
+        async def main():
+            servable = build_servable("echo", name="echo", size=8,
+                                      buckets=(4,))
+            runtime = ModelRuntime()
+            runtime.register(servable)
+            batcher = MicroBatcher(runtime, max_wait_ms=1.0,
+                                   metrics=MetricsRegistry())
+            worker = InferenceWorker("w", runtime, batcher, prefix="v1/echo",
+                                     checkpoint_root=str(tmp_path),
+                                     admin_api_keys={"sek"})
+            worker.serve_model(servable, sync_path="/run")
+            await batcher.start()
+            client = await serve(worker.service.app)
+            try:
+                resp = await client.post("/v1/echo/models/echo/reload")
+                assert resp.status == 401
+                from ai4e_tpu.checkpoint import save_params
+                ckpt = str(tmp_path / "echo_v2")
+                save_params(ckpt, {"scale": np.array(2.0, np.float32)})
+                resp = await client.post(
+                    "/v1/echo/models/echo/reload",
+                    json={"checkpoint": ckpt},
+                    headers={"Ocp-Apim-Subscription-Key": "sek"})
+                assert resp.status == 200, await resp.json()
+            finally:
+                await batcher.stop()
+                await client.close()
+
+        run(main())
+
+
+class TestLegacyTaskIdReplay:
+    """ADVICE r5: the ':' TaskId guard must not run on journal replay or
+    follower absorb — a legacy journal must load, not crash-loop."""
+
+    def _legacy_record(self, task_id: str) -> dict:
+        return {"TaskId": task_id, "Timestamp": time.time(),
+                "Status": "created", "BackendStatus": "created",
+                "Endpoint": "/v1/legacy/x",
+                "ContentType": "application/json",
+                "BodyHex": b"legacy-body".hex()}
+
+    def test_replay_accepts_legacy_colon_ids(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(json.dumps(self._legacy_record("legacy:0")) + "\n")
+        store = JournaledTaskStore(path)
+        try:
+            assert store.get("legacy:0").endpoint == "/v1/legacy/x"
+            # External writes still validate.
+            with pytest.raises(ValueError):
+                store.upsert(APITask(task_id="evil:1", endpoint="/v1/x"))
+        finally:
+            store.close()
+
+    def test_follower_absorb_accepts_legacy_colon_ids(self, tmp_path):
+        path = str(tmp_path / "follower.jsonl")
+        store = FollowerTaskStore(path)
+        try:
+            store.absorb_lines(
+                [json.dumps(self._legacy_record("legacy:1"))])
+            assert store.get("legacy:1").endpoint == "/v1/legacy/x"
+        finally:
+            store.close()
+
+
+class TestPassiveEpochBound:
+    """ADVICE r5: unauthenticated X-Store-Epoch evidence may demote a
+    primary only within PASSIVE_EPOCH_BOUND of its own epoch; a forged huge
+    epoch is ignored — only the authenticated /demote path is unbounded."""
+
+    def test_plausible_epoch_demotes(self, tmp_path):
+        store = FollowerTaskStore(str(tmp_path / "a.jsonl"),
+                                  start_as_primary=True)
+        try:
+            store.note_epoch(store.epoch + 1)
+            assert store.role == "follower"
+        finally:
+            store.close()
+
+    def test_forged_huge_epoch_ignored(self, tmp_path):
+        store = FollowerTaskStore(str(tmp_path / "b.jsonl"),
+                                  start_as_primary=True)
+        try:
+            forged = store.epoch + store.PASSIVE_EPOCH_BOUND + 1
+            store.note_epoch(forged)
+            assert store.role == "primary"  # still serving writes
+            assert store.epoch == 0        # evidence NOT adopted
+            # The explicit authenticated path stays unbounded.
+            store.demote(forged)
+            assert store.role == "follower"
+            assert store.epoch == forged
+        finally:
+            store.close()
+
+
+class TestClientRetryExhaustion:
+    """ADVICE r5: a replica pass that captures neither a response nor a
+    connection error (budget expired mid-pass) must raise a real
+    TaskTimeout, not ``raise None``'s TypeError."""
+
+    def test_budget_exhausted_raises_task_timeout(self):
+        client = ai4e_client.AI4EClient(
+            ["http://127.0.0.1:1", "http://127.0.0.1:2"],
+            timeout=0.0, retries=0)
+        with pytest.raises(ai4e_client.TaskTimeout):
+            client.status("some-task")
+
+    def test_single_gateway_budget_exhausted_raises_task_timeout(self):
+        client = ai4e_client.AI4EClient("http://127.0.0.1:1",
+                                        timeout=0.0, retries=2,
+                                        retry_backoff=0.001)
+        with pytest.raises(ai4e_client.TaskTimeout):
+            client.status("some-task")
+
+
+class TestNormalizeBackendsCopy:
+    """ADVICE r5: the pre-normalized fast path must return a COPY — caller
+    mutation after registration must not rewrite live routing weights."""
+
+    def test_fast_path_returns_copy(self):
+        backends = [("http://h1/v1/x", 1.0), ("http://h2/v1/x", 3.0)]
+        out = normalize_backends(backends)
+        assert out == backends and out is not backends
+        backends[1] = ("http://evil/v1/x", 1000.0)
+        backends.append(("http://more-evil/v1/x", 1000.0))
+        assert out == [("http://h1/v1/x", 1.0), ("http://h2/v1/x", 3.0)]
+        # The registered set still routes to the original hosts only.
+        assert {pick_backend(out) for _ in range(50)} <= {
+            "http://h1/v1/x", "http://h2/v1/x"}
+
+
+# -- staleness-proof fills + single-flight cleanup (review hardening) --------
+
+
+class TestStaleFillRefusal:
+    """A result that was already EXECUTING when an invalidation landed must
+    not re-populate the cache on completion — the fill is conditional on
+    still owning the single-flight registration (async path) or on the
+    family's invalidation generation (sync proxy path)."""
+
+    def _store_and_cache(self):
+        store = InMemoryTaskStore()
+        cache = ResultCache(metrics=MetricsRegistry())
+        attach_store(store, cache)
+        return store, cache
+
+    def _complete(self, store, task):
+        store.set_result(task.task_id, b'{"r": 1}')
+        store.upsert(task.with_status("completed", TaskStatus.COMPLETED))
+
+    def test_registered_leader_fill_lands(self):
+        store, cache = self._store_and_cache()
+        task = store.upsert(APITask(endpoint="/v1/x", body=b"p",
+                                    cache_key="fam|k"))
+        cache.register_inflight("fam|k", task.task_id)
+        self._complete(store, task)
+        assert cache.peek("fam|k")
+        assert cache.leader_for("fam|k") is None
+
+    def test_invalidation_mid_flight_refuses_the_fill(self):
+        store, cache = self._store_and_cache()
+        task = store.upsert(APITask(endpoint="/v1/x", body=b"p",
+                                    cache_key="fam|k"))
+        cache.register_inflight("fam|k", task.task_id)
+        # Checkpoint hot reload lands while the task is still executing.
+        cache.invalidate_family("fam")
+        self._complete(store, task)
+        assert not cache.peek("fam|k")  # old-weights result never lands
+        assert cache.leader_for("fam|k") is None
+
+    def test_unregistered_completion_leaves_cache_cold(self):
+        # Journal-restored / requeued task: completed with a cache_key but
+        # no live registration — cold is safe, stale is not.
+        store, cache = self._store_and_cache()
+        task = store.upsert(APITask(endpoint="/v1/x", body=b"p",
+                                    cache_key="fam|k"))
+        self._complete(store, task)
+        assert not cache.peek("fam|k")
+
+    def test_put_if_generation_refuses_stale_sync_fill(self):
+        cache = ResultCache(metrics=MetricsRegistry())
+        gen = cache.generation("fam|k")  # captured at proxy leadership
+        cache.invalidate_family("fam")   # reload lands mid-proxy
+        assert cache.put("fam|k", b"old", if_generation=gen) is False
+        assert not cache.peek("fam|k")
+        assert cache.put("fam|k", b"new",
+                         if_generation=cache.generation("fam|k")) is True
+        assert cache.peek("fam|k")
+
+    def test_fill_inflight_only_for_the_owner(self):
+        cache = ResultCache(metrics=MetricsRegistry())
+        cache.register_inflight("f|k", "t1")
+        assert cache.fill_inflight("f|k", "t2", b"r") is False
+        assert not cache.peek("f|k")
+        assert cache.leader_for("f|k") == "t1"  # non-owner releases nothing
+        assert cache.fill_inflight("f|k", "t1", b"r") is True
+        assert cache.peek("f|k") and cache.leader_for("f|k") is None
+
+    def test_release_inflight_reports_ownership(self):
+        cache = ResultCache(metrics=MetricsRegistry())
+        cache.register_inflight("f|k", "t1")
+        assert cache.release_inflight("f|k", "t2") is False
+        assert cache.release_inflight("f|k", "t1") is True
+
+
+class TestEdgeOnlyCounting:
+    def test_uncounted_lookup_leaves_hit_ratio_alone(self):
+        """Internal lookups (dispatcher redelivery check, worker sync path)
+        pass count=False so one external request records exactly one
+        outcome and the hit ratio stays an edge statement."""
+        cache = ResultCache(metrics=MetricsRegistry())
+        cache.put("f|k", b"x")
+        assert cache.get("f|k", count=False) is not None
+        assert cache.get("f|missing", count=False) is None
+        stats = cache.stats()
+        assert stats["hits"] == 0 and stats["misses"] == 0
+        cache.get("f|k")
+        assert cache.stats()["hits"] == 1
+
+
+class TestSyncSingleFlightCleanup:
+    def test_leader_failure_before_proxy_releases_waiters(self):
+        """The leader future is registered BEFORE the backend session is
+        acquired; a failure (or cancellation) in that window must still run
+        the cleanup, or every later identical POST awaits a future nobody
+        will ever resolve. Regression: _get_session raising used to leak the
+        registration and wedge the key forever."""
+        async def main():
+            reg = MetricsRegistry()
+            platform = LocalPlatform(PlatformConfig(result_cache=True),
+                                     metrics=reg)
+            platform.publish_sync_api("/v1/public/sync",
+                                      "http://127.0.0.1:1/v1/x")
+
+            async def boom():
+                raise RuntimeError("session factory down")
+
+            platform.gateway._get_session = boom
+            gw = await serve(platform.gateway.app)
+            try:
+                # Without the try/finally covering the registration window,
+                # one of these wedges forever and gather never returns.
+                r1, r2 = await asyncio.wait_for(asyncio.gather(
+                    gw.post("/v1/public/sync", data=b"B"),
+                    gw.post("/v1/public/sync", data=b"B")), timeout=10.0)
+                assert r1.status == 500 and r2.status == 500
+                assert platform.gateway._sync_inflight == {}
+                # The key is not wedged: a fresh identical POST still runs.
+                r3 = await asyncio.wait_for(
+                    gw.post("/v1/public/sync", data=b"B"), timeout=10.0)
+                assert r3.status == 500
+                assert platform.gateway._sync_inflight == {}
+            finally:
+                await gw.close()
+
+        run(main())
+
+
+class TestWorkerSyncBypass:
+    def test_bypass_header_executes_past_the_worker_cache(self):
+        """The documented X-Cache-Bypass contract ("this request must
+        execute; no cache read, no store") must hold at the worker's own
+        sync cache — not only at the gateway. Regression: the _sync handler
+        had no access to request headers, so a bypassed request was still
+        answered from the worker cache."""
+        async def main():
+            reg = MetricsRegistry()
+            servable = build_servable("echo", name="echo", size=8,
+                                      buckets=(4,))
+            runtime = ModelRuntime()
+            runtime.register(servable)
+            batcher = MicroBatcher(runtime, max_wait_ms=1.0, metrics=reg)
+            cache = ResultCache(metrics=reg)
+            worker = InferenceWorker("w", runtime, batcher,
+                                     prefix="v1/echo", result_cache=cache)
+            worker.serve_model(servable, sync_path="/run")
+            await batcher.start()
+            client = await serve(worker.service.app)
+            try:
+                payload = npy_bytes(np.arange(8, dtype=np.float32))
+                first = await (await client.post(
+                    "/v1/echo/run", data=payload)).json()
+                assert _executed_examples(reg) == 1.0
+                assert cache.stats()["entries"] == 1
+
+                # A cached answer exists — the bypass must execute anyway
+                # (no cache read) and must not overwrite the entry (no
+                # store).
+                for hdr in ({"X-Cache-Bypass": "1"},
+                            {"Cache-Control": "no-cache"}):
+                    again = await (await client.post(
+                        "/v1/echo/run", data=payload, headers=hdr)).json()
+                    assert again == first
+                assert _executed_examples(reg) == 3.0
+                assert cache.stats()["entries"] == 1
+
+                # Without the header the cache still answers.
+                assert (await (await client.post(
+                    "/v1/echo/run", data=payload)).json()) == first
+                assert _executed_examples(reg) == 3.0
+            finally:
+                await batcher.stop()
+                await client.close()
+
+        run(main())
+
+
+class TestSyncCoalesceInvalidation:
+    def test_waiter_does_not_adopt_pre_reload_leader(self):
+        """A checkpoint reload that lands while a sync leader is proxying
+        invalidates the family; identical requests arriving AFTER the swap
+        must re-execute instead of coalescing onto the old-weights
+        execution. Regression: waiters joined the leader future with no
+        generation check, so the put(if_generation=) guard protected the
+        cache but not the coalesced responses."""
+        async def main():
+            reg = MetricsRegistry()
+            hits = 0
+            got_request = asyncio.Event()
+            release = asyncio.Event()
+
+            from aiohttp import web
+
+            async def backend(request):
+                nonlocal hits
+                hits += 1
+                mine = hits
+                got_request.set()
+                if mine == 1:
+                    await release.wait()
+                return web.Response(text=str(mine))
+
+            app = web.Application()
+            app.router.add_post("/v1/x", backend)
+            be = await serve(app)
+
+            platform = LocalPlatform(PlatformConfig(result_cache=True),
+                                     metrics=reg)
+            backend_uri = str(be.make_url("/v1/x"))
+            platform.publish_sync_api("/v1/public/sync", backend_uri)
+            gw = await serve(platform.gateway.app)
+            try:
+                leader = asyncio.create_task(
+                    gw.post("/v1/public/sync", data=b"B"))
+                await asyncio.wait_for(got_request.wait(), timeout=10.0)
+
+                # Weight swap mid-proxy: the family's generation advances.
+                from ai4e_tpu.taskstore.task import endpoint_path
+                platform.result_cache.invalidate_family(
+                    endpoint_path(backend_uri))
+
+                waiter = asyncio.create_task(
+                    gw.post("/v1/public/sync", data=b"B"))
+                await asyncio.sleep(0.05)   # let the waiter join the future
+                release.set()
+
+                r1 = await asyncio.wait_for(leader, timeout=10.0)
+                r2 = await asyncio.wait_for(waiter, timeout=10.0)
+                assert await r1.text() == "1"
+                # The waiter re-executed on the (notionally new) weights —
+                # it did NOT adopt the pre-swap leader's response.
+                assert r2.headers.get("X-Cache") != "coalesced"
+                assert await r2.text() == "2"
+                assert hits == 2
+                # And the leader's stale fill was refused.
+                assert platform.result_cache.stats()["entries"] == 0
+            finally:
+                await gw.close()
+                await be.close()
+
+        run(main())
+
+
+class TestDispatcherNoResultStore:
+    def test_cache_hit_without_result_store_dispatches(self):
+        """A Dispatcher given a cache but no result_store must NOT complete
+        from the cache: there is nowhere to put the payload, and a terminal
+        task whose result fetch returns nothing is a permanently lost
+        output. It dispatches normally instead."""
+        async def main():
+            from ai4e_tpu.broker.dispatcher import Dispatcher
+            from ai4e_tpu.broker.queue import InMemoryBroker, Message
+            cache = ResultCache()
+            key = request_key("/v1/x", b"B")
+            cache.put(key, b'{"ok": 1}')
+            d = Dispatcher(InMemoryBroker(), "q", "http://127.0.0.1:1/v1/x",
+                           task_manager=None, result_cache=cache,
+                           result_store=None)
+            msg = Message(task_id="t-1", endpoint="/v1/x", cache_key=key)
+            assert await d._complete_from_cache(msg) is False
+
+        run(main())
+
+
+class TestStandbyOutcomeCounting:
+    def test_not_primary_503_counts_no_cache_outcome(self):
+        """A standby replica answers cacheable POSTs with 503 not-primary;
+        each client retry must NOT count a rescache miss (or bypass) —
+        outcomes sum to answered requests (docs/METRICS.md). Regression:
+        count_miss fired before the upsert raised NotPrimaryError."""
+        async def main():
+            from ai4e_tpu.gateway.router import Gateway
+            from ai4e_tpu.taskstore import NotPrimaryError
+
+            class StandbyStore(InMemoryTaskStore):
+                def upsert(self, task, **kw):
+                    raise NotPrimaryError()
+
+            reg = MetricsRegistry()
+            cache = ResultCache(metrics=reg)
+            gateway = Gateway(StandbyStore(), metrics=reg)
+            gateway.set_result_cache(cache)
+            gateway.add_async_route("/v1/public/run",
+                                    "http://127.0.0.1:1/v1/x")
+            gw = await serve(gateway.app)
+            try:
+                for hdrs in (None, {"X-Cache-Bypass": "1"}):
+                    resp = await gw.post("/v1/public/run", data=b"B",
+                                         headers=hdrs)
+                    assert resp.status == 503
+                    assert resp.headers.get("X-Not-Primary") == "1"
+                s = cache.stats()
+                assert (s["misses"], s["bypass"]) == (0.0, 0.0)
+            finally:
+                await gw.close()
+
+        run(main())
+
+
+class TestNonDurableResultsStayInline:
+    def test_hit_result_skips_the_offload_backend(self, tmp_path):
+        """With a result backend + offload threshold configured, a
+        durable=False record's result must store inline: per-hit blob
+        writes would put payload-sized I/O back on the path the cache
+        exists to avoid, and a restart would orphan the blobs (no
+        journaled record references them)."""
+        from ai4e_tpu.taskstore.results import FileResultBackend
+        path = str(tmp_path / "journal.jsonl")
+        blobs = tmp_path / "blobs"
+        store = JournaledTaskStore(
+            path, result_backend=FileResultBackend(str(blobs)),
+            result_offload_threshold=1)
+        payload = b'{"r": "x"}'
+
+        a = store.upsert(APITask(endpoint="/v1/x", status="completed - ok",
+                                 backend_status="completed"))
+        store.set_result(a.task_id, payload)
+        blobs_after_durable = len(list(blobs.iterdir()))
+        assert blobs_after_durable > 0   # >= threshold: offloaded
+
+        b = store.upsert(APITask(endpoint="/v1/x",
+                                 status="completed - served from cache",
+                                 backend_status="completed", durable=False))
+        store.set_result(b.task_id, payload)
+        assert len(list(blobs.iterdir())) == blobs_after_durable  # inline
+        assert store.get_result(b.task_id) == (payload,
+                                               "application/json")
+        store.close()
+
+
+class TestNativeStoreCacheProvenance:
+    def test_listener_fill_and_release_work_on_the_native_store(self):
+        """PlatformConfig(native_store=True, result_cache=True): the C++
+        record has no CacheKey field, so provenance rides a Python-side
+        sidecar (native.py). Regression: tasks notified by the native store
+        carried cache_key=='' — the cache never filled and single-flight
+        registrations never released, coalescing every later duplicate onto
+        a stale (possibly failed) record until eviction."""
+        from ai4e_tpu.taskstore.native import NativeTaskStore
+        cache = ResultCache()
+        store = NativeTaskStore()
+        attach_store(store, cache)
+        key = request_key("/v1/api/op", b"payload")
+
+        t = store.upsert(APITask(task_id="", endpoint="http://h/v1/api/op",
+                                 body=b"payload", cache_key=key))
+        assert store.get(t.task_id).cache_key == key
+        cache.register_inflight(key, t.task_id)
+
+        store.set_result(t.task_id, b'{"r": 1}')
+        store.update_status(t.task_id, "completed - done",
+                            backend_status="completed")
+        # The terminal transition filled the cache and released the leader.
+        assert cache.get(key) == (b'{"r": 1}', "application/json")
+        assert cache.leader_for(key) is None
+
+    def test_failed_leader_releases_on_the_native_store(self):
+        """A FAILED task must release its registration too, or duplicates
+        coalesce onto the corpse forever."""
+        from ai4e_tpu.taskstore.native import NativeTaskStore
+        cache = ResultCache()
+        store = NativeTaskStore()
+        attach_store(store, cache)
+        key = request_key("/v1/api/op", b"payload")
+        t = store.upsert(APITask(task_id="", endpoint="http://h/v1/api/op",
+                                 body=b"payload", cache_key=key))
+        cache.register_inflight(key, t.task_id)
+        store.update_status(t.task_id, "failed - backend 500",
+                            backend_status="failed")
+        assert cache.get(key) is None
+        assert cache.leader_for(key) is None
+
+
+class TestHitRecordDurability:
+    def test_non_durable_records_skip_the_journal(self, tmp_path):
+        """durable=False records (cache hits) stay queryable in memory but
+        never reach the journal — not on upsert, not via their result, and
+        not through compaction — so a high duplicate rate costs no fsync
+        I/O. After a restart they are simply gone (the submit response
+        already carried the terminal record)."""
+        from ai4e_tpu.taskstore.store import TaskNotFound
+        path = str(tmp_path / "journal.jsonl")
+        store = JournaledTaskStore(path)
+        a = store.upsert(APITask(endpoint="/v1/x", body=b"req-a",
+                                 status="completed - done",
+                                 backend_status="completed"))
+        store.set_result(a.task_id, b'{"r": "a"}')
+        size_after_durable = os.path.getsize(path)
+        assert size_after_durable > 0
+
+        b = store.upsert(APITask(endpoint="/v1/x", body=b"req-a",
+                                 status="completed - served from cache",
+                                 backend_status="completed", durable=False))
+        store.set_result(b.task_id, b'{"r": "a"}')
+        assert os.path.getsize(path) == size_after_durable
+        # Queryable while the process lives — the client contract holds.
+        assert store.get(b.task_id).status == "completed - served from cache"
+        assert store.get_result(b.task_id) == (b'{"r": "a"}',
+                                               "application/json")
+        # A rewrite must not promote it to durability.
+        store.compact()
+        store.close()
+
+        reopened = JournaledTaskStore(path)
+        assert reopened.get(a.task_id).canonical_status == "completed"
+        assert reopened.get_result(a.task_id) == (b'{"r": "a"}',
+                                                  "application/json")
+        with pytest.raises(TaskNotFound):
+            reopened.get(b.task_id)
+        assert reopened.get_result(b.task_id) is None
+        reopened.close()
+
+    def test_gateway_hit_record_is_non_durable(self):
+        """The async-path cache hit marks its task record durable=False."""
+        async def main():
+            reg = MetricsRegistry()
+            (platform, gw, svc, batcher, payload) = await _echo_platform(reg)
+            try:
+                first = await gw.post("/v1/public/run", data=payload)
+                miss_id = (await first.json())["TaskId"]
+                await poll_until(gw, miss_id,
+                                 lambda rec: "completed" in rec["Status"])
+                hit = await gw.post("/v1/public/run", data=payload)
+                assert hit.headers.get("X-Cache") == "hit"
+                hit_id = (await hit.json())["TaskId"]
+                assert platform.store.get(miss_id).durable is True
+                assert platform.store.get(hit_id).durable is False
+            finally:
+                await platform.stop()
+                await batcher.stop()
+                await gw.close()
+                await svc.close()
+
+        run(main())
+
+
+class TestWorkerCliHardeningWired:
+    def test_build_worker_wires_reload_confinement_and_keys(self, tmp_path):
+        """The production worker entrypoint must actually pass the reload
+        hardening through — checkpoint_root from the checkpoint mount and
+        admin keys from the front-door secret — or the ADVICE r5 fix is
+        inert in deployment (guards default to None/open)."""
+        from ai4e_tpu.cli import build_worker
+        from ai4e_tpu.config import FrameworkConfig
+        cfg = FrameworkConfig.from_env(env={
+            "AI4E_RUNTIME_PLATFORM": "cpu",
+            "AI4E_RUNTIME_CHECKPOINT_DIR": str(tmp_path),
+            "AI4E_GATEWAY_API_KEYS": "sk-1, sk-2",
+        })
+        worker, batcher, _tm = build_worker(cfg, {"models": []})
+        assert worker._checkpoint_root == os.path.realpath(str(tmp_path))
+        assert worker._admin_keys == {"sk-1", "sk-2"}
+
+        open_worker, _b, _t = build_worker(
+            FrameworkConfig.from_env(env={"AI4E_RUNTIME_PLATFORM": "cpu"}),
+            {"models": []})
+        assert open_worker._checkpoint_root is None   # dev stays open
+        assert open_worker._admin_keys is None
+
+
+class TestNonDurablePromotion:
+    def test_external_upsert_cannot_promote_a_hit_record(self, tmp_path):
+        """A full upsert over a non-durable (cache-hit) record — e.g. via
+        the taskstore HTTP facade, where from_dict defaults durable=True —
+        must stay memory-only: its create was never journaled, so promoting
+        it would journal orphan transitions and resurrect on restart a
+        TaskId the hit contract says should 404."""
+        path = str(tmp_path / "journal.jsonl")
+        store = JournaledTaskStore(path)
+        hit = store.upsert(APITask(endpoint="/v1/x",
+                                   status="completed - served from cache",
+                                   backend_status="completed",
+                                   durable=False))
+        size = os.path.getsize(path)
+        replacement = store.upsert(APITask(task_id=hit.task_id,
+                                           endpoint="/v1/x",
+                                           status="completed - rewritten",
+                                           backend_status="completed"))
+        assert replacement.durable is False
+        assert os.path.getsize(path) == size
+        store.compact()
+        store.close()
+        reopened = JournaledTaskStore(path)
+        from ai4e_tpu.taskstore.store import TaskNotFound
+        with pytest.raises(TaskNotFound):
+            reopened.get(hit.task_id)
+        reopened.close()
+
+
+class TestConfigPlumbing:
+    def test_platform_env_section_carries_cache_knobs(self):
+        """The deployable surface: AI4E_PLATFORM_RESULT_CACHE* must reach
+        PlatformConfig, or the control-plane CLI can never enable the
+        cache."""
+        from ai4e_tpu.config import PlatformSection
+        cfg = PlatformSection.from_env(env={
+            "AI4E_PLATFORM_RESULT_CACHE": "true",
+            "AI4E_PLATFORM_CACHE_MAX_ENTRIES": "7",
+            "AI4E_PLATFORM_CACHE_MAX_BYTES": "1024",
+            "AI4E_PLATFORM_CACHE_TTL_SECONDS": "60",
+        }).to_platform_config()
+        assert cfg.result_cache is True
+        assert cfg.cache_max_entries == 7
+        assert cfg.cache_max_bytes == 1024
+        assert cfg.cache_ttl_seconds == 60.0
+        off = PlatformSection.from_env(env={}).to_platform_config()
+        assert off.result_cache is False
