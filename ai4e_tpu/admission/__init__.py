@@ -1,0 +1,34 @@
+"""Admission control: end-to-end deadline propagation, priority shedding,
+and adaptive concurrency (``docs/admission.md``).
+
+Opt-in via ``PlatformConfig(admission=True)`` /
+``AI4E_PLATFORM_ADMISSION=1``. Three parts:
+
+- ``deadline``  — the ``X-Deadline-Ms`` / ``X-Priority`` /
+  ``X-Shed-Reason`` vocabulary every hop shares, and the ``expired``
+  terminal status;
+- ``controller`` — the latency-gradient AIMD limiter that continuously
+  resizes the gateway sync in-flight cap and each dispatcher's delivery
+  fan-out, plus drain-rate-derived ``Retry-After`` and goodput metrics;
+- ``shedder``    — lowest-priority-first refusal with computed backoff.
+"""
+
+from .controller import (AdmissionController, AdmissionScope, DecayingRate,
+                         GradientLimiter)
+from .deadline import (BACKGROUND, DEADLINE_AT_HEADER, DEADLINE_MS_HEADER,
+                       DEFAULT, INTERACTIVE, PRIORITY_CLASSES,
+                       PRIORITY_HEADER, SHED_REASON_HEADER, DeadlineExceeded,
+                       expired, expired_status, parse_deadline_at,
+                       parse_priority, priority_name, propagation_headers,
+                       remaining_s, shed_reason, worker_admission_kwargs)
+from .shedder import PriorityShedder
+
+__all__ = [
+    "AdmissionController", "AdmissionScope", "DecayingRate",
+    "GradientLimiter", "PriorityShedder", "DeadlineExceeded",
+    "DEADLINE_AT_HEADER", "DEADLINE_MS_HEADER", "PRIORITY_HEADER",
+    "SHED_REASON_HEADER", "PRIORITY_CLASSES", "INTERACTIVE", "DEFAULT",
+    "BACKGROUND", "expired", "expired_status", "parse_deadline_at",
+    "parse_priority", "priority_name", "propagation_headers", "remaining_s",
+    "shed_reason", "worker_admission_kwargs",
+]

@@ -1,0 +1,186 @@
+"""Deadline & priority propagation — the per-request admission state.
+
+The reference platform carries a task through the broker and onto the
+backend no matter how long it has queued (``BackendQueueProcessor.cs:27-81``
+retries for up to 24 h); nothing ever asks whether the client is still
+waiting. Under saturation that inverts the metric that matters — goodput
+(within-deadline completions/s) — because the device spends its cycles on
+work whose caller already gave up (PAPERS.md: *Adaptive Orchestration for
+Large-Scale Inference*, *Evaluating Kubernetes Performance for GenAI
+Inference*).
+
+This module is the shared vocabulary every hop uses. Pure stdlib — it is
+imported by the gateway, broker, batcher, worker, client, and bench, none
+of which may drag the others in.
+
+Headers:
+
+- ``X-Deadline-Ms`` (public): the caller's RELATIVE latency budget in
+  milliseconds. The gateway anchors it to an absolute wall-clock deadline
+  the moment the request is admitted.
+- ``X-Deadline-At`` (internal, hop-to-hop): the ABSOLUTE deadline as unix
+  seconds. Forwarded by the dispatcher/sync proxy so transport delay can
+  never re-extend a budget the way re-anchoring a relative value would.
+- ``X-Priority``: ``interactive`` | ``default`` | ``background`` (or the
+  numeric class). Unlabeled public requests are ``default``.
+- ``X-Shed-Reason`` (response): provenance on every refusal — which hop
+  shed the request and why (``deadline``/``pressure``).
+
+Priority classes map directly onto the micro-batcher's integer priorities
+(0 = interactive fills batches first; higher classes age toward the front
+one class per ``priority_aging_s`` so nothing starves — ``runtime/
+batcher.py``): interactive=0, default=1, background=2. The batch API's
+stacks already submit at 1, so labeled interactive traffic batches ahead
+of stacks and background batches behind them with no extra wiring.
+"""
+
+from __future__ import annotations
+
+import time
+
+# Public request header: relative budget, milliseconds.
+DEADLINE_MS_HEADER = "X-Deadline-Ms"
+# Internal hop-to-hop header: absolute deadline, unix seconds (float).
+DEADLINE_AT_HEADER = "X-Deadline-At"
+PRIORITY_HEADER = "X-Priority"
+SHED_REASON_HEADER = "X-Shed-Reason"
+
+INTERACTIVE = 0
+DEFAULT = 1
+BACKGROUND = 2
+
+PRIORITY_CLASSES = {
+    "interactive": INTERACTIVE,
+    "default": DEFAULT,
+    "background": BACKGROUND,
+}
+_PRIORITY_NAMES = {v: k for k, v in PRIORITY_CLASSES.items()}
+
+
+class DeadlineExceeded(RuntimeError):
+    """Raised inside the serving path when work expires before execution
+    (the micro-batcher sets it on a pending future at batch-cut time)."""
+
+    def __init__(self, hop: str, deadline_at: float = 0.0):
+        super().__init__(f"deadline exceeded at {hop}")
+        self.hop = hop
+        self.deadline_at = deadline_at
+
+
+def priority_name(priority: int) -> str:
+    """Label for metrics/provenance; out-of-range classes clamp to the
+    nearest named one (priorities are ordered, not enumerated)."""
+    if priority <= INTERACTIVE:
+        return "interactive"
+    if priority >= BACKGROUND:
+        return "background"
+    return _PRIORITY_NAMES.get(priority, "default")
+
+
+def parse_priority(headers, default: int = DEFAULT) -> int:
+    """``X-Priority`` as an integer class. Accepts the class names or a
+    bare integer; anything unparseable (attacker-chosen header) falls back
+    to ``default`` — a malformed label must never 400 a request that would
+    otherwise serve."""
+    raw = headers.get(PRIORITY_HEADER)
+    if raw is None:
+        return default
+    value = raw.strip().lower()
+    if value in PRIORITY_CLASSES:
+        return PRIORITY_CLASSES[value]
+    try:
+        return max(INTERACTIVE, min(BACKGROUND, int(value)))
+    except ValueError:
+        return default
+
+
+def parse_deadline_at(headers, now: float | None = None) -> float:
+    """The request's absolute deadline (unix seconds), 0.0 when none.
+
+    ``X-Deadline-At`` (absolute, stamped by an upstream hop) wins over
+    ``X-Deadline-Ms`` (relative, anchored HERE at ``now``) — re-anchoring
+    a relative budget at every hop would silently extend it by the
+    transport time the deadline exists to bound. Malformed or
+    non-positive values mean "no deadline" rather than an error."""
+    raw = headers.get(DEADLINE_AT_HEADER)
+    if raw is not None:
+        try:
+            at = float(raw)
+        except ValueError:
+            at = 0.0
+        return at if at > 0 else 0.0
+    raw = headers.get(DEADLINE_MS_HEADER)
+    if raw is None:
+        return 0.0
+    try:
+        budget_ms = float(raw)
+    except ValueError:
+        return 0.0
+    if budget_ms <= 0:
+        return 0.0
+    return (time.time() if now is None else now) + budget_ms / 1000.0
+
+
+def expired(deadline_at: float, now: float | None = None) -> bool:
+    """True when the deadline exists and has passed."""
+    if not deadline_at:
+        return False
+    return (time.time() if now is None else now) >= deadline_at
+
+
+def remaining_s(deadline_at: float, now: float | None = None) -> float:
+    """Seconds of budget left (may be negative); +inf when no deadline."""
+    if not deadline_at:
+        return float("inf")
+    return deadline_at - (time.time() if now is None else now)
+
+
+def drain_retry_after(excess: float, drain_rate: float) -> float:
+    """THE Retry-After policy, shared by every refusal surface (shedder
+    429/503s, the standby 503, deadline-infeasibility sheds): seconds for
+    ``excess`` backlog units to drain at the observed rate, clamped to
+    [1, 60] — a cold estimator (no drain evidence yet) answers the
+    pre-admission constant 2 s rather than infinity, and a hot one never
+    tells clients to hammer. One definition, so shed responses and
+    standby responses can never advertise different backoff policies."""
+    if drain_rate <= 1e-9:
+        return 2.0
+    return max(1.0, min(60.0, excess / drain_rate))
+
+
+def expired_status(hop: str) -> str:
+    """The terminal Status prose for work shed on deadline at ``hop``.
+    Buckets to the ``expired`` canonical state (``TaskStatus.canonical``),
+    which is TERMINAL — pollers wake, retention evicts, the client's
+    ``wait()`` raises ``TaskExpired``."""
+    return f"expired - deadline exceeded at {hop}"
+
+
+def shed_reason(hop: str, why: str) -> str:
+    """``X-Shed-Reason`` provenance value: which hop refused, and why
+    (``deadline`` — the budget is already spent; ``pressure`` — the
+    shedder refused the class to protect higher-priority work)."""
+    return f"{why} at {hop}"
+
+
+def propagation_headers(deadline_at: float, priority: int) -> dict:
+    """Headers a hop attaches when handing admitted work downstream (the
+    dispatcher's backend POST, the gateway's sync proxy): the ABSOLUTE
+    deadline plus the priority class. The class is ALWAYS explicit — the
+    worker's no-header default is interactive (pre-admission behavior for
+    direct callers), so omitting `default` here would silently promote
+    every default-class request back to interactive at the next hop."""
+    headers = {PRIORITY_HEADER: str(priority)}
+    if deadline_at:
+        headers[DEADLINE_AT_HEADER] = repr(deadline_at)
+    return headers
+
+
+def worker_admission_kwargs(headers) -> dict:
+    """Request-side extraction for the worker's endpoint handlers:
+    ``{"deadline_at": float, "priority": int}``. The default priority here
+    is INTERACTIVE (0), not the public default class — an unlabeled direct
+    request to a worker behaves exactly as before this subsystem existed;
+    only traffic the gateway classified carries a different class."""
+    return {"deadline_at": parse_deadline_at(headers),
+            "priority": parse_priority(headers, default=INTERACTIVE)}

@@ -1,0 +1,55 @@
+"""Priority load shedder — lowest class refused first, Retry-After computed.
+
+Under pressure the platform used to answer a flat 503 with a hardcoded
+``Retry-After: "2"`` regardless of who asked or how deep the backlog was
+(``gateway/router.py``). This shedder makes refusal a POLICY:
+
+- each priority class may occupy only a FRACTION of the capacity —
+  interactive traffic can fill it, default stops at 85%, background at
+  60% — so as occupancy climbs the classes shed strictly lowest-first,
+  and a background flood can never 503 interactive traffic out of its
+  reserved headroom (the same shape the micro-batcher's
+  ``interactive_reserve`` gives device batches, applied at admission);
+- the Retry-After on a refusal is the time the EXCESS above the class's
+  threshold should take to drain at the observed drain rate — an honest
+  hint that scales with the backlog instead of a constant that is wrong
+  in both directions.
+"""
+
+from __future__ import annotations
+
+from .deadline import BACKGROUND, DEFAULT, INTERACTIVE, drain_retry_after
+
+
+class PriorityShedder:
+    #: Fraction of capacity each class may occupy before it sheds.
+    DEFAULT_FRACTIONS = {INTERACTIVE: 1.0, DEFAULT: 0.85, BACKGROUND: 0.6}
+
+    def __init__(self, fractions: dict[int, float] | None = None):
+        self.fractions = dict(fractions or self.DEFAULT_FRACTIONS)
+
+    def threshold(self, priority: int, capacity: int) -> float:
+        """Occupancy above which ``priority`` sheds. Classes beyond the
+        configured map clamp to the nearest configured neighbor —
+        priorities are ordered, not enumerated."""
+        if priority in self.fractions:
+            frac = self.fractions[priority]
+        elif priority <= min(self.fractions):
+            frac = self.fractions[min(self.fractions)]
+        else:
+            frac = self.fractions[max(self.fractions)]
+        # Every class, however low, may use at least one slot: a pure
+        # background workload on an idle platform must still run.
+        return max(1.0, frac * capacity)
+
+    def check(self, priority: int, occupancy: int, capacity: int,
+              drain_rate: float = 0.0) -> float | None:
+        """None to admit; else the Retry-After (seconds) for the refusal.
+
+        ``occupancy``/``capacity`` are whatever the calling surface
+        measures — in-flight vs the adaptive limit on the sync proxy,
+        created-set depth vs ``max_backlog`` at the async edge."""
+        threshold = self.threshold(priority, capacity)
+        if occupancy < threshold:
+            return None
+        return drain_retry_after(occupancy - threshold + 1.0, drain_rate)

@@ -83,6 +83,7 @@ class Dispatcher:
         rng=None,
         result_cache=None,
         result_store=None,
+        admission=None,
     ):
         self.broker = broker
         self.queue_name = queue_name
@@ -95,6 +96,15 @@ class Dispatcher:
         # works exactly as on the execute path.
         self.result_cache = result_cache
         self.result_store = result_store
+        # Admission controller (admission/): when set, this dispatcher's
+        # delivery RTTs feed the controller's per-queue limiter (which in
+        # turn drives set_concurrency — see platform_assembly), backend
+        # backpressure triggers an immediate multiplicative backoff, and
+        # expired work is dropped at pop time with provenance metrics.
+        # Deadline DROPS themselves need no controller — any message
+        # carrying deadline_at is honored (only an admission-enabled
+        # gateway stamps one).
+        self.admission = admission
         self.backends = normalize_backends(backend_uri)
         # The primary (first) backend — what single-backend consumers and
         # introspection read; weighted picks use the full set.
@@ -109,6 +119,20 @@ class Dispatcher:
             "ai4e_dispatch_total", "Dispatch attempts by outcome")
         self._stop = asyncio.Event()
         self._workers: list[asyncio.Task] = []
+        # Graceful scale-down debt (set_concurrency): how many delivery
+        # loops should exit at their next idle point instead of being
+        # cancelled mid-POST. Event-loop-only state, like _workers.
+        self._excess = 0
+        # Resizes before start() (or after stop()) only record the level;
+        # spawning belongs to the started dispatcher's event loop.
+        self._started = False
+        # Delivery loops currently processing a message (vs idle in
+        # receive): the concurrency actually IN USE, which is what the
+        # admission limiter's Little's-law clamp compares the limit
+        # against — without it an idle queue's limit would ratchet to the
+        # ceiling on healthy RTTs alone, then dump that fan-out on the
+        # first burst.
+        self._busy = 0
         # In-flight POSTs are bounded by the worker-loop count (see
         # set_concurrency), so the pool must not add a lower cap.
         self._sessions = SessionHolder(timeout=request_timeout, limit=0)
@@ -119,7 +143,9 @@ class Dispatcher:
         # clear the stop latch and drop finished workers so the top-up
         # spawns live loops, not instant-exit ones.
         self._stop.clear()
+        self._started = True
         self._workers = [w for w in self._workers if not w.done()]
+        self._excess = 0
         # Top up, never replace: set_concurrency may have spawned loops
         # already, and replacing the list would orphan them past stop().
         loop = asyncio.get_running_loop()
@@ -127,6 +153,7 @@ class Dispatcher:
             self._workers.append(loop.create_task(self._run(len(self._workers))))
 
     async def stop(self) -> None:
+        self._started = False
         self._stop.set()
         for w in self._workers:
             w.cancel()
@@ -135,26 +162,57 @@ class Dispatcher:
 
     def set_concurrency(self, n: int) -> None:
         """Live-resize the delivery loop count — the scale surface the
-        autoscaler drives (the reference scales *pod replicas* via HPA,
-        ``autoscaler.yaml:11-21``; here request-level fan-out is dispatcher
-        loops feeding the shared micro-batcher, SURVEY.md §2 parallelism
-        table row 1)."""
+        autoscaler AND the admission controller drive (the reference scales
+        *pod replicas* via HPA, ``autoscaler.yaml:11-21``; here
+        request-level fan-out is dispatcher loops feeding the shared
+        micro-batcher, SURVEY.md §2 parallelism table row 1).
+
+        Scale-DOWN is graceful: surplus loops finish their in-flight
+        delivery and exit at the next idle point (bounded by the 1 s
+        receive poll) rather than being cancelled mid-POST — the adaptive
+        controller resizes this constantly, and a hard cancel would
+        abandon a message whose backend call already succeeded, turning
+        every downward step into a spurious redelivery. stop() still
+        cancels outright (shutdown wants the lease back immediately)."""
         n = max(0, n)
-        if n == len(self._workers):
+        if not self._started:
+            # Assembly time (the admission controller applies its initial
+            # limit at registration; a standby platform registers but must
+            # not dispatch): record the level — start() spawns to it.
+            self.concurrency = n
+            self._excess = 0
             return
         loop = asyncio.get_running_loop()
-        while len(self._workers) < n:
-            self._workers.append(
-                loop.create_task(self._run(len(self._workers))))
-        while len(self._workers) > n:
-            self._workers.pop().cancel()
+        # Prune exited loops (earlier scale-downs) so the live count — not
+        # the historical list length — is what grows/shrinks.
+        self._workers = [w for w in self._workers if not w.done()]
+        live = len(self._workers) - self._excess
+        if n == live:
+            self.concurrency = n
+            return
+        if n > live:
+            # Cancel outstanding exit debt first; only the remainder needs
+            # fresh loops.
+            absorbed = min(self._excess, n - live)
+            self._excess -= absorbed
+            while len(self._workers) - self._excess < n:
+                self._workers.append(
+                    loop.create_task(self._run(len(self._workers))))
+        else:
+            self._excess += live - n
         self.concurrency = n
 
     async def _run(self, worker_idx: int) -> None:
         while not self._stop.is_set():
+            if self._excess > 0:
+                # Graceful scale-down: retire this loop at an idle point
+                # (single-threaded event loop — the decrement cannot race).
+                self._excess -= 1
+                return
             msg = await self.broker.receive(self.queue_name, timeout=1.0)
             if msg is None:
                 continue
+            self._busy += 1
             try:
                 await self._dispatch_one(msg)
             except asyncio.CancelledError:
@@ -173,6 +231,8 @@ class Dispatcher:
                     await self._try_update(
                         msg.task_id, TaskStatus.DEAD_LETTER,
                         TaskStatus.FAILED)
+            finally:
+                self._busy -= 1
 
     def _target_for(self, msg: Message) -> str:
         """Dispatch target: a *registered* backend URI (fresh host — a
@@ -183,9 +243,12 @@ class Dispatcher:
         return rebase_endpoint(msg.endpoint, self.queue_name, base)
 
     async def _dispatch_one(self, msg: Message) -> None:
+        import time as _time
         from urllib.parse import urlparse
 
         from ..observability import get_tracer
+        if await self._drop_expired(msg):
+            return
         if await self._complete_from_cache(msg):
             return
         target = self._target_for(msg)
@@ -195,6 +258,7 @@ class Dispatcher:
         backend = urlparse(target).netloc
         session = await self._sessions.get()
         tracer = get_tracer()
+        t0 = _time.perf_counter()
         try:
             # One span per delivery attempt, keyed by TaskId; the injected
             # x-b3 headers parent the backend's endpoint span to this one,
@@ -204,6 +268,7 @@ class Dispatcher:
                              attempt=msg.delivery_count) as span:
                 headers = {"taskId": msg.task_id,
                            "Content-Type": msg.content_type,
+                           **self._admission_headers(msg),
                            **tracer.headers()}
                 async with session.post(
                     target, data=msg.body, headers=headers,
@@ -226,7 +291,22 @@ class Dispatcher:
             self.broker.complete(msg)
             self._dispatched.inc(outcome="delivered", queue=self.queue_name,
                                  backend=backend)
+            if self.admission is not None:
+                # Delivered-POST RTT feeds the per-queue limiter: when the
+                # worker's event loop congests, these round trips stretch
+                # and the controller narrows this dispatcher's fan-out
+                # BEFORE the worker has to start 503ing. ``_busy`` (loops
+                # actually mid-delivery) is the in-flight figure the
+                # Little's-law clamp needs — an underused queue's limit
+                # then tracks ~2× its real concurrency instead of
+                # ratcheting to the ceiling.
+                self.admission.scope("dispatch:" + self.queue_name).observe(
+                    _time.perf_counter() - t0, inflight=self._busy)
         elif status in BACKPRESSURE_CODES:
+            if self.admission is not None:
+                # Explicit saturation outranks latency evidence: shrink the
+                # fan-out multiplicatively right now, don't wait a window.
+                self.admission.scope("dispatch:" + self.queue_name).backoff()
             await self._backpressure(msg, backend=backend)
         else:
             # Permanent failure: complete (no redelivery) + fail the task
@@ -239,6 +319,45 @@ class Dispatcher:
                 f"failed - backend returned {status}",
                 TaskStatus.FAILED,
             )
+
+    def _admission_headers(self, msg: Message) -> dict:
+        """Deadline/priority propagation onto the backend POST — the worker
+        runs its own submit-time expiry check and priority-classed batching
+        off these (``admission/deadline.py``). Absolute deadline, so
+        transport time spent in the queue can never re-extend the budget."""
+        deadline_at = getattr(msg, "deadline_at", 0.0)
+        priority = getattr(msg, "priority", 1)
+        if self.admission is None and not deadline_at and priority == 1:
+            # Admission off and nothing stamped: byte-identical POST
+            # headers to the pre-admission dispatcher.
+            return {}
+        from ..admission.deadline import propagation_headers
+        return propagation_headers(deadline_at, priority)
+
+    async def _drop_expired(self, msg: Message) -> bool:
+        """Deadline check at pop time (admission/): work whose budget ran
+        out while queued is completed off the broker and transitioned to
+        the terminal ``expired`` status — it never reaches the backend,
+        let alone the TPU. A task without a deadline (admission off, or
+        the caller sent none) always dispatches."""
+        import time as _time
+
+        deadline_at = getattr(msg, "deadline_at", 0.0)
+        if not deadline_at or _time.time() < deadline_at:
+            return False
+        from ..admission.deadline import expired_status
+        from ..taskstore import TaskStatus as _TS
+        self.broker.complete(msg)
+        self._dispatched.inc(outcome="expired", queue=self.queue_name,
+                             backend="")
+        if self.admission is not None:
+            self.admission.note_expired("dispatcher",
+                                        getattr(msg, "priority", 1))
+        # Awaited, not fire-and-forget: the terminal transition is what
+        # wakes the task's long-poll waiters and scores goodput.
+        await self._try_update(msg.task_id, expired_status("dispatcher"),
+                               _TS.EXPIRED)
+        return True
 
     async def _complete_from_cache(self, msg: Message) -> bool:
         """Serve the task from the result cache instead of dispatching, when
@@ -309,13 +428,14 @@ class DispatcherPool:
 
     def __init__(self, broker: InMemoryBroker, task_manager: TaskManagerBase,
                  retry_delay: float = 60.0, concurrency: int = 1,
-                 result_cache=None, result_store=None):
+                 result_cache=None, result_store=None, admission=None):
         self.broker = broker
         self.task_manager = task_manager
         self.retry_delay = retry_delay
         self.concurrency = concurrency
         self.result_cache = result_cache
         self.result_store = result_store
+        self.admission = admission
         self.dispatchers: dict[str, Dispatcher] = {}
 
     def register(self, queue_name: str, backend_uri,
@@ -326,6 +446,7 @@ class DispatcherPool:
             retry_delay=self.retry_delay if retry_delay is None else retry_delay,
             concurrency=self.concurrency if concurrency is None else concurrency,
             result_cache=self.result_cache, result_store=self.result_store,
+            admission=self.admission,
         )
         self.dispatchers[queue_name] = d
         return d

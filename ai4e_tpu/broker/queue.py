@@ -48,6 +48,14 @@ class Message:
     # round trip. "" = uncacheable/opted-out (the native broker's C struct
     # has no slot for it — its messages always dispatch).
     cache_key: str = ""
+    # Admission state copied from the task (admission/): the absolute
+    # deadline (unix seconds; 0.0 = none) and priority class, so the
+    # dispatcher can drop already-expired work at pop time — without a
+    # store round trip — and label its backend POST for the worker's own
+    # shedding. (The native broker's C struct has no slots for these;
+    # platform assembly refuses admission=True on the native fabric.)
+    deadline_at: float = 0.0
+    priority: int = 1
 
 
 DeadLetterHandler = Callable[[Message], None]
@@ -253,7 +261,9 @@ class InMemoryBroker:
                                            "application/json"),
                       seq=next(self._seq),
                       queue_name=self.resolve_queue_name(task.endpoint),
-                      cache_key=getattr(task, "cache_key", ""))
+                      cache_key=getattr(task, "cache_key", ""),
+                      deadline_at=getattr(task, "deadline_at", 0.0),
+                      priority=getattr(task, "priority", 1))
         loop = self._loop
         try:
             running = asyncio.get_running_loop()

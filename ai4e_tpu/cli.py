@@ -374,8 +374,13 @@ async def run_control_plane(config: FrameworkConfig, routes: dict) -> None:
     site = web.TCPSite(runner, config.gateway.host, config.gateway.port)
     await site.start()
     await platform.start()
-    log.info("control plane on %s:%s (%d routes)", config.gateway.host,
-             config.gateway.port, len(platform.gateway.routes))
+    log.info("control plane on %s:%s (%d routes%s)", config.gateway.host,
+             config.gateway.port, len(platform.gateway.routes),
+             # Operators grep startup lines for posture; admission changes
+             # the public contract (sheds, expiry, computed Retry-After —
+             # AI4E_PLATFORM_ADMISSION=1, docs/admission.md).
+             ", admission control ON" if platform.admission is not None
+             else "")
     try:
         await _wait_for_termination()
     finally:

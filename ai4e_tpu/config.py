@@ -164,6 +164,16 @@ class PlatformSection:
     cache_max_entries: int = 4096
     cache_max_bytes: int = 268435456          # 256 MiB resident payloads
     cache_ttl_seconds: typing.Optional[float] = 300.0
+    # Admission control (docs/admission.md): deadline propagation
+    # (X-Deadline-Ms/X-Priority), priority shedding with computed
+    # Retry-After, adaptive gateway-sync/dispatcher concurrency. Off by
+    # default: enabling it means the platform may refuse or expire work
+    # (terminal `expired` status) instead of serving arbitrarily late.
+    admission: bool = False
+    admission_min_limit: int = 1
+    admission_max_limit: int = 256
+    admission_initial_limit: int = 8
+    admission_max_backlog: int = 1024
 
     def to_platform_config(self):
         from .platform_assembly import PlatformConfig
@@ -196,6 +206,11 @@ class PlatformSection:
             cache_max_entries=self.cache_max_entries,
             cache_max_bytes=self.cache_max_bytes,
             cache_ttl_seconds=self.cache_ttl_seconds,
+            admission=self.admission,
+            admission_min_limit=self.admission_min_limit,
+            admission_max_limit=self.admission_max_limit,
+            admission_initial_limit=self.admission_initial_limit,
+            admission_max_backlog=self.admission_max_backlog,
         )
 
 

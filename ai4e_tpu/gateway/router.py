@@ -27,6 +27,9 @@ from dataclasses import dataclass
 import aiohttp
 from aiohttp import web
 
+from ..admission.deadline import (SHED_REASON_HEADER, expired,
+                                  parse_deadline_at, parse_priority,
+                                  propagation_headers, shed_reason)
 from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
 from ..rescache.keys import (CACHE_STATUS_HEADER, cache_bypass_requested,
                              request_key)
@@ -89,6 +92,10 @@ class Gateway:
         # Inference result cache (``rescache/``); None → every request
         # executes. Set via set_result_cache (platform assembly wires it).
         self._result_cache = None
+        # Admission controller (``admission/``); None → no deadlines, no
+        # shedding, unbounded sync proxy — the pre-admission behavior,
+        # untouched. Set via set_admission (platform assembly wires it).
+        self._admission = None
         # Sync-path single flight: key -> Future resolving to the leader's
         # (status, payload, content_type), or None when the leader errored.
         # Event-loop objects, so they live here rather than in the
@@ -127,6 +134,17 @@ class Gateway:
         (``bypass`` when the request opted out via ``X-Cache-Bypass`` or
         ``Cache-Control: no-cache``); uncached routes are unchanged."""
         self._result_cache = cache
+
+    def set_admission(self, controller) -> None:
+        """Enable (or clear with None) admission control on the published
+        surface (``admission/``, ``docs/admission.md``): requests carry
+        ``X-Deadline-Ms``/``X-Priority``; already-expired work answers 504
+        with ``X-Shed-Reason`` instead of creating a task; the async edge
+        sheds lowest-priority-first against the backlog; the sync proxy
+        runs under the controller's adaptive in-flight cap; and every
+        backpressure ``Retry-After`` is computed from the observed drain
+        rate instead of a constant."""
+        self._admission = controller
 
     def set_quota_tracker(self, tracker) -> None:
         """Enable (or clear with None) per-key request QUOTAS — APIM's
@@ -269,6 +287,24 @@ class Gateway:
             from ..taskstore import NotPrimaryError
             content_type = request.content_type or "application/json"
 
+            # Admission (admission/): anchor the caller's relative budget
+            # to an absolute deadline, classify, and 504 already-dead work
+            # HERE — before any task state exists. The PRESSURE shed runs
+            # later, after the cache consult: a request servable from the
+            # cache (or coalescible onto an in-flight leader) adds no
+            # backlog, so refusing it under backlog pressure would cost a
+            # free answer. Off (None) → nothing parsed, nothing stamped:
+            # the pre-admission path byte for byte.
+            deadline_at = 0.0
+            task_priority = 1
+            if self._admission is not None:
+                deadline_at = parse_deadline_at(request.headers)
+                task_priority = parse_priority(request.headers)
+                refusal = self._admission_expired(route, task_priority,
+                                                  deadline_at)
+                if refusal is not None:
+                    return refusal
+
             # Result-cache consult (rescache/): hit → terminal task served
             # straight from the cache; identical request already in flight →
             # hand back the SAME task record (single-flight coalescing, no
@@ -324,6 +360,17 @@ class Gateway:
                                     headers={CACHE_STATUS_HEADER: "coalesced"})
                         cache_key = key
                         xcache = "miss"
+            if self._admission is not None:
+                # Pressure shed, now that the cache had its chance: only
+                # requests about to CREATE work are tested against the
+                # route's backlog. Nothing to unwind on refusal — the
+                # miss/bypass outcome is counted after record creation and
+                # inflight leadership is registered after it too, so a
+                # shed here leaves no cache state behind.
+                refusal = self._admission_pressure(route, task_priority,
+                                                   deadline_at)
+                if refusal is not None:
+                    return refusal
             with get_tracer().span("create_task", route=route.prefix,
                                    headers=request.headers) as span:
                 try:
@@ -333,6 +380,8 @@ class Gateway:
                         content_type=content_type,
                         publish=True,
                         cache_key=cache_key,
+                        deadline_at=deadline_at,
+                        priority=task_priority,
                     ))
                 except NotPrimaryError:
                     # Standby control plane: reads are served here, task
@@ -347,7 +396,10 @@ class Gateway:
                         # Same marker as the store surface: clients with a
                         # replica list rotate ONLY on this header — a plain
                         # overload 503 must never re-home them (ADVICE r4).
-                        headers={"Retry-After": "2", "X-Not-Primary": "1"})
+                        # Retry-After is drain-rate-derived when admission
+                        # runs (satellite: no hardcoded backoff hints).
+                        headers={"Retry-After": self._standby_retry_after(),
+                                 "X-Not-Primary": "1"})
                 span.task_id = task.task_id
             if cache is not None and xcache is not None:
                 # Miss/bypass recorded only NOW, after the record exists: a
@@ -370,6 +422,53 @@ class Gateway:
                 headers={CACHE_STATUS_HEADER: xcache} if xcache else None)
 
         return handler
+
+    def _admission_expired(self, route: Route, priority: int,
+                           deadline_at: float) -> web.Response | None:
+        """504 for async work whose budget is already spent — creating a
+        task would only carry a corpse through the broker. Runs BEFORE the
+        cache consult: even a cached answer serves nobody here."""
+        if not expired(deadline_at):
+            return None
+        self._admission.note_expired("gateway", priority)
+        self._requests.inc(route=route.prefix, outcome="expired")
+        return web.Response(
+            status=504, text="Deadline already expired.",
+            headers={SHED_REASON_HEADER: shed_reason("gateway", "deadline")})
+
+    def _admission_pressure(self, route: Route, priority: int,
+                            deadline_at: float) -> web.Response | None:
+        """429 lowest-priority-first when the route's created backlog says
+        new work would queue past its class's share (or past its own
+        deadline) — with a ``Retry-After`` computed from the observed
+        drain rate and ``X-Shed-Reason`` provenance. Runs AFTER the cache
+        consult: only requests about to create backlog are tested."""
+        adm = self._admission
+        try:
+            backlog = self.store.set_len(endpoint_path(route.backend_uri),
+                                         TaskStatus.CREATED)
+        except Exception:  # noqa: BLE001 — duck-typed store stand-ins
+            backlog = 0
+        decision = adm.shed_async(priority, backlog, deadline_at)
+        if decision is None:
+            return None
+        retry_after, why = decision
+        adm.note_shed("gateway", priority)
+        self._requests.inc(route=route.prefix, outcome="shed")
+        return web.json_response(
+            {"error": f"request shed ({why}); retry later"},
+            status=429,
+            headers={"Retry-After": str(max(1, math.ceil(retry_after))),
+                     SHED_REASON_HEADER: shed_reason("gateway", why)})
+
+    def _standby_retry_after(self) -> str:
+        """Retry-After on the standby-replica 503. With admission running
+        this is the drain-rate estimate (how long until the backlog the
+        promotion inherits should clear a unit of work); without it, the
+        historical constant."""
+        if self._admission is None:
+            return "2"
+        return str(max(1, math.ceil(self._admission.retry_after_s())))
 
     def _derive_cache_key(self, route: Route, request: web.Request,
                           body: bytes, content_type: str) -> str:
@@ -431,14 +530,42 @@ class Gateway:
             # the cache; an identical request already proxying makes this
             # one a single-flight subscriber — it awaits the leader's
             # response instead of re-executing.
+            # Admission on the sync proxy (admission/): POST-only, like the
+            # cache — POSTs are the inference requests; GETs and friends
+            # pass through untouched. An already-expired request answers
+            # 504 before the cache or the backend see it; admitted ones
+            # run under the controller's adaptive in-flight cap (acquired
+            # below, inside the try/finally).
+            adm = self._admission if request.method == "POST" else None
+            sync_scope = None
+            priority = 1
+            deadline_at = 0.0
+            if adm is not None:
+                deadline_at = parse_deadline_at(request.headers)
+                priority = parse_priority(request.headers)
+                if expired(deadline_at):
+                    adm.note_expired("gateway_sync", priority)
+                    self._requests.inc(route=route.prefix, outcome="expired")
+                    return web.Response(
+                        status=504, text="Deadline already expired.",
+                        headers={SHED_REASON_HEADER:
+                                 shed_reason("gateway_sync", "deadline")})
+                sync_scope = adm.scope(adm.SYNC_SCOPE)
+
             cache = self._result_cache if route.cacheable else None
             key = None
             fut = None  # set when THIS request is the single-flight leader
             gen = 0  # family invalidation generation captured at leadership
             bypassed = False
+            # Outcome counting is DEFERRED until the request survives the
+            # admission acquire below: a miss/bypass recorded here and
+            # then shed with 503 would count an outcome for a request
+            # that never executed (docs/METRICS.md: outcomes sum to
+            # executing/served requests — the same reason the async path
+            # counts only after the task record exists).
+            miss_pending = False
             if cache is not None and request.method == "POST":
                 if cache_bypass_requested(request.headers):
-                    cache.count_bypass()
                     bypassed = True
                 else:
                     key = self._derive_cache_key(route, request, body,
@@ -475,21 +602,50 @@ class Gateway:
                         # fill, applied to coalescing). Proxy ourselves,
                         # uncoalesced (no re-registration: an erroring
                         # backend must not chain a convoy of waiters behind
-                        # each retry). This request executes: it is a miss.
-                        cache.count_miss()
+                        # each retry). If this request executes (survives
+                        # admission), it is a miss.
+                        miss_pending = True
                         key = None
                     else:
                         fut = asyncio.get_running_loop().create_future()
                         gen = cache.generation(key)
                         self._sync_inflight[key] = (fut, gen)
-                        cache.count_miss()
+                        miss_pending = True
 
             # From the moment the leader future is registered, EVERY exit —
             # backend errors, unexpected exceptions, the client
             # disconnecting (aiohttp cancels the handler wherever it is
             # suspended) — must run the finally below, or the unresolved
             # future wedges every later identical request forever.
+            import time as _time
+            acquired = False
+            t0 = _time.perf_counter()
             try:
+                if sync_scope is not None:
+                    # Adaptive in-flight cap, lowest priority shed first.
+                    # Inside the try: a shed leader's finally still
+                    # resolves the single-flight future (waiters then
+                    # proxy themselves and face their own admission).
+                    retry_after = sync_scope.try_acquire(priority)
+                    if retry_after is not None:
+                        adm.note_shed("gateway_sync", priority)
+                        self._requests.inc(route=route.prefix,
+                                           outcome="shed")
+                        return web.Response(
+                            status=503, text="Sync capacity exhausted.",
+                            headers={"Retry-After":
+                                     str(max(1, math.ceil(retry_after))),
+                                     SHED_REASON_HEADER:
+                                     shed_reason("gateway_sync",
+                                                 "pressure")})
+                    acquired = True
+                # Admitted: the request WILL execute — now the deferred
+                # cache outcome is true.
+                if cache is not None:
+                    if miss_pending:
+                        cache.count_miss()
+                    elif bypassed:
+                        cache.count_bypass()
                 # Weighted per-request pick over the route's backend set
                 # (single-backend routes skip the RNG) — Istio's weighted
                 # VirtualService subsets, at the gateway.
@@ -504,11 +660,21 @@ class Gateway:
                         # Strip hop headers AND the gateway credential: a sync
                         # backend (arbitrary URI, possibly third-party) must
                         # never see the subscription key it could replay
-                        # against the keyed public surface.
-                        headers={k: v for k, v in request.headers.items()
-                                 if k.lower() not in (
-                                     "host", "content-length",
-                                     "ocp-apim-subscription-key", "x-api-key")},
+                        # against the keyed public surface. With admission,
+                        # the RELATIVE deadline header is stripped too and
+                        # the ABSOLUTE one attached — re-anchoring
+                        # X-Deadline-Ms at the worker would extend the
+                        # budget by exactly the proxy time it bounds.
+                        headers={
+                            **{k: v for k, v in request.headers.items()
+                               if k.lower() not in (
+                                   "host", "content-length",
+                                   "ocp-apim-subscription-key", "x-api-key",
+                                   *(("x-deadline-ms", "x-deadline-at",
+                                      "x-priority")
+                                     if sync_scope is not None else ()))},
+                            **(propagation_headers(deadline_at, priority)
+                               if sync_scope is not None else {})},
                     ) as resp:
                         payload = await resp.read()
                         self._requests.inc(route=route.prefix,
@@ -545,6 +711,18 @@ class Gateway:
                     return web.Response(status=502,
                                         text=f"Backend unreachable: {exc}")
             finally:
+                if acquired:
+                    # Observe BEFORE release, so the limiter's Little's-law
+                    # clamp sees the in-flight count including this request
+                    # (the dispatcher path passes its _busy counter the
+                    # same way) — observing after the decrement would
+                    # record inflight=0 under serial traffic and let the
+                    # limit ratchet to the ceiling unused. RTT feeds the
+                    # limiter ONLY for requests that held a slot — shed
+                    # paths return in microseconds and would teach it a
+                    # fictitious no-load RTT.
+                    sync_scope.observe(_time.perf_counter() - t0)
+                    sync_scope.release()
                 if fut is not None:
                     self._sync_inflight.pop(key, None)
                     if not fut.done():
@@ -576,13 +754,13 @@ class Gateway:
             except ValueError:
                 return web.Response(status=400, text="Bad wait parameter.")
 
-        if wait > 0 and task.canonical_status not in ("completed", "failed"):
+        if wait > 0 and task.canonical_status not in TaskStatus.TERMINAL:
             # Register the waiter BEFORE the re-read so a transition between
             # re-read and wait() still sets the event (no lost wakeup).
             event = self._waiter_for(task_id)
             try:
                 task = self.store.get(task_id)
-                if task.canonical_status not in ("completed", "failed"):
+                if task.canonical_status not in TaskStatus.TERMINAL:
                     try:
                         await asyncio.wait_for(event.wait(), timeout=wait)
                     except asyncio.TimeoutError:
@@ -618,8 +796,9 @@ class Gateway:
 
     def _on_task_change(self, task) -> None:
         """Store listener — may fire from any thread; wake that task's
-        long-poll waiters on terminal transitions."""
-        if task.canonical_status not in ("completed", "failed"):
+        long-poll waiters on terminal transitions (``expired`` included —
+        a poller must learn its task was shed, not wait out the poll)."""
+        if task.canonical_status not in TaskStatus.TERMINAL:
             return
         for loop, event in self._waiters.get(task.task_id, frozenset()):
             loop.call_soon_threadsafe(event.set)

@@ -86,6 +86,21 @@ class PlatformConfig:
     # invalidates synchronously; TTL is the staleness backstop for caches
     # that a remote worker's reload cannot reach. None = no TTL.
     cache_ttl_seconds: float | None = 300.0
+    # Admission control (admission/, docs/admission.md): end-to-end
+    # deadline propagation (X-Deadline-Ms / X-Priority / X-Shed-Reason),
+    # priority load shedding with drain-rate-derived Retry-After, and an
+    # adaptive (gradient/AIMD) concurrency limit replacing the fixed
+    # gateway sync cap and dispatcher fan-out. Off by default — enabling
+    # it is a semantic statement that the platform may refuse or expire
+    # work (terminal `expired` status) instead of carrying every request
+    # to completion however late.
+    admission: bool = False
+    admission_min_limit: int = 1
+    admission_max_limit: int = 256
+    admission_initial_limit: int = 8
+    # Async-edge backlog capacity the priority shedder fractions divide
+    # (created-set depth per route; background sheds first at 60%).
+    admission_max_backlog: int = 1024
 
 
 class LocalPlatform:
@@ -174,6 +189,31 @@ class LocalPlatform:
                 # in a Python-side sidecar (native.py) — the hasattr is
                 # only a guard for exotic store substitutes in tests.
                 attach_store(self.store, self.result_cache)
+        self.admission = None
+        if self.config.admission:
+            if self.config.native_store or self.config.native_broker:
+                # The C cores have no deadline/priority slots on their
+                # record/message structs and no `expired` status bucket in
+                # their canonical sets — admission there would silently
+                # drop the very state it exists to enforce. Same loud-fail
+                # pattern as retention/journal on the native store.
+                raise ValueError(
+                    "admission control requires the Python store and "
+                    "broker (the native cores carry no deadline/priority "
+                    "state)")
+            from .admission import AdmissionController
+            self.admission = AdmissionController(
+                metrics=self.metrics,
+                min_limit=self.config.admission_min_limit,
+                max_limit=self.config.admission_max_limit,
+                initial_limit=self.config.admission_initial_limit,
+                max_backlog=self.config.admission_max_backlog)
+            if hasattr(self.store, "add_listener"):
+                # Terminal transitions feed the drain-rate estimator (the
+                # Retry-After on every shed/standby response) and score
+                # goodput — the same change feed the long-poll waiters and
+                # the result cache ride.
+                self.admission.attach_store(self.store)
         self.broker = None
         self.dispatchers = None
         self.topic = None
@@ -202,7 +242,8 @@ class LocalPlatform:
                 result_cache=self.result_cache,
                 result_store=(self.store if self.result_cache is not None
                               and hasattr(self.store, "set_result")
-                              else None))
+                              else None),
+                admission=self.admission)
         else:
             raise ValueError(
                 f"unknown transport {self.config.transport!r}; "
@@ -210,6 +251,8 @@ class LocalPlatform:
         self.gateway = Gateway(self.store, metrics=self.metrics)
         if self.result_cache is not None:
             self.gateway.set_result_cache(self.result_cache)
+        if self.admission is not None:
+            self.gateway.set_admission(self.admission)
         # Terminal-history retention: None = AUTO — 15 min on the Python
         # store, sized to the soak evidence (unevicted terminal history
         # grows ~12 MB/min at 200 req/s → AUTO bounds steady-state at
@@ -329,6 +372,14 @@ class LocalPlatform:
                 self.store, queue_name, DispatcherScaleTarget(dispatcher),
                 policy=autoscale, interval=autoscale_interval,
                 metrics=self.metrics))
+        elif self.admission is not None:
+            # The adaptive controller owns this dispatcher's fan-out: its
+            # per-queue limiter (fed by delivery RTTs + backpressure
+            # backoffs) replaces the fixed concurrency constant. An
+            # explicit AutoscalePolicy wins — two control loops driving one
+            # actuator would fight.
+            self.admission.add_target("dispatch:" + queue_name,
+                                      dispatcher.set_concurrency)
 
     def publish_sync_api(self, public_prefix: str, backend_uri,
                          max_body_bytes: int | None = None) -> None:
@@ -538,7 +589,7 @@ class LocalPlatform:
     async def _fail_dead_letter(self, task_id: str) -> None:
         try:
             task = self.store.get(task_id)
-            if task.canonical_status not in ("completed", "failed"):
+            if task.canonical_status not in TaskStatus.TERMINAL:
                 await self.task_manager.fail_task(
                     task_id, TaskStatus.DEAD_LETTER)
         except Exception:  # noqa: BLE001 — best-effort terminal transition

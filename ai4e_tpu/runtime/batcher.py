@@ -49,6 +49,11 @@ class _Pending:
     future: asyncio.Future
     enqueued: float = field(default_factory=time.perf_counter)
     priority: int = 0  # 0 = interactive, higher = background
+    # Absolute wall-clock deadline (unix seconds; 0.0 = none): an entry
+    # still pending when it passes is dropped at batch-cut time with
+    # DeadlineExceeded instead of being padded onto the device
+    # (admission/ — dead work never reaches the TPU).
+    deadline_at: float = 0.0
 
 
 class MicroBatcher:
@@ -118,6 +123,11 @@ class MicroBatcher:
         self._d2h_bytes = self.metrics.counter(
             "ai4e_batch_d2h_bytes_total",
             "Device-to-host bytes fetched (batch outputs)")
+        # Deadline drops at the batch cut (admission/): same series every
+        # other hop reports into, labeled with THIS hop.
+        self._expired_total = self.metrics.counter(
+            "ai4e_admission_expired_total",
+            "Requests dropped on deadline expiry, by hop/priority")
 
     # -- request side ------------------------------------------------------
 
@@ -126,7 +136,7 @@ class MicroBatcher:
         return sum(len(v) for v in self._pending.values())
 
     async def submit(self, model_name: str, example: np.ndarray,
-                     priority: int = 0):
+                     priority: int = 0, deadline_at: float = 0.0):
         """Queue one example; resolves to that example's postprocessed result.
 
         ``priority`` 0 is interactive (default); higher values are
@@ -134,6 +144,11 @@ class MicroBatcher:
         is filled interactive-first, so a long background stack shares the
         device without queueing ahead of interactive latency — the
         isolation the reference gets only from separate container pools.
+
+        ``deadline_at`` (absolute unix seconds; 0.0 = none): if the entry
+        is still pending when the deadline passes, the await raises
+        ``DeadlineExceeded`` at the next batch cut and the example never
+        ships to the device (admission/).
         """
         if self._stop:
             raise RuntimeError("batcher stopped")
@@ -149,7 +164,8 @@ class MicroBatcher:
                 f"bad input shape {example.shape}, expected {expected}")
         fut = asyncio.get_running_loop().create_future()
         self._pending.setdefault(model_name, []).append(
-            _Pending(example, fut, priority=priority))
+            _Pending(example, fut, priority=priority,
+                     deadline_at=deadline_at))
         self._pending_gauge.set(self.pending_count)
         self._wakeup.set()
         return await fut
@@ -226,6 +242,9 @@ class MicroBatcher:
         queue = self._pending.get(model_name, [])
         if not queue:
             return []
+        queue = self._sweep_expired(model_name, queue)
+        if not queue:
+            return []
         servable = self.runtime.models[model_name]
         take = min(len(queue), servable.max_bucket)
         if take < len(queue):
@@ -247,6 +266,32 @@ class MicroBatcher:
         self._pending[model_name] = rest
         self._pending_gauge.set(self.pending_count)
         return batch
+
+    def _sweep_expired(self, model_name: str,
+                       queue: list[_Pending]) -> list[_Pending]:
+        """Drop pending entries whose deadline passed while they queued —
+        at the batch cut, the last gate before the device (admission/: zero
+        expired examples ever reach ``_execute``). Their futures resolve to
+        ``DeadlineExceeded`` so the worker can move the task to the
+        terminal ``expired`` status. Deadline-free entries pass untouched;
+        the all-deadline-free fast path allocates nothing."""
+        now = time.time()
+        if not any(p.deadline_at and p.deadline_at <= now for p in queue):
+            return queue
+        from ..admission.deadline import DeadlineExceeded, priority_name
+        live: list[_Pending] = []
+        for p in queue:
+            if (p.deadline_at and p.deadline_at <= now
+                    and not p.future.done()):
+                p.future.set_exception(
+                    DeadlineExceeded("batcher", p.deadline_at))
+                self._expired_total.inc(hop="batcher",
+                                        priority=priority_name(p.priority))
+            else:
+                live.append(p)
+        self._pending[model_name] = live
+        self._pending_gauge.set(self.pending_count)
+        return live
 
     async def _execute(self, loop, model_name: str,
                        batch: list[_Pending]) -> None:

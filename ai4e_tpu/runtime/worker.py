@@ -17,10 +17,14 @@ import logging
 
 import numpy as np
 
+from ..admission.deadline import (SHED_REASON_HEADER, DeadlineExceeded,
+                                  expired, expired_status, priority_name,
+                                  shed_reason, worker_admission_kwargs)
 from ..metrics import MetricsRegistry
 from ..rescache.keys import cache_bypass_requested, request_key
 from ..service import APIService
 from ..service.task_manager import TaskManagerBase
+from ..taskstore import TaskStatus
 from .batcher import BatcherSaturated, MicroBatcher
 from .registry import ModelRuntime, ServableModel
 
@@ -67,6 +71,11 @@ class InferenceWorker:
         self.service = APIService(name, prefix=prefix,
                                   task_manager=task_manager, metrics=metrics,
                                   reporter=reporter)
+        # Deadline drops at the worker's submit hop (admission/): the same
+        # series the gateway/dispatcher/batcher report into.
+        self._expired_total = self.service.metrics.counter(
+            "ai4e_admission_expired_total",
+            "Requests dropped on deadline expiry, by hop/priority")
         self._served: dict[str, dict] = {}  # model -> endpoint listing
         # Serializes hot reloads: concurrent swaps would otherwise leave
         # checkpoint_path/params_version reporting a different rollout
@@ -256,17 +265,40 @@ class InferenceWorker:
             # documented X-Cache-Bypass / Cache-Control: no-cache contract
             # ("this request must execute; no cache read, no store") must
             # hold at the worker's own cache too — the gateway's sync proxy
-            # forwards these headers verbatim.
+            # forwards these headers verbatim. Admission state rides the
+            # same extraction: X-Deadline-At (stamped by the proxy) or
+            # X-Deadline-Ms (a direct caller), X-Priority.
             return {"body": await request.read(),
                     "content_type": request.content_type,
-                    "cache_bypass": cache_bypass_requested(request.headers)}
+                    "cache_bypass": cache_bypass_requested(request.headers),
+                    **worker_admission_kwargs(request.headers)}
+
+        async def _async_request_kwargs(request):
+            # The dispatcher forwards X-Deadline-At / X-Priority on its
+            # backend POST (broker/dispatcher.py); the worker is the LAST
+            # shed point before the device, so the handler needs them.
+            return {"body": await request.read(),
+                    "content_type": request.content_type,
+                    **worker_admission_kwargs(request.headers)}
 
         @self.service.api_sync_func(
             sync_path, maximum_concurrent_requests=maximum_concurrent_requests,
             admission_check=_saturation_check,
             request_processing_function=_sync_request_kwargs)
-        async def _sync(body, content_type, cache_bypass=False, _name=name,
+        async def _sync(body, content_type, cache_bypass=False,
+                        deadline_at=0.0, priority=0, _name=name,
                         _servable=servable):
+            if expired(deadline_at):
+                # Submit-hop shed (admission/): the budget is already gone —
+                # answering 504 now is strictly better than computing a
+                # result the caller stopped waiting for.
+                self._expired_total.inc(hop="worker",
+                                        priority=priority_name(priority))
+                from aiohttp import web
+                return web.Response(
+                    status=504, text="Deadline exceeded before execution.",
+                    headers={SHED_REASON_HEADER:
+                             shed_reason("worker", "deadline")})
             # Worker-level result cache (rescache/): keyed on the model AND
             # its params_version, so a hot reload's version bump alone makes
             # every pre-swap entry unreachable (the reload hook additionally
@@ -285,11 +317,19 @@ class InferenceWorker:
                     return json.loads(found[0])
             example = _servable.preprocess(body, content_type)
             try:
-                result = await self.batcher.submit(_name, np.asarray(example))
+                result = await self.batcher.submit(_name, np.asarray(example),
+                                                   priority=priority,
+                                                   deadline_at=deadline_at)
             except BatcherSaturated:
                 from aiohttp import web
                 return web.Response(status=503,
                                     text="Inference queue saturated; retry.")
+            except DeadlineExceeded as exc:
+                from aiohttp import web
+                return web.Response(
+                    status=504, text="Deadline exceeded while queued.",
+                    headers={SHED_REASON_HEADER:
+                             shed_reason(exc.hop, "deadline")})
             out = _jsonable(result)
             if key is not None:
                 cache.put(key, json.dumps(out).encode(), "application/json")
@@ -297,10 +337,20 @@ class InferenceWorker:
 
         @self.service.api_async_func(
             async_path, maximum_concurrent_requests=maximum_concurrent_requests,
-            admission_check=_saturation_check)
-        async def _async(taskId, body, content_type, _name=name,
-                         _servable=servable):
+            admission_check=_saturation_check,
+            request_processing_function=_async_request_kwargs)
+        async def _async(taskId, body, content_type, deadline_at=0.0,
+                         priority=0, _name=name, _servable=servable):
             tm = self.service.task_manager
+            if expired(deadline_at):
+                # Submit-hop shed (admission/): terminal `expired`, never
+                # adopted into the batcher — the dispatcher treats the 200
+                # as delivered and the store transition carries provenance.
+                self._expired_total.inc(hop="worker",
+                                        priority=priority_name(priority))
+                await tm.update_task_status(
+                    taskId, expired_status("worker"), TaskStatus.EXPIRED)
+                return
             await tm.update_task_status(taskId, f"running - {_name} inference")
             try:
                 example = _servable.preprocess(body, content_type)
@@ -308,7 +358,9 @@ class InferenceWorker:
                 await tm.fail_task(taskId, f"failed - bad input: {exc}")
                 return
             try:
-                result = await self.batcher.submit(_name, np.asarray(example))
+                result = await self.batcher.submit(_name, np.asarray(example),
+                                                   priority=priority,
+                                                   deadline_at=deadline_at)
             except BatcherSaturated:
                 # Saturated between admission and submit: hand the task back
                 # to the broker (same-endpoint republish with empty body →
@@ -316,6 +368,12 @@ class InferenceWorker:
                 current = await tm.get_task_status(taskId)
                 endpoint = (current or {}).get("Endpoint", async_path)
                 await tm.add_pipeline_task(taskId, endpoint)
+                return
+            except DeadlineExceeded as exc:
+                # Expired while pending in the batcher (which already
+                # counted the hop metric): terminal transition only.
+                await tm.update_task_status(
+                    taskId, expired_status(exc.hop), TaskStatus.EXPIRED)
                 return
             if pipeline_to is not None:
                 if handoff_wants_example:

@@ -193,6 +193,14 @@ class InMemoryTaskStore(StoreSideEffects):
                 # the terminal result of the LAST stage is what the original
                 # request's cache key should resolve to (rescache/wiring.py).
                 task.cache_key = prev.cache_key
+            if not task.deadline_at:
+                # Admission state survives handoffs/requeues the same way:
+                # a pipeline's second stage runs under the ORIGINAL
+                # request's deadline (the caller's budget covers the whole
+                # composite), and a requeue must not shed its class label.
+                task.deadline_at = prev.deadline_at
+            if task.priority == 1 and prev.priority != 1:
+                task.priority = prev.priority
             if not prev.durable:
                 # Memory-only stays memory-only: an external full upsert
                 # (facade records default durable=True) must not promote a

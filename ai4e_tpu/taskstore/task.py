@@ -23,9 +23,14 @@ class TaskStatus:
     RUNNING = "running"
     COMPLETED = "completed"
     FAILED = "failed"
+    # Deadline-shed work (admission/): terminal like failed, but it is not
+    # a platform failure — the request's budget ran out before execution
+    # and the platform declined to burn device time on an answer nobody is
+    # waiting for (docs/admission.md).
+    EXPIRED = "expired"
 
-    ALL = (CREATED, RUNNING, COMPLETED, FAILED)
-    TERMINAL = (COMPLETED, FAILED)
+    ALL = (CREATED, RUNNING, COMPLETED, FAILED, EXPIRED)
+    TERMINAL = (COMPLETED, FAILED, EXPIRED)
 
     # The exact prose the platform writes when a task's transport message
     # exhausts its delivery budget (queue or push). The redrive surface's
@@ -44,7 +49,8 @@ class TaskStatus:
         (``CacheConnectorUpsert.cs:111-123``).
         """
         s = (status or "").lower()
-        for canon in (TaskStatus.FAILED, TaskStatus.COMPLETED, TaskStatus.RUNNING):
+        for canon in (TaskStatus.FAILED, TaskStatus.COMPLETED,
+                      TaskStatus.EXPIRED, TaskStatus.RUNNING):
             if canon in s:
                 return canon
         return TaskStatus.CREATED
@@ -87,6 +93,14 @@ class APITask:
     # redelivery straight from the cache, and operators can see WHY a task
     # says "completed - served from cache".
     cache_key: str = ""
+    # Admission state (admission/): the absolute wall-clock deadline
+    # (unix seconds; 0.0 = none) the gateway anchored from the caller's
+    # X-Deadline-Ms, and the priority class (0 interactive / 1 default /
+    # 2 background). They ride the record, the wire, and the journal so
+    # every hop — dispatcher pop, batcher cut, worker submit — can drop
+    # already-dead work and shed lowest-priority-first.
+    deadline_at: float = 0.0
+    priority: int = 1
     # Journal participation. False for records whose loss on restart is
     # acceptable — cache-hit tasks, whose terminal record was already in the
     # submit response: a JournaledTaskStore keeps them queryable in memory
@@ -119,6 +133,12 @@ class APITask:
             # Only when set: pre-cache records (and uncached tasks) keep the
             # exact reference wire shape.
             d["CacheKey"] = self.cache_key
+        if self.deadline_at:
+            # Same only-when-set rule: deadline-free traffic keeps the
+            # reference wire shape byte for byte.
+            d["DeadlineAt"] = self.deadline_at
+        if self.priority != 1:
+            d["Priority"] = self.priority
         return d
 
     @classmethod
@@ -138,6 +158,8 @@ class APITask:
             content_type=d.get("ContentType", "application/json"),
             publish=bool(d.get("PublishToGrid", False)),
             cache_key=d.get("CacheKey", ""),
+            deadline_at=float(d.get("DeadlineAt") or 0.0),
+            priority=int(d.get("Priority") or 1),
         )
 
     def with_status(self, status: str, backend_status: str | None = None) -> "APITask":

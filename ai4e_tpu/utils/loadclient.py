@@ -44,6 +44,8 @@ async def run_closed_loop(
     task_timeout: float = 120.0,
     poll_wait: float = 30.0,
     post_url_for=None,
+    headers_for=None,
+    deadline_s: float | None = None,
 ) -> dict:
     """Drive ``post_url`` closed-loop; returns window stats.
 
@@ -51,9 +53,19 @@ async def run_closed_loop(
     ``post_url_for() -> url`` (optional) picks the POST target per request —
     the bench's duplicate-request mix rides this (identical requests POST
     the bare route, unique ones carry a never-repeating query param).
+    ``headers_for() -> dict`` (optional) adds per-request headers on top of
+    ``headers`` — the bench's deadline/priority mix rides this
+    (admission control).
+    ``deadline_s`` (optional): the per-request latency budget the traffic
+    carries; completions are additionally bucketed into goodput (finished
+    within the budget) vs ``late``, and tasks the platform shed on their
+    deadline (terminal ``expired`` status / 504) count as ``expired``,
+    not failed.
     Returns ``{"value", "p50_latency_ms", "p95_latency_ms", "completed",
-    "failed", "duration_s"}`` where value is completions/second inside the
-    measurement window that opens after ``ramp`` seconds.
+    "failed", "expired", "duration_s", ...}`` where value is
+    completions/second inside the measurement window that opens after
+    ``ramp`` seconds; with ``deadline_s`` set the dict gains
+    ``goodput`` (within-deadline completions/second) and ``late``.
     """
     import aiohttp
 
@@ -63,20 +75,37 @@ async def run_closed_loop(
     latencies: list[float] = []
     completed = 0
     failed = 0
+    expired = 0
+    good = 0  # completions within deadline_s (== completed when unset)
+
+    def _headers() -> dict:
+        if headers_for is None:
+            return headers
+        return {**headers, **headers_for()}
+
+    def _score_completion(elapsed: float) -> None:
+        nonlocal completed, good
+        latencies.append(elapsed)
+        completed += 1
+        if deadline_s is None or elapsed <= deadline_s:
+            good += 1
 
     async def one_async() -> None:
-        nonlocal completed, failed
+        nonlocal failed, expired
         t0 = time.perf_counter()
         url = post_url if post_url_for is None else post_url_for()
         try:
             async with session.post(url, data=payload,
-                                    headers=headers) as resp:
+                                    headers=_headers()) as resp:
                 if resp.status in (503, 429):
                     # Backpressure (admission 503 / per-key throttle 429):
                     # not a failure — yield briefly and re-enter. The client
                     # honors Retry-After when present, capped so one long
                     # hint can't idle the closed loop past the window.
                     await asyncio.sleep(_backoff(resp))
+                    return
+                if resp.status == 504:  # shed: budget spent at the edge
+                    expired += 1
                     return
                 task = await resp.json()
             task_id = task["TaskId"]
@@ -90,7 +119,7 @@ async def run_closed_loop(
                 async with session.get(status_url_for(task_id),
                                        params={"wait": str(int(poll_wait))},
                                        headers=headers) as resp:
-                    if resp.status == 404:  # reaped/expired task
+                    if resp.status == 404:  # reaped/evicted task
                         failed += 1
                         return
                     record = await resp.json()
@@ -106,8 +135,12 @@ async def run_closed_loop(
                 failed += 1
                 return
             if "completed" in status:
-                latencies.append(time.perf_counter() - t0)
-                completed += 1
+                _score_completion(time.perf_counter() - t0)
+                return
+            if "expired" in status:
+                # Admission shed the task on its deadline (terminal) —
+                # shed work, not a platform failure.
+                expired += 1
                 return
             if time.perf_counter() > deadline:  # stuck task: don't hang the run
                 failed += 1
@@ -117,22 +150,24 @@ async def run_closed_loop(
         # 503 backpressure: sleep briefly and return (neither completed nor
         # failed) — client_loop re-enters until the run deadline, same as
         # one_async, so sustained backpressure can never outlive the run.
-        nonlocal completed, failed
+        nonlocal failed, expired
         t0 = time.perf_counter()
         url = post_url if post_url_for is None else post_url_for()
         try:
             async with session.post(url, data=payload,
-                                    headers=headers) as resp:
+                                    headers=_headers()) as resp:
                 if resp.status in (503, 429):
                     await asyncio.sleep(_backoff(resp))
+                    return
+                if resp.status == 504:  # admission shed on deadline
+                    expired += 1
                     return
                 await resp.read()
                 ok = resp.status == 200
         except (aiohttp.ClientError, asyncio.TimeoutError):
             ok = False
         if ok:
-            latencies.append(time.perf_counter() - t0)
-            completed += 1
+            _score_completion(time.perf_counter() - t0)
         else:
             failed += 1
 
@@ -152,7 +187,8 @@ async def run_closed_loop(
     async def open_window() -> None:
         await asyncio.sleep(ramp)
         mark.update(t=time.perf_counter(), completed=completed,
-                    failed=failed, n_lat=len(latencies))
+                    failed=failed, expired=expired, good=good,
+                    n_lat=len(latencies))
 
     async def close_window() -> None:
         # Snapshot AT stop_at, not after the drain: gather() returns only
@@ -161,7 +197,8 @@ async def run_closed_loop(
         # completions — deflating throughput several-fold.
         await asyncio.sleep(ramp + duration)
         close.update(t=time.perf_counter(), completed=completed,
-                     failed=failed, n_lat=len(latencies))
+                     failed=failed, expired=expired, good=good,
+                     n_lat=len(latencies))
 
     stop_at = time.perf_counter() + ramp + duration
     await asyncio.gather(open_window(), close_window(),
@@ -174,12 +211,20 @@ async def run_closed_loop(
     def pctl(q: float) -> float:
         return round(window_lat[max(0, int(len(window_lat) * q) - 1)] * 1000, 1)
 
-    return {
+    out = {
         "value": round(n / elapsed, 2),
         "p50_latency_ms": round(window_lat[len(window_lat) // 2] * 1000, 1),
         "p95_latency_ms": pctl(0.95),
         "p99_latency_ms": pctl(0.99),
         "completed": n,
         "failed": close["failed"] - mark["failed"],
+        "expired": close["expired"] - mark["expired"],
         "duration_s": round(elapsed, 1),
     }
+    if deadline_s is not None:
+        n_good = close["good"] - mark["good"]
+        # Goodput — THE saturation metric (PAPERS.md): completions that
+        # landed inside the caller's budget, per second of the window.
+        out["goodput"] = round(n_good / elapsed, 2)
+        out["late"] = n - n_good
+    return out

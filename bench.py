@@ -470,6 +470,89 @@ def _build_pipeline_servables(args):
     return det, sp, buf.getvalue(), meta
 
 
+def _admission_enabled(args) -> bool:
+    return (getattr(args, "deadline_ms", 0.0) > 0
+            or bool(getattr(args, "priority_mix", "")))
+
+
+def _parse_priority_mix(spec: str) -> list[tuple[str, float]]:
+    """``"interactive:6,default:3,background:1"`` → weighted classes.
+    Bare class names weight 1 (``"interactive,background"``)."""
+    mix = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, _, w = part.partition(":")
+            mix.append((name.strip(), float(w)))
+        else:
+            mix.append((part, 1.0))
+    if not mix:
+        raise ValueError(f"empty --priority-mix {spec!r}")
+    return mix
+
+
+def _admission_drivers(args):
+    """``(headers_for, deadline_s)`` for the load client: per-request
+    X-Deadline-Ms plus a weighted X-Priority draw (seeded — runs are
+    reproducible)."""
+    if not _admission_enabled(args):
+        return None, None
+    import random as _random
+    rng = _random.Random(2)
+    mix = _parse_priority_mix(args.priority_mix) if args.priority_mix else None
+    base = ({"X-Deadline-Ms": str(int(args.deadline_ms))}
+            if args.deadline_ms > 0 else {})
+    if mix:
+        names = [n for n, _ in mix]
+        weights = [w for _, w in mix]
+
+        def headers_for():
+            return {**base,
+                    "X-Priority": rng.choices(names, weights=weights)[0]}
+    else:
+        def headers_for():
+            return dict(base)
+
+    deadline_s = args.deadline_ms / 1000.0 if args.deadline_ms > 0 else None
+    return headers_for, deadline_s
+
+
+def _admission_report(args, platform) -> dict:
+    """The bench artifact's admission block: knobs + the ai4e_admission_*
+    counters/gauges accumulated over the run (shed/expired by hop and
+    priority, adaptive limits by scope, goodput outcomes)."""
+    adm = getattr(platform, "admission", None)
+    if adm is None:
+        return {}
+    reg = platform.metrics
+
+    def counter_by_labels(name, keys):
+        out = {}
+        for _, _, labels, v in reg.counter(name, "").collect():
+            out["/".join(labels.get(k, "") for k in keys)] = int(v)
+        return out
+
+    limits = {}
+    for _, _, labels, v in reg.gauge("ai4e_admission_limit", "").collect():
+        limits[labels.get("scope", "")] = int(v)
+    return {"admission": {
+        "deadline_ms": args.deadline_ms,
+        "priority_mix": args.priority_mix or None,
+        # *_by_hop: server-side counters; the client-observed window counts
+        # (goodput/late/expired) are merged in by the caller under their
+        # own keys.
+        "shed_by_hop": counter_by_labels("ai4e_admission_shed_total",
+                                         ("hop", "priority")),
+        "expired_by_hop": counter_by_labels("ai4e_admission_expired_total",
+                                            ("hop", "priority")),
+        "limits": limits,
+        "goodput_outcomes": counter_by_labels(
+            "ai4e_admission_goodput_total", ("outcome",)),
+    }}
+
+
 def build_platform(args):
     from aiohttp import web  # noqa: F401 — ensure aiohttp present early
 
@@ -490,7 +573,16 @@ def build_platform(args):
         retry_delay=0.05, dispatcher_concurrency=args.dispatcher_concurrency,
         # --cache-hit-ratio > 0 enables the inference result cache +
         # single-flight coalescing (rescache/) for the duplicate-mix run.
-        result_cache=getattr(args, "cache_hit_ratio", 0.0) > 0))
+        result_cache=getattr(args, "cache_hit_ratio", 0.0) > 0,
+        # --deadline-ms / --priority-mix enable admission control
+        # (ai4e_tpu/admission/): deadline-aware shedding at every hop +
+        # adaptive dispatcher/sync concurrency. Sized for the bench: the
+        # limiter starts near the configured fan-out instead of probing up
+        # from cold inside the measured window.
+        admission=_admission_enabled(args),
+        admission_initial_limit=max(8, args.dispatcher_concurrency // 8),
+        admission_max_limit=max(256, args.dispatcher_concurrency),
+        admission_max_backlog=max(256, args.concurrency * 4)))
     runtime = ModelRuntime(donate_batch=args.donate_batch)
     batcher = MicroBatcher(runtime, max_wait_ms=args.max_wait_ms,
                            max_pending=args.concurrency * 4,
@@ -985,6 +1077,10 @@ async def run_bench(args) -> dict:
             if cache is not None:
                 cache_mark.update(cache.stats())
 
+        # Admission-mix drivers (--deadline-ms / --priority-mix): each POST
+        # carries its budget + class; completions score goodput.
+        headers_for, deadline_s = _admission_drivers(args)
+
         # Closed loop with a steady-state ramp before the measured window
         # (shared with examples/loadgen.py — ai4e_tpu/utils/loadclient.py).
         window, _ = await asyncio.gather(run_closed_loop(
@@ -993,8 +1089,17 @@ async def run_bench(args) -> dict:
             mode=args.mode,
             status_url_for=lambda tid: f"{gw}/v1/taskmanagement/task/{tid}",
             concurrency=args.concurrency, duration=args.duration,
-            ramp=args.ramp, post_url_for=post_url_for),
+            ramp=args.ramp, post_url_for=post_url_for,
+            headers_for=headers_for, deadline_s=deadline_s),
             _snap_cache_at_window_open())
+
+    admission_meta = _admission_report(args, platform)
+    if admission_meta:
+        # Goodput rides beside raw req/s: under offered load > capacity the
+        # headline number alone rewards completing dead work.
+        for key in ("goodput", "late", "expired"):
+            if key in window:
+                admission_meta["admission"][key] = window[key]
 
     cache_meta = {}
     if cache is not None:
@@ -1155,6 +1260,7 @@ async def run_bench(args) -> dict:
         "concurrency": args.concurrency,
         "device": _device_kind(),
         **build_meta,
+        **admission_meta,
         **cache_meta,
         **batch_meta,
         **capability_meta,
@@ -1324,6 +1430,9 @@ def _forward_argv(args) -> list[str]:
             "--seq-input", args.seq_input,
             "--wire", args.wire,
             "--cache-hit-ratio", str(args.cache_hit_ratio),
+            "--deadline-ms", str(args.deadline_ms),
+            *(["--priority-mix", args.priority_mix]
+              if args.priority_mix else []),
             "--buckets", *[str(b) for b in args.buckets]]
 
 
@@ -1428,6 +1537,22 @@ def main() -> None:
                              "execute. The JSON gains a 'cache' block with "
                              "the measured hit ratio and served-from-cache "
                              "req/s. 0 (default) = cache off")
+    parser.add_argument("--deadline-ms", type=float, default=0.0,
+                        help="enable admission control (ai4e_tpu/admission/)"
+                             " and attach this X-Deadline-Ms budget to every"
+                             " request: the platform sheds work that cannot"
+                             " finish in time (terminal `expired` status, "
+                             "504/429 with X-Shed-Reason) and the JSON "
+                             "gains an 'admission' block with GOODPUT "
+                             "(within-deadline completions/s) beside raw "
+                             "req/s plus shed/expired counts by hop and "
+                             "priority. 0 (default) = admission off")
+    parser.add_argument("--priority-mix", default="",
+                        help="weighted X-Priority draw per request, e.g. "
+                             "'interactive:6,default:3,background:1' — "
+                             "enables admission control; under saturation "
+                             "the shedder refuses lowest class first. "
+                             "Empty (default) = unlabeled traffic")
     parser.add_argument("--cpu", action="store_true",
                         help="force CPU (debug runs)")
     parser.add_argument("--probe-timeout", type=float, default=60.0,

@@ -48,6 +48,13 @@ class TaskFailed(RuntimeError):
         self.record = record
 
 
+class TaskExpired(TaskFailed):
+    """The platform shed the task on its deadline (terminal ``expired``
+    status, admission control — ``docs/admission.md``). Subclass of
+    ``TaskFailed`` so existing failure handling catches it; the ``Status``
+    prose says which hop shed it."""
+
+
 class TaskTimeout(TimeoutError):
     """The task did not reach a terminal state within the wait budget."""
 
@@ -92,13 +99,25 @@ class AI4EClient:
     def _request(self, method: str, path: str, body: bytes | None = None,
                  content_type: str | None = None,
                  timeout: float | None = None,
-                 no_cache: bool = False):
+                 no_cache: bool = False,
+                 deadline_ms: float | None = None,
+                 priority: str | int | None = None):
         headers = dict(self._headers)
         if content_type:
             headers["Content-Type"] = content_type
         if no_cache:
             # Per-request result-cache opt-out (rescache.keys.BYPASS_HEADER).
             headers["X-Cache-Bypass"] = "1"
+        if deadline_ms is not None and deadline_ms > 0:
+            # Admission control (docs/admission.md): the server anchors
+            # this relative budget and sheds the work at whatever hop it
+            # expires. Admission-off platforms ignore it on the async
+            # path; on the sync path the proxy forwards it and the worker
+            # honors it, so it is only ever sent on explicit request or
+            # from run()'s async submit.
+            headers["X-Deadline-Ms"] = str(int(deadline_ms))
+        if priority is not None:
+            headers["X-Priority"] = str(priority)
         attempt = 0
         per_try = self.timeout if timeout is None else timeout
         # Retry sleeps AND replica attempts stay INSIDE the caller's time
@@ -190,13 +209,22 @@ class AI4EClient:
 
     def submit(self, path: str, payload: bytes,
                content_type: str = DEFAULT_CONTENT_TYPE,
-               no_cache: bool = False) -> str:
+               no_cache: bool = False,
+               deadline_ms: float | None = None,
+               priority: str | int | None = None) -> str:
         """POST an async API; returns the TaskId the gateway created (or the
         in-flight identical request's TaskId when the gateway coalesced —
         check ``last_cache_status``). ``no_cache=True`` bypasses the result
-        cache for this request."""
+        cache for this request.
+
+        ``deadline_ms``/``priority`` ride as ``X-Deadline-Ms`` /
+        ``X-Priority`` (admission control; priority is ``interactive`` |
+        ``default`` | ``background``). On an admission platform an
+        expired/shed request surfaces as ``urllib.error.HTTPError``
+        504/429 (429 retries transparently like any backpressure)."""
         with self._request("POST", path, payload, content_type,
-                           no_cache=no_cache) as resp:
+                           no_cache=no_cache, deadline_ms=deadline_ms,
+                           priority=priority) as resp:
             self.last_cache_status = resp.headers.get("X-Cache")
             record = json.loads(resp.read())
         return record["TaskId"]
@@ -231,6 +259,11 @@ class AI4EClient:
                 raise TaskFailed(record)
             if "completed" in status:
                 return record
+            if "expired" in status:
+                # Admission shed the task on its deadline (terminal) —
+                # checked AFTER failed/completed, matching the platform's
+                # canonical bucketing order.
+                raise TaskExpired(record)
             if time.monotonic() >= deadline:
                 raise TaskTimeout(f"task {task_id} not terminal "
                                   f"after {timeout}s: {status!r}")
@@ -258,9 +291,25 @@ class AI4EClient:
 
     def run(self, path: str, payload: bytes,
             content_type: str = DEFAULT_CONTENT_TYPE,
-            timeout: float = 300.0) -> object | None:
-        """submit → wait → result in one call."""
-        record = self.wait(self.submit(path, payload, content_type),
+            timeout: float = 300.0,
+            priority: str | int | None = None,
+            deadline_ms: float | None = None) -> object | None:
+        """submit → wait → result in one call.
+
+        The submit carries ``X-Deadline-Ms`` derived from ``timeout`` (the
+        moment this call stops polling) unless ``deadline_ms`` overrides
+        it — so on an admission platform, server-side shedding aligns
+        exactly with the caller's give-up point: work this caller would
+        abandon anyway is dropped before it reaches the device instead of
+        executing for nobody (docs/admission.md). On the ASYNC path an
+        admission-off platform ignores the header end to end (the gateway
+        stamps nothing, the dispatcher forwards nothing), so behavior
+        there is unchanged."""
+        if deadline_ms is None:
+            deadline_ms = timeout * 1000.0
+        record = self.wait(self.submit(path, payload, content_type,
+                                       deadline_ms=deadline_ms,
+                                       priority=priority),
                            timeout=timeout)
         return self.result(record)
 
@@ -268,13 +317,22 @@ class AI4EClient:
 
     def call_sync(self, path: str, payload: bytes,
                   content_type: str = DEFAULT_CONTENT_TYPE,
-                  no_cache: bool = False) -> object:
+                  no_cache: bool = False,
+                  deadline_ms: float | None = None,
+                  priority: str | int | None = None) -> object:
         """POST a sync API; returns the parsed JSON response (raw bytes if
         the response is not JSON — keyed off the Content-Type header, same
         as ``result``, so a text body that happens to parse isn't coerced).
-        ``no_cache=True`` bypasses the result cache for this request."""
+        ``no_cache=True`` bypasses the result cache for this request.
+        ``deadline_ms``/``priority``: admission headers, as in ``submit``.
+        No deadline is sent unless the caller asks for one: the sync
+        proxy forwards ``X-Deadline-Ms`` to the worker verbatim even on
+        admission-OFF platforms (the worker honors it for direct
+        callers), so a silent default here would change answers against
+        unupgraded deployments."""
         with self._request("POST", path, payload, content_type,
-                           no_cache=no_cache) as resp:
+                           no_cache=no_cache, deadline_ms=deadline_ms,
+                           priority=priority) as resp:
             self.last_cache_status = resp.headers.get("X-Cache")
             body = resp.read()
             if resp.headers.get_content_type() == "application/json":

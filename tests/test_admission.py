@@ -1,0 +1,792 @@
+"""Admission-control tests (``ai4e_tpu/admission/``, docs/admission.md):
+deadline expiry shed at every hop (gateway edge, sync proxy, dispatcher
+pop, batcher cut, worker submit) with terminal ``expired`` status and
+``X-Shed-Reason`` provenance; priority ordering of sheds under synthetic
+overload; the gradient limiter raising under headroom and backing off
+under latency; drain-rate-derived Retry-After on the standby 503;
+graceful mid-flight ``Dispatcher.set_concurrency`` resizes; and
+``admission=False`` leaving every pre-admission behavior untouched."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.admission import (AdmissionController, DeadlineExceeded,
+                                GradientLimiter, PriorityShedder)
+from ai4e_tpu.admission.deadline import (parse_deadline_at, parse_priority,
+                                         propagation_headers)
+from ai4e_tpu.broker import Dispatcher, InMemoryBroker
+from ai4e_tpu.broker.queue import Message
+from ai4e_tpu.metrics import MetricsRegistry
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.service import LocalTaskManager
+from ai4e_tpu.taskstore import APITask, InMemoryTaskStore, TaskStatus
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+PAST = lambda: time.time() - 5.0  # noqa: E731
+FUTURE = lambda: time.time() + 60.0  # noqa: E731
+
+
+# ---------------------------------------------------------------------------
+# Vocabulary: headers, canonical status, wire shape
+# ---------------------------------------------------------------------------
+
+class TestVocabulary:
+    def test_parse_deadline_relative_anchors_at_now(self):
+        at = parse_deadline_at({"X-Deadline-Ms": "1500"}, now=1000.0)
+        assert at == 1001.5
+
+    def test_parse_deadline_absolute_wins_over_relative(self):
+        h = {"X-Deadline-At": "123.5", "X-Deadline-Ms": "999999"}
+        assert parse_deadline_at(h) == 123.5
+
+    def test_malformed_deadline_means_none(self):
+        assert parse_deadline_at({"X-Deadline-Ms": "soon"}) == 0.0
+        assert parse_deadline_at({"X-Deadline-Ms": "-5"}) == 0.0
+        assert parse_deadline_at({"X-Deadline-At": "nope"}) == 0.0
+        assert parse_deadline_at({}) == 0.0
+
+    def test_parse_priority_names_ints_garbage(self):
+        assert parse_priority({"X-Priority": "interactive"}) == 0
+        assert parse_priority({"X-Priority": "background"}) == 2
+        assert parse_priority({"X-Priority": "2"}) == 2
+        assert parse_priority({"X-Priority": "99"}) == 2  # clamped
+        assert parse_priority({"X-Priority": "???"}) == 1  # default class
+        assert parse_priority({}) == 1
+        assert parse_priority({}, default=0) == 0
+
+    def test_expired_is_a_terminal_canonical_bucket(self):
+        assert TaskStatus.EXPIRED in TaskStatus.TERMINAL
+        assert TaskStatus.canonical(
+            "expired - deadline exceeded at dispatcher") == "expired"
+        # failed/completed prose still wins its historical bucket.
+        assert TaskStatus.canonical("failed - expired thing") == "failed"
+
+    def test_task_wire_shape_round_trips_and_stays_clean_by_default(self):
+        plain = APITask(endpoint="/v1/x").to_dict()
+        assert "DeadlineAt" not in plain and "Priority" not in plain
+        d = APITask(endpoint="/v1/x", deadline_at=42.5, priority=2).to_dict()
+        back = APITask.from_dict(d)
+        assert back.deadline_at == 42.5 and back.priority == 2
+
+    def test_propagation_headers_absolute_deadline_explicit_class(self):
+        h = propagation_headers(99.5, 2)
+        assert h == {"X-Deadline-At": "99.5", "X-Priority": "2"}
+        # The default CLASS stays explicit: the worker's no-header default
+        # is interactive, so dropping it would promote the request.
+        assert propagation_headers(0.0, 1) == {"X-Priority": "1"}
+
+
+# ---------------------------------------------------------------------------
+# Adaptive limiter + shedder units
+# ---------------------------------------------------------------------------
+
+class TestGradientLimiter:
+    def test_raises_under_headroom_and_backs_off_under_latency(self):
+        lim = GradientLimiter(initial=8, min_limit=1, max_limit=64, window=4)
+        for _ in range(48):
+            lim.observe(0.01, inflight=lim.limit)
+        grown = lim.limit
+        assert grown > 8
+        for _ in range(48):
+            lim.observe(1.0, inflight=lim.limit)
+        assert lim.limit < grown
+
+    def test_littles_law_clamp_bounds_idle_growth(self):
+        lim = GradientLimiter(initial=8, min_limit=1, max_limit=512, window=4)
+        for _ in range(200):
+            lim.observe(0.01, inflight=2)  # barely-used scope
+        # Never grows far past twice the observed in-flight peak.
+        assert lim.limit <= 2 * 2 + 10
+
+    def test_bounds_respected(self):
+        lim = GradientLimiter(initial=4, min_limit=2, max_limit=6, window=2)
+        for _ in range(100):
+            lim.observe(0.001, inflight=100)
+        assert lim.limit <= 6
+        for _ in range(100):
+            lim.observe(5.0, inflight=100)
+        assert lim.limit >= 2
+
+    def test_backoff_is_immediate_multiplicative(self):
+        lim = GradientLimiter(initial=100, min_limit=1, max_limit=200)
+        assert lim.backoff()
+        assert lim.limit == 80
+
+
+class TestPriorityShedder:
+    def test_lowest_class_sheds_first(self):
+        shed = PriorityShedder()
+        capacity = 10
+        # Occupancy 7: background (threshold 6) sheds, default (8.5) and
+        # interactive (10) admit.
+        assert shed.check(2, 7, capacity) is not None
+        assert shed.check(1, 7, capacity) is None
+        assert shed.check(0, 7, capacity) is None
+        # Occupancy 9: default sheds too; interactive still admits.
+        assert shed.check(1, 9, capacity) is not None
+        assert shed.check(0, 9, capacity) is None
+        # Full: everyone sheds.
+        assert shed.check(0, 10, capacity) is not None
+
+    def test_retry_after_scales_with_drain_rate(self):
+        shed = PriorityShedder()
+        ra = shed.check(2, 26, 10, drain_rate=10.0)  # excess 21 @ 10/s
+        assert ra == pytest.approx(2.1)
+        assert shed.check(2, 26, 10, drain_rate=0.0) == 2.0  # no evidence
+
+    def test_every_class_keeps_at_least_one_slot(self):
+        shed = PriorityShedder()
+        assert shed.check(2, 0, 1) is None  # empty tiny capacity admits
+
+
+class TestControllerWiring:
+    def test_limit_changes_drive_targets(self):
+        adm = AdmissionController(metrics=MetricsRegistry(),
+                                  initial_limit=8, max_limit=64)
+        applied = []
+        adm.add_target("s", applied.append)
+        assert applied == [8]  # applied at registration, never stale
+        sc = adm.scope("s")
+        for _ in range(64):
+            sc.inflight = sc.limit
+            sc.observe(0.01)
+        sc.inflight = 0
+        assert applied[-1] > 8
+
+    def test_goodput_and_drain_from_store_feed(self):
+        reg = MetricsRegistry()
+        adm = AdmissionController(metrics=reg)
+        store = InMemoryTaskStore()
+        adm.attach_store(store)
+        good = store.upsert(APITask(endpoint="/v1/x", deadline_at=FUTURE()))
+        store.update_status(good.task_id, "completed", "completed")
+        late = store.upsert(APITask(endpoint="/v1/x", deadline_at=PAST()))
+        store.update_status(late.task_id, "completed", "completed")
+        exp = store.upsert(APITask(endpoint="/v1/x", deadline_at=PAST()))
+        store.update_status(exp.task_id, "expired - deadline exceeded at "
+                            "dispatcher", TaskStatus.EXPIRED)
+        counter = reg.counter("ai4e_admission_goodput_total", "")
+        assert counter.value(outcome="in_deadline") == 1
+        assert counter.value(outcome="late") == 1
+        assert adm.drain_rate() > 0  # three terminal transitions
+
+    def test_retry_after_clamps_and_cold_fallback(self):
+        adm = AdmissionController(metrics=MetricsRegistry())
+        assert adm.retry_after_s() == 2.0  # cold: historical constant
+        for _ in range(500):
+            adm.on_drain_event()
+        assert adm.retry_after_s() == 1.0  # hot store drains fast
+
+
+# ---------------------------------------------------------------------------
+# Gateway hops (async edge + sync proxy)
+# ---------------------------------------------------------------------------
+
+def _admission_platform(**kw):
+    cfg = dict(admission=True, retry_delay=0.05)
+    cfg.update(kw)
+    return LocalPlatform(PlatformConfig(**cfg), metrics=MetricsRegistry())
+
+
+class TestGatewayAsyncEdge:
+    def test_expired_request_answers_504_before_any_task_exists(self):
+        async def main():
+            platform = _admission_platform()
+            platform.publish_async_api("/v1/pub/x",
+                                       "http://127.0.0.1:9/v1/be/x")
+            gw = await serve(platform.gateway.app)
+            try:
+                resp = await gw.post(
+                    "/v1/pub/x", data=b"p",
+                    headers={"X-Deadline-At": str(PAST())})
+                assert resp.status == 504
+                assert resp.headers["X-Shed-Reason"] == "deadline at gateway"
+                assert len(list(platform.store.snapshot())) == 0
+                expired = platform.metrics.counter(
+                    "ai4e_admission_expired_total", "")
+                assert expired.value(hop="gateway", priority="default") == 1
+            finally:
+                await gw.close()
+
+        run(main())
+
+    def test_admitted_request_stamps_deadline_and_priority(self):
+        async def main():
+            platform = _admission_platform()
+            platform.publish_async_api("/v1/pub/x",
+                                       "http://127.0.0.1:9/v1/be/x")
+            gw = await serve(platform.gateway.app)
+            try:
+                before = time.time()
+                resp = await gw.post(
+                    "/v1/pub/x", data=b"p",
+                    headers={"X-Deadline-Ms": "60000",
+                             "X-Priority": "background"})
+                assert resp.status == 200
+                record = await resp.json()
+                task = platform.store.get(record["TaskId"])
+                assert task.priority == 2
+                assert task.deadline_at >= before + 59
+                # The broker message carries the same admission state.
+                q = platform.broker.queue("/v1/be/x")
+                msg = await q.receive(timeout=1.0)
+                assert msg.deadline_at == task.deadline_at
+                assert msg.priority == 2
+            finally:
+                await gw.close()
+
+        run(main())
+
+    def test_backlog_sheds_lowest_priority_first_with_provenance(self):
+        async def main():
+            platform = _admission_platform(admission_max_backlog=10)
+            platform.publish_async_api("/v1/pub/x",
+                                       "http://127.0.0.1:9/v1/be/x")
+            # Synthetic overload: 8 created tasks already queued for the
+            # route (created-set depth is the edge's backlog signal).
+            for _ in range(8):
+                platform.store.upsert(APITask(endpoint="/v1/be/x",
+                                              body=b"q"))
+            gw = await serve(platform.gateway.app)
+            try:
+                shed = await gw.post("/v1/pub/x", data=b"p",
+                                     headers={"X-Priority": "background"})
+                assert shed.status == 429
+                assert shed.headers["X-Shed-Reason"] == "pressure at gateway"
+                assert int(shed.headers["Retry-After"]) >= 1
+                ok = await gw.post("/v1/pub/x", data=b"p",
+                                   headers={"X-Priority": "default"})
+                assert ok.status == 200
+                top = await gw.post("/v1/pub/x", data=b"p",
+                                    headers={"X-Priority": "interactive"})
+                assert top.status == 200
+                shed_total = platform.metrics.counter(
+                    "ai4e_admission_shed_total", "")
+                assert shed_total.value(hop="gateway",
+                                        priority="background") == 1
+            finally:
+                await gw.close()
+
+        run(main())
+
+
+class TestGatewaySyncProxy:
+    async def _echo_backend(self, seen):
+        async def handler(request):
+            seen.append(dict(request.headers))
+            return web.json_response({"ok": True})
+
+        app = web.Application()
+        app.router.add_post("/v1/be/echo", handler)
+        return await serve(app)
+
+    def test_deadline_504_cap_shed_ordering_and_propagation(self):
+        async def main():
+            seen = []
+            be = await self._echo_backend(seen)
+            platform = _admission_platform()
+            platform.publish_sync_api(
+                "/v1/pub/echo", str(be.make_url("/v1/be/echo")))
+            gw = await serve(platform.gateway.app)
+            try:
+                # Expired → 504, backend untouched.
+                resp = await gw.post("/v1/pub/echo", data=b"p",
+                                     headers={"X-Deadline-At": str(PAST())})
+                assert resp.status == 504
+                assert resp.headers["X-Shed-Reason"] == \
+                    "deadline at gateway_sync"
+                assert seen == []
+
+                # Admitted → proxied with the ABSOLUTE deadline attached
+                # (the relative header is stripped).
+                resp = await gw.post("/v1/pub/echo", data=b"p",
+                                     headers={"X-Deadline-Ms": "60000"})
+                assert resp.status == 200
+                assert "X-Deadline-At" in seen[0]
+                assert "X-Deadline-Ms" not in seen[0]
+
+                # Synthetic occupancy at 70% of the limit: background
+                # sheds (60% share), interactive still admits.
+                sc = platform.admission.scope("gateway_sync")
+                sc.inflight = max(1, int(sc.limit * 0.7))
+                resp = await gw.post("/v1/pub/echo", data=b"p",
+                                     headers={"X-Priority": "background"})
+                assert resp.status == 503
+                assert resp.headers["X-Shed-Reason"] == \
+                    "pressure at gateway_sync"
+                assert int(resp.headers["Retry-After"]) >= 1
+                resp = await gw.post("/v1/pub/echo", data=b"p",
+                                     headers={"X-Priority": "interactive"})
+                assert resp.status == 200
+            finally:
+                await gw.close()
+                await be.close()
+
+        run(main())
+
+
+class TestStandbyRetryAfter:
+    class _StandbyStore(InMemoryTaskStore):
+        def upsert(self, task):
+            from ai4e_tpu.taskstore import NotPrimaryError
+            raise NotPrimaryError("standby")
+
+    def _gateway(self, admission=None):
+        from ai4e_tpu.gateway import Gateway
+        gw = Gateway(self._StandbyStore(), metrics=MetricsRegistry())
+        if admission is not None:
+            gw.set_admission(admission)
+        gw.add_async_route("/v1/pub/x", "http://127.0.0.1:9/v1/be/x")
+        return gw
+
+    def test_constant_without_admission_drain_rate_with(self):
+        async def main():
+            plain = await serve(self._gateway().app)
+            adm = AdmissionController(metrics=MetricsRegistry())
+            for _ in range(500):
+                adm.on_drain_event()  # hot drain: ~50 evt/s → 1 s hint
+            hot = await serve(self._gateway(admission=adm).app)
+            try:
+                resp = await plain.post("/v1/pub/x", data=b"p")
+                assert resp.status == 503
+                assert resp.headers["Retry-After"] == "2"
+                assert resp.headers["X-Not-Primary"] == "1"
+                resp = await hot.post("/v1/pub/x", data=b"p")
+                assert resp.status == 503
+                assert resp.headers["Retry-After"] == "1"  # computed
+                assert resp.headers["X-Not-Primary"] == "1"
+            finally:
+                await plain.close()
+                await hot.close()
+
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher hop
+# ---------------------------------------------------------------------------
+
+class TestDispatcherHop:
+    def test_expired_message_never_reaches_the_backend(self):
+        async def main():
+            store = InMemoryTaskStore()
+            broker = InMemoryBroker()
+            adm = AdmissionController(metrics=MetricsRegistry())
+            # Dead backend port: a POST attempt would surface as
+            # backpressure/retry, not the instant terminal expiry below.
+            d = Dispatcher(broker, "/v1/be/x", "http://127.0.0.1:9/v1/be/x",
+                           LocalTaskManager(store), retry_delay=0.01,
+                           admission=adm)
+            task = store.upsert(APITask(endpoint="/v1/be/x", body=b"p",
+                                        deadline_at=PAST(), priority=2))
+            broker.queue("/v1/be/x").put(Message(
+                task_id=task.task_id, endpoint="/v1/be/x", body=b"p", seq=1,
+                queue_name="/v1/be/x", deadline_at=task.deadline_at,
+                priority=2))
+            msg = await broker.receive("/v1/be/x", timeout=1.0)
+            await d._dispatch_one(msg)
+            stored = store.get(task.task_id)
+            assert stored.canonical_status == "expired"
+            assert "dispatcher" in stored.status
+            q = broker.queue("/v1/be/x")
+            assert len(q) == 0 and q.in_flight == 0  # completed, not leaked
+            assert d.metrics.counter("ai4e_dispatch_total", "").value(
+                outcome="expired", queue="/v1/be/x", backend="") >= 1
+            assert adm.metrics.counter(
+                "ai4e_admission_expired_total", "").value(
+                    hop="dispatcher", priority="background") == 1
+
+        run(main())
+
+    def test_live_message_carries_deadline_and_priority_headers(self):
+        async def main():
+            seen = []
+
+            async def handler(request):
+                seen.append(dict(request.headers))
+                return web.Response(text="ok")
+
+            app = web.Application()
+            app.router.add_post("/v1/be/x", handler)
+            be = await serve(app)
+            store = InMemoryTaskStore()
+            broker = InMemoryBroker()
+            d = Dispatcher(broker, "/v1/be/x",
+                           str(be.make_url("/v1/be/x")),
+                           LocalTaskManager(store), retry_delay=0.01)
+            deadline = FUTURE()
+            broker.queue("/v1/be/x").put(Message(
+                task_id="t1", endpoint="/v1/be/x", body=b"p", seq=1,
+                queue_name="/v1/be/x", deadline_at=deadline, priority=2))
+            msg = await broker.receive("/v1/be/x", timeout=1.0)
+            await d._dispatch_one(msg)
+            await d._sessions.close()
+            assert seen and seen[0]["X-Deadline-At"] == repr(deadline)
+            assert seen[0]["X-Priority"] == "2"
+            await be.close()
+
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# Batcher + worker hops
+# ---------------------------------------------------------------------------
+
+def _double_servable():
+    import jax.numpy as jnp
+
+    from ai4e_tpu.runtime import ServableModel
+    return ServableModel(
+        name="double",
+        apply_fn=lambda params, batch: batch * params["scale"],
+        params={"scale": jnp.asarray(2.0)},
+        input_shape=(4,),
+        preprocess=lambda body, ct: np.frombuffer(body, np.float32),
+        postprocess=lambda out: {"sum": float(np.asarray(out).sum())},
+        batch_buckets=(1, 2, 4),
+    )
+
+
+class TestBatcherHop:
+    def test_expired_entry_dropped_at_cut_live_entry_executes(self):
+        async def main():
+            from ai4e_tpu.runtime import MicroBatcher, ModelRuntime
+            reg = MetricsRegistry()
+            runtime = ModelRuntime()
+            runtime.register(_double_servable())
+            batcher = MicroBatcher(runtime, max_wait_ms=1.0, metrics=reg)
+            await batcher.start()
+            try:
+                x = np.ones(4, np.float32)
+                dead = asyncio.ensure_future(
+                    batcher.submit("double", x, deadline_at=PAST()))
+                live = asyncio.ensure_future(
+                    batcher.submit("double", x, deadline_at=FUTURE()))
+                with pytest.raises(DeadlineExceeded):
+                    await dead
+                assert (await live)["sum"] == pytest.approx(8.0)
+                counter = reg.counter("ai4e_admission_expired_total", "")
+                assert counter.value(hop="batcher",
+                                     priority="interactive") == 1
+            finally:
+                await batcher.stop()
+
+        run(main())
+
+
+class TestWorkerHop:
+    def test_expired_async_task_transitions_terminal_without_batching(self):
+        async def main():
+            from ai4e_tpu.runtime import (InferenceWorker, MicroBatcher,
+                                          ModelRuntime)
+            reg = MetricsRegistry()
+            store = InMemoryTaskStore()
+            runtime = ModelRuntime()
+            servable = runtime.register(_double_servable())
+            batcher = MicroBatcher(runtime, metrics=reg)
+            worker = InferenceWorker("w", runtime, batcher,
+                                     task_manager=LocalTaskManager(store),
+                                     prefix="v1", store=store, metrics=reg)
+            worker.serve_model(servable)
+            task = store.upsert(APITask(endpoint="/v1/double-async"))
+            wc = await serve(worker.service.app)
+            try:
+                payload = np.ones(4, np.float32).tobytes()
+                resp = await wc.post(
+                    "/v1/double-async", data=payload,
+                    headers={"taskId": task.task_id,
+                             "X-Deadline-At": str(PAST()),
+                             "X-Priority": "2"})
+                assert resp.status == 200  # task adopted, answer immediate
+                for _ in range(200):
+                    if store.get(task.task_id).canonical_status == "expired":
+                        break
+                    await asyncio.sleep(0.01)
+                stored = store.get(task.task_id)
+                assert stored.canonical_status == "expired"
+                assert "worker" in stored.status
+                assert batcher.pending_count == 0  # never entered the queue
+                assert reg.counter("ai4e_admission_expired_total", "").value(
+                    hop="worker", priority="background") == 1
+            finally:
+                await wc.close()
+
+        run(main())
+
+    def test_expired_sync_request_answers_504(self):
+        async def main():
+            from ai4e_tpu.runtime import (InferenceWorker, MicroBatcher,
+                                          ModelRuntime)
+            runtime = ModelRuntime()
+            servable = runtime.register(_double_servable())
+            batcher = MicroBatcher(runtime, metrics=MetricsRegistry())
+            worker = InferenceWorker("w", runtime, batcher, prefix="v1",
+                                     metrics=MetricsRegistry())
+            worker.serve_model(servable)
+            wc = await serve(worker.service.app)
+            try:
+                resp = await wc.post(
+                    "/v1/double", data=np.ones(4, np.float32).tobytes(),
+                    headers={"X-Deadline-At": str(PAST())})
+                assert resp.status == 504
+                assert resp.headers["X-Shed-Reason"] == "deadline at worker"
+            finally:
+                await wc.close()
+
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: expiry mid-queue through the full platform
+# ---------------------------------------------------------------------------
+
+class TestEndToEnd:
+    def test_task_expiring_in_the_broker_is_shed_not_executed(self):
+        async def main():
+            platform = _admission_platform()
+            executed = []
+            svc = platform.make_service("slow", prefix="v1/slow")
+
+            @svc.api_async_func("/work")
+            async def work(taskId, body, content_type, **kw):
+                executed.append(taskId)
+                await platform.task_manager.complete_task(taskId, "completed")
+
+            svc_client = await serve(svc.app)
+            platform.publish_async_api(
+                "/v1/pub/work", str(svc_client.make_url("/v1/slow/work")))
+            gw = await serve(platform.gateway.app)
+            try:
+                # Create the task with a short budget BEFORE transport
+                # starts: by the time the dispatcher pops it, it is dead.
+                resp = await gw.post("/v1/pub/work", data=b"p",
+                                     headers={"X-Deadline-Ms": "120"})
+                assert resp.status == 200
+                tid = (await resp.json())["TaskId"]
+                await asyncio.sleep(0.25)
+                await platform.start()
+                for _ in range(300):
+                    if (platform.store.get(tid).canonical_status
+                            in TaskStatus.TERMINAL):
+                        break
+                    await asyncio.sleep(0.01)
+                stored = platform.store.get(tid)
+                assert stored.canonical_status == "expired"
+                assert executed == []  # the backend never saw it
+                # Long-poll waiters wake on the expired transition.
+                resp = await gw.get(f"/v1/taskmanagement/task/{tid}",
+                                    params={"wait": "5"})
+                assert "expired" in (await resp.json())["Status"]
+            finally:
+                await platform.stop()
+                await gw.close()
+                await svc_client.close()
+
+        run(main())
+
+    def test_admission_off_leaves_everything_untouched(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05),
+                                     metrics=MetricsRegistry())
+            platform.publish_async_api("/v1/pub/x",
+                                       "http://127.0.0.1:9/v1/be/x")
+            gw = await serve(platform.gateway.app)
+            try:
+                # A long-dead deadline header is IGNORED: task created,
+                # nothing stamped, nothing shed.
+                resp = await gw.post(
+                    "/v1/pub/x", data=b"p",
+                    headers={"X-Deadline-At": str(PAST()),
+                             "X-Priority": "background"})
+                assert resp.status == 200
+                record = await resp.json()
+                task = platform.store.get(record["TaskId"])
+                assert task.deadline_at == 0.0
+                assert task.priority == 1
+                assert "DeadlineAt" not in task.to_dict()
+                msg = await platform.broker.queue("/v1/be/x").receive(
+                    timeout=1.0)
+                assert msg.deadline_at == 0.0 and msg.priority == 1
+                assert platform.admission is None
+                assert platform.gateway._admission is None
+            finally:
+                await gw.close()
+
+        run(main())
+
+    def test_admission_requires_python_fabric(self):
+        with pytest.raises(ValueError, match="native"):
+            LocalPlatform(PlatformConfig(admission=True, native_store=True))
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher.set_concurrency mid-flight (satellite)
+# ---------------------------------------------------------------------------
+
+class TestSetConcurrencyResize:
+    def test_shrink_and_grow_while_busy_loses_and_duplicates_nothing(self):
+        async def main():
+            gate = asyncio.Event()
+            hits: dict[str, int] = {}
+
+            async def handler(request):
+                tid = request.headers["taskId"]
+                hits[tid] = hits.get(tid, 0) + 1
+                await gate.wait()
+                return web.Response(text="ok")
+
+            app = web.Application()
+            app.router.add_post("/v1/be/x", handler)
+            be = await serve(app)
+            store = InMemoryTaskStore()
+            broker = InMemoryBroker()
+            broker.bind_loop(asyncio.get_running_loop())
+            d = Dispatcher(broker, "/v1/be/x", str(be.make_url("/v1/be/x")),
+                           LocalTaskManager(store), retry_delay=0.01,
+                           concurrency=3)
+            for i in range(6):
+                broker.publish(APITask(task_id=f"t{i}", endpoint="/v1/be/x",
+                                       body=b"p"))
+            await d.start()
+            try:
+                # Wait until all 3 loops are mid-POST (blocked on the gate).
+                for _ in range(300):
+                    if len(hits) == 3:
+                        break
+                    await asyncio.sleep(0.01)
+                assert len(hits) == 3
+
+                # SHRINK while busy: in-flight deliveries must complete —
+                # not be cancelled into redeliveries.
+                d.set_concurrency(1)
+                gate.set()
+                for _ in range(500):
+                    if len(hits) == 6:
+                        break
+                    await asyncio.sleep(0.01)
+                assert len(hits) == 6  # nothing lost
+                assert set(hits.values()) == {1}  # nothing double-dispatched
+                # The surplus loops retired at their idle point.
+                for _ in range(300):
+                    live = [w for w in d._workers if not w.done()]
+                    if len(live) == 1:
+                        break
+                    await asyncio.sleep(0.01)
+                assert len([w for w in d._workers if not w.done()]) == 1
+
+                # GROW again: fresh loops pick up new work immediately.
+                d.set_concurrency(4)
+                assert len([w for w in d._workers if not w.done()]) == 4
+                for i in range(6, 10):
+                    broker.publish(APITask(task_id=f"t{i}",
+                                           endpoint="/v1/be/x", body=b"p"))
+                # Drained = broker empty AND no lease outstanding (a hit is
+                # counted at handler entry, before the dispatcher completes
+                # the message — polling on hits alone would race the last
+                # complete()).
+                q = broker.queue("/v1/be/x")
+                for _ in range(500):
+                    if len(hits) == 10 and len(q) == 0 and q.in_flight == 0:
+                        break
+                    await asyncio.sleep(0.01)
+                assert len(hits) == 10
+                assert set(hits.values()) == {1}
+                assert len(q) == 0 and q.in_flight == 0
+                assert q.dead_letters == []
+            finally:
+                await d.stop()
+                await be.close()
+
+        run(main())
+
+    def test_resize_before_start_only_records_the_level(self):
+        store = InMemoryTaskStore()
+        d = Dispatcher(InMemoryBroker(), "/q", "http://127.0.0.1:9/q",
+                       LocalTaskManager(store), concurrency=2)
+        d.set_concurrency(7)  # no loop yet — must not try to spawn
+        assert d.concurrency == 7
+        assert d._workers == []
+
+    def test_shrink_to_zero_then_grow(self):
+        async def main():
+            store = InMemoryTaskStore()
+            broker = InMemoryBroker()
+            broker.bind_loop(asyncio.get_running_loop())
+            d = Dispatcher(broker, "/q", "http://127.0.0.1:9/q",
+                           LocalTaskManager(store), concurrency=2)
+            await d.start()
+            try:
+                d.set_concurrency(0)
+                for _ in range(300):
+                    if not [w for w in d._workers if not w.done()]:
+                        break
+                    await asyncio.sleep(0.01)
+                assert not [w for w in d._workers if not w.done()]
+                d.set_concurrency(3)
+                assert len([w for w in d._workers if not w.done()]) == 3
+            finally:
+                await d.stop()
+
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# Python client (satellite): deadline derivation + TaskExpired
+# ---------------------------------------------------------------------------
+
+class TestClientSatellite:
+    def test_run_derives_deadline_from_timeout_and_wait_raises_expired(self):
+        import importlib.util
+        import os
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "ai4e_client",
+            os.path.join(repo, "clients", "python", "ai4e_client.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        AI4EClient, TaskExpired, TaskFailed = (
+            mod.AI4EClient, mod.TaskExpired, mod.TaskFailed)
+
+        async def main():
+            platform = _admission_platform()
+            platform.publish_async_api("/v1/pub/x",
+                                       "http://127.0.0.1:9/v1/be/x")
+            gw = await serve(platform.gateway.app)
+            base = str(gw.make_url("/")).rstrip("/")
+            try:
+                client = AI4EClient(base, retries=0)
+                before = time.time()
+                tid = await asyncio.to_thread(
+                    client.submit, "/v1/pub/x", b"p",
+                    deadline_ms=45000, priority="background")
+                task = platform.store.get(tid)
+                assert task.priority == 2
+                assert task.deadline_at == pytest.approx(before + 45.0,
+                                                         abs=5.0)
+                # Platform sheds the task → wait() surfaces TaskExpired
+                # (a TaskFailed subclass, so existing handlers still catch).
+                platform.store.update_status(
+                    tid, "expired - deadline exceeded at dispatcher",
+                    TaskStatus.EXPIRED)
+                with pytest.raises(TaskExpired):
+                    await asyncio.to_thread(client.wait, tid, 5.0, 1.0)
+                assert issubclass(TaskExpired, TaskFailed)
+            finally:
+                await gw.close()
+
+        run(main())
