@@ -84,6 +84,7 @@ class Dispatcher:
         result_cache=None,
         result_store=None,
         admission=None,
+        resilience=None,
     ):
         self.broker = broker
         self.queue_name = queue_name
@@ -105,6 +106,17 @@ class Dispatcher:
         # carrying deadline_at is honored (only an admission-enabled
         # gateway stamps one).
         self.admission = admission
+        # Shared per-backend health model (resilience/): breaker-aware
+        # backend picks (open backends ejected, their weight redistributed),
+        # bounded in-delivery retries with failover to a DIFFERENT backend
+        # on connection error, and 5xx-as-transient redelivery. None (the
+        # default) keeps the pre-resilience delivery SEMANTICS: one
+        # attempt, 5xx→permanent fail, unreachable→redeliver. (Redelivery
+        # PACING is jittered-exponential either way — _redelivery_delay;
+        # retry_delay is its base/first step, no longer a constant.)
+        self.resilience = resilience
+        self._retry_budget = (resilience.new_budget()
+                              if resilience is not None else None)
         self.backends = normalize_backends(backend_uri)
         # The primary (first) backend — what single-backend consumers and
         # introspection read; weighted picks use the full set.
@@ -234,13 +246,47 @@ class Dispatcher:
             finally:
                 self._busy -= 1
 
-    def _target_for(self, msg: Message) -> str:
+    def _target_for(self, msg: Message,
+                    exclude: tuple | list = ()) -> tuple[str, str]:
         """Dispatch target: a *registered* backend URI (fresh host — a
         journal-restored task may carry a stale one; weighted pick across a
-        canary set) with the task endpoint's operation tail and query
-        grafted on (``rebase_endpoint``)."""
-        base = pick_backend(self.backends, self._rng)
-        return rebase_endpoint(msg.endpoint, self.queue_name, base)
+        canary set, health-aware under resilience) with the task endpoint's
+        operation tail and query grafted on (``rebase_endpoint``). Returns
+        ``(base, target)`` — the base is the health-model key for outcome
+        recording."""
+        if self.resilience is not None:
+            base = self.resilience.pick(self.backends, self._rng,
+                                        exclude=exclude)
+        else:
+            base = pick_backend(self.backends, self._rng)
+        return base, rebase_endpoint(msg.endpoint, self.queue_name, base)
+
+    def _record_outcome(self, base: str, status: int | None = None,
+                        failed: bool = False) -> None:
+        """Feed one delivery outcome to the shared health model. A breaker
+        that OPENS here also backs off the admission limiter: explicit
+        evidence that a backend died outranks the latency samples the
+        gradient limiter would otherwise need a whole window to believe."""
+        if self.resilience is None:
+            return
+        opened = (self.resilience.record_failure(base) if failed
+                  else self.resilience.observe_status(base, status))
+        if opened and self.admission is not None:
+            self.admission.scope("dispatch:" + self.queue_name).backoff()
+
+    def _can_retry(self, attempt: int) -> bool:
+        """In-delivery retry gate: attempts remaining AND retry budget —
+        past either, the message falls back to broker redelivery, whose
+        patience (max_delivery_count) bounds the total."""
+        return (self.resilience is not None
+                and attempt < self.resilience.policy.max_attempts
+                and self._retry_budget.try_retry())
+
+    async def _retry_sleep(self, attempt: int) -> None:
+        from ..resilience.retry import backoff_s
+        policy = self.resilience.policy
+        await asyncio.sleep(backoff_s(attempt, policy.retry_base_s,
+                                      policy.retry_cap_s, self._rng))
 
     async def _dispatch_one(self, msg: Message) -> None:
         import time as _time
@@ -249,66 +295,116 @@ class Dispatcher:
         from ..observability import get_tracer
         if await self._drop_expired(msg):
             return
+        if self.resilience is not None and await self._suppress_duplicate(msg):
+            return
         if await self._complete_from_cache(msg):
             return
-        target = self._target_for(msg)
-        # Per-backend outcome label: the canary loop is "watch the canary's
-        # error rate, then promote" — without the host dimension a canary's
-        # failures would vanish into the fleet's counter.
-        backend = urlparse(target).netloc
-        session = await self._sessions.get()
+        if self._retry_budget is not None:
+            self._retry_budget.on_request()
         tracer = get_tracer()
-        t0 = _time.perf_counter()
-        try:
-            # One span per delivery attempt, keyed by TaskId; the injected
-            # x-b3 headers parent the backend's endpoint span to this one,
-            # so a task's dispatch → execution is a single trace.
-            with tracer.span("dispatch", task_id=msg.task_id,
-                             queue=self.queue_name,
-                             attempt=msg.delivery_count) as span:
-                headers = {"taskId": msg.task_id,
-                           "Content-Type": msg.content_type,
-                           **self._admission_headers(msg),
-                           **tracer.headers()}
-                async with session.post(
-                    target, data=msg.body, headers=headers,
-                ) as resp:
-                    status = resp.status
-                    await resp.read()
-                span.attrs["http_status"] = status
-                if not (200 <= status < 300 or status in BACKPRESSURE_CODES):
-                    span.status = "error"
-                    span.error = f"backend returned {status}"
-        except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
-            # Backend unreachable — treat like saturation: the pod may be
-            # restarting; broker patience (max deliveries) bounds total retry.
-            log.warning("backend %s unreachable (%s); will redeliver",
-                        target, exc)
-            await self._backpressure(msg, backend=backend)
-            return
+        tried: list[str] = []
+        attempt = 0
+        while True:
+            attempt += 1
+            base, target = self._target_for(msg, exclude=tried)
+            # Per-backend outcome label: the canary loop is "watch the
+            # canary's error rate, then promote" — without the host
+            # dimension a canary's failures would vanish into the fleet's
+            # counter.
+            backend = urlparse(target).netloc
+            session = await self._sessions.get()
+            t0 = _time.perf_counter()
+            try:
+                # One span per delivery attempt, keyed by TaskId; the
+                # injected x-b3 headers parent the backend's endpoint span
+                # to this one, so a task's dispatch → execution is a single
+                # trace.
+                with tracer.span("dispatch", task_id=msg.task_id,
+                                 queue=self.queue_name,
+                                 attempt=msg.delivery_count) as span:
+                    headers = {"taskId": msg.task_id,
+                               "Content-Type": msg.content_type,
+                               **self._admission_headers(msg),
+                               **tracer.headers()}
+                    async with session.post(
+                        target, data=msg.body, headers=headers,
+                    ) as resp:
+                        status = resp.status
+                        await resp.read()
+                    span.attrs["http_status"] = status
+                    if not (200 <= status < 300
+                            or status in BACKPRESSURE_CODES):
+                        span.status = "error"
+                        span.error = f"backend returned {status}"
+            except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+                self._record_outcome(base, failed=True)
+                if (self.resilience is not None
+                        and await self._suppress_duplicate(msg)):
+                    # Lost-response window INSIDE the attempt loop: a
+                    # timeout/disconnect can follow an execution that
+                    # already completed the task (the redelivery path
+                    # re-checks this at pop time; an in-delivery retry
+                    # must too, or it re-executes against a worker whose
+                    # completion write is unconditional).
+                    return
+                if self._can_retry(attempt):
+                    # Failover: the next pick excludes this backend, so a
+                    # multi-backend set retries on a DIFFERENT host (a
+                    # single-backend set retries in place after the
+                    # jittered backoff — the pod may be restarting).
+                    tried.append(base)
+                    self.resilience.note_failover("dispatcher")
+                    await self._retry_sleep(attempt)
+                    continue
+                # Backend unreachable — treat like saturation: the pod may
+                # be restarting; broker patience (max deliveries) bounds
+                # total retry.
+                log.warning("backend %s unreachable (%s); will redeliver",
+                            target, exc)
+                await self._backpressure(msg, backend=backend)
+                return
 
-        if 200 <= status < 300:
-            self.broker.complete(msg)
-            self._dispatched.inc(outcome="delivered", queue=self.queue_name,
-                                 backend=backend)
-            if self.admission is not None:
-                # Delivered-POST RTT feeds the per-queue limiter: when the
-                # worker's event loop congests, these round trips stretch
-                # and the controller narrows this dispatcher's fan-out
-                # BEFORE the worker has to start 503ing. ``_busy`` (loops
-                # actually mid-delivery) is the in-flight figure the
-                # Little's-law clamp needs — an underused queue's limit
-                # then tracks ~2× its real concurrency instead of
-                # ratcheting to the ceiling.
-                self.admission.scope("dispatch:" + self.queue_name).observe(
-                    _time.perf_counter() - t0, inflight=self._busy)
-        elif status in BACKPRESSURE_CODES:
-            if self.admission is not None:
-                # Explicit saturation outranks latency evidence: shrink the
-                # fan-out multiplicatively right now, don't wait a window.
-                self.admission.scope("dispatch:" + self.queue_name).backoff()
-            await self._backpressure(msg, backend=backend)
-        else:
+            self._record_outcome(base, status=status)
+            if 200 <= status < 300:
+                self.broker.complete(msg)
+                self._dispatched.inc(outcome="delivered",
+                                     queue=self.queue_name, backend=backend)
+                if self.admission is not None:
+                    # Delivered-POST RTT feeds the per-queue limiter: when
+                    # the worker's event loop congests, these round trips
+                    # stretch and the controller narrows this dispatcher's
+                    # fan-out BEFORE the worker has to start 503ing.
+                    # ``_busy`` (loops actually mid-delivery) is the
+                    # in-flight figure the Little's-law clamp needs — an
+                    # underused queue's limit then tracks ~2× its real
+                    # concurrency instead of ratcheting to the ceiling.
+                    self.admission.scope(
+                        "dispatch:" + self.queue_name).observe(
+                        _time.perf_counter() - t0, inflight=self._busy)
+                return
+            if status in BACKPRESSURE_CODES:
+                if self.admission is not None:
+                    # Explicit saturation outranks latency evidence: shrink
+                    # the fan-out multiplicatively right now, don't wait a
+                    # window.
+                    self.admission.scope(
+                        "dispatch:" + self.queue_name).backoff()
+                await self._backpressure(msg, backend=backend)
+                return
+            if self.resilience is not None and status >= 500:
+                # Transient-class server error under resilience: retry
+                # (budget-bounded, different backend when one exists), then
+                # fall back to redelivery — the broker's delivery budget
+                # bounds the total, and dead-letter still terminates the
+                # task. 4xx stays permanent: the backend is healthy, the
+                # request is not.
+                if self._can_retry(attempt):
+                    tried.append(base)
+                    self.resilience.note_retry("dispatcher")
+                    await self._retry_sleep(attempt)
+                    continue
+                await self._backpressure(msg, backend=backend)
+                return
             # Permanent failure: complete (no redelivery) + fail the task
             # (BackendQueueProcessor.cs:65-70).
             self.broker.complete(msg)
@@ -319,6 +415,7 @@ class Dispatcher:
                 f"failed - backend returned {status}",
                 TaskStatus.FAILED,
             )
+            return
 
     def _admission_headers(self, msg: Message) -> dict:
         """Deadline/priority propagation onto the backend POST — the worker
@@ -397,11 +494,56 @@ class Dispatcher:
                                TaskStatus.COMPLETED)
         return True
 
+    async def _suppress_duplicate(self, msg: Message) -> bool:
+        """Resilience-mode redelivery suppression: a message whose task is
+        ALREADY terminal (lease-expiry redelivery racing a completion, a
+        duplicated publish, a delivery whose response was lost after the
+        backend finished) is completed off the broker without re-POSTing —
+        the backend must not execute, and the client must not see a second
+        completion overwrite the one it may already have read. Closes the
+        common duplicate window; a backend completing tasks should still do
+        so conditionally (``update_status_if``) for the residual race where
+        the duplicate pops mid-execution (docs/resilience.md)."""
+        try:
+            record = await self.task_manager.get_task_status(msg.task_id)
+        except Exception:  # noqa: BLE001 — a status probe must never block dispatch
+            return False
+        if not record:
+            return False
+        if TaskStatus.canonical(record.get("Status", "")) in TaskStatus.TERMINAL:
+            self.broker.complete(msg)
+            self._dispatched.inc(outcome="duplicate", queue=self.queue_name,
+                                 backend="")
+            return True
+        return False
+
+    def _redelivery_delay(self, msg: Message) -> float:
+        """Backoff before handing a message back for redelivery: jittered
+        exponential from the message's own ``delivery_count`` (base =
+        ``retry_delay``, the reference's constant — now the first step),
+        capped at half the lease so a retry can never outlive its own
+        lease and hand the reaper a double delivery. Same half-jitter
+        schedule as the in-delivery retries (``resilience.retry``)."""
+        from ..resilience.retry import backoff_s
+        lease = float(getattr(self.broker, "lease_seconds", 300.0) or 300.0)
+        return backoff_s(msg.delivery_count, self.retry_delay, lease / 2.0,
+                         self._rng)
+
     async def _backpressure(self, msg: Message, backend: str) -> None:
+        if self.resilience is not None and await self._suppress_duplicate(msg):
+            # The task turned TERMINAL between dispatch and this redelivery
+            # decision — the classic lost-response window: the backend
+            # executed and completed the task, then the response (or a
+            # retry) failed. The unconditional AWAITING write below would
+            # clobber that completed status back to created, and the
+            # redelivery would then complete the task a SECOND time — the
+            # exact duplicate-visible-completion the chaos invariants
+            # reject. Complete the message instead; the work is done.
+            return
         self._dispatched.inc(outcome="backpressure", queue=self.queue_name,
                              backend=backend)
         await self._try_update(msg.task_id, AWAITING_STATUS, TaskStatus.CREATED)
-        await asyncio.sleep(self.retry_delay)
+        await asyncio.sleep(self._redelivery_delay(msg))
         if not self.broker.abandon(msg):
             # Dead-lettered: out of delivery budget — the backend that was
             # just attempted is the one whose failures spent it; a canary
@@ -428,7 +570,8 @@ class DispatcherPool:
 
     def __init__(self, broker: InMemoryBroker, task_manager: TaskManagerBase,
                  retry_delay: float = 60.0, concurrency: int = 1,
-                 result_cache=None, result_store=None, admission=None):
+                 result_cache=None, result_store=None, admission=None,
+                 resilience=None, metrics: MetricsRegistry | None = None):
         self.broker = broker
         self.task_manager = task_manager
         self.retry_delay = retry_delay
@@ -436,6 +579,12 @@ class DispatcherPool:
         self.result_cache = result_cache
         self.result_store = result_store
         self.admission = admission
+        self.resilience = resilience
+        # Registry the registered dispatchers count into — the assembly's
+        # own, so a custom-registry platform's /metrics carries
+        # ai4e_dispatch_total instead of it silently landing in the
+        # process-default registry.
+        self.metrics = metrics
         self.dispatchers: dict[str, Dispatcher] = {}
 
     def register(self, queue_name: str, backend_uri,
@@ -446,7 +595,8 @@ class DispatcherPool:
             retry_delay=self.retry_delay if retry_delay is None else retry_delay,
             concurrency=self.concurrency if concurrency is None else concurrency,
             result_cache=self.result_cache, result_store=self.result_store,
-            admission=self.admission,
+            admission=self.admission, resilience=self.resilience,
+            metrics=self.metrics,
         )
         self.dispatchers[queue_name] = d
         return d

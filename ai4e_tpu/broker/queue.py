@@ -66,11 +66,25 @@ class EndpointQueue:
 
     def __init__(self, name: str, max_delivery_count: int = 1440,
                  lease_seconds: float = 300.0,
-                 dead_letter_handler: DeadLetterHandler | None = None):
+                 dead_letter_handler: DeadLetterHandler | None = None,
+                 max_dead_letters: int = 256, metrics=None):
         self.name = name
         self.max_delivery_count = max_delivery_count
         self.lease_seconds = lease_seconds
         self.dead_letter_handler = dead_letter_handler
+        # Retained dead-letter bound: the list keeps the NEWEST N message
+        # objects for inspection; older ones (bodies included) are released
+        # so a poisoned queue can't grow the broker without bound. The
+        # total is never silently forgotten — every dead-letter increments
+        # ai4e_broker_dead_letters_total{queue=} (and ``_dead_seqs`` keeps
+        # every seq, ints only, so abandon() stays truthful for evicted
+        # messages too).
+        self.max_dead_letters = max_dead_letters
+        from ..metrics import DEFAULT_REGISTRY
+        self._dead_letter_total = (metrics or DEFAULT_REGISTRY).counter(
+            "ai4e_broker_dead_letters_total",
+            "Messages dead-lettered per queue (total ever, unlike the "
+            "bounded retained list)")
         self._ready: deque[Message] = deque()
         # Seqs logically ready (mirrors _ready minus retractions): a message
         # completed after its lease expired (the reaper already requeued it)
@@ -87,7 +101,11 @@ class EndpointQueue:
 
     def _dead_letter(self, msg: Message) -> None:
         self.dead_letters.append(msg)
+        if (self.max_dead_letters > 0
+                and len(self.dead_letters) > self.max_dead_letters):
+            del self.dead_letters[0]
         self._dead_seqs.add(msg.seq)
+        self._dead_letter_total.inc(queue=self.name)
         if self.dead_letter_handler is not None:
             try:
                 self.dead_letter_handler(msg)
@@ -195,9 +213,12 @@ class InMemoryBroker:
     """
 
     def __init__(self, max_delivery_count: int = 1440,
-                 lease_seconds: float = 300.0):
+                 lease_seconds: float = 300.0,
+                 max_dead_letters: int = 256, metrics=None):
         self.max_delivery_count = max_delivery_count
         self.lease_seconds = lease_seconds
+        self.max_dead_letters = max_dead_letters
+        self._metrics = metrics
         self._queues: dict[str, EndpointQueue] = {}
         self._queues_lock = threading.Lock()
         self._seq = itertools.count(1)
@@ -227,7 +248,9 @@ class InMemoryBroker:
             if q is None:
                 q = self._queues[name] = EndpointQueue(
                     name, self.max_delivery_count, self.lease_seconds,
-                    dead_letter_handler=self._dead_letter_handler)
+                    dead_letter_handler=self._dead_letter_handler,
+                    max_dead_letters=self.max_dead_letters,
+                    metrics=self._metrics)
             return q
 
     def queue_names(self) -> list[str]:

@@ -1,0 +1,32 @@
+"""Deterministic fault-injection harness (``docs/resilience.md``).
+
+Test/bench tooling, never wired by ``PlatformConfig`` — production
+assemblies carry no chaos code path. Three parts:
+
+- ``injector``   — seeded ``FaultInjector`` + wrappers for the HTTP hop
+  (error status / connection-refused / latency / dropped response) and
+  the queue publish surface (duplicate delivery);
+- ``harness``    — kill/restart helpers: ``RestartableBackend`` (a
+  worker that dies and comes back on the same port),
+  ``kill_dispatcher``/``restart_dispatcher``;
+- ``invariants`` — ``InvariantChecker`` riding the store's change feed:
+  every accepted task terminates, no task is lost, no duplicate
+  client-visible completion.
+
+``bench.py --fault-rate R [--resilience]`` drives the same injector over
+the full platform for the goodput-under-failure A/B.
+"""
+
+from .harness import (RestartableBackend, kill_dispatcher, kill_worker,
+                      restart_dispatcher, restart_worker)
+from .injector import (ChaosSession, ChaosSessionHolder, Decision,
+                       FaultInjector, FaultRule, wrap_platform_http,
+                       wrap_publish_duplicates)
+from .invariants import InvariantChecker
+
+__all__ = [
+    "FaultInjector", "FaultRule", "Decision", "ChaosSession",
+    "ChaosSessionHolder", "wrap_platform_http", "wrap_publish_duplicates",
+    "RestartableBackend", "kill_dispatcher", "restart_dispatcher",
+    "kill_worker", "restart_worker", "InvariantChecker",
+]

@@ -1,0 +1,81 @@
+"""Kill/restart helpers for chaos scenarios — the process-level faults the
+HTTP injector cannot express: a worker that is *gone* (its port answers
+connection-refused) and a dispatcher that stops mid-delivery and later
+comes back.
+
+``RestartableBackend`` serves any aiohttp app on a stable port and can be
+killed and restarted on THAT SAME port, so every URI the platform
+recorded (task endpoints, registered backends) stays valid across the
+outage — exactly what a pod restart behind a stable Service VIP looks
+like.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+
+class RestartableBackend:
+    """An aiohttp app on a stable host:port with kill()/restart()."""
+
+    def __init__(self, app: web.Application, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.app = app
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def start(self) -> "RestartableBackend":
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if not self.port:
+            self.port = self._runner.addresses[0][1]
+        return self
+
+    async def kill(self) -> None:
+        """Stop serving: the port answers connection-refused until
+        ``restart``. In-flight requests are aborted, like a real crash."""
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def restart(self) -> None:
+        if self._runner is not None:
+            return  # already serving
+        await self.start()
+
+    @property
+    def alive(self) -> bool:
+        return self._runner is not None
+
+
+async def kill_dispatcher(platform, queue_name: str):
+    """Stop one dispatcher's delivery loops (in-flight deliveries are
+    cancelled and their messages abandoned back to the broker — the crash
+    path ``Dispatcher._run`` already implements). Returns the dispatcher
+    so the caller can ``restart_dispatcher`` it."""
+    d = platform.dispatchers.dispatchers[queue_name]
+    await d.stop()
+    return d
+
+
+async def restart_dispatcher(platform, queue_name: str):
+    """Bring a killed dispatcher back; its queue's backlog (including
+    everything abandoned at kill time) drains normally."""
+    d = platform.dispatchers.dispatchers[queue_name]
+    await d.start()
+    return d
+
+
+async def kill_worker(backend: RestartableBackend) -> None:
+    await backend.kill()
+
+
+async def restart_worker(backend: RestartableBackend) -> None:
+    await backend.restart()

@@ -1,0 +1,89 @@
+"""The invariants a chaos run must uphold, checked from the store's own
+change feed — the same listener surface the gateway's long-poll waiters,
+the result cache, and the admission goodput counter already ride.
+
+Three claims, matching the platform's client contract:
+
+1. **every accepted task terminates** — a POST that returned a TaskId is
+   a promise; whatever faults the run injected, that task must reach a
+   terminal status (completed / failed / dead-letter / expired), never
+   sit in limbo forever;
+2. **no task is lost** — an accepted task the store no longer knows AND
+   that was never observed terminal vanished without a trace;
+3. **no duplicate client-visible completion** — a task must enter the
+   terminal set exactly once. A second terminal transition means a
+   redelivered/duplicated execution overwrote a result the client may
+   already have read.
+
+Attach BEFORE traffic starts (listeners only see transitions from then
+on); ``note_accepted`` records each TaskId the client was actually given.
+"""
+
+from __future__ import annotations
+
+from ..taskstore import TaskNotFound, TaskStatus
+
+
+class InvariantChecker:
+    def __init__(self):
+        self._store = None
+        self.accepted: set[str] = set()
+        # First terminal status seen per task (listener feed).
+        self.terminal: dict[str, str] = {}
+        # (task_id, first_terminal, second_terminal) per violation.
+        self.duplicate_completions: list[tuple[str, str, str]] = []
+
+    def attach(self, store) -> "InvariantChecker":
+        store.add_listener(self.on_task_event)
+        self._store = store
+        return self
+
+    def note_accepted(self, task_id: str) -> None:
+        """The client holds this TaskId (POST answered 200)."""
+        self.accepted.add(task_id)
+
+    def on_task_event(self, task) -> None:
+        # May fire from any thread (store listeners run outside the lock);
+        # dict/set mutation here is single-item and GIL-atomic.
+        status = task.canonical_status
+        if status not in TaskStatus.TERMINAL:
+            return
+        first = self.terminal.get(task.task_id)
+        if first is None:
+            self.terminal[task.task_id] = status
+        else:
+            self.duplicate_completions.append((task.task_id, first, status))
+
+    # -- verdicts -----------------------------------------------------------
+
+    def violations(self) -> list[str]:
+        out = []
+        for tid in sorted(self.accepted):
+            if tid in self.terminal:
+                continue
+            # Never seen terminal: distinguish "still limbo" from "gone".
+            try:
+                record = self._store.get(tid) if self._store else None
+            except TaskNotFound:
+                record = None
+            if record is None:
+                out.append(f"task {tid} LOST: accepted, never terminal, "
+                           "and unknown to the store")
+            else:
+                out.append(f"task {tid} never reached a terminal status "
+                           f"(stuck at {record.canonical_status!r})")
+        for tid, first, second in self.duplicate_completions:
+            out.append(f"task {tid} completed twice (client-visible): "
+                       f"{first!r} then {second!r}")
+        return out
+
+    def assert_ok(self) -> None:
+        problems = self.violations()
+        if problems:
+            raise AssertionError(
+                "chaos invariants violated:\n  " + "\n  ".join(problems))
+
+    def summary(self) -> dict:
+        return {"accepted": len(self.accepted),
+                "terminal": len(self.terminal),
+                "duplicates": len(self.duplicate_completions)}

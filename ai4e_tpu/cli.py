@@ -374,13 +374,16 @@ async def run_control_plane(config: FrameworkConfig, routes: dict) -> None:
     site = web.TCPSite(runner, config.gateway.host, config.gateway.port)
     await site.start()
     await platform.start()
+    # Operators grep startup lines for posture; admission changes the
+    # public contract (sheds, expiry, computed Retry-After —
+    # AI4E_PLATFORM_ADMISSION=1, docs/admission.md) and resilience changes
+    # failure semantics (breakers, retries, 5xx-as-transient —
+    # AI4E_PLATFORM_RESILIENCE=1, docs/resilience.md).
+    posture = ("".join([
+        ", admission control ON" if platform.admission is not None else "",
+        ", resilience ON" if platform.resilience is not None else ""]))
     log.info("control plane on %s:%s (%d routes%s)", config.gateway.host,
-             config.gateway.port, len(platform.gateway.routes),
-             # Operators grep startup lines for posture; admission changes
-             # the public contract (sheds, expiry, computed Retry-After —
-             # AI4E_PLATFORM_ADMISSION=1, docs/admission.md).
-             ", admission control ON" if platform.admission is not None
-             else "")
+             config.gateway.port, len(platform.gateway.routes), posture)
     try:
         await _wait_for_termination()
     finally:

@@ -174,6 +174,20 @@ class PlatformSection:
     admission_max_limit: int = 256
     admission_initial_limit: int = 8
     admission_max_backlog: int = 1024
+    # Resilient routing (docs/resilience.md): per-backend circuit breakers
+    # shared by the sync proxy and every dispatcher, health-aware weighted
+    # picks (open backends ejected), budget-bounded retries with failover
+    # on connection error, 5xx treated as transient (redelivered). Off by
+    # default: enabling it changes failure semantics — a 5xx is no longer
+    # instantly terminal.
+    resilience: bool = False
+    resilience_failure_threshold: int = 5
+    resilience_window: int = 16
+    resilience_error_rate: float = 0.5
+    resilience_recovery_seconds: float = 30.0
+    resilience_max_attempts: int = 3
+    resilience_retry_base_s: float = 0.05
+    resilience_retry_budget_ratio: float = 0.2
 
     def to_platform_config(self):
         from .platform_assembly import PlatformConfig
@@ -211,6 +225,14 @@ class PlatformSection:
             admission_max_limit=self.admission_max_limit,
             admission_initial_limit=self.admission_initial_limit,
             admission_max_backlog=self.admission_max_backlog,
+            resilience=self.resilience,
+            resilience_failure_threshold=self.resilience_failure_threshold,
+            resilience_window=self.resilience_window,
+            resilience_error_rate=self.resilience_error_rate,
+            resilience_recovery_seconds=self.resilience_recovery_seconds,
+            resilience_max_attempts=self.resilience_max_attempts,
+            resilience_retry_base_s=self.resilience_retry_base_s,
+            resilience_retry_budget_ratio=self.resilience_retry_budget_ratio,
         )
 
 

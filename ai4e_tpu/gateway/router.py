@@ -96,6 +96,12 @@ class Gateway:
         # shedding, unbounded sync proxy — the pre-admission behavior,
         # untouched. Set via set_admission (platform assembly wires it).
         self._admission = None
+        # Per-backend health model (``resilience/``), shared with the
+        # dispatchers; None → single-attempt proxying, 502 on the first
+        # connection error — the pre-resilience behavior, untouched. Set
+        # via set_resilience (platform assembly wires it).
+        self._resilience = None
+        self._sync_retry_budget = None
         # Sync-path single flight: key -> Future resolving to the leader's
         # (status, payload, content_type), or None when the leader errored.
         # Event-loop objects, so they live here rather than in the
@@ -145,6 +151,18 @@ class Gateway:
         backpressure ``Retry-After`` is computed from the observed drain
         rate instead of a constant."""
         self._admission = controller
+
+    def set_resilience(self, health) -> None:
+        """Enable (or clear with None) resilient sync proxying
+        (``resilience/``, ``docs/resilience.md``): weighted backend picks
+        become health-aware (open-breaker backends ejected, their weight
+        redistributed), a connection error retries against a *different*
+        backend of the set under a retry budget with jittered backoff
+        (instead of answering 502 after a single attempt), and backend
+        response statuses feed the same breakers the dispatchers read."""
+        self._resilience = health
+        self._sync_retry_budget = (health.new_budget()
+                                   if health is not None else None)
 
     def set_quota_tracker(self, tracker) -> None:
         """Enable (or clear with None) per-key request QUOTAS — APIM's
@@ -646,70 +664,120 @@ class Gateway:
                         cache.count_miss()
                     elif bypassed:
                         cache.count_bypass()
-                # Weighted per-request pick over the route's backend set
-                # (single-backend routes skip the RNG) — Istio's weighted
-                # VirtualService subsets, at the gateway.
-                base = pick_backend(route.backends)
-                target = base + (("/" + tail) if tail else "")
-                if request.query_string:
-                    target += "?" + request.query_string
-                session = await self._get_session()
-                try:
-                    async with session.request(
-                        request.method, target, data=body,
-                        # Strip hop headers AND the gateway credential: a sync
-                        # backend (arbitrary URI, possibly third-party) must
-                        # never see the subscription key it could replay
-                        # against the keyed public surface. With admission,
-                        # the RELATIVE deadline header is stripped too and
-                        # the ABSOLUTE one attached — re-anchoring
-                        # X-Deadline-Ms at the worker would extend the
-                        # budget by exactly the proxy time it bounds.
-                        headers={
-                            **{k: v for k, v in request.headers.items()
-                               if k.lower() not in (
-                                   "host", "content-length",
-                                   "ocp-apim-subscription-key", "x-api-key",
-                                   *(("x-deadline-ms", "x-deadline-at",
-                                      "x-priority")
-                                     if sync_scope is not None else ()))},
-                            **(propagation_headers(deadline_at, priority)
-                               if sync_scope is not None else {})},
-                    ) as resp:
-                        payload = await resp.read()
+                # Strip hop headers AND the gateway credential: a sync
+                # backend (arbitrary URI, possibly third-party) must
+                # never see the subscription key it could replay
+                # against the keyed public surface. With admission,
+                # the RELATIVE deadline header is stripped too and
+                # the ABSOLUTE one attached — re-anchoring
+                # X-Deadline-Ms at the worker would extend the
+                # budget by exactly the proxy time it bounds.
+                fwd_headers = {
+                    **{k: v for k, v in request.headers.items()
+                       if k.lower() not in (
+                           "host", "content-length",
+                           "ocp-apim-subscription-key", "x-api-key",
+                           *(("x-deadline-ms", "x-deadline-at",
+                              "x-priority")
+                             if sync_scope is not None else ()))},
+                    **(propagation_headers(deadline_at, priority)
+                       if sync_scope is not None else {})}
+                res = self._resilience
+                tried: list[str] = []
+                attempt = 0
+                if self._sync_retry_budget is not None:
+                    self._sync_retry_budget.on_request()
+                while True:
+                    attempt += 1
+                    # Weighted per-request pick over the route's backend set
+                    # (single-backend routes skip the RNG) — Istio's
+                    # weighted VirtualService subsets, at the gateway;
+                    # health-aware under resilience (open backends ejected).
+                    base = (res.pick(route.backends, exclude=tried)
+                            if res is not None
+                            else pick_backend(route.backends))
+                    target = base + (("/" + tail) if tail else "")
+                    if request.query_string:
+                        target += "?" + request.query_string
+                    session = await self._get_session()
+                    try:
+                        async with session.request(
+                            request.method, target, data=body,
+                            headers=fwd_headers,
+                        ) as resp:
+                            payload = await resp.read()
+                            if res is not None:
+                                # Breakers read the proxied status too —
+                                # 5xx (not 503 backpressure) is failure
+                                # evidence; the RESPONSE still goes to the
+                                # client untouched (the backend executed;
+                                # replaying a non-idempotent inference POST
+                                # that answered is not the proxy's call).
+                                res.observe_status(base, resp.status)
+                            self._requests.inc(route=route.prefix,
+                                               outcome=str(resp.status))
+                            if fut is not None:
+                                # Only successes become cache entries — and
+                                # only when the family's invalidation
+                                # generation still matches the one captured
+                                # at leadership (a checkpoint reload
+                                # mid-proxy means this result came from the
+                                # OLD weights; refuse the stale fill). The
+                                # waiters get whatever the backend said
+                                # regardless (it IS their request's
+                                # response — errors included).
+                                if resp.status == 200:
+                                    cache.put(key, payload,
+                                              resp.content_type,
+                                              if_generation=gen)
+                                fut.set_result((resp.status, payload,
+                                                resp.content_type))
+                            return web.Response(
+                                status=resp.status, body=payload,
+                                content_type=resp.content_type,
+                                # Same X-Cache contract as the async path
+                                # (docs/API.md): leader → miss, opted out →
+                                # bypass; a waiter-turned-executor (leader
+                                # errored) carries no header — it neither
+                                # led nor consulted the cache for its
+                                # answer.
+                                headers=({CACHE_STATUS_HEADER: "miss"}
+                                         if fut is not None
+                                         else {CACHE_STATUS_HEADER: "bypass"}
+                                         if bypassed else None))
+                    except (aiohttp.ClientError,
+                            asyncio.TimeoutError) as exc:
+                        # Under resilience every transport failure is
+                        # breaker evidence (and resolves a probe slot),
+                        # but only a CONNECT-phase failure may retry: the
+                        # request never reached the backend, so replaying
+                        # it is safe for any method. A timeout or a
+                        # mid-response disconnect may have EXECUTED a
+                        # non-idempotent inference POST — unlike the async
+                        # path there is no duplicate suppression here, so
+                        # those answer 502 without failover (same rule as
+                        # refusing to replay an answered 5xx). Resilience
+                        # off keeps today's behavior exactly: single
+                        # attempt, ClientError → 502, timeout propagates.
+                        if res is not None:
+                            res.record_failure(base)
+                            if (isinstance(exc, aiohttp.ClientConnectorError)
+                                    and attempt < res.policy.max_attempts
+                                    and self._sync_retry_budget.try_retry()):
+                                from ..resilience.retry import backoff_s
+                                tried.append(base)
+                                res.note_failover("gateway_sync")
+                                await asyncio.sleep(backoff_s(
+                                    attempt, res.policy.retry_base_s,
+                                    res.policy.retry_cap_s))
+                                continue
+                        elif isinstance(exc, asyncio.TimeoutError):
+                            raise
                         self._requests.inc(route=route.prefix,
-                                           outcome=str(resp.status))
-                        if fut is not None:
-                            # Only successes become cache entries — and only
-                            # when the family's invalidation generation still
-                            # matches the one captured at leadership (a
-                            # checkpoint reload mid-proxy means this result
-                            # came from the OLD weights; refuse the stale
-                            # fill). The waiters get whatever the backend
-                            # said regardless (it IS their request's
-                            # response — errors included).
-                            if resp.status == 200:
-                                cache.put(key, payload, resp.content_type,
-                                          if_generation=gen)
-                            fut.set_result((resp.status, payload,
-                                            resp.content_type))
+                                           outcome="unreachable")
                         return web.Response(
-                            status=resp.status, body=payload,
-                            content_type=resp.content_type,
-                            # Same X-Cache contract as the async path
-                            # (docs/API.md): leader → miss, opted out →
-                            # bypass; a waiter-turned-executor (leader
-                            # errored) carries no header — it neither led
-                            # nor consulted the cache for its answer.
-                            headers=({CACHE_STATUS_HEADER: "miss"}
-                                     if fut is not None
-                                     else {CACHE_STATUS_HEADER: "bypass"}
-                                     if bypassed else None))
-                except aiohttp.ClientError as exc:
-                    self._requests.inc(route=route.prefix,
-                                       outcome="unreachable")
-                    return web.Response(status=502,
-                                        text=f"Backend unreachable: {exc}")
+                            status=502,
+                            text=f"Backend unreachable: {exc}")
             finally:
                 if acquired:
                     # Observe BEFORE release, so the limiter's Little's-law

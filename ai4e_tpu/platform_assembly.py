@@ -101,6 +101,23 @@ class PlatformConfig:
     # Async-edge backlog capacity the priority shedder fractions divide
     # (created-set depth per route; background sheds first at 60%).
     admission_max_backlog: int = 1024
+    # Resilient routing under failure (resilience/, docs/resilience.md):
+    # a per-backend circuit breaker shared by the gateway sync proxy and
+    # every dispatcher (open backends ejected from weighted picks, their
+    # weight redistributed; half-open probes re-admit them), plus
+    # budget-bounded in-delivery retries with failover to a different
+    # backend on connection error and 5xx-as-transient redelivery. Off by
+    # default — enabling it is a semantic statement that 5xx responses
+    # are transient (retried/redelivered, not instantly terminal) and
+    # that redeliveries of already-terminal tasks are suppressed.
+    resilience: bool = False
+    resilience_failure_threshold: int = 5   # consecutive failures to trip
+    resilience_window: int = 16             # rolling error-rate window
+    resilience_error_rate: float = 0.5      # window fraction that trips
+    resilience_recovery_seconds: float = 30.0  # open → half-open cooldown
+    resilience_max_attempts: int = 3        # POST attempts per delivery
+    resilience_retry_base_s: float = 0.05   # first in-delivery retry delay
+    resilience_retry_budget_ratio: float = 0.2  # retries per request, steady
 
 
 class LocalPlatform:
@@ -214,6 +231,24 @@ class LocalPlatform:
                 # goodput — the same change feed the long-poll waiters and
                 # the result cache ride.
                 self.admission.attach_store(self.store)
+        self.resilience = None
+        if self.config.resilience:
+            # ONE health model per assembly: the sync proxy and every
+            # dispatcher record into (and route around) the same breakers,
+            # so a backend melting under queue deliveries is ejected from
+            # sync picks too.
+            from .resilience import BackendHealth, ResiliencePolicy
+            self.resilience = BackendHealth(
+                policy=ResiliencePolicy(
+                    failure_threshold=self.config.resilience_failure_threshold,
+                    window=self.config.resilience_window,
+                    error_rate=self.config.resilience_error_rate,
+                    recovery_seconds=self.config.resilience_recovery_seconds,
+                    max_attempts=self.config.resilience_max_attempts,
+                    retry_base_s=self.config.resilience_retry_base_s,
+                    retry_budget_ratio=(
+                        self.config.resilience_retry_budget_ratio)),
+                metrics=self.metrics)
         self.broker = None
         self.dispatchers = None
         self.topic = None
@@ -233,7 +268,8 @@ class LocalPlatform:
             else:
                 self.broker = InMemoryBroker(
                     max_delivery_count=self.config.max_delivery_count,
-                    lease_seconds=self.config.lease_seconds)
+                    lease_seconds=self.config.lease_seconds,
+                    metrics=self.metrics)
             self.store.set_publisher(self.broker.publish)
             self.dispatchers = DispatcherPool(
                 self.broker, self.task_manager,
@@ -243,7 +279,9 @@ class LocalPlatform:
                 result_store=(self.store if self.result_cache is not None
                               and hasattr(self.store, "set_result")
                               else None),
-                admission=self.admission)
+                admission=self.admission,
+                resilience=self.resilience,
+                metrics=self.metrics)
         else:
             raise ValueError(
                 f"unknown transport {self.config.transport!r}; "
@@ -253,6 +291,8 @@ class LocalPlatform:
             self.gateway.set_result_cache(self.result_cache)
         if self.admission is not None:
             self.gateway.set_admission(self.admission)
+        if self.resilience is not None:
+            self.gateway.set_resilience(self.resilience)
         # Terminal-history retention: None = AUTO — 15 min on the Python
         # store, sized to the soak evidence (unevicted terminal history
         # grows ~12 MB/min at 200 req/s → AUTO bounds steady-state at

@@ -1,0 +1,28 @@
+"""Resilient routing under failure (``docs/resilience.md``).
+
+Opt-in via ``PlatformConfig(resilience=True)`` /
+``AI4E_PLATFORM_RESILIENCE=1``. Three parts:
+
+- ``breaker`` — per-backend circuit breaker (closed → open on
+  consecutive-failure/error-rate threshold → half-open probe → closed);
+- ``health``  — the ``BackendHealth`` registry the gateway sync proxy and
+  every dispatcher share: health-aware weighted picks that eject open
+  backends (redistributing their weight), last-resort least-recently-
+  failed probing when the whole set is dark, and the
+  ``ai4e_resilience_*`` metric family;
+- ``retry``   — Finagle-style retry budgets and half-jittered exponential
+  backoff, so retries can neither storm a browning-out backend nor wake
+  in synchronized herds.
+
+The deterministic fault-injection harness that proves all of this lives
+in ``ai4e_tpu/chaos/``.
+"""
+
+from .breaker import CLOSED, HALF_OPEN, OPEN, STATE_CODES, CircuitBreaker
+from .health import BackendHealth, ResiliencePolicy
+from .retry import RetryBudget, backoff_s
+
+__all__ = [
+    "BackendHealth", "CircuitBreaker", "ResiliencePolicy", "RetryBudget",
+    "backoff_s", "CLOSED", "HALF_OPEN", "OPEN", "STATE_CODES",
+]

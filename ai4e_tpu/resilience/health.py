@@ -1,0 +1,202 @@
+"""Shared per-backend health model — breakers + health-aware routing.
+
+One ``BackendHealth`` instance per platform assembly, shared by the
+gateway sync proxy and every dispatcher, so a backend that is melting
+under the dispatcher's deliveries is ALSO ejected from the sync proxy's
+picks (and vice versa) — the two surfaces see one truth.
+
+Routing policy (``pick``):
+
+- every backend whose breaker admits traffic keeps its configured weight;
+- an OPEN backend is **ejected**: its weight implicitly redistributes
+  across the remaining healthy set (``random.choices`` over the
+  survivors — no renormalization pass needed, relative weights are the
+  contract ``utils/backends.py`` already defines);
+- a half-open backend competes at its normal weight but the breaker
+  bounds its in-flight probes, so recovery traffic is a trickle, not a
+  stampede;
+- **all open** (fully-dark set): route to the least-recently-failed
+  backend as a forced probe — a dark set must keep probing its way back
+  to life, because with every breaker open there is nobody else to try.
+
+Exported metrics (``ai4e_resilience_*``, docs/METRICS.md): breaker state
+per backend, open/close transitions, ejections, retries, failovers, and
+probe outcomes.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from urllib.parse import urlparse
+
+from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from ..utils.backends import Weighted, pick_backend
+from .breaker import STATE_CODES, CircuitBreaker
+from .retry import RetryBudget
+
+
+@dataclass
+class ResiliencePolicy:
+    """The assembly-level knob set (``PlatformConfig`` mirrors these —
+    ``resilience_*`` fields / ``AI4E_PLATFORM_*`` env vars)."""
+
+    failure_threshold: int = 5       # consecutive failures that trip a breaker
+    window: int = 16                 # rolling outcome window (error-rate trip)
+    error_rate: float = 0.5          # window failure fraction that trips
+    recovery_seconds: float = 30.0   # open → half-open cooldown
+    half_open_probes: int = 1        # concurrent probes while half-open
+    max_attempts: int = 3            # delivery attempts per POST (1 + retries)
+    retry_base_s: float = 0.05       # first in-attempt retry delay (jittered)
+    retry_cap_s: float = 1.0         # in-attempt retry delay ceiling
+    retry_budget_ratio: float = 0.2  # retries per ordinary request, steady state
+
+
+class BackendHealth:
+    """Breaker registry + health-aware weighted pick (module docstring)."""
+
+    def __init__(self, policy: ResiliencePolicy | None = None,
+                 metrics: MetricsRegistry | None = None,
+                 clock=time.monotonic, rng: random.Random | None = None):
+        self.policy = policy or ResiliencePolicy()
+        self.metrics = metrics or DEFAULT_REGISTRY
+        self._clock = clock
+        self._rng = rng
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._state_gauge = self.metrics.gauge(
+            "ai4e_resilience_breaker_state",
+            "Breaker state per backend: 0 closed, 1 half-open, 2 open")
+        self._transitions = self.metrics.counter(
+            "ai4e_resilience_transitions_total",
+            "Breaker state transitions by backend and new state")
+        self._ejections = self.metrics.counter(
+            "ai4e_resilience_ejections_total",
+            "Weighted picks that routed around an open backend")
+        self._retries = self.metrics.counter(
+            "ai4e_resilience_retries_total",
+            "In-attempt retries by component")
+        self._failovers = self.metrics.counter(
+            "ai4e_resilience_failovers_total",
+            "Retries that switched to a different backend, by component")
+        self._probes = self.metrics.counter(
+            "ai4e_resilience_probe_total",
+            "Half-open/forced probe outcomes by backend")
+
+    # -- registry -----------------------------------------------------------
+
+    @staticmethod
+    def _label(uri: str) -> str:
+        """Metrics label for a backend URI — the host, matching the
+        ``backend`` dimension ``ai4e_dispatch_total`` already exports."""
+        return urlparse(uri).netloc or uri
+
+    def breaker_for(self, uri: str) -> CircuitBreaker:
+        br = self._breakers.get(uri)
+        if br is None:
+            p = self.policy
+            br = self._breakers[uri] = CircuitBreaker(
+                failure_threshold=p.failure_threshold, window=p.window,
+                error_rate=p.error_rate,
+                recovery_seconds=p.recovery_seconds,
+                half_open_probes=p.half_open_probes, clock=self._clock)
+            self._state_gauge.set(0, backend=self._label(uri))
+        return br
+
+    def state(self, uri: str) -> str:
+        return self.breaker_for(uri).state
+
+    def new_budget(self) -> RetryBudget:
+        """A retry budget at this policy's ratio — one per retrying
+        component (each dispatcher queue, the sync proxy)."""
+        return RetryBudget(ratio=self.policy.retry_budget_ratio)
+
+    # -- routing ------------------------------------------------------------
+
+    def pick(self, backends: Weighted, rng: random.Random | None = None,
+             exclude=()) -> str:
+        """Health-aware weighted pick. ``exclude``: backends already tried
+        in THIS delivery attempt chain (failover must reach a *different*
+        backend when one exists); ignored when it would empty the set."""
+        now = self._clock()
+        pool = [(u, w) for u, w in backends if u not in exclude and w > 0]
+        if not pool:
+            pool = [(u, w) for u, w in backends if w > 0]
+        candidates = []
+        ejected = []
+        for uri, weight in pool:
+            if self.breaker_for(uri).available(now):
+                candidates.append((uri, weight))
+            else:
+                ejected.append(uri)
+        if candidates:
+            # Ejections counted only when somebody healthy absorbed the
+            # traffic — an all-dark set's forced probe below routes INTO
+            # the open backend, which is not an ejection.
+            for uri in ejected:
+                self._ejections.inc(backend=self._label(uri))
+            chosen = pick_backend(candidates, rng or self._rng)
+        else:
+            # Fully dark: forced probe of the least-recently-failed
+            # backend — the one most likely to have had time to recover.
+            chosen = min((u for u, _ in pool),
+                         key=lambda u: self.breaker_for(u).last_failure_at)
+        br = self.breaker_for(chosen)
+        if br.state != "closed":
+            br.begin_probe(now)
+            self._set_state(chosen, br)
+        return chosen
+
+    # -- outcome recording --------------------------------------------------
+
+    def record_success(self, uri: str) -> None:
+        br = self.breaker_for(uri)
+        probing = br.state != "closed"
+        br.record_success()
+        if probing and br.state == "closed":
+            # Actually recovered (half-open probe). A stale success
+            # against a still-OPEN breaker is ignored by the state machine
+            # and must not count a probe/transition either.
+            self._probes.inc(backend=self._label(uri), outcome="success")
+            self._transitions.inc(backend=self._label(uri), state="closed")
+        self._set_state(uri, br)
+
+    def record_failure(self, uri: str) -> bool:
+        """Record a failure; True when the breaker opened on this call."""
+        br = self.breaker_for(uri)
+        probing = br.state != "closed"
+        opened = br.record_failure(self._clock())
+        if probing:
+            self._probes.inc(backend=self._label(uri), outcome="failure")
+        if opened:
+            self._transitions.inc(backend=self._label(uri), state="open")
+        self._set_state(uri, br)
+        return opened
+
+    def observe_status(self, uri: str, status: int) -> bool:
+        """Classify an HTTP response for the breaker: 5xx (other than 503
+        backpressure) is a failure, 429/503 is a *saturation* signal — the
+        backend answered, it is alive, and ejecting it would shift load
+        onto peers that are probably saturating too (admission control
+        owns that signal) — and everything else is a success. Returns
+        True when the breaker opened."""
+        if status in (429, 503):
+            # Neutral for open/close decisions, but it RESOLVES a probe:
+            # without the release, one 503'd half-open probe would pin the
+            # probe slot and eject the backend permanently.
+            self.breaker_for(uri).record_neutral()
+            return False
+        if status >= 500:
+            return self.record_failure(uri)
+        self.record_success(uri)
+        return False
+
+    def note_retry(self, component: str) -> None:
+        self._retries.inc(component=component)
+
+    def note_failover(self, component: str) -> None:
+        self._failovers.inc(component=component)
+
+    def _set_state(self, uri: str, br: CircuitBreaker) -> None:
+        self._state_gauge.set(STATE_CODES[br.state],
+                              backend=self._label(uri))

@@ -1,0 +1,65 @@
+"""Retry budgets and jittered backoff — bounded, storm-proof retries.
+
+Two failure amplifiers hide in naive retry loops, and this module exists
+to kill both:
+
+- **retry storms**: when a backend browns out, every caller retrying N
+  times multiplies the offered load by N exactly when capacity halved.
+  ``RetryBudget`` is the Finagle-style token bucket: ordinary requests
+  deposit ``ratio`` tokens, each retry spends one — so steady-state
+  retries can never exceed ~``ratio`` of real traffic, with a small
+  fixed reserve so cold starts and singleton failures still get their
+  retry.
+- **synchronized herds**: unjittered exponential backoff turns one
+  outage into evenly-spaced waves of simultaneous retries.
+  ``backoff_s`` spreads each delay uniformly over [d/2, d] (half
+  jitter), so no two callers wake in lockstep.
+"""
+
+from __future__ import annotations
+
+import random
+
+
+def backoff_s(attempt: int, base: float, cap: float,
+              rng: random.Random | None = None) -> float:
+    """Jittered exponential delay for retry ``attempt`` (1-based): the
+    deterministic schedule is ``base * 2**(attempt-1)`` capped at ``cap``;
+    the returned delay is uniform in [schedule/2, schedule]. ``rng`` is
+    injectable so tests and the chaos harness stay seeded."""
+    if base <= 0 or cap <= 0:
+        return 0.0
+    # Exponent clamped: attempt counts are unbounded upstream (the broker
+    # allows 1440 redeliveries), and 2**1019 overflows float — which would
+    # turn the sleep into an exception, i.e. NO backoff at all, exactly
+    # when a long-dark backend needs it most. 2**63·base dwarfs any cap.
+    delay = min(cap, base * (2 ** min(63, max(0, attempt - 1))))
+    return delay * (0.5 + 0.5 * (rng or random).random())
+
+
+class RetryBudget:
+    """Token-bucket retry budget (see module docstring). Event-loop-only
+    state, like the breaker — each retrying component (one per dispatcher
+    queue, one for the gateway sync proxy) owns its own budget so a
+    melting queue cannot spend another queue's retries."""
+
+    def __init__(self, ratio: float = 0.2, reserve: float = 10.0,
+                 cap: float = 100.0):
+        self.ratio = max(0.0, ratio)
+        self.cap = max(reserve, cap)
+        self._tokens = min(float(reserve), self.cap)
+
+    @property
+    def tokens(self) -> float:
+        return self._tokens
+
+    def on_request(self) -> None:
+        """One ordinary (non-retry) request happened: deposit."""
+        self._tokens = min(self.cap, self._tokens + self.ratio)
+
+    def try_retry(self) -> bool:
+        """Spend one retry if the budget allows."""
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False

@@ -582,7 +582,11 @@ def build_platform(args):
         admission=_admission_enabled(args),
         admission_initial_limit=max(8, args.dispatcher_concurrency // 8),
         admission_max_limit=max(256, args.dispatcher_concurrency),
-        admission_max_backlog=max(256, args.concurrency * 4)))
+        admission_max_backlog=max(256, args.concurrency * 4),
+        # --resilience enables per-backend breakers + budget-bounded
+        # retries (ai4e_tpu/resilience/) — the A/B lever for the
+        # --fault-rate goodput-under-failure runs.
+        resilience=getattr(args, "resilience", False)))
     runtime = ModelRuntime(donate_batch=args.donate_batch)
     batcher = MicroBatcher(runtime, max_wait_ms=args.max_wait_ms,
                            max_pending=args.concurrency * 4,
@@ -997,6 +1001,21 @@ async def run_bench(args) -> dict:
     await gw_site.start()
     gw_port = gw_runner.addresses[0][1]
 
+    # --fault-rate: seeded chaos on the backend-POST hop (dispatcher
+    # deliveries + sync proxy) — injected 5xx at the given rate, so the
+    # run measures goodput under failure. Wrapped AFTER routes registered
+    # (each dispatcher's session holder exists), BEFORE traffic starts.
+    injector = None
+    fault_rate = getattr(args, "fault_rate", 0.0) or 0.0
+    if fault_rate > 0:
+        from ai4e_tpu.chaos import FaultInjector, wrap_platform_http
+        injector = FaultInjector(seed=getattr(args, "fault_seed", 0))
+        injector.add_rule(error_rate=fault_rate, error_status=500)
+        wrap_platform_http(platform, injector)
+        log(f"chaos: injecting 5xx at rate {fault_rate} "
+            f"(seed {injector.seed}, resilience="
+            f"{getattr(args, 'resilience', False)})")
+
     await batcher.start()
     await platform.start()
 
@@ -1092,6 +1111,28 @@ async def run_bench(args) -> dict:
             ramp=args.ramp, post_url_for=post_url_for,
             headers_for=headers_for, deadline_s=deadline_s),
             _snap_cache_at_window_open())
+
+    fault_meta = {}
+    if injector is not None:
+        # Goodput under failure: completions/s inside the window (failures
+        # and client slots burned on failed tasks excluded by
+        # construction) — the resilience=on/off A/B figure, beside the
+        # injected-fault accounting and the resilience counters.
+        reg = platform.metrics
+        fault_meta["fault"] = {
+            "rate": fault_rate,
+            "seed": injector.seed,
+            "resilience": bool(getattr(args, "resilience", False)),
+            "injected": injector.counts(),
+            "goodput_req_s": window["value"],
+            "failed": window["failed"],
+            "retries": int(sum(v for *_, v in reg.counter(
+                "ai4e_resilience_retries_total", "").collect())),
+            "redeliveries": int(sum(
+                v for _, _, labels, v in reg.counter(
+                    "ai4e_dispatch_total", "").collect()
+                if labels.get("outcome") == "backpressure")),
+        }
 
     admission_meta = _admission_report(args, platform)
     if admission_meta:
@@ -1262,6 +1303,7 @@ async def run_bench(args) -> dict:
         **build_meta,
         **admission_meta,
         **cache_meta,
+        **fault_meta,
         **batch_meta,
         **capability_meta,
         **pallas_meta,
@@ -1430,6 +1472,9 @@ def _forward_argv(args) -> list[str]:
             "--seq-input", args.seq_input,
             "--wire", args.wire,
             "--cache-hit-ratio", str(args.cache_hit_ratio),
+            "--fault-rate", str(args.fault_rate),
+            "--fault-seed", str(args.fault_seed),
+            *(["--resilience"] if args.resilience else []),
             "--deadline-ms", str(args.deadline_ms),
             *(["--priority-mix", args.priority_mix]
               if args.priority_mix else []),
@@ -1547,6 +1592,22 @@ def main() -> None:
                              "(within-deadline completions/s) beside raw "
                              "req/s plus shed/expired counts by hop and "
                              "priority. 0 (default) = admission off")
+    parser.add_argument("--fault-rate", type=float, default=0.0,
+                        help="inject seeded 5xx faults on the backend-POST "
+                             "hop (dispatcher deliveries + sync proxy) at "
+                             "this rate (ai4e_tpu/chaos/): the JSON gains "
+                             "a 'fault' block with goodput under failure — "
+                             "pair with/without --resilience for the A/B. "
+                             "0 (default) = no injection")
+    parser.add_argument("--fault-seed", type=int, default=0,
+                        help="seed for the --fault-rate injector (runs "
+                             "replay identically under one seed)")
+    parser.add_argument("--resilience", action="store_true",
+                        help="enable resilient routing (ai4e_tpu/"
+                             "resilience/): per-backend circuit breakers, "
+                             "health-aware picks, budget-bounded retries "
+                             "with failover, 5xx-as-transient redelivery "
+                             "(docs/resilience.md)")
     parser.add_argument("--priority-mix", default="",
                         help="weighted X-Priority draw per request, e.g. "
                              "'interactive:6,default:3,background:1' — "

@@ -32,6 +32,7 @@ result is not stored).
 from __future__ import annotations
 
 import json
+import random
 import time
 import urllib.error
 import urllib.parse
@@ -182,7 +183,12 @@ class AI4EClient:
                 except ValueError:
                     delay = 0.0
             if delay <= 0:
-                delay = self.retry_backoff * (2 ** attempt)
+                # Half-jittered: a herd of clients refused in the same
+                # instant must not wake in lockstep and re-refuse together
+                # (a server-sent Retry-After above is honored verbatim —
+                # the drain-derived values already differ per response).
+                delay = (self.retry_backoff * (2 ** attempt)
+                         * (0.5 + 0.5 * random.random()))
             delay = min(delay, 60.0)
             if time.monotonic() + delay >= deadline:
                 raise self._pass_error(signal, conn_error, per_try)

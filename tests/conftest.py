@@ -18,3 +18,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # Registered here (no pytest.ini): `slow` gates tier-1's wall clock
+    # (`-m 'not slow'`), `chaos` marks the seeded fault-injection
+    # scenarios CI's chaos-smoke job runs explicitly (`-m chaos`).
+    config.addinivalue_line("markers", "slow: excluded from tier-1 CI")
+    config.addinivalue_line(
+        "markers", "chaos: seeded fault-injection scenario "
+        "(AI4E_CHAOS_SEED overrides the seed)")

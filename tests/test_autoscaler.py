@@ -121,6 +121,17 @@ class TestAutoscaleE2E:
                         break
                     await asyncio.sleep(0.02)
                 assert dispatcher.concurrency == 6, dispatcher.concurrency
+                # concurrency == 6 only says the loops were SPAWNED;
+                # whether their POSTs have reached the backend yet is an
+                # event-loop photo finish (create_task → receive → connect
+                # → handler entry, several hops behind the attribute
+                # write). Releasing on the attribute alone raced that, and
+                # the race flips with unrelated scheduling shifts — wait
+                # for concurrent delivery to actually be OBSERVED first.
+                for _ in range(600):
+                    if peak > 1:
+                        break
+                    await asyncio.sleep(0.02)
 
                 # Unblock; queue drains; after stabilization it scales back
                 # to min.

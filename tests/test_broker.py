@@ -278,3 +278,73 @@ class TestLeaseAbandonInterplay:
             assert await broker.receive("/v1/api", timeout=0.05) is None
 
         run(main())
+
+
+class TestDeadLetterAccounting:
+    """Satellites: the reaper path that EXHAUSTS the delivery budget
+    (queue.py ``_reap_expired_leases``), the bounded retained dead-letter
+    list, and the total-ever counter that keeps evicted ones visible."""
+
+    def test_reaper_dead_letters_exhausted_message_handler_once(self):
+        async def main():
+            from ai4e_tpu.broker.queue import EndpointQueue, Message
+
+            handled = []
+            q = EndpointQueue("/v1/api", max_delivery_count=1,
+                              lease_seconds=0.05,
+                              dead_letter_handler=handled.append)
+            q.put(Message(task_id="t", endpoint="/v1/api", seq=1))
+            msg = await q.receive(timeout=1)
+            assert msg.delivery_count == 1  # budget now spent
+            await asyncio.sleep(0.1)        # consumer "crashed"; lease expires
+            # The reaper (run inside receive) must dead-letter, not requeue.
+            assert await q.receive(timeout=0.05) is None
+            assert [m.task_id for m in handled] == ["t"]
+            assert [m.task_id for m in q.dead_letters] == ["t"]
+            # A late abandon from the crashed consumer reports the truth.
+            assert q.abandon(msg) is False
+
+        run(main())
+
+    def test_raising_dead_letter_handler_does_not_break_receives(self):
+        async def main():
+            from ai4e_tpu.broker.queue import EndpointQueue, Message
+
+            def explode(_msg):
+                raise RuntimeError("handler bug")
+
+            q = EndpointQueue("/v1/api", max_delivery_count=1,
+                              lease_seconds=0.05,
+                              dead_letter_handler=explode)
+            q.put(Message(task_id="dead", endpoint="/v1/api", seq=1))
+            await q.receive(timeout=1)
+            await asyncio.sleep(0.1)
+            assert await q.receive(timeout=0.05) is None  # reaped, survived
+            # The queue still serves fresh traffic after the handler blew up.
+            q.put(Message(task_id="alive", endpoint="/v1/api", seq=2))
+            msg = await q.receive(timeout=1)
+            assert msg.task_id == "alive"
+            q.complete(msg)
+
+        run(main())
+
+    def test_retained_dead_letters_bounded_newest_kept_total_counted(self):
+        async def main():
+            from ai4e_tpu.broker.queue import EndpointQueue, Message
+            from ai4e_tpu.metrics import MetricsRegistry
+
+            reg = MetricsRegistry()
+            q = EndpointQueue("/v1/api", max_delivery_count=1,
+                              max_dead_letters=3, metrics=reg)
+            for i in range(5):
+                q.put(Message(task_id=f"t{i}", endpoint="/v1/api", seq=i + 1))
+                msg = await q.receive(timeout=1)
+                assert q.abandon(msg) is False  # budget 1: dead-letters
+            # Retained list keeps the NEWEST 3; the counter keeps the total.
+            assert [m.task_id for m in q.dead_letters] == ["t2", "t3", "t4"]
+            counter = reg.counter("ai4e_broker_dead_letters_total", "")
+            assert counter.value(queue="/v1/api") == 5
+            # Evicted seqs still answer abandon() truthfully.
+            assert q._dead_letter_has(1)
+
+        run(main())

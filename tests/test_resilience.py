@@ -1,0 +1,557 @@
+"""Resilient-routing tests (``ai4e_tpu/resilience/``, docs/resilience.md):
+the per-backend circuit breaker state machine under an injected clock;
+health-aware weighted picks ejecting open backends (and the all-open
+least-recently-failed last resort); retry budgets and jittered backoff;
+the dispatcher's in-delivery retry/failover + 5xx-as-transient
+redelivery + duplicate suppression; the gateway sync proxy failing over
+on connection error instead of answering 502; and ``resilience=False``
+leaving every pre-resilience behavior untouched."""
+
+import asyncio
+import random
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.metrics import MetricsRegistry
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.resilience import (BackendHealth, CircuitBreaker,
+                                 ResiliencePolicy, RetryBudget, backoff_s)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# Breaker state machine
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_opens_on_consecutive_failures(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=3, clock=clock)
+        assert br.state == "closed"
+        assert not br.record_failure()
+        assert not br.record_failure()
+        assert br.record_failure()  # third consecutive: trips NOW
+        assert br.state == "open"
+        assert not br.available()
+
+    def test_success_resets_the_consecutive_run(self):
+        br = CircuitBreaker(failure_threshold=3, clock=FakeClock())
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        assert not br.record_failure()
+        assert br.state == "closed"
+
+    def test_opens_on_window_error_rate(self):
+        # A flapping backend that never fails thrice in a row but fails
+        # half its window still trips.
+        br = CircuitBreaker(failure_threshold=10, window=6, error_rate=0.5,
+                            clock=FakeClock())
+        for _ in range(10):
+            if br.record_failure() or br.record_failure():
+                break
+            br.record_success()
+        assert br.state == "open"
+
+    def test_half_open_probe_success_closes(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=1, recovery_seconds=10.0,
+                            clock=clock)
+        br.record_failure()
+        assert br.state == "open" and not br.available()
+        clock.t = 11.0  # cooldown elapsed
+        assert br.available()
+        br.begin_probe()
+        assert br.state == "half_open"
+        # The single probe slot is taken: no stampede on the recovering pod.
+        assert not br.available()
+        br.record_success()
+        assert br.state == "closed" and br.available()
+
+    def test_stale_success_does_not_cancel_an_open_cooldown(self):
+        # Concurrent delivery loops: a request dispatched BEFORE the trip
+        # completing 200 after it must not re-admit the flapping backend
+        # (review finding: one straggler success per trip would defeat
+        # ejection entirely).
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=2, recovery_seconds=10.0,
+                            clock=clock)
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "open"
+        br.record_success()  # straggler from before the trip
+        assert br.state == "open"
+        assert not br.available()
+
+    def test_backpressured_probe_releases_the_slot(self):
+        # A half-open probe answered 429/503 (alive but saturated) is
+        # neutral for open/close — but it RESOLVES the probe, or one
+        # 503'd probe would pin the slot and eject the backend forever
+        # (review finding).
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=1, recovery_seconds=10.0,
+                            clock=clock)
+        br.record_failure()
+        clock.t = 11.0
+        br.begin_probe()
+        assert not br.available()  # slot taken
+        br.record_neutral()        # probe drew a 503
+        assert br.state == "half_open"
+        assert br.available()      # slot free: the next probe can go
+
+    def test_stale_failures_do_not_extend_an_open_cooldown(self):
+        # Staggered timeouts on concurrent loops dribble in for the whole
+        # request_timeout after the trip; refreshing the anchor on each
+        # would eject a hung-then-restarted backend for minutes instead of
+        # recovery_seconds (review finding).
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=1, recovery_seconds=10.0,
+                            clock=clock)
+        br.record_failure()          # trips at t=0
+        clock.t = 9.0
+        br.record_failure()          # straggler while open
+        clock.t = 10.5               # recovery_seconds from the TRIP
+        assert br.available()
+
+    def test_leaked_probe_slot_escapes_after_a_cooldown(self):
+        # A probe cancelled before any outcome (dispatcher stop mid-POST,
+        # client disconnect) never records success/failure/neutral; the
+        # slot must re-open by time, not stay pinned forever (review
+        # finding: permanent ejection in a multi-backend set).
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=1, recovery_seconds=10.0,
+                            clock=clock)
+        br.record_failure()
+        clock.t = 11.0
+        br.begin_probe()             # probe vanishes without an outcome
+        assert not br.available()
+        clock.t = 22.0               # one cooldown of silence
+        assert br.available()
+
+    def test_stale_success_without_inflight_probe_does_not_close(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=1, recovery_seconds=10.0,
+                            clock=clock)
+        br.record_failure()
+        clock.t = 11.0
+        br.begin_probe()
+        br.record_neutral()          # probe resolved 503: slot freed
+        br.record_success()          # straggler from before the trip
+        assert br.state == "half_open"  # only a real probe's 200 closes
+
+    def test_half_open_probe_failure_reopens_with_fresh_cooldown(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=1, recovery_seconds=10.0,
+                            clock=clock)
+        br.record_failure()
+        clock.t = 11.0
+        br.begin_probe()
+        assert br.record_failure()  # probe failed → open again (an event)
+        assert br.state == "open"
+        clock.t = 20.0  # cooldown restarts at the probe failure (t=11)
+        assert not br.available()
+        clock.t = 21.5
+        assert br.available()
+
+
+# ---------------------------------------------------------------------------
+# Retry budget + backoff
+# ---------------------------------------------------------------------------
+
+class TestRetry:
+    def test_backoff_doubles_jitters_and_caps(self):
+        rng = random.Random(7)
+        for attempt, ceiling in ((1, 0.1), (2, 0.2), (3, 0.4), (9, 1.0)):
+            d = backoff_s(attempt, base=0.1, cap=1.0, rng=rng)
+            assert ceiling / 2 <= d <= ceiling
+        assert backoff_s(1, base=0.0, cap=1.0) == 0.0
+        # Unbounded attempt counts (broker patience is 1440 deliveries)
+        # must stay at the cap, not overflow float and skip the backoff.
+        huge = backoff_s(1440, base=60.0, cap=150.0, rng=rng)
+        assert 75.0 <= huge <= 150.0
+
+    def test_budget_limits_retries_to_a_fraction_of_requests(self):
+        budget = RetryBudget(ratio=0.2, reserve=2.0)
+        # Reserve spends first...
+        assert budget.try_retry() and budget.try_retry()
+        assert not budget.try_retry()
+        # ...then retries track ~ratio of ordinary requests.
+        for _ in range(10):
+            budget.on_request()
+        assert budget.try_retry()
+        assert not budget.try_retry()
+
+
+# ---------------------------------------------------------------------------
+# Health-aware pick (ejection / redistribution / last resort)
+# ---------------------------------------------------------------------------
+
+def _health(clock=None, **policy):
+    return BackendHealth(policy=ResiliencePolicy(**policy),
+                         metrics=MetricsRegistry(),
+                         clock=clock or FakeClock(),
+                         rng=random.Random(3))
+
+
+class TestBackendHealth:
+    BACKENDS = [("http://a:1/v1/x", 1.0), ("http://b:1/v1/x", 1.0)]
+
+    def test_open_backend_is_ejected_and_weight_redistributes(self):
+        h = _health(failure_threshold=1)
+        h.record_failure("http://a:1/v1/x")
+        picks = {h.pick(self.BACKENDS) for _ in range(20)}
+        assert picks == {"http://b:1/v1/x"}
+        ej = h.metrics.counter("ai4e_resilience_ejections_total", "")
+        assert ej.value(backend="a:1") == 20
+
+    def test_all_open_probes_least_recently_failed(self):
+        clock = FakeClock()
+        h = _health(clock=clock, failure_threshold=1,
+                    recovery_seconds=1000.0)
+        clock.t = 1.0
+        h.record_failure("http://a:1/v1/x")
+        clock.t = 2.0
+        h.record_failure("http://b:1/v1/x")
+        # Both dark, neither cooled down: probe the one that failed FIRST.
+        assert h.pick(self.BACKENDS) == "http://a:1/v1/x"
+        # A successful forced probe closes the breaker — the dark set
+        # found its way back without any operator.
+        h.record_success("http://a:1/v1/x")
+        assert h.state("http://a:1/v1/x") == "closed"
+
+    def test_exclude_reaches_a_different_backend(self):
+        h = _health()
+        for _ in range(10):
+            assert h.pick(self.BACKENDS,
+                          exclude=["http://a:1/v1/x"]) == "http://b:1/v1/x"
+        # Excluding everything falls back to the full set, never empties.
+        assert h.pick(self.BACKENDS,
+                      exclude=[u for u, _ in self.BACKENDS]) in {
+                          u for u, _ in self.BACKENDS}
+
+    def test_observe_status_classifies(self):
+        h = _health(failure_threshold=1)
+        uri = "http://a:1/v1/x"
+        assert not h.observe_status(uri, 503)  # saturation: alive, no trip
+        assert h.state(uri) == "closed"
+        assert h.observe_status(uri, 500)
+        assert h.state(uri) == "open"
+        h2 = _health(failure_threshold=1)
+        assert not h2.observe_status(uri, 404)  # 4xx: request's fault
+        assert h2.state(uri) == "closed"
+
+    def test_breaker_open_transition_counted_once(self):
+        h = _health(failure_threshold=2)
+        uri = "http://a:1/v1/x"
+        assert not h.record_failure(uri)
+        assert h.record_failure(uri)
+        assert not h.record_failure(uri)  # already open: no second event
+        tr = h.metrics.counter("ai4e_resilience_transitions_total", "")
+        assert tr.value(backend="a:1", state="open") == 1
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher: failover, 5xx retry, duplicate suppression, redelivery backoff
+# ---------------------------------------------------------------------------
+
+def _resilient_platform(**kw):
+    cfg = dict(resilience=True, retry_delay=0.01,
+               resilience_retry_base_s=0.001,
+               resilience_recovery_seconds=0.05)
+    cfg.update(kw)
+    return LocalPlatform(PlatformConfig(**cfg), metrics=MetricsRegistry())
+
+
+def _completing_app(platform, calls, fail_first=0, status=500):
+    """Backend app that records hits and completes the task — after
+    answering ``status`` to the first ``fail_first`` POSTs."""
+    async def handler(request):
+        calls.append(request.headers["taskId"])
+        if len(calls) <= fail_first:
+            return web.Response(status=status)
+        # Conditional completion (update_status_if): the idempotent
+        # completion pattern docs/resilience.md prescribes for
+        # at-least-once transports.
+        platform.store.update_status_if(
+            request.headers["taskId"], "created", "completed", "completed")
+        return web.Response(text="ok")
+
+    app = web.Application()
+    app.router.add_post("/v1/be/x", handler)
+    return app
+
+
+async def _post_and_wait(platform, gw, path="/v1/pub/x", timeout=5.0):
+    resp = await gw.post(path, data=b"payload")
+    assert resp.status == 200
+    tid = (await resp.json())["TaskId"]
+    end = asyncio.get_running_loop().time() + timeout
+    from ai4e_tpu.taskstore import TaskStatus
+    while asyncio.get_running_loop().time() < end:
+        record = platform.store.get(tid)
+        if record.canonical_status in TaskStatus.TERMINAL:
+            return tid, record
+        await asyncio.sleep(0.01)
+    return tid, platform.store.get(tid)
+
+
+class TestDispatcherResilience:
+    def test_connection_error_fails_over_to_live_backend(self):
+        async def main():
+            platform = _resilient_platform()
+            calls = []
+            be = await serve(_completing_app(platform, calls))
+            live = str(be.make_url("/v1/be/x"))
+            dead = "http://127.0.0.1:9/v1/be/x"
+            platform.publish_async_api("/v1/pub/x", [(dead, 1.0), (live, 1.0)])
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                for _ in range(6):
+                    _, record = await _post_and_wait(platform, gw)
+                    assert record.canonical_status == "completed", record
+                failovers = platform.metrics.counter(
+                    "ai4e_resilience_failovers_total", "")
+                ejections = platform.metrics.counter(
+                    "ai4e_resilience_ejections_total", "")
+                # The dead host either cost an in-delivery failover or —
+                # once its breaker opened — was ejected from the pick.
+                assert (failovers.value(component="dispatcher")
+                        + ejections.value(backend="127.0.0.1:9")) > 0
+            finally:
+                await platform.stop()
+                await gw.close()
+                await be.close()
+
+        run(main())
+
+    def test_transient_500_is_retried_not_terminal(self):
+        async def main():
+            platform = _resilient_platform()
+            calls = []
+            be = await serve(_completing_app(platform, calls, fail_first=1))
+            platform.publish_async_api("/v1/pub/x",
+                                       str(be.make_url("/v1/be/x")))
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                _, record = await _post_and_wait(platform, gw)
+                assert record.canonical_status == "completed", record
+                assert len(calls) >= 2  # the 500 was retried
+                retries = platform.metrics.counter(
+                    "ai4e_resilience_retries_total", "")
+                assert retries.value(component="dispatcher") >= 1
+            finally:
+                await platform.stop()
+                await gw.close()
+                await be.close()
+
+        run(main())
+
+    def test_500_without_resilience_stays_permanent(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.01),
+                                     metrics=MetricsRegistry())
+            calls = []
+            be = await serve(_completing_app(platform, calls, fail_first=99))
+            platform.publish_async_api("/v1/pub/x",
+                                       str(be.make_url("/v1/be/x")))
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                _, record = await _post_and_wait(platform, gw)
+                assert record.canonical_status == "failed", record
+                assert len(calls) == 1  # single attempt, byte-identical
+            finally:
+                await platform.stop()
+                await gw.close()
+                await be.close()
+
+        run(main())
+
+    def test_duplicate_message_for_terminal_task_is_suppressed(self):
+        async def main():
+            platform = _resilient_platform()
+            calls = []
+            be = await serve(_completing_app(platform, calls))
+            platform.publish_async_api("/v1/pub/x",
+                                       str(be.make_url("/v1/be/x")))
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                tid, record = await _post_and_wait(platform, gw)
+                assert record.canonical_status == "completed"
+                executed = len(calls)
+                # Duplicate publish (the lease-expiry hazard): the message
+                # must complete off the broker without re-POSTing.
+                platform.broker.publish(platform.store.get(tid))
+                await asyncio.sleep(0.1)
+                assert len(calls) == executed
+                dup = platform.metrics.counter("ai4e_dispatch_total", "")
+                assert dup.value(outcome="duplicate", queue="/v1/be/x",
+                                 backend="") == 1
+            finally:
+                await platform.stop()
+                await gw.close()
+                await be.close()
+
+        run(main())
+
+    def test_redelivery_delay_is_jittered_exponential_capped_by_lease(self):
+        from ai4e_tpu.broker import InMemoryBroker
+        from ai4e_tpu.broker.dispatcher import Dispatcher
+        from ai4e_tpu.broker.queue import Message
+        from ai4e_tpu.service import LocalTaskManager
+        from ai4e_tpu.taskstore import InMemoryTaskStore
+
+        broker = InMemoryBroker(lease_seconds=10.0)
+        d = Dispatcher(broker, "/v1/q", "http://b/v1/q",
+                       LocalTaskManager(InMemoryTaskStore()),
+                       retry_delay=1.0, metrics=MetricsRegistry(),
+                       rng=random.Random(0))
+        by_count = {}
+        for count in (1, 2, 3, 4, 10):
+            delays = [d._redelivery_delay(
+                Message(task_id="t", endpoint="/v1/q",
+                        delivery_count=count)) for _ in range(50)]
+            # Jitter band [d/2, d]; cap = lease/2 = 5 s — a retry can
+            # never outlive its own lease.
+            ceiling = min(5.0, 1.0 * 2 ** (count - 1))
+            assert all(ceiling / 2 <= x <= ceiling for x in delays), (
+                count, min(delays), max(delays))
+            by_count[count] = sum(delays) / len(delays)
+        assert by_count[1] < by_count[2] < by_count[3]
+        assert by_count[10] <= 5.0
+
+    def test_breaker_open_backs_off_admission_limiter(self):
+        # Breaker outcomes feed the admission limiter's backoff signal:
+        # an opened breaker shrinks the queue's fan-out immediately.
+        async def main():
+            platform = _resilient_platform(
+                admission=True, resilience_failure_threshold=2,
+                admission_initial_limit=64)
+            platform.publish_async_api("/v1/pub/x",
+                                       "http://127.0.0.1:9/v1/be/x")
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            scope = platform.admission.scope("dispatch:/v1/be/x")
+            before = scope.limit
+            try:
+                resp = await gw.post("/v1/pub/x", data=b"p")
+                assert resp.status == 200
+                for _ in range(200):
+                    if scope.limit < before:
+                        break
+                    await asyncio.sleep(0.01)
+                assert scope.limit < before
+            finally:
+                await platform.stop()
+                await gw.close()
+
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# Gateway sync proxy: failover on connection error
+# ---------------------------------------------------------------------------
+
+class TestGatewaySyncResilience:
+    def test_sync_proxy_fails_over_instead_of_502(self):
+        async def main():
+            platform = _resilient_platform()
+            hits = []
+
+            async def ok(request):
+                hits.append(1)
+                return web.Response(text="pong")
+
+            app = web.Application()
+            app.router.add_post("/v1/be/x", ok)
+            be = await serve(app)
+            live = str(be.make_url("/v1/be/x"))
+            dead = "http://127.0.0.1:9/v1/be/x"
+            platform.publish_sync_api("/v1/pub/x", [(dead, 1.0), (live, 1.0)])
+            gw = await serve(platform.gateway.app)
+            try:
+                for _ in range(8):
+                    resp = await gw.post("/v1/pub/x", data=b"ping")
+                    assert resp.status == 200, await resp.text()
+                assert len(hits) == 8
+            finally:
+                await gw.close()
+                await be.close()
+
+        run(main())
+
+    def test_sync_proxy_all_dead_still_answers_502(self):
+        async def main():
+            platform = _resilient_platform()
+            platform.publish_sync_api("/v1/pub/x",
+                                      "http://127.0.0.1:9/v1/be/x")
+            gw = await serve(platform.gateway.app)
+            try:
+                resp = await gw.post("/v1/pub/x", data=b"ping")
+                assert resp.status == 502
+            finally:
+                await gw.close()
+
+        run(main())
+
+    def test_sync_proxy_single_attempt_without_resilience(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(),
+                                     metrics=MetricsRegistry())
+            platform.publish_sync_api("/v1/pub/x",
+                                      "http://127.0.0.1:9/v1/be/x")
+            gw = await serve(platform.gateway.app)
+            try:
+                resp = await gw.post("/v1/pub/x", data=b"ping")
+                assert resp.status == 502  # unchanged pre-resilience answer
+            finally:
+                await gw.close()
+
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# Config surface
+# ---------------------------------------------------------------------------
+
+class TestConfigSurface:
+    def test_env_knobs_reach_the_policy(self):
+        from ai4e_tpu.config import PlatformSection
+        sec = PlatformSection.from_env(env={
+            "AI4E_PLATFORM_RESILIENCE": "1",
+            "AI4E_PLATFORM_RESILIENCE_FAILURE_THRESHOLD": "9",
+            "AI4E_PLATFORM_RESILIENCE_RECOVERY_SECONDS": "2.5",
+        })
+        cfg = sec.to_platform_config()
+        assert cfg.resilience is True
+        platform = LocalPlatform(cfg, metrics=MetricsRegistry())
+        assert platform.resilience.policy.failure_threshold == 9
+        assert platform.resilience.policy.recovery_seconds == 2.5
+
+    def test_default_platform_has_no_resilience_state(self):
+        platform = LocalPlatform(PlatformConfig(), metrics=MetricsRegistry())
+        assert platform.resilience is None
+        assert platform.gateway._resilience is None
+        d = platform.dispatchers
+        assert d.resilience is None
