@@ -1,0 +1,41 @@
+"""ai4e-lint — AST-based platform-invariant analyzer (docs/analysis.md).
+
+The platform is ~18k LoC of heavily concurrent asyncio serving code, and
+the same hand-findable bug classes kept reappearing in review: dispatch
+metrics silently landing in ``DEFAULT_REGISTRY`` instead of the assembly
+registry, terminal-task-status clobbers causing double completions (the
+PR 3 chaos harness caught a live one), blocking calls stalling the event
+loop. Each rule here encodes one of those past bugs as a machine-checked
+invariant, so later perf/refactor PRs can move fast without regressing
+them (the "systematic, not artisanal" stance of PAPERS.md's adaptive-
+orchestration paper, applied to correctness invariants).
+
+Usage::
+
+    python -m ai4e_tpu.analysis ai4e_tpu/          # exit 1 on findings
+    python -m ai4e_tpu.analysis --json ai4e_tpu/   # machine-readable
+    python -m ai4e_tpu.analysis --list-rules
+
+Suppression: ``# ai4e: noqa[AIL001]`` on the flagged line (comma-list for
+several rules). Grandfathering: a checked-in baseline file where every
+entry carries a written justification (``--baseline``/``--write-baseline``).
+
+Stdlib-only by design: the CI gate must not need the JAX toolchain.
+"""
+
+from .core import (AnalysisResult, Analyzer, Baseline, BaselineError,
+                   Finding, ModuleContext, ProjectContext, ProjectRule, Rule)
+from .rules import ALL_RULES
+
+__all__ = [
+    "ALL_RULES",
+    "AnalysisResult",
+    "Analyzer",
+    "Baseline",
+    "BaselineError",
+    "Finding",
+    "ModuleContext",
+    "ProjectContext",
+    "ProjectRule",
+    "Rule",
+]

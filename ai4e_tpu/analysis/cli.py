@@ -1,0 +1,113 @@
+"""``python -m ai4e_tpu.analysis`` — the CI gate entrypoint.
+
+Exit codes: 0 clean (baselined findings allowed), 1 non-baselined
+findings, 2 configuration error (unreadable baseline, entry without a
+justification). Stdlib-only: the gate runs without the JAX toolchain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import Analyzer, Baseline, BaselineError
+from .rules import ALL_RULES
+
+DEFAULT_BASELINE = "analysis_baseline.json"
+
+
+def _build_rules(select: str | None, ignore: str | None):
+    rules = [cls() for cls in ALL_RULES]
+    if select:
+        wanted = {r.strip().upper() for r in select.split(",") if r.strip()}
+        rules = [r for r in rules if r.rule_id in wanted]
+    if ignore:
+        dropped = {r.strip().upper() for r in ignore.split(",") if r.strip()}
+        rules = [r for r in rules if r.rule_id not in dropped]
+    return rules
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ai4e_tpu.analysis",
+        description="ai4e-lint: AST-based platform-invariant analyzer "
+                    "(docs/analysis.md)")
+    parser.add_argument("paths", nargs="*", default=["ai4e_tpu"],
+                        help="files/directories to analyze "
+                             "(default: ai4e_tpu)")
+    parser.add_argument("--root", default=None,
+                        help="repo root for relative paths and the docs/ "
+                             "surface AIL006 correlates against "
+                             "(default: cwd)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: {DEFAULT_BASELINE} "
+                             "under --root when it exists)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline file")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write current findings to the baseline file "
+                             "with EMPTY justifications (the next run "
+                             "refuses the file until each is filled in)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    parser.add_argument("--select", default=None, metavar="IDS",
+                        help="comma-separated rule ids to run")
+    parser.add_argument("--ignore", default=None, metavar="IDS",
+                        help="comma-separated rule ids to skip")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.rule_id}  {cls.name:<26} {cls.description}")
+        return 0
+
+    root = os.path.abspath(args.root or os.getcwd())
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    baseline = Baseline()
+    if not args.no_baseline and not args.write_baseline:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except BaselineError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+
+    rules = _build_rules(args.select, args.ignore)
+    analyzer = Analyzer(rules, root=root, baseline=baseline)
+    result = analyzer.run([os.path.join(root, p)
+                           if not os.path.isabs(p) else p
+                           for p in args.paths])
+
+    if args.write_baseline:
+        Baseline.write(baseline_path, result.findings)
+        print(f"wrote {len(result.findings)} finding(s) to {baseline_path}; "
+              "fill in every justification before committing")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in result.findings],
+            "baselined": [f.to_dict() for f in result.baselined],
+            "suppressed": result.suppressed,
+            "stale_baseline": result.stale_baseline,
+            "files_scanned": result.files_scanned,
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for e in result.stale_baseline:
+            print(f"warning: stale baseline entry {e.get('fingerprint')} "
+                  f"({e.get('rule')} in {e.get('path')}) — finding no "
+                  "longer exists; remove it", file=sys.stderr)
+        n = len(result.findings)
+        print(f"ai4e-lint: {result.files_scanned} file(s), {n} finding(s), "
+              f"{len(result.baselined)} baselined, "
+              f"{result.suppressed} suppressed")
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

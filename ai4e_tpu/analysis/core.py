@@ -1,0 +1,371 @@
+"""Rule framework: findings, suppression, baseline, the analyzer driver.
+
+Design notes (docs/analysis.md has the operator view):
+
+- **Rules are AST visitors.** A per-module rule subclasses ``Rule`` and
+  yields ``Finding``s from ``check_module``; a whole-project rule (e.g.
+  AIL006 config-drift, which correlates code against ``docs/``) subclasses
+  ``ProjectRule`` and runs once after every module is parsed.
+- **Suppression is per line.** ``# ai4e: noqa[AIL001]`` (comma-list
+  allowed) on the line a finding is reported at suppresses it. There is
+  deliberately no file- or rule-wide off switch — a rule that needs one is
+  a rule that should not have shipped.
+- **The baseline grandfathers, it does not bless.** Baselined findings are
+  matched by a line-number-free fingerprint (rule | path | enclosing
+  symbol | normalized source line) so refactors that merely move code
+  don't resurrect them, and every entry must carry a human-written
+  justification — an empty one fails the run louder than the finding
+  itself would have.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field, replace
+
+# Matched only inside COMMENT tokens (tokenize), so the leading "#" is
+# implicit — the marker can share a comment with other annotations
+# ("# noqa: BLE001; ai4e: noqa[AIL005] — reason").
+_NOQA_RE = re.compile(r"ai4e:\s*noqa\[([A-Za-z0-9_,\s]+)\]")
+
+# Rule id for files the analyzer itself cannot parse: a syntax error means
+# every other invariant is unverifiable, which is itself a finding.
+PARSE_ERROR_RULE = "AIL000"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str           # stable rule id, e.g. "AIL001"
+    path: str           # repo-relative posix path
+    line: int           # 1-based
+    col: int            # 0-based
+    message: str
+    symbol: str = ""    # enclosing qualname ("Class.method"), "" at module level
+    snippet: str = ""   # stripped source of the flagged line
+    # k-th identical (rule, path, symbol, snippet) occurrence in source
+    # order, assigned by Analyzer.run. Part of the fingerprint: without
+    # it, one baseline entry would silently grandfather every NEW
+    # byte-identical finding added to the same symbol later. Removing an
+    # earlier twin shifts later ordinals — conservative by design: the
+    # survivor resurfaces for re-justification rather than hiding.
+    ordinal: int = 0
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-free identity for baseline matching: stable across
+        pure moves/reformats of surrounding code, invalidated when the
+        flagged line itself (or its enclosing symbol) changes."""
+        norm = " ".join(self.snippet.split())
+        raw = f"{self.rule}|{self.path}|{self.symbol}|{norm}|{self.ordinal}"
+        return hashlib.sha1(raw.encode("utf-8")).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "col": self.col, "message": self.message, "symbol": self.symbol,
+            "snippet": self.snippet, "fingerprint": self.fingerprint,
+        }
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}:{self.col + 1}: {self.rule}{sym} {self.message}"
+
+
+@dataclass
+class ModuleContext:
+    """Everything a per-module rule sees."""
+    path: str                 # repo-relative posix path
+    abspath: str
+    tree: ast.Module
+    source: str
+    lines: list[str] = field(default_factory=list)
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str,
+                symbol: str = "") -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, path=self.path, line=line, col=col,
+                       message=message, symbol=symbol,
+                       snippet=self.snippet(line))
+
+
+@dataclass
+class ProjectContext:
+    """Everything a whole-project rule sees: every parsed module plus the
+    repo root (for correlating against non-Python surfaces like docs/)."""
+    root: str
+    modules: list[ModuleContext]
+
+
+class Rule:
+    """Per-module rule. Subclasses set the class attributes and implement
+    ``check_module``."""
+
+    rule_id: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check_module(self, ctx: ModuleContext):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class ProjectRule(Rule):
+    """Whole-project rule: runs once, after every module is parsed."""
+
+    def check_module(self, ctx: ModuleContext):
+        return ()
+
+    def check_project(self, ctx: ProjectContext):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+# -- shared AST helpers (used by several rules) ------------------------------
+
+
+def import_aliases(tree: ast.AST) -> dict[str, str]:
+    """Map local names to the canonical dotted name they import, across the
+    whole module (function-level imports included — the codebase uses lazy
+    imports heavily for optional deps and cycle breaking).
+
+    ``import time as t``           → {"t": "time"}
+    ``from time import sleep``     → {"sleep": "time.sleep"}
+    ``from urllib import request`` → {"request": "urllib.request"}
+    """
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_name(node: ast.AST, aliases: dict[str, str] | None = None
+                ) -> str | None:
+    """Resolve an attribute chain to a dotted name; the leftmost ``Name``
+    goes through the module's import aliases when given. Returns None for
+    chains rooted at calls/subscripts (dynamic — unresolvable)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    root = node.id
+    if aliases and root in aliases:
+        root = aliases[root]
+    parts.append(root)
+    return ".".join(reversed(parts))
+
+
+def enclosing_symbol(stack: list[ast.AST]) -> str:
+    names = [getattr(n, "name", "") for n in stack
+             if isinstance(n, (ast.ClassDef, ast.FunctionDef,
+                               ast.AsyncFunctionDef))]
+    return ".".join(n for n in names if n)
+
+
+# -- suppression -------------------------------------------------------------
+
+
+def noqa_lines(source: str) -> dict[int, frozenset[str]]:
+    """Line → suppressed rule ids, from ``# ai4e: noqa[AIL001,AIL005]``
+    comments. Tokenize-based so strings containing the marker don't count."""
+    out: dict[int, frozenset[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(iter(source.splitlines(True)).__next__)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _NOQA_RE.search(tok.string)
+            if not m:
+                continue
+            rules = frozenset(r.strip().upper()
+                              for r in m.group(1).split(",") if r.strip())
+            if rules:
+                out[tok.start[0]] = out.get(tok.start[0], frozenset()) | rules
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+class BaselineError(Exception):
+    """The baseline file is unusable (unparseable, or an entry has no
+    written justification) — a configuration error, exit 2, distinct from
+    findings (exit 1)."""
+
+
+class Baseline:
+    """Checked-in grandfather list. Schema::
+
+        {"version": 1,
+         "findings": [{"rule": "AIL005", "path": "...", "symbol": "...",
+                       "fingerprint": "...", "justification": "why"}]}
+    """
+
+    def __init__(self, entries: list[dict] | None = None, path: str = ""):
+        self.path = path
+        self.entries = entries or []
+        self._by_fp = {e.get("fingerprint", ""): e for e in self.entries}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+        except FileNotFoundError:
+            return cls([], path)
+        except (OSError, ValueError) as exc:
+            raise BaselineError(f"cannot read baseline {path}: {exc}") from exc
+        entries = data.get("findings", [])
+        for e in entries:
+            if not str(e.get("justification", "")).strip():
+                raise BaselineError(
+                    f"baseline {path}: entry {e.get('fingerprint', '?')} "
+                    f"({e.get('rule', '?')} in {e.get('path', '?')}) has no "
+                    "written justification — baselining without a reason is "
+                    "just hiding the finding")
+        return cls(entries, path)
+
+    def match(self, finding: Finding) -> dict | None:
+        return self._by_fp.get(finding.fingerprint)
+
+    def stale(self, findings: list[Finding]) -> list[dict]:
+        """Entries whose finding no longer exists — candidates for removal."""
+        live = {f.fingerprint for f in findings}
+        return [e for e in self.entries
+                if e.get("fingerprint", "") not in live]
+
+    @staticmethod
+    def write(path: str, findings: list[Finding]) -> None:
+        """Seed a baseline from current findings. Justifications are left
+        EMPTY on purpose: the very next run refuses the file until a human
+        writes one per entry — grandfathering is a decision, not a default."""
+        entries = [{"rule": f.rule, "path": f.path, "symbol": f.symbol,
+                    "snippet": f.snippet, "fingerprint": f.fingerprint,
+                    "justification": ""} for f in findings]
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({"version": 1, "findings": entries}, fh, indent=2)
+            fh.write("\n")
+
+
+# -- analyzer ----------------------------------------------------------------
+
+
+@dataclass
+class AnalysisResult:
+    findings: list[Finding]            # active (not suppressed, not baselined)
+    baselined: list[Finding]
+    suppressed: int
+    stale_baseline: list[dict]
+    files_scanned: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def _iter_py_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            out.extend(os.path.join(dirpath, f)
+                       for f in sorted(filenames) if f.endswith(".py"))
+    return out
+
+
+class Analyzer:
+    def __init__(self, rules: list[Rule], root: str | None = None,
+                 baseline: Baseline | None = None):
+        self.rules = rules
+        self.root = os.path.abspath(root) if root else os.getcwd()
+        self.baseline = baseline or Baseline()
+
+    def _relpath(self, path: str) -> str:
+        rel = os.path.relpath(os.path.abspath(path), self.root)
+        return rel.replace(os.sep, "/")
+
+    def run(self, paths: list[str]) -> AnalysisResult:
+        files = _iter_py_files(paths)
+        modules: list[ModuleContext] = []
+        raw: list[Finding] = []
+        suppressions: dict[str, dict[int, frozenset[str]]] = {}
+        for path in files:
+            rel = self._relpath(path)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    source = fh.read()
+                tree = ast.parse(source, filename=path)
+            except (OSError, SyntaxError, ValueError) as exc:
+                line = getattr(exc, "lineno", 1) or 1
+                raw.append(Finding(
+                    rule=PARSE_ERROR_RULE, path=rel, line=line, col=0,
+                    message=f"cannot parse: {exc}", snippet=""))
+                continue
+            ctx = ModuleContext(path=rel, abspath=os.path.abspath(path),
+                                tree=tree, source=source,
+                                lines=source.splitlines())
+            modules.append(ctx)
+            suppressions[rel] = noqa_lines(source)
+            for rule in self.rules:
+                if isinstance(rule, ProjectRule):
+                    continue
+                raw.extend(rule.check_module(ctx))
+        project_ctx = ProjectContext(root=self.root, modules=modules)
+        for rule in self.rules:
+            if isinstance(rule, ProjectRule):
+                raw.extend(rule.check_project(project_ctx))
+
+        # Assign occurrence ordinals in source order so byte-identical
+        # findings in the same symbol get distinct fingerprints.
+        raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        seen_keys: dict[tuple, int] = {}
+        stamped: list[Finding] = []
+        for f in raw:
+            key = (f.rule, f.path, f.symbol, " ".join(f.snippet.split()))
+            k = seen_keys.get(key, 0)
+            seen_keys[key] = k + 1
+            stamped.append(replace(f, ordinal=k) if k else f)
+        raw = stamped
+
+        active: list[Finding] = []
+        baselined: list[Finding] = []
+        suppressed = 0
+        for f in raw:
+            if f.rule in suppressions.get(f.path, {}).get(f.line, frozenset()):
+                suppressed += 1
+                continue
+            if self.baseline.match(f) is not None:
+                baselined.append(f)
+                continue
+            active.append(f)
+        active.sort(key=lambda f: (f.path, f.line, f.rule))
+        matched = baselined + active
+        return AnalysisResult(
+            findings=active, baselined=baselined, suppressed=suppressed,
+            stale_baseline=self.baseline.stale(matched),
+            files_scanned=len(files))

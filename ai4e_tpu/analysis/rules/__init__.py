@@ -1,0 +1,19 @@
+"""Rule registry — one module per rule, ids are append-only stable."""
+
+from .blocking import BlockingCallInAsync
+from .config_drift import ConfigDrift
+from .fire_and_forget import FireAndForgetTask
+from .registry_leak import MetricsRegistryLeak
+from .status_clobber import TerminalStatusClobber
+from .swallowed import SwallowedException
+
+ALL_RULES = [
+    BlockingCallInAsync,
+    MetricsRegistryLeak,
+    TerminalStatusClobber,
+    FireAndForgetTask,
+    SwallowedException,
+    ConfigDrift,
+]
+
+__all__ = ["ALL_RULES"] + [cls.__name__ for cls in ALL_RULES]

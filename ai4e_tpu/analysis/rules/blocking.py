@@ -1,0 +1,105 @@
+"""AIL001 — blocking call inside ``async def``.
+
+The bug class: one ``time.sleep`` (or synchronous HTTP/subprocess/file
+I/O) on a coroutine path stalls the WHOLE event loop — every in-flight
+request on that loop eats the stall as tail latency, and under load the
+gateway's adaptive limiter reads it as backend congestion and sheds.
+The platform's convention is explicit: sleeps are ``asyncio.sleep``,
+outbound HTTP is aiohttp, and genuinely-blocking work hops off the loop
+via ``asyncio.to_thread`` / ``run_in_executor`` (which pass the callable
+without calling it, so they never trip this rule).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_name, enclosing_symbol, import_aliases
+
+# Exact canonical call names that block the loop.
+BLOCKING_CALLS = frozenset({
+    "time.sleep",
+    "os.system",
+    "os.popen",
+    "os.wait",
+    "os.waitpid",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.getoutput",
+    "subprocess.getstatusoutput",
+    "subprocess.Popen",
+    "urllib.request.urlopen",
+    "urllib.request.urlretrieve",
+})
+
+# Module prefixes where EVERY call is synchronous network I/O.
+BLOCKING_PREFIXES = ("requests.", "http.client.", "urllib3.")
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rule: "BlockingCallInAsync", ctx):
+        self.rule = rule
+        self.ctx = ctx
+        self.aliases = import_aliases(ctx.tree)
+        self.findings = []
+        # Innermost function kind: True inside async def, False inside a
+        # nested sync def/lambda (a sync helper defined in a coroutine runs
+        # wherever it is CALLED — commonly an executor — so it resets the
+        # context rather than inheriting it).
+        self._stack: list[ast.AST] = []
+        self._async: list[bool] = []
+
+    def _enter(self, node, is_async: bool):
+        self._stack.append(node)
+        self._async.append(is_async)
+        self.generic_visit(node)
+        self._async.pop()
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._enter(node, False)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._enter(node, True)
+
+    def visit_Lambda(self, node):
+        self._stack.append(node)
+        self._async.append(False)
+        self.generic_visit(node)
+        self._async.pop()
+        self._stack.pop()
+
+    def visit_ClassDef(self, node):
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_Call(self, node):
+        if self._async and self._async[-1]:
+            name = dotted_name(node.func, self.aliases)
+            if name and (name in BLOCKING_CALLS
+                         or name.startswith(BLOCKING_PREFIXES)):
+                self.findings.append(self.ctx.finding(
+                    self.rule.rule_id, node,
+                    f"blocking call {name}() inside async def stalls the "
+                    "event loop (use the asyncio/aiohttp equivalent or "
+                    "asyncio.to_thread)",
+                    symbol=enclosing_symbol(self._stack)))
+        self.generic_visit(node)
+
+
+class BlockingCallInAsync(Rule):
+    rule_id = "AIL001"
+    name = "blocking-call-in-async"
+    description = ("time.sleep / synchronous HTTP / subprocess / socket "
+                   "calls inside async def stall the event loop")
+
+    def check_module(self, ctx):
+        v = _Visitor(self, ctx)
+        v.visit(ctx.tree)
+        return v.findings

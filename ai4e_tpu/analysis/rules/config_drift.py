@@ -1,0 +1,192 @@
+"""AIL006 — config/docs drift on the ``AI4E_*`` env-var surface.
+
+The bug class: a knob exists in code but no operator can discover it (it
+appears in no doc), or a doc names a variable that no longer exists (a
+rename that missed the docs — the operator sets it, nothing happens, and
+``FrameworkConfig.from_env``'s unknown-variable check may even refuse
+startup). Config drift is the quiet variant of an outage: the knob you
+need during an incident is the one that was never written down.
+
+Three checks, run once over the whole project:
+
+1. every env var derived from an ``@_env_section("AI4E_X_")`` config
+   dataclass field (``AI4E_X_<FIELD>``) appears somewhere under ``docs/``
+   or ``README.md``;
+2. every direct ``os.environ``/``os.getenv`` read of an ``AI4E_*``
+   literal in code appears in the docs too;
+3. every ``AI4E_*`` token mentioned in the docs corresponds to a real
+   config field or direct read (exact match, or a prefix of one — docs
+   may legitimately write ``AI4E_PLATFORM_RESILIENCE*`` for a family).
+
+Out-of-band namespaces (``AI4E_FAULT_*`` fault injection,
+``AI4E_CHAOS_*`` chaos-harness seeds) are exempt from check 3's
+must-exist-as-config-field requirement — they are read by test/failure
+paths, never part of the typed config (``config.py`` exempts them from
+its own unknown-variable check for the same reason) — but code reads in
+them still must be documented (check 2).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+# The SAME tuple FrameworkConfig.from_env exempts from its
+# unknown-variable check — imported, not copied, so a namespace added
+# there can never silently diverge from what this rule enforces
+# (config.py is stdlib-only, so the analyzer stays dependency-free).
+from ...config import OUT_OF_BAND_ENV_PREFIXES as OUT_OF_BAND
+from ..core import Finding, ProjectRule, dotted_name, import_aliases
+
+_TOKEN_RE = re.compile(r"AI4E_[A-Z0-9_]*[A-Z0-9]")
+DOC_FILES = ("README.md",)
+DOC_DIRS = ("docs",)
+
+
+def _section_env_names(module) -> list[tuple[str, int, str]]:
+    """(env_name, lineno, field) for every ``@_env_section(prefix)`` class
+    field in the module."""
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        prefix = None
+        for dec in node.decorator_list:
+            if (isinstance(dec, ast.Call)
+                    and isinstance(dec.func, ast.Name)
+                    and dec.func.id == "_env_section"
+                    and dec.args
+                    and isinstance(dec.args[0], ast.Constant)
+                    and isinstance(dec.args[0].value, str)):
+                prefix = dec.args[0].value
+        if prefix is None:
+            continue
+        for stmt in node.body:
+            if (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)):
+                out.append((prefix + stmt.target.id.upper(),
+                            stmt.lineno, stmt.target.id))
+    return out
+
+
+def _direct_env_reads(module) -> list[tuple[str, int]]:
+    """(env_name, lineno) for os.environ.get("AI4E_...")/os.getenv/
+    environ["AI4E_..."] literals."""
+    aliases = import_aliases(module.tree)
+    out = []
+    for node in ast.walk(module.tree):
+        literal = None
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func, aliases) or ""
+            if name.endswith(("environ.get", "getenv")) and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    literal = arg.value
+        elif isinstance(node, ast.Subscript):
+            base = dotted_name(node.value, aliases) or ""
+            if base.endswith("environ"):
+                sl = node.slice
+                if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                    literal = sl.value
+        if literal and literal.startswith("AI4E_"):
+            out.append((literal, node.lineno))
+    return out
+
+
+class ConfigDrift(ProjectRule):
+    rule_id = "AIL006"
+    name = "config-drift"
+    description = ("every AI4E_* env var in code must be documented, and "
+                   "every documented one must exist in code")
+
+    def check_project(self, ctx):
+        findings: list[Finding] = []
+        known: dict[str, tuple[str, int]] = {}   # env name -> (path, line)
+        for module in ctx.modules:
+            for env_name, line, _field in _section_env_names(module):
+                known.setdefault(env_name, (module.path, line))
+            for env_name, line in _direct_env_reads(module):
+                known.setdefault(env_name, (module.path, line))
+        doc_tokens = self._doc_tokens(ctx.root)
+        if not known and not doc_tokens:
+            return findings
+        documented = {tok for tok, _loc, _family in doc_tokens}
+        # A FAMILY mention must be explicit — the token is followed by "*"
+        # (or "_*") in the doc text, e.g. AI4E_PLATFORM_RESILIENCE*.
+        # Without that requirement any documented var would silently
+        # "document" every future knob that merely extends its name
+        # (AI4E_PLATFORM_ADMISSION documenting AI4E_PLATFORM_ADMISSION_FOO),
+        # defeating the add-the-doc-row-in-the-same-PR guarantee.
+        families = {tok for tok, _loc, family in doc_tokens if family}
+
+        def _snippet(path: str, line: int) -> str:
+            try:
+                with open(os.path.join(ctx.root, path), encoding="utf-8") as fh:
+                    lines = fh.read().splitlines()
+                return lines[line - 1].strip() if 0 < line <= len(lines) else ""
+            except OSError:
+                return ""
+
+        # Checks 1+2: code side must be documented — exactly, or by an
+        # explicit starred family mention covering it.
+        for env_name, (path, line) in sorted(known.items()):
+            if env_name in documented or any(
+                    env_name == tok or env_name.startswith(tok + "_")
+                    for tok in families):
+                continue
+            findings.append(Finding(
+                self.rule_id, path, line, 0,
+                f"{env_name} is read by code but documented nowhere under "
+                "docs/ or README.md — operators cannot discover it",
+                snippet=_snippet(path, line)))
+
+        # Check 3: doc side must exist in code. Leniently here — prose that
+        # names a PREFIX of a real variable ("the AI4E_DEMO knobs") is not
+        # drift, it's writing.
+        for tok, (doc_path, line), _family in sorted(doc_tokens):
+            if tok in known:
+                continue
+            if any(name.startswith(tok) for name in known):
+                continue  # family/prefix mention
+            if tok.startswith(OUT_OF_BAND) or any(
+                    ns.startswith(tok) for ns in OUT_OF_BAND):
+                # In-namespace variable, or the namespace itself named
+                # without its trailing underscore ("the AI4E_CHAOS
+                # namespace") — prose, not drift.
+                continue
+            findings.append(Finding(
+                self.rule_id, doc_path, line, 0,
+                f"docs mention {tok} but no config field or env read "
+                "defines it — stale doc or a rename that missed the docs",
+                snippet=_snippet(doc_path, line)))
+        return findings
+
+    def _doc_tokens(self, root: str
+                    ) -> list[tuple[str, tuple[str, int], bool]]:
+        """(token, (doc path, line), is_family) — family = explicitly
+        starred in the doc text (``AI4E_X_*``)."""
+        out = []
+        paths: list[str] = []
+        for name in DOC_FILES:
+            p = os.path.join(root, name)
+            if os.path.isfile(p):
+                paths.append(p)
+        for d in DOC_DIRS:
+            base = os.path.join(root, d)
+            for dirpath, _dirnames, filenames in os.walk(base):
+                paths.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames) if f.endswith(".md"))
+        for path in paths:
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    text = fh.read()
+            except OSError:
+                continue
+            for i, line in enumerate(text.splitlines(), 1):
+                for m in _TOKEN_RE.finditer(line):
+                    rest = line[m.end():]
+                    family = rest.startswith("*") or rest.startswith("_*")
+                    out.append((m.group(0), (rel, i), family))
+        return out

@@ -1,0 +1,74 @@
+"""AIL004 — fire-and-forget ``create_task`` / ``ensure_future``.
+
+The bug class: spawning a task and dropping the handle. Two failure
+modes, both silent. (1) The event loop holds only a WEAK reference to
+tasks — a dropped handle can be garbage-collected mid-flight and the
+coroutine simply stops running. (2) An exception raised inside the task
+is reported nowhere until interpreter shutdown ("Task exception was
+never retrieved"), long after the context that could have handled it is
+gone. The platform idiom (``service/app.py``, ``broker/push.py``) is to
+add the task to a holder set with a done-callback discard::
+
+    t = loop.create_task(coro())
+    self._tasks.add(t)
+    t.add_done_callback(self._tasks.discard)
+
+The rule flags spawn calls used as bare expression statements — result
+not assigned, awaited, passed as an argument, or chained into
+``.add_done_callback``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, enclosing_symbol
+
+SPAWN_NAMES = frozenset({"create_task", "ensure_future"})
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rule, ctx):
+        self.rule = rule
+        self.ctx = ctx
+        self.findings = []
+        self._stack: list[ast.AST] = []
+
+    def _enter(self, node):
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_ClassDef = _enter
+    visit_FunctionDef = _enter
+    visit_AsyncFunctionDef = _enter
+
+    def visit_Expr(self, node):
+        call = node.value
+        if isinstance(call, ast.Call):
+            name = None
+            if isinstance(call.func, ast.Attribute):
+                name = call.func.attr
+            elif isinstance(call.func, ast.Name):
+                name = call.func.id
+            if name in SPAWN_NAMES:
+                self.findings.append(self.ctx.finding(
+                    self.rule.rule_id, node,
+                    f"result of {name}() dropped — the task can be "
+                    "garbage-collected mid-flight and its exceptions "
+                    "vanish; store the handle (holder set + "
+                    "add_done_callback discard) or await it",
+                    symbol=enclosing_symbol(self._stack)))
+        self.generic_visit(node)
+
+
+class FireAndForgetTask(Rule):
+    rule_id = "AIL004"
+    name = "fire-and-forget-task"
+    description = ("create_task/ensure_future results must be stored, "
+                   "awaited, or given a done-callback")
+
+    def check_module(self, ctx):
+        v = _Visitor(self, ctx)
+        v.visit(ctx.tree)
+        return v.findings

@@ -1,0 +1,127 @@
+"""AIL002 — metrics created on ``DEFAULT_REGISTRY`` despite an injected one.
+
+The bug class (the DispatcherPool bug fixed by hand in PR 3): a component
+accepts a ``metrics=``/``registry=`` parameter — the assembly plumbs its
+own ``MetricsRegistry`` through it — but some method creates or
+increments a series on the process-global ``DEFAULT_REGISTRY`` anyway.
+Nothing crashes; the series just silently lands in a registry nobody
+scrapes, and the counter is "missing" in the assembly's ``/metrics``.
+
+The ONE blessed default-resolution idiom is ``<param> or DEFAULT_REGISTRY``
+(what every component in the codebase uses). Anything else that routes a
+metric call at ``DEFAULT_REGISTRY`` inside such a class is flagged:
+
+- ``DEFAULT_REGISTRY.counter(...)`` directly in a method;
+- ``local = DEFAULT_REGISTRY`` (including the conditional
+  ``if metrics is None: metrics = DEFAULT_REGISTRY`` rebinding — the
+  form the replication/tracing leaks hid in) followed by a metric call
+  through the local;
+- ``self.metrics = DEFAULT_REGISTRY`` pinning the attribute to the
+  global despite the injectable parameter.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+
+INJECT_PARAMS = frozenset({"metrics", "registry"})
+METRIC_METHODS = frozenset({"counter", "gauge", "histogram",
+                            "inc", "dec", "set", "observe"})
+
+
+def _is_default_registry(node: ast.AST) -> bool:
+    """Name/attribute chain ending in DEFAULT_REGISTRY."""
+    if isinstance(node, ast.Name):
+        return node.id == "DEFAULT_REGISTRY"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "DEFAULT_REGISTRY"
+    return False
+
+
+def _ordered(node: ast.AST):
+    """Pre-order DFS — source order, which taint tracking needs (ast.walk
+    is breadth-first and would visit a later call before an earlier nested
+    assignment)."""
+    for child in ast.iter_child_nodes(node):
+        yield child
+        yield from _ordered(child)
+
+
+class MetricsRegistryLeak(Rule):
+    rule_id = "AIL002"
+    name = "metrics-registry-leak"
+    description = ("class accepts a metrics=/registry= parameter but routes "
+                   "metric calls at DEFAULT_REGISTRY")
+
+    def check_module(self, ctx):
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(ctx, node))
+        return findings
+
+    def _check_class(self, ctx, cls: ast.ClassDef):
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        injected: set[str] = set()
+        for m in methods:
+            args = m.args
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                if a.arg in INJECT_PARAMS:
+                    injected.add(a.arg)
+        if not injected:
+            return
+        params = frozenset(injected)
+        for m in methods:
+            yield from self._check_method(ctx, cls, m, params)
+
+    def _check_method(self, ctx, cls, method, params: frozenset[str]):
+        symbol = f"{cls.name}.{method.name}"
+        tainted: set[str] = set()
+        for node in _ordered(method):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        if _is_default_registry(node.value):
+                            # e.g. `if metrics is None: metrics =
+                            # DEFAULT_REGISTRY` — the conditional rebinding
+                            # the leak hides in. Taint; the metric call
+                            # through it is the finding. Any other value —
+                            # notably the blessed `metrics or
+                            # DEFAULT_REGISTRY` BoolOp — clears it.
+                            tainted.add(tgt.id)
+                        else:
+                            tainted.discard(tgt.id)
+                    elif (isinstance(tgt, ast.Attribute)
+                          and _is_default_registry(node.value)):
+                        yield ctx.finding(
+                            self.rule_id, node,
+                            f"{cls.name} accepts "
+                            f"{'/'.join(sorted(params))}= but pins "
+                            f"{ast.unparse(tgt)} to DEFAULT_REGISTRY — use "
+                            "the injected registry "
+                            "(`metrics or DEFAULT_REGISTRY`)",
+                            symbol=symbol)
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if not (isinstance(func, ast.Attribute)
+                        and func.attr in METRIC_METHODS):
+                    continue
+                target = func.value
+                direct = _is_default_registry(target)
+                via_taint = (isinstance(target, ast.Name)
+                             and target.id in tainted)
+                if direct or via_taint:
+                    what = ("DEFAULT_REGISTRY" if direct else
+                            f"{target.id} (rebound to DEFAULT_REGISTRY)")
+                    yield ctx.finding(
+                        self.rule_id, node,
+                        f"{cls.name} accepts "
+                        f"{'/'.join(sorted(params))}= but calls "
+                        f".{func.attr}() on {what} — series lands in the "
+                        "process-global registry, invisible to the "
+                        "assembly's /metrics (blessed default: "
+                        "`metrics or DEFAULT_REGISTRY`)",
+                        symbol=symbol)

@@ -1,0 +1,151 @@
+"""AIL003 — task-status write without a ``TaskStatus.TERMINAL`` re-check.
+
+The bug class (the PR 3 double-completion, caught live by the chaos
+harness): a delivery path writes task status unconditionally — e.g. the
+"Awaiting service availability" backpressure write — on a message that
+can be a REDELIVERY of a task that already completed. The write clobbers
+the terminal status back to a live one, the redelivery then completes the
+task a second time, and the client observes two completions (the exact
+invariant ``chaos/invariants.py`` rejects).
+
+The rule: any status-writing call (``update_task_status`` /
+``update_status`` / ``complete_task`` / ``fail_task`` / ``_try_update``)
+must sit in a function that visibly re-checks terminality, meaning the
+function either
+
+- tests membership against ``TaskStatus.TERMINAL`` (``... in`` /
+  ``not in``), or
+- calls one of the blessed guard helpers the task store exports —
+  ``update_status_if`` / ``requeue_if`` (atomic conditional transitions),
+  ``_suppress_duplicate``, or the shared ``TaskManagerBase.is_terminal``
+  probe — or
+- is itself registered through ``api_async_func`` (the service shell
+  re-checks terminality before invoking the handler — the shell is the
+  guard).
+
+Exemptions: modules under ``taskstore/`` (the guard layer itself — the
+store's writers are the primitives the helpers are built FROM), and
+functions that are themselves thin writer shims (``_try_update`` etc.) —
+their CALLERS are where the decision is made and checked.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, enclosing_symbol
+
+WRITER_CALLS = frozenset({
+    "update_task_status", "update_status", "complete_task", "fail_task",
+    "_try_update",
+})
+# Functions that ARE the write plumbing: wrappers whose only job is to
+# forward/guard the raw call. Flagging inside them would double-report
+# every call site.
+SHIM_NAMES = WRITER_CALLS | frozenset({"_update"})
+GUARD_HELPERS = frozenset({"update_status_if", "requeue_if",
+                           "_suppress_duplicate", "is_terminal"})
+GUARD_DECORATORS = ("api_async_func",)
+EXEMPT_PATH_PARTS = ("taskstore/",)
+
+
+def _call_name(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _has_terminal_check(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare):
+            for op, comparator in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.In, ast.NotIn)):
+                    if any(isinstance(n, ast.Attribute)
+                           and n.attr == "TERMINAL"
+                           for n in ast.walk(comparator)):
+                        return True
+        elif isinstance(node, ast.Call):
+            name = _call_name(node.func)
+            if name in GUARD_HELPERS:
+                return True
+    return False
+
+
+def _shell_guarded(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = _call_name(target)
+        if name in GUARD_DECORATORS:
+            return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rule, ctx):
+        self.rule = rule
+        self.ctx = ctx
+        self.findings = []
+        self._stack: list[ast.AST] = []
+        # Per-function cached guard verdict, keyed by id(node).
+        self._guarded: dict[int, bool] = {}
+
+    def _enter(self, node):
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_ClassDef = _enter
+    visit_FunctionDef = _enter
+    visit_AsyncFunctionDef = _enter
+
+    def _enclosing_fn(self):
+        for node in reversed(self._stack):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return node
+        return None
+
+    def visit_Call(self, node):
+        name = _call_name(node.func)
+        if name in WRITER_CALLS:
+            fn = self._enclosing_fn()
+            if fn is None:
+                self._flag(node, name, "<module>")
+            elif fn.name not in SHIM_NAMES:
+                key = id(fn)
+                if key not in self._guarded:
+                    # Shell-guard exemption walks the WHOLE enclosing
+                    # stack: a progress callback nested inside an
+                    # api_async_func handler is only ever invoked from
+                    # that (shell-guarded) execution.
+                    self._guarded[key] = (_has_terminal_check(fn)
+                                          or any(_shell_guarded(f)
+                                                 for f in self._stack))
+                if not self._guarded[key]:
+                    self._flag(node, name, fn.name)
+        self.generic_visit(node)
+
+    def _flag(self, node, name, fn_name):
+        self.findings.append(self.ctx.finding(
+            self.rule.rule_id, node,
+            f"status write {name}() in {fn_name!r} without a "
+            "TaskStatus.TERMINAL re-check — a redelivery can clobber a "
+            "completed task back to live and double-complete it (guard "
+            "with `canonical in TaskStatus.TERMINAL`, update_status_if, "
+            "or _suppress_duplicate)",
+            symbol=enclosing_symbol(self._stack)))
+
+
+class TerminalStatusClobber(Rule):
+    rule_id = "AIL003"
+    name = "terminal-status-clobber"
+    description = ("task-status writes must re-check TaskStatus.TERMINAL "
+                   "(or go through a blessed conditional helper)")
+
+    def check_module(self, ctx):
+        if any(part in ctx.path for part in EXEMPT_PATH_PARTS):
+            return []
+        v = _Visitor(self, ctx)
+        v.visit(ctx.tree)
+        return v.findings

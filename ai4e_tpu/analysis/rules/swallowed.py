@@ -1,0 +1,107 @@
+"""AIL005 — broad exception handler that swallows silently.
+
+The bug class: ``except Exception:`` (or bare ``except:``) whose body
+neither logs, re-raises, nor counts a metric. In a serving platform these
+are where real failures go to disappear — a store probe that starts
+erroring under load, a listener that dies on every event — with zero
+operator signal. The platform's own broad handlers are legitimate
+("telemetry must not break serving", "the dispatcher must never die") and
+they all LOG; this rule enforces that the next one does too.
+
+Accepted evidence inside the handler body:
+
+- a ``raise`` (bare re-raise or a new exception),
+- a logging call — any ``.debug/.info/.warning/.error/.exception/
+  .critical/.log`` attribute call, or ``print`` as a last resort,
+- a metric write (``.inc()`` / ``.observe()`` / ``.set(value)`` — a bare
+  ``.set()`` is Event signalling, not telemetry, and does not count),
+- a ``return``/assignment path is NOT evidence — returning a default is
+  exactly how swallowing looks.
+
+Intentionally-silent handlers carry ``# ai4e: noqa[AIL005] — reason`` on
+the ``except`` line; the reason is part of the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, enclosing_symbol
+
+LOG_METHODS = frozenset({"debug", "info", "warning", "error", "exception",
+                         "critical", "log"})
+BROAD = frozenset({"Exception", "BaseException"})
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in BROAD:
+            return True
+    return False
+
+
+def _has_evidence(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in LOG_METHODS or f.attr in {"inc", "observe"}:
+                    return True
+                if f.attr == "set" and (node.args or node.keywords):
+                    # Gauge.set(value) is metric evidence; a bare .set()
+                    # is asyncio/threading Event signalling — ubiquitous
+                    # in shutdown paths and NOT an operator signal, so it
+                    # must not satisfy the rule.
+                    return True
+            if isinstance(f, ast.Name) and f.id == "print":
+                return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rule, ctx):
+        self.rule = rule
+        self.ctx = ctx
+        self.findings = []
+        self._stack: list[ast.AST] = []
+
+    def _enter(self, node):
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_ClassDef = _enter
+    visit_FunctionDef = _enter
+    visit_AsyncFunctionDef = _enter
+
+    def visit_ExceptHandler(self, node):
+        if _is_broad(node) and not _has_evidence(node):
+            kind = ("bare except" if node.type is None
+                    else "except Exception")
+            self.findings.append(self.ctx.finding(
+                self.rule.rule_id, node,
+                f"{kind} swallows silently — log it, count it "
+                "(ai4e_*_errors_total), re-raise, or justify with "
+                "`# ai4e: noqa[AIL005] — reason`",
+                symbol=enclosing_symbol(self._stack)))
+        self.generic_visit(node)
+
+
+class SwallowedException(Rule):
+    rule_id = "AIL005"
+    name = "swallowed-exception"
+    description = ("broad except handlers must log, count a metric, or "
+                   "re-raise — silence needs a written justification")
+
+    def check_module(self, ctx):
+        v = _Visitor(self, ctx)
+        v.visit(ctx.tree)
+        return v.findings

@@ -129,6 +129,12 @@ class Dispatcher:
         self.metrics = metrics or DEFAULT_REGISTRY
         self._dispatched = self.metrics.counter(
             "ai4e_dispatch_total", "Dispatch attempts by outcome")
+        # Component tracer carrying this dispatcher's registry so its
+        # ai4e_span_seconds series lands beside ai4e_dispatch_total in the
+        # assembly's /metrics instead of the process default (AIL002);
+        # exporter/sampling still follow configure_tracer live.
+        from ..observability import Tracer
+        self.tracer = Tracer("dispatcher", metrics=self.metrics)
         self._stop = asyncio.Event()
         self._workers: list[asyncio.Task] = []
         # Graceful scale-down debt (set_concurrency): how many delivery
@@ -238,11 +244,16 @@ class Dispatcher:
                     # Lease-reaper path: no delivery was attempted here, so
                     # there is no target host — empty label keeps the
                     # series key set consistent with the delivery path.
+                    # Terminal re-check (AIL003): a crash AFTER the task
+                    # completed (e.g. complete() raced the lease reaper)
+                    # must not stamp DEAD_LETTER over the completion the
+                    # client may already have read.
                     self._dispatched.inc(outcome="dead_letter",
                                          queue=self.queue_name, backend="")
-                    await self._try_update(
-                        msg.task_id, TaskStatus.DEAD_LETTER,
-                        TaskStatus.FAILED)
+                    if not await self.task_manager.is_terminal(msg.task_id):
+                        await self._try_update(
+                            msg.task_id, TaskStatus.DEAD_LETTER,
+                            TaskStatus.FAILED)
             finally:
                 self._busy -= 1
 
@@ -292,7 +303,6 @@ class Dispatcher:
         import time as _time
         from urllib.parse import urlparse
 
-        from ..observability import get_tracer
         if await self._drop_expired(msg):
             return
         if self.resilience is not None and await self._suppress_duplicate(msg):
@@ -301,7 +311,7 @@ class Dispatcher:
             return
         if self._retry_budget is not None:
             self._retry_budget.on_request()
-        tracer = get_tracer()
+        tracer = self.tracer
         tried: list[str] = []
         attempt = 0
         while True:
@@ -445,6 +455,17 @@ class Dispatcher:
         from ..admission.deadline import expired_status
         from ..taskstore import TaskStatus as _TS
         self.broker.complete(msg)
+        # Terminal re-check (AIL003) BEFORE any accounting: this path runs
+        # ahead of duplicate suppression, so a lease-expiry redelivery of a
+        # task that already COMPLETED — and whose deadline has since passed
+        # — is a DUPLICATE, not an expiry. Counting it as expired (or
+        # charging admission's goodput signal via note_expired) would
+        # misreport it and tighten shedding on phantom evidence; writing
+        # `expired` would clobber the completion the client may have read.
+        if await self.task_manager.is_terminal(msg.task_id):
+            self._dispatched.inc(outcome="duplicate", queue=self.queue_name,
+                                 backend="")
+            return True
         self._dispatched.inc(outcome="expired", queue=self.queue_name,
                              backend="")
         if self.admission is not None:
@@ -471,6 +492,19 @@ class Dispatcher:
         found = self.result_cache.get(key, count=False)
         if found is None:
             return False
+        # task_manager is None only in result-path-focused tests; this path
+        # never touched it before the guard, so stay tolerant.
+        if (self.task_manager is not None
+                and await self.task_manager.is_terminal(msg.task_id)):
+            # Terminal re-check (AIL003), after the cache consult so the
+            # probe only costs on actual hits: a redelivery of a task that
+            # already completed must not write "completed - served from
+            # cache" over the original completion — the client would
+            # observe a SECOND completion (the chaos invariant).
+            self.broker.complete(msg)
+            self._dispatched.inc(outcome="duplicate", queue=self.queue_name,
+                                 backend="")
+            return True
         if self.result_store is None:
             # Nowhere to put the payload: completing anyway would hand the
             # client a terminal task whose result fetch returns nothing —
@@ -504,13 +538,7 @@ class Dispatcher:
         common duplicate window; a backend completing tasks should still do
         so conditionally (``update_status_if``) for the residual race where
         the duplicate pops mid-execution (docs/resilience.md)."""
-        try:
-            record = await self.task_manager.get_task_status(msg.task_id)
-        except Exception:  # noqa: BLE001 — a status probe must never block dispatch
-            return False
-        if not record:
-            return False
-        if TaskStatus.canonical(record.get("Status", "")) in TaskStatus.TERMINAL:
+        if await self.task_manager.is_terminal(msg.task_id):
             self.broker.complete(msg)
             self._dispatched.inc(outcome="duplicate", queue=self.queue_name,
                                  backend="")

@@ -65,6 +65,12 @@ HDR_EVENT_ID = "X-AI4E-Event-Id"
 HDR_EVENT_SUBJECT = "X-AI4E-Event-Subject"
 HDR_EVENT_TYPE = "X-AI4E-Event-Type"
 HDR_EVENT_TIME = "X-AI4E-Event-Time"
+# Delivery-attempt ordinal (1-based). Lets the webhook treat a RETRY
+# differently from a first delivery: a retry can trail an execution whose
+# response was lost, so the webhook probes task terminality before
+# re-forwarding (the queue dispatcher's duplicate-suppression analogue)
+# while first deliveries stay probe-free on the hot path.
+HDR_EVENT_ATTEMPT = "X-AI4E-Event-Attempt"
 
 
 @dataclass
@@ -120,12 +126,20 @@ class PushEvent:
             "Content-Type": self.content_type or "application/octet-stream",
         }
 
+    def headers_for_attempt(self, attempt: int) -> dict[str, str]:
+        """Delivery headers stamped with the attempt ordinal (1-based)."""
+        return {**self.to_headers(), HDR_EVENT_ATTEMPT: str(attempt)}
+
     @classmethod
     def from_headers(cls, headers, body: bytes) -> "PushEvent":
         try:
             event_time = float(headers.get(HDR_EVENT_TIME, ""))
         except ValueError:
             event_time = time.time()
+        try:
+            attempts = int(headers.get(HDR_EVENT_ATTEMPT, "0"))
+        except ValueError:
+            attempts = 0
         from urllib.parse import unquote
         return cls(
             id=headers.get(HDR_EVENT_ID, ""),
@@ -135,6 +149,7 @@ class PushEvent:
                                      "application/octet-stream"),
             event_type=headers.get(HDR_EVENT_TYPE, TASK_EVENT),
             event_time=event_time,
+            attempts=attempts,
         )
 
 
@@ -281,7 +296,8 @@ class PushTopic:
                 async with self._window:
                     async with session.post(
                             sub.url, data=event.data,
-                            headers=event.to_headers()) as resp:
+                            headers=event.headers_for_attempt(
+                                attempts)) as resp:
                         status = resp.status
                         await resp.read()
                 if 200 <= status < 300:
@@ -348,6 +364,12 @@ class WebhookDispatcher:
         self.metrics = metrics or DEFAULT_REGISTRY
         self._forwarded = self.metrics.counter(
             "ai4e_webhook_forwards_total", "Webhook forwards by outcome")
+        # Component tracer carrying this webhook's registry so its
+        # ai4e_span_seconds series lands in the assembly's /metrics, not
+        # the process default (AIL002); exporter/sampling still follow
+        # configure_tracer live.
+        from ..observability import Tracer
+        self.tracer = Tracer("webhook", metrics=self.metrics)
         # queue path prefix -> weighted backend set (utils/backends.py)
         self._routes: dict[str, list] = {}
         # In-flight bounded by the topic's delivery window, not a hidden
@@ -412,17 +434,34 @@ class WebhookDispatcher:
         return web.Response(status=worst_status)
 
     async def _forward(self, event: PushEvent) -> int:
-        from ..observability import get_tracer
+        if event.attempts > 1 and await self.task_manager.is_terminal(
+                event.id):
+            # Terminal re-check (AIL003) — the push transport's analogue of
+            # the queue dispatcher's duplicate suppression: a RETRIED
+            # delivery can trail an execution whose response was lost, so
+            # re-forwarding would re-execute on the backend and the
+            # AWAITING/failed writes below would clobber the completion the
+            # client may already have read (the PR 3 double-completion
+            # class, which the queue side fixed and this side had open).
+            # First deliveries (attempts <= 1) skip the probe — no store
+            # round trip on the hot path; a duplicated PUBLISH of a
+            # finished task is still caught at the service shell's
+            # adoption guard, and every failure-path write below re-checks
+            # terminality itself.
+            self._forwarded.inc(outcome="duplicate")
+            return 200
         target = self._target_for(event.subject)
         if target is None:
             self._forwarded.inc(outcome="unroutable")
-            await self._try_update(event.id,
-                                   f"failed - no backend route for {event.subject}",
-                                   TaskStatus.FAILED)
+            if not await self.task_manager.is_terminal(event.id):
+                await self._try_update(
+                    event.id,
+                    f"failed - no backend route for {event.subject}",
+                    TaskStatus.FAILED)
             return 200  # ack: retrying an unroutable event cannot help
         from urllib.parse import urlparse
         backend = urlparse(target).netloc  # canary observability dimension
-        tracer = get_tracer()
+        tracer = self.tracer
         session = await self._sessions.get()
         try:
             with tracer.span("webhook_dispatch", task_id=event.id) as span:
@@ -445,12 +484,19 @@ class WebhookDispatcher:
         if status in BACKPRESSURE_CODES:
             # Saturated backend: mark awaiting, pass 429 through so the
             # topic's backoff schedule drives the retry (BackendWebhook.cs:69-72).
+            # Cold path, so the terminal probe is affordable here: the
+            # unconditional AWAITING write was the push side's status
+            # clobber (AIL003).
             self._forwarded.inc(outcome="backpressure", backend=backend)
-            await self._try_update(event.id, AWAITING_STATUS, TaskStatus.CREATED)
+            if not await self.task_manager.is_terminal(event.id):
+                await self._try_update(event.id, AWAITING_STATUS,
+                                       TaskStatus.CREATED)
             return 429
         self._forwarded.inc(outcome="failed", backend=backend)
-        await self._try_update(event.id, f"failed - backend returned {status}",
-                               TaskStatus.FAILED)
+        if not await self.task_manager.is_terminal(event.id):
+            await self._try_update(event.id,
+                                   f"failed - backend returned {status}",
+                                   TaskStatus.FAILED)
         return 200  # permanent failure: ack, no redelivery
 
     async def _try_update(self, task_id: str, status: str, backend: str) -> None:

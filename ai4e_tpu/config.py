@@ -32,6 +32,18 @@ from dataclasses import dataclass, field, fields
 _TRUE = frozenset({"1", "true", "yes", "on"})
 _FALSE = frozenset({"0", "false", "no", "off", ""})
 
+# Out-of-band AI4E_* namespaces, read directly by the paths that need them
+# and never part of the typed config: AI4E_FAULT_* (fault injection, e.g.
+# AI4E_FAULT_FETCH_FAIL_NTHS), AI4E_CHAOS_* (chaos-harness seeds,
+# tests/test_chaos.py), AI4E_FEED_* (the multihost shard feed's direct
+# knobs, e.g. AI4E_FEED_ADVERTISE_IP in parallel/multihost.py — previously
+# REJECTED by from_env, so a multihost deployment pinning its feed IP
+# could not boot; AIL006 surfaced the drift). Single source of truth —
+# FrameworkConfig.from_env exempts these from its unknown-variable check
+# and the AIL006 config-drift rule imports the same tuple. All three are
+# documented in docs/config.md.
+OUT_OF_BAND_ENV_PREFIXES = ("AI4E_FAULT_", "AI4E_CHAOS_", "AI4E_FEED_")
+
 
 class ConfigError(ValueError):
     pass
@@ -377,12 +389,9 @@ class FrameworkConfig:
         # would silently keep every default — catch it here.
         env_map = os.environ if env is None else env
         prefixes = tuple(s._env_prefix for s in sections.values())
-        # AI4E_FAULT_* is the fault-injection namespace (e.g.
-        # AI4E_FAULT_FETCH_FAIL_NTHS, parallel/multihost.py) — read directly
-        # by the failure paths under test, never part of the typed config.
         unknown = [k for k in env_map
                    if k.startswith("AI4E_") and not k.startswith(prefixes)
-                   and not k.startswith("AI4E_FAULT_")]
+                   and not k.startswith(OUT_OF_BAND_ENV_PREFIXES)]
         if unknown:
             raise ConfigError(
                 f"unknown config section in variable(s) {sorted(unknown)}; "

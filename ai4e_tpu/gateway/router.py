@@ -78,6 +78,12 @@ class Gateway:
         self.routes: list[Route] = []
         self._requests = self.metrics.counter(
             "ai4e_gateway_requests_total", "Gateway requests by route/outcome")
+        # Component tracer carrying THIS gateway's registry: its
+        # ai4e_span_seconds series must land beside the gateway counters in
+        # the assembly's /metrics, not in the process default (AIL002 —
+        # exporter/sampling still follow configure_tracer live).
+        from ..observability import Tracer
+        self.tracer = Tracer("gateway", metrics=self.metrics)
         # Proxy fan-out is bounded by inbound connections, not the pool.
         self._sessions = SessionHolder(limit=0)
         # task_id -> {(loop, Event)} long-poll waiters (see _task).
@@ -301,7 +307,6 @@ class Gateway:
                 endpoint = endpoint.rstrip("/") + "/" + tail
             if request.query_string:
                 endpoint += "?" + request.query_string
-            from ..observability import get_tracer
             from ..taskstore import NotPrimaryError
             content_type = request.content_type or "application/json"
 
@@ -337,7 +342,7 @@ class Gateway:
                 else:
                     key = self._derive_cache_key(route, request, body,
                                                  content_type)
-                    with get_tracer().span("cache_lookup", route=route.prefix,
+                    with self.tracer.span("cache_lookup", route=route.prefix,
                                            headers=request.headers) as span:
                         # count=False: the outcome is counted exactly once
                         # below, when it is KNOWN — a lookup that ends up
@@ -389,7 +394,7 @@ class Gateway:
                                                    deadline_at)
                 if refusal is not None:
                     return refusal
-            with get_tracer().span("create_task", route=route.prefix,
+            with self.tracer.span("create_task", route=route.prefix,
                                    headers=request.headers) as span:
                 try:
                     task = self.store.upsert(APITask(
@@ -465,7 +470,7 @@ class Gateway:
         try:
             backlog = self.store.set_len(endpoint_path(route.backend_uri),
                                          TaskStatus.CREATED)
-        except Exception:  # noqa: BLE001 — duck-typed store stand-ins
+        except Exception:  # noqa: BLE001; ai4e: noqa[AIL005] — duck-typed store stand-ins in tests lack set_len; empty backlog is the correct degraded answer
             backlog = 0
         decision = adm.shed_async(priority, backlog, deadline_at)
         if decision is None:

@@ -173,13 +173,19 @@ class Tracer:
         self.service = service
         # None → follow the process tracer *live* (resolved per span), so
         # configure_tracer() after component construction applies everywhere.
+        # Same rule for metrics: a component tracer built WITH a registry
+        # (every assembly-owned component passes its own) lands
+        # ai4e_span_seconds there; without one it follows the process
+        # tracer, then the process default — resolved per observation, not
+        # frozen at construction, or the AIL002 leak comes back the moment
+        # construction order changes.
         self.exporter = exporter
         self.sample_rate = sample_rate
-        if metrics is None:
-            from ..metrics import DEFAULT_REGISTRY
-            metrics = DEFAULT_REGISTRY
-        self._span_seconds = metrics.histogram(
-            "ai4e_span_seconds", "Span durations by span name")
+        self.metrics = metrics
+        # (resolved registry, its histogram) — avoids re-taking the
+        # registry's get-or-create lock on every span observation while
+        # still following a live configure_tracer(metrics=...) rebinding.
+        self._span_hist_cache: tuple | None = None
 
     def _effective_exporter(self):
         if self.exporter is not None:
@@ -187,6 +193,21 @@ class Tracer:
         if self is not _GLOBAL and _GLOBAL.exporter is not None:
             return _GLOBAL.exporter
         return _DEFAULT_EXPORTER
+
+    def _effective_metrics(self):
+        # When self IS the global tracer, self.metrics and _GLOBAL.metrics
+        # are the same attribute, so one or-chain covers every case.
+        from ..metrics import DEFAULT_REGISTRY
+        return self.metrics or _GLOBAL.metrics or DEFAULT_REGISTRY
+
+    def _span_seconds(self):
+        reg = self._effective_metrics()
+        cached = self._span_hist_cache
+        if cached is None or cached[0] is not reg:
+            cached = (reg, reg.histogram(
+                "ai4e_span_seconds", "Span durations by span name"))
+            self._span_hist_cache = cached
+        return cached[1]
 
     def _effective_sample_rate(self) -> float:
         if self.sample_rate is not None:
@@ -258,8 +279,8 @@ class Tracer:
         finally:
             _CURRENT.reset(token)
             span.duration = time.perf_counter() - t0
-            self._span_seconds.observe(span.duration, name=name,
-                                       service=self.service)
+            self._span_seconds().observe(span.duration, name=name,
+                                         service=self.service)
             if sampled:
                 try:
                     self._effective_exporter().export(span)
@@ -293,17 +314,20 @@ def get_tracer() -> Tracer:
 
 
 def configure_tracer(service: str | None = None, exporter=_UNSET,
-                     sample_rate=_UNSET) -> Tracer:
+                     sample_rate=_UNSET, metrics=_UNSET) -> Tracer:
     """Reconfigure the process tracer in place. Component tracers built
-    without an explicit exporter/sample_rate (every service/gateway/dispatcher
-    default) follow these settings live. Pass ``None`` explicitly to reset a
-    field to its default (LogExporter / rate 1.0)."""
+    without an explicit exporter/sample_rate/metrics (every
+    service/gateway/dispatcher default) follow these settings live. Pass
+    ``None`` explicitly to reset a field to its default (LogExporter /
+    rate 1.0 / the process-default metrics registry)."""
     if service is not None:
         _GLOBAL.service = service
     if exporter is not _UNSET:
         _GLOBAL.exporter = exporter
     if sample_rate is not _UNSET:
         _GLOBAL.sample_rate = sample_rate
+    if metrics is not _UNSET:
+        _GLOBAL.metrics = metrics
     return _GLOBAL
 
 

@@ -16,7 +16,6 @@ double-buffers every in/out block; scratch is single-buffered).
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 # v4/v5e/v5p cores expose ~16 MiB of VMEM; stay under with headroom for

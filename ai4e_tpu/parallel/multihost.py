@@ -134,7 +134,15 @@ class _ShardFeed:
 
 def _fetch(url: str, token: str, timeout_s: float = 60.0) -> bytes:
     """GET with retry — the shard is staged before the descriptor broadcast,
-    so 404 only means a transient reordering/hiccup, not absence."""
+    so 404 only means a transient reordering/hiccup, not absence.
+
+    Sync-only path, verified for AIL001: called exclusively from
+    ``follower_loop()`` — a blocking SPMD loop that runs in the follower
+    process's MAIN thread, where no event loop exists (followers run no
+    asyncio at all; the primary's platform stack never calls this). The
+    ``time.sleep`` backoff below is therefore correct as-is; converting it
+    to ``asyncio.sleep`` would require an event loop the caller
+    deliberately does not have."""
     import urllib.error
     import urllib.request
 

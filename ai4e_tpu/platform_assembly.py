@@ -10,15 +10,14 @@ service + broker + gateway) — see ``deploy/``.
 from __future__ import annotations
 
 import asyncio
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from .broker import DispatcherPool, InMemoryBroker
 from .gateway import Gateway
 from .metrics import DEFAULT_REGISTRY, MetricsRegistry
 from .service import APIService, LocalTaskManager
 from .utils.backends import Weighted, normalize_backends
-from .taskstore import (InMemoryTaskStore, JournaledTaskStore,
-                        TaskStatus, endpoint_path)
+from .taskstore import InMemoryTaskStore, TaskStatus, endpoint_path
 
 
 @dataclass
@@ -329,6 +328,11 @@ class LocalPlatform:
         self.prober = None
         self._transport_running = False
         self._started = False
+        # Strong refs to fire-and-forget background work (dead-letter
+        # terminal transitions): the event loop holds tasks WEAKLY, so a
+        # dropped create_task handle can be garbage-collected mid-flight
+        # and the task it was failing sits non-terminal forever (AIL004).
+        self._bg_tasks: set[asyncio.Task] = set()
 
     # -- assembly ----------------------------------------------------------
 
@@ -483,7 +487,7 @@ class LocalPlatform:
                 # Runs on the event loop (queues are loop-bound); fail the
                 # task asynchronously so it never sits non-terminal after its
                 # message is gone.
-                loop.create_task(self._fail_dead_letter(msg.task_id))
+                self._spawn_bg(loop, self._fail_dead_letter(msg.task_id))
 
             self.broker.set_dead_letter_handler(on_dead_letter)
             await self.dispatchers.start()
@@ -614,7 +618,7 @@ class LocalPlatform:
         self.topic.bind_loop(loop)
 
         def on_dead_letter(event) -> None:
-            loop.create_task(self._fail_dead_letter(event.id))
+            self._spawn_bg(loop, self._fail_dead_letter(event.id))
 
         self.topic.set_dead_letter_handler(on_dead_letter)
         runner = aioweb.AppRunner(self.webhook.app)
@@ -625,6 +629,15 @@ class LocalPlatform:
         self._webhook_runner = runner
         await self.topic.subscribe(
             "backend-webhook", f"http://127.0.0.1:{port}/api/events")
+
+    def _spawn_bg(self, loop: asyncio.AbstractEventLoop, coro) -> asyncio.Task:
+        """Spawn background work with a STRONG reference held until done
+        (AIL004): the loop's weak ref alone lets the garbage collector kill
+        the task mid-flight, silently dropping the terminal transition."""
+        task = loop.create_task(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
 
     async def _fail_dead_letter(self, task_id: str) -> None:
         try:

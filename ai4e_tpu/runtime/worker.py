@@ -199,7 +199,7 @@ class InferenceWorker:
                 await asyncio.to_thread(load_and_swap)
             except ValueError as exc:
                 return web.json_response({"error": str(exc)}, status=409)
-            except Exception as exc:  # noqa: BLE001 — checkpoint IO surface
+            except Exception as exc:  # noqa: BLE001; ai4e: noqa[AIL005] — the error is returned to the caller as the 400 body
                 return web.json_response(
                     {"error": f"reload failed: {type(exc).__name__}: "
                               f"{exc}"}, status=400)
@@ -354,7 +354,7 @@ class InferenceWorker:
             await tm.update_task_status(taskId, f"running - {_name} inference")
             try:
                 example = _servable.preprocess(body, content_type)
-            except Exception as exc:  # noqa: BLE001 — bad payload fails this task only
+            except Exception as exc:  # noqa: BLE001; ai4e: noqa[AIL005] — the error is recorded on the task record (failed - bad input)
                 await tm.fail_task(taskId, f"failed - bad input: {exc}")
                 return
             try:
@@ -493,7 +493,7 @@ class InferenceWorker:
                             # Throttle, don't fail: the stack shares the
                             # device with interactive traffic.
                             await asyncio.sleep(0.05)
-                        except Exception as exc:  # noqa: BLE001 — isolate the image
+                        except Exception as exc:  # noqa: BLE001; ai4e: noqa[AIL005] — the error is reported in the batch result payload for this index
                             results[i] = {"index": i, "error": str(exc)}
                             break
                     done += 1
@@ -521,7 +521,7 @@ class InferenceWorker:
             tm = self.service.task_manager
             try:
                 stack = await asyncio.to_thread(_decode_stack, body)
-            except Exception as exc:  # noqa: BLE001 — bad payload fails this task only
+            except Exception as exc:  # noqa: BLE001; ai4e: noqa[AIL005] — the error is recorded on the task record (failed - bad input)
                 await tm.fail_task(taskId, f"failed - bad input: {exc}")
                 return
             total = len(stack)

@@ -32,13 +32,13 @@ import asyncio
 import logging
 import time
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable
 
 from aiohttp import web
 
 from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
-from ..taskstore import InMemoryTaskStore
+from ..taskstore import InMemoryTaskStore, TaskStatus
 from .task_manager import LocalTaskManager, TaskManagerBase
 
 log = logging.getLogger("ai4e_tpu.service")
@@ -244,6 +244,22 @@ class APIService:
         task = await self.task_manager.add_task(
             endpoint=endpoint, body=b"", task_id=incoming_task_id)
         task_id = task["TaskId"]
+        if (incoming_task_id is not None
+                and TaskStatus.canonical(task.get("Status", ""))
+                in TaskStatus.TERMINAL):
+            # Terminal re-check at adoption (AIL003): a redelivered message
+            # for a task that already finished (lease-expiry redelivery
+            # racing a completion, a duplicated publish, a retried delivery
+            # whose first response was lost) must not re-execute — the
+            # handler's running/completed writes would clobber the terminal
+            # status the client may already have read, and the client would
+            # observe a second completion. 200 acks the message; the work is
+            # done. Re-executions the platform MEANS to happen (reaper
+            # requeue, pipeline handoff) rewrite the task to `created`
+            # before republishing, so they pass this check.
+            self._release(spec)
+            self._http_total.inc(code="200", path=spec.api_path)
+            return web.json_response(task)
 
         # The reserved slot is held until the background execution finishes —
         # the cap covers running tasks, not just open connections
@@ -275,7 +291,12 @@ class APIService:
         except Exception as exc:  # noqa: BLE001
             log.exception("async endpoint %s task %s failed", spec.api_path, task_id)
             try:
-                await self.task_manager.fail_task(task_id, f"failed: {exc}")
+                # Terminal re-check (AIL003): a handler that completed the
+                # task and THEN raised (cleanup error after complete_task)
+                # must not flip the completion the client may already have
+                # read to `failed`.
+                if not await self.task_manager.is_terminal(task_id):
+                    await self.task_manager.fail_task(task_id, f"failed: {exc}")
             except Exception:  # noqa: BLE001
                 log.exception("could not fail task %s", task_id)
         finally:

@@ -70,6 +70,26 @@ class TaskManagerBase:
     async def get_task_status(self, task_id: str) -> dict | None:
         raise NotImplementedError
 
+    async def is_terminal(self, task_id: str) -> bool:
+        """Terminal-status probe — the shared guard for status-writing cold
+        paths (AIL003; the dispatcher, webhook, and service shell all use
+        it before writes that could clobber a completed task on a
+        redelivery). A failed probe answers False — the caller must not
+        stall on a store hiccup — and is logged so a store outage
+        degrading duplicate suppression is visible."""
+        import logging
+        try:
+            record = await self.get_task_status(task_id)
+        except Exception:  # noqa: BLE001 — a probe must never block its caller
+            logging.getLogger("ai4e_tpu.task_manager").warning(
+                "status probe for task %s failed; proceeding as "
+                "non-terminal", task_id, exc_info=True)
+            return False
+        if not record:
+            return False
+        return TaskStatus.canonical(
+            record.get("Status", "")) in TaskStatus.TERMINAL
+
     async def _upsert(self, task: APITask) -> dict:
         raise NotImplementedError
 

@@ -380,7 +380,7 @@ def make_app(store: InMemoryTaskStore,
                     else:
                         served_from = offset
                     try:
-                        fh = open(journal_path, "rb")
+                        fh = open(journal_path, "rb")  # noqa: ASYNC230  # local journal open under the store lock; generation/offset consistency needs it
                     except FileNotFoundError:
                         fh = None
                 try:

@@ -125,7 +125,7 @@ class NativeTaskStore(StoreSideEffects):
     def __del__(self):  # pragma: no cover - interpreter teardown ordering
         try:
             self._lib.tsc_destroy(self._handle)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001; ai4e: noqa[AIL005] — __del__ during interpreter teardown; nothing to report to
             pass
 
     def _consume(self, view) -> APITask | None:

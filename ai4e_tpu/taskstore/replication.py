@@ -46,6 +46,7 @@ import logging
 
 import aiohttp
 
+from ..metrics import DEFAULT_REGISTRY
 from ..utils.http import SessionHolder
 from .store import FollowerTaskStore
 
@@ -73,9 +74,10 @@ class JournalReplicator:
         self.primary_url = primary_url.rstrip("/")
         self.poll_wait = poll_wait
         self.chunk_limit = chunk_limit
-        if metrics is None:
-            from ..metrics import DEFAULT_REGISTRY
-            metrics = DEFAULT_REGISTRY
+        # Blessed default-resolution idiom (AIL002): the assembly plumbs its
+        # own registry; standalone construction falls back to the process
+        # default in ONE visible expression, never a conditional rebinding.
+        metrics = metrics or DEFAULT_REGISTRY
         self._offset_gauge = metrics.gauge(
             "ai4e_replication_offset_bytes",
             "Journal bytes this follower has absorbed")
@@ -107,7 +109,7 @@ class JournalReplicator:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001; ai4e: noqa[AIL005] — awaiting our own cancelled loop; the outcome is irrelevant at teardown
                 pass
             self._task = None
 
@@ -222,7 +224,7 @@ class FailoverWatchdog:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001; ai4e: noqa[AIL005] — awaiting our own cancelled loop; the outcome is irrelevant at teardown
                 pass
             self._task = None
 
@@ -305,7 +307,7 @@ class FencingProber:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001; ai4e: noqa[AIL005] — awaiting our own cancelled loop; the outcome is irrelevant at teardown
                 pass
             self._task = None
 
@@ -353,8 +355,12 @@ class FencingProber:
                 await self._probe_once()
             except asyncio.CancelledError:
                 raise
-            except Exception:  # noqa: BLE001 — peer unreachable is the normal case
-                pass
+            except Exception as exc:  # noqa: BLE001 — peer unreachable is the normal case
+                # Debug, not warning: while the peer is partitioned/down this
+                # fires every probe interval for as long as the outage lasts —
+                # but the evidence must exist somewhere when fencing is the
+                # thing being debugged (AIL005).
+                log.debug("fencing probe of %s failed: %s", self.peer_url, exc)
             try:
                 await asyncio.wait_for(self._stopped.wait(), self.interval)
                 return

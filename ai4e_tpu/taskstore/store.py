@@ -81,7 +81,7 @@ class StoreSideEffects:
             return
         try:
             publisher(task)
-        except Exception as exc:  # noqa: BLE001 — any publish failure fails the task
+        except Exception as exc:  # noqa: BLE001; ai4e: noqa[AIL005] — the failure is recorded ON the task itself (failed - could not publish)
             self.update_status(
                 task.task_id,
                 f"failed - could not publish task: {exc}",

@@ -8,11 +8,10 @@ Then: TID=$(curl -s -X POST localhost:8080/v1/camera-trap/detect -d @image.jpg |
 
 import asyncio
 import sys
-import time
 
 from aiohttp import web
 
-from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.platform_assembly import LocalPlatform
 
 
 async def main() -> None:
@@ -35,7 +34,7 @@ async def main() -> None:
         async def drive():
             await platform.task_manager.update_task_status(
                 taskId, "running - detector scoring image")
-            time.sleep(1.0)  # pretend long inference
+            await asyncio.sleep(1.0)  # pretend long inference
             await platform.task_manager.complete_task(
                 taskId, f"completed - scored {len(body)} bytes")
         asyncio.run(drive())

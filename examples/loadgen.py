@@ -33,7 +33,7 @@ async def run(args) -> dict:
 
     from ai4e_tpu.utils.loadclient import run_closed_loop
 
-    with open(args.payload, "rb") as f:
+    with open(args.payload, "rb") as f:  # noqa: ASYNC230  # one-time payload read at startup
         payload = f.read()
     headers = {"Content-Type": args.content_type}
     if args.api_key:

@@ -1,0 +1,720 @@
+"""ai4e-lint tests (docs/analysis.md).
+
+Three layers:
+
+- per-rule fixtures: at least one true positive, one near-miss negative,
+  and one suppression case for each of AIL001-AIL006;
+- framework semantics: noqa parsing, baseline matching/justification
+  enforcement, fingerprint stability under line moves, CLI exit codes;
+- the whole-repo smoke test: ``ai4e_tpu/`` must be clean modulo the
+  checked-in baseline — the same gate CI runs;
+
+plus behavioral regression tests for the real defects the analyzer
+surfaced and this PR fixed (terminal-status clobbers on the push/expired/
+cache paths, the dropped dead-letter task handles, span metrics leaking
+into DEFAULT_REGISTRY, the rejected AI4E_FEED_* namespace).
+"""
+
+import asyncio
+import os
+import textwrap
+
+import pytest
+
+from ai4e_tpu.analysis import Analyzer, Baseline, BaselineError
+from ai4e_tpu.analysis.rules import ALL_RULES
+from ai4e_tpu.analysis.rules.blocking import BlockingCallInAsync
+from ai4e_tpu.analysis.rules.config_drift import ConfigDrift
+from ai4e_tpu.analysis.rules.fire_and_forget import FireAndForgetTask
+from ai4e_tpu.analysis.rules.registry_leak import MetricsRegistryLeak
+from ai4e_tpu.analysis.rules.status_clobber import TerminalStatusClobber
+from ai4e_tpu.analysis.rules.swallowed import SwallowedException
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_rule(tmp_path, rule, source, filename="mod.py"):
+    """Run one rule over a snippet; returns active findings."""
+    f = tmp_path / filename
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return Analyzer([rule], root=str(tmp_path)).run([str(f)]).findings
+
+
+def run_analysis(coro):
+    return asyncio.run(coro)
+
+
+# -- AIL001 blocking-call-in-async -------------------------------------------
+
+
+class TestBlockingCallInAsync:
+    def test_true_positive_time_sleep(self, tmp_path):
+        findings = run_rule(tmp_path, BlockingCallInAsync(), """
+            import time
+            async def handler():
+                time.sleep(1)
+        """)
+        assert [f.rule for f in findings] == ["AIL001"]
+        assert "time.sleep" in findings[0].message
+
+    def test_true_positive_requests_and_alias(self, tmp_path):
+        findings = run_rule(tmp_path, BlockingCallInAsync(), """
+            import requests
+            import time as t
+            async def handler():
+                requests.get("http://x")
+                t.sleep(0.1)
+        """)
+        assert len(findings) == 2
+
+    def test_near_miss_negatives(self, tmp_path):
+        # asyncio.sleep, sync def, nested sync helper (executor-bound), and
+        # time.sleep passed as a CALLABLE to to_thread are all fine.
+        findings = run_rule(tmp_path, BlockingCallInAsync(), """
+            import asyncio
+            import time
+            async def ok():
+                await asyncio.sleep(1)
+                await asyncio.to_thread(time.sleep, 1)
+                def helper():
+                    time.sleep(1)   # runs in an executor, not on the loop
+                await asyncio.to_thread(helper)
+            def sync_path():
+                time.sleep(1)
+        """)
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = run_rule(tmp_path, BlockingCallInAsync(), """
+            import time
+            async def handler():
+                time.sleep(0.001)  # ai4e: noqa[AIL001] — sub-ms, measured
+        """)
+        assert findings == []
+
+
+# -- AIL002 metrics-registry-leak --------------------------------------------
+
+
+class TestMetricsRegistryLeak:
+    def test_true_positive_direct_call(self, tmp_path):
+        findings = run_rule(tmp_path, MetricsRegistryLeak(), """
+            from ai4e_tpu.metrics import DEFAULT_REGISTRY
+            class Pool:
+                def __init__(self, metrics=None):
+                    self.metrics = metrics
+                def work(self):
+                    DEFAULT_REGISTRY.counter("x").inc()
+        """)
+        assert [f.rule for f in findings] == ["AIL002"]
+        assert "DEFAULT_REGISTRY" in findings[0].message
+
+    def test_true_positive_conditional_rebinding(self, tmp_path):
+        # The exact shape the replication/tracing leaks hid in.
+        findings = run_rule(tmp_path, MetricsRegistryLeak(), """
+            class Replicator:
+                def __init__(self, metrics=None):
+                    if metrics is None:
+                        from ai4e_tpu.metrics import DEFAULT_REGISTRY
+                        metrics = DEFAULT_REGISTRY
+                    self._gauge = metrics.gauge("lag")
+        """)
+        assert [f.rule for f in findings] == ["AIL002"]
+
+    def test_near_miss_blessed_idiom(self, tmp_path):
+        findings = run_rule(tmp_path, MetricsRegistryLeak(), """
+            from ai4e_tpu.metrics import DEFAULT_REGISTRY
+            class Pool:
+                def __init__(self, metrics=None):
+                    self.metrics = metrics or DEFAULT_REGISTRY
+                    self._c = (metrics or DEFAULT_REGISTRY).counter("x")
+                def work(self):
+                    self.metrics.counter("y").inc()
+            class NoInjection:
+                def work(self):
+                    DEFAULT_REGISTRY.counter("z").inc()  # no metrics param
+        """)
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = run_rule(tmp_path, MetricsRegistryLeak(), """
+            from ai4e_tpu.metrics import DEFAULT_REGISTRY
+            class Pool:
+                def __init__(self, metrics=None):
+                    DEFAULT_REGISTRY.counter("x").inc()  # ai4e: noqa[AIL002] — process-wide by design
+        """)
+        assert findings == []
+
+
+# -- AIL003 terminal-status-clobber ------------------------------------------
+
+
+class TestTerminalStatusClobber:
+    def test_true_positive_unguarded_write(self, tmp_path):
+        findings = run_rule(tmp_path, TerminalStatusClobber(), """
+            async def deliver(tm, task_id):
+                await tm.update_task_status(task_id, "Awaiting")
+        """)
+        assert [f.rule for f in findings] == ["AIL003"]
+
+    def test_near_miss_guarded_variants(self, tmp_path):
+        findings = run_rule(tmp_path, TerminalStatusClobber(), """
+            from ai4e_tpu.taskstore import TaskStatus
+
+            async def guarded(tm, task_id, record):
+                if TaskStatus.canonical(record) not in TaskStatus.TERMINAL:
+                    await tm.update_task_status(task_id, "Awaiting")
+
+            async def via_helper(self, store, task_id):
+                if await self._suppress_duplicate(task_id):
+                    return
+                await self.task_manager.fail_task(task_id, "failed")
+
+            async def conditional(store, task_id):
+                store.update_status_if(task_id, "running", "completed")
+        """)
+        assert findings == []
+
+    def test_shell_guarded_decorator(self, tmp_path):
+        # api_async_func handlers (and callbacks nested in them) are
+        # guarded by the service shell's adoption-time terminal check.
+        findings = run_rule(tmp_path, TerminalStatusClobber(), """
+            def register(svc, tm):
+                @svc.api_async_func("/x")
+                async def handler(taskId, body):
+                    await tm.update_task_status(taskId, "running")
+                    async def on_progress(done):
+                        await tm.update_task_status(taskId, f"running {done}")
+                    return on_progress
+        """)
+        assert findings == []
+
+    def test_taskstore_layer_exempt(self, tmp_path):
+        findings = run_rule(tmp_path, TerminalStatusClobber(), """
+            def sweep(store, task_id):
+                store.update_status(task_id, "failed - lease expired")
+        """, filename="taskstore/reaper.py")
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = run_rule(tmp_path, TerminalStatusClobber(), """
+            async def deliver(tm, task_id):
+                await tm.update_task_status(task_id, "Awaiting")  # ai4e: noqa[AIL003] — task created this call, cannot be terminal
+        """)
+        assert findings == []
+
+
+# -- AIL004 fire-and-forget-task ---------------------------------------------
+
+
+class TestFireAndForgetTask:
+    def test_true_positive(self, tmp_path):
+        findings = run_rule(tmp_path, FireAndForgetTask(), """
+            import asyncio
+            def spawn(loop, coro):
+                loop.create_task(coro)
+                asyncio.ensure_future(coro)
+        """)
+        assert [f.rule for f in findings] == ["AIL004", "AIL004"]
+
+    def test_near_miss_stored_awaited_chained(self, tmp_path):
+        findings = run_rule(tmp_path, FireAndForgetTask(), """
+            import asyncio
+            async def spawn(loop, coro, holder):
+                t = loop.create_task(coro)
+                holder.add(t)
+                t.add_done_callback(holder.discard)
+                await asyncio.ensure_future(coro)
+                loop.create_task(coro).add_done_callback(print)
+                holder.track(loop.create_task(coro))
+        """)
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = run_rule(tmp_path, FireAndForgetTask(), """
+            def spawn(loop, coro):
+                loop.create_task(coro)  # ai4e: noqa[AIL004] — test scaffolding, loop torn down next line
+        """)
+        assert findings == []
+
+
+# -- AIL005 swallowed-exception ----------------------------------------------
+
+
+class TestSwallowedException:
+    def test_true_positive_silent_pass(self, tmp_path):
+        findings = run_rule(tmp_path, SwallowedException(), """
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+                try:
+                    work()
+                except:
+                    return None
+        """)
+        assert [f.rule for f in findings] == ["AIL005", "AIL005"]
+
+    def test_near_miss_logged_counted_raised(self, tmp_path):
+        findings = run_rule(tmp_path, SwallowedException(), """
+            def f(log, errors):
+                try:
+                    work()
+                except Exception:
+                    log.exception("work failed")
+                try:
+                    work()
+                except Exception:
+                    errors.inc(kind="work")
+                try:
+                    work()
+                except Exception as exc:
+                    raise RuntimeError("wrapped") from exc
+                try:
+                    work()
+                except ValueError:
+                    pass   # narrow except is out of scope for AIL005
+        """)
+        assert findings == []
+
+    def test_event_set_is_not_metric_evidence(self, tmp_path):
+        """A bare .set() is asyncio/threading Event signalling, not
+        telemetry — it must not satisfy the rule; Gauge.set(value) does."""
+        findings = run_rule(tmp_path, SwallowedException(), """
+            def f(self, gauge):
+                try:
+                    work()
+                except Exception:
+                    self._stopped.set()
+                try:
+                    work()
+                except Exception:
+                    gauge.set(1.0)
+        """)
+        assert len(findings) == 1 and findings[0].line == 5
+
+    def test_suppression(self, tmp_path):
+        findings = run_rule(tmp_path, SwallowedException(), """
+            def f():
+                try:
+                    work()
+                except Exception:  # ai4e: noqa[AIL005] — destructor-time best effort
+                    pass
+        """)
+        assert findings == []
+
+
+# -- AIL006 config-drift ------------------------------------------------------
+
+
+class TestConfigDrift:
+    def _project(self, tmp_path, doc_text):
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "config.md").write_text(doc_text)
+        (tmp_path / "config.py").write_text(textwrap.dedent("""
+            import os
+            def _env_section(prefix):
+                def deco(cls):
+                    return cls
+                return deco
+            @_env_section("AI4E_DEMO_")
+            class DemoSection:
+                port: int = 1
+                host: str = "x"
+            TOKEN = os.environ.get("AI4E_DEMO_EXTRA_TOKEN", "")
+        """))
+        return Analyzer([ConfigDrift()], root=str(tmp_path)).run(
+            [str(tmp_path / "config.py")]).findings
+
+    def test_true_positive_undocumented_and_stale(self, tmp_path):
+        findings = self._project(
+            tmp_path, "Only `AI4E_DEMO_PORT` and `AI4E_DEMO_GONE` here.\n")
+        msgs = {f.message.split(" ", 1)[0]: f for f in findings}
+        # host + direct read undocumented; AI4E_DEMO_GONE stale in docs.
+        assert "AI4E_DEMO_HOST" in msgs
+        assert "AI4E_DEMO_EXTRA_TOKEN" in msgs
+        stale = [f for f in findings if "AI4E_DEMO_GONE" in f.message]
+        assert stale and stale[0].path == "docs/config.md"
+
+    def test_near_miss_fully_documented(self, tmp_path):
+        findings = self._project(
+            tmp_path,
+            "`AI4E_DEMO_PORT`, `AI4E_DEMO_HOST`, `AI4E_DEMO_EXTRA_TOKEN`;\n"
+            "out-of-band: `AI4E_FAULT_SOMETHING`, `AI4E_CHAOS_SEED`.\n")
+        assert findings == []
+
+    def test_prefix_mention_covers_family(self, tmp_path):
+        findings = self._project(
+            tmp_path,
+            "All `AI4E_DEMO` knobs (AI4E_DEMO_*) are demo-only.\n")
+        assert findings == []
+
+    def test_unstarred_mention_does_not_cover_extensions(self, tmp_path):
+        """Documenting AI4E_DEMO_PORT must not silently 'document' a later
+        AI4E_DEMO_PORT_FOO — family coverage needs an explicit star."""
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "config.md").write_text(
+            "`AI4E_DEMO_PO` is documented (no star).\n")
+        (tmp_path / "config.py").write_text(textwrap.dedent("""
+            def _env_section(prefix):
+                def deco(cls):
+                    return cls
+                return deco
+            @_env_section("AI4E_DEMO_")
+            class DemoSection:
+                port: int = 1
+        """))
+        findings = Analyzer([ConfigDrift()], root=str(tmp_path)).run(
+            [str(tmp_path / "config.py")]).findings
+        assert any("AI4E_DEMO_PORT" in f.message for f in findings)
+
+
+# -- framework: noqa, baseline, fingerprints, CLI -----------------------------
+
+
+class TestFramework:
+    def test_fingerprint_stable_across_line_moves(self, tmp_path):
+        src1 = "import time\nasync def h():\n    time.sleep(1)\n"
+        src2 = ("import time\n\n# a comment pushing everything down\n\n"
+                "async def h():\n    time.sleep(1)\n")
+        f1 = run_rule(tmp_path, BlockingCallInAsync(), src1, "a/m.py")
+        f2 = run_rule(tmp_path, BlockingCallInAsync(), src2, "a/m.py")
+        assert f1[0].line != f2[0].line
+        assert f1[0].fingerprint == f2[0].fingerprint
+
+    def test_baseline_grandfathers_and_reports_stale(self, tmp_path):
+        src = "import time\nasync def h():\n    time.sleep(1)\n"
+        (tmp_path / "m.py").write_text(src)
+        raw = Analyzer([BlockingCallInAsync()], root=str(tmp_path)).run(
+            [str(tmp_path / "m.py")]).findings
+        entries = [{"rule": "AIL001", "path": "m.py",
+                    "fingerprint": raw[0].fingerprint,
+                    "justification": "legacy warmup sleep; tracked in #42"},
+                   {"rule": "AIL001", "path": "gone.py",
+                    "fingerprint": "feedfeedfeedfeed",
+                    "justification": "file was deleted"}]
+        result = Analyzer(
+            [BlockingCallInAsync()], root=str(tmp_path),
+            baseline=Baseline(entries)).run([str(tmp_path / "m.py")])
+        assert result.findings == [] and len(result.baselined) == 1
+        assert [e["path"] for e in result.stale_baseline] == ["gone.py"]
+
+    def test_identical_findings_get_distinct_fingerprints(self, tmp_path):
+        """Two byte-identical flagged lines in one symbol must not share a
+        fingerprint — else one baseline entry would grandfather NEW
+        identical findings nobody justified."""
+        src = ("import time\n"
+               "async def h():\n"
+               "    time.sleep(1)\n"
+               "    time.sleep(1)\n")
+        (tmp_path / "m.py").write_text(src)
+        raw = Analyzer([BlockingCallInAsync()], root=str(tmp_path)).run(
+            [str(tmp_path / "m.py")]).findings
+        assert len(raw) == 2
+        assert raw[0].fingerprint != raw[1].fingerprint
+        # Baselining only the first leaves the second ACTIVE.
+        entries = [{"rule": "AIL001", "path": "m.py",
+                    "fingerprint": raw[0].fingerprint,
+                    "justification": "first sleep is grandfathered"}]
+        result = Analyzer([BlockingCallInAsync()], root=str(tmp_path),
+                          baseline=Baseline(entries)).run(
+            [str(tmp_path / "m.py")])
+        assert len(result.findings) == 1 and len(result.baselined) == 1
+
+    def test_baseline_without_justification_refused(self, tmp_path):
+        p = tmp_path / "baseline.json"
+        p.write_text('{"version": 1, "findings": [{"rule": "AIL001", '
+                     '"fingerprint": "abc", "justification": "  "}]}')
+        with pytest.raises(BaselineError):
+            Baseline.load(str(p))
+
+    def test_parse_error_is_a_finding(self, tmp_path):
+        (tmp_path / "bad.py").write_text("def broken(:\n")
+        result = Analyzer([BlockingCallInAsync()],
+                          root=str(tmp_path)).run([str(tmp_path / "bad.py")])
+        assert [f.rule for f in result.findings] == ["AIL000"]
+
+    def test_cli_exit_codes_and_json(self, tmp_path, capsys):
+        from ai4e_tpu.analysis.cli import main
+        (tmp_path / "m.py").write_text(
+            "import time\nasync def h():\n    time.sleep(1)\n")
+        assert main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                     "--select", "AIL001"]) == 1
+        capsys.readouterr()
+        assert main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                     "--select", "AIL004"]) == 0
+        capsys.readouterr()
+        assert main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                     "--select", "AIL001", "--json"]) == 1
+        out = capsys.readouterr().out
+        import json as _json
+        data = _json.loads(out)
+        assert data["findings"][0]["rule"] == "AIL001"
+
+    def test_cli_write_baseline_then_requires_justification(self, tmp_path,
+                                                            capsys):
+        from ai4e_tpu.analysis.cli import main
+        (tmp_path / "m.py").write_text(
+            "import time\nasync def h():\n    time.sleep(1)\n")
+        assert main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                     "--write-baseline"]) == 0
+        # The freshly-seeded baseline has empty justifications: the gate
+        # refuses it (exit 2) until a human writes them.
+        assert main([str(tmp_path / "m.py"), "--root", str(tmp_path)]) == 2
+
+
+# -- the repo gate ------------------------------------------------------------
+
+
+class TestRepoClean:
+    def test_ai4e_tpu_clean_modulo_baseline(self):
+        """The same check CI runs: the production tree must be clean —
+        every rule, empty-or-justified baseline."""
+        baseline_path = os.path.join(REPO, "analysis_baseline.json")
+        baseline = Baseline.load(baseline_path)
+        analyzer = Analyzer([cls() for cls in ALL_RULES], root=REPO,
+                            baseline=baseline)
+        result = analyzer.run([os.path.join(REPO, "ai4e_tpu")])
+        assert result.findings == [], "\n".join(
+            f.render() for f in result.findings)
+        assert result.stale_baseline == []
+        assert result.files_scanned > 50
+
+
+# -- behavioral regressions for defects the analyzer surfaced -----------------
+
+
+class TestTerminalClobberFixes:
+    """AIL003 true positives fixed in this PR, each with the scenario that
+    used to corrupt task state."""
+
+    def test_push_forward_suppresses_terminal_duplicate(self):
+        """A RETRIED push event (attempts > 1, e.g. after a lost response)
+        for a completed task must not re-execute, and must not clobber the
+        completion (the queue side fixed this in PR 3; the push side was
+        still open). The attempt ordinal rides X-AI4E-Event-Attempt."""
+        from ai4e_tpu.broker.push import PushEvent, WebhookDispatcher
+        from ai4e_tpu.service import LocalTaskManager
+        from ai4e_tpu.taskstore import APITask, InMemoryTaskStore
+
+        async def main():
+            store = InMemoryTaskStore()
+            wd = WebhookDispatcher(LocalTaskManager(store))
+            wd.add_route("/v1/x", "http://127.0.0.1:1/v1/x")  # unreachable
+            task = store.upsert(APITask(endpoint="/v1/x", body=b"{}"))
+            store.update_status(task.task_id, "completed - 3 found")
+            status = await wd._forward(PushEvent(
+                id=task.task_id, subject="/v1/x", data=b"{}", attempts=2))
+            assert status == 200  # acked, not retried
+            assert store.get(task.task_id).status == "completed - 3 found"
+            assert wd._forwarded.value(outcome="duplicate") == 1
+            # First delivery (attempts <= 1) skips the probe — hot path
+            # unchanged: the unreachable backend surfaces as a retryable
+            # 429, and the completion still isn't clobbered (the
+            # failure-path writes carry their own terminal guard).
+            status = await wd._forward(PushEvent(
+                id=task.task_id, subject="/v1/x", data=b"{}", attempts=1))
+            assert status == 429
+            assert store.get(task.task_id).status == "completed - 3 found"
+
+        run_analysis(main())
+
+    def test_push_event_attempt_rides_the_wire(self):
+        """headers_for_attempt stamps the ordinal; from_headers restores
+        it — the signal the webhook's duplicate suppression keys on."""
+        from ai4e_tpu.broker.push import PushEvent
+
+        ev = PushEvent(id="t1", subject="/v1/x", data=b"payload")
+        headers = ev.headers_for_attempt(3)
+        back = PushEvent.from_headers(headers, b"payload")
+        assert back.attempts == 3 and back.id == "t1"
+        assert PushEvent.from_headers(ev.to_headers(), b"x").attempts == 0
+
+    def test_dispatcher_drop_expired_skips_terminal(self):
+        """An expired redelivery of an already-completed task must not
+        flip the completion to `expired` (dispatch-side AIL003)."""
+        from ai4e_tpu.broker import InMemoryBroker
+        from ai4e_tpu.broker.dispatcher import Dispatcher
+        from ai4e_tpu.broker.queue import Message
+        from ai4e_tpu.service import LocalTaskManager
+        from ai4e_tpu.taskstore import APITask, InMemoryTaskStore
+
+        async def main():
+            store = InMemoryTaskStore()
+            broker = InMemoryBroker()
+            broker.register_queue("/v1/x")
+            d = Dispatcher(broker, "/v1/x", "http://127.0.0.1:1/v1/x",
+                           LocalTaskManager(store))
+            task = store.upsert(APITask(endpoint="/v1/x", body=b"{}"))
+            store.update_status(task.task_id, "completed - done")
+            msg = Message(task_id=task.task_id, endpoint="/v1/x",
+                          deadline_at=1.0, queue_name="/v1/x")
+            assert await d._drop_expired(msg) is True
+            assert store.get(task.task_id).status == "completed - done"
+
+        run_analysis(main())
+
+    def test_async_shell_suppresses_terminal_duplicate(self):
+        """Service-shell adoption guard: a redelivered taskId whose task is
+        already terminal acks without invoking the handler."""
+        from aiohttp.test_utils import TestClient, TestServer
+        from ai4e_tpu.service import APIService, LocalTaskManager
+        from ai4e_tpu.taskstore import APITask, InMemoryTaskStore
+
+        store = InMemoryTaskStore()
+        svc = APIService("svc", prefix="v1/test",
+                         task_manager=LocalTaskManager(store))
+        calls = []
+
+        @svc.api_async_func("/run")
+        async def run_ep(taskId, body, content_type):
+            calls.append(taskId)
+            await svc.task_manager.complete_task(taskId, "completed - ran")
+
+        async def main():
+            task = store.upsert(APITask(endpoint="/v1/test/run", body=b""))
+            store.update_status(task.task_id, "completed - first run")
+            client = TestClient(TestServer(svc.app))
+            await client.start_server()
+            try:
+                resp = await client.post("/v1/test/run", data=b"{}",
+                                         headers={"taskId": task.task_id})
+                assert resp.status == 200
+                await svc.drain(timeout=2.0)
+            finally:
+                await client.close()
+            assert calls == []  # handler never invoked
+            assert store.get(task.task_id).status == "completed - first run"
+
+        run_analysis(main())
+
+    def test_handler_failure_after_completion_keeps_completion(self):
+        """_execute_async must not stamp `failed` over a completion the
+        handler already wrote (cleanup-error-after-complete)."""
+        from aiohttp.test_utils import TestClient, TestServer
+        from ai4e_tpu.service import APIService, LocalTaskManager
+        from ai4e_tpu.taskstore import InMemoryTaskStore
+
+        store = InMemoryTaskStore()
+        svc = APIService("svc", prefix="v1/test",
+                         task_manager=LocalTaskManager(store))
+
+        @svc.api_async_func("/run")
+        async def run_ep(taskId, body, content_type):
+            await svc.task_manager.complete_task(taskId, "completed - ok")
+            raise RuntimeError("cleanup exploded after completion")
+
+        async def main():
+            client = TestClient(TestServer(svc.app))
+            await client.start_server()
+            try:
+                resp = await client.post("/v1/test/run", data=b"{}")
+                task_id = (await resp.json())["TaskId"]
+                await svc.drain(timeout=2.0)
+            finally:
+                await client.close()
+            assert store.get(task_id).status == "completed - ok"
+
+        run_analysis(main())
+
+
+class TestFireAndForgetFix:
+    def test_dead_letter_spawn_holds_strong_ref(self):
+        """AIL004 fix: the assembly keeps strong refs to dead-letter
+        transitions until done (the loop's weak ref alone permits GC
+        mid-flight)."""
+        from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+
+        async def main():
+            platform = LocalPlatform(PlatformConfig())
+            loop = asyncio.get_running_loop()
+            started = asyncio.Event()
+            release = asyncio.Event()
+
+            async def work():
+                started.set()
+                await release.wait()
+
+            t = platform._spawn_bg(loop, work())
+            await started.wait()
+            assert t in platform._bg_tasks  # strong ref while running
+            release.set()
+            await t
+            await asyncio.sleep(0)
+            assert t not in platform._bg_tasks  # discarded when done
+
+        run_analysis(main())
+
+
+class TestRegistryLeakFixes:
+    def test_span_metrics_land_in_component_registry(self):
+        """AIL002 fix: gateway/dispatcher/webhook tracers observe
+        ai4e_span_seconds into the assembly's registry, and an
+        assembly-driven span leaves NO new series in DEFAULT_REGISTRY."""
+        from ai4e_tpu.broker import InMemoryBroker
+        from ai4e_tpu.broker.dispatcher import Dispatcher
+        from ai4e_tpu.gateway import Gateway
+        from ai4e_tpu.metrics import DEFAULT_REGISTRY, MetricsRegistry
+        from ai4e_tpu.service import LocalTaskManager
+        from ai4e_tpu.taskstore import InMemoryTaskStore
+
+        before = set(DEFAULT_REGISTRY._metrics)
+        reg = MetricsRegistry()
+        store = InMemoryTaskStore()
+        gw = Gateway(store, metrics=reg)
+        broker = InMemoryBroker(metrics=reg)
+        broker.register_queue("/v1/x")
+        d = Dispatcher(broker, "/v1/x", "http://127.0.0.1:1/v1/x",
+                       LocalTaskManager(store), metrics=reg)
+        with gw.tracer.span("create_task"):
+            pass
+        with d.tracer.span("dispatch"):
+            pass
+        hist = reg.histogram("ai4e_span_seconds")
+        assert hist.quantile(0.5, name="create_task",
+                             service="gateway") >= 0
+        assert hist.quantile(0.5, name="dispatch",
+                             service="dispatcher") >= 0
+        assert set(DEFAULT_REGISTRY._metrics) == before
+
+    def test_replication_gauges_land_in_injected_registry(self, tmp_path):
+        """AIL002 fix (satellite): replication gauges ride the injected
+        registry — visible in the assembly's /metrics, absent from
+        DEFAULT_REGISTRY."""
+        from ai4e_tpu.metrics import DEFAULT_REGISTRY, MetricsRegistry
+        from ai4e_tpu.taskstore.replication import JournalReplicator
+        from ai4e_tpu.taskstore.store import FollowerTaskStore
+
+        async def main():
+            before = set(DEFAULT_REGISTRY._metrics)
+            reg = MetricsRegistry()
+            store = FollowerTaskStore(str(tmp_path / "journal.jsonl"))
+            repl = JournalReplicator(store, "http://127.0.0.1:1",
+                                     metrics=reg)
+            assert "ai4e_replication_offset_bytes" in reg._metrics
+            assert "ai4e_replication_lag_bytes" in reg._metrics
+            assert set(DEFAULT_REGISTRY._metrics) == before
+            await repl.aclose()
+
+        run_analysis(main())
+
+
+class TestConfigDriftFix:
+    def test_out_of_band_namespaces_boot(self):
+        """AIL006 fix: AI4E_FEED_*/AI4E_CHAOS_* are out-of-band namespaces
+        — FrameworkConfig.from_env used to REJECT AI4E_FEED_ADVERTISE_IP,
+        so a multihost deployment pinning its feed IP could not boot."""
+        from ai4e_tpu.config import ConfigError, FrameworkConfig
+
+        cfg = FrameworkConfig.from_env(env={
+            "AI4E_FEED_ADVERTISE_IP": "10.0.0.7",
+            "AI4E_CHAOS_SEED": "123",
+            "AI4E_FAULT_FETCH_FAIL_NTHS": "2",
+        })
+        assert cfg.platform.transport == "queue"
+        # Misspellings still fail loudly — the exemption is namespaces,
+        # not a hole.
+        with pytest.raises(ConfigError):
+            FrameworkConfig.from_env(env={"AI4E_PLATFROM_TRANSPORT": "push"})

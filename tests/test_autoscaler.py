@@ -8,12 +8,7 @@ from aiohttp import web
 from aiohttp.test_utils import TestServer
 
 from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
-from ai4e_tpu.scaling import (
-    AutoscaleController,
-    AutoscalePolicy,
-    DispatcherScaleTarget,
-    HPADecider,
-)
+from ai4e_tpu.scaling import AutoscalePolicy, HPADecider
 
 
 class FakeClock:

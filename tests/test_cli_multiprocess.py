@@ -210,7 +210,7 @@ class TestLiveSplitBrainFencing:
             try:
                 for env in (pri_env, stb_env):
                     port = pri_port if env is pri_env else stb_port
-                    procs.append(subprocess.Popen(
+                    procs.append(subprocess.Popen(  # noqa: ASYNC220  # test launches real control-plane processes
                         [sys.executable, "-m", "ai4e_tpu", "control-plane",
                          "--routes", str(tmp_path / "routes.json"),
                          "--port", str(port)],

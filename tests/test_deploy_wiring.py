@@ -344,7 +344,6 @@ class TestChartEnvNames:
         review time instead. Validates NAMES only; values are deploy-time
         ${TEMPLATE} substitutions."""
 
-        from ai4e_tpu import config as cfg
         from ai4e_tpu.config import FrameworkConfig
 
         valid = set()

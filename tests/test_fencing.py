@@ -12,7 +12,6 @@ node rejoins as a follower automatically when the partition heals.
 """
 
 import asyncio
-import json
 
 import aiohttp
 import pytest

@@ -7,7 +7,6 @@ failures and keep running, never crash or hang."""
 
 import asyncio
 import itertools
-import json
 
 import pytest
 from aiohttp import ClientSession, TCPConnector, web

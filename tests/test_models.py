@@ -7,13 +7,11 @@ import numpy as np
 
 from ai4e_tpu.models import (
     create_detector,
-    create_resnet50,
     create_unet,
     decode_detections,
     segment_logits_to_classes,
 )
 from ai4e_tpu.models.resnet import ResNet
-from ai4e_tpu.models.unet import UNet
 
 
 class TestUNet:

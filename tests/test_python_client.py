@@ -7,7 +7,6 @@ import asyncio
 import importlib.util
 import io
 import os
-import sys
 import threading
 
 import numpy as np

@@ -37,7 +37,7 @@ class TestRClientContract:
         asyncio.run(self._replay())
 
     async def _replay(self):
-        with open(FIXTURES) as f:
+        with open(FIXTURES) as f:  # noqa: ASYNC230  # small local fixture read at test start
             spec = json.load(f)
 
         published = []

@@ -11,7 +11,6 @@ import asyncio
 import json
 
 import pytest
-from aiohttp import web
 from aiohttp.test_utils import TestClient, TestServer
 
 from ai4e_tpu.service.task_manager import HttpResultStore, HttpTaskManager

@@ -16,7 +16,6 @@ import importlib.util
 import io
 import json
 import os
-import sys
 import time
 
 import numpy as np

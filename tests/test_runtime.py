@@ -3,9 +3,7 @@
 failure isolation, saturation backpressure)."""
 
 import asyncio
-import json
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

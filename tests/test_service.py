@@ -3,7 +3,6 @@ size limits, draining, task polling — the semantics of
 ``APIs/1.0/base-py/ai4e_service.py:72-213``."""
 
 import asyncio
-import json
 import threading
 
 from aiohttp.test_utils import TestClient, TestServer
