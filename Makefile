@@ -1,0 +1,20 @@
+# Contributor conveniences. Each target reproduces the matching CI job
+# with the SAME flags (the scripts are the single source of truth).
+
+.PHONY: lint test race-smoke
+
+# Both lint gates CI runs (ruff correctness rules + ai4e-lint, see
+# scripts/lint.sh and docs/analysis.md).
+lint:
+	bash scripts/lint.sh
+
+# Tier-1: the suite ROADMAP.md's verify line runs.
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+	  --continue-on-collection-errors -p no:cacheprovider
+
+# The deterministic interleaving suite (docs/concurrency.md) — the same
+# selection CI's race-smoke job runs, JAX-free.
+race-smoke:
+	python -m pytest tests/test_race_explorer.py \
+	  tests/test_race_regressions.py -q -m race -p no:cacheprovider

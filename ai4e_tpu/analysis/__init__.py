@@ -21,6 +21,11 @@ several rules). Grandfathering: a checked-in baseline file where every
 entry carries a written justification (``--baseline``/``--write-baseline``).
 
 Stdlib-only by design: the CI gate must not need the JAX toolchain.
+
+The dynamic counterpart lives in ``ai4e_tpu.analysis.race`` (also
+stdlib-only): a deterministic interleaving explorer that runs the async
+task path's critical sections under schedule control and catches the
+races AIL007-AIL009 check the shape of — ``docs/concurrency.md``.
 """
 
 from .core import (AnalysisResult, Analyzer, Baseline, BaselineError,

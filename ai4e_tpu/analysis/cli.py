@@ -18,13 +18,30 @@ from .rules import ALL_RULES
 DEFAULT_BASELINE = "analysis_baseline.json"
 
 
+class UnknownRuleError(ValueError):
+    """``--select``/``--ignore`` named a rule id the catalog doesn't have.
+    A configuration error (exit 2), NOT an empty-selection no-op: a typo'd
+    id in the CI job must fail the gate loudly, not silently disable it."""
+
+
 def _build_rules(select: str | None, ignore: str | None):
     rules = [cls() for cls in ALL_RULES]
+    catalog = {r.rule_id for r in rules}
+
+    def _ids(raw: str, flag: str) -> set[str]:
+        ids = {r.strip().upper() for r in raw.split(",") if r.strip()}
+        unknown = sorted(ids - catalog)
+        if unknown:
+            raise UnknownRuleError(
+                f"{flag} names unknown rule id(s): {', '.join(unknown)} "
+                f"(catalog: {', '.join(sorted(catalog))})")
+        return ids
+
     if select:
-        wanted = {r.strip().upper() for r in select.split(",") if r.strip()}
+        wanted = _ids(select, "--select")
         rules = [r for r in rules if r.rule_id in wanted]
     if ignore:
-        dropped = {r.strip().upper() for r in ignore.split(",") if r.strip()}
+        dropped = _ids(ignore, "--ignore")
         rules = [r for r in rules if r.rule_id not in dropped]
     return rules
 
@@ -75,7 +92,11 @@ def main(argv: list[str] | None = None) -> int:
             print(f"error: {exc}", file=sys.stderr)
             return 2
 
-    rules = _build_rules(args.select, args.ignore)
+    try:
+        rules = _build_rules(args.select, args.ignore)
+    except UnknownRuleError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
     analyzer = Analyzer(rules, root=root, baseline=baseline)
     result = analyzer.run([os.path.join(root, p)
                            if not os.path.isabs(p) else p
@@ -88,8 +109,22 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.as_json:
+        # Schema documented in docs/analysis.md ("--json output"). Each
+        # finding carries its baseline fingerprint AND a ready-to-paste
+        # ``baseline_entry`` (justification left empty — a human writes
+        # it), so baselines are authored/audited from this output instead
+        # of re-deriving fingerprints by hand.
+        def _dump(f):
+            d = f.to_dict()
+            d["baseline_entry"] = {
+                "rule": f.rule, "path": f.path, "symbol": f.symbol,
+                "snippet": f.snippet, "fingerprint": f.fingerprint,
+                "justification": "",
+            }
+            return d
         print(json.dumps({
-            "findings": [f.to_dict() for f in result.findings],
+            "version": 1,
+            "findings": [_dump(f) for f in result.findings],
             "baselined": [f.to_dict() for f in result.baselined],
             "suppressed": result.suppressed,
             "stale_baseline": result.stale_baseline,

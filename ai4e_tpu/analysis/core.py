@@ -180,6 +180,174 @@ def enclosing_symbol(stack: list[ast.AST]) -> str:
     return ".".join(n for n in names if n)
 
 
+# -- await-aware flow (used by the AIL007-AIL009 concurrency rules) ----------
+
+
+#: Statement-level suspension constructs. ``ast.Await`` is the third kind,
+#: collected expression-side.
+_SUSPENDING_STMTS = (ast.AsyncFor, ast.AsyncWith)
+_NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _pos(node: ast.AST) -> tuple[int, int]:
+    return (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+
+
+class AwaitFlow:
+    """Lightweight CFG-over-suspension-points for ONE async function.
+
+    The question the concurrency rules need answered is narrow: *between
+    this guard evaluation and that write, can the coroutine suspend?* —
+    because every suspension hands the event loop to arbitrary other tasks,
+    invalidating anything the guard read. Rather than a full CFG this
+    models exactly that:
+
+    - **suspension points** are ``await`` expressions, ``async for`` loops
+      and ``async with`` entries/exits, collected in source order; nested
+      ``def``/``async def``/``lambda`` bodies are excluded (they suspend
+      their own callers, not this frame);
+    - ``suspensions_between(a, b)`` counts suspension points that can
+      execute after ``a`` completes and before ``b`` starts on SOME path
+      (exists-path semantics — a linter must flag the racy path even when
+      a clean one exists). Approximations, all deliberate:
+
+      * source position orders evaluation (true within a statement list;
+        branch bodies are corrected for below);
+      * a suspension inside one arm of an ``if`` is excluded when ``b``
+        sits in the *other* arm (no path through both);
+      * **back edges**: when ``b`` is inside a loop that ``a`` is NOT in,
+        every suspension in that loop counts — iteration ``n+1`` reaches
+        ``b`` after the iteration-``n`` suspensions, however they are
+        ordered in source. When ``a`` and ``b`` share the loop the back
+        edge re-executes ``a`` too (the guard is re-evaluated each
+        iteration), so only the source-ordered window counts.
+
+    ``dominates(g, w)`` answers the guard-placement half: an ``if``/
+    ``while`` TEST is evaluated on every path through the statement, so a
+    probe in a test guards everything after it; a probe inside one branch
+    body guards only that branch's descendants.
+    """
+
+    def __init__(self, fn: ast.AsyncFunctionDef | ast.FunctionDef):
+        self.fn = fn
+        self._parent: dict[ast.AST, ast.AST] = {}
+        self.suspensions: list[ast.AST] = []
+        self._collect(fn, parent=None, top=True)
+
+    def _collect(self, node: ast.AST, parent: ast.AST | None,
+                 top: bool = False) -> None:
+        if parent is not None:
+            self._parent[node] = parent
+        if not top and isinstance(node, _NESTED_SCOPES):
+            return  # a nested scope's awaits suspend the nested frame
+        if isinstance(node, ast.Await) or isinstance(node, _SUSPENDING_STMTS):
+            self.suspensions.append(node)
+        for child in ast.iter_child_nodes(node):
+            self._collect(child, node)
+
+    # -- structure queries --------------------------------------------------
+
+    def _ancestors(self, node: ast.AST) -> list[ast.AST]:
+        out = []
+        while node in self._parent:
+            node = self._parent[node]
+            out.append(node)
+        return out
+
+    def in_subtree(self, node: ast.AST, root: ast.AST) -> bool:
+        return node is root or root in self._ancestors(node)
+
+    def _branch_of(self, node: ast.AST, stmt: ast.stmt) -> str | None:
+        """Which field of ``stmt`` (an If/Try/loop) the ancestor path to
+        ``node`` enters through: 'test', 'body', 'orelse', 'handlers',
+        'finalbody', 'iter' — None when ``node`` is not inside ``stmt``."""
+        chain = [node, *self._ancestors(node)]
+        try:
+            child_idx = chain.index(stmt) - 1
+        except ValueError:
+            return None
+        if child_idx < 0:
+            return None
+        child = chain[child_idx]
+        for field, value in ast.iter_fields(stmt):
+            if value is child:
+                return field
+            if isinstance(value, list) and any(v is child for v in value):
+                return field
+        return None
+
+    def lift_to_await(self, node: ast.AST) -> ast.AST:
+        """The evaluation anchor of ``node``: its enclosing ``Await`` when
+        it is directly awaited (``await probe()`` — the await IS the
+        probe's suspension, not an intervening one), else ``node``."""
+        parent = self._parent.get(node)
+        if isinstance(parent, ast.Await):
+            return parent
+        return node
+
+    def _enclosing_loops(self, node: ast.AST) -> list[ast.AST]:
+        return [a for a in self._ancestors(node)
+                if isinstance(a, (ast.For, ast.While, ast.AsyncFor))
+                and self._branch_of(node, a) == "body"]
+
+    def dominates(self, guard: ast.AST, write: ast.AST) -> bool:
+        """Whether every path reaching ``write`` evaluated ``guard`` first
+        (syntactic approximation). A guard in an ``if``/``while`` TEST
+        dominates everything positioned after it; a guard inside a branch
+        body/handler dominates only that branch's own descendants."""
+        if _pos(guard) > _pos(write):
+            return False
+        for anc in self._ancestors(guard):
+            if self.in_subtree(write, anc):
+                return True  # reached the common ancestor: every step up
+                # to here kept write inside guard's branch
+            if isinstance(anc, (ast.If, ast.While)):
+                if self._branch_of(guard, anc) == "test":
+                    continue  # tests run on every path through the stmt
+                return False  # guard in one arm, write outside the stmt
+            if isinstance(anc, (ast.For, ast.AsyncFor, ast.Try,
+                                ast.With, ast.AsyncWith)):
+                branch = self._branch_of(guard, anc)
+                if branch in ("handlers", "orelse", "finalbody"):
+                    return False  # exceptional/conditional arm only
+                if isinstance(anc, (ast.For, ast.AsyncFor)):
+                    return False  # loop body may run zero times
+        return True
+
+    def suspensions_between(self, a: ast.AST, b: ast.AST) -> list[ast.AST]:
+        """Suspension points that can execute after ``a`` and before ``b``
+        on some path (see class docstring for the approximation), excluding
+        suspensions inside ``a``'s or ``b``'s own subtrees."""
+        a_loops = set(map(id, self._enclosing_loops(a)))
+        b_loops = self._enclosing_loops(b)
+        back_edge_loops = [L for L in b_loops if id(L) not in a_loops]
+        out = []
+        for s in self.suspensions:
+            if self.in_subtree(s, a) or self.in_subtree(s, b):
+                continue
+            if any(self.in_subtree(s, L) for L in back_edge_loops):
+                out.append(s)  # iteration n+1 reaches b after s
+                continue
+            if not (_pos(a) < _pos(s) < _pos(b)):
+                continue
+            if self._branch_disjoint(s, b) or self._branch_disjoint(s, a):
+                continue
+            out.append(s)
+        return out
+
+    def _branch_disjoint(self, s: ast.AST, other: ast.AST) -> bool:
+        """True when ``s`` and ``other`` sit in different arms of the same
+        ``if`` — no single path executes both."""
+        for anc in self._ancestors(s):
+            if isinstance(anc, ast.If) and self.in_subtree(other, anc):
+                sb = self._branch_of(s, anc)
+                ob = self._branch_of(other, anc)
+                if (sb in ("body", "orelse") and ob in ("body", "orelse")
+                        and sb != ob):
+                    return True
+        return False
+
+
 # -- suppression -------------------------------------------------------------
 
 

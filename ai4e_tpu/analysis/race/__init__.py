@@ -1,0 +1,54 @@
+"""ai4e-race — deterministic interleaving exploration for the async task path.
+
+The static rules (AIL007-AIL009) catch the check-then-act-across-await
+*shape*; this package catches the *behavior*: it runs real platform
+coroutines under a deterministic cooperative scheduler that controls task
+ordering at every yield point, explores seeded-random plus
+bounded-systematic interleavings, and tracks happens-before over
+instrumented shared-state accesses with vector clocks — so the races the
+PR 3/PR 4 chaos runs only hit by luck become reproducible unit tests
+(``docs/concurrency.md`` has the operator view).
+
+Three layers:
+
+- ``scheduler``  — ``VirtualLoop``: a minimal virtual-clock event loop
+  whose ready-queue pops are chosen by a ``Schedule`` (seeded random, or
+  a forced-prefix replay for systematic search). Timers advance virtual
+  time, so explored code sleeps for free and every run is
+  byte-deterministic;
+- ``explore``    — ``explore_interleavings(make_coros, schedules=N,
+  seed=...)``: the pytest helper. Fresh state per schedule, systematic
+  prefixes first, seeded random for the rest of the budget; same seed →
+  same schedules → same verdict;
+- ``hb``         — ``RaceTracker``: vector-clock happens-before over
+  accesses recorded by the instrumentation wrappers (``TracedTaskManager``,
+  ``TracedLock``, ``TracedEvent``, ``yield_point``), reporting racy access
+  pairs with both stack traces.
+
+Stdlib-only (like the rest of ``ai4e_tpu.analysis``): the CI ``race-smoke``
+job runs without the JAX toolchain.
+"""
+
+from .explore import ExplorationReport, RunResult, explore_interleavings
+from .hb import (RaceError, RaceTracker, TracedEvent, TracedLock,
+                 TracedTaskManager, yield_point)
+from .scheduler import (DeadlockError, PrefixSchedule, RandomSchedule,
+                        ScheduleBudgetExceeded, VirtualLoop, run_schedule)
+
+__all__ = [
+    "DeadlockError",
+    "ExplorationReport",
+    "PrefixSchedule",
+    "RaceError",
+    "RaceTracker",
+    "RandomSchedule",
+    "RunResult",
+    "ScheduleBudgetExceeded",
+    "TracedEvent",
+    "TracedLock",
+    "TracedTaskManager",
+    "VirtualLoop",
+    "explore_interleavings",
+    "run_schedule",
+    "yield_point",
+]

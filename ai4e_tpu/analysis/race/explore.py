@@ -1,0 +1,158 @@
+"""``explore_interleavings`` — the pytest-facing exploration harness.
+
+Usage (see ``tests/test_race_regressions.py`` for the platform suite)::
+
+    def make():
+        store = InMemoryTaskStore()            # FRESH state per schedule
+        tm = TracedTaskManager(LocalTaskManager(store))
+        ...build the competing coroutines...
+        def check():                            # post-run invariant
+            assert store.get(tid).canonical_status == "completed"
+        return [coro_a(), coro_b()], check
+
+    report = explore_interleavings(make, schedules=60, seed=20260803)
+    assert report.ok, report.describe()
+
+Exploration strategy — bounded-systematic first, seeded-random for the
+rest of the budget:
+
+- **systematic**: breadth-first over scheduling-decision prefixes. Run
+  the all-first-choice schedule, then for every decision point where
+  ``n`` callbacks were runnable, branch each untaken choice into a new
+  prefix; repeat until the budget's systematic share is spent. Shallow
+  divergences (where check-then-act races live — the competitor slotting
+  into the first few windows) are covered exhaustively;
+- **random**: ``random.Random(seed*1000003 + i)`` per remaining run —
+  deep/late interleavings the bounded frontier can't reach.
+
+Same ``(schedules, seed)`` → the same schedule set in the same order →
+the same verdict, on any machine: schedules never consult wall clock,
+and the virtual loop jumps time instead of sleeping.
+
+A run FAILS when a vthread raises, the post-run ``check`` raises, the
+scheduler deadlocks, or the step budget trips. The report carries each
+failure's schedule trace — paste it into ``PrefixSchedule`` to replay
+that exact interleaving under a debugger.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+from .scheduler import (DeadlockError, PrefixSchedule, RandomSchedule,
+                        ScheduleBudgetExceeded, VirtualLoop)
+
+
+@dataclass
+class RunResult:
+    schedule_id: int
+    kind: str                     # "systematic" | "random"
+    trace: list = field(default_factory=list)
+    error: BaseException | None = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+@dataclass
+class ExplorationReport:
+    runs: list[RunResult]
+    seed: int
+
+    @property
+    def failures(self) -> list[RunResult]:
+        return [r for r in self.runs if not r.ok]
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+    def describe(self) -> str:
+        if self.ok:
+            return (f"{len(self.runs)} schedules explored (seed "
+                    f"{self.seed}), no violation")
+        lines = [f"{len(self.failures)}/{len(self.runs)} schedules "
+                 f"violated (seed {self.seed}); first:"]
+        first = self.failures[0]
+        lines.append(f"  schedule #{first.schedule_id} ({first.kind}), "
+                     f"replay prefix: {[c for c, _ in first.trace]}")
+        lines.append(f"  {type(first.error).__name__}: {first.error}")
+        return "\n".join(lines)
+
+
+def _one_run(make_coros, schedule, max_steps: int) -> BaseException | None:
+    made = make_coros()
+    if (isinstance(made, tuple) and len(made) == 2 and callable(made[1])):
+        coros, check = made
+    else:
+        coros, check = made, None
+    loop = VirtualLoop(schedule, max_steps=max_steps)
+    try:
+        results = loop.run(list(coros))
+    except (DeadlockError, ScheduleBudgetExceeded) as exc:
+        return exc
+    for r in results:
+        if isinstance(r, BaseException):
+            return r
+    # Background tasks the explored code spawned are part of the verdict:
+    # a crash in one must fail the run, not pass silently because no root
+    # awaited it.
+    if loop.background_errors:
+        return loop.background_errors[0]
+    if check is not None:
+        try:
+            check()
+        except BaseException as exc:  # noqa: BLE001; ai4e: noqa[AIL005] — not swallowed: the exception IS the run's verdict, returned into the report
+            return exc
+    return None
+
+
+def explore_interleavings(make_coros, schedules: int = 50, seed: int = 0,
+                          systematic: int | None = None,
+                          max_steps: int = 20_000,
+                          fail_fast: bool = False) -> ExplorationReport:
+    """Run ``make_coros`` under up to ``schedules`` deterministic
+    interleavings (module docstring). ``make_coros()`` must build FRESH
+    coroutines AND fresh shared state each call, returning either a list
+    of coroutines or ``(coroutines, check)`` where ``check()`` asserts
+    the post-run invariant. ``systematic`` bounds the breadth-first
+    prefix share (default: half the budget). ``fail_fast`` stops at the
+    first violating schedule — regression tests usually want the full
+    count, minimization wants the first.
+    """
+    if systematic is None:
+        systematic = schedules // 2
+    runs: list[RunResult] = []
+    seen_traces: set[tuple] = set()
+    run_id = 0
+
+    frontier: deque[list[int]] = deque([[]])
+    while frontier and run_id < min(systematic, schedules):
+        prefix = frontier.popleft()
+        sched = PrefixSchedule(prefix)
+        error = _one_run(make_coros, sched, max_steps)
+        trace = sched.trace
+        key = tuple(c for c, _ in trace)
+        if key in seen_traces and error is None:
+            continue  # a shrunken prefix converged on a covered path
+        seen_traces.add(key)
+        runs.append(RunResult(run_id, "systematic", trace, error))
+        run_id += 1
+        if error is not None and fail_fast:
+            return ExplorationReport(runs, seed)
+        # Branch every untaken choice past this prefix's forced part.
+        for i in range(len(prefix), len(trace)):
+            _, n = trace[i]
+            for alt in range(1, n):
+                frontier.append([c for c, _ in trace[:i]] + [alt])
+
+    while run_id < schedules:
+        sched = RandomSchedule(seed * 1000003 + run_id)
+        error = _one_run(make_coros, sched, max_steps)
+        runs.append(RunResult(run_id, "random", sched.trace, error))
+        run_id += 1
+        if error is not None and fail_fast:
+            break
+    return ExplorationReport(runs, seed)

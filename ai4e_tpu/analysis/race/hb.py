@@ -1,0 +1,270 @@
+"""Vector-clock happens-before tracking over instrumented shared state.
+
+Single-threaded asyncio has no *data* races — each segment between
+suspension points is atomic — but it is full of *schedule* races: two
+coroutines touching the same logical state where at least one writes and
+neither access causally precedes the other, so a different interleaving
+produces a different outcome (the stale-guard clobbers AIL007 encodes).
+This module detects exactly those pairs:
+
+- every explored coroutine (vthread) carries a **vector clock**, bumped
+  on each recorded access;
+- synchronization edges come from the traced primitives — releasing a
+  ``TracedLock`` / setting a ``TracedEvent`` publishes the releaser's
+  clock, acquiring/waiting absorbs it;
+- two accesses to the same variable, at least one a write, with neither
+  clock ≤ the other, are a **racy pair** — reported with both stack
+  traces so the finding names the two code paths, not just the variable.
+
+Instrumentation is explicit (wrap the state you care about) — this is a
+test harness, not a global tracer. ``TracedTaskManager`` wraps any
+``TaskManagerBase``-shaped manager, inserting one ``yield_point()``
+before each store operation: the suspension every REAL deployment has
+(the store is an HTTP hop away), without which an in-process
+``LocalTaskManager``'s guard+write would execute atomically and the
+explorer could never interleave the window under test.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import traceback
+
+
+class _YieldOnce:
+    def __await__(self):
+        yield
+
+
+async def yield_point() -> None:
+    """One suspension point: hands the scheduler a decision, nothing else.
+    (``asyncio.sleep(0)`` without the asyncio import ceremony — and
+    grep-able as explicit race-window instrumentation.)"""
+    await _YieldOnce()
+
+
+class RaceError(AssertionError):
+    """Raised by ``RaceTracker.assert_race_free`` — carries the racy pairs."""
+
+    def __init__(self, pairs):
+        self.pairs = pairs
+        super().__init__(
+            f"{len(pairs)} racy access pair(s):\n\n" + "\n\n".join(
+                f"--- {a.kind} vs {b.kind} on {a.var!r} "
+                f"({a.vthread} / {b.vthread}) ---\n"
+                f"{a.vthread} {a.kind}:\n{a.stack}\n"
+                f"{b.vthread} {b.kind}:\n{b.stack}"
+                for a, b in pairs))
+
+
+class Access:
+    __slots__ = ("var", "kind", "vthread", "clock", "stack")
+
+    def __init__(self, var, kind, vthread, clock, stack):
+        self.var = var
+        self.kind = kind          # "read" | "write"
+        self.vthread = vthread    # task name
+        self.clock = clock        # dict vthread -> int, snapshot
+        self.stack = stack        # rendered stack trace (str)
+
+    def happens_before(self, other: "Access") -> bool:
+        return self.clock.get(self.vthread, 0) <= other.clock.get(
+            self.vthread, 0)
+
+
+class RaceTracker:
+    """Collects accesses, maintains clocks, reports racy pairs."""
+
+    def __init__(self, stack_depth: int = 12):
+        self.stack_depth = stack_depth
+        self._clocks: dict[str, dict[str, int]] = {}
+        self._accesses: dict[str, list[Access]] = {}
+        self.races: list[tuple[Access, Access]] = []
+        self._seen_pairs: set[tuple] = set()
+
+    # -- vthread identity / clocks ------------------------------------------
+
+    def _vthread(self) -> str:
+        task = asyncio.current_task()
+        return task.get_name() if task is not None else "<no-task>"
+
+    def _clock_of(self, vthread: str) -> dict[str, int]:
+        c = self._clocks.get(vthread)
+        if c is None:
+            c = self._clocks[vthread] = {}
+        return c
+
+    def _stack(self) -> str:
+        frames = traceback.extract_stack()
+        # Drop the tracker's own frames (this fn + the record caller).
+        frames = frames[:-2][-self.stack_depth:]
+        return "".join(traceback.format_list(frames)).rstrip()
+
+    # -- access recording -----------------------------------------------------
+
+    def record(self, var: str, kind: str) -> None:
+        vthread = self._vthread()
+        clock = self._clock_of(vthread)
+        clock[vthread] = clock.get(vthread, 0) + 1
+        acc = Access(var, kind, vthread, dict(clock), self._stack())
+        for prev in self._accesses.setdefault(var, []):
+            if prev.vthread == vthread:
+                continue
+            if prev.kind == "read" and kind == "read":
+                continue
+            if prev.happens_before(acc) or acc.happens_before(prev):
+                continue
+            key = (prev.vthread, prev.clock.get(prev.vthread, 0),
+                   vthread, clock[vthread], var)
+            if key not in self._seen_pairs:
+                self._seen_pairs.add(key)
+                self.races.append((prev, acc))
+        self._accesses[var].append(acc)
+
+    def read(self, var: str) -> None:
+        self.record(var, "read")
+
+    def write(self, var: str) -> None:
+        self.record(var, "write")
+
+    # -- synchronization edges ------------------------------------------------
+
+    def publish(self, sync_clock: dict[str, int]) -> None:
+        """Release side: fold the current vthread's clock into the sync
+        object's clock (lock release, event set)."""
+        vthread = self._vthread()
+        clock = self._clock_of(vthread)
+        clock[vthread] = clock.get(vthread, 0) + 1
+        for k, v in clock.items():
+            sync_clock[k] = max(sync_clock.get(k, 0), v)
+
+    def absorb(self, sync_clock: dict[str, int]) -> None:
+        """Acquire side: join the sync object's clock into the current
+        vthread's (lock acquire, event wait return)."""
+        clock = self._clock_of(self._vthread())
+        for k, v in sync_clock.items():
+            clock[k] = max(clock.get(k, 0), v)
+
+    # -- verdict ---------------------------------------------------------------
+
+    def assert_race_free(self) -> None:
+        if self.races:
+            raise RaceError(self.races)
+
+
+# -- traced synchronization primitives ----------------------------------------
+
+
+class TracedLock:
+    """``asyncio.Lock`` with happens-before edges: everything before a
+    release happens-before everything after the next acquire."""
+
+    def __init__(self, tracker: RaceTracker):
+        self._tracker = tracker
+        self._inner = asyncio.Lock()
+        self._clock: dict[str, int] = {}
+
+    async def __aenter__(self):
+        await self._inner.acquire()
+        self._tracker.absorb(self._clock)
+        return self
+
+    async def __aexit__(self, *exc):
+        self._tracker.publish(self._clock)
+        self._inner.release()
+
+
+class TracedEvent:
+    """``asyncio.Event`` with a set→wait happens-before edge."""
+
+    def __init__(self, tracker: RaceTracker):
+        self._tracker = tracker
+        self._inner = asyncio.Event()
+        self._clock: dict[str, int] = {}
+
+    def set(self) -> None:
+        self._tracker.publish(self._clock)
+        self._inner.set()
+
+    def is_set(self) -> bool:
+        return self._inner.is_set()
+
+    async def wait(self) -> None:
+        await self._inner.wait()
+        self._tracker.absorb(self._clock)
+
+
+# -- traced task manager -------------------------------------------------------
+
+
+class TracedTaskManager:
+    """Wraps a ``TaskManagerBase``-shaped manager for exploration.
+
+    Records each access (``task:<id>`` reads for probes, writes for
+    transitions) for the vector-clock tracker when one is given.
+
+    ``hop`` chooses which deployment the fixture models:
+
+    - ``hop=False`` (default) — **in-process store** (``LocalTaskManager``
+      over ``InMemoryTaskStore``): store calls complete without
+      suspending, exactly like production single-host. A probe
+      immediately followed by its write is atomic; the interleaving
+      windows are the code under test's own awaits (sleeps, backend
+      POSTs, result-store hops) — the windows whose clobbers PRs 3-5
+      actually shipped.
+    - ``hop=True`` — **remote store** (``HttpTaskManager``): one
+      ``yield_point()`` before every operation, the suspension the HTTP
+      hop makes unavoidable. Under this model even probe-then-write has
+      a one-suspension residual window — the accepted platform contract
+      (writers that must win that window use the store's conditional
+      verbs, ``update_status_if``/``requeue_if``; docs/concurrency.md).
+      ``tests/test_race_regressions.py`` keeps that paragraph honest by
+      demonstrating the residual window IS reachable under ``hop=True``.
+    """
+
+    def __init__(self, inner, tracker: RaceTracker | None = None,
+                 hop: bool = False):
+        self.inner = inner
+        self.tracker = tracker
+        self.hop = hop
+
+    async def _pre(self, task_id: str, kind: str) -> None:
+        if self.hop:
+            await yield_point()
+        if self.tracker is not None:
+            self.tracker.record(f"task:{task_id}", kind)
+
+    async def is_terminal(self, task_id: str) -> bool:
+        await self._pre(task_id, "read")
+        return await self.inner.is_terminal(task_id)
+
+    async def get_task_status(self, task_id: str):
+        await self._pre(task_id, "read")
+        return await self.inner.get_task_status(task_id)
+
+    async def update_task_status(self, task_id: str, status: str,
+                                 backend_status: str | None = None):
+        await self._pre(task_id, "write")
+        return await self.inner.update_task_status(
+            task_id, status, backend_status=backend_status)
+
+    async def complete_task(self, task_id: str, status: str = "completed"):
+        await self._pre(task_id, "write")
+        return await self.inner.complete_task(task_id, status)
+
+    async def fail_task(self, task_id: str, status: str = "failed"):
+        await self._pre(task_id, "write")
+        return await self.inner.fail_task(task_id, status)
+
+    async def add_task(self, endpoint, body, task_id=None, publish=False):
+        await self._pre(task_id or "", "write")
+        return await self.inner.add_task(endpoint, body, task_id=task_id,
+                                         publish=publish)
+
+    async def add_pipeline_task(self, task_id, next_endpoint, body=b""):
+        await self._pre(task_id, "write")
+        return await self.inner.add_pipeline_task(task_id, next_endpoint,
+                                                  body)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

@@ -1,0 +1,281 @@
+"""Deterministic cooperative scheduler — a virtual-clock event loop whose
+every scheduling decision is made by an explicit ``Schedule``.
+
+Why a custom loop instead of instrumenting coroutines: asyncio's own
+ready queue is FIFO, so for a fixed program it always explores exactly ONE
+interleaving — the racy window between a guard and its write is only ever
+hit when wall-clock jitter happens to land a competing callback in the
+gap (which is precisely why the PR 3/PR 4 races survived until a seeded
+chaos run stumbled into them). Here the ready queue is the decision
+surface: whenever more than one callback is runnable, the ``Schedule``
+picks which runs next. Every task step, future completion and timer is a
+callback, so the schedule controls ordering at every yield point of every
+explored coroutine — including awaits buried arbitrarily deep in platform
+code, with zero instrumentation of the code under test.
+
+Determinism:
+
+- the ready queue is insertion-ordered and popped by schedule choice;
+- timers live in a heap keyed ``(when, seq)`` — ties break by creation
+  order;
+- the clock is virtual: when nothing is ready, time JUMPS to the next
+  timer. ``asyncio.sleep(30)`` in explored code costs nothing and two
+  runs with the same schedule are byte-identical.
+
+The loop implements the subset of the event-loop surface that
+``asyncio``'s task/future/sleep/lock/event/gather machinery actually
+calls (``call_soon`` / ``call_later`` / ``call_at`` / ``time`` /
+``create_future`` / ``create_task`` / ``get_debug`` / …). It is NOT a
+general replacement loop — it exists to be driven by ``run_schedule``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import random
+
+
+class DeadlockError(RuntimeError):
+    """Every explored coroutine is blocked and no timer is pending — a
+    genuine deadlock (e.g. a lock cycle) in the explored code."""
+
+
+class ScheduleBudgetExceeded(RuntimeError):
+    """The run exceeded ``max_steps`` callbacks — explored code is looping
+    (or legitimately needs a bigger budget)."""
+
+
+class _Handle:
+    """Minimal Handle/TimerHandle: what Task/Future/sleep call on us."""
+
+    __slots__ = ("_callback", "_args", "_context", "_cancelled", "_when")
+
+    def __init__(self, callback, args, context=None, when=None):
+        self._callback = callback
+        self._args = args
+        self._context = context
+        self._cancelled = False
+        self._when = when
+
+    def cancel(self) -> None:
+        self._cancelled = True
+
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def when(self) -> float:
+        return self._when or 0.0
+
+    def _run(self) -> None:
+        if self._context is not None:
+            self._context.run(self._callback, *self._args)
+        else:
+            self._callback(*self._args)
+
+
+class RandomSchedule:
+    """Seeded random scheduling decisions; the trace records every
+    ``(choice, n_runnable)`` so a run can be replayed or minimized."""
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self.trace: list[tuple[int, int]] = []
+
+    def pick(self, n: int) -> int:
+        choice = self._rng.randrange(n)
+        self.trace.append((choice, n))
+        return choice
+
+
+class PrefixSchedule:
+    """Replay forced choices, then always pick 0 — the unit of systematic
+    exploration: the explorer enumerates divergence prefixes and this
+    schedule realizes each one deterministically."""
+
+    def __init__(self, prefix: list[int] | tuple[int, ...] = ()):
+        self.prefix = list(prefix)
+        self.trace: list[tuple[int, int]] = []
+
+    def pick(self, n: int) -> int:
+        k = len(self.trace)
+        choice = self.prefix[k] if k < len(self.prefix) else 0
+        if choice >= n:
+            choice = n - 1  # branching factor shrank on this path
+        self.trace.append((choice, n))
+        return choice
+
+
+class VirtualLoop:
+    """The virtual-clock, schedule-driven event loop (module docstring)."""
+
+    def __init__(self, schedule, max_steps: int = 20_000):
+        self._schedule = schedule
+        self._max_steps = max_steps
+        self._ready: list[_Handle] = []
+        self._timers: list[tuple[float, int, _Handle]] = []
+        self._time = 0.0
+        self._seq = 0
+        self._steps = 0
+        self._tasks: list[asyncio.Task] = []
+        self.exceptions: list[dict] = []  # call_exception_handler records
+        # Exceptions from BACKGROUND tasks the explored code spawned
+        # (create_task and forgot, or was still awaiting when the roots
+        # finished). Collected by run(); a verdict surface — the explorer
+        # fails the run on them, else a crash in a spawned task would pass
+        # silently (roots are reported via their own results).
+        self.background_errors: list[BaseException] = []
+
+    # -- the event-loop surface asyncio machinery calls ---------------------
+
+    def time(self) -> float:
+        return self._time
+
+    def call_soon(self, callback, *args, context=None) -> _Handle:
+        h = _Handle(callback, args, context)
+        self._ready.append(h)
+        return h
+
+    # publish()-style callers hop threads in production; under the
+    # explorer everything is one thread, so threadsafe == soon.
+    call_soon_threadsafe = call_soon
+
+    def call_later(self, delay, callback, *args, context=None) -> _Handle:
+        return self.call_at(self._time + max(0.0, delay), callback, *args,
+                            context=context)
+
+    def call_at(self, when, callback, *args, context=None) -> _Handle:
+        h = _Handle(callback, args, context, when=when)
+        self._seq += 1
+        heapq.heappush(self._timers, (when, self._seq, h))
+        return h
+
+    def create_future(self) -> asyncio.Future:
+        return asyncio.Future(loop=self)
+
+    def create_task(self, coro, *, name=None, context=None) -> asyncio.Task:
+        # Deterministic per-loop names: RaceTracker reports and replay
+        # traces must read identically across runs (the global Task-N
+        # counter depends on everything run before).
+        kwargs = {"loop": self,
+                  "name": name or f"vthread-{len(self._tasks)}"}
+        if context is not None:
+            kwargs["context"] = context
+        task = asyncio.Task(coro, **kwargs)
+        self._tasks.append(task)
+        return task
+
+    def get_debug(self) -> bool:
+        return False
+
+    def is_running(self) -> bool:
+        return True
+
+    def is_closed(self) -> bool:
+        return False
+
+    def call_exception_handler(self, context: dict) -> None:
+        self.exceptions.append(context)
+
+    # asyncio.Future.__del__ consults the loop's default handler path via
+    # call_exception_handler only — nothing else to implement.
+
+    # -- driving -------------------------------------------------------------
+
+    def _advance(self) -> None:
+        """Nothing ready: jump virtual time to the next timer deadline and
+        move every timer due at that instant to the ready queue."""
+        while self._timers and self._timers[0][2].cancelled():
+            heapq.heappop(self._timers)
+        if not self._timers:
+            raise DeadlockError(
+                "all explored coroutines are blocked and no timer is "
+                "pending — deadlock in the explored code")
+        when, _, h = heapq.heappop(self._timers)
+        self._time = max(self._time, when)
+        self._ready.append(h)
+        while self._timers and self._timers[0][0] <= self._time:
+            _, _, h2 = heapq.heappop(self._timers)
+            if not h2.cancelled():
+                self._ready.append(h2)
+
+    def _run_once(self) -> None:
+        while True:
+            if not self._ready:
+                self._advance()
+            n = len(self._ready)
+            idx = self._schedule.pick(n) if n > 1 else 0
+            handle = self._ready.pop(idx)
+            if handle.cancelled():
+                continue
+            self._steps += 1
+            if self._steps > self._max_steps:
+                raise ScheduleBudgetExceeded(
+                    f"run exceeded {self._max_steps} scheduler steps")
+            handle._run()
+            return
+
+    def run(self, coros) -> list:
+        """Drive ``coros`` (top-level vthreads) to completion under the
+        schedule; returns each one's result or exception (``gather``-style
+        ``return_exceptions`` shape, so one vthread's crash doesn't hide
+        the others' outcomes)."""
+        prev = asyncio.events._get_running_loop()
+        asyncio.events._set_running_loop(self)
+        try:
+            roots = [self.create_task(c) for c in coros]
+            while not all(t.done() for t in roots):
+                self._run_once()
+            # Let background tasks the explored code spawned finish (or
+            # fail) so their effects are part of the run's verdict; then
+            # reap stragglers so no pending-task warnings leak between
+            # runs.
+            settle = 0
+            while (any(not t.done() for t in self._tasks)
+                   and settle < self._max_steps):
+                settle += 1
+                try:
+                    self._run_once()
+                except DeadlockError:
+                    break
+            for t in self._tasks:
+                if not t.done():
+                    t.cancel()
+            settle = 0
+            while (any(not t.done() for t in self._tasks)
+                   and settle < 1000):
+                settle += 1
+                try:
+                    self._run_once()
+                except DeadlockError:
+                    break
+            roots_set = set(map(id, roots))
+            for t in self._tasks:
+                # Retrieve background failures NOW: unconsumed task
+                # exceptions otherwise surface only at GC time (or never),
+                # and the run's verdict must include them. Reap-phase
+                # cancellations are ours, not the explored code's.
+                if (id(t) not in roots_set and t.done()
+                        and not t.cancelled()
+                        and t.exception() is not None):
+                    self.background_errors.append(t.exception())
+            out = []
+            for t in roots:
+                if t.cancelled():
+                    out.append(asyncio.CancelledError())
+                elif t.exception() is not None:
+                    out.append(t.exception())
+                else:
+                    out.append(t.result())
+            return out
+        finally:
+            asyncio.events._set_running_loop(prev)
+
+
+def run_schedule(make_coros, schedule, max_steps: int = 20_000):
+    """One deterministic run: fresh coroutines (and fresh shared state —
+    ``make_coros`` must build both) under ``schedule``. Returns
+    ``(results, schedule.trace)``."""
+    loop = VirtualLoop(schedule, max_steps=max_steps)
+    return loop.run(make_coros()), schedule.trace

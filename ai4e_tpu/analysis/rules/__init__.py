@@ -3,7 +3,10 @@
 from .blocking import BlockingCallInAsync
 from .config_drift import ConfigDrift
 from .fire_and_forget import FireAndForgetTask
+from .lock_await import LockAcrossSlowAwait
 from .registry_leak import MetricsRegistryLeak
+from .rmw import NonatomicReadModifyWrite
+from .stale_read import StaleReadAcrossAwait
 from .status_clobber import TerminalStatusClobber
 from .swallowed import SwallowedException
 
@@ -14,6 +17,9 @@ ALL_RULES = [
     FireAndForgetTask,
     SwallowedException,
     ConfigDrift,
+    StaleReadAcrossAwait,
+    LockAcrossSlowAwait,
+    NonatomicReadModifyWrite,
 ]
 
 __all__ = ["ALL_RULES"] + [cls.__name__ for cls in ALL_RULES]

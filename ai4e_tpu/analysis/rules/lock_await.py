@@ -1,0 +1,182 @@
+"""AIL008 — a lock held across a slow (network/timer-bound) ``await``,
+plus inconsistent lock-acquisition order.
+
+The bug class: ``async with self._lock: await session.post(...)`` pins the
+lock for the full round-trip — every other coroutine needing it queues
+behind one slow backend, converting a per-request latency into a
+platform-wide convoy (and with ``threading.Lock`` it blocks the entire
+event loop). The platform's convention is the opposite shape: compute the
+decision under the lock, do the I/O outside it (see ``taskstore.store``'s
+blob handling, ``rescache.cache``'s fill protocol).
+
+Two checks, one rule id:
+
+- **slow await under a lock** — inside a ``with``/``async with`` whose
+  context manager resolves to a lock (name heuristic: the final attribute
+  segment contains ``lock``, or a direct ``asyncio.Lock()`` /
+  ``threading.Lock()`` / ``RLock()`` / ``Semaphore()`` call), an awaited
+  call whose final name is network/timer-bound (``post``/``get``/
+  ``request``/``read``/``sleep``/``wait_for``/…) is flagged. Awaiting a
+  *fast* coroutine under a lock is fine and common.
+- **acquisition-order drift** — per module, every function's nested lock
+  pairs are collected (``with A: … with B:`` → ``A→B``); two functions
+  acquiring the same two locks in opposite orders deadlock the first time
+  their schedules interleave, so both sites are flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_name, enclosing_symbol, import_aliases
+
+LOCK_FACTORY_TAILS = frozenset({"Lock", "RLock", "Semaphore",
+                                "BoundedSemaphore", "Condition"})
+# Awaited-call name tails that mean "this await parks for I/O or time":
+# HTTP verbs + socket/stream verbs + timers/waits. Deliberately NOT
+# included: ``to_thread`` / ``run_in_executor`` — offloading CPU/disk work
+# under a dedicated lock is a serialization *idiom* (the worker's
+# checkpoint-reload lock exists precisely to hold reloads across the
+# swap), not a convoy bug.
+SLOW_AWAIT_TAILS = frozenset({
+    "post", "get", "put", "patch", "delete", "head", "request", "fetch",
+    "urlopen", "connect", "send", "recv", "receive", "read", "text",
+    "json", "sleep", "wait", "wait_for", "drain", "gather", "subscribe",
+})
+
+
+def _chain_tail(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _lock_name(expr: ast.AST, aliases: dict) -> str | None:
+    """The canonical name of a lock-ish context manager, or None.
+
+    ``self._lock`` → ``self._lock``; ``asyncio.Lock()`` → its dotted
+    name; anything whose final segment doesn't look like a lock → None.
+    """
+    node = expr
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func, aliases)
+        if name and name.split(".")[-1] in LOCK_FACTORY_TAILS:
+            return name
+        return None
+    tail = _chain_tail(node)
+    # Word-boundary match, not substring: "_block"/"blocklist" contain
+    # "lock" but hold no lock — a CI-blocking rule must not misclassify
+    # them. Real lock names segment cleanly (_lock, _reload_lock, …).
+    if tail and any(seg in ("lock", "rlock", "locks")
+                    for seg in tail.lower().split("_")):
+        parts = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            parts.append(cur.id)
+            return ".".join(reversed(parts))
+        return tail
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rule, ctx):
+        self.rule = rule
+        self.ctx = ctx
+        self.aliases = import_aliases(ctx.tree)
+        self.findings = []
+        self._stack: list[ast.AST] = []
+        # Locks currently held (innermost last) while visiting.
+        self._held: list[tuple[str, ast.AST]] = []
+        # (outer, inner) -> first acquisition site, for order tracking.
+        self.pairs: dict[tuple[str, str], ast.AST] = {}
+
+    # -- scope bookkeeping ---------------------------------------------------
+
+    def _enter(self, node):
+        self._stack.append(node)
+        held, self._held = self._held, []  # locks don't cross def bounds
+        self.generic_visit(node)
+        self._held = held
+        self._stack.pop()
+
+    visit_ClassDef = _enter
+    visit_FunctionDef = _enter
+    visit_AsyncFunctionDef = _enter
+
+    # -- with/async with -----------------------------------------------------
+
+    def _visit_with(self, node):
+        acquired = []
+        for item in node.items:
+            name = _lock_name(item.context_expr, self.aliases)
+            if name is None:
+                continue
+            # Pair against locks already held AND earlier items of THIS
+            # statement — `async with a, b:` enters left-to-right, so it
+            # establishes the a->b order exactly like nesting does.
+            for outer, _site in self._held + acquired:
+                key = (outer, name)
+                self.pairs.setdefault(key, node)
+            acquired.append((name, node))
+        self._held.extend(acquired)
+        self.generic_visit(node)
+        if acquired:
+            del self._held[-len(acquired):]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    # -- awaits under a held lock --------------------------------------------
+
+    def visit_Await(self, node):
+        if self._held:
+            tail = None
+            value = node.value
+            if isinstance(value, ast.Call):
+                tail = _chain_tail(value.func)
+            if tail in SLOW_AWAIT_TAILS:
+                lock = self._held[-1][0]
+                self.findings.append(self.ctx.finding(
+                    self.rule.rule_id, node,
+                    f"await {tail}() while holding {lock} — the lock is "
+                    "pinned for a network/timer-bound round trip, so every "
+                    "other coroutine needing it convoys behind one slow "
+                    "peer (compute under the lock, do the I/O outside it)",
+                    symbol=enclosing_symbol(self._stack)))
+        self.generic_visit(node)
+
+
+class LockAcrossSlowAwait(Rule):
+    rule_id = "AIL008"
+    name = "lock-across-slow-await"
+    description = ("a lock held across a network/timer-bound await convoys "
+                   "the loop; opposite-order double acquisitions deadlock")
+
+    def check_module(self, ctx):
+        v = _Visitor(self, ctx)
+        v.visit(ctx.tree)
+        findings = v.findings
+        # Order drift: (A, B) and (B, A) both acquired somewhere in this
+        # module — the first interleaving of those two code paths deadlocks.
+        reported = set()
+        for (outer, inner), site in sorted(
+                v.pairs.items(), key=lambda kv: (kv[1].lineno, kv[0])):
+            if (inner, outer) in v.pairs and outer != inner:
+                pair = tuple(sorted((outer, inner)))
+                if pair in reported:
+                    continue
+                reported.add(pair)
+                other = v.pairs[(inner, outer)]
+                findings.append(ctx.finding(
+                    self.rule_id, site,
+                    f"lock order {outer} -> {inner} here, but "
+                    f"{inner} -> {outer} at line {other.lineno} — opposite "
+                    "acquisition orders deadlock when the two paths "
+                    "interleave (pick one order and stick to it)",
+                ))
+        return findings

@@ -1,0 +1,180 @@
+"""AIL009 — non-atomic read-modify-write of shared state across an await.
+
+The bug class: ``n = self._busy`` … ``await …`` … ``self._busy = n + 1``.
+Single-threaded asyncio makes each *segment between suspension points*
+atomic — which is exactly why this pattern is a trap: it LOOKS safe (no
+threads!), but the await in the middle lets any other coroutine run the
+same read-modify-write on the same attribute, and one of the two writes
+is lost. ``self._busy += 1`` with no await in the expression is fine (one
+segment); the same logic split across a suspension is not.
+
+What it flags, inside an ``async def`` method of a class:
+
+- ``x = <obj>.attr`` … ≥1 suspension point … ``<obj>.attr = f(x)`` (the
+  write's value references the stale local), where ``attr`` is written by
+  **more than one method** of the class (a single-writer attribute has
+  nobody to race with);
+- the one-statement form ``<obj>.attr = f(await g(), <obj>.attr)`` — the
+  read and write bracket the await inside a single statement.
+
+Fix idioms: re-read after the await; fold the update into one segment
+(``+=`` with no await in the expression); or guard the section with an
+``asyncio.Lock`` (held only across the update, not the I/O — AIL008).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import AwaitFlow, Rule, enclosing_symbol
+
+
+def _attr_chain(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _method_attr_writes(cls: ast.ClassDef) -> dict[str, set[str]]:
+    """attr chain (``self.x``) -> names of methods that assign it."""
+    writes: dict[str, set[str]] = {}
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(item):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                chain = _attr_chain(t)
+                if chain and chain.startswith("self."):
+                    writes.setdefault(chain, set()).add(item.name)
+    return writes
+
+
+class _MethodChecker:
+    def __init__(self, rule, ctx, fn, stack, shared_attrs: set[str]):
+        self.rule = rule
+        self.ctx = ctx
+        self.fn = fn
+        self.symbol = enclosing_symbol(stack)
+        self.shared = shared_attrs
+        self.flow = AwaitFlow(fn)
+        self.findings: list = []
+
+    def check(self):
+        for node in ast.walk(self.fn):
+            if node is not self.fn and node not in self.flow._parent:
+                continue  # nested scope
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                tchain = _attr_chain(target)
+                if tchain not in self.shared:
+                    continue
+                self._check_write(tchain, node)
+        return self.findings
+
+    def _check_write(self, chain: str, write: ast.Assign):
+        # One-statement form: value awaits AND reads the attr it assigns.
+        value_awaits = [n for n in ast.walk(write.value)
+                        if isinstance(n, ast.Await)]
+        value_reads_attr = any(
+            isinstance(n, ast.Attribute) and _attr_chain(n) == chain
+            and n is not write.targets[0]
+            for n in ast.walk(write.value))
+        if value_awaits and value_reads_attr:
+            self._flag(chain, write, "the same statement")
+            return
+        # Split form: find the read this write's value depends on.
+        for name_node in ast.walk(write.value):
+            if not isinstance(name_node, ast.Name):
+                continue
+            read = self._read_for(name_node.id, chain, write)
+            if read is None:
+                continue
+            between = self.flow.suspensions_between(read, write)
+            if between:
+                self._flag(chain, write,
+                           f"line {getattr(read, 'lineno', '?')}")
+                return
+
+    def _read_for(self, local: str, chain: str,
+                  write: ast.Assign) -> ast.AST | None:
+        from ..core import _pos
+        best = None
+        for node in ast.walk(self.fn):
+            if node is not self.fn and node not in self.flow._parent:
+                continue
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == local
+                    and isinstance(node.value, ast.Attribute)
+                    and _attr_chain(node.value) == chain
+                    and _pos(node) < _pos(write)):
+                if best is None or _pos(node) > _pos(best):
+                    best = node
+        return best
+
+    def _flag(self, chain: str, write: ast.AST, read_where: str):
+        self.findings.append(self.ctx.finding(
+            self.rule.rule_id, write,
+            f"{chain} is rewritten from a value read at {read_where}, "
+            "with a suspension point in between — another coroutine can "
+            "run the same read-modify-write in that window and one update "
+            "is lost (re-read after the await, fold into one segment, or "
+            "guard with an asyncio.Lock)",
+            symbol=self.symbol))
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rule, ctx):
+        self.rule = rule
+        self.ctx = ctx
+        self.findings = []
+        self._stack: list[ast.AST] = []
+        self._shared: list[set[str]] = []  # per enclosing class
+
+    def visit_ClassDef(self, node):
+        writes = _method_attr_writes(node)
+        shared = {chain for chain, methods in writes.items()
+                  if len(methods) > 1}
+        self._stack.append(node)
+        self._shared.append(shared)
+        self.generic_visit(node)
+        self._shared.pop()
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_AsyncFunctionDef(self, node):
+        self._stack.append(node)
+        if self._shared and self._shared[-1]:
+            self.findings.extend(_MethodChecker(
+                self.rule, self.ctx, node, self._stack,
+                self._shared[-1]).check())
+        self.generic_visit(node)
+        self._stack.pop()
+
+
+class NonatomicReadModifyWrite(Rule):
+    rule_id = "AIL009"
+    name = "nonatomic-read-modify-write"
+    description = ("read of a multi-writer attribute, a suspension point, "
+                   "then a dependent write back — a lost-update race")
+
+    def check_module(self, ctx):
+        v = _Visitor(self, ctx)
+        v.visit(ctx.tree)
+        return v.findings

@@ -1,0 +1,277 @@
+"""AIL007 — guard read goes stale across an ``await`` before the write.
+
+The bug class — every hard concurrency bug PRs 3-4 found by hand had this
+shape: a guard reads shared state (a task's terminal status, a breaker's
+state), an ``await`` hands the event loop to arbitrary other tasks, and
+the dependent write then acts on the stale read. Concrete instances: the
+dispatcher's ``_drop_expired`` flipping completed→expired on a redelivery,
+push ``_forward`` re-executing a completed task, the half-open breaker's
+leaked probe slot. AIL003 checks that terminal-status writes are *guarded*
+somewhere in the function; this rule checks the guard is still *valid*
+when the write runs — no suspension point between guard and write, or a
+visible re-check after the last one.
+
+What it flags, inside an ``async def``:
+
+- a **status write** (``update_task_status`` / ``update_status`` /
+  ``complete_task`` / ``fail_task`` / ``_try_update``) whose nearest
+  dominating **terminality guard** (``is_terminal`` /
+  ``_suppress_duplicate`` / ``_drop_expired`` / ``canonical_status`` /
+  ``… in TaskStatus.TERMINAL``) is separated from it by ≥1 suspension
+  point, with no re-check between the last suspension and the write;
+- a **state-attribute write** (``x.state = …`` / ``x.status = …``) whose
+  value the function guarded on the same attribute chain before an
+  intervening suspension.
+
+Blessed idioms (never flagged):
+
+- **atomic conditional helpers** — ``update_status_if`` / ``requeue_if``
+  re-check under the store lock, so staleness cannot clobber;
+- **probe-after-await** — ``if not await tm.is_terminal(t): await
+  write(t)``: the probe is itself the last suspension before the write
+  (the residual one-hop window is accepted platform-wide,
+  docs/concurrency.md);
+- any re-check of the guard vocabulary between the last suspension and
+  the write.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import AwaitFlow, Rule, enclosing_symbol
+
+# Unconditional status writers (AIL003's set) — the writes whose staleness
+# clobbers a terminal task.
+STATUS_WRITERS = frozenset({
+    "update_task_status", "update_status", "complete_task", "fail_task",
+    "_try_update",
+})
+# Terminality probes: evaluating one of these (re-)establishes the guard.
+GUARD_PROBES = frozenset({
+    "is_terminal", "_suppress_duplicate", "_drop_expired",
+})
+GUARD_ATTRS = frozenset({"canonical_status"})
+# State attributes the attribute-write half of the rule watches.
+STATE_ATTRS = frozenset({"state", "status"})
+# Writer shims (the function IS the write plumbing — callers carry the
+# guard; AIL003 applies the same exemption).
+SHIM_NAMES = STATUS_WRITERS | frozenset({"_update"})
+
+
+def _call_name(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _attr_chain(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_guard_expr(node: ast.AST) -> bool:
+    """Does this expression (re-)establish a terminality guard?"""
+    if isinstance(node, ast.Call) and _call_name(node.func) in GUARD_PROBES:
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in GUARD_ATTRS:
+        return True
+    if isinstance(node, ast.Compare):
+        for op, comparator in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.In, ast.NotIn)) and any(
+                    isinstance(n, ast.Attribute) and n.attr == "TERMINAL"
+                    for n in ast.walk(comparator)):
+                return True
+    return False
+
+
+def _collect_guards(fn: ast.AST, flow: AwaitFlow) -> list[ast.AST]:
+    """Guard anchors: every guard expression sitting in an ``if``/``while``
+    test (or a boolean/unary expression inside one). The anchor is lifted
+    to the enclosing ``Await`` when directly awaited, so the probe's own
+    suspension never counts against itself."""
+    guards: list[ast.AST] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            continue
+        if node is not fn and node not in flow._parent:
+            continue  # nested scope — its own checker owns it
+        test = node.test
+        for sub in ast.walk(test):
+            if _is_guard_expr(sub):
+                guards.append(flow.lift_to_await(sub))
+    return guards
+
+
+class _FnChecker:
+    def __init__(self, rule, ctx, fn, stack):
+        self.rule = rule
+        self.ctx = ctx
+        self.fn = fn
+        self.symbol = enclosing_symbol(stack)
+        self.flow = AwaitFlow(fn)
+        self.guards = _collect_guards(fn, self.flow)
+        self.findings: list = []
+
+    def check(self):
+        self._check_status_writes()
+        self._check_attr_writes()
+        return self.findings
+
+    # -- half 1: unconditional status writers --------------------------------
+
+    def _check_status_writes(self):
+        for node in ast.walk(self.fn):
+            if not (isinstance(node, ast.Call)
+                    and _call_name(node.func) in STATUS_WRITERS):
+                continue
+            if self._in_nested_scope(node):
+                continue
+            write = self.flow.lift_to_await(node)
+            guard = self._nearest_dominating_guard(write)
+            if guard is None:
+                continue  # unguarded entirely — AIL003's finding, not ours
+            self._flag_if_stale(guard, write, node,
+                                f"status write "
+                                f"{_call_name(node.func)}()")
+
+    # -- half 2: guarded state-attribute writes -------------------------------
+
+    def _check_attr_writes(self):
+        for node in ast.walk(self.fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            if self._in_nested_scope(node):
+                continue
+            for target in node.targets:
+                chain = _attr_chain(target)
+                if (chain is None
+                        or not isinstance(target, ast.Attribute)
+                        or target.attr not in STATE_ATTRS):
+                    continue
+                guard = self._nearest_chain_guard(chain, node)
+                if guard is None:
+                    continue
+                self._flag_if_stale(guard, node, node,
+                                    f"write to {chain}")
+
+    # -- shared window check --------------------------------------------------
+
+    def _flag_if_stale(self, guard: ast.AST, write: ast.AST,
+                       report_at: ast.AST, what: str):
+        between = self.flow.suspensions_between(guard, write)
+        if not between:
+            return
+        last = max(between, key=lambda s: (getattr(s, "lineno", 0),
+                                           getattr(s, "col_offset", 0)))
+        if self._rechecked_after(last, write):
+            return
+        self.findings.append(self.ctx.finding(
+            self.rule.rule_id, report_at,
+            f"{what} acts on a guard read that {len(between)} suspension "
+            f"point(s) ago (line {getattr(last, 'lineno', '?')}) may have "
+            "invalidated — another task can complete/transition the state "
+            "in that window (re-check the guard after the last await, or "
+            "use an atomic conditional helper like update_status_if)",
+            symbol=self.symbol))
+
+    def _rechecked_after(self, last_suspension: ast.AST,
+                         write: ast.AST) -> bool:
+        """A guard evaluated at-or-after the last intervening suspension and
+        before the write re-validates the read (the probe-after-await
+        idiom: the probe IS that last suspension). The re-check must
+        DOMINATE the write — a probe tucked inside a conditional branch
+        leaves the branch-not-taken path acting on the stale read, and
+        exists-path semantics say flag it."""
+        from ..core import _pos
+        lo, hi = _pos(last_suspension), _pos(write)
+        for g in self.guards:
+            if (lo <= _pos(g) < hi
+                    and not self.flow.in_subtree(g, write)
+                    and self.flow.dominates(g, write)):
+                return True
+        return False
+
+    def _nearest_dominating_guard(self, write: ast.AST) -> ast.AST | None:
+        from ..core import _pos
+        best = None
+        for g in self.guards:
+            if self.flow.in_subtree(g, write):
+                continue
+            if self.flow.dominates(g, write):
+                if best is None or _pos(g) > _pos(best):
+                    best = g
+        return best
+
+    def _nearest_chain_guard(self, chain: str,
+                             write: ast.AST) -> ast.AST | None:
+        """Nearest dominating if/while test that READS the same attribute
+        chain the write assigns."""
+        from ..core import _pos
+        best = None
+        for node in ast.walk(self.fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            if self._in_nested_scope(node):
+                continue
+            for sub in ast.walk(node.test):
+                if (isinstance(sub, ast.Attribute)
+                        and _attr_chain(sub) == chain):
+                    anchor = self.flow.lift_to_await(sub)
+                    if (not self.flow.in_subtree(anchor, write)
+                            and self.flow.dominates(anchor, write)
+                            and (best is None or _pos(anchor) > _pos(best))):
+                        best = anchor
+        return best
+
+    def _in_nested_scope(self, node: ast.AST) -> bool:
+        # AwaitFlow stops collecting at nested def/lambda boundaries, so a
+        # node with no parent entry lives in a nested scope — the visitor
+        # runs a separate checker for nested async defs.
+        return node is not self.fn and node not in self.flow._parent
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rule, ctx):
+        self.rule = rule
+        self.ctx = ctx
+        self.findings = []
+        self._stack: list[ast.AST] = []
+
+    def visit_ClassDef(self, node):
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_AsyncFunctionDef(self, node):
+        self._stack.append(node)
+        if node.name not in SHIM_NAMES:
+            self.findings.extend(
+                _FnChecker(self.rule, self.ctx, node, self._stack).check())
+        self.generic_visit(node)
+        self._stack.pop()
+
+
+class StaleReadAcrossAwait(Rule):
+    rule_id = "AIL007"
+    name = "stale-read-across-await"
+    description = ("a guard read of task/breaker state is invalidated by a "
+                   "suspension point before the guarded write")
+
+    def check_module(self, ctx):
+        v = _Visitor(self, ctx)
+        v.visit(ctx.tree)
+        return v.findings

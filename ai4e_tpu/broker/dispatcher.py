@@ -418,6 +418,18 @@ class Dispatcher:
             # Permanent failure: complete (no redelivery) + fail the task
             # (BackendQueueProcessor.cs:65-70).
             self.broker.complete(msg)
+            if (self.task_manager is not None
+                    and await self.task_manager.is_terminal(msg.task_id)):
+                # Re-check AFTER the POST (AIL007): the pop-time duplicate
+                # guard went stale across the delivery round trip — a
+                # concurrent duplicate (reaper rescue, lease-expiry
+                # redelivery on another loop) can have completed the task
+                # while this attempt was in flight, and its backend then
+                # 4xx'd THIS attempt. Stamping `failed` now would clobber
+                # the completion the client may already have read.
+                self._dispatched.inc(outcome="duplicate",
+                                     queue=self.queue_name, backend=backend)
+                return
             self._dispatched.inc(outcome="failed", queue=self.queue_name,
                                  backend=backend)
             await self._try_update(
@@ -522,6 +534,19 @@ class Dispatcher:
                           "dispatching instead", msg.task_id)
             return False
         self.broker.complete(msg)
+        if (self.task_manager is not None
+                and await self.task_manager.is_terminal(msg.task_id)):
+            # Re-check AFTER the set_result suspension (AIL007): the probe
+            # above ran before the (possibly remote) result write, and a
+            # concurrent path — the real backend finishing a lost-response
+            # execution, the reaper failing the task — can have turned the
+            # task terminal in that window. The earlier probe-then-write
+            # pair was exactly the stale-guard shape this PR's analyzer
+            # exists to catch; the result overwrite above is idempotent
+            # (same payload under the same key), the status write is not.
+            self._dispatched.inc(outcome="duplicate", queue=self.queue_name,
+                                 backend="")
+            return True
         self._dispatched.inc(outcome="cache_hit", queue=self.queue_name,
                              backend="")
         await self._try_update(msg.task_id, "completed - served from cache",
@@ -576,6 +601,18 @@ class Dispatcher:
             # Dead-lettered: out of delivery budget — the backend that was
             # just attempted is the one whose failures spent it; a canary
             # killing tasks must show in ITS per-backend series.
+            if (self.task_manager is not None
+                    and await self.task_manager.is_terminal(msg.task_id)):
+                # Re-check AFTER the awaiting-write + backoff sleep
+                # (AIL007): the entry guard is two suspensions stale by
+                # now, and the backoff can be many seconds — the classic
+                # lost-response window where the backend executed and
+                # completed the task while we slept. DEAD_LETTER/FAILED
+                # over that completion would be a client-visible double
+                # outcome (the chaos invariant).
+                self._dispatched.inc(outcome="duplicate",
+                                     queue=self.queue_name, backend=backend)
+                return
             self._dispatched.inc(outcome="dead_letter", queue=self.queue_name,
                                  backend=backend)
             await self._try_update(

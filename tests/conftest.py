@@ -15,16 +15,29 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
+try:
+    import jax  # noqa: E402
+except ImportError:
+    # The race-smoke CI job runs the interleaving suite with no JAX
+    # toolchain installed (like the stdlib-only analysis job). Tests that
+    # need JAX fail at their own module imports; the race/analysis files
+    # import none of it.
+    jax = None
 
-jax.config.update("jax_platforms", "cpu")
+if jax is not None:
+    jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_configure(config):
     # Registered here (no pytest.ini): `slow` gates tier-1's wall clock
     # (`-m 'not slow'`), `chaos` marks the seeded fault-injection
-    # scenarios CI's chaos-smoke job runs explicitly (`-m chaos`).
+    # scenarios CI's chaos-smoke job runs explicitly (`-m chaos`),
+    # `race` marks the deterministic interleaving suite CI's race-smoke
+    # job runs without JAX (`-m race`).
     config.addinivalue_line("markers", "slow: excluded from tier-1 CI")
     config.addinivalue_line(
         "markers", "chaos: seeded fault-injection scenario "
         "(AI4E_CHAOS_SEED overrides the seed)")
+    config.addinivalue_line(
+        "markers", "race: deterministic interleaving-exploration suite "
+        "(ai4e_tpu.analysis.race; runs JAX-free in race-smoke)")

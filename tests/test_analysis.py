@@ -718,3 +718,557 @@ class TestConfigDriftFix:
         # not a hole.
         with pytest.raises(ConfigError):
             FrameworkConfig.from_env(env={"AI4E_PLATFROM_TRANSPORT": "push"})
+
+
+# -- AIL007 stale-read-across-await -------------------------------------------
+
+
+class TestStaleReadAcrossAwait:
+    def setup_method(self):
+        from ai4e_tpu.analysis.rules.stale_read import StaleReadAcrossAwait
+        self.rule = StaleReadAcrossAwait()
+
+    def test_true_positive_suspension_between_guard_and_write(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            async def drop(tm, tid):
+                if not await tm.is_terminal(tid):
+                    await asyncio.sleep(1)
+                    await tm.update_task_status(tid, "expired")
+        """)
+        assert [f.rule for f in findings] == ["AIL007"]
+        assert "suspension" in findings[0].message
+
+    def test_true_positive_exact_deadletter_shape(self, tmp_path):
+        # The dispatcher._backpressure defect this PR's first run found:
+        # entry guard, AWAITING write, backoff sleep, then the dead-letter
+        # write acting on the entry guard.
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            async def backpressure(self, msg):
+                if await self._suppress_duplicate(msg):
+                    return
+                await self._try_update(msg.task_id, "awaiting")
+                await asyncio.sleep(5)
+                if not self.broker.abandon(msg):
+                    await self._try_update(msg.task_id, "dead-letter")
+        """)
+        assert len(findings) == 1
+        assert "dead-letter" in findings[0].snippet
+
+    def test_true_positive_guarded_state_attr_write(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            async def probe(breaker, session):
+                if breaker.state == "open":
+                    await session.post("http://b")
+                    breaker.state = "half_open"
+        """)
+        assert [f.rule for f in findings] == ["AIL007"]
+
+    def test_near_miss_probe_after_await_idiom(self, tmp_path):
+        # The blessed shape: the probe IS the last suspension before the
+        # write (the residual one-hop window is the documented contract).
+        findings = run_rule(tmp_path, self.rule, """
+            async def forward(tm, tid):
+                if not await tm.is_terminal(tid):
+                    await tm.update_task_status(tid, "awaiting")
+        """)
+        assert findings == []
+
+    def test_near_miss_recheck_after_last_suspension(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            async def drop(tm, tid):
+                if not await tm.is_terminal(tid):
+                    await asyncio.sleep(1)
+                    if not await tm.is_terminal(tid):
+                        await tm.update_task_status(tid, "expired")
+        """)
+        assert findings == []
+
+    def test_conditional_recheck_does_not_suppress(self, tmp_path):
+        # A re-check nested inside `if cond:` leaves the cond-False path
+        # acting on the stale guard — exists-path semantics: still flagged.
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            async def drop(tm, tid, cond):
+                if not await tm.is_terminal(tid):
+                    await asyncio.sleep(1)
+                    if cond:
+                        if await tm.is_terminal(tid):
+                            return
+                    await tm.update_task_status(tid, "expired")
+        """)
+        assert [f.rule for f in findings] == ["AIL007"]
+
+    def test_near_miss_unguarded_write_is_ail003s_domain(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            async def blind(tm, tid):
+                await asyncio.sleep(1)
+                await tm.update_task_status(tid, "failed")
+        """)
+        assert findings == []
+
+    def test_near_miss_guard_in_other_branch_does_not_count(self, tmp_path):
+        # The guard inside an except handler does not dominate the write
+        # on the success path — no guard, so no AIL007 (AIL003's domain).
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            async def deliver(tm, tid, session):
+                try:
+                    await session.post("http://b")
+                except OSError:
+                    if await tm.is_terminal(tid):
+                        return
+                    await asyncio.sleep(1)
+                    return
+                await tm.update_task_status(tid, "failed")
+        """)
+        assert findings == []
+
+    def test_loop_back_edge_counts_as_suspension(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            async def retry_loop(tm, tid, session):
+                if await tm.is_terminal(tid):
+                    return
+                while True:
+                    resp = await session.post("http://b")
+                    if resp == 200:
+                        return
+                    await tm.update_task_status(tid, "failed")
+        """)
+        assert len(findings) == 1
+
+    def test_suppression(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            async def drop(tm, tid):
+                if not await tm.is_terminal(tid):
+                    await asyncio.sleep(1)
+                    await tm.update_task_status(tid, "expired")  # ai4e: noqa[AIL007] — single-writer path, measured
+        """)
+        assert findings == []
+
+
+# -- AIL008 lock-across-slow-await --------------------------------------------
+
+
+class TestLockAcrossSlowAwait:
+    def setup_method(self):
+        from ai4e_tpu.analysis.rules.lock_await import LockAcrossSlowAwait
+        self.rule = LockAcrossSlowAwait()
+
+    def test_true_positive_post_under_lock(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            class C:
+                async def deliver(self, session):
+                    async with self._lock:
+                        async with session.post("http://b") as resp:
+                            await resp.read()
+        """)
+        assert findings and all(f.rule == "AIL008" for f in findings)
+        assert "holding self._lock" in findings[0].message
+
+    def test_true_positive_sleep_under_threading_lock(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            class C:
+                async def wait(self):
+                    with self._state_lock:
+                        await asyncio.sleep(1)
+        """)
+        assert [f.rule for f in findings] == ["AIL008"]
+
+    def test_near_miss_block_is_not_a_lock(self, tmp_path):
+        # "block"/"backlog" contain the substring "lock" but hold none —
+        # the name heuristic matches word segments, not substrings.
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            class C:
+                async def run(self, session):
+                    async with self._dispatch_block:
+                        await session.post("http://b")
+                    async with self._backlog_lock:
+                        await asyncio.sleep(1)
+        """)
+        assert len(findings) == 1  # only the real lock fires
+        assert "_backlog_lock" in findings[0].message
+
+    def test_near_miss_fast_work_under_lock(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            class C:
+                async def create(self):
+                    async with self._create_lock:
+                        self._session = object()
+                async def reload(self):
+                    async with self._reload_lock:
+                        await asyncio.to_thread(self._swap)
+        """)
+        assert findings == []
+
+    def test_near_miss_slow_await_outside_lock(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            class C:
+                async def deliver(self, session):
+                    with self._lock:
+                        decision = self._decide()
+                    await session.post("http://b")
+        """)
+        assert findings == []
+
+    def test_lock_order_drift_flagged(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            class C:
+                async def ab(self):
+                    async with self._a_lock:
+                        async with self._b_lock:
+                            pass
+                async def ba(self):
+                    async with self._b_lock:
+                        async with self._a_lock:
+                            pass
+        """)
+        assert len(findings) == 1
+        assert "opposite" in findings[0].message
+
+    def test_lock_order_drift_via_multi_item_with(self, tmp_path):
+        # `async with a, b:` enters left-to-right — it establishes a->b
+        # exactly like nesting, and must conflict with a nested b->a.
+        findings = run_rule(tmp_path, self.rule, """
+            class C:
+                async def ab(self):
+                    async with self._a_lock, self._b_lock:
+                        pass
+                async def ba(self):
+                    async with self._b_lock:
+                        async with self._a_lock:
+                            pass
+        """)
+        assert len(findings) == 1
+        assert "opposite" in findings[0].message
+
+    def test_consistent_lock_order_clean(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            class C:
+                async def one(self):
+                    async with self._a_lock:
+                        async with self._b_lock:
+                            pass
+                async def two(self):
+                    async with self._a_lock:
+                        async with self._b_lock:
+                            pass
+        """)
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            class C:
+                async def wait(self):
+                    with self._lock:
+                        await asyncio.sleep(0.001)  # ai4e: noqa[AIL008] — sub-ms tick under a private lock
+        """)
+        assert findings == []
+
+
+# -- AIL009 nonatomic-read-modify-write ---------------------------------------
+
+
+class TestNonatomicReadModifyWrite:
+    def setup_method(self):
+        from ai4e_tpu.analysis.rules.rmw import NonatomicReadModifyWrite
+        self.rule = NonatomicReadModifyWrite()
+
+    def test_true_positive_split_rmw(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            class C:
+                async def bump(self):
+                    n = self._busy
+                    await asyncio.sleep(0)
+                    self._busy = n + 1
+                async def other(self):
+                    self._busy = 0
+        """)
+        assert [f.rule for f in findings] == ["AIL009"]
+        assert "self._busy" in findings[0].message
+
+    def test_true_positive_one_statement_form(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            class C:
+                async def bump(self):
+                    self._busy = await self._next(self._busy)
+                async def other(self):
+                    self._busy = 0
+        """)
+        assert [f.rule for f in findings] == ["AIL009"]
+
+    def test_near_miss_single_writer_attribute(self, tmp_path):
+        # Only one method ever writes it: nobody to race with.
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            class C:
+                async def bump(self):
+                    n = self._busy
+                    await asyncio.sleep(0)
+                    self._busy = n + 1
+        """)
+        assert findings == []
+
+    def test_near_miss_same_segment_rmw(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            class C:
+                async def bump(self):
+                    self._busy += 1
+                    await asyncio.sleep(0)
+                    self._busy -= 1
+                async def other(self):
+                    self._busy = 0
+        """)
+        assert findings == []
+
+    def test_near_miss_reread_after_await(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            class C:
+                async def bump(self):
+                    n = self._busy
+                    await asyncio.sleep(0)
+                    n = self._busy
+                    self._busy = n + 1
+                async def other(self):
+                    self._busy = 0
+        """)
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = run_rule(tmp_path, self.rule, """
+            import asyncio
+            class C:
+                async def bump(self):
+                    n = self._busy
+                    await asyncio.sleep(0)
+                    self._busy = n + 1  # ai4e: noqa[AIL009] — the await cannot interleave a writer (startup only)
+                async def other(self):
+                    self._busy = 0
+        """)
+        assert findings == []
+
+
+# -- CLI satellites: unknown rule ids, JSON baseline authoring ----------------
+
+
+class TestCliRuleIdValidation:
+    def test_unknown_select_id_exits_2_and_names_it(self, tmp_path, capsys):
+        from ai4e_tpu.analysis.cli import main
+        (tmp_path / "m.py").write_text("x = 1\n")
+        # The CI-job-typo scenario: before this PR, --select AIL999
+        # silently filtered to an EMPTY rule list and exited 0 — a typo
+        # could disable the whole gate.
+        assert main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                     "--select", "AIL999"]) == 2
+        err = capsys.readouterr().err
+        assert "AIL999" in err and "--select" in err
+
+    def test_unknown_ignore_id_exits_2(self, tmp_path, capsys):
+        from ai4e_tpu.analysis.cli import main
+        (tmp_path / "m.py").write_text("x = 1\n")
+        assert main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                     "--ignore", "AIL001,AILOOPS"]) == 2
+        assert "AILOOPS" in capsys.readouterr().err
+
+    def test_known_ids_still_select(self, tmp_path, capsys):
+        from ai4e_tpu.analysis.cli import main
+        (tmp_path / "m.py").write_text(
+            "import time\nasync def h():\n    time.sleep(1)\n")
+        assert main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                     "--select", "ail001"]) == 1  # case-folded
+
+    def test_list_rules_shows_the_concurrency_family(self, capsys):
+        from ai4e_tpu.analysis.cli import main
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule_id in ("AIL007", "AIL008", "AIL009"):
+            assert rule_id in out
+
+
+class TestCliJsonBaselineAuthoring:
+    def test_json_findings_carry_paste_ready_baseline_entries(
+            self, tmp_path, capsys):
+        import json as _json
+        from ai4e_tpu.analysis.cli import main
+        (tmp_path / "m.py").write_text(
+            "import time\nasync def h():\n    time.sleep(1)\n")
+        assert main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                     "--json"]) == 1
+        data = _json.loads(capsys.readouterr().out)
+        assert data["version"] == 1
+        finding = data["findings"][0]
+        assert finding["fingerprint"]
+        entry = finding["baseline_entry"]
+        # The paste-ready shape: exactly what Baseline.load consumes, with
+        # the justification left for a human.
+        assert entry["fingerprint"] == finding["fingerprint"]
+        assert entry["justification"] == ""
+        assert set(entry) == {"rule", "path", "symbol", "snippet",
+                              "fingerprint", "justification"}
+        # Round-trip: a baseline authored from the JSON (plus a written
+        # justification) grandfathers the finding.
+        entry["justification"] = "known blocking call, measured sub-ms"
+        baseline_path = tmp_path / "analysis_baseline.json"
+        baseline_path.write_text(_json.dumps(
+            {"version": 1, "findings": [entry]}))
+        capsys.readouterr()
+        assert main([str(tmp_path / "m.py"), "--root", str(tmp_path)]) == 0
+
+
+# -- behavioral regressions for the AIL007 dispatcher fixes -------------------
+
+
+class TestStaleGuardFixes:
+    """The three stale-guard windows AIL007's first run found in the
+    dispatcher, fixed in this PR. The full interleaving regression suite
+    lives in tests/test_race_regressions.py (every schedule in the budget);
+    here: the single decisive interleaving per defect, as a plain unit
+    test that needs no explorer."""
+
+    def _fixture(self, **kw):
+        import random as _random
+        from ai4e_tpu.broker.dispatcher import Dispatcher
+        from ai4e_tpu.broker.queue import InMemoryBroker
+        from ai4e_tpu.metrics.registry import MetricsRegistry
+        from ai4e_tpu.resilience.health import BackendHealth
+        from ai4e_tpu.service.task_manager import LocalTaskManager
+        from ai4e_tpu.taskstore import APITask, InMemoryTaskStore
+
+        store = InMemoryTaskStore()
+        broker = InMemoryBroker(max_delivery_count=1)
+        broker.register_queue("/v1/q")
+        d = Dispatcher(broker, "/v1/q", "http://b",
+                       LocalTaskManager(store), retry_delay=0.0,
+                       metrics=MetricsRegistry(), rng=_random.Random(0),
+                       resilience=BackendHealth(metrics=MetricsRegistry()),
+                       **kw)
+        store.upsert(APITask(task_id="t1", endpoint="/v1/q/op",
+                             body=b"x", publish=False))
+        return store, broker, d
+
+    def test_deadletter_write_rechecks_terminality(self):
+        from ai4e_tpu.taskstore import TaskStatus
+
+        async def main():
+            store, broker, d = self._fixture()
+            task = store.get("t1")
+            broker.publish(task)
+            msg = await broker.receive("/v1/q", timeout=1.0)
+            # The lost-response completion lands during the backoff sleep:
+            # emulated by completing after the AWAITING write via a store
+            # listener hooked on that exact transition.
+            def complete_on_awaiting(t):
+                if t.task_id == "t1" and t.status == "Awaiting service availability":
+                    store.update_status("t1", "completed",
+                                        TaskStatus.COMPLETED)
+            store.add_listener(complete_on_awaiting)
+            await d._backpressure(msg, "b")
+            assert store.get("t1").canonical_status == TaskStatus.COMPLETED
+            assert d._dispatched.value(outcome="duplicate", queue="/v1/q",
+                                       backend="b") == 1
+
+        run_analysis(main())
+
+    def test_failure_paths_tolerate_no_task_manager(self):
+        """The new re-probes must not break the task_manager=None
+        configuration the cache path documents: a 4xx permanent failure
+        and a dead-letter exhaustion both finish without raising."""
+        import random as _random
+        from ai4e_tpu.broker.dispatcher import Dispatcher
+        from ai4e_tpu.broker.queue import InMemoryBroker, Message
+        from ai4e_tpu.metrics.registry import MetricsRegistry
+
+        class FakeResp:
+            status = 400
+            async def read(self):
+                return b""
+
+        class FakePost:
+            async def __aenter__(self):
+                return FakeResp()
+            async def __aexit__(self, *exc):
+                return False
+
+        class FakeSessions:
+            async def get(self):
+                return self
+            def post(self, url, **kw):
+                return FakePost()
+
+        async def main():
+            broker = InMemoryBroker(max_delivery_count=1)
+            broker.register_queue("/v1/q")
+            d = Dispatcher(broker, "/v1/q", "http://b", task_manager=None,
+                           retry_delay=0.0, metrics=MetricsRegistry(),
+                           rng=_random.Random(0))
+            d._sessions = FakeSessions()
+            msg = Message(task_id="t1", endpoint="/v1/q/op", body=b"x",
+                          queue_name="/v1/q", seq=1)
+            broker.queue("/v1/q").put(msg)
+            popped = await broker.receive("/v1/q", timeout=1.0)
+            await d._dispatch_one(popped)  # 4xx permanent-fail path
+            assert d._dispatched.value(outcome="failed", queue="/v1/q",
+                                       backend="b") == 1
+            msg2 = Message(task_id="t2", endpoint="/v1/q/op", body=b"x",
+                           queue_name="/v1/q", seq=2)
+            broker.queue("/v1/q").put(msg2)
+            popped2 = await broker.receive("/v1/q", timeout=1.0)
+            await d._backpressure(popped2, "b")  # dead-letter path
+            assert d._dispatched.value(outcome="dead_letter", queue="/v1/q",
+                                       backend="b") == 1
+
+        run_analysis(main())
+
+    def test_cache_complete_rechecks_after_result_hop(self):
+        from ai4e_tpu.metrics.registry import MetricsRegistry
+        from ai4e_tpu.rescache.cache import ResultCache
+        from ai4e_tpu.taskstore import TaskStatus
+
+        class HopStore:
+            def __init__(self, store, on_hop):
+                self.store, self.on_hop = store, on_hop
+            async def set_result(self, task_id, payload,
+                                 content_type="application/json"):
+                self.on_hop()
+                self.store.set_result(task_id, payload,
+                                      content_type=content_type)
+
+        async def main():
+            cache = ResultCache(metrics=MetricsRegistry())
+            cache.put("/v1/q|k", b"r")
+            store = None
+
+            def fail_during_hop():
+                store.update_status_if("t1", TaskStatus.RUNNING,
+                                       "failed - no progress",
+                                       backend_status=TaskStatus.FAILED)
+
+            s, broker, d = self._fixture(
+                result_cache=cache)
+            store = s
+            d.result_store = HopStore(store, fail_during_hop)
+            store.update_status("t1", TaskStatus.RUNNING, TaskStatus.RUNNING)
+            task = store.get("t1")
+            broker.publish(task)
+            msg = await broker.receive("/v1/q", timeout=1.0)
+            msg.cache_key = "/v1/q|k"
+            assert await d._complete_from_cache(msg) is True
+            # The reaper's failure landed mid-hop and must survive.
+            assert store.get("t1").canonical_status == TaskStatus.FAILED
+            assert d._dispatched.value(outcome="duplicate", queue="/v1/q",
+                                       backend="") == 1
+
+        run_analysis(main())

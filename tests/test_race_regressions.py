@@ -1,0 +1,847 @@
+"""Interleaving regression suite over the platform's hot critical sections
+(docs/concurrency.md) — the ai4e-race dynamic prong's "first run".
+
+Three layers, all deterministic (fixed seed, virtual clock):
+
+- **regressions for the stale-guard defects AIL007 found and this PR
+  fixed** (dispatcher dead-letter clobber, cache-complete clobber,
+  permanent-fail clobber): the FIXED code passes every schedule in the
+  budget; for the two method-sized defects a verbatim pre-fix revert
+  (taken from the PR 4 tree) is demonstrated caught by the explorer;
+- **replays of the PR 3/PR 4 hand-found races on clean reverts**
+  (completed→expired clobber, push ``_forward`` double execution, the
+  half-open probe-slot leak): each pre-fix body, verbatim from git
+  history, is caught within the schedule budget while current code runs
+  race-free under the same budget;
+- **clean drives over the remaining hot sections** (taskstore
+  reaper/redrive vs completion, rescache single-flight + generation
+  fencing, breaker transitions, ``GradientLimiter``) — the sections whose
+  first explorer run found nothing, pinned so refactors keep it that way;
+
+plus the documentation test for the REMOTE-store residual window
+(``TracedTaskManager(hop=True)``): probe-then-write over an HTTP hop has
+an irreducible one-suspension window — the accepted platform contract
+whose cure is the store's atomic conditional verbs — and this suite
+proves both halves (the window is reachable; ``update_status_if`` closes
+it).
+
+The chaos invariant enforced throughout: once a task reaches a terminal
+canonical status, that canonical status never changes again — the
+client-visible double-outcome ``chaos/invariants.py`` rejects, here
+checked per explored schedule instead of per seeded run.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+aiohttp = pytest.importorskip(
+    "aiohttp")  # broker imports it; the race-smoke job installs it (no JAX)
+
+from ai4e_tpu.admission.controller import GradientLimiter
+from ai4e_tpu.analysis.race import (TracedTaskManager, explore_interleavings,
+                                    yield_point)
+from ai4e_tpu.broker.dispatcher import AWAITING_STATUS, Dispatcher
+from ai4e_tpu.broker.push import PushEvent, WebhookDispatcher
+from ai4e_tpu.broker.queue import InMemoryBroker
+from ai4e_tpu.metrics.registry import MetricsRegistry
+from ai4e_tpu.rescache.cache import ResultCache
+from ai4e_tpu.resilience.breaker import CircuitBreaker
+from ai4e_tpu.resilience.health import BackendHealth, ResiliencePolicy
+from ai4e_tpu.service.task_manager import LocalTaskManager
+from ai4e_tpu.taskstore import APITask, InMemoryTaskStore, TaskStatus
+from ai4e_tpu.taskstore.reaper import TaskReaper
+
+pytestmark = pytest.mark.race
+
+SEED = 20260803
+SCHEDULES = 60
+
+
+class TerminalInvariant:
+    """Once terminal, a task's canonical status never changes again."""
+
+    def __init__(self, store):
+        self.violations = []
+        # Seed from current state: a task that is ALREADY terminal when
+        # the invariant attaches (the lost-response replays) must count
+        # any later canonical change as a clobber.
+        self._terminal_as = {
+            t.task_id: t.canonical_status for t in store.snapshot()
+            if t.canonical_status in TaskStatus.TERMINAL}
+        store.add_listener(self._on_change)
+
+    def _on_change(self, task):
+        prev = self._terminal_as.get(task.task_id)
+        cur = task.canonical_status
+        if prev is not None and cur != prev:
+            self.violations.append(
+                (task.task_id, f"{prev} -> {cur} ({task.status!r})"))
+        if cur in TaskStatus.TERMINAL:
+            self._terminal_as[task.task_id] = cur
+
+    def check(self):
+        assert not self.violations, (
+            f"terminal status clobbered: {self.violations}")
+
+
+def _seeded_task(store, broker, task_id="t1", queue="/v1/q",
+                 status=TaskStatus.CREATED, deadline_at=0.0):
+    task = store.upsert(APITask(task_id=task_id, endpoint=queue + "/op",
+                                body=b"payload", publish=False))
+    if status != TaskStatus.CREATED:
+        store.update_status(task_id, status, status)
+    if broker is not None:
+        task.deadline_at = deadline_at
+        broker.publish(task)
+    return task
+
+
+def _dispatcher(cls, broker, tm, queue="/v1/q", **kw):
+    return cls(broker, queue, "http://backend", tm, retry_delay=0.001,
+               metrics=MetricsRegistry(), rng=random.Random(0),
+               resilience=BackendHealth(metrics=MetricsRegistry()), **kw)
+
+
+# -- fake HTTP plumbing (the backend hop, with a real suspension) -------------
+
+
+class _FakeResponse:
+    def __init__(self, status):
+        self.status = status
+
+    async def read(self):
+        return b""
+
+
+class _FakePost:
+    def __init__(self, backend, url):
+        self.backend = backend
+        self.url = url
+
+    async def __aenter__(self):
+        await yield_point()  # the network round trip
+        return _FakeResponse(self.backend.execute(self.url))
+
+    async def __aexit__(self, *exc):
+        return False
+
+
+class FakeBackend:
+    """Stands in for ``SessionHolder``: ``execute`` runs per POST (counts
+    executions, optionally completes the task like a real service shell),
+    and the POST awaits one yield point — the suspension a real delivery
+    always has."""
+
+    def __init__(self, status=200, on_execute=None):
+        self.status = status
+        self.on_execute = on_execute
+        self.executions = 0
+
+    def execute(self, url):
+        self.executions += 1
+        if self.on_execute is not None:
+            self.on_execute()
+        return self.status
+
+    # SessionHolder surface
+    async def get(self):
+        return self
+
+    # session surface
+    def post(self, url, **kwargs):
+        return _FakePost(self, url)
+
+    async def close(self):
+        pass
+
+
+class AsyncHopResultStore:
+    """Duck-typed result store with the HTTP hop a remote deployment has
+    (``HttpResultStore``): one suspension before the write lands."""
+
+    def __init__(self, store):
+        self.store = store
+
+    async def set_result(self, task_id, payload,
+                         content_type="application/json"):
+        await yield_point()
+        self.store.set_result(task_id, payload, content_type=content_type)
+
+
+# -- this PR's fixes: dispatcher stale-guard clobbers -------------------------
+
+
+class RevertedDeadLetterDispatcher(Dispatcher):
+    """``_backpressure`` verbatim from the PR 4 tree — no terminal re-check
+    before the dead-letter write (the AIL007 finding)."""
+
+    async def _backpressure(self, msg, backend):
+        if self.resilience is not None and await self._suppress_duplicate(msg):
+            return
+        self._dispatched.inc(outcome="backpressure", queue=self.queue_name,
+                             backend=backend)
+        await self._try_update(msg.task_id, AWAITING_STATUS,
+                               TaskStatus.CREATED)
+        await asyncio.sleep(self._redelivery_delay(msg))
+        if not self.broker.abandon(msg):
+            self._dispatched.inc(outcome="dead_letter",
+                                 queue=self.queue_name, backend=backend)
+            await self._try_update(msg.task_id, TaskStatus.DEAD_LETTER,
+                                   TaskStatus.FAILED)
+
+
+def _deadletter_scenario(cls):
+    def make():
+        store = InMemoryTaskStore()
+        broker = InMemoryBroker(max_delivery_count=1)
+        broker.register_queue("/v1/q")
+        tm = TracedTaskManager(LocalTaskManager(store))
+        d = _dispatcher(cls, broker, tm)
+        _seeded_task(store, broker)
+        invariant = TerminalInvariant(store)
+
+        async def deliver():
+            msg = await broker.receive("/v1/q", timeout=1.0)
+            await d._backpressure(msg, "backend")
+
+        async def completer():
+            # The lost-response backend finishing mid-backoff: its own
+            # response hop is the one suspension before the completion.
+            await yield_point()
+            await tm.update_task_status("t1", "completed",
+                                        TaskStatus.COMPLETED)
+
+        return [deliver(), completer()], invariant.check
+
+    return make
+
+
+class TestDeadLetterClobber:
+    def test_fixed_dispatcher_race_free(self):
+        report = explore_interleavings(_deadletter_scenario(Dispatcher),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_reverted_dispatcher_caught(self):
+        report = explore_interleavings(
+            _deadletter_scenario(RevertedDeadLetterDispatcher),
+            schedules=SCHEDULES, seed=SEED)
+        assert not report.ok
+        assert "clobbered" in str(report.failures[0].error)
+
+
+class RevertedCacheCompleteDispatcher(Dispatcher):
+    """``_complete_from_cache`` tail verbatim from the PR 4 tree — the
+    terminality probe runs BEFORE the result-store hop and is never
+    re-checked after it."""
+
+    async def _complete_from_cache(self, msg):
+        key = getattr(msg, "cache_key", "")
+        if self.result_cache is None or not key:
+            return False
+        found = self.result_cache.get(key, count=False)
+        if found is None:
+            return False
+        if (self.task_manager is not None
+                and await self.task_manager.is_terminal(msg.task_id)):
+            self.broker.complete(msg)
+            self._dispatched.inc(outcome="duplicate", queue=self.queue_name,
+                                 backend="")
+            return True
+        if self.result_store is None:
+            return False
+        payload, ctype = found
+        import inspect
+        res = self.result_store.set_result(msg.task_id, payload,
+                                           content_type=ctype)
+        if inspect.isawaitable(res):
+            await res
+        self.broker.complete(msg)
+        self._dispatched.inc(outcome="cache_hit", queue=self.queue_name,
+                             backend="")
+        await self._try_update(msg.task_id, "completed - served from cache",
+                               TaskStatus.COMPLETED)
+        return True
+
+
+def _cache_complete_scenario(cls):
+    def make():
+        store = InMemoryTaskStore()
+        broker = InMemoryBroker(max_delivery_count=4)
+        broker.register_queue("/v1/q")
+        tm = TracedTaskManager(LocalTaskManager(store))
+        cache = ResultCache(metrics=MetricsRegistry())
+        key = "/v1/q|deadbeef"
+        cache.put(key, b"cached-result")
+        d = _dispatcher(cls, broker, tm, result_cache=cache,
+                        result_store=AsyncHopResultStore(store))
+        _seeded_task(store, broker, status=TaskStatus.RUNNING)
+        invariant = TerminalInvariant(store)
+
+        async def deliver():
+            msg = await broker.receive("/v1/q", timeout=1.0)
+            msg.cache_key = key
+            await d._complete_from_cache(msg)
+
+        async def reaper_fail():
+            # The reaper giving up on the stuck-running task — an atomic
+            # conditional transition, exactly as taskstore.reaper does it.
+            await yield_point()
+            store.update_status_if(
+                "t1", TaskStatus.RUNNING,
+                "failed - no progress after 3 rescues",
+                backend_status=TaskStatus.FAILED)
+
+        return [deliver(), reaper_fail()], invariant.check
+
+    return make
+
+
+class TestCacheCompleteClobber:
+    def test_fixed_dispatcher_race_free(self):
+        report = explore_interleavings(_cache_complete_scenario(Dispatcher),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_reverted_dispatcher_caught(self):
+        report = explore_interleavings(
+            _cache_complete_scenario(RevertedCacheCompleteDispatcher),
+            schedules=SCHEDULES, seed=SEED)
+        assert not report.ok
+        assert "failed -> completed" in str(report.failures[0].error)
+
+
+class TestPermanentFailClobber:
+    """The third AIL007 fix: ``_dispatch_one``'s permanent-failure write
+    now re-checks terminality after the POST round trip. No revert replica
+    (the method is the whole delivery loop); instead the regression is
+    behavioral — remove the re-check and the clobber schedule fails this
+    test, and the ``duplicate`` outcome proves the re-check actually fires
+    in at least one explored schedule."""
+
+    def test_fixed_dispatch_race_free_and_suppresses(self):
+        duplicates = []
+
+        def make():
+            store = InMemoryTaskStore()
+            broker = InMemoryBroker(max_delivery_count=4)
+            broker.register_queue("/v1/q")
+            tm = TracedTaskManager(LocalTaskManager(store))
+            d = _dispatcher(Dispatcher, broker, tm)
+            backend = FakeBackend(status=400)  # permanent-failure class
+            d._sessions = backend
+            _seeded_task(store, broker)
+            invariant = TerminalInvariant(store)
+
+            async def deliver():
+                msg = await broker.receive("/v1/q", timeout=1.0)
+                await d._dispatch_one(msg)
+
+            async def completer():
+                # A concurrent duplicate's execution completing while this
+                # attempt's POST is in flight — guarded like the PR 4
+                # service shell (probe + write, atomic in-process).
+                await yield_point()
+                if not await tm.is_terminal("t1"):
+                    await tm.update_task_status("t1", "completed",
+                                                TaskStatus.COMPLETED)
+
+            def check():
+                invariant.check()
+                duplicates.append(d._dispatched.value(
+                    outcome="duplicate", queue="/v1/q",
+                    backend="backend"))
+
+            return [deliver(), completer()], check
+
+        report = explore_interleavings(make, schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
+        # The re-check must have fired (outcome=duplicate) in at least one
+        # explored schedule — otherwise the window was never exercised and
+        # this test proves nothing.
+        assert any(duplicates), "no schedule exercised the re-check window"
+
+
+# -- PR 3/PR 4 replays on clean reverts ---------------------------------------
+
+
+class RevertedExpiredDispatcher(Dispatcher):
+    """``_drop_expired`` verbatim from the PR 3 tree — no terminality
+    probe: a lease-expiry redelivery of a COMPLETED task whose deadline
+    passed was stamped ``expired`` (the completed→expired clobber PR 4
+    fixed by hand)."""
+
+    async def _drop_expired(self, msg):
+        import time as _time
+        deadline_at = getattr(msg, "deadline_at", 0.0)
+        if not deadline_at or _time.time() < deadline_at:
+            return False
+        from ai4e_tpu.admission.deadline import expired_status
+        self.broker.complete(msg)
+        self._dispatched.inc(outcome="expired", queue=self.queue_name,
+                             backend="")
+        if self.admission is not None:
+            self.admission.note_expired("dispatcher",
+                                        getattr(msg, "priority", 1))
+        await self._try_update(msg.task_id, expired_status("dispatcher"),
+                               TaskStatus.EXPIRED)
+        return True
+
+
+def _expired_scenario(cls):
+    def make():
+        store = InMemoryTaskStore()
+        broker = InMemoryBroker(max_delivery_count=4)
+        broker.register_queue("/v1/q")
+        tm = TracedTaskManager(LocalTaskManager(store))
+        d = _dispatcher(cls, broker, tm)
+        # The PR 3 incident shape: the task COMPLETED (lost-response
+        # execution), then its lease-expiry redelivery pops with the
+        # deadline already past.
+        _seeded_task(store, broker, status=TaskStatus.COMPLETED,
+                     deadline_at=1.0)
+        invariant = TerminalInvariant(store)
+
+        async def deliver():
+            msg = await broker.receive("/v1/q", timeout=1.0)
+            await d._drop_expired(msg)
+
+        return [deliver()], invariant.check
+
+    return make
+
+
+class TestReplayCompletedExpiredClobber:
+    def test_fixed_dispatcher_suppresses_duplicate(self):
+        report = explore_interleavings(_expired_scenario(Dispatcher),
+                                       schedules=20, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_pr3_revert_caught(self):
+        report = explore_interleavings(
+            _expired_scenario(RevertedExpiredDispatcher),
+            schedules=20, seed=SEED)
+        assert not report.ok
+        assert "completed -> expired" in str(report.failures[0].error)
+
+
+class RevertedWebhookDispatcher(WebhookDispatcher):
+    """``_forward`` without the retried-delivery terminality suppression —
+    the PR 3 tree's webhook (PR 4 added the ``attempts > 1`` guard): a
+    retried delivery trailing a lost-response execution re-executed the
+    task on the backend."""
+
+    async def _forward(self, event):
+        target = self._target_for(event.subject)
+        if target is None:
+            self._forwarded.inc(outcome="unroutable")
+            await self._try_update(
+                event.id, f"failed - no backend route for {event.subject}",
+                TaskStatus.FAILED)
+            return 200
+        from urllib.parse import urlparse
+        backend = urlparse(target).netloc
+        session = await self._sessions.get()
+        with self.tracer.span("webhook_dispatch", task_id=event.id) as span:
+            headers = {"taskId": event.id,
+                       "Content-Type": event.content_type,
+                       **self.tracer.headers()}
+            async with session.post(target, data=event.data,
+                                    headers=headers) as resp:
+                status = resp.status
+                await resp.read()
+            span.attrs["http_status"] = status
+        if 200 <= status < 300:
+            self._forwarded.inc(outcome="delivered", backend=backend)
+            return 200
+        self._forwarded.inc(outcome="failed", backend=backend)
+        await self._try_update(event.id,
+                               f"failed - backend returned {status}",
+                               TaskStatus.FAILED)
+        return 200
+
+
+def _forward_scenario(cls):
+    def make():
+        store = InMemoryTaskStore()
+        tm = TracedTaskManager(LocalTaskManager(store))
+        wd = cls(tm, metrics=MetricsRegistry())
+        wd.add_route("/v1/q", "http://backend")
+        _seeded_task(store, None)
+        backend = FakeBackend(
+            status=200,
+            on_execute=lambda: store.update_status(
+                "t1", "completed", TaskStatus.COMPLETED))
+        wd._sessions = backend
+
+        def event(attempt):
+            ev = PushEvent(id="t1", subject="/v1/q/op", data=b"payload")
+            ev.attempts = attempt
+            return ev
+
+        async def topic_retry():
+            # Attempt 1 executes; its response is "lost" upstream, so the
+            # topic redelivers as attempt 2 after backoff.
+            await wd._forward(event(1))
+            await asyncio.sleep(10.0)  # topic backoff (virtual)
+            await wd._forward(event(2))
+
+        def check():
+            assert backend.executions == 1, (
+                f"task executed {backend.executions}x — the retried "
+                "delivery re-ran a completed task on the backend")
+
+        return [topic_retry()], check
+
+    return make
+
+
+class TestReplayPushForwardDoubleExecution:
+    def test_fixed_webhook_suppresses_retry_of_completed_task(self):
+        report = explore_interleavings(_forward_scenario(WebhookDispatcher),
+                                       schedules=20, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_pr3_revert_caught(self):
+        report = explore_interleavings(
+            _forward_scenario(RevertedWebhookDispatcher),
+            schedules=20, seed=SEED)
+        assert not report.ok
+        assert "executed 2x" in str(report.failures[0].error)
+
+
+class LeakyBreaker(CircuitBreaker):
+    """``available`` without the time-based probe-slot escape — the PR 3
+    review find: a probe whose delivery was cancelled before any outcome
+    was recorded pinned its slot, ejecting the backend forever."""
+
+    def available(self, now=None):
+        if self.state == "closed":
+            return True
+        now = self._clock() if now is None else now
+        if self.state == "open":
+            return (now - self._opened_at >= self.recovery_seconds
+                    and self._probes_inflight < self.half_open_probes)
+        return self._probes_inflight < self.half_open_probes
+
+
+def _probe_leak_scenario(cls):
+    def make():
+        clock = [0.0]
+        br = cls(failure_threshold=2, recovery_seconds=30.0,
+                 clock=lambda: clock[0])
+
+        async def trip_and_vanish():
+            br.record_failure()
+            await yield_point()
+            br.record_failure()          # trips open
+            clock[0] += 31.0             # cooldown elapses
+            assert br.available()
+            br.begin_probe()             # probe dispatched ...
+            await yield_point()          # ... and its delivery is
+            #                              cancelled: no outcome ever lands.
+
+        async def later_probe():
+            await yield_point()
+            clock[0] += 62.0             # two more cooldowns of silence
+
+        def check():
+            # However the clock advances interleaved: after one more full
+            # cooldown of silence past EVERYTHING above, the slot must be
+            # free again.
+            clock[0] += 31.0
+            assert br.available(), (
+                "probe slot leaked: backend ejected forever after a "
+                "vanished probe")
+
+        return [trip_and_vanish(), later_probe()], check
+
+    return make
+
+
+class TestReplayHalfOpenProbeSlotLeak:
+    def test_fixed_breaker_frees_the_slot_by_time(self):
+        report = explore_interleavings(_probe_leak_scenario(CircuitBreaker),
+                                       schedules=20, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_pr3_revert_caught(self):
+        report = explore_interleavings(_probe_leak_scenario(LeakyBreaker),
+                                       schedules=20, seed=SEED)
+        assert not report.ok
+        assert "leaked" in str(report.failures[0].error)
+
+
+# -- clean drives over the remaining hot sections -----------------------------
+
+
+class TestTaskstoreReaperRedrive:
+    def test_reaper_rescue_vs_completion_race_free(self):
+        def make():
+            store = InMemoryTaskStore()
+            published = []
+            store.set_publisher(published.append)
+            tm = TracedTaskManager(LocalTaskManager(store))
+            reaper = TaskReaper(store, running_timeout=0.0, interval=3600,
+                                metrics=MetricsRegistry())
+            _seeded_task(store, None, status=TaskStatus.RUNNING)
+            invariant = TerminalInvariant(store)
+
+            async def sweep():
+                await yield_point()
+                await reaper.sweep()
+
+            async def completer():
+                await yield_point()
+                await tm.update_task_status("t1", "completed",
+                                            TaskStatus.COMPLETED)
+
+            def check():
+                invariant.check()
+                final = store.get("t1").canonical_status
+                if final == TaskStatus.COMPLETED:
+                    return  # completion won or survived the requeue
+                # The rescue won: the task must be back in CREATED with
+                # its replayed body published, never wedged.
+                assert final == TaskStatus.CREATED
+                assert published
+
+            return [sweep(), completer()], invariant.check
+
+        report = explore_interleavings(make, schedules=40, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_reaper_give_up_vs_completion_race_free(self):
+        def make():
+            store = InMemoryTaskStore()
+            tm = TracedTaskManager(LocalTaskManager(store))
+            reaper = TaskReaper(store, running_timeout=0.0, interval=3600,
+                                max_requeues=0, metrics=MetricsRegistry())
+            _seeded_task(store, None, status=TaskStatus.RUNNING)
+            invariant = TerminalInvariant(store)
+
+            async def sweep():
+                await yield_point()
+                await reaper.sweep()
+
+            async def completer():
+                # Guarded completion (the PR 4 service-shell idiom): the
+                # reaper may have failed the task first; an unguarded
+                # completed-stamp over it is the bug class, not this
+                # fixture's subject.
+                await yield_point()
+                if not await tm.is_terminal("t1"):
+                    await tm.update_task_status("t1", "completed",
+                                                TaskStatus.COMPLETED)
+
+            return [sweep(), completer()], invariant.check
+
+        report = explore_interleavings(make, schedules=40, seed=SEED)
+        assert report.ok, report.describe()
+
+
+class TestRescacheInflight:
+    def test_single_flight_has_exactly_one_leader(self):
+        def make():
+            cache = ResultCache(metrics=MetricsRegistry())
+            key = "/v1/q|cafe"
+            wins = []
+
+            async def gateway(tid):
+                await yield_point()
+                if cache.register_inflight(key, tid):
+                    wins.append(tid)
+                else:
+                    assert cache.leader_for(key) is not None
+
+            def check():
+                assert len(wins) == 1, f"leaders: {wins}"
+
+            return [gateway("a"), gateway("b")], check
+
+        report = explore_interleavings(make, schedules=40, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_generation_fencing_refuses_stale_fill(self):
+        def make():
+            cache = ResultCache(metrics=MetricsRegistry())
+            key = "/v1/q|cafe"
+            family = "/v1/q"
+            captured = {}
+
+            async def leader():
+                captured["gen"] = cache.generation(key)
+                await yield_point()  # computing on the old weights
+                captured["ok"] = cache.put(key, b"result",
+                                           if_generation=captured["gen"])
+
+            async def reloader():
+                await yield_point()
+                cache.invalidate_family(family)
+
+            def check():
+                # Whatever the interleaving: a fill that landed must be
+                # provably fresh — if the entry is present, no invalidation
+                # has advanced the generation since the leader captured it.
+                if cache.peek(key):
+                    assert cache.generation(key) == captured["gen"], (
+                        "stale fill served after invalidation")
+
+            return [leader(), reloader()], check
+
+        report = explore_interleavings(make, schedules=40, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_fill_inflight_vs_invalidate_race_free(self):
+        def make():
+            cache = ResultCache(metrics=MetricsRegistry())
+            key = "/v1/q|cafe"
+            cache.register_inflight(key, "t1")
+
+            async def filler():
+                await yield_point()  # the execution
+                cache.fill_inflight(key, "t1", b"result")
+
+            async def reloader():
+                await yield_point()
+                cache.invalidate_family("/v1/q")
+
+            def check():
+                # Invalidation after the fill drops the entry; before the
+                # fill it clears the registration so the fill refuses.
+                # Either way no stale entry AND no orphaned registration
+                # blocking the next identical request forever... unless a
+                # successful fill already released it.
+                assert cache.leader_for(key) is None
+
+            return [filler(), reloader()], check
+
+        report = explore_interleavings(make, schedules=40, seed=SEED)
+        assert report.ok, report.describe()
+
+
+class TestBreakerTransitions:
+    def test_concurrent_delivery_loops_trip_and_recover(self):
+        def make():
+            clock = [0.0]
+            health = BackendHealth(
+                ResiliencePolicy(failure_threshold=2, recovery_seconds=5.0),
+                metrics=MetricsRegistry(), clock=lambda: clock[0],
+                rng=random.Random(0))
+            backends = [("http://b", 1)]
+
+            async def failing_loop():
+                for _ in range(2):
+                    uri = health.pick(backends, None)
+                    await yield_point()  # the POST
+                    health.record_failure(uri)
+
+            async def probing_loop():
+                await yield_point()
+                clock[0] += 6.0  # cooldown elapses
+                uri = health.pick(backends, None)
+                await yield_point()
+                health.observe_status(uri, 200)
+
+            def check():
+                br = health.breaker_for("http://b")
+                assert br.state in ("closed", "open", "half_open")
+                assert 0 <= br._probes_inflight <= br.half_open_probes
+                # However the loops interleaved, the backend must be
+                # reachable again once a success lands or the cooldown
+                # passes — never ejected forever.
+                clock[0] += 6.0
+                assert br.available()
+
+            return [failing_loop(), probing_loop()], check
+
+        report = explore_interleavings(make, schedules=60, seed=SEED)
+        assert report.ok, report.describe()
+
+
+class TestGradientLimiter:
+    def test_concurrent_observe_and_backoff_keep_limit_bounded(self):
+        def make():
+            limiter = GradientLimiter(initial=8, min_limit=1, max_limit=64,
+                                      window=4)
+
+            async def observer():
+                for rtt in (0.01, 0.02, 0.5, 0.01, 0.01):
+                    limiter.observe(rtt, inflight=4)
+                    await yield_point()
+
+            async def backer():
+                for _ in range(3):
+                    await yield_point()
+                    limiter.backoff()
+
+            def check():
+                assert 1 <= limiter.limit <= 64
+
+            return [observer(), observer(), backer()], check
+
+        report = explore_interleavings(make, schedules=60, seed=SEED)
+        assert report.ok, report.describe()
+
+
+# -- the documented remote-store residual window ------------------------------
+
+
+class TestRemoteStoreResidualWindow:
+    """docs/concurrency.md §"the residual window": over an HTTP store hop,
+    probe-then-write is irreducibly non-atomic — one suspension separates
+    the probe's answer from the write landing. The platform ACCEPTS that
+    window for its probe-guarded cold paths and closes it where it must
+    win with the store's atomic conditional verbs. Both halves proven
+    here, so the paragraph can't rot."""
+
+    def test_probe_then_write_window_is_reachable_over_a_hop(self):
+        def make():
+            store = InMemoryTaskStore()
+            tm = TracedTaskManager(LocalTaskManager(store), hop=True)
+            _seeded_task(store, None, status=TaskStatus.RUNNING)
+            invariant = TerminalInvariant(store)
+
+            async def prober_writer():
+                if not await tm.is_terminal("t1"):
+                    await tm.update_task_status("t1", "expired - deadline",
+                                                TaskStatus.EXPIRED)
+
+            async def completer():
+                await tm.update_task_status("t1", "completed",
+                                            TaskStatus.COMPLETED)
+
+            return [prober_writer(), completer()], invariant.check
+
+        report = explore_interleavings(make, schedules=40, seed=SEED)
+        assert not report.ok, (
+            "the documented residual window was not reachable — either the "
+            "hop model changed or the docs are now wrong")
+
+    def test_conditional_verb_closes_the_window(self):
+        def make():
+            store = InMemoryTaskStore()
+            tm = TracedTaskManager(LocalTaskManager(store), hop=True)
+            _seeded_task(store, None, status=TaskStatus.RUNNING)
+            invariant = TerminalInvariant(store)
+
+            async def conditional_writer():
+                await yield_point()  # the request hop
+                # The store-side atomic verb: transition only if still
+                # running (what the HTTP surface's /update-if exposes).
+                store.update_status_if("t1", TaskStatus.RUNNING,
+                                       "expired - deadline",
+                                       backend_status=TaskStatus.EXPIRED)
+
+            async def completer():
+                await yield_point()  # its own request hop
+                store.update_status_if("t1", TaskStatus.RUNNING,
+                                       "completed",
+                                       backend_status=TaskStatus.COMPLETED)
+
+            return [conditional_writer(), completer()], invariant.check
+
+        report = explore_interleavings(make, schedules=40, seed=SEED)
+        assert report.ok, report.describe()

@@ -891,6 +891,37 @@ class TestDispatcherNoResultStore:
 
         run(main())
 
+    def test_cache_hit_without_task_manager_completes(self):
+        """task_manager=None tolerance (result-path-focused tests) must
+        survive the PR 5 post-hop terminality re-check: a cache hit with a
+        result_store but NO task manager completes from the cache instead
+        of crashing on the re-probe (the _try_update shim already
+        tolerates the write failing)."""
+        async def main():
+            from ai4e_tpu.broker.dispatcher import Dispatcher
+            from ai4e_tpu.broker.queue import InMemoryBroker, Message
+
+            class Sink:
+                def __init__(self):
+                    self.results = {}
+
+                def set_result(self, task_id, payload,
+                               content_type="application/json"):
+                    self.results[task_id] = payload
+
+            cache = ResultCache()
+            key = request_key("/v1/x", b"B")
+            cache.put(key, b'{"ok": 1}')
+            sink = Sink()
+            d = Dispatcher(InMemoryBroker(), "q", "http://127.0.0.1:1/v1/x",
+                           task_manager=None, result_cache=cache,
+                           result_store=sink)
+            msg = Message(task_id="t-1", endpoint="/v1/x", cache_key=key)
+            assert await d._complete_from_cache(msg) is True
+            assert sink.results["t-1"] == b'{"ok": 1}'
+
+        run(main())
+
 
 class TestStandbyOutcomeCounting:
     def test_not_primary_503_counts_no_cache_outcome(self):
