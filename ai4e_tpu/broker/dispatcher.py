@@ -30,7 +30,7 @@ from ..utils.backends import normalize_backends, pick_backend
 from ..utils.http import SessionHolder
 from ..service.task_manager import TaskManagerBase
 from ..taskstore import TaskStatus
-from .queue import InMemoryBroker, Message
+from .queue import InMemoryBroker, Message, base_queue_name
 
 log = logging.getLogger("ai4e_tpu.dispatcher")
 
@@ -88,6 +88,11 @@ class Dispatcher:
     ):
         self.broker = broker
         self.queue_name = queue_name
+        # The endpoint path this queue serves — equal to queue_name except
+        # on shard sub-queues ("{path}#s{i}"), where dispatch-target
+        # rebasing must graft operation tails against the real route path,
+        # not the suffixed queue name.
+        self.route_path = base_queue_name(queue_name)
         # Inference result cache (rescache/): a message whose task carries a
         # cache key is checked against it BEFORE the backend POST — a
         # redelivered/requeued/journal-restored task whose identical request
@@ -270,7 +275,7 @@ class Dispatcher:
                                         exclude=exclude)
         else:
             base = pick_backend(self.backends, self._rng)
-        return base, rebase_endpoint(msg.endpoint, self.queue_name, base)
+        return base, rebase_endpoint(msg.endpoint, self.route_path, base)
 
     def _record_outcome(self, base: str, status: int | None = None,
                         failed: bool = False) -> None:

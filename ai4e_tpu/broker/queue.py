@@ -31,6 +31,25 @@ from typing import Callable
 
 from ..taskstore import endpoint_path as canonical_path
 
+# Shard sub-queue naming (taskstore/sharding.py): with a sharded task
+# store, each endpoint's logical queue splits into one physical sub-queue
+# per shard — "{path}#s{shard}" — so every shard gets its own dispatchers
+# and one shard's outage (store failover in progress, its dispatchers
+# backing off) never stalls another shard's deliveries. '#' can never
+# appear in a queue path: ``endpoint_path`` strips fragments, so the
+# separator is collision-free by construction.
+SHARD_QUEUE_SEP = "#s"
+
+
+def shard_queue_name(base: str, shard: int) -> str:
+    return f"{base}{SHARD_QUEUE_SEP}{shard}"
+
+
+def base_queue_name(name: str) -> str:
+    """The endpoint path a (possibly shard-suffixed) queue name serves —
+    what dispatch-target rebasing and depth attribution key on."""
+    return name.split(SHARD_QUEUE_SEP, 1)[0]
+
 
 @dataclass
 class Message:
@@ -214,11 +233,21 @@ class InMemoryBroker:
 
     def __init__(self, max_delivery_count: int = 1440,
                  lease_seconds: float = 300.0,
-                 max_dead_letters: int = 256, metrics=None):
+                 max_dead_letters: int = 256, metrics=None,
+                 shard_router=None):
         self.max_delivery_count = max_delivery_count
         self.lease_seconds = lease_seconds
         self.max_dead_letters = max_dead_letters
         self._metrics = metrics
+        # Shard router (``shard_router(task_id) -> shard index``): when set,
+        # publish lands each message on its task's per-shard sub-queue
+        # (``shard_queue_name``) instead of the endpoint's base queue —
+        # per-shard dispatchers then drain independently. Redelivery is
+        # shard-aware by construction: abandon/lease-expiry return a message
+        # to the sub-queue it lives on. A message whose task was rebalanced
+        # mid-flight drains from the OLD shard's sub-queue once more —
+        # placement staleness only; its store writes route by ring.
+        self._shard_router = shard_router
         self._queues: dict[str, EndpointQueue] = {}
         self._queues_lock = threading.Lock()
         self._seq = itertools.count(1)
@@ -263,11 +292,15 @@ class InMemoryBroker:
 
     def resolve_queue_name(self, endpoint: str) -> str:
         """Longest registered queue path that prefixes the endpoint path;
-        falls back to the exact path (a queue is created on demand)."""
+        falls back to the exact path (a queue is created on demand). Shard
+        sub-queues never match — routing picks the BASE queue, and publish
+        appends the task's shard suffix itself."""
         path = canonical_path(endpoint)
         with self._queues_lock:
             candidates = [n for n in self._queues
-                          if path == n or path.startswith(n.rstrip("/") + "/")]
+                          if SHARD_QUEUE_SEP not in n
+                          and (path == n
+                               or path.startswith(n.rstrip("/") + "/"))]
         return max(candidates, key=len) if candidates else path
 
     # -- publish side ------------------------------------------------------
@@ -278,12 +311,16 @@ class InMemoryBroker:
         Callable from any thread; the enqueue itself happens on the broker's
         event loop.
         """
+        queue_name = self.resolve_queue_name(task.endpoint)
+        if self._shard_router is not None:
+            queue_name = shard_queue_name(queue_name,
+                                          self._shard_router(task.task_id))
         msg = Message(task_id=task.task_id, endpoint=task.endpoint,
                       body=task.body,
                       content_type=getattr(task, "content_type",
                                            "application/json"),
                       seq=next(self._seq),
-                      queue_name=self.resolve_queue_name(task.endpoint),
+                      queue_name=queue_name,
                       cache_key=getattr(task, "cache_key", ""),
                       deadline_at=getattr(task, "deadline_at", 0.0),
                       priority=getattr(task, "priority", 1))

@@ -17,8 +17,9 @@ assemblies carry no chaos code path. Three parts:
 the full platform for the goodput-under-failure A/B.
 """
 
-from .harness import (RestartableBackend, kill_dispatcher, kill_worker,
-                      restart_dispatcher, restart_worker)
+from .harness import (RestartableBackend, kill_dispatcher, kill_shard_primary,
+                      kill_worker, rebalance_slot, restart_dispatcher,
+                      restart_worker)
 from .injector import (ChaosSession, ChaosSessionHolder, Decision,
                        FaultInjector, FaultRule, wrap_platform_http,
                        wrap_publish_duplicates)
@@ -28,5 +29,6 @@ __all__ = [
     "FaultInjector", "FaultRule", "Decision", "ChaosSession",
     "ChaosSessionHolder", "wrap_platform_http", "wrap_publish_duplicates",
     "RestartableBackend", "kill_dispatcher", "restart_dispatcher",
-    "kill_worker", "restart_worker", "InvariantChecker",
+    "kill_worker", "restart_worker", "kill_shard_primary", "rebalance_slot",
+    "InvariantChecker",
 ]

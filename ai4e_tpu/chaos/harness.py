@@ -79,3 +79,21 @@ async def kill_worker(backend: RestartableBackend) -> None:
 
 async def restart_worker(backend: RestartableBackend) -> None:
     await backend.restart()
+
+
+def kill_shard_primary(platform, shard: int) -> None:
+    """SIGKILL one shard primary of a sharded platform
+    (``PlatformConfig(task_shards=N)``): its journal handle closes and
+    every mutation refuses from this instant — no half-applied writes,
+    exactly the window a process kill leaves. The next write routed to
+    the shard performs the failover promotion inline (final journal
+    drain → replica ``promote()`` minting the fencing epoch)."""
+    platform.store.kill_shard_primary(shard)
+
+
+def rebalance_slot(platform, slot: int, dest_shard: int) -> int:
+    """Live rebalance under load: move one hash slot's keyspace range to
+    ``dest_shard`` (``ShardedTaskStore.move_slot`` — bulk copy, then an
+    atomic delta + ring flip under the old owner's lock). Returns tasks
+    moved."""
+    return platform.store.move_slot(slot, dest_shard)

@@ -25,8 +25,15 @@ from ..taskstore import TaskNotFound, TaskStatus
 
 
 class InvariantChecker:
-    def __init__(self):
+    def __init__(self, shard_of=None):
+        """``shard_of`` (optional, ``shard_of(task_id) -> int``): the hash
+        ring's owner function — when given, every verdict is ALSO
+        available per shard (``by_shard``/``assert_shard_ok``), so a
+        sharded chaos run can prove the invariants hold for each shard
+        independently and for an exact keyspace range across a rebalance
+        (``violations_for``)."""
         self._store = None
+        self.shard_of = shard_of
         self.accepted: set[str] = set()
         # First terminal status seen per task (listener feed).
         self.terminal: dict[str, str] = {}
@@ -56,9 +63,14 @@ class InvariantChecker:
 
     # -- verdicts -----------------------------------------------------------
 
-    def violations(self) -> list[str]:
+    def violations(self, task_ids=None) -> list[str]:
+        """All violations, or — with ``task_ids`` — only those inside that
+        keyspace range (the moved-slot check a rebalance scenario runs)."""
+        wanted = None if task_ids is None else set(task_ids)
         out = []
         for tid in sorted(self.accepted):
+            if wanted is not None and tid not in wanted:
+                continue
             if tid in self.terminal:
                 continue
             # Never seen terminal: distinguish "still limbo" from "gone".
@@ -73,6 +85,8 @@ class InvariantChecker:
                 out.append(f"task {tid} never reached a terminal status "
                            f"(stuck at {record.canonical_status!r})")
         for tid, first, second in self.duplicate_completions:
+            if wanted is not None and tid not in wanted:
+                continue
             out.append(f"task {tid} completed twice (client-visible): "
                        f"{first!r} then {second!r}")
         return out
@@ -87,3 +101,38 @@ class InvariantChecker:
         return {"accepted": len(self.accepted),
                 "terminal": len(self.terminal),
                 "duplicates": len(self.duplicate_completions)}
+
+    # -- per-shard verdicts (sharded runs; requires shard_of) ---------------
+
+    def by_shard(self) -> dict[int, dict]:
+        """Accepted/terminal/duplicate counts per shard — the invariant
+        summary refactored onto the ring, so a shard-primary-kill run can
+        prove the OTHER shards' keyspace was untouched."""
+        if self.shard_of is None:
+            raise ValueError("InvariantChecker was built without shard_of")
+        out: dict[int, dict] = {}
+        for tid in self.accepted:
+            s = out.setdefault(self.shard_of(tid),
+                               {"accepted": 0, "terminal": 0,
+                                "duplicates": 0})
+            s["accepted"] += 1
+            if tid in self.terminal:
+                s["terminal"] += 1
+        for tid, _first, _second in self.duplicate_completions:
+            s = out.setdefault(self.shard_of(tid),
+                               {"accepted": 0, "terminal": 0,
+                                "duplicates": 0})
+            s["duplicates"] += 1
+        return out
+
+    def assert_shard_ok(self, shard: int) -> None:
+        """Invariants restricted to ONE shard's keyspace: every accepted
+        task of that shard terminal, none lost, zero duplicates."""
+        if self.shard_of is None:
+            raise ValueError("InvariantChecker was built without shard_of")
+        ids = [tid for tid in self.accepted if self.shard_of(tid) == shard]
+        problems = self.violations(ids)
+        if problems:
+            raise AssertionError(
+                f"shard {shard} invariants violated:\n  "
+                + "\n  ".join(problems))

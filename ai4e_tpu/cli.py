@@ -381,7 +381,11 @@ async def run_control_plane(config: FrameworkConfig, routes: dict) -> None:
     # AI4E_PLATFORM_RESILIENCE=1, docs/resilience.md).
     posture = ("".join([
         ", admission control ON" if platform.admission is not None else "",
-        ", resilience ON" if platform.resilience is not None else ""]))
+        ", resilience ON" if platform.resilience is not None else "",
+        # Sharding changes the durability/availability topology (per-shard
+        # journals + failover — AI4E_PLATFORM_TASK_SHARDS, docs/sharding.md).
+        (f", task store sharded x{platform.config.task_shards}"
+         if platform.config.task_shards > 1 else "")]))
     log.info("control plane on %s:%s (%d routes%s)", config.gateway.host,
              config.gateway.port, len(platform.gateway.routes), posture)
     try:

@@ -200,6 +200,14 @@ class PlatformSection:
     resilience_max_attempts: int = 3
     resilience_retry_base_s: float = 0.05
     resilience_retry_budget_ratio: float = 0.2
+    # Sharded task store (docs/sharding.md): N independent shards over a
+    # consistent-hash slot ring, each with its own journal, passive
+    # replicas, and epoch-fenced failover. 1 = today's single store.
+    task_shards: int = 1
+    task_shard_slots: int = 64
+    task_shard_replicas: int = 1
+    shard_tail_interval: float = 0.25
+    shard_feed_recent: int = 4096
 
     def to_platform_config(self):
         from .platform_assembly import PlatformConfig
@@ -245,6 +253,11 @@ class PlatformSection:
             resilience_max_attempts=self.resilience_max_attempts,
             resilience_retry_base_s=self.resilience_retry_base_s,
             resilience_retry_budget_ratio=self.resilience_retry_budget_ratio,
+            task_shards=self.task_shards,
+            task_shard_slots=self.task_shard_slots,
+            task_shard_replicas=self.task_shard_replicas,
+            shard_tail_interval=self.shard_tail_interval,
+            shard_feed_recent=self.shard_feed_recent,
         )
 
 

@@ -113,7 +113,10 @@ class Gateway:
         # Event-loop objects, so they live here rather than in the
         # thread-safe cache.
         self._sync_inflight: dict = {}
-        if hasattr(store, "add_listener"):
+        if hasattr(store, "add_listener") and not hasattr(store, "feed_for"):
+            # Unsharded stores: per-task waiter map fed by a store listener.
+            # A sharded store's long-poll rides its per-shard change feeds
+            # instead (see _task) — no gateway-side listener at all.
             store.add_listener(self._on_task_change)
 
         # aiohttp's own cap is effectively disabled: _read_limited enforces
@@ -828,6 +831,24 @@ class Gateway:
                 return web.Response(status=400, text="Bad wait parameter.")
 
         if wait > 0 and task.canonical_status not in TaskStatus.TERMINAL:
+            feed_for = getattr(self.store, "feed_for", None)
+            if feed_for is not None:
+                # Sharded store: park on the owning shard's change feed
+                # (``taskstore/feed.py``). The wakeup delivers the terminal
+                # record itself — no per-request store re-poll — and the
+                # feed's replay map closes the attach-vs-event race, so the
+                # whole watcher population rides N shard feeds instead of
+                # N×watchers store listeners. Only the timeout path (and a
+                # task that migrates shards mid-wait, whose event lands on
+                # the destination feed) falls back to a store read.
+                record = await feed_for(task_id).wait_terminal(task_id, wait)
+                if record is not None:
+                    return web.json_response(record.to_dict())
+                try:
+                    task = self.store.get(task_id)
+                except TaskNotFound:
+                    return web.Response(status=404, text="Task not found.")
+                return web.json_response(task.to_dict())
             # Register the waiter BEFORE the re-read so a transition between
             # re-read and wait() still sets the event (no lost wakeup).
             event = self._waiter_for(task_id)

@@ -117,6 +117,26 @@ class PlatformConfig:
     resilience_max_attempts: int = 3        # POST attempts per delivery
     resilience_retry_base_s: float = 0.05   # first in-delivery retry delay
     resilience_retry_budget_ratio: float = 0.2  # retries per request, steady
+    # Sharded task store (taskstore/sharding.py, docs/sharding.md): split
+    # the task keyspace over N independent shards — each with its own
+    # journal, passive replicas (with journal_path), and epoch-fenced
+    # failover — so one shard primary's death degrades 1/N of the keyspace
+    # for the promotion window instead of everything. 1 (default) keeps
+    # today's single-store assembly byte for byte. >1 requires the Python
+    # store/broker and is exclusive with the whole-store HA pair
+    # (replicate_from) — shard replicas ARE the availability story.
+    task_shards: int = 1
+    # Hash-slot count the ring divides the keyspace into (a rebalance moves
+    # whole slots); must be >= task_shards.
+    task_shard_slots: int = 64
+    # Passive replicas per shard (journal_path required for them to absorb);
+    # 0 disables per-shard failover.
+    task_shard_replicas: int = 1
+    # Replica journal-tail poll interval (seconds).
+    shard_tail_interval: float = 0.25
+    # Per-shard change-feed replay window (terminal records retained for
+    # the long-poll attach race; taskstore/feed.py).
+    shard_feed_recent: int = 4096
 
 
 class LocalPlatform:
@@ -144,7 +164,27 @@ class LocalPlatform:
             result_backend=result_backend,
             result_offload_threshold=(self.config.result_offload_threshold
                                       if result_backend else None))
-        if self.config.replicate_from:
+        if self.config.task_shards > 1:
+            if self.config.native_store or self.config.native_broker:
+                raise ValueError(
+                    "task_shards > 1 requires the Python store and broker "
+                    "(the native cores hold no ring/fence state)")
+            if self.config.replicate_from:
+                raise ValueError(
+                    "task_shards > 1 is exclusive with replicate_from: "
+                    "per-shard replicas are the sharded availability "
+                    "story (docs/sharding.md)")
+            from .taskstore.sharding import ShardedTaskStore
+            self.store = ShardedTaskStore(
+                self.config.task_shards,
+                slots=self.config.task_shard_slots,
+                journal_path=self.config.journal_path,
+                replicas=(self.config.task_shard_replicas
+                          if self.config.journal_path else 0),
+                tail_interval=self.config.shard_tail_interval,
+                feed_recent=self.config.shard_feed_recent,
+                **result_kwargs)
+        elif self.config.replicate_from:
             if not self.config.journal_path:
                 raise ValueError(
                     "replicate_from (standby mode) requires journal_path — "
@@ -268,7 +308,11 @@ class LocalPlatform:
                 self.broker = InMemoryBroker(
                     max_delivery_count=self.config.max_delivery_count,
                     lease_seconds=self.config.lease_seconds,
-                    metrics=self.metrics)
+                    metrics=self.metrics,
+                    # Sharded store → per-shard sub-queues, so each shard's
+                    # dispatchers drain independently (broker/queue.py).
+                    shard_router=(self.store.shard_for
+                                  if self.config.task_shards > 1 else None))
             self.store.set_publisher(self.broker.publish)
             self.dispatchers = DispatcherPool(
                 self.broker, self.task_manager,
@@ -407,23 +451,36 @@ class LocalPlatform:
             self.webhook.add_route(queue_name, backend_uri)
             return
         self.broker.register_queue(queue_name)
-        dispatcher = self.dispatchers.register(queue_name, backend_uri,
-                                               retry_delay=retry_delay,
-                                               concurrency=concurrency)
-        if autoscale is not None:
-            from .scaling import AutoscaleController, DispatcherScaleTarget
-            self.autoscalers.append(AutoscaleController(
-                self.store, queue_name, DispatcherScaleTarget(dispatcher),
-                policy=autoscale, interval=autoscale_interval,
-                metrics=self.metrics))
-        elif self.admission is not None:
-            # The adaptive controller owns this dispatcher's fan-out: its
-            # per-queue limiter (fed by delivery RTTs + backpressure
-            # backoffs) replaces the fixed concurrency constant. An
-            # explicit AutoscalePolicy wins — two control loops driving one
-            # actuator would fight.
-            self.admission.add_target("dispatch:" + queue_name,
-                                      dispatcher.set_concurrency)
+        if self.config.task_shards > 1:
+            if autoscale is not None:
+                raise ValueError(
+                    "autoscale policies are per-dispatcher; with "
+                    "task_shards > 1 use admission's adaptive control "
+                    "(one limiter per shard sub-queue) instead")
+            from .broker.queue import shard_queue_name
+            queue_names = [shard_queue_name(queue_name, i)
+                           for i in range(self.config.task_shards)]
+        else:
+            queue_names = [queue_name]
+        for qn in queue_names:
+            dispatcher = self.dispatchers.register(qn, backend_uri,
+                                                   retry_delay=retry_delay,
+                                                   concurrency=concurrency)
+            if autoscale is not None:
+                from .scaling import (AutoscaleController,
+                                      DispatcherScaleTarget)
+                self.autoscalers.append(AutoscaleController(
+                    self.store, qn, DispatcherScaleTarget(dispatcher),
+                    policy=autoscale, interval=autoscale_interval,
+                    metrics=self.metrics))
+            elif self.admission is not None:
+                # The adaptive controller owns this dispatcher's fan-out:
+                # its per-queue limiter (fed by delivery RTTs + backpressure
+                # backoffs) replaces the fixed concurrency constant. An
+                # explicit AutoscalePolicy wins — two control loops driving
+                # one actuator would fight.
+                self.admission.add_target("dispatch:" + qn,
+                                          dispatcher.set_concurrency)
 
     def publish_sync_api(self, public_prefix: str, backend_uri,
                          max_body_bytes: int | None = None) -> None:
@@ -463,6 +520,9 @@ class LocalPlatform:
             # the HA-pair marker (both charts set it); the explicit
             # /demote endpoint stays available either way.
             self.store.passive_fencing = bool(self.config.advertise_url)
+        if hasattr(self.store, "start_replication"):
+            # Sharded store: per-shard replica journal tails (sharding.py).
+            await self.store.start_replication()
         await self._start_transport(loop)
         await self.depth_logger.start()
         if self.reaper is not None:
@@ -684,6 +744,8 @@ class LocalPlatform:
             if self.reaper is not None:
                 await self.reaper.stop()
             await self.depth_logger.stop()
+            if hasattr(self.store, "stop_replication"):
+                await self.store.stop_replication()
             self._started = False
         for svc in self.services:
             await svc.drain(timeout=5.0)

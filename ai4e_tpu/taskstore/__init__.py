@@ -3,8 +3,10 @@ from .store import (
     FollowerTaskStore,
     InMemoryTaskStore,
     JournaledTaskStore,
+    NotOwnerError,
     NotPrimaryError,
     StaleEpochError,
+    StoreClosedError,
     TaskNotFound,
 )
 from .task import APITask, TaskStatus, endpoint_path, new_task_id
@@ -17,8 +19,10 @@ __all__ = [
     "InMemoryTaskStore",
     "JournaledTaskStore",
     "FollowerTaskStore",
+    "NotOwnerError",
     "NotPrimaryError",
     "StaleEpochError",
+    "StoreClosedError",
     "TaskNotFound",
     "FileResultBackend",
     "ResultBackend",

@@ -342,6 +342,17 @@ def make_app(store: InMemoryTaskStore,
     app.router.add_post("/v1/taskstore/result-ref", stamped(put_result_ref))
     app.router.add_get("/v1/taskstore/result", stamped(get_result))
 
+    # -- shard topology (sharded facade only; taskstore/sharding.py) -------
+
+    if getattr(store, "ring", None) is not None:
+        async def shards(_: web.Request) -> web.Response:
+            """Ring layout + per-shard epoch/role/feed state — what an
+            operator (or a future shard-aware client) needs to see where
+            the keyspace lives and which fencing epoch each shard is on."""
+            return web.json_response(store.topology())
+
+        app.router.add_get("/v1/taskstore/shards", stamped(shards))
+
     # -- replication surface (journaled stores only; replication.py) -------
 
     journal_path = getattr(store, "_journal_path", None)

@@ -41,17 +41,30 @@ class TaskReaper:
                  interval: float = 30.0,
                  max_requeues: int = 3,
                  terminal_retention: float | None = None,
+                 owns=None,
                  metrics: MetricsRegistry | None = None):
         """``running_timeout`` None disables the stuck-task rescue;
         ``terminal_retention`` (seconds) evicts completed/failed history
         older than that — record, original body, results, offloaded blobs
         — bounding store memory and journal size over a long deployment
-        (the Redis-expiry role; None keeps history forever)."""
+        (the Redis-expiry role; None keeps history forever).
+
+        ``owns`` (optional, ``owns(task_id) -> bool``): shard-ownership
+        filter for sharded deployments running one reaper per shard — a
+        task whose hash slot was rebalanced away between the scan snapshot
+        and the rescue belongs to the NEW owner's reaper and is skipped
+        (docs/sharding.md). The store-level write fence (``NotOwnerError``)
+        backstops this: even a reaper that skips the filter cannot land a
+        stale-owner write. None (the default, and the facade-attached
+        reaper in the single-process assembly) rescues the full keyspace
+        it scans — actions route through the store it was given, which on
+        the sharded facade means a fresh ring lookup per rescue."""
         self.store = store
         self.running_timeout = running_timeout
         self.interval = interval
         self.max_requeues = max_requeues
         self.terminal_retention = terminal_retention
+        self.owns = owns
         self.metrics = metrics or DEFAULT_REGISTRY
         self._reaped = self.metrics.counter(
             "ai4e_reaper_actions_total", "Stuck-task rescues by outcome")
@@ -101,13 +114,7 @@ class TaskReaper:
                     acted += evicted
         if self.running_timeout is None:
             return acted
-        running: list = []
-        for path in self.store.endpoints():
-            for task_id in self.store.set_members(path, TaskStatus.RUNNING):
-                try:
-                    running.append(self.store.get(task_id))
-                except KeyError:
-                    continue
+        running = self._collect_running()
         running_ids = {t.task_id for t in running}
         # Release rescue budgets only on TERMINAL outcomes: a rescued task
         # waiting in CREATED (redelivery pending) must keep its count, or
@@ -125,6 +132,13 @@ class TaskReaper:
         for task in running:
             age = now - task.timestamp
             if age < self.running_timeout:
+                continue
+            if not self._owned(task.task_id):
+                # A rebalance moved this task's hash slot after the scan
+                # snapshot: the NEW owner's sweep is responsible for it
+                # now. Acting here would be the stale-owner rescue the
+                # store fence refuses (NotOwnerError) — skip instead of
+                # burning a routed rescue on a range mid-handoff.
                 continue
             count = self._requeues.get(task.task_id, 0)
             # Conditional transitions: the task may have completed between
@@ -155,3 +169,30 @@ class TaskReaper:
                 self._reaped.inc(outcome="requeued")
             acted += 1
         return acted
+
+    def _collect_running(self) -> list:
+        """Running-set snapshot. On a sharded store the scan is PER SHARD
+        (each shard's status sets, not one whole-keyspace walk — the scan
+        cost a shard pays is bounded by its own 1/N of the keyspace);
+        unsharded stores scan exactly as before."""
+        shards_fn = getattr(self.store, "shard_stores", None)
+        sources = shards_fn() if shards_fn is not None else [self.store]
+        running: list = []
+        for source in sources:
+            for path in source.endpoints():
+                for task_id in source.set_members(path, TaskStatus.RUNNING):
+                    try:
+                        running.append(source.get(task_id))
+                    except KeyError:
+                        continue
+        return running
+
+    def _owned(self, task_id: str) -> bool:
+        if self.owns is None:
+            return True
+        try:
+            return bool(self.owns(task_id))
+        except Exception:  # noqa: BLE001 — an ownership-probe fault must not kill the sweep
+            log.exception("shard ownership probe failed for %s; skipping "
+                          "rescue this sweep", task_id)
+            return False

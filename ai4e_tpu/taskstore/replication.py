@@ -55,6 +55,19 @@ log = logging.getLogger("ai4e_tpu.taskstore.replication")
 JOURNAL_PATH = "/v1/taskstore/journal"
 
 
+def split_complete_lines(buffer: bytes) -> tuple[list[str], bytes]:
+    """Split a journal-stream buffer into the complete lines it holds and
+    the unterminated remainder. Journal records are absorbed whole or not
+    at all — a chunk boundary mid-record must never half-apply — so every
+    tail consumer (the HTTP ``JournalReplicator`` here, the in-process
+    per-shard ``ShardReplicaLink`` in ``sharding.py``) shares this one
+    split rule."""
+    consumed = buffer.rfind(b"\n") + 1
+    if not consumed:
+        return [], buffer
+    return buffer[:consumed].decode("utf-8").splitlines(), buffer[consumed:]
+
+
 class JournalReplicator:
     """Tail the primary's journal stream into a ``FollowerTaskStore``.
 
@@ -160,15 +173,12 @@ class JournalReplicator:
                         raise aiohttp.ClientError(
                             f"journal reset served from offset {served_from}")
                 if chunk:
-                    buffer += chunk
-                    consumed = buffer.rfind(b"\n") + 1
-                    if consumed:
-                        lines = buffer[:consumed].decode("utf-8").splitlines()
+                    lines, buffer = split_complete_lines(buffer + chunk)
+                    if lines:
                         # Absorb off the event loop: applying a large resync
                         # chunk is file+dict work that must not stall the
                         # replica's serving loop.
                         await asyncio.to_thread(self.store.absorb_lines, lines)
-                        buffer = buffer[consumed:]
                     self.offset += len(chunk)
                 if self.offset >= size:
                     # Caught up to the primary's journal as of this poll —

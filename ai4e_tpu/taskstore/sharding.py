@@ -1,0 +1,784 @@
+"""Sharded task store — N independent shards over one consistent-hash ring.
+
+ROADMAP item 3 ("million-user control plane"): one primary store + passive
+replicas is both the availability ceiling (any primary death stalls the
+WHOLE keyspace until failover) and the scale ceiling (every journal byte
+funnels through one lock, one fsync stream). This module shards the task
+keyspace so the loss of any one shard primary degrades 1/N of the keyspace
+for the duration of a promotion, and the other N-1 shards never notice.
+
+Layout (Redis-Cluster-style consistent hashing over a fixed slot space):
+
+- ``ShardRing`` — TaskId → hash slot (stable BLAKE2 digest, never Python's
+  per-process ``hash``) → owning shard via a slot table. A fixed slot
+  space makes a *keyspace range* a first-class thing: a live rebalance is
+  "move slot S from shard A to shard B", not a re-hash of the world.
+- ``ShardGroup`` — one shard's primary (journaled, epoch-fenced — the
+  same ``FollowerTaskStore`` machinery the whole-store HA pair uses, per
+  shard) plus passive replicas absorbing the primary's journal through
+  ``ShardReplicaLink``. SIGKILL of the primary → ``fail_over`` drains the
+  durable journal tail into a replica, promotes it (minting the next
+  fencing epoch), and the facade re-routes — writes refuse on the corpse
+  (``StoreClosedError``), never half-apply.
+- ``ShardedTaskStore`` — the facade the rest of the platform holds where
+  it used to hold one store. Every single-store assumption becomes a
+  ring lookup; aggregate queries (depths, endpoints, snapshots) fan out;
+  listeners fan in through one relay per shard, which also publishes
+  terminal transitions to that shard's ``ShardChangeFeed`` (``feed.py``)
+  so ~100k long-poll watchers ride N feed attachments.
+
+Split-brain is structurally prevented, per shard and across rebalance:
+
+- **failover**: the promoted replica's ``promote()`` mints a journaled
+  epoch strictly above everything the dead primary ever wrote, and the
+  dead primary's store refuses all mutations (closed) — the same fence
+  the whole-store HA pair proves in ``tests/test_fencing.py``, now per
+  shard;
+- **rebalance**: the ring flip happens while holding the OLD owner's
+  store lock, and every shard store re-checks ring ownership under its
+  own lock on every mutation (``InMemoryTaskStore._check_owner`` →
+  ``NotOwnerError``). A write that routed to the old owner before the
+  flip blocks on that same lock and is refused after it; the facade
+  re-routes it to the new owner, which received the full range (bulk
+  copy + an atomic delta while the old owner was frozen) BEFORE the flip
+  became visible. The interleaving regression in
+  ``tests/test_race_regressions.py`` explores exactly this window.
+
+Residual windows (stated, not hidden — docs/sharding.md):
+
+- memory-only records (``durable=False`` cache hits) do not migrate; a
+  moved cache-hit TaskId 404s afterwards, the same contract as a restart;
+- a rebalanced task's already-enqueued broker message stays on the old
+  shard's sub-queue; its delivery still routes every store write through
+  the ring, so placement is stale for one delivery but correctness holds;
+- replicas re-arm after a failover the way the whole-store pair does:
+  the promoted store runs without a standby until the deployment
+  provisions one.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import threading
+from typing import Callable, Iterable
+
+from .feed import ShardChangeFeed
+from .replication import split_complete_lines
+from .store import (FollowerTaskStore, InMemoryTaskStore, NotOwnerError,
+                    NotPrimaryError, StoreClosedError, TaskNotFound)
+from .task import APITask, new_task_id
+
+log = logging.getLogger("ai4e_tpu.taskstore.sharding")
+
+
+def stable_hash(task_id: str) -> int:
+    """Process-independent TaskId hash (BLAKE2b-64). Python's ``hash`` is
+    salted per process — two control-plane processes would disagree on
+    ownership of every task."""
+    return int.from_bytes(
+        hashlib.blake2b(task_id.encode("utf-8"), digest_size=8).digest(),
+        "big")
+
+
+class ShardRing:
+    """TaskId → slot → shard, with atomic single-slot reassignment.
+
+    The slot table is the consistent-hash structure made explicit (the
+    Redis Cluster / 16384-hash-slots shape): adding capacity or rebalancing
+    moves whole slots, and only the moved slots' keys change owner —
+    everything else is untouched. ``version`` increments on every
+    reassignment: the rebalance epoch a stale owner's fence re-checks."""
+
+    def __init__(self, shards: int, slots: int = 64):
+        if shards < 1:
+            raise ValueError("shards must be >= 1")
+        if slots < shards:
+            raise ValueError(f"slots ({slots}) must be >= shards ({shards})")
+        self.shards = shards
+        self.slots = slots
+        self._assign = [i % shards for i in range(slots)]
+        self.version = 0
+        self._lock = threading.Lock()
+
+    def slot_for(self, task_id: str) -> int:
+        return stable_hash(task_id) % self.slots
+
+    def shard_for(self, task_id: str) -> int:
+        return self._assign[self.slot_for(task_id)]
+
+    def shard_of_slot(self, slot: int) -> int:
+        return self._assign[slot]
+
+    def slots_of(self, shard: int) -> list[int]:
+        return [s for s, owner in enumerate(self._assign) if owner == shard]
+
+    def assign(self, slot: int, shard: int) -> None:
+        """Reassign one slot. The caller (``move_slot``) holds the OLD
+        owner's store lock around this, which is what makes the flip
+        atomic with respect to that store's write fence."""
+        if not 0 <= shard < self.shards:
+            raise ValueError(f"shard {shard} out of range")
+        with self._lock:
+            self._assign[slot] = shard
+            self.version += 1
+
+    def assignments(self) -> list[int]:
+        return list(self._assign)
+
+
+class ShardReplicaLink:
+    """One passive replica's journal tail — the in-process analogue of
+    ``replication.JournalReplicator``, reading the primary's journal FILE
+    (which outlives the primary: it is the shard's durable truth) instead
+    of the HTTP stream. Same consume-whole-lines rule, same generation
+    resync contract (a compaction rewrite restarts the reader at offset 0
+    of what is then a full snapshot)."""
+
+    def __init__(self, group: "ShardGroup", standby: FollowerTaskStore):
+        self.group = group
+        self.standby = standby
+        self.generation = -1
+        self.offset = 0
+        self._buffer = b""
+        # Serializes tail-loop polls (executor thread) against the failover
+        # drain (caller's thread): both advance offset/_buffer through
+        # sync_once, and interleaving them would double-absorb or skip
+        # lines.
+        self._sync_lock = threading.Lock()
+
+    def sync_once(self) -> int:
+        """Absorb any new journal bytes; returns bytes consumed (0 = caught
+        up). Synchronous file work — callers on an event loop wrap it in
+        ``asyncio.to_thread`` (the replicator absorbs the same way)."""
+        with self._sync_lock:
+            return self._sync_once_locked()
+
+    def _sync_once_locked(self) -> int:
+        primary = self.group.primary
+        # Generation + open under the primary's lock: compaction swaps the
+        # file under that lock (http.py journal_stream does the same). A
+        # dead primary's lock is uncontended and its generation frozen.
+        with primary._lock:
+            gen = primary.journal_generation
+            try:
+                fh = open(self.group.journal_path, "rb")
+            except FileNotFoundError:
+                return 0
+        try:
+            if gen != self.generation:
+                if self.generation != -1:
+                    log.info("shard %d replica: journal generation %d -> %d;"
+                             " resyncing", self.group.index, self.generation,
+                             gen)
+                self.standby.reset()
+                self._buffer = b""
+                self.generation = gen
+                self.offset = 0
+            fh.seek(self.offset)
+            chunk = fh.read()
+        finally:
+            fh.close()
+        if not chunk:
+            return 0
+        lines, self._buffer = split_complete_lines(self._buffer + chunk)
+        if lines:
+            self.standby.absorb_lines(lines)
+        self.offset += len(chunk)
+        return len(chunk)
+
+    def drain(self) -> None:
+        """Final catch-up before promotion: the primary is dead (no more
+        appends — every acknowledged write was flushed before its caller
+        returned), so reading to EOF yields its exact final state."""
+        while self.sync_once():
+            pass
+
+
+class ShardGroup:
+    """One shard: primary + passive replicas + failover bookkeeping."""
+
+    def __init__(self, index: int, journal_path: str | None = None,
+                 replicas: int = 1, compact_every: int = 5000,
+                 store_kwargs: dict | None = None):
+        self.index = index
+        kw = dict(store_kwargs or {})
+        self.links: list[ShardReplicaLink] = []
+        if journal_path:
+            self.journal_path = f"{journal_path}.shard{index}"
+            self.primary: InMemoryTaskStore = FollowerTaskStore(
+                self.journal_path, start_as_primary=True,
+                compact_every=compact_every, **kw)
+            for j in range(replicas):
+                standby = FollowerTaskStore(
+                    f"{self.journal_path}.replica{j}",
+                    compact_every=compact_every, **kw)
+                self.links.append(ShardReplicaLink(self, standby))
+        else:
+            # Journal-less shards scale the keyspace but cannot fail over
+            # (nothing durable to promote from) — the same durability
+            # trade the unsharded in-memory store already makes.
+            self.journal_path = None
+            self.primary = InMemoryTaskStore(**kw)
+        self.active: InMemoryTaskStore = self.primary
+        self.dead = False
+        self._lock = threading.Lock()
+
+    @property
+    def epoch(self) -> int:
+        return getattr(self.active, "epoch", 0)
+
+    def mark_dead(self) -> None:
+        """SIGKILL semantics for the chaos harness: the primary's journal
+        handle closes and every subsequent mutation refuses with
+        ``StoreClosedError`` — no further writes are acknowledged, exactly
+        the window a real process kill leaves. The journal FILE survives
+        (it is the shard's durable truth) for the replica's final drain."""
+        self.active.close()
+        self.dead = True
+
+    def close(self) -> None:
+        self.active.close()
+        for link in self.links:
+            link.standby.close()
+
+
+class ShardedTaskStore:
+    """The facade the platform holds where it used to hold one store.
+
+    Same verb surface as ``InMemoryTaskStore`` (plus the HA extras the
+    assembly duck-types): per-TaskId verbs route by ring lookup with
+    bounded re-route on ``NotOwnerError`` (rebalance) and inline failover
+    promotion on ``StoreClosedError`` (shard primary death); aggregate
+    queries fan out; listeners and the publisher fan in/out through one
+    relay per shard."""
+
+    # Bounded re-route: one rebalance flip or one failover per attempt;
+    # anything needing more than this many is a real fault to surface.
+    _ROUTE_ATTEMPTS = 4
+
+    def __init__(self, shards: int, slots: int = 64,
+                 journal_path: str | None = None, replicas: int = 1,
+                 tail_interval: float = 0.25, feed_recent: int = 4096,
+                 compact_every: int = 5000, result_backend=None,
+                 result_offload_threshold: int | None = None):
+        self.ring = ShardRing(shards, slots=slots)
+        store_kwargs = dict(result_backend=result_backend,
+                            result_offload_threshold=result_offload_threshold)
+        self.groups = [
+            ShardGroup(i, journal_path=journal_path, replicas=replicas,
+                       compact_every=compact_every,
+                       store_kwargs=store_kwargs)
+            for i in range(shards)]
+        self.feeds = [ShardChangeFeed(i, recent=feed_recent)
+                      for i in range(shards)]
+        self.tail_interval = tail_interval
+        self._listeners: list[Callable[[APITask], None]] = []
+        self._publisher = None
+        self._rebalance_lock = threading.Lock()
+        self._tail_tasks: list[asyncio.Task] = []
+        self._tail_stop: asyncio.Event | None = None
+        for group in self.groups:
+            self._adopt(group.active, group.index)
+
+    # -- shard adoption (fence + publisher + listener relay) ---------------
+
+    def _adopt(self, store: InMemoryTaskStore, index: int) -> None:
+        """Wire one store in as shard ``index``'s active primary. The relay
+        is attached HERE — never to standbys, whose absorb-path
+        notifications would duplicate every event the primary already
+        relayed."""
+        store.set_write_fence(
+            lambda task_id, _i=index: self.ring.shard_for(task_id) == _i)
+        store.set_publisher(self._publish)
+        store.add_listener(
+            lambda task, _i=index: self._relay(task, _i))
+
+    def _publish(self, task: APITask) -> None:
+        if self._publisher is not None:
+            self._publisher(task)
+
+    def _relay(self, task: APITask, shard_index: int) -> None:
+        # Mirror StoreSideEffects._notify's isolation: one listener's
+        # failure must not starve the rest (or the feed).
+        for listener in self._listeners:
+            try:
+                listener(task)
+            except Exception:  # noqa: BLE001 — observers must not break the store
+                log.exception("sharded-store listener failed for %s",
+                              task.task_id)
+        try:
+            # Feed of the task's CURRENT ring owner, not the notifying
+            # shard: a watcher parks on feed_for(task_id), and a terminal
+            # transition applied by the old owner in the same instant a
+            # rebalance lands must reach the feed that watcher chose.
+            self.feeds[self.ring.shard_for(task.task_id)].publish(task)
+        except Exception:  # noqa: BLE001 — same isolation as above
+            log.exception("shard feed publish failed for %s", task.task_id)
+
+    # -- routing core -------------------------------------------------------
+
+    def shard_for(self, task_id: str) -> int:
+        """Owning shard index — also the broker's sub-queue router."""
+        return self.ring.shard_for(task_id)
+
+    def feed_for(self, task_id: str) -> ShardChangeFeed:
+        """The owning shard's change feed (gateway long-poll attaches
+        here — N feeds serve every watcher)."""
+        return self.feeds[self.ring.shard_for(task_id)]
+
+    def shard_stores(self) -> list[InMemoryTaskStore]:
+        """Active per-shard stores, for per-shard SCANS (the reaper). All
+        per-task ACTIONS must still route through the facade — a direct
+        write to a scanned store is exactly the stale-owner hazard the
+        fence exists to refuse."""
+        return [g.active for g in self.groups]
+
+    def _route(self, task_id: str, op):
+        """Run ``op(store)`` against the owning shard, re-routing across a
+        concurrent rebalance and promoting through a dead primary. Reads
+        are fenced too, by outcome rather than by lock: a miss (raise or
+        None) answered by a store the ring no longer points at may be the
+        handoff window — the moved range was forgotten there — so a miss
+        only stands when the answering store is STILL the owner."""
+        last: Exception | None = None
+        for _ in range(self._ROUTE_ATTEMPTS):
+            group = self.groups[self.ring.shard_for(task_id)]
+            if group.dead and not self._fail_over(group):
+                # No replica to promote: surface the dead shard loudly
+                # rather than serving from a corpse.
+                raise StoreClosedError(
+                    f"shard {group.index} primary is dead and has no "
+                    "promotable replica")
+            try:
+                result = op(group.active)
+            except NotOwnerError as exc:
+                # Rebalance flipped ownership between our ring lookup and
+                # the store's fence check; a fresh lookup finds the new
+                # owner (which imported the full range before the flip).
+                last = exc
+                continue
+            except TaskNotFound:
+                if self.groups[self.ring.shard_for(task_id)] is not group:
+                    # The slot moved while we were asking: the task was
+                    # forgotten HERE but lives on the new owner — a 404 to
+                    # the client would be a lie. Re-route.
+                    continue
+                raise
+            except (StoreClosedError, NotPrimaryError) as exc:
+                last = exc
+                if not self._fail_over(group):
+                    raise
+                continue
+            if (result is None
+                    and self.groups[self.ring.shard_for(task_id)]
+                    is not group):
+                # None-shaped miss (get_result/open_result, a conditional
+                # verb's refusal) from a store that lost the slot mid-call:
+                # the new owner holds the migrated state — ask it. The
+                # conditional verbs are safe to re-run: they re-check their
+                # condition against the migrated state.
+                continue
+            return result
+        raise StoreClosedError(
+            f"could not route task {task_id!r} after "
+            f"{self._ROUTE_ATTEMPTS} attempts") from last
+
+    # -- failover -----------------------------------------------------------
+
+    def _fail_over(self, group: ShardGroup) -> bool:
+        """Promote a replica over a dead shard primary. Returns True when
+        the group has a live active store on exit (this call promoted, or
+        another thread already had). Sequence mirrors the whole-store
+        watchdog: drain the durable journal tail first (zero loss — every
+        acknowledged write was flushed), promote (minting the fencing
+        epoch), and only then adopt + swap, so no write lands on the
+        standby before it holds the full state."""
+        with group._lock:
+            if not group.dead:
+                return True
+            if not group.links:
+                return False
+            link = group.links.pop(0)
+            standby = link.standby
+            try:
+                link.drain()
+            except Exception:  # noqa: BLE001 — promote anyway: the standby holds its last-absorbed state, and refusing leaves the shard with NO writer
+                log.exception(
+                    "shard %d: final journal drain failed; promoting the "
+                    "replica on its last absorbed state", group.index)
+            standby.promote()
+            self._adopt(standby, group.index)
+            group.primary = standby
+            # Remaining replicas (replicas > 1) must re-home onto the NEW
+            # primary's journal file and resync from its snapshot — their
+            # offsets into the dead primary's file mean nothing there.
+            group.journal_path = getattr(standby, "_journal_path",
+                                         group.journal_path)
+            for other in group.links:
+                other.generation = -1
+            group.active = standby
+            group.dead = False
+            log.warning(
+                "shard %d: primary dead; promoted replica at fencing "
+                "epoch %d", group.index, standby.epoch)
+            return True
+
+    # -- replication lifecycle ----------------------------------------------
+
+    async def start_replication(self) -> None:
+        """Start every replica's journal tail loop on the running loop."""
+        self._tail_stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for group in self.groups:
+            for link in group.links:
+                self._tail_tasks.append(
+                    loop.create_task(self._tail(link)))
+
+    async def _tail(self, link: ShardReplicaLink) -> None:
+        stop = self._tail_stop
+        while not stop.is_set():
+            try:
+                await asyncio.to_thread(link.sync_once)
+            except RuntimeError:
+                # absorb-after-promote / reset-after-promote: this standby
+                # was promoted out from under its tail loop — done.
+                return
+            except Exception:  # noqa: BLE001 — keep tailing through transient I/O errors
+                log.exception("shard %d replica tail failed; retrying",
+                              link.group.index)
+            try:
+                await asyncio.wait_for(stop.wait(), self.tail_interval)
+                return
+            except asyncio.TimeoutError:
+                continue
+
+    async def stop_replication(self) -> None:
+        if self._tail_stop is not None:
+            self._tail_stop.set()
+        for task in self._tail_tasks:
+            task.cancel()
+        for task in self._tail_tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001; ai4e: noqa[AIL005] — awaiting our own cancelled loops; the outcome is irrelevant at teardown
+                pass
+        self._tail_tasks = []
+
+    # -- live rebalance -----------------------------------------------------
+
+    def move_slot(self, slot: int, dest_index: int) -> int:
+        """Move one hash slot's keyspace range to ``dest_index`` under load;
+        returns tasks moved. Two phases:
+
+        1. **bulk copy** — export the range (brief source lock), import on
+           the destination; traffic keeps flowing to the source;
+        2. **atomic handoff** — under the SOURCE's store lock: export the
+           delta (records that changed since the copy — object identity,
+           every mutation replaces the record object), import it on the
+           destination (nested dest lock; the only place two shard locks
+           nest, always source→dest, so no cycle), flip the ring, and
+           forget the range on the source. The source's write fence checks
+           ownership under this same lock, so a concurrent write either
+           lands before the flip (and is exported in the delta) or is
+           refused after it and re-routed by the facade.
+        """
+        if not 0 <= slot < self.ring.slots:
+            raise ValueError(f"slot {slot} out of range")
+        with self._rebalance_lock:
+            src_index = self.ring.shard_of_slot(slot)
+            if src_index == dest_index:
+                return 0
+            # The whole move retries across a shard failover landing mid
+            # migration: phase 2 re-verifies (under the source lock) that
+            # the stores it snapshot are still the shards' active stores —
+            # a promotion swapped one out means the snapshot (or the
+            # import target) is a corpse's frozen state, and proceeding
+            # would flip the ring onto a copy missing the promoted
+            # store's writes.
+            last: Exception | None = None
+            for _attempt in range(3):
+                moved = self._try_move_slot(slot, src_index, dest_index)
+                if moved is not None:
+                    return moved
+                last = StoreClosedError(
+                    f"shard store swapped mid-rebalance of slot {slot}")
+            raise StoreClosedError(
+                f"rebalance of slot {slot} kept racing shard failovers"
+            ) from last
+
+    def _try_move_slot(self, slot: int, src_index: int,
+                       dest_index: int) -> int | None:
+        """One migration attempt; None = a failover swapped a store mid
+        copy and the caller should retry (the bulk copy is re-imported
+        idempotently over the stale one)."""
+        # Both ends must be live writers: a dead source would explode at
+        # the forget (after the copy), a dead destination at the import —
+        # fail over first, or refuse up front.
+        for group in (self.groups[src_index], self.groups[dest_index]):
+            if group.dead and not self._fail_over(group):
+                raise StoreClosedError(
+                    f"shard {group.index} primary is dead with no "
+                    "promotable replica; cannot rebalance")
+        src = self.groups[src_index].active
+        dest = self.groups[dest_index].active
+        # Phase 1: bulk copy. Snapshot record/result object identities
+        # for delta detection — every store mutation replaces the
+        # stored object, so `is` comparison is exact.
+        with src._lock:
+            ids1 = self._slot_ids(src, slot)
+            tasks1 = {tid: src._tasks[tid] for tid in ids1}
+            results1 = {}
+            for tid in ids1:
+                for key in src._result_keys.get(tid, ()):
+                    results1[key] = src._results.get(key)
+            recs1 = src.export_task_records(ids1)
+        try:
+            dest.import_task_records(recs1)
+        except (StoreClosedError, NotPrimaryError):
+            return None  # destination died mid-copy; retry fails it over
+        # Phase 2: atomic handoff under the source lock. Until the ring
+        # flips, the range transiently exists on BOTH shards (aggregate
+        # queries briefly double-count it — docs/sharding.md residual
+        # windows); a failure BEFORE the flip rolls the phase-1 copy
+        # back off the destination so nothing double-counts forever.
+        flipped = False
+        try:
+            with src._lock:
+                if (self.groups[src_index].active is not src
+                        or self.groups[dest_index].active is not dest
+                        or self.groups[src_index].dead
+                        or self.groups[dest_index].dead):
+                    # A promotion swapped a store between the phases.
+                    # ``close()`` serializes on the store lock, so once
+                    # this check passes the SOURCE cannot die before the
+                    # handoff completes; the stale phase-1 copy is either
+                    # on a corpse (dest swapped — irrelevant) or will be
+                    # re-imported from the promoted source on retry.
+                    return None
+                ids2 = self._slot_ids(src, slot)
+                delta_ids = [tid for tid in ids2
+                             if tasks1.get(tid) is not src._tasks[tid]]
+                delta = src.export_task_records(delta_ids)
+                delta_set = set(delta_ids)
+                for tid in ids2:
+                    if tid in delta_set:
+                        continue  # its results rode the full re-export
+                    for key in src._result_keys.get(tid, ()):
+                        cur = src._results.get(key)
+                        if (results1.get(key) is not cur
+                                and cur is not None):
+                            delta.append(src._result_record(
+                                key, cur[0], cur[1]))
+                dest.import_task_records(delta)
+                alive = set(ids2)
+                evicted_between = [tid for tid in ids1
+                                   if tid not in alive]
+                if evicted_between:
+                    # Evicted on the source AFTER the bulk copy (the
+                    # retention sweep): the destination must not keep
+                    # the phase-1 replica, or a task a client already
+                    # saw 404 would resurrect once the ring flips.
+                    dest.forget_tasks(evicted_between)
+                self.ring.assign(slot, dest_index)
+                flipped = True
+                src.forget_tasks(ids2)
+        except BaseException:
+            if not flipped:
+                # The ring never moved: undo the bulk copy or the
+                # destination keeps (and journals, and replays) an
+                # orphan replica of a range it does not own.
+                try:
+                    dest.forget_tasks(ids1)
+                except Exception:  # noqa: BLE001 — best-effort rollback; the raise below carries the real fault
+                    log.exception(
+                        "rebalance rollback of slot %d on shard %d "
+                        "failed; orphan copies may double-count until "
+                        "retention evicts them", slot, dest_index)
+            else:
+                # Flipped but the source cleanup failed: ownership is
+                # correct (fence blocks stale writes); the leftovers
+                # are garbage the terminal-retention sweep collects.
+                log.exception(
+                    "rebalance of slot %d: source forget failed after "
+                    "the flip; stale (fenced) copies remain on shard "
+                    "%d until retention evicts them", slot, src_index)
+            raise
+        # The moved range's future transitions publish to the DESTINATION
+        # feed now: stale terminal records in the source feed's replay map
+        # would outlive any redrive of these tasks (and answer a long-poll
+        # with the previous run's record if the slot ever moves back).
+        self.feeds[src_index].invalidate(set(ids1) | set(ids2))
+        moved = len(ids2)
+        log.info("rebalanced slot %d: shard %d -> %d (%d tasks, ring "
+                 "version %d)", slot, src_index, dest_index, moved,
+                 self.ring.version)
+        return moved
+
+    def _slot_ids(self, store: InMemoryTaskStore, slot: int) -> list[str]:
+        # Caller holds store._lock. O(shard's tasks); a per-slot index
+        # would make this O(range) — not needed at current scale
+        # (docs/sharding.md).
+        return [tid for tid in store._tasks
+                if self.ring.slot_for(tid) == slot]
+
+    # -- store verb surface (per-task: ring-routed) ------------------------
+
+    def upsert(self, task: APITask) -> APITask:
+        if not task.task_id:
+            # Mint here, not in the shard store: the id IS the routing key.
+            task.task_id = new_task_id()
+        return self._route(task.task_id, lambda s: s.upsert(task))
+
+    def update_status(self, task_id: str, status: str,
+                      backend_status: str | None = None) -> APITask:
+        return self._route(
+            task_id, lambda s: s.update_status(task_id, status,
+                                               backend_status))
+
+    def update_status_if(self, task_id: str, expected_status: str,
+                         status: str,
+                         backend_status: str | None = None) -> APITask | None:
+        return self._route(
+            task_id, lambda s: s.update_status_if(task_id, expected_status,
+                                                  status, backend_status))
+
+    def requeue_if(self, task_id: str, expected_status: str) -> APITask | None:
+        return self._route(
+            task_id, lambda s: s.requeue_if(task_id, expected_status))
+
+    def get(self, task_id: str) -> APITask:
+        return self._route(task_id, lambda s: s.get(task_id))
+
+    def get_original_body(self, task_id: str) -> bytes:
+        # The store's miss shape here is b"" (not a raise, not None) — map
+        # it to None so _route's ownership re-check applies: an empty
+        # answer from a store that just lost the slot must re-route to the
+        # owner holding the migrated OrigHex, not stand as "no body".
+        def op(store):
+            body = store.get_original_body(task_id)
+            return body if body else None
+
+        return self._route(task_id, op) or b""
+
+    def set_result(self, task_id: str, result: bytes,
+                   content_type: str = "application/json",
+                   stage: str | None = None) -> None:
+        return self._route(
+            task_id, lambda s: s.set_result(task_id, result,
+                                            content_type=content_type,
+                                            stage=stage))
+
+    def set_result_ref(self, task_id: str,
+                       content_type: str = "application/json",
+                       stage: str | None = None) -> None:
+        return self._route(
+            task_id, lambda s: s.set_result_ref(task_id,
+                                                content_type=content_type,
+                                                stage=stage))
+
+    def get_result(self, task_id: str,
+                   stage: str | None = None) -> tuple[bytes, str] | None:
+        return self._route(task_id,
+                           lambda s: s.get_result(task_id, stage=stage))
+
+    def open_result(self, task_id: str, stage: str | None = None):
+        return self._route(task_id,
+                           lambda s: s.open_result(task_id, stage=stage))
+
+    # -- side-effect plumbing ----------------------------------------------
+
+    def set_publisher(self, publisher) -> None:
+        self._publisher = publisher
+
+    def add_listener(self, listener: Callable[[APITask], None]) -> None:
+        self._listeners.append(listener)
+
+    # -- aggregate queries (fan-out) ---------------------------------------
+
+    def set_len(self, endpoint_path: str, status: str) -> int:
+        return sum(g.active.set_len(endpoint_path, status)
+                   for g in self.groups)
+
+    def set_members(self, endpoint_path: str, status: str) -> list[str]:
+        out: list[str] = []
+        for g in self.groups:
+            out.extend(g.active.set_members(endpoint_path, status))
+        return out
+
+    def endpoints(self) -> list[str]:
+        paths: set[str] = set()
+        for g in self.groups:
+            paths.update(g.active.endpoints())
+        return sorted(paths)
+
+    def depths(self) -> dict[str, dict[str, int]]:
+        out: dict[str, dict[str, int]] = {}
+        for g in self.groups:
+            for path, counts in g.active.depths().items():
+                agg = out.setdefault(path, {s: 0 for s in counts})
+                for status, n in counts.items():
+                    agg[status] = agg.get(status, 0) + n
+        return out
+
+    def snapshot(self) -> Iterable[APITask]:
+        out: list[APITask] = []
+        for g in self.groups:
+            out.extend(g.active.snapshot())
+        return out
+
+    def unfinished_tasks(self) -> list[APITask]:
+        out: list[APITask] = []
+        for g in self.groups:
+            out.extend(g.active.unfinished_tasks())
+        return out
+
+    def evict_terminal_older_than(self, age_s: float) -> int:
+        return sum(g.active.evict_terminal_older_than(age_s)
+                   for g in self.groups)
+
+    @property
+    def replayed_task_ids(self) -> set[str]:
+        """Union of journal-restored ids across shards — the platform's
+        restart re-seed reads this exactly as on the single store."""
+        out: set[str] = set()
+        for g in self.groups:
+            out.update(getattr(g.active, "replayed_task_ids", ()) or ())
+        return out
+
+    def compact(self) -> None:
+        for g in self.groups:
+            compact = getattr(g.active, "compact", None)
+            if compact is not None:
+                compact()
+
+    def close(self) -> None:
+        for g in self.groups:
+            g.close()
+
+    # -- chaos / introspection ----------------------------------------------
+
+    def kill_shard_primary(self, index: int) -> None:
+        """Chaos hook: SIGKILL shard ``index``'s primary (see
+        ``ShardGroup.mark_dead``). The next write routed there performs
+        the failover promotion inline."""
+        self.groups[index].mark_dead()
+
+    def topology(self) -> dict:
+        """Ring + per-shard role/epoch/feed state — the ``/v1/taskstore/
+        shards`` endpoint's body."""
+        return {
+            "shards": self.ring.shards,
+            "slots": self.ring.assignments(),
+            "version": self.ring.version,
+            "groups": [
+                {"shard": g.index,
+                 "epoch": g.epoch,
+                 "dead": g.dead,
+                 "replicas": len(g.links),
+                 "journal": g.journal_path,
+                 "feed_seq": self.feeds[g.index].seq,
+                 "watchers": self.feeds[g.index].watcher_count}
+                for g in self.groups],
+        }

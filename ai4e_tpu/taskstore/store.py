@@ -45,6 +45,22 @@ class NotPrimaryError(RuntimeError):
     writes (the HTTP surface maps this to 503 so store clients fail over)."""
 
 
+class StoreClosedError(RuntimeError):
+    """A mutation reached a closed store (shutdown, or a shard primary the
+    chaos harness SIGKILLed). RuntimeError subclass so pre-existing callers
+    that caught the old bare RuntimeError keep working; the sharded facade
+    keys its failover-promotion retry on this specific class."""
+
+
+class NotOwnerError(RuntimeError):
+    """A mutation reached a shard store for a TaskId the hash ring no longer
+    assigns to it — the caller raced a rebalance handoff and is the stale
+    owner (``taskstore/sharding.py``). Checked under the store lock, and the
+    ring flip happens under the OLD owner's lock, so a stale write can never
+    slip through the handoff window; the sharded facade re-routes via a
+    fresh ring lookup, direct holders of the old shard fail loudly."""
+
+
 class StaleEpochError(ValueError):
     """A demotion was attempted with an epoch no newer than the store's own
     — the caller is the stale side of the split, not this store (the HTTP
@@ -101,6 +117,16 @@ class InMemoryTaskStore(StoreSideEffects):
     functions.
     """
 
+    # True while applying already-accepted history verbatim (journal replay,
+    # follower absorb, rebalance import): input validation AND the shard
+    # write fence are both off — history must apply as-is.
+    _absorbing = False
+    # Closed stores refuse mutations (StoreClosedError); reads stay served.
+    # The journaled subclass additionally closes its journal handle; the
+    # base flag exists so journal-less shard primaries get SIGKILL
+    # semantics too (chaos ``ShardGroup.mark_dead``).
+    _closed = False
+
     def __init__(self, publisher: Publisher | None = None,
                  result_backend=None,
                  result_offload_threshold: int | None = None):
@@ -129,6 +155,12 @@ class InMemoryTaskStore(StoreSideEffects):
         # ordered + scored like the reference's Redis sorted sets.
         self._sets: dict[tuple[str, str], dict[str, float]] = {}
         self._publisher = publisher
+        # Shard ownership fence (``taskstore/sharding.py``): when set, every
+        # task/result mutation verifies — under this store's lock — that the
+        # hash ring still assigns the TaskId here; a stale owner raises
+        # NotOwnerError instead of applying an orphan write. None (the
+        # default, every unsharded deployment) is a no-op.
+        self._write_fence: Callable[[str], bool] | None = None
         # Change listeners (e.g. the gateway's long-poll waiters). Called
         # outside the lock, after every state transition, possibly from any
         # thread — listeners must be cheap and thread-safe
@@ -159,11 +191,14 @@ class InMemoryTaskStore(StoreSideEffects):
         pre-guard journal must never crash-loop ``__init__._replay`` or
         wedge a follower's absorb/retry loop at a fixed offset (ADVICE r5).
         """
-        if ":" in task.task_id and self._validates_task_ids():
-            raise ValueError(
-                f"TaskId must not contain ':' (reserved as the result "
-                f"stage separator): {task.task_id!r}")
         with self._lock:
+            # Validation decision UNDER the lock: ``_absorbing`` flips under
+            # it (rebalance import), and a pre-lock read could skip the
+            # guard for an unrelated external upsert racing an import.
+            if ":" in task.task_id and self._validates_task_ids():
+                raise ValueError(
+                    f"TaskId must not contain ':' (reserved as the result "
+                    f"stage separator): {task.task_id!r}")
             task = self._apply_upsert(task)
             publisher = self._publisher if task.publish else None
 
@@ -173,14 +208,40 @@ class InMemoryTaskStore(StoreSideEffects):
 
     def _validates_task_ids(self) -> bool:
         """Whether upsert enforces input validation — True on every external
-        write path; the journaled subclass turns it off while replaying or
-        absorbing history (records that were already accepted once must
-        apply verbatim, or a restart/follower can never catch up)."""
-        return True
+        write path; off while absorbing history (rebalance import here; the
+        journaled subclass additionally turns it off while replaying —
+        records that were already accepted once must apply verbatim, or a
+        restart/follower can never catch up)."""
+        return not self._absorbing
+
+    def set_write_fence(self, fence: Callable[[str], bool] | None) -> None:
+        """Install (or clear) the shard ownership fence — ``fence(task_id)``
+        must answer True iff this store currently owns the id. Called under
+        the store lock on every mutation, so it must be cheap and must not
+        take other locks (the ring lookup is arithmetic + a list read)."""
+        self._write_fence = fence
+
+    def _check_owner(self, task_id: str) -> None:
+        """Shard-fence gate for task/result mutations. Skipped while
+        absorbing (history applies verbatim — the rebalance import IS the
+        new owner receiving the range) and for empty ids (the id is minted
+        below, by a store that trivially owns a fresh GUID). Eviction is
+        deliberately NOT fenced: it is garbage collection — it can neither
+        resurrect nor clobber a task — and the migration's own post-flip
+        cleanup of the moved range runs as the (by then) non-owner."""
+        fence = self._write_fence
+        if fence is None or self._absorbing or not task_id:
+            return
+        if not fence(task_id):
+            raise NotOwnerError(
+                f"task {task_id} is no longer owned by this shard "
+                "(rebalance moved its hash slot); route via the ring")
 
     def _apply_upsert(self, task: APITask) -> APITask:
         """State mutation for upsert. Caller holds ``self._lock``; subclasses
         extend this to journal atomically with the mutation."""
+        self._check_open()
+        self._check_owner(task.task_id)
         prev = self._tasks.get(task.task_id)
         if prev is None:
             if not task.task_id:
@@ -221,7 +282,13 @@ class InMemoryTaskStore(StoreSideEffects):
                 # the stage's own input, not stage 1's.
                 self._orig_bodies[task.task_id] = (task.body, task.content_type)
             self._remove_from_set(prev)
-        task.timestamp = time.time()
+        if not (self._absorbing and task.timestamp):
+            # Live mutations stamp "now"; absorbed history (follower
+            # absorb, rebalance import) keeps the record's own timestamp so
+            # set scores and the reaper's age clock survive the handoff —
+            # and the journaled subclass's append then serializes the TRUE
+            # timestamp, so a restart of the absorbing store replays it.
+            task.timestamp = time.time()
         self._tasks[task.task_id] = task
         self._add_to_set(task)
         return task
@@ -241,6 +308,8 @@ class InMemoryTaskStore(StoreSideEffects):
         self, task_id: str, status: str, backend_status: str | None
     ) -> APITask:
         """State mutation for update. Caller holds ``self._lock``."""
+        self._check_open()
+        self._check_owner(task_id)
         prev = self._tasks.get(task_id)
         if prev is None:
             raise TaskNotFound(task_id)
@@ -392,6 +461,8 @@ class InMemoryTaskStore(StoreSideEffects):
                           content_type: str) -> None:
         """Result mutation (``result is None`` = offloaded pointer). Caller
         holds ``self._lock``; the journaled subclass extends this."""
+        self._check_open()
+        self._check_owner(key.split(":", 1)[0])
         prev = self._results.get(key)
         self._results[key] = (result, content_type)
         self._result_keys.setdefault(key.split(":", 1)[0], set()).add(key)
@@ -527,6 +598,153 @@ class InMemoryTaskStore(StoreSideEffects):
                 out.append(task)
             return out
 
+    # -- record shapes shared by the journal and the rebalance wire --------
+    # (defined here, not on the journaled subclass: the migration between
+    # shards uses the same full-record format whether or not the shard
+    # stores are journaled — docs/sharding.md)
+
+    def _full_record(self, task: APITask) -> dict:
+        """The journal's full (non-slim) record shape — one source of truth
+        for appends, compaction rewrites, and rebalance exports."""
+        rec = task.to_dict()
+        rec["BodyHex"] = task.body.hex()
+        orig = self._orig_bodies.get(task.task_id)
+        if orig is not None:
+            rec["OrigHex"] = orig[0].hex()
+            rec["OrigContentType"] = orig[1]
+        return rec
+
+    def _result_record(self, key: str, body: bytes | None,
+                       content_type: str) -> dict:
+        rec = {"Result": True, "Key": key, "ContentType": content_type}
+        if body is None:
+            # Offloaded: the payload is durable in the result backend; the
+            # journal carries only the pointer (no hex-doubling of large
+            # blobs — offload exists precisely to keep them out of memory
+            # and out of the journal).
+            rec["Offloaded"] = True
+        else:
+            rec["ResultHex"] = body.hex()
+        return rec
+
+    # -- rebalance handoff (``taskstore/sharding.py`` move_slot) -----------
+
+    def export_task_records(self, task_ids) -> list[dict]:
+        """Full journal-shaped records (task + original body + its results)
+        for the given ids — the rebalance wire format the new owner
+        ``import_task_records``s. Task records come first so import applies
+        them before their results, exactly like compaction/replay ordering.
+        Non-durable records (memory-only cache hits) are skipped: their
+        loss on a handoff has the same contract as their loss on a restart
+        (the TaskId 404s; the terminal answer was already served)."""
+        with self._lock:
+            recs: list[dict] = []
+            wanted = []
+            for tid in task_ids:
+                task = self._tasks.get(tid)
+                if task is None or not task.durable:
+                    continue
+                wanted.append(tid)
+                recs.append(self._full_record(task))
+            for tid in wanted:
+                for key in self._result_keys.get(tid, ()):
+                    found = self._results.get(key)
+                    if found is not None:
+                        recs.append(self._result_record(key, found[0],
+                                                        found[1]))
+            return recs
+
+    def import_task_records(self, recs: list[dict]) -> int:
+        """Absorb migrated history from another shard. Applied verbatim like
+        journal replay — no id validation, no publish, no listener
+        notification (every transition already notified on the exporting
+        shard; re-notifying here would be the duplicate-completion the
+        chaos invariants reject) — and, on a journaled store, appended to
+        the local journal so the imported range survives a restart of THIS
+        shard. Idempotent: re-importing a record overwrites with identical
+        state (the delta pass of ``move_slot`` relies on this)."""
+        applied = 0
+        with self._lock:
+            self._check_open()
+            prev_absorbing = self._absorbing
+            self._absorbing = True
+            # Defer auto-compaction past the import (journaled stores): the
+            # rebalance delta pass runs this while holding the SOURCE
+            # shard's lock, and an O(all tasks) compaction rewrite here
+            # would stall the source's entire keyspace for its duration.
+            # The next ordinary append — outside any foreign lock — picks
+            # the deferred compaction up.
+            prev_compact_at = getattr(self, "_next_compact_at", None)
+            if prev_compact_at is not None:
+                self._next_compact_at = float("inf")
+            try:
+                for rec in recs:
+                    if self._apply_import(rec):
+                        applied += 1
+            finally:
+                self._absorbing = prev_absorbing
+                if prev_compact_at is not None:
+                    self._next_compact_at = prev_compact_at
+        return applied
+
+    def _apply_import(self, rec: dict) -> bool:
+        """Apply ONE migrated record. Caller holds ``self._lock`` with
+        ``_absorbing`` set. Epoch markers are skipped — a fencing epoch is
+        the exporting shard's lineage, never the importer's."""
+        if "Epoch" in rec or rec.get("Evict") or rec.get("Slim"):
+            return False  # migration exports full state only
+        if rec.get("Result"):
+            body = (None if rec.get("Offloaded")
+                    else bytes.fromhex(rec.get("ResultHex", "")))
+            self._apply_set_result(rec["Key"], body,
+                                   rec.get("ContentType",
+                                           "application/json"))
+            return True
+        task = APITask.from_dict(rec)
+        task.body = bytes.fromhex(rec.get("BodyHex", ""))
+        # Never re-publish: the task's broker message (if any) already
+        # exists on the transport; the ring routes its status writes here.
+        task.publish = False
+        self._apply_upsert(task)  # _absorbing → timestamp preserved
+        orig = rec.get("OrigHex")
+        if orig:
+            self._orig_bodies[task.task_id] = (
+                bytes.fromhex(orig),
+                rec.get("OrigContentType", "application/json"))
+        return True
+
+    # True while forget_tasks drops a migrated range: the journaled
+    # subclass's Evict records then carry KeepBlobs, so neither this drop
+    # NOR a later replay of it deletes result blobs the importing shard's
+    # pointers now own (shards share one result backend). Only ever
+    # flipped under ``self._lock``.
+    _forgetting = False
+
+    def forget_tasks(self, task_ids) -> int:
+        """Drop the given tasks from this store entirely — the old owner's
+        post-flip cleanup after a rebalance export. Unlike eviction, the
+        offloaded result blobs are NOT deleted (see ``_forgetting``)."""
+        with self._lock:
+            dropped = 0
+            self._forgetting = True
+            try:
+                for tid in list(task_ids):
+                    if tid in self._tasks:
+                        self._apply_evict(tid)  # blob keys deliberately unused
+                        dropped += 1
+            finally:
+                self._forgetting = False
+            return dropped
+
+    def _check_open(self) -> None:
+        # Refuse BEFORE mutating (the journaled subclass shares this flag
+        # and additionally guards its journal handle).
+        if self._closed:
+            raise StoreClosedError("task store is closed")
+
+    def close(self) -> None:
+        self._closed = True
+
 
 class JournaledTaskStore(InMemoryTaskStore):
     """InMemoryTaskStore + append-only JSONL journal for crash recovery.
@@ -636,8 +854,13 @@ class JournaledTaskStore(InMemoryTaskStore):
             # append is a no-op — this just forgets the task. Blob
             # deletes re-run too: a crash between the Evict append
             # and the original deletes leaked them; replay cleans up.
-            for key in self._apply_evict(rec["TaskId"]):
-                self._delete_blob(key)
+            # EXCEPT KeepBlobs records (rebalance forget): those blobs
+            # belong to the shard that imported the range — deleting
+            # them here would dangle the new owner's pointers.
+            keys = self._apply_evict(rec["TaskId"])
+            if not rec.get("KeepBlobs"):
+                for key in keys:
+                    self._delete_blob(key)
             return
         if rec.get("Slim"):
             # Transition record: body/orig state is untouched (they
@@ -719,17 +942,6 @@ class JournaledTaskStore(InMemoryTaskStore):
                     "append-only journal")
             self._next_compact_at = self._records + self._compact_every
 
-    def _full_record(self, task: APITask) -> dict:
-        """The journal's full (non-slim) record shape — one source of truth
-        for appends and compaction rewrites."""
-        rec = task.to_dict()
-        rec["BodyHex"] = task.body.hex()
-        orig = self._orig_bodies.get(task.task_id)
-        if orig is not None:
-            rec["OrigHex"] = orig[0].hex()
-            rec["OrigContentType"] = orig[1]
-        return rec
-
     def _compact_locked(self) -> None:
         """Rewrite the journal as one full record per live task (+ one per
         result). Caller holds ``self._lock`` (or is still single-threaded in
@@ -795,19 +1007,6 @@ class JournaledTaskStore(InMemoryTaskStore):
         bloat denominator for the compaction heuristics."""
         return len(self._tasks) + len(self._results)
 
-    def _result_record(self, key: str, body: bytes | None,
-                       content_type: str) -> dict:
-        rec = {"Result": True, "Key": key, "ContentType": content_type}
-        if body is None:
-            # Offloaded: the payload is durable in the result backend; the
-            # journal carries only the pointer (no hex-doubling of large
-            # blobs — offload exists precisely to keep them out of memory
-            # and out of the journal).
-            rec["Offloaded"] = True
-        else:
-            rec["ResultHex"] = body.hex()
-        return rec
-
     def _apply_set_result(self, key: str, result: bytes | None,
                           content_type: str) -> None:
         # Journal the result so a completed task survives restart WITH its
@@ -831,7 +1030,13 @@ class JournaledTaskStore(InMemoryTaskStore):
         durable = self._tasks[task_id].durable
         blob_keys = super()._apply_evict(task_id)
         if durable:
-            self._append({"Evict": True, "TaskId": task_id})
+            rec = {"Evict": True, "TaskId": task_id}
+            if self._forgetting:
+                # Rebalance forget: the blobs moved WITH the range — a
+                # replay of this record must not delete the new owner's
+                # payloads out of the shared backend.
+                rec["KeepBlobs"] = True
+            self._append(rec)
         return blob_keys
 
     def _apply_upsert(self, task: APITask) -> APITask:
@@ -855,12 +1060,6 @@ class JournaledTaskStore(InMemoryTaskStore):
         # never re-validate it (ADVICE r5: a legacy ':' TaskId would
         # crash-loop replay / wedge absorb forever).
         return self._journal is not None and not self._absorbing
-
-    def _check_open(self) -> None:
-        # Refuse BEFORE mutating: a write after close() must not leave memory
-        # and journal divergent (reads stay available during shutdown).
-        if self._closed:
-            raise RuntimeError("task store is closed")
 
     def close(self) -> None:
         with self._lock:

@@ -586,7 +586,13 @@ def build_platform(args):
         # --resilience enables per-backend breakers + budget-bounded
         # retries (ai4e_tpu/resilience/) — the A/B lever for the
         # --fault-rate goodput-under-failure runs.
-        resilience=getattr(args, "resilience", False)))
+        resilience=getattr(args, "resilience", False),
+        # --task-shards N shards the task keyspace (taskstore/sharding.py,
+        # docs/sharding.md): N store shards + per-shard dispatcher
+        # sub-queues; the control-plane-headroom lever. Journal-less here
+        # (no per-append fsync): the run measures keyspace partitioning,
+        # not disk.
+        task_shards=getattr(args, "task_shards", 1)))
     runtime = ModelRuntime(donate_batch=args.donate_batch)
     batcher = MicroBatcher(runtime, max_wait_ms=args.max_wait_ms,
                            max_pending=args.concurrency * 4,
@@ -1091,10 +1097,40 @@ async def run_bench(args) -> dict:
 
         cache_mark: dict = {}
 
+        # --task-shards: per-shard goodput + long-poll watcher accounting.
+        # A facade listener counts terminal completions per shard; marks
+        # taken at window open subtract the ramp. Watchers are sampled off
+        # the shard feeds (every long-poller parks there) — the peak is
+        # the concurrent-watcher figure the feed fan-out design carries.
+        shards = getattr(args, "task_shards", 1) or 1
+        shard_counts: dict[int, int] = {}
+        shard_mark: dict[int, int] = {}
+        watcher_peak = [0]
+        if shards > 1:
+            from ai4e_tpu.taskstore import TaskStatus as _TS
+
+            def _count_terminal(task, _store=platform.store):
+                if task.canonical_status in _TS.TERMINAL:
+                    s = _store.shard_for(task.task_id)
+                    shard_counts[s] = shard_counts.get(s, 0) + 1
+
+            platform.store.add_listener(_count_terminal)
+
+            async def _sample_watchers():
+                while True:
+                    live = sum(f.watcher_count
+                               for f in platform.store.feeds)
+                    watcher_peak[0] = max(watcher_peak[0], live)
+                    await asyncio.sleep(0.25)
+
+            watcher_task = asyncio.get_running_loop().create_task(
+                _sample_watchers())
+
         async def _snap_cache_at_window_open():
             await asyncio.sleep(args.ramp)
             if cache is not None:
                 cache_mark.update(cache.stats())
+            shard_mark.update(shard_counts)
 
         # Admission-mix drivers (--deadline-ms / --priority-mix): each POST
         # carries its budget + class; completions score goodput.
@@ -1111,6 +1147,27 @@ async def run_bench(args) -> dict:
             ramp=args.ramp, post_url_for=post_url_for,
             headers_for=headers_for, deadline_s=deadline_s),
             _snap_cache_at_window_open())
+        if shards > 1:
+            watcher_task.cancel()
+
+    shard_meta = {}
+    if shards > 1:
+        elapsed = max(window["duration_s"], 1e-9)
+        per_shard = {}
+        for s in range(shards):
+            done = shard_counts.get(s, 0) - shard_mark.get(s, 0)
+            per_shard[str(s)] = {
+                "completed": int(done),
+                "goodput_req_s": round(done / elapsed, 2)}
+        shard_meta["shards"] = {
+            "task_shards": shards,
+            "slots": platform.store.ring.slots,
+            "per_shard": per_shard,
+            # Peak concurrent long-poll watchers parked on the N shard
+            # feeds during the run — the population that would otherwise
+            # be per-request store polls.
+            "longpoll_watchers_peak": int(watcher_peak[0]),
+        }
 
     fault_meta = {}
     if injector is not None:
@@ -1303,6 +1360,7 @@ async def run_bench(args) -> dict:
         **build_meta,
         **admission_meta,
         **cache_meta,
+        **shard_meta,
         **fault_meta,
         **batch_meta,
         **capability_meta,
@@ -1475,6 +1533,7 @@ def _forward_argv(args) -> list[str]:
             "--fault-rate", str(args.fault_rate),
             "--fault-seed", str(args.fault_seed),
             *(["--resilience"] if args.resilience else []),
+            "--task-shards", str(args.task_shards),
             "--deadline-ms", str(args.deadline_ms),
             *(["--priority-mix", args.priority_mix]
               if args.priority_mix else []),
@@ -1608,6 +1667,12 @@ def main() -> None:
                              "health-aware picks, budget-bounded retries "
                              "with failover, 5xx-as-transient redelivery "
                              "(docs/resilience.md)")
+    parser.add_argument("--task-shards", type=int, default=1,
+                        help="shard the task keyspace over N store shards "
+                             "with per-shard dispatcher sub-queues "
+                             "(docs/sharding.md); the result JSON gains a "
+                             "'shards' block with per-shard goodput and "
+                             "the peak long-poll watcher count")
     parser.add_argument("--priority-mix", default="",
                         help="weighted X-Priority draw per request, e.g. "
                              "'interactive:6,default:3,background:1' — "

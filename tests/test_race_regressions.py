@@ -845,3 +845,159 @@ class TestRemoteStoreResidualWindow:
 
         report = explore_interleavings(make, schedules=40, seed=SEED)
         assert report.ok, report.describe()
+
+
+# ---------------------------------------------------------------------------
+# PR 6: sharded-store critical sections (docs/sharding.md)
+# ---------------------------------------------------------------------------
+
+
+class TestRebalanceHandoffRace:
+    """The rebalance handoff's stale-owner window: a writer resolves the
+    ring, suspends (the hop), and the slot moves before its write lands.
+    The store-side ownership fence (``NotOwnerError``, checked under the
+    old owner's lock — the same lock the ring flip holds) refuses the
+    stale write and the ring re-route lands it on the new owner; with the
+    fence disabled, the exact same schedules resurrect the task on the
+    old owner — a divergent orphan copy no client read would ever see
+    updated again."""
+
+    @staticmethod
+    def _scenario(fenced: bool):
+        from ai4e_tpu.taskstore import NotOwnerError
+        from ai4e_tpu.taskstore.sharding import ShardedTaskStore
+
+        def make():
+            store = ShardedTaskStore(2, slots=8)
+            if not fenced:
+                for g in store.groups:  # the pre-fence world, verbatim
+                    g.active.set_write_fence(None)
+            store.upsert(APITask(task_id="t-race", endpoint="/v1/q/op",
+                                 body=b"b", publish=False))
+            slot = store.ring.slot_for("t-race")
+            src = store.ring.shard_of_slot(slot)
+            dest = 1 - src
+
+            async def stale_writer():
+                # Remote-client shape: resolve the owner, hop, write — the
+                # requeue/AWAITING upsert every transport cold path makes.
+                owner = store.groups[store.ring.shard_for("t-race")].active
+                await yield_point()  # the hop the flip can slot into
+                retry = APITask(task_id="t-race", endpoint="/v1/q/op",
+                                body=b"", status=AWAITING_STATUS,
+                                backend_status=TaskStatus.CREATED,
+                                publish=False)
+                try:
+                    owner.upsert(retry)
+                except NotOwnerError:
+                    # Fenced: re-route via a fresh ring lookup (what the
+                    # facade's _route loop does).
+                    store.upsert(retry)
+
+            async def mover():
+                await yield_point()
+                store.move_slot(slot, dest)
+
+            def check():
+                src_store = store.groups[src].active
+                dest_store = store.groups[dest].active
+                assert "t-race" not in src_store._tasks, (
+                    "stale-owner write resurrected the task on the old "
+                    "owner after the handoff")
+                assert dest_store.get("t-race").status == AWAITING_STATUS
+
+            return [stale_writer(), mover()], check
+
+        return make
+
+    def test_fenced_handoff_race_free(self):
+        report = explore_interleavings(self._scenario(fenced=True),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_unfenced_replica_caught(self):
+        report = explore_interleavings(self._scenario(fenced=False),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert not report.ok, (
+            "the stale-owner window was not reachable without the fence — "
+            "either move_slot stopped forgetting the range or the "
+            "scenario no longer models the handoff")
+
+
+class TestFeedAttachRace:
+    """The change feed's attach window: a watcher reads a non-terminal
+    status, suspends, and the terminal event fires before it attaches.
+    ``wait_terminal`` checks the bounded replay map and registers the
+    waiter under ONE lock, so the event is either replayed at attach or
+    delivered to the future — a replica without the replay check misses
+    the wakeup on exactly those schedules and waits out its (virtual)
+    timeout."""
+
+    @staticmethod
+    def _scenario(feed_cls):
+        from ai4e_tpu.taskstore.sharding import ShardedTaskStore
+
+        def make():
+            store = ShardedTaskStore(2, slots=8)
+            feed = feed_cls(0)
+            store.feeds = [feed, feed]  # both shards relay into one feed
+            store.upsert(APITask(task_id="t-watch", endpoint="/v1/q/op",
+                                 body=b"b", publish=False))
+            results = []
+
+            async def watcher():
+                # The gateway's long-poll shape: read, then attach.
+                record = store.get("t-watch")
+                if record.canonical_status in TaskStatus.TERMINAL:
+                    results.append(record)  # answered without waiting
+                    return
+                await yield_point()  # the window the event can fire in
+                results.append(await feed.wait_terminal("t-watch", 30.0))
+
+            async def completer():
+                await yield_point()
+                store.update_status("t-watch", "completed",
+                                    TaskStatus.COMPLETED)
+
+            def check():
+                assert results and results[0] is not None, (
+                    "watcher missed the terminal wakeup")
+                assert results[0].canonical_status == "completed"
+
+            return [watcher(), completer()], check
+
+        return make
+
+    def test_feed_attach_race_free(self):
+        from ai4e_tpu.taskstore.feed import ShardChangeFeed
+        report = explore_interleavings(self._scenario(ShardChangeFeed),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_replay_free_replica_caught(self):
+        from ai4e_tpu.taskstore.feed import ShardChangeFeed
+
+        class NoReplayFeed(ShardChangeFeed):
+            """wait_terminal WITHOUT the replay-map consult — the naive
+            register-then-wait a per-request listener would write."""
+
+            async def wait_terminal(self, task_id, timeout):
+                import asyncio as _asyncio
+                loop = _asyncio.get_running_loop()
+                fut = loop.create_future()
+                entry = (loop, fut)
+                with self._lock:  # registers, never checks _recent
+                    self._waiters[task_id] = self._waiters.get(
+                        task_id, frozenset()) | {entry}
+                try:
+                    return await _asyncio.wait_for(fut, timeout)
+                except _asyncio.TimeoutError:
+                    return None
+                finally:
+                    self._drop_waiter(task_id, entry)
+
+        report = explore_interleavings(self._scenario(NoReplayFeed),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert not report.ok, (
+            "the attach-vs-event window was not reachable without the "
+            "replay map — the scenario no longer models the race")

@@ -1,0 +1,226 @@
+"""Sharded-store chaos scenarios (ISSUE 6 acceptance; docs/sharding.md):
+
+(a) **shard-primary kill** — ``task_shards=4`` under seeded 20% injected
+    backend faults, SIGKILL one shard primary mid-traffic: the failover
+    promotes a replica within the fencing epoch (epoch+1, journaled),
+    every accepted task reaches a terminal status, zero lost, zero
+    duplicate client-visible completions — per shard AND globally — and
+    the other three shards never fail over (their keyspace is untouched);
+
+(b) **live rebalance under load** — a hash slot's keyspace range moves
+    between shards while traffic flows and the same seeded faults fire:
+    the per-shard invariant checker passes, and the moved range
+    specifically shows every task terminal exactly once, owned by the
+    destination, forgotten by the source.
+
+Both replay on the fixed ``AI4E_CHAOS_SEED`` CI pins (chaos-smoke job).
+"""
+
+import asyncio
+import os
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.chaos import (FaultInjector, InvariantChecker,
+                            kill_shard_primary, rebalance_slot,
+                            wrap_platform_http)
+from ai4e_tpu.metrics import MetricsRegistry
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.taskstore import TaskStatus
+
+SEED = int(os.environ.get("AI4E_CHAOS_SEED", "20260803"))
+SHARDS = 4
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def _sharded_platform(tmp_path):
+    return LocalPlatform(PlatformConfig(
+        task_shards=SHARDS,
+        journal_path=str(tmp_path / "journal"),
+        shard_tail_interval=0.02,
+        resilience=True,
+        retry_delay=0.01,
+        lease_seconds=2.0,
+        resilience_retry_base_s=0.001,
+        resilience_failure_threshold=3,
+        resilience_recovery_seconds=0.1,
+    ), metrics=MetricsRegistry())
+
+
+def _completing_backend(platform):
+    """Worker completing idempotently through the FACADE — its status
+    writes ring-route, so it exercises inline failover and the rebalance
+    fence exactly like a real worker talking to the control plane."""
+    async def handler(request):
+        tid = request.headers["taskId"]
+        platform.store.update_status_if(
+            tid, "created", f"completed - {len(await request.read())}b",
+            TaskStatus.COMPLETED)
+        return web.Response(text="ok")
+
+    app = web.Application()
+    app.router.add_post("/v1/be/x", handler)
+    return app
+
+
+async def _drain(checker, deadline_s=30.0):
+    deadline = asyncio.get_running_loop().time() + deadline_s
+    while asyncio.get_running_loop().time() < deadline:
+        if all(tid in checker.terminal for tid in checker.accepted):
+            return
+        await asyncio.sleep(0.05)
+
+
+@pytest.mark.chaos
+class TestShardPrimaryKill:
+    def test_kill_one_shard_primary_mid_traffic_invariants_hold(
+            self, tmp_path):
+        async def main():
+            platform = _sharded_platform(tmp_path)
+            checker = InvariantChecker(
+                shard_of=platform.store.shard_for).attach(platform.store)
+            be = await serve(_completing_backend(platform))
+            platform.publish_async_api("/v1/pub/x",
+                                       str(be.make_url("/v1/be/x")))
+            injector = FaultInjector(seed=SEED)
+            injector.add_rule(error_rate=0.2, error_status=500,
+                              drop_rate=0.05)
+            wrap_platform_http(platform, injector)
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                async def accept(n):
+                    for _ in range(n):
+                        resp = await gw.post("/v1/pub/x", data=b"payload")
+                        assert resp.status == 200
+                        checker.note_accepted((await resp.json())["TaskId"])
+
+                await accept(20)
+
+                # SIGKILL the shard owning the first accepted task, mid
+                # traffic: its journal handle closes this instant; nothing
+                # half-applies.
+                victim = platform.store.shard_for(
+                    sorted(checker.accepted)[0])
+                pre_epoch = platform.store.groups[victim].epoch
+                kill_shard_primary(platform, victim)
+
+                # Traffic continues through the outage: tasks hashing to
+                # the dead shard trigger the inline failover promotion;
+                # the other shards never notice.
+                await accept(15)
+                await _drain(checker)
+
+                # Failover promoted WITHIN the fencing epoch: exactly one
+                # mint above everything the corpse ever journaled.
+                assert platform.store.groups[victim].epoch == pre_epoch + 1
+                # The other shards' keyspace was untouched — no failover,
+                # no epoch movement.
+                for i in range(SHARDS):
+                    if i != victim:
+                        assert platform.store.groups[i].epoch == 0
+
+                # Global + per-shard: every accepted task terminal, zero
+                # lost, zero duplicate client-visible completions.
+                checker.assert_ok()
+                for i in range(SHARDS):
+                    checker.assert_shard_ok(i)
+                per_shard = checker.by_shard()
+                assert sum(s["accepted"] for s in per_shard.values()) == 35
+                for shard, stats in sorted(per_shard.items()):
+                    assert stats["terminal"] == stats["accepted"], (
+                        shard, stats)
+                    assert stats["duplicates"] == 0, (shard, stats)
+                # The injector actually fired in this run.
+                assert injector.counts().get("error", 0) > 0
+            finally:
+                await platform.stop()
+                await gw.close()
+                await be.close()
+
+        run(main())
+
+
+@pytest.mark.chaos
+class TestRebalanceUnderLoad:
+    def test_live_slot_move_under_seeded_faults_invariants_hold(
+            self, tmp_path):
+        async def main():
+            platform = _sharded_platform(tmp_path)
+            checker = InvariantChecker(
+                shard_of=platform.store.shard_for).attach(platform.store)
+            be = await serve(_completing_backend(platform))
+            platform.publish_async_api("/v1/pub/x",
+                                       str(be.make_url("/v1/be/x")))
+            injector = FaultInjector(seed=SEED)
+            injector.add_rule(error_rate=0.2, error_status=500,
+                              drop_rate=0.05)
+            wrap_platform_http(platform, injector)
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                stop_traffic = asyncio.Event()
+
+                async def traffic():
+                    while not stop_traffic.is_set():
+                        resp = await gw.post("/v1/pub/x", data=b"payload")
+                        assert resp.status == 200
+                        checker.note_accepted(
+                            (await resp.json())["TaskId"])
+                        await asyncio.sleep(0.002)
+
+                driver = asyncio.get_running_loop().create_task(traffic())
+                while len(checker.accepted) < 15:
+                    await asyncio.sleep(0.01)
+
+                # Move the slot of an accepted (ideally in-flight) task
+                # while the driver keeps hammering the gateway.
+                store = platform.store
+                target = next(iter(checker.accepted))
+                slot = store.ring.slot_for(target)
+                src = store.ring.shard_of_slot(slot)
+                dest = (src + 1) % SHARDS
+                moved_range = [tid for tid in checker.accepted
+                               if store.ring.slot_for(tid) == slot]
+                moved = rebalance_slot(platform, slot, dest)
+                assert store.ring.shard_of_slot(slot) == dest
+                assert store.ring.version == 1
+
+                while len(checker.accepted) < 30:
+                    await asyncio.sleep(0.01)
+                stop_traffic.set()
+                await driver
+                await _drain(checker)
+
+                checker.assert_ok()
+                for i in range(SHARDS):
+                    checker.assert_shard_ok(i)
+                # The moved range specifically: terminal exactly once,
+                # owned by the destination, forgotten by the source.
+                assert checker.violations(moved_range) == []
+                for tid in moved_range:
+                    assert store.shard_for(tid) == dest
+                    assert tid not in store.groups[src].active._tasks
+                    assert store.get(tid).canonical_status in \
+                        TaskStatus.TERMINAL
+                # The move actually carried keyspace (the target task was
+                # resident on the source when the slot flipped).
+                assert moved >= 1
+                assert injector.counts().get("error", 0) > 0
+            finally:
+                await platform.stop()
+                await gw.close()
+                await be.close()
+
+        run(main())

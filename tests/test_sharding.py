@@ -1,0 +1,759 @@
+"""Sharded task store (``ai4e_tpu/taskstore/sharding.py``, docs/sharding.md):
+ring determinism and slot moves; the facade's ring-routed verb surface with
+listener fan-in and publisher fan-out; per-shard epoch-fenced failover
+(SIGKILL → replica drain → promote); live rebalance with the atomic
+handoff + stale-owner write fence (``NotOwnerError``); the per-shard
+change feed's no-missed-wakeup contract; the reaper's per-shard scan and
+shard-ownership filter; config/assembly wiring (``task_shards=1`` builds
+the exact pre-shard store types); and the ``/v1/taskstore/shards``
+topology surface."""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.metrics import MetricsRegistry
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.taskstore import (APITask, InMemoryTaskStore, NotOwnerError,
+                                StoreClosedError, TaskNotFound, TaskStatus)
+from ai4e_tpu.taskstore.feed import ShardChangeFeed
+from ai4e_tpu.taskstore.reaper import TaskReaper
+from ai4e_tpu.taskstore.sharding import (ShardedTaskStore, ShardRing,
+                                         stable_hash)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_sharded(tmp_path=None, shards=4, replicas=1, **kw):
+    journal = str(tmp_path / "journal") if tmp_path is not None else None
+    return ShardedTaskStore(shards, journal_path=journal,
+                            replicas=replicas if journal else 0, **kw)
+
+
+def accept(store, n=20, endpoint="/v1/x/op", body=b"payload"):
+    return [store.upsert(APITask(endpoint=endpoint, body=body,
+                                 publish=True)).task_id
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Ring
+# ---------------------------------------------------------------------------
+
+class TestShardRing:
+    def test_stable_hash_is_process_independent(self):
+        # Pinned digests: ownership must agree across control-plane
+        # processes (Python's salted hash() would not).
+        assert stable_hash("abc") == stable_hash("abc")
+        assert stable_hash("") != stable_hash("a")
+        ring = ShardRing(4, slots=64)
+        slots = [ring.slot_for(f"task-{i}") for i in range(100)]
+        assert slots == [ring.slot_for(f"task-{i}") for i in range(100)]
+        assert len(set(ring.shard_for(f"task-{i}") for i in range(100))) == 4
+
+    def test_assign_moves_only_that_slot(self):
+        ring = ShardRing(4, slots=64)
+        before = ring.assignments()
+        slot = ring.slot_for("some-task")
+        src = ring.shard_of_slot(slot)
+        dest = (src + 1) % 4
+        ring.assign(slot, dest)
+        after = ring.assignments()
+        assert after[slot] == dest
+        assert [a for i, a in enumerate(after) if i != slot] == \
+               [a for i, a in enumerate(before) if i != slot]
+        assert ring.version == 1
+
+    def test_bounds(self):
+        with pytest.raises(ValueError):
+            ShardRing(0)
+        with pytest.raises(ValueError):
+            ShardRing(8, slots=4)
+        ring = ShardRing(2, slots=8)
+        with pytest.raises(ValueError):
+            ring.assign(0, 5)
+
+
+# ---------------------------------------------------------------------------
+# Facade routing + side effects
+# ---------------------------------------------------------------------------
+
+class TestFacade:
+    def test_crud_routes_by_ring_and_side_effects_fan_in(self):
+        store = make_sharded()
+        events, published = [], []
+        store.add_listener(lambda t: events.append(
+            (t.task_id, t.canonical_status)))
+        store.set_publisher(published.append)
+        ids = accept(store, 20)
+        assert len(published) == 20
+        # Tasks actually spread over the shards, each stored on its owner.
+        owners = {store.shard_for(tid) for tid in ids}
+        assert len(owners) > 1
+        for tid in ids:
+            shard = store.groups[store.shard_for(tid)].active
+            assert shard.get(tid).task_id == tid
+        for tid in ids[:5]:
+            store.update_status(tid, "completed - ok", TaskStatus.COMPLETED)
+            store.set_result(tid, b"RES", "text/plain")
+            assert store.get(tid).canonical_status == "completed"
+            assert store.get_result(tid) == (b"RES", "text/plain")
+        # One event per transition, no duplicates from the fan-in.
+        assert len([e for e in events if e[1] == "completed"]) == 5
+        assert store.set_len("/v1/x/op", TaskStatus.CREATED) == 15
+        assert store.endpoints() == ["/v1/x/op"]
+        assert len(list(store.snapshot())) == 20
+        assert len(store.unfinished_tasks()) == 15
+        depths = store.depths()["/v1/x/op"]
+        assert depths["created"] == 15 and depths["completed"] == 5
+
+    def test_conditional_verbs_and_original_body_replay(self):
+        store = make_sharded()
+        [tid] = accept(store, 1)
+        assert store.update_status_if(tid, "running", "x") is None
+        store.update_status(tid, "completed", TaskStatus.COMPLETED)
+        # requeue replays the original body through the facade's routing.
+        requeued = store.requeue_if(tid, "completed")
+        assert requeued is not None and requeued.body == b"payload"
+        assert store.get_original_body(tid) == b"payload"
+
+    def test_upsert_mints_id_before_routing(self):
+        store = make_sharded()
+        task = store.upsert(APITask(endpoint="/v1/x"))
+        assert task.task_id
+        assert store.get(task.task_id).task_id == task.task_id
+
+
+# ---------------------------------------------------------------------------
+# Failover: SIGKILL one shard primary → replica drains + promotes
+# ---------------------------------------------------------------------------
+
+class TestShardFailover:
+    def test_kill_then_write_promotes_replica_with_zero_loss(self, tmp_path):
+        store = make_sharded(tmp_path)
+        ids = accept(store, 30)
+        done = [tid for tid in ids[:10]]
+        for tid in done:
+            store.update_status(tid, "completed", TaskStatus.COMPLETED)
+            store.set_result(tid, b"R", "text/plain")
+        victim = store.shard_for(ids[10])
+        pre_epoch = store.groups[victim].epoch
+        store.kill_shard_primary(victim)
+        # Next write routed to the dead shard promotes inline, within the
+        # fencing epoch (strictly newer than anything the corpse wrote).
+        task = store.update_status(ids[10], "completed", TaskStatus.COMPLETED)
+        assert task.canonical_status == "completed"
+        assert store.groups[victim].epoch == pre_epoch + 1
+        # Every pre-kill record of that shard survived — acknowledged
+        # writes were journaled+flushed, the promotion drained them.
+        for tid in ids:
+            if store.shard_for(tid) != victim:
+                continue
+            record = store.get(tid)
+            if tid in done:
+                assert record.canonical_status == "completed"
+                assert store.get_result(tid) == (b"R", "text/plain")
+        # Other shards never noticed.
+        for tid in ids:
+            if store.shard_for(tid) != victim:
+                assert store.get(tid) is not None
+        store.close()
+
+    def test_dead_shard_without_replica_fails_loudly(self):
+        store = make_sharded()  # journal-less → no replicas
+        [tid] = accept(store, 1)
+        store.kill_shard_primary(store.shard_for(tid))
+        with pytest.raises(StoreClosedError):
+            store.update_status(tid, "completed", TaskStatus.COMPLETED)
+
+    def test_failover_preserves_listener_and_publisher_wiring(self, tmp_path):
+        store = make_sharded(tmp_path)
+        events, published = [], []
+        store.add_listener(lambda t: events.append(t.canonical_status))
+        store.set_publisher(published.append)
+        ids = accept(store, 8)
+        victim = store.shard_for(ids[0])
+        store.kill_shard_primary(victim)
+        store.update_status(ids[0], "completed", TaskStatus.COMPLETED)
+        assert events.count("completed") == 1
+        # A republish through the promoted store still reaches the broker.
+        n_pub = len(published)
+        assert store.requeue_if(ids[0], "completed") is not None
+        assert len(published) == n_pub + 1
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# Rebalance: live slot move + the stale-owner fence
+# ---------------------------------------------------------------------------
+
+class TestRebalance:
+    def _store_and_victim(self, tmp_path=None):
+        store = make_sharded(tmp_path)
+        ids = accept(store, 30)
+        tid = ids[0]
+        slot = store.ring.slot_for(tid)
+        src = store.ring.shard_of_slot(slot)
+        dest = (src + 1) % store.ring.shards
+        return store, ids, tid, slot, src, dest
+
+    def test_move_slot_migrates_records_results_and_bodies(self, tmp_path):
+        store, ids, tid, slot, src, dest = self._store_and_victim(tmp_path)
+        store.update_status(tid, "running", TaskStatus.RUNNING)
+        store.set_result(tid, b"partial", "text/plain", stage="s1")
+        moved = store.move_slot(slot, dest)
+        assert moved >= 1
+        assert store.ring.shard_of_slot(slot) == dest
+        assert store.shard_for(tid) == dest
+        # Record, stage result, and original body all followed the range.
+        assert store.get(tid).canonical_status == "running"
+        assert store.get_result(tid, stage="s1") == (b"partial",
+                                                     "text/plain")
+        assert store.get_original_body(tid) == b"payload"
+        # The old owner forgot the range entirely.
+        with pytest.raises(TaskNotFound):
+            store.groups[src].active.get(tid)
+        # Facade writes land on the new owner.
+        store.update_status(tid, "completed", TaskStatus.COMPLETED)
+        assert store.groups[dest].active.get(tid).canonical_status \
+            == "completed"
+        store.close()
+
+    def test_stale_owner_write_is_fenced(self):
+        store, ids, tid, slot, src, dest = self._store_and_victim()
+        old_owner = store.groups[src].active
+        store.move_slot(slot, dest)
+        # An upsert through a direct reference to the old owner (the
+        # stale-owner hazard: it would silently RECREATE the task there)
+        # refuses under the old owner's own lock.
+        with pytest.raises(NotOwnerError):
+            old_owner.upsert(APITask(task_id=tid, endpoint="/v1/x/op",
+                                     body=b"zz"))
+        # A stale result write cannot land either: the record is gone from
+        # the old owner (forget ran under the same lock as the flip).
+        with pytest.raises(TaskNotFound):
+            old_owner.set_result(tid, b"stale")
+
+    def test_move_slot_survives_restart_of_new_owner(self, tmp_path):
+        # The import journals on the destination: a restart of the new
+        # owner replays the migrated range.
+        store, ids, tid, slot, src, dest = self._store_and_victim(tmp_path)
+        ts_before = store.get(tid).timestamp
+        store.move_slot(slot, dest)
+        from ai4e_tpu.taskstore import FollowerTaskStore
+        restarted = FollowerTaskStore(store.groups[dest].journal_path,
+                                      start_as_primary=True)
+        try:
+            restored = restarted.get(tid)
+            assert restored.task_id == tid
+            # Migrated history keeps the record's own timestamp — the
+            # reaper's age clock must not reset on a handoff.
+            assert restored.timestamp == pytest.approx(ts_before)
+        finally:
+            restarted.close()
+            store.close()
+
+    def test_source_restart_replay_keeps_the_moved_ranges_blobs(
+            self, tmp_path):
+        # Offloaded result blobs move OWNERSHIP with the range (shards
+        # share one backend). The source journals its forget as
+        # KeepBlobs: neither the forget itself nor a later restart
+        # REPLAY of the source's journal may delete the destination's
+        # payloads — without the marker, replaying the Evict record
+        # dangles every moved pointer.
+        from ai4e_tpu.taskstore import FollowerTaskStore
+        from ai4e_tpu.taskstore.results import FileResultBackend
+        backend = FileResultBackend(str(tmp_path / "blobs"))
+        store = make_sharded(tmp_path, result_backend=backend,
+                             result_offload_threshold=1)
+        [tid] = accept(store, 1)
+        store.set_result(tid, b"BLOBBY", "text/plain")  # offloaded (>=1B)
+        slot = store.ring.slot_for(tid)
+        src = store.ring.shard_of_slot(slot)
+        dest = (src + 1) % store.ring.shards
+        src_path = store.groups[src].journal_path
+        store.move_slot(slot, dest)
+        assert store.get_result(tid) == (b"BLOBBY", "text/plain")
+        store.groups[src].active.close()
+        # The source restarts and replays its journal (which now carries
+        # the range's full records AND the KeepBlobs forget).
+        replayed = FollowerTaskStore(src_path, start_as_primary=True,
+                                     result_backend=backend,
+                                     result_offload_threshold=1)
+        try:
+            with pytest.raises(TaskNotFound):
+                replayed.get(tid)  # the range stays forgotten
+            # ...and the destination's blob survived the replay.
+            assert store.get_result(tid) == (b"BLOBBY", "text/plain")
+        finally:
+            replayed.close()
+            store.close()
+
+    def test_nondurable_records_do_not_migrate(self):
+        store = make_sharded()
+        task = store.upsert(APITask(endpoint="/v1/x",
+                                    status="completed - served from cache",
+                                    backend_status=TaskStatus.COMPLETED,
+                                    durable=False))
+        slot = store.ring.slot_for(task.task_id)
+        src = store.ring.shard_of_slot(slot)
+        store.move_slot(slot, (src + 1) % 4)
+        # Same contract as a restart: the memory-only record is gone.
+        with pytest.raises(TaskNotFound):
+            store.get(task.task_id)
+
+    def test_read_rerouted_when_ownership_flips_mid_call(self):
+        # A GET that resolved the ring to the source and then lost the
+        # race to a concurrent move_slot must NOT surface the source's
+        # TaskNotFound (the task is alive on the destination) — the
+        # facade re-checks ownership on any miss and re-routes.
+        store, ids, tid, slot, src, dest = self._store_and_victim()
+        src_store = store.groups[src].active
+        real_get = src_store.get
+        fired = []
+
+        def racing_get(task_id):
+            if not fired:
+                fired.append(1)
+                store.move_slot(slot, dest)  # the flip lands mid-read
+            return real_get(task_id)
+
+        src_store.get = racing_get
+        try:
+            assert store.get(tid).task_id == tid
+        finally:
+            src_store.get = real_get
+
+    def test_result_miss_rerouted_when_ownership_flips_mid_call(self):
+        # Same window for the None-shaped misses: a stale owner's "no
+        # result" must not stand when the result migrated.
+        store, ids, tid, slot, src, dest = self._store_and_victim()
+        store.set_result(tid, b"R", "text/plain")
+        src_store = store.groups[src].active
+        real_get_result = src_store.get_result
+        fired = []
+
+        def racing_get_result(task_id, stage=None):
+            if not fired:
+                fired.append(1)
+                store.move_slot(slot, dest)
+            return real_get_result(task_id, stage=stage)
+
+        src_store.get_result = racing_get_result
+        try:
+            assert store.get_result(tid) == (b"R", "text/plain")
+        finally:
+            src_store.get_result = real_get_result
+
+    def test_original_body_miss_rerouted_when_ownership_flips_mid_call(self):
+        # get_original_body's miss shape is b"" — the facade must treat an
+        # empty answer from a just-deposed owner as a re-route, not as
+        # "this task has no body" (the replay payload migrated).
+        store, ids, tid, slot, src, dest = self._store_and_victim()
+        src_store = store.groups[src].active
+        real = src_store.get_original_body
+        fired = []
+
+        def racing(task_id):
+            if not fired:
+                fired.append(1)
+                store.move_slot(slot, dest)
+            return real(task_id)
+
+        src_store.get_original_body = racing
+        try:
+            assert store.get_original_body(tid) == b"payload"
+        finally:
+            src_store.get_original_body = real
+
+    def test_task_evicted_between_phases_does_not_resurrect(self, tmp_path):
+        # Phase 1 copies a terminal task; the source's retention sweep
+        # evicts it before phase 2. The destination must drop its phase-1
+        # replica — a client that saw 404 must not see 200 again after
+        # the flip.
+        store, ids, tid, slot, src, dest = self._store_and_victim(tmp_path)
+        store.update_status(tid, "completed", TaskStatus.COMPLETED)
+        src_store = store.groups[src].active
+        real_export = src_store.export_task_records
+        fired = []
+
+        def racing_export(task_ids):
+            recs = real_export(task_ids)
+            if not fired and any(
+                    r.get("TaskId") == tid for r in recs):
+                fired.append(1)
+                # The retention sweep lands between the bulk copy and the
+                # handoff (phase 2 re-exports under the lock — only the
+                # FIRST export is the race window).
+                src_store.evict_terminal_older_than(-1.0)
+            return recs
+
+        src_store.export_task_records = racing_export
+        try:
+            store.move_slot(slot, dest)
+        finally:
+            src_store.export_task_records = real_export
+        with pytest.raises(TaskNotFound):
+            store.get(tid)
+        assert tid not in store.groups[dest].active._tasks
+        store.close()
+
+    def test_failover_mid_move_keeps_the_promoted_stores_writes(
+            self, tmp_path):
+        # The source primary dies DURING the bulk copy and a routed write
+        # lands on the promoted replica. The handoff must not flip the
+        # ring onto the corpse's frozen snapshot: phase 2 detects the
+        # swap (store identity re-check under the source lock) and the
+        # retry migrates the promoted store's state — the post-kill
+        # completion included.
+        store, ids, tid, slot, src, dest = self._store_and_victim(tmp_path)
+        src_store = store.groups[src].active
+        real_export = src_store.export_task_records
+        fired = []
+
+        def racing_export(task_ids):
+            recs = real_export(task_ids)
+            if not fired:
+                fired.append(1)
+                store.kill_shard_primary(src)
+                # Routed write → inline failover → lands on the replica.
+                store.update_status(tid, "completed - after kill",
+                                    TaskStatus.COMPLETED)
+            return recs
+
+        src_store.export_task_records = racing_export
+        try:
+            assert store.move_slot(slot, dest) >= 1
+        finally:
+            src_store.export_task_records = real_export
+        assert store.shard_for(tid) == dest
+        assert store.get(tid).status == "completed - after kill"
+        store.close()
+
+    def test_round_trip_move_does_not_replay_a_stale_terminal(self):
+        # Complete on A, move A→B, redrive (B's feed invalidates ITS
+        # entry), move back B→A: A's feed must not answer the next
+        # long-poll with the first run's terminal record — the handoff
+        # invalidates the source feed's replay entries for the range.
+        store = make_sharded()
+        [tid] = accept(store, 1)
+        store.update_status(tid, "completed - run 1", TaskStatus.COMPLETED)
+        slot = store.ring.slot_for(tid)
+        a = store.ring.shard_of_slot(slot)
+        b = (a + 1) % store.ring.shards
+        assert store.feeds[a].recent_terminal(tid) is not None
+        store.move_slot(slot, b)
+        assert store.feeds[a].recent_terminal(tid) is None
+        assert store.requeue_if(tid, "completed") is not None  # run 2
+        store.move_slot(slot, a)
+        async def wait():
+            return await store.feed_for(tid).wait_terminal(tid, 0.05)
+        assert run(wait()) is None  # run 2 still in flight: no stale answer
+
+    def test_replay_map_does_not_pin_request_bodies(self):
+        store = make_sharded()
+        task = store.upsert(APITask(endpoint="/v1/x/op",
+                                    body=b"x" * 4096, publish=False))
+        store.update_status(task.task_id, "completed", TaskStatus.COMPLETED)
+        record = store.feed_for(task.task_id).recent_terminal(task.task_id)
+        assert record is not None and record.body == b""
+        # ...while the wire shape watchers receive is untouched (to_dict
+        # never carried the body).
+        assert "Body" not in record.to_dict()
+
+    def test_move_to_self_is_a_noop(self):
+        store = make_sharded()
+        [tid] = accept(store, 1)
+        slot = store.ring.slot_for(tid)
+        assert store.move_slot(slot, store.ring.shard_of_slot(slot)) == 0
+        assert store.ring.version == 0
+
+
+# ---------------------------------------------------------------------------
+# Change feed
+# ---------------------------------------------------------------------------
+
+class TestChangeFeed:
+    def test_wake_carries_the_record(self):
+        async def main():
+            feed = ShardChangeFeed(0)
+            task = APITask(task_id="t1", endpoint="/v1/x")
+
+            async def completer():
+                await asyncio.sleep(0.01)
+                feed.publish(task.with_status("completed",
+                                              TaskStatus.COMPLETED))
+
+            waiter = asyncio.create_task(feed.wait_terminal("t1", 5.0))
+            await completer()
+            record = await waiter
+            assert record is not None
+            assert record.canonical_status == "completed"
+            assert feed.watcher_count == 0
+
+        run(main())
+
+    def test_event_before_attach_is_replayed(self):
+        async def main():
+            feed = ShardChangeFeed(0)
+            task = APITask(task_id="t1", endpoint="/v1/x")
+            feed.publish(task.with_status("failed - x", TaskStatus.FAILED))
+            # Attach AFTER the event: the replay map answers immediately.
+            record = await feed.wait_terminal("t1", 0.01)
+            assert record is not None and record.canonical_status == "failed"
+
+        run(main())
+
+    def test_non_terminal_events_ignored_and_timeout_returns_none(self):
+        async def main():
+            feed = ShardChangeFeed(0)
+            feed.publish(APITask(task_id="t1", endpoint="/v1/x",
+                                 status="running",
+                                 backend_status="running"))
+            assert await feed.wait_terminal("t1", 0.01) is None
+            assert feed.watcher_count == 0
+
+        run(main())
+
+    def test_replay_window_is_bounded(self):
+        feed = ShardChangeFeed(0, recent=4)
+        for i in range(8):
+            feed.publish(APITask(task_id=f"t{i}", endpoint="/v1/x",
+                                 status="completed",
+                                 backend_status="completed"))
+        assert feed.recent_terminal("t0") is None
+        assert feed.recent_terminal("t7") is not None
+        assert feed.seq == 8
+
+    def test_recreated_task_invalidates_the_replay_entry(self):
+        # A terminal task re-entering the lifecycle (redrive/requeue/
+        # re-submission) must not let the next long-poll answer instantly
+        # with the PREVIOUS run's terminal record.
+        store = make_sharded()
+        [tid] = accept(store, 1)
+        store.update_status(tid, "completed - run 1", TaskStatus.COMPLETED)
+        feed = store.feed_for(tid)
+        assert feed.recent_terminal(tid) is not None
+        assert store.requeue_if(tid, "completed") is not None  # run 2
+        assert feed.recent_terminal(tid) is None  # replay invalidated
+
+        async def second_run():
+            async def completer():
+                await asyncio.sleep(0.01)
+                store.update_status(tid, "completed - run 2",
+                                    TaskStatus.COMPLETED)
+
+            waiter = asyncio.create_task(feed.wait_terminal(tid, 5.0))
+            await completer()
+            record = await waiter
+            assert record is not None and record.status == "completed - run 2"
+
+        run(second_run())
+
+    def test_facade_routes_terminal_events_to_the_owning_feed(self):
+        store = make_sharded()
+        [tid] = accept(store, 1)
+        store.update_status(tid, "completed", TaskStatus.COMPLETED)
+        assert store.feed_for(tid).recent_terminal(tid) is not None
+        other = store.feeds[(store.shard_for(tid) + 1) % 4]
+        assert other.recent_terminal(tid) is None
+
+
+# ---------------------------------------------------------------------------
+# Reaper: per-shard scan + ownership filter (the satellite fix)
+# ---------------------------------------------------------------------------
+
+class TestShardedReaper:
+    def test_scan_is_per_shard_and_rescue_routes_through_the_ring(self):
+        async def main():
+            store = make_sharded()
+            published = []
+            store.set_publisher(published.append)
+            ids = accept(store, 12)
+            for tid in ids:
+                store.update_status(tid, "running", TaskStatus.RUNNING)
+            # Age them past the timeout.
+            for g in store.groups:
+                for task in g.active.snapshot():
+                    task.timestamp -= 100.0
+            reaper = TaskReaper(store, running_timeout=1.0,
+                                metrics=MetricsRegistry())
+            published.clear()
+            acted = await reaper.sweep()
+            assert acted == 12
+            assert len(published) == 12  # every rescue republished
+            for tid in ids:
+                assert store.get(tid).canonical_status == "created"
+
+        run(main())
+
+    def test_per_shard_reaper_skips_tasks_its_shard_no_longer_owns(self):
+        async def main():
+            store = make_sharded()
+            [tid] = accept(store, 1)
+            store.update_status(tid, "running", TaskStatus.RUNNING)
+            for g in store.groups:
+                for task in g.active.snapshot():
+                    task.timestamp -= 100.0
+            src = store.shard_for(tid)
+            # A per-shard reaper owns exactly its shard's slice of the ring.
+            reaper = TaskReaper(
+                store, running_timeout=1.0,
+                owns=lambda t, _s=src: store.shard_for(t) == _s,
+                metrics=MetricsRegistry())
+            # The range moves away AFTER the reaper exists (scan snapshot
+            # vs rescue window): the rescue must be skipped, not applied
+            # by the stale owner.
+            store.move_slot(store.ring.slot_for(tid),
+                            (src + 1) % store.ring.shards)
+            acted = await reaper.sweep()
+            assert acted == 0
+            assert store.get(tid).canonical_status == "running"
+            # The NEW owner's reaper picks it up.
+            new_reaper = TaskReaper(store, running_timeout=1.0,
+                                    metrics=MetricsRegistry())
+            assert await new_reaper.sweep() == 1
+            assert store.get(tid).canonical_status == "created"
+
+        run(main())
+
+    def test_direct_stale_owner_rescue_is_fenced_by_the_store(self):
+        # Even a reaper that bypasses the ownership filter and acts on the
+        # old shard store directly cannot land the write: after forget the
+        # conditional verbs see no task (None), and a blind re-create hits
+        # the fence. This is the structural backstop of the satellite fix.
+        store = make_sharded()
+        [tid] = accept(store, 1)
+        store.update_status(tid, "running", TaskStatus.RUNNING)
+        src = store.shard_for(tid)
+        old_owner = store.groups[src].active
+        store.move_slot(store.ring.slot_for(tid),
+                        (src + 1) % store.ring.shards)
+        assert old_owner.requeue_if(tid, TaskStatus.RUNNING) is None
+        with pytest.raises(NotOwnerError):
+            old_owner.upsert(APITask(task_id=tid, endpoint="/v1/x/op",
+                                     body=b""))
+
+
+# ---------------------------------------------------------------------------
+# Assembly + config wiring
+# ---------------------------------------------------------------------------
+
+class TestAssembly:
+    def test_default_task_shards_1_builds_the_unsharded_store(self):
+        platform = LocalPlatform(PlatformConfig(),
+                                 metrics=MetricsRegistry())
+        assert isinstance(platform.store, InMemoryTaskStore)
+        assert not isinstance(platform.store, ShardedTaskStore)
+        assert platform.broker._shard_router is None
+
+    def test_sharded_assembly_refuses_native_and_ha_combos(self):
+        with pytest.raises(ValueError, match="native"):
+            LocalPlatform(PlatformConfig(task_shards=2, native_store=True),
+                          metrics=MetricsRegistry())
+        with pytest.raises(ValueError, match="replicate_from"):
+            LocalPlatform(PlatformConfig(task_shards=2,
+                                         replicate_from="http://p"),
+                          metrics=MetricsRegistry())
+
+    def test_config_env_knobs(self):
+        from ai4e_tpu.config import PlatformSection
+        section = PlatformSection.from_env(env={
+            "AI4E_PLATFORM_TASK_SHARDS": "4",
+            "AI4E_PLATFORM_TASK_SHARD_SLOTS": "128",
+            "AI4E_PLATFORM_TASK_SHARD_REPLICAS": "2",
+            "AI4E_PLATFORM_SHARD_TAIL_INTERVAL": "0.05",
+            "AI4E_PLATFORM_SHARD_FEED_RECENT": "512",
+        })
+        pc = section.to_platform_config()
+        assert (pc.task_shards, pc.task_shard_slots,
+                pc.task_shard_replicas) == (4, 128, 2)
+        assert pc.shard_tail_interval == 0.05
+        assert pc.shard_feed_recent == 512
+
+    def test_sharded_platform_e2e_with_long_poll(self, tmp_path):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(
+                task_shards=4, journal_path=str(tmp_path / "j"),
+                retry_delay=0.01, lease_seconds=2.0,
+            ), metrics=MetricsRegistry())
+
+            async def handler(request):
+                tid = request.headers["taskId"]
+                platform.store.update_status_if(
+                    tid, "created", "completed - ok", TaskStatus.COMPLETED)
+                return web.Response(text="ok")
+
+            app = web.Application()
+            app.router.add_post("/v1/be/x", handler)
+            be = TestClient(TestServer(app))
+            await be.start_server()
+            platform.publish_async_api("/v1/pub/x",
+                                       str(be.make_url("/v1/be/x")))
+            gw = TestClient(TestServer(platform.gateway.app))
+            await gw.start_server()
+            await platform.start()
+            try:
+                # One dispatcher per shard sub-queue.
+                assert sorted(platform.dispatchers.dispatchers) == [
+                    f"/v1/be/x#s{i}" for i in range(4)]
+                tids = []
+                for _ in range(12):
+                    resp = await gw.post("/v1/pub/x", data=b"hello")
+                    assert resp.status == 200
+                    tids.append((await resp.json())["TaskId"])
+                for tid in tids:
+                    resp = await gw.get(
+                        f"/v1/taskmanagement/task/{tid}?wait=10")
+                    body = await resp.json()
+                    assert body["Status"].startswith("completed"), body
+                # Shard topology surface rides the control plane.
+                from ai4e_tpu.taskstore.http import make_app
+                ts = TestClient(TestServer(make_app(platform.store)))
+                await ts.start_server()
+                resp = await ts.get("/v1/taskstore/shards")
+                topo = await resp.json()
+                assert topo["shards"] == 4
+                assert len(topo["slots"]) == 64
+                assert [g["shard"] for g in topo["groups"]] == [0, 1, 2, 3]
+                await ts.close()
+            finally:
+                await platform.stop()
+                await gw.close()
+                await be.close()
+
+        run(main())
+
+    def test_replicas_absorb_while_primary_serves(self, tmp_path):
+        async def main():
+            store = make_sharded(tmp_path, tail_interval=0.02)
+            await store.start_replication()
+            try:
+                ids = accept(store, 16)
+                for tid in ids[:8]:
+                    store.update_status(tid, "completed",
+                                        TaskStatus.COMPLETED)
+                deadline = asyncio.get_running_loop().time() + 5.0
+                want = {store.shard_for(t) for t in ids}
+                while asyncio.get_running_loop().time() < deadline:
+                    caught_up = all(
+                        len(g.links[0].standby._tasks) == len(
+                            g.active._tasks)
+                        for g in store.groups if g.index in want)
+                    if caught_up:
+                        break
+                    await asyncio.sleep(0.02)
+                for g in store.groups:
+                    if g.index not in want:
+                        continue
+                    assert len(g.links[0].standby._tasks) == \
+                        len(g.active._tasks)
+            finally:
+                await store.stop_replication()
+                store.close()
+
+        run(main())
