@@ -1,7 +1,7 @@
 # Contributor conveniences. Each target reproduces the matching CI job
 # with the SAME flags (the scripts are the single source of truth).
 
-.PHONY: lint test race-smoke
+.PHONY: lint test race-smoke chaos
 
 # Both lint gates CI runs (ruff correctness rules + ai4e-lint, see
 # scripts/lint.sh and docs/analysis.md).
@@ -18,3 +18,11 @@ test:
 race-smoke:
 	python -m pytest tests/test_race_explorer.py \
 	  tests/test_race_regressions.py -q -m race -p no:cacheprovider
+
+# The seeded chaos scenarios with CI's pinned seed (chaos-smoke job) —
+# until now the seed + file selection lived only in the workflow YAML,
+# so "reproduce the red chaos check locally" meant reading CI config.
+chaos:
+	AI4E_CHAOS_SEED=20260803 JAX_PLATFORMS=cpu python -m pytest \
+	  tests/test_chaos.py tests/test_shard_chaos.py \
+	  tests/test_orchestration_chaos.py -q -m chaos -p no:cacheprovider

@@ -243,6 +243,22 @@ class AdmissionController:
         self.shedder = shedder or PriorityShedder()
         self._scopes: dict[str, AdmissionScope] = {}
         self._drain = DecayingRate(tau_s=drain_tau_s)
+        self._arrivals = DecayingRate(tau_s=drain_tau_s)
+        self._tau_s = drain_tau_s
+        # Per-route arrival/drain estimators (keyed by endpoint path,
+        # populated lazily by the store listener): the predictive
+        # autoscaler scales ONE route's dispatchers, so it must read
+        # THAT route's imbalance — the platform-global rates above would
+        # attribute a flooded route's growth to every idle route's
+        # scaler (bounded: one pair per registered endpoint).
+        self._route_arrivals: dict[str, DecayingRate] = {}
+        self._route_drains: dict[str, DecayingRate] = {}
+        # Degradation ladder (orchestration/ladder.py); None → no brownout
+        # modes, the pre-orchestration shedder behavior untouched. Set via
+        # set_ladder (the platform assembly wires it) and consulted
+        # FIRST on every admission decision — a declared brownout
+        # outranks per-request occupancy math.
+        self._ladder = None
         self._shed_total = self.metrics.counter(
             "ai4e_admission_shed_total",
             "Requests refused under pressure, by hop/priority")
@@ -257,6 +273,10 @@ class AdmissionController:
         self._drain_gauge = self.metrics.gauge(
             "ai4e_admission_drain_rate",
             "Estimated terminal transitions per second")
+        self._arrival_gauge = self.metrics.gauge(
+            "ai4e_admission_arrival_rate",
+            "Estimated task creations per second (predictive-scaling "
+            "numerator beside the drain rate)")
 
     # -- scopes ------------------------------------------------------------
 
@@ -298,6 +318,52 @@ class AdmissionController:
         ``drain_retry_after`` policy)."""
         return drain_retry_after(excess, self.drain_rate())
 
+    def arrival_rate(self, route: str | None = None) -> float:
+        """Decayed task-creation rate — paired with ``drain_rate`` this is
+        the queue-growth projection the predictive autoscaler acts on
+        (``scaling.predictive_signal``). ``route`` (an endpoint path)
+        narrows to that route's own estimator; None is the platform-wide
+        rate (and updates the gauge)."""
+        if route is not None:
+            est = self._route_arrivals.get(route)
+            return est.rate() if est is not None else 0.0
+        rate = self._arrivals.rate()
+        self._arrival_gauge.set(rate)
+        return rate
+
+    def route_drain_rate(self, route: str) -> float:
+        """One route's decayed terminal-transition rate (the per-route
+        counterpart of ``drain_rate``, which stays platform-wide — it
+        feeds Retry-After, a whole-platform statement)."""
+        est = self._route_drains.get(route)
+        return est.rate() if est is not None else 0.0
+
+    def _route_rate(self, table: dict, route: str) -> DecayingRate:
+        est = table.get(route)
+        if est is None:
+            est = table[route] = DecayingRate(tau_s=self._tau_s)
+        return est
+
+    # -- degradation ladder (orchestration) --------------------------------
+
+    def set_ladder(self, ladder) -> None:
+        """Attach (or clear with None) the degradation ladder: admission
+        decisions consult it first, and the store listener feeds it
+        actual deadline outcomes (docs/orchestration.md)."""
+        self._ladder = ladder
+
+    def brownout_refusal(self, priority: int) -> tuple[float, str] | None:
+        """``(retry_after_s, mode)`` when the ladder refuses this class
+        right now, else None. The sync proxy calls this beside
+        ``try_acquire``; the async edge gets the same consult inside
+        ``shed_async``."""
+        if self._ladder is None:
+            return None
+        mode = self._ladder.refuse(priority)
+        if mode is None:
+            return None
+        return self.retry_after_s(), mode
+
     # -- async-edge admission ----------------------------------------------
 
     def shed_async(self, priority: int, backlog: int,
@@ -306,7 +372,10 @@ class AdmissionController:
         """Edge decision for the async task-creation path: None to admit,
         else ``(retry_after_s, why)``.
 
-        Two tests, cheapest first:
+        Three tests, cheapest first:
+        - brownout — a declared ladder mode refusing this class outranks
+          any per-request math (the ladder already saw sustained
+          predicted-miss pressure);
         - class pressure — the backlog (created-set depth for the route)
           against this class's share of ``max_backlog``, lowest priority
           refused first (the shedder's fractions);
@@ -315,6 +384,9 @@ class AdmissionController:
           the task would expire in the queue; refusing NOW costs the
           client one cheap 429 instead of a full transport round trip
           ending in an expired record."""
+        brown = self.brownout_refusal(priority)
+        if brown is not None:
+            return brown[0], "brownout"
         retry_after = self.shedder.check(priority, backlog, self.max_backlog,
                                          drain_rate=self.drain_rate())
         if retry_after is not None:
@@ -334,16 +406,35 @@ class AdmissionController:
         estimator, and completed tasks score goodput by whether they beat
         their deadline (``no_deadline`` kept separate so the ratio stays
         meaningful for deadline-carrying traffic)."""
-        from ..taskstore import TaskStatus
+        from ..taskstore import TaskStatus, endpoint_path
 
         def on_task_change(task) -> None:
             status = task.canonical_status
             if status not in TaskStatus.TERMINAL:
+                if task.status == TaskStatus.CREATED:
+                    # The RAW "created" status is stamped exactly once, at
+                    # creation (requeues/backpressure rewrites carry
+                    # provenance prose) — the arrival-rate event for the
+                    # predictive scaler, platform-wide and per route. The
+                    # gauge updates HERE: production readers use the
+                    # per-route form of arrival_rate, which must not be
+                    # the only thing keeping the platform-wide gauge live.
+                    self._arrivals.on_event()
+                    self._arrival_gauge.set(self._arrivals.rate())
+                    self._route_rate(self._route_arrivals,
+                                     endpoint_path(task.endpoint)).on_event()
                 return
             self.on_drain_event()
-            if status != TaskStatus.COMPLETED:
-                return
+            self._route_rate(self._route_drains,
+                             endpoint_path(task.endpoint)).on_event()
             deadline_at = getattr(task, "deadline_at", 0.0)
+            if status != TaskStatus.COMPLETED:
+                if (self._ladder is not None and deadline_at
+                        and status == TaskStatus.EXPIRED):
+                    # Shed on its deadline somewhere downstream — actual
+                    # miss evidence for the brownout ladder.
+                    self._ladder.note(miss=True)
+                return
             if not deadline_at:
                 outcome = "no_deadline"
             elif time.time() <= deadline_at:
@@ -351,5 +442,7 @@ class AdmissionController:
             else:
                 outcome = "late"
             self._goodput_total.inc(outcome=outcome)
+            if self._ladder is not None and deadline_at:
+                self._ladder.note(miss=(outcome == "late"))
 
         store.add_listener(on_task_change)

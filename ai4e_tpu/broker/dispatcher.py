@@ -85,6 +85,7 @@ class Dispatcher:
         result_store=None,
         admission=None,
         resilience=None,
+        orchestration=None,
     ):
         self.broker = broker
         self.queue_name = queue_name
@@ -120,6 +121,13 @@ class Dispatcher:
         # PACING is jittered-exponential either way — _redelivery_delay;
         # retry_delay is its base/first step, no longer a constant.)
         self.resilience = resilience
+        # Orchestrator (orchestration/): when set (requires resilience —
+        # the assembly enforces it), each delivery's backend is the
+        # cheapest one predicted to finish within the message's remaining
+        # deadline budget instead of a health-weighted random pick, and
+        # delivered-POST RTTs feed the per-backend completion estimator.
+        # None (default) keeps the resilience pick byte for byte.
+        self.orchestration = orchestration
         self._retry_budget = (resilience.new_budget()
                               if resilience is not None else None)
         self.backends = normalize_backends(backend_uri)
@@ -266,11 +274,17 @@ class Dispatcher:
                     exclude: tuple | list = ()) -> tuple[str, str]:
         """Dispatch target: a *registered* backend URI (fresh host — a
         journal-restored task may carry a stale one; weighted pick across a
-        canary set, health-aware under resilience) with the task endpoint's
-        operation tail and query grafted on (``rebase_endpoint``). Returns
-        ``(base, target)`` — the base is the health-model key for outcome
-        recording."""
-        if self.resilience is not None:
+        canary set, health-aware under resilience, deadline/cost-aware
+        under orchestration) with the task endpoint's operation tail and
+        query grafted on (``rebase_endpoint``). Returns ``(base, target)``
+        — the base is the health-model key for outcome recording."""
+        if self.orchestration is not None:
+            base = self.orchestration.place(
+                self.backends,
+                deadline_at=getattr(msg, "deadline_at", 0.0),
+                priority=getattr(msg, "priority", 1),
+                rng=self._rng, exclude=exclude)
+        elif self.resilience is not None:
             base = self.resilience.pick(self.backends, self._rng,
                                         exclude=exclude)
         else:
@@ -329,6 +343,11 @@ class Dispatcher:
             backend = urlparse(target).netloc
             session = await self._sessions.get()
             t0 = _time.perf_counter()
+            if self.orchestration is not None:
+                # Queue-pressure input for the completion estimator; the
+                # finally below releases it on EVERY exit of this attempt
+                # (success, failure, retry-continue, cancellation).
+                self.orchestration.begin(base)
             try:
                 # One span per delivery attempt, keyed by TaskId; the
                 # injected x-b3 headers parent the backend's endpoint span
@@ -378,12 +397,20 @@ class Dispatcher:
                             target, exc)
                 await self._backpressure(msg, backend=backend)
                 return
+            finally:
+                if self.orchestration is not None:
+                    self.orchestration.end(base)
 
             self._record_outcome(base, status=status)
             if 200 <= status < 300:
                 self.broker.complete(msg)
                 self._dispatched.inc(outcome="delivered",
                                      queue=self.queue_name, backend=backend)
+                if self.orchestration is not None:
+                    # Delivered round trip feeds the per-backend completion
+                    # estimator (the placement's service-time evidence).
+                    self.orchestration.observe(base,
+                                               _time.perf_counter() - t0)
                 if self.admission is not None:
                     # Delivered-POST RTT feeds the per-queue limiter: when
                     # the worker's event loop congests, these round trips
@@ -641,7 +668,8 @@ class DispatcherPool:
     def __init__(self, broker: InMemoryBroker, task_manager: TaskManagerBase,
                  retry_delay: float = 60.0, concurrency: int = 1,
                  result_cache=None, result_store=None, admission=None,
-                 resilience=None, metrics: MetricsRegistry | None = None):
+                 resilience=None, orchestration=None,
+                 metrics: MetricsRegistry | None = None):
         self.broker = broker
         self.task_manager = task_manager
         self.retry_delay = retry_delay
@@ -650,6 +678,7 @@ class DispatcherPool:
         self.result_store = result_store
         self.admission = admission
         self.resilience = resilience
+        self.orchestration = orchestration
         # Registry the registered dispatchers count into — the assembly's
         # own, so a custom-registry platform's /metrics carries
         # ai4e_dispatch_total instead of it silently landing in the
@@ -666,6 +695,7 @@ class DispatcherPool:
             concurrency=self.concurrency if concurrency is None else concurrency,
             result_cache=self.result_cache, result_store=self.result_store,
             admission=self.admission, resilience=self.resilience,
+            orchestration=self.orchestration,
             metrics=self.metrics,
         )
         self.dispatchers[queue_name] = d

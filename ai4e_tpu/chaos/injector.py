@@ -79,6 +79,21 @@ class FaultInjector:
         self.rules.append(rule)
         return rule
 
+    def blackout(self, backend: str) -> FaultRule:
+        """Total darkness for matching backends — every hop refuses the
+        connection until ``lift``. Inserted at the FRONT of the rule list
+        so an existing background-noise rule can't shadow it (``decide``
+        takes the first matching rule). The dark-fleet scenario lever:
+        30% of a tier dark is ``blackout`` on 1 of its 3 backends."""
+        rule = FaultRule(backend=backend, connect_error_rate=1.0)
+        self.rules.insert(0, rule)
+        return rule
+
+    def lift(self, rule: FaultRule) -> None:
+        """End a ``blackout`` (idempotent)."""
+        if rule in self.rules:
+            self.rules.remove(rule)
+
     def counts(self) -> dict:
         return dict(self.injected)
 

@@ -382,6 +382,11 @@ async def run_control_plane(config: FrameworkConfig, routes: dict) -> None:
     posture = ("".join([
         ", admission control ON" if platform.admission is not None else "",
         ", resilience ON" if platform.resilience is not None else "",
+        # Orchestration changes placement + overload semantics (deadline/
+        # cost-aware picks, brownout ladder, predictive scaling —
+        # AI4E_PLATFORM_ORCHESTRATION=1, docs/orchestration.md).
+        (", orchestration ON"
+         if platform.orchestration is not None else ""),
         # Sharding changes the durability/availability topology (per-shard
         # journals + failover — AI4E_PLATFORM_TASK_SHARDS, docs/sharding.md).
         (f", task store sharded x{platform.config.task_shards}"

@@ -200,6 +200,22 @@ class PlatformSection:
     resilience_max_attempts: int = 3
     resilience_retry_base_s: float = 0.05
     resilience_retry_budget_ratio: float = 0.2
+    # Deadline-aware orchestration (docs/orchestration.md): per-request
+    # placement across unequal backends on predicted completion-within-
+    # deadline, the brownout degradation ladder, and predictive
+    # autoscaling. Requires admission AND resilience (it composes their
+    # signals).
+    orchestration: bool = False
+    orchestration_confidence: float = 0.75
+    orchestration_window: int = 256
+    orchestration_horizon_s: float = 60.0
+    # "substring=cost,..." per-backend relative cost (first match wins;
+    # unmatched backends cost 1.0).
+    orchestration_costs: typing.Optional[str] = None
+    orchestration_ladder_up: float = 0.3
+    orchestration_ladder_down: float = 0.1
+    orchestration_ladder_hold_s: float = 5.0
+    orchestration_scale_horizon_s: float = 10.0
     # Sharded task store (docs/sharding.md): N independent shards over a
     # consistent-hash slot ring, each with its own journal, passive
     # replicas, and epoch-fenced failover. 1 = today's single store.
@@ -253,6 +269,15 @@ class PlatformSection:
             resilience_max_attempts=self.resilience_max_attempts,
             resilience_retry_base_s=self.resilience_retry_base_s,
             resilience_retry_budget_ratio=self.resilience_retry_budget_ratio,
+            orchestration=self.orchestration,
+            orchestration_confidence=self.orchestration_confidence,
+            orchestration_window=self.orchestration_window,
+            orchestration_horizon_s=self.orchestration_horizon_s,
+            orchestration_costs=self.orchestration_costs,
+            orchestration_ladder_up=self.orchestration_ladder_up,
+            orchestration_ladder_down=self.orchestration_ladder_down,
+            orchestration_ladder_hold_s=self.orchestration_ladder_hold_s,
+            orchestration_scale_horizon_s=self.orchestration_scale_horizon_s,
             task_shards=self.task_shards,
             task_shard_slots=self.task_shard_slots,
             task_shard_replicas=self.task_shard_replicas,

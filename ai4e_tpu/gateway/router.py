@@ -108,6 +108,11 @@ class Gateway:
         # via set_resilience (platform assembly wires it).
         self._resilience = None
         self._sync_retry_budget = None
+        # Orchestrator (``orchestration/``), shared with the dispatchers;
+        # None → health-weighted picks and no brownout modes — the
+        # pre-orchestration behavior, untouched. Set via
+        # set_orchestration (platform assembly wires it).
+        self._orchestration = None
         # Sync-path single flight: key -> Future resolving to the leader's
         # (status, payload, content_type), or None when the leader errored.
         # Event-loop objects, so they live here rather than in the
@@ -172,6 +177,16 @@ class Gateway:
         self._resilience = health
         self._sync_retry_budget = (health.new_budget()
                                    if health is not None else None)
+
+    def set_orchestration(self, orchestrator) -> None:
+        """Enable (or clear with None) deadline/cost-aware placement on
+        the sync proxy (``orchestration/``, ``docs/orchestration.md``):
+        admitted POSTs are placed on the cheapest backend predicted to
+        finish within their remaining budget (proxied RTTs feed the
+        estimator), and the degradation ladder's brownout modes refuse
+        classes beside the adaptive in-flight cap. Requires admission +
+        resilience (the assembly enforces it)."""
+        self._orchestration = orchestrator
 
     def set_quota_tracker(self, tracker) -> None:
         """Enable (or clear with None) per-key request QUOTAS — APIM's
@@ -648,6 +663,26 @@ class Gateway:
             t0 = _time.perf_counter()
             try:
                 if sync_scope is not None:
+                    # Brownout check FIRST (orchestration ladder): a
+                    # declared degraded mode refuses the class before any
+                    # occupancy math — cache hits already answered above,
+                    # which is exactly the ladder's cache-only contract.
+                    # Inside the try for the same reason as the shed
+                    # below: a refused leader's finally must resolve the
+                    # single-flight future.
+                    brown = adm.brownout_refusal(priority)
+                    if brown is not None:
+                        brown_after, _mode = brown
+                        adm.note_shed("gateway_sync", priority)
+                        self._requests.inc(route=route.prefix,
+                                           outcome="shed")
+                        return web.Response(
+                            status=503, text="Service degraded (brownout).",
+                            headers={"Retry-After":
+                                     str(max(1, math.ceil(brown_after))),
+                                     SHED_REASON_HEADER:
+                                     shed_reason("gateway_sync",
+                                                 "brownout")})
                     # Adaptive in-flight cap, lowest priority shed first.
                     # Inside the try: a shed leader's finally still
                     # resolves the single-flight future (waiters then
@@ -700,20 +735,54 @@ class Gateway:
                     # Weighted per-request pick over the route's backend set
                     # (single-backend routes skip the RNG) — Istio's
                     # weighted VirtualService subsets, at the gateway;
-                    # health-aware under resilience (open backends ejected).
-                    base = (res.pick(route.backends, exclude=tried)
-                            if res is not None
-                            else pick_backend(route.backends))
+                    # health-aware under resilience (open backends ejected);
+                    # deadline/cost-aware for admitted POSTs under
+                    # orchestration (cheapest backend predicted to finish
+                    # within the remaining budget).
+                    if (sync_scope is not None
+                            and self._orchestration is not None):
+                        base = self._orchestration.place(
+                            route.backends, deadline_at=deadline_at,
+                            priority=priority, exclude=tried)
+                    elif res is not None:
+                        base = res.pick(route.backends, exclude=tried)
+                    else:
+                        base = pick_backend(route.backends)
                     target = base + (("/" + tail) if tail else "")
                     if request.query_string:
                         target += "?" + request.query_string
                     session = await self._get_session()
+                    attempt_t0 = _time.perf_counter()
+                    orch = (self._orchestration if sync_scope is not None
+                            else None)
+                    if orch is not None:
+                        # Queue-pressure input for the completion
+                        # estimator — the same begin/finally-end pairing
+                        # the dispatcher wraps its POST in, so sync
+                        # in-flight load discounts p_within too instead
+                        # of the proxy overloading a tier the estimator
+                        # still thinks is idle.
+                        orch.begin(base)
                     try:
                         async with session.request(
                             request.method, target, data=body,
                             headers=fwd_headers,
                         ) as resp:
                             payload = await resp.read()
+                            if (orch is not None
+                                    and 200 <= resp.status < 300):
+                                # Proxied completion RTT feeds the
+                                # estimator — on the sync path this IS
+                                # the end-to-end service time. Gated on
+                                # the SAME condition as placement
+                                # (admitted POSTs): a route's GET
+                                # health/status probes answer in
+                                # microseconds and would teach the
+                                # sketch a service time no inference
+                                # POST will ever see.
+                                self._orchestration.observe(
+                                    base,
+                                    _time.perf_counter() - attempt_t0)
                             if res is not None:
                                 # Breakers read the proxied status too —
                                 # 5xx (not 503 backpressure) is failure
@@ -786,6 +855,9 @@ class Gateway:
                         return web.Response(
                             status=502,
                             text=f"Backend unreachable: {exc}")
+                    finally:
+                        if orch is not None:
+                            orch.end(base)
             finally:
                 if acquired:
                     # Observe BEFORE release, so the limiter's Little's-law

@@ -1,0 +1,34 @@
+"""Deadline-aware orchestration over unequal backends (docs/orchestration.md).
+
+Three pieces, all composing signals the platform already produces:
+
+- ``CompletionEstimator`` (estimator.py) — per-backend decayed RTT
+  quantile sketches crossed with breaker state and queue pressure,
+  answering P(finishes within the remaining deadline budget);
+- ``DegradationLadder`` (ladder.py) — declared brownout modes stepped
+  through hysteretically under sustained predicted-miss pressure,
+  consulted by the admission shedder;
+- ``Orchestrator`` (core.py) — the cheapest-backend-that-clears-the-bar
+  placement replacing the health-weighted random pick in the dispatcher
+  and the gateway sync proxy.
+
+Opt-in via ``PlatformConfig(orchestration=True)`` /
+``AI4E_PLATFORM_ORCHESTRATION=1`` (requires admission + resilience —
+the layers whose signals it composes); off, the assembly is byte-
+identical to pre-orchestration behavior.
+"""
+
+from .core import Orchestrator, OrchestrationPolicy, parse_costs
+from .estimator import CompletionEstimator, DecayedQuantiles, backend_label
+from .ladder import LEVELS, DegradationLadder
+
+__all__ = [
+    "Orchestrator",
+    "OrchestrationPolicy",
+    "parse_costs",
+    "CompletionEstimator",
+    "DecayedQuantiles",
+    "backend_label",
+    "DegradationLadder",
+    "LEVELS",
+]

@@ -117,6 +117,29 @@ class PlatformConfig:
     resilience_max_attempts: int = 3        # POST attempts per delivery
     resilience_retry_base_s: float = 0.05   # first in-delivery retry delay
     resilience_retry_budget_ratio: float = 0.2  # retries per request, steady
+    # Deadline-aware orchestration over unequal backends (orchestration/,
+    # docs/orchestration.md): per-request placement on predicted
+    # completion-within-deadline and per-backend cost (replacing the
+    # health-weighted random pick in the dispatchers and sync proxy), the
+    # brownout degradation ladder consulted by the admission shedder, and
+    # predictive autoscaling (arrival/drain projection instead of raw
+    # depth; per-shard decisions through one actuator on sharded routes).
+    # Off by default — enabling it is a semantic statement that backends
+    # are UNEQUAL (placement prefers cheap tiers that clear the deadline
+    # bar) and that sustained predicted-miss pressure may brown the
+    # platform out class by class. Requires admission AND resilience —
+    # it composes their signals rather than inventing new ones.
+    orchestration: bool = False
+    orchestration_confidence: float = 0.75   # p_within bar a backend clears
+    orchestration_window: int = 256          # RTT samples per backend sketch
+    orchestration_horizon_s: float = 60.0    # sample decay horizon (s)
+    # "substring=cost,..." relative backend cost (first match wins,
+    # unmatched = 1.0) — e.g. "tpu=3,cpu-fallback=1,remote=5".
+    orchestration_costs: str | None = None
+    orchestration_ladder_up: float = 0.3     # pressure that steps the ladder up
+    orchestration_ladder_down: float = 0.1   # pressure that steps it down
+    orchestration_ladder_hold_s: float = 5.0  # sustain per step (hysteresis)
+    orchestration_scale_horizon_s: float = 10.0  # predictive-scale projection
     # Sharded task store (taskstore/sharding.py, docs/sharding.md): split
     # the task keyspace over N independent shards — each with its own
     # journal, passive replicas (with journal_path), and epoch-fenced
@@ -288,6 +311,36 @@ class LocalPlatform:
                     retry_budget_ratio=(
                         self.config.resilience_retry_budget_ratio)),
                 metrics=self.metrics)
+        self.orchestration = None
+        if self.config.orchestration:
+            if self.admission is None or self.resilience is None:
+                # The orchestrator composes the admission layer's
+                # deadline/drain signals and the resilience layer's
+                # breaker state — without either it would be guessing.
+                # Loud fail, same pattern as admission-on-native.
+                raise ValueError(
+                    "orchestration=True requires admission=True and "
+                    "resilience=True (it composes their signals — "
+                    "docs/orchestration.md)")
+            from .orchestration import (Orchestrator, OrchestrationPolicy,
+                                        parse_costs)
+            self.orchestration = Orchestrator(
+                self.resilience,
+                policy=OrchestrationPolicy(
+                    confidence=self.config.orchestration_confidence,
+                    window=self.config.orchestration_window,
+                    horizon_s=self.config.orchestration_horizon_s,
+                    costs=parse_costs(self.config.orchestration_costs),
+                    ladder_up=self.config.orchestration_ladder_up,
+                    ladder_down=self.config.orchestration_ladder_down,
+                    ladder_hold_s=self.config.orchestration_ladder_hold_s,
+                    scale_horizon_s=(
+                        self.config.orchestration_scale_horizon_s)),
+                metrics=self.metrics)
+            # The admission shedder consults the ladder on every decision,
+            # and its store listener feeds the ladder actual deadline
+            # outcomes (late/expired) — the brownout's evidence loop.
+            self.admission.set_ladder(self.orchestration.ladder)
         self.broker = None
         self.dispatchers = None
         self.topic = None
@@ -324,6 +377,7 @@ class LocalPlatform:
                               else None),
                 admission=self.admission,
                 resilience=self.resilience,
+                orchestration=self.orchestration,
                 metrics=self.metrics)
         else:
             raise ValueError(
@@ -336,6 +390,8 @@ class LocalPlatform:
             self.gateway.set_admission(self.admission)
         if self.resilience is not None:
             self.gateway.set_resilience(self.resilience)
+        if self.orchestration is not None:
+            self.gateway.set_orchestration(self.orchestration)
         # Terminal-history retention: None = AUTO — 15 min on the Python
         # store, sized to the soak evidence (unevicted terminal history
         # grows ~12 MB/min at 200 req/s → AUTO bounds steady-state at
@@ -452,35 +508,104 @@ class LocalPlatform:
             return
         self.broker.register_queue(queue_name)
         if self.config.task_shards > 1:
-            if autoscale is not None:
+            if autoscale is not None and self.orchestration is None:
+                # PR 6's two-loops/one-actuator refusal, now relaxed ONLY
+                # under orchestration: the predictive sharded controller
+                # makes per-shard decisions but routes them through one
+                # actuator (ShardScaleTarget), so there is still exactly
+                # one writer per dispatcher's concurrency.
                 raise ValueError(
                     "autoscale policies are per-dispatcher; with "
                     "task_shards > 1 use admission's adaptive control "
-                    "(one limiter per shard sub-queue) instead")
+                    "(one limiter per shard sub-queue) instead — or "
+                    "enable orchestration, whose predictive scaler "
+                    "routes per-shard decisions through one actuator "
+                    "(docs/orchestration.md)")
             from .broker.queue import shard_queue_name
             queue_names = [shard_queue_name(queue_name, i)
                            for i in range(self.config.task_shards)]
         else:
             queue_names = [queue_name]
-        for qn in queue_names:
-            dispatcher = self.dispatchers.register(qn, backend_uri,
-                                                   retry_delay=retry_delay,
-                                                   concurrency=concurrency)
-            if autoscale is not None:
-                from .scaling import (AutoscaleController,
-                                      DispatcherScaleTarget)
-                self.autoscalers.append(AutoscaleController(
-                    self.store, qn, DispatcherScaleTarget(dispatcher),
-                    policy=autoscale, interval=autoscale_interval,
-                    metrics=self.metrics))
-            elif self.admission is not None:
-                # The adaptive controller owns this dispatcher's fan-out:
-                # its per-queue limiter (fed by delivery RTTs + backpressure
-                # backoffs) replaces the fixed concurrency constant. An
-                # explicit AutoscalePolicy wins — two control loops driving
-                # one actuator would fight.
+        dispatchers = [self.dispatchers.register(qn, backend_uri,
+                                                 retry_delay=retry_delay,
+                                                 concurrency=concurrency)
+                       for qn in queue_names]
+        if autoscale is not None:
+            self._attach_autoscaler(queue_names, dispatchers, autoscale,
+                                    autoscale_interval)
+        elif self.admission is not None:
+            # The adaptive controller owns each dispatcher's fan-out: its
+            # per-queue limiter (fed by delivery RTTs + backpressure
+            # backoffs) replaces the fixed concurrency constant. An
+            # explicit AutoscalePolicy wins — two control loops driving
+            # one actuator would fight.
+            for qn, dispatcher in zip(queue_names, dispatchers):
                 self.admission.add_target("dispatch:" + qn,
                                           dispatcher.set_concurrency)
+
+    def _attach_autoscaler(self, queue_names, dispatchers, policy,
+                           interval) -> None:
+        """HPA-style scaling for a route's dispatcher(s). Under
+        orchestration the signal is PREDICTIVE — projected backlog from
+        the admission controller's arrival/drain estimators
+        (``scaling.predictive_signal``) instead of raw depth, so loops
+        scale ahead of the deadline-miss cliff; sharded routes get one
+        ``ShardedAutoscaleController`` (per-shard decisions, one
+        actuator)."""
+        from .scaling import (AutoscaleController, DispatcherScaleTarget,
+                              ShardScaleTarget, ShardedAutoscaleController,
+                              predictive_signal)
+        base_path = dispatchers[0].route_path
+        if len(dispatchers) > 1:
+            # Sharded (only reachable under orchestration — the refusal
+            # above): per-shard depth from each shard's own store, the
+            # global arrival/drain imbalance split evenly across shards
+            # (the ring spreads TaskIds uniformly).
+            horizon = self.orchestration.policy.scale_horizon_s
+            n = len(dispatchers)
+
+            def shard_depth(i, p=base_path):
+                def depth() -> float:
+                    # Resolved per tick, not captured: a shard failover
+                    # swaps the promoted replica in for the dead primary,
+                    # and a captured store object would read the corpse's
+                    # frozen counts forever.
+                    s = self.store.shard_stores()[i]
+                    return (s.set_len(p, "created")
+                            + s.set_len(p, "running"))
+                return depth
+
+            shards = []
+            for i, qn in enumerate(queue_names):
+                # THIS route's rates (not the platform-global ones — a
+                # flooded sibling route must not inflate this route's
+                # projection), split evenly across its shards (the ring
+                # spreads TaskIds uniformly).
+                shards.append((qn, predictive_signal(
+                    shard_depth(i),
+                    lambda p=base_path, n=n: (
+                        self.admission.arrival_rate(route=p) / n),
+                    lambda p=base_path, n=n: (
+                        self.admission.route_drain_rate(p) / n),
+                    horizon)))
+            self.autoscalers.append(ShardedAutoscaleController(
+                shards, ShardScaleTarget(dispatchers), policy=policy,
+                interval=interval, metrics=self.metrics))
+            return
+        signal = None
+        if self.orchestration is not None:
+            store = self.store
+            signal = predictive_signal(
+                lambda: (store.set_len(base_path, "created")
+                         + store.set_len(base_path, "running")),
+                lambda p=base_path: self.admission.arrival_rate(route=p),
+                lambda p=base_path: self.admission.route_drain_rate(p),
+                self.orchestration.policy.scale_horizon_s)
+        self.autoscalers.append(AutoscaleController(
+            self.store, queue_names[0],
+            DispatcherScaleTarget(dispatchers[0]),
+            policy=policy, interval=interval, signal=signal,
+            metrics=self.metrics))
 
     def publish_sync_api(self, public_prefix: str, backend_uri,
                          max_body_bytes: int | None = None) -> None:

@@ -141,11 +141,19 @@ class BackendHealth:
             # backend — the one most likely to have had time to recover.
             chosen = min((u for u, _ in pool),
                          key=lambda u: self.breaker_for(u).last_failure_at)
-        br = self.breaker_for(chosen)
-        if br.state != "closed":
-            br.begin_probe(now)
-            self._set_state(chosen, br)
+        self.commit_pick(chosen, now)
         return chosen
+
+    def commit_pick(self, uri: str, now: float | None = None) -> None:
+        """Account a routing decision made on this health model's state —
+        by ``pick`` above or by an out-of-band placement policy (the
+        orchestration scheduler): a non-closed breaker books the probe
+        slot, so recovery traffic is bounded identically no matter who
+        chose the backend."""
+        br = self.breaker_for(uri)
+        if br.state != "closed":
+            br.begin_probe(self._clock() if now is None else now)
+            self._set_state(uri, br)
 
     # -- outcome recording --------------------------------------------------
 

@@ -4,6 +4,9 @@ from .autoscaler import (
     DispatcherScaleTarget,
     HPADecider,
     ScaleTarget,
+    ShardScaleTarget,
+    ShardedAutoscaleController,
+    predictive_signal,
 )
 
 __all__ = [
@@ -12,4 +15,7 @@ __all__ = [
     "DispatcherScaleTarget",
     "HPADecider",
     "ScaleTarget",
+    "ShardScaleTarget",
+    "ShardedAutoscaleController",
+    "predictive_signal",
 ]

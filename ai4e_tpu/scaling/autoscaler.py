@@ -78,6 +78,30 @@ class HPADecider:
         return raw
 
 
+def predictive_signal(depth_fn: Callable[[], float],
+                      arrival_rate_fn: Callable[[], float],
+                      drain_rate_fn: Callable[[], float],
+                      horizon_s: float = 10.0) -> Callable[[], float]:
+    """Projected backlog ``horizon_s`` ahead — the predictive scaling
+    signal (docs/orchestration.md).
+
+    ``depth + max(0, arrival - drain) × horizon``: when arrivals outrun
+    the drain, the projection grows BEFORE raw depth does, so the HPA
+    rule scales up ahead of the queue wait that causes the first
+    deadline miss instead of after it. A draining queue projects its
+    current depth only (no negative term — scale-down damping belongs to
+    the decider's stabilization window, not to the signal).
+
+    The rate inputs are the admission controller's existing estimators
+    (``arrival_rate`` / ``drain_rate``) — no new measurement, just a new
+    reading of it.
+    """
+    def signal() -> float:
+        growth = max(0.0, float(arrival_rate_fn()) - float(drain_rate_fn()))
+        return float(depth_fn()) + growth * horizon_s
+    return signal
+
+
 class ScaleTarget(Protocol):
     """An actuator the controller drives."""
 
@@ -103,51 +127,44 @@ class DispatcherScaleTarget:
         self.dispatcher.set_concurrency(n)
 
 
-class AutoscaleController:
-    """Periodic control loop: signal → HPA decision → actuator.
+class _ControlLoop:
+    """Shared autoscale machinery: the ``ai4e_autoscale_*`` instruments,
+    the decide → log → count → actuate step, and the periodic-task
+    lifecycle — one copy, so a fix to any of them reaches both the
+    single-route and the sharded controller."""
 
-    ``signal`` defaults to queue pressure for the endpoint: tasks waiting in
-    the ``created`` state set plus tasks being processed (``running``) —
-    the reference's scaling metric pair (``TaskQueueLogger.cs:19-27`` depth
-    + ``CURRENT_REQUESTS`` in-flight counter) collapsed into one number.
-    """
+    interval: float = 5.0
+    _loop_name: str = "autoscale"
 
-    def __init__(self, store, endpoint_path: str, target: ScaleTarget,
-                 policy: AutoscalePolicy | None = None,
-                 interval: float = 5.0,
-                 signal: Callable[[], float] | None = None,
-                 metrics: MetricsRegistry | None = None):
-        self.store = store
-        self.endpoint_path = endpoint_path
-        self.target = target
-        self.policy = policy or AutoscalePolicy()
-        self.interval = interval
-        self.signal = signal or self._default_signal
-        self.decider = HPADecider(self.policy)
-        metrics = metrics or DEFAULT_REGISTRY
-        self._replica_gauge = metrics.gauge(
+    def _make_instruments(self, metrics: MetricsRegistry | None) -> None:
+        # The assembly passes ITS registry here; the `or` fallback is for
+        # direct construction in scripts — either way every series this
+        # controller emits lands in one registry (AIL002).
+        self.metrics = metrics or DEFAULT_REGISTRY
+        self._replica_gauge = self.metrics.gauge(
             "ai4e_autoscale_replicas", "Actuated replica count per endpoint")
-        self._signal_gauge = metrics.gauge(
+        self._signal_gauge = self.metrics.gauge(
             "ai4e_autoscale_signal", "Scaling signal value per endpoint")
+        self._decisions = self.metrics.counter(
+            "ai4e_autoscale_decisions_total",
+            "Actuated scaling decisions by endpoint and direction")
         self._task: asyncio.Task | None = None
 
-    def _default_signal(self) -> float:
-        return (self.store.set_len(self.endpoint_path, "created")
-                + self.store.set_len(self.endpoint_path, "running"))
-
-    def tick(self) -> int:
-        """One control step (sync; also called by the async loop)."""
-        value = float(self.signal())
-        current = self.target.replicas
-        desired = self.decider.desired(current, value)
-        self._signal_gauge.set(value, endpoint=self.endpoint_path)
+    def _apply_decision(self, name: str, decider: HPADecider, value: float,
+                        current: int, scale_fn) -> int:
+        desired = decider.desired(current, value)
+        self._signal_gauge.set(value, endpoint=name)
         if desired != current:
             log.info("autoscale %s: signal=%.1f replicas %d -> %d",
-                     self.endpoint_path, value, current, desired)
-            self.target.scale_to(desired)
-        self._replica_gauge.set(self.target.replicas,
-                                endpoint=self.endpoint_path)
+                     name, value, current, desired)
+            self._decisions.inc(endpoint=name,
+                                direction="up" if desired > current
+                                else "down")
+            scale_fn(desired)
         return desired
+
+    def tick(self):
+        raise NotImplementedError
 
     async def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._run())
@@ -168,4 +185,118 @@ class AutoscaleController:
                 self.tick()
             except Exception:  # noqa: BLE001 — control loop must survive
                 log.exception("autoscale tick failed for %s",
-                              self.endpoint_path)
+                              self._loop_name)
+
+
+class AutoscaleController(_ControlLoop):
+    """Periodic control loop: signal → HPA decision → actuator.
+
+    ``signal`` defaults to queue pressure for the endpoint: tasks waiting in
+    the ``created`` state set plus tasks being processed (``running``) —
+    the reference's scaling metric pair (``TaskQueueLogger.cs:19-27`` depth
+    + ``CURRENT_REQUESTS`` in-flight counter) collapsed into one number.
+    """
+
+    def __init__(self, store, endpoint_path: str, target: ScaleTarget,
+                 policy: AutoscalePolicy | None = None,
+                 interval: float = 5.0,
+                 signal: Callable[[], float] | None = None,
+                 metrics: MetricsRegistry | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.store = store
+        self.endpoint_path = endpoint_path
+        self._loop_name = endpoint_path
+        self.target = target
+        self.policy = policy or AutoscalePolicy()
+        self.interval = interval
+        self.signal = signal or self._default_signal
+        self.decider = HPADecider(self.policy, clock=clock)
+        self._make_instruments(metrics)
+
+    def _default_signal(self) -> float:
+        return (self.store.set_len(self.endpoint_path, "created")
+                + self.store.set_len(self.endpoint_path, "running"))
+
+    def tick(self) -> int:
+        """One control step (sync; also called by the async loop)."""
+        desired = self._apply_decision(
+            self.endpoint_path, self.decider, float(self.signal()),
+            self.target.replicas, self.target.scale_to)
+        self._replica_gauge.set(self.target.replicas,
+                                endpoint=self.endpoint_path)
+        return desired
+
+
+class ShardScaleTarget:
+    """ONE actuator over a sharded route's per-shard dispatchers.
+
+    PR 6 refused autoscale policies on sharded routes outright: an
+    HPA loop per sub-queue plus the admission controller would have been
+    several control loops fighting one set of actuators. This object is
+    the relaxation's actuator half — per-shard *decisions* (the
+    controller below) are applied through this single target, which is
+    also a plain ``ScaleTarget`` (``replicas``/``scale_to`` treat the
+    shard set as one pool, splitting evenly with the remainder on the
+    lowest-indexed shards)."""
+
+    def __init__(self, dispatchers: list):
+        if not dispatchers:
+            raise ValueError("ShardScaleTarget needs at least one dispatcher")
+        self.dispatchers = list(dispatchers)
+
+    @property
+    def replicas(self) -> int:
+        return sum(d.concurrency for d in self.dispatchers)
+
+    def scale_to(self, n: int) -> None:
+        base, rem = divmod(max(0, n), len(self.dispatchers))
+        for i, d in enumerate(self.dispatchers):
+            d.set_concurrency(base + (1 if i < rem else 0))
+
+    def shard_replicas(self, i: int) -> int:
+        return self.dispatchers[i].concurrency
+
+    def scale_shard(self, i: int, n: int) -> None:
+        self.dispatchers[i].set_concurrency(max(0, n))
+
+
+class ShardedAutoscaleController(_ControlLoop):
+    """Per-shard scaling decisions through one actuator (the PR 6
+    shards-vs-autoscale refusal, relaxed — requires orchestration, see
+    ``platform_assembly.register_internal_route``).
+
+    One control loop; per sub-queue, its own signal and its own
+    ``HPADecider`` (each shard's scale-down stabilization history is
+    independent — one hot shard must not pin a cold shard's loops up),
+    all actuated through a single ``ShardScaleTarget``. Instruments,
+    decision step, and lifecycle are the shared ``_ControlLoop``
+    machinery; the sub-queue is the endpoint label."""
+
+    def __init__(self, shards: list, target: ShardScaleTarget,
+                 policy: AutoscalePolicy | None = None,
+                 interval: float = 5.0,
+                 metrics: MetricsRegistry | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        # shards: [(sub_queue_name, signal_fn)] aligned with the target's
+        # dispatcher list.
+        if len(shards) != len(target.dispatchers):
+            raise ValueError(
+                f"{len(shards)} shard signals for "
+                f"{len(target.dispatchers)} dispatchers")
+        self.shards = list(shards)
+        self._loop_name = (shards[0][0] if shards else "sharded")
+        self.target = target
+        self.policy = policy or AutoscalePolicy()
+        self.interval = interval
+        self.deciders = [HPADecider(self.policy, clock=clock)
+                         for _ in self.shards]
+        self._make_instruments(metrics)
+
+    def tick(self) -> None:
+        for i, (name, signal) in enumerate(self.shards):
+            self._apply_decision(
+                name, self.deciders[i], float(signal()),
+                self.target.shard_replicas(i),
+                lambda n, i=i: self.target.scale_shard(i, n))
+            self._replica_gauge.set(self.target.shard_replicas(i),
+                                    endpoint=name)

@@ -77,26 +77,51 @@ async def run_closed_loop(
     failed = 0
     expired = 0
     good = 0  # completions within deadline_s (== completed when unset)
+    # Per-priority-class accounting, keyed by the X-Priority header each
+    # request carried ("" = unlabeled). Only populated when headers_for
+    # labels traffic — the bench's --mix profiles report per-class
+    # goodput and deadline-miss rate off these buckets.
+    by_class: dict[str, dict] = {}
+
+    def _bucket(cls: str) -> dict:
+        b = by_class.get(cls)
+        if b is None:
+            b = by_class[cls] = {"completed": 0, "good": 0, "failed": 0,
+                                 "expired": 0}
+        return b
 
     def _headers() -> dict:
         if headers_for is None:
             return headers
         return {**headers, **headers_for()}
 
-    def _score_completion(elapsed: float) -> None:
+    def _score_completion(elapsed: float, cls: str) -> None:
         nonlocal completed, good
         latencies.append(elapsed)
         completed += 1
+        _bucket(cls)["completed"] += 1
         if deadline_s is None or elapsed <= deadline_s:
             good += 1
+            _bucket(cls)["good"] += 1
+
+    def _score_failed(cls: str) -> None:
+        nonlocal failed
+        failed += 1
+        _bucket(cls)["failed"] += 1
+
+    def _score_expired(cls: str) -> None:
+        nonlocal expired
+        expired += 1
+        _bucket(cls)["expired"] += 1
 
     async def one_async() -> None:
-        nonlocal failed, expired
         t0 = time.perf_counter()
         url = post_url if post_url_for is None else post_url_for()
+        hdrs = _headers()
+        cls = hdrs.get("X-Priority", "")
         try:
             async with session.post(url, data=payload,
-                                    headers=_headers()) as resp:
+                                    headers=hdrs) as resp:
                 if resp.status in (503, 429):
                     # Backpressure (admission 503 / per-key throttle 429):
                     # not a failure — yield briefly and re-enter. The client
@@ -105,13 +130,13 @@ async def run_closed_loop(
                     await asyncio.sleep(_backoff(resp))
                     return
                 if resp.status == 504:  # shed: budget spent at the edge
-                    expired += 1
+                    _score_expired(cls)
                     return
                 task = await resp.json()
             task_id = task["TaskId"]
         except (aiohttp.ClientError, asyncio.TimeoutError, ValueError,
                 KeyError, TypeError):
-            failed += 1
+            _score_failed(cls)
             return
         deadline = t0 + task_timeout
         while True:
@@ -120,56 +145,57 @@ async def run_closed_loop(
                                        params={"wait": str(int(poll_wait))},
                                        headers=headers) as resp:
                     if resp.status == 404:  # reaped/evicted task
-                        failed += 1
+                        _score_failed(cls)
                         return
                     record = await resp.json()
                 status = record["Status"]
             except (aiohttp.ClientError, asyncio.TimeoutError, ValueError,
                     KeyError, TypeError):
-                failed += 1
+                _score_failed(cls)
                 return
             # "failed" FIRST — the platform's canonical bucketing
             # (TaskStatus.canonical) tests it first, so a status carrying
             # both words counts the same here as in the store's sets.
             if "failed" in status:
-                failed += 1
+                _score_failed(cls)
                 return
             if "completed" in status:
-                _score_completion(time.perf_counter() - t0)
+                _score_completion(time.perf_counter() - t0, cls)
                 return
             if "expired" in status:
                 # Admission shed the task on its deadline (terminal) —
                 # shed work, not a platform failure.
-                expired += 1
+                _score_expired(cls)
                 return
             if time.perf_counter() > deadline:  # stuck task: don't hang the run
-                failed += 1
+                _score_failed(cls)
                 return
 
     async def one_sync() -> None:
         # 503 backpressure: sleep briefly and return (neither completed nor
         # failed) — client_loop re-enters until the run deadline, same as
         # one_async, so sustained backpressure can never outlive the run.
-        nonlocal failed, expired
         t0 = time.perf_counter()
         url = post_url if post_url_for is None else post_url_for()
+        hdrs = _headers()
+        cls = hdrs.get("X-Priority", "")
         try:
             async with session.post(url, data=payload,
-                                    headers=_headers()) as resp:
+                                    headers=hdrs) as resp:
                 if resp.status in (503, 429):
                     await asyncio.sleep(_backoff(resp))
                     return
                 if resp.status == 504:  # admission shed on deadline
-                    expired += 1
+                    _score_expired(cls)
                     return
                 await resp.read()
                 ok = resp.status == 200
         except (aiohttp.ClientError, asyncio.TimeoutError):
             ok = False
         if ok:
-            _score_completion(time.perf_counter() - t0)
+            _score_completion(time.perf_counter() - t0, cls)
         else:
-            failed += 1
+            _score_failed(cls)
 
     one = one_sync if mode == "sync" else one_async
 
@@ -184,11 +210,14 @@ async def run_closed_loop(
     mark: dict = {}
     close: dict = {}
 
+    def _class_snapshot() -> dict:
+        return {cls: dict(b) for cls, b in by_class.items()}
+
     async def open_window() -> None:
         await asyncio.sleep(ramp)
         mark.update(t=time.perf_counter(), completed=completed,
                     failed=failed, expired=expired, good=good,
-                    n_lat=len(latencies))
+                    n_lat=len(latencies), by_class=_class_snapshot())
 
     async def close_window() -> None:
         # Snapshot AT stop_at, not after the drain: gather() returns only
@@ -198,7 +227,7 @@ async def run_closed_loop(
         await asyncio.sleep(ramp + duration)
         close.update(t=time.perf_counter(), completed=completed,
                      failed=failed, expired=expired, good=good,
-                     n_lat=len(latencies))
+                     n_lat=len(latencies), by_class=_class_snapshot())
 
     stop_at = time.perf_counter() + ramp + duration
     await asyncio.gather(open_window(), close_window(),
@@ -227,4 +256,36 @@ async def run_closed_loop(
         # landed inside the caller's budget, per second of the window.
         out["goodput"] = round(n_good / elapsed, 2)
         out["late"] = n - n_good
+        # Deadline-miss rate: late + platform-shed (expired) work over
+        # everything that asked for a deadline and resolved in-window.
+        n_expired = close["expired"] - mark["expired"]
+        resolved = n + n_expired
+        if resolved:
+            out["deadline_miss_rate"] = round(
+                (out["late"] + n_expired) / resolved, 3)
+    labeled = {cls for cls in close["by_class"] if cls}
+    if labeled:
+        # Per-priority window deltas (the --mix profiles' report): the
+        # class label is the X-Priority value each request carried.
+        per = {}
+        for cls in sorted(labeled):
+            at_close = close["by_class"].get(cls, {})
+            at_open = mark["by_class"].get(
+                cls, {"completed": 0, "good": 0, "failed": 0, "expired": 0})
+            c = at_close.get("completed", 0) - at_open["completed"]
+            g = at_close.get("good", 0) - at_open["good"]
+            e = at_close.get("expired", 0) - at_open["expired"]
+            entry = {
+                "completed": c,
+                "failed": at_close.get("failed", 0) - at_open["failed"],
+                "expired": e,
+            }
+            if deadline_s is not None:
+                entry["goodput"] = round(g / elapsed, 2)
+                entry["late"] = c - g
+                if c + e:
+                    entry["deadline_miss_rate"] = round(
+                        (entry["late"] + e) / (c + e), 3)
+            per[cls] = entry
+        out["by_priority"] = per
     return out

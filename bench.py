@@ -470,9 +470,50 @@ def _build_pipeline_servables(args):
     return det, sp, buf.getvalue(), meta
 
 
+# --mix: named traffic profiles bundling the deadline/priority/fault
+# knobs (docs/orchestration.md). A preset only fills knobs the caller
+# left at their defaults — an explicit --deadline-ms beside --mix wins.
+MIX_PRESETS = {
+    "interactive-heavy": {
+        "priority_mix": "interactive:7,default:2,background:1",
+        "deadline_ms": 2000.0,
+    },
+    "batch-heavy": {
+        "priority_mix": "interactive:1,default:2,background:7",
+        "deadline_ms": 8000.0,
+    },
+    "faulty-mixed": {
+        "priority_mix": "interactive:5,default:3,background:2",
+        "deadline_ms": 2000.0,
+        "fault_rate": 0.1,
+        "resilience": True,
+    },
+}
+
+_MIX_DEFAULTS = {"priority_mix": "", "deadline_ms": 0.0, "fault_rate": 0.0,
+                 "resilience": False}
+
+
+def apply_mix_preset(args) -> None:
+    """Expand ``--mix`` into its concrete knobs (defaults-only — explicit
+    flags win). Idempotent, so the orchestrator and its boxed inner
+    subprocess can both call it."""
+    name = getattr(args, "mix", "") or ""
+    if not name:
+        return
+    preset = MIX_PRESETS.get(name)
+    if preset is None:
+        raise SystemExit(
+            f"unknown --mix {name!r}; available: {sorted(MIX_PRESETS)}")
+    for knob, value in preset.items():
+        if getattr(args, knob) == _MIX_DEFAULTS[knob]:
+            setattr(args, knob, value)
+
+
 def _admission_enabled(args) -> bool:
     return (getattr(args, "deadline_ms", 0.0) > 0
-            or bool(getattr(args, "priority_mix", "")))
+            or bool(getattr(args, "priority_mix", ""))
+            or bool(getattr(args, "orchestration", False)))
 
 
 def _parse_priority_mix(spec: str) -> list[tuple[str, float]]:
@@ -553,6 +594,32 @@ def _admission_report(args, platform) -> dict:
     }}
 
 
+def _orchestration_report(args, platform) -> dict:
+    """The bench artifact's orchestration block: placement outcomes,
+    ladder posture, and brownout refusals accumulated over the run."""
+    orch = getattr(platform, "orchestration", None)
+    if orch is None:
+        return {}
+    reg = platform.metrics
+    placements: dict[str, int] = {}
+    for _, _, labels, v in reg.counter(
+            "ai4e_orchestration_placements_total", "").collect():
+        key = labels.get("outcome", "")
+        placements[key] = placements.get(key, 0) + int(v)
+    transitions = int(sum(v for *_, v in reg.counter(
+        "ai4e_orchestration_ladder_transitions_total", "").collect()))
+    refusals = int(sum(v for *_, v in reg.counter(
+        "ai4e_orchestration_brownout_refusals_total", "").collect()))
+    return {"orchestration": {
+        "enabled": True,
+        "mix": getattr(args, "mix", "") or None,
+        "placements": placements,
+        "ladder_level_final": orch.ladder.level,
+        "ladder_transitions": transitions,
+        "brownout_refusals": refusals,
+    }}
+
+
 def build_platform(args):
     from aiohttp import web  # noqa: F401 — ensure aiohttp present early
 
@@ -586,7 +653,13 @@ def build_platform(args):
         # --resilience enables per-backend breakers + budget-bounded
         # retries (ai4e_tpu/resilience/) — the A/B lever for the
         # --fault-rate goodput-under-failure runs.
-        resilience=getattr(args, "resilience", False),
+        resilience=(getattr(args, "resilience", False)
+                    or getattr(args, "orchestration", False)),
+        # --orchestration enables deadline/cost-aware placement, the
+        # brownout ladder, and predictive scaling (ai4e_tpu/
+        # orchestration/) — it composes admission + resilience, so both
+        # are forced on with it (docs/orchestration.md).
+        orchestration=getattr(args, "orchestration", False),
         # --task-shards N shards the task keyspace (taskstore/sharding.py,
         # docs/sharding.md): N store shards + per-shard dispatcher
         # sub-queues; the control-plane-headroom lever. Journal-less here
@@ -1194,10 +1267,14 @@ async def run_bench(args) -> dict:
     admission_meta = _admission_report(args, platform)
     if admission_meta:
         # Goodput rides beside raw req/s: under offered load > capacity the
-        # headline number alone rewards completing dead work.
-        for key in ("goodput", "late", "expired"):
+        # headline number alone rewards completing dead work. by_priority
+        # carries the per-class goodput + deadline-miss rate the --mix
+        # profiles exist to compare.
+        for key in ("goodput", "late", "expired", "deadline_miss_rate",
+                    "by_priority"):
             if key in window:
                 admission_meta["admission"][key] = window[key]
+    orchestration_meta = _orchestration_report(args, platform)
 
     cache_meta = {}
     if cache is not None:
@@ -1357,8 +1434,10 @@ async def run_bench(args) -> dict:
                                   "duration_s")},
         "concurrency": args.concurrency,
         "device": _device_kind(),
+        **({"mix": args.mix} if getattr(args, "mix", "") else {}),
         **build_meta,
         **admission_meta,
+        **orchestration_meta,
         **cache_meta,
         **shard_meta,
         **fault_meta,
@@ -1533,6 +1612,8 @@ def _forward_argv(args) -> list[str]:
             "--fault-rate", str(args.fault_rate),
             "--fault-seed", str(args.fault_seed),
             *(["--resilience"] if args.resilience else []),
+            *(["--orchestration"] if args.orchestration else []),
+            *(["--mix", args.mix] if args.mix else []),
             "--task-shards", str(args.task_shards),
             "--deadline-ms", str(args.deadline_ms),
             *(["--priority-mix", args.priority_mix]
@@ -1673,6 +1754,25 @@ def main() -> None:
                              "(docs/sharding.md); the result JSON gains a "
                              "'shards' block with per-shard goodput and "
                              "the peak long-poll watcher count")
+    parser.add_argument("--mix", default="",
+                        choices=("", *sorted(MIX_PRESETS)),
+                        help="named traffic profile bundling the deadline/"
+                             "priority/fault knobs (docs/orchestration.md): "
+                             "interactive-heavy (2 s budgets, 70%% "
+                             "interactive), batch-heavy (8 s budgets, 70%% "
+                             "background), faulty-mixed (2 s budgets + 10%% "
+                             "injected 5xx + resilience). Explicit knob "
+                             "flags override the preset's values. Pair "
+                             "with/without --orchestration for the A/B; "
+                             "the JSON reports per-priority goodput and "
+                             "deadline-miss rate either way")
+    parser.add_argument("--orchestration", action="store_true",
+                        help="enable deadline/cost-aware orchestration "
+                             "(ai4e_tpu/orchestration/): per-request "
+                             "placement on predicted completion-within-"
+                             "deadline, the brownout degradation ladder, "
+                             "predictive scaling. Forces admission + "
+                             "resilience on (it composes their signals)")
     parser.add_argument("--priority-mix", default="",
                         help="weighted X-Priority draw per request, e.g. "
                              "'interactive:6,default:3,background:1' — "
@@ -1693,6 +1793,10 @@ def main() -> None:
     args = parser.parse_args()
     if args.mode == "sync" and args.model == "pipeline":
         parser.error("the composite pipeline is async-only (task handoffs)")
+    # Expand --mix into concrete knobs HERE, in whichever process parses
+    # the flags — the orchestrator forwards the expanded knobs to its
+    # boxed subprocesses (_forward_argv), so they never re-expand.
+    apply_mix_preset(args)
     args.wire_provenance = None
     if args.wire == "auto":
         # Resolved ONCE here, in whichever process parses "auto" — the

@@ -24,6 +24,16 @@ fi
 
 # Gate 2: ai4e-lint, the platform-invariant analyzer (docs/analysis.md) —
 # all rules, baseline enforced, exit 1 on findings / 2 on config errors.
+# The rule count is printed first and a zero-rule registry FAILS: an
+# import error or refactor that empties ALL_RULES would otherwise scan
+# every file with no rules and report a clean pass (the same silent-
+# disable failure mode --select validation closes for typo'd ids).
+rule_count=$(python -m ai4e_tpu.analysis --list-rules | grep -c '^AIL' || true)
+echo "lint: analyzer registry: ${rule_count} rule(s)"
+if [ "${rule_count}" -eq 0 ]; then
+  echo "lint: analyzer rule registry is EMPTY — refusing to pass" >&2
+  exit 3
+fi
 python -m ai4e_tpu.analysis ai4e_tpu/
 
 echo "lint: both gates clean"

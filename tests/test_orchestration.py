@@ -1,0 +1,960 @@
+"""Deadline-aware orchestration (``ai4e_tpu/orchestration/``,
+docs/orchestration.md): the per-backend completion estimator, the
+cost/deadline placement policy, the brownout degradation ladder and its
+admission wiring, predictive autoscaling (scale-up BEFORE the first
+deadline miss; bounded flapping), the relaxed shards-vs-autoscale
+refusal, config knobs, and the ``orchestration=False`` identity the
+acceptance criteria pin."""
+
+import asyncio
+import time
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.admission.controller import AdmissionController, DecayingRate
+from ai4e_tpu.admission.deadline import BACKGROUND, DEFAULT, INTERACTIVE
+from ai4e_tpu.metrics import MetricsRegistry
+from ai4e_tpu.orchestration import (LEVELS, CompletionEstimator,
+                                    DecayedQuantiles, DegradationLadder,
+                                    Orchestrator, OrchestrationPolicy,
+                                    parse_costs)
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.resilience import BackendHealth, ResiliencePolicy
+from ai4e_tpu.scaling import (AutoscaleController, AutoscalePolicy,
+                              ShardScaleTarget, ShardedAutoscaleController,
+                              predictive_signal)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _health(clock=None) -> BackendHealth:
+    kw = {"clock": clock} if clock is not None else {}
+    return BackendHealth(ResiliencePolicy(failure_threshold=2,
+                                          recovery_seconds=5.0),
+                         metrics=MetricsRegistry(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# DecayedQuantiles
+# ---------------------------------------------------------------------------
+
+class TestDecayedQuantiles:
+    def test_quantile_and_p_le_over_live_window(self):
+        clk = FakeClock()
+        sk = DecayedQuantiles(size=16, horizon_s=10.0, clock=clk)
+        assert sk.quantile(0.5) is None
+        assert sk.p_le(1.0) is None
+        for v in (0.1, 0.2, 0.3, 0.4):
+            sk.observe(v)
+        assert sk.quantile(0.5) == 0.3  # upper median of 4
+        assert sk.p_le(0.2) == 0.5
+        assert sk.p_le(1.0) == 1.0
+        assert sk.p_le(0.05) == 0.0
+
+    def test_old_samples_age_out_of_queries(self):
+        clk = FakeClock()
+        sk = DecayedQuantiles(size=16, horizon_s=10.0, clock=clk)
+        sk.observe(5.0)           # slow past
+        clk.t = 11.0              # ...now stale
+        sk.observe(0.1)
+        assert sk.count() == 1
+        assert sk.quantile(0.5) == 0.1
+        assert sk.p_le(1.0) == 1.0
+
+    def test_bounded_size(self):
+        sk = DecayedQuantiles(size=4, horizon_s=100.0)
+        for v in range(10):
+            sk.observe(float(v))
+        assert sk.count() == 4
+        assert sk.p_le(5.0) == 0.0  # only 6..9 retained
+
+
+# ---------------------------------------------------------------------------
+# CompletionEstimator
+# ---------------------------------------------------------------------------
+
+class TestCompletionEstimator:
+    def test_empirical_probability(self):
+        est = CompletionEstimator(_health(), metrics=MetricsRegistry())
+        for v in (0.1, 0.1, 0.1, 0.9):
+            est.observe("http://b", v)
+        assert est.p_within("http://b", 0.5) == 0.75
+        assert est.p_within("http://b", 1.0) == 1.0
+
+    def test_open_breaker_is_zero_half_open_discounted(self):
+        clk = FakeClock()
+        health = _health(clock=clk)
+        est = CompletionEstimator(health, metrics=MetricsRegistry(),
+                                  clock=clk)
+        for _ in range(4):
+            est.observe("http://b", 0.01)
+        health.record_failure("http://b")
+        health.record_failure("http://b")  # trips (threshold 2)
+        assert est.p_within("http://b", 1.0) == 0.0
+        clk.t = 6.0  # cooldown elapsed: half-open probation
+        health.pick([("http://b", 1)])    # transitions to half-open
+        assert est.p_within("http://b", 1.0) == pytest.approx(0.5)
+
+    def test_cold_backend_answers_cold_prior(self):
+        est = CompletionEstimator(_health(), cold_p=1.0,
+                                  metrics=MetricsRegistry())
+        assert est.p_within("http://new", 0.5) == 1.0
+        est2 = CompletionEstimator(_health(), cold_p=0.25,
+                                   metrics=MetricsRegistry())
+        assert est2.p_within("http://new", 0.5) == 0.25
+
+    def test_inflight_pressure_discounts_the_budget(self):
+        est = CompletionEstimator(_health(), parallelism=1,
+                                  metrics=MetricsRegistry())
+        for _ in range(4):
+            est.observe("http://b", 0.4)
+        assert est.p_within("http://b", 0.5) == 1.0
+        est.begin("http://b")  # one delivery ahead: +p50 of wait
+        assert est.p_within("http://b", 0.5) == 0.0
+        est.end("http://b")
+        assert est.p_within("http://b", 0.5) == 1.0
+        est.end("http://b")  # never negative
+        assert est.inflight("http://b") == 0
+
+    def test_infinite_budget_always_clears_when_not_open(self):
+        est = CompletionEstimator(_health(), metrics=MetricsRegistry())
+        assert est.p_within("http://b", float("inf")) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Placement
+# ---------------------------------------------------------------------------
+
+TPU = "http://tpu-1:9/v1/x"
+CPU = "http://cpu-1:9/v1/x"
+BACKENDS = [(TPU, 1.0), (CPU, 1.0)]
+COSTS = {"tpu": 3.0, "cpu": 1.0}
+
+
+def _orch(clock=None, **policy_kw) -> Orchestrator:
+    clk = clock or FakeClock()
+    policy = OrchestrationPolicy(costs=dict(COSTS), **policy_kw)
+    return Orchestrator(_health(clock=clk), policy=policy,
+                        metrics=MetricsRegistry(), clock=clk)
+
+
+def _teach(orch, uri, rtt, n=8):
+    for _ in range(n):
+        orch.observe(uri, rtt)
+
+
+class TestPlacement:
+    def test_no_deadline_takes_the_cheapest_tier(self):
+        orch = _orch()
+        _teach(orch, TPU, 0.01)
+        _teach(orch, CPU, 2.0)
+        assert orch.place(BACKENDS) == CPU
+
+    def test_tight_deadline_falls_through_to_the_fast_tier(self):
+        orch = _orch()
+        _teach(orch, TPU, 0.01)
+        _teach(orch, CPU, 2.0)
+        assert orch.place(BACKENDS,
+                          deadline_at=time.time() + 1.0) == TPU
+
+    def test_loose_deadline_stays_cheap(self):
+        orch = _orch()
+        _teach(orch, TPU, 0.01)
+        _teach(orch, CPU, 2.0)
+        assert orch.place(BACKENDS,
+                          deadline_at=time.time() + 30.0) == CPU
+
+    def test_nobody_clears_serves_best_p_and_notes_a_predicted_miss(self):
+        orch = _orch(ladder_up=0.3)
+        _teach(orch, TPU, 0.1, n=4)
+        _teach(orch, TPU, 2.0, n=4)   # TPU: p_le(0.7) = 0.5 — below the bar
+        _teach(orch, CPU, 2.0)        # CPU: p_le(0.7) = 0.0
+        chosen = orch.place(BACKENDS, deadline_at=time.time() + 0.7)
+        assert chosen == TPU
+        c = orch.metrics.counter("ai4e_orchestration_placements_total", "")
+        assert c.value(backend="tpu-1:9", outcome="fallback") == 1
+        assert orch.ladder._miss.rate(0.0) > 0  # read on the fake clock
+
+    def test_exclude_reaches_a_different_backend(self):
+        orch = _orch()
+        _teach(orch, TPU, 0.01)
+        _teach(orch, CPU, 0.01)
+        assert orch.place(BACKENDS, exclude=(CPU,)) == TPU
+        assert orch.place(BACKENDS, exclude=(TPU,)) == CPU
+
+    def test_all_dark_delegates_to_the_forced_probe(self):
+        clk = FakeClock()
+        orch = _orch(clock=clk)
+        for uri in (TPU, CPU):
+            orch.health.record_failure(uri)
+            orch.health.record_failure(uri)
+        assert orch.health.state(TPU) == "open"
+        chosen = orch.place(BACKENDS, deadline_at=time.time() + 1.0)
+        assert chosen in (TPU, CPU)
+        c = orch.metrics.counter("ai4e_orchestration_placements_total", "")
+        assert sum(v for *_, v in c.collect()
+                   ) == c.value(backend=chosen.split("//")[1].split("/")[0],
+                                outcome="forced")
+
+    def test_recovered_backend_gets_a_priority_probe(self):
+        # The live-drive regression: an OPEN breaker's backend has
+        # estimate 0, so after its cooldown a p-based walk would keep
+        # choosing the healthy peer forever and the probe that closes
+        # the breaker would never fire. Placement must divert ONE
+        # request (probe-slot bounded) to the recovered candidate.
+        clk = FakeClock()
+        orch = _orch(clock=clk)
+        _teach(orch, TPU, 0.01)
+        _teach(orch, CPU, 0.01)
+        orch.health.record_failure(TPU)
+        orch.health.record_failure(TPU)  # trips (threshold 2)
+        assert orch.health.state(TPU) == "open"
+        clk.t = 6.0  # cooldown (5 s) elapsed
+        chosen = orch.place(BACKENDS, deadline_at=time.time() + 1.0)
+        assert chosen == TPU
+        c = orch.metrics.counter("ai4e_orchestration_placements_total", "")
+        assert c.value(backend="tpu-1:9", outcome="probe") == 1
+        # The probe slot is booked: the NEXT placement is not diverted.
+        assert orch.place(BACKENDS, deadline_at=time.time() + 1.0) == CPU
+        # Probe succeeds → breaker closes → normal placement resumes.
+        orch.health.observe_status(TPU, 200)
+        assert orch.health.state(TPU) == "closed"
+
+    def test_open_backend_is_never_placed_on(self):
+        orch = _orch()
+        _teach(orch, CPU, 0.01)
+        orch.health.record_failure(CPU)
+        orch.health.record_failure(CPU)
+        assert orch.health.state(CPU) == "open"
+        for _ in range(5):
+            assert orch.place(BACKENDS) == TPU
+
+    def test_brownout_restricts_background_to_the_cheap_tier(self):
+        orch = _orch()
+        _teach(orch, TPU, 0.01)
+        _teach(orch, CPU, 0.05)
+        orch.ladder.level = 1  # reroute_background
+        # Background with a tight-ish budget the CPU tier still clears:
+        # restricted to the cheap tier even though TPU also clears.
+        assert orch.place(BACKENDS, deadline_at=time.time() + 1.0,
+                          priority=BACKGROUND) == CPU
+        # Interactive is untouched by level 1.
+        assert orch.place(BACKENDS, deadline_at=time.time() + 0.02,
+                          priority=INTERACTIVE) == TPU
+
+    def test_equal_cost_tier_keeps_the_canary_split(self):
+        # Review finding: a deterministic first-clears-wins walk starves
+        # the minority backend of an equal-cost weighted canary pair.
+        # The choice within a clearing tier is a weighted pick.
+        import random as _random
+        orch = _orch()
+        orch.policy.costs = {}  # equal cost everywhere
+        pair = [(TPU, 9.0), (CPU, 1.0)]
+        _teach(orch, TPU, 0.01)
+        _teach(orch, CPU, 0.01)
+        rng = _random.Random(7)
+        counts = {TPU: 0, CPU: 0}
+        for _ in range(300):
+            counts[orch.place(pair, deadline_at=time.time() + 5.0,
+                              rng=rng)] += 1
+        assert counts[CPU] > 0, "canary starved"
+        assert counts[TPU] > counts[CPU]  # split respects the weights
+        assert 10 <= counts[CPU] <= 90    # ~10% of 300, wide tolerance
+
+    def test_parse_costs(self):
+        assert parse_costs("tpu=3, cpu-fallback=1") == {
+            "tpu": 3.0, "cpu-fallback": 1.0}
+        assert parse_costs(None) == {}
+        with pytest.raises(ValueError):
+            parse_costs("tpu")
+
+
+# ---------------------------------------------------------------------------
+# Degradation ladder
+# ---------------------------------------------------------------------------
+
+def _ladder(clk, **kw):
+    defaults = dict(up=0.5, down=0.1, hold_s=5.0, min_rate=0.05, tau_s=5.0,
+                    metrics=MetricsRegistry(), clock=clk)
+    defaults.update(kw)
+    return DegradationLadder(**defaults)
+
+
+class TestDegradationLadder:
+    def test_steps_up_only_after_sustained_pressure(self):
+        clk = FakeClock()
+        ladder = _ladder(clk)
+        for t in range(4):
+            clk.t = float(t)
+            ladder.note(miss=True)
+        assert ladder.level == 0  # 4 s of pressure < hold_s
+        clk.t = 6.0
+        ladder.note(miss=True)
+        assert ladder.level == 1
+        assert ladder.mode == "reroute_background"
+
+    def test_one_level_per_hold_window(self):
+        clk = FakeClock()
+        ladder = _ladder(clk)
+        for t in range(30):
+            clk.t = float(t)
+            ladder.note(miss=True)
+        # 30 s of solid pressure at hold_s=5: at most one step per hold.
+        assert ladder.level <= 30 // 5
+        assert ladder.level >= 2
+
+    def test_steps_down_hysteretically_when_pressure_clears(self):
+        clk = FakeClock()
+        ladder = _ladder(clk)
+        for t in range(12):
+            clk.t = float(t)
+            ladder.note(miss=True)
+        high = ladder.level
+        assert high >= 1
+        # Good outcomes flood in: pressure ratio collapses.
+        for i in range(200):
+            clk.t = 12.0 + i * 0.1
+            ladder.note(miss=False)
+        assert ladder.level < high
+        # A single good event must NOT have stepped down instantly:
+        clk2 = FakeClock()
+        l2 = _ladder(clk2)
+        for t in range(12):
+            clk2.t = float(t)
+            l2.note(miss=True)
+        lvl = l2.level
+        clk2.t = 12.1
+        l2.note(miss=False)
+        assert l2.level == lvl
+
+    def test_idle_platform_decays_back_to_normal(self):
+        clk = FakeClock()
+        ladder = _ladder(clk, min_rate=0.5)
+        for t in range(12):
+            clk.t = float(t)
+            ladder.note(miss=True)
+            ladder.note(miss=True)
+        assert ladder.level >= 1
+        # Silence: rates decay under min_rate → pressure reads 0 → the
+        # ladder steps down one hold at a time.
+        for t in range(100):
+            clk.t = 12.0 + t
+            ladder.evaluate()
+        assert ladder.level == 0
+
+    def test_refusals_by_level(self):
+        clk = FakeClock()
+        ladder = _ladder(clk)
+        ladder.level = 1
+        assert ladder.refuse(BACKGROUND) is None
+        ladder.level = 2
+        assert ladder.refuse(BACKGROUND) == "shed_background"
+        assert ladder.refuse(DEFAULT) is None
+        ladder.level = 3
+        assert ladder.refuse(DEFAULT) == "shed_default"
+        assert ladder.refuse(INTERACTIVE) is None
+        ladder.level = 4
+        assert ladder.refuse(INTERACTIVE) == "shed_interactive"
+        c = ladder.metrics.counter(
+            "ai4e_orchestration_brownout_refusals_total", "")
+        assert c.value(priority="background", mode="shed_background") == 1
+        assert c.value(priority="interactive", mode="shed_interactive") == 1
+
+    def test_transitions_metered_and_gauged(self):
+        clk = FakeClock()
+        ladder = _ladder(clk)
+        for t in range(12):
+            clk.t = float(t)
+            ladder.note(miss=True)
+        g = ladder.metrics.gauge("ai4e_orchestration_ladder_level", "")
+        assert g.value() == ladder.level >= 1
+        c = ladder.metrics.counter(
+            "ai4e_orchestration_ladder_transitions_total", "")
+        ups = sum(v for _, _, labels, v in c.collect()
+                  if labels.get("direction") == "up")
+        assert ups == ladder.level
+
+    def test_full_brownout_unwedges_on_refusal_consults(self):
+        # Review finding: at shed_interactive every admission is
+        # refused, so nothing calls note() and the ladder would wedge
+        # at full brownout forever. refuse() re-evaluates transitions,
+        # so retrying clients (they were told Retry-After) are the
+        # clock that steps a stale brownout down.
+        clk = FakeClock()
+        ladder = _ladder(clk, min_rate=0.5)
+        ladder.level = 4
+        assert ladder.refuse(INTERACTIVE) is not None
+        # Total silence: rates decay under the evidence floor; each
+        # consult is one evaluate() tick — one step down per hold.
+        for t in range(100):
+            clk.t = float(t)
+            if ladder.refuse(INTERACTIVE) is None:
+                break
+        assert ladder.level < 4
+        for t in range(100, 300):
+            clk.t = float(t)
+            ladder.refuse(BACKGROUND)
+        assert ladder.level == 0
+        assert ladder.refuse(BACKGROUND) is None
+
+    def test_threshold_validation(self):
+        with pytest.raises(ValueError):
+            DegradationLadder(up=0.1, down=0.3,
+                              metrics=MetricsRegistry())
+
+    def test_levels_are_the_documented_five(self):
+        assert LEVELS == ("normal", "reroute_background", "shed_background",
+                          "shed_default", "shed_interactive")
+
+
+# ---------------------------------------------------------------------------
+# Admission wiring (brownout refusals, arrival rate)
+# ---------------------------------------------------------------------------
+
+class TestAdmissionBrownout:
+    def _adm_with_ladder(self, level):
+        adm = AdmissionController(metrics=MetricsRegistry())
+        clk = FakeClock()
+        ladder = _ladder(clk)
+        ladder.level = level
+        adm.set_ladder(ladder)
+        return adm
+
+    def test_shed_async_refuses_brownout_first(self):
+        adm = self._adm_with_ladder(2)
+        decision = adm.shed_async(BACKGROUND, backlog=0)
+        assert decision is not None and decision[1] == "brownout"
+        assert adm.shed_async(INTERACTIVE, backlog=0) is None
+
+    def test_brownout_refusal_for_the_sync_proxy(self):
+        adm = self._adm_with_ladder(4)
+        got = adm.brownout_refusal(INTERACTIVE)
+        assert got is not None
+        retry_after, mode = got
+        assert retry_after >= 1.0 and mode == "shed_interactive"
+        assert AdmissionController(
+            metrics=MetricsRegistry()).brownout_refusal(INTERACTIVE) is None
+
+    def test_arrival_rate_counts_created_tasks_only(self):
+        from ai4e_tpu.taskstore import APITask, InMemoryTaskStore
+        adm = AdmissionController(metrics=MetricsRegistry())
+        store = InMemoryTaskStore()
+        adm.attach_store(store)
+        t = store.upsert(APITask(endpoint="/v1/x", publish=False))
+        assert adm.arrival_rate() > 0
+        before = adm._arrivals.rate()
+        # Status rewrites (backpressure AWAITING, completion) are not
+        # arrivals.
+        store.update_status(t.task_id, "Awaiting service availability",
+                            "created")
+        store.update_status(t.task_id, "completed", "completed")
+        assert adm._arrivals.rate() <= before
+
+    def test_per_route_rates_do_not_cross_routes(self):
+        # Review finding: predictive signals read the admission
+        # controller's rates per ROUTE — a flooded sibling route must
+        # not inflate an idle route's projection.
+        from ai4e_tpu.taskstore import APITask, InMemoryTaskStore
+        adm = AdmissionController(metrics=MetricsRegistry())
+        store = InMemoryTaskStore()
+        adm.attach_store(store)
+        for _ in range(5):
+            t = store.upsert(APITask(endpoint="/v1/flooded/x",
+                                     publish=False))
+            store.update_status(t.task_id, "completed", "completed")
+        assert adm.arrival_rate(route="/v1/flooded/x") > 0
+        assert adm.route_drain_rate("/v1/flooded/x") > 0
+        assert adm.arrival_rate(route="/v1/idle/x") == 0.0
+        assert adm.route_drain_rate("/v1/idle/x") == 0.0
+        # The platform-wide gauge is live from the LISTENER alone —
+        # production readers only call the per-route form, which must
+        # not be what keeps the documented gauge at zero.
+        g = adm.metrics.gauge("ai4e_admission_arrival_rate", "")
+        assert g.value() > 0
+        # The platform-wide figures still aggregate everything.
+        assert adm.arrival_rate() > 0
+
+    def test_terminal_outcomes_feed_the_ladder(self):
+        from ai4e_tpu.taskstore import APITask, InMemoryTaskStore
+        adm = AdmissionController(metrics=MetricsRegistry())
+        clk = FakeClock()
+        ladder = _ladder(clk)
+        adm.set_ladder(ladder)
+        store = InMemoryTaskStore()
+        adm.attach_store(store)
+        # late completion (deadline in the past) → miss evidence
+        # The ladder runs on the fake clock (pinned at 0) — read its
+        # rates on the same clock.
+        t = store.upsert(APITask(endpoint="/v1/x", publish=False,
+                                 deadline_at=time.time() - 5.0))
+        store.update_status(t.task_id, "completed", "completed")
+        assert ladder._miss.rate(0.0) > 0
+        miss_before = ladder._miss.rate(0.0)
+        total_before = ladder._total.rate(0.0)
+        # in-deadline completion → ok evidence (total up, miss unchanged)
+        t2 = store.upsert(APITask(endpoint="/v1/x", publish=False,
+                                  deadline_at=time.time() + 60.0))
+        store.update_status(t2.task_id, "completed", "completed")
+        assert ladder._miss.rate(0.0) == miss_before
+        assert ladder._total.rate(0.0) > total_before
+        # expired → miss evidence
+        t3 = store.upsert(APITask(endpoint="/v1/x", publish=False,
+                                  deadline_at=time.time() - 1.0))
+        store.update_status(t3.task_id, "expired - deadline", "expired")
+        assert ladder._miss.rate(0.0) > miss_before
+
+
+# ---------------------------------------------------------------------------
+# Predictive autoscaling
+# ---------------------------------------------------------------------------
+
+class _FakeTarget:
+    def __init__(self, replicas=1):
+        self._n = replicas
+        self.history = []
+
+    @property
+    def replicas(self):
+        return self._n
+
+    def scale_to(self, n):
+        self._n = n
+
+
+class TestPredictiveSignal:
+    def test_projection_math(self):
+        sig = predictive_signal(lambda: 4.0, lambda: 12.0, lambda: 2.0,
+                                horizon_s=10.0)
+        assert sig() == 4.0 + 10.0 * 10.0
+        # draining queue: no negative projection, depth only
+        sig2 = predictive_signal(lambda: 4.0, lambda: 1.0, lambda: 9.0,
+                                 horizon_s=10.0)
+        assert sig2() == 4.0
+
+
+class _RampSim:
+    """Deterministic overload ramp: arrivals climb past capacity; each
+    replica drains 5 tasks/s; a task MISSES its 2 s deadline when the
+    backlog at its arrival exceeds 2 s of drain. Used twice — once
+    unscaled to find the counterfactual first-miss time, once under a
+    controller to timestamp its first scale-up."""
+
+    PER_REPLICA = 5.0
+    DEADLINE_S = 2.0
+
+    @staticmethod
+    def arrival_at(t: float) -> float:
+        return 2.0 if t < 10 else min(20.0, 2.0 + 2.0 * (t - 10))
+
+    def __init__(self):
+        self.arrivals = DecayingRate(tau_s=5.0)
+        self.drains = DecayingRate(tau_s=5.0)
+        self.depth = 0.0
+
+    def step(self, t: float, replicas: int) -> bool:
+        """Advance one second; returns True when a task arriving at t
+        would miss its deadline (wait > DEADLINE_S)."""
+        arrival = self.arrival_at(t)
+        capacity = replicas * self.PER_REPLICA
+        processed = min(self.depth + arrival, capacity)
+        self.depth = self.depth + arrival - processed
+        self.arrivals.on_event(n=arrival, now=t)
+        if processed:
+            self.drains.on_event(n=processed, now=t)
+        wait = self.depth / capacity if capacity else float("inf")
+        return wait > self.DEADLINE_S
+
+
+class TestPredictiveScaler:
+    POLICY = AutoscalePolicy(min_replicas=1, max_replicas=20,
+                             target_per_replica=10.0,
+                             stabilization_seconds=30.0)
+
+    def _first_miss_unscaled(self) -> float:
+        sim = _RampSim()
+        for t in range(60):
+            if sim.step(float(t), replicas=1):
+                return float(t)
+        raise AssertionError("ramp never missed — sim broken")
+
+    def _drive(self, predictive: bool) -> tuple[float | None, float | None]:
+        """(first scale-up time, first miss time) under a live controller."""
+        sim = _RampSim()
+        clk = FakeClock()
+        target = _FakeTarget(replicas=1)
+        depth = lambda: sim.depth  # noqa: E731
+        # Rates read on the sim clock (the assembly reads them on the
+        # same monotonic clock it feeds them with; here that's clk).
+        signal = (predictive_signal(depth,
+                                    lambda: sim.arrivals.rate(clk.t),
+                                    lambda: sim.drains.rate(clk.t),
+                                    horizon_s=10.0)
+                  if predictive else depth)
+        ctrl = AutoscaleController(None, "/v1/x", target,
+                                   policy=self.POLICY, signal=signal,
+                                   metrics=MetricsRegistry(), clock=clk)
+        first_up = first_miss = None
+        for t in range(60):
+            clk.t = float(t)
+            missed = sim.step(float(t), target.replicas)
+            if missed and first_miss is None:
+                first_miss = float(t)
+            before = target.replicas
+            ctrl.tick()
+            if target.replicas > before and first_up is None:
+                first_up = float(t)
+        return first_up, first_miss
+
+    def test_scales_up_before_the_first_deadline_miss(self):
+        baseline_miss = self._first_miss_unscaled()
+        first_up, first_miss = self._drive(predictive=True)
+        assert first_up is not None
+        # The acceptance bar: capacity moved BEFORE the moment the
+        # unscaled platform starts missing deadlines...
+        assert first_up < baseline_miss, (first_up, baseline_miss)
+        # ...and with the predictive signal the scaled run never misses
+        # at all in this ramp.
+        assert first_miss is None or first_up < first_miss
+
+    def test_predictive_beats_depth_only(self):
+        pred_up, _ = self._drive(predictive=True)
+        react_up, _ = self._drive(predictive=False)
+        assert pred_up is not None and react_up is not None
+        assert pred_up <= react_up
+
+    def test_scale_down_hysteresis_bounds_flapping(self):
+        # Noisy signal oscillating hard around a mean: the stabilization
+        # window must keep actuation to <= 1 direction change per window.
+        clk = FakeClock()
+        target = _FakeTarget(replicas=2)
+        values = [28.0 if t % 2 == 0 else 6.0 for t in range(90)]
+        it = iter(values)
+        ctrl = AutoscaleController(None, "/v1/x", target,
+                                   policy=self.POLICY,
+                                   signal=lambda: next(it),
+                                   metrics=MetricsRegistry(), clock=clk)
+        changes = []  # (t, direction)
+        for t in range(90):
+            clk.t = float(t)
+            before = target.replicas
+            ctrl.tick()
+            if target.replicas != before:
+                changes.append((float(t),
+                                1 if target.replicas > before else -1))
+        window = self.POLICY.stabilization_seconds
+        for t0, d0 in changes:
+            in_window = [(t, d) for t, d in changes if t0 <= t < t0 + window]
+            directions = [d for _, d in in_window]
+            # ≤ 1 direction CHANGE per stabilization window.
+            flips = sum(1 for a, b in zip(directions, directions[1:])
+                        if a != b)
+            assert flips <= 1, changes
+
+    def test_decision_counter_lands_in_the_passed_registry(self):
+        reg = MetricsRegistry()
+        clk = FakeClock()
+        target = _FakeTarget(replicas=1)
+        ctrl = AutoscaleController(None, "/v1/x", target,
+                                   policy=self.POLICY,
+                                   signal=lambda: 100.0,
+                                   metrics=reg, clock=clk)
+        ctrl.tick()
+        c = reg.counter("ai4e_autoscale_decisions_total", "")
+        assert c.value(endpoint="/v1/x", direction="up") == 1
+        from ai4e_tpu.metrics import DEFAULT_REGISTRY
+        assert DEFAULT_REGISTRY.counter(
+            "ai4e_autoscale_decisions_total", "").value(
+            endpoint="/v1/x", direction="up") == 0
+
+
+class TestShardScaleTarget:
+    class _D:
+        def __init__(self, n=1):
+            self.concurrency = n
+
+        def set_concurrency(self, n):
+            self.concurrency = n
+
+    def test_even_split_with_remainder_low(self):
+        ds = [self._D(), self._D(), self._D()]
+        target = ShardScaleTarget(ds)
+        target.scale_to(8)
+        assert [d.concurrency for d in ds] == [3, 3, 2]
+        assert target.replicas == 8
+        target.scale_shard(1, 7)
+        assert target.shard_replicas(1) == 7
+
+    def test_per_shard_decisions_one_actuator(self):
+        ds = [self._D(), self._D()]
+        target = ShardScaleTarget(ds)
+        clk = FakeClock()
+        hot = [40.0]
+        cold = [1.0]
+        ctrl = ShardedAutoscaleController(
+            [("/q#s0", lambda: hot[0]), ("/q#s1", lambda: cold[0])],
+            target, policy=TestPredictiveScaler.POLICY,
+            metrics=MetricsRegistry(), clock=clk)
+        ctrl.tick()
+        # The hot shard fans out, the cold shard stays put.
+        assert ds[0].concurrency > 1
+        assert ds[1].concurrency == 1
+
+    def test_misaligned_signals_refused(self):
+        with pytest.raises(ValueError):
+            ShardedAutoscaleController(
+                [("/q#s0", lambda: 0.0)],
+                ShardScaleTarget([self._D(), self._D()]),
+                metrics=MetricsRegistry())
+
+
+# ---------------------------------------------------------------------------
+# Assembly / config
+# ---------------------------------------------------------------------------
+
+class TestAssembly:
+    def test_orchestration_off_is_identity(self):
+        platform = LocalPlatform(PlatformConfig(), metrics=MetricsRegistry())
+        assert platform.orchestration is None
+        assert platform.gateway._orchestration is None
+        platform.publish_async_api("/v1/p/x", "http://b:1/v1/p/x")
+        d = platform.dispatchers.dispatchers["/v1/p/x"]
+        assert d.orchestration is None
+        # Same assertion under admission+resilience without the flag —
+        # the layers orchestration composes must not auto-enable it.
+        p2 = LocalPlatform(PlatformConfig(admission=True, resilience=True),
+                           metrics=MetricsRegistry())
+        assert p2.orchestration is None
+        assert p2.admission._ladder is None
+
+    def test_orchestration_requires_admission_and_resilience(self):
+        for kw in ({}, {"admission": True}, {"resilience": True}):
+            with pytest.raises(ValueError, match="orchestration"):
+                LocalPlatform(PlatformConfig(orchestration=True, **kw),
+                              metrics=MetricsRegistry())
+
+    def test_orchestration_assembly_wires_everything(self):
+        platform = LocalPlatform(
+            PlatformConfig(orchestration=True, admission=True,
+                           resilience=True,
+                           orchestration_costs="tpu=3,cpu=1"),
+            metrics=MetricsRegistry())
+        platform.publish_async_api("/v1/p/x", "http://b:1/v1/p/x")
+        d = platform.dispatchers.dispatchers["/v1/p/x"]
+        assert d.orchestration is platform.orchestration
+        assert platform.gateway._orchestration is platform.orchestration
+        assert platform.admission._ladder is platform.orchestration.ladder
+        assert platform.orchestration.cost_of("http://tpu-9") == 3.0
+        assert platform.orchestration.cost_of("http://other") == 1.0
+
+    def test_shards_plus_autoscale_needs_orchestration(self):
+        p = LocalPlatform(PlatformConfig(task_shards=2),
+                          metrics=MetricsRegistry())
+        with pytest.raises(ValueError, match="orchestration"):
+            p.publish_async_api("/v1/p/x", "http://b:1/v1/p/x",
+                                autoscale=AutoscalePolicy())
+        p2 = LocalPlatform(
+            PlatformConfig(task_shards=2, orchestration=True,
+                           admission=True, resilience=True),
+            metrics=MetricsRegistry())
+        p2.publish_async_api("/v1/p/x", "http://b:1/v1/p/x",
+                             autoscale=AutoscalePolicy())
+        assert len(p2.autoscalers) == 1
+        assert isinstance(p2.autoscalers[0], ShardedAutoscaleController)
+        p2.autoscalers[0].tick()  # signals resolve against live stores
+
+    def test_unsharded_autoscale_gets_the_predictive_signal(self):
+        p = LocalPlatform(
+            PlatformConfig(orchestration=True, admission=True,
+                           resilience=True),
+            metrics=MetricsRegistry())
+        p.publish_async_api("/v1/p/x", "http://b:1/v1/p/x",
+                            autoscale=AutoscalePolicy())
+        ctrl = p.autoscalers[0]
+        assert ctrl.signal is not ctrl._default_signal
+        ctrl.tick()
+
+    def test_env_knobs_round_trip(self):
+        from ai4e_tpu.config import PlatformSection
+        sec = PlatformSection.from_env(env={
+            "AI4E_PLATFORM_ORCHESTRATION": "1",
+            "AI4E_PLATFORM_ORCHESTRATION_CONFIDENCE": "0.9",
+            "AI4E_PLATFORM_ORCHESTRATION_WINDOW": "64",
+            "AI4E_PLATFORM_ORCHESTRATION_HORIZON_S": "30",
+            "AI4E_PLATFORM_ORCHESTRATION_COSTS": "tpu=3,cpu=1",
+            "AI4E_PLATFORM_ORCHESTRATION_LADDER_UP": "0.4",
+            "AI4E_PLATFORM_ORCHESTRATION_LADDER_DOWN": "0.05",
+            "AI4E_PLATFORM_ORCHESTRATION_LADDER_HOLD_S": "2.5",
+            "AI4E_PLATFORM_ORCHESTRATION_SCALE_HORIZON_S": "15",
+        })
+        pc = sec.to_platform_config()
+        assert pc.orchestration is True
+        assert pc.orchestration_confidence == 0.9
+        assert pc.orchestration_window == 64
+        assert pc.orchestration_horizon_s == 30.0
+        assert pc.orchestration_costs == "tpu=3,cpu=1"
+        assert pc.orchestration_ladder_up == 0.4
+        assert pc.orchestration_ladder_down == 0.05
+        assert pc.orchestration_ladder_hold_s == 2.5
+        assert pc.orchestration_scale_horizon_s == 15.0
+
+    def test_orchestration_metrics_land_in_the_assembly_registry(self):
+        reg = MetricsRegistry()
+        platform = LocalPlatform(
+            PlatformConfig(orchestration=True, admission=True,
+                           resilience=True), metrics=reg)
+        platform.publish_async_api("/v1/p/x", "http://b:1/v1/p/x")
+        platform.orchestration.place(
+            platform.dispatchers.dispatchers["/v1/p/x"].backends)
+        rendered = reg.render_prometheus()
+        assert "ai4e_orchestration_placements_total" in rendered
+        assert "ai4e_orchestration_ladder_level" in rendered
+
+
+# ---------------------------------------------------------------------------
+# Gateway brownout behavior (async edge + sync proxy + cache-only)
+# ---------------------------------------------------------------------------
+
+def _orch_platform(**extra):
+    return LocalPlatform(PlatformConfig(
+        orchestration=True, admission=True, resilience=True,
+        retry_delay=0.01, resilience_retry_base_s=0.001, **extra),
+        metrics=MetricsRegistry())
+
+
+class TestGatewayBrownout:
+    def test_async_edge_sheds_brownout_with_reason(self):
+        async def main():
+            platform = _orch_platform()
+            platform.publish_async_api("/v1/pub/x", "http://b:1/v1/be/x")
+            platform.orchestration.ladder.level = 2
+            gw = await serve(platform.gateway.app)
+            try:
+                resp = await gw.post("/v1/pub/x", data=b"p",
+                                     headers={"X-Priority": "background"})
+                assert resp.status == 429
+                assert resp.headers["X-Shed-Reason"] == "brownout at gateway"
+                assert int(resp.headers["Retry-After"]) >= 1
+                # Interactive still admitted at level 2 (task created).
+                resp2 = await gw.post("/v1/pub/x", data=b"p",
+                                      headers={"X-Priority": "interactive"})
+                assert resp2.status == 200
+            finally:
+                await gw.close()
+
+        run(main())
+
+    def test_sync_proxy_sheds_brownout_503(self):
+        async def main():
+            platform = _orch_platform()
+
+            async def handler(request):
+                return web.Response(text="ok")
+
+            app = web.Application()
+            app.router.add_post("/v1/be/s", handler)
+            be = await serve(app)
+            platform.publish_sync_api("/v1/pub/s",
+                                      str(be.make_url("/v1/be/s")))
+            platform.orchestration.ladder.level = 4
+            gw = await serve(platform.gateway.app)
+            try:
+                resp = await gw.post("/v1/pub/s", data=b"p")
+                assert resp.status == 503
+                assert resp.headers["X-Shed-Reason"] == (
+                    "brownout at gateway_sync")
+                # GETs pass through untouched (admission is POST-only).
+                resp_get = await gw.get("/v1/pub/s")
+                assert resp_get.status == 405  # backend has no GET route
+            finally:
+                await gw.close()
+                await be.close()
+
+        run(main())
+
+    def test_sync_get_rtts_never_feed_the_estimator(self):
+        # Review finding: a sync route's fast GET probes must not teach
+        # the estimator a service time no inference POST will see —
+        # observe() is gated on the admitted-POST condition.
+        async def main():
+            platform = _orch_platform()
+
+            async def get_handler(request):
+                return web.Response(text="healthy")
+
+            app = web.Application()
+            app.router.add_get("/v1/be/g", get_handler)
+            app.router.add_route("*", "/v1/be/g/{tail:.*}", get_handler)
+            be = await serve(app)
+            platform.publish_sync_api("/v1/pub/g",
+                                      str(be.make_url("/v1/be/g")))
+            gw = await serve(platform.gateway.app)
+            try:
+                for _ in range(3):
+                    resp = await gw.get("/v1/pub/g")
+                    assert resp.status == 200
+                assert not platform.orchestration.estimator._sketches
+            finally:
+                await gw.close()
+                await be.close()
+
+        run(main())
+
+    def test_cache_hits_still_serve_under_full_brownout(self):
+        async def main():
+            platform = _orch_platform(result_cache=True)
+
+            async def handler(request):
+                tid = request.headers["taskId"]
+                from ai4e_tpu.taskstore import TaskStatus
+                platform.store.set_result(tid, b"cached-answer", "text/plain")
+                platform.store.update_status_if(
+                    tid, "created", "completed", TaskStatus.COMPLETED)
+                return web.Response(text="ok")
+
+            app = web.Application()
+            app.router.add_post("/v1/be/c", handler)
+            be = await serve(app)
+            platform.publish_async_api("/v1/pub/c",
+                                       str(be.make_url("/v1/be/c")))
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                # Fill the cache at level 0.
+                resp = await gw.post("/v1/pub/c", data=b"same")
+                tid = (await resp.json())["TaskId"]
+                r = await gw.get(f"/v1/taskmanagement/task/{tid}",
+                                 params={"wait": "10"})
+                assert "completed" in (await r.json())["Status"]
+                # Full brownout: identical request → cache hit, 200;
+                # novel request → 429 brownout.
+                platform.orchestration.ladder.level = 4
+                hit = await gw.post("/v1/pub/c", data=b"same")
+                assert hit.status == 200
+                assert hit.headers["X-Cache"] == "hit"
+                miss = await gw.post("/v1/pub/c", data=b"different")
+                assert miss.status == 429
+                assert miss.headers["X-Shed-Reason"] == "brownout at gateway"
+            finally:
+                await platform.stop()
+                await gw.close()
+                await be.close()
+
+        run(main())

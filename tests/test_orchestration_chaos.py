@@ -1,0 +1,443 @@
+"""Dark-fleet chaos acceptance for deadline-aware orchestration
+(``ai4e_tpu/orchestration/``, docs/orchestration.md):
+
+- **the acceptance scenario** — a mixed fleet (3 fast TPU-class backends
+  at cost 3, one slow CPU fallback at cost 1) behind one async route on
+  a 2-shard store, seeded background fault noise, and 1 of the 3
+  TPU-class backends BLACKED OUT for the middle third of the run (30% of
+  that tier's capacity dark). The bar: interactive goodput
+  (within-deadline completions) holds within 15% of a fault-free
+  baseline run of the identical seeded workload, background traffic
+  rides the cheap tier (reroute) or sheds, and the InvariantChecker is
+  clean — 0 lost, 0 duplicate completions — globally AND per shard;
+
+- **the combined scenario** — ``kill_shard_primary`` lands DURING a
+  dark-backend brownout (ladder at ``shed_background``): the shard
+  failover's fencing epoch bumps, orchestration keeps placing around the
+  dark backend, background stays refused with brownout provenance,
+  interactive completes, and once darkness lifts the ladder steps back
+  down — shard failover and the degradation ladder compose.
+
+Both replay on the fixed ``AI4E_CHAOS_SEED`` CI pins (chaos-smoke job);
+verified locally across seeds 1, 2, 3, 7 and 42.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.chaos import (FaultInjector, InvariantChecker,
+                            RestartableBackend, wrap_platform_http,
+                            wrap_publish_duplicates)
+from ai4e_tpu.chaos.harness import kill_shard_primary
+from ai4e_tpu.metrics import MetricsRegistry
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.taskstore import TaskStatus
+
+SEED = int(os.environ.get("AI4E_CHAOS_SEED", "20260803"))
+
+INTERACTIVE_DEADLINE_MS = 2000.0
+BACKGROUND_DEADLINE_MS = 30000.0
+# Slow tier: strictly slower than the interactive budget, so the
+# estimator can NEVER clear it for interactive work (the tier split is
+# deterministic: interactive → TPU-class, background → cheap CPU).
+CPU_LATENCY_S = 2.5
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def _platform(tmp_path=None, replicas=0, **extra):
+    return LocalPlatform(PlatformConfig(
+        orchestration=True, admission=True, resilience=True,
+        task_shards=2,
+        journal_path=(str(tmp_path / "shards") if tmp_path else None),
+        task_shard_replicas=replicas,
+        retry_delay=0.01,
+        lease_seconds=2.0,
+        resilience_retry_base_s=0.001,
+        resilience_failure_threshold=3,
+        resilience_recovery_seconds=0.2,
+        **extra), metrics=MetricsRegistry())
+
+
+def _completing_app(platform, latency_s: float = 0.0) -> web.Application:
+    """A worker that adopts (``running``) then completes tasks, both via
+    conditional writes — the service-shell discipline an at-least-once
+    transport requires. Adoption matters here: a slow tier's in-service
+    tasks must leave the ``created`` set, or they'd read as edge backlog
+    and trip the admission feasibility shed on queue state that is
+    actually in-flight work."""
+    async def handler(request):
+        tid = request.headers["taskId"]
+        body = await request.read()
+        platform.store.update_status_if(tid, "created", "running",
+                                        TaskStatus.RUNNING)
+        if latency_s:
+            await asyncio.sleep(latency_s)
+        platform.store.update_status_if(
+            tid, "running", f"completed - scored {len(body)}",
+            TaskStatus.COMPLETED)
+        return web.Response(text="ok")
+
+    app = web.Application()
+    app.router.add_post("/v1/be/x", handler)
+    return app
+
+
+async def _mixed_fleet(platform):
+    """3 fast TPU-class backends + 1 slow CPU-class fallback, one route.
+    Host names carry the tier tag the cost map keys on."""
+    tpus = []
+    for _ in range(3):
+        be = await RestartableBackend(_completing_app(platform)).start()
+        tpus.append(be)
+    cpu = await RestartableBackend(
+        _completing_app(platform, latency_s=CPU_LATENCY_S)).start()
+    # The injector and the cost map match on URL substrings; loopback
+    # URIs carry no tier names, so weight them in via the path instead:
+    # register with rewritten URIs is impossible (the port IS the host),
+    # so tag via a path prefix.
+    uris = [f"{be.url}/v1/be/x" for be in tpus] + [f"{cpu.url}/v1/be/x"]
+    return tpus, cpu, uris
+
+
+async def _warm_drain(gw, checker, n=30, timeout=30.0):
+    """Establish the admission drain-rate estimator before the measured
+    workload (no-deadline default-class tasks — the bench's ramp
+    philosophy): a cold estimator makes the edge's deadline-feasibility
+    shed refuse deadline traffic on a backlog/rate guess built from
+    nothing. Identical in every run, so comparisons stay apples-to-apples."""
+    ids = []
+    for _ in range(n):
+        resp = await gw.post("/v1/pub/x", data=b"warm")
+        assert resp.status == 200, resp.status
+        tid = (await resp.json())["TaskId"]
+        checker.note_accepted(tid)
+        ids.append(tid)
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if all(t in checker.terminal for t in ids):
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError("drain warm-up never completed")
+
+
+class _GoodputMeter:
+    """Per-priority within-deadline completion counts, measured off the
+    store's change feed exactly like admission's goodput scorer."""
+
+    def __init__(self, store):
+        self.in_deadline = {0: 0, 2: 0}
+        self.late = {0: 0, 2: 0}
+        store.add_listener(self._on_change)
+
+    def _on_change(self, task):
+        if task.canonical_status != TaskStatus.COMPLETED:
+            return
+        pri = getattr(task, "priority", 1)
+        if pri not in self.in_deadline:
+            return
+        deadline_at = getattr(task, "deadline_at", 0.0)
+        if deadline_at and time.time() <= deadline_at:
+            self.in_deadline[pri] += 1
+        else:
+            self.late[pri] += 1
+
+
+async def _drive_dark_fleet(dark: bool, tmp_path=None) -> dict:
+    """One seeded run of the mixed-fleet workload; ``dark`` blacks out
+    tpu[0] for the middle third. Returns the scorecard."""
+    platform = _platform()
+    tpus, cpu, uris = await _mixed_fleet(platform)
+    # Tier tags for cost + injector matching ride the weighted set as
+    # URI substrings can't (loopback hosts): use per-backend cost via
+    # explicit map on the orchestrator instead.
+    platform.orchestration.policy.costs = {
+        **{f":{be.port}": 3.0 for be in tpus},
+        f":{cpu.port}": 1.0}
+    platform.publish_async_api("/v1/pub/x", [(u, 1.0) for u in uris])
+
+    checker = InvariantChecker(
+        shard_of=platform.store.shard_for).attach(platform.store)
+    meter = _GoodputMeter(platform.store)
+
+    injector = FaultInjector(seed=SEED)
+    injector.add_rule(error_rate=0.08, error_status=500)
+    injector.add_rule(backend="/v1/be/x", duplicate_rate=0.05)
+    wrap_platform_http(platform, injector)
+    wrap_publish_duplicates(platform, injector)
+
+    # Pre-teach the estimator the tiers' shapes so the first interactive
+    # burst doesn't explore the slow tier cold (the sketches keep
+    # re-learning from live RTTs for the rest of the run).
+    for u in uris[:3]:
+        for _ in range(8):
+            platform.orchestration.observe(u, 0.02)
+    for _ in range(8):
+        platform.orchestration.observe(uris[3], CPU_LATENCY_S)
+
+    gw = await serve(platform.gateway.app)
+    await platform.start()
+    accepted = {0: 0, 2: 0}
+    try:
+        await _warm_drain(gw, checker)
+
+        async def accept(n_interactive, n_background):
+            for i in range(max(n_interactive, n_background)):
+                batch = []
+                if i < n_interactive:
+                    batch.append(("interactive", INTERACTIVE_DEADLINE_MS, 0))
+                if i < n_background:
+                    batch.append(("background", BACKGROUND_DEADLINE_MS, 2))
+                for name, budget, pri in batch:
+                    # The platform's client contract: a 429 carries
+                    # Retry-After — back off and re-issue. Interactive
+                    # retries until admitted (a SUSTAINED refusal of the
+                    # top class would time the test out and fail it);
+                    # background takes the shed (that's the brownout
+                    # design) after one retry.
+                    for attempt in range(60):
+                        resp = await gw.post(
+                            "/v1/pub/x", data=b"payload",
+                            headers={"X-Priority": name,
+                                     "X-Deadline-Ms": str(int(budget))})
+                        if resp.status == 200:
+                            checker.note_accepted(
+                                (await resp.json())["TaskId"])
+                            accepted[pri] += 1
+                            break
+                        assert resp.status == 429, (name, resp.status)
+                        if pri == 2 and attempt >= 1:
+                            break  # background shed — allowed
+                        await asyncio.sleep(0.1)
+                    else:
+                        raise AssertionError(
+                            f"{name} refused for the whole retry budget")
+                await asyncio.sleep(0.04)
+
+        # First third: everything up.
+        await accept(14, 6)
+        # Middle third: 1 of 3 TPU-class backends dark (30% of the tier).
+        rule = injector.blackout(f":{tpus[0].port}") if dark else None
+        await accept(14, 6)
+        # Final third: darkness lifts.
+        if rule is not None:
+            injector.lift(rule)
+        await accept(14, 6)
+
+        # Drain: every accepted task terminal.
+        deadline = asyncio.get_running_loop().time() + 40.0
+        while asyncio.get_running_loop().time() < deadline:
+            if all(t in checker.terminal for t in checker.accepted):
+                break
+            await asyncio.sleep(0.05)
+
+        checker.assert_ok()
+        for shard in range(2):
+            checker.assert_shard_ok(shard)
+
+        placements = platform.metrics.counter(
+            "ai4e_orchestration_placements_total", "")
+        cpu_host = f"127.0.0.1:{cpu.port}"
+        return {
+            "accepted": dict(accepted),
+            "in_deadline": dict(meter.in_deadline),
+            "late": dict(meter.late),
+            "by_shard": checker.by_shard(),
+            "injected": injector.counts(),
+            "cpu_placements": sum(
+                v for _, _, labels, v in placements.collect()
+                if labels.get("backend") == cpu_host),
+            "brownout_refusals": sum(
+                v for *_, v in platform.metrics.counter(
+                    "ai4e_orchestration_brownout_refusals_total",
+                    "").collect()),
+            "dark_breaker_opened": platform.metrics.counter(
+                "ai4e_resilience_transitions_total", "").value(
+                backend=f"127.0.0.1:{tpus[0].port}", state="open"),
+        }
+    finally:
+        await platform.stop()
+        await gw.close()
+        for be in tpus:
+            await be.kill()
+        await cpu.kill()
+
+
+@pytest.mark.chaos
+class TestDarkFleetAcceptance:
+    def test_interactive_goodput_holds_while_background_reroutes(self):
+        async def main():
+            baseline = await _drive_dark_fleet(dark=False)
+            dark = await _drive_dark_fleet(dark=True)
+
+            # Same seeded workload accepted in both runs (background may
+            # shed under brownout, interactive must not).
+            assert dark["accepted"][0] == baseline["accepted"][0] == 42
+
+            # THE acceptance bar: interactive goodput within 15% of the
+            # fault-free baseline despite 30% of the fast tier dark for
+            # the middle third.
+            assert baseline["in_deadline"][0] > 0
+            ratio = dark["in_deadline"][0] / baseline["in_deadline"][0]
+            assert ratio >= 0.85, (
+                f"interactive goodput collapsed under darkness: "
+                f"{dark['in_deadline'][0]} vs baseline "
+                f"{baseline['in_deadline'][0]} ({ratio:.2f})")
+
+            # Background traffic rode the cheap tier (best-effort
+            # reroute) or shed — it must not have starved interactive.
+            assert (dark["cpu_placements"] > 0
+                    or dark["brownout_refusals"] > 0)
+
+            # The darkness was real: deliveries actually hit the
+            # blacked-out backend (injected connection refusals) —
+            # often enough to trip its breaker, but with the canary-
+            # preserving weighted pick the per-backend hit count is
+            # seed/timing-dependent, so the refusals are the invariant
+            # and the breaker opening is corroboration, not a must.
+            assert (dark["injected"].get("connect_error", 0) > 0
+                    or dark["dark_breaker_opened"] >= 1)
+            assert dark["injected"].get("error", 0) > 0
+
+            # Per-shard verdicts came from both shards (the ring spread
+            # the keyspace).
+            assert set(dark["by_shard"]) == {0, 1}
+            for shard, stats in dark["by_shard"].items():
+                assert stats["terminal"] == stats["accepted"], (shard, stats)
+                assert stats["duplicates"] == 0, (shard, stats)
+
+        run(main())
+
+
+@pytest.mark.chaos
+class TestShardFailoverDuringBrownout:
+    def test_kill_shard_primary_composes_with_the_ladder(self, tmp_path):
+        async def main():
+            platform = _platform(tmp_path=tmp_path, replicas=1,
+                                 orchestration_ladder_hold_s=0.3)
+            tpus, cpu, uris = await _mixed_fleet(platform)
+            platform.orchestration.policy.costs = {
+                **{f":{be.port}": 3.0 for be in tpus},
+                f":{cpu.port}": 1.0}
+            platform.publish_async_api("/v1/pub/x",
+                                       [(u, 1.0) for u in uris])
+            checker = InvariantChecker(
+                shard_of=platform.store.shard_for).attach(platform.store)
+
+            injector = FaultInjector(seed=SEED)
+            injector.add_rule(error_rate=0.08, error_status=500)
+            wrap_platform_http(platform, injector)
+
+            gw = await serve(platform.gateway.app)
+            await platform.start()
+            try:
+                await _warm_drain(gw, checker)
+
+                async def accept(n, priority, budget_ms,
+                                 expect_admitted=True):
+                    admitted = 0
+                    for _ in range(n):
+                        resp = await gw.post(
+                            "/v1/pub/x", data=b"p",
+                            headers={"X-Priority": priority,
+                                     "X-Deadline-Ms": str(int(budget_ms))})
+                        if resp.status == 200:
+                            checker.note_accepted(
+                                (await resp.json())["TaskId"])
+                            admitted += 1
+                        elif expect_admitted:
+                            raise AssertionError(
+                                (priority, resp.status,
+                                 resp.headers.get("X-Shed-Reason")))
+                        else:
+                            assert "brownout" in resp.headers.get(
+                                "X-Shed-Reason", "")
+                        await asyncio.sleep(0.01)
+                    return admitted
+
+                await accept(8, "interactive", INTERACTIVE_DEADLINE_MS)
+                await accept(4, "background", BACKGROUND_DEADLINE_MS)
+
+                # Dark backend + forced brownout: drive the ladder to
+                # shed_background on real miss evidence at its real
+                # clock (hold_s is config-scaled in the ladder; feed a
+                # dense miss burst the way a miss storm would).
+                rule = injector.blackout(f":{tpus[0].port}")
+                ladder = platform.orchestration.ladder
+                t0 = time.monotonic()
+                while (ladder.level < 2
+                       and time.monotonic() - t0 < 30.0):
+                    ladder.note(miss=True)
+                    await asyncio.sleep(0.005)
+                assert ladder.level >= 2, "ladder never browned out"
+
+                # SIGKILL one shard primary MID-brownout.
+                epoch_before = platform.store.groups[0].epoch
+                kill_shard_primary(platform, 0)
+
+                # Background is refused with brownout provenance while
+                # interactive keeps flowing through the failover AND
+                # around the dark backend.
+                admitted_bg = await accept(4, "background",
+                                           BACKGROUND_DEADLINE_MS,
+                                           expect_admitted=False)
+                assert admitted_bg == 0
+                await accept(8, "interactive", INTERACTIVE_DEADLINE_MS)
+
+                # The killed shard promoted: epoch strictly above the
+                # corpse's, the OTHER shard untouched.
+                assert platform.store.groups[0].epoch > epoch_before
+
+                # Lift the darkness; good outcomes step the ladder down.
+                injector.lift(rule)
+                t0 = time.monotonic()
+                while ladder.level > 0 and time.monotonic() - t0 < 30.0:
+                    ladder.note(miss=False)
+                    await asyncio.sleep(0.005)
+                assert ladder.level == 0, (
+                    "ladder wedged at brownout after recovery")
+
+                # Drain the brownout-era backlog first (keeps the drain
+                # estimator honest for the readmission probe below).
+                deadline = asyncio.get_running_loop().time() + 40.0
+                while asyncio.get_running_loop().time() < deadline:
+                    if all(t in checker.terminal
+                           for t in checker.accepted):
+                        break
+                    await asyncio.sleep(0.05)
+                # Background is admitted again end-to-end.
+                await accept(4, "background", BACKGROUND_DEADLINE_MS)
+                deadline = asyncio.get_running_loop().time() + 40.0
+                while asyncio.get_running_loop().time() < deadline:
+                    if all(t in checker.terminal
+                           for t in checker.accepted):
+                        break
+                    await asyncio.sleep(0.05)
+                checker.assert_ok()
+                for shard in range(2):
+                    checker.assert_shard_ok(shard)
+                # Every interactive acceptance completed (none lost to
+                # the failover window or the dark backend).
+                summary = checker.summary()
+                assert summary["terminal"] == summary["accepted"]
+            finally:
+                await platform.stop()
+                await gw.close()
+                for be in tpus:
+                    await be.kill()
+                await cpu.kill()
+
+        run(main())

@@ -1001,3 +1001,155 @@ class TestFeedAttachRace:
         assert not report.ok, (
             "the attach-vs-event window was not reachable without the "
             "replay map — the scenario no longer models the race")
+
+
+# -- PR 7: orchestration check-then-act surfaces (docs/orchestration.md) ------
+
+
+class TestOrchestrationPlacementVsBreakerTrip:
+    """The placement pipeline is estimator-read → decision → POST →
+    outcome record: the decision's breaker evidence is one suspension
+    stale by the time the outcome lands, and a concurrent delivery loop
+    can trip (or recover) the same breaker mid-flight. The invariants a
+    schedule must never break: a placement always lands inside the
+    backend set, the half-open probe-slot accounting never leaks (the
+    PR 3 leak class — a leaked slot ejects a backend forever), and the
+    estimator's begin/end in-flight pairing survives every interleaving
+    (the dispatcher releases in a finally)."""
+
+    BACKENDS = [("http://tpu", 1.0), ("http://cpu", 1.0)]
+
+    def _make(self):
+        from ai4e_tpu.orchestration import Orchestrator, OrchestrationPolicy
+
+        clock = [0.0]
+        health = BackendHealth(
+            ResiliencePolicy(failure_threshold=2, recovery_seconds=5.0),
+            metrics=MetricsRegistry(), clock=lambda: clock[0],
+            rng=random.Random(0))
+        orch = Orchestrator(
+            health,
+            policy=OrchestrationPolicy(costs={"cpu": 1.0, "tpu": 3.0}),
+            metrics=MetricsRegistry(), clock=lambda: clock[0])
+        for _ in range(4):
+            orch.observe("http://tpu", 0.01)
+            orch.observe("http://cpu", 0.02)
+        return clock, health, orch
+
+    def test_placement_vs_trip_race_free(self):
+        def make():
+            clock, health, orch = self._make()
+            placed = []
+
+            async def placing_loop():
+                # The dispatcher's attempt shape: place → (suspend: the
+                # POST) → outcome, with the estimator's begin/end exactly
+                # where _dispatch_one puts them (finally-released).
+                for outcome_ok in (True, False):
+                    base = orch.place(self.BACKENDS, deadline_at=0.0)
+                    placed.append(base)
+                    orch.begin(base)
+                    try:
+                        await yield_point()  # the POST round trip
+                        if outcome_ok:
+                            health.observe_status(base, 200)
+                            orch.observe(base, 0.01)
+                        else:
+                            health.record_failure(base)
+                    finally:
+                        orch.end(base)
+
+            async def tripping_loop():
+                # A concurrent delivery loop melting the cheap tier: the
+                # breaker trips while the placer is mid-POST.
+                for _ in range(2):
+                    await yield_point()
+                    health.record_failure("http://cpu")
+                clock[0] += 6.0  # cooldown elapses → half-open probes
+                uri = orch.place(self.BACKENDS, deadline_at=0.0)
+                await yield_point()
+                health.observe_status(uri, 200)
+
+            def check():
+                for uri in ("http://tpu", "http://cpu"):
+                    br = health.breaker_for(uri)
+                    assert 0 <= br._probes_inflight <= br.half_open_probes
+                    assert orch.estimator.inflight(uri) == 0, (
+                        "estimator in-flight leaked")
+                assert set(placed) <= {u for u, _ in self.BACKENDS}
+                # However the trip interleaved, the set must stay
+                # routable once the cooldown passes (no permanent
+                # ejection — the PR 3 slot-leak symptom).
+                clock[0] += 6.0
+                assert any(health.breaker_for(u).available()
+                           for u, _ in self.BACKENDS)
+
+            return [placing_loop(), tripping_loop()], check
+
+        report = explore_interleavings(make, schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
+
+
+class TestLadderHysteresisVsMetricsFlush:
+    """Ladder step-up racing step-down racing a metrics flush: note()
+    arrives from placement (event loop) and from the store-listener
+    thread, while /metrics renders mid-transition. Per the
+    docs/concurrency.md contract the transition critical section is a
+    lock-protected sync block (no suspension points), so every explored
+    schedule must observe: level within [0, 4], conservation (up steps −
+    down steps == final level), and a flushed gauge that always equals a
+    level the ladder actually held."""
+
+    def test_step_up_vs_step_down_vs_flush(self):
+        def make():
+            from ai4e_tpu.orchestration import DegradationLadder
+
+            clock = [0.0]
+            reg = MetricsRegistry()
+            ladder = DegradationLadder(up=0.5, down=0.1, hold_s=2.0,
+                                       min_rate=0.01, tau_s=5.0,
+                                       metrics=reg,
+                                       clock=lambda: clock[0])
+            seen_levels = []
+
+            async def misser():
+                for _ in range(8):
+                    clock[0] += 1.0
+                    ladder.note(miss=True)
+                    seen_levels.append(ladder.level)
+                    await yield_point()
+
+            async def recoverer():
+                for _ in range(20):
+                    clock[0] += 0.5
+                    ladder.note(miss=False)
+                    seen_levels.append(ladder.level)
+                    await yield_point()
+
+            async def flusher():
+                for _ in range(4):
+                    await yield_point()
+                    reg.render_prometheus()  # the metrics scrape
+                    gauge = reg.gauge("ai4e_orchestration_ladder_level", "")
+                    seen_levels.append(int(gauge.value()))
+
+            def check():
+                assert all(0 <= lvl <= 4 for lvl in seen_levels), seen_levels
+                counter = reg.counter(
+                    "ai4e_orchestration_ladder_transitions_total", "")
+                ups = downs = 0
+                for _, _, labels, v in counter.collect():
+                    if labels.get("direction") == "up":
+                        ups += v
+                    else:
+                        downs += v
+                assert ups - downs == ladder.level, (
+                    f"transition conservation broken: {ups} up, {downs} "
+                    f"down, level {ladder.level}")
+                gauge = reg.gauge("ai4e_orchestration_ladder_level", "")
+                assert int(gauge.value()) == ladder.level
+
+            return [misser(), recoverer(), flusher()], check
+
+        report = explore_interleavings(make, schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
