@@ -4,6 +4,7 @@ from .blocking import BlockingCallInAsync
 from .config_drift import ConfigDrift
 from .fire_and_forget import FireAndForgetTask
 from .lock_await import LockAcrossSlowAwait
+from .metrics_drift import MetricsDrift
 from .registry_leak import MetricsRegistryLeak
 from .rmw import NonatomicReadModifyWrite
 from .stale_read import StaleReadAcrossAwait
@@ -20,6 +21,7 @@ ALL_RULES = [
     StaleReadAcrossAwait,
     LockAcrossSlowAwait,
     NonatomicReadModifyWrite,
+    MetricsDrift,
 ]
 
 __all__ = ["ALL_RULES"] + [cls.__name__ for cls in ALL_RULES]

@@ -26,6 +26,7 @@ import logging
 import aiohttp
 
 from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from ..observability import ledger as hop
 from ..utils.backends import normalize_backends, pick_backend
 from ..utils.http import SessionHolder
 from ..service.task_manager import TaskManagerBase
@@ -86,6 +87,7 @@ class Dispatcher:
         admission=None,
         resilience=None,
         orchestration=None,
+        observability=None,
     ):
         self.broker = broker
         self.queue_name = queue_name
@@ -128,6 +130,13 @@ class Dispatcher:
         # delivered-POST RTTs feed the per-backend completion estimator.
         # None (default) keeps the resilience pick byte for byte.
         self.orchestration = orchestration
+        # Request-observability hub (observability/hub.py): when set,
+        # every delivery stamps hop-ledger events — popped, placement
+        # outcome, delivered, retry/failover, backpressure, expiry,
+        # duplicate suppression, dead-letter — onto the task's timeline.
+        # None (the default) stamps nothing: the pre-observability
+        # dispatcher byte for byte.
+        self.observability = observability
         self._retry_budget = (resilience.new_budget()
                               if resilience is not None else None)
         self.backends = normalize_backends(backend_uri)
@@ -270,6 +279,17 @@ class Dispatcher:
             finally:
                 self._busy -= 1
 
+    def _stamp(self, task_id: str, event: str, reason: str | None = None,
+               t: float | None = None) -> None:
+        """Hop-ledger stamp (observability/); no-op when the layer is
+        off. The hub is fail-open — a dropped stamp never fails the
+        delivery it annotates."""
+        if self.observability is None:
+            return
+        self.observability.stamp(
+            task_id, hop.ledger_event(event, "dispatcher", t=t,
+                                      reason=reason))
+
     def _target_for(self, msg: Message,
                     exclude: tuple | list = ()) -> tuple[str, str]:
         """Dispatch target: a *registered* backend URI (fresh host — a
@@ -279,11 +299,28 @@ class Dispatcher:
         query grafted on (``rebase_endpoint``). Returns ``(base, target)``
         — the base is the health-model key for outcome recording."""
         if self.orchestration is not None:
+            note = None
+            if self.observability is not None:
+                def note(outcome: str, uri: str,
+                         _tid=msg.task_id) -> None:
+                    # Placement outcome + chosen backend onto the
+                    # timeline: probes keep their own event name (the
+                    # recovery-probe diversion is exactly what an
+                    # operator hunts for — and WHICH backend was probed
+                    # is the diagnostic half of that), everything else
+                    # is a ``placed`` with outcome + host as reason.
+                    from urllib.parse import urlparse
+                    host = urlparse(uri).netloc or uri
+                    self._stamp(_tid,
+                                hop.PROBE if outcome == "probe"
+                                else hop.PLACED,
+                                reason=(host if outcome == "probe"
+                                        else f"{outcome} {host}"))
             base = self.orchestration.place(
                 self.backends,
                 deadline_at=getattr(msg, "deadline_at", 0.0),
                 priority=getattr(msg, "priority", 1),
-                rng=self._rng, exclude=exclude)
+                rng=self._rng, exclude=exclude, note=note)
         elif self.resilience is not None:
             base = self.resilience.pick(self.backends, self._rng,
                                         exclude=exclude)
@@ -322,6 +359,8 @@ class Dispatcher:
         import time as _time
         from urllib.parse import urlparse
 
+        self._stamp(msg.task_id, hop.POPPED,
+                    reason=f"delivery {msg.delivery_count}")
         if await self._drop_expired(msg):
             return
         if self.resilience is not None and await self._suppress_duplicate(msg):
@@ -388,6 +427,8 @@ class Dispatcher:
                     # jittered backoff — the pod may be restarting).
                     tried.append(base)
                     self.resilience.note_failover("dispatcher")
+                    self._stamp(msg.task_id, hop.FAILOVER,
+                                reason=f"connect_error {backend}")
                     await self._retry_sleep(attempt)
                     continue
                 # Backend unreachable — treat like saturation: the pod may
@@ -404,6 +445,7 @@ class Dispatcher:
             self._record_outcome(base, status=status)
             if 200 <= status < 300:
                 self.broker.complete(msg)
+                self._stamp(msg.task_id, hop.DELIVERED, reason=backend)
                 self._dispatched.inc(outcome="delivered",
                                      queue=self.queue_name, backend=backend)
                 if self.orchestration is not None:
@@ -443,6 +485,8 @@ class Dispatcher:
                 if self._can_retry(attempt):
                     tried.append(base)
                     self.resilience.note_retry("dispatcher")
+                    self._stamp(msg.task_id, hop.RETRY,
+                                reason=f"HTTP {status} {backend}")
                     await self._retry_sleep(attempt)
                     continue
                 await self._backpressure(msg, backend=backend)
@@ -510,6 +554,7 @@ class Dispatcher:
             self._dispatched.inc(outcome="duplicate", queue=self.queue_name,
                                  backend="")
             return True
+        self._stamp(msg.task_id, hop.EXPIRED, reason="pop-time deadline")
         self._dispatched.inc(outcome="expired", queue=self.queue_name,
                              backend="")
         if self.admission is not None:
@@ -597,6 +642,8 @@ class Dispatcher:
         the duplicate pops mid-execution (docs/resilience.md)."""
         if await self.task_manager.is_terminal(msg.task_id):
             self.broker.complete(msg)
+            self._stamp(msg.task_id, hop.DUPLICATE,
+                        reason="redelivery of a terminal task")
             self._dispatched.inc(outcome="duplicate", queue=self.queue_name,
                                  backend="")
             return True
@@ -625,6 +672,7 @@ class Dispatcher:
             # exact duplicate-visible-completion the chaos invariants
             # reject. Complete the message instead; the work is done.
             return
+        self._stamp(msg.task_id, hop.BACKPRESSURE, reason=backend)
         self._dispatched.inc(outcome="backpressure", queue=self.queue_name,
                              backend=backend)
         await self._try_update(msg.task_id, AWAITING_STATUS, TaskStatus.CREATED)
@@ -645,6 +693,8 @@ class Dispatcher:
                 self._dispatched.inc(outcome="duplicate",
                                      queue=self.queue_name, backend=backend)
                 return
+            self._stamp(msg.task_id, hop.DEAD_LETTER,
+                        reason=f"after {msg.delivery_count} deliveries")
             self._dispatched.inc(outcome="dead_letter", queue=self.queue_name,
                                  backend=backend)
             await self._try_update(
@@ -668,7 +718,7 @@ class DispatcherPool:
     def __init__(self, broker: InMemoryBroker, task_manager: TaskManagerBase,
                  retry_delay: float = 60.0, concurrency: int = 1,
                  result_cache=None, result_store=None, admission=None,
-                 resilience=None, orchestration=None,
+                 resilience=None, orchestration=None, observability=None,
                  metrics: MetricsRegistry | None = None):
         self.broker = broker
         self.task_manager = task_manager
@@ -679,6 +729,7 @@ class DispatcherPool:
         self.admission = admission
         self.resilience = resilience
         self.orchestration = orchestration
+        self.observability = observability
         # Registry the registered dispatchers count into — the assembly's
         # own, so a custom-registry platform's /metrics carries
         # ai4e_dispatch_total instead of it silently landing in the
@@ -696,6 +747,7 @@ class DispatcherPool:
             result_cache=self.result_cache, result_store=self.result_store,
             admission=self.admission, resilience=self.resilience,
             orchestration=self.orchestration,
+            observability=self.observability,
             metrics=self.metrics,
         )
         self.dispatchers[queue_name] = d

@@ -25,15 +25,24 @@ from ..taskstore import TaskNotFound, TaskStatus
 
 
 class InvariantChecker:
-    def __init__(self, shard_of=None):
+    def __init__(self, shard_of=None, flight=None, dump_dir=None):
         """``shard_of`` (optional, ``shard_of(task_id) -> int``): the hash
         ring's owner function — when given, every verdict is ALSO
         available per shard (``by_shard``/``assert_shard_ok``), so a
         sharded chaos run can prove the invariants hold for each shard
         independently and for an exact keyspace range across a rebalance
-        (``violations_for``)."""
+        (``violations_for``).
+
+        ``flight`` (optional ``observability.FlightRecorder``): dumped
+        alongside the violation report when an assertion trips, so a red
+        seeded run ships the request timelines that explain it.
+        ``dump_dir`` overrides the artifact directory (default: the
+        ``AI4E_CHAOS_DUMP_DIR`` env var, else ``/tmp/ai4e-chaos`` — the
+        path CI's chaos-smoke job uploads on failure)."""
         self._store = None
         self.shard_of = shard_of
+        self.flight = flight
+        self.dump_dir = dump_dir
         self.accepted: set[str] = set()
         # First terminal status seen per task (listener feed).
         self.terminal: dict[str, str] = {}
@@ -94,8 +103,50 @@ class InvariantChecker:
     def assert_ok(self) -> None:
         problems = self.violations()
         if problems:
+            dumped = self.dump_debug(problems)
             raise AssertionError(
-                "chaos invariants violated:\n  " + "\n  ".join(problems))
+                "chaos invariants violated"
+                + (f" (debug artifacts: {dumped})" if dumped else "")
+                + ":\n  " + "\n  ".join(problems))
+
+    def dump_debug(self, problems: list[str]) -> str | None:
+        """Write the violation report + the flight-recorder ring (when
+        attached) + per-task summaries to the dump directory — the
+        artifact CI uploads on a red chaos run, so the failure is
+        debuggable without a local repro. Returns the directory, or
+        None when dumping itself failed (a dump failure must never mask
+        the violation it is documenting)."""
+        import json
+        import os
+        import time
+
+        directory = (self.dump_dir
+                     or os.environ.get("AI4E_CHAOS_DUMP_DIR")
+                     or "/tmp/ai4e-chaos")
+        try:
+            os.makedirs(directory, exist_ok=True)
+            stamp = time.strftime("%Y%m%d-%H%M%S")
+            report = {
+                "violations": problems,
+                "summary": self.summary(),
+                "accepted": sorted(self.accepted),
+                "terminal": dict(self.terminal),
+                "duplicates": list(self.duplicate_completions),
+            }
+            with open(os.path.join(directory,
+                                   f"violations-{stamp}.json"),
+                      "w", encoding="utf-8") as fh:
+                json.dump(report, fh, indent=1)
+            if self.flight is not None:
+                with open(os.path.join(directory, f"flight-{stamp}.json"),
+                          "w", encoding="utf-8") as fh:
+                    json.dump(self.flight.dump(), fh, indent=1)
+            return directory
+        except OSError:
+            import logging
+            logging.getLogger("ai4e_tpu.chaos").exception(
+                "could not write chaos debug artifacts to %s", directory)
+            return None
 
     def summary(self) -> dict:
         return {"accepted": len(self.accepted),
@@ -133,6 +184,8 @@ class InvariantChecker:
         ids = [tid for tid in self.accepted if self.shard_of(tid) == shard]
         problems = self.violations(ids)
         if problems:
+            dumped = self.dump_debug(problems)
             raise AssertionError(
-                f"shard {shard} invariants violated:\n  "
-                + "\n  ".join(problems))
+                f"shard {shard} invariants violated"
+                + (f" (debug artifacts: {dumped})" if dumped else "")
+                + ":\n  " + "\n  ".join(problems))

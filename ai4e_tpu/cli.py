@@ -294,7 +294,11 @@ def build_worker(config: FrameworkConfig, models: dict):
                            max_pending=rt.batch_max_pending,
                            pipeline_depth=rt.batch_pipeline_depth,
                            interactive_reserve=rt.batch_interactive_reserve,
-                           priority_aging_s=rt.batch_priority_aging_s)
+                           priority_aging_s=rt.batch_priority_aging_s,
+                           # Device-phase decomposition rides the same
+                           # switch as the worker's ledger flushes
+                           # (AI4E_OBSERVABILITY_HOP_LEDGER).
+                           measure_phases=config.observability.hop_ledger)
     admin_keys = None
     if config.gateway.api_keys is not None:
         # The reload surface is an operator action: gate it with the same
@@ -312,7 +316,8 @@ def build_worker(config: FrameworkConfig, models: dict):
         # readable path. None (dev, no AI4E_RUNTIME_CHECKPOINT_DIR) keeps
         # the open single-host behavior.
         checkpoint_root=rt.checkpoint_dir,
-        admin_api_keys=admin_keys)
+        admin_api_keys=admin_keys,
+        hop_ledger=config.observability.hop_ledger)
     for spec in models.get("models", []):
         spec = dict(spec)
         family = spec.pop("family")
@@ -390,7 +395,14 @@ async def run_control_plane(config: FrameworkConfig, routes: dict) -> None:
         # Sharding changes the durability/availability topology (per-shard
         # journals + failover — AI4E_PLATFORM_TASK_SHARDS, docs/sharding.md).
         (f", task store sharded x{platform.config.task_shards}"
-         if platform.config.task_shards > 1 else "")]))
+         if platform.config.task_shards > 1 else ""),
+        # Observability adds the hop ledger + flight recorder
+        # (AI4E_PLATFORM_OBSERVABILITY, docs/observability.md) and,
+        # with objectives, the SLO burn-rate engine.
+        (", observability ON"
+         if platform.observability is not None else ""),
+        (f", SLO engine ON ({len(platform.slo.objectives)} objectives)"
+         if platform.slo is not None else "")]))
     log.info("control plane on %s:%s (%d routes%s)", config.gateway.host,
              config.gateway.port, len(platform.gateway.routes), posture)
     try:
@@ -505,10 +517,20 @@ def main(argv=None) -> None:
     tr = sub.add_parser(
         "trace",
         help="render task/request span trees from the JSONL trace log — "
-             "the App Insights end-to-end transaction view, offline")
+             "the App Insights end-to-end transaction view, offline — "
+             "or, with --url, a task's HOP LEDGER fetched live from the "
+             "control plane (docs/observability.md)")
     tr.add_argument("--export", default=None,
                     help="span log path (default: the configured "
                          "AI4E_OBSERVABILITY_TRACE_EXPORT_PATH)")
+    tr.add_argument("--url", default=None,
+                    help="control-plane base URL: fetch the task's hop "
+                         "ledger (GET /v1/taskmanagement/task/{id}"
+                         "?ledger=1) instead of reading a span log; "
+                         "requires --task-id")
+    tr.add_argument("--api-key", default=None,
+                    help="subscription key when the control plane runs "
+                         "with gateway keys (--url mode)")
     tr_sel = tr.add_mutually_exclusive_group()
     tr_sel.add_argument("--task-id", default=None,
                         help="render every trace this task traversed")
@@ -522,6 +544,34 @@ def main(argv=None) -> None:
     args = parser.parse_args(argv)
 
     if args.component == "trace":
+        if args.url:
+            # Live hop-ledger mode — pure HTTP client, no jax, no
+            # assembly: one GET answers "where did this task's time go"
+            # across every process it traversed.
+            if not args.task_id:
+                raise SystemExit("--url mode requires --task-id")
+            import json as _json
+            import urllib.error
+            import urllib.request
+
+            from .observability.ledger import render_ledger
+            req = urllib.request.Request(
+                args.url.rstrip("/")
+                + f"/v1/taskmanagement/task/{args.task_id}?ledger=1",
+                headers=({"Ocp-Apim-Subscription-Key": args.api_key}
+                         if args.api_key else {}))
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    record = _json.loads(resp.read())
+            except urllib.error.HTTPError as exc:
+                raise SystemExit(
+                    f"task fetch failed: HTTP {exc.code} "
+                    f"{exc.read().decode(errors='replace')[:200]}")
+            except OSError as exc:
+                raise SystemExit(f"cannot reach {args.url}: {exc}")
+            print(render_ledger(args.task_id, record.get("Ledger") or [],
+                                status=record.get("Status")))
+            return
         # Pure log reader — no jax, no platform assembly.
         from .observability.traceview import (load_spans, render_list,
                                               render_trace, select_traces)

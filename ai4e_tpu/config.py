@@ -224,6 +224,26 @@ class PlatformSection:
     task_shard_replicas: int = 1
     shard_tail_interval: float = 0.25
     shard_feed_recent: int = 4096
+    # Request observability (docs/observability.md): per-task hop
+    # ledger, tail-sampled flight recorder (GET /v1/debug/flight), and
+    # per-route e2e latency/outcome telemetry. Off = byte-identical
+    # assembly.
+    observability: bool = False
+    flight_capacity: int = 512
+    flight_sample: float = 0.05
+    flight_slow_ms: float = 1000.0
+    # Per-route SLO objectives + multi-window burn-rate engine
+    # (observability/slo.py): "/route=<latency_ms>:<target_pct>" or
+    # "/route=goodput:<target_pct>", comma-separated. Requires
+    # observability (the engine reads its histograms). Unset = no
+    # engine.
+    slo_objectives: typing.Optional[str] = None
+    slo_tick_s: float = 5.0
+    slo_fast_window_s: float = 300.0
+    slo_slow_window_s: float = 3600.0
+    # Feed sustained SLO breaches to the degradation ladder as an extra
+    # miss-evidence source (requires orchestration).
+    slo_ladder: bool = False
 
     def to_platform_config(self):
         from .platform_assembly import PlatformConfig
@@ -283,6 +303,15 @@ class PlatformSection:
             task_shard_replicas=self.task_shard_replicas,
             shard_tail_interval=self.shard_tail_interval,
             shard_feed_recent=self.shard_feed_recent,
+            observability=self.observability,
+            flight_capacity=self.flight_capacity,
+            flight_sample=self.flight_sample,
+            flight_slow_ms=self.flight_slow_ms,
+            slo_objectives=self.slo_objectives,
+            slo_tick_s=self.slo_tick_s,
+            slo_fast_window_s=self.slo_fast_window_s,
+            slo_slow_window_s=self.slo_slow_window_s,
+            slo_ladder=self.slo_ladder,
         )
 
 
@@ -380,6 +409,13 @@ class ObservabilitySection:
     trace_otlp_endpoint: typing.Optional[str] = None
     queue_depth_interval: float = 30.0      # TaskQueueLogger.cs:19 (30 s)
     process_depth_interval: float = 300.0   # TaskProcessLogger.cs:21 (5 min)
+    # Worker-side hop-ledger participation (docs/observability.md): the
+    # batcher measures device phases (h2d/compile/execute/d2h + overlap
+    # ratio) and the worker flushes each request's timeline to the task
+    # store — pair with AI4E_PLATFORM_OBSERVABILITY on the control
+    # plane for the full cross-process ledger. Off = the pre-ledger
+    # worker byte for byte.
+    hop_ledger: bool = False
 
     def apply(self) -> None:
         """Install these settings on the process tracer (components without

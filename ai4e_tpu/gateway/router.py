@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import math
+import time
 from dataclasses import dataclass
 
 import aiohttp
@@ -30,6 +31,7 @@ from aiohttp import web
 from ..admission.deadline import (SHED_REASON_HEADER, expired,
                                   parse_deadline_at, parse_priority,
                                   propagation_headers, shed_reason)
+from ..observability.ledger import ADMITTED, PUBLISHED, ledger_event
 from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
 from ..rescache.keys import (CACHE_STATUS_HEADER, cache_bypass_requested,
                              request_key)
@@ -113,6 +115,11 @@ class Gateway:
         # pre-orchestration behavior, untouched. Set via
         # set_orchestration (platform assembly wires it).
         self._orchestration = None
+        # Request-observability hub (``observability/hub.py``); None →
+        # no hop-ledger stamps, no flight recorder, no per-route e2e
+        # telemetry — the pre-observability gateway byte for byte. Set
+        # via set_observability (platform assembly wires it).
+        self._observability = None
         # Sync-path single flight: key -> Future resolving to the leader's
         # (status, payload, content_type), or None when the leader errored.
         # Event-loop objects, so they live here rather than in the
@@ -187,6 +194,41 @@ class Gateway:
         classes beside the adaptive in-flight cap. Requires admission +
         resilience (the assembly enforces it)."""
         self._orchestration = orchestrator
+
+    def set_observability(self, hub) -> None:
+        """Enable (or clear with None) the request-observability layer
+        (``observability/``, ``docs/observability.md``): every accepted
+        async request gets ``admitted``/``published`` hop-ledger stamps,
+        sheds and expiries feed the flight recorder, the sync proxy
+        observes per-route end-to-end latency for the SLO engine, and
+        ``GET /v1/debug/flight`` serves the tail-sampled flight-recorder
+        dump. ``GET /v1/taskmanagement/task/{id}?ledger=1`` returns the
+        task's timeline whenever the store carries one."""
+        first = (self._observability is None and hub is not None
+                 and not getattr(self, "_flight_route_added", False))
+        self._observability = hub
+        if hub is not None:
+            # Backfill the backend→published route map for routes
+            # registered before the hub was attached — async task
+            # records carry the BACKEND endpoint, and the hub must
+            # label their outcomes with the PUBLISHED prefix the SLO
+            # objectives (and the refusal counters) use.
+            for route in self.routes:
+                if route.mode == "async":
+                    hub.map_route(endpoint_path(route.backend_uri),
+                                  route.prefix)
+        if first:
+            # Added lazily so a default gateway's route table stays
+            # byte-identical; aiohttp accepts routes until the app runs.
+            self._flight_route_added = True
+            self.app.router.add_get("/v1/debug/flight", self._flight_dump)
+
+    async def _flight_dump(self, _: web.Request) -> web.Response:
+        hub = self._observability
+        if hub is None or hub.flight is None:
+            return web.json_response(
+                {"error": "flight recorder not enabled"}, status=404)
+        return web.json_response(hub.flight.dump())
 
     def set_quota_tracker(self, tracker) -> None:
         """Enable (or clear with None) per-key request QUOTAS — APIM's
@@ -270,6 +312,11 @@ class Gateway:
                       max_body_bytes=max_body_bytes,
                       cacheable=len(backends) == 1)
         self.routes.append(route)
+        if self._observability is not None:
+            # One route label for the whole request shape — see
+            # set_observability's backfill.
+            self._observability.map_route(
+                endpoint_path(route.backend_uri), route.prefix)
         self.app.router.add_post(route.prefix, self._make_async_handler(route))
         self.app.router.add_post(route.prefix + "/{tail:.*}",
                                  self._make_async_handler(route))
@@ -312,6 +359,11 @@ class Gateway:
 
     def _make_async_handler(self, route: Route):
         async def handler(request: web.Request) -> web.Response:
+            # Hop-ledger anchor (observability/): the ``admitted`` event
+            # carries the request's ARRIVAL time, appended once the
+            # record exists — so gateway processing time is visible as
+            # the admitted→published delta.
+            arrival = time.time() if self._observability is not None else 0.0
             body = await self._read_limited(request, route)
             if body is None:
                 return self._payload_too_large(route)
@@ -450,6 +502,15 @@ class Gateway:
                 (cache.count_miss if xcache == "miss"
                  else cache.count_bypass)()
             stored = self.store.get(task.task_id)
+            if self._observability is not None:
+                # admitted (at arrival time) + published: the store's
+                # publish hook ran synchronously inside upsert, so by
+                # here the task is on the transport.
+                self._observability.stamp(
+                    task.task_id,
+                    ledger_event(ADMITTED, "gateway", t=arrival,
+                                 reason=route.prefix),
+                    ledger_event(PUBLISHED, "gateway"))
             if cache_key and stored.canonical_status not in TaskStatus.TERMINAL:
                 # This task is now the one execution owning the key; the
                 # store listener releases it on the terminal transition
@@ -473,6 +534,9 @@ class Gateway:
             return None
         self._admission.note_expired("gateway", priority)
         self._requests.inc(route=route.prefix, outcome="expired")
+        if self._observability is not None:
+            self._observability.record_refusal(route.prefix, "expired",
+                                               priority=priority)
         return web.Response(
             status=504, text="Deadline already expired.",
             headers={SHED_REASON_HEADER: shed_reason("gateway", "deadline")})
@@ -496,6 +560,9 @@ class Gateway:
         retry_after, why = decision
         adm.note_shed("gateway", priority)
         self._requests.inc(route=route.prefix, outcome="shed")
+        if self._observability is not None:
+            self._observability.record_refusal(route.prefix, why,
+                                               priority=priority)
         return web.json_response(
             {"error": f"request shed ({why}); retry later"},
             status=429,
@@ -587,6 +654,9 @@ class Gateway:
                 if expired(deadline_at):
                     adm.note_expired("gateway_sync", priority)
                     self._requests.inc(route=route.prefix, outcome="expired")
+                    if self._observability is not None:
+                        self._observability.record_refusal(
+                            route.prefix, "expired", priority=priority)
                     return web.Response(
                         status=504, text="Deadline already expired.",
                         headers={SHED_REASON_HEADER:
@@ -676,6 +746,10 @@ class Gateway:
                         adm.note_shed("gateway_sync", priority)
                         self._requests.inc(route=route.prefix,
                                            outcome="shed")
+                        if self._observability is not None:
+                            self._observability.record_refusal(
+                                route.prefix, "brownout",
+                                priority=priority)
                         return web.Response(
                             status=503, text="Service degraded (brownout).",
                             headers={"Retry-After":
@@ -692,6 +766,10 @@ class Gateway:
                         adm.note_shed("gateway_sync", priority)
                         self._requests.inc(route=route.prefix,
                                            outcome="shed")
+                        if self._observability is not None:
+                            self._observability.record_refusal(
+                                route.prefix, "pressure",
+                                priority=priority)
                         return web.Response(
                             status=503, text="Sync capacity exhausted.",
                             headers={"Retry-After":
@@ -793,6 +871,16 @@ class Gateway:
                                 res.observe_status(base, resp.status)
                             self._requests.inc(route=route.prefix,
                                                outcome=str(resp.status))
+                            if (self._observability is not None
+                                    and request.method == "POST"):
+                                # Per-route e2e latency + outcome for
+                                # the SLO engine (POST-only — the same
+                                # inference-request gate admission and
+                                # the cache use).
+                                self._observability.observe_sync(
+                                    route.prefix,
+                                    _time.perf_counter() - t0,
+                                    resp.status)
                             if fut is not None:
                                 # Only successes become cache entries — and
                                 # only when the family's invalidation
@@ -852,6 +940,11 @@ class Gateway:
                             raise
                         self._requests.inc(route=route.prefix,
                                            outcome="unreachable")
+                        if (self._observability is not None
+                                and request.method == "POST"):
+                            self._observability.observe_sync(
+                                route.prefix,
+                                _time.perf_counter() - t0, 502)
                         return web.Response(
                             status=502,
                             text=f"Backend unreachable: {exc}")
@@ -890,6 +983,18 @@ class Gateway:
         the store's change listener wakes exactly the waiters for that task.
         """
         task_id = request.match_info["task_id"]
+
+        def answer(record) -> web.Response:
+            """The poll response; ``?ledger=1`` (opt-in — the default
+            wire shape is byte-identical) attaches the task's hop-ledger
+            timeline when the store carries one
+            (docs/observability.md)."""
+            payload = record.to_dict()
+            if request.query.get("ledger", "") not in ("", "0", "false"):
+                getter = getattr(self.store, "get_ledger", None)
+                payload["Ledger"] = getter(task_id) if getter else []
+            return web.json_response(payload)
+
         try:
             task = self.store.get(task_id)
         except TaskNotFound:
@@ -915,12 +1020,12 @@ class Gateway:
                 # the destination feed) falls back to a store read.
                 record = await feed_for(task_id).wait_terminal(task_id, wait)
                 if record is not None:
-                    return web.json_response(record.to_dict())
+                    return answer(record)
                 try:
                     task = self.store.get(task_id)
                 except TaskNotFound:
                     return web.Response(status=404, text="Task not found.")
-                return web.json_response(task.to_dict())
+                return answer(task)
             # Register the waiter BEFORE the re-read so a transition between
             # re-read and wait() still sets the event (no lost wakeup).
             event = self._waiter_for(task_id)
@@ -938,7 +1043,7 @@ class Gateway:
                 return web.Response(status=404, text="Task not found.")
             finally:
                 self._drop_waiter(task_id, event)
-        return web.json_response(task.to_dict())
+        return answer(task)
 
     # Waiter bookkeeping is copy-on-write (sets are replaced, never mutated):
     # _on_task_change may iterate from any thread while the event loop

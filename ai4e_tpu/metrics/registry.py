@@ -76,15 +76,26 @@ class Histogram:
         self.buckets = tuple(buckets)
         self._counts: dict[LabelKey, list[int]] = {}
         self._sums: dict[LabelKey, float] = defaultdict(float)
+        # OpenMetrics exemplars: (labelkey, bucket index) -> the LAST
+        # observation that landed there carrying an exemplar — so a p99
+        # bucket in /metrics links to a concrete trace/task id an
+        # operator can feed straight to the trace CLI or the flight
+        # recorder. Only populated by callers that pass one; the default
+        # exposition is byte-identical without them.
+        self._exemplars: dict[LabelKey, dict[int, tuple[dict, float, float]]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(self, value: float, exemplar: dict | None = None,
+                **labels: str) -> None:
         key = _label_key(labels)
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     counts[i] += 1
+                    if exemplar:
+                        self._exemplars.setdefault(key, {})[i] = (
+                            dict(exemplar), value, time.time())
                     break
             self._sums[key] += value
 
@@ -108,9 +119,14 @@ class Histogram:
         with self._lock:
             out = []
             for key, counts in self._counts.items():
-                out.append(("histogram", self.name, dict(key),
-                            {"buckets": list(zip(self.buckets, counts)),
-                             "sum": self._sums[key], "count": sum(counts)}))
+                data = {"buckets": list(zip(self.buckets, counts)),
+                        "sum": self._sums[key], "count": sum(counts)}
+                exemplars = self._exemplars.get(key)
+                if exemplars:
+                    # Keyed extension: consumers reading only
+                    # buckets/sum/count are untouched.
+                    data["exemplars"] = dict(exemplars)
+                out.append(("histogram", self.name, dict(key), data))
             return out
 
 
@@ -168,12 +184,32 @@ class MetricsRegistry:
                 label_s = "{" + label_s + "}" if label_s else ""
                 if kind == "histogram":
                     cum = 0
-                    for edge, c in value["buckets"]:
+                    exemplars = value.get("exemplars") or {}
+                    for i, (edge, c) in enumerate(value["buckets"]):
                         cum += c
                         le = "+Inf" if edge == float("inf") else repr(edge)
                         inner = dict(labels, le=le)
                         ls = ",".join(f'{k}="{v}"' for k, v in sorted(inner.items()))
                         lines.append(f"{name}_bucket{{{ls}}} {cum}")
+                        if i in exemplars:
+                            # Exemplar as a standalone COMMENT line right
+                            # under its bucket: the classic Prometheus
+                            # text format (which this endpoint serves)
+                            # has no exemplar syntax — appending
+                            # OpenMetrics' `# {…}` after the VALUE would
+                            # fail the whole scrape the moment one
+                            # exemplar lands. A full-line comment is
+                            # skipped by every classic parser while
+                            # humans and tooling still get the
+                            # bucket→trace/task link. Absent entirely
+                            # unless an observation carried one, so the
+                            # default exposition stays byte-identical.
+                            ex_labels, ex_value, ex_ts = exemplars[i]
+                            exs = ",".join(f'{k}="{v}"' for k, v
+                                           in sorted(ex_labels.items()))
+                            lines.append(
+                                f"# exemplar {name}_bucket{{{ls}}} "
+                                f"{{{exs}}} {ex_value} {ex_ts}")
                     lines.append(f"{name}_sum{label_s} {value['sum']}")
                     lines.append(f"{name}_count{label_s} {value['count']}")
                 else:

@@ -1,4 +1,6 @@
-"""Observability — distributed tracing keyed by TaskId, depth loggers.
+"""Observability — distributed tracing keyed by TaskId, depth loggers,
+the per-task hop ledger, the tail-sampled flight recorder, and the SLO
+burn-rate engine (docs/observability.md).
 
 The reference's three tracing mechanisms (SURVEY.md §5): OpenCensus spans
 around every endpoint (``APIs/1.0/base-py/ai4e_service.py:158-178``), Istio
@@ -26,9 +28,21 @@ from .tracing import (
     get_tracer,
 )
 from .depth_logger import DepthLogger
+from .flight import FlightRecorder
+from .hub import RequestObservability
+from .ledger import HopLedger, ledger_event, render_ledger
+from .slo import SloEngine, SloObjective, parse_objectives
 
 __all__ = [
     "DepthLogger",
+    "FlightRecorder",
+    "HopLedger",
+    "RequestObservability",
+    "SloEngine",
+    "SloObjective",
+    "ledger_event",
+    "parse_objectives",
+    "render_ledger",
     "FanoutExporter",
     "InMemoryExporter",
     "JsonlExporter",

@@ -146,12 +146,25 @@ class Orchestrator:
     # -- placement ----------------------------------------------------------
 
     def place(self, backends, deadline_at: float = 0.0, priority: int = 1,
-              rng=None, exclude=()) -> str:
+              rng=None, exclude=(), note=None) -> str:
         """Choose the delivery target for one request (module docstring).
         ``backends``/``exclude`` carry the same contract as
         ``BackendHealth.pick`` — weighted set, failover exclusion ignored
-        when it would empty the set."""
+        when it would empty the set. ``note`` (optional,
+        ``note(outcome, uri)``) receives the placement outcome label AND
+        the chosen backend — the observability layer stamps both onto
+        the task's hop ledger (``placed``/``probe`` events; a probe
+        event without the probed backend would carry no diagnostic
+        value) without changing the return contract either call site
+        depends on."""
         now = self._clock()
+
+        def _tell(outcome: str, uri: str) -> None:
+            if note is not None:
+                try:
+                    note(outcome, uri)
+                except Exception:  # noqa: BLE001; ai4e: noqa[AIL005] — an observability sink must never fail a placement
+                    pass
         pool = [(u, w) for u, w in backends if u not in exclude and w > 0]
         if not pool:
             pool = [(u, w) for u, w in backends if w > 0]
@@ -164,6 +177,7 @@ class Orchestrator:
             chosen = self.health.pick(backends, rng, exclude=exclude)
             self._placements.inc(backend=backend_label(chosen),
                                  outcome="forced")
+            _tell("forced", chosen)
             return chosen
         if priority >= BACKGROUND and self.ladder.restrict_background():
             cheapest = min(self.cost_of(u) for u, _ in avail)
@@ -187,6 +201,7 @@ class Orchestrator:
                 self.health.commit_pick(uri, now)
                 self._placements.inc(backend=backend_label(uri),
                                      outcome="probe")
+                _tell("probe", uri)
                 return uri
         budget = remaining_s(deadline_at)
         chosen = None
@@ -224,4 +239,5 @@ class Orchestrator:
             self.ladder.note(miss=False, now=now)
         self.health.commit_pick(chosen, now)
         self._placements.inc(backend=backend_label(chosen), outcome=outcome)
+        _tell(outcome, chosen)
         return chosen

@@ -104,15 +104,20 @@ class DegradationLadder:
 
     # -- pressure feed ------------------------------------------------------
 
-    def note(self, miss: bool, now: float | None = None) -> None:
-        """One unit of deadline evidence: a placement decision (miss =
-        nobody cleared the confidence bar) or a terminal outcome (miss =
-        late/expired). Evaluates transitions inline — the ladder needs
-        no background task."""
+    def note(self, miss: bool, now: float | None = None,
+             n: float = 1.0) -> None:
+        """``n`` units of deadline evidence: a placement decision (miss =
+        nobody cleared the confidence bar), a terminal outcome (miss =
+        late/expired), or — batched via ``n`` — an SLO engine tick's
+        worth of requests (one note per multi-second tick would decay
+        below the ``min_rate`` evidence floor and never move the
+        ladder; the engine passes the window's event count instead).
+        Evaluates transitions inline — the ladder needs no background
+        task."""
         now = self._clock() if now is None else now
-        self._total.on_event(now=now)
+        self._total.on_event(n, now=now)
         if miss:
-            self._miss.on_event(now=now)
+            self._miss.on_event(n, now=now)
         self.evaluate(now)
 
     def pressure(self, now: float | None = None) -> float:

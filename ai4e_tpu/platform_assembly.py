@@ -160,6 +160,31 @@ class PlatformConfig:
     # Per-shard change-feed replay window (terminal records retained for
     # the long-poll attach race; taskstore/feed.py).
     shard_feed_recent: int = 4096
+    # Request observability (observability/, docs/observability.md):
+    # per-task hop ledger stamped at every hop and carried on the task
+    # record (``GET /v1/taskmanagement/task/{id}?ledger=1``, the trace
+    # CLI), a tail-sampled flight recorder keeping 100% of slow/failed/
+    # expired/shed/failovered request timelines (``GET /v1/debug/flight``,
+    # dumped by the chaos harness on invariant violation), and the
+    # per-route e2e latency/outcome telemetry the SLO engine reads. Off
+    # by default — the assembly is byte-identical without it (asserted
+    # in tests); requires the Python store (the native core has no
+    # ledger slot).
+    observability: bool = False
+    flight_capacity: int = 512
+    flight_sample: float = 0.05       # kept fraction of boring requests
+    flight_slow_ms: float = 1000.0    # e2e latency that makes one interesting
+    # Per-route SLO objectives ("/route=<latency_ms>:<target_pct>" or
+    # "/route=goodput:<target_pct>", comma-separated) + the multi-window
+    # burn-rate engine exporting ai4e_slo_* (observability/slo.py).
+    # Requires observability=True (the engine reads its histograms).
+    slo_objectives: str | None = None
+    slo_tick_s: float = 5.0
+    slo_fast_window_s: float = 300.0
+    slo_slow_window_s: float = 3600.0
+    # Sustained SLO breaches feed the degradation ladder as an extra
+    # miss-evidence source (requires orchestration).
+    slo_ladder: bool = False
 
 
 class LocalPlatform:
@@ -341,6 +366,46 @@ class LocalPlatform:
             # and its store listener feeds the ladder actual deadline
             # outcomes (late/expired) — the brownout's evidence loop.
             self.admission.set_ladder(self.orchestration.ladder)
+        self.observability = None
+        self.slo = None
+        if self.config.observability:
+            if self.config.native_store:
+                # The C store has no ledger slot; silently running the
+                # layer without timelines would be the worst outcome —
+                # same loud-fail pattern as admission-on-native.
+                raise ValueError(
+                    "observability=True requires the Python store "
+                    "(the native core carries no hop-ledger state)")
+            from .observability.flight import FlightRecorder
+            from .observability.hub import RequestObservability
+            self.observability = RequestObservability(
+                self.store, metrics=self.metrics,
+                flight=FlightRecorder(
+                    capacity=self.config.flight_capacity,
+                    sample=self.config.flight_sample,
+                    slow_ms=self.config.flight_slow_ms,
+                    metrics=self.metrics))
+        if self.config.slo_objectives:
+            if self.observability is None:
+                raise ValueError(
+                    "slo_objectives requires observability=True — the "
+                    "SLO engine reads the e2e histograms the "
+                    "observability layer maintains "
+                    "(docs/observability.md)")
+            from .observability.slo import SloEngine, parse_objectives
+            self.slo = SloEngine(
+                parse_objectives(self.config.slo_objectives),
+                metrics=self.metrics,
+                fast_window_s=self.config.slo_fast_window_s,
+                slow_window_s=self.config.slo_slow_window_s,
+                tick_s=self.config.slo_tick_s)
+        if self.config.slo_ladder:
+            if self.slo is None or self.orchestration is None:
+                raise ValueError(
+                    "slo_ladder=True requires slo_objectives AND "
+                    "orchestration=True — it feeds SLO breaches to the "
+                    "degradation ladder (docs/observability.md)")
+            self.slo.attach_ladder(self.orchestration.ladder)
         self.broker = None
         self.dispatchers = None
         self.topic = None
@@ -378,6 +443,7 @@ class LocalPlatform:
                 admission=self.admission,
                 resilience=self.resilience,
                 orchestration=self.orchestration,
+                observability=self.observability,
                 metrics=self.metrics)
         else:
             raise ValueError(
@@ -392,6 +458,8 @@ class LocalPlatform:
             self.gateway.set_resilience(self.resilience)
         if self.orchestration is not None:
             self.gateway.set_orchestration(self.orchestration)
+        if self.observability is not None:
+            self.gateway.set_observability(self.observability)
         # Terminal-history retention: None = AUTO — 15 min on the Python
         # store, sized to the soak evidence (unevicted terminal history
         # grows ~12 MB/min at 200 req/s → AUTO bounds steady-state at
@@ -652,6 +720,8 @@ class LocalPlatform:
         await self.depth_logger.start()
         if self.reaper is not None:
             await self.reaper.start()
+        if self.slo is not None:
+            await self.slo.start()
         for scaler in self.autoscalers:
             await scaler.start()
         self._reseed_unfinished()
@@ -700,6 +770,8 @@ class LocalPlatform:
         await self._start_transport(loop)
         if self.reaper is not None:
             await self.reaper.start()
+        if self.slo is not None:
+            await self.slo.start()
         for scaler in self.autoscalers:
             await scaler.start()
         publish = (self.topic.publish if self.config.transport == "push"
@@ -868,6 +940,8 @@ class LocalPlatform:
                 await self.dispatchers.stop()
             if self.reaper is not None:
                 await self.reaper.stop()
+            if self.slo is not None:
+                await self.slo.stop()
             await self.depth_logger.stop()
             if hasattr(self.store, "stop_replication"):
                 await self.store.stop_replication()

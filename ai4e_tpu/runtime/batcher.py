@@ -54,6 +54,10 @@ class _Pending:
     # DeadlineExceeded instead of being padded onto the device
     # (admission/ — dead work never reaches the TPU).
     deadline_at: float = 0.0
+    # Hop-ledger buffer (observability/ledger.HopLedger) the worker
+    # passed with the request; the batcher stamps batch-cut and device
+    # phases into it. None = no stamping (the default).
+    ledger: object = None
 
 
 class MicroBatcher:
@@ -66,6 +70,7 @@ class MicroBatcher:
         pipeline_depth: int = 2,
         interactive_reserve: float = 0.25,
         priority_aging_s: float = 2.0,
+        measure_phases: bool = False,
     ):
         self.runtime = runtime
         self.max_wait = max_wait_ms / 1000.0
@@ -128,6 +133,42 @@ class MicroBatcher:
         self._expired_total = self.metrics.counter(
             "ai4e_admission_expired_total",
             "Requests dropped on deadline expiry, by hop/priority")
+        # Device-phase decomposition (observability/, ROADMAP item 2's
+        # overlap metric): off by default — the batch path and /metrics
+        # content are byte-identical until AI4E_OBSERVABILITY_HOP_LEDGER
+        # turns it on. When on, batches run through the runtime's
+        # run_batch_phases (measured h2d / compile-or-execute / d2h),
+        # each phase lands in its histogram, and the h2d seconds spent
+        # while ANOTHER batch was executing accumulate into the overlap
+        # counter — overlap ratio ≈ how well transfers hide under
+        # compute (1.0 = fully hidden, the double-buffering goal).
+        self.measure_phases = measure_phases
+        if measure_phases:
+            import threading
+            self._phase_hist = self.metrics.histogram(
+                "ai4e_device_phase_seconds",
+                "Device-boundary phase durations (h2d/compile/execute/"
+                "d2h) per batch")
+            self._overlap_total = self.metrics.counter(
+                "ai4e_batch_h2d_overlap_seconds_total",
+                "H2D transfer seconds that overlapped another batch's "
+                "execute phase")
+            self._overlap_ratio = self.metrics.gauge(
+                "ai4e_batch_overlap_ratio",
+                "Cumulative h2d/execute overlap ratio (overlapped h2d "
+                "seconds / total h2d seconds)")
+            self._phase_lock = threading.Lock()
+            # Completed execute windows (start, end) + in-flight batch
+            # starts — the overlap denominator's counterparty. In-flight
+            # windows are approximated from the batch's call start (the
+            # exact execute start is known only at completion), which
+            # slightly over-counts overlap; documented in
+            # docs/observability.md.
+            from collections import deque as _deque
+            self._exec_windows = _deque(maxlen=64)
+            self._exec_pending: dict[int, float] = {}
+            self._h2d_seconds = 0.0
+            self._h2d_overlap_seconds = 0.0
 
     # -- request side ------------------------------------------------------
 
@@ -136,7 +177,8 @@ class MicroBatcher:
         return sum(len(v) for v in self._pending.values())
 
     async def submit(self, model_name: str, example: np.ndarray,
-                     priority: int = 0, deadline_at: float = 0.0):
+                     priority: int = 0, deadline_at: float = 0.0,
+                     ledger=None):
         """Queue one example; resolves to that example's postprocessed result.
 
         ``priority`` 0 is interactive (default); higher values are
@@ -149,6 +191,11 @@ class MicroBatcher:
         is still pending when the deadline passes, the await raises
         ``DeadlineExceeded`` at the next batch cut and the example never
         ships to the device (admission/).
+
+        ``ledger`` (optional ``observability.ledger.HopLedger``): the
+        batch cut and the device phases this example rides are stamped
+        into it (``batched``/``h2d``/``execute``/``d2h``) — the worker
+        flushes the buffer to the task store when the request finishes.
         """
         if self._stop:
             raise RuntimeError("batcher stopped")
@@ -165,7 +212,7 @@ class MicroBatcher:
         fut = asyncio.get_running_loop().create_future()
         self._pending.setdefault(model_name, []).append(
             _Pending(example, fut, priority=priority,
-                     deadline_at=deadline_at))
+                     deadline_at=deadline_at, ledger=ledger))
         self._pending_gauge.set(self.pending_count)
         self._wakeup.set()
         return await fut
@@ -293,6 +340,53 @@ class MicroBatcher:
         self._pending_gauge.set(self.pending_count)
         return live
 
+    def _note_phases(self, model_name: str, t_call: float,
+                     phases: dict, batch: list[_Pending]) -> None:
+        """Account one phased batch: phase histograms, h2d/execute
+        overlap, and per-request ledger stamps. ``t_call`` is the
+        perf-counter start of the batch's device call."""
+        for phase, dur in phases.items():
+            self._phase_hist.observe(dur, phase=phase, model=model_name)
+        h2d = phases.get("h2d", 0.0)
+        exec_dur = phases.get("execute", phases.get("compile", 0.0))
+        h2d_w = (t_call, t_call + h2d)
+        exec_w = (h2d_w[1], h2d_w[1] + exec_dur)
+        now = time.perf_counter()
+        if h2d > 0:
+            with self._phase_lock:
+                overlap = 0.0
+                for w0, w1 in self._exec_windows:
+                    overlap += max(0.0, min(h2d_w[1], w1) - max(h2d_w[0], w0))
+                for token, start in self._exec_pending.items():
+                    if token != id(batch):
+                        # In-flight batch: execute window approximated
+                        # from its call start to now (over-counts by its
+                        # own h2d time; see __init__ comment).
+                        overlap += max(0.0, min(h2d_w[1], now)
+                                       - max(h2d_w[0], start))
+                overlap = min(overlap, h2d)
+                self._exec_windows.append(exec_w)
+                self._h2d_seconds += h2d
+                self._h2d_overlap_seconds += overlap
+                ratio = (self._h2d_overlap_seconds / self._h2d_seconds
+                         if self._h2d_seconds > 0 else 0.0)
+            self._overlap_total.inc(overlap, model=model_name)
+            self._overlap_ratio.set(ratio)
+        # Ledger stamps ride wall-clock time like every other hop:
+        # convert the perf-counter anchors through "now".
+        stamped = [p for p in batch if p.ledger is not None]
+        if stamped:
+            epoch_call = time.time() - (now - t_call)
+            cursor = epoch_call
+            for phase in ("h2d", "compile", "execute", "d2h"):
+                dur = phases.get(phase)
+                if dur is None:
+                    continue
+                for p in stamped:
+                    p.ledger.stamp(phase, "device", t=cursor,
+                                   ms=dur * 1e3)
+                cursor += dur
+
     async def _execute(self, loop, model_name: str,
                        batch: list[_Pending]) -> None:
         servable = self.runtime.models[model_name]
@@ -306,14 +400,30 @@ class MicroBatcher:
                           servable.input_dtype)
         for i, p in enumerate(batch):
             padded[i] = p.example
+            if p.ledger is not None:
+                p.ledger.stamp("batched", "batcher",
+                               reason=f"size {n} bucket {bucket}")
 
         t0 = time.perf_counter()
-        # run_batch_report surfaces rows a degraded follower invalidated
-        # (multihost zeros-shard path); plain run_batch is the fallback for
-        # duck-typed runtimes without one.
+        # Phase-decomposed path (observability): measured h2d / execute /
+        # d2h plus transfer/execute overlap accounting. Falls back to
+        # run_batch_report — which surfaces rows a degraded follower
+        # invalidated (multihost zeros-shard path) — and plain run_batch
+        # for duck-typed runtimes without either.
+        phased = (self.measure_phases
+                  and getattr(self.runtime, "run_batch_phases", None)
+                  is not None)
         runner = getattr(self.runtime, "run_batch_report", None)
+        phases: dict = {}
+        if phased:
+            with self._phase_lock:
+                self._exec_pending[id(batch)] = t0
         try:
-            if runner is not None:
+            if phased:
+                outputs, poisoned, phases = await loop.run_in_executor(
+                    self._executor, self.runtime.run_batch_phases,
+                    model_name, padded)
+            elif runner is not None:
                 outputs, poisoned = await loop.run_in_executor(
                     self._executor, runner, model_name, padded)
             else:
@@ -326,6 +436,12 @@ class MicroBatcher:
                 if not p.future.done():
                     p.future.set_exception(exc)
             return
+        finally:
+            if phased:
+                with self._phase_lock:
+                    self._exec_pending.pop(id(batch), None)
+        if phases:
+            self._note_phases(model_name, t0, phases, batch)
         self._batch_latency.observe(time.perf_counter() - t0, model=model_name)
         self._batch_size_hist.observe(n, model=model_name)
         self._h2d_bytes.inc(padded.nbytes, model=model_name)

@@ -113,6 +113,12 @@ class ModelRuntime:
         if replicate_outputs is None:
             replicate_outputs = jax.process_count() > 1
         self._replicate_outputs = replicate_outputs
+        # (model, padded-batch-size) programs this process has executed —
+        # run_batch_phases labels a first execution's device time
+        # ``compile`` instead of ``execute`` (with a persistent
+        # compilation cache the "compile" is a cache load, still the
+        # first-call stall worth naming).
+        self._executed_shapes: set[tuple[str, int]] = set()
 
     @property
     def data_axis_size(self) -> int:
@@ -250,6 +256,11 @@ class ModelRuntime:
             batch = jax.make_array_from_process_local_data(
                 servable._batch_sharding, batch, global_shape=batch.shape)
         out = servable._compiled(servable.params, batch)
+        # Mark the program executed for the phase decomposition's
+        # compile-vs-execute labeling: warmup drives every bucket through
+        # HERE, so a warmed worker's first phased serving call reports
+        # ``execute``, not a phantom ``compile``.
+        self._executed_shapes.add((name, batch.shape[0]))
         return jax.device_get(out)
 
     def run_batch_report(self, name: str, batch: np.ndarray
@@ -260,6 +271,49 @@ class ModelRuntime:
         has no partial-degrade mode: the set is always empty (a device
         failure raises and fails the whole batch)."""
         return self.run_batch(name, batch), frozenset()
+
+    def run_batch_phases(self, name: str, batch: np.ndarray
+                         ) -> tuple[object, frozenset, dict[str, float]]:
+        """``run_batch_report`` with the device boundary decomposed into
+        measured phases (observability/, docs/observability.md):
+
+        - ``h2d``: explicit ``device_put`` of the padded batch onto the
+          mesh sharding, blocked until resident;
+        - ``execute``: the compiled program on the already-resident
+          batch, blocked until outputs materialize — reported as
+          ``compile`` instead when this is the FIRST execution of the
+          (model, bucket) program in this process (warmup normally eats
+          these; a serving-path compile is exactly the stall an operator
+          needs to see named);
+        - ``d2h``: ``device_get`` of the outputs.
+
+        Returns ``(host_outputs, poisoned_rows, {phase: seconds})``.
+        Single-host only — the batcher falls back to ``run_batch_report``
+        (one undecomposed ``execute``) on runtimes without this method
+        (multi-host mirrors every call and must not diverge per phase).
+        """
+        servable = self.models[name]
+        if jax.process_count() > 1:
+            # Phase decomposition would desynchronise the follower
+            # mirror-loop's single-call contract; undecomposed fallback.
+            out, poisoned = self.run_batch_report(name, batch)
+            return out, poisoned, {}
+        phases: dict[str, float] = {}
+        t0 = time.perf_counter()
+        device_batch = jax.device_put(batch, servable._batch_sharding)
+        jax.block_until_ready(device_batch)
+        phases["h2d"] = time.perf_counter() - t0
+        first = (name, batch.shape[0]) not in self._executed_shapes
+        t0 = time.perf_counter()
+        out = servable._compiled(servable.params, device_batch)
+        jax.block_until_ready(out)
+        phases["compile" if first else "execute"] = (
+            time.perf_counter() - t0)
+        self._executed_shapes.add((name, batch.shape[0]))
+        t0 = time.perf_counter()
+        host = jax.device_get(out)
+        phases["d2h"] = time.perf_counter() - t0
+        return host, frozenset(), phases
 
 
 def enable_compilation_cache(path: str = "/tmp/ai4e_tpu_xla_cache") -> None:

@@ -39,12 +39,21 @@ class InferenceWorker:
                  prefix: str = "v1", metrics: MetricsRegistry | None = None,
                  store=None, reporter=None, result_cache=None,
                  checkpoint_root: str | None = None,
-                 admin_api_keys=None, cache_sync_path: bool = True):
+                 admin_api_keys=None, cache_sync_path: bool = True,
+                 hop_ledger: bool = False):
         import os
 
         self.runtime = runtime
         self.batcher = batcher
         self.store = store
+        # Hop-ledger participation (observability/ledger.py,
+        # AI4E_OBSERVABILITY_HOP_LEDGER): each async request carries a
+        # HopLedger buffer through the batcher (batch cut + device
+        # phases) and flushes it to the task store in ONE call before
+        # the terminal transition — so the control plane's per-task
+        # timeline is complete across the process boundary. Off (the
+        # default) allocates nothing and makes no extra store calls.
+        self._hop_ledger = hop_ledger
         # Inference result cache (rescache/): the sync path answers repeat
         # requests from it (keyed on model + params_version + wire + body,
         # so a reload's version bump alone already misses), and a checkpoint
@@ -342,6 +351,10 @@ class InferenceWorker:
         async def _async(taskId, body, content_type, deadline_at=0.0,
                          priority=0, _name=name, _servable=servable):
             tm = self.service.task_manager
+            buf = None
+            if self._hop_ledger:
+                from ..observability.ledger import HopLedger
+                buf = HopLedger()
             if expired(deadline_at):
                 # Submit-hop shed (admission/): terminal `expired`, never
                 # adopted into the batcher — the dispatcher treats the 200
@@ -360,7 +373,8 @@ class InferenceWorker:
             try:
                 result = await self.batcher.submit(_name, np.asarray(example),
                                                    priority=priority,
-                                                   deadline_at=deadline_at)
+                                                   deadline_at=deadline_at,
+                                                   ledger=buf)
             except BatcherSaturated:
                 # Saturated between admission and submit: hand the task back
                 # to the broker (same-endpoint republish with empty body →
@@ -372,9 +386,19 @@ class InferenceWorker:
             except DeadlineExceeded as exc:
                 # Expired while pending in the batcher (which already
                 # counted the hop metric): terminal transition only.
+                await self._flush_ledger(tm, taskId, buf)
                 await tm.update_task_status(
                     taskId, expired_status(exc.hop), TaskStatus.EXPIRED)
                 return
+            except Exception:
+                # Execution failure (device error surfacing through the
+                # batch future): the service shell fails the task AFTER
+                # this re-raise — flush the batched/phase stamps FIRST,
+                # while the task is still non-terminal, so exactly the
+                # failed requests the flight recorder keeps at 100 %
+                # carry their worker-side timeline.
+                await self._flush_ledger(tm, taskId, buf)
+                raise
             if pipeline_to is not None:
                 if handoff_wants_example:
                     # Handoffs consume the natural image; wire-encoded
@@ -387,6 +411,10 @@ class InferenceWorker:
                     handoff = pipeline_to(result)
                 if handoff is not None:
                     next_endpoint, next_body = handoff
+                    # Stage 1's device phases flush now — the next
+                    # stage's worker opens its own buffer under the
+                    # same TaskId, so the timeline spans the pipeline.
+                    await self._flush_ledger(tm, taskId, buf)
                     # Keep the stage's intermediate output retrievable
                     # under the same TaskId while the task moves on.
                     await self._store_result(
@@ -398,11 +426,32 @@ class InferenceWorker:
                     await tm.add_pipeline_task(taskId, next_endpoint,
                                                body=next_body)
                     return
+            # Flush BEFORE the result write and the terminal transition:
+            # the task is still live (retention cannot have evicted it),
+            # and a failing result hop then still leaves the timeline on
+            # the record for the shell's failure path.
+            await self._flush_ledger(tm, taskId, buf)
             await self._store_result(
                 taskId, json.dumps(_jsonable(result)).encode())
             await tm.complete_task(
                 taskId, f"completed - {_summarise(result)}")
 
+    async def _flush_ledger(self, tm, task_id: str, buf) -> None:
+        """Ship a request's buffered hop-ledger events to the store in
+        one call; DRAINS the buffer, so the finally backstop after an
+        already-flushed path is a no-op. Failures are dropped with a
+        debug log — fail-open telemetry, never a serving error
+        (docs/observability.md)."""
+        if buf is None:
+            return
+        events = buf.drain()
+        if not events:
+            return
+        try:
+            await tm.append_ledger(task_id, events)
+        except Exception:  # noqa: BLE001; ai4e: noqa[AIL005] — observability is fail-open: a dropped flush loses a timeline, not a task
+            log.debug("hop-ledger flush dropped for task %s", task_id,
+                      exc_info=True)
 
     def serve_batch(self, servable: ServableModel,
                     sync_path: str | None = None,

@@ -70,6 +70,15 @@ class TaskManagerBase:
     async def get_task_status(self, task_id: str) -> dict | None:
         raise NotImplementedError
 
+    async def append_ledger(self, task_id: str, events: list[dict]) -> int:
+        """Append hop-ledger events to the task's timeline on the store
+        (observability/ledger.py). Base default is a no-op so duck-typed
+        task-manager substitutes keep working; the real backends
+        forward to ``InMemoryTaskStore.append_ledger`` directly or over
+        ``POST /v1/taskstore/ledger``. Callers treat failures as
+        droppable — the ledger is fail-open telemetry."""
+        return 0
+
     async def is_terminal(self, task_id: str) -> bool:
         """Terminal-status probe — the shared guard for status-writing cold
         paths (AIL003; the dispatcher, webhook, and service shell all use
@@ -115,6 +124,12 @@ class LocalTaskManager(TaskManagerBase):
     async def _update(self, task_id: str, status: str,
                       backend_status: str | None = None) -> dict:
         return self.store.update_status(task_id, status, backend_status).to_dict()
+
+    async def append_ledger(self, task_id: str, events: list[dict]) -> int:
+        append = getattr(self.store, "append_ledger", None)
+        if append is None:  # duck-typed store substitutes in tests
+            return 0
+        return append(task_id, events)
 
 
 class _HttpStoreClient:
@@ -258,6 +273,22 @@ class HttpTaskManager(_HttpStoreClient, TaskManagerBase):
         if resp.status != 200:  # 204 = task unknown to the store
             raise KeyError(f"task not found: {task_id}")
         return json.loads(body)
+
+    async def append_ledger(self, task_id: str, events: list[dict]) -> int:
+        """Ship the worker's buffered hop-ledger events to the control
+        plane in one POST — the cross-process leg of the per-task
+        timeline (observability/ledger.py). A store without the surface
+        (older control plane) answers 404/405: treated as zero appended,
+        never an error — the ledger is fail-open telemetry."""
+        payload = {"TaskId": task_id, "Events": events}
+        resp, body = await self._request("POST", "/v1/taskstore/ledger",
+                                         data=json.dumps(payload))
+        if resp.status != 200:
+            return 0
+        try:
+            return int(json.loads(body).get("appended", 0))
+        except (json.JSONDecodeError, ValueError, AttributeError):
+            return 0
 
 
 class HttpResultStore(_HttpStoreClient):

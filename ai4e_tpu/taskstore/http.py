@@ -338,9 +338,53 @@ def make_app(store: InMemoryTaskStore,
             return web.json_response({"error": str(exc)}, status=400)
         return web.json_response({"ok": True})
 
+    async def append_ledger(request: web.Request) -> web.Response:
+        """Hop-ledger append (observability/ledger.py): a remote worker
+        ships its buffered device-phase/batch events here in one POST so
+        the task's timeline is complete across process boundaries.
+        Events are sanitized, never trusted verbatim; unknown tasks are
+        404 (the worker drops the stamp — fail-open telemetry)."""
+        raw = await read_body_limited(request, max_body_bytes)
+        if raw is None:
+            return too_large(max_body_bytes)
+        try:
+            payload = json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        task_id = payload.get("TaskId", "")
+        if not task_id:
+            return web.json_response({"error": "TaskId required"},
+                                     status=400)
+        append = getattr(store, "append_ledger", None)
+        if append is None:  # e.g. the native store: no ledger support
+            return web.json_response(
+                {"error": "store does not support the hop ledger"},
+                status=404)
+        from ..observability.ledger import validate_events
+        events = validate_events(payload.get("Events"))
+        try:
+            kept = append(task_id, events)
+        except TaskNotFound:
+            return web.json_response({"error": f"unknown task {task_id}"},
+                                     status=404)
+        except NotPrimaryError:
+            return not_primary()
+        return web.json_response({"ok": True, "appended": kept})
+
+    async def get_ledger(request: web.Request) -> web.Response:
+        task_id = request.query.get("taskId", "")
+        if not task_id:
+            return web.json_response({"error": "taskId required"},
+                                     status=400)
+        getter = getattr(store, "get_ledger", None)
+        events = getter(task_id) if getter is not None else []
+        return web.json_response({"TaskId": task_id, "Events": events})
+
     app.router.add_post("/v1/taskstore/result", stamped(put_result))
     app.router.add_post("/v1/taskstore/result-ref", stamped(put_result_ref))
     app.router.add_get("/v1/taskstore/result", stamped(get_result))
+    app.router.add_post("/v1/taskstore/ledger", stamped(append_ledger))
+    app.router.add_get("/v1/taskstore/ledger", stamped(get_ledger))
 
     # -- shard topology (sharded facade only; taskstore/sharding.py) -------
 

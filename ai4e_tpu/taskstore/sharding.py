@@ -687,6 +687,25 @@ class ShardedTaskStore:
         return self._route(task_id,
                            lambda s: s.open_result(task_id, stage=stage))
 
+    def append_ledger(self, task_id: str, events: list[dict]) -> int:
+        """Hop-ledger append, ring-routed like every per-TaskId mutation
+        (observability/ledger.py). Residual: a rebalance moving the slot
+        mid-flight leaves the already-stamped events on the old owner —
+        acceptable for fail-open telemetry (docs/observability.md), the
+        same contract as losing a timeline to a restart."""
+        return self._route(task_id,
+                           lambda s: s.append_ledger(task_id, events))
+
+    def get_ledger(self, task_id: str) -> list[dict]:
+        def op(store):
+            # Empty → None so _route's ownership re-check applies (the
+            # migrated timeline lives with the new owner when it moved
+            # before any post-move stamp; see get_original_body).
+            events = store.get_ledger(task_id)
+            return events if events else None
+
+        return self._route(task_id, op) or []
+
     # -- side-effect plumbing ----------------------------------------------
 
     def set_publisher(self, publisher) -> None:

@@ -166,6 +166,12 @@ class InMemoryTaskStore(StoreSideEffects):
         # thread — listeners must be cheap and thread-safe
         # (StoreSideEffects._notify).
         self._listeners: list[Callable[[APITask], None]] = []
+        # Hop-ledger timelines (observability/ledger.py): task_id ->
+        # [event dicts], appended by every hop when the observability
+        # layer is on. Observability state, NOT durable truth — never
+        # journaled, dropped with the record at eviction; a restart
+        # loses timelines, never tasks (docs/observability.md).
+        self._ledgers: dict[str, list[dict]] = {}
 
     # -- core state machine ------------------------------------------------
 
@@ -360,6 +366,47 @@ class InMemoryTaskStore(StoreSideEffects):
                 raise TaskNotFound(task_id)
             return task
 
+    # -- hop ledger (observability/ledger.py) -------------------------------
+
+    def append_ledger(self, task_id: str, events: list[dict]) -> int:
+        """Append hop-ledger events to a known task's timeline; returns
+        the events actually kept (the per-task cap —
+        ``observability.ledger.MAX_EVENTS``, the same bound the worker's
+        HopLedger buffers to — drops overflow with a single
+        ``truncated`` marker). Raises TaskNotFound for unknown ids and
+        refuses on closed/follower/non-owner stores like every other
+        mutation — callers (the observability hub, the HTTP surface)
+        treat all of those as droppable: the ledger is fail-open
+        telemetry, not task state."""
+        from ..observability.ledger import (MAX_EVENTS, TRUNCATED,
+                                            ledger_event)
+        check_writable = getattr(self, "_check_writable", None)
+        with self._lock:
+            self._check_open()
+            if check_writable is not None:
+                check_writable()
+            self._check_owner(task_id)
+            if task_id not in self._tasks:
+                raise TaskNotFound(task_id)
+            timeline = self._ledgers.setdefault(task_id, [])
+            kept = 0
+            for ev in events:
+                if len(timeline) >= MAX_EVENTS:
+                    if (not timeline
+                            or timeline[-1].get("e") != TRUNCATED):
+                        timeline.append(ledger_event(TRUNCATED, "store"))
+                    break
+                timeline.append(ev)
+                kept += 1
+            return kept
+
+    def get_ledger(self, task_id: str) -> list[dict]:
+        """The task's timeline (empty for unknown tasks or when the
+        observability layer never stamped — reads never raise: the
+        ledger query is a debugging surface)."""
+        with self._lock:
+            return list(self._ledgers.get(task_id, ()))
+
     # -- retention (terminal-history eviction) ------------------------------
 
     def evict_terminal_older_than(self, age_s: float) -> int:
@@ -402,6 +449,7 @@ class InMemoryTaskStore(StoreSideEffects):
             return []
         self._remove_from_set(task)
         self._orig_bodies.pop(task_id, None)
+        self._ledgers.pop(task_id, None)
         blob_keys = []
         # O(this task's results) via the key index — NEVER a scan of all
         # results (each victim of a bulk eviction would pay O(history)).

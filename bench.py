@@ -665,15 +665,26 @@ def build_platform(args):
         # sub-queues; the control-plane-headroom lever. Journal-less here
         # (no per-append fsync): the run measures keyspace partitioning,
         # not disk.
-        task_shards=getattr(args, "task_shards", 1)))
+        task_shards=getattr(args, "task_shards", 1),
+        # --observability enables the hop ledger + flight recorder on
+        # the control plane (observability/, docs/observability.md); the
+        # batcher's device-phase decomposition + worker ledger flushes
+        # ride the same flag, so the result JSON gains the ``phases``
+        # block (queue-wait/h2d/execute/d2h percentiles + overlap
+        # ratio).
+        observability=getattr(args, "observability", False)))
     runtime = ModelRuntime(donate_batch=args.donate_batch)
     batcher = MicroBatcher(runtime, max_wait_ms=args.max_wait_ms,
                            max_pending=args.concurrency * 4,
-                           pipeline_depth=args.pipeline_depth)
+                           pipeline_depth=args.pipeline_depth,
+                           measure_phases=getattr(args, "observability",
+                                                  False))
     worker = InferenceWorker(f"{args.model}-svc", runtime, batcher,
                              task_manager=platform.task_manager,
                              prefix=f"v1/{args.model}", store=platform.store,
                              result_cache=platform.result_cache,
+                             hop_ledger=getattr(args, "observability",
+                                                False),
                              # The platform gateway fronts this worker with
                              # the SAME cache — its proxy layer answers and
                              # fills; a worker-keyed duplicate per request
@@ -1404,6 +1415,54 @@ async def run_bench(args) -> dict:
             capability_meta["mfu_delivered"] = round(
                 flops_per_req_total * throughput / peak, 4)
 
+    # --observability: per-request device-phase decomposition from the
+    # batcher's phase histograms (observability satellite; ROADMAP item
+    # 2's decomposition) — where a request's time goes between queue
+    # wait, h2d, execute, and d2h, per percentile, plus the
+    # transfer/execute overlap ratio the pipeline window exists to
+    # create.
+    phases_meta = {}
+    if getattr(args, "observability", False) and batcher.measure_phases:
+        def _phase_pcts(hist, **labels) -> dict | None:
+            count = sum(
+                int(data["count"])
+                for _k, _n, hl, data in hist.collect()
+                if all(hl.get(k) == v for k, v in labels.items()))
+            if not count:
+                return None
+            # Bucket upper-edge quantiles — same convention as the
+            # batch_exec/queue_wait p99 fields above.
+            return {"count": count,
+                    **{f"p{int(q * 100)}_ms": round(
+                        1000 * hist.quantile(q, **labels), 2)
+                       for q in (0.5, 0.9, 0.99)}}
+
+        phase_hist = batcher.metrics.histogram(
+            "ai4e_device_phase_seconds", "")
+        wait_hist = batcher.metrics.histogram(
+            "ai4e_batch_queue_wait_seconds", "")
+        block: dict = {}
+        for model in batcher.runtime.models:
+            per_model: dict = {}
+            wait = _phase_pcts(wait_hist, model=model)
+            if wait is not None:
+                per_model["queue_wait"] = wait
+            for phase in ("h2d", "compile", "execute", "d2h"):
+                pcts = _phase_pcts(phase_hist, phase=phase, model=model)
+                if pcts is not None:
+                    per_model[phase] = pcts
+            if per_model:
+                block[model] = per_model
+        if block:
+            phases_meta["phases"] = {
+                **block,
+                # Cumulative overlap ratio: 1.0 = every h2d second hid
+                # under another batch's execute (docs/observability.md
+                # documents the in-flight approximation).
+                "h2d_execute_overlap_ratio": round(batcher.metrics.gauge(
+                    "ai4e_batch_overlap_ratio", "").value(), 4),
+            }
+
     # On real hardware the bench doubles as the Pallas kernel-validation
     # artifact: Mosaic-compiled (interpret=False) kernels vs XLA oracles +
     # VMEM-budget assertions (ops/pallas/validate.py).
@@ -1442,6 +1501,7 @@ async def run_bench(args) -> dict:
         **shard_meta,
         **fault_meta,
         **batch_meta,
+        **phases_meta,
         **capability_meta,
         **pallas_meta,
     }
@@ -1613,6 +1673,7 @@ def _forward_argv(args) -> list[str]:
             "--fault-seed", str(args.fault_seed),
             *(["--resilience"] if args.resilience else []),
             *(["--orchestration"] if args.orchestration else []),
+            *(["--observability"] if args.observability else []),
             *(["--mix", args.mix] if args.mix else []),
             "--task-shards", str(args.task_shards),
             "--deadline-ms", str(args.deadline_ms),
@@ -1748,6 +1809,13 @@ def main() -> None:
                              "health-aware picks, budget-bounded retries "
                              "with failover, 5xx-as-transient redelivery "
                              "(docs/resilience.md)")
+    parser.add_argument("--observability", action="store_true",
+                        help="enable the request-observability layer "
+                             "(hop ledger + flight recorder + device-"
+                             "phase decomposition, docs/observability"
+                             ".md); the result JSON gains a 'phases' "
+                             "block (queue-wait/h2d/execute/d2h "
+                             "percentiles + h2d/execute overlap ratio)")
     parser.add_argument("--task-shards", type=int, default=1,
                         help="shard the task keyspace over N store shards "
                              "with per-shard dispatcher sub-queues "

@@ -28,6 +28,17 @@ if jax is not None:
     jax.config.update("jax_platforms", "cpu")
 
 
+if os.environ.get("AI4E_OBSERVABILITY_TRACE_EXPORT_PATH"):
+    # CI debugging hook (observability PR): when the env names a span
+    # log, install the configured exporters on the process tracer —
+    # every platform component's tracer follows it live, so a red
+    # chaos/race run's spans land in a JSONL the workflow uploads as an
+    # artifact beside the invariant checker's flight-recorder dump.
+    # No-op locally (the variable is unset).
+    from ai4e_tpu.config import ObservabilitySection
+    ObservabilitySection.from_env().apply()
+
+
 def pytest_configure(config):
     # Registered here (no pytest.ini): `slow` gates tier-1's wall clock
     # (`-m 'not slow'`), `chaos` marks the seeded fault-injection

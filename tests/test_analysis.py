@@ -1272,3 +1272,90 @@ class TestStaleGuardFixes:
                                        backend="") == 1
 
         run_analysis(main())
+
+
+# -- AIL010 metrics-drift -----------------------------------------------------
+
+
+class TestMetricsDrift:
+    def _project(self, tmp_path, doc_text, code=None):
+        from ai4e_tpu.analysis.rules.metrics_drift import MetricsDrift
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "METRICS.md").write_text(doc_text)
+        (tmp_path / "mod.py").write_text(code or textwrap.dedent("""
+            class Svc:
+                def __init__(self, metrics):
+                    self._hits = metrics.counter(
+                        "ai4e_demo_hits_total", "hits")
+                    self._depth = metrics.gauge("ai4e_demo_depth", "d")
+                    self._lat = metrics.histogram(
+                        "ai4e_demo_seconds", "lat")
+        """))
+        return Analyzer([MetricsDrift()], root=str(tmp_path)).run(
+            [str(tmp_path / "mod.py")]).findings
+
+    def test_true_positive_undocumented_and_stale(self, tmp_path):
+        findings = self._project(
+            tmp_path,
+            "| `ai4e_demo_hits_total` | counter |\n"
+            "| `ai4e_demo_gone` | gauge |\n")
+        undocumented = {f.message.split(" ", 2)[1] for f in findings
+                        if "registered in code" in f.message}
+        assert undocumented == {"ai4e_demo_depth", "ai4e_demo_seconds"}
+        stale = [f for f in findings if "ai4e_demo_gone" in f.message]
+        assert stale and stale[0].path == "docs/METRICS.md"
+        assert stale[0].line == 2
+
+    def test_near_miss_fully_documented(self, tmp_path):
+        assert self._project(
+            tmp_path,
+            "| `ai4e_demo_hits_total` | `ai4e_demo_depth` |\n"
+            "| `ai4e_demo_seconds` | histogram |\n") == []
+
+    def test_starred_family_covers_code_names(self, tmp_path):
+        assert self._project(
+            tmp_path, "All `ai4e_demo_*` metrics are demo-only.\n") == []
+
+    def test_unstarred_prefix_does_not_cover(self, tmp_path):
+        findings = self._project(
+            tmp_path, "The `ai4e_demo` family (no star) is mentioned.\n")
+        assert any("ai4e_demo_hits_total" in f.message for f in findings)
+        # The bare prefix itself is stale too (nothing registers it).
+        assert any("documents ai4e_demo " in f.message for f in findings)
+
+    def test_exposition_suffixes_and_paths_excluded(self, tmp_path):
+        """Docs may spell a histogram's _bucket/_sum/_count exposition
+        and name files under ai4e_tpu/ without tripping the rule."""
+        assert self._project(
+            tmp_path,
+            "`ai4e_demo_seconds_bucket` and `ai4e_demo_seconds_count`\n"
+            "rendered by `ai4e_tpu/metrics/registry.py`; see also\n"
+            "`ai4e_demo_hits_total`, `ai4e_demo_depth`,\n"
+            "`ai4e_demo_seconds`.\n") == []
+
+    def test_dynamic_names_ignored(self, tmp_path):
+        """Only literal first arguments register: a computed name cannot
+        be matched against docs and must not crash the rule."""
+        assert self._project(
+            tmp_path, "nothing documented\n",
+            code=textwrap.dedent("""
+                def make(metrics, name):
+                    return metrics.counter(name, "dyn")
+                def other(metrics):
+                    return metrics.counter("not_ai4e_prefixed", "x")
+            """)) == []
+
+    def test_whole_repo_in_sync(self):
+        """The real tree: every registered ai4e_* metric documented in
+        docs/METRICS.md and vice versa — the gate CI now enforces (the
+        rule's first run found ai4e_trace_current documented but never
+        registered; fixed in this PR)."""
+        from ai4e_tpu.analysis.rules.metrics_drift import MetricsDrift
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pkg = os.path.join(root, "ai4e_tpu")
+        paths = []
+        for dirpath, _dirs, files in os.walk(pkg):
+            paths.extend(os.path.join(dirpath, f)
+                         for f in files if f.endswith(".py"))
+        result = Analyzer([MetricsDrift()], root=root).run(sorted(paths))
+        assert [f.render() for f in result.findings] == []

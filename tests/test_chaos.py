@@ -168,7 +168,21 @@ def _chaos_platform():
         resilience_retry_base_s=0.001,
         resilience_failure_threshold=3,
         resilience_recovery_seconds=0.1,
+        # Observability rides the chaos scenario (docs/observability.md):
+        # the hop ledger + flight recorder run UNDER injected faults, and
+        # an invariant violation dumps the flight ring as a CI artifact
+        # (InvariantChecker(flight=...) below).
+        observability=True,
     ), metrics=MetricsRegistry())
+
+
+def _checker(platform) -> InvariantChecker:
+    """The scenario checker, wired to the platform's flight recorder so
+    a red run's AssertionError ships the request timelines that explain
+    it (AI4E_CHAOS_DUMP_DIR; CI uploads the directory on failure)."""
+    flight = (platform.observability.flight
+              if platform.observability is not None else None)
+    return InvariantChecker(flight=flight).attach(platform.store)
 
 
 def _completing_backend(platform):
@@ -191,7 +205,7 @@ class TestChaosScenario:
     def test_faults_worker_kill_dispatcher_restart_invariants_hold(self):
         async def main():
             platform = _chaos_platform()
-            checker = InvariantChecker().attach(platform.store)
+            checker = _checker(platform)
             backend = await _completing_backend(platform).start()
             backend_uri = f"{backend.url}/v1/be/x"
             platform.publish_async_api("/v1/pub/x", backend_uri)
@@ -274,7 +288,7 @@ class TestChaosScenario:
         # each duplicate message must be suppressed off the broker.
         async def main():
             platform = _chaos_platform()
-            checker = InvariantChecker().attach(platform.store)
+            checker = _checker(platform)
             backend = await _completing_backend(platform).start()
             platform.publish_async_api("/v1/pub/x",
                                        f"{backend.url}/v1/be/x")

@@ -379,3 +379,135 @@ class TestOtlpExporter:
                            ("parentSpanId", 16)):
             v = garbage[key]
             assert len(v) == width and int(v, 16) >= 0, (key, v)
+
+
+class TestOtlpExportEdgeCases:
+    """Export-path edges (observability PR satellite): shutdown flush
+    drains everything queued across multiple batches, export after close
+    is a no-op, and a collector that comes back after being down gets
+    subsequent spans (lost batches counted, serving never blocked)."""
+
+    def _collector(self, fail_first: int = 0):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        received = []
+        state = {"fail": fail_first}
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                if state["fail"] > 0:
+                    state["fail"] -= 1
+                    self.send_response(503)
+                    self.end_headers()
+                    return
+                received.append(_json.loads(body))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        import threading
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return server, received, state
+
+    @staticmethod
+    def _span(i):
+        from ai4e_tpu.observability.tracing import Span
+        return Span(name=f"s{i}", service="svc", trace_id="ab" * 16,
+                    span_id="cd" * 8, start=100.0 + i, duration=0.01)
+
+    def test_close_flushes_queue_across_multiple_batches(self):
+        """Shutdown flush: a queue deeper than one batch drains FULLY on
+        close — the shutdown-time spans are the interesting ones."""
+        from ai4e_tpu.observability.otlp import OtlpHttpExporter
+        server, received, _ = self._collector()
+        try:
+            exporter = OtlpHttpExporter(
+                f"http://127.0.0.1:{server.server_address[1]}/v1/traces",
+                flush_interval=60.0, max_batch=4)  # interval never fires
+            for i in range(10):
+                exporter.export(self._span(i))
+            exporter.close()
+            assert exporter.exported == 10
+            total = sum(
+                len(scope["spans"])
+                for body in received
+                for rs in body["resourceSpans"]
+                for scope in rs["scopeSpans"])
+            assert total == 10
+            assert len(received) >= 3  # 4+4+2: batch bound respected
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_export_after_close_is_noop(self):
+        from ai4e_tpu.observability.otlp import OtlpHttpExporter
+        server, received, _ = self._collector()
+        try:
+            exporter = OtlpHttpExporter(
+                f"http://127.0.0.1:{server.server_address[1]}/v1/traces",
+                flush_interval=0.05)
+            exporter.close()
+            exporter.export(self._span(0))
+            exporter.close()  # idempotent
+            assert exporter.exported == 0
+            assert received == []
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_partial_outage_drops_failed_batch_keeps_later_ones(self):
+        """A 5xx-answering collector loses THAT batch (counted — no
+        retry convoy behind a dead sink) while later batches flow once
+        it recovers."""
+        import time as _time
+
+        from ai4e_tpu.observability.otlp import OtlpHttpExporter
+        server, received, state = self._collector(fail_first=1)
+        try:
+            exporter = OtlpHttpExporter(
+                f"http://127.0.0.1:{server.server_address[1]}/v1/traces",
+                flush_interval=0.05, timeout=2.0)
+            exporter.export(self._span(0))
+            deadline = _time.time() + 5.0
+            while exporter.export_errors == 0 and _time.time() < deadline:
+                _time.sleep(0.01)
+            assert exporter.export_errors == 1
+            assert state["fail"] == 0
+            exporter.export(self._span(1))
+            exporter.close()
+            assert exporter.exported == 1  # the post-recovery span only
+            (body,) = received
+            (span,) = body["resourceSpans"][0]["scopeSpans"][0]["spans"]
+            assert span["name"] == "s1"
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_urlopen_uses_status_not_exception_for_2xx_only(self):
+        """A 4xx answer is an error path too (urlopen raises HTTPError):
+        counted as an export error, spans lost, thread alive."""
+        import time as _time
+
+        from ai4e_tpu.observability.otlp import OtlpHttpExporter
+        server, received, state = self._collector(fail_first=10**9)
+        try:
+            exporter = OtlpHttpExporter(
+                f"http://127.0.0.1:{server.server_address[1]}/v1/traces",
+                flush_interval=0.05, timeout=2.0)
+            exporter.export(self._span(0))
+            deadline = _time.time() + 5.0
+            while exporter.export_errors == 0 and _time.time() < deadline:
+                _time.sleep(0.01)
+            assert exporter.export_errors >= 1 and exporter.exported == 0
+            # The export thread survived and still accepts work.
+            exporter.export(self._span(1))
+            exporter.close()
+            assert received == []
+        finally:
+            server.shutdown()
+            server.server_close()
