@@ -25,4 +25,5 @@ race-smoke:
 chaos:
 	AI4E_CHAOS_SEED=20260803 JAX_PLATFORMS=cpu python -m pytest \
 	  tests/test_chaos.py tests/test_shard_chaos.py \
-	  tests/test_orchestration_chaos.py -q -m chaos -p no:cacheprovider
+	  tests/test_orchestration_chaos.py tests/test_pipeline_chaos.py \
+	  -q -m chaos -p no:cacheprovider

@@ -244,6 +244,16 @@ class PlatformSection:
     # Feed sustained SLO breaches to the degradation ladder as an extra
     # miss-evidence source (requires orchestration).
     slo_ladder: bool = False
+    # First-class pipeline DAGs (docs/pipelines.md): declared multi-stage
+    # compositions executed under one TaskId by the coordinator, plus the
+    # SSE streaming surface GET /v1/taskmanagement/task/{id}/events.
+    # Requires the Python store/broker + queue transport. Off =
+    # byte-identical assembly.
+    pipeline: bool = False
+    # Per-task event replay buffer for late-attaching streams, and the
+    # maximum SSE stream duration per request (seconds).
+    pipeline_event_replay: int = 256
+    pipeline_stream_max_s: float = 300.0
 
     def to_platform_config(self):
         from .platform_assembly import PlatformConfig
@@ -312,6 +322,9 @@ class PlatformSection:
             slo_fast_window_s=self.slo_fast_window_s,
             slo_slow_window_s=self.slo_slow_window_s,
             slo_ladder=self.slo_ladder,
+            pipeline=self.pipeline,
+            pipeline_event_replay=self.pipeline_event_replay,
+            pipeline_stream_max_s=self.pipeline_stream_max_s,
         )
 
 

@@ -120,6 +120,11 @@ class Gateway:
         # telemetry — the pre-observability gateway byte for byte. Set
         # via set_observability (platform assembly wires it).
         self._observability = None
+        # Task event hub (``pipeline/events.py``); None → no streaming
+        # surface, no /events route — the pre-pipeline gateway byte for
+        # byte. Set via set_event_stream (platform assembly wires it).
+        self._event_hub = None
+        self._event_stream_max_s = 300.0
         # Sync-path single flight: key -> Future resolving to the leader's
         # (status, payload, content_type), or None when the leader errored.
         # Event-loop objects, so they live here rather than in the
@@ -222,6 +227,106 @@ class Gateway:
             # byte-identical; aiohttp accepts routes until the app runs.
             self._flight_route_added = True
             self.app.router.add_get("/v1/debug/flight", self._flight_dump)
+
+    def set_event_stream(self, hub, max_stream_s: float = 300.0) -> None:
+        """Enable (or clear with None) the streaming task-event surface
+        (``pipeline/``, ``docs/pipelines.md``): ``GET /v1/taskmanagement/
+        task/{id}/events`` serves the task's event stream — status
+        transitions, pipeline stage partials, incremental chunks — as
+        Server-Sent Events until the terminal event (or ``?wait=`` /
+        ``max_stream_s`` expires). The route is added lazily so a
+        pipeline-less gateway's route table stays byte-identical."""
+        first = (self._event_hub is None and hub is not None
+                 and not getattr(self, "_events_route_added", False))
+        self._event_hub = hub
+        self._event_stream_max_s = max_stream_s
+        if first:
+            self._events_route_added = True
+            self.app.router.add_get(
+                "/v1/taskmanagement/task/{task_id}/events",
+                self._task_events)
+
+    async def _task_events(self, request: web.Request) -> web.StreamResponse:
+        """SSE stream of one task's events (docs/pipelines.md: ``status`` /
+        ``stage`` / ``chunk`` / ``terminal``). Subscribe-then-re-read
+        closes the attach race: the hub's subscribe replays buffered
+        events under its lock, and any transition after the re-read below
+        is published live — a terminal event can be delivered twice at
+        the seam, never missed."""
+        from ..pipeline.events import TERMINAL, sse_encode
+
+        hub = self._event_hub
+        if hub is None:
+            return web.json_response(
+                {"error": "event streaming not enabled"}, status=404)
+        task_id = request.match_info["task_id"]
+        try:
+            task = self.store.get(task_id)
+        except TaskNotFound:
+            return web.Response(status=404, text="Task not found.")
+        cap = self._event_stream_max_s
+        try:
+            wait = min(float(request.query.get("wait", cap)), cap)
+        except ValueError:
+            return web.Response(status=400, text="Bad wait parameter.")
+        if not math.isfinite(wait):
+            # nan/inf would defeat the stream-duration cap (min(nan, cap)
+            # is nan, and the deadline arithmetic never expires).
+            return web.Response(status=400, text="Bad wait parameter.")
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "X-Accel-Buffering": "no",
+        })
+        await resp.prepare(request)
+        self._requests.inc(route="task_events", outcome="stream")
+        stream = hub.subscribe(task_id)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + wait
+        try:
+            # Current state first (the client may have attached late); the
+            # re-read AFTER subscribing closes the attach-vs-event race.
+            try:
+                task = self.store.get(task_id)
+            except TaskNotFound:
+                task = None
+            if task is not None:
+                await resp.write(sse_encode(
+                    {"seq": 0, "event": "status",
+                     "data": {"Status": task.status,
+                              "BackendStatus": task.backend_status}}))
+                if task.canonical_status in TaskStatus.TERMINAL:
+                    # Drain any buffered stage events before closing so a
+                    # late subscriber still sees the run's shape.
+                    for event in hub.replay(task_id):
+                        if event["event"] != TERMINAL:
+                            await resp.write(sse_encode(event))
+                    await resp.write(sse_encode(
+                        {"seq": 0, "event": TERMINAL,
+                         "data": task.to_dict()}))
+                    return resp
+            while True:
+                timeout = min(15.0, deadline - loop.time())
+                if timeout <= 0:
+                    break
+                try:
+                    event = await stream.next_event(timeout=timeout)
+                except asyncio.TimeoutError:
+                    # Heartbeat comment keeps proxies from timing the
+                    # stream out while a long stage runs.
+                    await resp.write(b": keep-alive\n\n")
+                    continue
+                if event is None:
+                    break
+                await resp.write(sse_encode(event))
+                if event["event"] == TERMINAL:
+                    break
+        except (ConnectionResetError, asyncio.CancelledError):
+            raise  # client went away / server shutting down
+        finally:
+            await stream.aclose()
+        return resp
 
     async def _flight_dump(self, _: web.Request) -> web.Response:
         hub = self._observability

@@ -35,7 +35,7 @@ import time
 
 from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
 from .flight import FlightRecorder
-from .ledger import COMPLETED, ledger_event
+from .ledger import COMPLETED, STAGE, ledger_event
 
 log = logging.getLogger("ai4e_tpu.observability")
 
@@ -53,8 +53,8 @@ class RequestObservability:
         self.metrics = metrics or DEFAULT_REGISTRY
         self.flight = flight
         self._lock = threading.Lock()
-        # task_id -> (created epoch seconds, route label)
-        self._created: dict[str, tuple[float, str]] = {}
+        # task_id -> (created epoch seconds, route label, endpoint path)
+        self._created: dict[str, tuple[float, str, str]] = {}
         # backend endpoint path -> published gateway prefix (map_route,
         # fed by the gateway). Task records carry the BACKEND endpoint;
         # without this map, async outcomes would count under the backend
@@ -129,12 +129,33 @@ class RequestObservability:
                 # route label resolves through the gateway's
                 # backend→published map so async outcomes and edge
                 # refusals share one SLO key.
-                route = self._route_for(task.endpoint_path)
+                path = task.endpoint_path
+                route = self._route_for(path)
+                stage_from = None
                 with self._lock:
-                    if len(self._created) >= _MAX_TRACKED:
-                        self._created.pop(next(iter(self._created)))
-                    self._created.setdefault(
-                        task.task_id, (time.time(), route))
+                    entry = self._created.get(task.task_id)
+                    if entry is None:
+                        if len(self._created) >= _MAX_TRACKED:
+                            self._created.pop(next(iter(self._created)))
+                        self._created[task.task_id] = (time.time(), route,
+                                                       path)
+                    elif entry[2] != path:
+                        # Pipeline handoff: the task was rewritten to
+                        # `created` with a NEW endpoint (AddPipelineTask,
+                        # service/task_manager.py). Keep the original
+                        # creation time + route label (the e2e metric
+                        # covers the whole composite) but remember the new
+                        # stage path — and stamp the boundary below, so
+                        # `trace` shows WHERE one stage ended and the next
+                        # began instead of an indistinguishable `created`.
+                        self._created[task.task_id] = (entry[0], entry[1],
+                                                       path)
+                        stage_from = entry[2]
+                if stage_from is not None:
+                    self.stamp(task.task_id,
+                               ledger_event(STAGE, "store",
+                                            reason=f"{stage_from} -> "
+                                                   f"{path}"))
             return
         now = time.time()
         with self._lock:

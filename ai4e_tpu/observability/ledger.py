@@ -55,6 +55,8 @@ PROBE = "probe"              # placement chose a recovery probe (r=backend)
 BACKPRESSURE = "backpressure"  # backend saturated; message redelivers
 DUPLICATE = "duplicate"      # redelivery suppressed (task already terminal)
 DEAD_LETTER = "dead_letter"  # delivery budget exhausted
+STAGE = "stage"              # pipeline stage boundary (r="name event" or
+                             # "old-path -> new-path" on hop-to-hop handoff)
 
 # Hard cap on events per task: a pathological retry loop must not grow
 # a record without bound. The overflow marker is itself an event, once.

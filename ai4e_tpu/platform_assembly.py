@@ -185,6 +185,22 @@ class PlatformConfig:
     # Sustained SLO breaches feed the degradation ladder as an extra
     # miss-evidence source (requires orchestration).
     slo_ladder: bool = False
+    # First-class pipeline DAGs (pipeline/, docs/pipelines.md): declared
+    # multi-stage compositions (fan-out/fan-in joins with a failure
+    # quorum, per-stage deadline fractions carved from X-Deadline-Ms,
+    # per-stage result-cache reuse) executed under ONE TaskId by a
+    # coordinator riding the existing store/broker/dispatcher fabric,
+    # plus the streaming surface GET /v1/taskmanagement/task/{id}/events
+    # (SSE: stage-by-stage partial results before the terminal answer).
+    # Off by default — the assembly is byte-identical without it
+    # (asserted in tests); requires the Python store/broker and the
+    # queue transport (the coordinator consumes entry queues).
+    pipeline: bool = False
+    # Per-task event replay buffer (events a late-attaching stream still
+    # sees) and the SSE stream's maximum duration per request (seconds;
+    # ?wait= may only shorten it).
+    pipeline_event_replay: int = 256
+    pipeline_stream_max_s: float = 300.0
 
 
 class LocalPlatform:
@@ -449,6 +465,41 @@ class LocalPlatform:
             raise ValueError(
                 f"unknown transport {self.config.transport!r}; "
                 "expected 'queue' or 'push'")
+        self.pipeline = None
+        self.task_events = None
+        if self.config.pipeline:
+            if self.config.transport != "queue":
+                raise ValueError(
+                    "pipeline=True requires the queue transport — the "
+                    "coordinator consumes pipeline entry queues "
+                    "(docs/pipelines.md)")
+            if self.config.native_store or self.config.native_broker:
+                raise ValueError(
+                    "pipeline=True requires the Python store and broker "
+                    "(the coordinator rides the store change feed and "
+                    "stage sub-records)")
+            from .pipeline import PipelineCoordinator, TaskEventHub
+            self.task_events = TaskEventHub(
+                replay=self.config.pipeline_event_replay,
+                metrics=self.metrics)
+            # Every transition of a tracked/streamed task becomes a
+            # `status` event; terminal transitions close streams — the
+            # same change feed the long-poll waiters and the result
+            # cache ride.
+            self.task_events.attach_store(self.store)
+            queue_names = None
+            if self.config.task_shards > 1:
+                from .broker.queue import shard_queue_name
+                n = self.config.task_shards
+
+                def queue_names(path, _n=n):
+                    return [shard_queue_name(path, i) for i in range(_n)]
+
+            self.pipeline = PipelineCoordinator(
+                self.store, self.broker, hub=self.task_events,
+                result_cache=self.result_cache, admission=self.admission,
+                observability=self.observability, metrics=self.metrics,
+                queue_names=queue_names)
         self.gateway = Gateway(self.store, metrics=self.metrics)
         if self.result_cache is not None:
             self.gateway.set_result_cache(self.result_cache)
@@ -460,6 +511,10 @@ class LocalPlatform:
             self.gateway.set_orchestration(self.orchestration)
         if self.observability is not None:
             self.gateway.set_observability(self.observability)
+        if self.task_events is not None:
+            self.gateway.set_event_stream(
+                self.task_events,
+                max_stream_s=self.config.pipeline_stream_max_s)
         # Terminal-history retention: None = AUTO — 15 min on the Python
         # store, sized to the soak evidence (unevicted terminal history
         # grows ~12 MB/min at 200 req/s → AUTO bounds steady-state at
@@ -675,6 +730,23 @@ class LocalPlatform:
             policy=policy, interval=interval, signal=signal,
             metrics=self.metrics))
 
+    def register_pipeline(self, spec, max_body_bytes: int | None = None
+                          ) -> None:
+        """Publish a declared pipeline DAG (``pipeline.PipelineSpec``,
+        ``docs/pipelines.md``): one gateway async route at ``spec.prefix``
+        whose tasks are consumed by the pipeline coordinator instead of a
+        backend dispatcher — stages then run as sub-tasks through the
+        ordinary fabric. Stage ENDPOINTS still need transport consumers:
+        register each one with ``register_internal_route`` (internal
+        stages) or ``publish_async_api`` (stages that are also public
+        APIs), exactly like hop-to-hop pipeline stages today."""
+        if self.pipeline is None:
+            raise ValueError(
+                "register_pipeline requires PlatformConfig(pipeline=True)")
+        self.gateway.add_async_route(spec.prefix, spec.entry_path,
+                                     max_body_bytes=max_body_bytes)
+        self.pipeline.register(spec)
+
     def publish_sync_api(self, public_prefix: str, backend_uri,
                          max_body_bytes: int | None = None) -> None:
         self.gateway.add_sync_route(public_prefix, backend_uri,
@@ -746,6 +818,13 @@ class LocalPlatform:
 
             self.broker.set_dead_letter_handler(on_dead_letter)
             await self.dispatchers.start()
+            if self.pipeline is not None:
+                # The coordinator starts WITH the transport (never on a
+                # standby — a follower must not drive pipeline runs the
+                # primary is already driving) and its entry-queue
+                # consumption precedes the restart re-seed, which is the
+                # pipeline resume path.
+                await self.pipeline.start()
 
     async def _on_promoted(self) -> None:
         """Watchdog fired: this standby is now the primary. Start transport
@@ -834,6 +913,11 @@ class LocalPlatform:
                 await scaler.stop()
             if self.reaper is not None:
                 await self.reaper.stop()
+            if self.pipeline is not None:
+                # Live runs abandon; the new primary's re-seed republishes
+                # their (non-terminal) root tasks and ITS coordinator
+                # resumes them — the same path as a restart.
+                await self.pipeline.stop()
             if self.dispatchers is not None:
                 await self.dispatchers.stop()
             if self.topic is not None:
@@ -936,6 +1020,8 @@ class LocalPlatform:
         if self._started:
             for scaler in self.autoscalers:
                 await scaler.stop()
+            if self.pipeline is not None:
+                await self.pipeline.stop()
             if self.dispatchers is not None:
                 await self.dispatchers.stop()
             if self.reaper is not None:

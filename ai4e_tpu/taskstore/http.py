@@ -130,6 +130,22 @@ def make_app(store: InMemoryTaskStore,
         task = APITask.from_dict(payload)
         # Existing-task transition if a TaskId was supplied and known; otherwise
         # create (CacheConnectorUpsert.cs decides the same way, :90-108).
+        from .task import SUB_TASK_SEP
+        if SUB_TASK_SEP in task.task_id:
+            # Pipeline stage sub-task namespace ("{root}~{stage}",
+            # pipeline/spec.py): transitions of EXISTING sub-records are
+            # legitimate (a stage worker's saturation requeue rides this
+            # surface), but a CREATE would let a caller forge a sub-record
+            # that aliases a running pipeline's stage — the coordinator
+            # would adopt the forged task's terminal outcome as the stage
+            # result. Only the in-process coordinator mints these ids.
+            try:
+                store.get(task.task_id)
+            except TaskNotFound:
+                return web.json_response(
+                    {"error": f"TaskId must not contain {SUB_TASK_SEP!r} "
+                              "(reserved for pipeline stage sub-tasks)"},
+                    status=400)
         try:
             task = store.upsert(task)
         except ValueError as exc:  # reserved characters in a supplied TaskId

@@ -205,6 +205,12 @@ class InMemoryTaskStore(StoreSideEffects):
                 raise ValueError(
                     f"TaskId must not contain ':' (reserved as the result "
                     f"stage separator): {task.task_id!r}")
+            # NOTE: '~' (task.SUB_TASK_SEP, pipeline stage sub-tasks) is
+            # deliberately NOT rejected here — the coordinator mints
+            # "{root}~{stage}" ids through this very path. The HTTP
+            # surface refuses CREATES of unknown '~' ids instead
+            # (taskstore/http.py), which is where a forged alias could
+            # enter; in-process callers are platform code.
             task = self._apply_upsert(task)
             publisher = self._publisher if task.publish else None
 

@@ -61,6 +61,15 @@ def new_task_id() -> str:
     return str(uuid.uuid4())
 
 
+# Separator between a pipeline root TaskId and a stage name in stage
+# sub-task ids ("{root}~{stage}", pipeline/spec.py). Lives here — beside
+# the ':' result-stage separator it complements — because the store's
+# external-TaskId validation must reject it: a client-supplied id
+# carrying '~' could alias a running pipeline's stage sub-records (the
+# coordinator routes terminal transitions by splitting on it).
+SUB_TASK_SEP = "~"
+
+
 def endpoint_path(endpoint: str) -> str:
     """Derived endpoint path, e.g. ``http://host/v1/landcover/classify`` →
     ``/v1/landcover/classify`` (``APITask.cs`` EndpointPath). Query strings

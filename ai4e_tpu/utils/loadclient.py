@@ -16,6 +16,7 @@ creates.
 from __future__ import annotations
 
 import asyncio
+import json
 import time
 
 
@@ -46,6 +47,7 @@ async def run_closed_loop(
     post_url_for=None,
     headers_for=None,
     deadline_s: float | None = None,
+    events_url_for=None,
 ) -> dict:
     """Drive ``post_url`` closed-loop; returns window stats.
 
@@ -61,6 +63,14 @@ async def run_closed_loop(
     within the budget) vs ``late``, and tasks the platform shed on their
     deadline (terminal ``expired`` status / 504) count as ``expired``,
     not failed.
+    ``events_url_for(task_id) -> url`` (optional, async mode): follow the
+    task's SSE event stream (``GET /task/{id}/events``, pipeline
+    platforms — docs/pipelines.md) instead of long-polling, recording
+    **time-to-first-partial** — POST to the first stage partial (a
+    ``stage`` event reaching completed/cached, or any ``chunk``) — and
+    scoring the terminal event; the window JSON then carries
+    ``time_to_first_partial_ms_p50``/``_p95`` and ``first_partials``. A
+    failed/closed stream falls back to the ordinary status poll.
     Returns ``{"value", "p50_latency_ms", "p95_latency_ms", "completed",
     "failed", "expired", "duration_s", ...}`` where value is
     completions/second inside the measurement window that opens after
@@ -73,6 +83,7 @@ async def run_closed_loop(
         raise ValueError("async mode needs status_url_for")
 
     latencies: list[float] = []
+    ttfps: list[float] = []  # time-to-first-partial samples (events mode)
     completed = 0
     failed = 0
     expired = 0
@@ -114,6 +125,67 @@ async def run_closed_loop(
         expired += 1
         _bucket(cls)["expired"] += 1
 
+    def _score_terminal(status: str, elapsed: float, cls: str) -> None:
+        # "failed" FIRST — the platform's canonical bucketing
+        # (TaskStatus.canonical) tests it first.
+        if "failed" in status:
+            _score_failed(cls)
+        elif "completed" in status:
+            _score_completion(elapsed, cls)
+        elif "expired" in status:
+            _score_expired(cls)
+        else:
+            _score_failed(cls)  # stream ended on a non-terminal status
+
+    async def _follow_events(task_id: str, t0: float, cls: str,
+                             deadline: float) -> bool:
+        """Consume the task's SSE stream: record the first partial, score
+        the terminal event. True when the request was scored; False →
+        the caller falls back to status polling."""
+        saw_partial = False
+        try:
+            budget = max(1.0, deadline - time.perf_counter())
+            async with session.get(
+                    events_url_for(task_id),
+                    params={"wait": str(round(budget, 1))},
+                    headers=headers) as resp:
+                if resp.status != 200:
+                    return False
+                current: dict = {}
+                async for raw in resp.content:
+                    if time.perf_counter() > deadline:
+                        _score_failed(cls)  # stuck task: don't hang the run
+                        return True
+                    line = raw.decode("utf-8").rstrip("\r\n")
+                    if line.startswith(":"):
+                        continue  # keep-alive
+                    if line:
+                        if line.startswith("event: "):
+                            current["event"] = line[len("event: "):]
+                        elif line.startswith("data: "):
+                            try:
+                                current["data"] = json.loads(
+                                    line[len("data: "):])
+                            except ValueError:
+                                pass
+                        continue
+                    etype = current.get("event")
+                    data = current.get("data") or {}
+                    current = {}
+                    if etype in ("stage", "chunk") and not saw_partial:
+                        state = data.get("state", "")
+                        if etype == "chunk" or state in ("completed",
+                                                         "cached"):
+                            saw_partial = True
+                            ttfps.append(time.perf_counter() - t0)
+                    elif etype == "terminal":
+                        _score_terminal(data.get("Status", ""),
+                                        time.perf_counter() - t0, cls)
+                        return True
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            return False
+        return False  # stream closed without a terminal event
+
     async def one_async() -> None:
         t0 = time.perf_counter()
         url = post_url if post_url_for is None else post_url_for()
@@ -139,6 +211,10 @@ async def run_closed_loop(
             _score_failed(cls)
             return
         deadline = t0 + task_timeout
+        if events_url_for is not None:
+            if await _follow_events(task_id, t0, cls, deadline):
+                return
+            # Stream unavailable/interrupted: poll like everyone else.
         while True:
             try:
                 async with session.get(status_url_for(task_id),
@@ -217,7 +293,8 @@ async def run_closed_loop(
         await asyncio.sleep(ramp)
         mark.update(t=time.perf_counter(), completed=completed,
                     failed=failed, expired=expired, good=good,
-                    n_lat=len(latencies), by_class=_class_snapshot())
+                    n_lat=len(latencies), n_ttfp=len(ttfps),
+                    by_class=_class_snapshot())
 
     async def close_window() -> None:
         # Snapshot AT stop_at, not after the drain: gather() returns only
@@ -227,7 +304,8 @@ async def run_closed_loop(
         await asyncio.sleep(ramp + duration)
         close.update(t=time.perf_counter(), completed=completed,
                      failed=failed, expired=expired, good=good,
-                     n_lat=len(latencies), by_class=_class_snapshot())
+                     n_lat=len(latencies), n_ttfp=len(ttfps),
+                     by_class=_class_snapshot())
 
     stop_at = time.perf_counter() + ramp + duration
     await asyncio.gather(open_window(), close_window(),
@@ -250,6 +328,18 @@ async def run_closed_loop(
         "expired": close["expired"] - mark["expired"],
         "duration_s": round(elapsed, 1),
     }
+    if events_url_for is not None:
+        # Time-to-first-partial (docs/pipelines.md): POST → first stage
+        # partial on the event stream, window-sliced like the latencies.
+        window_ttfp = sorted(ttfps[mark["n_ttfp"]:close["n_ttfp"]])
+        out["first_partials"] = len(window_ttfp)
+        if window_ttfp:
+            def tp(q: float) -> float:
+                idx = max(0, int(len(window_ttfp) * q) - 1)
+                return round(window_ttfp[idx] * 1000, 1)
+            out["time_to_first_partial_ms_p50"] = round(
+                window_ttfp[len(window_ttfp) // 2] * 1000, 1)
+            out["time_to_first_partial_ms_p95"] = tp(0.95)
     if deadline_s is not None:
         n_good = close["good"] - mark["good"]
         # Goodput — THE saturation metric (PAPERS.md): completions that

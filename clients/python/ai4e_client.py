@@ -274,6 +274,59 @@ class AI4EClient:
                 raise TaskTimeout(f"task {task_id} not terminal "
                                   f"after {timeout}s: {status!r}")
 
+    def iter_task_events(self, task_id: str, wait: float = 60.0,
+                         timeout: float | None = None):
+        """Generator over the task's event stream (``GET /v1/
+        taskmanagement/task/{id}/events`` — pipeline platforms,
+        ``docs/pipelines.md``): yields ``(event, data)`` tuples in server
+        order — ``("status", {...})`` transitions, ``("stage", {...})``
+        pipeline partials (completed/cached stage events carry the stage
+        result inline up to 64 KiB), ``("chunk", {...})`` incremental
+        partials — and ends after yielding ``("terminal", record)``.
+
+        ``wait`` bounds the server-side stream (the server also caps it);
+        the generator simply ends if the stream closes without a terminal
+        event — re-enter with a fresh call to keep following. Platforms
+        without the streaming surface answer 404 (``urllib.error
+        .HTTPError``): fall back to ``wait()``/``status()`` polling.
+
+        Usage::
+
+            for event, data in client.iter_task_events(task_id):
+                if event == "stage" and data.get("state") == "completed":
+                    print("partial:", data["stage"], data.get("result"))
+        """
+        path = (f"/v1/taskmanagement/task/{urllib.parse.quote(task_id)}"
+                f"/events?wait={wait}")
+        resp = self._request(
+            "GET", path,
+            timeout=(self.timeout + wait) if timeout is None else timeout)
+        try:
+            current: dict = {}
+            for raw in resp:
+                line = raw.decode("utf-8").rstrip("\r\n")
+                if line.startswith(":"):
+                    continue  # keep-alive comment
+                if line == "":
+                    if "event" in current:
+                        event = current.get("event", "message")
+                        yield event, current.get("data")
+                        if event == "terminal":
+                            return
+                    current = {}
+                    continue
+                if line.startswith("event: "):
+                    current["event"] = line[len("event: "):]
+                elif line.startswith("data: "):
+                    try:
+                        current["data"] = json.loads(
+                            line[len("data: "):])
+                    except ValueError:
+                        current["data"] = line[len("data: "):]
+                # id: lines are delivery bookkeeping — nothing to surface.
+        finally:
+            resp.close()
+
     def result(self, record_or_task_id, stage: str | None = None):
         """Fetch the stored result payload for a task (None if nothing is
         stored). ``stage`` retrieves an intermediate pipeline stage's result

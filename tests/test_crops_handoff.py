@@ -51,6 +51,53 @@ class TestCropsHandoff:
         stack = np.load(io.BytesIO(out[1]))
         assert len(stack) == 2  # max_crops cap
 
+    def test_box_fully_outside_image_clamps_to_border_sliver(self):
+        # A box entirely past the right/bottom edge must clamp to a >=1px
+        # region INSIDE the image (y0/x0 clamp to dim-1, y1/x1 to >= +1),
+        # never index out of bounds or produce an empty crop.
+        img = np.full((32, 32, 3), 7, np.uint8)
+        handoff = crops_handoff("/v1/next", crop_size=4)
+        out = handoff(detections((40, 40, 50, 50)), img)
+        assert out is not None
+        stack = np.load(io.BytesIO(out[1]))
+        assert stack.shape == (1, 4, 4, 3)
+        assert (stack == 7).all()  # resized from a real in-image sliver
+
+    def test_min_score_boundary_is_inclusive(self):
+        img = np.zeros((16, 16, 3), np.uint8)
+        handoff = crops_handoff("/v1/next", crop_size=4, min_score=0.5)
+        # Exactly at the threshold: kept (>= semantics).
+        out = handoff(detections((0, 0, 8, 8), score=0.5), img)
+        assert out is not None
+        assert len(np.load(io.BytesIO(out[1]))) == 1
+        # Strictly below: filtered; nothing left -> None (the stage then
+        # completes the task itself instead of handing off).
+        assert handoff(detections((0, 0, 8, 8), score=0.49999), img) is None
+
+    def test_max_crops_keeps_the_first_n_in_order(self):
+        # Detectors emit score-ordered detections; truncation must keep
+        # the FIRST max_crops (the top-scoring ones), in order.
+        img = np.zeros((32, 32, 3), np.uint8)
+        img[0:8, 0:8] = 10    # detection 1's region
+        img[0:8, 8:16] = 20   # detection 2's region
+        img[0:8, 16:24] = 30  # detection 3's region
+        handoff = crops_handoff("/v1/next", crop_size=4, max_crops=2)
+        out = handoff(detections((0, 0, 8, 8), (0, 8, 8, 16),
+                                 (0, 16, 8, 24)), img)
+        stack = np.load(io.BytesIO(out[1]))
+        assert stack.shape[0] == 2
+        assert int(stack[0].mean()) == 10 and int(stack[1].mean()) == 20
+
+    def test_missing_and_empty_result_complete_the_task(self):
+        # ``None`` from the handoff is the stage-completes-the-task signal
+        # (runtime/worker.py) — a result with no "detections" key, an
+        # empty list, or a None result must all take that path.
+        img = np.zeros((8, 8, 3), np.uint8)
+        handoff = crops_handoff("/v1/next", crop_size=4)
+        assert handoff({}, img) is None
+        assert handoff({"detections": None}, img) is None
+        assert handoff(None, img) is None
+
     def test_float_example_scaled(self):
         img = np.full((16, 16, 3), 0.5, np.float32)
         handoff = crops_handoff("/v1/next", crop_size=4)

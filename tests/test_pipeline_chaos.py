@@ -1,0 +1,251 @@
+"""Pipeline chaos scenario (docs/pipelines.md, CI chaos-smoke job):
+SIGKILL a worker mid-stage on a 3-stage fan-out/fan-in DAG under seeded
+injected faults → every pipeline resumes and completes, the re-run of an
+identical payload is satisfied from the stage cache (hits counted, zero
+re-executions), and the invariant checker is clean — 0 lost / 0
+duplicate client-visible terminal outcomes per TaskId."""
+
+import asyncio
+import json
+import os
+
+import pytest
+from aiohttp import web
+
+from ai4e_tpu.chaos import (FaultInjector, InvariantChecker,
+                            RestartableBackend, wrap_platform_http)
+from ai4e_tpu.metrics import MetricsRegistry
+from ai4e_tpu.pipeline import PipelineSpec, StageSpec
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.taskstore import TaskStatus
+
+SEED = int(os.environ.get("AI4E_CHAOS_SEED", "20260803"))
+
+STAGES = ("a", "b", "c", "d")
+
+
+def _pipeline_platform():
+    return LocalPlatform(PlatformConfig(
+        pipeline=True,
+        result_cache=True,                 # the stage cache under test
+        resilience=True,
+        observability=True,                # ledger + flight under faults
+        retry_delay=0.01,
+        lease_seconds=2.0,
+        resilience_retry_base_s=0.001,
+        resilience_recovery_seconds=0.1,
+    ), metrics=MetricsRegistry())
+
+
+class StageWorker:
+    """Raw aiohttp stage backends on a RestartableBackend: idempotent
+    completion discipline (``update_status_if``), per-stage execution
+    counters, a configurable mid-stage delay so a kill lands DURING
+    stage execution."""
+
+    def __init__(self, platform):
+        self.platform = platform
+        self.hits = {s: 0 for s in STAGES}
+        self.delay = {"b": 0.25, "c": 0.25}
+        app = web.Application()
+        for stage in STAGES:
+            app.router.add_post(f"/v1/st/{stage}",
+                                self._make_handler(stage))
+        self.backend = RestartableBackend(app)
+
+    def _make_handler(self, stage):
+        async def handler(request):
+            body = await request.read()
+            tid = request.headers["taskId"]
+            self.hits[stage] += 1
+            if self.delay.get(stage):
+                await asyncio.sleep(self.delay[stage])
+            try:
+                doc = json.loads(body.decode("utf-8"))
+            except ValueError:
+                doc = {"raw": len(body)}
+            self.platform.store.set_result(
+                tid, json.dumps({"stage": stage, "saw": doc}).encode(),
+                content_type="application/json")
+            self.platform.store.update_status_if(
+                tid, "created", f"completed - {stage}",
+                TaskStatus.COMPLETED)
+            return web.Response(text="ok")
+
+        return handler
+
+    def endpoint(self, stage):
+        return f"{self.backend.url}/v1/st/{stage}"
+
+
+@pytest.mark.chaos
+class TestPipelineChaos:
+    def test_worker_kill_mid_stage_resumes_with_stage_cache(self):
+        async def main():
+            platform = _pipeline_platform()
+            flight = (platform.observability.flight
+                      if platform.observability else None)
+            checker = InvariantChecker(flight=flight).attach(platform.store)
+            worker = StageWorker(platform)
+            await worker.backend.start()
+
+            spec = PipelineSpec("chaosdag", "/v1/pipe/chaos", [
+                StageSpec("a", worker.endpoint("a")),
+                StageSpec("b", worker.endpoint("b"), after=("a",)),
+                StageSpec("c", worker.endpoint("c"), after=("a",)),
+                StageSpec("d", worker.endpoint("d"), after=("b", "c"),
+                          quorum=2),
+            ])
+            platform.register_pipeline(spec)
+            for stage in STAGES:
+                platform.register_internal_route(worker.endpoint(stage))
+
+            # Seeded faults on every backend POST: injected 500s are
+            # transient under resilience — retried/redelivered, never a
+            # terminal stage failure.
+            injector = FaultInjector(seed=SEED)
+            injector.add_rule(error_rate=0.15, error_status=500)
+            wrap_platform_http(platform, injector)
+
+            from aiohttp.test_utils import TestClient, TestServer
+            gw = TestClient(TestServer(platform.gateway.app))
+            await gw.start_server()
+            await platform.start()
+            try:
+                payload = b'{"img": 7}'
+                roots = []
+                for i in range(8):
+                    resp = await gw.post(f"/v1/pipe/chaos?run={i}",
+                                         data=payload)
+                    assert resp.status == 200
+                    tid = (await resp.json())["TaskId"]
+                    checker.note_accepted(tid)
+                    roots.append(tid)
+
+                # Kill the worker MID-STAGE: wait until fan-out stages are
+                # actually executing (their handlers sleep 0.25 s), then
+                # pull the plug. In-flight deliveries abort; redelivery +
+                # the coordinator's event loop resume the runs once the
+                # worker is back.
+                deadline = asyncio.get_running_loop().time() + 20.0
+                while (worker.hits["b"] + worker.hits["c"]) == 0:
+                    assert asyncio.get_running_loop().time() < deadline, \
+                        "fan-out stages never started"
+                    await asyncio.sleep(0.01)
+                await worker.backend.kill()
+                await asyncio.sleep(0.4)
+                await worker.backend.restart()
+
+                # Drain: every accepted pipeline reaches a terminal state.
+                deadline = asyncio.get_running_loop().time() + 60.0
+                while asyncio.get_running_loop().time() < deadline:
+                    if all(tid in checker.terminal for tid in roots):
+                        break
+                    await asyncio.sleep(0.05)
+
+                assert all(tid in checker.terminal for tid in roots), {
+                    tid: platform.store.get(tid).status
+                    for tid in roots if tid not in checker.terminal}
+                # Nothing failed or expired: injected 500s were transient
+                # and the kill was survivable.
+                assert set(checker.terminal[tid] for tid in roots) \
+                    == {"completed"}
+                # All four stage results present under each root TaskId.
+                for tid in roots:
+                    for stage in STAGES:
+                        assert platform.store.get_result(
+                            tid, stage=stage) is not None, (tid, stage)
+                assert injector.counts().get("error", 0) > 0
+
+                # Re-run an identical payload (fresh request key via the
+                # query param, same stage inputs): satisfied entirely from
+                # the stage cache — ZERO new backend executions.
+                hits_before = dict(worker.hits)
+                resp = await gw.post("/v1/pipe/chaos?run=rerun",
+                                     data=payload)
+                rerun_tid = (await resp.json())["TaskId"]
+                checker.note_accepted(rerun_tid)
+                r = await gw.get(f"/v1/taskmanagement/task/{rerun_tid}",
+                                 params={"wait": "20"})
+                final = await r.json()
+                assert "completed" in final["Status"], final
+                assert worker.hits == hits_before, "cached stage re-executed"
+                cached = platform.metrics.counter(
+                    "ai4e_pipeline_stages_total", "")
+                assert cached.value(pipeline="chaosdag", stage="a",
+                                    outcome="cached") >= 1
+                total_cached = sum(
+                    cached.value(pipeline="chaosdag", stage=s,
+                                 outcome="cached") for s in STAGES)
+                assert total_cached >= 4
+
+                # THE invariants: none lost, none stuck, zero duplicate
+                # client-visible terminal outcomes per TaskId.
+                checker.assert_ok()
+                assert not checker.duplicate_completions
+            finally:
+                await platform.stop()
+                await gw.close()
+                await worker.backend.kill()
+
+        asyncio.run(main())
+
+    def test_control_plane_restart_resumes_uncached_stages_only(self):
+        """Coordinator death mid-run: stop the platform after stage a
+        completed, rebuild a fresh coordinator over the SAME store, and
+        republish the root (what the journal re-seed does on a real
+        restart) — the resumed run replays only the unfinished stages."""
+        async def main():
+            platform = _pipeline_platform()
+            worker = StageWorker(platform)
+            worker.delay = {"b": 0.3}
+            await worker.backend.start()
+            spec = PipelineSpec("resume", "/v1/pipe/resume", [
+                StageSpec("a", worker.endpoint("a")),
+                StageSpec("b", worker.endpoint("b"), after=("a",)),
+            ])
+            platform.register_pipeline(spec)
+            for stage in ("a", "b"):
+                platform.register_internal_route(worker.endpoint(stage))
+            from aiohttp.test_utils import TestClient, TestServer
+            gw = TestClient(TestServer(platform.gateway.app))
+            await gw.start_server()
+            await platform.start()
+            try:
+                resp = await gw.post("/v1/pipe/resume", data=b'{"v": 1}')
+                tid = (await resp.json())["TaskId"]
+                # Wait for stage a's result to land on the root, then
+                # "crash" the coordinator by stopping it mid-stage-b.
+                deadline = asyncio.get_running_loop().time() + 20.0
+                while platform.store.get_result(tid, stage="a") is None:
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.01)
+                await platform.pipeline.stop()
+                hits_a = worker.hits["a"]
+
+                # Restart the coordinator and republish the root — the
+                # re-seed path. Stage a is adopted from its stored result
+                # (resumed, not re-executed); only stage b replays.
+                await platform.pipeline.start()
+                platform.broker.publish(platform.store.get(tid))
+                deadline = asyncio.get_running_loop().time() + 30.0
+                while True:
+                    record = platform.store.get(tid)
+                    if record.canonical_status in TaskStatus.TERMINAL:
+                        break
+                    assert asyncio.get_running_loop().time() < deadline, \
+                        record.status
+                    await asyncio.sleep(0.05)
+                assert record.canonical_status == "completed", record.status
+                assert worker.hits["a"] == hits_a, \
+                    "completed stage re-executed after restart"
+                resumed = platform.metrics.counter(
+                    "ai4e_pipeline_stages_total", "")
+                assert resumed.value(pipeline="resume", stage="a",
+                                     outcome="resumed") >= 1
+            finally:
+                await platform.stop()
+                await gw.close()
+                await worker.backend.kill()
+
+        asyncio.run(main())

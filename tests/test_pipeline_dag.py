@@ -1,0 +1,214 @@
+"""Pipeline DAG declaration + event hub units (``ai4e_tpu/pipeline/``,
+docs/pipelines.md): spec validation (acyclicity, quorum bounds, budget
+fractions), deadline carving, sub-task id framing, and the task event
+hub's replay/live/terminal contract the SSE surface rides."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from ai4e_tpu.pipeline import (PipelineSpec, PipelineSpecError, StageSpec,
+                               TaskEventHub, split_sub_task_id,
+                               sse_encode, stage_deadline, sub_task_id)
+
+
+def chain(*names, **stage_kw):
+    stages = []
+    prev = None
+    for n in names:
+        stages.append(StageSpec(name=n, endpoint=f"/v1/st/{n}",
+                                after=(prev,) if prev else (), **stage_kw))
+        prev = n
+    return stages
+
+
+class TestSpecValidation:
+    def test_linear_chain_orders_topologically(self):
+        spec = PipelineSpec("p", "/v1/p", chain("a", "b", "c"))
+        assert spec.order == ("a", "b", "c")
+        assert spec.sinks() == ("c",)
+        assert spec.downstream_of("a") == ("b",)
+        assert spec.entry_path == "/v1/_pipelines/p"
+
+    def test_fan_out_fan_in(self):
+        spec = PipelineSpec("p", "/v1/p", [
+            StageSpec("a", "/v1/a"),
+            StageSpec("b", "/v1/b", after=("a",)),
+            StageSpec("c", "/v1/c", after=("a",)),
+            StageSpec("d", "/v1/d", after=("b", "c"), quorum=1),
+        ])
+        assert set(spec.order[:1]) == {"a"}
+        assert spec.order[-1] == "d"
+        assert spec.sinks() == ("d",)
+        assert spec.stage("d").required_successes() == 1
+        # Default quorum = all upstreams.
+        assert StageSpec("j", "/v1/j",
+                         after=("x", "y")).required_successes() == 2
+
+    def test_cycle_refused(self):
+        with pytest.raises(PipelineSpecError, match="cycle"):
+            PipelineSpec("p", "/v1/p", [
+                StageSpec("a", "/v1/a", after=("b",)),
+                StageSpec("b", "/v1/b", after=("a",)),
+            ])
+
+    def test_self_dependency_refused(self):
+        with pytest.raises(PipelineSpecError, match="itself"):
+            PipelineSpec("p", "/v1/p",
+                         [StageSpec("a", "/v1/a", after=("a",))])
+
+    def test_unknown_dep_and_duplicate_names_refused(self):
+        with pytest.raises(PipelineSpecError, match="unknown stage"):
+            PipelineSpec("p", "/v1/p",
+                         [StageSpec("a", "/v1/a", after=("nope",))])
+        with pytest.raises(PipelineSpecError, match="duplicate"):
+            PipelineSpec("p", "/v1/p", [StageSpec("a", "/v1/a"),
+                                        StageSpec("a", "/v1/a2")])
+
+    def test_bad_names_refused(self):
+        with pytest.raises(PipelineSpecError):
+            PipelineSpec("p", "/v1/p", [StageSpec("has~sep", "/v1/a")])
+        with pytest.raises(PipelineSpecError):
+            PipelineSpec("p", "/v1/p", [StageSpec("has:colon", "/v1/a")])
+        with pytest.raises(PipelineSpecError):
+            PipelineSpec("bad name", "/v1/p", [StageSpec("a", "/v1/a")])
+
+    def test_quorum_bounds(self):
+        with pytest.raises(PipelineSpecError, match="quorum"):
+            PipelineSpec("p", "/v1/p", [
+                StageSpec("a", "/v1/a"),
+                StageSpec("b", "/v1/b", after=("a",), quorum=2),
+            ])
+
+    def test_budget_fractions_must_fit_one_request(self):
+        # 0.6 + 0.6 along one path > 1.0 — the DAG would promise stages
+        # more budget than the request has.
+        with pytest.raises(PipelineSpecError, match="cumulative"):
+            PipelineSpec("p", "/v1/p",
+                         chain("a", "b", deadline_fraction=0.6))
+        # Parallel branches each get their own window: 0.6 + 0.6 across
+        # SIBLINGS is fine.
+        PipelineSpec("p", "/v1/p", [
+            StageSpec("a", "/v1/a", deadline_fraction=0.3),
+            StageSpec("b", "/v1/b", after=("a",), deadline_fraction=0.6),
+            StageSpec("c", "/v1/c", after=("a",), deadline_fraction=0.6),
+        ])
+
+    def test_empty_and_bad_input_refused(self):
+        with pytest.raises(PipelineSpecError, match="no stages"):
+            PipelineSpec("p", "/v1/p", [])
+        with pytest.raises(PipelineSpecError, match="input"):
+            PipelineSpec("p", "/v1/p",
+                         [StageSpec("a", "/v1/a", input="weird")])
+
+
+class TestBudgetCarving:
+    def test_fraction_carves_remaining_budget(self):
+        st = StageSpec("a", "/v1/a", deadline_fraction=0.5)
+        now = time.time()
+        root = now + 10.0
+        d = stage_deadline(st, root, now=now)
+        assert abs(d - (now + 5.0)) < 1e-6
+
+    def test_no_fraction_inherits_root(self):
+        st = StageSpec("a", "/v1/a")
+        root = time.time() + 10.0
+        assert stage_deadline(st, root) == root
+
+    def test_no_deadline_stays_zero(self):
+        assert stage_deadline(
+            StageSpec("a", "/v1/a", deadline_fraction=0.5), 0.0) == 0.0
+
+    def test_spent_budget_never_extends(self):
+        st = StageSpec("a", "/v1/a", deadline_fraction=0.5)
+        now = time.time()
+        root = now - 1.0  # already past
+        assert stage_deadline(st, root, now=now) == root
+
+
+class TestSubTaskIds:
+    def test_round_trip(self):
+        sid = sub_task_id("root-guid", "stage_b")
+        assert split_sub_task_id(sid) == ("root-guid", "stage_b")
+
+    def test_plain_ids_do_not_parse(self):
+        assert split_sub_task_id("plain-guid") is None
+        assert split_sub_task_id("") is None
+
+
+class TestEventHub:
+    def test_replay_then_live_then_terminal(self):
+        async def main():
+            hub = TaskEventHub()
+            hub.track("t1")
+            hub.publish("t1", "stage", {"stage": "a", "state": "completed"})
+            stream = hub.subscribe("t1")
+            first = await stream.next_event(timeout=1.0)
+            assert first["event"] == "stage" and first["seq"] == 1
+            hub.publish("t1", "stage", {"stage": "b", "state": "completed"})
+            hub.publish("t1", "terminal", {"Status": "completed"})
+            second = await stream.next_event(timeout=1.0)
+            third = await stream.next_event(timeout=1.0)
+            assert second["data"]["stage"] == "b"
+            assert third["event"] == "terminal"
+            assert await stream.next_event(timeout=1.0) is None
+            # Post-terminal publishes are dropped; replay keeps history.
+            hub.publish("t1", "stage", {"stage": "z"})
+            assert [e["event"] for e in hub.replay("t1")] == [
+                "stage", "stage", "terminal"]
+
+        asyncio.run(main())
+
+    def test_untracked_unsubscribed_events_dropped(self):
+        hub = TaskEventHub()
+        hub.publish("ghost", "stage", {"stage": "a"})
+        assert hub.replay("ghost") == []
+
+    def test_subscriber_makes_task_tracked(self):
+        async def main():
+            hub = TaskEventHub()
+            stream = hub.subscribe("t2")
+            hub.publish("t2", "chunk", {"stage": "a", "index": 0})
+            ev = await stream.next_event(timeout=1.0)
+            assert ev["event"] == "chunk"
+            await stream.aclose()
+            assert hub.subscriber_count == 0
+
+        asyncio.run(main())
+
+    def test_task_lru_bound(self):
+        hub = TaskEventHub(max_tasks=2)
+        for tid in ("a", "b", "c"):
+            hub.track(tid)
+            hub.publish(tid, "status", {"Status": "created"})
+        assert hub.replay("a") == []  # evicted
+        assert hub.replay("c") != []
+
+    def test_replay_cap_bounds_history(self):
+        hub = TaskEventHub(replay=3)
+        hub.track("t")
+        for i in range(10):
+            hub.publish("t", "chunk", {"index": i})
+        assert len(hub.replay("t")) == 3
+
+    def test_sse_encoding(self):
+        wire = sse_encode({"seq": 7, "event": "stage",
+                           "data": {"stage": "a"}}).decode()
+        assert wire.startswith("id: 7\nevent: stage\ndata: ")
+        assert wire.endswith("\n\n")
+        assert json.loads(wire.split("data: ", 1)[1]) == {"stage": "a"}
+
+    def test_cross_thread_publish_wakes_loop(self):
+        async def main():
+            hub = TaskEventHub()
+            stream = hub.subscribe("t3")
+            import threading
+            threading.Thread(
+                target=hub.publish,
+                args=("t3", "stage", {"stage": "x"})).start()
+            ev = await stream.next_event(timeout=2.0)
+            assert ev["data"]["stage"] == "x"
+
+        asyncio.run(main())

@@ -638,6 +638,37 @@ class TestEndToEnd:
 
         run(main())
 
+    def test_pipeline_handoff_stamps_stage_boundary(self):
+        """The hop-to-hop handoff (rewrite-to-`created` with a NEW
+        endpoint, AddPipelineTask) used to produce an indistinguishable
+        `created` in the timeline — it must stamp an explicit `stage`
+        event carrying the boundary, so `trace` shows where one DAG
+        stage ended and the next began (docs/pipelines.md satellite)."""
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05,
+                                                    observability=True))
+            await platform.start()
+            try:
+                from ai4e_tpu.taskstore import APITask
+                task = platform.store.upsert(APITask(
+                    endpoint="http://h/v1/det/run", body=b"x",
+                    publish=False))
+                await platform.task_manager.add_pipeline_task(
+                    task.task_id, "http://h/v1/cls/run")
+                events = platform.store.get_ledger(task.task_id)
+                stages = [e for e in events if e["e"] == "stage"]
+                assert stages, events
+                assert stages[0]["r"] == "/v1/det/run -> /v1/cls/run"
+                # A same-endpoint requeue (reaper rescue shape) is NOT a
+                # stage boundary — no second stamp.
+                platform.store.requeue_if(task.task_id, "created")
+                events = platform.store.get_ledger(task.task_id)
+                assert len([e for e in events if e["e"] == "stage"]) == 1
+            finally:
+                await platform.stop()
+
+        run(main())
+
     def test_deadline_missed_task_lands_in_flight_dump(self):
         async def main():
             # An unreachable backend + a redelivery backoff longer than
