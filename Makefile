@@ -1,7 +1,7 @@
 # Contributor conveniences. Each target reproduces the matching CI job
 # with the SAME flags (the scripts are the single source of truth).
 
-.PHONY: lint test race-smoke chaos
+.PHONY: lint test race-smoke chaos durability
 
 # Both lint gates CI runs (ruff correctness rules + ai4e-lint, see
 # scripts/lint.sh and docs/analysis.md).
@@ -26,4 +26,13 @@ chaos:
 	AI4E_CHAOS_SEED=20260803 JAX_PLATFORMS=cpu python -m pytest \
 	  tests/test_chaos.py tests/test_shard_chaos.py \
 	  tests/test_orchestration_chaos.py tests/test_pipeline_chaos.py \
+	  tests/test_disk_chaos.py \
 	  -q -m chaos -p no:cacheprovider
+
+# The durable-truth gate (docs/durability.md) with CI's pinned seed
+# (durability-smoke job): journal envelope/salvage/fsync/degraded units
+# + the crash-point sweep + the disk-fault chaos scenarios. JAX-free.
+durability:
+	AI4E_CHAOS_SEED=20260803 python -m pytest \
+	  tests/test_durability.py tests/test_disk_chaos.py \
+	  -q -m 'not slow' -p no:cacheprovider

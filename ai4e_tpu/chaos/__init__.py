@@ -11,12 +11,23 @@ assemblies carry no chaos code path. Three parts:
   ``kill_dispatcher``/``restart_dispatcher``;
 - ``invariants`` — ``InvariantChecker`` riding the store's change feed:
   every accepted task terminates, no task is lost, no duplicate
-  client-visible completion.
+  client-visible completion — plus chain-verified replica convergence
+  per shard (``assert_replicas_converged``);
+- ``disk``       — seeded filesystem fault injection on the journal's
+  write path (torn/short write, ENOSPC, EIO-on-fsync, lost page cache)
+  — the storage-layer analogue of the network injector;
+- ``crashpoint`` — the crash-point sweep: kill/restart a journaled
+  store at every record boundary and seeded mid-record offsets, assert
+  0 acknowledged-task loss / no conflicting state / replica
+  convergence per reboot (docs/durability.md).
 
 ``bench.py --fault-rate R [--resilience]`` drives the same injector over
 the full platform for the goodput-under-failure A/B.
 """
 
+from .crashpoint import check_reboot, crash_offsets, drive_workload, sweep
+from .disk import (DiskFaultInjector, DiskFaultRule, FaultyFile,
+                   attach_journal_faults, lose_page_cache)
 from .harness import (RestartableBackend, kill_dispatcher, kill_shard_primary,
                       kill_worker, rebalance_slot, restart_dispatcher,
                       restart_worker)
@@ -31,4 +42,7 @@ __all__ = [
     "RestartableBackend", "kill_dispatcher", "restart_dispatcher",
     "kill_worker", "restart_worker", "kill_shard_primary", "rebalance_slot",
     "InvariantChecker",
+    "DiskFaultInjector", "DiskFaultRule", "FaultyFile",
+    "attach_journal_faults", "lose_page_cache",
+    "sweep", "drive_workload", "crash_offsets", "check_reboot",
 ]

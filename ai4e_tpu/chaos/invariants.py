@@ -153,6 +153,47 @@ class InvariantChecker:
                 "terminal": len(self.terminal),
                 "duplicates": len(self.duplicate_completions)}
 
+    # -- durable-truth verdicts (docs/durability.md) ------------------------
+
+    def chain_divergences(self, store) -> list[str]:
+        """Chain-verified replica convergence, per shard: every replica's
+        verified-stream chain head must equal its primary's own-file head
+        once the links have drained (equal heads ⇔ byte-identical
+        absorbed history — the primary/replica divergence detector the
+        record envelope exists for). ``store`` is the sharded facade;
+        links are drained here so the check is not racing the tail loop.
+        Replicas that never absorbed an enveloped line (fresh standby on
+        an idle shard) are unanchored and skipped."""
+        out: list[str] = []
+        for group in getattr(store, "groups", ()):
+            primary_head = getattr(group.active, "chain_head", None)
+            if primary_head is None:
+                continue
+            for link in group.links:
+                try:
+                    link.drain()
+                except Exception as exc:  # noqa: BLE001; ai4e: noqa[AIL005] — the exception IS the finding: it returns as a convergence violation
+                    out.append(f"shard {group.index}: replica drain "
+                               f"failed: {exc!r}")
+                    continue
+                head = link.standby.replica_chain_head
+                if head is not None and head != primary_head:
+                    out.append(
+                        f"shard {group.index}: replica chain head {head} "
+                        f"diverged from primary {primary_head}")
+        return out
+
+    def assert_replicas_converged(self, store) -> None:
+        """Raise (with debug artifacts) unless every shard's replicas are
+        chain-converged with their primary."""
+        problems = self.chain_divergences(store)
+        if problems:
+            dumped = self.dump_debug(problems)
+            raise AssertionError(
+                "replica chain convergence violated"
+                + (f" (debug artifacts: {dumped})" if dumped else "")
+                + ":\n  " + "\n  ".join(problems))
+
     # -- per-shard verdicts (sharded runs; requires shard_of) ---------------
 
     def by_shard(self) -> dict[int, dict]:

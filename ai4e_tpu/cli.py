@@ -384,6 +384,8 @@ async def run_control_plane(config: FrameworkConfig, routes: dict) -> None:
     # AI4E_PLATFORM_ADMISSION=1, docs/admission.md) and resilience changes
     # failure semantics (breakers, retries, 5xx-as-transient —
     # AI4E_PLATFORM_RESILIENCE=1, docs/resilience.md).
+    journal_stats = (platform.store.journal_stats()
+                     if hasattr(platform.store, "journal_stats") else {})
     posture = ("".join([
         ", admission control ON" if platform.admission is not None else "",
         ", resilience ON" if platform.resilience is not None else "",
@@ -402,7 +404,13 @@ async def run_control_plane(config: FrameworkConfig, routes: dict) -> None:
         (", observability ON"
          if platform.observability is not None else ""),
         (f", SLO engine ON ({len(platform.slo.objectives)} objectives)"
-         if platform.slo is not None else "")]))
+         if platform.slo is not None else ""),
+        # The fsync policy changes what an acknowledgment MEANS against
+        # a machine crash (AI4E_TASKSTORE_FSYNC, docs/durability.md) —
+        # logged whenever a journal is in play (single or sharded) so
+        # the posture line names the durability contract in force.
+        (f", journal fsync={journal_stats['fsync_policy']}"
+         if journal_stats else "")]))
     log.info("control plane on %s:%s (%d routes%s)", config.gateway.host,
              config.gateway.port, len(platform.gateway.routes), posture)
     try:

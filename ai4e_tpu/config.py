@@ -38,11 +38,16 @@ _FALSE = frozenset({"0", "false", "no", "off", ""})
 # tests/test_chaos.py), AI4E_FEED_* (the multihost shard feed's direct
 # knobs, e.g. AI4E_FEED_ADVERTISE_IP in parallel/multihost.py — previously
 # REJECTED by from_env, so a multihost deployment pinning its feed IP
-# could not boot; AIL006 surfaced the drift). Single source of truth —
-# FrameworkConfig.from_env exempts these from its unknown-variable check
-# and the AIL006 config-drift rule imports the same tuple. All three are
-# documented in docs/config.md.
-OUT_OF_BAND_ENV_PREFIXES = ("AI4E_FAULT_", "AI4E_CHAOS_", "AI4E_FEED_")
+# could not boot; AIL006 surfaced the drift), AI4E_TASKSTORE_* (the
+# journal's durability knobs, e.g. AI4E_TASKSTORE_FSYNC read by
+# taskstore/journal.py at store construction — a storage-layer policy any
+# journal-bearing process honors, whether or not it builds a typed
+# FrameworkConfig). Single source of truth — FrameworkConfig.from_env
+# exempts these from its unknown-variable check and the AIL006
+# config-drift rule imports the same tuple. All four are documented in
+# docs/config.md.
+OUT_OF_BAND_ENV_PREFIXES = ("AI4E_FAULT_", "AI4E_CHAOS_", "AI4E_FEED_",
+                            "AI4E_TASKSTORE_")
 
 
 class ConfigError(ValueError):

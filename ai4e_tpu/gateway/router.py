@@ -482,7 +482,7 @@ class Gateway:
                 endpoint = endpoint.rstrip("/") + "/" + tail
             if request.query_string:
                 endpoint += "?" + request.query_string
-            from ..taskstore import NotPrimaryError
+            from ..taskstore import JournalDegradedError, NotPrimaryError
             content_type = request.content_type or "application/json"
 
             # Admission (admission/): anchor the caller's relative budget
@@ -598,6 +598,27 @@ class Gateway:
                         # runs (satellite: no hardcoded backoff hints).
                         headers={"Retry-After": self._standby_retry_after(),
                                  "X-Not-Primary": "1"})
+                except JournalDegradedError as exc:
+                    # Journal disk fault (docs/durability.md): the store
+                    # is fenced read-only — nothing was created or
+                    # published (memory never runs ahead of disk), so
+                    # refuse with the typed 503 the resilience layer
+                    # treats like a dark backend. No X-Not-Primary:
+                    # reads still serve here; clients must not re-home.
+                    self._requests.inc(route=route.prefix,
+                                       outcome="journal_degraded")
+                    if self._observability is not None:
+                        # The flight recorder keeps 100% of refusals —
+                        # a degraded store mid-incident ships its own
+                        # evidence (observability/hub.py).
+                        self._observability.record_refusal(
+                            route.prefix, "journal-degraded",
+                            priority=task_priority)
+                    return web.json_response(
+                        {"error": f"journal degraded: {exc}"},
+                        status=503,
+                        headers={"Retry-After": self._standby_retry_after(),
+                                 SHED_REASON_HEADER: "journal-degraded"})
                 span.task_id = task.task_id
             if cache is not None and xcache is not None:
                 # Miss/bypass recorded only NOW, after the record exists: a
@@ -708,10 +729,13 @@ class Gateway:
         memory-only — a journaled store must not pay payload-sized journal
         appends per duplicate request (the workload the cache exists for);
         after a restart the TaskId 404s, same as zero-retention reaping.
-        Returns None when this replica cannot create records (standby) —
-        the caller falls through to the ordinary create path's not-primary
-        answer."""
-        from ..taskstore import NotPrimaryError
+        Returns None when this replica cannot create records (standby or
+        journal-degraded) — the caller falls through to the ordinary
+        create path, whose typed handlers answer not-primary and
+        journal-degraded 503s (a degraded store refuses even this
+        memory-only record: the cache hit must not leak a generic 500
+        where every other mutation ships X-Shed-Reason)."""
+        from ..taskstore import JournalDegradedError, NotPrimaryError
         payload, ctype = found
         try:
             task = self.store.upsert(APITask(
@@ -719,12 +743,17 @@ class Gateway:
                 status="completed - served from cache",
                 backend_status=TaskStatus.COMPLETED,
                 publish=False, cache_key=key, durable=False))
-        except NotPrimaryError:
+        except (NotPrimaryError, JournalDegradedError):
             return None
         try:
             self.store.set_result(task.task_id, payload, ctype)
         except TaskNotFound:
             pass  # reaped already (zero-retention config); record answered
+        except JournalDegradedError:
+            # Degraded raced in between: the memory-only record exists
+            # but its result cannot attach — fall through to the create
+            # path's typed 503 (the orphan is non-durable and reaped).
+            return None
         self._requests.inc(route=route.prefix, outcome="cache_hit")
         return web.json_response(task.to_dict(),
                                  headers={CACHE_STATUS_HEADER: "hit"})

@@ -27,6 +27,12 @@ class PlatformConfig:
     max_delivery_count: int = 1440  # broker patience (setup_env.sh:65)
     dispatcher_concurrency: int = 1  # serial per queue (host.json:5-9)
     journal_path: str | None = None  # None → pure in-memory store
+    # Journal fsync policy (docs/durability.md): "never" (default —
+    # write+flush, today's behavior: survives SIGKILL, loses the unsynced
+    # tail on a machine crash), "always" (fsync per append), or
+    # "group:<ms>" (batched group commit, crash window bounded by the
+    # window). None resolves the AI4E_TASKSTORE_FSYNC env knob.
+    taskstore_fsync: str | None = None
     lease_seconds: float = 300.0
     native_broker: bool = False      # C++ broker core (native/broker_core.cpp)
     native_store: bool = False       # C++ task-store core (native/taskstore_core.cpp)
@@ -228,6 +234,12 @@ class LocalPlatform:
             result_backend=result_backend,
             result_offload_threshold=(self.config.result_offload_threshold
                                       if result_backend else None))
+        # Journal-bearing stores additionally get the fsync policy and the
+        # assembly registry (ai4e_journal_* metrics must land beside the
+        # platform's own /metrics, not in the process default — AIL002).
+        journal_kwargs = dict(result_kwargs,
+                              fsync=self.config.taskstore_fsync,
+                              metrics=self.metrics)
         if self.config.task_shards > 1:
             if self.config.native_store or self.config.native_broker:
                 raise ValueError(
@@ -247,7 +259,7 @@ class LocalPlatform:
                           if self.config.journal_path else 0),
                 tail_interval=self.config.shard_tail_interval,
                 feed_recent=self.config.shard_feed_recent,
-                **result_kwargs)
+                **journal_kwargs)
         elif self.config.replicate_from:
             if not self.config.journal_path:
                 raise ValueError(
@@ -257,7 +269,7 @@ class LocalPlatform:
                 raise ValueError("standby mode requires the Python store")
             from .taskstore.store import FollowerTaskStore
             self.store = FollowerTaskStore(self.config.journal_path,
-                                           **result_kwargs)
+                                           **journal_kwargs)
         elif self.config.journal_path:
             if self.config.native_store:
                 raise ValueError(
@@ -272,7 +284,7 @@ class LocalPlatform:
             from .taskstore.store import FollowerTaskStore
             self.store = FollowerTaskStore(self.config.journal_path,
                                            start_as_primary=True,
-                                           **result_kwargs)
+                                           **journal_kwargs)
         elif self.config.native_store:
             from .taskstore.native import NativeTaskStore
             if result_backend is not None:

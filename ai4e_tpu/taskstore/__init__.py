@@ -1,7 +1,9 @@
+from .journal import JournalCorruptError
 from .results import FileResultBackend, ResultBackend
 from .store import (
     FollowerTaskStore,
     InMemoryTaskStore,
+    JournalDegradedError,
     JournaledTaskStore,
     NotOwnerError,
     NotPrimaryError,
@@ -19,6 +21,8 @@ __all__ = [
     "InMemoryTaskStore",
     "JournaledTaskStore",
     "FollowerTaskStore",
+    "JournalCorruptError",
+    "JournalDegradedError",
     "NotOwnerError",
     "NotPrimaryError",
     "StaleEpochError",

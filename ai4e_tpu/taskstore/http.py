@@ -48,8 +48,8 @@ import os
 
 from aiohttp import web
 
-from .store import (InMemoryTaskStore, NotPrimaryError, StaleEpochError,
-                    TaskNotFound)
+from .store import (InMemoryTaskStore, JournalDegradedError, NotPrimaryError,
+                    StaleEpochError, TaskNotFound)
 from .task import APITask, TaskStatus
 
 
@@ -119,6 +119,18 @@ def make_app(store: InMemoryTaskStore,
         return web.json_response({"error": "not primary"}, status=503,
                                  headers={"X-Not-Primary": "1"})
 
+    def journal_degraded(exc: JournalDegradedError) -> web.Response:
+        # Disk fault flipped the store to read-only degraded mode
+        # (docs/durability.md#degraded-mode): mutations refuse with a
+        # TYPED 503 — X-Shed-Reason names the cause so dashboards and
+        # the admission/resilience layers see "dark node", not generic
+        # overload. Deliberately NO X-Not-Primary: clients must not
+        # re-home reads off a store that is still serving them.
+        return web.json_response(
+            {"error": f"journal degraded: {exc}"}, status=503,
+            headers={"X-Shed-Reason": "journal-degraded",
+                     "Retry-After": "5"})
+
     async def upsert(request: web.Request) -> web.Response:
         raw = await read_body_limited(request, max_body_bytes)
         if raw is None:
@@ -152,6 +164,8 @@ def make_app(store: InMemoryTaskStore,
             return web.json_response({"error": str(exc)}, status=400)
         except NotPrimaryError:
             return not_primary()
+        except JournalDegradedError as exc:
+            return journal_degraded(exc)
         return web.json_response(store.get(task.task_id).to_dict())
 
     async def update(request: web.Request) -> web.Response:
@@ -172,6 +186,8 @@ def make_app(store: InMemoryTaskStore,
             return web.Response(status=204)
         except NotPrimaryError:
             return not_primary()
+        except JournalDegradedError as exc:
+            return journal_degraded(exc)
         return web.json_response(task.to_dict())
 
     async def redrive(request: web.Request) -> web.Response:
@@ -233,6 +249,8 @@ def make_app(store: InMemoryTaskStore,
                         redriven.append(tid)
         except NotPrimaryError:
             return not_primary()
+        except JournalDegradedError as exc:
+            return journal_degraded(exc)
         return web.json_response(
             {"redriven": len(redriven), "task_ids": redriven})
 
@@ -268,6 +286,8 @@ def make_app(store: InMemoryTaskStore,
                                      status=404)
         except NotPrimaryError:
             return not_primary()
+        except JournalDegradedError as exc:
+            return journal_degraded(exc)
         return web.json_response({"ok": True})
 
     async def get_result(request: web.Request) -> web.Response:
@@ -350,6 +370,8 @@ def make_app(store: InMemoryTaskStore,
             return web.json_response({"error": str(exc)}, status=409)
         except NotPrimaryError:
             return not_primary()
+        except JournalDegradedError as exc:
+            return journal_degraded(exc)
         except RuntimeError as exc:  # store has no backend configured
             return web.json_response({"error": str(exc)}, status=400)
         return web.json_response({"ok": True})
@@ -385,6 +407,8 @@ def make_app(store: InMemoryTaskStore,
                                      status=404)
         except NotPrimaryError:
             return not_primary()
+        except JournalDegradedError as exc:
+            return journal_degraded(exc)
         return web.json_response({"ok": True, "appended": kept})
 
     async def get_ledger(request: web.Request) -> web.Response:
@@ -545,7 +569,22 @@ def make_app(store: InMemoryTaskStore,
                 {"role": getattr(store, "role", "primary"),
                  "epoch": getattr(store, "epoch", 0),
                  "replicating": replicating,
-                 "generation": store.journal_generation})
+                 "generation": store.journal_generation,
+                 # Durable-truth introspection (docs/durability.md): the
+                 # journal's hash-chain head — equal bytes ⇔ equal heads,
+                 # so primary/standby divergence is a string comparison —
+                 # and whether a disk fault has this store refusing
+                 # mutations. A follower's OWN file legitimately diverges
+                 # from the primary's once it has re-seeded (reset writes
+                 # an epoch line), so the divergence check compares the
+                 # primary's chain_head against the follower's
+                 # replica_chain_head — the primary-STREAM head it has
+                 # verified up to (review finding: comparing chain_head
+                 # to chain_head false-alarms after any failover).
+                 "chain_head": getattr(store, "chain_head", None),
+                 "replica_chain_head": getattr(
+                     store, "replica_chain_head", None),
+                 "degraded": bool(getattr(store, "degraded", False))})
 
         app.router.add_get("/v1/taskstore/journal", stamped(journal_stream))
         app.router.add_post("/v1/taskstore/promote", stamped(promote))

@@ -48,6 +48,7 @@ import aiohttp
 
 from ..metrics import DEFAULT_REGISTRY
 from ..utils.http import SessionHolder
+from .journal import JournalCorruptError
 from .store import FollowerTaskStore
 
 log = logging.getLogger("ai4e_tpu.taskstore.replication")
@@ -189,6 +190,27 @@ class JournalReplicator:
                 backoff = 0.5
             except asyncio.CancelledError:
                 raise
+            except JournalCorruptError as exc:
+                # A streamed line failed checksum/chain verification
+                # (store.absorb_lines): the verified prefix applied;
+                # NEVER absorb the bad line silently. Force the
+                # generation-mismatch resync path — reset + re-read from
+                # offset 0 of the primary's file; transient stream
+                # corruption heals on the re-read, persistent primary
+                # disk corruption keeps failing loudly here until the
+                # primary's own boot-salvage/quarantine (or its next
+                # compaction rewrite) repairs the file.
+                log.error("journal stream from %s failed VERIFICATION "
+                          "(%s); forcing full resync", self.primary_url,
+                          exc)
+                self.synced.clear()
+                self.generation = -1
+                buffer = b""
+                try:
+                    await asyncio.wait_for(self._stopped.wait(), backoff)
+                except asyncio.TimeoutError:
+                    pass
+                backoff = min(backoff * 2, 10.0)
             except Exception as exc:  # noqa: BLE001 — keep tailing through outages
                 log.warning("journal stream from %s failed (%s); retrying",
                             self.primary_url, exc)

@@ -65,9 +65,11 @@ import threading
 from typing import Callable, Iterable
 
 from .feed import ShardChangeFeed
+from .journal import JournalCorruptError
 from .replication import split_complete_lines
-from .store import (FollowerTaskStore, InMemoryTaskStore, NotOwnerError,
-                    NotPrimaryError, StoreClosedError, TaskNotFound)
+from .store import (FollowerTaskStore, InMemoryTaskStore,
+                    JournalDegradedError, NotOwnerError, NotPrimaryError,
+                    StoreClosedError, TaskNotFound)
 from .task import APITask, new_task_id
 
 log = logging.getLogger("ai4e_tpu.taskstore.sharding")
@@ -142,6 +144,13 @@ class ShardReplicaLink:
         self.generation = -1
         self.offset = 0
         self._buffer = b""
+        # (generation, offset) this link is PARKED at after a verified
+        # journal line failed its checksum/chain (the file's bytes will
+        # not change — re-reading re-fails): the verified prefix stays
+        # absorbed, progress stops loudly, and a failover drain promotes
+        # on that prefix — torn-tail semantics. A compaction rewrite
+        # (generation bump) clears the park.
+        self._corrupt_at: tuple[int, int] | None = None
         # Serializes tail-loop polls (executor thread) against the failover
         # drain (caller's thread): both advance offset/_buffer through
         # sync_once, and interleaving them would double-absorb or skip
@@ -162,6 +171,15 @@ class ShardReplicaLink:
         # dead primary's lock is uncontended and its generation frozen.
         with primary._lock:
             gen = primary.journal_generation
+            if self._corrupt_at == (gen, self.offset):
+                # Parked on a verified-corrupt record of THIS generation;
+                # the bytes cannot heal in place. Checked before any
+                # open/read — a parked link must not re-read the primary's
+                # ever-growing unabsorbed suffix on every tail poll
+                # (review finding). A compaction rewrite (generation
+                # bump) clears the park; a failover drain stops here on
+                # the verified prefix.
+                return 0
             try:
                 fh = open(self.group.journal_path, "rb")
             except FileNotFoundError:
@@ -176,6 +194,10 @@ class ShardReplicaLink:
                 self._buffer = b""
                 self.generation = gen
                 self.offset = 0
+                # A park belongs to the generation it was observed in; a
+                # stale tuple could otherwise match a fresh (gen, offset)
+                # pair and silently stall a healthy replica forever.
+                self._corrupt_at = None
             fh.seek(self.offset)
             chunk = fh.read()
         finally:
@@ -184,7 +206,23 @@ class ShardReplicaLink:
             return 0
         lines, self._buffer = split_complete_lines(self._buffer + chunk)
         if lines:
-            self.standby.absorb_lines(lines)
+            try:
+                self.standby.absorb_lines(lines)
+            except JournalCorruptError as exc:
+                # absorb applied the verified prefix and refused the bad
+                # line. Park the link (never absorb it silently — that
+                # would ratify the primary's bit-rot on the replica too);
+                # the un-absorbed suffix re-absorbs idempotently if the
+                # generation ever changes.
+                self._corrupt_at = (self.generation, self.offset)
+                self._buffer = b""
+                log.error(
+                    "shard %d replica: journal line failed verification "
+                    "at ~offset %d of %s (%s); replica parks on the "
+                    "verified prefix until the journal is repaired or "
+                    "compacted (docs/durability.md)", self.group.index,
+                    self.offset, self.group.journal_path, exc)
+                return 0
         self.offset += len(chunk)
         return len(chunk)
 
@@ -218,7 +256,11 @@ class ShardGroup:
         else:
             # Journal-less shards scale the keyspace but cannot fail over
             # (nothing durable to promote from) — the same durability
-            # trade the unsharded in-memory store already makes.
+            # trade the unsharded in-memory store already makes. The
+            # journal-only knobs (fsync policy, journal metrics) have
+            # nothing to attach to here.
+            kw.pop("fsync", None)
+            kw.pop("metrics", None)
             self.journal_path = None
             self.primary = InMemoryTaskStore(**kw)
         self.active: InMemoryTaskStore = self.primary
@@ -262,10 +304,12 @@ class ShardedTaskStore:
                  journal_path: str | None = None, replicas: int = 1,
                  tail_interval: float = 0.25, feed_recent: int = 4096,
                  compact_every: int = 5000, result_backend=None,
-                 result_offload_threshold: int | None = None):
+                 result_offload_threshold: int | None = None,
+                 fsync: str | None = None, metrics=None):
         self.ring = ShardRing(shards, slots=slots)
         store_kwargs = dict(result_backend=result_backend,
-                            result_offload_threshold=result_offload_threshold)
+                            result_offload_threshold=result_offload_threshold,
+                            fsync=fsync, metrics=metrics)
         self.groups = [
             ShardGroup(i, journal_path=journal_path, replicas=replicas,
                        compact_every=compact_every,
@@ -371,6 +415,30 @@ class ShardedTaskStore:
                 if not self._fail_over(group):
                     raise
                 continue
+            except JournalDegradedError as exc:
+                # Disk fault on the shard primary (ENOSPC/EIO): it is
+                # fenced read-only — for the sharded facade that is a
+                # dead writer WHEN a replica can take over. Only then is
+                # it closed (journal handle released; the FILE holds
+                # every acknowledged write for the drain) and promoted
+                # over. With NO promotable replica the primary must stay
+                # open: it is still serving reads and is recover()able —
+                # closing it would convert a transient disk fault into a
+                # permanent full-shard outage (review finding). The typed
+                # degraded error surfaces instead, so the HTTP layer
+                # answers the 503 + X-Shed-Reason: journal-degraded
+                # contract.
+                if not group.dead and not group.links:
+                    raise
+                last = exc
+                if not group.dead:
+                    log.error(
+                        "shard %d: primary is journal-degraded (%s); "
+                        "failing over to a replica", group.index, exc)
+                    group.mark_dead()
+                if not self._fail_over(group):
+                    raise
+                continue
             if (result is None
                     and self.groups[self.ring.shard_for(task_id)]
                     is not group):
@@ -398,17 +466,36 @@ class ShardedTaskStore:
         with group._lock:
             if not group.dead:
                 return True
-            if not group.links:
+            standby = None
+            while group.links:
+                link = group.links.pop(0)
+                candidate = link.standby
+                try:
+                    link.drain()
+                except Exception:  # noqa: BLE001 — promote anyway: the standby holds its last-absorbed state, and refusing leaves the shard with NO writer
+                    log.exception(
+                        "shard %d: final journal drain failed; promoting "
+                        "the replica on its last absorbed state",
+                        group.index)
+                try:
+                    candidate.promote()
+                except JournalDegradedError as exc:
+                    # The STANDBY's own disk faulted minting the fencing
+                    # epoch: promote() unwound it to an intact (degraded)
+                    # follower. Letting the error escape here would both
+                    # abort the failover AND silently discard the popped
+                    # replica (review finding) — instead try the next
+                    # one; with none left the shard is loudly writer-less
+                    # (False → the caller's StoreClosedError).
+                    log.error(
+                        "shard %d: replica's journal disk faulted during "
+                        "promotion (%s); trying the next replica",
+                        group.index, exc)
+                    continue
+                standby = candidate
+                break
+            if standby is None:
                 return False
-            link = group.links.pop(0)
-            standby = link.standby
-            try:
-                link.drain()
-            except Exception:  # noqa: BLE001 — promote anyway: the standby holds its last-absorbed state, and refusing leaves the shard with NO writer
-                log.exception(
-                    "shard %d: final journal drain failed; promoting the "
-                    "replica on its last absorbed state", group.index)
-            standby.promote()
             self._adopt(standby, group.index)
             group.primary = standby
             # Remaining replicas (replicas > 1) must re-home onto the NEW
@@ -538,6 +625,12 @@ class ShardedTaskStore:
             dest.import_task_records(recs1)
         except (StoreClosedError, NotPrimaryError):
             return None  # destination died mid-copy; retry fails it over
+        except JournalDegradedError:
+            # Destination's disk faulted mid-import: same as a death for
+            # rebalance purposes — mark it so the retry fails it over to
+            # a replica before re-copying.
+            self.groups[dest_index].mark_dead()
+            return None
         # Phase 2: atomic handoff under the source lock. Until the ring
         # flips, the range transiently exists on BOTH shards (aggregate
         # queries briefly double-count it — docs/sharding.md residual
@@ -797,7 +890,35 @@ class ShardedTaskStore:
                  "dead": g.dead,
                  "replicas": len(g.links),
                  "journal": g.journal_path,
+                 # Hash-chain heads (docs/durability.md): the primary's
+                 # own-file head beside each replica's verified-stream
+                 # head — divergence is a string comparison right here.
+                 "chain_head": getattr(g.active, "chain_head", None),
+                 "replica_chain_heads": [
+                     link.standby.replica_chain_head for link in g.links],
+                 "degraded": bool(getattr(g.active, "degraded", False)),
                  "feed_seq": self.feeds[g.index].seq,
                  "watchers": self.feeds[g.index].watcher_count}
                 for g in self.groups],
+        }
+
+    def journal_stats(self) -> dict:
+        """Aggregate per-shard journal stats (bench's ``journal`` block):
+        sums across shards, max append p99, any-degraded."""
+        shards = []
+        for g in self.groups:
+            stats = getattr(g.active, "journal_stats", None)
+            if stats is not None:
+                shards.append(stats())
+        if not shards:
+            return {}
+        return {
+            "bytes_appended": sum(s["bytes_appended"] for s in shards),
+            "fsyncs": sum(s["fsyncs"] for s in shards),
+            "compactions": sum(s["compactions"] for s in shards),
+            "salvages": sum(s["salvages"] for s in shards),
+            "fsync_policy": shards[0]["fsync_policy"],
+            "append_p99_ms": max(s["append_p99_ms"] for s in shards),
+            "degraded": any(s["degraded"] for s in shards),
+            "per_shard_chain_heads": [s["chain_head"] for s in shards],
         }

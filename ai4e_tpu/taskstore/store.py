@@ -23,7 +23,6 @@ backends instead of an Azure Function over a remote Redis:
 
 from __future__ import annotations
 
-import json
 import os
 import threading
 import time
@@ -65,6 +64,28 @@ class StaleEpochError(ValueError):
     """A demotion was attempted with an epoch no newer than the store's own
     — the caller is the stale side of the split, not this store (the HTTP
     surface maps this to 409)."""
+
+
+class JournalDegradedError(RuntimeError):
+    """The journal hit a disk fault (ENOSPC/EIO on append or fsync) and the
+    store flipped to fenced read-only DEGRADED mode: reads keep serving,
+    every mutation refuses with this error until ``recover()`` clears it —
+    never an exception mid-mutation that leaves memory ahead of disk. The
+    HTTP surfaces map it to a typed 503 with ``X-Shed-Reason:
+    journal-degraded`` so breakers/orchestration see the node like a dark
+    backend; the sharded facade treats it as a failover trigger
+    (docs/durability.md#degraded-mode).
+
+    ``rollback`` tells the raising append's caller whether the in-memory
+    mutation must be unwound: True for write/flush failures (the record's
+    bytes may be torn or absent on disk), False for fsync failures (the
+    bytes ARE in the file — refusing the ack while keeping memory equal to
+    the file is the honest state; the refused-but-durable record is the
+    documented at-least-once residual)."""
+
+    def __init__(self, message: str, rollback: bool = True):
+        super().__init__(message)
+        self.rollback = rollback
 
 
 class StoreSideEffects:
@@ -427,24 +448,35 @@ class InMemoryTaskStore(StoreSideEffects):
         order, so a full scan is the only correct victim collection."""
         cutoff = time.time() - age_s
         blob_keys: list[str] = []
-        with self._lock:
-            victims = []
-            for (path, status), members in self._sets.items():
-                if status not in TaskStatus.TERMINAL:
-                    continue
-                victims.extend(task_id for task_id, score in members.items()
-                               if score < cutoff)
-            for task_id in victims:
-                blob_keys.extend(self._apply_evict(task_id))
-        # Backend I/O OUTSIDE the lock (a GCS/PD delete is a network round
-        # trip; thousands of victims on a first sweep must not stall every
-        # store operation). Crash-ordering: the journaled subclass appends
-        # the Evict record inside _apply_evict, i.e. BEFORE these deletes —
-        # a crash in between leaks blobs harmlessly instead of replaying a
-        # completed task whose offloaded result is gone.
-        for key in blob_keys:
-            self._delete_blob(key)
-        return len(victims)
+        evicted = 0
+        try:
+            with self._lock:
+                victims = []
+                for (path, status), members in self._sets.items():
+                    if status not in TaskStatus.TERMINAL:
+                        continue
+                    victims.extend(task_id
+                                   for task_id, score in members.items()
+                                   if score < cutoff)
+                for task_id in victims:
+                    blob_keys.extend(self._apply_evict(task_id))
+                    evicted += 1
+        finally:
+            # Backend I/O OUTSIDE the lock (a GCS/PD delete is a network
+            # round trip; thousands of victims on a first sweep must not
+            # stall every store operation). Crash-ordering: the journaled
+            # subclass appends the Evict record inside _apply_evict, i.e.
+            # BEFORE these deletes — a crash in between leaks blobs
+            # harmlessly instead of replaying a completed task whose
+            # offloaded result is gone. Runs in a finally: on a mid-batch
+            # journal-degraded abort, earlier victims are already evicted
+            # AND journaled, so no record references their blobs — skipping
+            # the deletes would orphan them on the mount forever (review
+            # finding; the aborted victim itself rolled back and kept its
+            # pointers, so its keys never reach blob_keys).
+            for key in blob_keys:
+                self._delete_blob(key)
+        return evicted
 
     def _apply_evict(self, task_id: str) -> list[str]:
         """Forget one task entirely; returns offloaded-result keys whose
@@ -504,10 +536,20 @@ class InMemoryTaskStore(StoreSideEffects):
                 self._apply_set_result(key, None if offload else result,
                                        content_type)
         except Exception:
-            if offload:
-                # The pointer never became visible (unknown/reaped task,
-                # closed store): reap the just-written blob or it leaks on
-                # the mount forever.
+            # Reap the just-written blob UNLESS an offloaded pointer for
+            # this key is visible in memory — the one invariant that
+            # matters: visible pointer ⇒ its blob must exist. No pointer
+            # (unknown/reaped task, closed store, degraded rollback of a
+            # fresh result) ⇒ nothing references the blob and it would
+            # leak on the mount forever. A visible pointer survives here
+            # two ways: the key already held one (put() overwrote that
+            # blob in place — deleting would dangle it; the residual is
+            # the blob serving the refused write's bytes,
+            # docs/durability.md#degraded-mode), or a rollback=False
+            # fsync failure applied the mutation to match the file.
+            with self._lock:
+                now = self._results.get(key)
+            if offload and not (now is not None and now[0] is None):
                 self._delete_blob(key)
             raise
 
@@ -517,6 +559,14 @@ class InMemoryTaskStore(StoreSideEffects):
         holds ``self._lock``; the journaled subclass extends this."""
         self._check_open()
         self._check_owner(key.split(":", 1)[0])
+        self._set_result_in_memory(key, result, content_type)
+
+    def _set_result_in_memory(self, key: str, result: bytes | None,
+                              content_type: str) -> None:
+        """The unchecked memory half of a result write. Split out so the
+        journaled subclass can apply it AFTER a failed-but-durable append
+        (rollback=False), when the open/degraded re-check would refuse a
+        mutation whose record is already in the file."""
         prev = self._results.get(key)
         self._results[key] = (result, content_type)
         self._result_keys.setdefault(key.split(":", 1)[0], set()).add(key)
@@ -816,12 +866,70 @@ class JournaledTaskStore(InMemoryTaskStore):
 
     def __init__(self, journal_path: str, publisher: Publisher | None = None,
                  compact_every: int = 5000, result_backend=None,
-                 result_offload_threshold: int | None = None):
+                 result_offload_threshold: int | None = None,
+                 fsync: str | None = None, metrics=None):
         super().__init__(publisher, result_backend=result_backend,
                          result_offload_threshold=result_offload_threshold)
+        from . import journal as journal_format
+        from ..metrics import DEFAULT_REGISTRY
+        self._journal_format = journal_format
         self._journal_path = journal_path
         self._journal = None  # gate journaling off during replay
         self._closed = False
+        # Fsync policy (docs/durability.md): never (default — today's
+        # write+flush behavior), always (fsync per append), group:<ms>
+        # (batched group commit). None resolves AI4E_TASKSTORE_FSYNC;
+        # a malformed value fails HERE, at construction.
+        self._fsync_kind, self._fsync_group_s = (
+            journal_format.parse_fsync_policy(fsync))
+        self._fsync_last = 0.0
+        self._fsync_timer = None        # pending group-commit timer
+        self._fsync_dirty = False       # bytes flushed but not yet fsynced
+        # Disk-fault degraded mode: set by _enter_degraded on EIO/ENOSPC;
+        # every mutation refuses with JournalDegradedError until recover().
+        self.degraded = False
+        self.degraded_reason: str | None = None
+        # Hash-chain head over this store's own journal file (journal.py):
+        # two stores holding the same bytes hold the same head, so
+        # divergence is a string comparison (topology/role endpoints).
+        self.chain_head = journal_format.GENESIS
+        # Blessed default-resolution idiom (AIL002): the assembly plumbs
+        # its registry; standalone construction falls back in one visible
+        # expression.
+        metrics = metrics or DEFAULT_REGISTRY
+        self._m_fsyncs = metrics.counter(
+            "ai4e_journal_fsyncs_total",
+            "Journal fsync calls, by fsync policy")
+        self._m_appended = metrics.counter(
+            "ai4e_journal_appended_bytes_total",
+            "Bytes appended to task-store journals")
+        self._m_salvages = metrics.counter(
+            "ai4e_journal_salvages_total",
+            "Torn journal tails truncated at open, by reason")
+        self._m_verify_fail = metrics.counter(
+            "ai4e_journal_verify_failures_total",
+            "Journal records that failed checksum/chain verification")
+        self._m_degraded = metrics.gauge(
+            "ai4e_journal_degraded",
+            "1 while the store refuses mutations after a journal disk "
+            "fault (read-only degraded mode)")
+        self._m_degraded_total = metrics.counter(
+            "ai4e_journal_degraded_total",
+            "Times a journal disk fault flipped the store to degraded "
+            "mode, by errno name")
+        self._m_compactions = metrics.counter(
+            "ai4e_journal_compactions_total",
+            "Journal compaction rewrites")
+        self._m_append_s = metrics.histogram(
+            "ai4e_journal_append_seconds",
+            "Journal append wall time (write+flush+policy fsync)")
+        # Instance-level stats for bench's `journal` result block — the
+        # registry aggregates across stores; these stay per store.
+        self._stat_bytes = 0
+        self._stat_fsyncs = 0
+        self._stat_compactions = 0
+        self._stat_salvages = 0
+        self._append_times: list[float] = []
         # Auto-compaction: status transitions append forever, so a
         # long-running store's journal (and restart replay time) would grow
         # without bound. Once ``compact_every`` records accumulate beyond
@@ -847,6 +955,26 @@ class JournaledTaskStore(InMemoryTaskStore):
         self.epoch = 0
         self.replayed_task_ids: set[str] = set()
         if os.path.exists(journal_path):
+            # Salvage BEFORE replay and before the append handle opens: a
+            # torn final record (mid-write crash) is truncated to the last
+            # complete verified record, so (a) replay can never crash-loop
+            # on a torn tail and (b) the "a"-mode handle below can never
+            # concatenate the next record onto torn bytes — the bug a
+            # skip-only replay fix would leave behind. A corrupt INTERIOR
+            # record raises loudly here with its offset instead
+            # (journal.salvage; docs/durability.md).
+            report = journal_format.salvage(journal_path)
+            if report is not None:
+                import logging
+                logging.getLogger("ai4e_tpu.taskstore").warning(
+                    "journal %s: salvaged torn tail — dropped %d bytes at "
+                    "offset %d (%s); %d records kept, chain head %s "
+                    "(report: %s.salvage.json)", journal_path,
+                    report.dropped_bytes, report.truncated_at,
+                    report.reason, report.records_kept, report.chain_head,
+                    journal_path)
+                self._m_salvages.inc(reason=report.reason)
+                self._stat_salvages += 1
             self._replay()
             self.replayed_task_ids = set(self._tasks)
             # Same heuristic as runtime auto-compaction: only rewrite when
@@ -860,13 +988,20 @@ class JournaledTaskStore(InMemoryTaskStore):
                                  encoding="utf-8")
 
     def _replay(self) -> None:
+        # Salvage already verified the file end to end; the replay pass
+        # re-verifies as it applies (cheap — CRC of control-plane-sized
+        # records) so the chain head comes out of one code path.
+        chain = self._journal_format.GENESIS
         with open(self._journal_path, encoding="utf-8") as f:
             for line in f:
                 line = line.strip()
                 if not line:
                     continue
+                rec, chain, _legacy = self._journal_format.verify_line(
+                    line, chain)
                 self._records += 1
-                self._apply_replay_record(json.loads(line))
+                self._apply_replay_record(rec)
+        self.chain_head = chain
 
     def _apply_replay_record(self, rec: dict) -> "APITask | None":
         """Apply ONE journal record to in-memory state — the replay step,
@@ -972,17 +1107,46 @@ class JournaledTaskStore(InMemoryTaskStore):
         # Called with self._lock held; shared by task and result records.
         if self._journal is None:
             return
-        self._journal.write(json.dumps(rec) + "\n")
-        self._journal.flush()
+        self._check_degraded()
+        start = time.monotonic()
+        line, chain = self._journal_format.encode_record(
+            rec, self.chain_head)
+        data = line + "\n"
+        try:
+            self._journal.write(data)
+            self._journal.flush()
+        except OSError as exc:
+            # The record's bytes may be torn or absent on disk: flip to
+            # degraded mode and tell the caller to unwind its in-memory
+            # mutation (rollback=True) — the store must never acknowledge,
+            # or remember, state the journal does not hold.
+            raise self._enter_degraded(exc, "append") from exc
+        self.chain_head = chain
+        nbytes = len(data.encode("utf-8"))
+        self._stat_bytes += nbytes
+        self._m_appended.inc(nbytes)
+        self._fsync_dirty = True
+        if self._fsync_kind == "always":
+            # Bytes reached the file before the fsync attempt: on failure
+            # memory EQUALS the file, so the mutation stays (rollback=False)
+            # — only the acknowledgment is refused (at-least-once residual,
+            # docs/durability.md#fsync-policies).
+            self._fsync_journal()
+        elif self._fsync_kind == "group":
+            self._group_commit()
+        self._record_append_time(time.monotonic() - start)
         self._records += 1
         if (self._records >= self._next_compact_at
                 and self._records > 2 * self._live_records()):
-            # The append above already made this mutation durable; a failed
-            # rewrite (disk full) must not surface as an error for — or
-            # skip the notify/publish of — a transition that succeeded. And
-            # it must not retry on the very next write (a full O(tasks)
-            # rewrite per transition while the disk is already under
-            # pressure): back off a full compaction interval either way.
+            # The append above flushed this mutation to the journal FILE
+            # (durable against process death; durable against machine
+            # crash only per the fsync policy — docs/durability.md); a
+            # failed rewrite (disk full) must not surface as an error for
+            # — or skip the notify/publish of — a transition that
+            # succeeded. And it must not retry on the very next write (a
+            # full O(tasks) rewrite per transition while the disk is
+            # already under pressure): back off a full compaction interval
+            # either way.
             import logging
             before = self._records
             try:
@@ -996,6 +1160,194 @@ class JournaledTaskStore(InMemoryTaskStore):
                     "append-only journal")
             self._next_compact_at = self._records + self._compact_every
 
+    # -- disk-fault degraded mode + fsync policy (docs/durability.md) ------
+
+    def _check_degraded(self) -> None:
+        if self.degraded:
+            raise JournalDegradedError(
+                f"task store is journal-degraded ({self.degraded_reason}); "
+                "mutations refused until recover()", rollback=False)
+
+    def _enter_degraded(self, exc: OSError,
+                        where: str) -> JournalDegradedError:
+        """Flip to fenced read-only degraded mode on a journal disk fault.
+        Returns the typed error for the caller to raise; idempotent for
+        repeat faults. Reads keep serving; the HTTP surfaces answer
+        mutations 503 + ``X-Shed-Reason: journal-degraded``."""
+        import errno as errno_mod
+        import logging
+        name = errno_mod.errorcode.get(exc.errno or 0, "OSError")
+        if not self.degraded:
+            self.degraded = True
+            self.degraded_reason = f"{name} on journal {where}: {exc}"
+            self._m_degraded.set(1.0)
+            self._m_degraded_total.inc(errno=name)
+            logging.getLogger("ai4e_tpu.taskstore").error(
+                "journal %s hit %s on %s; store is now DEGRADED "
+                "(read-only) — mutations refuse with 503 "
+                "journal-degraded until recover() "
+                "(docs/durability.md#degraded-mode)",
+                self._journal_path, name, where)
+        return JournalDegradedError(
+            self.degraded_reason or f"{name} on journal {where}",
+            rollback=(where == "append"))
+
+    def _fsync_journal(self) -> None:
+        """Push flushed journal bytes to stable storage. Caller holds
+        ``self._lock``. Raises JournalDegradedError(rollback=False) on
+        EIO — the bytes are in the FILE, so memory stays; only the
+        acknowledgment is refused."""
+        fh = self._journal
+        if fh is None or not self._fsync_dirty:
+            return
+        try:
+            # FaultyFile (chaos/disk.py) exposes fsync(); real handles go
+            # through os.fsync on the descriptor.
+            sync = getattr(fh, "fsync", None)
+            if sync is not None:
+                sync()
+            else:
+                os.fsync(fh.fileno())
+        except OSError as exc:
+            raise self._enter_degraded(exc, "fsync") from exc
+        self._fsync_dirty = False
+        self._fsync_last = time.monotonic()
+        self._stat_fsyncs += 1
+        self._m_fsyncs.inc(policy=self._fsync_kind)
+
+    def _group_commit(self) -> None:
+        """group:<ms> policy: at most one fsync per window. An append that
+        lands with the window already elapsed pays the fsync inline (the
+        amortization point — the store lock serializes appends, so one
+        fsync covers every record flushed since the last); otherwise a
+        timer completes the window so an idle tail is synced within <ms>
+        even when no further append arrives. Caller holds ``self._lock``."""
+        now = time.monotonic()
+        if now - self._fsync_last >= self._fsync_group_s:
+            self._fsync_journal()
+            return
+        if self._fsync_timer is None:
+            delay = max(self._fsync_group_s - (now - self._fsync_last),
+                        0.001)
+            t = threading.Timer(delay, self._timer_fsync)
+            t.daemon = True
+            self._fsync_timer = t
+            t.start()
+
+    def _timer_fsync(self) -> None:
+        """Group-commit window completion (timer thread). A fault here
+        flips degraded without raising — there is no caller to refuse;
+        the appends inside the broken window are the policy's documented
+        acknowledged-but-unsynced residual."""
+        with self._lock:
+            self._fsync_timer = None
+            if self._closed or self.degraded or self._journal is None:
+                return
+            try:
+                self._fsync_journal()
+            except JournalDegradedError:
+                pass  # _enter_degraded already logged + metered
+
+    def _record_append_time(self, seconds: float) -> None:
+        self._m_append_s.observe(seconds)
+        self._append_times.append(seconds)
+        if len(self._append_times) > 4096:
+            # Keep the bench-window reservoir bounded; p99 over the most
+            # recent half is plenty for the result block.
+            del self._append_times[:2048]
+
+    def recover(self) -> bool:
+        """Operator/cycle hook: leave degraded mode once the disk is
+        healthy again. Re-salvages the journal (the failed append may have
+        left a torn tail on disk — exactly the shape boot-salvage
+        repairs), reopens the append handle, probes an fsync, and
+        re-admits mutations. Returns True when the store is writable on
+        exit; False (still degraded) when the disk still faults."""
+        with self._lock:
+            if self._closed:
+                return False
+            if not self.degraded:
+                return True
+            # Discard the broken handle FIRST — before the scan, and
+            # without flushing: its write buffer holds exactly the
+            # rolled-back record's bytes, and an ordinary close() would
+            # re-flush them onto the now-healthy file, resurrecting a
+            # mutation the caller was told was refused and unwound
+            # (review finding, regression-tested). Whatever partial bytes
+            # the failed flush DID land are a torn tail the salvage scan
+            # below truncates.
+            # A FOLLOWER keeps its append handle in ``_raw`` with
+            # ``_journal`` gated off (e.g. a promote() whose epoch mint
+            # hit the disk fault and unwound): discard and reopen THAT
+            # slot, or the broken buffered handle would survive recovery
+            # while a fresh one lands in the wrong attribute.
+            follower = getattr(self, "role", "primary") == "follower"
+            if follower:
+                old, self._raw = self._raw, None
+            else:
+                old, self._journal = self._journal, None
+            if old is not None:
+                self._close_discarding(old)
+            try:
+                scan = self._journal_format.scan_journal(self._journal_path)
+                report = self._journal_format.salvage(
+                    self._journal_path, scan)
+                fh = open(self._journal_path, "a",  # noqa: SIM115
+                          encoding="utf-8")
+                os.fsync(fh.fileno())
+            except (OSError, self._journal_format.JournalCorruptError):
+                import logging
+                logging.getLogger("ai4e_tpu.taskstore").exception(
+                    "journal %s: recovery attempt failed; store stays "
+                    "degraded", self._journal_path)
+                return False
+            if follower:
+                self._raw = fh
+            else:
+                self._journal = fh
+            if report is not None:
+                # The salvage truncated bytes that were VISIBLE to
+                # replication readers (a torn fragment streams like any
+                # other bytes): a reader whose offset passed the verified
+                # prefix would otherwise be served the middle of a fresh
+                # record spliced onto its stale buffer — or report zero
+                # lag while missing every post-recover write. The
+                # generation bump is the system's one "file bytes
+                # changed" signal (compaction's contract); readers
+                # full-resync from offset 0 (review finding).
+                self.journal_generation += 1
+            self.chain_head = scan.chain_head
+            self._records = scan.records
+            self._fsync_dirty = False
+            self.degraded = False
+            self.degraded_reason = None
+            self._m_degraded.set(0.0)
+            import logging
+            logging.getLogger("ai4e_tpu.taskstore").warning(
+                "journal %s: recovered from degraded mode; mutations "
+                "re-admitted at chain head %s", self._journal_path,
+                self.chain_head)
+            return True
+
+    def journal_stats(self) -> dict:
+        """The bench/ops summary block: append volume, fsync/compaction
+        counts, and append p99 — docs/durability.md#observability."""
+        with self._lock:
+            times = sorted(self._append_times)
+            p99 = times[int(len(times) * 0.99)] if times else 0.0
+            return {
+                "bytes_appended": self._stat_bytes,
+                "fsyncs": self._stat_fsyncs,
+                "compactions": self._stat_compactions,
+                "salvages": self._stat_salvages,
+                "fsync_policy": (self._fsync_kind
+                                 if self._fsync_kind != "group" else
+                                 f"group:{self._fsync_group_s * 1000:g}"),
+                "append_p99_ms": round(p99 * 1000, 3),
+                "degraded": self.degraded,
+                "chain_head": self.chain_head,
+            }
+
     def _compact_locked(self) -> None:
         """Rewrite the journal as one full record per live task (+ one per
         result). Caller holds ``self._lock`` (or is still single-threaded in
@@ -1005,26 +1357,35 @@ class JournaledTaskStore(InMemoryTaskStore):
         succeeds."""
         tmp = self._journal_path + ".compact"
         new_journal = None
+        # The rewrite restarts the hash chain from genesis: the compacted
+        # file is a new byte lineage (followers already resync on the
+        # generation bump; the chain head is per (generation, file)).
+        chain = self._journal_format.GENESIS
+
+        def emit(f, rec: dict) -> None:
+            nonlocal chain
+            line, chain = self._journal_format.encode_record(rec, chain)
+            f.write(line + "\n")
+
         try:
             with open(tmp, "w", encoding="utf-8") as f:
                 if self.epoch:
                     # The fencing epoch must survive the rewrite — it is
                     # state, not history.
-                    f.write(json.dumps({"Epoch": self.epoch}) + "\n")
+                    emit(f, {"Epoch": self.epoch})
                 for task in self._tasks.values():
                     if not task.durable:
                         # In-memory-only records (cache hits) must not be
                         # promoted to durability by a rewrite.
                         continue
-                    f.write(json.dumps(self._full_record(task)) + "\n")
+                    emit(f, self._full_record(task))
                 # Tasks first, then results — replay applies them in file
                 # order and a result's task record must already exist.
                 for key, (body, ctype) in self._results.items():
                     owner = self._tasks.get(key.split(":", 1)[0])
                     if owner is not None and not owner.durable:
                         continue
-                    f.write(json.dumps(self._result_record(
-                        key, body, ctype)) + "\n")
+                    emit(f, self._result_record(key, body, ctype))
                 f.flush()
                 os.fsync(f.fileno())
             # Open the append handle on the tmp file BEFORE the rename: the
@@ -1046,6 +1407,12 @@ class JournaledTaskStore(InMemoryTaskStore):
         self._records = (len(self._tasks) + len(self._results)
                          + (1 if self.epoch else 0))
         self.journal_generation += 1
+        self.chain_head = chain
+        # The rewrite was fsynced before the rename; nothing unsynced
+        # survives from the old file's lineage.
+        self._fsync_dirty = False
+        self._stat_compactions += 1
+        self._m_compactions.inc()
         if old is not None:
             old.close()
 
@@ -1061,27 +1428,71 @@ class JournaledTaskStore(InMemoryTaskStore):
         bloat denominator for the compaction heuristics."""
         return len(self._tasks) + len(self._results)
 
+    def _check_open(self) -> None:
+        # Degraded refuses BEFORE any memory mutation, with the typed
+        # error the HTTP surfaces map to 503 journal-degraded — reads
+        # never come through here, so they keep serving.
+        super()._check_open()
+        if self.degraded:
+            self._check_degraded()
+
     def _apply_set_result(self, key: str, result: bytes | None,
                           content_type: str) -> None:
         # Journal the result so a completed task survives restart WITH its
         # payload — without this a replayed task would report completed
         # while its result is gone (a worse lie than losing the task).
+        # Append FIRST, mutate memory second: the base apply deletes a
+        # superseded offload blob, which must never happen before the
+        # record is known journaled — a degraded append after that delete
+        # would roll back to a pointer whose blob is gone, making an
+        # acknowledged result unreadable (review finding). Append-first
+        # means a failed append leaves memory untouched: nothing to
+        # unwind. Pre-validate what the apply would refuse so the journal
+        # never holds a record memory rejected.
         self._check_open()
-        super()._apply_set_result(key, result, content_type)
-        owner = self._tasks.get(key.split(":", 1)[0])
-        if owner is not None and not owner.durable:
-            # The owning record never reached the journal; its result must
-            # not either (replay would otherwise restore an orphan result).
-            return
-        self._append(self._result_record(key, result, content_type))
+        tid = key.split(":", 1)[0]
+        self._check_owner(tid)
+        owner = self._tasks.get(tid)
+        if owner is None or owner.durable:
+            try:
+                self._append(
+                    self._result_record(key, result, content_type))
+            except JournalDegradedError as exc:
+                if not exc.rollback:
+                    # Fsync-failure shape: the record's bytes ARE in the
+                    # file (and on any replica that absorbs the stream).
+                    # Apply the memory mutation so memory == file — the
+                    # refused-but-possibly-durable at-least-once
+                    # residual, the same contract upsert/update keep on
+                    # rollback=False (review finding: append-first must
+                    # not invert it). The unchecked core: the store is
+                    # degraded NOW, so the checked apply would refuse a
+                    # mutation whose record is already durable.
+                    self._set_result_in_memory(key, result, content_type)
+                raise
+        # else: the owning record never reached the journal; its result
+        # must not either (replay would otherwise restore an orphan
+        # result).
+        self._set_result_in_memory(key, result, content_type)
 
     def _apply_evict(self, task_id: str) -> list[str]:
         if task_id not in self._tasks:
             return []
         self._check_open()
         # Capture before the pop: a non-durable record was never journaled,
-        # so journaling its eviction would only bloat the file.
-        durable = self._tasks[task_id].durable
+        # so journaling its eviction would only bloat the file. The rest of
+        # the snapshot is the degraded-rollback undo — an eviction whose
+        # Evict append fails with possibly-torn bytes must restore the
+        # task wholesale, or memory forgets a task the journal still holds
+        # (restart/replicas resurrect it) and a recovered retry no-ops
+        # before ever journaling the eviction (review finding).
+        task = self._tasks[task_id]
+        durable = task.durable
+        orig = self._orig_bodies.get(task_id)
+        ledger = self._ledgers.get(task_id)
+        keys = set(self._result_keys.get(task_id, ()))
+        results = {key: self._results[key] for key in keys
+                   if key in self._results}
         blob_keys = super()._apply_evict(task_id)
         if durable:
             rec = {"Evict": True, "TaskId": task_id}
@@ -1090,21 +1501,76 @@ class JournaledTaskStore(InMemoryTaskStore):
                 # replay of this record must not delete the new owner's
                 # payloads out of the shared backend.
                 rec["KeepBlobs"] = True
-            self._append(rec)
+            try:
+                self._append(rec)
+            except JournalDegradedError as exc:
+                if exc.rollback:
+                    self._tasks[task_id] = task
+                    self._add_to_set(task)
+                    if orig is not None:
+                        self._orig_bodies[task_id] = orig
+                    if ledger is not None:
+                        self._ledgers[task_id] = ledger
+                    if keys:
+                        self._result_keys[task_id] = keys
+                        self._results.update(results)
+                    raise
+                # Fsync-failure shape: the Evict record IS in the file
+                # and memory already forgot the task — the eviction is
+                # complete, so fall through and surrender the blob keys.
+                # Raising here would leak them forever: nothing
+                # references the blobs anymore and the caller's delete
+                # loop would never receive the keys (review finding).
+                # The sweep's NEXT mutation refuses typed before
+                # touching memory, so degradation still surfaces.
         return blob_keys
 
     def _apply_upsert(self, task: APITask) -> APITask:
         self._check_open()
-        task = super()._apply_upsert(task)
-        self._log(task)
-        return task
+        prev = self._tasks.get(task.task_id) if task.task_id else None
+        had_orig = (task.task_id in self._orig_bodies
+                    if task.task_id else False)
+        prev_orig = (self._orig_bodies.get(task.task_id)
+                     if had_orig else None)
+        stored = super()._apply_upsert(task)
+        try:
+            self._log(stored)
+        except JournalDegradedError as exc:
+            if exc.rollback:
+                self._rollback_upsert(stored, prev, had_orig, prev_orig)
+            raise
+        return stored
+
+    def _rollback_upsert(self, stored: APITask, prev: APITask | None,
+                         had_orig: bool,
+                         prev_orig: tuple[bytes, str] | None) -> None:
+        """Unwind ONE in-memory upsert whose journal append failed with
+        possibly-torn bytes (degraded write path). Caller holds the lock."""
+        self._remove_from_set(stored)
+        if prev is None:
+            self._tasks.pop(stored.task_id, None)
+        else:
+            self._tasks[prev.task_id] = prev
+            self._add_to_set(prev)
+        if had_orig:
+            self._orig_bodies[stored.task_id] = prev_orig
+        else:
+            self._orig_bodies.pop(stored.task_id, None)
 
     def _apply_update(
         self, task_id: str, status: str, backend_status: str | None
     ) -> APITask:
         self._check_open()
+        prev = self._tasks.get(task_id)
         task = super()._apply_update(task_id, status, backend_status)
-        self._log(task, slim=True)
+        try:
+            self._log(task, slim=True)
+        except JournalDegradedError as exc:
+            if exc.rollback and prev is not None:
+                self._remove_from_set(task)
+                self._tasks[task_id] = prev
+                self._add_to_set(prev)
+            raise
         return task
 
     def _validates_task_ids(self) -> bool:
@@ -1115,10 +1581,68 @@ class JournaledTaskStore(InMemoryTaskStore):
         # crash-loop replay / wedge absorb forever).
         return self._journal is not None and not self._absorbing
 
+    def _drain_fsync_on_close(self) -> None:
+        """Cancel any pending group-commit timer and push the dirty tail
+        down on a CLEAN close (a graceful shutdown should not owe the
+        disk anything, whatever the policy). Caller holds ``self._lock``;
+        best-effort — close must succeed on a faulting disk too."""
+        timer, self._fsync_timer = self._fsync_timer, None
+        if timer is not None:
+            timer.cancel()
+        if (self._fsync_kind != "never" and self._fsync_dirty
+                and not self.degraded and self._journal is not None):
+            try:
+                self._fsync_journal()
+            except JournalDegradedError:
+                pass  # _enter_degraded logged it; close proceeds
+
+    @staticmethod
+    def _close_discarding(fh) -> None:
+        """Close a DEGRADED journal handle WITHOUT flushing its buffer.
+
+        After a rollback=True append failure the handle's write buffer
+        holds exactly the refused record's unflushed bytes — an ordinary
+        ``close()`` re-flushes them onto the (possibly healed) file,
+        landing a mutation the caller was told was refused and unwound:
+        a restart, a replica drain, or ``recover()`` would then resurrect
+        it (review finding; regression-tested). The descriptor is
+        atomically redirected onto ``os.devnull`` (dup2) BEFORE the
+        close, so the close-time flush drains harmlessly there. NOT
+        os.close()-then-close(): between those two calls another thread
+        (a blob write, a sibling shard's open) can open a file that
+        REUSES the freed descriptor number, and the close-time flush
+        would splice the refused bytes into that unrelated file (review
+        finding). Acknowledged records are never at risk — every
+        successful append flushed."""
+        try:
+            fd = fh.fileno()
+        except (OSError, ValueError):
+            fd = None
+        if fd is not None:
+            try:
+                devnull = os.open(os.devnull, os.O_WRONLY)
+            except OSError:
+                devnull = None
+            if devnull is not None:
+                try:
+                    os.dup2(devnull, fd)
+                except OSError:
+                    pass
+                finally:
+                    os.close(devnull)
+        try:
+            fh.close()
+        except (OSError, ValueError):
+            pass
+
     def close(self) -> None:
         with self._lock:
             if not self._closed and self._journal is not None:
-                self._journal.close()
+                self._drain_fsync_on_close()
+                if self.degraded:
+                    self._close_discarding(self._journal)
+                else:
+                    self._journal.close()
             self._closed = True
 
 
@@ -1146,6 +1670,14 @@ class FollowerTaskStore(JournaledTaskStore):
     # super().__init__ replays the local journal (instance attrs land after).
     role = "primary"
     _absorbing = False
+    # The PRIMARY's chain head as verified off the absorbed stream — the
+    # value divergence checks compare against the primary's own
+    # ``chain_head``. None = unanchored (fresh boot / legacy stream):
+    # checksums still verify, the first enveloped line's chain is adopted.
+    # Distinct from ``chain_head``, which tracks this replica's OWN file
+    # (whose leading epoch line from ``reset`` makes its byte lineage —
+    # legitimately — different from the primary's).
+    _absorb_chain: str | None = None
 
     def __init__(self, journal_path: str, start_as_primary: bool = False,
                  **kwargs):
@@ -1168,36 +1700,78 @@ class FollowerTaskStore(JournaledTaskStore):
 
     # -- replication feed ---------------------------------------------------
 
+    def _write_own_line(self, fh, rec: dict) -> None:
+        """Append one record to this replica's OWN journal, enveloped
+        against its own chain — so the local file is self-consistent for
+        its own restart salvage/replay (its byte lineage legitimately
+        differs from the primary's by the ``reset`` epoch line). Caller
+        holds ``self._lock``; caller flushes."""
+        line, self.chain_head = self._journal_format.encode_record(
+            rec, self.chain_head)
+        fh.write(line + "\n")
+
+    @property
+    def replica_chain_head(self) -> str | None:
+        """The primary-stream chain head this replica has verified up to —
+        compare with the primary's ``chain_head`` for divergence (None
+        until the first enveloped line anchors it)."""
+        return self._absorb_chain
+
     def absorb_lines(self, lines: list[str]) -> None:
         """Apply journal lines streamed from the primary and append them
-        verbatim to the local journal (one flush per call, not per line).
+        to the local journal (one flush per call, not per line).
         Replicated Slim transitions notify this replica's own listeners
         (gateway long-poll waiters on the standby must wake when a task
         completes on the primary); full upserts already notify inside
-        ``upsert``."""
+        ``upsert``.
+
+        Every line is checksum- and chain-verified BEFORE anything
+        applies: a corrupt streamed line must never absorb silently (it
+        would poison this replica with bytes the primary never wrote, or
+        ratify the primary's own bit-rot). The verified prefix is applied
+        and kept; the bad line and everything after it raise
+        ``JournalCorruptError`` — the HTTP replicator answers with a full
+        generation-style resync, the in-process shard link parks loudly
+        at the offset (``sharding.ShardReplicaLink``). Legacy
+        checksum-less lines absorb verbatim for migration."""
         transitions: list[APITask] = []
+        error = None
         with self._lock:
             if self.role != "follower":
                 raise RuntimeError("absorb after promote — replication "
                                    "must stop when the follower becomes "
                                    "primary")
             self._check_open()
+            verified: list[dict] = []
+            chain = self._absorb_chain
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec, chain, _legacy = (
+                        self._journal_format.verify_line(line, chain))
+                except self._journal_format.JournalCorruptError as exc:
+                    self._m_verify_fail.inc()
+                    error = exc
+                    break
+                verified.append(rec)
             self._absorbing = True
             try:
-                for line in lines:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    task = self._apply_replay_record(json.loads(line))
+                for rec in verified:
+                    task = self._apply_replay_record(rec)
                     if task is not None:
                         transitions.append(task)
-                    self._raw.write(line + "\n")
+                    self._write_own_line(self._raw, rec)
                     self._records += 1
             finally:
                 self._absorbing = False
             self._raw.flush()
+            self._absorb_chain = chain
         for task in transitions:
             self._notify(task)
+        if error is not None:
+            raise error
 
     def reset(self) -> None:
         """Discard all replicated state — the primary compacted (journal
@@ -1220,11 +1794,16 @@ class FollowerTaskStore(JournaledTaskStore):
             self._raw.close()
             self._raw = open(self._journal_path, "w",  # noqa: SIM115
                              encoding="utf-8")
+            # Fresh file, fresh lineages: our own chain restarts at
+            # genesis, and the absorbed stream restarts at the primary's
+            # genesis (the resync re-reads its file from offset 0).
+            self.chain_head = self._journal_format.GENESIS
+            self._absorb_chain = self._journal_format.GENESIS
             if self.epoch:
                 # The fencing epoch survives the truncation: a crash before
                 # the absorbed stream re-delivers the primary's epoch record
                 # must not replay this node back to an unfenced epoch 0.
-                self._raw.write(json.dumps({"Epoch": self.epoch}) + "\n")
+                self._write_own_line(self._raw, {"Epoch": self.epoch})
                 self._raw.flush()
                 self._records = 1
 
@@ -1244,7 +1823,26 @@ class FollowerTaskStore(JournaledTaskStore):
             self.role = "primary"
             self._journal = self._raw
             self.epoch += 1
-            self._append({"Epoch": self.epoch})
+            try:
+                self._append({"Epoch": self.epoch})
+            except JournalDegradedError as exc:
+                if exc.rollback:
+                    # The mint never reached the file: unwind WHOLESALE.
+                    # A half-promoted store would hold a memory-only
+                    # epoch a restart replays away — a later promotion
+                    # could then re-mint an epoch this lineage already
+                    # claimed, breaking the no-two-promotions-share-an-
+                    # epoch fencing guarantee (review finding). Unwound,
+                    # the store is an intact (degraded) follower; after
+                    # recover() a retried promote() re-mints cleanly.
+                    self.epoch -= 1
+                    self._journal = None
+                    self.role = "follower"
+                    raise
+                # Fsync-failure shape: the Epoch record IS in the file —
+                # the promotion is durable and complete. Swallow: the
+                # store is primary and degraded; every subsequent
+                # mutation refuses with the typed error anyway.
 
     def demote(self, epoch: int) -> None:
         """Fence this node out of the primary role: a peer presented
@@ -1269,7 +1867,7 @@ class FollowerTaskStore(JournaledTaskStore):
             # Record the fence so a restart replays epoch >= this value: a
             # rebooted deposed primary can never re-mint an epoch the new
             # primary already holds.
-            self._raw.write(json.dumps({"Epoch": epoch}) + "\n")
+            self._write_own_line(self._raw, {"Epoch": epoch})
             self._raw.flush()
             self._records += 1
 
@@ -1346,7 +1944,14 @@ class FollowerTaskStore(JournaledTaskStore):
         with self._lock:
             if not self._closed:
                 if self.role == "follower" and self._raw is not None:
+                    timer, self._fsync_timer = self._fsync_timer, None
+                    if timer is not None:
+                        timer.cancel()
                     self._raw.close()
                 elif self._journal is not None:
-                    self._journal.close()
+                    self._drain_fsync_on_close()
+                    if self.degraded:
+                        self._close_discarding(self._journal)
+                    else:
+                        self._journal.close()
             self._closed = True

@@ -33,7 +33,9 @@ import argparse
 import asyncio
 import io
 import json
+import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -632,11 +634,28 @@ def build_platform(args):
     )
 
     enable_compilation_cache()
+    fsync_policy = getattr(args, "fsync_policy", "")
+    journal_dir = None
+    if fsync_policy:
+        journal_dir = tempfile.mkdtemp(prefix="ai4e-bench-journal")
+        # The journal holds the whole run's append volume — reap it at
+        # process exit or repeated runs fill the bench box's temp dir.
+        import atexit
+        import shutil
+        atexit.register(shutil.rmtree, journal_dir, True)
     platform = LocalPlatform(PlatformConfig(
         transport=args.transport,
         native_store=args.fabric == "native",
         native_broker=(args.fabric == "native"
                        and args.transport == "queue"),
+        # --fsync-policy: journal the task store under the given policy
+        # (docs/durability.md) so the run pays the real append(+fsync)
+        # cost on the task hot path; the result JSON gains a `journal`
+        # block (bytes appended, fsyncs, compactions, append p99).
+        # Without the flag the bench stays journal-less as before.
+        journal_path=(os.path.join(journal_dir, "journal")
+                      if journal_dir else None),
+        taskstore_fsync=fsync_policy or None,
         retry_delay=0.05, dispatcher_concurrency=args.dispatcher_concurrency,
         # --cache-hit-ratio > 0 enables the inference result cache +
         # single-flight coalescing (rescache/) for the duplicate-mix run.
@@ -1253,6 +1272,25 @@ async def run_bench(args) -> dict:
             "longpoll_watchers_peak": int(watcher_peak[0]),
         }
 
+    journal_meta = {}
+    if getattr(args, "fsync_policy", ""):
+        stats_fn = getattr(platform.store, "journal_stats", None)
+        if stats_fn is not None:
+            js = stats_fn()
+            if js:
+                # The append-path cost of the chosen durability policy
+                # (docs/durability.md): volume, fsync count, and the
+                # p99 a task's journaled transition paid under the
+                # store lock.
+                journal_meta["journal"] = {
+                    "fsync_policy": js["fsync_policy"],
+                    "bytes_appended": js["bytes_appended"],
+                    "fsyncs": js["fsyncs"],
+                    "compactions": js["compactions"],
+                    "salvages": js["salvages"],
+                    "append_p99_ms": js["append_p99_ms"],
+                }
+
     fault_meta = {}
     if injector is not None:
         # Goodput under failure: completions/s inside the window (failures
@@ -1499,6 +1537,7 @@ async def run_bench(args) -> dict:
         **orchestration_meta,
         **cache_meta,
         **shard_meta,
+        **journal_meta,
         **fault_meta,
         **batch_meta,
         **phases_meta,
@@ -1813,6 +1852,8 @@ def _forward_argv(args) -> list[str]:
             *(["--orchestration"] if args.orchestration else []),
             *(["--observability"] if args.observability else []),
             *(["--mix", args.mix] if args.mix else []),
+            *(["--fsync-policy", args.fsync_policy]
+              if getattr(args, "fsync_policy", "") else []),
             "--task-shards", str(args.task_shards),
             "--deadline-ms", str(args.deadline_ms),
             *(["--priority-mix", args.priority_mix]
@@ -1954,6 +1995,13 @@ def main() -> None:
                              ".md); the result JSON gains a 'phases' "
                              "block (queue-wait/h2d/execute/d2h "
                              "percentiles + h2d/execute overlap ratio)")
+    parser.add_argument("--fsync-policy", default="",
+                        help="journal the task store under this fsync "
+                             "policy (never | always | group:<ms>, "
+                             "docs/durability.md) and report a "
+                             "`journal` block (bytes appended, fsyncs, "
+                             "compactions, append p99 ms) in the result "
+                             "JSON; empty (default) stays journal-less")
     parser.add_argument("--task-shards", type=int, default=1,
                         help="shard the task keyspace over N store shards "
                              "with per-shard dispatcher sub-queues "

@@ -52,3 +52,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "race: deterministic interleaving-exploration suite "
         "(ai4e_tpu.analysis.race; runs JAX-free in race-smoke)")
+    config.addinivalue_line(
+        "markers", "durability: crash-point sweep + disk-fault chaos "
+        "(docs/durability.md; runs JAX-free in durability-smoke)")

@@ -690,11 +690,16 @@ class TestRegistryLeakFixes:
         async def main():
             before = set(DEFAULT_REGISTRY._metrics)
             reg = MetricsRegistry()
-            store = FollowerTaskStore(str(tmp_path / "journal.jsonl"))
+            # The store takes the same injected registry (its
+            # ai4e_journal_* family follows the identical AIL002 idiom
+            # since the durability PR).
+            store = FollowerTaskStore(str(tmp_path / "journal.jsonl"),
+                                      metrics=reg)
             repl = JournalReplicator(store, "http://127.0.0.1:1",
                                      metrics=reg)
             assert "ai4e_replication_offset_bytes" in reg._metrics
             assert "ai4e_replication_lag_bytes" in reg._metrics
+            assert "ai4e_journal_fsyncs_total" in reg._metrics
             assert set(DEFAULT_REGISTRY._metrics) == before
             await repl.aclose()
 
