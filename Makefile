@@ -1,7 +1,7 @@
 # Contributor conveniences. Each target reproduces the matching CI job
 # with the SAME flags (the scripts are the single source of truth).
 
-.PHONY: lint test race-smoke chaos durability
+.PHONY: lint test race-smoke chaos durability rig
 
 # Both lint gates CI runs (ruff correctness rules + ai4e-lint, see
 # scripts/lint.sh and docs/analysis.md).
@@ -28,6 +28,18 @@ chaos:
 	  tests/test_orchestration_chaos.py tests/test_pipeline_chaos.py \
 	  tests/test_disk_chaos.py \
 	  -q -m chaos -p no:cacheprovider
+
+# The multi-process deployment rig at CI's reduced rate + pinned seed
+# (rig-smoke job, docs/deployment.md): real separate OS processes —
+# balancer, gateway replicas, shard store primaries + wire replicas,
+# dispatcher pools, CPU-echo workers — with the chaos replay (gateway
+# kill, dispatcher kill, live move_slot, shard-primary SIGKILL) and the
+# cross-process invariant verdict gating the exit code. JAX-free.
+rig:
+	python -m ai4e_tpu.rig up --gateways 3 --shards 2 --replicas 1 \
+	  --dispatchers 1 --workers 1 --loadgens 2 --rate 1500 \
+	  --duration 15 --ramp 3 --task-timeout 45 --seed 20260803 \
+	  --workdir /tmp/ai4e-rig --out /tmp/ai4e-rig/artifact
 
 # The durable-truth gate (docs/durability.md) with CI's pinned seed
 # (durability-smoke job): journal envelope/salvage/fsync/degraded units

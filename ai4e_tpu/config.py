@@ -42,12 +42,14 @@ _FALSE = frozenset({"0", "false", "no", "off", ""})
 # journal's durability knobs, e.g. AI4E_TASKSTORE_FSYNC read by
 # taskstore/journal.py at store construction — a storage-layer policy any
 # journal-bearing process honors, whether or not it builds a typed
-# FrameworkConfig). Single source of truth — FrameworkConfig.from_env
-# exempts these from its unknown-variable check and the AIL006
-# config-drift rule imports the same tuple. All four are documented in
-# docs/config.md.
+# FrameworkConfig), AI4E_RIG_* (the multi-process deployment rig's
+# driver-side knobs, e.g. AI4E_RIG_BASE_PORT read by ai4e_tpu/rig/ — rig
+# child processes are configured by the resolved topology spec file, not
+# env). Single source of truth — FrameworkConfig.from_env exempts these
+# from its unknown-variable check and the AIL006 config-drift rule
+# imports the same tuple. All five are documented in docs/config.md.
 OUT_OF_BAND_ENV_PREFIXES = ("AI4E_FAULT_", "AI4E_CHAOS_", "AI4E_FEED_",
-                            "AI4E_TASKSTORE_")
+                            "AI4E_TASKSTORE_", "AI4E_RIG_")
 
 
 class ConfigError(ValueError):

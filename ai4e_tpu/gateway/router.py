@@ -20,6 +20,7 @@ Routes:
 from __future__ import annotations
 
 import asyncio
+import inspect
 import logging
 import math
 import time
@@ -41,6 +42,17 @@ from ..taskstore import (APITask, InMemoryTaskStore, TaskNotFound, TaskStatus,
 from ..utils.http import SessionHolder
 
 log = logging.getLogger("ai4e_tpu.gateway")
+
+
+async def _aresult(value):
+    """Await ``value`` when the store verb came from a remote/async client
+    (the rig's ring-routed wire store — ``ai4e_tpu/rig/wire.py``), pass it
+    through when it came from the in-process sync store. The gateway's
+    store touchpoints all route through this so one Gateway class serves
+    both deployments; the sync store pays one ``isawaitable`` check."""
+    if inspect.isawaitable(value):
+        return await value
+    return value
 
 
 @dataclass
@@ -88,8 +100,13 @@ class Gateway:
         self.tracer = Tracer("gateway", metrics=self.metrics)
         # Proxy fan-out is bounded by inbound connections, not the pool.
         self._sessions = SessionHolder(limit=0)
-        # task_id -> {(loop, Event)} long-poll waiters (see _task).
-        self._waiters: dict[str, set] = {}
+        # Long-poll wake path (_feed_for): a store with per-shard change
+        # feeds (the sharded facade, the rig's wire store) supplies them;
+        # any other store gets ONE gateway-side feed lazily attached to
+        # its listener surface. There is no parallel per-task waiter map
+        # any more — the feed is the single wake mechanism, and it wakes
+        # with the terminal record itself.
+        self._fallback_feed = None
         # Subscription-key auth (the reference's APIM front door requires
         # Ocp-Apim-Subscription-Key on every published API). None → open.
         self._api_keys = set(api_keys) if api_keys else None
@@ -130,12 +147,6 @@ class Gateway:
         # Event-loop objects, so they live here rather than in the
         # thread-safe cache.
         self._sync_inflight: dict = {}
-        if hasattr(store, "add_listener") and not hasattr(store, "feed_for"):
-            # Unsharded stores: per-task waiter map fed by a store listener.
-            # A sharded store's long-poll rides its per-shard change feeds
-            # instead (see _task) — no gateway-side listener at all.
-            store.add_listener(self._on_task_change)
-
         # aiohttp's own cap is effectively disabled: _read_limited enforces
         # the per-route edge cap incrementally (bounded buffering), and an
         # explicit 0 (unlimited) must actually mean unlimited.
@@ -261,7 +272,7 @@ class Gateway:
                 {"error": "event streaming not enabled"}, status=404)
         task_id = request.match_info["task_id"]
         try:
-            task = self.store.get(task_id)
+            task = await _aresult(self.store.get(task_id))
         except TaskNotFound:
             return web.Response(status=404, text="Task not found.")
         cap = self._event_stream_max_s
@@ -288,7 +299,7 @@ class Gateway:
             # Current state first (the client may have attached late); the
             # re-read AFTER subscribing closes the attach-vs-event race.
             try:
-                task = self.store.get(task_id)
+                task = await _aresult(self.store.get(task_id))
             except TaskNotFound:
                 task = None
             if task is not None:
@@ -530,7 +541,7 @@ class Gateway:
                                                  else "coalesced" if leader
                                                  else "miss")
                     if found is not None:
-                        resp = self._serve_cached_task(
+                        resp = await self._serve_cached_task(
                             route, endpoint, body, content_type, key, found)
                         if resp is not None:
                             cache.count_hit()
@@ -543,7 +554,8 @@ class Gateway:
                     else:
                         if leader is not None:
                             try:
-                                record = self.store.get(leader)
+                                record = await _aresult(
+                                    self.store.get(leader))
                             except TaskNotFound:
                                 # Leader evicted mid-flight (tight
                                 # retention): clear the stale registration,
@@ -572,7 +584,7 @@ class Gateway:
             with self.tracer.span("create_task", route=route.prefix,
                                    headers=request.headers) as span:
                 try:
-                    task = self.store.upsert(APITask(
+                    task = await _aresult(self.store.upsert(APITask(
                         endpoint=endpoint,
                         body=body,
                         content_type=content_type,
@@ -580,7 +592,7 @@ class Gateway:
                         cache_key=cache_key,
                         deadline_at=deadline_at,
                         priority=task_priority,
-                    ))
+                    )))
                 except NotPrimaryError:
                     # Standby control plane: reads are served here, task
                     # creation belongs to the primary — tell the client to
@@ -627,7 +639,7 @@ class Gateway:
                 # sum to answered requests). Hit/coalesced returned earlier.
                 (cache.count_miss if xcache == "miss"
                  else cache.count_bypass)()
-            stored = self.store.get(task.task_id)
+            stored = await _aresult(self.store.get(task.task_id))
             if self._observability is not None:
                 # admitted (at arrival time) + published: the store's
                 # publish hook ran synchronously inside upsert, so by
@@ -717,9 +729,9 @@ class Gateway:
             extra=(tail + "?" + request.query_string
                    if request.query_string else tail))
 
-    def _serve_cached_task(self, route: Route, endpoint: str, body: bytes,
-                           content_type: str, key: str,
-                           found: tuple) -> web.Response | None:
+    async def _serve_cached_task(self, route: Route, endpoint: str,
+                                 body: bytes, content_type: str, key: str,
+                                 found: tuple) -> web.Response | None:
         """Answer an async-path cache hit. A REAL task record is created —
         already terminal, ``publish=False`` so it never touches the
         transport — and the cached payload is stored as its result, so the
@@ -738,15 +750,16 @@ class Gateway:
         from ..taskstore import JournalDegradedError, NotPrimaryError
         payload, ctype = found
         try:
-            task = self.store.upsert(APITask(
+            task = await _aresult(self.store.upsert(APITask(
                 endpoint=endpoint, body=body, content_type=content_type,
                 status="completed - served from cache",
                 backend_status=TaskStatus.COMPLETED,
-                publish=False, cache_key=key, durable=False))
+                publish=False, cache_key=key, durable=False)))
         except (NotPrimaryError, JournalDegradedError):
             return None
         try:
-            self.store.set_result(task.task_id, payload, ctype)
+            await _aresult(self.store.set_result(task.task_id, payload,
+                                                 ctype))
         except TaskNotFound:
             pass  # reaped already (zero-retention config); record answered
         except JournalDegradedError:
@@ -1130,7 +1143,7 @@ class Gateway:
             return web.json_response(payload)
 
         try:
-            task = self.store.get(task_id)
+            task = await _aresult(self.store.get(task_id))
         except TaskNotFound:
             return web.Response(status=404, text="Task not found.")
 
@@ -1142,71 +1155,47 @@ class Gateway:
                 return web.Response(status=400, text="Bad wait parameter.")
 
         if wait > 0 and task.canonical_status not in TaskStatus.TERMINAL:
-            feed_for = getattr(self.store, "feed_for", None)
-            if feed_for is not None:
-                # Sharded store: park on the owning shard's change feed
-                # (``taskstore/feed.py``). The wakeup delivers the terminal
-                # record itself — no per-request store re-poll — and the
-                # feed's replay map closes the attach-vs-event race, so the
-                # whole watcher population rides N shard feeds instead of
-                # N×watchers store listeners. Only the timeout path (and a
-                # task that migrates shards mid-wait, whose event lands on
-                # the destination feed) falls back to a store read.
-                record = await feed_for(task_id).wait_terminal(task_id, wait)
-                if record is not None:
-                    return answer(record)
-                try:
-                    task = self.store.get(task_id)
-                except TaskNotFound:
-                    return web.Response(status=404, text="Task not found.")
-                return answer(task)
-            # Register the waiter BEFORE the re-read so a transition between
-            # re-read and wait() still sets the event (no lost wakeup).
-            event = self._waiter_for(task_id)
+            # Park on the task's change feed (``taskstore/feed.py``) — the
+            # ONE wake mechanism for every store shape. The wakeup delivers
+            # the terminal record itself — no per-request store re-poll —
+            # and the feed's replay map closes the attach-vs-event race, so
+            # the whole watcher population rides N feeds instead of
+            # N×watchers store listeners. Only the timeout path (a task
+            # that migrated shards mid-wait, an evicted task, a wire feed
+            # that hiccuped) falls back to a store read — which is also
+            # where a mid-wait eviction answers 404, not 500.
+            record = await self._feed_for(task_id).wait_terminal(task_id,
+                                                                 wait)
+            if record is not None:
+                return answer(record)
             try:
-                task = self.store.get(task_id)
-                if task.canonical_status not in TaskStatus.TERMINAL:
-                    try:
-                        await asyncio.wait_for(event.wait(), timeout=wait)
-                    except asyncio.TimeoutError:
-                        pass
-                    task = self.store.get(task_id)
+                task = await _aresult(self.store.get(task_id))
             except TaskNotFound:
-                # Retention evicted the task mid-wait (tight retention
-                # config) — answer like any unknown task, not with a 500.
                 return web.Response(status=404, text="Task not found.")
-            finally:
-                self._drop_waiter(task_id, event)
         return answer(task)
 
-    # Waiter bookkeeping is copy-on-write (sets are replaced, never mutated):
-    # _on_task_change may iterate from any thread while the event loop
-    # registers/drops waiters, and an in-place add() during iteration would
-    # raise — swallowed by the store's _notify — losing the wakeup.
-
-    def _waiter_for(self, task_id: str) -> asyncio.Event:
-        event = asyncio.Event()
-        self._waiters[task_id] = self._waiters.get(task_id, frozenset()) | {
-            (asyncio.get_running_loop(), event)}
-        return event
-
-    def _drop_waiter(self, task_id: str, event: asyncio.Event) -> None:
-        entries = self._waiters.get(task_id)
-        if entries:
-            remaining = frozenset(e for e in entries if e[1] is not event)
-            if remaining:
-                self._waiters[task_id] = remaining
-            else:
-                del self._waiters[task_id]
-
-    def _on_task_change(self, task) -> None:
-        """Store listener — may fire from any thread; wake that task's
-        long-poll waiters on terminal transitions (``expired`` included —
-        a poller must learn its task was shed, not wait out the poll)."""
-        if task.canonical_status not in TaskStatus.TERMINAL:
-            return
-        for loop, event in self._waiters.get(task.task_id, frozenset()):
-            loop.call_soon_threadsafe(event.set)
+    def _feed_for(self, task_id: str):
+        """The change feed a long-poll for ``task_id`` parks on: the
+        store's own feed when it has one (the sharded facade's owning
+        shard, the rig wire store's locally-tailed shard feed), else one
+        gateway-side feed lazily attached to the store's listener surface.
+        This replaced the per-task waiter map that lived beside the feed
+        path: the feed wakes with the record, behaves identically when
+        the transition arrives via a replication absorb, and is the same
+        mechanism another gateway replica uses — so a long-poll answered
+        by a replica that did not admit the task still wakes with the
+        record (tests/test_longpoll.py)."""
+        feed_for = getattr(self.store, "feed_for", None)
+        if feed_for is not None:
+            return feed_for(task_id)
+        if self._fallback_feed is None:
+            from ..taskstore.feed import ShardChangeFeed
+            feed = ShardChangeFeed(0)
+            add = getattr(self.store, "add_listener", None)
+            if add is not None:
+                add(feed.publish)
+            self._fallback_feed = feed
+        return self._fallback_feed
 
     async def _health(self, _: web.Request) -> web.Response:
         return web.json_response({"status": "healthy", "routes": len(self.routes)})

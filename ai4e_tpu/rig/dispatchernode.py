@@ -1,0 +1,75 @@
+"""Dispatcher PROCESS — one shard's queue drainer over the wire.
+
+The unmodified ``broker.Dispatcher`` (with its full duplicate-suppression
+/ backpressure / dead-letter semantics) running against:
+
+- ``WireBroker`` — leases popped from the shard store node's
+  ``/v1/rig/broker/*`` surface (the lease lives server-side, so a SIGKILL
+  of this process loses nothing: the lease expires and the message
+  redelivers to another dispatcher — exactly the chaos verb the rig
+  replays);
+- ``RingStoreClient`` as the task manager — status writes ring-route by
+  TaskId, so a task whose slot moved mid-delivery still lands its
+  transition on the owning shard;
+- the shard's CPU-echo worker set as resilient weighted backends
+  (connect-failover between workers, terminal-probe duplicate
+  suppression on redeliveries).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+from ..broker.dispatcher import Dispatcher
+from ..metrics import MetricsRegistry
+from ..resilience import BackendHealth, ResiliencePolicy
+from ..taskstore import endpoint_path
+from .topology import Topology
+from .wire import RingStoreClient, WireBroker
+
+log = logging.getLogger("ai4e_tpu.rig.dispatcher")
+
+
+async def run_dispatchernode(topo: Topology, shard: int, index: int) -> None:
+    from .supervisor import serve_until_signal
+
+    metrics = MetricsRegistry()
+    ring = RingStoreClient(topo.all_shard_urls(), slots=topo.slots)
+    broker = WireBroker(topo.shard_urls(shard),
+                        lease_seconds=topo.lease_seconds)
+    health = BackendHealth(ResiliencePolicy(retry_base_s=0.05,
+                                            retry_cap_s=1.0),
+                           metrics=metrics)
+    dispatcher = Dispatcher(
+        broker, endpoint_path(topo.route), topo.worker_urls(shard), ring,
+        retry_delay=topo.retry_delay,
+        concurrency=topo.dispatcher_concurrency,
+        request_timeout=30.0, metrics=metrics, resilience=health)
+
+    app = web.Application()
+
+    async def health_route(_: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy", "shard": shard,
+                                  "busy": dispatcher._busy})
+
+    async def metrics_route(_: web.Request) -> web.Response:
+        return web.Response(text=metrics.render_prometheus(),
+                            content_type="text/plain")
+
+    app.router.add_get("/healthz", health_route)
+    app.router.add_get("/metrics", metrics_route)
+
+    async def start(_app) -> None:
+        await dispatcher.start()
+
+    async def stop(_app) -> None:
+        await dispatcher.stop()
+        await broker.aclose()
+        await ring.aclose()
+
+    app.on_startup.append(start)
+    app.on_cleanup.append(stop)
+    await serve_until_signal(app, topo.host,
+                             topo.dispatcher_port(shard, index))

@@ -1,0 +1,49 @@
+"""One gateway replica PROCESS (docs/deployment.md).
+
+The ordinary ``Gateway`` class over the rig's ``RingStoreClient`` instead
+of an in-process store: every store verb crosses the task-store HTTP
+surface ring-routed by TaskId, and the long-poll parks on the locally
+tailed wire change feed — so a replica that did NOT admit a task still
+wakes its long-poll with the record (the satellite regression in
+``tests/test_longpoll.py`` proves the mechanism; the rig exercises it
+across real processes). Each gateway carries its own per-role
+``MetricsRegistry``; the rig's verdict scrapes and merges every node's
+``/metrics`` into one coherent view.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..gateway.router import Gateway
+from ..metrics import MetricsRegistry
+from .topology import Topology
+from .wire import RingStoreClient
+
+log = logging.getLogger("ai4e_tpu.rig.gateway")
+
+
+def build_gateway(topo: Topology) -> tuple[Gateway, RingStoreClient]:
+    ring = RingStoreClient(topo.all_shard_urls(), slots=topo.slots)
+    gateway = Gateway(ring, metrics=MetricsRegistry())
+    # The recorded task Endpoint is nominal (dispatchers rebase onto their
+    # shard's worker set); its PATH is what names the broker queue.
+    gateway.add_async_route(topo.route, topo.worker_urls(0)[0])
+    return gateway, ring
+
+
+async def run_gatewaynode(topo: Topology, index: int) -> None:
+    from .supervisor import serve_until_signal
+
+    gateway, ring = build_gateway(topo)
+
+    async def start_tails(_app) -> None:
+        await ring.start_feed_tails()
+
+    async def stop_tails(_app) -> None:
+        await ring.aclose()
+
+    gateway.app.on_startup.append(start_tails)
+    gateway.app.on_cleanup.append(stop_tails)
+    await serve_until_signal(gateway.app, topo.host,
+                             topo.gateway_port(index))

@@ -1,0 +1,220 @@
+"""The rig driver — ``python -m ai4e_tpu.rig up`` / ``make rig``.
+
+Launches the topology as real OS processes under the ``Supervisor``,
+drives the multi-process loadgen through the balancer, replays the
+seeded chaos timeline at rate, and records the whole run — topology,
+per-loadgen windows (offered vs achieved + error taxonomy), the chaos
+events with their actual fire times, the per-shard + global invariant
+verdict, and the merged per-role metrics — as ONE JSON artifact
+(``bench_results/r12-*`` acceptance shape: the scale claim is a file,
+not a README paragraph).
+
+Boot order is dependency order: stores first (primaries, then replicas,
+each health-gated), then workers, dispatchers, gateways, the balancer,
+and only then the loadgens. Teardown is the supervisor's hard contract —
+every exit path (success, chaos gone wrong, ^C) runs it, and it verifies
+the ports actually drained.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import json
+import logging
+import os
+import time
+
+from . import chaos as rig_chaos
+from . import verdict as rig_verdict
+from .supervisor import Supervisor, python_argv
+from .topology import Topology
+
+log = logging.getLogger("ai4e_tpu.rig.run")
+
+
+def _spawn_topology(topo: Topology, sup: Supervisor) -> None:
+    spec = topo.spec_path()
+
+    def spawn(name: str, role: str, port: int | None, *extra: str) -> None:
+        argv = python_argv("ai4e_tpu.rig", role, "--spec", spec, *extra)
+        sup.spawn(name, argv, log_path=os.path.join(topo.workdir,
+                                                    f"{name}.log"),
+                  port=port,
+                  health_url=(f"http://{topo.host}:{port}/healthz"
+                              if port else None))
+
+    # Stores before everything (dependency order); primaries before
+    # replicas so the replica's first wire poll finds a stream.
+    for s in range(topo.shards):
+        spawn(f"store{s}", "storenode", topo.shard_port(s),
+              "--shard", str(s), "--index", "-1")
+    for s in range(topo.shards):
+        sup.wait_healthy(f"store{s}")
+    for s in range(topo.shards):
+        for r in range(topo.replicas):
+            spawn(f"store{s}r{r}", "storenode", topo.replica_port(s, r),
+                  "--shard", str(s), "--index", str(r))
+    for s in range(topo.shards):
+        for w in range(topo.workers):
+            spawn(f"worker{s}.{w}", "workernode", topo.worker_port(s, w),
+                  "--shard", str(s), "--index", str(w))
+        for d in range(topo.dispatchers):
+            spawn(f"dispatcher{s}.{d}", "dispatchernode",
+                  topo.dispatcher_port(s, d),
+                  "--shard", str(s), "--index", str(d))
+    for g in range(topo.gateways):
+        spawn(f"gateway{g}", "gatewaynode", topo.gateway_port(g),
+              "--index", str(g))
+    spawn("balancer", "balancer", topo.balancer_port())
+    for name in list(sup.children):
+        sup.wait_healthy(name)
+
+
+def _spawn_loadgens(topo: Topology, sup: Supervisor) -> list[str]:
+    names = []
+    for i in range(topo.loadgens):
+        name = f"loadgen{i}"
+        sup.spawn(name,
+                  python_argv("ai4e_tpu.rig", "loadgen", "--spec",
+                              topo.spec_path(), "--index", str(i)),
+                  log_path=os.path.join(topo.workdir, f"{name}.log"))
+        # Run-to-completion child: exiting is its JOB — the crash-loop
+        # monitor must neither restart nor count it.
+        sup.expect_death(name)
+        names.append(name)
+    return names
+
+
+async def _await_loadgens(topo: Topology, sup: Supervisor,
+                          names: list[str]) -> None:
+    """Wait for every loadgen to exit — ramp + window + the bounded
+    terminal drain, plus startup/flush headroom."""
+    deadline = time.monotonic() + (topo.ramp + topo.duration
+                                   + topo.task_timeout + 90.0)
+    while time.monotonic() < deadline:
+        if all(not sup.children[n].alive() for n in names):
+            return
+        # One monitor pass per second: restart crashed platform children
+        # (bounded), raise on a crash-loop. Chaos kills and loadgen exits
+        # are marked expected and skipped.
+        restarted = sup.check()
+        if restarted:
+            log.warning("monitor restarted: %s", restarted)
+        await asyncio.sleep(1.0)
+    raise TimeoutError("loadgens did not finish inside their budget")
+
+
+async def _drain_backlogs(topo: Topology, timeout: float) -> dict:
+    """Poll every live shard node's ``/v1/taskstore/depths`` until no
+    non-terminal work remains (or ``timeout``). Returns what was left."""
+    import urllib.request
+
+    def backlog() -> int:
+        remaining = 0
+        for s in range(topo.shards):
+            for base in topo.shard_urls(s):
+                try:
+                    with urllib.request.urlopen(
+                            base + "/v1/taskstore/depths",
+                            timeout=5) as resp:
+                        depths = json.loads(resp.read())
+                except OSError:
+                    continue  # dead node (chaos) — its replica answers
+                remaining += sum(
+                    counts.get("created", 0) + counts.get("running", 0)
+                    for counts in depths.values())
+                break  # one live node per shard is authoritative
+        return remaining
+
+    deadline = time.monotonic() + timeout
+    left = await asyncio.to_thread(backlog)
+    while left > 0 and time.monotonic() < deadline:
+        await asyncio.sleep(2.0)
+        left = await asyncio.to_thread(backlog)
+    return {"drained": left == 0, "left": left}
+
+
+async def run_rig(topo: Topology, out_dir: str | None = None) -> dict:
+    os.makedirs(topo.workdir, exist_ok=True)
+    # A stale run's journals/windows would contaminate the verdict.
+    for pattern in ("*.jsonl", "*.jsonl.replica*", "loadgen-*.json",
+                    "*.log", "*.salvage.json"):
+        for path in glob.glob(os.path.join(topo.workdir, pattern)):
+            os.unlink(path)
+    topo.save(topo.spec_path())
+
+    started_at = time.time()
+    events = rig_chaos.build_timeline(topo) if topo.chaos else []
+    result: dict = {"topology": topo.to_dict(), "started_at": started_at,
+                    "chaos": events}
+    with Supervisor(host=topo.host) as sup:
+        _spawn_topology(topo, sup)
+        log.info("topology up: %d processes", len(sup.children))
+        names = _spawn_loadgens(topo, sup)
+        window_opens_at = time.time() + topo.ramp
+        chaos_task = None
+        if events:
+            chaos_task = asyncio.get_running_loop().create_task(
+                rig_chaos.run_timeline(topo, sup, events, window_opens_at))
+        try:
+            await _await_loadgens(topo, sup, names)
+        finally:
+            if chaos_task is not None:
+                chaos_task.cancel()
+                try:
+                    await chaos_task
+                except asyncio.CancelledError:
+                    pass
+        # Backlog drain: an accepted task's invariant is "eventually
+        # terminal", and on a CPU-bound box the queues legitimately
+        # outlive the loadgens. Wait (bounded) for every shard's created
+        # backlog to hit zero BEFORE teardown, so the journals carry each
+        # promise's resolution — a drain that times out leaves the stuck
+        # tasks to the verdict, which is exactly what should fail then.
+        result["drain"] = await _drain_backlogs(
+            topo, timeout=float(topo.extra.get("drain_timeout_s", 120.0)))
+        # Scrape while the survivors are still up; chaos-killed processes
+        # are recorded as unreachable, which is itself evidence.
+        result["metrics"] = rig_verdict.scrape_and_merge(
+            rig_verdict.metrics_urls(topo))
+        loadgen_failures = [n for n in names
+                            if sup.children[n].proc.returncode]
+        result["loadgen_failures"] = loadgen_failures
+    # Journals are scanned AFTER teardown: no writer left, every lineage
+    # at its final byte.
+    result["verdict"] = rig_verdict.compute_verdict(topo)
+    result["finished_at"] = time.time()
+    result["ok"] = bool(result["verdict"]["ok"] and not loadgen_failures)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        out_path = os.path.join(out_dir, "rig.json")
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(result, fh, indent=1)
+        log.info("rig artifact written to %s", out_path)
+    return result
+
+
+def summarize(result: dict) -> str:
+    v = result["verdict"]
+    offered = sum(w["window"]["offered_rate"] for w in v["windows"]
+                  if w.get("window"))
+    achieved = sum(w["window"]["achieved_rate"] for w in v["windows"]
+                   if w.get("window"))
+    lines = [
+        f"rig {'OK' if result['ok'] else 'VIOLATED'}: "
+        f"offered {offered:.0f}/s achieved {achieved:.0f}/s, "
+        f"{v['accepted']} accepted, {v['terminal']} terminal, "
+        f"{v['duplicates']} duplicate completions, "
+        f"{v['violation_count']} violations"]
+    for s, meta in sorted(v["per_shard"].items()):
+        lines.append(
+            f"  shard {s}: accepted={meta['accepted']} "
+            f"terminal={meta['terminal']} dup={meta['duplicates']} "
+            f"epochs={meta['epochs']} "
+            f"{'promoted' if meta['promoted'] else 'primary held'} "
+            f"(monotonic={meta['epochs_strictly_monotonic']})")
+    for event in result.get("chaos", ()):
+        lines.append(f"  chaos @+{event['at']}s {event['verb']} "
+                     f"{'ok' if event.get('ok') else 'FAILED'}")
+    return "\n".join(lines)

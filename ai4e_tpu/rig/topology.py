@@ -1,0 +1,161 @@
+"""Topology spec — the one document every rig process derives itself from.
+
+The driver resolves counts + the port layout once, writes the spec to
+``<workdir>/topology.json``, and launches every child as
+``python -m ai4e_tpu.rig <role> --spec <file> --shard i --index j``. A
+child never guesses a peer's address: gateways compute the shard store
+URL lists (primary first, then replicas — the rotation order every wire
+client uses), dispatchers compute their shard's worker URLs, the
+balancer computes the gateway URLs. Deterministic ports also make the
+teardown verifiable: the supervisor can prove nothing it owns still
+listens.
+
+Port layout (``base_port`` from ``--base-port`` or ``AI4E_RIG_BASE_PORT``,
+default 18800; all on ``host``):
+
+- balancer:          base
+- gateway g:         base + 1 + g
+- shard s primary:   base + 20 + s
+- shard s replica r: base + 40 + s * replicas_max + r
+- dispatcher d of s: base + 60 + s * dispatchers_max + d  (health/metrics)
+- worker w of s:     base + 80 + s * workers_max + w
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+
+ECHO_ROUTE = "/v1/echo/run-async"
+
+# Sub-range strides: bounded so layouts stay stable as counts vary.
+_REPLICAS_MAX = 4
+_DISPATCHERS_MAX = 4
+_WORKERS_MAX = 4
+
+
+@dataclass
+class Topology:
+    gateways: int = 3
+    shards: int = 2
+    replicas: int = 1          # per shard
+    dispatchers: int = 1       # per shard (separate OS processes)
+    workers: int = 1           # per shard (CPU echo processes)
+    loadgens: int = 2
+    slots: int = 16            # hash-slot table size (stable_hash % slots)
+    rate: float = 10000.0      # offered req/s, total across loadgens
+    duration: float = 30.0     # measured window per loadgen (s)
+    ramp: float = 3.0
+    max_inflight: int = 512    # per loadgen process
+    task_timeout: float = 60.0
+    poll_wait: float = 20.0
+    dispatcher_concurrency: int = 8
+    lease_seconds: float = 5.0   # short: a killed dispatcher's leases must
+                                 # redeliver within the run, not in 5 min
+    retry_delay: float = 0.2
+    work_ms: float = 0.0       # artificial per-request worker time
+    chaos: bool = True
+    seed: int = 20260803
+    host: str = "127.0.0.1"
+    base_port: int = 18800
+    workdir: str = "/tmp/ai4e-rig"
+    route: str = ECHO_ROUTE
+    payload_bytes: int = 64
+    extra: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.gateways < 1 or self.shards < 1:
+            raise ValueError("topology needs >= 1 gateway and >= 1 shard")
+        if not (1 <= self.replicas <= _REPLICAS_MAX):
+            raise ValueError(f"replicas must be 1..{_REPLICAS_MAX}")
+        if not (1 <= self.dispatchers <= _DISPATCHERS_MAX):
+            raise ValueError(f"dispatchers must be 1..{_DISPATCHERS_MAX}")
+        if not (1 <= self.workers <= _WORKERS_MAX):
+            raise ValueError(f"workers must be 1..{_WORKERS_MAX}")
+        if self.slots < self.shards:
+            raise ValueError("slots must be >= shards")
+
+    # -- ports/urls ---------------------------------------------------------
+
+    def balancer_port(self) -> int:
+        return self.base_port
+
+    def gateway_port(self, g: int) -> int:
+        return self.base_port + 1 + g
+
+    def shard_port(self, s: int) -> int:
+        return self.base_port + 20 + s
+
+    def replica_port(self, s: int, r: int) -> int:
+        return self.base_port + 40 + s * _REPLICAS_MAX + r
+
+    def dispatcher_port(self, s: int, d: int) -> int:
+        return self.base_port + 60 + s * _DISPATCHERS_MAX + d
+
+    def worker_port(self, s: int, w: int) -> int:
+        return self.base_port + 80 + s * _WORKERS_MAX + w
+
+    def _url(self, port: int) -> str:
+        return f"http://{self.host}:{port}"
+
+    def balancer_url(self) -> str:
+        return self._url(self.balancer_port())
+
+    def gateway_urls(self) -> list[str]:
+        return [self._url(self.gateway_port(g)) for g in range(self.gateways)]
+
+    def shard_urls(self, s: int) -> list[str]:
+        """Store URL list for shard ``s`` — primary FIRST, then replicas:
+        the rotation order every wire client (gateway, dispatcher, worker,
+        feed tail) walks on connect errors / 503-not-primary, which is
+        what re-homes the whole fleet onto a promoted replica."""
+        return [self._url(self.shard_port(s))] + [
+            self._url(self.replica_port(s, r)) for r in range(self.replicas)]
+
+    def all_shard_urls(self) -> list[list[str]]:
+        return [self.shard_urls(s) for s in range(self.shards)]
+
+    def worker_urls(self, s: int) -> list[str]:
+        return [self._url(self.worker_port(s, w)) + self.route
+                for w in range(self.workers)]
+
+    def journal_path(self, s: int) -> str:
+        return os.path.join(self.workdir, f"shard{s}.jsonl")
+
+    def replica_journal_path(self, s: int, r: int) -> str:
+        return os.path.join(self.workdir, f"shard{s}.replica{r}.jsonl")
+
+    def all_ports(self) -> list[int]:
+        ports = [self.balancer_port()]
+        ports += [self.gateway_port(g) for g in range(self.gateways)]
+        for s in range(self.shards):
+            ports.append(self.shard_port(s))
+            ports += [self.replica_port(s, r) for r in range(self.replicas)]
+            ports += [self.dispatcher_port(s, d)
+                      for d in range(self.dispatchers)]
+            ports += [self.worker_port(s, w) for w in range(self.workers)]
+        return ports
+
+    # -- (de)serialization --------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Topology":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(self.to_dict(), fh, indent=1)
+
+    @classmethod
+    def load(cls, path: str) -> "Topology":
+        with open(path, encoding="utf-8") as fh:
+            return cls.from_dict(json.load(fh))
+
+    def spec_path(self) -> str:
+        return os.path.join(self.workdir, "topology.json")

@@ -1,0 +1,108 @@
+"""CPU-echo worker PROCESS — the rig's backend tier.
+
+Deliberately the smallest honest backend: it receives the dispatcher's
+POST, burns ``work_ms`` of CPU when the topology asks for service time,
+stores the echoed payload as the task result and completes the task —
+**conditionally** (``update_task_status_if created → completed``), the
+remote-store-safe form of the terminal-clobber guard: a redelivered
+execution racing the original can never produce a second client-visible
+completion, which is exactly invariant 3 the chaos replay checks. All
+store writes go through ``RingStoreClient``, so a task whose slot moved
+mid-delivery lands its completion on the owning shard.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from aiohttp import web
+
+from ..metrics import MetricsRegistry
+from ..taskstore import TaskNotFound, TaskStatus
+from .topology import Topology
+from .wire import RingStoreClient
+
+log = logging.getLogger("ai4e_tpu.rig.worker")
+
+COMPLETED_STATUS = "completed by rig echo worker"
+
+
+class EchoWorker:
+    def __init__(self, topo: Topology, shard: int):
+        self.topo = topo
+        self.shard = shard
+        self.metrics = MetricsRegistry()
+        self.ring = RingStoreClient(topo.all_shard_urls(), slots=topo.slots)
+        self._served = self.metrics.counter(
+            "ai4e_rig_worker_requests_total",
+            "Echo-worker deliveries by outcome")
+        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self.app.router.add_get("/healthz", self._health)
+        self.app.router.add_get("/metrics", self._metrics)
+        route = topo.route.rstrip("/")
+        self.app.router.add_post(route, self._run)
+        self.app.router.add_post(route + "/{tail:.*}", self._run)
+        self.app.on_cleanup.append(self._cleanup)
+
+    async def _health(self, _: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy", "shard": self.shard})
+
+    async def _metrics(self, _: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render_prometheus(),
+                            content_type="text/plain")
+
+    async def _cleanup(self, _app) -> None:
+        await self.ring.aclose()
+
+    async def _run(self, request: web.Request) -> web.Response:
+        task_id = request.headers.get("taskId", "")
+        body = await request.read()
+        if not task_id:
+            return web.json_response({"error": "taskId header required"},
+                                     status=400)
+        if self.topo.work_ms > 0:
+            # Real CPU burn off the event loop — service time that actually
+            # contends for the core, not a sleep that hides it.
+            await asyncio.to_thread(self._burn, self.topo.work_ms / 1000.0)
+        try:
+            await self.ring.set_result(
+                task_id, body or b"{}",
+                content_type=request.content_type or "application/json")
+        except TaskNotFound:
+            # Unknown to every shard RIGHT NOW. That is either a truly
+            # evicted task (no promise left) or a moved task mid-handoff
+            # whose copy window outlasted the ring client's patience — a
+            # 200 here would let the dispatcher complete the message and
+            # strand the latter forever. 503 instead: the broker
+            # redelivers with backoff, landing after the flip; a real
+            # ghost exhausts its delivery budget and is dropped.
+            self._served.inc(outcome="unknown_task")
+            return web.json_response(
+                {"ok": False, "reason": "unknown task"}, status=503,
+                headers={"Retry-After": "1"})
+        updated = await self.ring.update_task_status_if(
+            task_id, TaskStatus.CREATED, COMPLETED_STATUS,
+            TaskStatus.COMPLETED)
+        if updated is None:
+            # Already terminal — a duplicate delivery's write must NOT
+            # land (invariant 3). The 200 still completes the message.
+            self._served.inc(outcome="duplicate")
+            return web.json_response({"ok": True, "duplicate": True})
+        self._served.inc(outcome="completed")
+        return web.json_response({"ok": True, "TaskId": task_id})
+
+    @staticmethod
+    def _burn(seconds: float) -> None:
+        deadline = time.perf_counter() + seconds
+        x = 0
+        while time.perf_counter() < deadline:
+            x += 1
+
+
+async def run_workernode(topo: Topology, shard: int, index: int) -> None:
+    from .supervisor import serve_until_signal
+    worker = EchoWorker(topo, shard)
+    await serve_until_signal(worker.app, topo.host,
+                             topo.worker_port(shard, index))

@@ -47,6 +47,18 @@ class TaskManagerBase:
                                  backend_status: str | None = None) -> dict:
         return await self._update(task_id, status, backend_status)
 
+    async def update_task_status_if(self, task_id: str,
+                                    expected_status: str, status: str,
+                                    backend_status: str | None = None
+                                    ) -> dict | None:
+        """Conditional transition: apply iff the task's canonical status is
+        still ``expected_status``; None when the precondition failed (a
+        concurrent path already transitioned it — the caller's write is a
+        duplicate and must not land). This is the remote-store-safe form
+        of the terminal-clobber guard (docs/concurrency.md): the condition
+        is evaluated under the store's lock, not across a network hop."""
+        raise NotImplementedError
+
     async def complete_task(self, task_id: str, status: str = "completed") -> dict:
         return await self._update(task_id, status, TaskStatus.COMPLETED)
 
@@ -124,6 +136,14 @@ class LocalTaskManager(TaskManagerBase):
     async def _update(self, task_id: str, status: str,
                       backend_status: str | None = None) -> dict:
         return self.store.update_status(task_id, status, backend_status).to_dict()
+
+    async def update_task_status_if(self, task_id: str,
+                                    expected_status: str, status: str,
+                                    backend_status: str | None = None
+                                    ) -> dict | None:
+        task = self.store.update_status_if(task_id, expected_status, status,
+                                           backend_status)
+        return None if task is None else task.to_dict()
 
     async def append_ledger(self, task_id: str, events: list[dict]) -> int:
         append = getattr(self.store, "append_ledger", None)
@@ -272,6 +292,29 @@ class HttpTaskManager(_HttpStoreClient, TaskManagerBase):
         resp.raise_for_status()
         if resp.status != 200:  # 204 = task unknown to the store
             raise KeyError(f"task not found: {task_id}")
+        return json.loads(body)
+
+    async def update_task_status_if(self, task_id: str,
+                                    expected_status: str, status: str,
+                                    backend_status: str | None = None
+                                    ) -> dict | None:
+        """Conditional wire transition — ``ExpectedStatus`` evaluates under
+        the STORE's lock (``POST /v1/taskstore/update``), closing the
+        probe-then-write residual window a remote writer otherwise carries
+        (docs/concurrency.md). 409 (precondition failed) and 204 (task
+        unknown/evicted) both answer None: either way this writer's
+        transition must not land."""
+        payload = {
+            "TaskId": task_id,
+            "Status": status,
+            "BackendStatus": backend_status or TaskStatus.canonical(status),
+            "ExpectedStatus": expected_status,
+        }
+        resp, body = await self._request("POST", "/v1/taskstore/update",
+                                         data=json.dumps(payload))
+        if resp.status in (409, 204):
+            return None
+        resp.raise_for_status()
         return json.loads(body)
 
     async def append_ledger(self, task_id: str, events: list[dict]) -> int:

@@ -48,8 +48,8 @@ import os
 
 from aiohttp import web
 
-from .store import (InMemoryTaskStore, JournalDegradedError, NotPrimaryError,
-                    StaleEpochError, TaskNotFound)
+from .store import (InMemoryTaskStore, JournalDegradedError, NotOwnerError,
+                    NotPrimaryError, StaleEpochError, TaskNotFound)
 from .task import APITask, TaskStatus
 
 
@@ -119,6 +119,17 @@ def make_app(store: InMemoryTaskStore,
         return web.json_response({"error": "not primary"}, status=503,
                                  headers={"X-Not-Primary": "1"})
 
+    def not_owner(exc: NotOwnerError) -> web.Response:
+        # Keyspace-range fence (a live slot move in the multi-process rig,
+        # or any write-fenced store): the verb is valid, THIS store no
+        # longer owns the TaskId's slot. 409 + X-Not-Owner tells ring
+        # clients to re-fetch the fence table and re-route — the wire
+        # analogue of the sharded facade's NotOwnerError re-route
+        # (ai4e_tpu/rig/wire.py RingStoreClient).
+        return web.json_response({"error": f"not owner: {exc}"},
+                                 status=409,
+                                 headers={"X-Not-Owner": "1"})
+
     def journal_degraded(exc: JournalDegradedError) -> web.Response:
         # Disk fault flipped the store to read-only degraded mode
         # (docs/durability.md#degraded-mode): mutations refuse with a
@@ -162,6 +173,8 @@ def make_app(store: InMemoryTaskStore,
             task = store.upsert(task)
         except ValueError as exc:  # reserved characters in a supplied TaskId
             return web.json_response({"error": str(exc)}, status=400)
+        except NotOwnerError as exc:
+            return not_owner(exc)
         except NotPrimaryError:
             return not_primary()
         except JournalDegradedError as exc:
@@ -180,10 +193,34 @@ def make_app(store: InMemoryTaskStore,
         status = payload.get("Status", "")
         if not task_id or not status:
             return web.json_response({"error": "TaskId and Status required"}, status=400)
+        expected = payload.get("ExpectedStatus")
         try:
-            task = store.update_status(task_id, status, payload.get("BackendStatus"))
+            if expected:
+                # Conditional transition (``update_status_if``): the wire
+                # form of the suspension-point atomicity contract
+                # (docs/concurrency.md) — a remote worker completing a
+                # task over this surface would otherwise only have the
+                # reachably-racy probe-then-write shape; the condition
+                # evaluates under the store lock instead. 409 = the
+                # precondition no longer holds (typically a concurrent
+                # duplicate already transitioned the task).
+                task = store.update_status_if(task_id, expected, status,
+                                              payload.get("BackendStatus"))
+                if task is None:
+                    try:
+                        current = store.get(task_id).status
+                    except TaskNotFound:
+                        return web.Response(status=204)
+                    return web.json_response(
+                        {"error": "status precondition failed",
+                         "Status": current}, status=409)
+            else:
+                task = store.update_status(task_id, status,
+                                           payload.get("BackendStatus"))
         except TaskNotFound:
             return web.Response(status=204)
+        except NotOwnerError as exc:
+            return not_owner(exc)
         except NotPrimaryError:
             return not_primary()
         except JournalDegradedError as exc:
@@ -247,6 +284,8 @@ def make_app(store: InMemoryTaskStore,
                         continue
                     if store.requeue_if(tid, "failed") is not None:
                         redriven.append(tid)
+        except NotOwnerError as exc:
+            return not_owner(exc)
         except NotPrimaryError:
             return not_primary()
         except JournalDegradedError as exc:
@@ -284,6 +323,8 @@ def make_app(store: InMemoryTaskStore,
             # treats 2xx as "stored".
             return web.json_response({"error": f"unknown task {task_id}"},
                                      status=404)
+        except NotOwnerError as exc:
+            return not_owner(exc)
         except NotPrimaryError:
             return not_primary()
         except JournalDegradedError as exc:
@@ -368,6 +409,8 @@ def make_app(store: InMemoryTaskStore,
             # worker; 409 so the worker fails loudly instead of serving a
             # dangling pointer.
             return web.json_response({"error": str(exc)}, status=409)
+        except NotOwnerError as exc:
+            return not_owner(exc)
         except NotPrimaryError:
             return not_primary()
         except JournalDegradedError as exc:
@@ -405,6 +448,8 @@ def make_app(store: InMemoryTaskStore,
         except TaskNotFound:
             return web.json_response({"error": f"unknown task {task_id}"},
                                      status=404)
+        except NotOwnerError as exc:
+            return not_owner(exc)
         except NotPrimaryError:
             return not_primary()
         except JournalDegradedError as exc:

@@ -136,11 +136,37 @@ class ShardReplicaLink:
     (which outlives the primary: it is the shard's durable truth) instead
     of the HTTP stream. Same consume-whole-lines rule, same generation
     resync contract (a compaction rewrite restarts the reader at offset 0
-    of what is then a full snapshot)."""
+    of what is then a full snapshot).
 
-    def __init__(self, group: "ShardGroup", standby: FollowerTaskStore):
+    **Wire mode** (``primary_url=``): the same link absorbing the same
+    protocol over the socket — ``GET /v1/taskstore/journal`` with the
+    offset/generation/limit contract ``replication.py`` defines — for a
+    standby living in a DIFFERENT process than its shard primary (the
+    multi-process rig, ``ai4e_tpu/rig/``). Checksum/chain verification,
+    the corrupt-line park, and the generation resync behave identically
+    to file mode; what changes is reach (any host) and the failover
+    drain (a dead primary's HTTP stream is unreachable, so a same-host
+    rig drains the journal *file* instead — ``absorb_journal_file``).
+    Fetches are synchronous (urllib) by design: ``sync_once`` is sync
+    absorb work and event-loop callers already wrap it in
+    ``asyncio.to_thread``."""
+
+    def __init__(self, group: "ShardGroup | None", standby: FollowerTaskStore,
+                 primary_url: str | None = None, api_key: str | None = None,
+                 wire_timeout: float = 10.0,
+                 chunk_limit: int = 4 * 1024 * 1024):
+        if group is None and primary_url is None:
+            raise ValueError("a ShardReplicaLink needs a group (file mode) "
+                             "or a primary_url (wire mode)")
         self.group = group
         self.standby = standby
+        self.primary_url = primary_url.rstrip("/") if primary_url else None
+        self._wire_headers = ({"Ocp-Apim-Subscription-Key": api_key}
+                              if api_key else {})
+        self._wire_timeout = wire_timeout
+        self._chunk_limit = chunk_limit
+        # For log lines in wire mode (no group to name the shard).
+        self.shard_index = group.index if group is not None else -1
         self.generation = -1
         self.offset = 0
         self._buffer = b""
@@ -162,7 +188,95 @@ class ShardReplicaLink:
         up). Synchronous file work — callers on an event loop wrap it in
         ``asyncio.to_thread`` (the replicator absorbs the same way)."""
         with self._sync_lock:
+            if self.primary_url is not None:
+                return self._sync_once_wire()
             return self._sync_once_locked()
+
+    # -- wire mode ----------------------------------------------------------
+
+    def _fetch_wire(self, limit: int) -> tuple[int, int, int, bytes]:
+        """One journal-stream poll: ``(generation, served_from, size,
+        chunk)``. Raises ``OSError`` when the primary is unreachable (the
+        tail loop retries; a failover drain gives up and the rig falls
+        back to the journal file)."""
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        from .replication import JOURNAL_PATH
+        params = urllib.parse.urlencode({
+            "offset": str(self.offset),
+            "generation": str(self.generation),
+            "wait": "0",
+            "limit": str(limit),
+            # Fencing evidence, same as the HTTP replicator: a link that
+            # outlived a failover demotes the deposed primary it polls.
+            "epoch": str(self.standby.epoch)})
+        req = urllib.request.Request(
+            f"{self.primary_url}{JOURNAL_PATH}?{params}",
+            headers=self._wire_headers)
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self._wire_timeout) as resp:
+                gen = int(resp.headers.get("X-Journal-Generation", "0"))
+                served_from = int(resp.headers.get("X-Journal-Offset",
+                                                   str(self.offset)))
+                size = int(resp.headers.get("X-Journal-Size", "0"))
+                chunk = resp.read()
+        except urllib.error.HTTPError as exc:
+            raise OSError(
+                f"journal stream at {self.primary_url} answered "
+                f"HTTP {exc.code}") from exc
+        return gen, served_from, size, chunk
+
+    def _sync_once_wire(self) -> int:
+        parked = self._corrupt_at == (self.generation, self.offset)
+        # While parked, probe with a 1-byte limit: the only thing that can
+        # clear a park is a generation bump (compaction rewrote the bytes),
+        # and re-reading the primary's ever-growing unabsorbed suffix every
+        # poll is the cost the file mode's pre-open check avoids.
+        gen, served_from, size, chunk = self._fetch_wire(
+            1 if parked else self._chunk_limit)
+        if gen != self.generation or served_from != self.offset:
+            if served_from != 0:
+                # The server restarts mismatched readers at 0; anything
+                # else is a contract violation (replication.py).
+                raise OSError(
+                    f"journal reset served from offset {served_from}")
+            if self.generation != -1:
+                log.info("shard %d wire replica: journal generation "
+                         "%d -> %d; resyncing", self.shard_index,
+                         self.generation, gen)
+            self.standby.reset()
+            self._buffer = b""
+            self.generation = gen
+            self.offset = 0
+            self._corrupt_at = None
+            if parked and size > len(chunk):
+                # A parked probe's 1-byte limit truncated the resync
+                # chunk; drop it and let the next poll read full-width.
+                chunk = b""
+            parked = False
+        if parked or not chunk:
+            return 0
+        lines, self._buffer = split_complete_lines(self._buffer + chunk)
+        if lines:
+            try:
+                self.standby.absorb_lines(lines)
+            except JournalCorruptError as exc:
+                self._corrupt_at = (self.generation, self.offset)
+                self._buffer = b""
+                log.error(
+                    "shard %d wire replica: journal line failed "
+                    "verification at ~offset %d of %s (%s); replica parks "
+                    "on the verified prefix until the journal is repaired "
+                    "or compacted (docs/durability.md)", self.shard_index,
+                    self.offset, self.primary_url, exc)
+                return 0
+        self.offset += len(chunk)
+        return len(chunk)
+
+    # -- file mode ----------------------------------------------------------
 
     def _sync_once_locked(self) -> int:
         primary = self.group.primary
@@ -232,6 +346,32 @@ class ShardReplicaLink:
         returned), so reading to EOF yields its exact final state."""
         while self.sync_once():
             pass
+
+
+def absorb_journal_file(standby: FollowerTaskStore, path: str) -> int:
+    """Full resync of ``standby`` from a journal FILE — the failover drain
+    a wire-mode replica runs when its shard primary is DEAD: the HTTP
+    stream died with the process, but the journal file is the shard's
+    durable truth and (on a shared filesystem — the rig's one-host case)
+    still holds every acknowledged write. Reset-and-replay from offset 0
+    is always correct, exactly the HTTP replicator's reconnect contract:
+    the wire link's byte offset belongs to a generation the reader can no
+    longer verify against a live server, so no tail-continuation is
+    attempted. Whole lines only — an unterminated torn tail is left
+    behind, torn-tail semantics. Returns lines absorbed. A
+    ``JournalCorruptError`` mid-file leaves the verified prefix applied
+    and re-raises: the caller decides whether to promote on the prefix
+    (the park contract) or refuse."""
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except FileNotFoundError:
+        return 0
+    lines, _tail = split_complete_lines(data)
+    standby.reset()
+    if lines:
+        standby.absorb_lines(lines)
+    return len(lines)
 
 
 class ShardGroup:

@@ -1,138 +1,17 @@
 #!/bin/bash
 # Soak the production topology: control plane + worker as separate OS
 # processes under sustained async load, watching for the failure modes a
-# 20 s bench can't see — RSS creep (leaked sessions/buffers/tasks), journal
-# bloat beyond compaction, task failures appearing only after thousands of
-# cycles. The suite proves correctness per-operation; this proves the
-# platform HOLDS for `--minutes` of continuous traffic.
+# 20 s bench can't see — RSS creep, journal bloat, late-appearing task
+# failures. CLI contract unchanged:
 #
-# Usage: scripts/soak.sh [minutes] [outdir]     (defaults: 10, /tmp/soak)
-# Exits non-zero if any loadgen window records failures or either process
-# dies; prints one JSON summary line (rss samples, per-window throughput).
+#   scripts/soak.sh [minutes] [outdir]     (defaults: 10, /tmp/soak)
+#
+# The body moved into the rig's supervision module (ISSUE 11): the
+# port-wait/SIGKILL escalation ladder, health-gated spawns, and the
+# trap-kill teardown this script used to hand-roll in bash are now
+# `ai4e_tpu.rig.supervisor` — shared with the multi-process rig and
+# covered by its tests. This wrapper only keeps the CLI stable.
 set -u
 cd "$(dirname "$0")/.."
-MINUTES="${1:-10}"
-OUT="${2:-/tmp/soak}"
-mkdir -p "$OUT"
 export PYTHONPATH="$PWD:${PYTHONPATH:-}"
-export AI4E_RUNTIME_PLATFORM=cpu
-export AI4E_PLATFORM_RETRY_DELAY=0.2
-
-CP_PORT=18889
-WK_PORT=18890
-
-# A previous soak's control plane can outlive its SIGTERM by minutes if it
-# was wedged in store work when the trap fired (the signal lands when the
-# event loop breathes) — wait for the ports, then escalate to SIGKILL on
-# whatever still holds them.
-for port in "$CP_PORT" "$WK_PORT"; do
-    for _ in $(seq 1 30); do
-        ss -tln 2>/dev/null | grep -q ":${port} " || break
-        sleep 2
-    done
-    ss -tlnp 2>/dev/null | grep ":${port} " | grep -oP 'pid=\K[0-9]+' \
-        | head -1 | xargs -r kill -9
-done
-
-cat > "$OUT/routes.json" <<EOF
-{"apis": [{"prefix": "/v1/echo/run-async",
-           "backend": "http://127.0.0.1:${WK_PORT}/v1/echo/run-async",
-           "concurrency": 4, "retry_delay": 0.2}]}
-EOF
-cat > "$OUT/models.json" <<EOF
-{"service_name": "soak-echo", "prefix": "v1/echo", "taskstore": "http://127.0.0.1:${CP_PORT}",
- "models": [{"family": "echo", "name": "echo", "size": 16, "buckets": [8],
-             "async_path": "/run-async"}]}
-EOF
-python - <<'PY'
-import io
-import numpy as np
-buf = io.BytesIO()
-np.save(buf, np.arange(16, dtype=np.float32))
-open("/tmp/soak_payload.npy", "wb").write(buf.getvalue())
-PY
-
-AI4E_PLATFORM_JOURNAL_PATH="$OUT/tasks.jsonl" \
-    python -m ai4e_tpu control-plane --routes "$OUT/routes.json" \
-    --port "$CP_PORT" > "$OUT/cp.log" 2>&1 &
-CP_PID=$!
-python -m ai4e_tpu worker --models "$OUT/models.json" \
-    --port "$WK_PORT" > "$OUT/wk.log" 2>&1 &
-WK_PID=$!
-trap 'kill $CP_PID $WK_PID 2>/dev/null; sleep 3; kill -9 $CP_PID $WK_PID 2>/dev/null' EXIT
-
-for _ in $(seq 1 120); do
-    curl -sf "http://127.0.0.1:${CP_PORT}/healthz" >/dev/null 2>&1 && break
-    sleep 1
-done
-for _ in $(seq 1 180); do
-    curl -sf "http://127.0.0.1:${WK_PORT}/v1/echo/" >/dev/null 2>&1 && break
-    sleep 1
-done
-
-python - "$MINUTES" "$CP_PID" "$WK_PID" "$CP_PORT" "$OUT" <<'PY'
-import json
-import subprocess
-import sys
-import time
-
-minutes, cp_pid, wk_pid, cp_port, out = (
-    float(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4], sys.argv[5])
-
-
-def rss_mb(pid: str) -> float:
-    try:
-        kb = open(f"/proc/{pid}/status").read().split("VmRSS:")[1].split()[0]
-        return round(int(kb) / 1024.0, 1)
-    except (OSError, IndexError):
-        return -1.0  # process died
-
-
-windows, rss = [], []
-deadline = time.time() + minutes * 60
-failures = 0
-while time.time() < deadline:
-    run = subprocess.run(
-        [sys.executable, "examples/loadgen.py",
-         "--gateway", f"http://127.0.0.1:{cp_port}",
-         "--path", "/v1/echo/run-async",
-         "--payload", "/tmp/soak_payload.npy",
-         "--mode", "async", "--concurrency", "32",
-         "--duration", "30", "--ramp", "2"],
-        capture_output=True, text=True, timeout=300)
-    line = run.stdout.strip().splitlines()[-1] if run.stdout.strip() else "{}"
-    try:
-        rec = json.loads(line)
-    except json.JSONDecodeError:
-        rec = {"error": line[:200]}
-    rec["cp_rss_mb"], rec["wk_rss_mb"] = rss_mb(cp_pid), rss_mb(wk_pid)
-    windows.append(rec)
-    rss.append((rec["cp_rss_mb"], rec["wk_rss_mb"]))
-    failures += int(rec.get("failed", 0) or 0)
-    if rec["cp_rss_mb"] < 0 or rec["wk_rss_mb"] < 0:
-        break
-    print(json.dumps(rec), flush=True)
-
-summary = {
-    "soak_minutes": minutes,
-    "windows": len(windows),
-    "total_completed": sum(int(w.get("completed", 0) or 0) for w in windows),
-    "total_failed": failures,
-    "throughput_first": windows[0].get("value") if windows else None,
-    "throughput_last": windows[-1].get("value") if windows else None,
-    "cp_rss_first_mb": rss[0][0] if rss else None,
-    "cp_rss_last_mb": rss[-1][0] if rss else None,
-    "wk_rss_first_mb": rss[0][1] if rss else None,
-    "wk_rss_last_mb": rss[-1][1] if rss else None,
-    "process_death": any(a < 0 or b < 0 for a, b in rss),
-}
-print(json.dumps(summary), flush=True)
-with open(f"{out}/soak_summary.json", "w") as f:
-    json.dump({"summary": summary, "windows": windows}, f, indent=1)
-ok = (not summary["process_death"] and failures == 0
-      and summary["windows"] > 0)
-sys.exit(0 if ok else 1)
-PY
-STATUS=$?
-echo "soak exit: $STATUS" >&2
-exit $STATUS
+exec python -m ai4e_tpu.rig soak --minutes "${1:-10}" --out "${2:-/tmp/soak}"

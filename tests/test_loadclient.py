@@ -174,3 +174,119 @@ class TestThrottleBackpressure:
         window = run(main())
         assert window["completed"] > 0
         assert window["failed"] == 0  # throttling is not failure
+
+
+class TestLoadgenHonesty:
+    """ISSUE 11 satellite: the window JSON must record OFFERED vs ACHIEVED
+    rate and a client-side error taxonomy, so a CPU-bound run can't
+    silently report a lower rate as if it were the target."""
+
+    def test_closed_loop_reports_offered_and_error_taxonomy(self):
+        outcomes = itertools.cycle([200, 500])
+
+        async def main():
+            async def handler(request):
+                status = next(outcomes)
+                if status == 500:
+                    return web.Response(status=500, text="boom")
+                return web.json_response({"ok": True})
+
+            app = web.Application()
+            app.router.add_post("/v1/echo", handler)
+            runner, port = await _serve(app)
+            try:
+                async with ClientSession(
+                        connector=TCPConnector(limit=0)) as session:
+                    window = await run_closed_loop(
+                        session, post_url=f"http://127.0.0.1:{port}/v1/echo",
+                        payload=b"x", headers={}, mode="sync",
+                        concurrency=4, duration=0.6, ramp=0.2)
+            finally:
+                await runner.cleanup()
+            # Offered counts every attempt; achieved only completions —
+            # with every other request a 500, offered ≈ 2× completed.
+            assert window["offered"] >= window["completed"]
+            assert window["offered_rate"] >= window["achieved_rate"]
+            assert window["client_errors"].get("http_500", 0) > 0
+            assert window["achieved_rate"] == window["value"]
+
+        run(main())
+
+    def test_open_loop_offers_the_target_rate_and_reports_saturation(self):
+        """The open loop schedules starts by the clock: a slow platform
+        still sees the target offered rate, and starts the client could
+        not even launch (max_inflight) are recorded as client_saturated
+        — never silently dropped."""
+        from ai4e_tpu.utils.loadclient import run_open_loop
+
+        async def main():
+            accepted, terminal = [], []
+
+            async def post(request):
+                return web.json_response({"TaskId": "t-%d" % len(accepted)})
+
+            async def poll(request):
+                # Answer terminal instantly — the pacing under test is
+                # the POST schedule, not the platform.
+                return web.json_response({"Status": "completed"})
+
+            app = web.Application()
+            app.router.add_post("/v1/echo", post)
+            app.router.add_get("/v1/task/{tid}", poll)
+            runner, port = await _serve(app)
+            base = f"http://127.0.0.1:{port}"
+            try:
+                async with ClientSession(
+                        connector=TCPConnector(limit=0)) as session:
+                    window = await run_open_loop(
+                        session, post_url=f"{base}/v1/echo", payload=b"x",
+                        headers={}, rate=200.0,
+                        status_url_for=lambda t: f"{base}/v1/task/{t}",
+                        duration=1.0, ramp=0.3, max_inflight=64,
+                        on_accepted=accepted.append,
+                        on_terminal=lambda t, s: terminal.append((t, s)))
+            finally:
+                await runner.cleanup()
+            # The offered rate tracks the target (clock-scheduled), within
+            # scheduler slack on a busy box.
+            assert window["offered_rate"] > 100.0
+            assert window["target_rate"] == 200.0
+            assert window["total_offered"] >= window["total_launched"]
+            assert len(accepted) == window["total_launched"]
+            assert len(terminal) >= window["total_completed"]
+
+        run(main())
+
+    def test_open_loop_client_saturation_is_taxonomized(self):
+        from ai4e_tpu.utils.loadclient import run_open_loop
+
+        async def main():
+            async def post(request):
+                return web.json_response({"TaskId": "t"})
+
+            async def poll(request):
+                await asyncio.sleep(2.0)  # tasks outlive the client budget
+                return web.json_response({"Status": "created"})
+
+            app = web.Application()
+            app.router.add_post("/v1/echo", post)
+            app.router.add_get("/v1/task/{tid}", poll)
+            runner, port = await _serve(app)
+            base = f"http://127.0.0.1:{port}"
+            try:
+                async with ClientSession(
+                        connector=TCPConnector(limit=0)) as session:
+                    window = await run_open_loop(
+                        session, post_url=f"{base}/v1/echo", payload=b"x",
+                        headers={}, rate=300.0,
+                        status_url_for=lambda t: f"{base}/v1/task/{t}",
+                        duration=0.8, ramp=0.2, max_inflight=4,
+                        task_timeout=0.5)
+            finally:
+                await runner.cleanup()
+            # 4 pollers wedge instantly; every further offered start is
+            # recorded against the CLIENT, not hidden.
+            assert window["total_errors"].get("client_saturated", 0) > 0
+            assert window["total_offered"] > window["total_launched"]
+
+        run(main())

@@ -94,7 +94,8 @@ class TestGatewayLongPoll:
                 body = await resp.json()
                 assert body["Status"] == "created"
                 assert 0.15 <= waited < 2.0
-                assert platform.gateway._waiters == {}  # waiter cleaned up
+                # Waiter cleaned up off the (gateway-side fallback) feed.
+                assert platform.gateway._fallback_feed.watcher_count == 0
             finally:
                 await gw.close()
 
@@ -124,7 +125,8 @@ class TestGatewayLongPoll:
             try:
                 resp = await gw.get(f"/v1/taskmanagement/task/{task.task_id}")
                 assert (await resp.json())["Status"] == "created"
-                assert platform.gateway._waiters == {}
+                # A zero-wait GET never touches the feed path at all.
+                assert platform.gateway._fallback_feed is None
             finally:
                 await gw.close()
 
@@ -153,21 +155,66 @@ class TestEvictionDuringLongPoll:
                                          body=b"x"))
 
                 async def evict_soon():
-                    await asyncio.sleep(0.2)
-                    # complete (wakes the waiter) then evict before the
-                    # waiter's re-read.
+                    await asyncio.sleep(0.1)
+                    # Evicted mid-wait: no terminal transition ever
+                    # publishes to the feed, so the waiter rides out its
+                    # wait and the fallback re-read answers 404.
                     with store._lock:
                         store._apply_evict(t.task_id)
-                    for _loop, event in gw._waiters.get(t.task_id,
-                                                        frozenset()):
-                        _loop.call_soon_threadsafe(event.set)
 
                 asyncio.ensure_future(evict_soon())
                 resp = await client.get(
                     f"/v1/taskmanagement/task/{t.task_id}",
-                    params={"wait": "5"})
+                    params={"wait": "0.4"})
                 assert resp.status == 404
             finally:
                 await client.close()
+
+        asyncio.run(main())
+
+
+class TestCrossReplicaLongPoll:
+    def test_long_poll_through_other_gateway_wakes_with_record(self):
+        """The feed-unification regression (ISSUE 11): a long-poll
+        answered through a DIFFERENT gateway replica than the one that
+        admitted the task must wake with the terminal record. Two Gateway
+        instances share one store (the multi-process rig shares it over
+        the wire; the mechanism under test — the change feed, not a
+        gateway-private waiter map — is identical): admit through A,
+        long-poll through B, complete on the store, B wakes."""
+        import time as _time
+
+        from ai4e_tpu.gateway import Gateway
+
+        async def main():
+            store = InMemoryTaskStore()
+            gw_a, gw_b = Gateway(store), Gateway(store)
+            client_a = await serve(gw_a.app)
+            client_b = await serve(gw_b.app)
+            try:
+                task = store.upsert(APITask(endpoint="http://h/v1/api",
+                                            body=b"x", publish=False))
+
+                async def complete_soon():
+                    await asyncio.sleep(0.15)
+                    store.update_status(task.task_id, "completed",
+                                        TaskStatus.COMPLETED)
+
+                asyncio.ensure_future(complete_soon())
+                t0 = _time.perf_counter()
+                resp = await client_b.get(
+                    f"/v1/taskmanagement/task/{task.task_id}",
+                    params={"wait": "10"})
+                waited = _time.perf_counter() - t0
+                body = await resp.json()
+                assert body["Status"] == "completed"
+                assert waited < 5.0  # woke on the event, not the timeout
+                # B answered off its own feed — A's feed was never even
+                # created (it served no long-poll).
+                assert gw_b._fallback_feed is not None
+                assert gw_a._fallback_feed is None
+            finally:
+                await client_a.close()
+                await client_b.close()
 
         asyncio.run(main())

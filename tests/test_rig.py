@@ -1,0 +1,450 @@
+"""Multi-process deployment rig (docs/deployment.md): topology spec,
+process supervision, opt-in purity, and the move-window interleaving
+regression.
+
+The rig's end-to-end behavior — real processes, chaos replay at rate,
+the journal-reconciled verdict — is exercised by ``make rig`` / the CI
+``rig-smoke`` job. This file covers the pieces that must hold WITHOUT
+booting a fleet: the deterministic port layout and spec round-trip, the
+supervisor's spawn/health/crash-loop/teardown contracts (the
+``scripts/soak.sh`` escalation ladder, now code), the purity claim that
+nothing rig-shaped leaks into the single-process assembly, and the
+hand-found cross-process race of the live ``move_slot`` window replayed
+under ``explore_interleavings`` (the ROADMAP contributing-notes
+requirement for hand-found races).
+"""
+
+import asyncio
+import json
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+aiohttp = pytest.importorskip(
+    "aiohttp")  # the rig package imports it at module scope
+
+from ai4e_tpu.analysis.race import explore_interleavings, yield_point
+from ai4e_tpu.rig.storenode import SlotFence
+from ai4e_tpu.rig.supervisor import (RigError, Supervisor, ensure_port_free,
+                                     port_is_free)
+from ai4e_tpu.rig.topology import Topology
+from ai4e_tpu.taskstore import APITask, InMemoryTaskStore, TaskStatus
+from ai4e_tpu.taskstore.sharding import stable_hash
+from ai4e_tpu.taskstore.store import NotOwnerError, TaskNotFound
+
+HOST = "127.0.0.1"
+SEED = 20260803
+SCHEDULES = 60
+
+
+def _free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((HOST, 0))
+        return s.getsockname()[1]
+
+
+# -- opt-in purity ------------------------------------------------------------
+
+
+class TestRigOptIn:
+    def test_default_assembly_never_imports_the_rig(self):
+        """docs/deployment.md's purity claim: the single-process assembly
+        (what every existing deployment boots) must not pull in anything
+        under ``ai4e_tpu.rig`` — the rig is a driver AROUND the platform,
+        never a dependency OF it. A fresh interpreter keeps this immune to
+        import-order pollution from other tests."""
+        code = (
+            "import sys\n"
+            "import ai4e_tpu.platform_assembly\n"
+            "import ai4e_tpu.taskstore.sharding\n"
+            "import ai4e_tpu.gateway.router\n"
+            "bad = [m for m in sys.modules if m.startswith('ai4e_tpu.rig')]\n"
+            "assert not bad, f'rig leaked into the assembly: {bad}'\n")
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+
+
+# -- topology spec ------------------------------------------------------------
+
+
+class TestTopology:
+    def test_port_layout_is_disjoint_and_deterministic(self):
+        topo = Topology(gateways=3, shards=2, replicas=2, dispatchers=2,
+                        workers=2, loadgens=2)
+        ports = topo.all_ports()
+        assert len(ports) == len(set(ports)), "port layout collides"
+        # Deterministic: the same spec always lays out the same ports —
+        # what lets teardown PROVE nothing it owns still listens.
+        assert ports == Topology(gateways=3, shards=2, replicas=2,
+                                 dispatchers=2, workers=2,
+                                 loadgens=2).all_ports()
+
+    def test_shard_urls_are_primary_first(self):
+        topo = Topology(replicas=2)
+        urls = topo.shard_urls(1)
+        assert urls[0].endswith(str(topo.shard_port(1)))
+        assert urls[1].endswith(str(topo.replica_port(1, 0)))
+        assert urls[2].endswith(str(topo.replica_port(1, 1)))
+
+    def test_spec_round_trip(self, tmp_path):
+        topo = Topology(gateways=4, shards=3, rate=12500.0, seed=7,
+                        workdir=str(tmp_path), extra={"watchdog_s": 1.5})
+        path = str(tmp_path / "topology.json")
+        topo.save(path)
+        loaded = Topology.load(path)
+        assert loaded.to_dict() == topo.to_dict()
+        # Unknown keys are dropped, not fatal: an older driver can read a
+        # newer spec (children never guess — they read this file).
+        blob = json.loads(open(path).read())
+        blob["new_knob"] = 1
+        assert Topology.from_dict(blob).to_dict() == topo.to_dict()
+
+    def test_validation_refuses_bad_counts(self):
+        with pytest.raises(ValueError):
+            Topology(gateways=0)
+        with pytest.raises(ValueError):
+            Topology(replicas=99)
+        with pytest.raises(ValueError):
+            Topology(shards=8, slots=4)
+
+
+# -- supervision --------------------------------------------------------------
+
+
+def _sleeper_argv(port: int) -> list[str]:
+    return [sys.executable, "-c",
+            (f"import socket, time\n"
+             f"s = socket.socket()\n"
+             f"s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)\n"
+             f"s.bind(('{HOST}', {port})); s.listen()\n"
+             f"time.sleep(120)\n")]
+
+
+class TestSupervisor:
+    def test_health_gated_spawn_and_verified_teardown(self, tmp_path):
+        port = _free_port()
+        sup = Supervisor(host=HOST)
+        try:
+            child = sup.spawn("sleeper", _sleeper_argv(port),
+                              log_path=str(tmp_path / "sleeper.log"),
+                              port=port)
+            sup.wait_healthy("sleeper", timeout=20.0)
+            assert child.alive()
+            assert not port_is_free(HOST, port)
+        finally:
+            sup.shutdown()
+        # The teardown contract: process dead AND the port verifiably
+        # drained — no leak an atexit pass would have to mop up.
+        assert not child.alive()
+        assert port_is_free(HOST, port)
+
+    def test_boot_crash_fails_loudly_with_log_tail(self, tmp_path):
+        port = _free_port()
+        sup = Supervisor(host=HOST)
+        try:
+            sup.spawn("crasher",
+                      [sys.executable, "-c",
+                       "print('boom: spec missing'); raise SystemExit(3)"],
+                      log_path=str(tmp_path / "crasher.log"), port=port)
+            with pytest.raises(RigError) as err:
+                sup.wait_healthy("crasher", timeout=30.0)
+            # Immediate + diagnosable: the failure carries the child's own
+            # words, and does not burn the whole health timeout.
+            assert "died at boot" in str(err.value)
+            assert "boom: spec missing" in str(err.value)
+        finally:
+            sup.shutdown()
+
+    def test_port_conflict_eviction_kills_the_stale_holder(self, tmp_path):
+        port = _free_port()
+        holder = subprocess.Popen(_sleeper_argv(port))
+        try:
+            deadline = time.monotonic() + 10.0
+            while port_is_free(HOST, port):
+                assert time.monotonic() < deadline, "holder never bound"
+                time.sleep(0.05)
+            # The soak.sh ladder: wait briefly, then SIGKILL whatever
+            # still holds the port (a previous run's wedged process).
+            ensure_port_free(HOST, port, wait_s=0.5)
+            assert port_is_free(HOST, port)
+            assert holder.wait(timeout=10.0) != 0
+        finally:
+            if holder.poll() is None:
+                holder.kill()
+                holder.wait()
+
+    def test_long_uptime_death_is_not_a_crash_loop_strike(self, tmp_path):
+        """Review finding: every unexpected death used to count toward the
+        crash-loop threshold regardless of uptime, so two long-lived
+        deaths (a soak OOM at minute 3 and minute 7 — crashes, not a
+        loop) plus one fast death aborted the run. A death at or past
+        ``min_uptime_s`` must RESET the strike budget."""
+        sup = Supervisor(host=HOST, max_restarts=1, min_uptime_s=0.3)
+        try:
+            child = sup.spawn(
+                "longlived",
+                [sys.executable, "-c",
+                 "import time; time.sleep(0.6); raise SystemExit(1)"],
+                log_path=str(tmp_path / "longlived.log"))
+
+            def wait_dead():
+                deadline = time.monotonic() + 10.0
+                while child.alive():
+                    assert time.monotonic() < deadline
+                    time.sleep(0.05)
+
+            for _ in range(3):  # 3 long-uptime deaths > max_restarts=1
+                wait_dead()
+                assert sup.check() == ["longlived"]  # restarted, no raise
+        finally:
+            sup.shutdown()
+
+    def test_crash_loop_detection_is_bounded(self, tmp_path):
+        sup = Supervisor(host=HOST, max_restarts=1, min_uptime_s=5.0)
+        try:
+            child = sup.spawn("flapper",
+                              [sys.executable, "-c", "raise SystemExit(1)"],
+                              log_path=str(tmp_path / "flapper.log"))
+
+            def wait_dead():
+                deadline = time.monotonic() + 10.0
+                while child.alive():
+                    assert time.monotonic() < deadline
+                    time.sleep(0.05)
+
+            wait_dead()
+            assert sup.check() == ["flapper"]  # restart 1: bounded retry
+            wait_dead()
+            with pytest.raises(RigError, match="crash-looping"):
+                sup.check()  # restart budget exhausted under min uptime
+        finally:
+            sup.shutdown()
+
+    def test_chaos_kill_is_expected_and_never_restarted(self, tmp_path):
+        port = _free_port()
+        sup = Supervisor(host=HOST)
+        try:
+            child = sup.spawn("victim", _sleeper_argv(port),
+                              log_path=str(tmp_path / "victim.log"),
+                              port=port)
+            sup.wait_healthy("victim", timeout=20.0)
+            sup.kill("victim")  # the chaos timeline's SIGKILL primitive
+            deadline = time.monotonic() + 10.0
+            while child.alive():
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            # The monitor must treat the corpse as the chaos timeline's
+            # property: no restart, no crash-loop strike.
+            assert sup.check() == []
+            assert not child.alive()
+            # ... and the chaos respawn verb relaunches the same argv.
+            sup.respawn("victim")
+            sup.wait_healthy("victim", timeout=20.0)
+            assert child.alive()
+        finally:
+            sup.shutdown()
+
+
+# -- balancer failover semantics ---------------------------------------------
+
+
+class TestBalancerNoReplay:
+    """Review finding: the failover except-branch also caught
+    ``ConnectionResetError``/``OSError`` — which aiohttp raises (as
+    ``ClientOSError``/``ServerDisconnectedError``) when an ESTABLISHED
+    connection dies mid-request, e.g. the chaos SIGKILL landing after the
+    body was sent and possibly after the gateway admitted the task.
+    Replaying that request on the next replica mints a SECOND task. Only
+    connect-phase failures (``ClientConnectorError``) may fail over."""
+
+    def test_established_connection_death_502s_and_never_replays(self):
+        from aiohttp import web
+
+        from ai4e_tpu.rig.balancer import Balancer
+
+        async def main():
+            hits = {"a": 0, "b": 0}
+
+            async def dying(request):
+                # The gateway "dies" after receiving the request — the
+                # connection was established, the task may be admitted.
+                hits["a"] += 1
+                await request.read()
+                request.transport.close()
+                raise ConnectionResetError  # never a response
+
+            async def healthy(request):
+                hits["b"] += 1
+                return web.json_response({"TaskId": "t-replayed"})
+
+            ports = []
+            runners = []
+            for handler in (dying, healthy):
+                app = web.Application()
+                app.router.add_route("*", "/{tail:.*}", handler)
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, HOST, 0)
+                await site.start()
+                ports.append(site._server.sockets[0].getsockname()[1])
+                runners.append(runner)
+            topo = Topology(gateways=2, shards=1)
+            topo.gateway_urls = lambda: [f"http://{HOST}:{p}"
+                                         for p in ports]
+            balancer = Balancer(topo)
+            brunner = web.AppRunner(balancer.app)
+            await brunner.setup()
+            bsite = web.TCPSite(brunner, HOST, 0)
+            await bsite.start()
+            bport = bsite._server.sockets[0].getsockname()[1]
+            import aiohttp as http
+            try:
+                async with http.ClientSession() as session:
+                    # Round-robin starts at gateway 0 (the dying one).
+                    async with session.post(
+                            f"http://{HOST}:{bport}/v1/echo/run-async",
+                            data=b"x") as resp:
+                        assert resp.status == 502  # surfaced, NOT replayed
+                    assert hits["a"] == 1
+                    assert hits["b"] == 0, \
+                        "mid-stream death was replayed onto another gateway"
+                    # A CONNECT-phase failure still fails over: kill the
+                    # dying gateway's listener entirely and re-POST — rr
+                    # offers it to the healthy replica instead of 503ing.
+                    await runners[0].cleanup()
+                    async with session.post(
+                            f"http://{HOST}:{bport}/v1/echo/run-async",
+                            data=b"x") as resp:
+                        assert resp.status == 200
+                    assert hits["b"] == 1
+            finally:
+                for runner in runners[1:]:
+                    await runner.cleanup()
+                await brunner.cleanup()
+
+        asyncio.run(main())
+
+
+# -- the move-window race, replayed under the interleaving explorer -----------
+#
+# Hand-found while shaking the rig out (docs/deployment.md "Live
+# rebalance across the socket"): during a cross-process ``move_slot`` the
+# source fences the slot, copies, flips, then FORGETS the range — and a
+# forgotten task answers a conditional completion with "no such task"
+# (HTTP 204) BEFORE any ownership fence can fire, because the miss check
+# precedes the fence check by construction (``update_status_if`` returns
+# None for unknown ids). A worker completing a moved task against a
+# stale ring that takes that miss at face value strands an accepted
+# task in ``created`` forever — an invariant violation the full-rate rig
+# surfaced within seconds. The fix is ``RingStoreClient._routed``'s
+# outcome-checked misses: re-fetch the fence table before standing on a
+# 204/404, and treat a miss inside an owner-less (mid-copy) slot as
+# indeterminate, retried with backoff. Modeled here on the REAL store +
+# fence primitives with a yield point per wire hop, so the explorer owns
+# every interleaving of mover vs completer.
+
+
+def _slot_task(topo: Topology, shard: int) -> tuple[str, int]:
+    """A task id whose hash slot lands on ``shard`` under the static
+    assignment (slot % shards)."""
+    for i in range(10_000):
+        tid = f"task-{i}"
+        slot = stable_hash(tid) % topo.slots
+        if slot % topo.shards == shard:
+            return tid, slot
+    raise AssertionError("unreachable: no id hashed onto the shard")
+
+
+def _move_window_scenario(stand_on_miss: bool):
+    def make():
+        topo = Topology(gateways=1, shards=2, replicas=1, dispatchers=1,
+                        workers=1, loadgens=1, slots=4, chaos=False)
+        src_fence, dst_fence = SlotFence(topo, 0), SlotFence(topo, 1)
+        source, dest = InMemoryTaskStore(), InMemoryTaskStore()
+        source.set_write_fence(src_fence.owns)
+        dest.set_write_fence(dst_fence.owns)
+        stores = {0: (source, src_fence), 1: (dest, dst_fence)}
+        tid, slot = _slot_task(topo, 0)
+        source.upsert(APITask(task_id=tid, endpoint="/v1/echo/run-async/op",
+                              body=b"payload", publish=False))
+        applied: list[int] = []
+
+        async def mover():
+            # The wire move_slot sequence (rig/storenode.py _move_slot),
+            # one yield per cross-process hop.
+            src_fence.set_owner(slot, None)  # copy window: writes 409
+            recs = source.export_task_records([tid])
+            await yield_point()              # POST /v1/rig/import
+            dest.import_task_records(recs)
+            dst_fence.set_owner(slot, 1)
+            await yield_point()              # import response returns
+            src_fence.set_owner(slot, 1)     # flip
+            source.forget_tasks([tid])
+
+        async def completer():
+            # A worker's conditional completion through a (possibly stale)
+            # ring — RingStoreClient.update_task_status_if's semantics.
+            ring = {s: s % topo.shards for s in range(topo.slots)}
+            for _ in range(32):
+                store, fence = stores[ring[slot]]
+                await yield_point()          # the request's wire hop
+                try:
+                    task = store.update_status_if(
+                        tid, TaskStatus.CREATED, TaskStatus.COMPLETED,
+                        TaskStatus.COMPLETED)
+                except NotOwnerError:        # 409 X-Not-Owner
+                    owner = fence.fenced.get(slot)  # GET /v1/rig/slots
+                    if owner is None:
+                        await yield_point()  # owner-less copy window
+                        continue
+                    ring[slot] = owner
+                    continue
+                if task is not None:
+                    applied.append(ring[slot])
+                    return
+                # None: precondition failed — OR the task is simply not
+                # on this node (the store cannot tell a duplicate from a
+                # forgotten range; the HTTP surface answers 204).
+                try:
+                    store.get(tid)
+                except TaskNotFound:
+                    if stand_on_miss:
+                        return  # PRE-FIX: take the 204 at face value
+                    owner = fence.fenced.get(slot)  # outcome-checked miss
+                    if owner is not None and owner != ring[slot]:
+                        ring[slot] = owner
+                        continue
+                    await yield_point()      # indeterminate: back off
+                    continue
+                return  # genuinely already terminal: suppressed duplicate
+            raise AssertionError("route budget exhausted")
+
+        def check():
+            task = dest.get(tid)  # TaskNotFound here = the move LOST it
+            assert task.canonical_status == TaskStatus.COMPLETED, (
+                "accepted task stranded non-terminal by the move window "
+                f"(status {task.canonical_status!r}): the completer stood "
+                "on a miss from the old owner")
+            assert len(applied) == 1, (
+                f"client-visible completions: {applied}")
+
+        return [mover(), completer()], check
+
+    return make
+
+
+class TestMoveWindowRace:
+    def test_outcome_checked_ring_client_is_race_free(self):
+        report = explore_interleavings(_move_window_scenario(False),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_stand_on_miss_strands_the_task_and_is_caught(self):
+        report = explore_interleavings(_move_window_scenario(True),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert not report.ok
+        assert "stranded" in str(report.failures[0].error)

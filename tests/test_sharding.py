@@ -757,3 +757,193 @@ class TestAssembly:
                 store.close()
 
         run(main())
+
+
+# ---------------------------------------------------------------------------
+# Wire-mode ShardReplicaLink (ISSUE 11): the same link machinery absorbing
+# the primary's journal over the HTTP stream — the shape the multi-process
+# rig's replica processes run (ai4e_tpu/rig/), sharing replication.py's
+# whole-lines/generation-resync contract and PR 10's chain verification.
+# ---------------------------------------------------------------------------
+
+
+class TestWireReplicaLink:
+    async def _serve_primary(self, store):
+        from ai4e_tpu.taskstore.http import make_app
+        client = TestClient(TestServer(make_app(store)))
+        await client.start_server()
+        return client, str(client.make_url("")).rstrip("/")
+
+    def test_wire_link_absorbs_over_http_and_chain_heads_match(
+            self, tmp_path):
+        from ai4e_tpu.taskstore import FollowerTaskStore
+        from ai4e_tpu.taskstore.sharding import ShardReplicaLink
+
+        async def main():
+            primary = FollowerTaskStore(str(tmp_path / "p.jsonl"),
+                                        start_as_primary=True)
+            client, url = await self._serve_primary(primary)
+            standby = FollowerTaskStore(str(tmp_path / "r.jsonl"))
+            link = ShardReplicaLink(None, standby, primary_url=url)
+            try:
+                ids = [primary.upsert(APITask(endpoint="/v1/x/op",
+                                              body=b"b")).task_id
+                       for _ in range(6)]
+                primary.set_result(ids[0], b"out")
+                primary.update_status(ids[0], "completed",
+                                      TaskStatus.COMPLETED)
+                while await asyncio.to_thread(link.sync_once):
+                    pass
+                assert set(standby._tasks) == set(ids)
+                assert standby.get(ids[0]).status == "completed"
+                assert standby.get_result(ids[0]) is not None
+                # PR 10 divergence check ACROSS THE SOCKET: the replica's
+                # verified-stream head equals the primary's own-file head
+                # ⇔ byte-identical absorbed history.
+                assert standby.replica_chain_head == primary.chain_head
+                assert standby.replica_chain_head is not None
+            finally:
+                await client.close()
+                primary.close()
+                standby.close()
+
+        run(main())
+
+    def test_wire_link_survives_primary_restart_mid_tail(self, tmp_path):
+        """Primary process restarts between polls: same journal file, same
+        bytes → the link continues at its offset; a restart that salvaged
+        a torn tail (file shrank under the link's offset) or compacted
+        (generation bump) forces the full resync instead."""
+        from ai4e_tpu.taskstore import FollowerTaskStore
+        from ai4e_tpu.taskstore.sharding import ShardReplicaLink
+
+        async def main():
+            path = str(tmp_path / "p.jsonl")
+            primary = FollowerTaskStore(path, start_as_primary=True)
+            client, url = await self._serve_primary(primary)
+            standby = FollowerTaskStore(str(tmp_path / "r.jsonl"))
+            link = ShardReplicaLink(None, standby, primary_url=url)
+            try:
+                first = [primary.upsert(APITask(endpoint="/v1/x/op",
+                                                body=b"b")).task_id
+                         for _ in range(4)]
+                while await asyncio.to_thread(link.sync_once):
+                    pass
+                assert set(standby._tasks) == set(first)
+                # "Restart": close the store and the server, reopen both
+                # on the same journal (replay), keep tailing mid-stream.
+                await client.close()
+                primary.close()
+                primary = FollowerTaskStore(path, start_as_primary=True)
+                client, url = await self._serve_primary(primary)
+                link.primary_url = url
+                second = [primary.upsert(APITask(endpoint="/v1/x/op",
+                                                 body=b"b")).task_id
+                          for _ in range(3)]
+                while await asyncio.to_thread(link.sync_once):
+                    pass
+                assert set(standby._tasks) == set(first) | set(second)
+                assert standby.replica_chain_head == primary.chain_head
+                # Compaction bumps the generation: the link must resync
+                # from offset 0 of the rewritten file and converge again.
+                primary.update_status(second[0], "completed",
+                                      TaskStatus.COMPLETED)
+                primary.compact()
+                gen_before = link.generation
+                while await asyncio.to_thread(link.sync_once):
+                    pass
+                assert link.generation != gen_before
+                assert set(standby._tasks) == set(first) | set(second)
+                assert standby.get(second[0]).status == "completed"
+                assert standby.replica_chain_head == primary.chain_head
+            finally:
+                await client.close()
+                primary.close()
+                standby.close()
+
+        run(main())
+
+    def test_wire_link_parks_on_corrupt_line_until_compaction(
+            self, tmp_path):
+        """A journal line that fails checksum/chain verification over the
+        socket parks the link on the verified prefix (never absorbed
+        silently); the primary's next compaction rewrite (generation
+        bump) clears the park and the replica converges."""
+        from ai4e_tpu.taskstore import FollowerTaskStore
+        from ai4e_tpu.taskstore.sharding import ShardReplicaLink
+
+        async def main():
+            path = str(tmp_path / "p.jsonl")
+            primary = FollowerTaskStore(path, start_as_primary=True)
+            client, url = await self._serve_primary(primary)
+            standby = FollowerTaskStore(str(tmp_path / "r.jsonl"))
+            link = ShardReplicaLink(None, standby, primary_url=url)
+            try:
+                good = [primary.upsert(APITask(endpoint="/v1/x/op",
+                                               body=b"b")).task_id
+                        for _ in range(3)]
+                while await asyncio.to_thread(link.sync_once):
+                    pass
+                # Corrupt a byte of the NEXT record on disk, past the
+                # link's offset (simulated bit-rot in flight/on disk).
+                bad = primary.upsert(APITask(endpoint="/v1/x/op",
+                                             body=b"b")).task_id
+                with open(path, "rb") as fh:
+                    data = fh.read()
+                flip = link.offset + 20
+                data = data[:flip] + b"\x00" + data[flip + 1:]
+                with open(path, "wb") as fh:
+                    fh.write(data)
+                for _ in range(3):
+                    await asyncio.to_thread(link.sync_once)
+                assert link._corrupt_at is not None  # parked, loudly
+                assert set(standby._tasks) == set(good)  # verified prefix
+                parked_offset = link.offset
+                # Parked polls stay parked (and cheap).
+                await asyncio.to_thread(link.sync_once)
+                assert link.offset == parked_offset
+                # Compaction rewrites clean bytes from live state and
+                # bumps the generation — the park clears, full resync.
+                primary.compact()
+                for _ in range(4):
+                    await asyncio.to_thread(link.sync_once)
+                assert link._corrupt_at is None
+                assert set(standby._tasks) == set(good) | {bad}
+                assert standby.replica_chain_head == primary.chain_head
+            finally:
+                await client.close()
+                primary.close()
+                standby.close()
+
+        run(main())
+
+    def test_absorb_journal_file_is_the_dead_primary_drain(self, tmp_path):
+        """``absorb_journal_file``: the failover drain a wire replica runs
+        when the primary PROCESS is gone — the HTTP stream died with it,
+        the journal file did not. Full reset-and-replay, whole lines
+        only; the standby then promotes with zero acknowledged loss."""
+        from ai4e_tpu.taskstore import FollowerTaskStore, JournaledTaskStore
+        from ai4e_tpu.taskstore.sharding import absorb_journal_file
+
+        path = str(tmp_path / "p.jsonl")
+        primary = JournaledTaskStore(path)
+        ids = [primary.upsert(APITask(endpoint="/v1/x/op",
+                                      body=b"b")).task_id
+               for _ in range(5)]
+        primary.set_result(ids[0], b"out")
+        primary.update_status(ids[0], "completed", TaskStatus.COMPLETED)
+        primary.close()  # SIGKILL semantics: handle gone, file survives
+        # Torn tail: a half-appended record a crash left behind must not
+        # half-apply (whole-lines rule).
+        with open(path, "ab") as fh:
+            fh.write(b'{"torn": tr')
+        standby = FollowerTaskStore(str(tmp_path / "r.jsonl"))
+        absorbed = absorb_journal_file(standby, path)
+        assert absorbed > 0
+        assert set(standby._tasks) == set(ids)
+        assert standby.get_result(ids[0]) is not None
+        standby.promote()
+        assert standby.role == "primary"
+        assert standby.epoch >= 1
+        assert standby.get(ids[0]).status == "completed"
+        standby.close()

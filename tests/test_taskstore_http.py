@@ -465,3 +465,53 @@ class TestRedrive:
                 await client.close()
 
         run(main())
+
+
+class TestConditionalWireUpdate:
+    """``ExpectedStatus`` on ``POST /v1/taskstore/update`` — the wire form
+    of ``update_status_if`` (ISSUE 11): a remote writer's terminal
+    transition evaluates its precondition under the STORE's lock instead
+    of carrying the reachably-racy probe-then-write shape across the hop
+    (docs/concurrency.md's documented residual window, closed)."""
+
+    def test_conditional_update_applies_once_and_409s_the_loser(self):
+        from ai4e_tpu.taskstore import TaskStatus
+        store = InMemoryTaskStore()
+
+        async def main():
+            client, tm = await manager_for(store)
+            try:
+                task = await tm.add_task("http://h/v1/api", b"x")
+                tid = task["TaskId"]
+                store.update_status(tid, "running", TaskStatus.RUNNING)
+                won = await tm.update_task_status_if(
+                    tid, TaskStatus.RUNNING, "completed",
+                    TaskStatus.COMPLETED)
+                assert won is not None and "completed" in won["Status"]
+                # The duplicate's conditional write refuses instead of
+                # clobbering the completion the client may have read.
+                lost = await tm.update_task_status_if(
+                    tid, TaskStatus.RUNNING, "failed - duplicate",
+                    TaskStatus.FAILED)
+                assert lost is None
+                assert store.get(tid).status == "completed"
+            finally:
+                await client.close()
+
+        run(main())
+
+    def test_conditional_update_of_unknown_task_is_none(self):
+        from ai4e_tpu.taskstore import TaskStatus
+        store = InMemoryTaskStore()
+
+        async def main():
+            client, tm = await manager_for(store)
+            try:
+                got = await tm.update_task_status_if(
+                    "t-nope", TaskStatus.RUNNING, "completed",
+                    TaskStatus.COMPLETED)
+                assert got is None
+            finally:
+                await client.close()
+
+        run(main())
