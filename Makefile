@@ -1,7 +1,7 @@
 # Contributor conveniences. Each target reproduces the matching CI job
 # with the SAME flags (the scripts are the single source of truth).
 
-.PHONY: lint test race-smoke chaos durability rig
+.PHONY: lint test race-smoke chaos durability rig top timeline
 
 # Both lint gates CI runs (ruff correctness rules + ai4e-lint, see
 # scripts/lint.sh and docs/analysis.md).
@@ -48,3 +48,17 @@ durability:
 	AI4E_CHAOS_SEED=20260803 python -m pytest \
 	  tests/test_durability.py tests/test_disk_chaos.py \
 	  -q -m 'not slow' -p no:cacheprovider
+
+# Live fleet dashboard against a running rig (or any topology.json):
+# per-proc req/s, goodput, SLO burn, event-loop lag, RSS
+# (docs/observability.md). Mirrors `python -m ai4e_tpu top` flags.
+top:
+	python -m ai4e_tpu top --spec /tmp/ai4e-rig/topology.json \
+	  --interval 2.0
+
+# Re-render a recorded rig run as ONE loadable Perfetto timeline
+# (hop ledgers + device phases + chaos verbs + vitals curves) from the
+# artifact directory `make rig` writes. Load the output at
+# https://ui.perfetto.dev.
+timeline:
+	python -m ai4e_tpu timeline --rig-dir /tmp/ai4e-rig/artifact

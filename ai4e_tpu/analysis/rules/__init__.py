@@ -3,6 +3,7 @@
 from .blocking import BlockingCallInAsync
 from .config_drift import ConfigDrift
 from .fire_and_forget import FireAndForgetTask
+from .ledger_vocab import LedgerVocabularyDrift
 from .lock_await import LockAcrossSlowAwait
 from .metrics_drift import MetricsDrift
 from .registry_leak import MetricsRegistryLeak
@@ -22,6 +23,7 @@ ALL_RULES = [
     LockAcrossSlowAwait,
     NonatomicReadModifyWrite,
     MetricsDrift,
+    LedgerVocabularyDrift,
 ]
 
 __all__ = ["ALL_RULES"] + [cls.__name__ for cls in ALL_RULES]

@@ -1,0 +1,199 @@
+"""AIL011 — hop-ledger vocabulary drift between code and docs.
+
+The bug class (AIL010's sibling on the EVENT-name surface): the ledger
+vocabulary — the ``admitted``/``popped``/``h2d``/… event tokens every
+``trace`` rendering, flight-recorder filter, and timeline export keys
+on — grew by hand across PRs 8–11 with nothing keeping the operator
+table in ``docs/observability.md`` honest. An event stamped in code but
+absent from the table is a token the operator reading a trace cannot
+interpret; a documented event nothing stamps is a filter that silently
+matches nothing.
+
+Three checks, run once over the whole project:
+
+1. every event constant in ``observability/ledger.py`` (the UPPERCASE
+   string-constant block) appears in the ``ai4e:ledger-vocabulary``
+   marked table of ``docs/observability.md`` — and every backticked
+   token in that table's first column is one of those constants;
+2. the same, both directions, for the flight recorder's keep-reason
+   constants (``REASON_*`` in ``observability/flight.py``) against the
+   ``ai4e:flight-reasons`` marked table;
+3. any LITERAL event name passed to ``ledger_event("…", …)`` or
+   ``….stamp("…", …)`` anywhere in the project must be in the event
+   vocabulary — a typo'd literal stamp otherwise mints an
+   undocumented event that no table, filter, or renderer knows.
+
+The doc tables are delimited by HTML-comment markers so prose mentions
+of event words elsewhere in the doc never count::
+
+    <!-- ai4e:ledger-vocabulary --> … <!-- /ai4e:ledger-vocabulary -->
+    <!-- ai4e:flight-reasons -->    … <!-- /ai4e:flight-reasons -->
+
+Tokens are the backticked words of each table row's FIRST cell (a row
+may list several: ``| `h2d`, `compile` | … |``). Deleting the markers
+does not defeat the rule: vocabulary in code with no marked region is
+itself a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import Finding, ProjectRule
+
+_DOC_FILE = os.path.join("docs", "observability.md")
+_LEDGER_MOD = ("observability", "ledger.py")
+_FLIGHT_MOD = ("observability", "flight.py")
+_EVENT_MARK = "ai4e:ledger-vocabulary"
+_REASON_MARK = "ai4e:flight-reasons"
+_TOKEN_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
+_VALUE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_STAMP_FUNCS = ("ledger_event", "stamp")
+
+
+def _module_is(module, tail: tuple[str, str]) -> bool:
+    parts = module.path.replace(os.sep, "/").split("/")
+    return len(parts) >= 2 and tuple(parts[-2:]) == tail
+
+
+def _str_constants(module, name_filter) -> list[tuple[str, str, int]]:
+    """(constant_name, value, line) for top-level ``NAME = "value"``
+    assignments passing ``name_filter``."""
+    out = []
+    for node in module.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not (isinstance(target, ast.Name) and name_filter(target.id)):
+            continue
+        if (isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+                and _VALUE_RE.match(node.value.value)):
+            out.append((target.id, node.value.value, node.lineno))
+    return out
+
+
+def _literal_stamps(module) -> list[tuple[str, int]]:
+    """(event_literal, line) for ``ledger_event("x", …)`` /
+    ``….stamp("x", …)`` calls with a literal first argument."""
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        func = node.func
+        name = (func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else None)
+        if name not in _STAMP_FUNCS:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append((arg.value, node.lineno))
+    return out
+
+
+class LedgerVocabularyDrift(ProjectRule):
+    rule_id = "AIL011"
+    name = "ledger-vocabulary-drift"
+    description = ("every ledger event / flight keep-reason token in code "
+                   "must appear in docs/observability.md's marked "
+                   "vocabulary tables and vice versa; literal stamps must "
+                   "use vocabulary events")
+
+    def check_project(self, ctx):
+        findings: list[Finding] = []
+        events: dict[str, tuple[str, int]] = {}   # value -> (path, line)
+        reasons: dict[str, tuple[str, int]] = {}
+        stamps: list[tuple[str, str, int]] = []   # (value, path, line)
+        for module in ctx.modules:
+            if _module_is(module, _LEDGER_MOD):
+                for _name, value, line in _str_constants(
+                        module, str.isupper):
+                    events.setdefault(value, (module.path, line))
+            if _module_is(module, _FLIGHT_MOD):
+                for _name, value, line in _str_constants(
+                        module, lambda n: n.startswith("REASON_")):
+                    reasons.setdefault(value, (module.path, line))
+            for value, line in _literal_stamps(module):
+                stamps.append((value, module.path, line))
+        if not events and not reasons:
+            return findings  # project carries no ledger vocabulary
+
+        doc_path = _DOC_FILE.replace(os.sep, "/")
+        doc_events = self._marked_tokens(ctx.root, _EVENT_MARK)
+        doc_reasons = self._marked_tokens(ctx.root, _REASON_MARK)
+
+        for vocab, doc, mark, kind in (
+                (events, doc_events, _EVENT_MARK, "ledger event"),
+                (reasons, doc_reasons, _REASON_MARK,
+                 "flight keep-reason")):
+            if not vocab:
+                continue
+            if doc is None:
+                path, line = next(iter(vocab.values()))
+                findings.append(Finding(
+                    self.rule_id, path, line, 0,
+                    f"code defines {kind} vocabulary but {doc_path} has "
+                    f"no `<!-- {mark} -->` marked table — the operator "
+                    "vocabulary table is missing or unmarked"))
+                continue
+            doc_set = {tok for tok, _loc in doc}
+            for value, (path, line) in sorted(vocab.items()):
+                if value not in doc_set:
+                    findings.append(Finding(
+                        self.rule_id, path, line, 0,
+                        f"{kind} {value!r} is stamped/kept in code but "
+                        f"absent from {doc_path}'s {mark} table — a "
+                        "trace/flight consumer cannot interpret it"))
+            for tok, (path, line) in sorted(doc):
+                if tok not in vocab:
+                    findings.append(Finding(
+                        self.rule_id, path, line, 0,
+                        f"{doc_path} documents {kind} {tok!r} but no "
+                        "code defines it — stale row or a rename that "
+                        "missed the docs"))
+
+        for value, path, line in stamps:
+            if value not in events:
+                findings.append(Finding(
+                    self.rule_id, path, line, 0,
+                    f"literal ledger stamp {value!r} is not in the "
+                    "observability/ledger.py vocabulary — use a "
+                    "vocabulary constant (or add + document the event)"))
+        return findings
+
+    def _marked_tokens(self, root: str, mark: str
+                       ) -> list[tuple[str, tuple[str, int]]] | None:
+        """Backticked tokens from the FIRST table cell of each row
+        inside the ``mark`` region, or None when the region is absent.
+        Duplicate tokens keep their first location."""
+        path = os.path.join(root, _DOC_FILE)
+        rel = _DOC_FILE.replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as fh:
+                lines = fh.read().splitlines()
+        except OSError:
+            return None
+        inside = False
+        found_region = False
+        out: list[tuple[str, tuple[str, int]]] = []
+        seen: set[str] = set()
+        for i, line in enumerate(lines, 1):
+            # Markers may carry an annotation: `<!-- mark — why -->`.
+            if f"<!-- /{mark}" in line:
+                inside = False
+                continue
+            if f"<!-- {mark}" in line:
+                inside, found_region = True, True
+                continue
+            if not inside or not line.lstrip().startswith("|"):
+                continue
+            cells = line.split("|")
+            first = cells[1] if len(cells) > 1 else ""
+            for m in _TOKEN_RE.finditer(first):
+                tok = m.group(1)
+                if tok not in seen:
+                    seen.add(tok)
+                    out.append((tok, (rel, i)))
+        return out if found_region else None

@@ -379,6 +379,16 @@ async def run_control_plane(config: FrameworkConfig, routes: dict) -> None:
     site = web.TCPSite(runner, config.gateway.host, config.gateway.port)
     await site.start()
     await platform.start()
+    vitals = None
+    if config.observability.vitals:
+        # Runtime vitals into the ASSEMBLY registry: loop lag / GC /
+        # RSS land beside the serving metrics on this process's
+        # /metrics (AI4E_OBSERVABILITY_VITALS, docs/observability.md).
+        from .observability.vitals import VitalsSampler
+        vitals = VitalsSampler(platform.metrics,
+                               interval_s=config.observability
+                               .vitals_interval)
+        await vitals.start()
     # Operators grep startup lines for posture; admission changes the
     # public contract (sheds, expiry, computed Retry-After —
     # AI4E_PLATFORM_ADMISSION=1, docs/admission.md) and resilience changes
@@ -405,6 +415,9 @@ async def run_control_plane(config: FrameworkConfig, routes: dict) -> None:
          if platform.observability is not None else ""),
         (f", SLO engine ON ({len(platform.slo.objectives)} objectives)"
          if platform.slo is not None else ""),
+        # Vitals change what /metrics reports about the PROCESS itself
+        # (ai4e_process_* — AI4E_OBSERVABILITY_VITALS).
+        ", vitals ON" if vitals is not None else "",
         # The fsync policy changes what an acknowledgment MEANS against
         # a machine crash (AI4E_TASKSTORE_FSYNC, docs/durability.md) —
         # logged whenever a journal is in play (single or sharded) so
@@ -416,6 +429,8 @@ async def run_control_plane(config: FrameworkConfig, routes: dict) -> None:
     try:
         await _wait_for_termination()
     finally:
+        if vitals is not None:
+            await vitals.stop()
         await platform.stop()
         await runner.cleanup()
 
@@ -439,11 +454,24 @@ async def run_worker(config: FrameworkConfig, models: dict) -> None:
     await runner.setup()
     site = web.TCPSite(runner, config.service.host, config.service.port)
     await site.start()
-    log.info("worker on %s:%s serving %s", config.service.host,
-             config.service.port, list(worker.runtime.models))
+    vitals = None
+    if config.observability.vitals:
+        # Same sampler as the control plane, in the worker's service
+        # registry — loop lag here is what explains "the batch sat
+        # ready while the loop was blocked".
+        from .observability.vitals import VitalsSampler
+        vitals = VitalsSampler(worker.service.metrics,
+                               interval_s=config.observability
+                               .vitals_interval)
+        await vitals.start()
+    log.info("worker on %s:%s serving %s%s", config.service.host,
+             config.service.port, list(worker.runtime.models),
+             ", vitals ON" if vitals is not None else "")
     try:
         await _wait_for_termination()
     finally:
+        if vitals is not None:
+            await vitals.stop()
         await worker.service.drain(timeout=config.service.drain_timeout)
         await batcher.stop()
         if jax.process_count() > 1:
@@ -549,7 +577,69 @@ def main(argv=None) -> None:
     tr.add_argument("--limit", type=int, default=20,
                     help="--list: how many recent traces")
 
+    tp = sub.add_parser(
+        "top",
+        help="live fleet dashboard — per-proc req/s, goodput, SLO "
+             "burn, event-loop lag, RSS from the federation snapshot "
+             "(docs/observability.md)")
+    tp.add_argument("--collector", default=None,
+                    help="poll a collector's /v1/debug/fleet (the rig's "
+                         "collector role)")
+    tp.add_argument("--spec", default=None,
+                    help="scrape a rig topology.json's roles directly")
+    tp.add_argument("--targets", default=None,
+                    help="ad-hoc name=url,name=url target list")
+    tp.add_argument("--interval", type=float, default=2.0)
+    tp.add_argument("--once", action="store_true",
+                    help="print one frame and exit (scriptable)")
+
+    tl = sub.add_parser(
+        "timeline",
+        help="export a rig run as ONE Chrome-trace/Perfetto JSON — hop "
+             "ledgers, device phases, chaos verbs, vitals curves "
+             "(load the output at https://ui.perfetto.dev)")
+    tl.add_argument("--rig-dir", required=True,
+                    help="rig artifact directory (rig.json + the "
+                         "ledgers/vitals files the driver wrote)")
+    tl.add_argument("--out", default=None,
+                    help="output path (default <rig-dir>/timeline.json)")
+
     args = parser.parse_args(argv)
+
+    if args.component == "top":
+        # Pure fleet-snapshot client — no jax, no platform assembly.
+        from .observability.top import run_top
+        raise SystemExit(asyncio.run(run_top(
+            collector=args.collector, spec=args.spec,
+            targets=args.targets, interval=args.interval,
+            once=args.once)))
+
+    if args.component == "timeline":
+        # Pure artifact transform — no jax, no platform assembly.
+        import json as _json
+        import os as _os
+
+        from .observability.timeline import build_from_rig_dir
+        if not _os.path.isdir(args.rig_dir):
+            raise SystemExit(f"timeline: {args.rig_dir} is not a "
+                             "directory (pass the rig artifact dir "
+                             "`--out` wrote)")
+        if not any(_os.path.exists(_os.path.join(args.rig_dir, f))
+                   for f in ("rig.json", "ledgers.json")):
+            raise SystemExit(f"timeline: {args.rig_dir} has neither "
+                             "rig.json nor ledgers.json — not a rig "
+                             "artifact directory")
+        doc = build_from_rig_dir(args.rig_dir)
+        out_path = args.out or _os.path.join(args.rig_dir,
+                                             "timeline.json")
+        with open(out_path, "w", encoding="utf-8") as fh:
+            _json.dump(doc, fh)
+        meta = doc["otherData"]
+        print(f"wrote {out_path}: {len(doc['traceEvents'])} events, "
+              f"{meta['tasks']} tasks, hops {meta['hops']}, "
+              f"{len(meta['procs'])} procs — load it at "
+              "https://ui.perfetto.dev")
+        return
 
     if args.component == "trace":
         if args.url:

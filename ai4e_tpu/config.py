@@ -429,6 +429,13 @@ class ObservabilitySection:
     trace_otlp_endpoint: typing.Optional[str] = None
     queue_depth_interval: float = 30.0      # TaskQueueLogger.cs:19 (30 s)
     process_depth_interval: float = 300.0   # TaskProcessLogger.cs:21 (5 min)
+    # Per-process runtime vitals (observability/vitals.py): event-loop
+    # lag, GC pauses, RSS/CPU/fd/steal from /proc, exported as
+    # ai4e_process_* in the process's own registry. Started by the CLI
+    # launchers (control-plane AND worker); rig roles always sample.
+    # Off = no sampler task, no series — the launcher is byte-identical.
+    vitals: bool = False
+    vitals_interval: float = 1.0
     # Worker-side hop-ledger participation (docs/observability.md): the
     # batcher measures device phases (h2d/compile/execute/d2h + overlap
     # ratio) and the worker flushes each request's timeline to the task

@@ -1131,15 +1131,18 @@ class Gateway:
         """
         task_id = request.match_info["task_id"]
 
-        def answer(record) -> web.Response:
+        async def answer(record) -> web.Response:
             """The poll response; ``?ledger=1`` (opt-in — the default
             wire shape is byte-identical) attaches the task's hop-ledger
             timeline when the store carries one
-            (docs/observability.md)."""
+            (docs/observability.md). Await-transparent like every store
+            verb: the rig's ring store fetches the timeline from the
+            OWNING shard node over the wire."""
             payload = record.to_dict()
             if request.query.get("ledger", "") not in ("", "0", "false"):
                 getter = getattr(self.store, "get_ledger", None)
-                payload["Ledger"] = getter(task_id) if getter else []
+                payload["Ledger"] = (await _aresult(getter(task_id))
+                                     if getter else [])
             return web.json_response(payload)
 
         try:
@@ -1167,12 +1170,12 @@ class Gateway:
             record = await self._feed_for(task_id).wait_terminal(task_id,
                                                                  wait)
             if record is not None:
-                return answer(record)
+                return await answer(record)
             try:
                 task = await _aresult(self.store.get(task_id))
             except TaskNotFound:
                 return web.Response(status=404, text="Task not found.")
-        return answer(task)
+        return await answer(task)
 
     def _feed_for(self, task_id: str):
         """The change feed a long-poll for ``task_id`` parks on: the

@@ -45,6 +45,13 @@ log = logging.getLogger("ai4e_tpu.observability")
 # terminal transition simply records no e2e sample.
 _MAX_TRACKED = 65536
 
+# In-flight fire-and-forget wire-stamp bound: against a wedged or
+# failing-over shard each append coroutine can live through seconds of
+# retries, and an uncapped create_task() on the serving hot path would
+# accumulate live tasks/sockets without bound. Beyond the cap the stamp
+# is DROPPED — the same fail-open contract as every other ledger path.
+_MAX_WIRE_STAMPS = 1024
+
 
 class RequestObservability:
     def __init__(self, store, metrics: MetricsRegistry | None = None,
@@ -53,6 +60,10 @@ class RequestObservability:
         self.metrics = metrics or DEFAULT_REGISTRY
         self.flight = flight
         self._lock = threading.Lock()
+        # Strong refs to in-flight fire-and-forget wire stamps (the loop
+        # holds tasks weakly; AIL004) — populated only when the store's
+        # append_ledger is a coroutine function (the rig's ring client).
+        self._wire_stamps: set = set()
         # task_id -> (created epoch seconds, route label, endpoint path)
         self._created: dict[str, tuple[float, str, str]] = {}
         # backend endpoint path -> published gateway prefix (map_route,
@@ -106,15 +117,33 @@ class RequestObservability:
 
     def stamp(self, task_id: str, *events: dict) -> None:
         """Append events to the task's hop ledger; never raises. The
-        fast path is one store call under the store's own lock."""
+        fast path is one store call under the store's own lock. A store
+        whose ``append_ledger`` is async (the rig's ring client — the
+        timeline lives on the owning SHARD's process) gets a
+        fire-and-forget task instead: a stamp must never block the
+        serving path it documents, and the wire client already treats
+        every failure as a droppable 0."""
         if not events:
             return
         try:
-            self.store.append_ledger(task_id, list(events))
+            result = self.store.append_ledger(task_id, list(events))
         except Exception:  # noqa: BLE001; ai4e: noqa[AIL005] — observability is fail-open: an evicted/failing-over task drops its stamp, serving is untouched
             log.debug("ledger stamp dropped for task %s", task_id,
                       exc_info=True)
             return
+        if hasattr(result, "__await__"):
+            import asyncio
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                result.close()  # no loop (teardown): drop the stamp
+                return
+            if len(self._wire_stamps) >= _MAX_WIRE_STAMPS:
+                result.close()  # shard wedged: drop, never accumulate
+                return
+            task = loop.create_task(result)
+            self._wire_stamps.add(task)
+            task.add_done_callback(self._wire_stamps.discard)
         for ev in events:
             self._ledger_events.inc(event=ev.get("e", "?"))
 
@@ -188,6 +217,12 @@ class RequestObservability:
                 try:
                     events = getter(task.task_id)
                 except Exception:  # noqa: BLE001; ai4e: noqa[AIL005] — fail-open: a racing eviction loses the timeline, not the recording
+                    events = []
+                if hasattr(events, "__await__"):
+                    # Wire store: this listener is synchronous; record
+                    # the entry without the remote timeline (the shard
+                    # node's own flight recorder keeps the full one).
+                    events.close()
                     events = []
             self.flight.record(task.task_id, route, status=task.status,
                                duration_ms=duration_ms, events=events,

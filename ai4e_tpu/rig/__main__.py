@@ -35,6 +35,8 @@ def _topology_from_args(args) -> Topology:
         workers=args.workers, loadgens=args.loadgens,
         rate=args.rate, duration=args.duration, ramp=args.ramp,
         chaos=not args.no_chaos, seed=args.seed,
+        collector=not args.no_collector,
+        observability=not args.no_observability,
         work_ms=args.work_ms, base_port=args.base_port,
         workdir=args.workdir, max_inflight=args.max_inflight,
         task_timeout=args.task_timeout)
@@ -84,6 +86,12 @@ def main(argv=None) -> int:
     up.add_argument("--task-timeout", type=float, default=60.0)
     up.add_argument("--no-chaos", action="store_true",
                     help="measure only; skip the fault timeline")
+    up.add_argument("--no-collector", action="store_true",
+                    help="skip the fleet-telemetry collector role")
+    up.add_argument("--no-observability", action="store_true",
+                    help="no hop-ledger stamps / flight rings / vitals "
+                         "samplers / timeline (the serving fleet "
+                         "byte-identical to PR 11)")
     up.add_argument("--out", default=None,
                     help="artifact directory (rig.json is written here)")
 
@@ -94,12 +102,12 @@ def main(argv=None) -> int:
     soak.add_argument("--out", default="/tmp/soak")
 
     for role in ("storenode", "gatewaynode", "balancer", "dispatchernode",
-                 "workernode", "loadgen"):
+                 "workernode", "loadgen", "collector"):
         p = sub.add_parser(role)
         p.add_argument("--spec", required=True)
         if role in ("storenode", "dispatchernode", "workernode"):
             p.add_argument("--shard", type=int, required=True)
-        if role != "balancer":
+        if role not in ("balancer", "collector"):
             p.add_argument("--index", type=int,
                            required=role != "storenode",
                            default=-1 if role == "storenode" else None)
@@ -130,6 +138,9 @@ def main(argv=None) -> int:
     elif args.cmd == "balancer":
         from .balancer import run_balancer
         asyncio.run(run_balancer(topo))
+    elif args.cmd == "collector":
+        from .collectornode import run_collectornode
+        asyncio.run(run_collectornode(topo))
     elif args.cmd == "dispatchernode":
         from .dispatchernode import run_dispatchernode
         asyncio.run(run_dispatchernode(topo, args.shard, args.index))

@@ -39,6 +39,10 @@ class Balancer:
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.router.add_get("/healthz", self._health)
         self.app.router.add_get("/metrics", self._metrics)
+        # Vitals BEFORE the catch-all: aiohttp resolves in registration
+        # order, and /v1/debug/vitals must answer here, not proxy.
+        from .nodevitals import attach_vitals
+        attach_vitals(self.app, topo, self.metrics)
         self.app.router.add_route("*", "/{tail:.*}", self._proxy)
         self.app.on_cleanup.append(self._cleanup)
 

@@ -42,11 +42,20 @@ async def run_dispatchernode(topo: Topology, shard: int, index: int) -> None:
     health = BackendHealth(ResiliencePolicy(retry_base_s=0.05,
                                             retry_cap_s=1.0),
                            metrics=metrics)
+    observability = None
+    if topo.observability:
+        # The hub's stamps (popped/delivered/retry/failover/...) ride
+        # fire-and-forget wire appends to the owning shard node; its
+        # store listener is inert here (the ring client's add_listener
+        # is a no-op — terminal accounting lives on the shard nodes).
+        from ..observability.hub import RequestObservability
+        observability = RequestObservability(ring, metrics=metrics)
     dispatcher = Dispatcher(
         broker, endpoint_path(topo.route), topo.worker_urls(shard), ring,
         retry_delay=topo.retry_delay,
         concurrency=topo.dispatcher_concurrency,
-        request_timeout=30.0, metrics=metrics, resilience=health)
+        request_timeout=30.0, metrics=metrics, resilience=health,
+        observability=observability)
 
     app = web.Application()
 
@@ -60,6 +69,8 @@ async def run_dispatchernode(topo: Topology, shard: int, index: int) -> None:
 
     app.router.add_get("/healthz", health_route)
     app.router.add_get("/metrics", metrics_route)
+    from .nodevitals import attach_vitals
+    attach_vitals(app, topo, metrics)
 
     async def start(_app) -> None:
         await dispatcher.start()

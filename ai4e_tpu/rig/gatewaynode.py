@@ -7,8 +7,17 @@ tailed wire change feed — so a replica that did NOT admit a task still
 wakes its long-poll with the record (the satellite regression in
 ``tests/test_longpoll.py`` proves the mechanism; the rig exercises it
 across real processes). Each gateway carries its own per-role
-``MetricsRegistry``; the rig's verdict scrapes and merges every node's
-``/metrics`` into one coherent view.
+``MetricsRegistry``; the rig's fleet collector (and the verdict's
+post-hoc merge) scrape every node's ``/metrics`` into one coherent view.
+
+Observability (``topo.observability``, default on): the gateway gets the
+same ``RequestObservability`` hub the single-process assembly wires —
+``admitted``/``published`` hop-ledger stamps ride fire-and-forget wire
+appends to the OWNING shard node (the timeline lives beside the record),
+refusals feed a local flight-recorder ring served at
+``GET /v1/debug/flight``, and a vitals sampler exports
+``ai4e_process_*``. The store-side half (terminal stamps, e2e latency,
+outcome counters) lives on the shard nodes, which own the records.
 """
 
 from __future__ import annotations
@@ -17,6 +26,7 @@ import logging
 
 from ..gateway.router import Gateway
 from ..metrics import MetricsRegistry
+from .nodevitals import attach_vitals
 from .topology import Topology
 from .wire import RingStoreClient
 
@@ -29,6 +39,12 @@ def build_gateway(topo: Topology) -> tuple[Gateway, RingStoreClient]:
     # The recorded task Endpoint is nominal (dispatchers rebase onto their
     # shard's worker set); its PATH is what names the broker queue.
     gateway.add_async_route(topo.route, topo.worker_urls(0)[0])
+    if topo.observability:
+        from ..observability.flight import FlightRecorder
+        from ..observability.hub import RequestObservability
+        gateway.set_observability(RequestObservability(
+            ring, metrics=gateway.metrics,
+            flight=FlightRecorder(capacity=256, metrics=gateway.metrics)))
     return gateway, ring
 
 
@@ -36,6 +52,7 @@ async def run_gatewaynode(topo: Topology, index: int) -> None:
     from .supervisor import serve_until_signal
 
     gateway, ring = build_gateway(topo)
+    attach_vitals(gateway.app, topo, gateway.metrics)
 
     async def start_tails(_app) -> None:
         await ring.start_feed_tails()

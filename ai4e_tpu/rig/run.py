@@ -27,6 +27,7 @@ import time
 
 from . import chaos as rig_chaos
 from . import verdict as rig_verdict
+from ..observability.federation import fetch_json as _fetch_json
 from .supervisor import Supervisor, python_argv
 from .topology import Topology
 
@@ -67,6 +68,11 @@ def _spawn_topology(topo: Topology, sup: Supervisor) -> None:
         spawn(f"gateway{g}", "gatewaynode", topo.gateway_port(g),
               "--index", str(g))
     spawn("balancer", "balancer", topo.balancer_port())
+    if topo.collector:
+        # Last: its first scrape should find a healthy fleet, so a
+        # boot-time unreachable gateway doesn't flip the conservation
+        # check to advisory before traffic even starts.
+        spawn("collector", "collector", topo.collector_port())
     for name in list(sup.children):
         sup.wait_healthy(name)
 
@@ -135,11 +141,57 @@ async def _drain_backlogs(topo: Topology, timeout: float) -> dict:
     return {"drained": left == 0, "left": left}
 
 
+async def _collect_observability(topo: Topology) -> dict:
+    """Pre-teardown sweep of the fleet's memory-only observability
+    state: hop ledgers (they die with the store processes), per-role
+    vitals rings, flight-recorder rings, and the collector's live fleet
+    snapshot. Everything best-effort — a chaos-killed node contributes
+    nothing, which is itself recorded."""
+    out: dict = {"ledgers": {}, "vitals": {}, "flight": {}, "fleet": None}
+
+    def get(url: str):
+        return asyncio.to_thread(_fetch_json, url, 5.0)
+
+    # All fetches are independent — gather them (against saturated
+    # survivors every endpoint can take seconds, and a serial sweep of
+    # ~20 URLs would add tens of seconds before the verdict).
+    async def shard_ledgers(s: int) -> dict:
+        for base in topo.shard_urls(s):
+            dump = await get(base + "/v1/rig/ledgers")
+            if dump is not None:
+                return dump.get("Ledgers", {})
+            # next node: one live node per shard carries the timelines
+        return {}
+
+    urls = topo.metrics_urls()
+    flight_names = [n for n in urls if n.startswith(("gateway", "store"))]
+    fleet, ledger_dumps, vitals, flights = await asyncio.gather(
+        (get(topo.collector_url() + "/v1/debug/fleet")
+         if topo.collector else asyncio.sleep(0)),
+        asyncio.gather(*(shard_ledgers(s) for s in range(topo.shards))),
+        asyncio.gather(*(get(base + "/v1/debug/vitals")
+                         for base in urls.values())),
+        asyncio.gather(*(get(urls[n] + "/v1/debug/flight")
+                         for n in flight_names)))
+    out["fleet"] = fleet if topo.collector else None
+    for dump in ledger_dumps:
+        out["ledgers"].update(dump)
+    for name, vit in zip(urls, vitals):
+        if vit is not None and vit.get("recent"):
+            out["vitals"][name] = vit["recent"]
+    for name, flight in zip(flight_names, flights):
+        if flight is not None and "entries" in flight:
+            out["flight"][name] = flight
+    return out
+
+
 async def run_rig(topo: Topology, out_dir: str | None = None) -> dict:
     os.makedirs(topo.workdir, exist_ok=True)
     # A stale run's journals/windows would contaminate the verdict.
     for pattern in ("*.jsonl", "*.jsonl.replica*", "loadgen-*.json",
-                    "*.log", "*.salvage.json"):
+                    "*.log", "*.salvage.json", "timeline.json",
+                    "fleet.json", "flight-*.json", "ledgers.json",
+                    "vitals.json"):
         for path in glob.glob(os.path.join(topo.workdir, pattern)):
             os.unlink(path)
     topo.save(topo.spec_path())
@@ -178,6 +230,10 @@ async def run_rig(topo: Topology, out_dir: str | None = None) -> dict:
         # are recorded as unreachable, which is itself evidence.
         result["metrics"] = rig_verdict.scrape_and_merge(
             rig_verdict.metrics_urls(topo))
+        # The observability sweep must also beat teardown: hop ledgers,
+        # vitals rings, and flight rings are memory-only state.
+        observed = await _collect_observability(topo)
+        result["fleet"] = observed["fleet"]
         loadgen_failures = [n for n in names
                             if sup.children[n].proc.returncode]
         result["loadgen_failures"] = loadgen_failures
@@ -185,7 +241,19 @@ async def run_rig(topo: Topology, out_dir: str | None = None) -> dict:
     # at its final byte.
     result["verdict"] = rig_verdict.compute_verdict(topo)
     result["finished_at"] = time.time()
-    result["ok"] = bool(result["verdict"]["ok"] and not loadgen_failures)
+    # The live collector's conservation cross-check feeds the verdict:
+    # CONFIRMED breaches (terminal outcomes outran admissions with no
+    # counter loss to excuse it) fail the run beside the journal
+    # reconciliation; advisory ones (counters died with a chaos-killed
+    # proc) are recorded but never gate — the journals stay
+    # authoritative (docs/deployment.md).
+    conservation = ((observed["fleet"] or {}).get("conservation")
+                    or {"ok": True, "violations": []})
+    result["verdict"]["conservation"] = conservation
+    result["ok"] = bool(result["verdict"]["ok"]
+                        and conservation.get("ok", True)
+                        and not loadgen_failures)
+    _write_observability_artifacts(topo, result, observed, out_dir)
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
         out_path = os.path.join(out_dir, "rig.json")
@@ -193,6 +261,52 @@ async def run_rig(topo: Topology, out_dir: str | None = None) -> dict:
             json.dump(result, fh, indent=1)
         log.info("rig artifact written to %s", out_path)
     return result
+
+
+def _write_observability_artifacts(topo: Topology, result: dict,
+                                   observed: dict,
+                                   out_dir: str | None) -> None:
+    """The run as one loadable Perfetto timeline + the raw pieces. The
+    artifact directory always gets them; on a RED verdict they ALSO
+    land in the workdir beside the journals/logs — the teardown
+    artifacts CI uploads, so a red run ships the timelines that explain
+    it, not just the journals that convict it."""
+    from ..observability.timeline import build_chrome_trace
+
+    samples = {}
+    for w in result.get("verdict", {}).get("windows", ()):
+        if w.get("samples"):
+            samples[f"loadgen{w.get('loadgen', '?')}"] = w["samples"]
+    timeline = build_chrome_trace(observed["ledgers"],
+                                  chaos=result.get("chaos"),
+                                  vitals=observed["vitals"],
+                                  loadgen_samples=samples)
+
+    def dump_into(directory: str) -> None:
+        os.makedirs(directory, exist_ok=True)
+
+        def write(name: str, payload) -> None:
+            with open(os.path.join(directory, name), "w",
+                      encoding="utf-8") as fh:
+                json.dump(payload, fh)
+
+        write("timeline.json", timeline)
+        write("ledgers.json", {"Ledgers": observed["ledgers"]})
+        write("vitals.json", observed["vitals"])
+        if observed["fleet"] is not None:
+            write("fleet.json", observed["fleet"])
+        for name, flight in observed["flight"].items():
+            write(f"flight-{name}.json", flight)
+
+    if out_dir:
+        dump_into(out_dir)
+        log.info("timeline.json (%d tasks, %d procs) written to %s",
+                 timeline["otherData"]["tasks"],
+                 len(timeline["otherData"]["procs"]), out_dir)
+    if not result["ok"]:
+        dump_into(topo.workdir)
+        log.warning("verdict violated: flight rings + fleet snapshot + "
+                    "timeline dumped into %s", topo.workdir)
 
 
 def summarize(result: dict) -> str:
@@ -217,4 +331,11 @@ def summarize(result: dict) -> str:
     for event in result.get("chaos", ()):
         lines.append(f"  chaos @+{event['at']}s {event['verb']} "
                      f"{'ok' if event.get('ok') else 'FAILED'}")
+    cons = v.get("conservation")
+    if cons is not None:
+        lines.append(
+            f"  fleet conservation: "
+            f"{'ok' if cons.get('ok', True) else 'VIOLATED'} "
+            f"({len(cons.get('violations', []))} recorded"
+            f"{', degraded — counters lost with killed procs' if cons.get('degraded') else ''})")
     return "\n".join(lines)

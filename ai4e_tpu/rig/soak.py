@@ -19,6 +19,7 @@ import subprocess
 import sys
 import time
 
+from ..observability.vitals import read_rss_mb
 from .supervisor import Supervisor, python_argv
 
 log = logging.getLogger("ai4e_tpu.rig.soak")
@@ -28,12 +29,12 @@ WK_PORT = 18890
 
 
 def _rss_mb(pid: int | None) -> float:
-    try:
-        with open(f"/proc/{pid}/status", encoding="ascii") as fh:
-            kb = fh.read().split("VmRSS:")[1].split()[0]
-        return round(int(kb) / 1024.0, 1)
-    except (OSError, IndexError, TypeError):
-        return -1.0  # process died
+    """Child RSS via the shared vitals parser. The None guard is
+    load-bearing: a vanished child's pid is None, and the helper's
+    pid=None means '/proc/self' — without the guard a dead child would
+    read as the soak DRIVER's own RSS and the death check below
+    (`< 0` breaks the loop) would never fire."""
+    return read_rss_mb(pid) if pid is not None else -1.0
 
 
 def _write_specs(out: str) -> None:

@@ -61,6 +61,7 @@ log = logging.getLogger("ai4e_tpu.rig.storenode")
 
 MOVE_SLOT_PATH = "/v1/rig/move_slot"
 IMPORT_PATH = "/v1/rig/import"
+LEDGERS_PATH = "/v1/rig/ledgers"
 
 
 class SlotFence:
@@ -152,6 +153,32 @@ class _FeedStream:
             self._subs.discard(q)
 
 
+class _PrimaryGatedStore:
+    """The store as the observability hub sees it: listener callbacks
+    fire only while this node is the shard's PRIMARY. A replica's store
+    fires the same listeners while ABSORBING the primary's stream — and
+    the primary already counted those transitions in ITS registry, so an
+    ungated hub would double-count every terminal outcome fleet-wide
+    once per replica (the conservation cross-check's exact failure
+    mode). The tail between the dead primary's last scrape and a
+    promotion is honestly LOST from the fleet counters — documented in
+    docs/deployment.md; the journal-based verdict stays authoritative.
+    Everything except ``add_listener`` passes through untouched."""
+
+    def __init__(self, store):
+        self._store = store
+
+    def add_listener(self, callback) -> None:
+        def gated(task) -> None:
+            if self._store.role == "primary":
+                callback(task)
+
+        self._store.add_listener(gated)
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+
 class StoreNode:
     def __init__(self, topo: Topology, shard: int, index: int):
         """``index`` -1 = the shard's primary; >= 0 = replica ``index``."""
@@ -182,6 +209,23 @@ class StoreNode:
         self.store.set_publisher(self.broker.publish)
         self.feed = _FeedStream()
         self.store.add_listener(self.feed.on_task)
+        self.flight = None
+        self.observability = None
+        if topo.observability:
+            # The record-owning half of the observability plane: the
+            # hub's store listener stamps `completed` onto each
+            # timeline, observes created→terminal e2e latency, counts
+            # ai4e_request_outcomes_total (the conservation check's
+            # terminal side), and keeps this shard's flight-recorder
+            # ring — all primary-gated so replica absorption never
+            # double-counts (see _PrimaryGatedStore).
+            from ..observability.flight import FlightRecorder
+            from ..observability.hub import RequestObservability
+            self.flight = FlightRecorder(capacity=256,
+                                         metrics=self.metrics)
+            self.observability = RequestObservability(
+                _PrimaryGatedStore(self.store), metrics=self.metrics,
+                flight=self.flight)
         self.link: ShardReplicaLink | None = None
         if self.is_replica:
             self.link = ShardReplicaLink(
@@ -221,8 +265,12 @@ class StoreNode:
         app.router.add_post(BROKER_DONE_PATH, self._broker_done)
         app.router.add_post(MOVE_SLOT_PATH, self._move_slot)
         app.router.add_post(IMPORT_PATH, self._import_records)
+        app.router.add_get(LEDGERS_PATH, self._dump_ledgers)
+        app.router.add_get("/v1/debug/flight", self._flight_dump)
         app.router.add_get("/healthz", self._health)
         app.router.add_get("/metrics", self._metrics)
+        from .nodevitals import attach_vitals
+        attach_vitals(app, self.topo, self.metrics)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -254,6 +302,25 @@ class StoreNode:
 
     async def _get_slots(self, _: web.Request) -> web.Response:
         return web.json_response(self.fence.to_dict())
+
+    async def _dump_ledgers(self, request: web.Request) -> web.Response:
+        """Every resident hop-ledger timeline (bounded) — the driver's
+        pre-teardown sweep for the Perfetto timeline export: the ledgers
+        are memory-only and die with this process."""
+        try:
+            limit = int(request.query.get("limit", "5000"))
+        except ValueError:
+            return web.json_response({"error": "bad limit"}, status=400)
+        ledgers = self.store.dump_ledgers(limit=limit)
+        return web.json_response({"Shard": self.shard,
+                                  "Ledgers": ledgers,
+                                  "count": len(ledgers)})
+
+    async def _flight_dump(self, _: web.Request) -> web.Response:
+        if self.flight is None:
+            return web.json_response(
+                {"error": "observability off"}, status=404)
+        return web.json_response(self.flight.dump())
 
     async def _set_slot(self, request: web.Request) -> web.Response:
         """Fence propagation: the move driver (or the source node) flips a
@@ -484,14 +551,58 @@ class StoreNode:
                                 "unreachable (%s); watchdog armed",
                                 self.shard, self.index, exc)
                 elif now - down_since >= watchdog_s:
-                    await self._promote()
-                    return
+                    if await self._primary_alive():
+                        # Starved, not dead: the r13 observability plane
+                        # caught the rig's primaries at 1.7 s+ event-loop
+                        # lag under saturation — enough for the stream
+                        # tail to time out past watchdog_s while the
+                        # primary still serves. Promoting then is a
+                        # SPLIT BRAIN (two writers, mass task loss — a
+                        # red r13 take recorded exactly that). A
+                        # SIGKILLed primary refuses the probe instantly,
+                        # so real failover pays ~one RTT; a wedged-but-
+                        # listening one delays failover by at most the
+                        # probe timeout per watchdog period
+                        # (docs/deployment.md residual).
+                        log.warning(
+                            "shard %d replica %d: primary stream dark "
+                            "%.1fs but /healthz still answers — starved,"
+                            " not dead; watchdog re-armed",
+                            self.shard, self.index, now - down_since)
+                        down_since = None
+                    else:
+                        await self._promote()
+                        return
             except RuntimeError:
                 return  # promoted out from under the tail (absorb refused)
             except Exception:  # noqa: BLE001 — keep tailing through transient absorb errors
                 log.exception("shard %d replica %d: tail failed; retrying",
                               self.shard, self.index)
             await asyncio.sleep(interval)
+
+    async def _primary_alive(self) -> bool:
+        """Last-chance liveness probe before self-promotion: does the
+        primary still answer ``/healthz`` as a primary, given a generous
+        timeout? Distinguishes dead (connection refused — promote now)
+        from starved (late 200 — re-arm)."""
+        import aiohttp
+        timeout = float(self.topo.extra.get("promote_probe_timeout_s",
+                                            10.0))
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                        self.link.primary_url + "/healthz",
+                        timeout=aiohttp.ClientTimeout(
+                            total=timeout)) as resp:
+                    if resp.status != 200:
+                        return False
+                    payload = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                ValueError):
+            return False
+        # A deposed/demoted holdover answering as a follower is not a
+        # live primary — promotion should proceed.
+        return payload.get("role") == "primary"
 
     async def _promote(self) -> None:
         """The failover: drain the dead primary's journal file (durable

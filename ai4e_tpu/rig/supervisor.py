@@ -83,23 +83,19 @@ def _listen_inodes(port: int) -> set[str]:
 
 
 def pids_listening_on(port: int) -> list[int]:
-    """PIDs holding a LISTEN socket on ``port`` — inode → /proc/*/fd scan."""
+    """PIDs holding a LISTEN socket on ``port`` — inode → /proc/*/fd scan
+    (fd readlinks via the shared vitals helper)."""
+    from ..observability.vitals import proc_fd_links
+
     inodes = _listen_inodes(port)
     if not inodes:
         return []
     wanted = {f"socket:[{ino}]" for ino in inodes}
     pids = []
     for fd_dir in glob.glob("/proc/[0-9]*/fd"):
-        try:
-            for fd in os.listdir(fd_dir):
-                try:
-                    if os.readlink(os.path.join(fd_dir, fd)) in wanted:
-                        pids.append(int(fd_dir.split("/")[2]))
-                        break
-                except OSError:
-                    continue
-        except OSError:
-            continue
+        pid = fd_dir.split("/")[2]
+        if any(target in wanted for _fd, target in proc_fd_links(pid)):
+            pids.append(int(pid))
     return pids
 
 

@@ -14,7 +14,8 @@ Port layout (``base_port`` from ``--base-port`` or ``AI4E_RIG_BASE_PORT``,
 default 18800; all on ``host``):
 
 - balancer:          base
-- gateway g:         base + 1 + g
+- gateway g:         base + 1 + g      (g bounded by the collector slot)
+- collector:         base + 19         (fleet telemetry, docs/deployment.md)
 - shard s primary:   base + 20 + s
 - shard s replica r: base + 40 + s * replicas_max + r
 - dispatcher d of s: base + 60 + s * dispatchers_max + d  (health/metrics)
@@ -57,6 +58,10 @@ class Topology:
     retry_delay: float = 0.2
     work_ms: float = 0.0       # artificial per-request worker time
     chaos: bool = True
+    observability: bool = True  # hop-ledger stamps + flight rings per role
+    collector: bool = True     # fleet-telemetry collector process
+    scrape_interval: float = 2.0   # collector scrape period (s)
+    vitals_interval: float = 1.0   # per-role vitals sample period (s)
     seed: int = 20260803
     host: str = "127.0.0.1"
     base_port: int = 18800
@@ -68,6 +73,10 @@ class Topology:
     def __post_init__(self):
         if self.gateways < 1 or self.shards < 1:
             raise ValueError("topology needs >= 1 gateway and >= 1 shard")
+        if self.gateways > 18:
+            # Gateway g lives at base+1+g; g=17 (the 18th gateway) takes
+            # base+18, the last slot before the collector's base+19.
+            raise ValueError("gateways must be <= 18 (port layout)")
         if not (1 <= self.replicas <= _REPLICAS_MAX):
             raise ValueError(f"replicas must be 1..{_REPLICAS_MAX}")
         if not (1 <= self.dispatchers <= _DISPATCHERS_MAX):
@@ -84,6 +93,9 @@ class Topology:
 
     def gateway_port(self, g: int) -> int:
         return self.base_port + 1 + g
+
+    def collector_port(self) -> int:
+        return self.base_port + 19
 
     def shard_port(self, s: int) -> int:
         return self.base_port + 20 + s
@@ -127,8 +139,33 @@ class Topology:
     def replica_journal_path(self, s: int, r: int) -> str:
         return os.path.join(self.workdir, f"shard{s}.replica{r}.jsonl")
 
+    def collector_url(self) -> str:
+        return self._url(self.collector_port())
+
+    def metrics_urls(self) -> dict[str, str]:
+        """Every scrapeable node, by proc name — the rig verdict's
+        post-hoc merge and the live collector's target set share this
+        one map (the collector excludes itself)."""
+        urls = {"balancer": self.balancer_url()}
+        for g in range(self.gateways):
+            urls[f"gateway{g}"] = self.gateway_urls()[g]
+        for s in range(self.shards):
+            urls[f"store{s}"] = self.shard_urls(s)[0]
+            for r in range(self.replicas):
+                urls[f"store{s}r{r}"] = self.shard_urls(s)[1 + r]
+            for d in range(self.dispatchers):
+                urls[f"dispatcher{s}.{d}"] = \
+                    self._url(self.dispatcher_port(s, d))
+            for w in range(self.workers):
+                urls[f"worker{s}.{w}"] = self._url(self.worker_port(s, w))
+        if self.collector:
+            urls["collector"] = self.collector_url()
+        return urls
+
     def all_ports(self) -> list[int]:
         ports = [self.balancer_port()]
+        if self.collector:
+            ports.append(self.collector_port())
         ports += [self.gateway_port(g) for g in range(self.gateways)]
         for s in range(self.shards):
             ports.append(self.shard_port(s))

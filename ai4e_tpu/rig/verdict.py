@@ -38,7 +38,6 @@ import glob
 import json
 import logging
 import os
-import re
 import urllib.request
 
 from ..chaos.invariants import InvariantChecker
@@ -221,30 +220,10 @@ def compute_verdict(topo: Topology) -> dict:
 
 
 # -- per-role metrics scrape + merge ----------------------------------------
-
-_SERIES_RE = re.compile(
-    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[-+0-9.eE]+)$")
-
-
-def parse_prometheus(text: str) -> dict[tuple[str, str], float]:
-    """{(metric, sorted-label-string): value} for one exposition page."""
-    out: dict[tuple[str, str], float] = {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        m = _SERIES_RE.match(line)
-        if m is None:
-            continue
-        labels = m.group("labels") or ""
-        key = (m.group("name"),
-               ",".join(sorted(p.strip() for p in labels.split(",") if p)))
-        try:
-            out[key] = out.get(key, 0.0) + float(m.group("value"))
-        except ValueError:
-            continue
-    return out
+#
+# The parse/merge core lives in observability/federation.py now — the
+# LIVE FleetCollector (the collector rig role, `ai4e_tpu top`) and this
+# post-hoc teardown merge are the same code; only the timing differs.
 
 
 def scrape_and_merge(urls: dict[str, str],
@@ -255,7 +234,9 @@ def scrape_and_merge(urls: dict[str, str],
     its one registry. Returns ``{"merged": {...}, "per_role": {...},
     "unreachable": [...]}`` with merged keys rendered as
     ``name{labels}``."""
-    merged: dict[tuple[str, str], float] = {}
+    from ..observability.federation import (merge_series, parse_prometheus,
+                                            render_key)
+    per_proc: dict[str, dict] = {}
     per_role: dict[str, int] = {}
     unreachable: list[str] = []
     for role, base in urls.items():
@@ -269,32 +250,15 @@ def scrape_and_merge(urls: dict[str, str],
             # merge records the gap instead of failing the scrape.
             unreachable.append(role)
             continue
+        per_proc[role] = series
         per_role[role] = len(series)
-        for key, value in series.items():
-            merged[key] = merged.get(key, 0.0) + value
-
-    def render(key: tuple[str, str]) -> str:
-        name, labels = key
-        return f"{name}{{{labels}}}" if labels else name
-
-    return {"merged": {render(k): v for k, v in sorted(merged.items())},
+    merged = merge_series(per_proc)
+    return {"merged": {render_key(k): v for k, v in sorted(merged.items())},
             "per_role_series": per_role,
             "unreachable": unreachable}
 
 
 def metrics_urls(topo: Topology) -> dict[str, str]:
-    """Every scrapeable node in the topology, by role name."""
-    urls = {"balancer": topo.balancer_url()}
-    for g in range(topo.gateways):
-        urls[f"gateway{g}"] = topo.gateway_urls()[g]
-    for s in range(topo.shards):
-        urls[f"store{s}"] = topo.shard_urls(s)[0]
-        for r in range(topo.replicas):
-            urls[f"store{s}r{r}"] = topo.shard_urls(s)[1 + r]
-        for d in range(topo.dispatchers):
-            urls[f"dispatcher{s}.{d}"] = \
-                f"http://{topo.host}:{topo.dispatcher_port(s, d)}"
-        for w in range(topo.workers):
-            urls[f"worker{s}.{w}"] = \
-                f"http://{topo.host}:{topo.worker_port(s, w)}"
-    return urls
+    """Every scrapeable node in the topology, by role name (the
+    topology owns the map; the live collector uses the same one)."""
+    return topo.metrics_urls()

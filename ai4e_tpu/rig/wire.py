@@ -220,8 +220,58 @@ class RingStoreClient(TaskManagerBase):
         is the correct degraded answer rather than a wire round trip."""
         return 0
 
-    def get_ledger(self, task_id: str) -> list[dict]:
-        return []  # hop ledgers stay on the shard nodes (fail-open)
+    async def get_ledger(self, task_id: str) -> list[dict]:
+        """The task's hop-ledger timeline, fetched from the OWNING shard
+        node (it lives beside the record in that store's memory). The
+        wire form of the sharded facade's empty→None ownership re-check:
+        an empty timeline from a node that may have just handed the slot
+        away re-checks the fence table once and re-asks the new owner —
+        without it, ``trace --task-id`` against the rig answered ``[]``
+        for every task (the PR 11 fail-open this closes). Still
+        fail-open on transport errors: the ledger is telemetry, and a
+        mid-failover read answers empty, never raises."""
+        rechecked = False
+        while True:
+            try:
+                resp, body = await self._routed(
+                    task_id, "GET", "/v1/taskstore/ledger",
+                    params={"taskId": task_id})
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                    NotPrimaryError):
+                return []
+            if resp.status != 200:
+                return []
+            try:
+                events = json.loads(body).get("Events") or []
+            except ValueError:
+                return []
+            if events or rechecked:
+                return events
+            rechecked = True
+            shard = self.shard_for(task_id)
+            if not await self._refresh_slots(shard) \
+                    or self.shard_for(task_id) == shard:
+                return []
+
+    async def append_ledger(self, task_id: str, events: list[dict]) -> int:
+        """Hop-ledger append ring-routed to the owning shard — how the
+        rig gateway's admitted/published stamps (and the echo worker's
+        execute stamp) land beside the record. Fail-open like every
+        ledger path: a stamp that cannot land is dropped, serving is
+        untouched."""
+        try:
+            resp, body = await self._routed(
+                task_id, "POST", "/v1/taskstore/ledger", check_miss=True,
+                data=json.dumps({"TaskId": task_id, "Events": events}))
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                NotPrimaryError):
+            return 0
+        if resp.status != 200:
+            return 0
+        try:
+            return int(json.loads(body).get("appended", 0))
+        except (ValueError, TypeError):
+            return 0
 
     def add_listener(self, listener) -> None:
         """No-op: cross-process components ride the wire feed instead."""

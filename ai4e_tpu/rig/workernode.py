@@ -20,6 +20,7 @@ import time
 from aiohttp import web
 
 from ..metrics import MetricsRegistry
+from ..observability.ledger import EXECUTE, ledger_event
 from ..taskstore import TaskNotFound, TaskStatus
 from .topology import Topology
 from .wire import RingStoreClient
@@ -45,6 +46,9 @@ class EchoWorker:
         self.app.router.add_post(route, self._run)
         self.app.router.add_post(route + "/{tail:.*}", self._run)
         self.app.on_cleanup.append(self._cleanup)
+        # Strong refs to in-flight fire-and-forget ledger stamps
+        # (AIL004 — the loop holds tasks weakly).
+        self._stamps: set[asyncio.Task] = set()
 
     async def _health(self, _: web.Request) -> web.Response:
         return web.json_response({"status": "healthy", "shard": self.shard})
@@ -62,10 +66,26 @@ class EchoWorker:
         if not task_id:
             return web.json_response({"error": "taskId header required"},
                                      status=400)
+        t0 = time.perf_counter()
         if self.topo.work_ms > 0:
             # Real CPU burn off the event loop — service time that actually
             # contends for the core, not a sleep that hides it.
             await asyncio.to_thread(self._burn, self.topo.work_ms / 1000.0)
+        if self.topo.observability and len(self._stamps) < 256:
+            # The worker's service-time slice on the task's timeline,
+            # fire-and-forget to the owning shard node (the hot path at
+            # rig rates must not wait on telemetry; beyond the in-flight
+            # cap the stamp is dropped — a wedged shard must not
+            # accumulate stamp tasks). ms-carrying events follow the
+            # t-is-start contract (render_ledger/timeline.py compute
+            # end = t + ms), so back-date t to the burn start.
+            elapsed = time.perf_counter() - t0
+            stamp = asyncio.get_running_loop().create_task(
+                self.ring.append_ledger(task_id, [ledger_event(
+                    EXECUTE, "worker", t=time.time() - elapsed,
+                    ms=elapsed * 1e3)]))
+            self._stamps.add(stamp)
+            stamp.add_done_callback(self._stamps.discard)
         try:
             await self.ring.set_result(
                 task_id, body or b"{}",
@@ -102,7 +122,9 @@ class EchoWorker:
 
 
 async def run_workernode(topo: Topology, shard: int, index: int) -> None:
+    from .nodevitals import attach_vitals
     from .supervisor import serve_until_signal
     worker = EchoWorker(topo, shard)
+    attach_vitals(worker.app, topo, worker.metrics)
     await serve_until_signal(worker.app, topo.host,
                              topo.worker_port(shard, index))

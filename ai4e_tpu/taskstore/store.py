@@ -434,6 +434,19 @@ class InMemoryTaskStore(StoreSideEffects):
         with self._lock:
             return list(self._ledgers.get(task_id, ()))
 
+    def dump_ledgers(self, limit: int = 5000) -> dict[str, list[dict]]:
+        """Every resident timeline (bounded) — the rig driver's
+        pre-teardown collection surface (``GET /v1/rig/ledgers``): hop
+        ledgers are memory-only observability state, so the timeline
+        exporter must read them out before the process dies with them
+        (docs/observability.md). Newest-stamped last; reads never
+        raise."""
+        with self._lock:
+            items = list(self._ledgers.items())
+        if limit >= 0:
+            items = items[-limit:] if limit else []
+        return {tid: list(evs) for tid, evs in items}
+
     # -- retention (terminal-history eviction) ------------------------------
 
     def evict_terminal_older_than(self, age_s: float) -> int:

@@ -1364,3 +1364,143 @@ class TestMetricsDrift:
                          for f in files if f.endswith(".py"))
         result = Analyzer([MetricsDrift()], root=root).run(sorted(paths))
         assert [f.render() for f in result.findings] == []
+
+
+# -- AIL011 ledger-vocabulary drift -------------------------------------------
+
+
+class TestLedgerVocabularyDrift:
+    DOC_OK = textwrap.dedent("""\
+        # Observability
+
+        <!-- ai4e:ledger-vocabulary -->
+        | event | stamped by |
+        |---|---|
+        | `admitted` | gateway |
+        | `h2d`, `execute` | device |
+        <!-- /ai4e:ledger-vocabulary -->
+
+        Prose mentioning `popped` outside the table never counts.
+
+        <!-- ai4e:flight-reasons -->
+        | reason | kept because |
+        |---|---|
+        | `failed` | terminal failed |
+        | `sampled` | baseline stride |
+        <!-- /ai4e:flight-reasons -->
+        """)
+
+    LEDGER_OK = textwrap.dedent("""\
+        ADMITTED = "admitted"
+        H2D = "h2d"
+        EXECUTE = "execute"
+        MAX_EVENTS = 128
+        """)
+
+    FLIGHT_OK = textwrap.dedent("""\
+        REASON_FAILED = "failed"
+        REASON_SAMPLED = "sampled"
+        """)
+
+    def _project(self, tmp_path, doc=None, ledger=None, flight=None,
+                 extra=None):
+        from ai4e_tpu.analysis.rules.ledger_vocab import \
+            LedgerVocabularyDrift
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "observability.md").write_text(
+            self.DOC_OK if doc is None else doc)
+        obs = tmp_path / "observability"
+        obs.mkdir()
+        (obs / "ledger.py").write_text(
+            self.LEDGER_OK if ledger is None else ledger)
+        (obs / "flight.py").write_text(
+            self.FLIGHT_OK if flight is None else flight)
+        paths = [str(obs / "ledger.py"), str(obs / "flight.py")]
+        if extra is not None:
+            (tmp_path / "caller.py").write_text(extra)
+            paths.append(str(tmp_path / "caller.py"))
+        return Analyzer([LedgerVocabularyDrift()],
+                        root=str(tmp_path)).run(sorted(paths)).findings
+
+    def test_in_sync_project_is_clean(self, tmp_path):
+        assert self._project(tmp_path) == []
+
+    def test_undocumented_event_and_reason(self, tmp_path):
+        findings = self._project(
+            tmp_path,
+            ledger=self.LEDGER_OK + 'POPPED = "popped"\n',
+            flight=self.FLIGHT_OK + 'REASON_SLOW = "slow"\n')
+        msgs = [f.message for f in findings]
+        assert any("'popped'" in m and "absent from" in m for m in msgs)
+        assert any("'slow'" in m and "absent from" in m for m in msgs)
+        assert len(findings) == 2
+
+    def test_stale_doc_rows_both_tables(self, tmp_path):
+        doc = self.DOC_OK.replace("| `admitted` | gateway |",
+                                  "| `admitted` | gateway |\n"
+                                  "| `vanished` | nowhere |")
+        doc = doc.replace("| `failed` | terminal failed |",
+                          "| `failed` | terminal failed |\n"
+                          "| `gone` | nothing |")
+        findings = self._project(tmp_path, doc=doc)
+        msgs = [f.message for f in findings]
+        assert any("'vanished'" in m and "no code defines" in m
+                   for m in msgs)
+        assert any("'gone'" in m and "no code defines" in m for m in msgs)
+        stale = [f for f in findings if "'vanished'" in f.message]
+        assert stale[0].path == "docs/observability.md"
+
+    def test_literal_stamp_outside_vocabulary(self, tmp_path):
+        findings = self._project(tmp_path, extra=textwrap.dedent("""\
+            from observability.ledger import ledger_event
+
+            def f(buf, hub, tid, e):
+                buf.stamp("admitted", "gateway")     # vocabulary: fine
+                buf.stamp("typo_event", "gateway")   # NOT vocabulary
+                ledger_event("execute", "device")    # fine
+                hub.stamp(tid, e)                    # non-literal: fine
+            """))
+        assert len(findings) == 1
+        assert "'typo_event'" in findings[0].message
+        assert findings[0].path == "caller.py"
+
+    def test_missing_marked_region_is_itself_a_finding(self, tmp_path):
+        findings = self._project(
+            tmp_path, doc="# Observability\n\nno markers at all\n")
+        msgs = [f.message for f in findings]
+        assert any("ai4e:ledger-vocabulary" in m and "no" in m
+                   for m in msgs)
+        assert any("ai4e:flight-reasons" in m for m in msgs)
+        assert len(findings) == 2
+
+    def test_prose_outside_markers_never_counts(self, tmp_path):
+        # `popped` appears in prose — neither documented (code side
+        # would flag it if the constant existed) nor stale (doc side
+        # must not read it as a table row).
+        assert self._project(tmp_path) == []
+
+    def test_non_vocabulary_project_is_silent(self, tmp_path):
+        from ai4e_tpu.analysis.rules.ledger_vocab import \
+            LedgerVocabularyDrift
+        (tmp_path / "plain.py").write_text("x = 1\n")
+        findings = Analyzer([LedgerVocabularyDrift()],
+                            root=str(tmp_path)).run(
+            [str(tmp_path / "plain.py")]).findings
+        assert findings == []
+
+    def test_whole_repo_in_sync(self):
+        """The real tree: the observability.md vocabulary tables and
+        the ledger/flight constants agree both directions, and every
+        literal stamp in the codebase uses a vocabulary event — the
+        gate CI now enforces."""
+        from ai4e_tpu.analysis.rules.ledger_vocab import \
+            LedgerVocabularyDrift
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pkg = os.path.join(root, "ai4e_tpu")
+        paths = []
+        for dirpath, _dirs, files in os.walk(pkg):
+            paths.extend(os.path.join(dirpath, f)
+                         for f in files if f.endswith(".py"))
+        result = Analyzer([LedgerVocabularyDrift()],
+                          root=root).run(sorted(paths))
+        assert [f.render() for f in result.findings] == []

@@ -1,0 +1,377 @@
+"""Fleet metrics federation (observability/federation.py): parse/merge,
+bounded-cardinality labels, the sound cross-tick conservation check with
+its confirmed/advisory distinction, the live collector against real HTTP
+targets, and the `top` dashboard renderer. JAX-free."""
+
+from __future__ import annotations
+
+import asyncio
+import http.server
+import threading
+
+import pytest
+
+from ai4e_tpu.metrics import MetricsRegistry
+from ai4e_tpu.observability.federation import (FleetCollector, merge_series,
+                                               parse_prometheus, render_key,
+                                               role_of)
+from ai4e_tpu.observability.top import render_top
+
+GW_PAGE = """# HELP ai4e_gateway_requests_total Gateway requests
+# TYPE ai4e_gateway_requests_total counter
+ai4e_gateway_requests_total{outcome="created",route="/v1/echo"} 10
+ai4e_gateway_requests_total{outcome="413",route="/v1/echo"} 1
+ai4e_process_rss_bytes 1048576
+ai4e_process_loop_lag_max_seconds 0.002
+"""
+
+STORE_PAGE = """ai4e_request_outcomes_total{outcome="ok",route="/v1/echo"} 6
+ai4e_request_outcomes_total{outcome="failed",route="/v1/echo"} 1
+ai4e_process_rss_bytes 2097152
+"""
+
+
+class _MetricsServer:
+    """One fake role: serves a settable exposition page at /metrics."""
+
+    def __init__(self, page: str):
+        self.page = page
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib contract
+                body = outer.page.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                     Handler)
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def fake_fleet():
+    gw = _MetricsServer(GW_PAGE)
+    store = _MetricsServer(STORE_PAGE)
+    yield gw, store
+    gw.stop()
+    store.stop()
+
+
+class TestParseMerge:
+    def test_parse_page(self):
+        series = parse_prometheus(GW_PAGE)
+        assert series[("ai4e_gateway_requests_total",
+                       'outcome="created",route="/v1/echo"')] == 10
+        assert series[("ai4e_process_rss_bytes", "")] == 1048576
+
+    def test_merge_sums_same_keys(self):
+        a = parse_prometheus(GW_PAGE)
+        b = parse_prometheus(GW_PAGE)
+        merged = merge_series({"g0": a, "g1": b})
+        assert merged[("ai4e_gateway_requests_total",
+                       'outcome="created",route="/v1/echo"')] == 20
+        assert render_key(("x", "")) == "x"
+        assert render_key(("x", 'a="1"')) == 'x{a="1"}'
+
+    def test_role_of(self):
+        assert role_of("gateway0") == "gateway"
+        assert role_of("store1r0") == "store"
+        assert role_of("dispatcher0.1") == "dispatcher"
+        assert role_of("worker0.0") == "worker"
+        assert role_of("balancer") == "balancer"
+
+    def test_verdict_scrape_and_merge_delegates(self, fake_fleet):
+        # The post-hoc teardown merge and the live collector share one
+        # parse/merge core (the promotion satellite): verdict's output
+        # shape is unchanged.
+        from ai4e_tpu.rig.verdict import scrape_and_merge
+        gw, store = fake_fleet
+        view = scrape_and_merge({"gateway0": gw.url, "store0": store.url,
+                                 "dead": "http://127.0.0.1:9"})
+        assert view["unreachable"] == ["dead"]
+        assert view["merged"][
+            'ai4e_gateway_requests_total{outcome="created",'
+            'route="/v1/echo"}'] == 10
+        assert view["per_role_series"]["store0"] == 3
+
+
+class TestFleetCollector:
+    def _collect(self, coro):
+        return asyncio.run(coro)
+
+    def test_scrape_snapshot_and_merged_labels(self, fake_fleet):
+        gw, store = fake_fleet
+        m = MetricsRegistry()
+        col = FleetCollector({"gateway0": gw.url, "store0": store.url},
+                             metrics=m)
+
+        async def run():
+            await col.scrape_once()
+            return col.snapshot(), col.render_merged()
+
+        snap, merged = self._collect(run())
+        assert snap["fleet"]["admitted"] == 10
+        assert snap["fleet"]["terminal"] == 7
+        assert snap["fleet"]["in_flight"] == 3
+        assert snap["per_proc"]["gateway0"]["up"] is True
+        assert snap["per_proc"]["gateway0"]["rss_bytes"] == 1048576
+        assert snap["per_proc"]["store0"]["outcomes"] == {"ok": 6,
+                                                          "failed": 1}
+        assert snap["conservation"]["ok"] is True
+        # Merged exposition carries proc+role labels and is itself
+        # parseable by the same parser (round-trip honesty).
+        reparsed = parse_prometheus(merged)
+        assert reparsed[("ai4e_process_rss_bytes",
+                         'proc="gateway0",role="gateway"')] == 1048576
+        assert m.gauge("ai4e_fleet_up").value(proc="store0") == 1
+
+    def test_dead_target_keeps_last_seen_lower_bound(self, fake_fleet):
+        gw, store = fake_fleet
+        col = FleetCollector({"gateway0": gw.url, "store0": store.url},
+                             metrics=MetricsRegistry())
+
+        async def run():
+            await col.scrape_once()
+            store.stop()
+            await col.scrape_once()
+            return col.snapshot()
+
+        snap = self._collect(run())
+        assert snap["per_proc"]["store0"]["up"] is False
+        # The monotonic counters' last observation survives as a lower
+        # bound — the fleet terminal count doesn't vanish with the proc.
+        assert snap["fleet"]["terminal"] == 7
+
+    def test_conservation_cross_tick_bound_confirmed(self, fake_fleet):
+        """terminal(k) > admitted(k+1) is a REAL breach when no
+        admitted-side proc was lost: more terminal outcomes than
+        admissions ever issued."""
+        gw, store = fake_fleet
+        col = FleetCollector({"gateway0": gw.url, "store0": store.url},
+                             metrics=MetricsRegistry())
+
+        async def run():
+            await col.scrape_once()
+            # The store suddenly claims 99 completions while the gateway
+            # only ever admitted 10 — a duplicate/phantom flood.
+            store.page = STORE_PAGE.replace(
+                'outcome="ok",route="/v1/echo"} 6',
+                'outcome="ok",route="/v1/echo"} 99')
+            await col.scrape_once()
+            await col.scrape_once()
+            return col.snapshot()
+
+        snap = self._collect(run())
+        cons = snap["conservation"]
+        assert cons["ok"] is False
+        assert cons["confirmed_violations"]
+        assert cons["confirmed_violations"][0]["kind"] == \
+            "terminal_exceeds_admitted"
+
+    def test_no_false_positive_within_one_tick(self, fake_fleet):
+        """The unsound same-tick comparison would flag terminal >
+        admitted-as-scraped-earlier; the cross-tick bound must not: a
+        fleet where completions caught up between the two reads is
+        healthy."""
+        gw, store = fake_fleet
+        col = FleetCollector({"gateway0": gw.url, "store0": store.url},
+                             metrics=MetricsRegistry())
+
+        async def run():
+            await col.scrape_once()
+            # Both advance between ticks; terminal(k)=7 <= admitted(k+1).
+            gw.page = GW_PAGE.replace("} 10", "} 20")
+            store.page = STORE_PAGE.replace("} 6", "} 18")
+            await col.scrape_once()
+            return col.snapshot()
+
+        snap = self._collect(run())
+        assert snap["conservation"]["violations"] == []
+
+    def test_gateway_loss_degrades_to_advisory(self, fake_fleet):
+        """A chaos-killed gateway takes un-scraped admissions with it:
+        later breaches are recorded but confirmed=false, and the
+        overall conservation verdict stays ok (the journal verdict is
+        authoritative for degraded runs — docs/deployment.md)."""
+        gw, store = fake_fleet
+        col = FleetCollector({"gateway0": gw.url, "store0": store.url},
+                             metrics=MetricsRegistry())
+
+        async def run():
+            await col.scrape_once()
+            gw.stop()  # the kill
+            await col.scrape_once()
+            store.page = STORE_PAGE.replace(
+                'outcome="ok",route="/v1/echo"} 6',
+                'outcome="ok",route="/v1/echo"} 50')
+            await col.scrape_once()
+            await col.scrape_once()
+            return col.snapshot()
+
+        snap = self._collect(run())
+        cons = snap["conservation"]
+        assert cons["degraded"] is True
+        assert cons["violations"], "breach must still be RECORDED"
+        assert all(not v["confirmed"] for v in cons["violations"])
+        assert cons["ok"] is True
+
+    def test_proc_cardinality_is_bounded(self, fake_fleet):
+        gw, _store = fake_fleet
+        col = FleetCollector({f"gateway{i}": gw.url for i in range(6)},
+                             metrics=MetricsRegistry(), max_procs=4)
+
+        async def run():
+            await col.scrape_once()
+            return col.render_merged()
+
+        merged = self._collect(run())
+        reparsed = parse_prometheus(merged)
+        procs = {lbl for (_n, lbl) in reparsed}
+        assert any('proc="other"' in lbl for lbl in procs)
+        named = {lbl for lbl in procs if 'proc="gateway' in lbl}
+        assert len({lbl.split('proc="')[1].split('"')[0]
+                    for lbl in named}) == 4
+        # The overflow procs' series still COUNT (collapsed, not lost).
+        assert reparsed[("ai4e_process_rss_bytes",
+                         'proc="other",role="other"')] == 2 * 1048576
+
+    def test_requires_targets(self):
+        with pytest.raises(ValueError):
+            FleetCollector({})
+
+
+class TestTopRenderer:
+    def _snap(self, t=100.0, req=50.0):
+        return {
+            "t": t, "targets": 2, "ticks": 1,
+            "fleet": {"admitted": 10, "terminal": 7, "in_flight": 3,
+                      "up": 2},
+            "conservation": {"ok": True, "violations": [],
+                             "confirmed_violations": [],
+                             "degraded": False},
+            "per_proc": {
+                "gateway0": {"role": "gateway", "up": True,
+                             "requests_total": req,
+                             "outcomes": {}, "loop_lag_max_s": 0.004,
+                             "rss_bytes": 50 * 1024 * 1024,
+                             "open_fds": 12, "slo_burn_max": None},
+                "store0": {"role": "store", "up": True,
+                           "requests_total": 0.0,
+                           "outcomes": {"ok": 6, "failed": 1},
+                           "loop_lag_max_s": None, "rss_bytes": None,
+                           "open_fds": None, "slo_burn_max": 2.5},
+            },
+        }
+
+    def test_frame_contents_and_rates(self):
+        prev = self._snap(t=100.0, req=50.0)
+        cur = self._snap(t=102.0, req=70.0)
+        frame = render_top(cur, prev)
+        assert "conservation OK" in frame
+        assert "gateway0" in frame and "store0" in frame
+        assert "10.0" in frame          # (70-50)/2s
+        assert "85.7%" in frame         # 6 ok / 7 terminal
+        assert "4ms" in frame           # loop lag
+        assert "50M" in frame           # rss
+        assert "2.5" in frame           # burn
+
+    def test_violated_and_degraded_frame(self):
+        snap = self._snap()
+        snap["conservation"] = {
+            "ok": False, "degraded": True,
+            "violations": [{"kind": "terminal_exceeds_admitted",
+                            "confirmed": True, "t": 1.0}],
+            "confirmed_violations": [{"kind": "terminal_exceeds_admitted",
+                                      "confirmed": True, "t": 1.0}]}
+        frame = render_top(snap)
+        assert "VIOLATED" in frame
+        assert "degraded" in frame
+        assert "confirmed conservation violation" in frame
+
+    def test_once_against_live_collector(self, fake_fleet):
+        """`top --targets ... --once` end-to-end: one frame, exit 0."""
+        from ai4e_tpu.observability.top import run_top
+        gw, store = fake_fleet
+        frames = []
+        rc = asyncio.run(run_top(
+            targets=f"gateway0={gw.url},store0={store.url}",
+            once=True, out=frames.append))
+        assert rc == 0
+        assert len(frames) == 1
+        assert "gateway0" in frames[0]
+        assert "admitted 10" in frames[0]
+
+    def test_top_requires_a_source(self):
+        from ai4e_tpu.observability.top import run_top
+        assert asyncio.run(run_top()) == 2
+
+
+class TestConservationSoundness:
+    def test_counter_reset_degrades_to_advisory(self, fake_fleet):
+        """A supervisor-RESTARTED gateway resets its registry without
+        the scrape ever failing (the replacement answers the next tick)
+        — the up→down heuristic can't see it, but the monotonic counter
+        going backward can. Breaches after a reset must be advisory,
+        not a false CONFIRMED conviction the journals would overturn."""
+        gw, store = fake_fleet
+        col = FleetCollector({"gateway0": gw.url, "store0": store.url},
+                             metrics=MetricsRegistry())
+
+        async def run():
+            await col.scrape_once()
+            # Restart: admitted counter falls 10 -> 2 between ticks
+            # while the terminal side keeps its history.
+            gw.page = GW_PAGE.replace("} 10", "} 2")
+            await col.scrape_once()
+            await col.scrape_once()
+            return col.snapshot()
+
+        snap = asyncio.run(run())
+        cons = snap["conservation"]
+        assert cons["degraded"] is True
+        assert cons["violations"], "the breach is still RECORDED"
+        assert all(not v["confirmed"] for v in cons["violations"])
+        assert cons["ok"] is True
+
+    def test_conservation_off_is_view_only(self, fake_fleet):
+        """conservation=False (top --targets, non-rig surfaces whose
+        sync/refusal outcomes never had an admission): totals still
+        serve, no violations ever recorded, snapshot says unchecked."""
+        gw, store = fake_fleet
+        col = FleetCollector({"gateway0": gw.url, "store0": store.url},
+                             metrics=MetricsRegistry(),
+                             conservation=False)
+
+        async def run():
+            await col.scrape_once()
+            # A shape that WOULD violate: terminal >> admitted.
+            store.page = STORE_PAGE.replace(
+                'outcome="ok",route="/v1/echo"} 6',
+                'outcome="ok",route="/v1/echo"} 99')
+            await col.scrape_once()
+            await col.scrape_once()
+            return col.snapshot()
+
+        snap = asyncio.run(run())
+        assert snap["conservation"]["checked"] is False
+        assert snap["conservation"]["violations"] == []
+        assert snap["fleet"]["terminal"] == 100  # the view still serves
+        frame = render_top(snap)
+        assert "conservation unchecked" in frame

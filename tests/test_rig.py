@@ -448,3 +448,216 @@ class TestMoveWindowRace:
                                        schedules=SCHEDULES, seed=SEED)
         assert not report.ok
         assert "stranded" in str(report.failures[0].error)
+
+
+# -- cross-process trace assembly (ISSUE 12 satellite) ------------------------
+
+
+def _post_json(url: str, payload: dict, timeout: float = 10.0) -> dict:
+    import urllib.request
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _get_json(url: str, timeout: float = 10.0) -> dict:
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+class TestCrossProcessTrace:
+    """The PR 11 fail-open (`RingStoreClient.get_ledger -> []`) closed:
+    ledger reads ring-route to the OWNING shard store node, so `trace
+    --task-id --url <gateway>` renders a real cross-process timeline
+    against the live rig — gateway stamps arriving over one wire hop,
+    worker-style stamps over another, the read over a third."""
+
+    def test_trace_renders_a_cross_process_ledger(self, tmp_path, capsys):
+        topo = Topology(gateways=1, shards=1, replicas=1, dispatchers=1,
+                        workers=1, loadgens=1, chaos=False, collector=False,
+                        base_port=28800, workdir=str(tmp_path))
+        # The derived layout must actually be free on this runner.
+        for port in (topo.gateway_port(0), topo.shard_port(0)):
+            ensure_port_free(HOST, port, wait_s=2.0)
+        topo.save(topo.spec_path())
+        store_url = topo.shard_urls(0)[0]
+        gw_url = topo.gateway_urls()[0]
+        argv = [sys.executable, "-m", "ai4e_tpu.rig"]
+        with Supervisor(host=HOST) as sup:
+            sup.spawn("store0",
+                      argv + ["storenode", "--spec", topo.spec_path(),
+                              "--shard", "0", "--index", "-1"],
+                      log_path=str(tmp_path / "store0.log"),
+                      port=topo.shard_port(0),
+                      health_url=store_url + "/healthz")
+            sup.wait_healthy("store0", timeout=60.0)
+            sup.spawn("gateway0",
+                      argv + ["gatewaynode", "--spec", topo.spec_path(),
+                              "--index", "0"],
+                      log_path=str(tmp_path / "gateway0.log"),
+                      port=topo.gateway_port(0),
+                      health_url=gw_url + "/healthz")
+            sup.wait_healthy("gateway0", timeout=60.0)
+
+            created = _post_json(gw_url + topo.route, {"probe": 1})
+            tid = created["TaskId"]
+
+            # A worker-style stamp lands through the task-store ledger
+            # surface on the owning shard (the rig worker's execute
+            # stamp takes exactly this path).
+            appended = _post_json(store_url + "/v1/taskstore/ledger",
+                                  {"TaskId": tid,
+                                   "Events": [{"e": "execute",
+                                               "h": "worker",
+                                               "t": time.time(),
+                                               "ms": 1.5}]})
+            assert appended.get("appended") == 1
+
+            # The gateway's admitted/published stamps are fire-and-forget
+            # wire appends — poll briefly for them to land.
+            events = []
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                record = _get_json(
+                    f"{gw_url}/v1/taskmanagement/task/{tid}?ledger=1")
+                events = record.get("Ledger") or []
+                if {ev["e"] for ev in events} >= {"admitted", "published",
+                                                  "execute"}:
+                    break
+                time.sleep(0.2)
+            names = [ev["e"] for ev in events]
+            assert "admitted" in names and "published" in names, names
+            assert "execute" in names, names
+            # Every event crossed a process boundary to get here: the
+            # ledger lives on the store node, the read came through the
+            # gateway's ring client.
+
+            # The bulk dump the timeline exporter sweeps pre-teardown.
+            dump = _get_json(store_url + "/v1/rig/ledgers")
+            assert tid in dump["Ledgers"]
+
+            # And the one-command render (the satellite's acceptance):
+            # `python -m ai4e_tpu trace --task-id … --url <gateway>`.
+            from ai4e_tpu.cli import main as cli_main
+            cli_main(["trace", "--url", gw_url, "--task-id", tid])
+            out = capsys.readouterr().out
+            assert "admitted" in out and "published" in out
+            assert "execute 1.5ms" in out
+
+
+class TestRigObservabilityOff:
+    def test_no_observability_leaves_roles_bare(self):
+        """`--no-observability` must reproduce the PR 11 serving fleet:
+        no hub on the gateway, no hub/flight on the store node — the
+        same off-means-identical contract the platform assembly keeps
+        for AI4E_PLATFORM_OBSERVABILITY."""
+        from ai4e_tpu.rig.gatewaynode import build_gateway
+        from ai4e_tpu.rig.storenode import StoreNode
+        topo = Topology(observability=False, workdir="/tmp/ai4e-rig-idt")
+        import os
+        os.makedirs(topo.workdir, exist_ok=True)
+        gateway, _ring = build_gateway(topo)
+        assert gateway._observability is None
+        node = StoreNode(topo, shard=0, index=-1)
+        try:
+            assert node.observability is None
+            assert node.flight is None
+        finally:
+            node.store.close()
+        # ...and no vitals either: no sampler task, no debug route, no
+        # ai4e_process_* series (review finding: the help text promises
+        # a telemetry-FREE fleet, vitals included).
+        from aiohttp import web
+        from ai4e_tpu.metrics import MetricsRegistry
+        from ai4e_tpu.rig.nodevitals import attach_vitals
+        app = web.Application()
+        metrics = MetricsRegistry()
+        hooks_before = len(app.on_startup)
+        assert attach_vitals(app, topo, metrics) is None
+        assert not list(app.router.routes())
+        assert len(app.on_startup) == hooks_before
+        assert "ai4e_process_" not in metrics.render_prometheus()
+
+    def test_observability_on_wires_the_plane(self):
+        from ai4e_tpu.rig.gatewaynode import build_gateway
+        from ai4e_tpu.rig.storenode import StoreNode
+        topo = Topology(observability=True, workdir="/tmp/ai4e-rig-idt")
+        import os
+        os.makedirs(topo.workdir, exist_ok=True)
+        gateway, _ring = build_gateway(topo)
+        assert gateway._observability is not None
+        node = StoreNode(topo, shard=1, index=-1)
+        try:
+            assert node.observability is not None
+            assert node.flight is not None
+            # The hub's terminal accounting is primary-gated: a replica
+            # absorbing its primary's stream must not double-count
+            # fleet-wide outcomes (the conservation check's failure
+            # mode) — proven by flipping the role under a live task.
+            task = APITask(task_id="g-1", endpoint="/v1/echo/run-async",
+                           body=b"{}", status=TaskStatus.CREATED,
+                           backend_status=TaskStatus.CREATED)
+            node.store.upsert(task)
+            node.store.update_status("g-1", TaskStatus.COMPLETED)
+            ok = node.metrics.counter("ai4e_request_outcomes_total")
+            assert ok.value(route="/v1/echo/run-async", outcome="ok") == 1
+        finally:
+            node.store.close()
+
+
+class TestWatchdogStarvationProbe:
+    """The r13 observability plane caught shard primaries at 1.7s+
+    event-loop lag under saturation — past the 2s watchdog window while
+    the primary still served — and one recorded take split-brained
+    (replica promoted beside a live primary; 498 tasks lost). The
+    watchdog now probes /healthz with a generous timeout before
+    promoting: refused = dead (promote), late 200 as primary = starved
+    (re-arm)."""
+
+    def _replica(self, tmp_path, primary_port):
+        from ai4e_tpu.rig.storenode import StoreNode
+        topo = Topology(shards=1, replicas=1, workdir=str(tmp_path),
+                        base_port=primary_port - 20)
+        node = StoreNode(topo, shard=0, index=0)
+        return node
+
+    def test_probe_dead_vs_alive_vs_follower(self, tmp_path):
+        from aiohttp import web
+
+        async def run():
+            port = _free_port()
+            node = self._replica(tmp_path, port)
+            node.topo.extra["promote_probe_timeout_s"] = 5.0
+            try:
+                # Nothing listening: dead — promotion must proceed.
+                assert await node._primary_alive() is False
+
+                role = {"role": "primary"}
+
+                async def health(_req):
+                    await asyncio.sleep(0.3)  # starved: late but alive
+                    return web.json_response(
+                        {"status": "healthy", **role})
+
+                app = web.Application()
+                app.router.add_get("/healthz", health)
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, HOST, port)
+                await site.start()
+                try:
+                    # Late 200 as primary: starved, NOT dead — re-arm.
+                    assert await node._primary_alive() is True
+                    # A deposed holdover answering as follower is not a
+                    # live primary — promotion proceeds.
+                    role["role"] = "follower"
+                    assert await node._primary_alive() is False
+                finally:
+                    await runner.cleanup()
+            finally:
+                node.store.close()
+
+        asyncio.run(run())

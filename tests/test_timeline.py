@@ -1,0 +1,155 @@
+"""Chrome-trace/Perfetto timeline export (observability/timeline.py):
+builder invariants (valid trace-event JSON, lane packing, phase slices,
+chaos instants, vitals counters) and the `timeline` CLI over a rig
+artifact directory. JAX-free."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from ai4e_tpu.observability.timeline import (build_chrome_trace,
+                                             build_from_rig_dir)
+
+T0 = 1000.0
+
+
+def _ledger(offset: float, complete: bool = True) -> list[dict]:
+    evs = [
+        {"e": "admitted", "h": "gateway", "t": T0 + offset,
+         "r": "/v1/echo/run-async"},
+        {"e": "published", "h": "gateway", "t": T0 + offset + 0.001},
+        {"e": "popped", "h": "dispatcher", "t": T0 + offset + 0.01},
+        {"e": "delivered", "h": "dispatcher", "t": T0 + offset + 0.02,
+         "r": "127.0.0.1:8081"},
+        {"e": "execute", "h": "worker", "t": T0 + offset + 0.02,
+         "ms": 5.0},
+    ]
+    if complete:
+        evs.append({"e": "completed", "h": "store",
+                    "t": T0 + offset + 0.03, "r": "completed"})
+    return evs
+
+
+class TestBuilder:
+    def test_document_shape_and_json_serializable(self):
+        doc = build_chrome_trace(
+            {"t1": _ledger(0.0), "t2": _ledger(0.005)},
+            chaos=[{"verb": "kill_gateway", "t": T0 + 0.015,
+                    "gateway": 1, "ok": True}],
+            vitals={"gateway0": [{"t": T0, "lag_s": 0.002,
+                                  "rss_bytes": 1048576, "fds": 9,
+                                  "cpu_s": 1.0, "gc_pause_s": 0.0}]},
+            loadgen_samples={"loadgen0": [{"t": T0 + 0.01,
+                                           "accepted": 2,
+                                           "terminal": 1}]})
+        # Loadable: valid JSON, ints for pid/tid, ts >= 0 everywhere.
+        json.dumps(doc)
+        assert doc["displayTimeUnit"] == "ms"
+        for ev in doc["traceEvents"]:
+            assert isinstance(ev["pid"], int)
+            assert isinstance(ev["tid"], int)
+            if ev["ph"] != "M":
+                assert ev["ts"] >= 0
+        assert doc["otherData"]["tasks"] == 2
+        assert doc["otherData"]["hops"] == ["dispatcher", "gateway",
+                                            "store", "worker"]
+
+    def test_task_slices_and_lane_packing(self):
+        # Two OVERLAPPING tasks must land in different lanes; a third
+        # starting after both end reuses lane 1.
+        doc = build_chrome_trace({"a": _ledger(0.0), "b": _ledger(0.01),
+                                  "c": _ledger(10.0)})
+        slices = {ev["args"]["task_id"]: ev for ev in doc["traceEvents"]
+                  if ev["ph"] == "X" and "task_id" in ev.get("args", {})
+                  and ev["name"] in ("completed", "in-flight")}
+        assert slices["a"]["tid"] != slices["b"]["tid"]
+        assert slices["c"]["tid"] == slices["a"]["tid"]
+        # The slice spans first event -> last (completed), in µs.
+        assert slices["a"]["dur"] == pytest.approx(0.03 * 1e6, rel=1e-3)
+
+    def test_phase_events_become_duration_slices(self):
+        doc = build_chrome_trace({"a": _ledger(0.0)})
+        phases = [ev for ev in doc["traceEvents"]
+                  if ev["ph"] == "X" and ev["name"] == "execute"]
+        assert len(phases) == 1
+        assert phases[0]["dur"] == 5000.0  # 5 ms in µs
+
+    def test_chaos_verbs_are_global_instants(self):
+        doc = build_chrome_trace(
+            {"a": _ledger(0.0)},
+            chaos=[{"verb": "move_slot", "t": T0 + 1.0, "slot": 3,
+                    "src": 0, "dest": 1, "ok": True},
+                   {"verb": "never_fired"}])  # no t -> skipped
+        instants = [ev for ev in doc["traceEvents"]
+                    if ev["ph"] == "i" and ev.get("s") == "g"]
+        assert len(instants) == 1
+        assert instants[0]["name"] == "move_slot"
+        assert instants[0]["args"]["slot"] == 3
+
+    def test_vitals_and_loadgen_counter_tracks(self):
+        doc = build_chrome_trace(
+            {}, vitals={"worker0.0": [
+                {"t": T0, "lag_s": 0.3, "rss_bytes": 2 * 1048576},
+                {"t": T0 + 1, "rss_bytes": -1.0}]},  # dead read skipped
+            loadgen_samples={"loadgen1": [{"t": T0, "accepted": 5,
+                                           "terminal": 2}]})
+        counters = [ev for ev in doc["traceEvents"] if ev["ph"] == "C"]
+        lag = [c for c in counters if c["name"] == "loop_lag_ms"]
+        assert lag and lag[0]["args"]["lag"] == 300.0
+        rss = [c for c in counters if c["name"] == "rss_mb"]
+        assert len(rss) == 1  # the -1 sample contributed nothing
+        tasks = [c for c in counters if c["name"] == "tasks"]
+        assert tasks[0]["args"] == {"accepted": 5, "terminal": 2}
+        names = {ev["args"]["name"] for ev in doc["traceEvents"]
+                 if ev["ph"] == "M"}
+        assert {"proc:worker0.0", "proc:loadgen1"} <= names
+
+    def test_empty_inputs_produce_a_loadable_document(self):
+        doc = build_chrome_trace({})
+        json.dumps(doc)
+        assert doc["otherData"]["tasks"] == 0
+
+
+class TestCliRoundTrip:
+    def _rig_dir(self, tmp_path) -> str:
+        (tmp_path / "rig.json").write_text(json.dumps({
+            "chaos": [{"verb": "kill_gateway", "t": T0 + 0.5,
+                       "ok": True}],
+            "verdict": {"windows": [
+                {"loadgen": 0, "window": {},
+                 "samples": [{"t": T0, "accepted": 1, "terminal": 0}]}]},
+        }))
+        (tmp_path / "ledgers.json").write_text(json.dumps(
+            {"Ledgers": {"t1": _ledger(0.0)}}))
+        (tmp_path / "vitals.json").write_text(json.dumps(
+            {"gateway0": [{"t": T0, "lag_s": 0.001,
+                           "rss_bytes": 1048576}]}))
+        return str(tmp_path)
+
+    def test_build_from_rig_dir(self, tmp_path):
+        doc = build_from_rig_dir(self._rig_dir(tmp_path))
+        assert doc["otherData"]["tasks"] == 1
+        assert any(ev["name"] == "kill_gateway"
+                   for ev in doc["traceEvents"])
+        assert any(ev["ph"] == "C" and ev["name"] == "tasks"
+                   for ev in doc["traceEvents"])
+
+    def test_timeline_cli(self, tmp_path, capsys):
+        from ai4e_tpu.cli import main as cli_main
+        rig_dir = self._rig_dir(tmp_path)
+        cli_main(["timeline", "--rig-dir", rig_dir])
+        out = capsys.readouterr().out
+        assert "timeline.json" in out and "perfetto" in out.lower()
+        doc = json.loads((tmp_path / "timeline.json").read_text())
+        assert doc["otherData"]["tasks"] == 1
+
+    def test_missing_pieces_still_export(self, tmp_path):
+        # Only rig.json (a chaos-only run, observability swept nothing):
+        # the export must still produce a loadable file.
+        (tmp_path / "rig.json").write_text(json.dumps(
+            {"chaos": [], "verdict": {"windows": []}}))
+        doc = build_from_rig_dir(str(tmp_path))
+        json.dumps(doc)
+        assert doc["otherData"]["tasks"] == 0

@@ -1,0 +1,224 @@
+"""Per-process vitals sampler (observability/vitals.py): /proc helpers,
+event-loop-lag detection, GC pause bracketing, and the de-duplication
+satellites (soak RSS watch + supervisor fd scan ride the shared
+helpers). JAX-free."""
+
+from __future__ import annotations
+
+import asyncio
+import gc
+import os
+import random
+import socket
+import time
+
+import pytest
+
+from ai4e_tpu.metrics import MetricsRegistry
+from ai4e_tpu.observability.vitals import (VitalsSampler, proc_fd_links,
+                                           read_cpu_seconds, read_fd_count,
+                                           read_host_cpu_ticks,
+                                           read_rss_bytes, read_rss_mb)
+
+
+def _fake_proc(tmp_path, pid="self", vmrss_kb=2048, utime=120, stime=80,
+               fds=3, steal=(100, 7)):
+    """A minimal /proc tree the helpers can parse."""
+    d = tmp_path / str(pid)
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "status").write_text(
+        f"Name:\tx\nVmPeak:\t  9999 kB\nVmRSS:\t  {vmrss_kb} kB\n")
+    # comm field with spaces+parens — the parser must split after the
+    # LAST ')', the classic /proc/stat trap.
+    stat_fields = ["S", "1", "1", "1", "0", "-1", "4194560", "0", "0",
+                   "0", "0", str(utime), str(stime), "0", "0"]
+    (d / "stat").write_text(f"42 (a (weird) name) {' '.join(stat_fields)}\n")
+    fd_dir = d / "fd"
+    fd_dir.mkdir(exist_ok=True)
+    for stale in fd_dir.iterdir():
+        stale.unlink()
+    for i in range(fds):
+        os.symlink(f"socket:[{1000 + i}]", fd_dir / str(i))
+    idle, st = steal
+    (tmp_path / "stat").write_text(
+        f"cpu  50 0 30 {idle} 5 0 2 {st}\ncpu0 1 2 3 4 5 6 7 8\n")
+    return str(tmp_path)
+
+
+class TestProcHelpers:
+    def test_parse_fake_proc_tree(self, tmp_path):
+        root = _fake_proc(tmp_path)
+        assert read_rss_bytes(proc_root=root) == 2048 * 1024
+        assert read_rss_mb(proc_root=root) == 2.0
+        clk = float(os.sysconf("SC_CLK_TCK"))
+        assert read_cpu_seconds(proc_root=root) == pytest.approx(
+            (120 + 80) / clk)
+        assert read_fd_count(proc_root=root) == 3
+        links = proc_fd_links("self", proc_root=root)
+        assert ("0", "socket:[1000]") in links
+        ticks = read_host_cpu_ticks(proc_root=root)
+        assert ticks["steal"] == 7 and ticks["idle"] == 100
+
+    def test_missing_process_fails_soft(self, tmp_path):
+        assert read_rss_bytes(99999999, proc_root=str(tmp_path)) == -1.0
+        assert read_rss_mb(99999999, proc_root=str(tmp_path)) == -1.0
+        assert read_cpu_seconds(99999999, proc_root=str(tmp_path)) == -1.0
+        assert read_fd_count(99999999, proc_root=str(tmp_path)) == -1
+        assert proc_fd_links(99999999, proc_root=str(tmp_path)) == []
+        assert read_host_cpu_ticks(proc_root=str(tmp_path / "nope")) is None
+
+    @pytest.mark.skipif(not os.path.isdir("/proc/self"),
+                        reason="needs a Linux /proc")
+    def test_real_proc_self(self):
+        assert read_rss_bytes() > 1024 * 1024  # a Python process is > 1 MiB
+        assert read_fd_count() > 0
+        assert read_cpu_seconds() >= 0.0
+        assert any(t.startswith("socket:")
+                   or t.startswith(("/", "pipe:", "anon_inode:"))
+                   for _fd, t in proc_fd_links("self"))
+
+
+class TestSampler:
+    def test_sample_once_updates_gauges_and_history(self, tmp_path):
+        root = _fake_proc(tmp_path)
+        m = MetricsRegistry()
+        s = VitalsSampler(metrics=m, proc_root=root, history=4)
+        sample = s.sample_once(lag_s=0.02)
+        assert sample["rss_bytes"] == 2048 * 1024
+        assert m.gauge("ai4e_process_rss_bytes").value() == 2048 * 1024
+        assert m.gauge("ai4e_process_open_fds").value() == 3
+        assert m.gauge("ai4e_process_loop_lag_max_seconds").value() == \
+            pytest.approx(0.02)
+        # CPU counter counts DELTAS: the first sample only anchors.
+        assert m.counter("ai4e_process_cpu_seconds_total").value() == 0.0
+        for _ in range(6):
+            s.sample_once()
+        assert len(s.recent()) == 4  # bounded ring
+
+    def test_cpu_delta_counts(self, tmp_path):
+        root = _fake_proc(tmp_path, utime=100, stime=0)
+        m = MetricsRegistry()
+        s = VitalsSampler(metrics=m, proc_root=root)
+        s.sample_once()
+        _fake_proc(tmp_path, utime=150, stime=0)
+        s.sample_once()
+        clk = float(os.sysconf("SC_CLK_TCK"))
+        assert m.counter("ai4e_process_cpu_seconds_total").value() == \
+            pytest.approx(50 / clk)
+
+    def test_steal_ratio_from_tick_delta(self, tmp_path):
+        root = _fake_proc(tmp_path, steal=(100, 0))
+        m = MetricsRegistry()
+        s = VitalsSampler(metrics=m, proc_root=root)
+        s.sample_once()
+        # 100 more total ticks, 25 of them stolen.
+        _fake_proc(tmp_path, steal=(175, 25))
+        sample = s.sample_once()
+        assert sample["steal"] == pytest.approx(0.25, abs=0.01)
+        assert m.gauge("ai4e_process_cpu_steal_ratio").value() == \
+            pytest.approx(0.25, abs=0.01)
+
+    def test_gc_pause_bracketing(self):
+        m = MetricsRegistry()
+        s = VitalsSampler(metrics=m)
+        s.install_gc_hook()
+        try:
+            gc.collect()
+        finally:
+            s.remove_gc_hook()
+        hist = m.histogram("ai4e_process_gc_pause_seconds")
+        assert sum(c for _e, c in hist.collect()[0][3]["buckets"]) >= 1
+        total = m.counter("ai4e_process_gc_collections_total")
+        assert total.value(generation="2") >= 1
+        # The accumulated pause lands on the NEXT sample.
+        assert s.sample_once()["gc_pause_s"] >= 0.0
+
+    def test_gc_hook_removed_after_stop(self):
+        s = VitalsSampler(metrics=MetricsRegistry())
+
+        async def run():
+            await s.start()
+            assert s._on_gc in gc.callbacks
+            await s.stop()
+
+        asyncio.run(run())
+        assert s._on_gc not in gc.callbacks
+
+    def test_interval_validation(self):
+        with pytest.raises(ValueError):
+            VitalsSampler(metrics=MetricsRegistry(), interval_s=0)
+
+    def test_chaos_stall_detected_by_loop_lag(self):
+        """Acceptance: a chaos-injected event-loop stall is visibly
+        detected by ``ai4e_process_loop_lag_seconds``. The stall is a
+        seeded blocking call landing ON the loop thread — exactly the
+        AIL001 bug class — while the sampler ticks at 50 ms."""
+        rng = random.Random(20260803)
+        stall_s = 0.2 + rng.random() * 0.2  # seeded 200–400 ms stall
+        m = MetricsRegistry()
+        s = VitalsSampler(metrics=m, interval_s=0.05)
+
+        async def run():
+            await s.start()
+            await asyncio.sleep(0.12)       # healthy baseline ticks
+            time.sleep(stall_s)             # the chaos stall, on the loop
+            await asyncio.sleep(0.12)       # the late tick measures it
+            await s.stop()
+
+        asyncio.run(run())
+        hist = m.histogram("ai4e_process_loop_lag_seconds")
+        # The stall's full duration showed up as lag on the tick that
+        # was due while the loop was blocked.
+        assert hist.collect()[0][3]["sum"] >= stall_s * 0.8
+        assert m.gauge(
+            "ai4e_process_loop_lag_max_seconds").value() >= stall_s * 0.8
+        lags = [smp["lag_s"] for smp in s.recent() if "lag_s" in smp]
+        assert max(lags) >= stall_s * 0.8
+        # ...and the healthy ticks stayed healthy (the stall is a spike,
+        # not a baseline shift).
+        assert min(lags) < 0.05
+
+
+class TestDedupSatellites:
+    def test_soak_rss_rides_the_shared_helper(self):
+        from ai4e_tpu.rig import soak
+        assert soak.read_rss_mb is read_rss_mb
+        # ...but keeps its own None contract: None = child vanished =
+        # -1.0 (the death check), NEVER /proc/self (review finding: the
+        # helper's pid=None means SELF, which would report the driver's
+        # RSS as a dead child's and the soak would hammer a corpse).
+        assert soak._rss_mb(None) == -1.0
+        if os.path.isdir("/proc/self"):
+            assert soak._rss_mb(os.getpid()) == read_rss_mb(os.getpid())
+
+    @pytest.mark.skipif(not os.path.isdir("/proc/self"),
+                        reason="needs a Linux /proc")
+    def test_supervisor_fd_scan_rides_proc_fd_links(self):
+        from ai4e_tpu.rig.supervisor import pids_listening_on
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as srv:
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(1)
+            port = srv.getsockname()[1]
+            assert os.getpid() in pids_listening_on(port)
+        assert os.getpid() not in pids_listening_on(port)
+
+
+class TestAssemblyIdentity:
+    def test_default_assembly_has_no_process_series(self):
+        """Vitals live in the launchers (CLI / rig roles), never in the
+        platform assembly: a default platform's registry must carry no
+        ai4e_process_* series (the observability-off byte-identity
+        contract extends to this layer)."""
+        from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+        platform = LocalPlatform(PlatformConfig())
+        assert "ai4e_process_" not in platform.metrics.render_prometheus()
+
+    def test_vitals_knobs_parse(self):
+        from ai4e_tpu.config import ObservabilitySection
+        sec = ObservabilitySection.from_env(
+            {"AI4E_OBSERVABILITY_VITALS": "1",
+             "AI4E_OBSERVABILITY_VITALS_INTERVAL": "0.5"})
+        assert sec.vitals is True
+        assert sec.vitals_interval == 0.5
+        assert ObservabilitySection.from_env({}).vitals is False
