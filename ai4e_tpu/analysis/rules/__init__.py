@@ -1,6 +1,7 @@
 """Rule registry — one module per rule, ids are append-only stable."""
 
 from .blocking import BlockingCallInAsync
+from .bucket_literal import StaticBucketLadder
 from .config_drift import ConfigDrift
 from .fire_and_forget import FireAndForgetTask
 from .ledger_vocab import LedgerVocabularyDrift
@@ -24,6 +25,7 @@ ALL_RULES = [
     NonatomicReadModifyWrite,
     MetricsDrift,
     LedgerVocabularyDrift,
+    StaticBucketLadder,
 ]
 
 __all__ = ["ALL_RULES"] + [cls.__name__ for cls in ALL_RULES]

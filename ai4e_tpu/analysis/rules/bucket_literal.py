@@ -1,0 +1,94 @@
+"""AIL012 — static bucket/tile ladder literal outside the deriver module.
+
+The bug class: PR 13 made the batch-bucket ladder a live artifact derived
+from the request-shape histogram (``runtime/ladder.py``), replacing the
+hard-coded ``(1, 2, 4, ..., 256)`` tuple that had pinned the device path
+to a traffic guess since the seed. A new literal ladder pasted anywhere
+under ``runtime/`` — a "temporary" default in a family factory, a copy
+of the exposition buckets in the batcher — silently reintroduces exactly
+that static guess, and nothing at runtime would notice: the code works,
+the ladder just stops following traffic. The factory defaults that must
+exist live as named constants in the deriver module, the one place this
+rule does not scan.
+
+A bucket/tile ladder literal is recognized as: a tuple or list whose
+LEADING elements are >= 3 integer constants, strictly ascending,
+starting at 1 (every ladder admits single-example batches; shape tuples
+and stage-size tuples fail the ascending-from-1 test). Trailing
+non-integer elements (e.g. ``float("inf")`` exposition sentinels) do not
+exempt the literal — the pre-PR-13 exposition tuple ended in exactly
+such a sentinel.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, enclosing_symbol
+
+#: Only the serving runtime is in scope — model configs, benches, and
+#: tests legitimately write explicit ladders.
+SCOPE_PART = "runtime/"
+#: The deriver module: the single home for factory-default ladders.
+EXEMPT_SUFFIX = "runtime/ladder.py"
+MIN_RUN = 3
+
+
+def _leading_ints(node) -> list[int]:
+    out: list[int] = []
+    for elt in node.elts:
+        if (isinstance(elt, ast.Constant) and isinstance(elt.value, int)
+                and not isinstance(elt.value, bool)):
+            out.append(elt.value)
+        else:
+            break
+    return out
+
+
+class StaticBucketLadder(Rule):
+    rule_id = "AIL012"
+    name = "static-bucket-ladder"
+    description = ("literal bucket/tile ladder tuples under runtime/ must "
+                   "live in the deriver module (runtime/ladder.py) — the "
+                   "static ladder must not silently come back")
+
+    def check_module(self, ctx):
+        path = ctx.path.replace("\\", "/")
+        if SCOPE_PART not in path or path.endswith(EXEMPT_SUFFIX):
+            return []
+        rule = self
+
+        class _Visitor(ast.NodeVisitor):
+            def __init__(self):
+                self.findings = []
+                self._stack: list[ast.AST] = []
+
+            def _enter(self, node):
+                self._stack.append(node)
+                self.generic_visit(node)
+                self._stack.pop()
+
+            visit_ClassDef = _enter
+            visit_FunctionDef = _enter
+            visit_AsyncFunctionDef = _enter
+
+            def _check(self, node):
+                run = _leading_ints(node)
+                if (len(run) >= MIN_RUN and run[0] == 1
+                        and all(b > a for a, b in zip(run, run[1:]))):
+                    self.findings.append(ctx.finding(
+                        rule.rule_id, node,
+                        f"literal bucket ladder {tuple(run)} in runtime/ "
+                        "— ladders are derived from traffic "
+                        "(runtime/ladder.py); import a named constant "
+                        "from the deriver module instead of hard-coding "
+                        "the static guess",
+                        symbol=enclosing_symbol(self._stack)))
+                self.generic_visit(node)
+
+            visit_Tuple = _check
+            visit_List = _check
+
+        visitor = _Visitor()
+        visitor.visit(ctx.tree)
+        return visitor.findings

@@ -290,34 +290,13 @@ def build_worker(config: FrameworkConfig, models: dict):
         reporter = ProcessingReporterClient(config.service.reporter_uri,
                                             cluster=config.service.cluster)
 
-    batcher = MicroBatcher(runtime, max_wait_ms=rt.batch_max_wait_ms,
-                           max_pending=rt.batch_max_pending,
-                           pipeline_depth=rt.batch_pipeline_depth,
-                           interactive_reserve=rt.batch_interactive_reserve,
-                           priority_aging_s=rt.batch_priority_aging_s,
-                           # Device-phase decomposition rides the same
-                           # switch as the worker's ledger flushes
-                           # (AI4E_OBSERVABILITY_HOP_LEDGER).
-                           measure_phases=config.observability.hop_ledger)
-    admin_keys = None
-    if config.gateway.api_keys is not None:
-        # The reload surface is an operator action: gate it with the same
-        # front-door secret the gateway checks (the reference's APIM keys;
-        # the control plane reuses it for the taskstore too).
-        admin_keys = {k.strip() for k in config.gateway.api_keys.split(",")
-                      if k.strip()}
-    worker = InferenceWorker(
-        models.get("service_name", "tpu-worker"), runtime, batcher,
-        task_manager=task_manager, prefix=models.get("prefix", "v1"),
-        store=store, reporter=reporter,
-        # Hot-reload confinement (ADVICE r5): checkpoints must resolve
-        # under the configured checkpoint mount — without this, anyone who
-        # can reach the worker port could swap the served weights to any
-        # readable path. None (dev, no AI4E_RUNTIME_CHECKPOINT_DIR) keeps
-        # the open single-host behavior.
-        checkpoint_root=rt.checkpoint_dir,
-        admin_api_keys=admin_keys,
-        hop_ledger=config.observability.hop_ledger)
+    # Register every servable BEFORE the batcher exists: with ladder
+    # derivation on, the ai4e_batch_size exposition buckets are built
+    # from the servables' (possibly restored) ladders at batcher
+    # construction, and the persisted-ladder restore must land before
+    # warmup so a restarted worker AOT-warms the traffic-tuned ladder
+    # (docs/device_path.md).
+    to_serve: list[tuple] = []
     for spec in models.get("models", []):
         spec = dict(spec)
         family = spec.pop("family")
@@ -349,6 +328,69 @@ def build_worker(config: FrameworkConfig, models: dict):
             servable.checkpoint_path = checkpoint
             log.info("restored %s params from %s", servable.name, checkpoint)
         runtime.register(servable)
+        to_serve.append((servable, sync_path, async_path, cap,
+                         pipeline_spec, batch))
+
+    ladders = None
+    import jax
+    if rt.ladder_derive and jax.process_count() > 1:
+        # The deriver thread compiles + executes dummy batches on THIS
+        # process alone; over a process-spanning mesh that deadlocks on
+        # collectives and followers would never learn the swapped
+        # ladder (the serving-path compile the swap invariant forbids).
+        # Multi-host keeps the factory ladder, loudly.
+        log.warning("ladder derivation requested but the mesh spans %d "
+                    "processes — single-host only, serving the factory "
+                    "ladder (docs/device_path.md)", jax.process_count())
+    elif rt.ladder_derive:
+        # Traffic-tuned bucket ladders (AI4E_RUNTIME_LADDER_*, docs/
+        # device_path.md): restore any persisted derived ladder now —
+        # BEFORE warmup — so the restarted worker compiles the tuned
+        # ladder and its first serving call stamps execute, not compile.
+        import os
+        from .runtime.ladder import LadderManager
+        ladders = LadderManager(
+            runtime, window_s=rt.ladder_window_s,
+            max_programs=rt.ladder_max_programs,
+            period_s=rt.ladder_period_s, dwell_s=rt.ladder_dwell_s,
+            persist_path=(rt.ladder_path or os.path.join(
+                rt.compile_cache_dir, "ladders.json")))
+        restored = ladders.restore()
+        if restored:
+            log.info("restored derived ladders for %s",
+                     sorted(restored))
+
+    batcher = MicroBatcher(runtime, max_wait_ms=rt.batch_max_wait_ms,
+                           max_pending=rt.batch_max_pending,
+                           pipeline_depth=rt.batch_pipeline_depth,
+                           interactive_reserve=rt.batch_interactive_reserve,
+                           priority_aging_s=rt.batch_priority_aging_s,
+                           # Device-phase decomposition rides the same
+                           # switch as the worker's ledger flushes
+                           # (AI4E_OBSERVABILITY_HOP_LEDGER).
+                           measure_phases=config.observability.hop_ledger,
+                           ladder_manager=ladders,
+                           double_buffer=rt.batch_double_buffer)
+    admin_keys = None
+    if config.gateway.api_keys is not None:
+        # The reload surface is an operator action: gate it with the same
+        # front-door secret the gateway checks (the reference's APIM keys;
+        # the control plane reuses it for the taskstore too).
+        admin_keys = {k.strip() for k in config.gateway.api_keys.split(",")
+                      if k.strip()}
+    worker = InferenceWorker(
+        models.get("service_name", "tpu-worker"), runtime, batcher,
+        task_manager=task_manager, prefix=models.get("prefix", "v1"),
+        store=store, reporter=reporter,
+        # Hot-reload confinement (ADVICE r5): checkpoints must resolve
+        # under the configured checkpoint mount — without this, anyone who
+        # can reach the worker port could swap the served weights to any
+        # readable path. None (dev, no AI4E_RUNTIME_CHECKPOINT_DIR) keeps
+        # the open single-host behavior.
+        checkpoint_root=rt.checkpoint_dir,
+        admin_api_keys=admin_keys,
+        hop_ledger=config.observability.hop_ledger)
+    for servable, sync_path, async_path, cap, pipeline_spec, batch in to_serve:
         worker.serve_model(servable, sync_path=sync_path,
                            async_path=async_path,
                            maximum_concurrent_requests=cap,
@@ -358,7 +400,6 @@ def build_worker(config: FrameworkConfig, models: dict):
                                **(batch if isinstance(batch, dict) else {}))
     runtime.warmup()
 
-    import jax
     if jax.process_count() > 1:
         # Multi-host serving (SURVEY.md §7 hard part #3): the primary's
         # batcher broadcasts each batch so every process enters the same
@@ -464,9 +505,14 @@ async def run_worker(config: FrameworkConfig, models: dict) -> None:
                                interval_s=config.observability
                                .vitals_interval)
         await vitals.start()
-    log.info("worker on %s:%s serving %s%s", config.service.host,
+    log.info("worker on %s:%s serving %s%s%s%s", config.service.host,
              config.service.port, list(worker.runtime.models),
-             ", vitals ON" if vitals is not None else "")
+             ", vitals ON" if vitals is not None else "",
+             # Device-path posture (docs/device_path.md): operators grep
+             # these to confirm the traffic-tuned/overlapped hot path.
+             ", ladder derivation ON" if batcher._ladders is not None
+             else "",
+             ", double-buffered transfers ON" if batcher._double else "")
     try:
         await _wait_for_termination()
     finally:

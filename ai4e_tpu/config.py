@@ -373,6 +373,25 @@ class RuntimeSection:
     # (0 = strict priority).
     batch_interactive_reserve: float = 0.25
     batch_priority_aging_s: float = 2.0
+    # Double-buffered device transfers (docs/device_path.md): h2d/execute/
+    # d2h on dedicated threads with an alternating staging-buffer ring so
+    # batch N+1's device_put overlaps batch N's execute. Off = the fused
+    # single-executor path, byte-identical to the pre-double-buffer worker.
+    batch_double_buffer: bool = False
+    # Traffic-tuned bucket ladders (runtime/ladder.py, docs/device_path.md):
+    # derive each servable's batch buckets from the live cut-size histogram,
+    # AOT-compile in the background, swap atomically, persist beside the
+    # compile cache. Off = static factory ladders, byte-identical batch
+    # path and /metrics.
+    ladder_derive: bool = False
+    ladder_window_s: float = 300.0       # histogram decay half-life
+    ladder_max_programs: int = 16        # compiled-programs budget per model
+    ladder_period_s: float = 60.0        # re-derive cadence per model
+    ladder_dwell_s: float = 120.0        # min seconds between ladder swaps
+    # Persisted derived-ladder file; unset = <compile_cache_dir>/ladders.json
+    # (beside the persistent compilation cache, so a restart AOT-warms the
+    # traffic-tuned ladder).
+    ladder_path: typing.Optional[str] = None
     buckets: typing.Tuple[int, ...] = (1, 8, 32, 64)
     compile_cache_dir: str = "/tmp/ai4e_tpu_xla_cache"
     checkpoint_dir: typing.Optional[str] = None

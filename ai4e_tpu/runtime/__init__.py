@@ -1,17 +1,21 @@
 from .batcher import BatcherSaturated, MicroBatcher
 from .families import FAMILIES, build_servable
 from .handoffs import crops_handoff
+from .ladder import LadderManager, ShapeHistogram, derive_ladder
 from .registry import ModelRuntime, ServableModel, enable_compilation_cache
 from .worker import InferenceWorker
 
 __all__ = [
     "BatcherSaturated",
     "FAMILIES",
+    "LadderManager",
     "MicroBatcher",
     "ModelRuntime",
     "ServableModel",
+    "ShapeHistogram",
     "InferenceWorker",
     "build_servable",
     "crops_handoff",
+    "derive_ladder",
     "enable_compilation_cache",
 ]

@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from .ladder import EXPOSITION_BUCKETS, exposition_buckets
 from .registry import ModelRuntime
 
 log = logging.getLogger("ai4e_tpu.batcher")
@@ -71,6 +72,8 @@ class MicroBatcher:
         interactive_reserve: float = 0.25,
         priority_aging_s: float = 2.0,
         measure_phases: bool = False,
+        ladder_manager=None,
+        double_buffer: bool = False,
     ):
         self.runtime = runtime
         self.max_wait = max_wait_ms / 1000.0
@@ -106,9 +109,22 @@ class MicroBatcher:
                                             thread_name_prefix="tpu-batcher")
         self._window = asyncio.Semaphore(pipeline_depth)
         self._inflight_execs: set[asyncio.Task] = set()
+        # Traffic-tuned ladders (runtime/ladder.py, AI4E_RUNTIME_LADDER_
+        # DERIVE): the manager sees every batch cut and re-derives each
+        # servable's bucket ladder in the background. None (default) =
+        # static factory ladders, no observation overhead.
+        self._ladders = ladder_manager
+        # With derivation on, the ai4e_batch_size exposition buckets are
+        # built from the servables' OWN ladders at construction (the
+        # static copy would drift the moment ladders are derived); with
+        # it off they stay the static exposition ladder so the default
+        # /metrics content is byte-identical to the pre-derivation
+        # platform. Register AFTER all models so the union is complete.
+        expo = (exposition_buckets(runtime.models.values())
+                if ladder_manager is not None else EXPOSITION_BUCKETS)
         self._batch_size_hist = self.metrics.histogram(
             "ai4e_batch_size", "Executed batch sizes",
-            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, float("inf")))
+            buckets=(*expo, float("inf")))
         self._batch_latency = self.metrics.histogram(
             "ai4e_batch_exec_seconds", "Device execution time per batch")
         self._queue_wait = self.metrics.histogram(
@@ -169,6 +185,47 @@ class MicroBatcher:
             self._exec_pending: dict[int, float] = {}
             self._h2d_seconds = 0.0
             self._h2d_overlap_seconds = 0.0
+        # Pad-waste accounting (ai4e_batch_pad_ratio / _pad_bytes_total):
+        # the measurement that justifies — and regression-guards — ladder
+        # derivation (docs/METRICS.md). Gated with the device-phase /
+        # ladder instruments so the default batcher's /metrics stays
+        # byte-identical to the pre-ladder platform.
+        self._pad_enabled = measure_phases or ladder_manager is not None
+        if self._pad_enabled:
+            self._pad_state: dict[str, list[int]] = {}
+            self._pad_ratio = self.metrics.gauge(
+                "ai4e_batch_pad_ratio",
+                "Cumulative padded-slots / occupied-slots per model "
+                "(0 = every executed batch exactly filled its bucket)")
+            self._pad_bytes = self.metrics.counter(
+                "ai4e_batch_pad_bytes_total",
+                "Host-to-device bytes spent on bucket padding, per model")
+        # Double-buffered transfer pipeline (docs/device_path.md#double-
+        # buffered-transfers, AI4E_RUNTIME_BATCH_DOUBLE_BUFFER): h2d,
+        # execute, and d2h run on separate single-thread executors with
+        # an alternating host staging-buffer ring, so batch N+1's
+        # device_put overlaps batch N's execute and batch N's device_get
+        # overlaps batch N+1's execute — the PR 8 overlap ratio's reason
+        # to be > 0. Requires a runtime exposing the split-phase surface
+        # (single-host ModelRuntime); otherwise the fused path serves.
+        self._double = bool(
+            double_buffer
+            and getattr(runtime, "supports_split_phases", None) is not None
+            and runtime.supports_split_phases())
+        if self._double:
+            self._h2d_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tpu-h2d")
+            self._exec_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tpu-exec")
+            self._d2h_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tpu-d2h")
+            # Host staging ring per (model, bucket): pipeline_depth
+            # buffers cycling, so batch N+1 pads into a fresh buffer
+            # while batch N's is still device-bound; the window
+            # semaphore bounds in-flight batches at pipeline_depth, so
+            # a buffer is never reused before its h2d completed.
+            self._staging: dict[tuple[str, int], list] = {}
+            self._staging_idx: dict[tuple[str, int], int] = {}
 
     # -- request side ------------------------------------------------------
 
@@ -232,6 +289,9 @@ class MicroBatcher:
             await asyncio.gather(*self._inflight_execs,
                                  return_exceptions=True)
         self._executor.shutdown(wait=True)
+        if self._double:
+            for pool in (self._h2d_pool, self._exec_pool, self._d2h_pool):
+                pool.shutdown(wait=True)
 
     # -- flusher -----------------------------------------------------------
 
@@ -244,30 +304,39 @@ class MicroBatcher:
                     await asyncio.wait_for(self._wakeup.wait(), timeout=0.5)
                 except asyncio.TimeoutError:
                     continue
-            # Brief accumulation window: let more requests join the batch.
+            # Brief PER-MODEL accumulation window: a model is cut when
+            # ITS OWN largest bucket is full or ITS OWN oldest entry has
+            # waited max_wait; until some model is ready, sleep to the
+            # nearest per-model deadline. (The old global gate anchored
+            # one shared window on the oldest pending anywhere and
+            # compared the longest queue against the GLOBALLY largest
+            # bucket — one model's ladder deciding another's cut, the
+            # cross-model coupling per-model derived ladders cannot
+            # tolerate.)
             if self.max_wait > 0:
-                first = min((p[0].enqueued for p in self._pending.values() if p),
-                            default=time.perf_counter())
-                window = self.max_wait - (time.perf_counter() - first)
-                if window > 0 and self._max_queue_len() < self._largest_bucket():
-                    await asyncio.sleep(window)
+                sleep_for = self._nearest_cut_deadline(time.perf_counter())
+                if sleep_for is not None and sleep_for > 0:
+                    await asyncio.sleep(sleep_for)
+            now = time.perf_counter()
             for model_name in list(self._pending):
                 if not self._pending.get(model_name):
                     continue
+                if not self._cut_ready(model_name, now):
+                    continue  # still accumulating its own window
                 # Acquire the window slot BEFORE carving the batch: while all
                 # slots are busy, arriving requests keep joining the pending
                 # queue, so the batch cut the moment a slot frees is as full
                 # as possible (cutting first would freeze the batch at
                 # whatever had arrived, then let it stale-wait).
                 await self._window.acquire()
-                batch = self._take_batch(model_name)
+                batch, bucket = self._take_batch(model_name)
                 if not batch:
                     self._window.release()
                     continue
                 # Bounded pipelining: admit the batch and keep draining —
                 # don't wait for its results.
                 task = loop.create_task(
-                    self._execute(loop, model_name, batch))
+                    self._execute(loop, model_name, batch, bucket))
                 self._inflight_execs.add(task)
                 self._inflight_gauge.set(len(self._inflight_execs))
 
@@ -278,22 +347,65 @@ class MicroBatcher:
 
                 task.add_done_callback(_done)
 
-    def _max_queue_len(self) -> int:
-        return max((len(v) for v in self._pending.values()), default=0)
+    def _cut_ready(self, model_name: str, now: float) -> bool:
+        """This model's cut decision, against ITS OWN ladder only: full
+        largest bucket, or its oldest pending entry has waited out the
+        accumulation window (max_wait == 0 is always ready)."""
+        queue = self._pending.get(model_name)
+        if not queue:
+            return False
+        servable = self.runtime.models.get(model_name)
+        if servable is not None and len(queue) >= servable.max_bucket:
+            return True
+        return (self.max_wait <= 0
+                or now - queue[0].enqueued >= self.max_wait)
 
-    def _largest_bucket(self) -> int:
-        return max((m.max_bucket for m in self.runtime.models.values()),
-                   default=1)
+    def _nearest_cut_deadline(self, now: float) -> float | None:
+        """Seconds until the FIRST model becomes cut-ready: 0.0 when one
+        already is (full bucket or expired window), the smallest
+        remaining per-model window otherwise, None with nothing
+        pending."""
+        nearest: float | None = None
+        for name, queue in self._pending.items():
+            if not queue:
+                continue
+            if self._cut_ready(name, now):
+                return 0.0
+            remaining = self.max_wait - (now - queue[0].enqueued)
+            nearest = (remaining if nearest is None
+                       else min(nearest, remaining))
+        return nearest
 
-    def _take_batch(self, model_name: str) -> list[_Pending]:
+    def _take_batch(self, model_name: str
+                    ) -> tuple[list[_Pending], int]:
+        """Cut one batch and choose its bucket from ONE snapshot of the
+        servable's ladder. Returns ``(batch, bucket)`` — the bucket is
+        decided HERE, not in ``_execute``: a deriver-thread ladder swap
+        between the cut and the execute would otherwise let
+        ``bucket_for(n)`` clamp to a new, smaller top bucket than the
+        cut itself (IndexError mid-padding, every future in the batch
+        stranded). A bucket chosen from the pre-swap tuple stays safe on
+        either side of a swap — old-ladder programs are never evicted
+        (``_executed_shapes`` is append-only)."""
         queue = self._pending.get(model_name, [])
         if not queue:
-            return []
+            return [], 0
         queue = self._sweep_expired(model_name, queue)
         if not queue:
-            return []
+            return [], 0
         servable = self.runtime.models[model_name]
-        take = min(len(queue), servable.max_bucket)
+        ladder = tuple(servable.batch_buckets)  # single read vs the swap
+        if self._ladders is not None:
+            # Feed the PRE-clamp demand to the ladder deriver — O(1)
+            # histogram update; derivation/compile runs on its own
+            # thread. Observing the post-clamp cut size would let the
+            # ladder only ever ratchet DOWN: once a swap shrinks the top
+            # bucket, every cut is capped at it and the histogram could
+            # never witness the larger demand that should grow the
+            # ladder back (the manager clamps to the FACTORY ladder's
+            # max — the operator's memory bound).
+            self._ladders.observe_cut(model_name, len(queue))
+        take = min(len(queue), ladder[-1])
         if take < len(queue):
             # Cut interactive-first: a background stack never queues ahead
             # of fresh interactive requests when the batch can't hold
@@ -312,7 +424,8 @@ class MicroBatcher:
         batch, rest = queue[:take], queue[take:]
         self._pending[model_name] = rest
         self._pending_gauge.set(self.pending_count)
-        return batch
+        bucket = next((b for b in ladder if b >= take), ladder[-1])
+        return batch, bucket
 
     def _sweep_expired(self, model_name: str,
                        queue: list[_Pending]) -> list[_Pending]:
@@ -342,59 +455,142 @@ class MicroBatcher:
 
     def _note_phases(self, model_name: str, t_call: float,
                      phases: dict, batch: list[_Pending]) -> None:
-        """Account one phased batch: phase histograms, h2d/execute
-        overlap, and per-request ledger stamps. ``t_call`` is the
-        perf-counter start of the batch's device call."""
-        for phase, dur in phases.items():
-            self._phase_hist.observe(dur, phase=phase, model=model_name)
-        h2d = phases.get("h2d", 0.0)
-        exec_dur = phases.get("execute", phases.get("compile", 0.0))
-        h2d_w = (t_call, t_call + h2d)
-        exec_w = (h2d_w[1], h2d_w[1] + exec_dur)
+        """Account one FUSED-path phased batch (``run_batch_phases``
+        measures durations, not wall windows): reconstruct back-to-back
+        windows from the call start and delegate. The double-buffered
+        path calls ``_note_phase_windows`` directly with the real,
+        possibly gapped, per-stage windows."""
+        windows: dict[str, tuple[float, float]] = {}
+        cursor = t_call
+        for phase in ("h2d", "compile", "execute", "d2h"):
+            dur = phases.get(phase)
+            if dur is None:
+                continue
+            windows[phase] = (cursor, cursor + dur)
+            cursor += dur
+        self._note_phase_windows(model_name, windows, batch,
+                                 token=id(batch))
+
+    def _note_phase_windows(self, model_name: str,
+                            windows: dict[str, tuple[float, float]],
+                            batch: list[_Pending],
+                            token: int | None = None) -> None:
+        """Account one batch's measured phase wall windows (perf-counter
+        space): phase histograms, h2d/execute overlap against OTHER
+        batches' execute windows, and per-request ledger stamps.
+        ``token`` identifies this batch in ``_exec_pending`` so its own
+        in-flight execute never counts as overlap."""
         now = time.perf_counter()
-        if h2d > 0:
+        for phase, (w0, w1) in windows.items():
+            self._phase_hist.observe(w1 - w0, phase=phase, model=model_name)
+        h2d_w = windows.get("h2d")
+        exec_w = windows.get("execute", windows.get("compile"))
+        if h2d_w is not None and h2d_w[1] > h2d_w[0]:
+            h2d = h2d_w[1] - h2d_w[0]
             with self._phase_lock:
                 overlap = 0.0
                 for w0, w1 in self._exec_windows:
                     overlap += max(0.0, min(h2d_w[1], w1) - max(h2d_w[0], w0))
-                for token, start in self._exec_pending.items():
-                    if token != id(batch):
+                for tok, start in self._exec_pending.items():
+                    if tok != token:
                         # In-flight batch: execute window approximated
                         # from its call start to now (over-counts by its
-                        # own h2d time; see __init__ comment).
+                        # own h2d time on the fused path; exact on the
+                        # double-buffered path, whose pending entries
+                        # are stamped at execute-stage entry — see
+                        # __init__ comment / docs/observability.md).
                         overlap += max(0.0, min(h2d_w[1], now)
                                        - max(h2d_w[0], start))
                 overlap = min(overlap, h2d)
-                self._exec_windows.append(exec_w)
+                if exec_w is not None:
+                    self._exec_windows.append(exec_w)
                 self._h2d_seconds += h2d
                 self._h2d_overlap_seconds += overlap
                 ratio = (self._h2d_overlap_seconds / self._h2d_seconds
                          if self._h2d_seconds > 0 else 0.0)
             self._overlap_total.inc(overlap, model=model_name)
             self._overlap_ratio.set(ratio)
+        elif exec_w is not None:
+            with self._phase_lock:
+                self._exec_windows.append(exec_w)
         # Ledger stamps ride wall-clock time like every other hop:
         # convert the perf-counter anchors through "now".
         stamped = [p for p in batch if p.ledger is not None]
         if stamped:
-            epoch_call = time.time() - (now - t_call)
-            cursor = epoch_call
+            epoch_off = time.time() - now
             for phase in ("h2d", "compile", "execute", "d2h"):
-                dur = phases.get(phase)
-                if dur is None:
+                w = windows.get(phase)
+                if w is None:
                     continue
                 for p in stamped:
-                    p.ledger.stamp(phase, "device", t=cursor,
-                                   ms=dur * 1e3)
-                cursor += dur
+                    p.ledger.stamp(phase, "device", t=epoch_off + w[0],
+                                   ms=(w[1] - w[0]) * 1e3)
 
-    async def _execute(self, loop, model_name: str,
-                       batch: list[_Pending]) -> None:
+    def _note_pad(self, model_name: str, n: int, bucket: int,
+                  example_nbytes: int) -> None:
+        """Pad-waste accounting at the cut: cumulative padded/occupied
+        slot ratio and padding bytes shipped to the device — the series
+        that justifies (and regression-guards) ladder derivation."""
+        if not self._pad_enabled:
+            return
+        state = self._pad_state.setdefault(model_name, [0, 0])
+        state[0] += bucket - n
+        state[1] += n
+        self._pad_ratio.set(state[0] / state[1], model=model_name)
+        if bucket > n:
+            self._pad_bytes.inc((bucket - n) * example_nbytes,
+                                model=model_name)
+
+    def _staging_buffer(self, model_name: str, bucket: int,
+                        servable) -> np.ndarray:
+        """Next host staging buffer from the (model, bucket) ring — the
+        alternating buffer pair (``pipeline_depth`` deep) that lets
+        batch N+1 pad while batch N's buffer is still transfer-bound.
+        The window semaphore admits at most ``pipeline_depth`` in-flight
+        batches in FIFO order, so a buffer is never handed out again
+        before its previous batch fully completed."""
+        key = (model_name, bucket)
+        # A ladder swap retired buckets: drop their rings, or shifting
+        # traffic accumulates pipeline_depth full-size host buffers per
+        # stale bucket forever (a 512px detector ring is ~200 MB each).
+        # Swept on EVERY call — a shrink-only swap never allocates a new
+        # key, so allocation-time-only eviction would keep the retired
+        # larger ring for the process lifetime. In-flight batches hold
+        # their own references to the arrays, so eviction only releases
+        # this cache; a cut still riding the pre-swap ladder (this
+        # call's ``bucket`` is exempt from the sweep) re-allocates.
+        live = set(servable.batch_buckets)
+        for stale in [k for k in self._staging
+                      if k[0] == model_name and k[1] not in live
+                      and k[1] != bucket]:
+            del self._staging[stale]
+            self._staging_idx.pop(stale, None)
+        ring = self._staging.get(key)
+        if ring is None:
+            ring = [np.zeros((bucket, *servable.input_shape),
+                             servable.input_dtype)
+                    for _ in range(self.pipeline_depth)]
+            self._staging[key] = ring
+            self._staging_idx[key] = 0
+        idx = self._staging_idx[key]
+        self._staging_idx[key] = (idx + 1) % len(ring)
+        return ring[idx]
+
+    async def _execute(self, loop, model_name: str, batch: list[_Pending],
+                       bucket: int) -> None:
+        """Run one cut batch padded to ``bucket`` — chosen at cut time
+        from the same ladder snapshot as the cut itself (see
+        ``_take_batch``); never re-derived here."""
         servable = self.runtime.models[model_name]
         n = len(batch)
-        bucket = servable.bucket_for(n)
         now = time.perf_counter()
         for p in batch:
             self._queue_wait.observe(now - p.enqueued, model=model_name)
+
+        if self._double:
+            await self._execute_pipelined(loop, model_name, servable,
+                                          batch, n, bucket)
+            return
 
         padded = np.zeros((bucket, *servable.input_shape),
                           servable.input_dtype)
@@ -403,6 +599,7 @@ class MicroBatcher:
             if p.ledger is not None:
                 p.ledger.stamp("batched", "batcher",
                                reason=f"size {n} bucket {bucket}")
+        self._note_pad(model_name, n, bucket, padded.nbytes // bucket)
 
         t0 = time.perf_counter()
         # Phase-decomposed path (observability): measured h2d / execute /
@@ -446,6 +643,71 @@ class MicroBatcher:
         self._batch_size_hist.observe(n, model=model_name)
         self._h2d_bytes.inc(padded.nbytes, model=model_name)
         self._d2h_bytes.inc(_tree_nbytes(outputs), model=model_name)
+        await self._deliver(loop, model_name, servable, batch, outputs,
+                            n, poisoned)
+
+    async def _execute_pipelined(self, loop, model_name: str, servable,
+                                 batch: list[_Pending], n: int,
+                                 bucket: int) -> None:
+        """The double-buffered execute path: padding into an alternating
+        staging buffer, then h2d → execute → d2h on three dedicated
+        single-thread executors. The device still serialises compute
+        (one execute thread), but batch N+1's ``device_put`` runs while
+        batch N executes and batch N's ``device_get`` runs while batch
+        N+1 executes — transfer hidden under compute, measured by the
+        phase windows this path hands ``_note_phase_windows`` verbatim
+        (real wall windows, not back-to-back reconstructions)."""
+        buf = self._staging_buffer(model_name, bucket, servable)
+        for i, p in enumerate(batch):
+            buf[i] = p.example
+            if p.ledger is not None:
+                p.ledger.stamp("batched", "batcher",
+                               reason=f"size {n} bucket {bucket}")
+        if n < bucket:
+            buf[n:] = 0  # previous batch's rows must not ride as padding
+        self._note_pad(model_name, n, bucket, buf.nbytes // bucket)
+        token = id(batch)
+        t0 = time.perf_counter()
+        try:
+            device_batch, h2d_w = await loop.run_in_executor(
+                self._h2d_pool, self.runtime.h2d_resident, model_name, buf)
+            if self.measure_phases:
+                # Visible to concurrent batches' overlap accounting from
+                # the moment this batch enters the execute stage.
+                with self._phase_lock:
+                    self._exec_pending[token] = time.perf_counter()
+            try:
+                out, label, exec_w = await loop.run_in_executor(
+                    self._exec_pool, self.runtime.execute_resident,
+                    model_name, device_batch)
+            finally:
+                if self.measure_phases:
+                    with self._phase_lock:
+                        self._exec_pending.pop(token, None)
+            outputs, d2h_w = await loop.run_in_executor(
+                self._d2h_pool, self.runtime.fetch_resident, out)
+        except Exception as exc:  # noqa: BLE001 — device failure fails the batch
+            log.exception("batch execution failed for %s", model_name)
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(exc)
+            return
+        if self.measure_phases:
+            self._note_phase_windows(
+                model_name, {"h2d": h2d_w, label: exec_w, "d2h": d2h_w},
+                batch, token=token)
+        self._batch_latency.observe(d2h_w[1] - t0, model=model_name)
+        self._batch_size_hist.observe(n, model=model_name)
+        self._h2d_bytes.inc(buf.nbytes, model=model_name)
+        self._d2h_bytes.inc(_tree_nbytes(outputs), model=model_name)
+        # Split-phase execution is single-runtime only (the multi-host
+        # mirror loop keeps the fused path): no partial-degrade mode.
+        await self._deliver(loop, model_name, servable, batch, outputs,
+                            n, frozenset())
+
+    async def _deliver(self, loop, model_name: str, servable,
+                       batch: list[_Pending], outputs, n: int,
+                       poisoned: frozenset) -> None:
         if poisoned:
             # Fail exactly the affected tasks — their rows ran on a zeros
             # shard (or a failed follower) and any "result" would be a

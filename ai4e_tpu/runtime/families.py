@@ -21,6 +21,7 @@ import io
 import jax
 import numpy as np
 
+from .ladder import DETECTOR_BUCKETS, IMAGE_BUCKETS
 from .registry import ServableModel
 
 
@@ -145,7 +146,7 @@ def build_echo(name: str = "echo", size: int = 16, buckets=(8,),
 
 
 def build_unet(name: str = "landcover", tile: int = 256,
-               widths=(32, 64, 128), num_classes: int = 8, buckets=(1, 16, 64),
+               widths=(32, 64, 128), num_classes: int = 8, buckets=IMAGE_BUCKETS,
                fused_postprocess: bool = True,
                return_classmap: bool = False,
                wire: str = "rgb8", **_) -> ServableModel:
@@ -230,7 +231,7 @@ def build_unet(name: str = "landcover", tile: int = 256,
 def build_resnet(name: str = "classifier", image_size: int = 224,
                  num_classes: int = 1000, stage_sizes=(3, 4, 6, 3),
                  width: int = 64, labels: list | None = None,
-                 buckets=(1, 16, 64), fused_normalize: bool = True,
+                 buckets=IMAGE_BUCKETS, fused_normalize: bool = True,
                  wire: str = "rgb8", **_) -> ServableModel:
     """Batched species classification (BASELINE.json config #4).
 
@@ -370,7 +371,7 @@ def _dct_servable(name: str, params, apply_on_normalized, h: int, w: int,
 
 def build_detector(name: str = "megadetector", image_size: int = 512,
                    widths=(64, 128, 256), max_detections: int = 64,
-                   score_threshold: float = 0.2, buckets=(1, 8, 16),
+                   score_threshold: float = 0.2, buckets=DETECTOR_BUCKETS,
                    fused_normalize: bool = True,
                    wire: str = "rgb8", **_) -> ServableModel:
     """Camera-trap detection (BASELINE.json config #3, MegaDetector slot).
@@ -418,7 +419,7 @@ def build_detector(name: str = "megadetector", image_size: int = 512,
 
 def build_vit(name: str = "vit", image_size: int = 224, patch: int = 16,
               dim: int = 384, depth: int = 12, heads: int = 6,
-              num_classes: int = 1000, buckets=(1, 16, 64), **_
+              num_classes: int = 1000, buckets=IMAGE_BUCKETS, **_
               ) -> ServableModel:
     from ..models import create_vit
 

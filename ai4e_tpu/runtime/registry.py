@@ -20,6 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .ladder import DEFAULT_BUCKETS
+
 log = logging.getLogger("ai4e_tpu.runtime")
 
 Preprocess = Callable[[bytes, str], np.ndarray]
@@ -47,7 +49,7 @@ class ServableModel:
     input_shape: tuple[int, ...]
     preprocess: Preprocess
     postprocess: Postprocess
-    batch_buckets: tuple[int, ...] = (1, 2, 4, 8)
+    batch_buckets: tuple[int, ...] = DEFAULT_BUCKETS
     input_dtype: Any = np.float32
     version: str = "1.0"
     # Weights provenance for hot reload: the checkpoint this servable's
@@ -175,24 +177,13 @@ class ModelRuntime:
             # the run_batch pass. Without one, parallel mode would compile
             # every program twice; serial is strictly better then.
             log.warning("warmup: persistent compilation cache not enabled "
-                        "(enable_compilation_cache()); using serial warmup")
+                        "(enable_compilation_cache(); see docs/"
+                        "device_path.md#compile-cache-and-aot-warmup); "
+                        "using serial warmup")
             parallel = False
         if parallel and jax.process_count() == 1:
-            from concurrent.futures import ThreadPoolExecutor
-
-            def compile_one(servable, bucket):
-                dummy = jax.ShapeDtypeStruct(
-                    (bucket, *servable.input_shape),
-                    np.dtype(servable.input_dtype))
-                servable._compiled.lower(servable.params, dummy).compile()
-
             jobs = [(s, b) for _, s in todo for b in s.batch_buckets]
-            t0 = time.perf_counter()
-            with ThreadPoolExecutor(max_workers=min(8, max(1, len(jobs)))) as ex:
-                # Surface the first compile error, if any.
-                for f in [ex.submit(compile_one, s, b) for s, b in jobs]:
-                    f.result()
-            compile_s = time.perf_counter() - t0
+            compile_s = self._aot_compile(jobs)
             log.info("warmup: %d programs compiled concurrently in %.1fs",
                      len(jobs), compile_s)
 
@@ -213,6 +204,75 @@ class ModelRuntime:
             log.info("warmup %s: %d buckets in %.1fs", name,
                      len(servable.batch_buckets), times[name])
         return times
+
+    def _aot_compile(self, jobs) -> float:
+        """Concurrently lower+compile ``(servable, bucket)`` programs —
+        the warmup fast path, reused by ``prepare_buckets`` so a derived
+        ladder's background compile costs ~max, not ~sum, of its
+        programs. Returns wall seconds; surfaces the first compile
+        error."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def compile_one(servable, bucket):
+            dummy = jax.ShapeDtypeStruct(
+                (bucket, *servable.input_shape),
+                np.dtype(servable.input_dtype))
+            servable._compiled.lower(servable.params, dummy).compile()
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=min(8, max(1, len(jobs)))) as ex:
+            for f in [ex.submit(compile_one, s, b) for s, b in jobs]:
+                f.result()
+        return time.perf_counter() - t0
+
+    def prepare_buckets(self, name: str, buckets) -> tuple[int, ...]:
+        """Compile + warm-execute a candidate ladder for ``name`` WITHOUT
+        swapping it in (the ladder deriver's background step,
+        docs/device_path.md). Buckets are rounded up to the mesh's data-
+        axis multiple (same SPMD rule ``register`` applies), AOT-compiled
+        concurrently when the persistent compilation cache is enabled,
+        and each previously-unseen bucket is executed once through
+        ``run_batch`` so the jit dispatch cache is warm and the program
+        is marked executed — after this returns, ``apply_ladder`` can
+        swap with zero serving-path compiles. Returns the aligned tuple
+        to pass to ``apply_ladder``."""
+        from ..parallel.sharding import pad_to_multiple
+        servable = self.models[name]
+        aligned = tuple(sorted({
+            pad_to_multiple(int(b), self.data_axis_size) for b in buckets}))
+        if not aligned:
+            raise ValueError(f"empty ladder for {name}")
+        todo = [b for b in aligned
+                if (name, b) not in self._executed_shapes]
+        if not todo:
+            return aligned
+        if jax.process_count() == 1 and jax.config.jax_compilation_cache_dir:
+            self._aot_compile([(servable, b) for b in todo])
+        for bucket in todo:
+            dummy = np.zeros((bucket, *servable.input_shape),
+                             servable.input_dtype)
+            self.run_batch(name, dummy)
+        return aligned
+
+    def apply_ladder(self, name: str, buckets) -> tuple[int, ...]:
+        """Atomically swap ``name``'s serving ladder to ``buckets`` (the
+        tuple ``prepare_buckets`` returned). The swap is one attribute
+        assignment — in-flight batch cuts hold the old tuple, whose
+        programs stay compiled (``_executed_shapes`` is append-only), so
+        no request on either side of the swap ever pads to a bucket
+        without a compiled program. Refuses any bucket that has not been
+        executed — the invariant the ladder-swap interleaving regression
+        (tests/test_race_regressions.py) pins."""
+        servable = self.models[name]
+        aligned = tuple(sorted({int(b) for b in buckets}))
+        missing = [b for b in aligned
+                   if (name, b) not in self._executed_shapes]
+        if missing:
+            raise RuntimeError(
+                f"apply_ladder({name}): buckets {missing} have no "
+                f"executed program — call prepare_buckets first")
+        servable.batch_buckets = aligned
+        return aligned
 
     def reload_params(self, name: str, new_params) -> "ServableModel":
         """Hot-swap a registered servable's weights — zero-downtime model
@@ -314,6 +374,53 @@ class ModelRuntime:
         host = jax.device_get(out)
         phases["d2h"] = time.perf_counter() - t0
         return host, frozenset(), phases
+
+    # -- split-phase surface (double-buffered batcher) ---------------------
+    #
+    # The three device-boundary steps of run_batch_phases as separate
+    # blocking calls, each returning its (perf-counter start, end) wall
+    # window — the MicroBatcher's double-buffered path runs them on
+    # separate single-thread executors so batch N+1's h2d genuinely
+    # overlaps batch N's execute and batch N's d2h overlaps batch N+1's
+    # execute (docs/device_path.md#double-buffered-transfers). Single-
+    # host only: the batcher falls back to the fused path on runtimes
+    # without ``supports_split_phases`` (MultihostRuntime mirrors every
+    # call and must not diverge per phase).
+
+    def supports_split_phases(self) -> bool:
+        return jax.process_count() == 1
+
+    def h2d_resident(self, name: str, batch: np.ndarray):
+        """``device_put`` the padded batch onto the mesh sharding,
+        blocked until resident. Returns ``(device_batch, (t0, t1))``."""
+        servable = self.models[name]
+        t0 = time.perf_counter()
+        device_batch = jax.device_put(batch, servable._batch_sharding)
+        jax.block_until_ready(device_batch)
+        return device_batch, (t0, time.perf_counter())
+
+    def execute_resident(self, name: str, device_batch):
+        """Run the compiled program on an already-resident batch, blocked
+        until outputs materialize on device. Returns ``(device_outputs,
+        label, (t0, t1))`` where label is ``"compile"`` on the first
+        execution of the (model, bucket) program in this process —
+        warmup normally eats these — else ``"execute"``."""
+        servable = self.models[name]
+        key = (name, device_batch.shape[0])
+        first = key not in self._executed_shapes
+        t0 = time.perf_counter()
+        out = servable._compiled(servable.params, device_batch)
+        jax.block_until_ready(out)
+        self._executed_shapes.add(key)
+        return out, ("compile" if first else "execute"), (
+            t0, time.perf_counter())
+
+    def fetch_resident(self, out):
+        """``device_get`` the outputs. Returns ``(host_outputs,
+        (t0, t1))``."""
+        t0 = time.perf_counter()
+        host = jax.device_get(out)
+        return host, (t0, time.perf_counter())
 
 
 def enable_compilation_cache(path: str = "/tmp/ai4e_tpu_xla_cache") -> None:

@@ -693,30 +693,18 @@ def build_platform(args):
         # ratio).
         observability=getattr(args, "observability", False)))
     runtime = ModelRuntime(donate_batch=args.donate_batch)
-    batcher = MicroBatcher(runtime, max_wait_ms=args.max_wait_ms,
-                           max_pending=args.concurrency * 4,
-                           pipeline_depth=args.pipeline_depth,
-                           measure_phases=getattr(args, "observability",
-                                                  False))
-    worker = InferenceWorker(f"{args.model}-svc", runtime, batcher,
-                             task_manager=platform.task_manager,
-                             prefix=f"v1/{args.model}", store=platform.store,
-                             result_cache=platform.result_cache,
-                             hop_ledger=getattr(args, "observability",
-                                                False),
-                             # The platform gateway fronts this worker with
-                             # the SAME cache — its proxy layer answers and
-                             # fills; a worker-keyed duplicate per request
-                             # would double-count every payload against the
-                             # byte budget (reload invalidation still works).
-                             cache_sync_path=False,
-                             checkpoint_root=args.checkpoint_dir)
     content_type = "application/octet-stream"
     # Routes the gateway/dispatchers must know: [(public?, path)] — the
     # first is the API clients POST; the rest are internal stage backends.
     api_path = f"/v1/{args.model}/classify-async"
     extra_paths: list[str] = []
 
+    # Build + register every servable BEFORE the batcher: with
+    # --ladder-derive, the ai4e_batch_size exposition buckets come from
+    # the servables' (possibly restored) ladders at batcher construction
+    # and the persisted-ladder restore must precede warmup
+    # (docs/device_path.md).
+    serve_calls: list[tuple] = []  # (servable, serve_model kwargs)
     if args.model == "pipeline":
         det, sp, payload, ckpt_meta = _build_pipeline_servables(args)
         runtime.register(det)
@@ -731,18 +719,63 @@ def build_platform(args):
                 return stage2, b""  # empty body → ORIG replay downstream
             return None
 
-        worker.serve_model(det, async_path="/detect-async",
-                           pipeline_to=handoff,
-                           maximum_concurrent_requests=args.concurrency * 4)
-        worker.serve_model(sp, async_path="/classify-species-async",
-                           maximum_concurrent_requests=args.concurrency * 4)
+        serve_calls.append((det, dict(
+            async_path="/detect-async", pipeline_to=handoff,
+            maximum_concurrent_requests=args.concurrency * 4)))
+        serve_calls.append((sp, dict(
+            async_path="/classify-species-async",
+            maximum_concurrent_requests=args.concurrency * 4)))
     else:
         servable, payload, ckpt_meta = _build_servable(args)
         content_type = ckpt_meta.pop("content_type", content_type)
         runtime.register(servable)
-        worker.serve_model(servable, sync_path="/classify",
-                           async_path="/classify-async",
-                           maximum_concurrent_requests=args.concurrency * 4)
+        serve_calls.append((servable, dict(
+            sync_path="/classify", async_path="/classify-async",
+            maximum_concurrent_requests=args.concurrency * 4)))
+
+    ladders = None
+    if getattr(args, "ladder_derive", False):
+        # Traffic-tuned ladders at a bench-sized cadence: the 20 s
+        # measured window must hold observe → derive → background
+        # compile → swap, so period/dwell shrink from the production
+        # defaults (docs/config.md) to 2 s / 1 s.
+        from ai4e_tpu.runtime.ladder import LadderManager
+        persist = getattr(args, "ladder_path", "") or None
+        if persist is None:
+            ladder_dir = tempfile.mkdtemp(prefix="ai4e-bench-ladder")
+            import atexit
+            import shutil
+            atexit.register(shutil.rmtree, ladder_dir, True)
+            persist = os.path.join(ladder_dir, "ladders.json")
+        ladders = LadderManager(runtime, window_s=60.0, max_programs=16,
+                                period_s=2.0, dwell_s=1.0,
+                                min_observations=8, persist_path=persist)
+        restored = ladders.restore()
+        if restored:
+            log(f"ladder restore: {restored}")
+    batcher = MicroBatcher(runtime, max_wait_ms=args.max_wait_ms,
+                           max_pending=args.concurrency * 4,
+                           pipeline_depth=args.pipeline_depth,
+                           measure_phases=getattr(args, "observability",
+                                                  False),
+                           ladder_manager=ladders,
+                           double_buffer=getattr(args, "double_buffer",
+                                                 False))
+    worker = InferenceWorker(f"{args.model}-svc", runtime, batcher,
+                             task_manager=platform.task_manager,
+                             prefix=f"v1/{args.model}", store=platform.store,
+                             result_cache=platform.result_cache,
+                             hop_ledger=getattr(args, "observability",
+                                                False),
+                             # The platform gateway fronts this worker with
+                             # the SAME cache — its proxy layer answers and
+                             # fills; a worker-keyed duplicate per request
+                             # would double-count every payload against the
+                             # byte budget (reload invalidation still works).
+                             cache_sync_path=False,
+                             checkpoint_root=args.checkpoint_dir)
+    for srv, kwargs in serve_calls:
+        worker.serve_model(srv, **kwargs)
 
     t0 = time.perf_counter()
     runtime.warmup()
@@ -1408,6 +1441,16 @@ async def run_bench(args) -> dict:
             batch_meta["h2d_bytes_per_req"] = round(h2d / n_examples)
             batch_meta["d2h_bytes_per_req"] = round(d2h / n_examples)
         batch_meta["wire_bytes_per_req"] = len(payload)
+        if getattr(batcher, "_pad_enabled", False):
+            # Pad-waste accounting (docs/device_path.md): cumulative
+            # padded/occupied slots per model + total padding bytes —
+            # the A/B lever --ladder-derive exists to move.
+            pad_gauge = batcher.metrics.gauge("ai4e_batch_pad_ratio", "")
+            batch_meta["pad_ratio"] = {
+                m: round(pad_gauge.value(model=m), 4)
+                for m in batcher.runtime.models}
+            batch_meta["pad_bytes_total"] = round(_counter_total(
+                "ai4e_batch_pad_bytes_total"))
 
     # Link-independent device capability (VERDICT r2 #3): time the compiled
     # program on an already-on-device batch (no h2d per iteration, outputs
@@ -1501,6 +1544,29 @@ async def run_bench(args) -> dict:
                     "ai4e_batch_overlap_ratio", "").value(), 4),
             }
 
+    # --ladder-derive: the derived-ladder block — per-model generation,
+    # factory baseline vs the ladder that ended the run serving, and the
+    # derive-outcome counts (docs/device_path.md).
+    ladder_meta = {}
+    if getattr(batcher, "_ladders", None) is not None:
+        mgr = batcher._ladders
+        derives = batcher.metrics.counter("ai4e_ladder_derives_total", "")
+        ladder_meta["ladder"] = {
+            "derive": True,
+            "models": {
+                m: {"generation": mgr.generation(m),
+                    "baseline": list(mgr.baseline(m)),
+                    "buckets": list(
+                        batcher.runtime.models[m].batch_buckets)}
+                for m in batcher.runtime.models},
+            "derives": {
+                outcome: int(sum(
+                    v for _, _, labels, v in derives.collect()
+                    if labels.get("outcome") == outcome))
+                for outcome in ("swapped", "unchanged", "skipped",
+                                "failed")},
+        }
+
     # On real hardware the bench doubles as the Pallas kernel-validation
     # artifact: Mosaic-compiled (interpret=False) kernels vs XLA oracles +
     # VMEM-budget assertions (ops/pallas/validate.py).
@@ -1524,6 +1590,8 @@ async def run_bench(args) -> dict:
         "transport": args.transport,
         "fabric": args.fabric,
         **({"donate_batch": True} if args.donate_batch else {}),
+        **({"double_buffer": True}
+           if getattr(args, "double_buffer", False) else {}),
         "vs_baseline": round(throughput / cfg["anchor"], 2),
         "baseline_anchor": cfg["anchor"],
         **{k: window[k] for k in ("p50_latency_ms", "p95_latency_ms",
@@ -1541,6 +1609,7 @@ async def run_bench(args) -> dict:
         **fault_meta,
         **batch_meta,
         **phases_meta,
+        **ladder_meta,
         **capability_meta,
         **pallas_meta,
     }
@@ -1851,6 +1920,12 @@ def _forward_argv(args) -> list[str]:
             *(["--resilience"] if args.resilience else []),
             *(["--orchestration"] if args.orchestration else []),
             *(["--observability"] if args.observability else []),
+            *(["--ladder-derive"] if getattr(args, "ladder_derive", False)
+              else []),
+            *(["--ladder-path", args.ladder_path]
+              if getattr(args, "ladder_path", "") else []),
+            *(["--double-buffer"] if getattr(args, "double_buffer", False)
+              else []),
             *(["--mix", args.mix] if args.mix else []),
             *(["--fsync-policy", args.fsync_policy]
               if getattr(args, "fsync_policy", "") else []),
@@ -1988,6 +2063,24 @@ def main() -> None:
                              "health-aware picks, budget-bounded retries "
                              "with failover, 5xx-as-transient redelivery "
                              "(docs/resilience.md)")
+    parser.add_argument("--ladder-derive", action="store_true",
+                        help="derive the batch-bucket ladder from the "
+                             "live cut-size histogram (runtime/ladder.py, "
+                             "docs/device_path.md) — bench-tuned cadence "
+                             "(2s period, 1s dwell) so swaps land inside "
+                             "the measured window; result JSON gains a "
+                             "`ladder` block")
+    parser.add_argument("--ladder-path", default="",
+                        help="persisted derived-ladder file (default: a "
+                             "per-run temp file, reaped at exit); pass "
+                             "the same path across two runs to measure "
+                             "the restart-serves-hot contract")
+    parser.add_argument("--double-buffer", action="store_true",
+                        help="double-buffered device transfers "
+                             "(AI4E_RUNTIME_BATCH_DOUBLE_BUFFER shape): "
+                             "h2d/execute/d2h on dedicated threads so "
+                             "transfer overlaps execute — pair with "
+                             "--observability to read the overlap ratio")
     parser.add_argument("--observability", action="store_true",
                         help="enable the request-observability layer "
                              "(hop ledger + flight recorder + device-"

@@ -1504,3 +1504,67 @@ class TestLedgerVocabularyDrift:
         result = Analyzer([LedgerVocabularyDrift()],
                           root=root).run(sorted(paths))
         assert [f.render() for f in result.findings] == []
+
+
+# -- AIL012 static-bucket-ladder ---------------------------------------------
+
+
+class TestStaticBucketLadder:
+    """A literal bucket/tile ladder under ``runtime/`` outside the
+    deriver module is a finding — the static ladder PR 13 retired must
+    not silently come back (docs/device_path.md)."""
+
+    def _run(self, tmp_path, source, filename):
+        from ai4e_tpu.analysis.rules.bucket_literal import \
+            StaticBucketLadder
+        return run_rule(tmp_path, StaticBucketLadder(), source,
+                        filename=filename)
+
+    def test_true_positive_in_runtime(self, tmp_path):
+        findings = self._run(tmp_path, """
+            BUCKETS = (1, 2, 4, 8)
+        """, "ai4e_tpu/runtime/batcher2.py")
+        assert [f.rule for f in findings] == ["AIL012"]
+        assert "(1, 2, 4, 8)" in findings[0].message
+
+    def test_trailing_inf_sentinel_does_not_exempt(self, tmp_path):
+        # The exact pre-PR-13 exposition shape: int ladder + float("inf").
+        findings = self._run(tmp_path, """
+            hist = registry.histogram(
+                "x", "", buckets=(1, 2, 4, 8, 16, float("inf")))
+        """, "ai4e_tpu/runtime/metrics_shim.py")
+        assert [f.rule for f in findings] == ["AIL012"]
+
+    def test_list_literal_flagged_too(self, tmp_path):
+        findings = self._run(tmp_path, """
+            ladder = [1, 16, 64]
+        """, "ai4e_tpu/runtime/worker_extra.py")
+        assert [f.rule for f in findings] == ["AIL012"]
+
+    def test_deriver_module_exempt(self, tmp_path):
+        findings = self._run(tmp_path, """
+            DEFAULT_BUCKETS = (1, 2, 4, 8)
+            IMAGE_BUCKETS = (1, 16, 64)
+        """, "ai4e_tpu/runtime/ladder.py")
+        assert findings == []
+
+    def test_outside_runtime_not_flagged(self, tmp_path):
+        findings = self._run(tmp_path, """
+            buckets = (1, 8, 32, 64)
+        """, "ai4e_tpu/models/config.py")
+        assert findings == []
+
+    def test_shape_and_width_tuples_not_flagged(self, tmp_path):
+        findings = self._run(tmp_path, """
+            stage_sizes = (3, 4, 6, 3)      # not ascending
+            widths = (32, 64, 128)          # does not start at 1
+            pair = (1, 8)                   # too short to be a ladder
+            shape = (1, 224, x)             # non-constant tail, run of 2
+        """, "ai4e_tpu/runtime/families2.py")
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = self._run(tmp_path, """
+            LEGACY = (1, 2, 4)  # ai4e: noqa[AIL012] — fixture for the migration test
+        """, "ai4e_tpu/runtime/fixture.py")
+        assert findings == []

@@ -1,0 +1,825 @@
+"""Traffic-tuned ladder + double-buffered transfer tests (PR 13,
+docs/device_path.md):
+
+- ``ShapeHistogram`` decay/bounds, ``derive_ladder`` unit + PROPERTY
+  tests (monotone, covers the observed max, never worse pad-waste than
+  the static ladder on the same histogram, program budget respected);
+- persistence round-trip + the invalidation rule (params_version bump
+  keeps the ladder, model code change discards it);
+- ``LadderManager`` derive→prepare→swap→persist loop over a stub
+  runtime (order: every bucket warmed BEFORE the swap), dwell + sample
+  floors, restore-before-warmup;
+- batcher identity: with derivation off the registered metric set and
+  the ``ai4e_batch_size`` exposition buckets are byte-identical to the
+  pre-ladder platform (same discipline as observability=False); with it
+  on, exposition buckets come from the servables' own ladders;
+- the double-buffered execute path on the real runtime: identical
+  results, measured phase windows, overlap accounting;
+- restart-warm acceptance: a second runtime restoring the persisted
+  ladder warms it and its first phased serving call stamps ``execute``,
+  never ``compile``.
+"""
+
+import asyncio
+import random
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from ai4e_tpu.metrics.registry import MetricsRegistry
+from ai4e_tpu.runtime.ladder import (
+    DEFAULT_BUCKETS,
+    EXPOSITION_BUCKETS,
+    LadderManager,
+    ShapeHistogram,
+    derive_ladder,
+    expected_pad_waste,
+    exposition_buckets,
+    load_ladders,
+    save_ladders,
+    servable_fingerprint,
+)
+
+SEED = 20260803
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _stub_servable(buckets=(1, 64), name="m", version="1.0"):
+    return SimpleNamespace(name=name, version=version,
+                           batch_buckets=tuple(buckets),
+                           input_shape=(4,), input_dtype=np.float32,
+                           params_version=1, max_bucket=max(buckets))
+
+
+class _StubRuntime:
+    """Duck-typed ModelRuntime for manager tests — records the
+    prepare/apply order and enforces the swap-safety invariant the real
+    ``apply_ladder`` enforces (no un-executed bucket ever swaps in)."""
+
+    data_axis_size = 1
+
+    def __init__(self, buckets=(1, 64)):
+        self.models = {"m": _stub_servable(buckets)}
+        self.prepared: list[tuple] = []
+        self.applied: list[tuple] = []
+        self._warm = set(buckets)
+
+    def prepare_buckets(self, name, buckets):
+        aligned = tuple(sorted({int(b) for b in buckets}))
+        self.prepared.append(aligned)
+        self._warm |= set(aligned)
+        return aligned
+
+    def apply_ladder(self, name, buckets):
+        aligned = tuple(sorted(buckets))
+        missing = [b for b in aligned if b not in self._warm]
+        assert not missing, f"swap with un-warmed buckets {missing}"
+        self.applied.append(aligned)
+        self.models[name].batch_buckets = aligned
+        return aligned
+
+
+class TestShapeHistogram:
+    def test_observe_and_snapshot(self):
+        clock = _FakeClock()
+        hist = ShapeHistogram(window_s=10.0, clock=clock)
+        for _ in range(3):
+            hist.observe(7)
+        hist.observe(20)
+        snap = hist.snapshot()
+        assert snap[7] == pytest.approx(3.0)
+        assert snap[20] == pytest.approx(1.0)
+        assert hist.observations == 4
+
+    def test_half_life_decay(self):
+        clock = _FakeClock()
+        hist = ShapeHistogram(window_s=10.0, clock=clock)
+        hist.observe(8, weight=4.0)
+        clock.t += 10.0  # one half-life
+        assert hist.snapshot()[8] == pytest.approx(2.0)
+        clock.t += 20.0  # two more
+        assert hist.snapshot()[8] == pytest.approx(0.5)
+
+    def test_bounded_evicts_lightest(self):
+        clock = _FakeClock()
+        hist = ShapeHistogram(window_s=1e9, max_sizes=4, clock=clock)
+        for s in (1, 2, 3, 4):
+            hist.observe(s, weight=10.0)
+        hist.observe(5, weight=0.5)   # over the bound: lightest (5) evicted
+        hist.observe(6, weight=20.0)  # heavier entry evicts the next lightest
+        snap = hist.snapshot()
+        assert len(snap) == 4
+        assert 6 in snap
+
+    def test_nonpositive_size_ignored(self):
+        hist = ShapeHistogram()
+        hist.observe(0)
+        hist.observe(-3)
+        assert hist.snapshot() == {}
+        assert hist.observations == 0
+
+
+class TestDeriveLadder:
+    def test_empty_histogram_returns_baseline(self):
+        assert derive_ladder({}, baseline=(1, 8, 32)) == (1, 8, 32)
+
+    def test_exact_sizes_get_exact_buckets(self):
+        hist = {3: 10.0, 17: 5.0}
+        out = derive_ladder(hist, baseline=(1, 64), max_programs=8)
+        assert expected_pad_waste(out, hist) == 0.0
+        assert 3 in out and 17 in out
+
+    def test_budget_of_one_covers_the_max(self):
+        hist = {3: 10.0, 17: 5.0}
+        out = derive_ladder(hist, baseline=(1, 64), max_programs=1)
+        assert out == (17,)
+
+    def test_product_objective_prefers_fewer_zero_waste_programs(self):
+        # One observed size: one bucket gives waste 0 × 1 program —
+        # strictly better than any larger zero-waste ladder.
+        out = derive_ladder({24: 100.0}, baseline=(1, 2, 4, 8, 16, 32),
+                            max_programs=8)
+        assert out == (24,)
+
+    def test_alignment_rounds_up_and_dedupes(self):
+        hist = {3: 1.0, 5: 1.0, 9: 1.0}
+        out = derive_ladder(hist, baseline=DEFAULT_BUCKETS,
+                            max_programs=8, align=8)
+        assert all(b % 8 == 0 for b in out)
+        assert max(out) >= 9
+
+    def test_property_derived_never_worse_than_static(self):
+        rng = random.Random(SEED)
+        static = EXPOSITION_BUCKETS  # the retired (1, 2, 4, ..., 256)
+        for trial in range(250):
+            hist = {rng.randint(1, 256): rng.uniform(0.1, 100.0)
+                    for _ in range(rng.randint(1, 14))}
+            derived = derive_ladder(hist, baseline=static, max_programs=16)
+            # Monotone (strictly ascending).
+            assert list(derived) == sorted(set(derived)), (trial, hist)
+            # Largest bucket covers the observed max.
+            assert max(derived) >= max(hist), (trial, hist)
+            # Program budget respected.
+            assert 1 <= len(derived) <= 16, (trial, hist)
+            # Never more expected pad-waste than the static ladder.
+            assert (expected_pad_waste(derived, hist)
+                    <= expected_pad_waste(static, hist) + 1e-9), (
+                trial, hist, derived)
+
+    def test_property_holds_under_alignment(self):
+        rng = random.Random(SEED + 1)
+        static = EXPOSITION_BUCKETS
+        for trial in range(100):
+            hist = {rng.randint(1, 256): rng.uniform(0.1, 10.0)
+                    for _ in range(rng.randint(1, 10))}
+            derived = derive_ladder(hist, baseline=static,
+                                    max_programs=16, align=8)
+            assert all(b % 8 == 0 for b in derived), (trial, derived)
+            assert max(derived) >= max(hist), (trial, hist)
+            aligned_static = tuple(sorted(
+                {((b + 7) // 8) * 8 for b in static}))
+            assert (expected_pad_waste(derived, hist)
+                    <= expected_pad_waste(aligned_static, hist) + 1e-9), (
+                trial, hist, derived)
+
+    def test_skewed_histogram_beats_static_strictly(self):
+        # The bench's skew shape: cuts cluster at 20 on a (1, 64) ladder.
+        hist = {20: 100.0, 21: 40.0, 1: 5.0}
+        static = (1, 64)
+        derived = derive_ladder(hist, baseline=static, max_programs=8)
+        assert expected_pad_waste(derived, hist) < expected_pad_waste(
+            static, hist)
+
+    def test_rejects_bad_budget(self):
+        with pytest.raises(ValueError):
+            derive_ladder({1: 1.0}, baseline=(1,), max_programs=0)
+
+
+class TestPersistence:
+    def test_round_trip_and_corrupt_file(self, tmp_path):
+        path = str(tmp_path / "ladders.json")
+        entries = {"m": {"fingerprint": "f", "buckets": [4, 8],
+                         "baseline": [1, 64], "generation": 2}}
+        save_ladders(path, entries)
+        assert load_ladders(path) == entries
+        with open(path, "w") as fh:
+            fh.write("{not json")
+        assert load_ladders(path) == {}
+        assert load_ladders(str(tmp_path / "missing.json")) == {}
+
+    def test_fingerprint_ignores_params_version(self):
+        s = _stub_servable()
+        before = servable_fingerprint(s)
+        s.params_version += 1  # hot weight reload
+        assert servable_fingerprint(s) == before
+
+    def test_fingerprint_tracks_code_identity(self):
+        s = _stub_servable()
+        before = servable_fingerprint(s)
+        s.version = "2.0"  # model code change
+        assert servable_fingerprint(s) != before
+
+
+class TestLadderManager:
+    def _manager(self, runtime, tmp_path=None, **kw):
+        clock = kw.pop("clock", _FakeClock())
+        path = str(tmp_path / "ladders.json") if tmp_path else None
+        mgr = LadderManager(runtime, period_s=kw.pop("period_s", 5.0),
+                            dwell_s=kw.pop("dwell_s", 0.0),
+                            min_observations=kw.pop("min_observations", 4),
+                            persist_path=path, metrics=MetricsRegistry(),
+                            clock=clock, **kw)
+        return mgr, clock
+
+    def test_derive_swaps_after_prepare_and_persists(self, tmp_path):
+        rt = _StubRuntime(buckets=(1, 64))
+        mgr, _clock = self._manager(rt, tmp_path)
+        for _ in range(10):
+            mgr.observe_cut("m", 20)
+        assert mgr.derive_now("m") == "swapped"
+        # prepare ran BEFORE apply, and apply saw only warmed buckets.
+        assert rt.prepared and rt.applied
+        assert 20 in rt.models["m"].batch_buckets
+        assert mgr.generation("m") == 1
+        entry = load_ladders(str(tmp_path / "ladders.json"))["m"]
+        assert entry["generation"] == 1
+        assert 20 in entry["buckets"]
+        assert entry["fingerprint"] == servable_fingerprint(rt.models["m"])
+
+    def test_unchanged_and_sample_floor(self, tmp_path):
+        rt = _StubRuntime(buckets=(1, 64))
+        mgr, _clock = self._manager(rt, tmp_path)
+        assert mgr.derive_now("m") == "skipped"  # nothing observed
+        for _ in range(10):
+            mgr.observe_cut("m", 64)  # traffic that matches the ladder
+        # (1, 64) on an all-64 histogram: 64 covers with 0 waste and the
+        # product objective still can't beat... a single (64,) bucket
+        # CAN: generation may swap to the smaller ladder. Drive with the
+        # baseline shape instead: sizes 1 and 64.
+        for _ in range(10):
+            mgr.observe_cut("m", 1)
+        out = mgr.derive_now("m")
+        assert out in ("unchanged", "swapped")
+        if out == "swapped":
+            assert mgr.derive_now("m") == "unchanged"  # fixpoint
+
+    def test_dwell_bounds_swap_churn(self, tmp_path):
+        rt = _StubRuntime(buckets=(1, 64))
+        clock = _FakeClock()
+        # period_s huge: this test drives derive_now explicitly and must
+        # not race the observe_cut-kicked background deriver.
+        mgr, _ = self._manager(rt, tmp_path, dwell_s=100.0, clock=clock,
+                               period_s=1e9)
+        for _ in range(10):
+            mgr.observe_cut("m", 20)
+        assert mgr.derive_now("m") == "swapped"
+        for _ in range(10):
+            mgr.observe_cut("m", 33)
+        assert mgr.derive_now("m") == "skipped"  # inside the dwell
+        clock.t += 101.0
+        for _ in range(10):
+            mgr.observe_cut("m", 33)
+        assert mgr.derive_now("m") == "swapped"
+
+    def test_observe_cut_schedules_background_derive(self, tmp_path):
+        rt = _StubRuntime(buckets=(1, 64))
+        clock = _FakeClock()
+        mgr, _ = self._manager(rt, tmp_path, period_s=5.0, clock=clock)
+        for _ in range(20):
+            mgr.observe_cut("m", 20)
+        assert not rt.applied  # inside the first period: no derive yet
+        clock.t += 6.0
+        mgr.observe_cut("m", 20)  # period elapsed → background thread
+        for _ in range(200):
+            if rt.applied:
+                break
+            import time
+            time.sleep(0.01)
+        assert rt.applied, "background derive never swapped"
+        assert 20 in rt.models["m"].batch_buckets
+
+    def test_restore_applies_matching_entry(self, tmp_path):
+        rt = _StubRuntime(buckets=(1, 64))
+        path = str(tmp_path / "ladders.json")
+        save_ladders(path, {"m": {
+            "fingerprint": servable_fingerprint(rt.models["m"]),
+            "baseline": [1, 64], "buckets": [4, 20, 64],
+            "generation": 3}})
+        mgr = LadderManager(rt, persist_path=path,
+                            metrics=MetricsRegistry())
+        restored = mgr.restore()
+        assert restored == {"m": (4, 20, 64)}
+        assert rt.models["m"].batch_buckets == (4, 20, 64)
+        assert mgr.generation("m") == 3
+
+    def test_restore_discards_stale_fingerprint(self, tmp_path):
+        rt = _StubRuntime(buckets=(1, 64))
+        path = str(tmp_path / "ladders.json")
+        save_ladders(path, {"m": {
+            "fingerprint": "someone-else", "baseline": [1, 64],
+            "buckets": [4, 20, 64], "generation": 3}})
+        mgr = LadderManager(rt, persist_path=path,
+                            metrics=MetricsRegistry())
+        assert mgr.restore() == {}
+        assert rt.models["m"].batch_buckets == (1, 64)
+
+    def test_restore_discards_misaligned_buckets(self, tmp_path):
+        rt = _StubRuntime(buckets=(8, 64))
+        rt.data_axis_size = 8  # the mesh grew since the ladder persisted
+        path = str(tmp_path / "ladders.json")
+        save_ladders(path, {"m": {
+            "fingerprint": servable_fingerprint(rt.models["m"]),
+            "baseline": [8, 64], "buckets": [4, 20], "generation": 1}})
+        mgr = LadderManager(rt, persist_path=path,
+                            metrics=MetricsRegistry())
+        assert mgr.restore() == {}
+        assert rt.models["m"].batch_buckets == (8, 64)
+
+    def test_failed_derive_keeps_serving_ladder(self, tmp_path):
+        rt = _StubRuntime(buckets=(1, 64))
+
+        def boom(name, buckets):
+            raise RuntimeError("compile exploded")
+
+        rt.prepare_buckets = boom
+        mgr, _ = self._manager(rt, tmp_path)
+        for _ in range(10):
+            mgr.observe_cut("m", 20)
+        mgr._derive_in_background("m")  # the thread body, synchronously
+        assert rt.models["m"].batch_buckets == (1, 64)
+        assert mgr.metrics.counter(
+            "ai4e_ladder_derives_total", "").value(
+            model="m", outcome="failed") == 1
+        # The busy flag must clear or no later derive ever runs.
+        assert "m" not in mgr._busy
+
+
+# The exact metric-name set the pre-ladder batcher registered — the
+# byte-identity contract for derivation-off (acceptance criterion; same
+# discipline as the observability=False assembly assertions).
+HEAD_BATCHER_METRICS = {
+    "ai4e_batch_size", "ai4e_batch_exec_seconds",
+    "ai4e_batch_queue_wait_seconds", "ai4e_batcher_pending",
+    "ai4e_batcher_inflight_batches", "ai4e_batch_h2d_bytes_total",
+    "ai4e_batch_d2h_bytes_total", "ai4e_admission_expired_total",
+}
+
+
+class TestBatcherIdentityAndExposition:
+    def _batcher(self, **kw):
+        from ai4e_tpu.runtime.batcher import MicroBatcher
+        runtime = kw.pop("runtime", None)
+        if runtime is None:
+            runtime = SimpleNamespace(models={})
+        reg = MetricsRegistry()
+        return MicroBatcher(runtime, metrics=reg, **kw), reg
+
+    def test_default_batcher_metric_set_identical_to_head(self):
+        _b, reg = self._batcher()
+        assert set(reg._metrics) == HEAD_BATCHER_METRICS
+        # And the exposition buckets are the static ladder, verbatim.
+        hist = reg.histogram("ai4e_batch_size", "")
+        assert hist.buckets == (*EXPOSITION_BUCKETS, float("inf"))
+
+    def test_default_exposition_rendering_has_no_ladder_series(self):
+        _b, reg = self._batcher()
+        text = reg.render_prometheus()
+        assert "ai4e_ladder_" not in text
+        assert "ai4e_batch_pad_" not in text
+
+    def test_derivation_on_builds_exposition_from_servable_ladders(self):
+        rt = _StubRuntime(buckets=(1, 20, 64))
+        rt.models["m2"] = _stub_servable(buckets=(4, 96), name="m2")
+        mgr = LadderManager(rt, metrics=MetricsRegistry())
+        b, reg = self._batcher(runtime=rt, ladder_manager=mgr)
+        hist = reg.histogram("ai4e_batch_size", "")
+        assert hist.buckets == (1, 4, 20, 64, 96, float("inf"))
+        # Pad metrics ride the ladder/phase instruments.
+        assert "ai4e_batch_pad_ratio" in reg._metrics
+        assert "ai4e_batch_pad_bytes_total" in reg._metrics
+
+    def test_exposition_union_helper(self):
+        assert exposition_buckets([]) == EXPOSITION_BUCKETS
+        assert exposition_buckets(
+            [_stub_servable((1, 8)), _stub_servable((4, 8))]
+        ) == (1, 4, 8)
+
+    def test_measure_phases_alone_registers_pad_metrics(self):
+        _b, reg = self._batcher(measure_phases=True)
+        assert "ai4e_batch_pad_ratio" in reg._metrics
+
+    def test_per_model_flush_gate(self):
+        # The cross-model coupling fix, both directions: a full
+        # SMALL-bucket model is cut-ready immediately even while a
+        # large-bucket model idles, AND a hot full model does NOT
+        # cancel a trickle model's own accumulation window.
+        import time as _t
+        from ai4e_tpu.runtime.batcher import _Pending
+
+        def entry(age=0.0):
+            p = _Pending.__new__(_Pending)
+            p.enqueued = _t.perf_counter() - age
+            return p
+
+        rt = SimpleNamespace(models={
+            "small": _stub_servable((1, 4), name="small"),
+            "big": _stub_servable((1, 256), name="big")})
+        b, _reg = self._batcher(runtime=rt, max_wait_ms=50.0)
+        now = _t.perf_counter()
+        b._pending = {"small": [entry()] * 4, "big": [entry()]}
+        assert b._cut_ready("small", now)        # its own bucket is full
+        assert not b._cut_ready("big", now)      # its window keeps running
+        assert b._nearest_cut_deadline(now) == 0.0
+        b._pending = {"small": [entry()] * 3, "big": [entry()]}
+        assert not b._cut_ready("small", now)
+        nearest = b._nearest_cut_deadline(now)
+        assert nearest is not None and 0 < nearest <= 0.06
+        # An expired per-model window is ready regardless of fill.
+        b._pending = {"big": [entry(age=0.06)]}
+        assert b._cut_ready("big", _t.perf_counter())
+
+
+def _echo_servable(buckets, name="echo", size=4):
+    import jax.numpy as jnp
+    from ai4e_tpu.runtime import ServableModel
+    return ServableModel(
+        name=name,
+        apply_fn=lambda params, batch: jnp.asarray(batch) * params["k"],
+        params={"k": jnp.asarray(3.0)},
+        input_shape=(size,),
+        preprocess=lambda body, ct: np.frombuffer(body, np.float32),
+        postprocess=lambda out: {"sum": float(np.asarray(out).sum())},
+        batch_buckets=buckets,
+    )
+
+
+def _single_device_runtime(**kw):
+    import jax
+    from ai4e_tpu.parallel import MeshSpec, make_mesh
+    from ai4e_tpu.runtime import ModelRuntime
+    mesh = make_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
+    return ModelRuntime(mesh=mesh, **kw)
+
+
+class TestRealRuntimeLadder:
+    def test_prepare_then_apply_swaps_and_old_buckets_stay_warm(self):
+        runtime = _single_device_runtime()
+        runtime.register(_echo_servable((1, 8)))
+        runtime.warmup(parallel=False)
+        prepared = runtime.prepare_buckets("echo", (4, 8))
+        assert prepared == (4, 8)
+        runtime.apply_ladder("echo", prepared)
+        assert runtime.models["echo"].batch_buckets == (4, 8)
+        # Old AND new buckets execute without a compile stamp.
+        for bucket in (1, 4, 8):
+            _out, _p, phases = runtime.run_batch_phases(
+                "echo", np.ones((bucket, 4), np.float32))
+            assert "execute" in phases and "compile" not in phases
+
+    def test_apply_without_prepare_refused(self):
+        runtime = _single_device_runtime()
+        runtime.register(_echo_servable((1, 8)))
+        runtime.warmup(parallel=False)
+        with pytest.raises(RuntimeError, match="no\\s+executed program"):
+            runtime.apply_ladder("echo", (1, 4, 8))
+
+    def test_restart_restores_persisted_ladder_and_serves_execute(
+            self, tmp_path):
+        path = str(tmp_path / "ladders.json")
+        # "First life": derive + persist a traffic-tuned ladder.
+        rt1 = _single_device_runtime()
+        rt1.register(_echo_servable((1, 64)))
+        rt1.warmup(parallel=False)
+        mgr1 = LadderManager(rt1, persist_path=path, min_observations=4,
+                             dwell_s=0.0, metrics=MetricsRegistry())
+        for _ in range(16):
+            mgr1.observe_cut("echo", 20)
+        assert mgr1.derive_now("echo") == "swapped"
+        tuned = rt1.models["echo"].batch_buckets
+        assert 20 in tuned
+        # "Restart": fresh runtime, factory ladder, restore BEFORE warmup.
+        rt2 = _single_device_runtime()
+        rt2.register(_echo_servable((1, 64)))
+        mgr2 = LadderManager(rt2, persist_path=path,
+                             metrics=MetricsRegistry())
+        assert mgr2.restore() == {"echo": tuned}
+        rt2.warmup(parallel=False)
+        # First serving call on the tuned bucket stamps execute — the
+        # restart serves hot (acceptance criterion).
+        _out, _p, phases = rt2.run_batch_phases(
+            "echo", np.ones((20, 4), np.float32))
+        assert "execute" in phases and "compile" not in phases
+
+
+class TestDoubleBufferedBatcher:
+    def _submit_many(self, batcher, n, size=4):
+        async def main():
+            await batcher.start()
+            try:
+                outs = await asyncio.gather(*(
+                    batcher.submit("echo", np.full((size,), i,
+                                                   np.float32))
+                    for i in range(n)))
+            finally:
+                await batcher.stop()
+            return outs
+        return run(main())
+
+    def test_results_identical_to_fused_path(self):
+        from ai4e_tpu.runtime import MicroBatcher
+        results = {}
+        for double in (False, True):
+            runtime = _single_device_runtime()
+            runtime.register(_echo_servable((1, 2, 4, 8)))
+            runtime.warmup(parallel=False)
+            batcher = MicroBatcher(runtime, max_wait_ms=1.0,
+                                   metrics=MetricsRegistry(),
+                                   double_buffer=double)
+            assert batcher._double is double
+            results[double] = self._submit_many(batcher, 12)
+        assert results[True] == results[False]
+
+    def test_phase_windows_and_pad_accounting(self):
+        from ai4e_tpu.runtime import MicroBatcher
+        runtime = _single_device_runtime()
+        runtime.register(_echo_servable((1, 2, 4, 8)))
+        runtime.warmup(parallel=False)
+        reg = MetricsRegistry()
+        batcher = MicroBatcher(runtime, max_wait_ms=1.0, metrics=reg,
+                               double_buffer=True, measure_phases=True)
+        self._submit_many(batcher, 16)
+        phase_hist = reg.histogram("ai4e_device_phase_seconds", "")
+        counts = {}
+        for _k, _n, labels, data in phase_hist.collect():
+            counts[labels["phase"]] = counts.get(labels["phase"], 0) \
+                + int(data["count"])
+        assert counts.get("h2d", 0) > 0
+        assert counts.get("execute", 0) > 0
+        assert counts.get("d2h", 0) > 0
+        # Warmed worker: the serving path never stamps compile.
+        assert counts.get("compile", 0) == 0
+        # Overlap ratio is defined (>= 0); on shared CPU the actual
+        # overlap is not asserted — the bench artifact carries that.
+        assert reg.gauge("ai4e_batch_overlap_ratio", "").value() >= 0.0
+        # Pad accounting saw the padded cuts.
+        assert reg.gauge("ai4e_batch_pad_ratio", "").value(
+            model="echo") >= 0.0
+
+    def test_double_buffer_respects_multihost_fallback(self):
+        # A runtime without the split surface keeps the fused path.
+        from ai4e_tpu.runtime.batcher import MicroBatcher
+        rt = SimpleNamespace(models={})
+        batcher = MicroBatcher(rt, metrics=MetricsRegistry(),
+                               double_buffer=True)
+        assert batcher._double is False
+
+    def test_staging_ring_alternates_and_reuses(self):
+        from ai4e_tpu.runtime import MicroBatcher
+        runtime = _single_device_runtime()
+        servable = _echo_servable((1, 2, 4, 8))
+        runtime.register(servable)
+        runtime.warmup(parallel=False)
+        batcher = MicroBatcher(runtime, metrics=MetricsRegistry(),
+                               double_buffer=True, pipeline_depth=2)
+        b1 = batcher._staging_buffer("echo", 8, servable)
+        b2 = batcher._staging_buffer("echo", 8, servable)
+        b3 = batcher._staging_buffer("echo", 8, servable)
+        assert b1 is not b2
+        assert b3 is b1  # ring of pipeline_depth
+
+
+class TestBatcherLadderIntegration:
+    def test_cuts_feed_manager_and_swap_changes_buckets(self):
+        from ai4e_tpu.runtime import MicroBatcher
+        runtime = _single_device_runtime()
+        runtime.register(_echo_servable((1, 64)))
+        runtime.warmup(parallel=False)
+        mgr = LadderManager(runtime, min_observations=4, dwell_s=0.0,
+                            period_s=1e9,  # no background kicks in-test
+                            metrics=MetricsRegistry())
+        batcher = MicroBatcher(runtime, max_wait_ms=20.0,
+                               metrics=MetricsRegistry(),
+                               ladder_manager=mgr)
+
+        async def burst(n):
+            await asyncio.gather(*(
+                batcher.submit("echo", np.full((4,), i, np.float32))
+                for i in range(n)))
+
+        async def main():
+            await batcher.start()
+            try:
+                for _ in range(6):
+                    await burst(20)
+            finally:
+                await batcher.stop()
+
+        run(main())
+        assert mgr._hists["echo"].observations > 0
+        assert mgr.derive_now("echo") == "swapped"
+        tuned = runtime.models["echo"].batch_buckets
+        assert max(tuned) <= 64
+        hist = mgr._hists["echo"].snapshot()
+        assert expected_pad_waste(tuned, hist) <= expected_pad_waste(
+            (1, 64), hist)
+
+
+class TestReviewRegressions:
+    """Fixes from the PR 13 review pass, each pinned."""
+
+    def test_ladder_grows_back_after_demand_rises(self, tmp_path):
+        # The ratchet-down bug: observing POST-clamp cut sizes meant a
+        # shrunken ladder capped every later observation at its own max
+        # and could never grow back. The batcher now feeds pre-clamp
+        # demand and the manager clamps to the FACTORY max only.
+        rt = _StubRuntime(buckets=(1, 64))
+        mgr = LadderManager(rt, period_s=1e9, dwell_s=0.0,
+                            min_observations=4,
+                            persist_path=str(tmp_path / "l.json"),
+                            metrics=MetricsRegistry(), clock=_FakeClock())
+        for _ in range(10):
+            mgr.observe_cut("m", 20)
+        assert mgr.derive_now("m") == "swapped"
+        assert max(rt.models["m"].batch_buckets) == 20  # shrunk
+        # Demand rises past the derived max (the batcher reports the
+        # pre-clamp queue length, so 64 IS observable again).
+        for _ in range(40):
+            mgr.observe_cut("m", 64)
+        assert mgr.derive_now("m") == "swapped"
+        assert max(rt.models["m"].batch_buckets) == 64  # grew back
+
+    def test_observed_demand_clamps_to_factory_max(self):
+        rt = _StubRuntime(buckets=(1, 64))
+        mgr = LadderManager(rt, period_s=1e9, metrics=MetricsRegistry(),
+                            clock=_FakeClock())
+        mgr.observe_cut("m", 500)  # a deep backlog, not a servable batch
+        assert max(mgr._hists["m"].snapshot()) == 64
+
+    def test_batcher_reports_preclamp_demand(self):
+        from ai4e_tpu.runtime.batcher import MicroBatcher, _Pending
+        rt = _StubRuntime(buckets=(1, 8))
+        seen = []
+        mgr = SimpleNamespace(observe_cut=lambda name, n: seen.append(n))
+
+        async def main():
+            batcher = MicroBatcher(rt, metrics=MetricsRegistry(),
+                                   ladder_manager=mgr)
+            loop = asyncio.get_running_loop()
+            batcher._pending["m"] = [
+                _Pending(np.zeros(4, np.float32), loop.create_future())
+                for _ in range(20)]
+            batch, bucket = batcher._take_batch("m")
+            assert len(batch) == 8  # clamped to the ladder's max bucket
+            assert bucket == 8      # chosen from the SAME ladder snapshot
+        run(main())
+        assert seen == [20]  # …but the DEMAND was observed
+
+    def test_restore_discards_changed_factory_ladder(self, tmp_path):
+        # The documented invalidation rule: an operator raising the
+        # factory ladder must not be shadowed by a ladder tuned under
+        # the old config (fingerprint alone cannot carry this — at
+        # persist time batch_buckets already holds the derived ladder).
+        rt = _StubRuntime(buckets=(1, 128))  # factory raised since persist
+        path = str(tmp_path / "ladders.json")
+        save_ladders(path, {"m": {
+            "fingerprint": servable_fingerprint(rt.models["m"]),
+            "baseline": [1, 64], "buckets": [4, 20], "generation": 2}})
+        mgr = LadderManager(rt, persist_path=path,
+                            metrics=MetricsRegistry())
+        assert mgr.restore() == {}
+        assert rt.models["m"].batch_buckets == (1, 128)
+
+    def test_pad_gauge_tracks_serving_ladder_on_skip(self, tmp_path):
+        rt = _StubRuntime(buckets=(1, 64))
+        clock = _FakeClock()
+        reg = MetricsRegistry()
+        mgr = LadderManager(rt, period_s=1e9, dwell_s=1000.0,
+                            min_observations=4,
+                            persist_path=str(tmp_path / "l.json"),
+                            metrics=reg, clock=clock)
+        for _ in range(10):
+            mgr.observe_cut("m", 20)
+        assert mgr.derive_now("m") == "swapped"
+        for _ in range(10):
+            mgr.observe_cut("m", 33)
+        assert mgr.derive_now("m") == "skipped"  # dwell holds
+        gauge = reg.gauge("ai4e_ladder_expected_pad_ratio", "")
+        hist = mgr._hists["m"].snapshot()
+        serving = rt.models["m"].batch_buckets
+        expect = expected_pad_waste(serving, hist) / sum(
+            s * w for s, w in hist.items())
+        # The gauge reports the SERVING ladder's ratio, not the
+        # candidate that never swapped in.
+        assert gauge.value(model="m") == pytest.approx(expect)
+
+    def test_staging_ring_evicted_on_ladder_swap(self):
+        from ai4e_tpu.runtime import MicroBatcher
+        runtime = _single_device_runtime()
+        servable = _echo_servable((1, 8, 64))
+        runtime.register(servable)
+        runtime.warmup(parallel=False)
+        batcher = MicroBatcher(runtime, metrics=MetricsRegistry(),
+                               double_buffer=True, pipeline_depth=2)
+        batcher._staging_buffer("echo", 64, servable)
+        batcher._staging_buffer("echo", 8, servable)
+        assert ("echo", 64) in batcher._staging
+        # A swap retires bucket 64; the next NEW ring allocation drops
+        # the stale ring instead of leaking its host buffers forever.
+        prepared = runtime.prepare_buckets("echo", (1, 16))
+        runtime.apply_ladder("echo", prepared)
+        batcher._staging_buffer("echo", 16, servable)
+        assert ("echo", 64) not in batcher._staging
+        assert ("echo", 16) in batcher._staging
+
+    def test_swap_between_cut_and_execute_pads_to_cut_time_bucket(self):
+        # The second review pass's cut-vs-swap race: the bucket is
+        # chosen at CUT time from one ladder snapshot, so a deriver
+        # swap that shrinks the top bucket before _execute runs cannot
+        # make bucket_for(n) clamp below n (IndexError mid-padding,
+        # stranded futures). The pre-swap bucket's program stays
+        # compiled (append-only warm set), so the batch executes fine.
+        from ai4e_tpu.runtime import MicroBatcher
+
+        async def main():
+            runtime = _single_device_runtime()
+            servable = _echo_servable((1, 64))
+            runtime.register(servable)
+            runtime.warmup(parallel=False)
+            batcher = MicroBatcher(runtime, metrics=MetricsRegistry())
+            loop = asyncio.get_running_loop()
+            from ai4e_tpu.runtime.batcher import _Pending
+            batcher._pending["echo"] = [
+                _Pending(np.full((4,), i, np.float32),
+                         loop.create_future())
+                for i in range(40)]
+            batch, bucket = batcher._take_batch("echo")
+            assert (len(batch), bucket) == (40, 64)
+            # The deriver swaps the ladder down BETWEEN cut and execute.
+            prepared = runtime.prepare_buckets("echo", (1, 4, 8))
+            runtime.apply_ladder("echo", prepared)
+            await batcher._execute(loop, "echo", batch, bucket)
+            results = [p.future.result() for p in batch]  # all resolved
+            assert len(results) == 40
+        run(main())
+
+    def test_concurrent_persists_keep_both_models(self, tmp_path):
+        # _persist is a load-modify-write of the shared ladder file;
+        # without the lock two models' deriver threads could each read
+        # a stale snapshot and the last writer dropped the other's
+        # entry (a restart then warmed that model's factory ladder).
+        import threading
+        rt = _StubRuntime(buckets=(1, 64))
+        rt.models["m2"] = _stub_servable(buckets=(1, 32), name="m2")
+        path = str(tmp_path / "ladders.json")
+        mgr = LadderManager(rt, persist_path=path,
+                            metrics=MetricsRegistry(),
+                            clock=_FakeClock())
+        mgr._adopt("m")
+        mgr._adopt("m2")
+        mgr._generation["m"] = mgr._generation["m2"] = 1
+
+        def hammer(name, bucket):
+            for _ in range(25):
+                mgr._persist(name, (bucket,))
+
+        threads = [threading.Thread(target=hammer, args=("m", 20)),
+                   threading.Thread(target=hammer, args=("m2", 16))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        entries = load_ladders(path)
+        assert set(entries) == {"m", "m2"}
+
+    def test_staging_ring_evicted_on_shrink_only_swap(self):
+        # Third review pass: a swap that only SHRINKS the ladder never
+        # allocates a new ring, so allocation-time-only eviction kept
+        # the retired larger ring (pipeline_depth full-size host
+        # buffers) for the process lifetime — the sweep now runs on
+        # every staging-buffer fetch.
+        from ai4e_tpu.runtime import MicroBatcher
+        runtime = _single_device_runtime()
+        servable = _echo_servable((1, 16, 64))
+        runtime.register(servable)
+        runtime.warmup(parallel=False)
+        batcher = MicroBatcher(runtime, metrics=MetricsRegistry(),
+                               double_buffer=True, pipeline_depth=2)
+        batcher._staging_buffer("echo", 64, servable)
+        batcher._staging_buffer("echo", 16, servable)
+        prepared = runtime.prepare_buckets("echo", (1, 16))  # shrink only
+        runtime.apply_ladder("echo", prepared)
+        batcher._staging_buffer("echo", 16, servable)  # existing ring
+        assert ("echo", 64) not in batcher._staging
+        assert ("echo", 16) in batcher._staging

@@ -1153,3 +1153,75 @@ class TestLadderHysteresisVsMetricsFlush:
 
         report = explore_interleavings(make, schedules=SCHEDULES, seed=SEED)
         assert report.ok, report.describe()
+
+
+class TestLadderSwapVsBatchCut:
+    """The derived-ladder swap window (runtime/ladder.py, docs/
+    device_path.md): a batch cut reads the servable's ladder tuple
+    (``bucket_for``), suspends (the executor hop), and pads to the chosen
+    bucket — while the deriver thread compiles a NEW ladder and swaps it
+    in. The invariant: no request is ever padded to a bucket that has no
+    compiled program. The fixed order — ``prepare_buckets`` warms every
+    new bucket, THEN ``apply_ladder`` assigns the tuple (and refuses
+    un-executed buckets), with the warm set append-only so old-ladder
+    cuts stay compiled — is race-free over the schedule budget; the
+    reverted order (assign first, compile after: the naive hot-swap)
+    lets a cut pick a bucket whose first call would compile on the
+    serving path, and is caught."""
+
+    @staticmethod
+    def _scenario(prepare_before_swap: bool):
+        def make():
+            # Warm set + serving ladder, mirroring ModelRuntime
+            # (_executed_shapes is append-only; batch_buckets is swapped
+            # in one assignment).
+            state = {"ladder": (1, 8), "warm": {1, 8}}
+            cold_pads: list[int] = []
+
+            async def cutter():
+                # Two cuts racing the swap: each reads the tuple, hops
+                # to the executor, then pads — the exact _execute shape.
+                for n in (3, 5):
+                    ladder = state["ladder"]
+                    await yield_point()  # run_in_executor hand-off
+                    bucket = next((b for b in ladder if b >= n),
+                                  ladder[-1])
+                    if bucket not in state["warm"]:
+                        cold_pads.append(bucket)
+                    await yield_point()
+
+            async def swapper():
+                new = (4, 8)
+                if prepare_before_swap:
+                    for b in new:  # prepare_buckets: warm FIRST…
+                        state["warm"].add(b)
+                        await yield_point()  # compiles suspend freely
+                    state["ladder"] = new  # …then the atomic assignment
+                else:
+                    state["ladder"] = new  # reverted: assign, then warm
+                    await yield_point()
+                    for b in new:
+                        state["warm"].add(b)
+                        await yield_point()
+
+            def check():
+                assert not cold_pads, (
+                    f"batch padded to bucket(s) {cold_pads} with no "
+                    "compiled program — a serving-path compile stall")
+
+            return [cutter(), swapper()], check
+
+        return make
+
+    def test_prepare_then_swap_race_free(self):
+        report = explore_interleavings(self._scenario(True),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_swap_before_prepare_caught(self):
+        report = explore_interleavings(self._scenario(False),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert not report.ok, (
+            "the assign-before-compile window was not reachable — either "
+            "the scenario no longer models the swap or the budget is "
+            "too small")

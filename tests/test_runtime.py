@@ -230,7 +230,7 @@ class TestMicroBatcher:
                 _Pending(np.zeros((4,), np.float32), loop.create_future())
                 for _ in range(9)]
             batcher._pending["double"] = [old_bg, *fresh]
-            cut = batcher._take_batch("double")
+            cut, _bucket = batcher._take_batch("double")
             assert old_bg in cut, "aged background item was starved"
 
         run(main())
