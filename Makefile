@@ -14,7 +14,9 @@ test:
 	  --continue-on-collection-errors -p no:cacheprovider
 
 # The deterministic interleaving suite (docs/concurrency.md) — the same
-# selection CI's race-smoke job runs, JAX-free.
+# selection CI's race-smoke job runs, JAX-free (including the decode
+# engine's slot-conservation regressions, which is why runtime/decode.py
+# must stay importable without JAX or numpy).
 race-smoke:
 	python -m pytest tests/test_race_explorer.py \
 	  tests/test_race_regressions.py -q -m race -p no:cacheprovider

@@ -213,6 +213,25 @@ def _mesh_from_config(rt):
                                         for k, v in axes.items()}))
 
 
+def _restore_checkpoint(servable, checkpoint: str,
+                        checkpoint_dir: str | None) -> None:
+    """Restore a servable's params from a models-spec checkpoint —
+    shared by the batch and streaming-LM paths so resolution cannot
+    diverge. Relative paths resolve under ``checkpoint_dir``
+    (AI4E_RUNTIME_CHECKPOINT_DIR, the chart's volume mount) or the
+    working directory — orbax requires absolute paths. The path is
+    recorded for the hot-reload endpoint (POST
+    {prefix}/models/{name}/reload re-reads it)."""
+    import os
+    from .checkpoint import load_params
+    if not os.path.isabs(checkpoint):
+        checkpoint = os.path.abspath(os.path.join(
+            checkpoint_dir or ".", checkpoint))
+    servable.params = load_params(checkpoint, like=servable.params)
+    servable.checkpoint_path = checkpoint
+    log.info("restored %s params from %s", servable.name, checkpoint)
+
+
 def build_worker(config: FrameworkConfig, models: dict):
     """Assemble a worker process; returns (worker, batcher, task_manager)."""
     from .runtime import (
@@ -297,9 +316,16 @@ def build_worker(config: FrameworkConfig, models: dict):
     # warmup so a restarted worker AOT-warms the traffic-tuned ladder
     # (docs/device_path.md).
     to_serve: list[tuple] = []
+    lm_specs: list[dict] = []
     for spec in models.get("models", []):
         spec = dict(spec)
         family = spec.pop("family")
+        if family == "seqformer-lm":
+            # Streaming decode servables ride the continuous-batching
+            # engine, not the MicroBatcher — collected here, wired after
+            # the worker exists (docs/streaming.md).
+            lm_specs.append(spec)
+            continue
         sync_path = spec.pop("sync_path", None)
         async_path = spec.pop("async_path", None)
         cap = spec.pop("maximum_concurrent_requests", 64)
@@ -313,20 +339,8 @@ def build_worker(config: FrameworkConfig, models: dict):
         if checkpoint:
             # Restore real weights at pod start (SURVEY.md §5: the slot the
             # reference fills by baking weights into container images;
-            # ai4e_tpu.train.make_checkpoints produces them). Relative paths
-            # resolve under runtime.checkpoint_dir (AI4E_RUNTIME_CHECKPOINT_DIR,
-            # the chart's volume mount) or the working directory — orbax
-            # requires absolute paths.
-            import os
-            from .checkpoint import load_params
-            if not os.path.isabs(checkpoint):
-                checkpoint = os.path.abspath(os.path.join(
-                    rt.checkpoint_dir or ".", checkpoint))
-            servable.params = load_params(checkpoint, like=servable.params)
-            # Recorded for the hot-reload endpoint (POST
-            # {prefix}/models/{name}/reload re-reads this path).
-            servable.checkpoint_path = checkpoint
-            log.info("restored %s params from %s", servable.name, checkpoint)
+            # ai4e_tpu.train.make_checkpoints produces them).
+            _restore_checkpoint(servable, checkpoint, rt.checkpoint_dir)
         runtime.register(servable)
         to_serve.append((servable, sync_path, async_path, cap,
                          pipeline_spec, batch))
@@ -399,6 +413,46 @@ def build_worker(config: FrameworkConfig, models: dict):
             worker.serve_batch(servable,
                                **(batch if isinstance(batch, dict) else {}))
     runtime.warmup()
+
+    # Continuous-batching decode path (AI4E_RUNTIME_DECODE_ENABLE,
+    # docs/streaming.md): one engine per seqformer-lm spec, AOT-warmed
+    # (prefill buckets + the step program) so nothing compiles on the
+    # serving path. Gated twice: the knob AND a spec — neither alone
+    # constructs an engine, keeping the default worker byte-identical.
+    # serve_stream registers each engine on worker.decode_engines (the
+    # reload endpoint and run_worker's start/stop read it there).
+    if lm_specs and not rt.decode_enable:
+        log.warning("models spec names %d seqformer-lm servable(s) but "
+                    "AI4E_RUNTIME_DECODE_ENABLE is off — not serving them",
+                    len(lm_specs))
+    elif lm_specs and jax.process_count() > 1:
+        log.warning("streaming decode is single-host only (the engine "
+                    "loop owns the device); not serving %d seqformer-lm "
+                    "servable(s)", len(lm_specs))
+    elif lm_specs:
+        from .runtime.decode import DecodeEngine
+        from .runtime.kvcache import PagedDecodeRuntime, build_lm_servable
+        for spec in lm_specs:
+            async_path = spec.pop("async_path", None)
+            cap = spec.pop("maximum_concurrent_requests", 64)
+            checkpoint = spec.pop("checkpoint", None)
+            spec.setdefault("max_len", rt.kv_max_len)
+            lm = build_lm_servable(**spec)
+            if checkpoint:
+                _restore_checkpoint(lm, checkpoint, rt.checkpoint_dir)
+            backend = PagedDecodeRuntime(
+                lm, slots=rt.kv_slots,
+                prompt_buckets=rt.decode_prompt_buckets or None)
+            backend.warm()
+            engine = DecodeEngine(backend,
+                                  max_pending=rt.decode_max_pending,
+                                  metrics=worker.service.metrics)
+            worker.serve_stream(engine, async_path=async_path,
+                                maximum_concurrent_requests=cap)
+            log.info("decode engine %s: %d slots, max_len %d, prompt "
+                     "buckets %s, cache %.1f MB", lm.name, backend.slots,
+                     backend.max_len, backend.prompt_buckets,
+                     backend.cache_nbytes() / 1e6)
 
     if jax.process_count() > 1:
         # Multi-host serving (SURVEY.md §7 hard part #3): the primary's
@@ -491,6 +545,8 @@ async def run_worker(config: FrameworkConfig, models: dict) -> None:
         return
 
     await batcher.start()
+    for engine in getattr(worker, "decode_engines", []):
+        await engine.start()
     runner = web.AppRunner(worker.service.app)
     await runner.setup()
     site = web.TCPSite(runner, config.service.host, config.service.port)
@@ -505,14 +561,20 @@ async def run_worker(config: FrameworkConfig, models: dict) -> None:
                                interval_s=config.observability
                                .vitals_interval)
         await vitals.start()
-    log.info("worker on %s:%s serving %s%s%s%s", config.service.host,
+    log.info("worker on %s:%s serving %s%s%s%s%s", config.service.host,
              config.service.port, list(worker.runtime.models),
              ", vitals ON" if vitals is not None else "",
              # Device-path posture (docs/device_path.md): operators grep
              # these to confirm the traffic-tuned/overlapped hot path.
              ", ladder derivation ON" if batcher._ladders is not None
              else "",
-             ", double-buffered transfers ON" if batcher._double else "")
+             ", double-buffered transfers ON" if batcher._double else "",
+             # Streaming posture (docs/streaming.md): the continuous-
+             # batching decode engines this worker serves.
+             (", streaming decode ON (%s)" % ", ".join(
+                 e.backend.name
+                 for e in getattr(worker, "decode_engines", []))
+              if getattr(worker, "decode_engines", []) else ""))
     try:
         await _wait_for_termination()
     finally:
@@ -520,6 +582,8 @@ async def run_worker(config: FrameworkConfig, models: dict) -> None:
             await vitals.stop()
         await worker.service.drain(timeout=config.service.drain_timeout)
         await batcher.stop()
+        for engine in getattr(worker, "decode_engines", []):
+            await engine.stop()
         if jax.process_count() > 1:
             worker.runtime.shutdown_followers()
         if worker.service.reporter is not None:

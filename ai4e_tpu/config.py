@@ -261,6 +261,11 @@ class PlatformSection:
     # maximum SSE stream duration per request (seconds).
     pipeline_event_replay: int = 256
     pipeline_stream_max_s: float = 300.0
+    # Separate bound for CHUNK events (token streams): a late attacher
+    # replays at most this many trailing chunks, older ones are dropped
+    # with a single `truncated` marker — a slow client must never hold
+    # unbounded token history (docs/streaming.md).
+    pipeline_chunk_replay: int = 128
 
     def to_platform_config(self):
         from .platform_assembly import PlatformConfig
@@ -332,6 +337,7 @@ class PlatformSection:
             pipeline=self.pipeline,
             pipeline_event_replay=self.pipeline_event_replay,
             pipeline_stream_max_s=self.pipeline_stream_max_s,
+            pipeline_chunk_replay=self.pipeline_chunk_replay,
         )
 
 
@@ -393,6 +399,22 @@ class RuntimeSection:
     # traffic-tuned ladder).
     ladder_path: typing.Optional[str] = None
     buckets: typing.Tuple[int, ...] = (1, 8, 32, 64)
+    # Continuous-batching decode engine (runtime/decode.py,
+    # docs/streaming.md): iteration-level scheduling over a KV-cache
+    # slot pool with per-token `chunk` streaming. Off = the engine is
+    # never constructed — the batch path and /metrics exposition are
+    # byte-identical to the decode-less worker.
+    decode_enable: bool = False
+    decode_max_pending: int = 64       # queued streams before 503
+    # Prompt-padding bucket ladder; empty = the factory
+    # ladder.DECODE_PROMPT_BUCKETS (the KV length is always appended as
+    # the covering top bucket).
+    decode_prompt_buckets: typing.Tuple[int, ...] = ()
+    # KV-cache slot-pool geometry (runtime/kvcache.py): concurrent
+    # decoding sequences per model, and the per-slot cache length
+    # (prompt + generated tokens must fit under it).
+    kv_slots: int = 8
+    kv_max_len: int = 256
     compile_cache_dir: str = "/tmp/ai4e_tpu_xla_cache"
     checkpoint_dir: typing.Optional[str] = None
     donate_batch: bool = False

@@ -284,6 +284,17 @@ class Gateway:
             # nan/inf would defeat the stream-duration cap (min(nan, cap)
             # is nan, and the deadline arithmetic never expires).
             return web.Response(status=400, text="Bad wait parameter.")
+        # SSE reconnect resume: the browser EventSource contract sends
+        # the last consumed `id:` back as Last-Event-ID; replay restarts
+        # strictly after it (?lastEventId= for manual clients). A resume
+        # point inside chunk history the bounded replay already dropped
+        # yields one synthetic `truncated` event (docs/streaming.md).
+        raw_last = (request.headers.get("Last-Event-ID")
+                    or request.query.get("lastEventId") or "0")
+        try:
+            after_seq = max(0, int(raw_last))
+        except ValueError:
+            return web.Response(status=400, text="Bad Last-Event-ID.")
 
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
@@ -292,7 +303,7 @@ class Gateway:
         })
         await resp.prepare(request)
         self._requests.inc(route="task_events", outcome="stream")
-        stream = hub.subscribe(task_id)
+        stream = hub.subscribe(task_id, after_seq=after_seq)
         loop = asyncio.get_running_loop()
         deadline = loop.time() + wait
         try:
@@ -308,9 +319,10 @@ class Gateway:
                      "data": {"Status": task.status,
                               "BackendStatus": task.backend_status}}))
                 if task.canonical_status in TaskStatus.TERMINAL:
-                    # Drain any buffered stage events before closing so a
-                    # late subscriber still sees the run's shape.
-                    for event in hub.replay(task_id):
+                    # Drain any buffered stage/chunk events before closing
+                    # so a late subscriber still sees the run's shape
+                    # (from its resume point; truncated marker included).
+                    for event in hub.replay(task_id, after_seq=after_seq):
                         if event["event"] != TERMINAL:
                             await resp.write(sse_encode(event))
                     await resp.write(sse_encode(

@@ -106,6 +106,159 @@ class SeqFormer(nn.Module):
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(h)
 
 
+class _LMBlock(nn.Module):
+    """One causal decoder block with the two attention entry points the
+    serving runtime needs: ``prefill`` (full causal attention over the
+    prompt, returning the K/V it computed) and ``step`` (one token per
+    sequence against a K/V cache, returning the cache with the new
+    token's K/V written at ``position``). Both run through the SAME
+    parameters — ``setup`` instead of ``nn.compact`` so the two methods
+    share the module tree."""
+
+    dim: int
+    heads: int
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.ln1 = nn.LayerNorm(name="ln1")
+        self.qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype,
+                            name="qkv")
+        self.proj = nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+                             name="proj")
+        self.ln2 = nn.LayerNorm(name="ln2")
+        self.mlp_up = nn.Dense(self.dim * 4, dtype=self.dtype, name="mlp_up")
+        self.mlp_down = nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")
+
+    def prefill(self, x, mask):
+        """x: (B, S, D); mask: (B, S) True on real tokens. Returns
+        ``(y, k, v)`` with k/v of shape (B, H, S, hd) — the block's
+        contribution to the sequence's KV cache."""
+        b, s, _ = x.shape
+        hd = self.dim // self.heads
+        h = self.ln1(x)
+        qkv = self.qkv(h).reshape(b, s, 3, self.heads, hd)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(hd)
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        keep = causal[None, None] & mask[:, None, None, :]
+        scores = jnp.where(keep, scores, jnp.asarray(-1e30, scores.dtype))
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
+        x = x + self.proj(o.transpose(0, 2, 1, 3).reshape(b, s, self.dim))
+        x = x + self.mlp_down(nn.gelu(self.mlp_up(self.ln2(x))))
+        return x, k, v
+
+    def step(self, x, k_cache, v_cache, position):
+        """One decode step over the slot pool. x: (S, D) — one new token
+        per slot; k_cache/v_cache: (S, H, L, hd); position: (S,) — the
+        cache index the new token's K/V lands at. Returns ``(y, k, v)``
+        with the caches updated via a one-hot scatter (SPMD-friendly: no
+        per-slot dynamic slices)."""
+        s, _ = x.shape
+        hd = self.dim // self.heads
+        length = k_cache.shape[2]
+        h = self.ln1(x)
+        qkv = self.qkv(h).reshape(s, 3, self.heads, hd)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # (S, H, hd)
+        oh = jax.nn.one_hot(position, length, dtype=k_cache.dtype)  # (S, L)
+        k_cache = (k_cache * (1.0 - oh)[:, None, :, None]
+                   + k_new[:, :, None, :] * oh[:, None, :, None])
+        v_cache = (v_cache * (1.0 - oh)[:, None, :, None]
+                   + v_new[:, :, None, :] * oh[:, None, :, None])
+        scores = jnp.einsum("shd,shld->shl", q, k_cache) / jnp.sqrt(hd)
+        valid = (jnp.arange(length)[None, :]
+                 <= position[:, None])  # keys at or before the new token
+        scores = jnp.where(valid[:, None, :], scores,
+                           jnp.asarray(-1e30, scores.dtype))
+        o = jnp.einsum("shl,shld->shd", jax.nn.softmax(scores, axis=-1),
+                       v_cache)
+        x = x + self.proj(o.reshape(s, self.dim))
+        x = x + self.mlp_down(nn.gelu(self.mlp_up(self.ln2(x))))
+        return x, k_cache, v_cache
+
+
+class SeqFormerLM(nn.Module):
+    """Causal token LM over the SeqFormer block stack — the
+    autoregressive serving shape (``runtime/decode.py``). Two entry
+    points, applied via ``method=``:
+
+    - ``prefill(tokens (B, P), length (B,))`` → ``(next-token ids (B,),
+      k, v)`` with k/v of shape (depth, B, H, P, hd) — the prompt's KV
+      block, inserted into a slot of the pooled cache by the decode
+      runtime (``runtime/kvcache.py``);
+    - ``decode_step(tokens (S,), k (depth, S, H, L, hd), v, position
+      (S,))`` → ``(next-token ids (S,), k, v)`` — ONE token for every
+      slot in the pool per call, inactive slots riding along masked
+      (their cache rows are garbage a later prefill overwrites).
+
+    Greedy decoding is computed on-device (argmax over the tied-embedding
+    logits) so each step ships S int32s back to the host, not S×V logits.
+    """
+
+    vocab_size: int
+    max_len: int
+    dim: int = 64
+    depth: int = 2
+    heads: int = 4
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.embed = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype,
+                              name="embed")
+        self.pos_emb = self.param("pos_emb", nn.initializers.normal(0.02),
+                                  (self.max_len, self.dim))
+        self.blocks = [_LMBlock(self.dim, self.heads, dtype=self.dtype,
+                                name=f"block{i}") for i in range(self.depth)]
+        self.ln_f = nn.LayerNorm(name="ln_f")
+
+    def _logits(self, h):
+        # Tied embedding head: attend() reuses the embedding matrix, so
+        # the LM head adds no parameters beyond the encoder families'.
+        return self.embed.attend(self.ln_f(h).astype(jnp.float32)
+                                 .astype(self.dtype))
+
+    def prefill(self, tokens, length):
+        b, p = tokens.shape
+        h = self.embed(tokens) + self.pos_emb[None, :p].astype(self.dtype)
+        mask = jnp.arange(p)[None, :] < length[:, None]
+        ks, vs = [], []
+        for blk in self.blocks:
+            h, k, v = blk.prefill(h, mask)
+            ks.append(k)
+            vs.append(v)
+        last = jnp.take_along_axis(
+            h, (length - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        next_token = jnp.argmax(self._logits(last), axis=-1).astype(jnp.int32)
+        return next_token, jnp.stack(ks), jnp.stack(vs)
+
+    def decode_step(self, tokens, k_cache, v_cache, position):
+        h = (self.embed(tokens)
+             + self.pos_emb[position].astype(self.dtype))  # (S, D)
+        new_k, new_v = [], []
+        for i, blk in enumerate(self.blocks):
+            h, k, v = blk.step(h, k_cache[i], v_cache[i], position)
+            new_k.append(k)
+            new_v.append(v)
+        next_token = jnp.argmax(self._logits(h), axis=-1).astype(jnp.int32)
+        return next_token, jnp.stack(new_k), jnp.stack(new_v)
+
+
+def create_seqformer_lm(rng=None, vocab_size: int = 512, max_len: int = 256,
+                        dim: int = 64, depth: int = 2, heads: int = 4):
+    """Build the causal LM + params for the continuous-batching decode
+    path. ``max_len`` is the KV-cache depth per slot — prompt plus
+    generated tokens must fit under it (``docs/streaming.md`` has the
+    memory math)."""
+    if dim % heads:
+        raise ValueError(f"dim {dim} not divisible by heads {heads}")
+    model = SeqFormerLM(vocab_size=vocab_size, max_len=max_len, dim=dim,
+                        depth=depth, heads=heads)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    init_p = min(8, max_len)
+    params = model.init(rng, np.zeros((1, init_p), np.int32),
+                        np.ones((1,), np.int32), method=SeqFormerLM.prefill)
+    return model, params
+
+
 def attention_for(mesh=None, strategy: str = "auto", causal: bool = False,
                   batch_axes=("dp", "fsdp")) -> Callable:
     """Pick the attention implementation for a mesh.

@@ -57,6 +57,9 @@ DUPLICATE = "duplicate"      # redelivery suppressed (task already terminal)
 DEAD_LETTER = "dead_letter"  # delivery budget exhausted
 STAGE = "stage"              # pipeline stage boundary (r="name event" or
                              # "old-path -> new-path" on hop-to-hop handoff)
+CHUNK = "chunk"              # streaming first token (ms=TTFT; one stamp
+                             # per request — a 512-token stream must not
+                             # eat the event cap)
 
 # Hard cap on events per task: a pathological retry loop must not grow
 # a record without bound. The overflow marker is itself an event, once.

@@ -63,12 +63,32 @@ def sse_encode(event: dict) -> bytes:
             f"data: {data}\n\n").encode("utf-8")
 
 
-class TaskEventHub:
-    """Bounded, thread-safe per-task event fan-out with replay."""
+#: The synthetic event type a subscriber sees in place of chunk history
+#: the bounded replay dropped (never published by producers; minted at
+#: attach time from the drop accounting).
+TRUNCATED = "truncated"
 
-    def __init__(self, replay: int = 256, max_tasks: int = 4096,
+
+class TaskEventHub:
+    """Bounded, thread-safe per-task event fan-out with replay.
+
+    Chunk hardening (docs/streaming.md): CHUNK events — per-token
+    partials, potentially hundreds per task — are bounded separately
+    from the first-``replay`` buffer the other event types keep. The
+    newest ``chunk_replay`` chunks are retained (a tail ring: a client
+    attaching mid-stream wants the RECENT tokens), older ones are
+    dropped, and a subscriber whose attach point falls inside the
+    dropped range receives one synthetic ``truncated`` event carrying
+    the cumulative drop count — a slow client can never hold unbounded
+    token history. ``subscribe``/``replay`` take ``after_seq`` (the SSE
+    ``Last-Event-ID`` resume contract): replay starts strictly after it.
+    """
+
+    def __init__(self, replay: int = 256, chunk_replay: int = 128,
+                 max_tasks: int = 4096,
                  metrics: MetricsRegistry | None = None):
         self._replay_cap = replay
+        self._chunk_cap = chunk_replay
         self._max_tasks = max_tasks
         self._lock = threading.Lock()
         # task_id -> {"seq": int, "events": [event dicts], "done": bool}
@@ -95,8 +115,12 @@ class TaskEventHub:
     def _entry(self, task_id: str) -> dict:
         entry = self._tasks.get(task_id)
         if entry is None:
-            entry = self._tasks[task_id] = {"seq": 0, "events": [],
-                                            "done": False}
+            entry = self._tasks[task_id] = {
+                "seq": 0, "events": [], "done": False,
+                # Chunk-bound accounting: live chunk count in `events`,
+                # cumulative dropped chunks, and the highest dropped seq
+                # (the `truncated` marker's position at attach).
+                "chunks": 0, "chunks_dropped": 0, "dropped_through": 0}
             while len(self._tasks) > self._max_tasks:
                 self._tasks.popitem(last=False)
         else:
@@ -118,7 +142,27 @@ class TaskEventHub:
                 return  # stream already closed by a terminal event
             entry["seq"] += 1
             event = {"seq": entry["seq"], "event": event_type, "data": data}
-            if len(entry["events"]) < self._replay_cap:
+            if event_type == CHUNK:
+                # Tail ring for token streams: keep the newest
+                # chunk_replay chunks, evict the oldest past the cap —
+                # the bounded-history contract (class docstring). The
+                # scan for the oldest resident chunk starts at the last
+                # eviction's index (everything before it is non-chunk
+                # and a pop never moves those), so a long stream pays
+                # O(chunk window), not O(buffer), per evicting publish.
+                events = entry["events"]
+                events.append(event)
+                entry["chunks"] += 1
+                if entry["chunks"] > self._chunk_cap:
+                    floor = entry.get("chunk_floor", 0)
+                    idx = next(i for i in range(floor, len(events))
+                               if events[i]["event"] == CHUNK)
+                    dropped = events.pop(idx)
+                    entry["chunk_floor"] = idx
+                    entry["chunks"] -= 1
+                    entry["chunks_dropped"] += 1
+                    entry["dropped_through"] = dropped["seq"]
+            elif len(entry["events"]) < self._replay_cap:
                 entry["events"].append(event)
             if event_type == TERMINAL:
                 entry["done"] = True
@@ -180,21 +224,42 @@ class TaskEventHub:
 
     # -- consumer side -------------------------------------------------------
 
-    def subscribe(self, task_id: str) -> "TaskEventStream":
+    @staticmethod
+    def _replay_view(entry: dict, after_seq: int) -> list[dict]:
+        """The replay a subscriber resuming after ``after_seq`` sees:
+        buffered events strictly past it, preceded by ONE synthetic
+        ``truncated`` event when dropped chunk history falls inside the
+        requested range. Caller holds the lock."""
+        view = [e for e in entry["events"] if e["seq"] > after_seq]
+        through = entry["dropped_through"]
+        if through > after_seq:
+            marker = {"seq": through, "event": TRUNCATED,
+                      "data": {"dropped_chunks": entry["chunks_dropped"],
+                               "through_seq": through}}
+            at = next((i for i, e in enumerate(view)
+                       if e["seq"] > through), len(view))
+            view.insert(at, marker)
+        return view
+
+    def subscribe(self, task_id: str, after_seq: int = 0
+                  ) -> "TaskEventStream":
         """Attach a consumer: returns an async-iterable stream yielding the
         task's replay buffer then live events, under one lock so no event
-        can fall between replay and registration."""
+        can fall between replay and registration. ``after_seq`` is the
+        ``Last-Event-ID`` resume point: replay starts strictly after it
+        (0 = from the beginning)."""
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue(maxsize=1024)
         entry_key = (loop, queue)
         with self._lock:
             entry = self._entry(task_id)
-            replay = list(entry["events"])
+            replay = self._replay_view(entry, after_seq)
             done = entry["done"]
             if not done:
                 self._subscribers[task_id] = self._subscribers.get(
                     task_id, frozenset()) | {entry_key}
-        return TaskEventStream(self, task_id, entry_key, replay, done)
+        return TaskEventStream(self, task_id, entry_key, replay, done,
+                               seen_seq=after_seq)
 
     def _unsubscribe(self, task_id: str, entry_key) -> None:
         with self._lock:
@@ -207,11 +272,13 @@ class TaskEventHub:
             else:
                 del self._subscribers[task_id]
 
-    def replay(self, task_id: str) -> list[dict]:
-        """The task's buffered events (introspection/tests)."""
+    def replay(self, task_id: str, after_seq: int = 0) -> list[dict]:
+        """The task's buffered events past ``after_seq``, with the same
+        ``truncated`` marker a subscriber would see (introspection, and
+        the gateway's already-terminal fast path)."""
         with self._lock:
             entry = self._tasks.get(task_id)
-            return list(entry["events"]) if entry else []
+            return self._replay_view(entry, after_seq) if entry else []
 
     @property
     def subscriber_count(self) -> int:
@@ -225,14 +292,16 @@ class TaskEventStream:
     iterator) detaches the subscription."""
 
     def __init__(self, hub: TaskEventHub, task_id: str, entry_key,
-                 replay: list[dict], done: bool):
+                 replay: list[dict], done: bool, seen_seq: int = 0):
         self._hub = hub
         self.task_id = task_id
         self._entry_key = entry_key
         self._pending = list(replay)
         self._queue = entry_key[1]
         self._live = not done
-        self._seen_seq = 0
+        # Resume point (Last-Event-ID): live events at or under it are
+        # duplicates of what the client already consumed.
+        self._seen_seq = seen_seq
 
     def __aiter__(self):
         return self

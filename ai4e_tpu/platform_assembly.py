@@ -207,6 +207,10 @@ class PlatformConfig:
     # ?wait= may only shorten it).
     pipeline_event_replay: int = 256
     pipeline_stream_max_s: float = 300.0
+    # Trailing CHUNK events (token streams) a late attacher replays
+    # before the bounded history drops to a single `truncated` marker
+    # (docs/streaming.md).
+    pipeline_chunk_replay: int = 128
 
 
 class LocalPlatform:
@@ -493,6 +497,7 @@ class LocalPlatform:
             from .pipeline import PipelineCoordinator, TaskEventHub
             self.task_events = TaskEventHub(
                 replay=self.config.pipeline_event_replay,
+                chunk_replay=self.config.pipeline_chunk_replay,
                 metrics=self.metrics)
             # Every transition of a tracked/streamed task becomes a
             # `status` event; terminal transitions close streams — the

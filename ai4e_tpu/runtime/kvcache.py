@@ -1,0 +1,243 @@
+"""Pooled KV-cache decode runtime — the device side of continuous
+batching (``runtime/decode.py`` owns the scheduling).
+
+The cache is ONE preallocated slot-pool buffer per tensor::
+
+    k, v : (layers, slots, heads, max_len, head_dim)
+
+keyed by ``(model, params_version)`` — a hot weight reload bumps the
+version and the engine invalidates (``reset_cache``) then re-prefills,
+the same key contract as rescache (a KV block computed under old weights
+is a stale cached result). Slots are rows of that buffer; admission and
+release are pure bookkeeping in ``decode.SlotPool`` — the device never
+reallocates per request.
+
+Three compiled programs serve the whole path, none of which may compile
+on the serving path (``warm()`` executes every one — the AOT-warm
+discipline ``ModelRuntime.warmup`` applies to batch buckets):
+
+- **prefill** — full causal attention over ONE padded prompt, per
+  prompt bucket (``ladder.DECODE_PROMPT_BUCKETS``: prompts pad to the
+  smallest fitting bucket, so XLA compiles ``len(buckets)`` prefill
+  programs, not one per prompt length);
+- **insert** — ``dynamic_update_slice`` of a prefill's KV block into a
+  slot row (slot index is a traced scalar: one program per bucket, any
+  slot);
+- **step** — one decode step over the WHOLE pool: every slot advances
+  one token (inactive slots ride along masked; their rows are garbage a
+  later prefill overwrites). One fixed shape → exactly one program.
+
+Buffer donation: the step and insert programs consume the cache and
+return the updated one; on non-CPU backends the input buffer is donated
+so the pool exists on-device exactly once.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+log = logging.getLogger("ai4e_tpu.kvcache")
+
+
+@dataclass
+class LMServable:
+    """A deployable autoregressive LM — the decode path's analogue of
+    ``registry.ServableModel`` (which stays the batch path's contract:
+    LMs never enter ``runtime.models``, the MicroBatcher cannot serve
+    them)."""
+
+    name: str
+    model: Any                   # models.seqformer.SeqFormerLM
+    params: Any
+    vocab_size: int
+    max_len: int
+    eos_id: int | None = None
+    version: str = "1.0"
+    checkpoint_path: str | None = None
+    params_version: int = 1
+
+
+def build_lm_servable(name: str = "lm", vocab_size: int = 512,
+                      max_len: int = 256, dim: int = 64, depth: int = 2,
+                      heads: int = 4, eos_id: int | None = None,
+                      rng=None, **_) -> LMServable:
+    """Build a SeqFormerLM servable for the streaming path (the ``**_``
+    sink mirrors the batch families: spec-driven callers may pass keys
+    this family ignores)."""
+    from ..models.seqformer import create_seqformer_lm
+    model, params = create_seqformer_lm(
+        rng=rng, vocab_size=vocab_size, max_len=max_len, dim=dim,
+        depth=depth, heads=heads)
+    return LMServable(name=name, model=model, params=params,
+                      vocab_size=vocab_size, max_len=max_len, eos_id=eos_id)
+
+
+class PagedDecodeRuntime:
+    """The ``DecodeEngine`` backend over a real JAX model. All methods
+    are blocking — the engine runs them on its single device-executor
+    thread (the device is the serial resource, batcher discipline)."""
+
+    def __init__(self, servable: LMServable, slots: int = 8,
+                 prompt_buckets=None, donate: bool | None = None):
+        from .ladder import DECODE_PROMPT_BUCKETS
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.servable = servable
+        self.name = servable.name
+        self.slots = slots
+        self.max_len = servable.max_len
+        self.eos_id = servable.eos_id
+        raw = tuple(prompt_buckets) if prompt_buckets else (
+            DECODE_PROMPT_BUCKETS)
+        # Clamp to the cache length and force coverage: the top bucket is
+        # always max_len, so every admissible prompt (< max_len) has a
+        # compiled program — no serving-path compile, ever.
+        self.prompt_buckets = tuple(sorted(
+            {min(int(b), self.max_len) for b in raw} | {self.max_len}))
+        self._k = None
+        self._v = None
+        self._donate = donate
+        self._programs = None
+
+    # -- cache lifecycle ---------------------------------------------------
+
+    @property
+    def params_version(self) -> int:
+        return self.servable.params_version
+
+    def cache_nbytes(self) -> int:
+        """Resident bytes of the pooled cache (both tensors) — the
+        number the memory math in docs/streaming.md bounds."""
+        m = self.servable.model
+        head_dim = m.dim // m.heads
+        return (2 * m.depth * self.slots * m.heads * self.max_len
+                * head_dim * np.dtype(np.float32).itemsize)
+
+    def reset_cache(self) -> None:
+        """Drop + reallocate the pooled cache (hot-reload invalidation:
+        blocks computed under the old weights must never serve)."""
+        import jax.numpy as jnp
+        m = self.servable.model
+        head_dim = m.dim // m.heads
+        shape = (m.depth, self.slots, m.heads, self.max_len, head_dim)
+        self._k = jnp.zeros(shape, jnp.float32)
+        self._v = jnp.zeros(shape, jnp.float32)
+
+    def _ensure(self) -> None:
+        if self._k is None:
+            self.reset_cache()
+        if self._programs is None:
+            self._build_programs()
+
+    def _build_programs(self) -> None:
+        import jax
+        from ..models.seqformer import SeqFormerLM
+        model = self.servable.model
+        if self._donate is None:
+            # CPU XLA cannot donate (every run would warn); on device
+            # backends donation keeps the pool resident exactly once.
+            self._donate = jax.default_backend() != "cpu"
+        donate_step = (2, 3) if self._donate else ()
+        donate_insert = (0, 1) if self._donate else ()
+
+        def prefill(params, tokens, length):
+            return model.apply(params, tokens, length,
+                               method=SeqFormerLM.prefill)
+
+        def step(params, tokens, k, v, position):
+            return model.apply(params, tokens, k, v, position,
+                               method=SeqFormerLM.decode_step)
+
+        def insert(k, v, k_block, v_block, slot):
+            zero = (0, slot, 0, 0, 0)
+            # Blocks arrive as (depth, 1, H, P, hd) — rank-matched to the
+            # pool, so one dynamic_update_slice lands the whole prompt.
+            return (jax.lax.dynamic_update_slice(k, k_block, zero),
+                    jax.lax.dynamic_update_slice(v, v_block, zero))
+
+        self._programs = {
+            "prefill": jax.jit(prefill),
+            "step": jax.jit(step, donate_argnums=donate_step),
+            "insert": jax.jit(insert, donate_argnums=donate_insert),
+        }
+
+    # -- engine backend surface -------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if b >= n:
+                return b
+        return self.prompt_buckets[-1]
+
+    def prefill_into(self, slot: int, tokens) -> int:
+        """Run the prompt through the prefill program (padded to its
+        bucket), write its KV block into ``slot``, return the first
+        generated token id."""
+        self._ensure()
+        n = len(tokens)
+        if not 0 < n < self.max_len:
+            raise ValueError(
+                f"prompt of {n} tokens must be in [1, {self.max_len})")
+        bucket = self.bucket_for(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = tokens
+        token, k_block, v_block = self._programs["prefill"](
+            self.servable.params, padded, np.asarray([n], np.int32))
+        self._k, self._v = self._programs["insert"](
+            self._k, self._v, k_block, v_block, np.int32(slot))
+        return int(token[0])
+
+    def step(self, tokens, positions, active) -> list[int]:
+        """One decode step over the pool. ``active`` is advisory — the
+        program computes every slot; inactive rows are garbage the
+        engine never reads."""
+        self._ensure()
+        del active
+        out, self._k, self._v = self._programs["step"](
+            self.servable.params, np.asarray(tokens, np.int32),
+            self._k, self._v, np.asarray(positions, np.int32))
+        return [int(t) for t in np.asarray(out)]
+
+    # -- weights -----------------------------------------------------------
+
+    def reload_params(self, new_params) -> int:
+        """Hot-swap the LM's weights (same tree contract as
+        ``ModelRuntime.reload_params``); bumps ``params_version`` so the
+        engine invalidates the pooled cache at its next tick."""
+        import jax
+        import jax.numpy as jnp
+
+        def spec_of(tree):
+            return jax.tree.map(
+                lambda a: (tuple(a.shape), jnp.result_type(a).name), tree)
+
+        if spec_of(self.servable.params) != spec_of(new_params):
+            raise ValueError(
+                "checkpoint tree does not match the served model")
+        self.servable.params = new_params
+        self.servable.params_version += 1
+        return self.servable.params_version
+
+    # -- warmup ------------------------------------------------------------
+
+    def warm(self) -> float:
+        """Execute every program once — ``len(prompt_buckets)`` prefill +
+        insert pairs and the one step program — so nothing compiles on
+        the serving path, then reset the cache to a clean pool. Returns
+        wall seconds (exported by the worker boot like batch warmup)."""
+        self._ensure()
+        t0 = time.perf_counter()
+        for bucket in self.prompt_buckets:
+            n = min(bucket, self.max_len - 1)
+            self.prefill_into(0, [1] * n)
+        self.step([0] * self.slots, [1] * self.slots, [True] * self.slots)
+        self.reset_cache()
+        seconds = time.perf_counter() - t0
+        log.info("decode warmup %s: %d prompt buckets + step in %.1fs",
+                 self.name, len(self.prompt_buckets), seconds)
+        return seconds

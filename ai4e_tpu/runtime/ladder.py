@@ -70,6 +70,14 @@ DETECTOR_BUCKETS = (1, 8, 16)
 #: derivation-off batchers so /metrics stays byte-identical to the
 #: pre-derivation platform.
 EXPOSITION_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+#: Decode-path PROMPT buckets (runtime/kvcache.py): a streaming
+#: request's prompt pads to the smallest fitting bucket before prefill,
+#: so XLA compiles len(ladder) prefill programs instead of one per
+#: prompt length. The decode runtime always appends the KV-cache length
+#: as the covering top bucket (every admissible prompt has a compiled
+#: program). Same AIL012 discipline as the batch ladders: the literal
+#: lives HERE, overridden by AI4E_RUNTIME_DECODE_PROMPT_BUCKETS.
+DECODE_PROMPT_BUCKETS = (1, 16, 64)
 
 
 def _align_up(n: int, multiple: int) -> int:

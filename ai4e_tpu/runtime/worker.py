@@ -86,6 +86,10 @@ class InferenceWorker:
             "ai4e_admission_expired_total",
             "Requests dropped on deadline expiry, by hop/priority")
         self._served: dict[str, dict] = {}  # model -> endpoint listing
+        # Streaming decode engines served via serve_stream — the reload
+        # endpoint resolves LM names here (they never enter
+        # runtime.models) and the launcher starts/stops them.
+        self.decode_engines: list = []
         # Serializes hot reloads: concurrent swaps would otherwise leave
         # checkpoint_path/params_version reporting a different rollout
         # than the params actually serving.
@@ -152,8 +156,19 @@ class InferenceWorker:
                     status=401)
         name = request.match_info["name"]
         servable = self.runtime.models.get(name)
+        lm_backend = None
         if servable is None:
-            return web.json_response({"error": "unknown model"}, status=404)
+            # Streaming LMs live on decode engines, not runtime.models;
+            # their reload additionally invalidates the pooled KV cache
+            # (params_version bump → the engine re-prefills actives,
+            # docs/streaming.md).
+            lm_backend = next(
+                (e.backend for e in self.decode_engines
+                 if getattr(e.backend, "name", None) == name), None)
+            if lm_backend is None:
+                return web.json_response({"error": "unknown model"},
+                                         status=404)
+            servable = lm_backend.servable
         if jax.process_count() > 1:
             return web.json_response(
                 {"error": "hot reload is single-host; roll the replicas of "
@@ -200,6 +215,9 @@ class InferenceWorker:
         def load_and_swap():
             from ..checkpoint import load_params
             new_params = load_params(path, like=servable.params)
+            if lm_backend is not None:
+                lm_backend.reload_params(new_params)
+                return servable
             return self.runtime.reload_params(name, new_params)
 
         async with self._reload_lock:
@@ -452,6 +470,152 @@ class InferenceWorker:
         except Exception:  # noqa: BLE001; ai4e: noqa[AIL005] — observability is fail-open: a dropped flush loses a timeline, not a task
             log.debug("hop-ledger flush dropped for task %s", task_id,
                       exc_info=True)
+
+    def serve_stream(self, engine, async_path: str | None = None,
+                     maximum_concurrent_requests: int = 64,
+                     event_hub=None) -> None:
+        """Expose a streaming autoregressive endpoint over a
+        ``DecodeEngine`` (``runtime/decode.py``) — the continuous-
+        batching serving path. The request joins the running decode
+        batch between steps; every generated token is published as a
+        ``chunk`` event through ``event_hub`` (the PR 9 ``TaskEventHub``)
+        under the request's TaskId, so ``GET /v1/taskmanagement/task/
+        {id}/events`` streams tokens live while the task runs.
+
+        Request body (JSON): ``{"prompt": [token ids],
+        "max_new_tokens": N}``. The stored result is
+        ``{"tokens": [...], "count": N}``. ``event_hub=None`` (a worker
+        process with no in-process hub) still serves — tokens just
+        aren't fanned out as SSE chunks from THIS process.
+
+        Backpressure rides the existing admission path: a saturated
+        engine answers 503 at admission (the dispatcher's delay +
+        redeliver contract), and a mid-handler saturation republishes
+        the task exactly like the batch path.
+        """
+        from ..pipeline.events import CHUNK
+        from .decode import DecodeSaturated
+
+        name = engine.backend.name
+        async_path = async_path or f"/{name}-stream-async"
+        self._served.setdefault(name, {}).update(
+            stream_async=self.service.prefix + async_path)
+        self.decode_engines.append(engine)
+        vocab = getattr(engine.backend, "servable", None)
+        vocab = getattr(vocab, "vocab_size", None)
+
+        def _saturation_check():
+            if engine.pending_count >= engine.max_pending:
+                return 503, "Decode queue saturated; retry later."
+            return None
+
+        async def _request_kwargs(request):
+            return {"body": await request.read(),
+                    "content_type": request.content_type,
+                    **worker_admission_kwargs(request.headers)}
+
+        def _parse(body: bytes) -> tuple[list[int], int]:
+            payload = json.loads(body)
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            # "prompt" is the client wire; "tokens" lets an upstream
+            # stage's stored result ({"tokens": [...]}) feed this stage
+            # directly — the chained ASR→summarize pipeline shape
+            # (docs/streaming.md).
+            prompt = payload.get("prompt", payload.get("tokens"))
+            if (not isinstance(prompt, list) or not prompt
+                    or not all(isinstance(t, int) for t in prompt)):
+                raise ValueError('"prompt" must be a non-empty list of '
+                                 'token ids')
+            if vocab is not None and any(
+                    not 0 <= t < vocab for t in prompt):
+                raise ValueError(f"token ids must be in [0, {vocab})")
+            if len(prompt) >= engine.backend.max_len:
+                # Client-input error, failed HERE so it lands as
+                # "failed - bad input" like every other bad payload —
+                # engine.submit's own guard would otherwise surface
+                # through the shell's crash path.
+                raise ValueError(
+                    f"prompt of {len(prompt)} tokens leaves no room to "
+                    f"generate under the KV-cache length "
+                    f"{engine.backend.max_len}")
+            max_new = payload.get("max_new_tokens", 64)
+            if not isinstance(max_new, int) or max_new < 1:
+                raise ValueError('"max_new_tokens" must be a positive int')
+            return prompt, max_new
+
+        @self.service.api_async_func(
+            async_path,
+            maximum_concurrent_requests=maximum_concurrent_requests,
+            admission_check=_saturation_check,
+            request_processing_function=_request_kwargs)
+        async def _stream(taskId, body, content_type, deadline_at=0.0,
+                          priority=0, _name=name):
+            tm = self.service.task_manager
+            buf = None
+            if self._hop_ledger:
+                from ..observability.ledger import HopLedger
+                buf = HopLedger()
+            if expired(deadline_at):
+                self._expired_total.inc(hop="worker",
+                                        priority=priority_name(priority))
+                await tm.update_task_status(
+                    taskId, expired_status("worker"), TaskStatus.EXPIRED)
+                return
+            try:
+                prompt, max_new = _parse(body)
+            except (ValueError, json.JSONDecodeError) as exc:
+                await tm.fail_task(taskId, f"failed - bad input: {exc}")
+                return
+            # Pipeline-stage chunk layering (docs/pipelines.md): a stage
+            # sub-task's tokens publish under the ROOT TaskId — the one
+            # stream a client watches — with the stage name labeling
+            # which node is talking, exactly like the coordinator's
+            # `stage` events.
+            publish_id = taskId
+            if event_hub is not None:
+                from ..pipeline.spec import split_sub_task_id
+                root = split_sub_task_id(taskId)
+                if root is not None:
+                    publish_id = root[0]
+                # Buffer chunks even before any SSE subscriber attaches —
+                # a client connecting mid-stream replays the (bounded)
+                # token history (docs/streaming.md).
+                event_hub.track(publish_id)
+            await tm.update_task_status(taskId, f"running - {_name} decode")
+
+            def on_token(index: int, token: int) -> None:
+                if event_hub is not None:
+                    event_hub.publish(publish_id, CHUNK,
+                                      {"stage": _name, "index": index,
+                                       "data": {"token": token}})
+
+            try:
+                tokens = await engine.submit(prompt, max_new,
+                                             on_token=on_token,
+                                             priority=priority,
+                                             deadline_at=deadline_at,
+                                             ledger=buf)
+            except DecodeSaturated:
+                # Saturated between admission and submit: hand the task
+                # back to the broker, same as the batch path.
+                current = await tm.get_task_status(taskId)
+                endpoint = (current or {}).get("Endpoint", async_path)
+                await tm.add_pipeline_task(taskId, endpoint)
+                return
+            except DeadlineExceeded as exc:
+                await self._flush_ledger(tm, taskId, buf)
+                await tm.update_task_status(
+                    taskId, expired_status(exc.hop), TaskStatus.EXPIRED)
+                return
+            except Exception:
+                await self._flush_ledger(tm, taskId, buf)
+                raise
+            await self._flush_ledger(tm, taskId, buf)
+            await self._store_result(taskId, json.dumps(
+                {"tokens": tokens, "count": len(tokens)}).encode())
+            await tm.complete_task(
+                taskId, f"completed - {len(tokens)} tokens")
 
     def serve_batch(self, servable: ServableModel,
                     sync_path: str | None = None,

@@ -1,0 +1,151 @@
+"""Chained streaming DAG: two autoregressive stages under ONE TaskId.
+
+The PAPERS 2602.04900 serving shape (ASR → LLM summarization chains):
+stage 1 ("transcribe") decodes a token stream from the client's prompt,
+stage 2 ("summarize") decodes from stage 1's tokens — both through the
+continuous-batching decode engine (docs/streaming.md), both publishing
+per-token ``chunk`` events through the ``TaskEventHub`` under the ROOT
+TaskId, so one SSE subscription watches the whole pipeline stream:
+
+    chunk {"stage": "transcribe", "index": 0, "data": {"token": ...}}
+    ...
+    stage {"stage": "transcribe", "state": "completed", ...}
+    chunk {"stage": "summarize", "index": 0, "data": {"token": ...}}
+    ...
+    terminal {...}
+
+Run:  JAX_PLATFORMS=cpu python examples/streaming_pipeline.py
+
+The script boots the whole platform in-process (gateway + store +
+broker + dispatcher + pipeline coordinator + a worker hosting both
+decode engines), POSTs one request, and prints the live event stream —
+tokens appear stage by stage, before the terminal record exists.
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from aiohttp import ClientSession, web
+
+from ai4e_tpu.pipeline import PipelineSpec, StageSpec
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.runtime import InferenceWorker
+from ai4e_tpu.runtime.decode import DecodeEngine
+from ai4e_tpu.runtime.kvcache import PagedDecodeRuntime, build_lm_servable
+
+
+async def main() -> None:
+    platform = LocalPlatform(PlatformConfig(pipeline=True, retry_delay=0.1))
+
+    # Two tiny LMs — "transcribe" produces a 24-token stream from the
+    # prompt, "summarize" produces 12 tokens from that transcript.
+    # (Init weights: the tokens are arbitrary; the demo is the serving
+    # shape, not the model quality.)
+    engines = {}
+    for name in ("transcribe", "summarize"):
+        servable = build_lm_servable(name=name, vocab_size=256, max_len=64,
+                                     dim=48, depth=2, heads=4)
+        backend = PagedDecodeRuntime(servable, slots=2, prompt_buckets=(8,))
+        print(f"warming {name} (prefill buckets + step program)...",
+              flush=True)
+        backend.warm()
+        engines[name] = DecodeEngine(backend)
+
+    from types import SimpleNamespace
+    worker = InferenceWorker(
+        "stream-demo",
+        runtime=SimpleNamespace(models={}),
+        batcher=SimpleNamespace(pending_count=0, max_pending=64),
+        task_manager=platform.task_manager, prefix="v1/lm",
+        store=platform.store)
+    for engine in engines.values():
+        worker.serve_stream(engine, event_hub=platform.task_events)
+
+    be_runner = web.AppRunner(worker.service.app)
+    await be_runner.setup()
+    be_site = web.TCPSite(be_runner, "127.0.0.1", 0)
+    await be_site.start()
+    be_port = be_site._server.sockets[0].getsockname()[1]
+    base = f"http://127.0.0.1:{be_port}/v1/lm"
+    for name in engines:
+        platform.register_internal_route(f"{base}/{name}-stream-async")
+
+    platform.register_pipeline(PipelineSpec(
+        "voicebrief", "/v1/voice/brief",
+        stages=(
+            StageSpec("transcribe",
+                      endpoint=f"{base}/transcribe-stream-async"),
+            # input="auto": the summarize stage's body is transcribe's
+            # stored result ({"tokens": [...]}) — serve_stream accepts
+            # it as the prompt directly.
+            StageSpec("summarize",
+                      endpoint=f"{base}/summarize-stream-async",
+                      after=("transcribe",)),
+        )))
+
+    gw_runner = web.AppRunner(platform.gateway.app)
+    await gw_runner.setup()
+    gw_site = web.TCPSite(gw_runner, "127.0.0.1", 0)
+    await gw_site.start()
+    gw_port = gw_site._server.sockets[0].getsockname()[1]
+    gw = f"http://127.0.0.1:{gw_port}"
+
+    await platform.start()
+    for engine in engines.values():
+        await engine.start()
+
+    async with ClientSession() as session:
+        body = json.dumps({"prompt": [5, 17, 42, 99, 7, 3],
+                           "max_new_tokens": 24})
+        async with session.post(f"{gw}/v1/voice/brief", data=body) as resp:
+            task = await resp.json()
+        task_id = task["TaskId"]
+        print(f"\nTaskId {task_id} — streaming "
+              f"{gw}/v1/taskmanagement/task/{task_id}/events\n", flush=True)
+
+        tokens: dict[str, list[int]] = {}
+        async with session.get(
+                f"{gw}/v1/taskmanagement/task/{task_id}/events",
+                params={"wait": "60"}) as resp:
+            event, current = "", {}
+            async for raw in resp.content:
+                line = raw.decode().rstrip("\n")
+                if line.startswith("event: "):
+                    event = line[7:]
+                elif line.startswith("data: "):
+                    current = json.loads(line[6:])
+                elif line == "" and event:
+                    if event == "chunk":
+                        stage = current["stage"]
+                        tokens.setdefault(stage, []).append(
+                            current["data"]["token"])
+                        print(f"  chunk  [{stage}] #{current['index']} "
+                              f"token={current['data']['token']}",
+                              flush=True)
+                    elif event == "stage":
+                        print(f"  stage  [{current['stage']}] "
+                              f"{current.get('state')}", flush=True)
+                    elif event == "terminal":
+                        print(f"\nterminal: {current.get('Status')}",
+                              flush=True)
+                        break
+                    event, current = "", {}
+
+    print(f"\ntranscribe streamed {len(tokens.get('transcribe', []))} "
+          f"tokens, summarize streamed "
+          f"{len(tokens.get('summarize', []))} — one TaskId, one SSE "
+          f"stream, two stages.", flush=True)
+
+    for engine in engines.values():
+        await engine.stop()
+    await platform.stop()
+    await gw_runner.cleanup()
+    await be_runner.cleanup()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

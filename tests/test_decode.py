@@ -1,0 +1,575 @@
+"""Continuous-batching decode engine (runtime/decode.py + kvcache.py,
+docs/streaming.md).
+
+Three layers:
+
+- **engine scheduling over a fake backend** (no JAX): iteration-level
+  joins, backpressure, per-step deadline sweeps, cancellation,
+  hot-reload re-prefill, slot conservation — plus THE acceptance
+  property: a request arriving mid-decode of a long sequence receives
+  its first token before that sequence finishes (and provably does NOT
+  under the whole-batch baseline);
+- **device path** (JAX): the KV-cache step function's correctness
+  oracle — token-by-token decode must equal greedy re-prefill over the
+  growing history — and the AOT-warm discipline (no serving-path
+  compile);
+- **metric identity**: constructing no engine registers no
+  ``ai4e_decode_*`` series — the decode-engine-off worker's /metrics
+  exposition is byte-identical (the PR 13 ladder discipline).
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from ai4e_tpu.admission.deadline import DeadlineExceeded
+from ai4e_tpu.taskstore import APITask
+from ai4e_tpu.metrics.registry import MetricsRegistry
+from ai4e_tpu.runtime.decode import (DecodeEngine, DecodeSaturated,
+                                     SlotError, SlotPool)
+
+
+class FakeBackend:
+    """Deterministic decode backend: token ids count up from the last
+    prompt token; ``step_s`` simulates device time so latency ordering
+    (TTFT vs remaining decode) is measurable."""
+
+    def __init__(self, slots=2, max_len=64, eos_id=None, step_s=0.0,
+                 name="lm"):
+        self.slots = slots
+        self.max_len = max_len
+        self.eos_id = eos_id
+        self.name = name
+        self.step_s = step_s
+        self.params_version = 1
+        self.resets = 0
+        self.prefills = []
+        self.steps = 0
+
+    def reset_cache(self):
+        self.resets += 1
+
+    def prefill_into(self, slot, tokens):
+        if self.step_s:
+            time.sleep(self.step_s)
+        self.prefills.append((slot, tuple(tokens)))
+        return int(tokens[-1]) + 1
+
+    def step(self, tokens, positions, active):
+        if self.step_s:
+            time.sleep(self.step_s)
+        self.steps += 1
+        return [int(t) + 1 for t in tokens]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def wait_until(cond, timeout=10.0):
+    deadline = time.perf_counter() + timeout
+    while True:
+        if cond():
+            return
+        assert time.perf_counter() < deadline, "condition not reached"
+        await asyncio.sleep(0.001)
+
+
+class TestSlotPool:
+    def test_acquire_release_conservation(self):
+        pool = SlotPool(3)
+        a, b = pool.acquire(), pool.acquire()
+        assert {a, b} == {0, 1}
+        pool.release(a)
+        assert pool.free_count == 2 and pool.busy_count == 1
+        pool.check_conservation()
+
+    def test_exhaustion_returns_none(self):
+        pool = SlotPool(1)
+        assert pool.acquire() == 0
+        assert pool.acquire() is None
+
+    def test_double_release_raises(self):
+        pool = SlotPool(2)
+        s = pool.acquire()
+        pool.release(s)
+        with pytest.raises(SlotError):
+            pool.release(s)
+
+    def test_foreign_release_raises(self):
+        pool = SlotPool(2)
+        with pytest.raises(SlotError):
+            pool.release(1)
+
+
+class TestEngineScheduling:
+    def test_generates_and_streams_tokens(self):
+        async def main():
+            backend = FakeBackend(slots=2)
+            engine = DecodeEngine(backend, metrics=MetricsRegistry())
+            await engine.start()
+            chunks = []
+            out = await engine.submit([5, 6], 4,
+                                      on_token=lambda i, t: chunks.append(
+                                          (i, t)))
+            await engine.stop()
+            return out, chunks, backend
+
+        out, chunks, backend = run(main())
+        # Prefill emits 7; each step increments the last token.
+        assert out == [7, 8, 9, 10]
+        assert chunks == [(0, 7), (1, 8), (2, 9), (3, 10)]
+        assert backend.prefills[0] == (0, (5, 6))
+
+    def test_eos_finishes_early_and_frees_slot(self):
+        async def main():
+            backend = FakeBackend(slots=1, eos_id=9)
+            engine = DecodeEngine(backend, metrics=MetricsRegistry())
+            await engine.start()
+            out = await engine.submit([6], 64)
+            await engine.stop()
+            return out
+
+        assert run(main()) == [7, 8, 9]  # stops AT the eos token
+
+    def test_backpressure_raises_decode_saturated(self):
+        async def main():
+            backend = FakeBackend(slots=1)
+            engine = DecodeEngine(backend, max_pending=1,
+                                  metrics=MetricsRegistry())
+            # Engine not started: submissions stay queued.
+            first = asyncio.ensure_future(engine.submit([1], 2))
+            await asyncio.sleep(0)
+            with pytest.raises(DecodeSaturated):
+                await engine.submit([1], 2)
+            first.cancel()
+            return True
+
+        assert run(main())
+
+    def test_prompt_must_fit_kv_cache(self):
+        async def main():
+            engine = DecodeEngine(FakeBackend(slots=1, max_len=4),
+                                  metrics=MetricsRegistry())
+            with pytest.raises(ValueError):
+                await engine.submit([1, 2, 3, 4], 2)
+
+        run(main())
+
+    def test_context_full_finishes_sequence(self):
+        async def main():
+            backend = FakeBackend(slots=1, max_len=5)
+            engine = DecodeEngine(backend, metrics=MetricsRegistry())
+            await engine.start()
+            # Prompt of 3 + KV length 5: prefill token (position 3) then
+            # 2 steps fill the cache → 3 tokens, not the 64 requested.
+            out = await engine.submit([1, 2, 3], 64)
+            await engine.stop()
+            return out
+
+        assert len(run(main())) == 3
+
+    def test_late_joiner_streams_before_running_sequence_finishes(self):
+        """THE acceptance property: a request arriving mid-decode of a
+        long sequence gets its first chunk while that sequence is still
+        decoding — its TTFT is smaller than the remaining decode time of
+        the running sequence. The whole-batch baseline provably inverts
+        this (the joiner waits for the full drain)."""
+
+        async def drive(continuous):
+            backend = FakeBackend(slots=2, step_s=0.002)
+            engine = DecodeEngine(backend, continuous=continuous,
+                                  metrics=MetricsRegistry())
+            await engine.start()
+            stamps = {}
+
+            long_task = asyncio.ensure_future(engine.submit([1], 60))
+            # Let the long sequence get well into its decode.
+            await wait_until(lambda: backend.prefills and backend.steps >= 5)
+            t_join = time.perf_counter()
+            joiner = await engine.submit(
+                [40], 3,
+                on_token=lambda i, t: stamps.setdefault(
+                    "first", time.perf_counter()))
+            t_long_done_floor = time.perf_counter()
+            await long_task
+            t_long_done = max(time.perf_counter(), t_long_done_floor)
+            await engine.stop()
+            ttft = stamps["first"] - t_join
+            remaining = t_long_done - t_join
+            return ttft, remaining, len(joiner)
+
+        ttft, remaining, n = run(drive(continuous=True))
+        assert n == 3
+        assert ttft < remaining, (
+            f"continuous batching must stream the late joiner before the "
+            f"running sequence finishes: TTFT {ttft * 1e3:.1f}ms vs "
+            f"{remaining * 1e3:.1f}ms remaining")
+
+        async def whole_batch():
+            backend = FakeBackend(slots=2, step_s=0.002)
+            engine = DecodeEngine(backend, continuous=False,
+                                  metrics=MetricsRegistry())
+            await engine.start()
+            stamps = {}
+            long_done = {}
+
+            long_task = asyncio.ensure_future(engine.submit([1], 30))
+            long_task.add_done_callback(
+                lambda _: long_done.setdefault("t", time.perf_counter()))
+            await wait_until(lambda: backend.steps >= 5)
+            await engine.submit(
+                [40], 3,
+                on_token=lambda i, t: stamps.setdefault(
+                    "first", time.perf_counter()))
+            await long_task
+            await engine.stop()
+            return stamps["first"], long_done["t"]
+
+        t_first, t_long_done = run(whole_batch())
+        assert t_first >= t_long_done, (
+            "whole-batch baseline must NOT admit the joiner before the "
+            "running batch drains")
+
+    def test_deadline_sweep_frees_slot_mid_decode(self):
+        async def main():
+            # 5 ms per device call: the 10k-token budget cannot finish
+            # inside the 50 ms deadline — the sweep MUST fire mid-decode.
+            backend = FakeBackend(slots=1, step_s=0.005)
+            reg = MetricsRegistry()
+            engine = DecodeEngine(backend, metrics=reg)
+            await engine.start()
+            with pytest.raises(DeadlineExceeded):
+                # Deadline passes mid-decode (the sequence wants 10k
+                # tokens); the per-step sweep retires it and frees the
+                # slot instead of completing late.
+                await engine.submit([1], 10_000,
+                                    deadline_at=time.time() + 0.05)
+            assert engine.pool.free_count == 1
+            expired = reg.counter("ai4e_admission_expired_total")
+            assert expired.value(hop="decode", priority="interactive") == 1
+            await engine.stop()
+            engine.pool.check_conservation()
+
+        run(main())
+
+    def test_cancelled_waiter_frees_slot(self):
+        async def main():
+            backend = FakeBackend(slots=1)
+            engine = DecodeEngine(backend, metrics=MetricsRegistry())
+            await engine.start()
+            fut = asyncio.ensure_future(engine.submit([1], 10_000))
+            await wait_until(lambda: engine.active_count)
+            fut.cancel()
+            await wait_until(lambda: not engine.active_count)
+            assert engine.pool.free_count == 1
+            await engine.stop()
+            engine.pool.check_conservation()
+
+        run(main())
+
+    def test_hot_reload_invalidates_and_reprefills(self):
+        async def main():
+            backend = FakeBackend(slots=1, step_s=0.002)
+            reg = MetricsRegistry()
+            engine = DecodeEngine(backend, metrics=reg)
+            await engine.start()
+            fut = asyncio.ensure_future(engine.submit([1], 30))
+            await wait_until(lambda: backend.steps >= 3)
+            backend.params_version += 1  # hot reload lands
+            out = await fut
+            await engine.stop()
+            return backend, reg, out
+
+        backend, reg, out = run(main())
+        assert len(out) == 30
+        # The invalidation reset the pooled cache and re-prefilled the
+        # active sequence from its prompt + generated history.
+        assert backend.resets >= 1
+        reprefill = [p for p in backend.prefills if len(p[1]) > 1]
+        assert reprefill, "active sequence must re-prefill on reload"
+        assert reg.counter("ai4e_decode_reprefills_total").value(
+            model="lm") >= 1
+        # The re-prefilled history starts with the original prompt.
+        assert reprefill[0][1][0] == 1
+
+    def test_metrics_registered_only_with_engine(self):
+        reg = MetricsRegistry()
+        assert not any(n.startswith("ai4e_decode_") for n in reg._metrics)
+        DecodeEngine(FakeBackend(), metrics=reg)
+        decode_metrics = {n for n in reg._metrics
+                          if n.startswith("ai4e_decode_")}
+        assert decode_metrics == {
+            "ai4e_decode_ttft_seconds", "ai4e_decode_intertoken_seconds",
+            "ai4e_decode_step_seconds", "ai4e_decode_slot_occupancy",
+            "ai4e_decode_pending", "ai4e_decode_tokens_total",
+            "ai4e_decode_sequences_total", "ai4e_decode_reprefills_total"}
+
+    def test_default_worker_has_no_decode_metrics(self):
+        """Decode-engine-off identity (acceptance): nothing in the
+        default worker construction path registers a decode series —
+        same discipline as the ladder-off exposition assertions."""
+        from ai4e_tpu.runtime.batcher import MicroBatcher
+        from types import SimpleNamespace
+        reg = MetricsRegistry()
+        MicroBatcher(SimpleNamespace(models={}), metrics=reg)
+        text = reg.render_prometheus()
+        assert "ai4e_decode_" not in text
+
+
+# -- device path (JAX) -------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lm_runtime():
+    from ai4e_tpu.runtime.kvcache import (PagedDecodeRuntime,
+                                          build_lm_servable)
+    servable = build_lm_servable(name="lm", vocab_size=64, max_len=24,
+                                 dim=32, depth=2, heads=4)
+    runtime = PagedDecodeRuntime(servable, slots=3, prompt_buckets=(4, 8))
+    runtime.warm()
+    return runtime
+
+
+class TestPagedDecodeRuntime:
+    def test_prompt_buckets_cover_max_len(self, lm_runtime):
+        assert lm_runtime.prompt_buckets == (4, 8, 24)
+        assert lm_runtime.bucket_for(3) == 4
+        assert lm_runtime.bucket_for(9) == 24
+
+    def test_decode_matches_greedy_reprefill_oracle(self, lm_runtime):
+        """The KV-cache step path must produce exactly the tokens greedy
+        re-prefill over the growing history produces — the correctness
+        oracle for cache insert/step index arithmetic."""
+        from ai4e_tpu.runtime.kvcache import PagedDecodeRuntime
+        prompt = [3, 7, 11]
+        tok = lm_runtime.prefill_into(1, prompt)
+        got = [tok]
+        position = len(prompt)
+        for _ in range(5):
+            out = lm_runtime.step(
+                [0, got[-1], 0], [0, position, 0], [False, True, False])
+            got.append(out[1])
+            position += 1
+
+        oracle_rt = PagedDecodeRuntime(lm_runtime.servable, slots=1,
+                                       prompt_buckets=(24,))
+        history = list(prompt)
+        oracle = []
+        for _ in range(6):
+            t = oracle_rt.prefill_into(0, history)
+            oracle.append(t)
+            history.append(t)
+        assert got == oracle
+
+    def test_reload_params_bumps_version_and_checks_tree(self, lm_runtime):
+        import jax
+        before = lm_runtime.params_version
+        new = jax.tree.map(lambda a: a, lm_runtime.servable.params)
+        assert lm_runtime.reload_params(new) == before + 1
+        with pytest.raises(ValueError):
+            lm_runtime.reload_params({"params": {}})
+
+    def test_engine_end_to_end_on_device(self, lm_runtime):
+        async def main():
+            engine = DecodeEngine(lm_runtime, metrics=MetricsRegistry())
+            await engine.start()
+            a, b = await asyncio.gather(engine.submit([1, 2, 3], 5),
+                                        engine.submit([4, 5], 4))
+            await engine.stop()
+            engine.pool.check_conservation()
+            return a, b
+
+        a, b = run(main())
+        assert len(a) == 5 and len(b) == 4
+        assert all(0 <= t < 64 for t in a + b)
+
+
+# -- worker serve_stream + SSE chunk flow ------------------------------------
+
+
+class TestServeStream:
+    def _worker(self, hub=None, engine=None):
+        from ai4e_tpu.runtime.worker import InferenceWorker
+        from ai4e_tpu.service.task_manager import LocalTaskManager
+        from ai4e_tpu.taskstore import InMemoryTaskStore
+        from types import SimpleNamespace
+        store = InMemoryTaskStore()
+        runtime = SimpleNamespace(models={})
+        batcher = SimpleNamespace(pending_count=0, max_pending=8)
+        worker = InferenceWorker("svc", runtime, batcher,
+                                 task_manager=LocalTaskManager(store),
+                                 metrics=MetricsRegistry(), store=store)
+        if engine is not None:
+            worker.serve_stream(engine, event_hub=hub)
+        return worker, store
+
+    def test_stream_endpoint_publishes_chunks_and_result(self):
+        from ai4e_tpu.pipeline.events import TaskEventHub
+
+        async def main():
+            backend = FakeBackend(slots=2, name="lm")
+            engine = DecodeEngine(backend, metrics=MetricsRegistry())
+            hub = TaskEventHub(metrics=MetricsRegistry())
+            worker, store = self._worker(hub=hub, engine=engine)
+            await engine.start()
+            store.upsert(APITask(task_id="t-1",
+                                 endpoint="/lm-stream-async",
+                                 body=b"", publish=False))
+            handler = worker.service.endpoints["/lm-stream-async"].func
+            body = json.dumps({"prompt": [5], "max_new_tokens": 3}).encode()
+            await handler(taskId="t-1", body=body,
+                          content_type="application/json")
+            await engine.stop()
+            return hub, store
+
+        hub, store = run(main())
+        events = hub.replay("t-1")
+        chunks = [e for e in events if e["event"] == "chunk"]
+        assert [c["data"]["data"]["token"] for c in chunks] == [6, 7, 8]
+        assert all(c["data"]["stage"] == "lm" for c in chunks)
+        task = store.get("t-1")
+        assert task.canonical_status == "completed"
+        result, _ = store.get_result("t-1")
+        assert json.loads(result) == {"tokens": [6, 7, 8], "count": 3}
+
+    def test_bad_input_fails_task_not_engine(self):
+        async def main():
+            backend = FakeBackend(slots=1)
+            engine = DecodeEngine(backend, metrics=MetricsRegistry())
+            worker, store = self._worker(engine=engine)
+            store.upsert(APITask(task_id="t-bad",
+                                 endpoint="/lm-stream-async",
+                                 body=b"", publish=False))
+            handler = worker.service.endpoints["/lm-stream-async"].func
+            await handler(taskId="t-bad", body=b'{"prompt": "nope"}',
+                          content_type="application/json")
+            return store
+
+        store = run(main())
+        assert store.get("t-bad").canonical_status == "failed"
+
+    def test_saturated_engine_answers_503_at_admission(self):
+        async def main():
+            backend = FakeBackend(slots=1)
+            engine = DecodeEngine(backend, max_pending=0,
+                                  metrics=MetricsRegistry())
+            worker, _ = self._worker(engine=engine)
+            check = worker.service.endpoints[
+                "/lm-stream-async"].admission_check
+            return check()
+
+        status, _ = run(main())
+        assert status == 503
+
+
+# -- CLI wiring (AI4E_RUNTIME_DECODE_*) --------------------------------------
+
+
+class TestCliDecodeWiring:
+    MODELS = {
+        "service_name": "w", "prefix": "v1/lm",
+        "models": [
+            {"family": "echo", "name": "echo", "size": 4, "buckets": [2]},
+            {"family": "seqformer-lm", "name": "lm", "vocab_size": 32,
+             "max_len": 32, "dim": 16, "depth": 1, "heads": 2,
+             "eos_id": 2}]}
+
+    def test_decode_enable_builds_engine_and_stream_endpoint(self):
+        from ai4e_tpu.cli import build_worker
+        from ai4e_tpu.config import FrameworkConfig
+        config = FrameworkConfig()
+        config.runtime.decode_enable = True
+        config.runtime.kv_slots = 2
+        config.runtime.decode_prompt_buckets = (4,)
+        worker, _batcher, _tm = build_worker(config, dict(self.MODELS))
+        assert len(worker.decode_engines) == 1
+        engine = worker.decode_engines[0]
+        assert engine.backend.slots == 2
+        # Spec max_len wins over the kv_max_len default; the prompt
+        # ladder is the knob's, with the covering top appended.
+        assert engine.backend.max_len == 32
+        assert engine.backend.prompt_buckets == (4, 32)
+        assert engine.backend.eos_id == 2
+        # The LM is NOT a batch servable…
+        assert "lm" not in worker.runtime.models
+        # …but IS a served streaming endpoint.
+        assert "/lm-stream-async" in worker.service.endpoints
+        assert worker._served["lm"]["stream_async"] == \
+            "/v1/lm/lm-stream-async"
+
+    def test_decode_off_skips_lm_specs(self):
+        """Default knobs: no engine, no stream route, no LM in the batch
+        registry — the decode-off worker is the pre-decode worker. (The
+        /metrics byte-identity half lives in
+        ``TestEngineScheduling.test_default_worker_has_no_decode_metrics``
+        on an isolated registry — the cli path shares the process-default
+        registry, which an earlier decode-on test legitimately used.)"""
+        from ai4e_tpu.cli import build_worker
+        from ai4e_tpu.config import FrameworkConfig
+        worker, _batcher, _tm = build_worker(FrameworkConfig(),
+                                             dict(self.MODELS))
+        assert worker.decode_engines == []
+        assert "/lm-stream-async" not in worker.service.endpoints
+        assert "lm" not in worker.runtime.models
+
+
+class TestLMHotReloadEndpoint:
+    def test_reload_endpoint_reaches_decode_backend(self, tmp_path):
+        """POST {prefix}/models/{lm}/reload must resolve streaming LMs
+        (they never enter runtime.models) and bump params_version — the
+        engine's KV-cache invalidation trigger."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from ai4e_tpu.checkpoint import save_params
+        from ai4e_tpu.runtime.kvcache import (PagedDecodeRuntime,
+                                              build_lm_servable)
+
+        async def main():
+            lm = build_lm_servable(name="lm", vocab_size=16, max_len=16,
+                                   dim=16, depth=1, heads=2)
+            backend = PagedDecodeRuntime(lm, slots=1, prompt_buckets=(4,))
+            engine = DecodeEngine(backend, metrics=MetricsRegistry())
+            worker, _store = TestServeStream()._worker(engine=engine)
+            ckpt = str(tmp_path / "lm-ckpt")
+            save_params(ckpt, lm.params)
+            client = TestClient(TestServer(worker.service.app))
+            await client.start_server()
+            try:
+                resp = await client.post("/v1/models/lm/reload",
+                                         json={"checkpoint": ckpt})
+                body = await resp.json()
+                missing = await client.post("/v1/models/nope/reload",
+                                            json={"checkpoint": ckpt})
+                return resp.status, body, missing.status, backend
+            finally:
+                await client.close()
+
+        status, body, missing, backend = run(main())
+        assert status == 200, body
+        assert body["params_version"] == 2
+        assert backend.params_version == 2
+        assert body["checkpoint"].endswith("lm-ckpt")
+        assert missing == 404
+
+    def test_oversized_prompt_fails_task_as_bad_input(self):
+        async def main():
+            backend = FakeBackend(slots=1, max_len=4)
+            engine = DecodeEngine(backend, metrics=MetricsRegistry())
+            worker, store = TestServeStream()._worker(engine=engine)
+            store.upsert(APITask(task_id="t-big",
+                                 endpoint="/lm-stream-async",
+                                 body=b"", publish=False))
+            handler = worker.service.endpoints["/lm-stream-async"].func
+            await handler(
+                taskId="t-big",
+                body=json.dumps({"prompt": [1, 2, 3, 4, 5],
+                                 "max_new_tokens": 2}).encode(),
+                content_type="application/json")
+            return store.get("t-big")
+
+        task = run(main())
+        assert task.canonical_status == "failed"
+        assert "bad input" in task.status

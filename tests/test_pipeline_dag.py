@@ -187,11 +187,22 @@ class TestEventHub:
         assert hub.replay("c") != []
 
     def test_replay_cap_bounds_history(self):
-        hub = TaskEventHub(replay=3)
+        # Non-chunk events keep the FIRST `replay` (run shape survives);
+        # chunk events keep the NEWEST `chunk_replay` behind a single
+        # synthetic `truncated` marker (docs/streaming.md;
+        # tests/test_streaming_sse.py has the full contract).
+        hub = TaskEventHub(replay=3, chunk_replay=3)
         hub.track("t")
         for i in range(10):
-            hub.publish("t", "chunk", {"index": i})
+            hub.publish("t", "status", {"i": i})
         assert len(hub.replay("t")) == 3
+        hub.track("c")
+        for i in range(10):
+            hub.publish("c", "chunk", {"index": i})
+        events = hub.replay("c")
+        assert [e["event"] for e in events] == [
+            "truncated", "chunk", "chunk", "chunk"]
+        assert [e["data"]["index"] for e in events[1:]] == [7, 8, 9]
 
     def test_sse_encoding(self):
         wire = sse_encode({"seq": 7, "event": "stage",

@@ -1225,3 +1225,180 @@ class TestLadderSwapVsBatchCut:
             "the assign-before-compile window was not reachable — either "
             "the scenario no longer models the swap or the budget is "
             "too small")
+
+
+# -- decode engine: KV-cache slot conservation (PR 14) ------------------------
+#
+# The continuous-batching engine (runtime/decode.py, docs/streaming.md)
+# runs four verbs that all touch slot state: join-batch (admission
+# prefill), decode-step, expiry-sweep, and hot-reload-invalidate
+# (re-prefill). THE invariant: a slot is never double-assigned, never
+# leaked, freed exactly once — SlotPool raises SlotError the moment any
+# schedule violates it, and check_conservation() audits the end state.
+# The engine imports neither JAX nor numpy, so this suite runs in the
+# race-smoke job's toolchain-free environment against the REAL engine.
+
+import time as _time
+
+from ai4e_tpu.admission.deadline import DeadlineExceeded
+from ai4e_tpu.runtime.decode import DecodeEngine
+
+
+class _FakeDecodeBackend:
+    """Async decode backend: every device call is a real suspension
+    (yield_point), so the explorer owns every interleaving window the
+    executor-thread hop opens in production."""
+
+    def __init__(self, slots=2, max_len=64, eos_id=None):
+        self.slots = slots
+        self.max_len = max_len
+        self.eos_id = eos_id
+        self.name = "lm"
+        self.params_version = 1
+        self.resets = 0
+
+    async def reset_cache(self):
+        await yield_point()
+        self.resets += 1
+
+    async def prefill_into(self, slot, tokens):
+        await yield_point()
+        return int(tokens[-1]) + 1
+
+    async def step(self, tokens, positions, active):
+        await yield_point()
+        return [int(t) + 1 for t in tokens]
+
+
+class _SplitSweepEngine(DecodeEngine):
+    """Verbatim pre-fix expiry sweep: the doomed set is selected, then
+    each expiry suspends (publishing the expiry event) BEFORE releasing
+    the slot — the guard and the release in different segments, the
+    AIL007 shape. A cancel landing in the window retires the sequence
+    first; the resumed sweep then releases a slot it no longer holds."""
+
+    async def _tick(self):
+        await self._check_reload()
+        await self._sweep_split()
+        await self._admit()
+        await self._step()
+
+    async def _sweep_split(self):
+        now = _time.time()
+        doomed = [(seq, seq.slot) for seq in self._active.values()
+                  if not seq.done and seq.deadline_at
+                  and seq.deadline_at <= now]
+        for seq, slot in doomed:
+            await yield_point()          # pre-fix: emitted the event first
+            self._active.pop(slot, None)
+            self.pool.release(slot)      # stale guard: freed exactly once?
+            seq.slot = None
+            seq.done = True
+            if not seq.future.done():
+                seq.future.set_exception(
+                    DeadlineExceeded("decode", seq.deadline_at))
+
+
+def _decode_drain(engine, results):
+    """End-of-run drain: every leftover sequence is retired exactly once
+    through the funnel, so an interrupted scenario still lets futures
+    resolve and conservation be audited."""
+    for seq in (list(engine._active.values()) + list(engine._queue)):
+        engine._retire(seq, "cancelled", error=RuntimeError("drained"))
+    results["drained"] = True
+
+
+def _slot_conservation_scenario(engine_cls, ticks=120):
+    """Join vs decode-step vs expiry-sweep vs cancel vs hot-reload:
+    the full verb mix over a 2-slot pool."""
+
+    def make():
+        backend = _FakeDecodeBackend(slots=2, max_len=8)
+        engine = engine_cls(backend, max_pending=8,
+                            metrics=MetricsRegistry())
+        results = {}
+
+        async def driver():
+            for _ in range(ticks):
+                if results.get("stop"):
+                    break
+                # An idle tick has no suspension point — yield explicitly
+                # so submitters are never starved past the tick budget
+                # (the drain below would then resolve their futures with
+                # the engine never having served them).
+                await yield_point()
+                await engine._tick()
+            _decode_drain(engine, results)
+
+        async def submit(tag, prompt, max_new, **kw):
+            try:
+                results[tag] = await engine.submit(prompt, max_new, **kw)
+            except BaseException as exc:  # noqa: BLE001 — the outcome IS the result under exploration
+                results[tag] = exc
+
+        async def joiner():
+            # Joins mid-decode of the first sequence under most
+            # schedules — the continuous-batching admission window.
+            await yield_point()
+            await submit("b", [10], 2)
+
+        async def expiring_then_cancel():
+            # Arm a mid-decode expiry on the first active sequence, then
+            # cancel it — the two release paths that must compose to
+            # exactly one free.
+            for _ in range(40):
+                if engine._active:
+                    break
+                await yield_point()
+            else:
+                return
+            seq = next(iter(engine._active.values()))
+            seq.deadline_at = 1.0        # long past: next sweep dooms it
+            await yield_point()
+            engine.cancel(seq.future)
+
+        async def reloader():
+            await yield_point()
+            backend.params_version += 1  # hot reload: cache invalidated
+
+        async def finisher():
+            # Let the driver stop once every waiter resolved.
+            for _ in range(200):
+                if "a" in results and "b" in results:
+                    break
+                await yield_point()
+            results["stop"] = True
+
+        coros = [driver(), submit("a", [1], 6), joiner(),
+                 expiring_then_cancel(), reloader(), finisher()]
+
+        def check():
+            engine.pool.check_conservation()
+            assert engine.pool.free_count == engine.pool.slots, (
+                f"slot leak: {engine.pool.busy_count} busy after drain")
+            assert not engine._active and not engine._queue
+            assert "a" in results and "b" in results, results
+
+        return coros, check
+
+    return make
+
+
+class TestDecodeSlotConservation:
+    def test_fixed_engine_conserves_slots(self):
+        report = explore_interleavings(
+            _slot_conservation_scenario(DecodeEngine),
+            schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_split_sweep_revert_caught(self):
+        report = explore_interleavings(
+            _slot_conservation_scenario(_SplitSweepEngine),
+            schedules=SCHEDULES, seed=SEED)
+        assert not report.ok, (
+            "the sweep-vs-cancel double-free window was not reachable — "
+            "either the scenario no longer arms a mid-decode expiry or "
+            "the budget is too small")
+        assert any("Slot" in type(r.error).__name__
+                   or "released" in str(r.error)
+                   for r in report.failures), report.describe()

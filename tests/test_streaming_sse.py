@@ -1,0 +1,268 @@
+"""SSE hub chunk hardening + resume (pipeline/events.py, gateway SSE;
+docs/streaming.md):
+
+- bounded per-task CHUNK replay: the newest ``chunk_replay`` chunks are
+  kept, older ones drop behind a single synthetic ``truncated`` marker —
+  a slow client attaching mid-stream can never hold unbounded token
+  history;
+- ``Last-Event-ID`` resume on reconnect: replay restarts strictly after
+  the client's last consumed event id, through the hub
+  (``subscribe(after_seq=)``) and the gateway route (header or
+  ``?lastEventId=``);
+- the streaming soak (marked ``slow``): a long token stream through
+  engine → hub stays inside the bounded buffers.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.metrics.registry import MetricsRegistry
+from ai4e_tpu.pipeline.events import CHUNK, TERMINAL, TRUNCATED, TaskEventHub
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.taskstore import APITask
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def chunk(i):
+    return {"stage": "lm", "index": i, "data": {"token": i}}
+
+
+class TestChunkBoundedReplay:
+    def _hub(self, **kw):
+        kw.setdefault("metrics", MetricsRegistry())
+        return TaskEventHub(**kw)
+
+    def test_tail_ring_keeps_newest_chunks(self):
+        hub = self._hub(chunk_replay=3)
+        hub.track("t")
+        for i in range(10):
+            hub.publish("t", CHUNK, chunk(i))
+        events = hub.replay("t")
+        kinds = [(e["event"], e["data"].get("index")) for e in events
+                 if e["event"] == CHUNK]
+        assert kinds == [(CHUNK, 7), (CHUNK, 8), (CHUNK, 9)]
+
+    def test_truncated_marker_precedes_surviving_chunks(self):
+        hub = self._hub(chunk_replay=3)
+        hub.track("t")
+        for i in range(10):
+            hub.publish("t", CHUNK, chunk(i))
+        events = hub.replay("t")
+        assert events[0]["event"] == TRUNCATED
+        assert events[0]["data"]["dropped_chunks"] == 7
+        # The marker sits at the last dropped seq, so a client resuming
+        # FROM the marker id gets exactly the surviving chunks.
+        assert events[0]["seq"] == 7
+        assert [e["seq"] for e in events[1:]] == [8, 9, 10]
+
+    def test_no_marker_under_the_cap(self):
+        hub = self._hub(chunk_replay=8)
+        hub.track("t")
+        for i in range(5):
+            hub.publish("t", CHUNK, chunk(i))
+        assert all(e["event"] == CHUNK for e in hub.replay("t"))
+
+    def test_resume_past_dropped_range_gets_no_marker(self):
+        hub = self._hub(chunk_replay=3)
+        hub.track("t")
+        for i in range(10):
+            hub.publish("t", CHUNK, chunk(i))
+        # Client already consumed through seq 8: only seq 9/10 replay,
+        # and the truncation (through seq 7) is invisible to it.
+        events = hub.replay("t", after_seq=8)
+        assert [e["seq"] for e in events] == [9, 10]
+        assert all(e["event"] == CHUNK for e in events)
+
+    def test_non_chunk_events_keep_first_n_and_order(self):
+        hub = self._hub(replay=4, chunk_replay=2)
+        hub.track("t")
+        hub.publish("t", "status", {"Status": "running"})
+        for i in range(6):
+            hub.publish("t", CHUNK, chunk(i))
+        hub.publish("t", "stage", {"stage": "lm", "state": "completed"})
+        events = hub.replay("t")
+        kinds = [e["event"] for e in events]
+        # status (seq 1) survives; chunks truncated to the 2 newest; the
+        # stage event appended within the non-chunk cap.
+        assert kinds == ["status", TRUNCATED, CHUNK, CHUNK, "stage"]
+
+    def test_subscribe_resume_skips_consumed_and_dedups_live(self):
+        async def main():
+            hub = self._hub(chunk_replay=16)
+            hub.track("t")
+            for i in range(4):
+                hub.publish("t", CHUNK, chunk(i))  # seqs 1..4
+            stream = hub.subscribe("t", after_seq=2)
+            got = [await stream.next_event(timeout=1.0) for _ in range(2)]
+            hub.publish("t", TERMINAL, {"Status": "completed"})
+            got.append(await stream.next_event(timeout=1.0))
+            assert await stream.next_event(timeout=1.0) is None
+            return got
+
+        got = run(main())
+        assert [e["seq"] for e in got] == [3, 4, 5]
+        assert got[-1]["event"] == TERMINAL
+
+
+class TestGatewayLastEventIdResume:
+    def _parse_sse(self, text):
+        events, current = [], {}
+        for line in text.splitlines():
+            if line.startswith("id: "):
+                current["id"] = int(line[4:])
+            elif line.startswith("event: "):
+                current["event"] = line[7:]
+            elif line.startswith("data: "):
+                current["data"] = json.loads(line[6:])
+            elif line == "" and current:
+                events.append(current)
+                current = {}
+        return events
+
+    def test_reconnect_resumes_after_last_event_id(self):
+        async def main():
+            platform = LocalPlatform(PlatformConfig(
+                pipeline=True, pipeline_chunk_replay=4))
+            hub = platform.task_events
+            platform.store.upsert(APITask(task_id="t-1", endpoint="/v1/x",
+                                          body=b"", publish=False))
+            hub.track("t-1")
+            for i in range(10):
+                hub.publish("t-1", CHUNK, chunk(i))  # seqs 1..10
+            platform.store.update_status("t-1", "completed - 10 tokens")
+            gw = await serve_gw(platform)
+            try:
+                # Fresh attach: truncated marker then the surviving tail.
+                r1 = await gw.get("/v1/taskmanagement/task/t-1/events",
+                                  params={"wait": "2"})
+                fresh = self._parse_sse(await r1.text())
+                # Reconnect with Last-Event-ID past the drop: no marker,
+                # only events after the resume point.
+                r2 = await gw.get("/v1/taskmanagement/task/t-1/events",
+                                  params={"wait": "2"},
+                                  headers={"Last-Event-ID": "8"})
+                resumed = self._parse_sse(await r2.text())
+                # Query-param spelling for non-EventSource clients.
+                r3 = await gw.get("/v1/taskmanagement/task/t-1/events",
+                                  params={"wait": "2", "lastEventId": "8"})
+                q_resumed = self._parse_sse(await r3.text())
+                r4 = await gw.get("/v1/taskmanagement/task/t-1/events",
+                                  headers={"Last-Event-ID": "bogus"})
+                return fresh, resumed, q_resumed, r4.status
+            finally:
+                await gw.close()
+                await platform.stop()
+
+        fresh, resumed, q_resumed, bad = run(main())
+        fresh_types = [e["event"] for e in fresh]
+        assert TRUNCATED in fresh_types
+        assert fresh_types[-1] == TERMINAL
+        chunk_ids = [e["id"] for e in fresh if e["event"] == CHUNK]
+        assert chunk_ids == [7, 8, 9, 10]  # the 4 newest survive
+        resumed_chunks = [e["id"] for e in resumed if e["event"] == CHUNK]
+        assert resumed_chunks == [9, 10]
+        assert TRUNCATED not in [e["event"] for e in resumed]
+        assert [e["id"] for e in q_resumed if e["event"] == CHUNK] == [9, 10]
+        assert bad == 400
+
+    def test_live_stream_resume_mid_decode(self):
+        """Attach, consume a few chunks, disconnect, reconnect with
+        Last-Event-ID — the resumed stream continues where the client
+        stopped, not from the beginning."""
+
+        async def main():
+            platform = LocalPlatform(PlatformConfig(pipeline=True))
+            hub = platform.task_events
+            platform.store.upsert(APITask(task_id="t-2", endpoint="/v1/x",
+                                          body=b"", publish=False))
+            hub.track("t-2")
+            for i in range(3):
+                hub.publish("t-2", CHUNK, chunk(i))  # seqs 1..3
+            gw = await serve_gw(platform)
+            try:
+                r1 = await gw.get("/v1/taskmanagement/task/t-2/events",
+                                  params={"wait": "0.2"})
+                first = self._parse_sse(await r1.text())
+                last_id = max(e["id"] for e in first)
+                for i in range(3, 6):
+                    hub.publish("t-2", CHUNK, chunk(i))  # seqs 4..6
+                platform.store.update_status("t-2", "completed - done")
+                r2 = await gw.get("/v1/taskmanagement/task/t-2/events",
+                                  params={"wait": "2"},
+                                  headers={"Last-Event-ID": str(last_id)})
+                resumed = self._parse_sse(await r2.text())
+                return last_id, resumed
+            finally:
+                await gw.close()
+                await platform.stop()
+
+        last_id, resumed = run(main())
+        assert last_id == 3
+        resumed_chunks = [e["data"]["index"] for e in resumed
+                          if e["event"] == CHUNK]
+        assert resumed_chunks == [3, 4, 5]
+        assert resumed[-1]["event"] == TERMINAL
+
+
+async def serve_gw(platform):
+    client = TestClient(TestServer(platform.gateway.app))
+    await client.start_server()
+    await platform.start()
+    return client
+
+
+@pytest.mark.slow
+class TestStreamingSoak:
+    def test_long_stream_stays_inside_bounded_buffers(self):
+        """>30s streaming soak (hence the slow marker): a decode engine
+        pushing a long token stream through the hub must keep the
+        per-task buffer bounded and the SSE consumer live throughout."""
+        from ai4e_tpu.runtime.decode import DecodeEngine
+        from tests.test_decode import FakeBackend
+
+        async def main():
+            hub = TaskEventHub(replay=64, chunk_replay=32,
+                               metrics=MetricsRegistry())
+            hub.track("soak")
+            backend = FakeBackend(slots=2, max_len=100_000, step_s=0.004)
+            engine = DecodeEngine(backend, metrics=MetricsRegistry())
+            await engine.start()
+            seen = []
+            stream = hub.subscribe("soak")
+
+            async def consume():
+                while True:
+                    event = await stream.next_event(timeout=10.0)
+                    if event is None:
+                        return
+                    seen.append(event["seq"])
+
+            consumer = asyncio.ensure_future(consume())
+            t0 = time.monotonic()
+            total = 0
+            while time.monotonic() - t0 < 32.0:
+                out = await engine.submit(
+                    [1], 200,
+                    on_token=lambda i, t: hub.publish(
+                        "soak", CHUNK, chunk(i)))
+                total += len(out)
+            hub.publish("soak", TERMINAL, {"Status": "completed"})
+            await consumer
+            await engine.stop()
+            engine.pool.check_conservation()
+            buffered = hub.replay("soak")
+            return total, seen, buffered
+
+        total, seen, buffered = run(main())
+        assert total >= 1000
+        # The live consumer saw a strictly increasing stream…
+        assert all(b > a for a, b in zip(seen, seen[1:]))
+        # …while the replay buffer stayed bounded no matter the volume.
+        assert len(buffered) <= 64 + 32 + 1
