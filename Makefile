@@ -28,7 +28,7 @@ chaos:
 	AI4E_CHAOS_SEED=20260803 JAX_PLATFORMS=cpu python -m pytest \
 	  tests/test_chaos.py tests/test_shard_chaos.py \
 	  tests/test_orchestration_chaos.py tests/test_pipeline_chaos.py \
-	  tests/test_disk_chaos.py \
+	  tests/test_disk_chaos.py tests/test_tenancy_chaos.py \
 	  -q -m chaos -p no:cacheprovider
 
 # The multi-process deployment rig at CI's reduced rate + pinned seed

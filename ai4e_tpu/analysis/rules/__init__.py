@@ -6,6 +6,7 @@ from .config_drift import ConfigDrift
 from .fire_and_forget import FireAndForgetTask
 from .ledger_vocab import LedgerVocabularyDrift
 from .lock_await import LockAcrossSlowAwait
+from .metric_label import UnboundedMetricLabel
 from .metrics_drift import MetricsDrift
 from .registry_leak import MetricsRegistryLeak
 from .rmw import NonatomicReadModifyWrite
@@ -26,6 +27,7 @@ ALL_RULES = [
     MetricsDrift,
     LedgerVocabularyDrift,
     StaticBucketLadder,
+    UnboundedMetricLabel,
 ]
 
 __all__ = ["ALL_RULES"] + [cls.__name__ for cls in ALL_RULES]

@@ -1,0 +1,107 @@
+"""AIL013 — unbounded metric label from caller identity.
+
+The bug class: metric labels mint one time series per distinct value, so
+a label fed from anything the CALLER controls — a subscription key, a
+tenant id, a client identifier pulled from request headers — grows the
+registry without bound and hands an attacker a memory lever (one rotated
+header per request = one fresh series per request). The gateway has
+guarded this by hand since PR 2 (``gateway/router.py`` labels 401s with
+the constant ``route="unauthorized"`` precisely because "the path is
+attacker-chosen and would grow metric cardinality without bound"), and
+PR 16's tenant scope makes it systemic: every per-tenant series must
+pass the id through the registry's FROZEN bounded mapper
+(``TenantRegistry.tenant_label`` — top-N declared tenants + ``other``,
+docs/tenancy.md cardinality policy) instead of labeling with the raw id.
+
+The rule flags metric writes — ``.inc(...)`` / ``.set(...)`` /
+``.observe(...)`` / ``.dec(...)`` — whose keyword argument is an
+identity-class label name (``tenant``, ``api_key``, ``caller``, ...)
+bound to a DYNAMIC value. Blessed shapes, in the spirit of ai4e-lint's
+other idiom rules (fix the idiom, not the instance):
+
+- a string constant (``tenant="other"`` — already bounded);
+- a call to a ``*_label``/``tenant_label`` mapper (inline bounding);
+- a name/attribute whose identifier contains ``label`` (the mapped value
+  was computed a line earlier — ``label = reg.tenant_label(tid)``).
+
+Everything else — the raw variable, an f-string, a header read — is the
+unbounded series waiting to happen.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, enclosing_symbol
+
+#: Metric-write method names whose kwargs carry label values.
+WRITE_METHODS = frozenset({"inc", "dec", "set", "observe"})
+#: Label names that, by platform convention, carry caller identity — the
+#: values that MUST be bounded before becoming a series dimension.
+IDENTITY_LABELS = frozenset({"tenant", "tenant_id", "api_key",
+                             "subscription_key", "caller", "client_id",
+                             "identity", "user", "user_id"})
+
+
+def _is_blessed(value: ast.AST) -> bool:
+    """Whether a label-value expression is visibly bounded."""
+    if isinstance(value, ast.Constant) and isinstance(value.value, str):
+        return True
+    if isinstance(value, ast.Call):
+        fn = value.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        return "label" in name
+    if isinstance(value, ast.Name):
+        return "label" in value.id
+    if isinstance(value, ast.Attribute):
+        return "label" in value.attr
+    return False
+
+
+class UnboundedMetricLabel(Rule):
+    rule_id = "AIL013"
+    name = "unbounded-metric-label"
+    description = ("identity-class metric labels (tenant=, api_key=, ...) "
+                   "must pass through a bounded-cardinality mapper "
+                   "(*_label) — raw caller identity mints unbounded "
+                   "series")
+
+    def check_module(self, ctx):
+        rule = self
+
+        class _Visitor(ast.NodeVisitor):
+            def __init__(self):
+                self.findings = []
+                self._stack: list[ast.AST] = []
+
+            def _enter(self, node):
+                self._stack.append(node)
+                self.generic_visit(node)
+                self._stack.pop()
+
+            visit_ClassDef = _enter
+            visit_FunctionDef = _enter
+            visit_AsyncFunctionDef = _enter
+
+            def visit_Call(self, node):
+                fn = node.func
+                if (isinstance(fn, ast.Attribute)
+                        and fn.attr in WRITE_METHODS):
+                    for kw in node.keywords:
+                        if (kw.arg in IDENTITY_LABELS
+                                and not _is_blessed(kw.value)):
+                            self.findings.append(ctx.finding(
+                                rule.rule_id, node,
+                                f"metric label {kw.arg}= carries caller "
+                                "identity from a dynamic value — pass it "
+                                "through the bounded-cardinality mapper "
+                                "(TenantRegistry.tenant_label: top-N + "
+                                "'other', docs/tenancy.md) before it "
+                                "becomes a series dimension",
+                                symbol=enclosing_symbol(self._stack)))
+                self.generic_visit(node)
+
+        visitor = _Visitor()
+        visitor.visit(ctx.tree)
+        return visitor.findings

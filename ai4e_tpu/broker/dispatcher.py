@@ -88,6 +88,7 @@ class Dispatcher:
         resilience=None,
         orchestration=None,
         observability=None,
+        tenancy=None,
     ):
         self.broker = broker
         self.queue_name = queue_name
@@ -137,6 +138,12 @@ class Dispatcher:
         # None (the default) stamps nothing: the pre-observability
         # dispatcher byte for byte.
         self.observability = observability
+        # Tenancy facade (tenancy/): when set alongside orchestration,
+        # every successful delivery charges the message's tenant the
+        # placement cost of the backend it ran on — the per-workload cost
+        # accounting the per-tenant series report. None (default) charges
+        # nothing: the pre-tenancy dispatcher byte for byte.
+        self.tenancy = tenancy
         self._retry_budget = (resilience.new_budget()
                               if resilience is not None else None)
         self.backends = normalize_backends(backend_uri)
@@ -453,6 +460,12 @@ class Dispatcher:
                     # estimator (the placement's service-time evidence).
                     self.orchestration.observe(base,
                                                _time.perf_counter() - t0)
+                    if self.tenancy is not None:
+                        # Charge the tenant what this placement cost — at
+                        # delivery, on the backend it actually ran on, so
+                        # failovers bill the final host, not the intent.
+                        self.tenancy.charge(getattr(msg, "tenant", ""),
+                                            self.orchestration.cost_of(base))
                 if self.admission is not None:
                     # Delivered-POST RTT feeds the per-queue limiter: when
                     # the worker's event loop congests, these round trips
@@ -719,7 +732,7 @@ class DispatcherPool:
                  retry_delay: float = 60.0, concurrency: int = 1,
                  result_cache=None, result_store=None, admission=None,
                  resilience=None, orchestration=None, observability=None,
-                 metrics: MetricsRegistry | None = None):
+                 tenancy=None, metrics: MetricsRegistry | None = None):
         self.broker = broker
         self.task_manager = task_manager
         self.retry_delay = retry_delay
@@ -730,6 +743,7 @@ class DispatcherPool:
         self.resilience = resilience
         self.orchestration = orchestration
         self.observability = observability
+        self.tenancy = tenancy
         # Registry the registered dispatchers count into — the assembly's
         # own, so a custom-registry platform's /metrics carries
         # ai4e_dispatch_total instead of it silently landing in the
@@ -748,6 +762,7 @@ class DispatcherPool:
             admission=self.admission, resilience=self.resilience,
             orchestration=self.orchestration,
             observability=self.observability,
+            tenancy=self.tenancy,
             metrics=self.metrics,
         )
         self.dispatchers[queue_name] = d

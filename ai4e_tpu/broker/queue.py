@@ -75,9 +75,21 @@ class Message:
     # platform assembly refuses admission=True on the native fabric.)
     deadline_at: float = 0.0
     priority: int = 1
+    # Tenant scope copied from the task (tenancy/): the lane key for the
+    # weighted-fair dequeue. "" = the shared default lane. (The native
+    # broker's C struct has no slot for it — platform assembly refuses
+    # tenancy=True on the native fabric.)
+    tenant: str = ""
 
 
 DeadLetterHandler = Callable[[Message], None]
+
+# Deficit-round-robin cost of serving one message. Every message costs the
+# same here — differential *placement* cost is charged downstream by the
+# dispatcher through the orchestration cost model (tenancy/accounting.py);
+# the queue's job is ratio fairness, and with unit cost a lane's service
+# rate converges to weight/Σweights of the contended throughput.
+_DRR_COST = 1.0
 
 
 class EndpointQueue:
@@ -86,8 +98,14 @@ class EndpointQueue:
     def __init__(self, name: str, max_delivery_count: int = 1440,
                  lease_seconds: float = 300.0,
                  dead_letter_handler: DeadLetterHandler | None = None,
-                 max_dead_letters: int = 256, metrics=None):
+                 max_dead_letters: int = 256, metrics=None, fair=None):
         self.name = name
+        # Weighted-fair dequeue policy (tenancy/lanes.py) or None. When
+        # set, ready messages park in per-lane FIFOs served by deficit
+        # round-robin — a flooded lane fills itself, never another — and
+        # ``_ready`` stays empty. When None (the default), the single-FIFO
+        # hot path below is byte-for-byte the pre-tenancy behavior.
+        self.fair = fair
         self.max_delivery_count = max_delivery_count
         self.lease_seconds = lease_seconds
         self.dead_letter_handler = dead_letter_handler
@@ -117,6 +135,15 @@ class EndpointQueue:
         self._waiters: deque[asyncio.Future] = deque()
         self.dead_letters: list[Message] = []
         self._dead_seqs: set[int] = set()
+        # DRR state (fair mode only). Invariants the race regression pins
+        # (tests/test_race_regressions.py, docs/concurrency.md): a lane key
+        # is in ``_ring`` iff it is in ``_lanes``; deficits are never
+        # negative and never exceed ``_DRR_COST + max quantum``; a lane's
+        # deficit is dropped when the lane empties (no banking — an idle
+        # tenant cannot save up a burst of scheduling credit).
+        self._lanes: dict[str, deque[Message]] = {}
+        self._ring: deque[str] = deque()
+        self._deficit: dict[str, float] = {}
 
     def _dead_letter(self, msg: Message) -> None:
         self.dead_letters.append(msg)
@@ -151,19 +178,86 @@ class EndpointQueue:
                 return
 
     def put(self, msg: Message) -> None:
-        self._ready.append(msg)
-        self._ready_seqs.add(msg.seq)
+        self._requeue(msg)
         self._wake_one()
+
+    def _requeue(self, msg: Message) -> None:
+        """Make a message logically ready (no waiter wake — ``put`` wakes,
+        the lease reaper deliberately does not, exactly as before)."""
+        if self.fair is not None:
+            key = self.fair.lane_of(msg)
+            lane = self._lanes.get(key)
+            if lane is None:
+                lane = self._lanes[key] = deque()
+                self._ring.append(key)
+            lane.append(msg)
+        else:
+            self._ready.append(msg)
+        self._ready_seqs.add(msg.seq)
+
+    def _pop_ready(self) -> Message | None:
+        """Next message to lease, or None if nothing is logically ready.
+        Retracted seqs (see ``__init__``) are skipped lazily in both modes."""
+        if self.fair is not None:
+            return self._pop_fair()
+        while self._ready:
+            msg = self._ready.popleft()
+            if msg.seq not in self._ready_seqs:
+                continue
+            return msg
+        return None
+
+    def _pop_fair(self) -> Message | None:
+        """Deficit round-robin across per-tenant lanes.
+
+        Single-pop variant: visit the lane at the ring head; if its deficit
+        covers one message, serve it and keep the ring position (the lane
+        may have credit for more); otherwise credit the lane its quantum
+        (its LIVE weight — read from the policy per visit, so a registry
+        update rebalances the very next decision) and rotate. Terminates
+        because every lane's quantum has a positive floor
+        (tenancy/lanes.py min_quantum), so the head lane's deficit reaches
+        ``_DRR_COST`` in a bounded number of rotations.
+        """
+        while self._ring:
+            key = self._ring[0]
+            lane = self._lanes[key]
+            while lane and lane[0].seq not in self._ready_seqs:
+                lane.popleft()  # retracted — same lazy skip as FIFO mode
+            if not lane:
+                # Lane drained: drop it from the ring and FORGET its
+                # deficit (no banking across idle periods).
+                self._ring.popleft()
+                del self._lanes[key]
+                self._deficit.pop(key, None)
+                continue
+            credit = self._deficit.get(key, 0.0)
+            if credit >= _DRR_COST:
+                self._deficit[key] = credit - _DRR_COST
+                return lane.popleft()
+            self._deficit[key] = credit + self.fair.quantum(key)
+            self._ring.rotate(-1)
+        return None
+
+    def lane_depths(self) -> dict[str, int]:
+        """Logically-ready depth per lane (fair mode; {} otherwise) —
+        introspection for tests and the rig verdict, not the hot path."""
+        depths = {key: sum(1 for m in lane if m.seq in self._ready_seqs)
+                  for key, lane in self._lanes.items()}
+        return {key: n for key, n in depths.items() if n}
+
+    def deficits(self) -> dict[str, float]:
+        """Snapshot of DRR deficit counters — the race regression asserts
+        conservation (never negative, bounded by cost + max quantum)."""
+        return dict(self._deficit)
 
     async def receive(self, timeout: float | None = None) -> Message | None:
         """Lease the next message; None on timeout."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             self._reap_expired_leases()
-            while self._ready:
-                msg = self._ready.popleft()
-                if msg.seq not in self._ready_seqs:  # retracted (see __init__)
-                    continue
+            msg = self._pop_ready()
+            if msg is not None:
                 self._ready_seqs.discard(msg.seq)
                 msg.delivery_count += 1
                 msg.lease_expires = time.time() + self.lease_seconds
@@ -211,8 +305,7 @@ class EndpointQueue:
             if msg.delivery_count >= self.max_delivery_count:
                 self._dead_letter(msg)
             else:
-                self._ready.append(msg)
-                self._ready_seqs.add(msg.seq)
+                self._requeue(msg)
 
 
 class InMemoryBroker:
@@ -234,11 +327,16 @@ class InMemoryBroker:
     def __init__(self, max_delivery_count: int = 1440,
                  lease_seconds: float = 300.0,
                  max_dead_letters: int = 256, metrics=None,
-                 shard_router=None):
+                 shard_router=None, fair=None):
         self.max_delivery_count = max_delivery_count
         self.lease_seconds = lease_seconds
         self.max_dead_letters = max_dead_letters
         self._metrics = metrics
+        # Weighted-fair lane policy (tenancy/lanes.py), handed to every
+        # queue — including per-shard sub-queues, so fairness holds inside
+        # each shard's drain independently (the noisy-neighbor chaos
+        # scenario checks invariants per shard for exactly this reason).
+        self._fair = fair
         # Shard router (``shard_router(task_id) -> shard index``): when set,
         # publish lands each message on its task's per-shard sub-queue
         # (``shard_queue_name``) instead of the endpoint's base queue —
@@ -279,7 +377,7 @@ class InMemoryBroker:
                     name, self.max_delivery_count, self.lease_seconds,
                     dead_letter_handler=self._dead_letter_handler,
                     max_dead_letters=self.max_dead_letters,
-                    metrics=self._metrics)
+                    metrics=self._metrics, fair=self._fair)
             return q
 
     def queue_names(self) -> list[str]:
@@ -323,7 +421,8 @@ class InMemoryBroker:
                       queue_name=queue_name,
                       cache_key=getattr(task, "cache_key", ""),
                       deadline_at=getattr(task, "deadline_at", 0.0),
-                      priority=getattr(task, "priority", 1))
+                      priority=getattr(task, "priority", 1),
+                      tenant=getattr(task, "tenant", ""))
         loop = self._loop
         try:
             running = asyncio.get_running_loop()

@@ -503,6 +503,12 @@ async def run_control_plane(config: FrameworkConfig, routes: dict) -> None:
         # journals + failover — AI4E_PLATFORM_TASK_SHARDS, docs/sharding.md).
         (f", task store sharded x{platform.config.task_shards}"
          if platform.config.task_shards > 1 else ""),
+        # Tenancy changes the admission contract per caller (tenant
+        # quotas, fair lanes, per-tenant series — AI4E_TENANCY_ENABLED,
+        # docs/tenancy.md).
+        (f", tenancy ON ({len(platform.tenancy.registry.tenant_ids())}"
+         f" tenants)"
+         if getattr(platform, "tenancy", None) is not None else ""),
         # Observability adds the hop ledger + flight recorder
         # (AI4E_PLATFORM_OBSERVABILITY, docs/observability.md) and,
         # with objectives, the SLO burn-rate engine.

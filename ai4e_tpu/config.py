@@ -511,6 +511,31 @@ class ObservabilitySection:
         configure_tracer(exporter=exporter, sample_rate=rate)
 
 
+@_env_section("AI4E_TENANCY_")
+class TenancySection:
+    """Multi-tenancy knobs (tenancy/, docs/tenancy.md) — the analogue of
+    the reference's per-product APIM subscription policy (rate + quota per
+    product, ``create_async_api_management_api.sh:52-80``), plus the
+    scheduler-share weight APIM never had."""
+    # Master switch → PlatformConfig.tenancy.
+    enabled: bool = False
+    # Tenant spec "name=key1|key2[:weight[:rps[:burst]]]" comma-separated
+    # (tenancy/registry.py parse_tenants).
+    tenants: typing.Optional[str] = None
+    # Defaults for omitted spec fields AND the default tenant's own policy
+    # (rps 0 = unlimited).
+    default_weight: float = 1.0
+    default_rps: float = 0.0
+    default_burst: float = 0.0
+    # Bounded metric-label cardinality: first N declared tenants keep
+    # their id, the rest collapse into "other" (AIL013's blessed mapper).
+    label_top_n: int = 8
+    # Goodput target the per-tenant SLO-burn gauge normalizes against.
+    goodput_target: float = 0.99
+    # Floor on a lane's DRR credit per ring visit.
+    min_quantum: float = 0.05
+
+
 @dataclass
 class FrameworkConfig:
     """The whole platform's config tree."""
@@ -520,6 +545,7 @@ class FrameworkConfig:
     gateway: GatewaySection = field(default_factory=GatewaySection)
     observability: ObservabilitySection = field(
         default_factory=ObservabilitySection)
+    tenancy: TenancySection = field(default_factory=TenancySection)
 
     @classmethod
     def from_env(cls, env: typing.Mapping[str, str] | None = None
@@ -547,6 +573,14 @@ class FrameworkConfig:
         pc = self.platform.to_platform_config()
         pc.queue_depth_interval = self.observability.queue_depth_interval
         pc.process_depth_interval = self.observability.process_depth_interval
+        pc.tenancy = self.tenancy.enabled
+        pc.tenancy_tenants = self.tenancy.tenants
+        pc.tenancy_default_weight = self.tenancy.default_weight
+        pc.tenancy_default_rps = self.tenancy.default_rps
+        pc.tenancy_default_burst = self.tenancy.default_burst
+        pc.tenancy_label_top_n = self.tenancy.label_top_n
+        pc.tenancy_goodput_target = self.tenancy.goodput_target
+        pc.tenancy_min_quantum = self.tenancy.min_quantum
         return pc
 
     def to_dict(self) -> dict:

@@ -114,6 +114,10 @@ class Gateway:
         self._rate_limiter = None
         # Per-key request quotas (APIM product quota); None → unlimited.
         self._quota_tracker = None
+        # Multi-tenancy facade (``tenancy/``); None → no tenant resolution,
+        # no per-tenant quota, tasks stay tenantless — the pre-tenancy
+        # gateway byte for byte. Set via set_tenancy (assembly wires it).
+        self._tenancy = None
         # Inference result cache (``rescache/``); None → every request
         # executes. Set via set_result_cache (platform assembly wires it).
         self._result_cache = None
@@ -365,6 +369,19 @@ class Gateway:
         with Retry-After = the window reset."""
         self._quota_tracker = tracker
 
+    def set_tenancy(self, tenancy) -> None:
+        """Enable (or clear with None) the multi-tenancy layer
+        (``tenancy/``, ``docs/tenancy.md``): the subscription key resolves
+        to a tenant once, HERE at the edge; work-creating requests on the
+        published surface spend the tenant's token bucket (429 with a
+        drain-derived ``Retry-After`` on refusal — composed with, never
+        replacing, the per-key throttle above and the admission shedder
+        below); and the resolved tenant id rides the task record so the
+        broker lanes, the dispatcher's cost charge, and the per-tenant
+        series all scope by it. Off (None) → nothing resolved, nothing
+        stamped: the pre-tenancy path byte for byte."""
+        self._tenancy = tenancy
+
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
         """Subscription-key gate — the APIM front-door behavior (every
@@ -422,7 +439,44 @@ class Gateway:
                                  str(max(1, math.ceil(retry_after)))})
             if self._quota_tracker is not None:
                 self._quota_tracker.allow(identity)  # consume the unit
+        if (self._tenancy is not None and not exempt
+                and not request.path.startswith("/v1/taskstore/")):
+            # Tenant scope resolves ONCE, here at the edge — downstream
+            # hops read the resolved id, never the key. The tenant bucket
+            # is spent only by WORK-CREATING requests (published routes):
+            # status polls and event streams cost the platform nothing a
+            # quota contract meters, and charging them would let a slow
+            # backend double-bill its own tenant's polling.
+            tenant = self._tenancy.resolve(key)
+            request["ai4e_tenant"] = tenant.tenant_id
+            if self._published_route(request.path):
+                allowed, retry_after = self._tenancy.admit(tenant.tenant_id)
+                if not allowed:
+                    if self._admission is not None:
+                        # Compose with the admission drain estimate: back
+                        # off for whichever bottleneck is slower — the
+                        # tenant's own refill or the platform's drain.
+                        retry_after = max(retry_after,
+                                          self._admission.retry_after_s())
+                    self._tenancy.note_quota_shed(tenant.tenant_id)
+                    self._requests.inc(route="throttled",
+                                       outcome="tenant_429")
+                    return web.json_response(
+                        {"error": "tenant quota exceeded"}, status=429,
+                        headers={"Retry-After":
+                                 str(max(1, math.ceil(retry_after))),
+                                 SHED_REASON_HEADER:
+                                 shed_reason("gateway", "tenant-quota")})
+                self._tenancy.note_admitted(tenant.tenant_id)
         return await handler(request)
+
+    def _published_route(self, path: str) -> bool:
+        """Whether a request path targets a published API (the
+        work-creating surface the tenant bucket meters)."""
+        for route in self.routes:
+            if path == route.prefix or path.startswith(route.prefix + "/"):
+                return True
+        return False
 
     def add_async_route(self, prefix: str, task_endpoint,
                         max_body_bytes: int | None = None) -> None:
@@ -604,6 +658,7 @@ class Gateway:
                         cache_key=cache_key,
                         deadline_at=deadline_at,
                         priority=task_priority,
+                        tenant=request.get("ai4e_tenant", ""),
                     )))
                 except NotPrimaryError:
                     # Standby control plane: reads are served here, task

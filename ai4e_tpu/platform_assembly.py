@@ -211,6 +211,37 @@ class PlatformConfig:
     # before the bounded history drops to a single `truncated` marker
     # (docs/streaming.md).
     pipeline_chunk_replay: int = 128
+    # Multi-tenancy (tenancy/, docs/tenancy.md): subscription keys resolve
+    # to tenants once at the gateway edge; work-creating requests spend a
+    # per-tenant token bucket (429 + drain-derived Retry-After, composed
+    # with the priority shedder); the broker's per-shard sub-queues dequeue
+    # deficit-round-robin across per-tenant lanes so a flooded tenant fills
+    # its own lane, never another's; the dispatcher charges placement cost
+    # per tenant; and goodput/SLO-burn series carry a bounded-cardinality
+    # tenant label (top-N + "other", never raw keys). Off by default — the
+    # assembly is byte-identical without it (asserted in tests); requires
+    # the Python store/broker and the queue transport (the native broker's
+    # C structs carry no tenant slot, and the push transport has no queue
+    # to lane).
+    tenancy: bool = False
+    # Tenant spec "name=key1|key2[:weight[:rps[:burst]]]" comma-separated
+    # (tenancy/registry.py parse_tenants); None/"" = no declared tenants
+    # (all traffic rides the default tenant's lane and bucket).
+    tenancy_tenants: str | None = None
+    # Defaults for spec entries that omit a field — and the default
+    # tenant's own policy (rps 0 = unlimited).
+    tenancy_default_weight: float = 1.0
+    tenancy_default_rps: float = 0.0
+    tenancy_default_burst: float = 0.0
+    # Frozen metric-label cardinality bound: first N declared tenants keep
+    # their id as label value, the rest collapse into "other".
+    tenancy_label_top_n: int = 8
+    # Goodput target the per-tenant SLO-burn gauge normalizes against
+    # (burn 1.0 = failing exactly (1 - target) of the window).
+    tenancy_goodput_target: float = 0.99
+    # Floor on a lane's DRR credit per ring visit (guards pathological
+    # weights; tenancy/lanes.py).
+    tenancy_min_quantum: float = 0.05
 
 
 class LocalPlatform:
@@ -438,6 +469,36 @@ class LocalPlatform:
                     "orchestration=True — it feeds SLO breaches to the "
                     "degradation ladder (docs/observability.md)")
             self.slo.attach_ladder(self.orchestration.ladder)
+        self.tenancy = None
+        if self.config.tenancy:
+            if self.config.transport != "queue":
+                raise ValueError(
+                    "tenancy=True requires the queue transport — the "
+                    "weighted-fair lanes live inside the broker's queues "
+                    "(docs/tenancy.md)")
+            if self.config.native_store or self.config.native_broker:
+                # The C structs have no tenant slot; running the layer
+                # there would silently drop the very scope it enforces —
+                # same loud-fail pattern as admission-on-native.
+                raise ValueError(
+                    "tenancy=True requires the Python store and broker "
+                    "(the native cores carry no tenant state)")
+            from .tenancy import Tenancy
+            self.tenancy = Tenancy.from_spec(
+                self.config.tenancy_tenants,
+                metrics=self.metrics,
+                default_weight=self.config.tenancy_default_weight,
+                default_rps=self.config.tenancy_default_rps,
+                default_burst=self.config.tenancy_default_burst,
+                label_top_n=self.config.tenancy_label_top_n,
+                goodput_target=self.config.tenancy_goodput_target,
+                min_quantum=self.config.tenancy_min_quantum)
+            if hasattr(self.store, "add_listener"):
+                # Terminal transitions label the per-tenant outcome/burn
+                # series — the same change feed admission's goodput scorer
+                # rides, attached independently so per-tenant series exist
+                # without the observability layer.
+                self.tenancy.attach_store(self.store)
         self.broker = None
         self.dispatchers = None
         self.topic = None
@@ -462,7 +523,11 @@ class LocalPlatform:
                     # Sharded store → per-shard sub-queues, so each shard's
                     # dispatchers drain independently (broker/queue.py).
                     shard_router=(self.store.shard_for
-                                  if self.config.task_shards > 1 else None))
+                                  if self.config.task_shards > 1 else None),
+                    # Tenancy → per-tenant DRR lanes inside every queue,
+                    # shard sub-queues included (broker/queue.py).
+                    fair=(self.tenancy.lanes
+                          if self.tenancy is not None else None))
             self.store.set_publisher(self.broker.publish)
             self.dispatchers = DispatcherPool(
                 self.broker, self.task_manager,
@@ -476,6 +541,7 @@ class LocalPlatform:
                 resilience=self.resilience,
                 orchestration=self.orchestration,
                 observability=self.observability,
+                tenancy=self.tenancy,
                 metrics=self.metrics)
         else:
             raise ValueError(
@@ -528,6 +594,8 @@ class LocalPlatform:
             self.gateway.set_orchestration(self.orchestration)
         if self.observability is not None:
             self.gateway.set_observability(self.observability)
+        if self.tenancy is not None:
+            self.gateway.set_tenancy(self.tenancy)
         if self.task_events is not None:
             self.gateway.set_event_stream(
                 self.task_events,

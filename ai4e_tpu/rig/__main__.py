@@ -39,7 +39,10 @@ def _topology_from_args(args) -> Topology:
         observability=not args.no_observability,
         work_ms=args.work_ms, base_port=args.base_port,
         workdir=args.workdir, max_inflight=args.max_inflight,
-        task_timeout=args.task_timeout)
+        task_timeout=args.task_timeout,
+        tenants=args.tenants,
+        loadgen_tenants=(json.loads(args.loadgen_tenants)
+                         if args.loadgen_tenants else []))
 
 
 def main(argv=None) -> int:
@@ -92,6 +95,18 @@ def main(argv=None) -> int:
                     help="no hop-ledger stamps / flight rings / vitals "
                          "samplers / timeline (the serving fleet "
                          "byte-identical to PR 11)")
+    up.add_argument("--tenants",
+                    default=os.environ.get("AI4E_RIG_TENANTS", ""),
+                    help="tenant registry spec "
+                         "('name=key:weight:rps:burst,...') — enables "
+                         "per-gateway quota edges + weighted-fair shard "
+                         "lanes (docs/tenancy.md); empty = tenancy off")
+    up.add_argument("--loadgen-tenants",
+                    default=os.environ.get("AI4E_RIG_LOADGEN_TENANTS", ""),
+                    help="JSON list pinning loadgen i to a tenant: "
+                         '[{"name": ..., "key": ..., "rate": rps}, ...] — '
+                         "rate overrides the even rate/loadgens split "
+                         "(the noisy-neighbor lever)")
     up.add_argument("--out", default=None,
                     help="artifact directory (rig.json is written here)")
 

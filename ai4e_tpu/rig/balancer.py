@@ -82,8 +82,19 @@ class Balancer:
                     payload = await resp.read()
                 self._requests.inc(gateway=str(g),
                                    outcome=str(resp.status))
+                # Forward the gateway's response headers: shed provenance
+                # (X-Shed-Reason) and quota drain (Retry-After) are part
+                # of the refusal contract clients key off — a front door
+                # that strips them breaks the tenant taxonomy. The body
+                # arrives decoded, so content-* framing stays ours.
+                resp_headers = {
+                    k: v for k, v in resp.headers.items()
+                    if k.lower() not in _HOP_HEADERS
+                    and k.lower() not in ("content-type",
+                                          "content-encoding")}
                 return web.Response(status=resp.status, body=payload,
-                                    content_type=resp.content_type)
+                                    content_type=resp.content_type,
+                                    headers=resp_headers)
             except aiohttp.ClientConnectorError as exc:
                 # Connect-phase failure ONLY: the gateway never saw the
                 # request — safe to offer it to the next replica. A reset

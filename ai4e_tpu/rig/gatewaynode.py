@@ -39,6 +39,17 @@ def build_gateway(topo: Topology) -> tuple[Gateway, RingStoreClient]:
     # The recorded task Endpoint is nominal (dispatchers rebase onto their
     # shard's worker set); its PATH is what names the broker queue.
     gateway.add_async_route(topo.route, topo.worker_urls(0)[0])
+    if topo.tenants:
+        # Per-replica tenancy edge: THIS process resolves subscription
+        # keys and enforces the token-bucket quota locally (no shared
+        # bucket across gateways — the fleet admits up to gateways × rps
+        # per tenant, the per-instance rate-limit semantic stated in
+        # docs/tenancy.md). Outcome accounting stays on the record-owning
+        # side; the edge counters (ai4e_tenant_admissions_total) land in
+        # this node's registry and merge in the verdict scrape.
+        from ..tenancy import Tenancy
+        gateway.set_tenancy(Tenancy.from_spec(topo.tenants,
+                                              metrics=gateway.metrics))
     if topo.observability:
         from ..observability.flight import FlightRecorder
         from ..observability.hub import RequestObservability

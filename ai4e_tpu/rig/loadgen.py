@@ -34,6 +34,18 @@ async def run_loadgen(topo: Topology, index: int) -> None:
     payload = json.dumps(
         {"loadgen": index,
          "pad": "x" * max(0, topo.payload_bytes - 32)}).encode("utf-8")
+    headers = {"Content-Type": "application/json"}
+    rate = topo.rate / max(1, topo.loadgens)
+    tenant = None
+    if index < len(topo.loadgen_tenants):
+        # Tenant-pinned loadgen: ONE tenant's whole traffic stream, so
+        # the window's error taxonomy (tenant_quota_429 vs backpressure)
+        # IS that tenant's shed tally and the noisy-neighbor A/B reads
+        # straight off the per-loadgen artifacts.
+        assignment = topo.loadgen_tenants[index]
+        tenant = assignment.get("name")
+        headers["Ocp-Apim-Subscription-Key"] = assignment["key"]
+        rate = float(assignment.get("rate", rate))
     accepted: list[str] = []
     terminal: dict[str, str] = {}
     samples: list[dict] = []
@@ -66,8 +78,8 @@ async def run_loadgen(topo: Topology, index: int) -> None:
             session,
             post_url=base + topo.route,
             payload=payload,
-            headers={"Content-Type": "application/json"},
-            rate=topo.rate / max(1, topo.loadgens),
+            headers=headers,
+            rate=rate,
             status_url_for=status_url_for,
             duration=topo.duration,
             ramp=topo.ramp,
@@ -82,6 +94,7 @@ async def run_loadgen(topo: Topology, index: int) -> None:
 
     out = {
         "loadgen": index,
+        **({"tenant": tenant} if tenant else {}),
         "started_at": started_at,
         "finished_at": time.time(),
         "window": window,

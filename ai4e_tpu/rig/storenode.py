@@ -201,9 +201,19 @@ class StoreNode:
                                                   50_000_000)),
             metrics=self.metrics)
         self.store.set_write_fence(self.fence.owns)
+        fair = None
+        if topo.tenants:
+            # Weighted-fair lanes (tenancy/lanes.py) on THIS shard's
+            # queue: the upsert's Tenant field rode the wire with the
+            # record, so the publisher stamps each message's lane and the
+            # DRR dequeue holds the weight ratio inside the shard —
+            # exactly where the backlog lives in the rig.
+            from ..tenancy import Tenancy
+            fair = Tenancy.from_spec(topo.tenants).lanes
         self.broker = InMemoryBroker(
             max_delivery_count=int(topo.extra.get("max_delivery_count", 20)),
-            lease_seconds=topo.lease_seconds, metrics=self.metrics)
+            lease_seconds=topo.lease_seconds, metrics=self.metrics,
+            fair=fair)
         self.broker.register_queue(self._route_path())
         self.broker.set_dead_letter_handler(self._dead_letter)
         self.store.set_publisher(self.broker.publish)
@@ -364,7 +374,7 @@ class StoreNode:
             "DeliveryCount": msg.delivery_count, "Seq": msg.seq,
             "LeaseExpires": msg.lease_expires, "Queue": msg.queue_name,
             "CacheKey": msg.cache_key, "DeadlineAt": msg.deadline_at,
-            "Priority": msg.priority})
+            "Priority": msg.priority, "Tenant": msg.tenant})
 
     async def _broker_done(self, request: web.Request) -> web.Response:
         try:

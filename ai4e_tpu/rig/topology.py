@@ -68,6 +68,18 @@ class Topology:
     workdir: str = "/tmp/ai4e-rig"
     route: str = ECHO_ROUTE
     payload_bytes: int = 64
+    # Multi-tenancy (tenancy/, docs/tenancy.md). ``tenants`` is the
+    # registry spec ("name=key:weight:rps:burst,..."): non-empty puts the
+    # tenant resolver + token-bucket quota on EVERY gateway replica (each
+    # enforces the contracted rps locally, so the fleet ceiling is
+    # gateways × rps — the per-instance rate-limit semantic, stated in
+    # docs/tenancy.md) and weighted-fair lanes on every shard broker.
+    # ``loadgen_tenants[i]`` pins loadgen i to one tenant:
+    # {"name": ..., "key": ..., "rate": rps} — rate overrides the even
+    # rate/loadgens split, which is how the noisy-neighbor scenario
+    # drives one tenant at 10× while the victims hold rated.
+    tenants: str = ""
+    loadgen_tenants: list = field(default_factory=list)
     extra: dict = field(default_factory=dict)
 
     def __post_init__(self):

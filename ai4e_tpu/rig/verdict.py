@@ -140,6 +140,8 @@ def compute_verdict(topo: Topology) -> dict:
         accepted.update(data.get("accepted", ()))
         client_terminal.update(data.get("terminal", {}))
         windows.append({"loadgen": data.get("loadgen"),
+                        **({"tenant": data["tenant"]}
+                           if data.get("tenant") else {}),
                         "window": data.get("window"),
                         "samples": data.get("samples")})
 

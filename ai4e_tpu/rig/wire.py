@@ -439,7 +439,8 @@ class WireBroker:
             queue_name=d.get("Queue", queue_name),
             cache_key=d.get("CacheKey", ""),
             deadline_at=float(d.get("DeadlineAt", 0.0)),
-            priority=int(d.get("Priority", 1)))
+            priority=int(d.get("Priority", 1)),
+            tenant=d.get("Tenant", ""))
 
     def _ack(self, msg: Message, outcome: str) -> None:
         async def send() -> None:

@@ -110,6 +110,12 @@ class APITask:
     # already-dead work and shed lowest-priority-first.
     deadline_at: float = 0.0
     priority: int = 1
+    # Tenant scope (tenancy/): the tenant id the gateway resolved from the
+    # caller's subscription key — never the key itself. Rides the record,
+    # the wire, and the journal so the broker can lane the message, the
+    # dispatcher can charge placement cost, and the outcome feed can label
+    # per-tenant series. "" = tenantless (layer off, or internal traffic).
+    tenant: str = ""
     # Journal participation. False for records whose loss on restart is
     # acceptable — cache-hit tasks, whose terminal record was already in the
     # submit response: a JournaledTaskStore keeps them queryable in memory
@@ -148,6 +154,10 @@ class APITask:
             d["DeadlineAt"] = self.deadline_at
         if self.priority != 1:
             d["Priority"] = self.priority
+        if self.tenant:
+            # Only when set — tenantless deployments keep the reference
+            # wire shape byte for byte.
+            d["Tenant"] = self.tenant
         return d
 
     @classmethod
@@ -169,6 +179,7 @@ class APITask:
             cache_key=d.get("CacheKey", ""),
             deadline_at=float(d.get("DeadlineAt") or 0.0),
             priority=int(d.get("Priority") or 1),
+            tenant=d.get("Tenant", ""),
         )
 
     def with_status(self, status: str, backend_status: str | None = None) -> "APITask":

@@ -70,6 +70,7 @@ async def run_closed_loop(
     headers_for=None,
     deadline_s: float | None = None,
     events_url_for=None,
+    tenant_names: dict | None = None,
 ) -> dict:
     """Drive ``post_url`` closed-loop; returns window stats.
 
@@ -85,6 +86,13 @@ async def run_closed_loop(
     within the budget) vs ``late``, and tasks the platform shed on their
     deadline (terminal ``expired`` status / 504) count as ``expired``,
     not failed.
+    ``tenant_names`` (optional): subscription key → tenant name. When
+    set, every outcome is additionally bucketed by the tenant whose key
+    the request carried (``Ocp-Apim-Subscription-Key``, set via
+    ``headers``/``headers_for``) and the window JSON gains a
+    ``by_tenant`` block — completions, goodput, and the tenant-quota
+    429s (``quota_shed``) the gateway's per-tenant bucket refused
+    (docs/tenancy.md). Keys absent from the map bucket under ``""``.
     ``events_url_for(task_id) -> url`` (optional, async mode): follow the
     task's SSE event stream (``GET /task/{id}/events``, pipeline
     platforms — docs/pipelines.md) instead of long-polling, recording
@@ -131,45 +139,85 @@ async def run_closed_loop(
             b = by_class[cls] = {"completed": 0, "good": 0, "failed": 0,
                                  "expired": 0}
         return b
+    # Per-tenant accounting (docs/tenancy.md), keyed by the tenant whose
+    # subscription key each request carried — only populated when the
+    # caller supplies the key → name map.
+    by_tenant: dict[str, dict] = {}
+
+    def _tbucket(name: str) -> dict:
+        b = by_tenant.get(name)
+        if b is None:
+            b = by_tenant[name] = {"offered": 0, "completed": 0, "good": 0,
+                                   "failed": 0, "expired": 0,
+                                   "quota_shed": 0}
+        return b
+
+    def _tenant_of(hdrs: dict) -> str | None:
+        if tenant_names is None:
+            return None
+        return tenant_names.get(
+            hdrs.get("Ocp-Apim-Subscription-Key", ""), "")
 
     def _headers() -> dict:
         if headers_for is None:
             return headers
         return {**headers, **headers_for()}
 
-    def _score_completion(elapsed: float, cls: str) -> None:
+    def _score_completion(elapsed: float, cls: str, tname=None) -> None:
         nonlocal completed, good
         latencies.append(elapsed)
         completed += 1
         _bucket(cls)["completed"] += 1
-        if deadline_s is None or elapsed <= deadline_s:
+        in_deadline = deadline_s is None or elapsed <= deadline_s
+        if in_deadline:
             good += 1
             _bucket(cls)["good"] += 1
+        if tname is not None:
+            _tbucket(tname)["completed"] += 1
+            if in_deadline:
+                _tbucket(tname)["good"] += 1
 
-    def _score_failed(cls: str) -> None:
+    def _score_failed(cls: str, tname=None) -> None:
         nonlocal failed
         failed += 1
         _bucket(cls)["failed"] += 1
+        if tname is not None:
+            _tbucket(tname)["failed"] += 1
 
-    def _score_expired(cls: str) -> None:
+    def _score_expired(cls: str, tname=None) -> None:
         nonlocal expired
         expired += 1
         _bucket(cls)["expired"] += 1
+        if tname is not None:
+            _tbucket(tname)["expired"] += 1
 
-    def _score_terminal(status: str, elapsed: float, cls: str) -> None:
+    def _score_backpressure(resp, tname=None) -> None:
+        # A tenant-quota 429 is the tenant's OWN contract (shed, carries
+        # Retry-After) — bucket it to the tenant so the noisy-neighbor
+        # A/B can show who paid; other 429/503s are platform pressure.
+        reason = resp.headers.get("X-Shed-Reason", "")
+        if "tenant-quota" in reason:
+            _err("tenant_quota_429")
+            if tname is not None:
+                _tbucket(tname)["quota_shed"] += 1
+        else:
+            _err(f"backpressure_{resp.status}")
+
+    def _score_terminal(status: str, elapsed: float, cls: str,
+                        tname=None) -> None:
         # "failed" FIRST — the platform's canonical bucketing
         # (TaskStatus.canonical) tests it first.
         if "failed" in status:
-            _score_failed(cls)
+            _score_failed(cls, tname)
         elif "completed" in status:
-            _score_completion(elapsed, cls)
+            _score_completion(elapsed, cls, tname)
         elif "expired" in status:
-            _score_expired(cls)
+            _score_expired(cls, tname)
         else:
-            _score_failed(cls)  # stream ended on a non-terminal status
+            _score_failed(cls, tname)  # stream ended on a non-terminal status
 
     async def _follow_events(task_id: str, t0: float, cls: str,
-                             deadline: float) -> bool:
+                             deadline: float, tname=None) -> bool:
         """Consume the task's SSE stream: record the first partial, score
         the terminal event. True when the request was scored; False →
         the caller falls back to status polling."""
@@ -185,7 +233,8 @@ async def run_closed_loop(
                 current: dict = {}
                 async for raw in resp.content:
                     if time.perf_counter() > deadline:
-                        _score_failed(cls)  # stuck task: don't hang the run
+                        # stuck task: don't hang the run
+                        _score_failed(cls, tname)
                         return True
                     line = raw.decode("utf-8").rstrip("\r\n")
                     if line.startswith(":"):
@@ -211,7 +260,8 @@ async def run_closed_loop(
                             ttfps.append(time.perf_counter() - t0)
                     elif etype == "terminal":
                         _score_terminal(data.get("Status", ""),
-                                        time.perf_counter() - t0, cls)
+                                        time.perf_counter() - t0, cls,
+                                        tname)
                         return True
         except (aiohttp.ClientError, asyncio.TimeoutError):
             return False
@@ -223,45 +273,49 @@ async def run_closed_loop(
         url = post_url if post_url_for is None else post_url_for()
         hdrs = _headers()
         cls = hdrs.get("X-Priority", "")
+        tname = _tenant_of(hdrs)
         offered += 1
+        if tname is not None:
+            _tbucket(tname)["offered"] += 1
         try:
             async with session.post(url, data=payload,
                                     headers=hdrs) as resp:
                 if resp.status in (503, 429):
-                    # Backpressure (admission 503 / per-key throttle 429):
-                    # not a failure — yield briefly and re-enter. The client
-                    # honors Retry-After when present, capped so one long
-                    # hint can't idle the closed loop past the window.
-                    _err(f"backpressure_{resp.status}")
+                    # Backpressure (admission 503 / per-key throttle 429 /
+                    # tenant quota 429): not a failure — yield briefly and
+                    # re-enter. The client honors Retry-After when present,
+                    # capped so one long hint can't idle the closed loop
+                    # past the window.
+                    _score_backpressure(resp, tname)
                     await asyncio.sleep(_backoff(resp))
                     return
                 if resp.status == 504:  # shed: budget spent at the edge
                     _err("shed_504")
-                    _score_expired(cls)
+                    _score_expired(cls, tname)
                     return
                 if resp.status >= 400:
                     _err(f"http_{resp.status}")
-                    _score_failed(cls)
+                    _score_failed(cls, tname)
                     return
                 task = await resp.json()
             task_id = task["TaskId"]
         except asyncio.TimeoutError:
             _err("timeout")
-            _score_failed(cls)
+            _score_failed(cls, tname)
             return
         except aiohttp.ClientError as exc:
             _err("connect_error"
                  if isinstance(exc, aiohttp.ClientConnectorError)
                  else "transport_error")
-            _score_failed(cls)
+            _score_failed(cls, tname)
             return
         except (ValueError, KeyError, TypeError):
             _err("bad_response")
-            _score_failed(cls)
+            _score_failed(cls, tname)
             return
         deadline = t0 + task_timeout
         if events_url_for is not None:
-            if await _follow_events(task_id, t0, cls, deadline):
+            if await _follow_events(task_id, t0, cls, deadline, tname):
                 return
             # Stream unavailable/interrupted: poll like everyone else.
         while True:
@@ -271,32 +325,32 @@ async def run_closed_loop(
                                        headers=headers) as resp:
                     if resp.status == 404:  # reaped/evicted task
                         _err("task_poll_404")
-                        _score_failed(cls)
+                        _score_failed(cls, tname)
                         return
                     record = await resp.json()
                 status = record["Status"]
             except (aiohttp.ClientError, asyncio.TimeoutError, ValueError,
                     KeyError, TypeError):
                 _err("poll_transport")
-                _score_failed(cls)
+                _score_failed(cls, tname)
                 return
             # "failed" FIRST — the platform's canonical bucketing
             # (TaskStatus.canonical) tests it first, so a status carrying
             # both words counts the same here as in the store's sets.
             if "failed" in status:
-                _score_failed(cls)
+                _score_failed(cls, tname)
                 return
             if "completed" in status:
-                _score_completion(time.perf_counter() - t0, cls)
+                _score_completion(time.perf_counter() - t0, cls, tname)
                 return
             if "expired" in status:
                 # Admission shed the task on its deadline (terminal) —
                 # shed work, not a platform failure.
-                _score_expired(cls)
+                _score_expired(cls, tname)
                 return
             if time.perf_counter() > deadline:  # stuck task: don't hang the run
                 _err("stuck_timeout")
-                _score_failed(cls)
+                _score_failed(cls, tname)
                 return
 
     async def one_sync() -> None:
@@ -308,17 +362,20 @@ async def run_closed_loop(
         url = post_url if post_url_for is None else post_url_for()
         hdrs = _headers()
         cls = hdrs.get("X-Priority", "")
+        tname = _tenant_of(hdrs)
         offered += 1
+        if tname is not None:
+            _tbucket(tname)["offered"] += 1
         try:
             async with session.post(url, data=payload,
                                     headers=hdrs) as resp:
                 if resp.status in (503, 429):
-                    _err(f"backpressure_{resp.status}")
+                    _score_backpressure(resp, tname)
                     await asyncio.sleep(_backoff(resp))
                     return
                 if resp.status == 504:  # admission shed on deadline
                     _err("shed_504")
-                    _score_expired(cls)
+                    _score_expired(cls, tname)
                     return
                 await resp.read()
                 ok = resp.status == 200
@@ -333,9 +390,9 @@ async def run_closed_loop(
                  else "transport_error")
             ok = False
         if ok:
-            _score_completion(time.perf_counter() - t0, cls)
+            _score_completion(time.perf_counter() - t0, cls, tname)
         else:
-            _score_failed(cls)
+            _score_failed(cls, tname)
 
     one = one_sync if mode == "sync" else one_async
 
@@ -353,13 +410,17 @@ async def run_closed_loop(
     def _class_snapshot() -> dict:
         return {cls: dict(b) for cls, b in by_class.items()}
 
+    def _tenant_snapshot() -> dict:
+        return {name: dict(b) for name, b in by_tenant.items()}
+
     async def open_window() -> None:
         await asyncio.sleep(ramp)
         mark.update(t=time.perf_counter(), completed=completed,
                     failed=failed, expired=expired, good=good,
                     offered=offered, errors=dict(errors),
                     n_lat=len(latencies), n_ttfp=len(ttfps),
-                    by_class=_class_snapshot())
+                    by_class=_class_snapshot(),
+                    by_tenant=_tenant_snapshot())
 
     async def close_window() -> None:
         # Snapshot AT stop_at, not after the drain: gather() returns only
@@ -371,7 +432,8 @@ async def run_closed_loop(
                      failed=failed, expired=expired, good=good,
                      offered=offered, errors=dict(errors),
                      n_lat=len(latencies), n_ttfp=len(ttfps),
-                     by_class=_class_snapshot())
+                     by_class=_class_snapshot(),
+                     by_tenant=_tenant_snapshot())
 
     stop_at = time.perf_counter() + ramp + duration
     await asyncio.gather(open_window(), close_window(),
@@ -449,6 +511,23 @@ async def run_closed_loop(
                         (entry["late"] + e) / (c + e), 3)
             per[cls] = entry
         out["by_priority"] = per
+    if tenant_names is not None:
+        # Per-tenant window deltas (docs/tenancy.md): who completed, who
+        # ran late, and who paid the tenant-quota 429s — the bench's
+        # --tenant-mix noisy-neighbor A/B reads its verdict off this.
+        zero = {"offered": 0, "completed": 0, "good": 0, "failed": 0,
+                "expired": 0, "quota_shed": 0}
+        per_tenant = {}
+        for name in sorted(close["by_tenant"]):
+            at_close = close["by_tenant"][name]
+            at_open = mark["by_tenant"].get(name, zero)
+            entry = {k: at_close.get(k, 0) - at_open[k] for k in zero}
+            g = entry.pop("good")
+            if deadline_s is not None:
+                entry["goodput"] = round(g / elapsed, 2)
+                entry["late"] = entry["completed"] - g
+            per_tenant[name] = entry
+        out["by_tenant"] = per_tenant
     return out
 
 
@@ -508,7 +587,14 @@ async def run_open_loop(
             async with session.post(url, data=payload,
                                     headers=headers) as resp:
                 if resp.status in (503, 429):
-                    _err(f"backpressure_{resp.status}")
+                    # Tenant-quota 429s get their own taxonomy line: the
+                    # rig runs one open loop per tenant, so this count IS
+                    # that tenant's shed tally in the verdict.
+                    if "tenant-quota" in resp.headers.get(
+                            "X-Shed-Reason", ""):
+                        _err("tenant_quota_429")
+                    else:
+                        _err(f"backpressure_{resp.status}")
                     return
                 if resp.status == 504:
                     _err("shed_504")

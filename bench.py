@@ -596,6 +596,108 @@ def _admission_report(args, platform) -> dict:
     }}
 
 
+def _parse_tenant_mix(spec: str) -> list[tuple[str, float, float, float]]:
+    """``"paid=3:50,trial=1:5"`` → ``[(name, weight, rps, share)]``.
+
+    ``name=weight:rps[:share]`` — *weight* is the tenant's fair-share
+    weight AND its declared quota shape (burst defaults inside the
+    registry), *rps* its token-bucket rate, *share* its fraction of the
+    offered traffic draw (defaults to *weight*, so a 3:1 weight split is
+    also a 3:1 traffic split unless overridden). Subscription keys are
+    synthesized as ``key-<name>``."""
+    mix = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, rest = part.partition("=")
+        name = name.strip()
+        if not sep or not name:
+            raise ValueError(
+                f"--tenant-mix entry {part!r}: expected name=weight:rps")
+        fields = [f.strip() for f in rest.split(":")]
+        if len(fields) not in (2, 3):
+            raise ValueError(
+                f"--tenant-mix entry {part!r}: expected name=weight:rps"
+                f"[:share], got {len(fields)} field(s)")
+        try:
+            weight, rps = float(fields[0]), float(fields[1])
+            share = float(fields[2]) if len(fields) == 3 else weight
+        except ValueError:
+            raise ValueError(
+                f"--tenant-mix entry {part!r}: weight/rps/share must be "
+                f"numbers") from None
+        if any(n == name for n, *_ in mix):
+            raise ValueError(f"--tenant-mix tenant {name!r} declared twice")
+        mix.append((name, weight, rps, share))
+    if not mix:
+        raise ValueError(f"empty --tenant-mix {spec!r}")
+    return mix
+
+
+def _tenant_spec(args) -> str | None:
+    """The registry spec (``name=key:weight:rps``) the platform assembles
+    from, derived from ``--tenant-mix``."""
+    if not getattr(args, "tenant_mix", ""):
+        return None
+    return ",".join(f"{name}=key-{name}:{weight:g}:{rps:g}"
+                    for name, weight, rps, _ in
+                    _parse_tenant_mix(args.tenant_mix))
+
+
+def _tenant_drivers(args):
+    """``(tenant_headers_for, tenant_names)`` for the load client: each
+    POST draws a subscription key by the mix's share weights (seeded —
+    runs are reproducible); ``tenant_names`` maps key → tenant so the
+    client buckets its window per tenant."""
+    if not getattr(args, "tenant_mix", ""):
+        return None, None
+    import random as _random
+    rng = _random.Random(3)
+    mix = _parse_tenant_mix(args.tenant_mix)
+    names = [name for name, *_ in mix]
+    shares = [share for *_, share in mix]
+    keys = {name: f"key-{name}" for name in names}
+
+    def tenant_headers_for():
+        return {"Ocp-Apim-Subscription-Key":
+                keys[rng.choices(names, weights=shares)[0]]}
+
+    return tenant_headers_for, {keys[n]: n for n in names}
+
+
+def _tenancy_report(args, platform) -> dict:
+    """The bench artifact's tenancy block: the mix + the ai4e_tenant_*
+    series accumulated over the run (edge admissions/quota sheds, terminal
+    outcomes, charged cost, SLO burn) keyed per tenant."""
+    ten = getattr(platform, "tenancy", None)
+    if ten is None:
+        return {}
+    reg = platform.metrics
+
+    def counter_by_labels(name, keys, cast=int):
+        out = {}
+        for _, _, labels, v in reg.counter(name, "").collect():
+            out["/".join(labels.get(k, "") for k in keys)] = cast(v)
+        return out
+
+    names = [name for name, *_ in _parse_tenant_mix(args.tenant_mix)]
+    return {"tenancy": {
+        "tenant_mix": args.tenant_mix,
+        # Edge decisions and terminal outcomes by tenant; labels are the
+        # registry's bounded set (frozen top-N + "other"), never raw keys.
+        "admissions": counter_by_labels(
+            "ai4e_tenant_admissions_total", ("tenant", "decision")),
+        "outcomes": counter_by_labels(
+            "ai4e_tenant_outcomes_total", ("tenant", "outcome")),
+        "cost": counter_by_labels(
+            "ai4e_tenant_cost_total", ("tenant",),
+            cast=lambda v: round(float(v), 3)),
+        "slo_burn": {n: round(ten.accounting.burn_rate(n), 3)
+                     for n in names},
+    }}
+
+
 def _orchestration_report(args, platform) -> dict:
     """The bench artifact's orchestration block: placement outcomes,
     ladder posture, and brownout refusals accumulated over the run."""
@@ -685,6 +787,14 @@ def build_platform(args):
         # (no per-append fsync): the run measures keyspace partitioning,
         # not disk.
         task_shards=getattr(args, "task_shards", 1),
+        # --tenant-mix declares tenants (tenancy/, docs/tenancy.md):
+        # subscription keys resolve at the gateway edge, token-bucket
+        # quotas shed over-rate tenants with 429 + Retry-After, and the
+        # broker dequeues weighted-fair across per-tenant lanes. The
+        # result JSON gains a `tenancy` block (per-tenant admissions/
+        # outcomes/cost/burn) beside the client's by_tenant window.
+        tenancy=bool(getattr(args, "tenant_mix", "")),
+        tenancy_tenants=_tenant_spec(args),
         # --observability enables the hop ledger + flight recorder on
         # the control plane (observability/, docs/observability.md); the
         # batcher's device-phase decomposition + worker ledger flushes
@@ -1272,6 +1382,15 @@ async def run_bench(args) -> dict:
         # carries its budget + class; completions score goodput.
         headers_for, deadline_s = _admission_drivers(args)
 
+        # Tenant-mix drivers (--tenant-mix): each POST draws a
+        # subscription key by share; composes with the admission headers.
+        tenant_headers_for, tenant_names = _tenant_drivers(args)
+        if tenant_headers_for is not None:
+            def headers_for(_adm=headers_for, _ten=tenant_headers_for):
+                hdrs = _adm() if _adm is not None else {}
+                hdrs.update(_ten())
+                return hdrs
+
         # Closed loop with a steady-state ramp before the measured window
         # (shared with examples/loadgen.py — ai4e_tpu/utils/loadclient.py).
         window, _ = await asyncio.gather(run_closed_loop(
@@ -1281,7 +1400,8 @@ async def run_bench(args) -> dict:
             status_url_for=lambda tid: f"{gw}/v1/taskmanagement/task/{tid}",
             concurrency=args.concurrency, duration=args.duration,
             ramp=args.ramp, post_url_for=post_url_for,
-            headers_for=headers_for, deadline_s=deadline_s),
+            headers_for=headers_for, deadline_s=deadline_s,
+            tenant_names=tenant_names),
             _snap_cache_at_window_open())
         if shards > 1:
             watcher_task.cancel()
@@ -1357,6 +1477,12 @@ async def run_bench(args) -> dict:
             if key in window:
                 admission_meta["admission"][key] = window[key]
     orchestration_meta = _orchestration_report(args, platform)
+
+    tenancy_meta = _tenancy_report(args, platform)
+    if tenancy_meta and "by_tenant" in window:
+        # The client-observed window per tenant (offered/goodput/sheds as
+        # the load client scored them) rides beside the server counters.
+        tenancy_meta["tenancy"]["by_tenant_window"] = window["by_tenant"]
 
     cache_meta = {}
     if cache is not None:
@@ -1603,6 +1729,7 @@ async def run_bench(args) -> dict:
         **build_meta,
         **admission_meta,
         **orchestration_meta,
+        **tenancy_meta,
         **cache_meta,
         **shard_meta,
         **journal_meta,
@@ -2088,6 +2215,8 @@ def _forward_argv(args) -> list[str]:
             "--deadline-ms", str(args.deadline_ms),
             *(["--priority-mix", args.priority_mix]
               if args.priority_mix else []),
+            *(["--tenant-mix", args.tenant_mix]
+              if getattr(args, "tenant_mix", "") else []),
             "--buckets", *[str(b) for b in args.buckets]]
 
 
@@ -2281,6 +2410,18 @@ def main() -> None:
                              "enables admission control; under saturation "
                              "the shedder refuses lowest class first. "
                              "Empty (default) = unlabeled traffic")
+    parser.add_argument("--tenant-mix", default="",
+                        help="declared tenants + per-request key draw, "
+                             "e.g. 'paid=3:50,trial=1:5' "
+                             "(name=weight:rps[:share]) — enables "
+                             "multi-tenancy (docs/tenancy.md): gateway-"
+                             "edge key resolution, token-bucket quotas "
+                             "(429 + Retry-After over rate), weighted-"
+                             "fair broker lanes, per-tenant accounting. "
+                             "share defaults to weight; keys are "
+                             "synthesized as key-<name>. The JSON gains "
+                             "a 'tenancy' block and a per-tenant client "
+                             "window. Empty (default) = tenancy off")
     parser.add_argument("--pipeline", action="store_true",
                         help="declared-DAG preset (docs/pipelines.md): a "
                              "2-stage echo chain executed by the pipeline "

@@ -65,10 +65,19 @@ class AI4EClient:
                  timeout: float = 60.0, retries: int = 4,
                  retry_backoff: float = 1.0):
         """``retries``: transparent retries of backpressure responses —
-        429 (per-key rate limit, honoring the gateway's ``Retry-After``
-        delta-seconds) and 503 (admission backpressure) — with exponential
-        backoff when no Retry-After is given. 0 disables (the raw
-        HTTPError surfaces).
+        429 (per-key rate limit or the tenant's own quota bucket, honoring
+        the gateway's ``Retry-After`` delta-seconds) and 503 (admission
+        backpressure) — with exponential backoff when no Retry-After is
+        given. 0 disables (the raw HTTPError surfaces).
+
+        On a multi-tenant platform (``docs/tenancy.md``) ``api_key`` IS
+        the tenant identity: the gateway resolves it to a tenant once at
+        the edge, meters the tenant's quota, and schedules the tenant's
+        fair share — nothing else to configure client-side. A quota 429's
+        ``Retry-After`` is derived from the tenant's own bucket refill;
+        check ``last_shed_reason`` (the most recent backpressure
+        response's ``X-Shed-Reason``, e.g. ``gateway/tenant-quota``) to
+        tell your own quota from platform-wide pressure.
 
         ``gateway`` may be a LIST of gateway URLs (the control-plane HA
         pair, primary first): a dead replica (connection refused/reset)
@@ -94,6 +103,11 @@ class AI4EClient:
         # X-Cache of the most recent submit/call_sync response (None when
         # the gateway runs without a result cache).
         self.last_cache_status: str | None = None
+        # X-Shed-Reason of the most recent backpressure (429/503) response
+        # this client absorbed or surfaced — ``gateway/tenant-quota`` means
+        # the caller's own tenant bucket refused it (docs/tenancy.md),
+        # anything else is platform pressure. None until a shed happens.
+        self.last_shed_reason: str | None = None
 
     # -- transport ---------------------------------------------------------
 
@@ -161,6 +175,7 @@ class AI4EClient:
                         self.gateway = base  # it answered; it is the one
                         raise
                     backpressure = exc
+                    self.last_shed_reason = exc.headers.get("X-Shed-Reason")
                     break  # backpressure: do NOT fan out to the peer
                 except (urllib.error.URLError, OSError) as exc:
                     if len(ordered) == 1:

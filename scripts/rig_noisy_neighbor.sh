@@ -1,0 +1,67 @@
+#!/bin/bash
+# Noisy-neighbor isolation on the REAL multi-process rig
+# (docs/tenancy.md, docs/deployment.md): three tenants, one loadgen
+# process each, through the balancer → gateway replicas → sharded
+# stores. Two seeded runs:
+#
+#   baseline  — every tenant offers at rated (just under its quota);
+#   flood     — the noisy tenant offers 10×, victims unchanged.
+#
+# Each gateway replica enforces the token-bucket locally (fleet ceiling
+# = gateways × rps, the per-instance semantic docs/tenancy.md states),
+# and every shard broker dequeues weighted-fair across tenant lanes.
+# Read the per-loadgen artifacts: the victims' windows must show ZERO
+# `tenant_quota_429`s and a flat achieved rate across both runs, while
+# the flood run's noisy window eats every quota shed — with the
+# cross-process invariant verdict (0 lost, 0 duplicate) green in both.
+#
+#   scripts/rig_noisy_neighbor.sh [outdir]       (default: /tmp/ai4e-rig-nn)
+#
+# The in-process twin of this scenario (single pytest, tighter
+# assertions) is tests/test_tenancy_chaos.py — `make chaos`.
+set -eu
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD:${PYTHONPATH:-}"
+
+OUT="${1:-/tmp/ai4e-rig-nn}"
+SEED="${AI4E_CHAOS_SEED:-20260803}"
+# Provisioning rule (docs/tenancy.md): a quota only isolates if the
+# fleet ceiling it grants — gateways × rps, summed over tenants — fits
+# inside platform capacity. This shared 2-core box sustains ~70 req/s
+# end-to-end, so 3 tenants × 2 gateways × 15 rps = 90 admitted-ceiling
+# is already generous; a tenant's flood can then never admit enough
+# work to starve the others' rated streams.
+RATED=15          # contracted rps per tenant PER GATEWAY REPLICA
+OFFER=12          # rated offered rps — just under the bucket
+TENANTS="noisy=key-noisy:1:${RATED}:15,victim1=key-v1:1:${RATED}:15,victim2=key-v2:1:${RATED}:15"
+
+run () {  # $1 = label, $2 = noisy tenant's offered rps
+  python -m ai4e_tpu.rig up --gateways 2 --shards 2 --replicas 1 \
+    --dispatchers 1 --workers 1 --loadgens 3 --rate 36 \
+    --duration 15 --ramp 3 --task-timeout 45 --seed "$SEED" \
+    --no-chaos \
+    --tenants "$TENANTS" \
+    --loadgen-tenants "[
+      {\"name\": \"noisy\",   \"key\": \"key-noisy\", \"rate\": $2},
+      {\"name\": \"victim1\", \"key\": \"key-v1\",    \"rate\": $OFFER},
+      {\"name\": \"victim2\", \"key\": \"key-v2\",    \"rate\": $OFFER}]" \
+    --workdir "/tmp/ai4e-rig-nn-work" --out "$OUT/$1"
+}
+
+run baseline "$OFFER"
+run flood    "$((OFFER * 10))"
+
+python - "$OUT" <<'EOF'
+import json, sys
+out = sys.argv[1]
+for label in ("baseline", "flood"):
+    rig = json.load(open(f"{out}/{label}/rig.json"))
+    print(f"{label}: ok={rig['ok']}")
+    for w in rig["verdict"]["windows"]:
+        win = w["window"]
+        errors = win.get("total_errors", {})
+        print(f"  {w.get('tenant', w['loadgen']):>8}: "
+              f"offered {win['offered_rate']:.0f}/s "
+              f"achieved {win['achieved_rate']:.0f}/s "
+              f"quota_429={errors.get('tenant_quota_429', 0)}")
+EOF

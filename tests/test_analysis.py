@@ -1568,3 +1568,96 @@ class TestStaticBucketLadder:
             LEGACY = (1, 2, 4)  # ai4e: noqa[AIL012] — fixture for the migration test
         """, "ai4e_tpu/runtime/fixture.py")
         assert findings == []
+
+
+# -- AIL013 unbounded-metric-label -------------------------------------------
+
+
+class TestUnboundedMetricLabel:
+    """An identity-class metric label fed a dynamic value is a finding —
+    caller identity must pass through a bounded-cardinality mapper
+    (``TenantRegistry.tenant_label``, docs/tenancy.md) before it becomes
+    a series dimension."""
+
+    def _run(self, tmp_path, source, filename="ai4e_tpu/svc/mod.py"):
+        from ai4e_tpu.analysis.rules.metric_label import \
+            UnboundedMetricLabel
+        return run_rule(tmp_path, UnboundedMetricLabel(), source,
+                        filename=filename)
+
+    def test_true_positive_raw_tenant_id(self, tmp_path):
+        findings = self._run(tmp_path, """
+            def note(counter, tenant_id):
+                counter.inc(tenant=tenant_id)
+        """)
+        assert [f.rule for f in findings] == ["AIL013"]
+        assert "tenant=" in findings[0].message
+
+    def test_true_positive_header_read(self, tmp_path):
+        # The nightmare shape: one rotated header per request = one fresh
+        # series per request.
+        findings = self._run(tmp_path, """
+            def note(counter, request):
+                counter.inc(api_key=request.headers.get("X-Api-Key"))
+        """)
+        assert [f.rule for f in findings] == ["AIL013"]
+
+    def test_observe_and_set_flagged_too(self, tmp_path):
+        findings = self._run(tmp_path, """
+            def note(hist, gauge, caller_id):
+                hist.observe(0.5, caller=caller_id)
+                gauge.set(1.0, client_id=caller_id)
+        """)
+        assert sorted(f.rule for f in findings) == ["AIL013", "AIL013"]
+
+    def test_blessed_inline_mapper_call(self, tmp_path):
+        findings = self._run(tmp_path, """
+            def note(counter, registry, tenant_id):
+                counter.inc(tenant=registry.tenant_label(tenant_id))
+        """)
+        assert findings == []
+
+    def test_blessed_label_named_variable(self, tmp_path):
+        # The two-line idiom: map first, label with the mapped value.
+        findings = self._run(tmp_path, """
+            def note(counter, registry, tenant_id):
+                label = registry.tenant_label(tenant_id)
+                counter.inc(tenant=label)
+        """)
+        assert findings == []
+
+    def test_blessed_string_constant(self, tmp_path):
+        findings = self._run(tmp_path, """
+            def note(counter):
+                counter.inc(tenant="other")
+        """)
+        assert findings == []
+
+    def test_non_identity_kwarg_not_flagged(self, tmp_path):
+        findings = self._run(tmp_path, """
+            def note(counter, route_prefix):
+                counter.inc(route=route_prefix, outcome="200")
+        """)
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        findings = self._run(tmp_path, """
+            def note(counter, tenant_id):
+                counter.inc(tenant=tenant_id)  # ai4e: noqa[AIL013] — bounded upstream by construction
+        """)
+        assert findings == []
+
+    def test_whole_repo_clean(self):
+        """The real tree ships with zero findings — the tenancy layer was
+        born using the bounded mapper (the gate CI now enforces)."""
+        from ai4e_tpu.analysis.rules.metric_label import \
+            UnboundedMetricLabel
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pkg = os.path.join(root, "ai4e_tpu")
+        paths = []
+        for dirpath, _dirs, files in os.walk(pkg):
+            paths.extend(os.path.join(dirpath, f)
+                         for f in files if f.endswith(".py"))
+        result = Analyzer([UnboundedMetricLabel()],
+                          root=root).run(sorted(paths))
+        assert [f.render() for f in result.findings] == []

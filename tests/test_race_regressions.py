@@ -44,7 +44,7 @@ from ai4e_tpu.analysis.race import (TracedTaskManager, explore_interleavings,
                                     yield_point)
 from ai4e_tpu.broker.dispatcher import AWAITING_STATUS, Dispatcher
 from ai4e_tpu.broker.push import PushEvent, WebhookDispatcher
-from ai4e_tpu.broker.queue import InMemoryBroker
+from ai4e_tpu.broker.queue import EndpointQueue, InMemoryBroker, Message
 from ai4e_tpu.metrics.registry import MetricsRegistry
 from ai4e_tpu.rescache.cache import ResultCache
 from ai4e_tpu.resilience.breaker import CircuitBreaker
@@ -1402,3 +1402,109 @@ class TestDecodeSlotConservation:
         assert any("Slot" in type(r.error).__name__
                    or "released" in str(r.error)
                    for r in report.failures), report.describe()
+
+
+# ---------------------------------------------------------------------------
+# PR 16: weighted-fair dequeue vs concurrent tenant weight update
+# ---------------------------------------------------------------------------
+
+class _SnapshotRebuildQueue(EndpointQueue):
+    """The rejected reweight design, kept as the broken replica: apply a
+    tenant weight change by snapshotting the per-tenant lanes, publishing
+    the new policy (an await — the config push a multi-process deployment
+    would make), then reinstalling rebuilt lanes. Any ``put`` that lands
+    inside the publish window is clobbered by the stale snapshot: its seq
+    stays in ``_ready_seqs`` but its message object is gone from every
+    lane, so it is never delivered again — a silently lost task. The
+    shipped design has no such window: ``TenantRegistry.set_weight`` is
+    one dict write and ``_pop_fair`` reads the LIVE weight at every ring
+    visit, so a reweight needs no queue surgery at all."""
+
+    async def apply_weights(self, registry, tenant_id, weight) -> None:
+        from collections import deque as _deque
+        snapshot = {k: list(v) for k, v in self._lanes.items()}
+        registry.set_weight(tenant_id, weight)
+        await yield_point()  # the policy publish hop
+        self._lanes = {k: _deque(v) for k, v in snapshot.items() if v}
+        self._ring = _deque(self._lanes.keys())
+        self._deficit = {}
+
+
+class TestTenantFairDequeueVsWeightUpdate:
+    """PR 16's DRR lanes under a concurrent operator reweight: producers
+    for two tenants, a consumer draining by deficit round-robin, and an
+    updater changing tenant ``a``'s weight mid-stream. The shipped
+    live-read design delivers every message exactly once under every
+    schedule and the deficit counters conserve (never negative, bounded
+    by ``_DRR_COST`` + the largest quantum). The snapshot-rebuild replica
+    loses concurrently-enqueued messages inside its publish window."""
+
+    @staticmethod
+    def _scenario(rebuild: bool):
+        from ai4e_tpu.tenancy import Tenancy
+
+        def make():
+            tenancy = Tenancy.from_spec("a=ka:1,b=kb:1")
+            cls = _SnapshotRebuildQueue if rebuild else EndpointQueue
+            q = cls("/v1/q", fair=tenancy.lanes)
+            seqs_a, seqs_b = (1, 2, 3), (10, 11)
+            delivered: list[int] = []
+
+            def _put(seq, tenant):
+                q.put(Message(task_id=f"{tenant}{seq}", endpoint="/v1/q",
+                              seq=seq, tenant=tenant))
+
+            async def producer_a():
+                for seq in seqs_a:
+                    _put(seq, "a")
+                    await yield_point()
+
+            async def producer_b():
+                for seq in seqs_b:
+                    _put(seq, "b")
+                    await yield_point()
+
+            async def consumer():
+                for _ in range(len(seqs_a) + len(seqs_b)):
+                    msg = await q.receive(timeout=5.0)
+                    assert msg is not None, (
+                        "an enqueued message was never delivered — the "
+                        "reweight lost it")
+                    delivered.append(msg.seq)
+                    q.complete(msg)
+
+            async def updater():
+                await yield_point()
+                if rebuild:
+                    await q.apply_weights(tenancy.registry, "a", 4.0)
+                else:
+                    # Shipped path: one synchronous dict write; the very
+                    # next _pop_fair ring visit reads the new quantum.
+                    tenancy.registry.set_weight("a", 4.0)
+
+            def check():
+                assert sorted(delivered) == sorted(seqs_a + seqs_b), (
+                    f"exactly-once broken: delivered {sorted(delivered)}")
+                for key, credit in q.deficits().items():
+                    assert 0.0 <= credit < 1.0 + 4.0, (
+                        f"deficit for lane {key!r} escaped its bound: "
+                        f"{credit}")
+                assert q.lane_depths() == {}
+
+            return ([producer_a(), producer_b(), consumer(), updater()],
+                    check)
+
+        return make
+
+    def test_live_weight_read_race_free(self):
+        report = explore_interleavings(self._scenario(rebuild=False),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_snapshot_rebuild_replica_caught(self):
+        report = explore_interleavings(self._scenario(rebuild=True),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert not report.ok, (
+            "the snapshot-rebuild lost-put window was not reachable — "
+            "either the replica stopped rebuilding across an await or "
+            "the schedule budget is too small")

@@ -1,0 +1,562 @@
+"""Multi-tenancy tests (``ai4e_tpu/tenancy/``, docs/tenancy.md): the
+registry's key→tenant resolution and FROZEN bounded-cardinality label;
+per-tenant token-bucket quotas with the rate-limiter's burst/retry
+arithmetic; the broker's deficit-round-robin lanes (ratio fairness,
+flood isolation, no banking, live reweights); per-tenant accounting off
+the store change feed; the gateway's tenant-quota 429 path; and
+``tenancy=False`` leaving every pre-tenancy behavior untouched —
+assembly attributes, route table, and ``/metrics`` exposition."""
+
+import asyncio
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.broker.queue import EndpointQueue, InMemoryBroker, Message
+from ai4e_tpu.metrics import MetricsRegistry
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.taskstore import APITask, InMemoryTaskStore, TaskStatus
+from ai4e_tpu.tenancy import (DEFAULT_TENANT, OTHER_LABEL, Tenancy, Tenant,
+                              TenantLanes, TenantQuota, TenantRegistry,
+                              parse_tenants)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def _msg(seq, tenant="", task_id=None):
+    return Message(task_id=task_id or f"t{seq}", endpoint="/v1/q",
+                   seq=seq, tenant=tenant)
+
+
+# ---------------------------------------------------------------------------
+# Registry: spec parsing, resolution, frozen bounded label
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_parse_spec_full_and_defaulted_fields(self):
+        tenants = parse_tenants("alpha=key-a1|key-a2:4:50:100,beta=key-b:2",
+                                default_rps=7.0)
+        a, b = tenants
+        assert a.tenant_id == "alpha" and a.keys == ("key-a1", "key-a2")
+        assert (a.weight, a.rps, a.burst) == (4.0, 50.0, 100.0)
+        assert (b.weight, b.rps, b.burst) == (2.0, 7.0, 0.0)
+
+    def test_parse_spec_malformed_fails_loudly(self):
+        with pytest.raises(ValueError, match="expected name="):
+            parse_tenants("justaname")
+        with pytest.raises(ValueError, match="no subscription keys"):
+            parse_tenants("a=")
+        with pytest.raises(ValueError, match="declared twice"):
+            parse_tenants("a=k1,a=k2")
+        with pytest.raises(ValueError, match="two tenants"):
+            parse_tenants("a=k,b=k")
+        with pytest.raises(ValueError, match="not a number"):
+            parse_tenants("a=k:heavy")
+        with pytest.raises(ValueError, match="weight must be"):
+            parse_tenants("a=k:0")
+
+    def test_resolution_known_unknown_none(self):
+        reg = TenantRegistry(parse_tenants("a=ka:3,b=kb"))
+        assert reg.resolve("ka").tenant_id == "a"
+        assert reg.resolve("nope").tenant_id == DEFAULT_TENANT
+        assert reg.resolve(None).tenant_id == DEFAULT_TENANT
+
+    def test_default_tenant_carries_configured_policy(self):
+        reg = TenantRegistry([], default_weight=2.0, default_rps=5.0)
+        t = reg.resolve(None)
+        assert t.weight == 2.0 and t.rps == 5.0
+        assert reg.weight("") == 2.0  # the shared default lane's weight
+
+    def test_bucket_capacity_burst_rule_matches_rate_limiter(self):
+        # burst 0 → max(2*rps, 1), same convention as gateway/ratelimit.py.
+        assert Tenant("t", rps=10.0).bucket_capacity() == 20.0
+        assert Tenant("t", rps=0.2).bucket_capacity() == 1.0
+        assert Tenant("t", rps=10.0, burst=5.0).bucket_capacity() == 5.0
+
+    def test_label_frozen_top_n_plus_other(self):
+        reg = TenantRegistry(parse_tenants("a=ka,b=kb,c=kc"), label_top_n=2)
+        assert reg.tenant_label("a") == "a"
+        assert reg.tenant_label("b") == "b"
+        assert reg.tenant_label("c") == OTHER_LABEL
+        assert reg.tenant_label("never-seen") == OTHER_LABEL
+        assert reg.tenant_label(DEFAULT_TENANT) == OTHER_LABEL
+
+    def test_label_set_does_not_grow_with_live_updates(self):
+        # FROZEN at construction: a tenant registered later never steals a
+        # label slot — a scrape series must not flip identity mid-run.
+        reg = TenantRegistry(parse_tenants("a=ka"), label_top_n=8)
+        reg.update(Tenant("late", keys=("kl",)))
+        assert reg.resolve("kl").tenant_id == "late"
+        assert reg.tenant_label("late") == OTHER_LABEL
+
+    def test_update_replaces_row_and_set_weight_lives(self):
+        reg = TenantRegistry(parse_tenants("a=ka:1:10"))
+        reg.set_weight("a", 9.0)
+        assert reg.weight("a") == 9.0
+        assert reg.resolve("ka").rps == 10.0  # other fields kept
+
+    def test_update_refuses_key_theft(self):
+        reg = TenantRegistry(parse_tenants("a=ka,b=kb"))
+        with pytest.raises(ValueError, match="already belongs"):
+            reg.update(Tenant("b", keys=("ka",)))
+
+
+# ---------------------------------------------------------------------------
+# Quota: token buckets with live policy reads
+# ---------------------------------------------------------------------------
+
+class TestQuota:
+    def _clock(self):
+        state = {"t": 100.0}
+        return state, (lambda: state["t"])
+
+    def test_burst_then_refusal_then_refill(self):
+        reg = TenantRegistry(parse_tenants("a=ka:1:2:3"))  # 2 rps, burst 3
+        state, now = self._clock()
+        q = TenantQuota(reg, now=now)
+        assert [q.admit("a")[0] for _ in range(3)] == [True] * 3
+        allowed, retry = q.admit("a")
+        assert not allowed
+        assert retry == pytest.approx(0.5)  # 1 token / 2 rps
+        state["t"] += 0.6
+        assert q.admit("a")[0]
+
+    def test_zero_rps_is_unlimited(self):
+        reg = TenantRegistry(parse_tenants("a=ka"))
+        q = TenantQuota(reg)
+        assert all(q.admit("a") == (True, 0.0) for _ in range(100))
+        assert q.admit(DEFAULT_TENANT) == (True, 0.0)
+
+    def test_policy_update_takes_effect_without_rebuild(self):
+        reg = TenantRegistry(parse_tenants("a=ka:1:1:1"))
+        state, now = self._clock()
+        q = TenantQuota(reg, now=now)
+        assert q.admit("a")[0]
+        assert not q.admit("a")[0]
+        # Operator raises the contract live; the very next refill obeys it.
+        reg.update(Tenant("a", rps=100.0, burst=100.0, keys=("ka",)))
+        state["t"] += 1.0
+        assert [q.admit("a")[0] for _ in range(50)] == [True] * 50
+
+    def test_idle_buckets_pruned(self):
+        reg = TenantRegistry(parse_tenants("a=ka:1:5"))
+        state, now = self._clock()
+        q = TenantQuota(reg, now=now)
+        q.admit("a")
+        state["t"] += 120.0
+        q.admit("a")  # triggers the prune pass (interval elapsed, full again)
+        assert len(q._buckets) <= 1
+
+
+# ---------------------------------------------------------------------------
+# DRR lanes: ratio fairness, isolation, no banking, live reweights
+# ---------------------------------------------------------------------------
+
+class TestFairDequeue:
+    def _fair(self, spec, **kw):
+        return Tenancy.from_spec(spec, **kw).lanes
+
+    def _drain(self, q, n):
+        async def main():
+            out = []
+            for _ in range(n):
+                m = await q.receive(timeout=0.2)
+                assert m is not None
+                out.append(m)
+                q.complete(m)
+            return out
+        return run(main())
+
+    def test_service_ratio_follows_weights(self):
+        q = EndpointQueue("/q", fair=self._fair("a=ka:3,b=kb:1"))
+        seq = 0
+        for tenant in ("a",) * 40 + ("b",) * 40:
+            seq += 1
+            q.put(_msg(seq, tenant))
+        got = self._drain(q, 40)
+        counts = {"a": 0, "b": 0}
+        for m in got:
+            counts[m.tenant] += 1
+        assert counts == {"a": 30, "b": 10}  # exactly weight/Σweights
+
+    def test_flooded_lane_cannot_starve_another(self):
+        # The noisy-neighbor kernel: 500 queued for the flood tenant, 1
+        # for the victim — the victim's message is served within one DRR
+        # round, not after the backlog.
+        q = EndpointQueue("/q", fair=self._fair("noisy=kn:1,victim=kv:1"))
+        for seq in range(1, 501):
+            q.put(_msg(seq, "noisy"))
+        q.put(_msg(999, "victim"))
+        got = self._drain(q, 4)
+        assert "victim" in [m.tenant for m in got[:2]]
+
+    def test_fifo_order_within_a_lane(self):
+        q = EndpointQueue("/q", fair=self._fair("a=ka"))
+        for seq in (1, 2, 3):
+            q.put(_msg(seq, "a"))
+        assert [m.seq for m in self._drain(q, 3)] == [1, 2, 3]
+
+    def test_deficit_reset_on_empty_no_banking(self):
+        # An idle tenant must not bank scheduling credit: drain its lane,
+        # and its deficit entry is gone.
+        q = EndpointQueue("/q", fair=self._fair("a=ka:5,b=kb:1"))
+        q.put(_msg(1, "a"))
+        q.put(_msg(2, "b"))
+        self._drain(q, 2)
+        assert q.lane_depths() == {}
+        # Emptied lanes keep no spendable credit (cleanup is lazy, so a
+        # just-served lane may linger at < one service cost until the
+        # next visit drops it — but never a full serve's worth).
+        assert all(credit < 1.0 for credit in q.deficits().values())
+        # And once the lane is revisited empty, its state is forgotten:
+        q.put(_msg(3, "a"))
+        self._drain(q, 1)
+        assert "b" not in q.deficits()
+
+    def test_deficits_bounded_and_nonnegative(self):
+        lanes = self._fair("a=ka:4,b=kb:1")
+        q = EndpointQueue("/q", fair=lanes)
+        for seq in range(1, 61):
+            q.put(_msg(seq, "a" if seq % 3 else "b"))
+        self._drain(q, 30)
+        for credit in q.deficits().values():
+            assert 0.0 <= credit < 1.0 + 4.0  # cost + max quantum
+
+    def test_live_reweight_shifts_the_ratio(self):
+        t = Tenancy.from_spec("a=ka:1,b=kb:1")
+        q = EndpointQueue("/q", fair=t.lanes)
+        seq = 0
+        for tenant in ("a",) * 60 + ("b",) * 60:
+            seq += 1
+            q.put(_msg(seq, tenant))
+        first = self._drain(q, 20)
+        assert sum(1 for m in first if m.tenant == "a") == 10  # 1:1
+        t.registry.set_weight("a", 3.0)  # live — no queue rebuild
+        second = self._drain(q, 20)
+        assert sum(1 for m in second if m.tenant == "a") == 15  # 3:1
+
+    def test_tenantless_messages_share_the_default_lane(self):
+        q = EndpointQueue("/q", fair=self._fair("a=ka:1"))
+        q.put(_msg(1, ""))
+        q.put(_msg(2, "a"))
+        got = self._drain(q, 2)
+        assert {m.seq for m in got} == {1, 2}
+        assert q.lane_depths() == {}
+
+    def test_retracted_seq_skipped_inside_lane(self):
+        # complete() after lease expiry retracts a seq; the lane's lazy
+        # skip must drop it exactly like the FIFO path does.
+        q = EndpointQueue("/q", lease_seconds=0.01,
+                          fair=self._fair("a=ka"))
+
+        async def main():
+            q.put(_msg(1, "a"))
+            m1 = await q.receive(timeout=0.2)
+            await asyncio.sleep(0.05)       # lease expires
+            q._reap_expired_leases()        # reaper requeues seq 1
+            q.complete(m1)                  # late complete → retraction
+            q.put(_msg(2, "a"))
+            m = await q.receive(timeout=0.2)
+            assert m.seq == 2               # seq 1 never redelivered
+            assert await q.receive(timeout=0.05) is None
+        run(main())
+
+    def test_lease_expiry_redelivers_into_the_lane(self):
+        q = EndpointQueue("/q", lease_seconds=0.01,
+                          fair=self._fair("a=ka"))
+
+        async def main():
+            q.put(_msg(1, "a"))
+            m1 = await q.receive(timeout=0.2)
+            assert m1.delivery_count == 1
+            await asyncio.sleep(0.05)
+            m2 = await q.receive(timeout=0.5)
+            assert m2.seq == 1 and m2.delivery_count == 2
+        run(main())
+
+    def test_broker_publish_stamps_tenant_and_lanes_per_queue(self):
+        t = Tenancy.from_spec("a=ka:2,b=kb:1")
+        broker = InMemoryBroker(metrics=MetricsRegistry(), fair=t.lanes)
+        broker.register_queue("/v1/q")
+        broker.publish(APITask(task_id="x", endpoint="/v1/q", tenant="a"))
+        q = broker.queue("/v1/q")
+        assert q.fair is t.lanes
+        assert q.lane_depths() == {"a": 1}
+
+        async def main():
+            m = await broker.receive("/v1/q", timeout=0.2)
+            assert m.tenant == "a"
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# Accounting: outcome feed, burn windows, bounded series
+# ---------------------------------------------------------------------------
+
+class TestAccounting:
+    def _tenancy(self, spec="a=ka,b=kb", **kw):
+        reg = MetricsRegistry()
+        return Tenancy.from_spec(spec, metrics=reg, **kw), reg
+
+    def _outcome(self, reg, **labels):
+        return reg.counter("ai4e_tenant_outcomes_total").value(**labels)
+
+    def test_store_feed_labels_outcomes_per_tenant(self):
+        t, reg = self._tenancy()
+        store = InMemoryTaskStore()
+        t.attach_store(store)
+        ok = store.upsert(APITask(endpoint="/v1/q", tenant="a"))
+        store.update_status(ok.task_id, TaskStatus.COMPLETED)
+        bad = store.upsert(APITask(endpoint="/v1/q", tenant="b"))
+        store.update_status(bad.task_id, TaskStatus.FAILED)
+        assert self._outcome(reg, tenant="a", outcome="ok") == 1
+        assert self._outcome(reg, tenant="b", outcome="failed") == 1
+
+    def test_late_completion_counts_late_not_ok(self):
+        t, reg = self._tenancy()
+        store = InMemoryTaskStore()
+        t.attach_store(store)
+        task = store.upsert(APITask(endpoint="/v1/q", tenant="a",
+                                    deadline_at=time.time() - 1.0))
+        store.update_status(task.task_id, TaskStatus.COMPLETED)
+        assert self._outcome(reg, tenant="a", outcome="late") == 1
+
+    def test_labels_are_bounded_never_raw_ids(self):
+        t, reg = self._tenancy("a=ka,b=kb", label_top_n=1)
+        store = InMemoryTaskStore()
+        t.attach_store(store)
+        for tenant in ("a", "b", "who-is-this"):
+            task = store.upsert(APITask(endpoint="/v1/q", tenant=tenant))
+            store.update_status(task.task_id, TaskStatus.COMPLETED)
+        text = reg.render_prometheus()
+        assert 'tenant="a"' in text
+        assert 'tenant="b"' not in text           # outside frozen top-1
+        assert "who-is-this" not in text          # unknown id never a label
+        assert self._outcome(reg, tenant=OTHER_LABEL, outcome="ok") == 2
+
+    def test_quota_shed_burns_only_the_shedding_tenant(self):
+        t, _reg = self._tenancy(goodput_target=0.9)
+        for _ in range(5):
+            t.note_quota_shed("a")
+        assert t.accounting.burn_rate("a") > 1.0   # all-bad window
+        assert t.accounting.burn_rate("b") == 0.0  # victims untouched
+
+    def test_cost_charge_accumulates_per_tenant(self):
+        t, reg = self._tenancy()
+        t.charge("a", 2.5)
+        t.charge("a", 1.5)
+        t.charge("b", 0.0)  # zero-cost backends charge nothing
+        cost = reg.counter("ai4e_tenant_cost_total")
+        assert cost.value(tenant="a") == 4.0
+        assert cost.value(tenant="b") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Gateway edge: tenant resolution + quota 429 path
+# ---------------------------------------------------------------------------
+
+class TestGatewayEdge:
+    def _platform(self, **cfg):
+        defaults = dict(tenancy=True,
+                        tenancy_tenants="paid=key-paid:4:100,"
+                                        "trial=key-trial:1:2:2")
+        defaults.update(cfg)
+        return LocalPlatform(PlatformConfig(**defaults),
+                             metrics=MetricsRegistry())
+
+    def test_resolved_tenant_rides_the_task_record(self):
+        async def main():
+            platform = self._platform()
+            platform.gateway.set_api_keys({"key-paid", "key-trial"})
+            platform.publish_async_api("/v1/api/run",
+                                       backend_uri="http://127.0.0.1:9/v1/b")
+            client = await serve(platform.gateway.app)
+            try:
+                resp = await client.post(
+                    "/v1/api/run", data=b"{}",
+                    headers={"Ocp-Apim-Subscription-Key": "key-paid"})
+                assert resp.status == 200
+                tid = (await resp.json())["TaskId"]
+                assert platform.store.get(tid).tenant == "paid"
+            finally:
+                await client.close()
+        run(main())
+
+    def test_over_quota_tenant_sheds_with_retry_after_and_reason(self):
+        async def main():
+            platform = self._platform()
+            platform.gateway.set_api_keys({"key-paid", "key-trial"})
+            platform.publish_async_api("/v1/api/run",
+                                       backend_uri="http://127.0.0.1:9/v1/b")
+            client = await serve(platform.gateway.app)
+            try:
+                statuses = []
+                for _ in range(6):  # trial: 2 rps, burst 2
+                    resp = await client.post(
+                        "/v1/api/run", data=b"{}",
+                        headers={"Ocp-Apim-Subscription-Key": "key-trial"})
+                    statuses.append(resp.status)
+                    if resp.status == 429:
+                        assert int(resp.headers["Retry-After"]) >= 1
+                        assert "tenant-quota" in resp.headers["X-Shed-Reason"]
+                        assert "tenant quota" in (await resp.json())["error"]
+                assert statuses.count(429) == 4
+                # The flooded tenant's shed never touches the other lane:
+                resp = await client.post(
+                    "/v1/api/run", data=b"{}",
+                    headers={"Ocp-Apim-Subscription-Key": "key-paid"})
+                assert resp.status == 200
+            finally:
+                await client.close()
+        run(main())
+
+    def test_status_polls_are_not_metered(self):
+        async def main():
+            platform = self._platform()
+            platform.gateway.set_api_keys({"key-paid", "key-trial"})
+            platform.publish_async_api("/v1/api/run",
+                                       backend_uri="http://127.0.0.1:9/v1/b")
+            client = await serve(platform.gateway.app)
+            try:
+                resp = await client.post(
+                    "/v1/api/run", data=b"{}",
+                    headers={"Ocp-Apim-Subscription-Key": "key-trial"})
+                assert resp.status == 200
+                tid = (await resp.json())["TaskId"]
+                # Polling costs no quota: far more polls than the bucket
+                # holds, all 200.
+                for _ in range(10):
+                    resp = await client.get(
+                        f"/v1/taskmanagement/task/{tid}",
+                        headers={"Ocp-Apim-Subscription-Key": "key-trial"})
+                    assert resp.status == 200
+            finally:
+                await client.close()
+        run(main())
+
+    def test_auth_off_resolves_the_default_tenant(self):
+        async def main():
+            platform = self._platform(
+                tenancy_tenants=None, tenancy_default_rps=1.0,
+                tenancy_default_burst=1.0)
+            platform.publish_async_api("/v1/api/run",
+                                       backend_uri="http://127.0.0.1:9/v1/b")
+            client = await serve(platform.gateway.app)
+            try:
+                first = await client.post("/v1/api/run", data=b"{}")
+                assert first.status == 200
+                tid = (await first.json())["TaskId"]
+                assert platform.store.get(tid).tenant == DEFAULT_TENANT
+                second = await client.post("/v1/api/run", data=b"{}")
+                assert second.status == 429  # shared default bucket drained
+            finally:
+                await client.close()
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# Assembly wiring: off byte-identical, on fully threaded, refusals
+# ---------------------------------------------------------------------------
+
+class TestAssemblyWiring:
+    def test_off_by_default_byte_identical(self):
+        platform = LocalPlatform(PlatformConfig(),
+                                 metrics=MetricsRegistry())
+        assert platform.tenancy is None
+        assert platform.gateway._tenancy is None
+        assert platform.dispatchers.tenancy is None
+        assert platform.broker._fair is None
+        platform.broker.register_queue("/v1/q")
+        q = platform.broker.queue("/v1/q")
+        assert q.fair is None and q._lanes == {} and q._ring == q._ring.__class__()
+        # No tenant series exists with the layer off — the /metrics
+        # exposition is unchanged (same discipline as every opt-in layer).
+        assert "ai4e_tenant_" not in platform.metrics.render_prometheus()
+        # The task wire shape is unchanged too.
+        assert "Tenant" not in APITask(endpoint="/v1/q").to_dict()
+
+    def test_on_threads_every_layer(self):
+        platform = LocalPlatform(
+            PlatformConfig(tenancy=True, tenancy_tenants="a=ka:2:10"),
+            metrics=MetricsRegistry())
+        assert platform.tenancy is not None
+        assert platform.gateway._tenancy is platform.tenancy
+        assert platform.dispatchers.tenancy is platform.tenancy
+        assert platform.broker._fair is platform.tenancy.lanes
+        d = platform.dispatchers.register("/v1/q", "http://h/v1/q")
+        assert d.tenancy is platform.tenancy
+        q = platform.broker.queue("/v1/q")
+        assert q.fair is platform.tenancy.lanes
+
+    def test_sharded_sub_queues_get_lanes_too(self):
+        platform = LocalPlatform(
+            PlatformConfig(tenancy=True, task_shards=2,
+                           tenancy_tenants="a=ka"),
+            metrics=MetricsRegistry())
+        platform.broker.register_queue("/v1/q")
+        platform.store.upsert(APITask(endpoint="/v1/q", tenant="a",
+                                      publish=True))
+        depths = {name: platform.broker.queue(name).lane_depths()
+                  for name in platform.broker.queue_names()}
+        assert sum(d.get("a", 0) for d in depths.values()) == 1
+        laned = [n for n, d in depths.items() if d.get("a")]
+        assert laned and "#s" in laned[0]  # landed on a shard sub-queue
+
+    def test_refusals(self):
+        with pytest.raises(ValueError, match="queue transport"):
+            LocalPlatform(PlatformConfig(tenancy=True, transport="push"))
+        with pytest.raises(ValueError, match="Python store and broker"):
+            LocalPlatform(PlatformConfig(tenancy=True, native_broker=True))
+        with pytest.raises(ValueError, match="Python store and broker"):
+            LocalPlatform(PlatformConfig(tenancy=True, native_store=True))
+
+    def test_malformed_spec_fails_at_assembly(self):
+        with pytest.raises(ValueError, match="expected name="):
+            LocalPlatform(PlatformConfig(tenancy=True,
+                                         tenancy_tenants="oops"))
+
+    def test_config_env_round_trip(self):
+        from ai4e_tpu.config import FrameworkConfig
+        cfg = FrameworkConfig.from_env({
+            "AI4E_TENANCY_ENABLED": "1",
+            "AI4E_TENANCY_TENANTS": "a=ka:3:20:40",
+            "AI4E_TENANCY_LABEL_TOP_N": "4",
+            "AI4E_TENANCY_GOODPUT_TARGET": "0.95",
+        })
+        pc = cfg.to_platform_config()
+        assert pc.tenancy is True
+        assert pc.tenancy_tenants == "a=ka:3:20:40"
+        assert pc.tenancy_label_top_n == 4
+        assert pc.tenancy_goodput_target == 0.95
+
+    def test_dispatcher_charges_cost_through_orchestration(self):
+        class _Orch:
+            def cost_of(self, uri):
+                return 2.0
+
+        class _Tenancy:
+            def __init__(self):
+                self.charges = []
+
+            def charge(self, tenant, cost):
+                self.charges.append((tenant, cost))
+
+        from ai4e_tpu.broker.dispatcher import Dispatcher
+        from ai4e_tpu.service import LocalTaskManager
+        store = InMemoryTaskStore()
+        broker = InMemoryBroker(metrics=MetricsRegistry())
+        t = _Tenancy()
+        d = Dispatcher(broker, "/v1/q", "http://h/v1/q",
+                       LocalTaskManager(store), metrics=MetricsRegistry(),
+                       orchestration=_Orch(), tenancy=t)
+        assert d.tenancy is t  # threading asserted; the charge call site
+        # is exercised end-to-end by tests/test_tenancy_chaos.py

@@ -1,0 +1,271 @@
+"""Noisy-neighbor chaos acceptance for multi-tenancy
+(``ai4e_tpu/tenancy/``, docs/tenancy.md):
+
+Three tenants share one async route on a 2-shard store behind seeded
+background fault noise (5xx bursts + duplicate deliveries). All three
+hold the same quota and the same fair-share weight. The measured run
+drives the ``noisy`` tenant at 10× its rated request rate while both
+victims run exactly at rated; the baseline run is the identical seeded
+workload with ``noisy`` also at rated.
+
+The bar, per ISSUE 16:
+
+- the victims never notice: each victim's within-deadline goodput holds
+  within 15% of its own fault-free-neighbor baseline, its tenant-quota
+  shed count is ZERO, and its SLO burn stays under budget;
+- only the noisy tenant sheds: the 10× flood is refused at the edge
+  with 429 + ``Retry-After`` (tenant-quota provenance), and the noisy
+  tenant burns its OWN error budget, nobody else's;
+- the ``InvariantChecker`` is clean — 0 lost tasks, 0 duplicate
+  completions — globally AND per shard, in both runs.
+
+Replays on the fixed ``AI4E_CHAOS_SEED`` CI pin (chaos-smoke job), and
+in the multi-process rig via ``scripts/rig_noisy_neighbor.sh``.
+"""
+
+import asyncio
+import os
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai4e_tpu.chaos import (FaultInjector, InvariantChecker,
+                            RestartableBackend, wrap_platform_http,
+                            wrap_publish_duplicates)
+from ai4e_tpu.metrics import MetricsRegistry
+from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+from ai4e_tpu.taskstore import TaskStatus
+
+SEED = int(os.environ.get("AI4E_CHAOS_SEED", "20260803"))
+
+DEADLINE_MS = 5000.0
+RATED_RPS = 40.0           # every tenant's contracted rate
+VICTIM_REQUESTS = 30       # per victim, paced just under rated
+NOISY_MULT = 10            # the flood: 10× volume at 10× pace
+
+# noisy gets a tight burst so the flood sheds at the edge instead of
+# parking an unbounded lane backlog; victims get a full-run burst so
+# rated pacing never brushes their own bucket.
+TENANT_SPEC = (f"noisy=key-noisy:1:{RATED_RPS:g}:20,"
+               f"victim1=key-v1:1:{RATED_RPS:g}:{VICTIM_REQUESTS},"
+               f"victim2=key-v2:1:{RATED_RPS:g}:{VICTIM_REQUESTS}")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def serve(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def _platform():
+    return LocalPlatform(PlatformConfig(
+        tenancy=True,
+        tenancy_tenants=TENANT_SPEC,
+        # One late straggler in 30 must not read as a burned budget:
+        # 10% budget → burn 1.0 means >3 victim stragglers, noise-proof.
+        tenancy_goodput_target=0.9,
+        admission=True,          # composed with, not replaced by, quotas
+        resilience=True,
+        task_shards=2,
+        retry_delay=0.01,
+        lease_seconds=2.0,
+        resilience_retry_base_s=0.001,
+        resilience_failure_threshold=3,
+        resilience_recovery_seconds=0.2), metrics=MetricsRegistry())
+
+
+def _completing_app(platform) -> web.Application:
+    """Fast worker: adopt then complete via conditional writes — the
+    service-shell discipline an at-least-once transport requires."""
+    async def handler(request):
+        tid = request.headers["taskId"]
+        body = await request.read()
+        platform.store.update_status_if(tid, "created", "running",
+                                        TaskStatus.RUNNING)
+        platform.store.update_status_if(
+            tid, "running", f"completed - scored {len(body)}",
+            TaskStatus.COMPLETED)
+        return web.Response(text="ok")
+
+    app = web.Application()
+    app.router.add_post("/v1/be/x", handler)
+    return app
+
+
+async def _warm_drain(gw, checker, n=30, timeout=30.0):
+    """Warm the admission drain estimator with keyless (default-tenant,
+    unmetered) traffic — identical in every run, so the baseline and the
+    flood run compare apples-to-apples."""
+    ids = []
+    for _ in range(n):
+        resp = await gw.post("/v1/pub/x", data=b"warm")
+        assert resp.status == 200, resp.status
+        tid = (await resp.json())["TaskId"]
+        checker.note_accepted(tid)
+        ids.append(tid)
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if all(t in checker.terminal for t in ids):
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError("drain warm-up never completed")
+
+
+async def _drive_tenant(gw, checker, key: str, requests: int, pace_s: float,
+                        retry_non_quota: bool) -> dict:
+    """Open-ish loop for one tenant. Victims (``retry_non_quota``) honor
+    the platform's client contract for PRESSURE sheds (back off on 429
+    and re-issue) but treat a tenant-quota 429 as a contract violation;
+    the noisy tenant takes every shed and keeps flooding."""
+    accepted = quota_shed = other_shed = 0
+    for _ in range(requests):
+        for attempt in range(40):
+            resp = await gw.post(
+                "/v1/pub/x", data=b"payload",
+                headers={"Ocp-Apim-Subscription-Key": key,
+                         "X-Deadline-Ms": str(int(DEADLINE_MS))})
+            if resp.status == 200:
+                checker.note_accepted((await resp.json())["TaskId"])
+                accepted += 1
+                break
+            assert resp.status == 429, (key, resp.status)
+            if "tenant-quota" in resp.headers.get("X-Shed-Reason", ""):
+                assert int(resp.headers["Retry-After"]) >= 1
+                quota_shed += 1
+                break  # the tenant's own contract — no retry credit
+            other_shed += 1
+            if not retry_non_quota:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise AssertionError(f"{key} refused for the whole retry budget")
+        await asyncio.sleep(pace_s)
+    return {"accepted": accepted, "quota_shed": quota_shed,
+            "other_shed": other_shed}
+
+
+async def _drive_noisy_neighbor(noisy: bool) -> dict:
+    """One seeded run; ``noisy`` floods the noisy tenant at 10×."""
+    platform = _platform()
+    backends = []
+    for _ in range(2):
+        be = await RestartableBackend(_completing_app(platform)).start()
+        backends.append(be)
+    platform.publish_async_api(
+        "/v1/pub/x", [(f"{be.url}/v1/be/x", 1.0) for be in backends])
+
+    checker = InvariantChecker(
+        shard_of=platform.store.shard_for).attach(platform.store)
+
+    injector = FaultInjector(seed=SEED)
+    injector.add_rule(error_rate=0.08, error_status=500)
+    injector.add_rule(backend="/v1/be/x", duplicate_rate=0.05)
+    wrap_platform_http(platform, injector)
+    wrap_publish_duplicates(platform, injector)
+
+    # Rated pacing sits just under the contracted rate; the flood runs
+    # 10× the volume at 10× the pace.
+    rated_pace = 1.25 / RATED_RPS
+    gw = await serve(platform.gateway.app)
+    await platform.start()
+    try:
+        await _warm_drain(gw, checker)
+        results = await asyncio.gather(
+            _drive_tenant(gw, checker, "key-noisy",
+                          VICTIM_REQUESTS * (NOISY_MULT if noisy else 1),
+                          rated_pace / (NOISY_MULT if noisy else 1),
+                          retry_non_quota=False),
+            _drive_tenant(gw, checker, "key-v1", VICTIM_REQUESTS,
+                          rated_pace, retry_non_quota=True),
+            _drive_tenant(gw, checker, "key-v2", VICTIM_REQUESTS,
+                          rated_pace, retry_non_quota=True))
+        by_tenant = dict(zip(("noisy", "victim1", "victim2"), results))
+
+        # Drain: every accepted task terminal.
+        deadline = asyncio.get_running_loop().time() + 40.0
+        while asyncio.get_running_loop().time() < deadline:
+            if all(t in checker.terminal for t in checker.accepted):
+                break
+            await asyncio.sleep(0.05)
+
+        checker.assert_ok()
+        for shard in range(2):
+            checker.assert_shard_ok(shard)
+
+        outcomes = platform.metrics.counter("ai4e_tenant_outcomes_total", "")
+        admissions = platform.metrics.counter(
+            "ai4e_tenant_admissions_total", "")
+        return {
+            "by_tenant": by_tenant,
+            "ok": {t: outcomes.value(tenant=t, outcome="ok")
+                   for t in ("noisy", "victim1", "victim2")},
+            "edge_shed": {t: admissions.value(tenant=t, decision="quota_shed")
+                          for t in ("noisy", "victim1", "victim2")},
+            "burn": {t: platform.tenancy.accounting.burn_rate(t)
+                     for t in ("noisy", "victim1", "victim2")},
+            "by_shard": checker.by_shard(),
+            "injected": injector.counts(),
+        }
+    finally:
+        await platform.stop()
+        await gw.close()
+        for be in backends:
+            await be.kill()
+
+
+@pytest.mark.chaos
+class TestNoisyNeighborIsolation:
+    def test_victims_hold_flat_while_only_the_flooder_sheds(self):
+        async def main():
+            baseline = await _drive_noisy_neighbor(noisy=False)
+            flooded = await _drive_noisy_neighbor(noisy=True)
+
+            # At rated, nobody sheds — the quota is a ceiling, not a tax.
+            for t in ("noisy", "victim1", "victim2"):
+                assert baseline["by_tenant"][t]["quota_shed"] == 0, (
+                    t, baseline["by_tenant"][t])
+
+            # THE acceptance bar: each victim's within-deadline goodput
+            # holds within 15% of its own baseline despite the 10× flood
+            # next door.
+            for t in ("victim1", "victim2"):
+                assert baseline["ok"][t] > 0
+                ratio = flooded["ok"][t] / baseline["ok"][t]
+                assert ratio >= 0.85, (
+                    f"{t} goodput collapsed under the flood: "
+                    f"{flooded['ok'][t]} vs baseline {baseline['ok'][t]} "
+                    f"({ratio:.2f})")
+                # Every rated victim request was admitted, none on the
+                # tenant-quota path.
+                assert flooded["by_tenant"][t]["accepted"] == VICTIM_REQUESTS
+                assert flooded["by_tenant"][t]["quota_shed"] == 0
+                assert flooded["edge_shed"][t] == 0
+                # The victims' SLO error budget is intact.
+                assert flooded["burn"][t] < 1.0, (t, flooded["burn"][t])
+
+            # Only the noisy tenant shed, and it shed hard: the flood is
+            # 10× a bucket that holds ~rated, so most of it bounced.
+            noisy = flooded["by_tenant"]["noisy"]
+            assert noisy["quota_shed"] > VICTIM_REQUESTS, noisy
+            assert flooded["edge_shed"]["noisy"] == noisy["quota_shed"]
+            # ...into its OWN error budget.
+            assert flooded["burn"]["noisy"] > 1.0, flooded["burn"]
+            # What the flood DID get admitted still completed — shedding
+            # is the only penalty, accepted work is never dropped.
+            assert flooded["ok"]["noisy"] >= 1
+
+            # The fault noise was real in both runs.
+            assert flooded["injected"].get("error", 0) > 0
+
+            # Per-shard verdicts came from both shards, each clean.
+            assert set(flooded["by_shard"]) == {0, 1}
+            for shard, stats in flooded["by_shard"].items():
+                assert stats["terminal"] == stats["accepted"], (shard, stats)
+                assert stats["duplicates"] == 0, (shard, stats)
+
+        run(main())
