@@ -1,7 +1,7 @@
 # Contributor conveniences. Each target reproduces the matching CI job
 # with the SAME flags (the scripts are the single source of truth).
 
-.PHONY: lint test race-smoke chaos durability rig top timeline
+.PHONY: lint test race-smoke chaos durability rig top timeline mesh
 
 # Both lint gates CI runs (ruff correctness rules + ai4e-lint, see
 # scripts/lint.sh and docs/analysis.md).
@@ -30,6 +30,15 @@ chaos:
 	  tests/test_orchestration_chaos.py tests/test_pipeline_chaos.py \
 	  tests/test_disk_chaos.py tests/test_tenancy_chaos.py \
 	  -q -m chaos -p no:cacheprovider
+
+# The mesh serving plane with CI's pinned seed (mesh-smoke job,
+# docs/mesh_serving.md): spec grammar + validation, the byte-identical
+# mesh-vs-unmeshed oracle on the 8-host-device CPU substrate
+# (tests/conftest.py's XLA_FLAGS), cost-tier deadline escalation, and
+# the poisoned-row redelivery chaos e2e.
+mesh:
+	AI4E_CHAOS_SEED=20260803 JAX_PLATFORMS=cpu python -m pytest \
+	  tests/test_mesh_serving.py -q -p no:cacheprovider
 
 # The multi-process deployment rig at CI's reduced rate + pinned seed
 # (rig-smoke job, docs/deployment.md): real separate OS processes —

@@ -13,6 +13,7 @@ from .rmw import NonatomicReadModifyWrite
 from .stale_read import StaleReadAcrossAwait
 from .status_clobber import TerminalStatusClobber
 from .swallowed import SwallowedException
+from .unplaced import UnplacedDeviceTransfer
 
 ALL_RULES = [
     BlockingCallInAsync,
@@ -28,6 +29,7 @@ ALL_RULES = [
     LedgerVocabularyDrift,
     StaticBucketLadder,
     UnboundedMetricLabel,
+    UnplacedDeviceTransfer,
 ]
 
 __all__ = ["ALL_RULES"] + [cls.__name__ for cls in ALL_RULES]

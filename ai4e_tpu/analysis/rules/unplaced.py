@@ -1,0 +1,98 @@
+"""AIL014 — device transfer without an explicit placement on the serving path.
+
+The bug class: PR 17 made device placement declarative — a worker's mesh
+layout is a validated ``MeshSpec``, batches land via ``NamedSharding``
+batch-axis placements, params via partition rules, and outputs come back
+through the one blessed fetch helper (``runtime/mesh/placement.py``). A
+bare ``jax.device_put(x)`` pasted under ``runtime/`` or ``parallel/``
+silently re-introduces the pre-mesh behavior: the array lands wherever
+JAX's default device points (device 0 of however many the process sees),
+which *works* on a single-device dev box and then hot-loops one core of
+an 8-device serving mesh — or worse, desyncs a multi-process slice whose
+followers placed the same array differently. Same for ``device_get``:
+an unmediated fetch bypasses the replicated-output contract the fetch
+helper documents (and is invisible to any future remote-transfer
+accounting), so every device→host read routes through
+``placement.fetch_to_host`` — the ONE module this rule does not scan.
+
+A transfer is *placed* when it states where the data goes:
+
+- ``jax.device_put(x, sharding_or_device)`` — second positional arg;
+- ``jax.device_put(x, device=...)`` / ``(x, sharding=...)`` /
+  ``(x, dst_sharding=...)`` — any placement keyword.
+
+``jax.device_put(x)`` alone is the finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, dotted_name, enclosing_symbol, import_aliases
+
+#: Only the serving device path is in scope — model code, benches, and
+#: tests legitimately use default placements.
+SCOPE_PARTS = ("runtime/", "parallel/")
+#: The blessed transfer-helper module (see its docstring).
+EXEMPT_SUFFIX = "runtime/mesh/placement.py"
+
+_PLACEMENT_KWARGS = {"device", "sharding", "dst_sharding", "donate"}
+
+
+class UnplacedDeviceTransfer(Rule):
+    rule_id = "AIL014"
+    name = "unplaced-device-transfer"
+    description = ("device transfers under runtime/ and parallel/ must "
+                   "state their placement: device_put needs a sharding/"
+                   "device argument, device_get goes through "
+                   "runtime/mesh/placement.fetch_to_host")
+
+    def check_module(self, ctx):
+        path = ctx.path.replace("\\", "/")
+        if (not any(part in path for part in SCOPE_PARTS)
+                or path.endswith(EXEMPT_SUFFIX)):
+            return []
+        aliases = import_aliases(ctx.tree)
+        rule = self
+
+        class _Visitor(ast.NodeVisitor):
+            def __init__(self):
+                self.findings = []
+                self._stack: list[ast.AST] = []
+
+            def _enter(self, node):
+                self._stack.append(node)
+                self.generic_visit(node)
+                self._stack.pop()
+
+            visit_ClassDef = _enter
+            visit_FunctionDef = _enter
+            visit_AsyncFunctionDef = _enter
+
+            def visit_Call(self, node):
+                name = dotted_name(node.func, aliases)
+                if name == "jax.device_put":
+                    placed = (len(node.args) >= 2
+                              or any(kw.arg in _PLACEMENT_KWARGS
+                                     for kw in node.keywords))
+                    if not placed:
+                        self.findings.append(ctx.finding(
+                            rule.rule_id, node,
+                            "jax.device_put without a placement lands on "
+                            "JAX's default device — pass the NamedSharding "
+                            "(runtime/mesh/placement.batch_placement) or "
+                            "target device explicitly",
+                            symbol=enclosing_symbol(self._stack)))
+                elif name == "jax.device_get":
+                    self.findings.append(ctx.finding(
+                        rule.rule_id, node,
+                        "bare jax.device_get on the serving path — route "
+                        "device→host fetches through "
+                        "runtime/mesh/placement.fetch_to_host (the one "
+                        "sanctioned transfer helper)",
+                        symbol=enclosing_symbol(self._stack)))
+                self.generic_visit(node)
+
+        visitor = _Visitor()
+        visitor.visit(ctx.tree)
+        return visitor.findings

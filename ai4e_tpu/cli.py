@@ -191,11 +191,30 @@ def _declarative_handoff(spec: dict | None):
 
 
 def _mesh_from_config(rt):
-    """Build the serving mesh from the runtime section's axis sizes
-    (AI4E_RUNTIME_DP/FSDP/TP/SP/EP). All defaults (dp=0, rest=1) → None →
-    ModelRuntime's all-devices data-parallel default."""
+    """Build the serving mesh from the runtime section. Two sources,
+    mutually exclusive:
+
+    - ``AI4E_RUNTIME_MESH_SPEC`` — the declarative serving-mesh grammar
+      ("dp=8", "dp=2,tp=2"; runtime/mesh/spec.py), validated against the
+      visible device/process topology and served as a mesh endpoint
+      (docs/mesh_serving.md);
+    - the low-level AI4E_RUNTIME_DP/FSDP/TP/SP/EP axis sizes.
+
+    All defaults (no spec, dp=0, rest=1) → None → ModelRuntime's
+    all-devices data-parallel default."""
+    from .runtime.mesh.spec import parse_mesh_spec
+    layout = parse_mesh_spec(rt.mesh_spec)
     axes = dict(fsdp=rt.fsdp, tp=rt.tp, sp=rt.sp, ep=rt.ep)
-    if rt.dp <= 0 and all(v <= 1 for v in axes.values()):
+    axes_set = rt.dp > 0 or any(v > 1 for v in axes.values())
+    if layout is not None:
+        if axes_set:
+            raise ValueError(
+                "AI4E_RUNTIME_MESH_SPEC and the AI4E_RUNTIME_DP/FSDP/TP/"
+                "SP/EP axis knobs are mutually exclusive — the spec IS "
+                "the serving mesh; unset the axis knobs")
+        from .runtime.mesh.placement import mesh_for_layout
+        return mesh_for_layout(layout)
+    if not axes_set:
         return None
     import jax
 
@@ -347,15 +366,17 @@ def build_worker(config: FrameworkConfig, models: dict):
 
     ladders = None
     import jax
-    if rt.ladder_derive and jax.process_count() > 1:
-        # The deriver thread compiles + executes dummy batches on THIS
-        # process alone; over a process-spanning mesh that deadlocks on
-        # collectives and followers would never learn the swapped
-        # ladder (the serving-path compile the swap invariant forbids).
-        # Multi-host keeps the factory ladder, loudly.
-        log.warning("ladder derivation requested but the mesh spans %d "
-                    "processes — single-host only, serving the factory "
-                    "ladder (docs/device_path.md)", jax.process_count())
+    if rt.ladder_derive and jax.process_count() > 1 and jax.process_index():
+        # Only the mesh primary derives: followers mirror the primary's
+        # executions in follower_loop and jit-compile new bucket shapes
+        # the moment its descriptors carry them, so a follower-local
+        # deriver would only desync the broadcast order
+        # (docs/mesh_serving.md). This replaces the old blanket
+        # multi-process refusal — the primary's deriver now warm-executes
+        # through MultihostRuntime.prepare_buckets, which broadcasts the
+        # dummies so the whole slice compiles in lockstep.
+        log.info("ladder derivation: follower %d defers to the mesh "
+                 "primary's derived ladder", jax.process_index())
     elif rt.ladder_derive:
         # Traffic-tuned bucket ladders (AI4E_RUNTIME_LADDER_*, docs/
         # device_path.md): restore any persisted derived ladder now —
@@ -462,6 +483,38 @@ def build_worker(config: FrameworkConfig, models: dict):
         mh = MultihostRuntime(runtime)
         worker.runtime = mh
         batcher.runtime = mh
+        if ladders is not None:
+            # Derivation dummies must enter through the broadcast so
+            # followers mirror them (MultihostRuntime.prepare_buckets).
+            ladders.runtime = mh
+
+    from .runtime.mesh import parse_mesh_spec
+    layout = parse_mesh_spec(rt.mesh_spec)
+    if layout is not None:
+        # Mesh serving plane (AI4E_RUNTIME_MESH_SPEC, docs/mesh_serving.md):
+        # the worker serves through a validated MeshEndpoint — layout
+        # checked against the live mesh, poison accounting wired to the
+        # coordinator's follower-health state machine, per-process device
+        # phases drained into hop ledgers. Outermost wrapper: it must see
+        # the multihost runtime's poison gathers, not raw registry calls.
+        from .runtime.mesh import EndpointHealth, MeshCoordinator, MeshEndpoint
+        health = EndpointHealth()
+        coordinator = MeshCoordinator(
+            layout, health=health,
+            process_count=jax.process_count(),
+            process_index=jax.process_index(),
+            unhealthy_after=rt.mesh_unhealthy_after)
+        inner = worker.runtime
+        if hasattr(inner, "poison_listener"):
+            coordinator.attach(inner)
+        endpoint = MeshEndpoint(inner, layout, health=health,
+                                coordinator=coordinator)
+        worker.runtime = endpoint
+        batcher.runtime = endpoint
+        log.info("mesh serving plane ON: %s (tier %s, %d devices, "
+                 "process %d/%d)", layout.describe()["spec"],
+                 layout.tier_label, layout.size, jax.process_index(),
+                 jax.process_count())
     return worker, batcher, task_manager
 
 
@@ -567,8 +620,14 @@ async def run_worker(config: FrameworkConfig, models: dict) -> None:
                                interval_s=config.observability
                                .vitals_interval)
         await vitals.start()
-    log.info("worker on %s:%s serving %s%s%s%s%s", config.service.host,
+    log.info("worker on %s:%s serving %s%s%s%s%s%s", config.service.host,
              config.service.port, list(worker.runtime.models),
+             # Mesh posture (docs/mesh_serving.md): the declared serving
+             # layout doubles as the orchestration cost-tier label.
+             (", mesh %s ON (tier %s)" % (
+                 worker.runtime.layout.describe()["spec"],
+                 worker.runtime.layout.tier_label)
+              if hasattr(worker.runtime, "layout") else ""),
              ", vitals ON" if vitals is not None else "",
              # Device-path posture (docs/device_path.md): operators grep
              # these to confirm the traffic-tuned/overlapped hot path.

@@ -424,6 +424,16 @@ class RuntimeSection:
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    # Mesh serving plane (runtime/mesh/, docs/mesh_serving.md): the
+    # declarative serving-mesh spec — "dp=8", "dp=2,tp=2", optionally
+    # ",sp=N" — validated at boot and exposed on GET /v1/models. Empty =
+    # mesh serving off (byte-identical worker); mutually exclusive with
+    # the low-level dp/fsdp/tp/sp/ep axis knobs above.
+    mesh_spec: str = ""
+    # Consecutive poisoned batches attributed to one mesh process before
+    # the endpoint flips unhealthy (admission answers 500; breakers
+    # eject it). One clean batch marks it healthy again.
+    mesh_unhealthy_after: int = 3
 
 
 @_env_section("AI4E_GATEWAY_")

@@ -213,6 +213,20 @@ class MultihostRuntime:
         self.total_egress_bytes = 0
         self.last_ingest_s = 0.0
         self._fetch_count = 0  # fault-injection ordinal (follower side)
+        # Mesh serving plane hooks (runtime/mesh/, docs/mesh_serving.md):
+        # ``poison_listener(flags)`` receives the per-process poison flags
+        # of every gather (the coordinator's follower-health signal), and
+        # ``_process_phases`` accumulates (label, process_index, seconds)
+        # device-phase tuples per batch — staged-shard egress per follower
+        # plus the primary's assemble and execute — drained by the mesh
+        # endpoint into per-request hop ledgers. Both are fail-open
+        # telemetry; under pipelined batches drain attribution can lag one
+        # batch (the order lock serialises the executions themselves).
+        self.poison_listener = None
+        self._process_phases: list[tuple[str, int, float]] = []
+        # Own lock (not _order_lock): drain runs on the event loop and
+        # must never wait out a whole device execution.
+        self._phases_lock = threading.Lock()
         if jax.process_count() > 1:
             self._open_feed()
 
@@ -307,20 +321,27 @@ class MultihostRuntime:
             self._seq += 1
             plan = self._plan(model_name, batch.shape)
             egress = 0
+            phases: list[tuple[str, int, float]] = []
             for proc, ranges in plan.items():
                 if proc == jax.process_index():
                     continue
+                ts = time.perf_counter()
                 payload = np.concatenate(
                     [batch[a:b] for a, b in ranges]).tobytes()
                 self._feed.stage(self._seq, proc, payload)
+                phases.append(("h2d", proc, time.perf_counter() - ts))
                 egress += len(payload)
             self.last_egress_bytes = egress
             self.total_egress_bytes += egress
             self._broadcast_descriptor(
                 self._model_index(model_name), self._seq, batch)
+            ts = time.perf_counter()
             garr = self._assemble(model_name, batch.shape, batch.dtype,
                                   lambda a, b: batch[a:b])
+            phases.append(("h2d", jax.process_index(),
+                           time.perf_counter() - ts))
             self.last_ingest_s = time.perf_counter() - t0
+            ts = time.perf_counter()
             try:
                 out = self.runtime.run_batch(model_name, garr)
             finally:
@@ -329,12 +350,64 @@ class MultihostRuntime:
                 # a primary that skipped it would leave the slice's
                 # collectives misaligned from here on.
                 flags = self._gather_poison(0)
+            # The jitted program is one SPMD execution across the slice;
+            # its wall time is stamped under the primary's process index.
+            phases.append(("execute", jax.process_index(),
+                           time.perf_counter() - ts))
+            with self._phases_lock:
+                self._process_phases.extend(phases)
+            if self.poison_listener is not None:
+                self.poison_listener(list(flags))
             poisoned: set[int] = set()
             for proc, flag in enumerate(flags):
                 if flag:
                     for a, b in plan.get(proc, []):
                         poisoned.update(range(a, b))
             return out, frozenset(poisoned)
+
+    # -- ladder derivation (primary-gated, docs/mesh_serving.md) -------------
+
+    @property
+    def data_axis_size(self) -> int:
+        return self.runtime.data_axis_size
+
+    def prepare_buckets(self, name: str, buckets) -> tuple[int, ...]:
+        """Warm-execute candidate ladder buckets THROUGH the broadcast
+        path, so every follower enters (and jit-compiles) the same
+        program — the deriver's dummy batches become ordinary SPMD
+        executions instead of the primary-only calls the old
+        ``build_worker`` refusal guarded against. Followers learn new
+        bucket shapes from the descriptors themselves; the swap
+        (``apply_ladder``) stays a primary-local attribute assignment
+        because followers never cut batches — they only mirror shapes
+        the primary broadcasts."""
+        if jax.process_count() == 1:
+            return self.runtime.prepare_buckets(name, buckets)
+        from .sharding import pad_to_multiple
+        servable = self.runtime.models[name]
+        aligned = tuple(sorted({
+            pad_to_multiple(int(b), self.data_axis_size) for b in buckets}))
+        if not aligned:
+            raise ValueError(f"empty ladder for {name}")
+        for bucket in aligned:
+            if (name, bucket) in self.runtime._executed_shapes:
+                continue
+            dummy = np.zeros((bucket, *servable.input_shape),
+                             servable.input_dtype)
+            # Marks (name, bucket) executed on every process via the
+            # wrapped runtime's run_batch.
+            self.run_batch_report(name, dummy)
+        return aligned
+
+    def apply_ladder(self, name: str, buckets) -> tuple[int, ...]:
+        return self.runtime.apply_ladder(name, buckets)
+
+    def drain_process_phases(self) -> list[tuple[str, int, float]]:
+        """Pop the accumulated per-process device-phase tuples (the mesh
+        endpoint forwards them into per-request hop ledgers)."""
+        with self._phases_lock:
+            out, self._process_phases = self._process_phases, []
+        return out
 
     def shutdown_followers(self) -> None:
         if jax.process_count() > 1 and is_primary():

@@ -95,13 +95,37 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def spec_for_param(path: tuple, value, tp_rules: dict | None = None) -> P:
+def spec_for_param(path: tuple, value, tp_rules=None) -> P:
     """PartitionSpec for one parameter by name-path match.
 
-    ``tp_rules`` maps a substring of the joined param path (e.g. ``"mlp/up"``)
-    to a PartitionSpec. Default: replicate. This is the annotate-and-let-XLA-
-    insert-collectives workflow: params get specs, pjit does the rest.
+    Two rule forms, both first-match-wins on the ``/``-joined param path:
+
+    - ``dict`` — substring → PartitionSpec (the original form; e.g.
+      ``{"mlp/up": P(None, "tp")}``). No match: replicate.
+    - ``list``/``tuple`` of ``(regex, PartitionSpec)`` pairs — the
+      checkpoint-tree mapping the mesh serving plane declares
+      (docs/mesh_serving.md#partition-rules): ``re.search`` per rule in
+      order. Scalar (rank-0) leaves always replicate without consulting
+      the rules; a non-scalar leaf NO rule matches raises ValueError at
+      placement time — a regex rule set is a complete declaration, and a
+      silently replicated tp param would serve wrong math on a split
+      mesh, so the gap must fail registration, not the request path.
+      End the list with ``(".*", P())`` to opt into replicate-by-default.
+
+    This is the annotate-and-let-XLA-insert-collectives workflow: params
+    get specs, pjit does the rest.
     """
+    if isinstance(tp_rules, (list, tuple)):
+        if not hasattr(value, "ndim") or value.ndim == 0:
+            return P()
+        import re
+        joined = "/".join(str(p) for p in path)
+        for pattern, spec in tp_rules:
+            if re.search(pattern, joined):
+                return spec
+        raise ValueError(
+            f"no partition rule matches param {joined!r} — regex rule sets "
+            f"must be complete (add a ('.*', P()) catch-all to replicate)")
     if tp_rules:
         joined = "/".join(str(p) for p in path)
         for needle, spec in tp_rules.items():
@@ -110,8 +134,9 @@ def spec_for_param(path: tuple, value, tp_rules: dict | None = None) -> P:
     return P()
 
 
-def shard_params(params, mesh: Mesh, tp_rules: dict | None = None):
-    """Place a pytree of params onto the mesh per ``tp_rules``."""
+def shard_params(params, mesh: Mesh, tp_rules=None):
+    """Place a pytree of params onto the mesh per ``tp_rules`` (either
+    rule form ``spec_for_param`` accepts)."""
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     placed = []
     for path, leaf in flat:

@@ -42,7 +42,9 @@ def _topology_from_args(args) -> Topology:
         task_timeout=args.task_timeout,
         tenants=args.tenants,
         loadgen_tenants=(json.loads(args.loadgen_tenants)
-                         if args.loadgen_tenants else []))
+                         if args.loadgen_tenants else []),
+        mesh=args.mesh, mesh_poison_nths=args.mesh_poison_nths,
+        mesh_recovery_s=args.mesh_recovery_s)
 
 
 def main(argv=None) -> int:
@@ -107,6 +109,22 @@ def main(argv=None) -> int:
                          '[{"name": ..., "key": ..., "rate": rps}, ...] — '
                          "rate overrides the even rate/loadgens split "
                          "(the noisy-neighbor lever)")
+    up.add_argument("--mesh",
+                    default=os.environ.get("AI4E_RIG_MESH", ""),
+                    help="mesh layout spec ('dp=8', 'dp=2,tp=2') — boots "
+                         "every worker as a mesh endpoint with the tier "
+                         "label in its route (docs/mesh_serving.md); "
+                         "empty = plain echo workers")
+    up.add_argument("--mesh-poison-nths",
+                    default=os.environ.get("AI4E_RIG_MESH_POISON_NTHS", ""),
+                    help="comma-separated 1-based delivery ordinals each "
+                         "mesh worker poisons (503 result-invalidated → "
+                         "per-task redelivery; consecutive poisons flip "
+                         "the endpoint unhealthy)")
+    up.add_argument("--mesh-recovery-s", type=float,
+                    default=_env_float("AI4E_RIG_MESH_RECOVERY_S", 2.0),
+                    help="seconds a flipped-unhealthy mesh worker stays "
+                         "dark before its follower-restart probe")
     up.add_argument("--out", default=None,
                     help="artifact directory (rig.json is written here)")
 

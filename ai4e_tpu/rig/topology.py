@@ -80,9 +80,32 @@ class Topology:
     # drives one tenant at 10× while the victims hold rated.
     tenants: str = ""
     loadgen_tenants: list = field(default_factory=list)
+    # Mesh serving plane (runtime/mesh/, docs/mesh_serving.md). ``mesh``
+    # is the declarative layout spec ("dp=8", "dp=2,tp=2"): non-empty
+    # boots every worker as a MESH endpoint — the JAX-free half of the
+    # production MeshEndpoint (same spec grammar, same EndpointHealth/
+    # MeshCoordinator state machine), with the layout's cost-tier label
+    # riding the route so every backend URI carries the substring the
+    # orchestration cost map keys on. ``mesh_poison_nths`` injects
+    # degradation: comma-separated 1-based delivery ordinals each worker
+    # poisons (the rig analogue of AI4E_FAULT_MESH_POISON_NTHS — those
+    # deliveries answer 503 result-invalidated and redeliver per task).
+    # ``mesh_recovery_s`` is how long a flipped-unhealthy worker stays
+    # dark (answering 500, ejected by dispatcher breakers) before its
+    # "follower restart" probe delivery is allowed to heal it.
+    mesh: str = ""
+    mesh_poison_nths: str = ""
+    mesh_recovery_s: float = 2.0
     extra: dict = field(default_factory=dict)
 
     def __post_init__(self):
+        if self.mesh:
+            from ..runtime.mesh import parse_mesh_spec
+            layout = parse_mesh_spec(self.mesh)  # raises MeshSpecError early
+            if layout is not None and self.route == ECHO_ROUTE:
+                # Tier-labelled route: reloading a saved spec keeps the
+                # already-derived route (it no longer equals ECHO_ROUTE).
+                self.route = f"/v1/echo-{layout.tier_label}/run-async"
         if self.gateways < 1 or self.shards < 1:
             raise ValueError("topology needs >= 1 gateway and >= 1 shard")
         if self.gateways > 18:

@@ -121,10 +121,96 @@ class EchoWorker:
             x += 1
 
 
+class MeshEchoWorker(EchoWorker):
+    """The meshworker role variant (``topo.mesh``, docs/mesh_serving.md):
+    EchoWorker plus the mesh endpoint's health contract, driven by the
+    SAME JAX-free state machine the production worker runs
+    (``runtime/mesh/{spec,coordinator,redelivery}.py``) so the rig fleet
+    chaos-proves it across real processes:
+
+    - an injected poisoned delivery (``topo.mesh_poison_nths``, the rig
+      analogue of ``AI4E_FAULT_MESH_POISON_NTHS``) answers **503
+      result-invalidated** — saturation-neutral, so the broker
+      redelivers exactly that task and breakers stay closed; the
+      original never writes a result, so the redelivered execution's
+      conditional completion can never double-complete (invariant 3);
+    - ``unhealthy_after`` consecutive poisons flip ``EndpointHealth``
+      and the worker answers **500** — a breaker *failure*, so the
+      dispatcher ejects this endpoint and fails over to its peers —
+      until ``mesh_recovery_s`` elapses and a probe delivery (the
+      "follower restart") runs clean, which heals it.
+    """
+
+    def __init__(self, topo: Topology, shard: int):
+        super().__init__(topo, shard)
+        from ..runtime.mesh import (EndpointHealth, MeshCoordinator,
+                                    parse_mesh_spec)
+        self.layout = parse_mesh_spec(topo.mesh)
+        self.health = EndpointHealth()
+        # One virtual follower (process 1) carries the injected poison —
+        # the same attribution the production endpoint's single-host
+        # fault injection uses.
+        self.coordinator = MeshCoordinator(self.layout, health=self.health,
+                                           process_count=2)
+        self._deliveries = 0
+        self._poison_nths = frozenset(
+            int(s) for s in topo.mesh_poison_nths.split(",") if s.strip())
+        self._unhealthy_at = 0.0
+        self._healthy_gauge = self.metrics.gauge(
+            "ai4e_rig_mesh_healthy", "1 while the mesh endpoint is healthy")
+        self._healthy_gauge.set(1.0)
+        if self._poison_nths:
+            log.warning("meshworker shard %d: poisoning deliveries %s",
+                        shard, sorted(self._poison_nths))
+
+    async def _health(self, _: web.Request) -> web.Response:
+        body = {"status": "healthy", "shard": self.shard,
+                "mesh": self.layout.describe(),
+                "mesh_healthy": self.health.healthy}
+        if not self.health.healthy:
+            body["mesh_unhealthy_reason"] = self.health.reason
+        # Always 200: the supervisor's liveness gate is process health;
+        # mesh ejection is the DISPATCHER's breaker decision, driven by
+        # the 500s below.
+        return web.json_response(body)
+
+    async def _run(self, request: web.Request) -> web.Response:
+        if not self.health.healthy:
+            if (time.monotonic() - self._unhealthy_at
+                    < self.topo.mesh_recovery_s):
+                # 500, not 503: resilience/health.py treats 503/429 as
+                # saturation-neutral — only a >=500 failure opens the
+                # dispatcher's breaker and ejects this endpoint.
+                self._served.inc(outcome="unhealthy")
+                return web.json_response(
+                    {"ok": False, "reason": "mesh endpoint unhealthy: "
+                                            + self.health.reason},
+                    status=500)
+            # Recovery window over — this delivery is the follower-restart
+            # probe: fall through; a clean run heals via observe_poison.
+        self._deliveries += 1
+        if self._deliveries in self._poison_nths:
+            was_healthy = self.health.healthy
+            self.coordinator.observe_poison([0, 1])
+            if was_healthy and not self.health.healthy:
+                self._unhealthy_at = time.monotonic()
+                self._healthy_gauge.set(0.0)
+            self._served.inc(outcome="poisoned")
+            return web.json_response(
+                {"ok": False,
+                 "reason": "result invalidated: a worker host degraded "
+                           "while executing this row's shard"},
+                status=503, headers={"Retry-After": "1"})
+        self.coordinator.observe_poison([0, 0])
+        self._healthy_gauge.set(1.0)
+        return await super()._run(request)
+
+
 async def run_workernode(topo: Topology, shard: int, index: int) -> None:
     from .nodevitals import attach_vitals
     from .supervisor import serve_until_signal
-    worker = EchoWorker(topo, shard)
+    worker = (MeshEchoWorker(topo, shard) if topo.mesh
+              else EchoWorker(topo, shard))
     attach_vitals(worker.app, topo, worker.metrics)
     await serve_until_signal(worker.app, topo.host,
                              topo.worker_port(shard, index))

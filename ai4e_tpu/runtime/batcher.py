@@ -639,6 +639,17 @@ class MicroBatcher:
                     self._exec_pending.pop(id(batch), None)
         if phases:
             self._note_phases(model_name, t0, phases, batch)
+        # Mesh serving plane: per-mesh-process device phases (primary's
+        # per-follower shard staging + the SPMD execute) stamped into each
+        # request's ledger keyed by process index — existing h2d/execute
+        # vocabulary, reason carries the key (docs/mesh_serving.md).
+        drain = getattr(self.runtime, "drain_process_phases", None)
+        if drain is not None:
+            for label, proc, dur in drain():
+                for p in batch:
+                    if p.ledger is not None:
+                        p.ledger.stamp(label, "device",
+                                       reason=f"proc={proc}", ms=dur * 1e3)
         self._batch_latency.observe(time.perf_counter() - t0, model=model_name)
         self._batch_size_hist.observe(n, model=model_name)
         self._h2d_bytes.inc(padded.nbytes, model=model_name)
@@ -712,14 +723,16 @@ class MicroBatcher:
             # Fail exactly the affected tasks — their rows ran on a zeros
             # shard (or a failed follower) and any "result" would be a
             # confidently wrong answer; the batch's other rows are good.
+            # The typed RowPoisoned lets the worker redeliver exactly these
+            # tasks through resilience instead of terminally failing them
+            # (runtime/mesh/redelivery.py, docs/mesh_serving.md).
+            from .mesh.redelivery import RowPoisoned
             log.error("batch for %s: %d of %d rows poisoned by a degraded "
                       "host; failing those tasks", model_name,
                       sum(1 for i in range(n) if i in poisoned), n)
             for i, p in enumerate(batch):
                 if i in poisoned and not p.future.done():
-                    p.future.set_exception(RuntimeError(
-                        "result invalidated: a worker host degraded while "
-                        "executing this row's shard"))
+                    p.future.set_exception(RowPoisoned())
 
         # Per-example postprocess runs on the executor, not the event loop:
         # a heavy postprocess (e.g. PNG-encoding 64 class maps) would

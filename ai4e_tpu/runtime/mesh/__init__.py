@@ -1,0 +1,39 @@
+"""Mesh serving plane — a worker *is* a mesh endpoint (docs/mesh_serving.md).
+
+The package splits along the JAX boundary on purpose:
+
+- ``spec`` and ``redelivery`` are stdlib-only, so the JAX-free surfaces
+  that need the vocabulary — the batcher's poison contract, the race
+  harness, the rig's meshworker role, the analyzer — import them without
+  pulling a device runtime into the process;
+- ``placement``, ``endpoint`` and ``coordinator`` hold the device-side
+  machinery and import jax at module level; reach them via the lazy
+  attributes below (or import the submodules directly).
+"""
+
+from .redelivery import EndpointHealth, RowPoisoned, redeliver_poisoned
+from .spec import MeshLayout, MeshSpecError, parse_mesh_spec
+
+_LAZY = {
+    "MeshEndpoint": ".endpoint",
+    "MeshCoordinator": ".coordinator",
+}
+
+__all__ = [
+    "EndpointHealth",
+    "MeshCoordinator",
+    "MeshEndpoint",
+    "MeshLayout",
+    "MeshSpecError",
+    "RowPoisoned",
+    "parse_mesh_spec",
+    "redeliver_poisoned",
+]
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+    return getattr(importlib.import_module(mod, __name__), name)

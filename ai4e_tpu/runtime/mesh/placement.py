@@ -1,0 +1,92 @@
+"""Device placement for the mesh serving plane (docs/mesh_serving.md).
+
+Three concerns, all thin layers over ``parallel/sharding.py``:
+
+- **layout → mesh**: translate the declarative ``MeshLayout`` into the
+  runtime's named ``jax.sharding.Mesh`` (dp/fsdp/ep/sp/tp axis order,
+  ``make_mesh``'s tp-innermost ICI layout);
+- **batch-axis placement**: the NamedSharding that puts a request batch's
+  leading dimension on the data axes and replicates the rest — what the
+  registry jits inputs against and ``h2d_resident`` places with;
+- **partition rules**: resolve a regex rule set against a checkpoint
+  param tree (first-match-wins, complete-by-construction — see
+  ``spec_for_param``) so a registration error surfaces as a readable
+  per-param report instead of a mid-placement ValueError.
+
+``fetch_to_host`` is the blessed device→host transfer helper: the ONE
+place in ``runtime/``+``parallel/`` allowed to call a bare
+``jax.device_get`` (AIL014 ``unplaced-device-transfer`` exempts this
+module). Outputs arrive replicated-or-single-device by construction
+(``ModelRuntime`` jits outputs replicated on multi-process meshes), so
+the fetch needs no placement argument — every OTHER device transfer on
+the serving path must state where the data lives.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...parallel.sharding import MeshSpec, make_mesh
+from .spec import MeshLayout
+
+#: Mesh axes the batch (leading) dimension shards over — dp plus fsdp so
+#: a serving mesh composes with an fsdp-split runtime mesh unchanged.
+BATCH_AXES = ("dp", "fsdp")
+
+
+def mesh_for_layout(layout: MeshLayout, devices=None) -> Mesh:
+    """Build the named device mesh for a validated serving layout."""
+    devices = devices if devices is not None else jax.devices()
+    layout.validate(len(devices), jax.process_count())
+    return make_mesh(MeshSpec(dp=layout.dp, tp=layout.tp, sp=layout.sp),
+                     devices=devices)
+
+
+def batch_axis_spec(ndim: int, batch_axis: int = 0) -> P:
+    """PartitionSpec placing dimension ``batch_axis`` of a rank-``ndim``
+    array on the data axes, everything else replicated."""
+    if not 0 <= batch_axis < ndim:
+        raise ValueError(f"batch_axis {batch_axis} out of range for "
+                         f"rank-{ndim} input")
+    axes: list = [None] * ndim
+    axes[batch_axis] = BATCH_AXES
+    return P(*axes)
+
+
+def batch_placement(mesh: Mesh, ndim: int,
+                    batch_axis: int = 0) -> NamedSharding:
+    """The input/output sharding for request batches on ``mesh``."""
+    return NamedSharding(mesh, batch_axis_spec(ndim, batch_axis))
+
+
+def match_partition_rules(rules, params) -> dict[str, P]:
+    """Resolve a regex rule set against a param tree WITHOUT placing it:
+    ``{joined/param/path: PartitionSpec}`` for introspection and
+    registration-time validation. Raises ``ValueError`` naming every
+    unmatched non-scalar param at once (a checkpoint with three unmapped
+    layers should fail with three names, not one per retry)."""
+    from ...parallel.sharding import spec_for_param
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    resolved: dict[str, P] = {}
+    missing: list[str] = []
+    for path, leaf in flat:
+        joined = "/".join(str(p.key if hasattr(p, "key") else p.idx)
+                          for p in path)
+        try:
+            resolved[joined] = spec_for_param(
+                tuple(p.key if hasattr(p, "key") else p.idx for p in path),
+                leaf, rules)
+        except ValueError:
+            missing.append(joined)
+    if missing:
+        raise ValueError(
+            f"partition rules leave {len(missing)} param(s) unmapped: "
+            f"{', '.join(missing)} (add rules or a ('.*', P()) catch-all)")
+    return resolved
+
+
+def fetch_to_host(out):
+    """Blessed device→host fetch for serving outputs (module docstring:
+    the one sanctioned bare ``device_get`` in the serving tree)."""
+    return jax.device_get(out)

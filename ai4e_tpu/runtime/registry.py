@@ -21,6 +21,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .ladder import DEFAULT_BUCKETS
+# The one sanctioned device→host fetch (AIL014: every other transfer on
+# the serving path must carry an explicit placement).
+from .mesh.placement import fetch_to_host
 
 log = logging.getLogger("ai4e_tpu.runtime")
 
@@ -321,7 +324,7 @@ class ModelRuntime:
         # HERE, so a warmed worker's first phased serving call reports
         # ``execute``, not a phantom ``compile``.
         self._executed_shapes.add((name, batch.shape[0]))
-        return jax.device_get(out)
+        return fetch_to_host(out)
 
     def run_batch_report(self, name: str, batch: np.ndarray
                          ) -> tuple[object, frozenset]:
@@ -371,7 +374,7 @@ class ModelRuntime:
             time.perf_counter() - t0)
         self._executed_shapes.add((name, batch.shape[0]))
         t0 = time.perf_counter()
-        host = jax.device_get(out)
+        host = fetch_to_host(out)
         phases["d2h"] = time.perf_counter() - t0
         return host, frozenset(), phases
 
@@ -419,7 +422,7 @@ class ModelRuntime:
         """``device_get`` the outputs. Returns ``(host_outputs,
         (t0, t1))``."""
         t0 = time.perf_counter()
-        host = jax.device_get(out)
+        host = fetch_to_host(out)
         return host, (t0, time.perf_counter())
 
 

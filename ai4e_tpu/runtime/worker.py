@@ -26,6 +26,7 @@ from ..service import APIService
 from ..service.task_manager import TaskManagerBase
 from ..taskstore import TaskStatus
 from .batcher import BatcherSaturated, MicroBatcher
+from .mesh.redelivery import RowPoisoned, redeliver_poisoned
 from .registry import ModelRuntime, ServableModel
 
 log = logging.getLogger("ai4e_tpu.worker")
@@ -105,6 +106,13 @@ class InferenceWorker:
         container registry + values files, queryable live here."""
         from aiohttp import web
         out = []
+        # Mesh serving plane: the validated layout + live health, one per
+        # endpoint (worker-level, every model on it) — how clients and
+        # the orchestrator discover the shape/cost tier a worker serves
+        # (docs/mesh_serving.md#introspection).
+        mesh_desc = (self.runtime.describe()
+                     if hasattr(self.runtime, "layout")
+                     and hasattr(self.runtime, "describe") else None)
         for name, s in self.runtime.models.items():
             entry = {
                 "name": name, "version": s.version,
@@ -115,6 +123,8 @@ class InferenceWorker:
                 "batch_buckets": list(s.batch_buckets),
                 "endpoints": self._served.get(name, {}),
             }
+            if mesh_desc is not None:
+                entry["mesh"] = mesh_desc
             if s.stack_item_shape is not None:
                 # The batch-STACK contract when it differs from the device
                 # input shape (wire-encoded servables): clients discover the
@@ -278,6 +288,15 @@ class InferenceWorker:
             "async": self.service.prefix + async_path})
 
         def _saturation_check():
+            # Mesh-endpoint health gate (docs/mesh_serving.md): a dead
+            # follower means THIS endpoint cannot answer correctly — 500,
+            # a breaker FAILURE, so dispatchers eject it and route to
+            # healthy replicas. Deliberately not 503: observe_status
+            # treats 503 as saturation-neutral ("peers are melting too"),
+            # which must not apply to a half-dead mesh.
+            health = getattr(self.runtime, "health", None)
+            if health is not None and not health.healthy:
+                return 500, f"Mesh endpoint unhealthy: {health.reason}"
             # Admission-time backpressure: refuse BEFORE adopting a task so
             # the dispatcher's 503 handling (delay + redeliver) engages —
             # queue-depth-vs-device-occupancy replacing the reference's
@@ -351,6 +370,15 @@ class InferenceWorker:
                 from aiohttp import web
                 return web.Response(status=503,
                                     text="Inference queue saturated; retry.")
+            except RowPoisoned:
+                # Sync path has no task to redeliver — answer an honest
+                # retryable error (503: the caller/proxy retries; other
+                # rows of the batch were unaffected), never the zeros-
+                # shard "result".
+                from aiohttp import web
+                return web.Response(
+                    status=503,
+                    text="Result invalidated by a degraded mesh host; retry.")
             except DeadlineExceeded as exc:
                 from aiohttp import web
                 return web.Response(
@@ -400,6 +428,20 @@ class InferenceWorker:
                 current = await tm.get_task_status(taskId)
                 endpoint = (current or {}).get("Endpoint", async_path)
                 await tm.add_pipeline_task(taskId, endpoint)
+                return
+            except RowPoisoned:
+                # A degraded mesh host invalidated THIS row (the batch's
+                # other rows completed): redeliver the task through the
+                # broker — per-task retry, never a terminal failure and
+                # never a silent wrong answer. The redelivery helper
+                # probes terminality first, so a concurrently completed
+                # duplicate is suppressed, not re-executed
+                # (docs/mesh_serving.md#poisoned-rows).
+                if buf is not None:
+                    from ..observability.ledger import RETRY
+                    buf.stamp(RETRY, "worker", reason="poisoned-row")
+                await self._flush_ledger(tm, taskId, buf)
+                await redeliver_poisoned(tm, taskId, async_path)
                 return
             except DeadlineExceeded as exc:
                 # Expired while pending in the batcher (which already

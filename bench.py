@@ -802,7 +802,23 @@ def build_platform(args):
         # block (queue-wait/h2d/execute/d2h percentiles + overlap
         # ratio).
         observability=getattr(args, "observability", False)))
-    runtime = ModelRuntime(donate_batch=args.donate_batch)
+    # --mesh dp=N[,tp=M[,sp=K]] serves through the mesh plane
+    # (runtime/mesh/, docs/mesh_serving.md): the layout is validated
+    # against the visible devices, batches/params placed by NamedSharding,
+    # and the worker wrapped in a MeshEndpoint below so failure semantics
+    # (poisoned rows, health gating) match production. On --cpu the
+    # substrate is a host-device mesh — main() forces
+    # jax_num_cpu_devices to the layout size before backend init.
+    mesh_layout = None
+    if getattr(args, "mesh", ""):
+        from ai4e_tpu.runtime.mesh import parse_mesh_spec
+        from ai4e_tpu.runtime.mesh.placement import mesh_for_layout
+        mesh_layout = parse_mesh_spec(args.mesh)
+    if mesh_layout is not None:
+        runtime = ModelRuntime(mesh=mesh_for_layout(mesh_layout),
+                               donate_batch=args.donate_batch)
+    else:
+        runtime = ModelRuntime(donate_batch=args.donate_batch)
     content_type = "application/octet-stream"
     # Routes the gateway/dispatchers must know: [(public?, path)] — the
     # first is the API clients POST; the rest are internal stage backends.
@@ -887,13 +903,30 @@ def build_platform(args):
     for srv, kwargs in serve_calls:
         worker.serve_model(srv, **kwargs)
 
+    if mesh_layout is not None:
+        # Same wrapping as cli.build_worker: the endpoint is the
+        # outermost runtime facade, so worker AND batcher route every
+        # batch through its health gate and poison accounting.
+        from ai4e_tpu.runtime.mesh import (EndpointHealth, MeshCoordinator,
+                                           MeshEndpoint)
+        health = EndpointHealth()
+        endpoint = MeshEndpoint(runtime, mesh_layout, health=health,
+                                coordinator=MeshCoordinator(mesh_layout,
+                                                            health=health))
+        worker.runtime = endpoint
+        batcher.runtime = endpoint
+        log(f"mesh serving plane ON: {args.mesh} "
+            f"(tier {mesh_layout.tier_label}, {mesh_layout.size} devices)")
+
     t0 = time.perf_counter()
     runtime.warmup()
     warmup_s = round(time.perf_counter() - t0, 1)
     log(f"warmup (compile) took {warmup_s}s for "
         f"{[(n, m.batch_buckets) for n, m in runtime.models.items()]}")
     return (platform, worker, batcher, payload,
-            {"warmup_s": warmup_s, **ckpt_meta},
+            {"warmup_s": warmup_s, **ckpt_meta,
+             **({"mesh": worker.runtime.describe()}
+                if mesh_layout is not None else {})},
             api_path, extra_paths, content_type)
 
 
@@ -2177,6 +2210,25 @@ def _clamp_for_cpu(args) -> None:
         args.stack_streams = 1
 
 
+def _apply_mesh_cpu_devices(args) -> None:
+    """--mesh on the CPU substrate: fan the host out into enough XLA host
+    devices to carry the layout via
+    ``--xla_force_host_platform_device_count`` — the same substrate the
+    mesh test suite runs on (docs/mesh_serving.md). XLA_FLAGS is read at
+    backend *init*, not ``import jax``, so appending here works as long
+    as no devices have been touched yet — which is why every caller sits
+    before the first ``jax.devices()`` of its path."""
+    if not getattr(args, "mesh", ""):
+        return
+    from ai4e_tpu.runtime.mesh import parse_mesh_spec
+    layout = parse_mesh_spec(args.mesh)
+    if layout is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count"
+            f"={layout.size}").strip()
+
+
 def _forward_argv(args) -> list[str]:
     return ["--duration", str(args.duration),
             "--ramp", str(args.ramp),
@@ -2217,6 +2269,7 @@ def _forward_argv(args) -> list[str]:
               if args.priority_mix else []),
             *(["--tenant-mix", args.tenant_mix]
               if getattr(args, "tenant_mix", "") else []),
+            *(["--mesh", args.mesh] if getattr(args, "mesh", "") else []),
             "--buckets", *[str(b) for b in args.buckets]]
 
 
@@ -2422,6 +2475,17 @@ def main() -> None:
                              "synthesized as key-<name>. The JSON gains "
                              "a 'tenancy' block and a per-tenant client "
                              "window. Empty (default) = tenancy off")
+    parser.add_argument("--mesh", default="",
+                        help="serving-mesh layout spec, e.g. 'dp=2' or "
+                             "'dp=2,tp=2' (runtime/mesh/, "
+                             "docs/mesh_serving.md): the worker serves "
+                             "through a validated MeshEndpoint with "
+                             "NamedSharding batch placement; on --cpu the "
+                             "host is fanned out into dp*tp*sp XLA host "
+                             "devices so the mesh path runs end-to-end. "
+                             "The JSON gains a 'mesh' block (spec/tier/"
+                             "devices/health). Empty (default) = unmeshed "
+                             "runtime, identical to pre-mesh builds")
     parser.add_argument("--pipeline", action="store_true",
                         help="declared-DAG preset (docs/pipelines.md): a "
                              "2-stage echo chain executed by the pipeline "
@@ -2519,6 +2583,7 @@ def main() -> None:
         import jax
         if args.cpu:
             jax.config.update("jax_platforms", "cpu")
+            _apply_mesh_cpu_devices(args)
         log(f"devices: {jax.devices()}")
         if args.prewarm:
             prewarm(args)
@@ -2537,6 +2602,7 @@ def main() -> None:
         # drain. Pass explicit flags to override the clamps.
         import jax
         jax.config.update("jax_platforms", "cpu")
+        _apply_mesh_cpu_devices(args)
         _clamp_for_cpu(args)
         result = asyncio.run(run_bench(args))
         if args.wire_provenance is not None:
@@ -2605,6 +2671,7 @@ def main() -> None:
         if result is None:  # last resort: inline, let the driver time it
             import jax
             jax.config.update("jax_platforms", "cpu")
+            _apply_mesh_cpu_devices(args)
             result = asyncio.run(run_bench(args))
     result.update(meta)
     print(json.dumps(result), flush=True)

@@ -1617,6 +1617,98 @@ class TestUnboundedMetricLabel:
         """)
         assert findings == []
 
+
+# -- AIL014 unplaced-device-transfer ------------------------------------------
+
+
+class TestUnplacedDeviceTransfer:
+    """A device transfer under ``runtime/``/``parallel/`` that does not
+    state its placement is a finding — PR 17 made placement declarative
+    (NamedSharding batch axes, partition rules, the one blessed fetch
+    helper in ``runtime/mesh/placement.py``; docs/mesh_serving.md)."""
+
+    def _run(self, tmp_path, source,
+             filename="ai4e_tpu/runtime/mod.py"):
+        from ai4e_tpu.analysis.rules.unplaced import UnplacedDeviceTransfer
+        return run_rule(tmp_path, UnplacedDeviceTransfer(), source,
+                        filename=filename)
+
+    def test_true_positive_bare_device_put(self, tmp_path):
+        findings = self._run(tmp_path, """
+            import jax
+            def stage(batch):
+                return jax.device_put(batch)
+        """)
+        assert [f.rule for f in findings] == ["AIL014"]
+        assert "default device" in findings[0].message
+
+    def test_true_positive_bare_device_get(self, tmp_path):
+        findings = self._run(tmp_path, """
+            import jax
+            def fetch(out):
+                return jax.device_get(out)
+        """, filename="ai4e_tpu/parallel/mod.py")
+        assert [f.rule for f in findings] == ["AIL014"]
+        assert "fetch_to_host" in findings[0].message
+
+    def test_from_import_alias_resolved(self, tmp_path):
+        findings = self._run(tmp_path, """
+            from jax import device_put as put
+            def stage(batch):
+                return put(batch)
+        """)
+        assert [f.rule for f in findings] == ["AIL014"]
+
+    def test_positional_sharding_is_placed(self, tmp_path):
+        findings = self._run(tmp_path, """
+            import jax
+            def stage(batch, sharding, device):
+                a = jax.device_put(batch, sharding)
+                b = jax.device_put(batch, device)
+                return a, b
+        """)
+        assert findings == []
+
+    def test_placement_kwargs_are_placed(self, tmp_path):
+        findings = self._run(tmp_path, """
+            import jax
+            def stage(batch, s, d):
+                a = jax.device_put(batch, sharding=s)
+                b = jax.device_put(batch, device=d)
+                return a, b
+        """)
+        assert findings == []
+
+    def test_blessed_helper_module_exempt(self, tmp_path):
+        findings = self._run(tmp_path, """
+            import jax
+            def fetch_to_host(out):
+                return jax.device_get(out)
+        """, filename="ai4e_tpu/runtime/mesh/placement.py")
+        assert findings == []
+
+    def test_outside_device_path_not_flagged(self, tmp_path):
+        findings = self._run(tmp_path, """
+            import jax
+            def load(x):
+                return jax.device_put(x)
+        """, filename="ai4e_tpu/bench.py")
+        assert findings == []
+
+    def test_whole_repo_baseline_empty(self):
+        """The real tree: every transfer on the serving path is placed
+        (registry's fetches route through placement.fetch_to_host) —
+        the gate CI enforces from this PR on."""
+        from ai4e_tpu.analysis.rules.unplaced import UnplacedDeviceTransfer
+        pkg = os.path.join(REPO, "ai4e_tpu")
+        paths = []
+        for dirpath, _dirs, files in os.walk(pkg):
+            paths.extend(os.path.join(dirpath, f)
+                         for f in files if f.endswith(".py"))
+        result = Analyzer([UnplacedDeviceTransfer()],
+                          root=REPO).run(sorted(paths))
+        assert [f.render() for f in result.findings] == []
+
     def test_blessed_label_named_variable(self, tmp_path):
         # The two-line idiom: map first, label with the mapped value.
         findings = self._run(tmp_path, """

@@ -1,0 +1,326 @@
+"""Mesh serving plane e2e (PR 17, docs/mesh_serving.md): a worker built by
+``cli.build_worker`` with ``AI4E_RUNTIME_MESH_SPEC`` serves through a
+NamedSharding mesh endpoint on the CPU host-device substrate (conftest
+forces 8 host devices), and the contract holds at every layer:
+
+- **correctness**: meshed results are byte-identical to the unmeshed
+  oracle, and mesh=off leaves the worker byte-identical (unwrapped);
+- **introspection**: the validated layout + live health ride
+  ``GET {prefix}/models``;
+- **failure semantics**: a poisoned row (``AI4E_FAULT_MESH_POISON_NTHS``)
+  completes the batch's other rows and redelivers ONLY its own task —
+  RETRY visible in the hop ledger, exactly one client-visible completion
+  per task (the chaos half of tests/test_race_regressions.py's
+  interleaving proof);
+- **orchestration**: distinct mesh shapes are distinct cost tiers — the
+  placement walk routes a deadline-bearing request to the cheapest tier
+  whose completion estimate clears the budget.
+"""
+
+import asyncio
+import io
+import time
+
+import numpy as np
+import pytest
+
+from ai4e_tpu.config import FrameworkConfig
+from ai4e_tpu.runtime.mesh import MeshLayout, MeshSpecError, parse_mesh_spec
+
+DEVICES = 8  # conftest: --xla_force_host_platform_device_count=8
+
+
+def _build(mesh_spec="", hop_ledger=False):
+    from ai4e_tpu.cli import build_worker
+    config = FrameworkConfig()
+    config.runtime.mesh_spec = mesh_spec
+    config.observability.hop_ledger = hop_ledger
+    return build_worker(config, {
+        "service_name": "w", "prefix": "v1/echo",
+        "models": [{"family": "echo", "name": "echo", "size": 4,
+                    "buckets": [DEVICES], "async_path": "/echo-async"}]})
+
+
+# ---------------------------------------------------------------------------
+# Spec grammar (stdlib-only — the same module the rig and race harness use)
+# ---------------------------------------------------------------------------
+
+class TestMeshSpecGrammar:
+    def test_parse_and_describe_round_trip(self):
+        layout = MeshLayout.parse("dp=2,tp=2,sp=2")
+        assert (layout.dp, layout.tp, layout.sp) == (2, 2, 2)
+        assert layout.size == 8
+        d = layout.describe()
+        assert MeshLayout.parse(d["spec"]) == layout
+        assert d["data_axis_multiple"] == 2
+
+    def test_tier_labels_elide_unit_axes(self):
+        assert MeshLayout.parse("dp=8").tier_label == "mesh-dp8"
+        assert MeshLayout.parse("tp=4").tier_label == "mesh-tp4"
+        assert MeshLayout.parse("dp=2,tp=2").tier_label == "mesh-dp2tp2"
+        assert MeshLayout().tier_label == "mesh-dp1"
+
+    def test_off_spellings_mean_mesh_off(self):
+        assert parse_mesh_spec(None) is None
+        assert parse_mesh_spec("") is None
+        assert parse_mesh_spec("  off ") is None
+        assert parse_mesh_spec("dp=4") == MeshLayout(dp=4)
+
+    @pytest.mark.parametrize("bad", ["dp", "dp=0", "dp=x", "ep=2",
+                                     "dp=2,dp=4", ","])
+    def test_bad_specs_are_named_errors(self, bad):
+        with pytest.raises(MeshSpecError):
+            MeshLayout.parse(bad)
+
+    def test_validate_names_the_device_gap_and_the_cpu_substrate_fix(self):
+        with pytest.raises(MeshSpecError,
+                           match="xla_force_host_platform_device_count"):
+            MeshLayout.parse("dp=3").validate(DEVICES)
+
+    def test_validate_requires_even_process_split(self):
+        with pytest.raises(MeshSpecError, match="split evenly"):
+            MeshLayout.parse("dp=8").validate(8, process_count=3)
+
+
+# ---------------------------------------------------------------------------
+# The mesh endpoint on the real device path
+# ---------------------------------------------------------------------------
+
+class TestMeshEndpointE2E:
+    def test_meshed_results_byte_identical_to_unmeshed_oracle(self):
+        meshed, _b1, _t1 = _build("dp=8")
+        plain, _b2, _t2 = _build("")
+        # mesh=off is the unwrapped runtime — byte-identical worker.
+        assert hasattr(meshed.runtime, "layout")
+        assert not hasattr(plain.runtime, "layout")
+        assert meshed.runtime.layout.tier_label == "mesh-dp8"
+        assert meshed.runtime.supports_split_phases() == \
+            plain.runtime.supports_split_phases()
+
+        rng = np.random.default_rng(20260803)
+        batch = rng.standard_normal((DEVICES, 4)).astype(np.float32)
+        out_mesh, poisoned = meshed.runtime.run_batch_report("echo", batch)
+        out_plain = plain.runtime.run_batch("echo", batch)
+        assert poisoned == frozenset()
+        assert np.asarray(out_mesh).tobytes() == \
+            np.asarray(out_plain).tobytes()
+
+    def test_distinct_shapes_are_distinct_tiers(self):
+        worker, _b, _t = _build("dp=4,tp=2")
+        desc = worker.runtime.describe()
+        assert desc["tier"] == "mesh-dp4tp2"
+        assert desc["devices"] == DEVICES
+        assert desc["data_axis_multiple"] == 4
+        assert desc["healthy"] is True
+
+    def test_models_endpoint_exposes_the_layout(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def main():
+            worker, _b, _t = _build("dp=8")
+            client = TestClient(TestServer(worker.service.app))
+            await client.start_server()
+            try:
+                resp = await client.get("/v1/echo/models")
+                body = await resp.json()
+            finally:
+                await client.close()
+            entry = body["models"][0]
+            assert entry["mesh"]["spec"] == "dp=8"
+            assert entry["mesh"]["tier"] == "mesh-dp8"
+            assert entry["mesh"]["healthy"] is True
+
+        asyncio.run(main())
+
+    def test_mesh_spec_and_axis_knobs_are_mutually_exclusive(self):
+        config = FrameworkConfig()
+        config.runtime.mesh_spec = "dp=8"
+        config.runtime.tp = 2
+        from ai4e_tpu.cli import build_worker
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            build_worker(config, {"service_name": "w", "prefix": "v1/e",
+                                  "models": []})
+
+    def test_mesh_spec_must_cover_the_visible_devices(self):
+        with pytest.raises(MeshSpecError,
+                           match="xla_force_host_platform_device_count"):
+            _build("dp=3")
+
+
+class TestPartitionRules:
+    def test_unmatched_params_fail_with_every_path_named(self):
+        from jax.sharding import PartitionSpec as P
+
+        from ai4e_tpu.runtime.mesh.placement import match_partition_rules
+        params = {"dense": {"kernel": np.zeros((4, 4)),
+                            "bias": np.zeros((4,))},
+                  "gamma": np.zeros((4,))}
+        with pytest.raises(ValueError) as err:
+            match_partition_rules([(r".*kernel", P(None, "tp"))], params)
+        # Every unmapped param named at once, not one per retry.
+        assert "dense/bias" in str(err.value)
+        assert "gamma" in str(err.value)
+
+    def test_catch_all_completes_the_mapping(self):
+        from jax.sharding import PartitionSpec as P
+
+        from ai4e_tpu.runtime.mesh.placement import match_partition_rules
+        params = {"dense": {"kernel": np.zeros((4, 4)),
+                            "bias": np.zeros((4,))}}
+        specs = match_partition_rules(
+            [(r".*kernel", P(None, "tp")), (r".*", P())], params)
+        assert specs["dense/kernel"] == P(None, "tp")
+        assert specs["dense/bias"] == P()
+
+
+# ---------------------------------------------------------------------------
+# Mesh shapes as orchestration cost tiers
+# ---------------------------------------------------------------------------
+
+MESH_DP8 = "http://pool-a:9/v1/echo-mesh-dp8/run-async"
+MESH_DP4TP2 = "http://pool-b:9/v1/echo-mesh-dp4tp2/run-async"
+TIERS = [(MESH_DP8, 1.0), (MESH_DP4TP2, 1.0)]
+
+
+class TestMeshCostTiers:
+    """The placement walk prices mesh shapes by tier label — the label a
+    mesh worker's route carries (``spec.tier_label``) is the substring
+    the cost map keys on, so no orchestration code knows about meshes."""
+
+    @staticmethod
+    def _orch():
+        from ai4e_tpu.metrics.registry import MetricsRegistry
+        from ai4e_tpu.orchestration.core import (OrchestrationPolicy,
+                                                 Orchestrator)
+        from ai4e_tpu.resilience.health import (BackendHealth,
+                                                ResiliencePolicy)
+        health = BackendHealth(ResiliencePolicy(failure_threshold=2),
+                               metrics=MetricsRegistry())
+        # The dp=8 pool is the cheap tier (small model, commodity slice);
+        # the dp=4,tp=2 pool is the expensive one (big-model slice).
+        policy = OrchestrationPolicy(
+            costs={"mesh-dp8": 1.0, "mesh-dp4tp2": 4.0})
+        orch = Orchestrator(health, policy=policy,
+                            metrics=MetricsRegistry())
+        for _ in range(8):
+            orch.observe(MESH_DP8, 0.8)       # cheap but slow
+            orch.observe(MESH_DP4TP2, 0.01)   # expensive but fast
+        return orch
+
+    def test_tier_labels_price_the_walk(self):
+        orch = self._orch()
+        assert orch.cost_of(MESH_DP8) == 1.0
+        assert orch.cost_of(MESH_DP4TP2) == 4.0
+
+    def test_no_deadline_takes_the_cheapest_mesh_tier(self):
+        assert self._orch().place(TIERS) == MESH_DP8
+
+    def test_generous_deadline_stays_on_the_cheap_tier(self):
+        orch = self._orch()
+        assert orch.place(TIERS, deadline_at=time.time() + 5.0) == MESH_DP8
+
+    def test_tight_deadline_routes_to_the_tier_that_clears(self):
+        orch = self._orch()
+        # 100 ms budget: the dp=8 tier's 800 ms estimate can never clear
+        # it; the walk escalates to the expensive mesh shape that does.
+        chosen = orch.place(TIERS, deadline_at=time.time() + 0.1)
+        assert chosen == MESH_DP4TP2
+
+
+# ---------------------------------------------------------------------------
+# Poisoned-row chaos: per-task redelivery on the full async path
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestPoisonedRowRedeliveryE2E:
+    def test_poisoned_row_redelivers_only_its_task(self, monkeypatch):
+        """Batch 1 gets one injected poisoned row. Every accepted task
+        still completes exactly once (the poisoned one via broker
+        redelivery, stamped RETRY/poisoned-row in its hop ledger); the
+        batch's other rows complete in place; no whole-batch failure."""
+        monkeypatch.setenv("AI4E_FAULT_MESH_POISON_NTHS", "1")
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from ai4e_tpu.observability.ledger import RETRY
+        from ai4e_tpu.platform_assembly import LocalPlatform, PlatformConfig
+        from ai4e_tpu.taskstore import TaskStatus
+
+        async def serve_app(app):
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            return client
+
+        async def main():
+            platform = LocalPlatform(PlatformConfig(retry_delay=0.05))
+            worker, batcher, _tm = _build("dp=8", hop_ledger=True)
+            worker.service.task_manager = platform.task_manager
+            worker.store = platform.store
+
+            # Exactly-once client-visible completions, off the store's
+            # change feed (the chaos/invariants.py discipline).
+            prev: dict[str, str] = {}
+            completions: dict[str, int] = {}
+
+            def _count(task):
+                cur = task.canonical_status
+                if (cur == TaskStatus.COMPLETED
+                        and prev.get(task.task_id) != TaskStatus.COMPLETED):
+                    completions[task.task_id] = (
+                        completions.get(task.task_id, 0) + 1)
+                prev[task.task_id] = cur
+
+            platform.store.add_listener(_count)
+
+            await batcher.start()
+            svc = await serve_app(worker.service.app)
+            base = str(svc.make_url("")).rstrip("/")
+            platform.publish_async_api("/v1/pub/echo",
+                                       base + "/v1/echo/echo-async")
+            gw = await serve_app(platform.gateway.app)
+            await platform.start()
+            try:
+                tids = []
+                for i in range(3):
+                    buf = io.BytesIO()
+                    np.save(buf, np.full(4, float(i + 1), np.float32))
+                    resp = await gw.post("/v1/pub/echo",
+                                         data=buf.getvalue())
+                    assert resp.status == 200, resp.status
+                    tids.append((await resp.json())["TaskId"])
+
+                deadline = asyncio.get_running_loop().time() + 30.0
+                while asyncio.get_running_loop().time() < deadline:
+                    stats = {t: platform.store.get(t).canonical_status
+                             for t in tids}
+                    if all(s == TaskStatus.COMPLETED
+                           for s in stats.values()):
+                        break
+                    assert TaskStatus.FAILED not in stats.values(), (
+                        f"poisoned row escalated to a task failure: "
+                        f"{stats}")
+                    await asyncio.sleep(0.02)
+                else:
+                    raise AssertionError(f"tasks never drained: {stats}")
+
+                # Never a duplicate client-visible completion.
+                assert all(completions.get(t) == 1 for t in tids), (
+                    completions)
+                # Exactly one task was redelivered, and its timeline says
+                # why (the per-task retry the ledger makes auditable).
+                retried = [t for t in tids
+                           if any(e.get("e") == RETRY
+                                  and e.get("r") == "poisoned-row"
+                                  for e in platform.store.get_ledger(t))]
+                assert len(retried) == 1, (
+                    f"expected exactly one poisoned-row redelivery, "
+                    f"got {retried}")
+                # The mesh endpoint stayed healthy: one poisoned batch is
+                # below the consecutive-degrade threshold.
+                assert worker.runtime.health.healthy
+            finally:
+                await platform.stop()
+                await batcher.stop()
+                await gw.close()
+                await svc.close()
+
+        asyncio.run(main())

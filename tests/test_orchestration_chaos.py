@@ -72,7 +72,14 @@ def _platform(tmp_path=None, replicas=0, **extra):
         **extra), metrics=MetricsRegistry())
 
 
-def _completing_app(platform, latency_s: float = 0.0) -> web.Application:
+#: The fast tier serves through a mesh endpoint (PR 17): its tier label
+#: rides the delivery route as a URI substring, which is exactly what the
+#: orchestrator's cost map keys on (docs/mesh_serving.md#cost-tiers).
+MESH_ROUTE = "/v1/be/mesh-dp2tp2/x"
+
+
+def _completing_app(platform, latency_s: float = 0.0,
+                    route: str = "/v1/be/x") -> web.Application:
     """A worker that adopts (``running``) then completes tasks, both via
     conditional writes — the service-shell discipline an at-least-once
     transport requires. Adoption matters here: a slow tier's in-service
@@ -92,24 +99,23 @@ def _completing_app(platform, latency_s: float = 0.0) -> web.Application:
         return web.Response(text="ok")
 
     app = web.Application()
-    app.router.add_post("/v1/be/x", handler)
+    app.router.add_post(route, handler)
     return app
 
 
 async def _mixed_fleet(platform):
-    """3 fast TPU-class backends + 1 slow CPU-class fallback, one route.
-    Host names carry the tier tag the cost map keys on."""
+    """3 fast mesh-tier backends (replicas of one dp=2,tp=2 serving mesh,
+    so one cost tier) + 1 slow CPU-class fallback. Loopback hosts carry
+    no tier names, so the mesh tier's tag rides its route path — the
+    same place a real mesh worker's tier label lives."""
     tpus = []
     for _ in range(3):
-        be = await RestartableBackend(_completing_app(platform)).start()
+        be = await RestartableBackend(
+            _completing_app(platform, route=MESH_ROUTE)).start()
         tpus.append(be)
     cpu = await RestartableBackend(
         _completing_app(platform, latency_s=CPU_LATENCY_S)).start()
-    # The injector and the cost map match on URL substrings; loopback
-    # URIs carry no tier names, so weight them in via the path instead:
-    # register with rewritten URIs is impossible (the port IS the host),
-    # so tag via a path prefix.
-    uris = [f"{be.url}/v1/be/x" for be in tpus] + [f"{cpu.url}/v1/be/x"]
+    uris = [f"{be.url}{MESH_ROUTE}" for be in tpus] + [f"{cpu.url}/v1/be/x"]
     return tpus, cpu, uris
 
 
@@ -161,12 +167,10 @@ async def _drive_dark_fleet(dark: bool, tmp_path=None) -> dict:
     tpu[0] for the middle third. Returns the scorecard."""
     platform = _platform()
     tpus, cpu, uris = await _mixed_fleet(platform)
-    # Tier tags for cost + injector matching ride the weighted set as
-    # URI substrings can't (loopback hosts): use per-backend cost via
-    # explicit map on the orchestrator instead.
+    # One substring prices the whole mesh tier (all three replicas);
+    # the CPU fallback is priced by its port.
     platform.orchestration.policy.costs = {
-        **{f":{be.port}": 3.0 for be in tpus},
-        f":{cpu.port}": 1.0}
+        "mesh-dp2tp2": 3.0, f":{cpu.port}": 1.0}
     platform.publish_async_api("/v1/pub/x", [(u, 1.0) for u in uris])
 
     checker = InvariantChecker(
@@ -175,7 +179,7 @@ async def _drive_dark_fleet(dark: bool, tmp_path=None) -> dict:
 
     injector = FaultInjector(seed=SEED)
     injector.add_rule(error_rate=0.08, error_status=500)
-    injector.add_rule(backend="/v1/be/x", duplicate_rate=0.05)
+    injector.add_rule(backend="/v1/be/", duplicate_rate=0.05)
     wrap_platform_http(platform, injector)
     wrap_publish_duplicates(platform, injector)
 
@@ -330,8 +334,7 @@ class TestShardFailoverDuringBrownout:
                                  orchestration_ladder_hold_s=0.3)
             tpus, cpu, uris = await _mixed_fleet(platform)
             platform.orchestration.policy.costs = {
-                **{f":{be.port}": 3.0 for be in tpus},
-                f":{cpu.port}": 1.0}
+                "mesh-dp2tp2": 3.0, f":{cpu.port}": 1.0}
             platform.publish_async_api("/v1/pub/x",
                                        [(u, 1.0) for u in uris])
             checker = InvariantChecker(

@@ -1508,3 +1508,115 @@ class TestTenantFairDequeueVsWeightUpdate:
             "the snapshot-rebuild lost-put window was not reachable — "
             "either the replica stopped rebuilding across an await or "
             "the schedule budget is too small")
+
+
+# -- PR 17: mesh poisoned-row redelivery vs duplicate completion --------------
+
+
+async def _reverted_whole_batch_fail(tm, batch):
+    """Verbatim pre-mesh batch failure path: any bad row fails EVERY task
+    in the batch, unconditionally — no per-row attribution and no
+    terminal re-check before the write (the behavior
+    ``runtime/mesh/redelivery.py`` replaced). A duplicate delivery that
+    completed one of those tasks concurrently gets its COMPLETED
+    clobbered to FAILED — a client-visible double outcome."""
+    for tid in batch:
+        await yield_point()  # the per-task store hop
+        await tm.update_task_status(tid, "failed: mesh host degraded",
+                                    TaskStatus.FAILED)
+
+
+class TestMeshPoisonedRowRedelivery:
+    """PR 17's degraded-batch contract (``docs/mesh_serving.md``): a
+    poisoned row redelivers exactly its own task; the other rows
+    complete; a concurrently-finishing duplicate delivery is suppressed
+    against the terminal record — never a duplicate client-visible
+    completion, never a whole-batch fail. Three racers: the worker's
+    poison handling (REAL ``redeliver_poisoned``), a duplicate delivery
+    completing the poisoned task on another replica, and the mesh
+    coordinator flipping endpoint health over the same degrade."""
+
+    @staticmethod
+    def _scenario(fixed: bool):
+        from ai4e_tpu.runtime.mesh import (EndpointHealth, MeshCoordinator,
+                                           MeshLayout, RowPoisoned,
+                                           redeliver_poisoned)
+
+        def make():
+            store = InMemoryTaskStore()
+            tm = TracedTaskManager(LocalTaskManager(store))
+            _seeded_task(store, None, task_id="t1")  # the poisoned row
+            _seeded_task(store, None, task_id="t2")  # a clean row, same batch
+            invariant = TerminalInvariant(store)
+            health = EndpointHealth()
+            coordinator = MeshCoordinator(MeshLayout(dp=2), health=health,
+                                          process_count=2, unhealthy_after=2)
+            completions = {"t1": 0, "t2": 0}
+
+            async def _complete_if_fresh(tid):
+                # Every completer is a redelivery consumer: conditional
+                # transition, duplicate-suppressed against a record a
+                # concurrent path may already have finished.
+                res = await tm.update_task_status_if(
+                    tid, TaskStatus.CREATED, "completed",
+                    TaskStatus.COMPLETED)
+                if res is not None:
+                    completions[tid] += 1
+
+            async def mesh_batch():
+                # The worker's async path over a degraded batch: t1's
+                # future failed with RowPoisoned, t2's row is clean.
+                poison = RowPoisoned()
+                assert "invalidated" in str(poison)
+                if not fixed:
+                    await _reverted_whole_batch_fail(tm, ("t1", "t2"))
+                    return
+                await _complete_if_fresh("t2")
+                republished = await redeliver_poisoned(tm, "t1", "/v1/q/op")
+                if republished:
+                    # The broker redelivers; the consumer's completion is
+                    # conditional like any redelivery consumer's.
+                    await yield_point()
+                    await _complete_if_fresh("t1")
+
+            async def duplicate_completer():
+                # A duplicate delivery of t1 finishing on another replica,
+                # concurrent with the poison handling — its response hop
+                # is the one suspension before the completion.
+                await yield_point()
+                await _complete_if_fresh("t1")
+
+            async def health_flip():
+                # The coordinator's view of the same degrade: two
+                # consecutive poisoned gathers flip the endpoint
+                # unhealthy (admission starts answering 500 so breakers
+                # eject it); one clean gather heals it.
+                for flags in ([0, 1], [0, 1], [0, 0]):
+                    await yield_point()
+                    coordinator.observe_poison(flags)
+
+            def check():
+                invariant.check()
+                assert health.healthy, (
+                    f"clean gather did not heal the endpoint: "
+                    f"{health.reason}")
+                if fixed:
+                    assert completions == {"t1": 1, "t2": 1}, (
+                        f"client-visible completions drifted (want exactly "
+                        f"one per task): {completions}")
+
+            return ([mesh_batch(), duplicate_completer(), health_flip()],
+                    check)
+
+        return make
+
+    def test_fixed_poisoned_row_race_free(self):
+        report = explore_interleavings(self._scenario(fixed=True),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_reverted_whole_batch_fail_caught(self):
+        report = explore_interleavings(self._scenario(fixed=False),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert not report.ok
+        assert "clobbered" in str(report.failures[0].error)

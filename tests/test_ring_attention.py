@@ -2,13 +2,18 @@
 must match single-device full attention bit-for-near-bit on the 8-way CPU
 mesh, causal and non-causal."""
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from ai4e_tpu.parallel import MeshSpec, make_mesh
-from ai4e_tpu.parallel.ring_attention import (
+jax = pytest.importorskip("jax")
+# Skip (not error) when this jax build has no usable shard_map — same
+# posture as conftest's jax-guard, so tier-1 collection stays clean.
+pytest.importorskip("ai4e_tpu.parallel.ring_attention")
+
+import jax.numpy as jnp  # noqa: E402
+
+from ai4e_tpu.parallel import MeshSpec, make_mesh  # noqa: E402
+from ai4e_tpu.parallel.ring_attention import (  # noqa: E402
     reference_attention,
     ring_attention,
     ulysses_attention,
