@@ -1,7 +1,7 @@
 # Contributor conveniences. Each target reproduces the matching CI job
 # with the SAME flags (the scripts are the single source of truth).
 
-.PHONY: lint test race-smoke chaos durability rig top timeline mesh
+.PHONY: lint test race-smoke chaos durability rig top timeline mesh upgrade
 
 # Both lint gates CI runs (ruff correctness rules + ai4e-lint, see
 # scripts/lint.sh and docs/analysis.md).
@@ -51,6 +51,26 @@ rig:
 	  --dispatchers 1 --workers 1 --loadgens 2 --rate 1500 \
 	  --duration 15 --ramp 3 --task-timeout 45 --seed 20260803 \
 	  --workdir /tmp/ai4e-rig --out /tmp/ai4e-rig/artifact
+
+# The rolling-upgrade scenarios (upgrade-smoke job, docs/
+# deployment.md#rollouts) at CI's pinned seed: drain + restart every
+# worker at generation 2 under load (clean: must promote with zero
+# client-visible loss), then the seeded bad canary (must auto-rollback
+# before its share passes 50%, with `rollback` ledger evidence). Chaos
+# off — the upgrade IS the disruption under test. JAX-free.
+upgrade:
+	python -m ai4e_tpu.rig up --gateways 2 --shards 1 --replicas 1 \
+	  --dispatchers 1 --workers 2 --loadgens 2 --rate 300 \
+	  --duration 22 --ramp 2 --task-timeout 45 --seed 20260803 \
+	  --no-chaos --rollout clean --rollout-steps 50,100 \
+	  --rollout-hold-s 2 --rollout-drain-timeout-ms 4000 \
+	  --workdir /tmp/ai4e-upgrade --out /tmp/ai4e-upgrade/clean
+	python -m ai4e_tpu.rig up --gateways 2 --shards 1 --replicas 1 \
+	  --dispatchers 1 --workers 2 --loadgens 2 --rate 300 \
+	  --duration 25 --ramp 2 --task-timeout 45 --seed 20260803 \
+	  --no-chaos --rollout bad-canary --rollout-steps 25,50,100 \
+	  --rollout-hold-s 3 --rollout-drain-timeout-ms 4000 \
+	  --workdir /tmp/ai4e-upgrade --out /tmp/ai4e-upgrade/bad-canary
 
 # The durable-truth gate (docs/durability.md) with CI's pinned seed
 # (durability-smoke job): journal envelope/salvage/fsync/degraded units

@@ -9,6 +9,7 @@ from .lock_await import LockAcrossSlowAwait
 from .metric_label import UnboundedMetricLabel
 from .metrics_drift import MetricsDrift
 from .registry_leak import MetricsRegistryLeak
+from .retry_after import RefusalWithoutRetryAfter
 from .rmw import NonatomicReadModifyWrite
 from .stale_read import StaleReadAcrossAwait
 from .status_clobber import TerminalStatusClobber
@@ -30,6 +31,7 @@ ALL_RULES = [
     StaticBucketLadder,
     UnboundedMetricLabel,
     UnplacedDeviceTransfer,
+    RefusalWithoutRetryAfter,
 ]
 
 __all__ = ["ALL_RULES"] + [cls.__name__ for cls in ALL_RULES]

@@ -40,7 +40,13 @@ WRITE_METHODS = frozenset({"inc", "dec", "set", "observe"})
 #: values that MUST be bounded before becoming a series dimension.
 IDENTITY_LABELS = frozenset({"tenant", "tenant_id", "api_key",
                              "subscription_key", "caller", "client_id",
-                             "identity", "user", "user_id"})
+                             "identity", "user", "user_id",
+                             # Rollout generations are unbounded over a
+                             # process lifetime (a weekly reload mints a
+                             # new one forever) — the generation_label
+                             # mapper (rollout/canary.py) is the blessed
+                             # top-N+other fold.
+                             "generation"})
 
 
 def _is_blessed(value: ast.AST) -> bool:

@@ -1,0 +1,120 @@
+"""AIL015 — refusal without Retry-After.
+
+The bug class: a 429/503 is the platform telling a caller "not now, try
+again" — and every refusal surface the platform ships has a caller that
+OBEYS retry metadata: the dispatcher's backpressure redelivery derives
+its delay from ``Retry-After`` (``broker/dispatcher.py``), the tenant
+quota edge composes the token bucket's drain time into it
+(``tenancy/``), and the shedder's contract since PR 9 is "every 503
+carries the cost of coming back". A refusal WITHOUT the header degrades
+each of those callers to blind exponential guessing — the retry storm
+arrives exactly when the platform is least able to absorb it. PR 18's
+drain path raises the stakes: a draining worker's 503 is an explicit
+"retry a peer NOW", and a missing header there turns an orderly rollout
+into visible latency.
+
+The rule flags ``web.Response``/``web.json_response`` (and bare
+``Response``/``json_response``) calls whose ``status=`` is the literal
+429 or 503 when the ``headers=`` argument is absent or is a dict literal
+with no ``Retry-After`` key (case-insensitive). Scope is the code that
+answers callers over HTTP — ``gateway/``, ``rig/``, and the worker's
+serving surface (``runtime/worker.py``) — matching the ISSUE's refusal
+inventory; non-literal ``headers=`` values are accepted (the mapping was
+built elsewhere — the rule polices the idiom, not the dataflow).
+Deliberate exceptions (e.g. rotate markers whose callers rotate instead
+of waiting) carry ``# ai4e: noqa[AIL015]`` with the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, enclosing_symbol
+
+#: Response constructors whose kwargs carry the refusal.
+RESPONSE_CALLS = frozenset({"Response", "json_response"})
+#: Statuses that mean "come back later" — and so must say when.
+RETRYABLE_STATUSES = frozenset({429, 503})
+
+
+def _status_of(node: ast.Call) -> int | None:
+    for kw in node.keywords:
+        if (kw.arg == "status" and isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, int)):
+            return kw.value.value
+    return None
+
+
+def _headers_carry_retry_after(node: ast.Call) -> bool:
+    """True when headers= visibly carries Retry-After OR is dynamic
+    (built elsewhere — not this rule's business)."""
+    for kw in node.keywords:
+        if kw.arg != "headers":
+            continue
+        value = kw.value
+        if not isinstance(value, ast.Dict):
+            return True  # dynamic mapping — accepted
+        for key in value.keys:
+            if key is None:
+                return True  # **spread — accepted (dynamic)
+            if (isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    and key.value.lower() == "retry-after"):
+                return True
+        return False
+    return False  # no headers= at all
+
+
+def _in_scope(path: str) -> bool:
+    return ("gateway/" in path or "rig/" in path
+            or path.endswith("runtime/worker.py"))
+
+
+class RefusalWithoutRetryAfter(Rule):
+    rule_id = "AIL015"
+    name = "refusal-without-retry-after"
+    description = ("429/503 refusals on the gateway/worker/rig HTTP "
+                   "surfaces must carry Retry-After — a refusal without "
+                   "retry metadata turns every well-behaved caller into "
+                   "a blind retry storm")
+
+    def check_module(self, ctx):
+        if not _in_scope(ctx.path):
+            return []
+        rule = self
+
+        class _Visitor(ast.NodeVisitor):
+            def __init__(self):
+                self.findings = []
+                self._stack: list[ast.AST] = []
+
+            def _enter(self, node):
+                self._stack.append(node)
+                self.generic_visit(node)
+                self._stack.pop()
+
+            visit_ClassDef = _enter
+            visit_FunctionDef = _enter
+            visit_AsyncFunctionDef = _enter
+
+            def visit_Call(self, node):
+                fn = node.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else "")
+                if name in RESPONSE_CALLS:
+                    status = _status_of(node)
+                    if (status in RETRYABLE_STATUSES
+                            and not _headers_carry_retry_after(node)):
+                        self.findings.append(ctx.finding(
+                            rule.rule_id, node,
+                            f"{status} refusal without Retry-After — "
+                            "callers (dispatcher backpressure, quota-"
+                            "aware clients) derive their retry delay "
+                            "from it; add headers={'Retry-After': ...} "
+                            "or justify why this caller must not wait",
+                            symbol=enclosing_symbol(self._stack)))
+                self.generic_visit(node)
+
+        visitor = _Visitor()
+        visitor.visit(ctx.tree)
+        return visitor.findings

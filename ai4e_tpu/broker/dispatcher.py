@@ -410,6 +410,7 @@ class Dispatcher:
                         target, data=msg.body, headers=headers,
                     ) as resp:
                         status = resp.status
+                        draining = resp.headers.get("X-Draining")
                         await resp.read()
                     span.attrs["http_status"] = status
                     if not (200 <= status < 300
@@ -449,6 +450,13 @@ class Dispatcher:
                 if self.orchestration is not None:
                     self.orchestration.end(base)
 
+            if draining and self.resilience is not None:
+                # The worker said it is LEAVING (rollout drain, not
+                # saturation): eject it from placement for a TTL so the
+                # redelivered task lands on a peer — saturation-neutral
+                # for the breaker, which _record_outcome already ensures
+                # for the 503 itself (docs/deployment.md#drain).
+                self.resilience.mark_draining(base)
             self._record_outcome(base, status=status)
             if 200 <= status < 300:
                 self.broker.complete(msg)

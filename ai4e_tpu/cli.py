@@ -424,8 +424,14 @@ def build_worker(config: FrameworkConfig, models: dict):
         # the open single-host behavior.
         checkpoint_root=rt.checkpoint_dir,
         admin_api_keys=admin_keys,
-        hop_ledger=config.observability.hop_ledger)
+        hop_ledger=config.observability.hop_ledger,
+        drain_timeout_s=config.rollout.drain_timeout_ms / 1000.0)
     for servable, sync_path, async_path, cap, pipeline_spec, batch in to_serve:
+        if config.rollout.generation:
+            # The deploy generation this process serves (rollout/): the
+            # rollout controller bumps it per respawn; 0 keeps the
+            # registry default.
+            servable.generation = config.rollout.generation
         worker.serve_model(servable, sync_path=sync_path,
                            async_path=async_path,
                            maximum_concurrent_requests=cap,

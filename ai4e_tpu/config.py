@@ -546,6 +546,35 @@ class TenancySection:
     min_quantum: float = 0.05
 
 
+@_env_section("AI4E_ROLLOUT_")
+class RolloutSection:
+    """Zero-downtime rollout knobs (rollout/, docs/deployment.md#rollouts):
+    the drain budget the worker's drain verb enforces and the canary
+    ladder/burn bars the rollout controller promotes against."""
+    # Per-worker graceful-drain budget: in-flight device batches, active
+    # decode sequences and in-flight reloads get this long to finish
+    # before stragglers are force-retired (each redelivers per task).
+    drain_timeout_ms: float = 30000.0
+    # Canary traffic-share ladder in percent, increasing, ending at 100
+    # (rollout/controller.parse_steps).
+    canary_steps: str = "25,50,100"
+    # Clean fast+slow burn window held at each ladder step before
+    # promoting to the next.
+    step_hold_s: float = 10.0
+    # Burn/breaker sampling period inside a hold.
+    guard_tick_s: float = 1.0
+    # Burn bars: roll back only when BOTH windows breach (the SLO
+    # engine's multi-window page shape, observability/slo.py).
+    burn_fast_max: float = 1.0
+    burn_slow_max: float = 1.0
+    # How long a drain-marked backend stays ejected from placement per
+    # X-Draining observation (resilience/health.mark_draining).
+    drain_eject_ttl_s: float = 30.0
+    # The deploy generation this process serves (registry's
+    # ServableModel.generation default for reloads that don't name one).
+    generation: int = 0
+
+
 @dataclass
 class FrameworkConfig:
     """The whole platform's config tree."""
@@ -556,6 +585,7 @@ class FrameworkConfig:
     observability: ObservabilitySection = field(
         default_factory=ObservabilitySection)
     tenancy: TenancySection = field(default_factory=TenancySection)
+    rollout: RolloutSection = field(default_factory=RolloutSection)
 
     @classmethod
     def from_env(cls, env: typing.Mapping[str, str] | None = None
@@ -591,6 +621,7 @@ class FrameworkConfig:
         pc.tenancy_label_top_n = self.tenancy.label_top_n
         pc.tenancy_goodput_target = self.tenancy.goodput_target
         pc.tenancy_min_quantum = self.tenancy.min_quantum
+        pc.rollout_drain_eject_ttl_s = self.rollout.drain_eject_ttl_s
         return pc
 
     def to_dict(self) -> dict:

@@ -1083,6 +1083,12 @@ class Gateway:
                                 # replaying a non-idempotent inference POST
                                 # that answered is not the proxy's call).
                                 res.observe_status(base, resp.status)
+                                if resp.headers.get("X-Draining"):
+                                    # Rollout drain marker: eject this
+                                    # backend from the proxy's picks for
+                                    # a TTL — it told us it is leaving
+                                    # (docs/deployment.md#drain).
+                                    res.mark_draining(base)
                             self._requests.inc(route=route.prefix,
                                                outcome=str(resp.status))
                             if (self._observability is not None

@@ -60,6 +60,11 @@ STAGE = "stage"              # pipeline stage boundary (r="name event" or
 CHUNK = "chunk"              # streaming first token (ms=TTFT; one stamp
                              # per request — a 512-token stream must not
                              # eat the event cap)
+ROLLOUT = "rollout"          # rollout transition (r="worker -> gen" /
+                             # "canary weight N%" — the controller's
+                             # evidence trail, docs/deployment.md)
+ROLLBACK = "rollback"        # rollout aborted (r=breach reason; the
+                             # canary burn/breaker trigger is in r)
 
 # Hard cap on events per task: a pathological retry loop must not grow
 # a record without bound. The overflow marker is itself an event, once.

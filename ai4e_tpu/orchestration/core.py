@@ -168,6 +168,19 @@ class Orchestrator:
         pool = [(u, w) for u, w in backends if u not in exclude and w > 0]
         if not pool:
             pool = [(u, w) for u, w in backends if w > 0]
+        # Drain eject (rollout/): route around a draining backend while
+        # any peer remains — an eject-from-placement, not a breaker
+        # event, so a planned upgrade never reads as a failure.
+        undrained = [(u, w) for u, w in pool
+                     if not self.health.is_draining(u)]
+        if undrained:
+            pool = undrained
+        # Canary split (rollout/canary.py): rescale so the canary
+        # generation's backends hold their configured traffic share; the
+        # rescaled weights carry through the in-tier weighted pick below.
+        if self.health.canary is not None:
+            pool = [(u, w) for u, w in self.health.canary.apply(pool)
+                    if w > 0] or pool
         avail = [(u, w) for u, w in pool
                  if self.health.breaker_for(u).available(now)]
         if not avail:

@@ -242,6 +242,10 @@ class PlatformConfig:
     # Floor on a lane's DRR credit per ring visit (guards pathological
     # weights; tenancy/lanes.py).
     tenancy_min_quantum: float = 0.05
+    # How long a drain-marked backend (503 + X-Draining) stays ejected
+    # from placement per observation (rollout/; AI4E_ROLLOUT_
+    # DRAIN_EJECT_TTL_S feeds this through FrameworkConfig).
+    rollout_drain_eject_ttl_s: float = 30.0
 
 
 class LocalPlatform:
@@ -397,7 +401,9 @@ class LocalPlatform:
                     max_attempts=self.config.resilience_max_attempts,
                     retry_base_s=self.config.resilience_retry_base_s,
                     retry_budget_ratio=(
-                        self.config.resilience_retry_budget_ratio)),
+                        self.config.resilience_retry_budget_ratio),
+                    drain_eject_ttl_s=(
+                        self.config.rollout_drain_eject_ttl_s)),
                 metrics=self.metrics)
         self.orchestration = None
         if self.config.orchestration:

@@ -51,6 +51,7 @@ class ResiliencePolicy:
     retry_base_s: float = 0.05       # first in-attempt retry delay (jittered)
     retry_cap_s: float = 1.0         # in-attempt retry delay ceiling
     retry_budget_ratio: float = 0.2  # retries per ordinary request, steady state
+    drain_eject_ttl_s: float = 30.0  # placement eject per X-Draining mark (rollout/)
 
 
 class BackendHealth:
@@ -82,6 +83,19 @@ class BackendHealth:
         self._probes = self.metrics.counter(
             "ai4e_resilience_probe_total",
             "Half-open/forced probe outcomes by backend")
+        # Drain ejections (rollout/, docs/deployment.md#drain): a backend
+        # that answered 503 + X-Draining told us it is LEAVING — eject it
+        # from placement for a TTL. Deliberately NOT a breaker state:
+        # draining is orderly, a breaker trip would smear a planned
+        # upgrade as a failure in every dashboard keyed on breaker
+        # transitions. uri -> monotonic deadline.
+        self._draining: dict[str, float] = {}
+        self._drain_ejections = self.metrics.counter(
+            "ai4e_rollout_drain_ejections_total",
+            "Weighted picks that routed around a draining backend")
+        # Canary split policy (rollout/canary.py CanaryWeights), attached
+        # by the assembly when a rollout is live; None = no reweighting.
+        self._canary = None
 
     # -- registry -----------------------------------------------------------
 
@@ -111,6 +125,55 @@ class BackendHealth:
         component (each dispatcher queue, the sync proxy)."""
         return RetryBudget(ratio=self.policy.retry_budget_ratio)
 
+    # -- drain eject (rollout/) ---------------------------------------------
+
+    def mark_draining(self, uri: str, ttl_s: float | None = None) -> None:
+        """Eject ``uri`` from placement for ``ttl_s`` (default: the
+        policy's ``drain_eject_ttl_s`` — AI4E_ROLLOUT_DRAIN_EJECT_TTL_S)
+        — called when a response carried ``X-Draining`` (the worker's
+        drain refusal) or by the rollout driver before it drains a
+        worker. TTL-bounded so a worker that comes back (rollback
+        resume, restart at the new generation) re-enters placement
+        without an explicit clear."""
+        if ttl_s is None:
+            ttl_s = self.policy.drain_eject_ttl_s
+        self._draining[uri] = self._clock() + max(0.0, ttl_s)
+
+    def clear_draining(self, uri: str) -> None:
+        self._draining.pop(uri, None)
+
+    def reset(self, uri: str) -> None:
+        """Forget a backend's breaker history and drain mark — the
+        rollout driver's post-restart hook: a deliberately replaced
+        process re-enters placement with a clean slate instead of
+        inheriting the connect failures its own restart window minted
+        (which would read as an open canary breaker and roll back a
+        healthy upgrade)."""
+        self._draining.pop(uri, None)
+        if self._breakers.pop(uri, None) is not None:
+            self._state_gauge.set(0, backend=self._label(uri))
+
+    def is_draining(self, uri: str) -> bool:
+        deadline = self._draining.get(uri)
+        if deadline is None:
+            return False
+        if self._clock() >= deadline:
+            del self._draining[uri]
+            return False
+        return True
+
+    # -- canary split (rollout/) --------------------------------------------
+
+    def attach_canary(self, canary) -> None:
+        """Attach a ``CanaryWeights`` policy: both placement surfaces
+        (``pick`` here, the orchestrator's in-tier choice) then split
+        in-tier traffic between generations."""
+        self._canary = canary
+
+    @property
+    def canary(self):
+        return self._canary
+
     # -- routing ------------------------------------------------------------
 
     def pick(self, backends: Weighted, rng: random.Random | None = None,
@@ -122,6 +185,20 @@ class BackendHealth:
         pool = [(u, w) for u, w in backends if u not in exclude and w > 0]
         if not pool:
             pool = [(u, w) for u, w in backends if w > 0]
+        # Drain eject (rollout/): a draining backend told us it is
+        # leaving — route around it while anyone else remains. When the
+        # WHOLE pool is draining (single-replica shard mid-upgrade) keep
+        # the pool: a drain refusal redelivers, a no-backend error loses.
+        undrained = [(u, w) for u, w in pool if not self.is_draining(u)]
+        if undrained and len(undrained) < len(pool):
+            for uri, _ in pool:
+                if self.is_draining(uri):
+                    self._drain_ejections.inc(backend=self._label(uri))
+            pool = undrained
+        # Canary split (rollout/canary.py): rescale so the canary
+        # generation holds its configured share of the pool's weight.
+        if self._canary is not None:
+            pool = self._canary.apply(pool)
         candidates = []
         ejected = []
         for uri, weight in pool:
@@ -129,6 +206,12 @@ class BackendHealth:
                 candidates.append((uri, weight))
             else:
                 ejected.append(uri)
+        if candidates and all(w <= 0 for _, w in candidates):
+            # The canary rescale can zero a subset (share 0 or 1); when
+            # breaker ejections leave ONLY that subset available, serve
+            # it evenly rather than crash the pick — a zero-weight
+            # survivor beats no backend at all.
+            candidates = [(u, 1.0) for u, _ in candidates]
         if candidates:
             # Ejections counted only when somebody healthy absorbed the
             # traffic — an all-dark set's forced probe below routes INTO

@@ -44,7 +44,11 @@ def _topology_from_args(args) -> Topology:
         loadgen_tenants=(json.loads(args.loadgen_tenants)
                          if args.loadgen_tenants else []),
         mesh=args.mesh, mesh_poison_nths=args.mesh_poison_nths,
-        mesh_recovery_s=args.mesh_recovery_s)
+        mesh_recovery_s=args.mesh_recovery_s,
+        rollout=args.rollout, rollout_error_rate=args.rollout_error_rate,
+        rollout_steps=args.rollout_steps,
+        rollout_hold_s=args.rollout_hold_s,
+        rollout_drain_timeout_ms=args.rollout_drain_timeout_ms)
 
 
 def main(argv=None) -> int:
@@ -125,6 +129,30 @@ def main(argv=None) -> int:
                     default=_env_float("AI4E_RIG_MESH_RECOVERY_S", 2.0),
                     help="seconds a flipped-unhealthy mesh worker stays "
                          "dark before its follower-restart probe")
+    up.add_argument("--rollout", default=os.environ.get("AI4E_RIG_ROLLOUT",
+                                                        ""),
+                    choices=["", "clean", "bad-canary"],
+                    help="rolling-upgrade scenario under load "
+                         "(docs/deployment.md#rollouts): 'clean' must "
+                         "promote with zero loss; 'bad-canary' seeds "
+                         "errors into generation 2 and must auto-rollback "
+                         "before its share passes 50%%")
+    up.add_argument("--rollout-error-rate", type=float,
+                    default=_env_float("AI4E_RIG_ROLLOUT_ERROR_RATE", 0.0),
+                    help="seeded 500 rate at generations >= 2 "
+                         "(bad-canary; 0 with --rollout bad-canary "
+                         "defaults to 0.25)")
+    up.add_argument("--rollout-steps",
+                    default=os.environ.get("AI4E_RIG_ROLLOUT_STEPS",
+                                           "25,50,100"),
+                    help="canary weight ladder in percent, ending at 100")
+    up.add_argument("--rollout-hold-s", type=float,
+                    default=_env_float("AI4E_RIG_ROLLOUT_HOLD_S", 3.0),
+                    help="clean-burn hold per canary step (s)")
+    up.add_argument("--rollout-drain-timeout-ms", type=float,
+                    default=_env_float("AI4E_RIG_ROLLOUT_DRAIN_TIMEOUT_MS",
+                                       5000.0),
+                    help="per-worker drain budget before force-retire")
     up.add_argument("--out", default=None,
                     help="artifact directory (rig.json is written here)")
 

@@ -115,7 +115,8 @@ class Balancer:
                                     text=f"gateway dropped mid-response: "
                                          f"{exc}")
         return web.Response(status=503,
-                            text=f"no gateway reachable: {last}")
+                            text=f"no gateway reachable: {last}",
+                            headers={"Retry-After": "1"})
 
 
 async def run_balancer(topo: Topology) -> None:

@@ -25,6 +25,7 @@ from aiohttp import web
 from ..broker.dispatcher import Dispatcher
 from ..metrics import MetricsRegistry
 from ..resilience import BackendHealth, ResiliencePolicy
+from ..rollout.canary import CanaryWeights
 from ..taskstore import endpoint_path
 from .topology import Topology
 from .wire import RingStoreClient, WireBroker
@@ -42,6 +43,12 @@ async def run_dispatchernode(topo: Topology, shard: int, index: int) -> None:
     health = BackendHealth(ResiliencePolicy(retry_base_s=0.05,
                                             retry_cap_s=1.0),
                            metrics=metrics)
+    # Canary split (rollout/, docs/deployment.md#rollouts): the rolling-
+    # upgrade driver POSTs generation assignments + the canary share to
+    # /v1/rollout/weights; placement rescales the weighted worker pool
+    # through the attached CanaryWeights on every pick.
+    canary = CanaryWeights()
+    health.attach_canary(canary)
     observability = None
     if topo.observability:
         # The hub's stamps (popped/delivered/retry/failover/...) ride
@@ -67,8 +74,36 @@ async def run_dispatchernode(topo: Topology, shard: int, index: int) -> None:
         return web.Response(text=metrics.render_prometheus(),
                             content_type="text/plain")
 
+    async def rollout_weights(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError("body must be an object")
+            for uri, gen in (body.get("generations") or {}).items():
+                canary.set_generation(str(uri), int(gen))
+            # Pre-restart eject / post-restart re-admit: the rollout
+            # driver marks a backend draining BEFORE it drains + kills
+            # the process (deliveries route to peers for the TTL, no
+            # connect-error breaker trips from the restart window) and
+            # resets it once the replacement answers /healthz.
+            for uri, ttl in (body.get("draining") or {}).items():
+                health.mark_draining(str(uri), float(ttl))
+            for uri in body.get("undrain") or ():
+                health.reset(str(uri))
+            if body.get("clear"):
+                canary.clear_split()
+            elif body.get("canary_generation") is not None:
+                canary.set_split(int(body["canary_generation"]),
+                                 float(body.get("share", 0.0)))
+        except (ValueError, TypeError) as exc:
+            return web.json_response({"error": str(exc)}, status=400)
+        generation, share = canary.split
+        return web.json_response({"canary_generation": generation,
+                                  "share": share})
+
     app.router.add_get("/healthz", health_route)
     app.router.add_get("/metrics", metrics_route)
+    app.router.add_post("/v1/rollout/weights", rollout_weights)
     from .nodevitals import attach_vitals
     attach_vitals(app, topo, metrics)
 

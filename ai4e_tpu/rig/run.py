@@ -37,13 +37,15 @@ log = logging.getLogger("ai4e_tpu.rig.run")
 def _spawn_topology(topo: Topology, sup: Supervisor) -> None:
     spec = topo.spec_path()
 
-    def spawn(name: str, role: str, port: int | None, *extra: str) -> None:
+    def spawn(name: str, role: str, port: int | None, *extra: str,
+              drain_url: str | None = None) -> None:
         argv = python_argv("ai4e_tpu.rig", role, "--spec", spec, *extra)
         sup.spawn(name, argv, log_path=os.path.join(topo.workdir,
                                                     f"{name}.log"),
                   port=port,
                   health_url=(f"http://{topo.host}:{port}/healthz"
-                              if port else None))
+                              if port else None),
+                  drain_url=drain_url)
 
     # Stores before everything (dependency order); primaries before
     # replicas so the replica's first wire poll finds a stream.
@@ -58,8 +60,14 @@ def _spawn_topology(topo: Topology, sup: Supervisor) -> None:
                   "--shard", str(s), "--index", str(r))
     for s in range(topo.shards):
         for w in range(topo.workers):
-            spawn(f"worker{s}.{w}", "workernode", topo.worker_port(s, w),
-                  "--shard", str(s), "--index", str(w))
+            # drain_url: the supervisor's hard teardown drains workers
+            # FIRST (wave 0) through this verb before any SIGTERM —
+            # their in-flight deliveries finish, refused ones redeliver.
+            from .workernode import DRAIN_PATH
+            port = topo.worker_port(s, w)
+            spawn(f"worker{s}.{w}", "workernode", port,
+                  "--shard", str(s), "--index", str(w),
+                  drain_url=f"http://{topo.host}:{port}{DRAIN_PATH}")
         for d in range(topo.dispatchers):
             spawn(f"dispatcher{s}.{d}", "dispatchernode",
                   topo.dispatcher_port(s, d),
@@ -209,6 +217,11 @@ async def run_rig(topo: Topology, out_dir: str | None = None) -> dict:
         if events:
             chaos_task = asyncio.get_running_loop().create_task(
                 rig_chaos.run_timeline(topo, sup, events, window_opens_at))
+        rollout_task = None
+        if topo.rollout:
+            from . import rollout as rig_rollout
+            rollout_task = asyncio.get_running_loop().create_task(
+                rig_rollout.run_rollout(topo, sup, window_opens_at))
         try:
             await _await_loadgens(topo, sup, names)
         finally:
@@ -218,6 +231,17 @@ async def run_rig(topo: Topology, out_dir: str | None = None) -> dict:
                     await chaos_task
                 except asyncio.CancelledError:
                     pass
+            if rollout_task is not None:
+                # The upgrade should finish well inside the loadgen
+                # window + drain budget; a wedged driver is cancelled and
+                # recorded as such (the rollout gate then fails the run).
+                try:
+                    result["rollout"] = await asyncio.wait_for(
+                        asyncio.shield(rollout_task), timeout=60.0)
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    rollout_task.cancel()
+                    result["rollout"] = {"scenario": topo.rollout,
+                                         "outcome": "timed_out"}
         # Backlog drain: an accepted task's invariant is "eventually
         # terminal", and on a CPU-bound box the queues legitimately
         # outlive the loadgens. Wait (bounded) for every shard's created
@@ -250,9 +274,20 @@ async def run_rig(topo: Topology, out_dir: str | None = None) -> dict:
     conservation = ((observed["fleet"] or {}).get("conservation")
                     or {"ok": True, "violations": []})
     result["verdict"]["conservation"] = conservation
+    rollout_gate_ok = True
+    if topo.rollout:
+        from . import rollout as rig_rollout
+        rollout_gate_ok, why = rig_rollout.rollout_ok(
+            topo, result.get("rollout"))
+        result.setdefault("rollout", {})["gate"] = {
+            "ok": rollout_gate_ok, "reason": why}
+        log.log(logging.INFO if rollout_gate_ok else logging.WARNING,
+                "rollout gate: %s (%s)",
+                "ok" if rollout_gate_ok else "FAILED", why)
     result["ok"] = bool(result["verdict"]["ok"]
                         and conservation.get("ok", True)
-                        and not loadgen_failures)
+                        and not loadgen_failures
+                        and rollout_gate_ok)
     _write_observability_artifacts(topo, result, observed, out_dir)
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
@@ -292,6 +327,8 @@ def _write_observability_artifacts(topo: Topology, result: dict,
 
         write("timeline.json", timeline)
         write("ledgers.json", {"Ledgers": observed["ledgers"]})
+        if result.get("rollout"):
+            write("rollout.json", result["rollout"])
         write("vitals.json", observed["vitals"])
         if observed["fleet"] is not None:
             write("fleet.json", observed["fleet"])
@@ -331,6 +368,17 @@ def summarize(result: dict) -> str:
     for event in result.get("chaos", ()):
         lines.append(f"  chaos @+{event['at']}s {event['verb']} "
                      f"{'ok' if event.get('ok') else 'FAILED'}")
+    rollout = result.get("rollout")
+    if rollout:
+        gate = rollout.get("gate", {})
+        lines.append(
+            f"  rollout [{rollout.get('scenario')}]: "
+            f"{rollout.get('outcome')} "
+            f"(weights {rollout.get('weight_history', [])}, "
+            f"{len(rollout.get('upgraded', []))} upgraded, "
+            f"{len(rollout.get('reverted', []))} reverted) — gate "
+            f"{'ok' if gate.get('ok') else 'FAILED'}: "
+            f"{gate.get('reason', '')}")
     cons = v.get("conservation")
     if cons is not None:
         lines.append(

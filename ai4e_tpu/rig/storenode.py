@@ -412,7 +412,7 @@ class StoreNode:
             return web.json_response({"error": "slot and dest required"},
                                      status=400)
         if self.store.role != "primary":
-            return web.json_response({"error": "not primary"}, status=503,
+            return web.json_response({"error": "not primary"}, status=503,  # ai4e: noqa[AIL015] — X-Not-Primary is a rotate marker: the wire client tries the next node NOW, waiting would be wrong
                                      headers={"X-Not-Primary": "1"})
         if slot not in self.fence.owned:
             return web.json_response(
@@ -477,7 +477,7 @@ class StoreNode:
             return web.json_response({"error": "bad import body"},
                                      status=400)
         if self.store.role != "primary":
-            return web.json_response({"error": "not primary"}, status=503,
+            return web.json_response({"error": "not primary"}, status=503,  # ai4e: noqa[AIL015] — X-Not-Primary is a rotate marker: the wire client tries the next node NOW, waiting would be wrong
                                      headers={"X-Not-Primary": "1"})
         applied = self.store.import_task_records(recs)
         with self.store._lock:

@@ -135,13 +135,19 @@ def ensure_port_free(host: str, port: int, wait_s: float = 10.0,
 class Child:
     def __init__(self, name: str, argv: list[str], env: dict,
                  log_path: str, port: int | None = None,
-                 health_url: str | None = None):
+                 health_url: str | None = None,
+                 drain_url: str | None = None):
         self.name = name
         self.argv = argv
         self.env = env
         self.log_path = log_path
         self.port = port
         self.health_url = health_url
+        # Graceful-drain verb (rollout/, docs/deployment.md#drain): set
+        # for children that serve one (workers) — teardown POSTs it
+        # best-effort before the SIGTERM so in-flight work redelivers
+        # instead of dying with the process.
+        self.drain_url = drain_url
         self.proc: subprocess.Popen | None = None
         self.started_at = 0.0
         self.restarts = 0
@@ -181,7 +187,8 @@ class Supervisor:
 
     def spawn(self, name: str, argv: list[str], env: dict | None = None,
               log_path: str | None = None, port: int | None = None,
-              health_url: str | None = None) -> Child:
+              health_url: str | None = None,
+              drain_url: str | None = None) -> Child:
         if name in self.children and self.children[name].alive():
             raise RigError(f"child {name!r} already running")
         if port is not None:
@@ -191,7 +198,7 @@ class Supervisor:
         child = self.children.get(name) or Child(
             name, argv, dict(env or os.environ),
             log_path or f"/tmp/rig-{name}.log", port=port,
-            health_url=health_url)
+            health_url=health_url, drain_url=drain_url)
         child.argv, child.env = argv, dict(env or os.environ)
         self.children[name] = child
         self._start(child)
@@ -270,13 +277,18 @@ class Supervisor:
             os.kill(pid, sig)
         return pid
 
-    def respawn(self, name: str) -> Child:
+    def respawn(self, name: str, env_overrides: dict | None = None) -> Child:
         """Relaunch a (dead) child with its original argv/env — the chaos
         timeline's dispatcher-restart verb, and what a crash-loop restart
-        does one step at a time."""
+        does one step at a time. ``env_overrides`` merge into the child's
+        env (and STICK for later respawns) — the rolling-upgrade driver's
+        generation bump (``AI4E_ROLLOUT_GENERATION``)."""
         child = self.children[name]
         if child.alive():
             raise RigError(f"cannot respawn {name}: still running")
+        if env_overrides:
+            child.env = {**child.env,
+                         **{k: str(v) for k, v in env_overrides.items()}}
         if child.port is not None:
             ensure_port_free(self.host, child.port)
         self._start(child)
@@ -317,20 +329,69 @@ class Supervisor:
 
     # -- teardown -----------------------------------------------------------
 
+    @staticmethod
+    def _teardown_wave(child: Child) -> int:
+        """Drain-first teardown ordering (docs/deployment.md#teardown):
+        workers go first (their drain verb redelivers in-flight work),
+        then dispatchers (they stop popping a queue whose workers are
+        gone), then everything else, stores LAST — every earlier wave may
+        still be flushing task state into them."""
+        if child.name.startswith("worker"):
+            return 0
+        if child.name.startswith("dispatcher"):
+            return 1
+        if child.name.startswith("store"):
+            return 3
+        return 2
+
+    def _post_drain(self, child: Child, timeout_s: float = 2.0) -> None:
+        """Best-effort drain POST before a worker's SIGTERM: bounded,
+        fail-open — a worker that cannot answer still dies on the signal
+        path below; the drain just lets in-flight work redeliver first."""
+        import urllib.error
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(
+                child.drain_url, data=b'{"timeout_ms": 1500}',
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                log.info("drained %s before teardown (HTTP %d)",
+                         child.name, resp.status)
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            log.debug("teardown drain of %s skipped: %s", child.name, exc)
+
     def shutdown(self, grace_s: float = 5.0) -> None:
-        """Hard teardown that cannot leak: SIGTERM every group, bounded
-        grace, SIGKILL stragglers, reap, then verify our ports are free
+        """Hard teardown that cannot leak: drain-first ordered SIGTERM
+        waves (workers → dispatchers → the rest → stores), bounded grace,
+        SIGKILL stragglers, reap, then verify our ports are free
         (evicting any holder as the last resort). Idempotent — atexit and
         explicit callers can both run it."""
         if self._down:
             return
         self._down = True
+        waves: dict[int, list[Child]] = {}
         for child in self.children.values():
-            if child.alive():
-                try:
-                    os.killpg(os.getpgid(child.proc.pid), signal.SIGTERM)
-                except OSError:
-                    pass
+            waves.setdefault(self._teardown_wave(child), []).append(child)
+        # Per-wave slice of the grace budget; the global grace loop below
+        # stays the fallback bound, so total teardown time is unchanged.
+        wave_grace = grace_s / max(1, len(waves)) if waves else grace_s
+        for _, members in sorted(waves.items()):
+            for child in members:
+                if child.drain_url and child.alive():
+                    self._post_drain(child)
+            for child in members:
+                if child.alive():
+                    try:
+                        os.killpg(os.getpgid(child.proc.pid),
+                                  signal.SIGTERM)
+                    except OSError:
+                        pass
+            wave_deadline = time.monotonic() + wave_grace
+            while time.monotonic() < wave_deadline:
+                if not any(c.alive() for c in members):
+                    break
+                time.sleep(0.05)
         deadline = time.monotonic() + grace_s
         while time.monotonic() < deadline:
             if not any(c.alive() for c in self.children.values()):

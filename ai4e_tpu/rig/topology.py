@@ -96,6 +96,22 @@ class Topology:
     mesh: str = ""
     mesh_poison_nths: str = ""
     mesh_recovery_s: float = 2.0
+    # Zero-downtime rollout (rollout/, docs/deployment.md#rollouts).
+    # ``rollout`` is the scenario: "" (off), "clean" (every generation
+    # healthy — the upgrade must lose nothing and surface zero
+    # client-visible 5xx from drained workers) or "bad-canary"
+    # (``rollout_error_rate`` of deliveries fail with 500 at generations
+    # >= ``rollout_bad_generation`` — the guard must auto-rollback before
+    # the canary's traffic share passes 50%). The driver (rig/rollout.py)
+    # starts after ``ramp``, drains + respawns workers one at a time with
+    # a bumped AI4E_ROLLOUT_GENERATION, and steps canary weight through
+    # ``rollout_steps`` holding ``rollout_hold_s`` per step.
+    rollout: str = ""
+    rollout_error_rate: float = 0.0
+    rollout_bad_generation: int = 2
+    rollout_steps: str = "25,50,100"
+    rollout_hold_s: float = 3.0
+    rollout_drain_timeout_ms: float = 5000.0
     extra: dict = field(default_factory=dict)
 
     def __post_init__(self):
@@ -120,6 +136,11 @@ class Topology:
             raise ValueError(f"workers must be 1..{_WORKERS_MAX}")
         if self.slots < self.shards:
             raise ValueError("slots must be >= shards")
+        if self.rollout not in ("", "clean", "bad-canary"):
+            raise ValueError("rollout must be '', 'clean' or 'bad-canary'")
+        if self.rollout == "bad-canary" and self.rollout_error_rate <= 0:
+            # The scenario's whole point is a visibly bad generation.
+            self.rollout_error_rate = 0.25
 
     # -- ports/urls ---------------------------------------------------------
 

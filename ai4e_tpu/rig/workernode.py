@@ -15,12 +15,16 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import random
 import time
 
 from aiohttp import web
 
 from ..metrics import MetricsRegistry
 from ..observability.ledger import EXECUTE, ledger_event
+from ..rollout.canary import generation_label
+from ..rollout.drain import DRAINING_HEADER, DrainState
 from ..taskstore import TaskNotFound, TaskStatus
 from .topology import Topology
 from .wire import RingStoreClient
@@ -28,6 +32,17 @@ from .wire import RingStoreClient
 log = logging.getLogger("ai4e_tpu.rig.worker")
 
 COMPLETED_STATUS = "completed by rig echo worker"
+
+# The rig worker's drain/resume verbs — same shape as the production
+# worker's (runtime/worker.py); the supervisor's teardown and the
+# rolling-upgrade driver (rig/rollout.py) POST these.
+DRAIN_PATH = "/v1/worker/drain"
+RESUME_PATH = "/v1/worker/resume"
+
+# Env var the rolling-upgrade driver bumps on respawn — which deploy
+# generation this worker PROCESS serves (the rig analogue of
+# ServableModel.generation).
+GENERATION_ENV = "AI4E_ROLLOUT_GENERATION"
 
 
 class EchoWorker:
@@ -39,9 +54,38 @@ class EchoWorker:
         self._served = self.metrics.counter(
             "ai4e_rig_worker_requests_total",
             "Echo-worker deliveries by outcome")
+        # --- rollout state (docs/deployment.md#rollouts) ------------------
+        # Which deploy generation this PROCESS serves; the rolling-upgrade
+        # driver bumps it through the supervisor's respawn env overrides.
+        self.generation = int(os.environ.get(GENERATION_ENV, "1") or 1)
+        self.drain_state = DrainState()
+        self._inflight = 0
+        self._rollout_outcomes = self.metrics.counter(
+            "ai4e_rollout_outcomes_total",
+            "Deliveries by deploy generation and outcome")
+        self._drain_gauge = self.metrics.gauge(
+            "ai4e_rollout_drain_state",
+            "0 active, 1 draining, 2 drained")
+        # Scenario B's bad canary: at the designated generation, fail a
+        # seeded fraction of deliveries with a breaker-visible 500 so the
+        # guard's burn/breaker signals have something real to trip on.
+        self._error_rate = (topo.rollout_error_rate
+                            if (topo.rollout_error_rate > 0
+                                and self.generation
+                                >= topo.rollout_bad_generation > 0)
+                            else 0.0)
+        self._err_rng = random.Random(
+            f"{topo.seed}:{shard}:{self.generation}:bad-canary")
+        if self._error_rate > 0:
+            log.warning("worker shard %d generation %d: injecting %.0f%% "
+                        "error rate (bad-canary scenario)",
+                        shard, self.generation, self._error_rate * 100)
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.router.add_get("/healthz", self._health)
         self.app.router.add_get("/metrics", self._metrics)
+        self.app.router.add_post(DRAIN_PATH, self._drain)
+        self.app.router.add_get(DRAIN_PATH, self._drain_status)
+        self.app.router.add_post(RESUME_PATH, self._resume)
         route = topo.route.rstrip("/")
         self.app.router.add_post(route, self._run)
         self.app.router.add_post(route + "/{tail:.*}", self._run)
@@ -51,7 +95,45 @@ class EchoWorker:
         self._stamps: set[asyncio.Task] = set()
 
     async def _health(self, _: web.Request) -> web.Response:
-        return web.json_response({"status": "healthy", "shard": self.shard})
+        return web.json_response({"status": "healthy", "shard": self.shard,
+                                  "generation": self.generation,
+                                  "draining": self.drain_state.is_draining})
+
+    async def _drain(self, request: web.Request) -> web.Response:
+        """Graceful drain: stop admitting deliveries (503 + X-Draining so
+        the dispatcher redelivers to a peer AND ejects us from placement),
+        then wait — bounded — for in-flight deliveries to finish."""
+        timeout_s = 5.0
+        try:
+            body = await request.json()
+            if isinstance(body, dict) and "timeout_ms" in body:
+                timeout_s = max(0.0, float(body["timeout_ms"]) / 1000.0)
+        except (ValueError, TypeError):
+            pass  # empty/non-JSON body — the default budget applies
+        t0 = time.monotonic()
+        self.drain_state.begin()
+        self._drain_gauge.set(float(self.drain_state.state_code))
+        while (time.monotonic() - t0 < timeout_s
+               and (self._inflight > 0
+                    or self.drain_state.reloads_in_flight > 0)):
+            await asyncio.sleep(0.02)
+        clean = self._inflight == 0
+        self.drain_state.mark_drained()
+        self._drain_gauge.set(float(self.drain_state.state_code))
+        return web.json_response({
+            "state": self.drain_state.state, "clean": clean,
+            "inflight": self._inflight, "generation": self.generation,
+            "drain_s": round(time.monotonic() - t0, 3)})
+
+    async def _drain_status(self, _: web.Request) -> web.Response:
+        return web.json_response({"state": self.drain_state.state,
+                                  "inflight": self._inflight,
+                                  "generation": self.generation})
+
+    async def _resume(self, _: web.Request) -> web.Response:
+        self.drain_state.resume()
+        self._drain_gauge.set(float(self.drain_state.state_code))
+        return web.json_response({"state": self.drain_state.state})
 
     async def _metrics(self, _: web.Request) -> web.Response:
         return web.Response(text=self.metrics.render_prometheus(),
@@ -61,6 +143,38 @@ class EchoWorker:
         await self.ring.aclose()
 
     async def _run(self, request: web.Request) -> web.Response:
+        gen_label = generation_label(self.generation)
+        if self.drain_state.is_draining:
+            # Saturation-neutral refusal (503, not 5xx-failure): the
+            # dispatcher redelivers this exact task to a peer, and the
+            # X-Draining marker ejects us from placement WITHOUT opening
+            # a breaker — draining is on purpose, not a fault.
+            self._served.inc(outcome="draining")
+            self._rollout_outcomes.inc(generation=gen_label,
+                                       outcome="draining")
+            return web.json_response(
+                {"ok": False, "reason": "worker draining; retry a peer"},
+                status=503,
+                headers={"Retry-After": "1", DRAINING_HEADER: "1"})
+        if self._error_rate > 0 and self._err_rng.random() < self._error_rate:
+            # Bad-canary injection: a real failure (500) — breaker-visible
+            # and burn-visible — and NO result write, so the redelivered
+            # execution completes the task on a healthy generation.
+            self._served.inc(outcome="injected_error")
+            self._rollout_outcomes.inc(generation=gen_label,
+                                       outcome="error")
+            return web.json_response(
+                {"ok": False, "reason": "injected canary fault"}, status=500)
+        self._inflight += 1
+        try:
+            resp = await self._execute(request)
+        finally:
+            self._inflight -= 1
+        if resp.status == 200:
+            self._rollout_outcomes.inc(generation=gen_label, outcome="ok")
+        return resp
+
+    async def _execute(self, request: web.Request) -> web.Response:
         task_id = request.headers.get("taskId", "")
         body = await request.read()
         if not task_id:

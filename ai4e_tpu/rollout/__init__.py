@@ -1,0 +1,38 @@
+"""Zero-downtime rollouts — drain-aware workers, SLO-burn-guarded canary,
+automatic rollback (docs/deployment.md#rollouts).
+
+The reference platform's deploy story is Istio/Helm rolling upgrades of
+containerized model APIs; our native rebuild had every ingredient
+(per-version servables, the SLO burn engine, breakers, the multi-process
+rig) but no upgrade lifecycle — a weight rollout was either an
+instantaneous per-worker hot swap or SIGTERM-the-group. This package is
+the missing lifecycle, three pieces:
+
+- ``drain``     — the worker-side graceful-drain state machine: stop
+  admitting, finish in-flight device work bounded by a budget, redeliver
+  stragglers through the broker per task (stdlib-only so the race
+  explorer exercises the REAL code, like ``runtime/decode.py``);
+- ``canary``    — generation-keyed traffic splitting applied on top of
+  the weighted in-tier pick every placement path already uses;
+- ``controller``— the rollout controller: upgrade one worker at a time,
+  step the canary weight up on clean fast+slow SLO burn windows, and
+  automatically roll back when the canary generation's burn rate or
+  breaker state breaches.
+"""
+
+from .canary import CanaryWeights, generation_label
+from .controller import RolloutController, RolloutPolicy
+from .drain import (DRAINING_HEADER, DrainingError, DrainState,
+                    drain_worker, retire_pending)
+
+__all__ = [
+    "CanaryWeights",
+    "generation_label",
+    "RolloutController",
+    "RolloutPolicy",
+    "DRAINING_HEADER",
+    "DrainingError",
+    "DrainState",
+    "drain_worker",
+    "retire_pending",
+]

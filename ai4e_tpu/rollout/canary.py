@@ -1,0 +1,106 @@
+"""Versioned canary routing — generation-keyed traffic splitting.
+
+The rollout generation is tracked in the registry beside
+``params_version`` (``ServableModel.generation``); placement splits
+traffic by weight between old- and new-generation replicas by rescaling
+the weighted backend set every pick already consumes
+(``utils/backends.pick_backend`` — "equal-cost backends are a canary
+split"). The split is exact by construction: the canary generation's
+backends are rescaled to hold ``share`` of the pool's total weight as a
+GROUP, whatever the replica counts are on each side.
+
+``generation_label`` is the bounded-cardinality mapper for the
+``generation`` metric dimension (AIL013, docs/observability.md): a
+long-lived worker that reloads weekly would otherwise mint one series
+per generation number forever.
+"""
+
+from __future__ import annotations
+
+#: Distinct generation values one process may label before folding the
+#: rest into ``other`` — a worker sees its own generation plus a handful
+#: of rollouts per process lifetime, so the cap is generous.
+GENERATION_LABEL_CAP = 8
+_seen_generations: list[str] = []
+
+
+def generation_label(generation) -> str:
+    """Bounded mapper for the ``generation`` metric label: the first
+    ``GENERATION_LABEL_CAP`` distinct values seen by this process keep
+    their own series; everything after folds into ``other`` (the
+    tenancy top-N+other precedent, docs/tenancy.md)."""
+    value = str(generation)
+    if value in _seen_generations:
+        return value
+    if len(_seen_generations) < GENERATION_LABEL_CAP:
+        _seen_generations.append(value)
+        return value
+    return "other"
+
+
+class CanaryWeights:
+    """Generation→traffic-share policy applied to a weighted backend set.
+
+    One instance per assembly, attached to the shared ``BackendHealth``
+    (and through it the orchestrator): both placement paths then split
+    in-tier traffic between generations without either learning anything
+    about rollouts. ``apply`` is pure with respect to the pool — callers
+    keep their own lists."""
+
+    def __init__(self):
+        self._generations: dict[str, int] = {}
+        self._canary_generation: int | None = None
+        self._canary_share: float = 0.0
+
+    # -- registration --------------------------------------------------------
+
+    def set_generation(self, uri: str, generation: int) -> None:
+        self._generations[str(uri)] = int(generation)
+
+    def generation_of(self, uri: str) -> int | None:
+        return self._generations.get(str(uri))
+
+    def set_split(self, canary_generation: int, share: float) -> None:
+        """Route ``share`` (0..1) of the pool's traffic to backends of
+        ``canary_generation``; the rest serves the other generations."""
+        self._canary_generation = int(canary_generation)
+        self._canary_share = min(1.0, max(0.0, float(share)))
+
+    def clear_split(self) -> None:
+        self._canary_generation = None
+        self._canary_share = 0.0
+
+    @property
+    def split(self) -> tuple[int | None, float]:
+        return self._canary_generation, self._canary_share
+
+    # -- placement hook ------------------------------------------------------
+
+    def apply(self, pool):
+        """Rescale ``[(uri, weight), ...]`` so the canary generation's
+        backends hold exactly the configured share of total weight.
+        Degenerate pools pass through unchanged: no split configured,
+        no canary backend present (nothing to canary), or no
+        non-canary backend present (the canary IS the fleet)."""
+        if self._canary_generation is None or not pool:
+            return pool
+        canary_total = other_total = 0.0
+        for uri, weight in pool:
+            if self._generations.get(uri) == self._canary_generation:
+                canary_total += weight
+            else:
+                other_total += weight
+        if canary_total <= 0 or other_total <= 0:
+            return pool
+        total = canary_total + other_total
+        share = self._canary_share
+        out = []
+        for uri, weight in pool:
+            if self._generations.get(uri) == self._canary_generation:
+                out.append((uri, weight * share * total / canary_total))
+            else:
+                out.append((uri, weight * (1.0 - share) * total
+                            / other_total))
+        if all(w <= 0 for _, w in out):
+            return pool
+        return out

@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from ..rollout.drain import DrainingError, retire_pending
 from .ladder import EXPOSITION_BUCKETS, exposition_buckets
 from .registry import ModelRuntime
 
@@ -93,6 +94,12 @@ class MicroBatcher:
         self._pending: dict[str, list[_Pending]] = {}
         self._wakeup: asyncio.Event = asyncio.Event()
         self._stop = False
+        # Rollout drain (rollout/drain.py, docs/deployment.md#drain):
+        # while draining, submits raise DrainingError (the worker answers
+        # 503 + Retry-After + X-Draining and async tasks redeliver through
+        # the broker), the flusher stops cutting new batches, and batches
+        # already on the device finish normally.
+        self._draining = False
         self._flusher: asyncio.Task | None = None
         # ``pipeline_depth`` device-feeding threads + an equal-slot window:
         # the device still serialises compute, but batch N+1's host work
@@ -256,6 +263,8 @@ class MicroBatcher:
         """
         if self._stop:
             raise RuntimeError("batcher stopped")
+        if self._draining:
+            raise DrainingError("batcher draining; submit refused")
         cap = self.max_pending if priority <= 0 else self._background_cap
         if self.pending_count >= cap:
             raise BatcherSaturated(
@@ -273,6 +282,35 @@ class MicroBatcher:
         self._pending_gauge.set(self.pending_count)
         self._wakeup.set()
         return await fut
+
+    # -- drain (rollout/drain.py drives these; docs/deployment.md) ---------
+
+    def begin_drain(self) -> int:
+        """Stop cutting new batches and retire every UNCUT pending entry
+        with ``DrainingError`` (each redelivers through the broker per
+        task). The take-and-clear is one synchronous step with the
+        draining flip — no await — so a concurrently scheduled batch cut
+        can never deliver into a future this sweep already failed
+        (tests/test_race_regressions.py). Batches already in the pipeline
+        window finish normally; ``drain_complete`` turns true when they
+        have."""
+        self._draining = True
+        retired = retire_pending(self._pending)
+        self._pending_gauge.set(self.pending_count)
+        self._wakeup.set()
+        return retired
+
+    @property
+    def drain_complete(self) -> bool:
+        """Draining AND quiesced: nothing pending, nothing on the device."""
+        return (self._draining and not self._inflight_execs
+                and self.pending_count == 0)
+
+    def resume_from_drain(self) -> None:
+        """Re-arm after an aborted drain (the rollback path re-weights a
+        worker back into service without a process restart)."""
+        self._draining = False
+        self._wakeup.set()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -318,6 +356,13 @@ class MicroBatcher:
                 if sleep_for is not None and sleep_for > 0:
                     await asyncio.sleep(sleep_for)
             now = time.perf_counter()
+            if self._draining:
+                # Drained pending queues are already empty; anything that
+                # raced in between the retire sweep and the submit-side
+                # refusal is retired here rather than cut to the device.
+                retire_pending(self._pending)
+                self._pending_gauge.set(self.pending_count)
+                continue
             for model_name in list(self._pending):
                 if not self._pending.get(model_name):
                     continue

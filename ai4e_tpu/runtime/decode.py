@@ -54,6 +54,7 @@ from functools import partial
 
 from ..admission.deadline import DeadlineExceeded, priority_name
 from ..metrics import DEFAULT_REGISTRY, MetricsRegistry
+from ..rollout.drain import DrainingError
 
 log = logging.getLogger("ai4e_tpu.decode")
 
@@ -178,6 +179,11 @@ class DecodeEngine:
         self._active: dict[int, _Sequence] = {}
         self._wakeup = asyncio.Event()
         self._stop = False
+        # Rollout drain (rollout/drain.py): stop admitting prefills but
+        # let ACTIVE sequences decode to completion — bounded by the
+        # caller's drain budget, after which ``force_drain`` retires the
+        # stragglers (each redelivers through the broker per task).
+        self._draining = False
         self._loop_task: asyncio.Task | None = None
         self._executor = None
         self._cache_version = None
@@ -232,6 +238,8 @@ class DecodeEngine:
         slot at the next sweep."""
         if self._stop:
             raise RuntimeError("decode engine stopped")
+        if self._draining:
+            raise DrainingError("decode engine draining; submit refused")
         if self.pending_count >= self.max_pending:
             raise DecodeSaturated(
                 f"decode queue at {self.pending_count}/{self.max_pending} "
@@ -264,6 +272,51 @@ class DecodeEngine:
             if seq.future is future:
                 self._retire(seq, "cancelled")
                 return
+
+    # -- drain (rollout/drain.py drives these; docs/deployment.md) ---------
+
+    def begin_drain(self) -> int:
+        """Stop admitting prefills and retire every QUEUED sequence with
+        ``DrainingError`` (each redelivers through the broker per task);
+        active sequences keep decoding — ``drain_complete`` turns true
+        when the last one finishes. Flip + retire are one synchronous
+        step, so a concurrently scheduled ``_admit`` cannot prefill a
+        sequence this sweep already failed."""
+        self._draining = True
+        retired = 0
+        for seq in list(self._queue):
+            if not seq.done:
+                self._retire(seq, "cancelled",
+                             error=DrainingError(
+                                 "decode engine draining; redeliver"))
+                retired += 1
+        self._wakeup.set()
+        return retired
+
+    @property
+    def drain_complete(self) -> bool:
+        """Draining AND quiesced: no queued, no active sequences."""
+        return self._draining and not self._active and not self._queue
+
+    def force_drain(self) -> int:
+        """Retire the ACTIVE stragglers past the drain budget with
+        ``DrainingError`` — each redelivers through the broker per task,
+        the PR 17 poisoned-row path."""
+        forced = 0
+        for seq in list(self._active.values()):
+            if not seq.done:
+                self._retire(seq, "cancelled",
+                             error=DrainingError(
+                                 "decode drain budget exhausted; "
+                                 "redeliver"))
+                forced += 1
+        return forced
+
+    def resume_from_drain(self) -> None:
+        """Re-arm after an aborted drain (rollback re-weights the worker
+        back into service without a process restart)."""
+        self._draining = False
+        self._wakeup.set()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -377,6 +430,15 @@ class DecodeEngine:
         once at entry), then fills every slot it can: the old whole-
         batch-in/whole-batch-out contract, kept measurable as the bench
         baseline."""
+        if self._draining:
+            # Anything that raced past the submit-side refusal is retired
+            # here rather than prefilled onto a leaving worker.
+            for seq in list(self._queue):
+                if not seq.done:
+                    self._retire(seq, "cancelled",
+                                 error=DrainingError(
+                                     "decode engine draining; redeliver"))
+            return
         if not self.continuous and self._active:
             return
         while self._queue:

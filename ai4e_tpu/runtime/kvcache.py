@@ -60,6 +60,10 @@ class LMServable:
     version: str = "1.0"
     checkpoint_path: str | None = None
     params_version: int = 1
+    # Rollout generation (rollout/, docs/deployment.md) — same contract
+    # as registry.ServableModel.generation: the cross-replica deploy
+    # coordinate the canary split routes on; the reload verb sets it.
+    generation: int = 1
 
 
 def build_lm_servable(name: str = "lm", vocab_size: int = 512,

@@ -62,6 +62,12 @@ class ServableModel:
     # rollout landed.
     checkpoint_path: str | None = None
     params_version: int = 1
+    # Rollout generation (rollout/, docs/deployment.md): which fleet-wide
+    # deploy this servable's weights belong to. params_version is a local
+    # monotonic swap counter; generation is the cross-replica coordinate
+    # the canary split routes on — the reload verb sets it from the
+    # controller's payload, /models exposes it.
+    generation: int = 1
     # Param-path → PartitionSpec rules applied at register() — how a family
     # declares model-parallel placement (e.g. MoE experts over ep) that must
     # survive the runtime's own param placement.

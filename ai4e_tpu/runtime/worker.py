@@ -14,6 +14,7 @@ import asyncio
 import inspect
 import json
 import logging
+import time
 
 import numpy as np
 
@@ -22,6 +23,9 @@ from ..admission.deadline import (SHED_REASON_HEADER, DeadlineExceeded,
                                   shed_reason, worker_admission_kwargs)
 from ..metrics import MetricsRegistry
 from ..rescache.keys import cache_bypass_requested, request_key
+from ..rollout.drain import (DRAINING_HEADER, DrainingError, DrainState,
+                             drain_worker)
+from ..rollout.canary import generation_label
 from ..service import APIService
 from ..service.task_manager import TaskManagerBase
 from ..taskstore import TaskStatus
@@ -41,7 +45,8 @@ class InferenceWorker:
                  store=None, reporter=None, result_cache=None,
                  checkpoint_root: str | None = None,
                  admin_api_keys=None, cache_sync_path: bool = True,
-                 hop_ledger: bool = False):
+                 hop_ledger: bool = False,
+                 drain_timeout_s: float = 30.0):
         import os
 
         self.runtime = runtime
@@ -95,11 +100,100 @@ class InferenceWorker:
         # checkpoint_path/params_version reporting a different rollout
         # than the params actually serving.
         self._reload_lock = asyncio.Lock()
+        # Rollout drain (rollout/drain.py, AI4E_ROLLOUT_DRAIN_TIMEOUT_MS):
+        # one state machine shared by every surface of this process — the
+        # batcher, the decode engines, the reload verb and the admission
+        # checks all consult it.
+        self.drain_state = DrainState()
+        self._drain_timeout_s = drain_timeout_s
+        # Per-generation serving outcomes/latency (docs/METRICS.md): the
+        # rollout controller's burn guard compares these series between
+        # the canary and the incumbent generation. The label is bounded
+        # by generation_label (AIL013 — top-N+other).
+        self._rollout_outcomes = self.service.metrics.counter(
+            "ai4e_rollout_outcomes_total",
+            "Worker inference outcomes by rollout generation")
+        self._rollout_latency = self.service.metrics.histogram(
+            "ai4e_rollout_request_seconds",
+            "Worker inference latency by rollout generation")
+        self._drain_gauge = self.service.metrics.gauge(
+            "ai4e_rollout_drain_state",
+            "Worker drain state (0 active, 1 draining, 2 drained)")
         self.service.app.router.add_get(self.service.prefix + "/models",
                                         self._list_models)
         self.service.app.router.add_post(
             self.service.prefix + "/models/{name}/reload",
             self._reload_model)
+        self.service.app.router.add_post(
+            self.service.prefix + "/worker/drain", self._drain_worker)
+        self.service.app.router.add_get(
+            self.service.prefix + "/worker/drain", self._drain_status)
+        self.service.app.router.add_post(
+            self.service.prefix + "/worker/resume", self._resume_worker)
+
+    def _admin_denied(self, request):
+        """The admin surface's API-key gate (reload/drain/resume): same
+        header contract as the gateway's middleware; None passes."""
+        if self._admin_keys is None:
+            return None
+        from aiohttp import web
+        key = (request.headers.get("Ocp-Apim-Subscription-Key")
+               or request.headers.get("X-Api-Key"))
+        if key not in self._admin_keys:
+            return web.json_response(
+                {"error": "missing or invalid subscription key"},
+                status=401)
+        return None
+
+    async def _drain_worker(self, request):
+        """POST {prefix}/worker/drain — graceful drain: stop admitting,
+        retire uncut work (each async task redelivers through the broker),
+        finish in-flight device batches / active decode sequences bounded
+        by the drain budget, then force-retire stragglers. Idempotent —
+        a second POST reports the current state. Body (optional):
+        ``{"timeout_ms": N}`` overrides the configured budget."""
+        from aiohttp import web
+        denied = self._admin_denied(request)
+        if denied is not None:
+            return denied
+        timeout_s = self._drain_timeout_s
+        try:
+            payload = json.loads(await request.read() or b"{}")
+            if isinstance(payload, dict) and "timeout_ms" in payload:
+                timeout_s = max(0.0, float(payload["timeout_ms"])) / 1000.0
+        except (json.JSONDecodeError, TypeError, ValueError):
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        summary = await drain_worker(
+            self.drain_state, batchers=[self.batcher],
+            engines=self.decode_engines, timeout_s=timeout_s)
+        self._drain_gauge.set(self.drain_state.state_code)
+        log.warning("worker drained: %s", summary)
+        return web.json_response(summary)
+
+    async def _drain_status(self, _request):
+        from aiohttp import web
+        return web.json_response({
+            "state": self.drain_state.state,
+            "reloads_in_flight": self.drain_state.reloads_in_flight,
+            "batcher_pending": self.batcher.pending_count,
+            "decode_active": sum(e.active_count
+                                 for e in self.decode_engines)})
+
+    async def _resume_worker(self, request):
+        """POST {prefix}/worker/resume — re-arm after an aborted drain:
+        the rollback path re-weights this replica back into service
+        without a process restart."""
+        from aiohttp import web
+        denied = self._admin_denied(request)
+        if denied is not None:
+            return denied
+        self.drain_state.resume()
+        self.batcher.resume_from_drain()
+        for engine in self.decode_engines:
+            engine.resume_from_drain()
+        self._drain_gauge.set(self.drain_state.state_code)
+        log.warning("worker resumed from drain")
+        return web.json_response({"state": self.drain_state.state})
 
     async def _list_models(self, _request):
         """Model-registry introspection — what the reference delegates to its
@@ -117,6 +211,7 @@ class InferenceWorker:
             entry = {
                 "name": name, "version": s.version,
                 "params_version": s.params_version,
+                "generation": s.generation,
                 "checkpoint": s.checkpoint_path,
                 "input_shape": list(s.input_shape),
                 "input_dtype": str(np.dtype(s.input_dtype)),
@@ -155,15 +250,9 @@ class InferenceWorker:
 
         import jax
 
-        if self._admin_keys is not None:
-            # Same header contract as the gateway's API-key middleware —
-            # weight swaps are an operator action, not an open endpoint.
-            key = (request.headers.get("Ocp-Apim-Subscription-Key")
-                   or request.headers.get("X-Api-Key"))
-            if key not in self._admin_keys:
-                return web.json_response(
-                    {"error": "missing or invalid subscription key"},
-                    status=401)
+        denied = self._admin_denied(request)
+        if denied is not None:
+            return denied
         name = request.match_info["name"]
         servable = self.runtime.models.get(name)
         lm_backend = None
@@ -181,8 +270,10 @@ class InferenceWorker:
             servable = lm_backend.servable
         if jax.process_count() > 1:
             return web.json_response(
-                {"error": "hot reload is single-host; roll the replicas of "
-                          "a multi-host slice instead"}, status=501)
+                {"error": "hot reload is single-host; drain each replica "
+                          "(POST /v1/worker/drain) and roll the multi-host "
+                          "slice through the rollout controller instead "
+                          "(docs/deployment.md#rollouts)"}, status=501)
         try:
             payload = json.loads(await request.read() or b"{}")
         except json.JSONDecodeError:
@@ -222,6 +313,11 @@ class InferenceWorker:
                               "checkpoint directory"}, status=403)
             path = real
 
+        generation = payload.get("generation")
+        if generation is not None and not isinstance(generation, int):
+            return web.json_response(
+                {"error": "generation must be an integer"}, status=400)
+
         def load_and_swap():
             from ..checkpoint import load_params
             new_params = load_params(path, like=servable.params)
@@ -230,29 +326,50 @@ class InferenceWorker:
                 return servable
             return self.runtime.reload_params(name, new_params)
 
-        async with self._reload_lock:
-            try:
-                # Off the event loop: orbax reads disk and device_puts.
-                await asyncio.to_thread(load_and_swap)
-            except ValueError as exc:
-                return web.json_response({"error": str(exc)}, status=409)
-            except Exception as exc:  # noqa: BLE001; ai4e: noqa[AIL005] — the error is returned to the caller as the 400 body
-                return web.json_response(
-                    {"error": f"reload failed: {type(exc).__name__}: "
-                              f"{exc}"}, status=400)
-            servable.checkpoint_path = path
-            if self.result_cache is not None:
-                # Invalidation-on-reload (rescache/): drop every cached
-                # result this model could have produced — the worker's own
-                # family (sync path) AND each endpoint path it serves (the
-                # gateway/dispatcher key namespace) — so a result computed
-                # on the old weights is unreachable from the moment the
-                # swap lands.
-                for family in (name, *self._served.get(name, {}).values()):
-                    self.result_cache.invalidate_family(family)
+        # Drain interlock (rollout/drain.py): check + register are one
+        # synchronous step, so a reload racing a drain either lands fully
+        # before the drain (which then waits on reloads_in_flight) or is
+        # refused here — a weight swap can never complete on a worker
+        # that already reported itself drained
+        # (tests/test_race_regressions.py).
+        if not self.drain_state.try_begin_reload():
             return web.json_response(
-                {"model": name, "checkpoint": path,
-                 "params_version": servable.params_version})
+                {"error": "worker is draining; reload refused — the "
+                          "rollout path owns this replica now"},
+                status=409, headers={DRAINING_HEADER: "1"})
+        try:
+            async with self._reload_lock:
+                try:
+                    # Off the event loop: orbax reads disk and device_puts.
+                    await asyncio.to_thread(load_and_swap)
+                except ValueError as exc:
+                    return web.json_response({"error": str(exc)}, status=409)
+                except Exception as exc:  # noqa: BLE001; ai4e: noqa[AIL005] — the error is returned to the caller as the 400 body
+                    return web.json_response(
+                        {"error": f"reload failed: {type(exc).__name__}: "
+                                  f"{exc}"}, status=400)
+                servable.checkpoint_path = path
+                if generation is not None:
+                    # The rollout coordinate: the controller's reload
+                    # carries the target generation; the canary split
+                    # routes on it (rollout/canary.py).
+                    servable.generation = generation
+                if self.result_cache is not None:
+                    # Invalidation-on-reload (rescache/): drop every cached
+                    # result this model could have produced — the worker's
+                    # own family (sync path) AND each endpoint path it
+                    # serves (the gateway/dispatcher key namespace) — so a
+                    # result computed on the old weights is unreachable
+                    # from the moment the swap lands.
+                    for family in (name,
+                                   *self._served.get(name, {}).values()):
+                        self.result_cache.invalidate_family(family)
+                return web.json_response(
+                    {"model": name, "checkpoint": path,
+                     "params_version": servable.params_version,
+                     "generation": servable.generation})
+        finally:
+            self.drain_state.end_reload()
 
     def serve_model(self, servable: ServableModel,
                     sync_path: str | None = None,
@@ -288,6 +405,15 @@ class InferenceWorker:
             "async": self.service.prefix + async_path})
 
         def _saturation_check():
+            # Drain gate first (rollout/drain.py): a draining worker
+            # refuses BEFORE adopting a task — the broker redelivers it to
+            # a peer, and the X-Draining marker ejects this backend from
+            # placement for a TTL instead of tripping a breaker.
+            if self.drain_state.is_draining:
+                return (503, "Worker draining; retry a peer.",
+                        {"Retry-After": "1", DRAINING_HEADER: "1",
+                         SHED_REASON_HEADER:
+                             shed_reason("worker", "draining")})
             # Mesh-endpoint health gate (docs/mesh_serving.md): a dead
             # follower means THIS endpoint cannot answer correctly — 500,
             # a breaker FAILURE, so dispatchers eject it and route to
@@ -302,7 +428,8 @@ class InferenceWorker:
             # queue-depth-vs-device-occupancy replacing the reference's
             # per-replica thread cap (SURVEY.md §7 hard part #2).
             if self.batcher.pending_count >= self.batcher.max_pending:
-                return 503, "Inference queue saturated; retry later."
+                return 503, "Inference queue saturated; retry later.", {
+                    "Retry-After": "1"}
             return None
 
         async def _sync_request_kwargs(request):
@@ -362,14 +489,29 @@ class InferenceWorker:
                 if found is not None:
                     return json.loads(found[0])
             example = _servable.preprocess(body, content_type)
+            gen_label = generation_label(_servable.generation)
+            t0 = time.perf_counter()
             try:
                 result = await self.batcher.submit(_name, np.asarray(example),
                                                    priority=priority,
                                                    deadline_at=deadline_at)
             except BatcherSaturated:
                 from aiohttp import web
+                self._rollout_outcomes.inc(generation=gen_label,
+                                           outcome="saturated")
                 return web.Response(status=503,
-                                    text="Inference queue saturated; retry.")
+                                    text="Inference queue saturated; retry.",
+                                    headers={"Retry-After": "1"})
+            except DrainingError:
+                # Raced the drain flip between admission and submit: the
+                # refusal is retryable at a peer, never a failure of this
+                # request (docs/deployment.md#drain).
+                from aiohttp import web
+                self._rollout_outcomes.inc(generation=gen_label,
+                                           outcome="draining")
+                return web.Response(
+                    status=503, text="Worker draining; retry a peer.",
+                    headers={"Retry-After": "1", DRAINING_HEADER: "1"})
             except RowPoisoned:
                 # Sync path has no task to redeliver — answer an honest
                 # retryable error (503: the caller/proxy retries; other
@@ -378,13 +520,21 @@ class InferenceWorker:
                 from aiohttp import web
                 return web.Response(
                     status=503,
-                    text="Result invalidated by a degraded mesh host; retry.")
+                    text="Result invalidated by a degraded mesh host; retry.",
+                    headers={"Retry-After": "1"})
             except DeadlineExceeded as exc:
                 from aiohttp import web
+                self._rollout_outcomes.inc(generation=gen_label, outcome="expired")
                 return web.Response(
                     status=504, text="Deadline exceeded while queued.",
                     headers={SHED_REASON_HEADER:
                              shed_reason(exc.hop, "deadline")})
+            except Exception:
+                self._rollout_outcomes.inc(generation=gen_label, outcome="error")
+                raise
+            self._rollout_outcomes.inc(generation=gen_label, outcome="ok")
+            self._rollout_latency.observe(time.perf_counter() - t0,
+                                          generation=gen_label)
             out = _jsonable(result)
             if key is not None:
                 cache.put(key, json.dumps(out).encode(), "application/json")
@@ -416,6 +566,8 @@ class InferenceWorker:
             except Exception as exc:  # noqa: BLE001; ai4e: noqa[AIL005] — the error is recorded on the task record (failed - bad input)
                 await tm.fail_task(taskId, f"failed - bad input: {exc}")
                 return
+            gen_label = generation_label(_servable.generation)
+            t0 = time.perf_counter()
             try:
                 result = await self.batcher.submit(_name, np.asarray(example),
                                                    priority=priority,
@@ -425,9 +577,25 @@ class InferenceWorker:
                 # Saturated between admission and submit: hand the task back
                 # to the broker (same-endpoint republish with empty body →
                 # original-body replay → redelivery) instead of failing it.
+                self._rollout_outcomes.inc(generation=gen_label,
+                                           outcome="saturated")
                 current = await tm.get_task_status(taskId)
                 endpoint = (current or {}).get("Endpoint", async_path)
                 await tm.add_pipeline_task(taskId, endpoint)
+                return
+            except DrainingError:
+                # The drain retired this entry before it was cut to the
+                # device (or the flip raced submit): redeliver the task
+                # through the broker — per task, exactly the poisoned-row
+                # path — so a peer serves it and no client sees a loss
+                # (docs/deployment.md#drain).
+                self._rollout_outcomes.inc(generation=gen_label,
+                                           outcome="draining")
+                if buf is not None:
+                    from ..observability.ledger import RETRY
+                    buf.stamp(RETRY, "worker", reason="draining")
+                await self._flush_ledger(tm, taskId, buf)
+                await redeliver_poisoned(tm, taskId, async_path)
                 return
             except RowPoisoned:
                 # A degraded mesh host invalidated THIS row (the batch's
@@ -446,6 +614,7 @@ class InferenceWorker:
             except DeadlineExceeded as exc:
                 # Expired while pending in the batcher (which already
                 # counted the hop metric): terminal transition only.
+                self._rollout_outcomes.inc(generation=gen_label, outcome="expired")
                 await self._flush_ledger(tm, taskId, buf)
                 await tm.update_task_status(
                     taskId, expired_status(exc.hop), TaskStatus.EXPIRED)
@@ -457,8 +626,12 @@ class InferenceWorker:
                 # while the task is still non-terminal, so exactly the
                 # failed requests the flight recorder keeps at 100 %
                 # carry their worker-side timeline.
+                self._rollout_outcomes.inc(generation=gen_label, outcome="error")
                 await self._flush_ledger(tm, taskId, buf)
                 raise
+            self._rollout_outcomes.inc(generation=gen_label, outcome="ok")
+            self._rollout_latency.observe(time.perf_counter() - t0,
+                                          generation=gen_label)
             if pipeline_to is not None:
                 if handoff_wants_example:
                     # Handoffs consume the natural image; wire-encoded
@@ -547,8 +720,14 @@ class InferenceWorker:
         vocab = getattr(vocab, "vocab_size", None)
 
         def _saturation_check():
+            if self.drain_state.is_draining:
+                return (503, "Worker draining; retry a peer.",
+                        {"Retry-After": "1", DRAINING_HEADER: "1",
+                         SHED_REASON_HEADER:
+                             shed_reason("worker", "draining")})
             if engine.pending_count >= engine.max_pending:
-                return 503, "Decode queue saturated; retry later."
+                return 503, "Decode queue saturated; retry later.", {
+                    "Retry-After": "1"}
             return None
 
         async def _request_kwargs(request):
@@ -644,6 +823,17 @@ class InferenceWorker:
                 current = await tm.get_task_status(taskId)
                 endpoint = (current or {}).get("Endpoint", async_path)
                 await tm.add_pipeline_task(taskId, endpoint)
+                return
+            except DrainingError:
+                # Drained mid-decode (queued entry retired, or an active
+                # straggler force-retired past the budget): redeliver
+                # through the broker per task — a peer re-decodes from
+                # the prompt, the client never sees the drain.
+                if buf is not None:
+                    from ..observability.ledger import RETRY
+                    buf.stamp(RETRY, "worker", reason="draining")
+                await self._flush_ledger(tm, taskId, buf)
+                await redeliver_poisoned(tm, taskId, async_path)
                 return
             except DeadlineExceeded as exc:
                 await self._flush_ledger(tm, taskId, buf)

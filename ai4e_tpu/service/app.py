@@ -146,10 +146,16 @@ class APIService:
     # -- request admission (ai4e_service.py:116-133) -----------------------
 
     def _admission_error(self, spec: EndpointSpec, request: web.Request):
+        """A refusal is ``(code, message)`` or ``(code, message, headers)``
+        — the 3-tuple form lets admission checks attach refusal markers
+        (``Retry-After``, ``X-Draining``) the caller's retry policy keys
+        on (AIL015: every 429/503 must tell the caller when to retry)."""
         if self.is_terminating:
-            return 503, "Service is shutting down."
+            return (503, "Service is shutting down.",
+                    {"Retry-After": "1", "X-Draining": "1"})
         if spec.in_flight >= spec.maximum_concurrent_requests:
-            return 503, "Too many requests; try again later."
+            return 503, "Too many requests; try again later.", {
+                "Retry-After": "1"}
         if spec.content_types:
             ctype = request.content_type or ""
             if ctype not in spec.content_types:
@@ -184,9 +190,10 @@ class APIService:
             # request.read()).
             err = self._admission_error(spec, request)
             if err:
-                code, msg = err
+                code, msg, *rest = err
                 self._http_total.inc(code=str(code), path=spec.api_path)
-                return web.Response(status=code, text=msg)
+                return web.Response(status=code, text=msg,
+                                    headers=rest[0] if rest else None)
             self._reserve(spec)
 
             released_to_background = False
@@ -323,7 +330,9 @@ class APIService:
 
     async def _health(self, _: web.Request) -> web.Response:
         if self.is_terminating:
-            return web.Response(status=503, text="Draining.")
+            return web.Response(status=503, text="Draining.",
+                                headers={"Retry-After": "1",
+                                         "X-Draining": "1"})
         return web.json_response({"service": self.name, "status": "healthy"})
 
     async def _task_status(self, request: web.Request) -> web.Response:

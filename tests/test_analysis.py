@@ -1198,6 +1198,7 @@ class TestStaleGuardFixes:
 
         class FakeResp:
             status = 400
+            headers = {}  # the dispatcher consults X-Draining
             async def read(self):
                 return b""
 

@@ -461,8 +461,10 @@ class TestServeStream:
                 "/lm-stream-async"].admission_check
             return check()
 
-        status, _ = run(main())
+        status, _, headers = run(main())
         assert status == 503
+        # Every refusal names its retry horizon (docs/analysis.md AIL015).
+        assert headers["Retry-After"] == "1"
 
 
 # -- CLI wiring (AI4E_RUNTIME_DECODE_*) --------------------------------------

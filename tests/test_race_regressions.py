@@ -110,6 +110,7 @@ def _dispatcher(cls, broker, tm, queue="/v1/q", **kw):
 class _FakeResponse:
     def __init__(self, status):
         self.status = status
+        self.headers = {}  # the dispatcher consults X-Draining
 
     async def read(self):
         return b""
@@ -1620,3 +1621,219 @@ class TestMeshPoisonedRowRedelivery:
                                        schedules=SCHEDULES, seed=SEED)
         assert not report.ok
         assert "clobbered" in str(report.failures[0].error)
+
+
+# -- rollout drain: the two flip windows (PR 18) ------------------------------
+#
+# The drain state machine (rollout/drain.py, docs/deployment.md#drain)
+# keeps two suspension-point-atomicity contracts, both stdlib-only so
+# this job explores them against the REAL code: (1) the drain flip and
+# the pending sweep are one synchronous step with the take-and-clear,
+# so a concurrently scheduled batch cut can never deliver a device
+# result into a future the sweep already failed; (2) the reload
+# admission check and the in-flight registration are one synchronous
+# step, so a weight swap can never complete on a worker that already
+# reported itself drained.
+
+from ai4e_tpu.rollout.drain import (ACTIVE, DRAINED, DrainingError,
+                                    DrainState, drain_worker, retire_pending)
+
+
+class _PendingEntry:
+    __slots__ = ("task_id", "future")
+
+    def __init__(self, task_id, future):
+        self.task_id = task_id
+        self.future = future
+
+
+async def _reverted_retire_pending(pending_by_model):
+    """The pre-fix sweep, verbatim: snapshot the queue, flush the pending
+    gauge (an await), then clear and fail — the take-and-clear straddles
+    a suspension point (AIL007's shape), so a batch cut landing inside
+    the window owns futures this sweep is about to fail."""
+    retired = 0
+    for entries in list(pending_by_model.values()):
+        taken = list(entries)
+        await yield_point()  # the pending-gauge flush hop
+        entries[:] = []
+        for entry in taken:
+            fut = getattr(entry, "future", entry)
+            fut.set_exception(DrainingError())
+            retired += 1
+    return retired
+
+
+class TestDrainFlipVsBatchCut:
+    """Drain-flip vs in-flight batch completion: the flusher cuts a
+    batch (synchronous take-and-clear, then the device hop, then results
+    land in the taken futures) while the drain verb sweeps the same
+    pending queues. Fixed (``retire_pending``: synchronous take-and-
+    clear, ``done()``-guarded fail): every task gets exactly one client
+    outcome — completed on this worker, redelivered to a peer, or
+    refused at admission — and a redelivered task was never ALSO
+    executed here. Reverted (await between snapshot and clear): a cut
+    inside the window either double-resolves a future the sweep failed
+    (InvalidStateError mid-drain) or executes a batch whose tasks the
+    broker is simultaneously redelivering — a duplicate delivery."""
+
+    @staticmethod
+    def _scenario(fixed: bool):
+        def make():
+            pending = {"echo": []}
+            state = DrainState(clock=lambda: 0.0)
+            outcomes = {"t1": [], "t2": []}
+            executed = []
+
+            async def submitter():
+                # Two submits through the batcher's admission gate: a
+                # draining worker refuses (503 + X-Draining -> the
+                # caller retries a peer), an active one queues.
+                futs = {}
+                for task_id in ("t1", "t2"):
+                    if state.is_draining:
+                        outcomes[task_id].append("refused")
+                    else:
+                        fut = asyncio.get_running_loop().create_future()
+                        pending["echo"].append(_PendingEntry(task_id, fut))
+                        futs[task_id] = fut
+                    if task_id == "t1":
+                        await yield_point()
+                for task_id, fut in futs.items():
+                    try:
+                        await fut
+                        outcomes[task_id].append("completed")
+                    except DrainingError:
+                        outcomes[task_id].append("redelivered")
+
+            async def flusher():
+                # One batch cut racing the drain: the take-and-clear is
+                # one synchronous step (the real flusher's shape), the
+                # device hop suspends, then results deliver.
+                while True:
+                    if pending["echo"]:
+                        taken, pending["echo"][:] = (
+                            list(pending["echo"]), [])
+                        await yield_point()  # the device execute hop
+                        for entry in taken:
+                            executed.append(entry.task_id)
+                            if not entry.future.done():
+                                entry.future.set_result("ok")
+                        return
+                    if state.is_draining:
+                        return
+                    await yield_point()
+
+            async def drainer():
+                await yield_point()  # the drain verb arrives mid-traffic
+                state.begin()
+                if fixed:
+                    retire_pending(pending)
+                else:
+                    await _reverted_retire_pending(pending)
+                state.mark_drained()
+
+            def check():
+                for task_id, outs in outcomes.items():
+                    assert len(outs) == 1, (
+                        f"client outcome for {task_id} clobbered: {outs}")
+                    if outs == ["redelivered"]:
+                        assert task_id not in executed, (
+                            f"{task_id} redelivered AND executed on the "
+                            "draining worker — a duplicate delivery")
+
+            return [submitter(), flusher(), drainer()], check
+
+        return make
+
+    def test_fixed_sweep_race_free(self):
+        report = explore_interleavings(self._scenario(fixed=True),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_reverted_sweep_caught(self):
+        report = explore_interleavings(self._scenario(fixed=False),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert not report.ok, (
+            "the snapshot-await-clear window was not reachable — either "
+            "the scenario no longer models the sweep or the budget is "
+            "too small")
+
+
+async def _reverted_try_begin_reload(state):
+    """The pre-fix reload admission, verbatim: the drain check and the
+    in-flight registration straddled the reload-lock acquisition — one
+    suspension between guard and guarded write (AIL007's shape). A drain
+    that lands inside the window reads ``reloads_in_flight == 0``,
+    reports the worker drained, and the swap then completes on a worker
+    the rollout controller already moved past."""
+    if state.is_draining:
+        return False
+    await yield_point()  # acquiring the reload serial lock
+    state._reloads += 1
+    return True
+
+
+class TestDrainFlipVsReload:
+    """Drain-flip vs concurrent hot reload: the reload verb races the
+    drain verb on one worker. Fixed (``try_begin_reload``: check +
+    register in one synchronous step): the reload either registers fully
+    before the drain — which then waits for it — or is refused with 409
+    while draining; ``drain_worker`` never reports a worker drained with
+    a swap still in flight. Reverted (await between check and register):
+    the drain completes inside the window and the swap lands on a worker
+    that already reported itself drained."""
+
+    @staticmethod
+    def _scenario(fixed: bool):
+        def make():
+            state = DrainState(clock=lambda: 0.0)
+            events = []
+
+            async def reloader():
+                await yield_point()  # the reload POST arrives
+                if fixed:
+                    admitted = state.try_begin_reload()
+                else:
+                    admitted = await _reverted_try_begin_reload(state)
+                if not admitted:
+                    events.append(("refused", state.state))  # the 409
+                    return
+                await yield_point()  # the weight swap itself
+                events.append(("swapped", state.state))
+                state.end_reload()
+
+            async def drainer():
+                res = await drain_worker(state, timeout_s=30.0,
+                                         poll_s=0.01, clock=lambda: 0.0)
+                events.append(("drained", res["clean"]))
+
+            def check():
+                assert ("drained", True) in events, (
+                    f"drain never completed clean: {events}")
+                for kind, detail in events:
+                    if kind == "swapped":
+                        assert detail != DRAINED, (
+                            "weight swap completed on a worker that "
+                            "already reported itself drained")
+                    if kind == "refused":
+                        assert detail != ACTIVE, (
+                            "reload 409'd on an active worker")
+
+            return [reloader(), drainer()], check
+
+        return make
+
+    def test_fixed_interlock_race_free(self):
+        report = explore_interleavings(self._scenario(fixed=True),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_reverted_interlock_caught(self):
+        report = explore_interleavings(self._scenario(fixed=False),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert not report.ok, (
+            "the check-await-register window was not reachable — either "
+            "the scenario no longer models the admission or the budget "
+            "is too small")
+        assert "drained" in str(report.failures[0].error)
