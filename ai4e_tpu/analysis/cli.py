@@ -17,6 +17,59 @@ from .rules import ALL_RULES
 
 DEFAULT_BASELINE = "analysis_baseline.json"
 
+_FAMILY_TITLES = {
+    "invariants": "intra-process invariants",
+    "wire": "wire contracts (cross-process)",
+    "hygiene": "analyzer hygiene",
+}
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _sarif_document(result, rules) -> dict:
+    """SARIF 2.1.0 for the run's ACTIVE findings (baselined ones are
+    accepted debt, not annotations). The baseline fingerprint doubles as
+    ``partialFingerprints`` — same identity, so an annotation survives
+    pushes that merely move the finding, exactly like the baseline does.
+    Schema documented in docs/analysis.md beside --json v1."""
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "ai4e-lint",
+                "informationUri": "docs/analysis.md",
+                "rules": [{
+                    "id": r.rule_id,
+                    "name": r.name,
+                    "shortDescription": {"text": r.description},
+                } for r in rules],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                }}],
+                "partialFingerprints": {
+                    "ai4eFingerprint/v1": f.fingerprint},
+            } for f in result.findings],
+        }],
+    }
+
+
+def _print_stats(result, stream) -> None:
+    print(f"stats: {result.files_scanned} file(s) parsed in "
+          f"{result.parse_seconds * 1000:.0f} ms, total "
+          f"{result.total_seconds * 1000:.0f} ms", file=stream)
+    for rule_id, secs in sorted(result.rule_seconds.items(),
+                                key=lambda kv: -kv[1]):
+        print(f"stats: {rule_id}  {secs * 1000:8.1f} ms", file=stream)
+
 
 class UnknownRuleError(ValueError):
     """``--select``/``--ignore`` named a rule id the catalog doesn't have.
@@ -69,20 +122,57 @@ def main(argv: list[str] | None = None) -> int:
                              "refuses the file until each is filled in)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="machine-readable output")
+    parser.add_argument("--sarif", action="store_true",
+                        help="SARIF 2.1.0 output (active findings only; "
+                             "fingerprints ride partialFingerprints so "
+                             "PR annotations dedupe across pushes)")
+    parser.add_argument("--stats", action="store_true",
+                        help="print per-rule wall time after the run "
+                             "(stderr in text mode, `stats` key in "
+                             "--json)")
     parser.add_argument("--select", default=None, metavar="IDS",
                         help="comma-separated rule ids to run")
     parser.add_argument("--ignore", default=None, metavar="IDS",
                         help="comma-separated rule ids to skip")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
+    parser.add_argument("--dump-wire", action="store_true",
+                        help="print docs/API.md's ai4e:routes / "
+                             "ai4e:headers marked tables generated from "
+                             "the extracted wire surface, and exit")
     args = parser.parse_args(argv)
 
     if args.list_rules:
+        # Family group headers deliberately do NOT start with "AIL":
+        # scripts/lint.sh counts rules with `grep -c '^AIL'` and an
+        # AIL-prefixed banner would inflate the registry count it gates.
+        last_family = None
         for cls in ALL_RULES:
+            family = getattr(cls, "family", "invariants")
+            if family != last_family:
+                print(f"# {_FAMILY_TITLES.get(family, family)}")
+                last_family = family
             print(f"{cls.rule_id}  {cls.name:<26} {cls.description}")
         return 0
 
     root = os.path.abspath(args.root or os.getcwd())
+    abs_paths = [os.path.join(root, p) if not os.path.isabs(p) else p
+                 for p in args.paths]
+
+    if args.dump_wire:
+        from .core import ProjectContext, _iter_py_files, parse_module
+        from .rules.wire import dump_wire
+        modules = []
+        for path in _iter_py_files(abs_paths):
+            rel = os.path.relpath(os.path.abspath(path), root)
+            try:
+                modules.append(parse_module(path, rel.replace(os.sep, "/")))
+            except (OSError, SyntaxError, ValueError):
+                continue
+        print(dump_wire(root, ProjectContext(root=root, modules=modules)),
+              end="")
+        return 0
+
     baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
     baseline = Baseline()
     if not args.no_baseline and not args.write_baseline:
@@ -98,15 +188,19 @@ def main(argv: list[str] | None = None) -> int:
         print(f"error: {exc}", file=sys.stderr)
         return 2
     analyzer = Analyzer(rules, root=root, baseline=baseline)
-    result = analyzer.run([os.path.join(root, p)
-                           if not os.path.isabs(p) else p
-                           for p in args.paths])
+    result = analyzer.run(abs_paths)
 
     if args.write_baseline:
         Baseline.write(baseline_path, result.findings)
         print(f"wrote {len(result.findings)} finding(s) to {baseline_path}; "
               "fill in every justification before committing")
         return 0
+
+    if args.sarif:
+        print(json.dumps(_sarif_document(result, rules), indent=2))
+        if args.stats:
+            _print_stats(result, sys.stderr)
+        return 1 if result.findings else 0
 
     if args.as_json:
         # Schema documented in docs/analysis.md ("--json output"). Each
@@ -122,14 +216,21 @@ def main(argv: list[str] | None = None) -> int:
                 "justification": "",
             }
             return d
-        print(json.dumps({
+        doc = {
             "version": 1,
             "findings": [_dump(f) for f in result.findings],
             "baselined": [f.to_dict() for f in result.baselined],
             "suppressed": result.suppressed,
             "stale_baseline": result.stale_baseline,
             "files_scanned": result.files_scanned,
-        }, indent=2))
+        }
+        if args.stats:
+            doc["stats"] = {
+                "parse_seconds": result.parse_seconds,
+                "total_seconds": result.total_seconds,
+                "rule_seconds": result.rule_seconds,
+            }
+        print(json.dumps(doc, indent=2))
     else:
         for f in result.findings:
             print(f.render())
@@ -141,6 +242,8 @@ def main(argv: list[str] | None = None) -> int:
         print(f"ai4e-lint: {result.files_scanned} file(s), {n} finding(s), "
               f"{len(result.baselined)} baselined, "
               f"{result.suppressed} suppressed")
+        if args.stats:
+            _print_stats(result, sys.stderr)
     return 1 if result.findings else 0
 
 

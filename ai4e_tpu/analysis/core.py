@@ -25,17 +25,25 @@ import hashlib
 import json
 import os
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field, replace
 
 # Matched only inside COMMENT tokens (tokenize), so the leading "#" is
 # implicit — the marker can share a comment with other annotations
-# ("# noqa: BLE001; ai4e: noqa[AIL005] — reason").
+# ("# noqa: BLE001; ai4e: noqa[AILxxx] — reason"; the placeholder id
+# keeps this example itself out of AIL019's unused-suppression sweep).
 _NOQA_RE = re.compile(r"ai4e:\s*noqa\[([A-Za-z0-9_,\s]+)\]")
 
 # Rule id for files the analyzer itself cannot parse: a syntax error means
 # every other invariant is unverifiable, which is itself a finding.
 PARSE_ERROR_RULE = "AIL000"
+
+# Rule id for suppressions that suppress nothing (see Analyzer.run). The
+# logic lives in the driver — it needs the full raw-finding set — but the
+# id is registered as a normal catalog rule so --select/--ignore and the
+# docs treat it uniformly.
+_UNUSED_SUPPRESSION_RULE = "AIL019"
 
 
 @dataclass(frozen=True)
@@ -54,14 +62,25 @@ class Finding:
     # earlier twin shifts later ordinals — conservative by design: the
     # survivor resurfaces for re-justification rather than hiding.
     ordinal: int = 0
+    # Rule-chosen identity override. The default fingerprint is keyed on
+    # (path, symbol, snippet) — right for per-module rules, wrong for
+    # wire-surface rules whose finding is about a CONTRACT, not a line:
+    # moving a route registration between files must not churn the
+    # baseline (the contract didn't change). Wire rules set this to the
+    # contract identity ("AIL016|dead-route|GET /healthz").
+    fingerprint_key: str = ""
 
     @property
     def fingerprint(self) -> str:
         """Line-number-free identity for baseline matching: stable across
         pure moves/reformats of surrounding code, invalidated when the
-        flagged line itself (or its enclosing symbol) changes."""
-        norm = " ".join(self.snippet.split())
-        raw = f"{self.rule}|{self.path}|{self.symbol}|{norm}|{self.ordinal}"
+        flagged line itself (or its enclosing symbol) changes. Rules may
+        override the identity with ``fingerprint_key`` (wire contracts)."""
+        if self.fingerprint_key:
+            raw = f"{self.fingerprint_key}|{self.ordinal}"
+        else:
+            norm = " ".join(self.snippet.split())
+            raw = f"{self.rule}|{self.path}|{self.symbol}|{norm}|{self.ordinal}"
         return hashlib.sha1(raw.encode("utf-8")).hexdigest()[:16]
 
     def to_dict(self) -> dict:
@@ -114,6 +133,10 @@ class Rule:
     rule_id: str = ""
     name: str = ""
     description: str = ""
+    # Catalog grouping for --list-rules: "invariants" (intra-process,
+    # AIL001–AIL015), "wire" (cross-process contracts), "hygiene"
+    # (the analyzer checking its own annotations).
+    family: str = "invariants"
 
     def check_module(self, ctx: ModuleContext):  # pragma: no cover - interface
         raise NotImplementedError
@@ -348,6 +371,42 @@ class AwaitFlow:
         return False
 
 
+# -- parse cache -------------------------------------------------------------
+
+
+#: (abspath) → (mtime_ns, size, tree, source). Parsing dominates analyzer
+#: wall time (one full-repo run parses ~200 files); within one process —
+#: the test suite, a watch loop, repeated Analyzer.run calls — a file
+#: whose stat signature is unchanged reuses the parsed tree. Rules treat
+#: trees as read-only (nothing in the framework mutates them), so sharing
+#: across runs is safe. Bounded: blown away wholesale past _PARSE_CACHE_MAX
+#: entries rather than LRU-tracked — the workload is "same repo, many
+#: runs", where eviction precision buys nothing.
+_PARSE_CACHE: dict[str, tuple[int, int, ast.Module, str]] = {}
+_PARSE_CACHE_MAX = 4096
+
+
+def parse_module(abspath: str, relpath: str) -> ModuleContext:
+    """Parse ``abspath`` into a ModuleContext (fresh context, cached
+    tree/source keyed on mtime+size). Raises OSError/SyntaxError/
+    ValueError exactly like ``ast.parse`` — callers decide whether a
+    parse failure is a finding (Analyzer: AIL000) or a skip."""
+    abspath = os.path.abspath(abspath)
+    st = os.stat(abspath)
+    hit = _PARSE_CACHE.get(abspath)
+    if hit is not None and hit[0] == st.st_mtime_ns and hit[1] == st.st_size:
+        tree, source = hit[2], hit[3]
+    else:
+        with open(abspath, encoding="utf-8") as fh:
+            source = fh.read()
+        tree = ast.parse(source, filename=abspath)
+        if len(_PARSE_CACHE) >= _PARSE_CACHE_MAX:
+            _PARSE_CACHE.clear()
+        _PARSE_CACHE[abspath] = (st.st_mtime_ns, st.st_size, tree, source)
+    return ModuleContext(path=relpath, abspath=abspath, tree=tree,
+                         source=source, lines=source.splitlines())
+
+
 # -- suppression -------------------------------------------------------------
 
 
@@ -445,6 +504,11 @@ class AnalysisResult:
     suppressed: int
     stale_baseline: list[dict]
     files_scanned: int
+    # --stats surface: where the run's wall time went. ``rule_seconds``
+    # is keyed by rule id, source-order preserved by dict insertion.
+    parse_seconds: float = 0.0
+    total_seconds: float = 0.0
+    rule_seconds: dict[str, float] = field(default_factory=dict)
 
     @property
     def clean(self) -> bool:
@@ -478,35 +542,72 @@ class Analyzer:
         return rel.replace(os.sep, "/")
 
     def run(self, paths: list[str]) -> AnalysisResult:
+        t_run = time.perf_counter()
         files = _iter_py_files(paths)
         modules: list[ModuleContext] = []
         raw: list[Finding] = []
         suppressions: dict[str, dict[int, frozenset[str]]] = {}
+        by_rel: dict[str, ModuleContext] = {}
+        parse_seconds = 0.0
+        rule_seconds: dict[str, float] = {
+            r.rule_id: 0.0 for r in self.rules}
         for path in files:
             rel = self._relpath(path)
+            t0 = time.perf_counter()
             try:
-                with open(path, encoding="utf-8") as fh:
-                    source = fh.read()
-                tree = ast.parse(source, filename=path)
+                ctx = parse_module(path, rel)
             except (OSError, SyntaxError, ValueError) as exc:
+                parse_seconds += time.perf_counter() - t0
                 line = getattr(exc, "lineno", 1) or 1
                 raw.append(Finding(
                     rule=PARSE_ERROR_RULE, path=rel, line=line, col=0,
                     message=f"cannot parse: {exc}", snippet=""))
                 continue
-            ctx = ModuleContext(path=rel, abspath=os.path.abspath(path),
-                                tree=tree, source=source,
-                                lines=source.splitlines())
+            parse_seconds += time.perf_counter() - t0
             modules.append(ctx)
-            suppressions[rel] = noqa_lines(source)
+            by_rel[rel] = ctx
+            suppressions[rel] = noqa_lines(ctx.source)
             for rule in self.rules:
                 if isinstance(rule, ProjectRule):
                     continue
+                t0 = time.perf_counter()
                 raw.extend(rule.check_module(ctx))
+                rule_seconds[rule.rule_id] += time.perf_counter() - t0
         project_ctx = ProjectContext(root=self.root, modules=modules)
         for rule in self.rules:
             if isinstance(rule, ProjectRule):
+                t0 = time.perf_counter()
                 raw.extend(rule.check_project(project_ctx))
+                rule_seconds[rule.rule_id] += time.perf_counter() - t0
+
+        # AIL019 — unused suppressions. A ``# ai4e: noqa[AILxxx]`` whose
+        # rule did not fire on that line is dead weight at best and a
+        # masked regression at worst (the bug was fixed, the blindfold
+        # stayed on). Only rules ACTIVE in this run are judged: under
+        # ``--select`` a suppression for an unselected rule is unproven,
+        # not unused. Suppressing AIL019 itself on the line (noqa[AIL005,
+        # AIL019]) works through the normal pipeline below.
+        active_ids = {r.rule_id for r in self.rules}
+        if _UNUSED_SUPPRESSION_RULE in active_ids:
+            t0 = time.perf_counter()
+            fired = {(f.path, f.line, f.rule) for f in raw}
+            for rel in sorted(suppressions):
+                for line, ids in sorted(suppressions[rel].items()):
+                    for rid in sorted(ids):
+                        if (rid == _UNUSED_SUPPRESSION_RULE
+                                or rid not in active_ids
+                                or (rel, line, rid) in fired):
+                            continue
+                        mod = by_rel.get(rel)
+                        raw.append(Finding(
+                            rule=_UNUSED_SUPPRESSION_RULE, path=rel,
+                            line=line, col=0,
+                            message=(f"suppression `ai4e: noqa[{rid}]` has "
+                                     f"no effect — {rid} does not fire on "
+                                     "this line; drop it (a stale noqa "
+                                     "masks the next real finding)"),
+                            snippet=mod.snippet(line) if mod else ""))
+            rule_seconds[_UNUSED_SUPPRESSION_RULE] += time.perf_counter() - t0
 
         # Assign occurrence ordinals in source order so byte-identical
         # findings in the same symbol get distinct fingerprints.
@@ -536,4 +637,7 @@ class Analyzer:
         return AnalysisResult(
             findings=active, baselined=baselined, suppressed=suppressed,
             stale_baseline=self.baseline.stale(matched),
-            files_scanned=len(files))
+            files_scanned=len(files),
+            parse_seconds=parse_seconds,
+            total_seconds=time.perf_counter() - t_run,
+            rule_seconds=rule_seconds)

@@ -15,6 +15,8 @@ from .stale_read import StaleReadAcrossAwait
 from .status_clobber import TerminalStatusClobber
 from .swallowed import SwallowedException
 from .unplaced import UnplacedDeviceTransfer
+from .unused_noqa import UnusedSuppression
+from .wire import ClientRouteDrift, HeaderVocabularyDrift, UnhandledRefusalStatus
 
 ALL_RULES = [
     BlockingCallInAsync,
@@ -32,6 +34,10 @@ ALL_RULES = [
     UnboundedMetricLabel,
     UnplacedDeviceTransfer,
     RefusalWithoutRetryAfter,
+    ClientRouteDrift,
+    HeaderVocabularyDrift,
+    UnhandledRefusalStatus,
+    UnusedSuppression,
 ]
 
 __all__ = ["ALL_RULES"] + [cls.__name__ for cls in ALL_RULES]

@@ -1,0 +1,32 @@
+"""AIL019 — unused suppression (the ruff-RUF100 shape).
+
+An ``# ai4e: noqa[AILxxx]`` on a line where that rule no longer fires is
+not harmless cruft: the bug it blessed was fixed, the blindfold stayed
+on, and the NEXT regression on that line lands pre-suppressed. The check
+itself lives in ``core.Analyzer.run`` — it needs the complete raw
+finding set, which no individual rule sees — but the id is registered
+here as a normal catalog rule so ``--select``/``--ignore``, the rule
+count gate in scripts/lint.sh, and the docs catalog treat it uniformly.
+
+Scope guard: only rules ACTIVE in the run are judged. Under ``--select
+AIL001`` a ``noqa[AIL005]`` is unproven (AIL005 never ran), not unused.
+A justified keep is expressed by adding AIL019 to the same marker:
+``# ai4e: noqa[AIL005,AIL019] — fires only under the py3.12 parser``.
+"""
+
+from __future__ import annotations
+
+from ..core import Rule
+
+
+class UnusedSuppression(Rule):
+    rule_id = "AIL019"
+    name = "unused-suppression"
+    description = ("an `ai4e: noqa[RULE]` comment on a line where RULE "
+                  "does not fire suppresses nothing today and the next "
+                  "real finding tomorrow — drop it")
+    family = "hygiene"
+
+    def check_module(self, ctx):
+        # Implemented in Analyzer.run (needs the whole raw finding set).
+        return ()

@@ -1,0 +1,413 @@
+"""AIL016–AIL018 — cross-process wire-contract drift.
+
+The platform's hardest review-found bugs were wire-shaped: the PR 8
+backend-vs-published route-label split (two processes disagreeing about
+what a path is called, pinning goodput SLOs bad during shedding), and
+PR 18's reload-409-while-draining interlock that every reload caller
+must branch on or silently wedge an upgrade. AIL001–AIL015 verify
+invariants *within* a process; these three check the contracts *between*
+them, against the statically extracted HTTP surface
+(``analysis/wire_surface.py``):
+
+- **AIL016 client-route-drift** — a client call whose path+method
+  resolves to no registered route (it can only 404), and a registered
+  route that no client calls and no ``external`` caller row in
+  docs/API.md's ``ai4e:routes`` table vouches for (dead surface). The
+  marked table is also kept honest both directions, AIL011-style:
+  a registered route missing from the table, and a table row nothing
+  registers, are both findings.
+- **AIL017 header-vocabulary-drift** — the ``X-*``/``Retry-After``
+  header vocabulary must round-trip: every header code emits needs a
+  reader somewhere (or an ``external`` reader documented), every header
+  code reads needs an emitter (or an ``external`` emitter — browsers
+  and load clients set ``X-Deadline-Ms``), every used header needs a
+  row in the ``ai4e:headers`` marked table, and every documented header
+  must still exist in code. A literal header outside the vocabulary is
+  a typo-minted header no peer will ever read.
+- **AIL018 unhandled-refusal-status** — a distinguished refusal status
+  a route demonstrably mints (409 drain/ownership interlock, 429
+  quota/shed, 503 backpressure/standby, 504 deadline) that the calling
+  function's branch structure never distinguishes from generic failure.
+  Callers that hand the raw response back to *their* caller (``_request``
+  helpers) are exempt — the distinguishing happens one frame up.
+
+Wire findings carry a ``fingerprint_key`` naming the CONTRACT (method +
+canonical path, or header name), not the file/line — moving a
+registration between modules is a refactor, not a contract change, and
+must not churn the baseline.
+
+The out-of-tree client library (``clients/python/``) is parsed as
+client-side evidence only: its calls count as callers and its header
+uses as emitters/readers, but it registers no routes.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from ..core import Finding, ProjectRule, parse_module
+from ..wire_surface import (
+    RouteReg,
+    WireSurface,
+    extract_wire_surface,
+    load_extra_clients,
+    parse_shape,
+    shape_display,
+)
+
+_API_DOC = "docs/API.md"
+ROUTES_MARK = "ai4e:routes"
+HEADERS_MARK = "ai4e:headers"
+
+_METHOD_RE = re.compile(r"`([A-Z*]+)`")
+_PATH_RE = re.compile(r"`(/[^`]*)`")
+_HEADER_TOKEN_RE = re.compile(r"`([A-Za-z][A-Za-z0-9-]*)`")
+
+#: Operator-facing names for the distinguished refusal statuses.
+STATUS_LABELS = {
+    409: "conflict — drain/ownership interlock",
+    429: "quota/shed refusal",
+    503: "backpressure/standby refusal",
+    504: "deadline exceeded",
+}
+
+
+def _safe_parse(abspath: str, rel: str):
+    try:
+        return parse_module(abspath, rel)
+    except (OSError, SyntaxError, ValueError):
+        return None
+
+
+def surface_of(ctx) -> WireSurface:
+    """Extract (once per ProjectContext — the three wire rules share one
+    pass) the project's wire surface, with ``clients/python/`` parsed in
+    as extra client-side evidence."""
+    cached = getattr(ctx, "_wire_surface", None)
+    if cached is None:
+        extra = load_extra_clients(ctx.root, _safe_parse)
+        cached = extract_wire_surface(ctx, extra)
+        ctx._wire_surface = cached
+    return cached
+
+
+def marked_rows(root: str, mark: str
+                ) -> list[tuple[list[str], int]] | None:
+    """(cells, line) for each data row of the ``mark`` marked table in
+    docs/API.md, or None when the region is absent. Separator rows and
+    the header row (no backticked first cell) are skipped."""
+    path = os.path.join(root, *_API_DOC.split("/"))
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return None
+    inside = found = False
+    out: list[tuple[list[str], int]] = []
+    for i, line in enumerate(lines, 1):
+        if f"<!-- /{mark}" in line:
+            inside = False
+            continue
+        if f"<!-- {mark}" in line:
+            inside = found = True
+            continue
+        if not inside:
+            continue
+        s = line.strip()
+        if not s.startswith("|"):
+            continue
+        cells = [c.strip() for c in s.strip("|").split("|")]
+        if not cells or "`" not in cells[0]:
+            continue  # header or separator row
+        out.append((cells, i))
+    return out if found else None
+
+
+def _first(uses):
+    return min(uses, key=lambda u: (u.path, u.line))
+
+
+class ClientRouteDrift(ProjectRule):
+    rule_id = "AIL016"
+    name = "client-route-drift"
+    description = ("every client call site must resolve to a registered "
+                  "route and every registered route must have a caller "
+                  "(in code, or documented `external` in docs/API.md's "
+                  "ai4e:routes table); the table round-trips with the "
+                  "registrations both directions")
+    family = "wire"
+
+    def check_project(self, ctx):
+        findings: list[Finding] = []
+        surface = surface_of(ctx)
+        routes = surface.matchable_routes()
+        if not routes and not surface.clients:
+            return findings
+
+        by_key: dict[tuple, list[RouteReg]] = {}
+        for r in routes:
+            by_key.setdefault(r.key, []).append(r)
+
+        rows = marked_rows(ctx.root, ROUTES_MARK)
+        doc_keys: dict[tuple, tuple[str, int]] = {}  # key -> (callers, line)
+        if rows is not None:
+            for cells, line in rows:
+                m = _METHOD_RE.search(cells[0]) if cells else None
+                p = _PATH_RE.search(cells[1]) if len(cells) > 1 else None
+                if not m or not p:
+                    continue
+                callers = cells[3] if len(cells) > 3 else ""
+                doc_keys[(m.group(1), parse_shape(p.group(1)))] = (
+                    callers, line)
+        elif routes:
+            r0 = min(routes, key=lambda r: (r.path, r.line))
+            findings.append(Finding(
+                self.rule_id, r0.path, r0.line, 0,
+                f"project registers HTTP routes but {_API_DOC} has no "
+                f"`<!-- {ROUTES_MARK} -->` marked table — generate one "
+                "with `python -m ai4e_tpu.analysis --dump-wire`",
+                snippet="", fingerprint_key=f"{self.rule_id}|no-table"))
+
+        # Direction 1: client call with no matching registration.
+        flagged_client: set[tuple[str, tuple]] = set()
+        for ref in surface.clients:
+            if surface.routes_for(ref):
+                continue
+            ck = (ref.method, ref.shape)
+            if ck in flagged_client:
+                continue
+            flagged_client.add(ck)
+            findings.append(Finding(
+                self.rule_id, ref.path, ref.line, 0,
+                f"client calls {ref.method} {ref.display} but no "
+                "registered route matches — the request can only 404 "
+                "(the PR 8 route-label split began as exactly this "
+                "drift)", symbol=ref.symbol,
+                fingerprint_key=(f"{self.rule_id}|client|"
+                                 f"{ref.method} {ref.display}")))
+
+        # Direction 2: registration with no caller; doc round-trip.
+        for key in sorted(by_key, key=lambda k: (k[0], k[1])):
+            regs = by_key[key]
+            r0 = min(regs, key=lambda r: (r.path, r.line))
+            doc = doc_keys.get(key)
+            if rows is not None and doc is None:
+                findings.append(Finding(
+                    self.rule_id, r0.path, r0.line, 0,
+                    f"route {r0.method} {r0.display} is registered but "
+                    f"absent from {_API_DOC}'s {ROUTES_MARK} table — "
+                    "regenerate it with --dump-wire",
+                    fingerprint_key=(f"{self.rule_id}|undocumented|"
+                                     f"{r0.method} {r0.display}")))
+            # Only an explicit `external` caller note counts as doc
+            # evidence: module names in the Callers cell are derived
+            # from code and must be backed by a live call site.
+            external = doc is not None and "external" in doc[0].lower()
+            if not surface.clients_for(r0) and not external:
+                findings.append(Finding(
+                    self.rule_id, r0.path, r0.line, 0,
+                    f"route {r0.method} {r0.display} has no client call "
+                    "site in the platform and no `external` caller "
+                    f"documented in {_API_DOC}'s {ROUTES_MARK} table — "
+                    "dead surface, or a caller this analyzer cannot see "
+                    "(document it as external)",
+                    fingerprint_key=(f"{self.rule_id}|dead-route|"
+                                     f"{r0.method} {r0.display}")))
+        for key in sorted(doc_keys, key=lambda k: (k[0], k[1])):
+            if key not in by_key:
+                _callers, line = doc_keys[key]
+                method, shape = key
+                findings.append(Finding(
+                    self.rule_id, _API_DOC, line, 0,
+                    f"{_API_DOC} documents route {method} "
+                    f"{shape_display(shape)} but nothing registers it — "
+                    "stale row (regenerate with --dump-wire)",
+                    fingerprint_key=(f"{self.rule_id}|stale-doc|"
+                                     f"{method} {shape_display(shape)}")))
+        return findings
+
+
+class HeaderVocabularyDrift(ProjectRule):
+    rule_id = "AIL017"
+    name = "header-vocabulary-drift"
+    description = ("every emitted X-*/Retry-After header needs a reader "
+                  "and a row in docs/API.md's ai4e:headers table (and "
+                  "vice versa); a literal header outside the vocabulary "
+                  "is typo-minted")
+    family = "wire"
+
+    def check_project(self, ctx):
+        findings: list[Finding] = []
+        surface = surface_of(ctx)
+        emits: dict[str, list] = {}
+        reads: dict[str, list] = {}
+        for use in surface.headers:
+            if use.kind == "emit":
+                emits.setdefault(use.name, []).append(use)
+            elif use.kind == "read":
+                reads.setdefault(use.name, []).append(use)
+        used = set(emits) | set(reads)
+        if not used:
+            return findings
+
+        rows = marked_rows(ctx.root, HEADERS_MARK)
+        if rows is None:
+            u0 = _first([u for n in used for u in emits.get(n, [])
+                         + reads.get(n, [])])
+            findings.append(Finding(
+                self.rule_id, u0.path, u0.line, 0,
+                f"project uses wire headers but {_API_DOC} has no "
+                f"`<!-- {HEADERS_MARK} -->` marked table — generate one "
+                "with `python -m ai4e_tpu.analysis --dump-wire`",
+                fingerprint_key=f"{self.rule_id}|no-table"))
+            return findings
+
+        doc: dict[str, tuple[str, str, int]] = {}  # name -> (emit, read, ln)
+        for cells, line in rows:
+            m = _HEADER_TOKEN_RE.search(cells[0]) if cells else None
+            if not m:
+                continue
+            doc[m.group(1)] = (cells[1] if len(cells) > 1 else "",
+                               cells[2] if len(cells) > 2 else "", line)
+
+        for name in sorted(used):
+            if name not in doc:
+                u0 = _first(emits.get(name, []) + reads.get(name, []))
+                findings.append(Finding(
+                    self.rule_id, u0.path, u0.line, 0,
+                    f"header {name!r} is not in {_API_DOC}'s "
+                    f"{HEADERS_MARK} vocabulary — typo-minted (no peer "
+                    "will ever read a misspelled header) or undocumented",
+                    fingerprint_key=f"{self.rule_id}|vocab|{name}"))
+        for name in sorted(emits):
+            if name in reads:
+                continue
+            read_cell = doc.get(name, ("", "", 0))[1]
+            if "external" in read_cell.lower():
+                continue
+            u0 = _first(emits[name])
+            findings.append(Finding(
+                self.rule_id, u0.path, u0.line, 0,
+                f"header {name!r} is emitted but nothing in the platform "
+                "reads it and no `external` reader is documented in "
+                f"{_API_DOC} — dead bytes on every response, or a "
+                "reader that drifted away",
+                fingerprint_key=f"{self.rule_id}|emit-no-reader|{name}"))
+        for name in sorted(reads):
+            if name in emits:
+                continue
+            emit_cell = doc.get(name, ("", "", 0))[0]
+            if "external" in emit_cell.lower():
+                continue
+            u0 = _first(reads[name])
+            findings.append(Finding(
+                self.rule_id, u0.path, u0.line, 0,
+                f"header {name!r} is read but nothing emits it and no "
+                f"`external` emitter is documented in {_API_DOC} — the "
+                "branch it guards is dead",
+                fingerprint_key=f"{self.rule_id}|read-no-emitter|{name}"))
+        for name in sorted(doc):
+            if name not in used:
+                findings.append(Finding(
+                    self.rule_id, _API_DOC, doc[name][2], 0,
+                    f"{_API_DOC} documents header {name!r} but no code "
+                    "emits or reads it — stale row (regenerate with "
+                    "--dump-wire)",
+                    fingerprint_key=f"{self.rule_id}|stale-doc|{name}"))
+        return findings
+
+
+class UnhandledRefusalStatus(ProjectRule):
+    rule_id = "AIL018"
+    name = "unhandled-refusal-status"
+    description = ("a refusal status a route demonstrably returns (409 "
+                  "drain interlock, 429 shed, 503 backpressure, 504 "
+                  "deadline) that the caller never distinguishes from "
+                  "generic failure — the PR 18 reload-409 class")
+    family = "wire"
+
+    def check_project(self, ctx):
+        findings: list[Finding] = []
+        surface = surface_of(ctx)
+        seen: set[tuple] = set()
+        for ref in surface.clients:
+            if ref.propagates:
+                continue  # raw response handed up — caller distinguishes
+            statuses: set[int] = set()
+            for route in surface.routes_for(ref):
+                statuses |= route.statuses
+            for status in sorted(statuses - set(ref.handled)):
+                key = (ref.method, ref.shape, status, ref.symbol)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    self.rule_id, ref.path, ref.line, 0,
+                    f"{ref.method} {ref.display} can return {status} "
+                    f"({STATUS_LABELS.get(status, 'refusal')}) but "
+                    f"{ref.symbol or 'this call site'} never branches on "
+                    "it — generic-failure handling here wedges the "
+                    "refusal contract (reload-409 class)",
+                    symbol=ref.symbol,
+                    fingerprint_key=(f"{self.rule_id}|{ref.method} "
+                                     f"{ref.display}|{status}|"
+                                     f"{ref.symbol}")))
+        return findings
+
+
+def _route_rows(surface: WireSurface) -> list[tuple[str, str, str, str]]:
+    """(method, display, registered-in, callers) rows, deduped by wire
+    key, for the generated ai4e:routes table."""
+    by_key: dict[tuple, list[RouteReg]] = {}
+    for r in surface.matchable_routes():
+        by_key.setdefault(r.key, []).append(r)
+    rows = []
+    for key in sorted(by_key, key=lambda k: (k[1], k[0])):
+        regs = sorted(by_key[key], key=lambda r: (r.path, r.line))
+        r0 = regs[0]
+        reg_cell = ", ".join(
+            f"`{p}`" for p in dict.fromkeys(r.path for r in regs))
+        callers = sorted({c.path for c in surface.clients_for(r0)})
+        caller_cell = ", ".join(f"`{p}`" for p in callers) if callers else "—"
+        rows.append((f"`{r0.method}`", f"`{r0.display}`", reg_cell,
+                     caller_cell))
+    return rows
+
+
+def _header_rows(surface: WireSurface) -> list[tuple[str, str, str]]:
+    """(header, emitted-by, read-by) rows for the generated
+    ai4e:headers table. Mention-only headers are excluded — a strip
+    list or constant alone creates no wire obligation."""
+    emits: dict[str, set[str]] = {}
+    reads: dict[str, set[str]] = {}
+    for use in surface.headers:
+        if use.kind == "emit":
+            emits.setdefault(use.name, set()).add(use.path)
+        elif use.kind == "read":
+            reads.setdefault(use.name, set()).add(use.path)
+    rows = []
+    for name in sorted(set(emits) | set(reads)):
+        e = ", ".join(f"`{p}`" for p in sorted(emits.get(name, ()))) or "—"
+        r = ", ".join(f"`{p}`" for p in sorted(reads.get(name, ()))) or "—"
+        rows.append((f"`{name}`", e, r))
+    return rows
+
+
+def dump_wire(root: str, ctx) -> str:
+    """Render the two marked tables for docs/API.md (the --dump-wire
+    helper). Humans edit `—` cells to `external — <who>` for callers or
+    peers the analyzer cannot see; those notes are preserved manually on
+    regeneration (the tool prints, it does not rewrite the doc)."""
+    surface = surface_of(ctx)
+    out = [f"<!-- {ROUTES_MARK} -->",
+           "| Method | Path | Registered in | Callers |",
+           "|---|---|---|---|"]
+    out += ["| " + " | ".join(row) + " |" for row in _route_rows(surface)]
+    out += [f"<!-- /{ROUTES_MARK} -->", "",
+            f"<!-- {HEADERS_MARK} -->",
+            "| Header | Emitted by | Read by |",
+            "|---|---|---|"]
+    out += ["| " + " | ".join(row) + " |" for row in _header_rows(surface)]
+    out += [f"<!-- /{HEADERS_MARK} -->"]
+    return "\n".join(out) + "\n"

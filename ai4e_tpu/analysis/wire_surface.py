@@ -1,0 +1,819 @@
+"""Static extractor of the platform's cross-process HTTP wire surface.
+
+The platform stopped being one process around PR 15: the rig runs N
+gateway replicas, per-shard store processes, dispatcher pools, and
+drain-aware workers as separate OS processes talking HTTP — and the
+contracts between them (which routes exist, which headers round-trip,
+which refusal statuses a caller must distinguish) are exactly the things
+no per-process test can see drifting. This module extracts that surface
+once per analyzer run, shared by the three wire rules (AIL016–AIL018 in
+``rules/wire.py``) and the ``--dump-wire`` table generator:
+
+- **server routes** — every ``router.add_get/add_post/add_put/
+  add_delete/add_route`` registration, with the path resolved through
+  module-level string constants (``DRAIN_PATH``), cross-module imports
+  of those constants, and prefix concatenations
+  (``self.service.prefix + "/models/{name}/reload"`` becomes the
+  leading multi-segment wildcard ``{**}``);
+- **client call sites** — literal path references reaching the wire:
+  aiohttp session verbs (``session.post(base + FEED_PATH)``),
+  ``urllib.request.urlopen``/``Request``, the store-client idioms
+  (``self._request("GET", "/v1/taskstore/task")``,
+  ``self._routed(tid, "POST", path)``), and the rig's blocking helpers
+  (``_http_json``/``_fetch_text``). One level of local-variable
+  resolution (``url = base + X; session.post(url)``) is followed;
+- **header uses** — every literal (or constant-resolved) occurrence of
+  an ``X-*`` / ``Retry-After`` header name, classified by syntactic
+  context into *emit* (dict-literal key, ``headers[...] = v``,
+  ``setdefault``/``add``), *read* (``.get/.getone/.pop``, subscript
+  load, ``in`` membership), or *mention* (strip lists, constant
+  definitions);
+- **refusal statuses** — per registered route, the distinguished
+  refusal statuses (409/429/503/504) its resolved handler demonstrably
+  mints (literal ``status=`` on ``Response``/``json_response``, the
+  ``web.HTTPConflict``-family constructors), followed one call hop into
+  same-module helpers; and per client call site, the statuses its
+  enclosing function visibly branches on, plus whether it propagates
+  the raw response to ITS caller.
+
+Path shapes are segment tuples where ``{*}`` matches exactly one
+segment and ``{**}`` matches any run of segments — ``{param}`` route
+placeholders become ``{*}``, ``{tail:.*}`` and unresolvable prefixes
+become ``{**}``. A registration or call whose path has no literal
+segment at all is *dynamic* (config-driven, e.g. the gateway's
+published routes.json surface) and is deliberately excluded from drift
+matching — config wiring is checked by the deployment tests, not by
+this pass.
+
+Stdlib-only, like everything under ``analysis/``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+from .core import ModuleContext, ProjectContext, import_aliases
+
+#: One-segment / multi-segment wildcards in canonical path shapes.
+SEG_ONE = "{*}"
+SEG_MANY = "{**}"
+
+#: Session-verb attribute names that take the URL as the first argument.
+_VERB_ATTRS = {"get": "GET", "post": "POST", "put": "PUT",
+               "delete": "DELETE", "patch": "PATCH", "head": "HEAD"}
+#: Route-registration attribute names.
+_REG_ATTRS = {"add_get": "GET", "add_post": "POST", "add_put": "PUT",
+              "add_delete": "DELETE", "add_patch": "PATCH",
+              "add_head": "HEAD"}
+#: ``aiohttp.web`` refusal constructors and their statuses (only the
+#: distinguished ones AIL018 cares about).
+_HTTP_EXC_STATUS = {"HTTPConflict": 409, "HTTPTooManyRequests": 429,
+                    "HTTPServiceUnavailable": 503,
+                    "HTTPGatewayTimeout": 504}
+#: Statuses a caller must visibly distinguish from generic failure.
+DISTINGUISHED_STATUSES = frozenset({409, 429, 503, 504})
+
+#: Header-name domain of the wire vocabulary: the platform's extension
+#: headers plus the one standard header the refusal contract is built on.
+_HEADER_RE = re.compile(r"^X-[A-Za-z0-9][A-Za-z0-9-]*$")
+_NAMED_HEADERS = frozenset({"Retry-After"})
+
+_GETTERS = {"get", "getone", "getall", "pop"}
+_SETTERS = {"setdefault", "add"}
+
+_DYN = "\x00"  # placeholder for a dynamic fragment inside a joined path
+
+
+def is_wire_header(name: str) -> bool:
+    return bool(_HEADER_RE.match(name)) or name in _NAMED_HEADERS
+
+
+@dataclass(frozen=True)
+class RouteReg:
+    method: str                    # "GET"… or "*" (any)
+    shape: tuple[str, ...]
+    display: str                   # canonical "/v1/…/{*}" form
+    path: str                      # registering module (repo-relative)
+    line: int
+    handler: str = ""              # resolved handler symbol name
+    dynamic: bool = False          # no literal segment — excluded from drift
+    statuses: frozenset[int] = frozenset()
+
+    @property
+    def key(self) -> tuple[str, tuple[str, ...]]:
+        return (self.method, self.shape)
+
+
+@dataclass(frozen=True)
+class ClientRef:
+    method: str                    # "GET"… or "*" (unresolvable)
+    shape: tuple[str, ...]
+    display: str
+    path: str
+    line: int
+    symbol: str = ""               # enclosing function qualname
+    handled: frozenset[int] = frozenset()  # statuses the function branches on
+    propagates: bool = False       # returns the raw response to its caller
+
+
+@dataclass(frozen=True)
+class HeaderUse:
+    name: str
+    kind: str                      # "emit" | "read" | "mention"
+    path: str
+    line: int
+
+
+@dataclass
+class WireSurface:
+    routes: list[RouteReg] = field(default_factory=list)
+    clients: list[ClientRef] = field(default_factory=list)
+    headers: list[HeaderUse] = field(default_factory=list)
+
+    # -- matching ----------------------------------------------------------
+
+    def matchable_routes(self) -> list[RouteReg]:
+        """Routes drift can be checked against: at least one literal
+        segment, and not a catch-all proxy (a shape that accepts every
+        path can neither evidence nor refute a client's)."""
+        return [r for r in self.routes
+                if not r.dynamic and any(
+                    s not in (SEG_ONE, SEG_MANY) for s in r.shape)]
+
+    def routes_for(self, ref: ClientRef) -> list[RouteReg]:
+        return [r for r in self.matchable_routes()
+                if _method_ok(ref.method, r.method)
+                and shapes_match(r.shape, ref.shape)]
+
+    def clients_for(self, route: RouteReg) -> list[ClientRef]:
+        return [c for c in self.clients
+                if _method_ok(c.method, route.method)
+                and shapes_match(route.shape, c.shape)]
+
+
+def _method_ok(client_method: str, route_method: str) -> bool:
+    return (client_method == "*" or route_method == "*"
+            or client_method == route_method)
+
+
+def shapes_match(server: tuple[str, ...], client: tuple[str, ...]) -> bool:
+    """Segment-wise match where either side's ``{*}`` matches one segment
+    and ``{**}`` matches any run (possibly empty) of segments."""
+
+    def rec(a: tuple[str, ...], b: tuple[str, ...]) -> bool:
+        if not a:
+            return not b or all(s == SEG_MANY for s in b)
+        if not b:
+            return all(s == SEG_MANY for s in a)
+        x, y = a[0], b[0]
+        if x == SEG_MANY:
+            return rec(a[1:], b) or rec(a, b[1:])
+        if y == SEG_MANY:
+            return rec(a, b[1:]) or rec(a[1:], b)
+        if x == SEG_ONE or y == SEG_ONE or x == y:
+            return rec(a[1:], b[1:])
+        return False
+
+    return rec(server, client)
+
+
+def parse_shape(display: str) -> tuple[str, ...]:
+    """Canonical-display (or doc-table) path → shape tuple. ``{tail:.*}``
+    and ``{**}``/``{prefix}`` are multi-wildcards; any other ``{…}``
+    placeholder is one segment."""
+    display = display.split("?", 1)[0]
+    segs: list[str] = []
+    for raw in display.strip("/").split("/"):
+        if not raw:
+            continue
+        if raw in (SEG_MANY, "{prefix}") or (
+                raw.startswith("{") and ":" in raw and raw.endswith("}")):
+            segs.append(SEG_MANY)
+        elif "{" in raw or "<" in raw:
+            segs.append(SEG_ONE)
+        else:
+            segs.append(raw)
+    return tuple(segs)
+
+
+def shape_display(shape: tuple[str, ...]) -> str:
+    return "/" + "/".join(shape) if shape else "/"
+
+
+# -- expression → path parts -------------------------------------------------
+
+
+class _ConstMap:
+    """Project-wide module-level string constants, for resolving
+    ``DRAIN_PATH``-style names at registration/call/header sites. Keyed
+    by bare name; a name bound to DIFFERENT values in different modules
+    is ambiguous and resolves to nothing (conservative)."""
+
+    _AMBIGUOUS = object()
+
+    def __init__(self, modules: list[ModuleContext]):
+        self._by_name: dict[str, object] = {}
+        for m in modules:
+            for node in m.tree.body:
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    continue
+                if not (isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    continue
+                name, value = node.targets[0].id, node.value.value
+                prior = self._by_name.get(name)
+                if prior is None:
+                    self._by_name[name] = value
+                elif prior != value:
+                    self._by_name[name] = self._AMBIGUOUS
+
+    def lookup(self, name: str) -> str | None:
+        value = self._by_name.get(name)
+        return value if isinstance(value, str) else None
+
+
+def _name_of(expr: ast.AST) -> str | None:
+    """Bare name of a Name or the attr of an Attribute (``FEED_PATH`` and
+    ``wire.FEED_PATH`` both resolve through the constant map)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _path_parts(expr: ast.AST, consts: _ConstMap,
+                local: dict[str, ast.AST] | None = None,
+                depth: int = 0) -> list[str]:
+    """Flatten a URL expression into literal fragments and ``_DYN``
+    markers, resolving constants and (one level of) local assignments."""
+    if depth > 6:
+        return [_DYN]
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        return (_path_parts(expr.left, consts, local, depth + 1)
+                + _path_parts(expr.right, consts, local, depth + 1))
+    if isinstance(expr, ast.JoinedStr):
+        out: list[str] = []
+        for v in expr.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.append(v.value)
+            elif isinstance(v, ast.FormattedValue):
+                # f"http://{host}:{port}{DRAIN_PATH}" — a braced constant
+                # name still resolves; everything else is dynamic.
+                out.extend(_path_parts(v.value, consts, local, depth + 1))
+            else:
+                out.append(_DYN)
+        return out
+    name = _name_of(expr)
+    if name is not None:
+        if local and name in local and isinstance(expr, ast.Name):
+            target = local[name]
+            if target is not expr:
+                return _path_parts(target, consts, None, depth + 1)
+        value = consts.lookup(name)
+        if value is not None:
+            return [value]
+    if (isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr in ("rstrip", "strip", "format")):
+        # ``base.rstrip("/") + path`` — the receiver carries the text.
+        return _path_parts(expr.func.value, consts, local, depth + 1)
+    return [_DYN]
+
+
+def shape_from_parts(parts: list[str]) -> tuple[str, ...] | None:
+    """Join fragments, locate the path, normalize to a shape. Returns
+    None when no literal path fragment is present (fully dynamic)."""
+    joined = "".join(parts)
+    if "/" not in joined.replace("://", ""):
+        return None
+    # Drop a scheme+host prefix: the path starts at the first "/" after
+    # the authority (or at a leading "/" when there is no scheme).
+    if "://" in joined:
+        after = joined.split("://", 1)[1]
+        idx = after.find("/")
+        if idx < 0:
+            return None
+        joined = after[idx:]
+    else:
+        idx = joined.find("/")
+        # A dynamic prefix before the first literal "/" is a base URL.
+        joined = joined[idx:]
+    joined = joined.split("?", 1)[0]
+    segs: list[str] = []
+    for raw in joined.strip("/").split("/"):
+        if not raw:
+            continue
+        if raw == _DYN * len(raw) and raw:
+            segs.append(SEG_ONE)
+        elif raw.startswith("{") and ":" in raw and raw.endswith("}"):
+            segs.append(SEG_MANY)
+        elif "{" in raw or _DYN in raw:
+            segs.append(SEG_ONE)
+        else:
+            segs.append(raw)
+    if not segs or all(s in (SEG_ONE, SEG_MANY) for s in segs):
+        return None
+    # A leading dynamic fragment glued to the path ("{base}/v1/x" keeps
+    # its "/" — already handled), but a *prefix expression* like
+    # ``self.prefix + "/models"`` arrives as [DYN, "/models"]: the DYN
+    # consumed above was before the first "/", so nothing to do here.
+    return tuple(segs)
+
+
+def _leading_dynamic(parts: list[str]) -> bool:
+    """True when the joined expression starts with a dynamic fragment
+    that is NOT a full base URL — i.e. a route prefix (``self.prefix +
+    "/models"``), which must match as a leading multi-wildcard."""
+    for p in parts:
+        if p == _DYN:
+            return True
+        if p.strip():
+            return False
+    return False
+
+
+# -- module walking ----------------------------------------------------------
+
+
+class _ParentVisitor(ast.NodeVisitor):
+    """One walk that records parents + enclosing function per node."""
+
+    def __init__(self):
+        self.parents: dict[ast.AST, ast.AST] = {}
+        self.funcs: dict[ast.AST, ast.AST | None] = {}
+        self._fn_stack: list[ast.AST] = []
+        self._name_stack: list[str] = []
+
+    def generic_visit(self, node):
+        is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        is_scope = is_fn or isinstance(node, ast.ClassDef)
+        if is_fn:
+            self._fn_stack.append(node)
+        if is_scope:
+            self._name_stack.append(node.name)
+        for child in ast.iter_child_nodes(node):
+            self.parents[child] = node
+            self.funcs[child] = self._fn_stack[-1] if self._fn_stack else None
+            self.generic_visit(child)
+        if is_fn:
+            self._fn_stack.pop()
+        if is_scope:
+            self._name_stack.pop()
+
+
+def _qualname(visitor: _ParentVisitor, node: ast.AST) -> str:
+    names = []
+    cur = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            names.append(cur.name)
+        cur = visitor.parents.get(cur)
+    return ".".join(reversed(names))
+
+
+def _local_assigns(fn: ast.AST | None) -> dict[str, ast.AST]:
+    """name → assigned value for simple single-target assignments inside
+    ``fn`` — names assigned more than once resolve to nothing."""
+    if fn is None:
+        return {}
+    seen: dict[str, ast.AST | None] = {}
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            name = node.targets[0].id
+            seen[name] = None if name in seen else node.value
+    return {k: v for k, v in seen.items() if v is not None}
+
+
+# -- handler status extraction -----------------------------------------------
+
+
+def _module_functions(tree: ast.Module) -> dict[str, ast.AST]:
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def _statuses_in(fn: ast.AST) -> set[int]:
+    """Distinguished refusal statuses a function body visibly mints."""
+    out: set[int] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _name_of(node.func) or ""
+        if fname in ("Response", "json_response", "StreamResponse"):
+            for kw in node.keywords:
+                if (kw.arg == "status" and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, int)):
+                    out.add(kw.value.value)
+        elif fname in _HTTP_EXC_STATUS:
+            out.add(_HTTP_EXC_STATUS[fname])
+    return out & set(DISTINGUISHED_STATUSES)
+
+
+def _handler_statuses(handler_expr: ast.AST, tree: ast.Module) -> tuple[str, frozenset[int]]:
+    """Resolve a registration's handler expression to a same-module
+    function and collect its distinguished statuses, following ONE call
+    hop into same-module helpers (``self._refuse(...)``); tuple-returning
+    admission helpers and cross-module shells are beyond static reach and
+    contribute nothing (under-approximation by design)."""
+    expr = handler_expr
+    # Unwrap single-argument wrappers: ``stamped(upsert)``.
+    if isinstance(expr, ast.Call) and expr.args:
+        inner = expr.args[0]
+        if _name_of(inner) is not None:
+            expr = inner
+    name = _name_of(expr)
+    if name is None:
+        return "", frozenset()
+    fns = _module_functions(tree)
+    fn = fns.get(name)
+    if fn is None:
+        return name, frozenset()
+    statuses = _statuses_in(fn)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = _name_of(node.func)
+            if callee and callee != name and callee in fns:
+                statuses |= _statuses_in(fns[callee])
+    return name, frozenset(statuses)
+
+
+# -- client-side status handling ---------------------------------------------
+
+
+def _ints_in_compares(fn: ast.AST) -> frozenset[int]:
+    """Every int literal participating in a comparison (or membership
+    tuple/set/list) inside ``fn`` — the statuses the function's branch
+    structure can distinguish."""
+    out: set[int] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        for side in [node.left, *node.comparators]:
+            if isinstance(side, ast.Constant) and isinstance(side.value, int):
+                out.add(side.value)
+            elif isinstance(side, (ast.Tuple, ast.Set, ast.List)):
+                for el in side.elts:
+                    if (isinstance(el, ast.Constant)
+                            and isinstance(el.value, int)):
+                        out.add(el.value)
+    return frozenset(out)
+
+
+def _response_names(visitor: _ParentVisitor, call: ast.Call) -> set[str]:
+    """Names the call's response lands in: ``resp = await …`` /
+    ``resp, body = await …`` / ``async with … as resp``."""
+    names: set[str] = set()
+    cur: ast.AST = call
+    parent = visitor.parents.get(cur)
+    while isinstance(parent, (ast.Await, ast.withitem)) or (
+            isinstance(parent, (ast.With, ast.AsyncWith))):
+        if isinstance(parent, ast.withitem):
+            if isinstance(parent.optional_vars, ast.Name):
+                names.add(parent.optional_vars.id)
+        cur, parent = parent, visitor.parents.get(parent)
+    if isinstance(parent, ast.Assign):
+        for target in parent.targets:
+            if isinstance(target, ast.Name):
+                names.add(target.id)
+            elif isinstance(target, ast.Tuple) and target.elts:
+                # ``resp, body = await …`` — only the FIRST element is
+                # the response; returning the parsed body does not hand
+                # the status to the caller.
+                first = target.elts[0]
+                if isinstance(first, ast.Name):
+                    names.add(first.id)
+    return names
+
+
+def _handled_with_helpers(fn: ast.AST, resp_names: set[str],
+                          fns: dict[str, ast.AST],
+                          base: frozenset[int]) -> frozenset[int]:
+    """``base`` (the enclosing function's own compares) plus ONE call hop
+    into same-module helpers the response is passed to —
+    ``_raise_refusal(resp)`` — symmetric with the server-side hop in
+    ``_handler_statuses``. The hop needs the response NAME as an
+    argument: ``resp.raise_for_status()`` is an attribute call on the
+    response and distinguishes nothing."""
+    if not resp_names:
+        return base
+    out = set(base)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _name_of(node.func)
+        if (callee and callee in fns and fns[callee] is not fn
+                and any(isinstance(a, ast.Name) and a.id in resp_names
+                        for a in node.args)):
+            out |= _ints_in_compares(fns[callee])
+    return frozenset(out)
+
+
+def _propagates(fn: ast.AST, resp_names: set[str]) -> bool:
+    """The function hands the raw response (or its status) back to its
+    caller — callers do the distinguishing (``_request`` helpers)."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Return, ast.Yield)) and node.value is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name) and sub.id in resp_names:
+                    return True
+                if isinstance(sub, ast.Attribute) and sub.attr == "status":
+                    return True
+        if isinstance(node, ast.Raise) and node.exc is not None:
+            # ``raise StatusError(resp.status, …)`` — a *typed* carrier
+            # the caller can branch on still counts as propagation only
+            # when the response itself rides the exception; generic
+            # message-formatting does not.
+            continue
+    return False
+
+
+# -- the extractor -----------------------------------------------------------
+
+
+def extract_wire_surface(ctx: ProjectContext,
+                         extra_client_modules: list[ModuleContext] | None = None
+                         ) -> WireSurface:
+    """Build the project's wire surface. ``extra_client_modules`` lets
+    the caller bring out-of-tree callers (``clients/python/``) in as
+    client/header evidence without making them a registration surface."""
+    surface = WireSurface()
+    all_modules = list(ctx.modules) + list(extra_client_modules or [])
+    consts = _ConstMap(all_modules)
+    for module in ctx.modules:
+        _extract_module(module, consts, surface, server=True)
+    for module in extra_client_modules or []:
+        _extract_module(module, consts, surface, server=False)
+    return surface
+
+
+def _extract_module(module: ModuleContext, consts: _ConstMap,
+                    surface: WireSurface, server: bool) -> None:
+    visitor = _ParentVisitor()
+    visitor.parents[module.tree] = None  # type: ignore[assignment]
+    visitor.generic_visit(module.tree)
+    fn_handled: dict[ast.AST, frozenset[int]] = {}
+    local_cache: dict[ast.AST, dict[str, ast.AST]] = {}
+    module_fns = _module_functions(module.tree)
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            if server:
+                _maybe_route(module, consts, surface, visitor, node)
+            _maybe_client(module, consts, surface, visitor, node,
+                          fn_handled, local_cache, module_fns)
+    _extract_headers(module, consts, surface, visitor)
+
+
+def _canonical_display(shape: tuple[str, ...]) -> str:
+    return shape_display(shape)
+
+
+def _maybe_route(module: ModuleContext, consts: _ConstMap,
+                 surface: WireSurface, visitor: _ParentVisitor,
+                 node: ast.Call) -> None:
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return
+    method = _REG_ATTRS.get(func.attr)
+    path_arg: ast.AST | None = None
+    handler_arg: ast.AST | None = None
+    if method is not None and node.args:
+        path_arg = node.args[0]
+        handler_arg = node.args[1] if len(node.args) > 1 else None
+    elif func.attr == "add_route" and len(node.args) >= 2:
+        m = node.args[0]
+        method = (m.value.upper()
+                  if isinstance(m, ast.Constant) and isinstance(m.value, str)
+                  else "*")
+        path_arg = node.args[1]
+        handler_arg = node.args[2] if len(node.args) > 2 else None
+    if method is None or path_arg is None:
+        return
+    # Only router registrations: the receiver chain must end in
+    # ``.router`` or be ``app``-named (``self.app.router.add_get``,
+    # ``app.router.add_post``) — keeps dict helpers named add_route
+    # (e.g. the push webhook's topic map) off the surface.
+    recv = func.value
+    recv_name = _name_of(recv) or ""
+    if recv_name != "router" and "router" not in recv_name:
+        return
+    fn = visitor.funcs.get(node)
+    local = _local_assigns(fn)
+    parts = _path_parts(path_arg, consts, local)
+    leading_dyn = _leading_dynamic(parts)
+    shape = shape_from_parts(parts)
+    if shape is None:
+        dynamic = True
+        shape = (SEG_MANY,)
+    else:
+        dynamic = False
+        if leading_dyn:
+            shape = (SEG_MANY, *shape)
+    handler = ""
+    statuses: frozenset[int] = frozenset()
+    if handler_arg is not None:
+        handler, statuses = _handler_statuses(handler_arg, module.tree)
+    surface.routes.append(RouteReg(
+        method=method, shape=shape, display=_canonical_display(shape),
+        path=module.path, line=node.lineno, handler=handler,
+        dynamic=dynamic, statuses=statuses))
+
+
+#: Bare or attribute calls that take the target URL as the first
+#: argument: the stdlib entrypoints plus this codebase's blocking-helper
+#:  idioms (rig drivers, rollout controller, observability pollers).
+_URL_FIRST_FUNCS = frozenset({
+    "urlopen", "_http_json", "_fetch_json", "_fetch_text",
+    "fetch_json", "fetch_text", "http_json",
+})
+
+
+def _client_call_parts(node: ast.Call) -> tuple[str, ast.AST] | None:
+    """(method, url_expr) when ``node`` is a recognized client call."""
+    func = node.func
+    fname = _name_of(func)
+    if fname is None:
+        return None
+    if fname in _VERB_ATTRS:
+        # ``session.get(url)`` — and the bare-name local wrappers the rig
+        # drivers define (``get(base + "/v1/rig/ledgers")``). Bare names
+        # are safe because every client ref is additionally gated on the
+        # argument resolving to a literal path shape.
+        if node.args:
+            return _VERB_ATTRS[fname], node.args[0]
+        return None
+    if fname == "request" and isinstance(func, ast.Attribute):
+        if len(node.args) >= 2:
+            m = node.args[0]
+            method = (m.value.upper() if isinstance(m, ast.Constant)
+                      and isinstance(m.value, str) else "*")
+            return method, node.args[1]
+        return None
+    if fname in _URL_FIRST_FUNCS:
+        if node.args:
+            method = "*"
+            return method, node.args[0]
+        return None
+    if fname == "to_thread" and len(node.args) >= 2:
+        # ``asyncio.to_thread(_http_json, url + PATH, body)`` — the url
+        # is the wrapped callable's first argument. Accepted for any
+        # callable: the shape gate keeps non-URL second arguments out.
+        return "*", node.args[1]
+    if fname == "Request":
+        if node.args:
+            method = "*"
+            for kw in node.keywords:
+                if (kw.arg == "method" and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)):
+                    method = kw.value.value.upper()
+            return method, node.args[0]
+        return None
+    if fname in ("_request", "_routed") and isinstance(func, ast.Attribute):
+        offset = 0 if fname == "_request" else 1
+        if len(node.args) >= offset + 2:
+            m = node.args[offset]
+            if isinstance(m, ast.Constant) and isinstance(m.value, str):
+                return m.value.upper(), node.args[offset + 1]
+        return None
+    return None
+
+
+def _maybe_client(module: ModuleContext, consts: _ConstMap,
+                  surface: WireSurface, visitor: _ParentVisitor,
+                  node: ast.Call,
+                  fn_handled: dict[ast.AST, frozenset[int]],
+                  local_cache: dict[ast.AST, dict[str, ast.AST]],
+                  module_fns: dict[str, ast.AST]) -> None:
+    got = _client_call_parts(node)
+    if got is None:
+        return
+    method, url_expr = got
+    fn = visitor.funcs.get(node)
+    if fn is not None and fn not in local_cache:
+        # Merge locals along the enclosing-function chain, outermost
+        # first: a closure posting to ``url`` built one frame up (the
+        # chaos driver's nested ``post()``) still resolves.
+        chain: list[ast.AST] = []
+        cur: ast.AST | None = fn
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                chain.append(cur)
+            cur = visitor.parents.get(cur)
+        merged: dict[str, ast.AST] = {}
+        for outer in reversed(chain):
+            merged.update(_local_assigns(outer))
+        local_cache[fn] = merged
+    parts = _path_parts(url_expr, consts,
+                        local_cache.get(fn) if fn is not None else None)
+    shape = shape_from_parts(parts)
+    if shape is None:
+        return  # fully dynamic — config-driven, not this pass's business
+    handled: frozenset[int] = frozenset()
+    propagates = False
+    if fn is not None:
+        if fn not in fn_handled:
+            fn_handled[fn] = _ints_in_compares(fn)
+        resp_names = _response_names(visitor, node)
+        handled = _handled_with_helpers(fn, resp_names, module_fns,
+                                        fn_handled[fn])
+        propagates = _propagates(fn, resp_names)
+    surface.clients.append(ClientRef(
+        method=method, shape=shape, display=_canonical_display(shape),
+        path=module.path, line=node.lineno,
+        symbol=_qualname(visitor, node), handled=handled,
+        propagates=propagates))
+
+
+# -- headers -----------------------------------------------------------------
+
+
+def _header_value(node: ast.AST, consts: _ConstMap) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if is_wire_header(node.value) else None
+    name = _name_of(node)
+    if name is not None and (name.endswith("_HEADER")
+                             or name.endswith("_HDR")):
+        value = consts.lookup(name)
+        if value is not None and is_wire_header(value):
+            return value
+    return None
+
+
+def _classify_header(visitor: _ParentVisitor, node: ast.AST) -> str:
+    parent = visitor.parents.get(node)
+    if isinstance(parent, ast.Dict) and node in parent.keys:
+        return "emit"
+    if isinstance(parent, ast.Subscript) and parent.slice is node:
+        gp = visitor.parents.get(parent)
+        if isinstance(gp, (ast.Assign, ast.AugAssign)) and (
+                parent in getattr(gp, "targets", ()) or
+                getattr(gp, "target", None) is parent):
+            return "emit"
+        if isinstance(gp, ast.Delete):
+            return "emit"
+        return "read"
+    if isinstance(parent, ast.Call) and parent.args \
+            and parent.args[0] is node \
+            and isinstance(parent.func, ast.Attribute):
+        if parent.func.attr in _GETTERS:
+            return "read"
+        if parent.func.attr in _SETTERS:
+            return "emit"
+    if isinstance(parent, ast.Compare):
+        ops = parent.ops
+        if any(isinstance(op, (ast.In, ast.NotIn)) for op in ops):
+            return "read"
+    return "mention"
+
+
+def _extract_headers(module: ModuleContext, consts: _ConstMap,
+                     surface: WireSurface,
+                     visitor: _ParentVisitor) -> None:
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            continue
+        if isinstance(node, (ast.Constant, ast.Name, ast.Attribute)):
+            value = _header_value(node, consts)
+            if value is None:
+                continue
+            # The defining assignment itself is a mention, not an emit.
+            surface.headers.append(HeaderUse(
+                name=value, kind=_classify_header(visitor, node),
+                path=module.path, line=getattr(node, "lineno", 1)))
+
+
+# -- out-of-tree client evidence ---------------------------------------------
+
+
+def load_extra_clients(root: str, parse) -> list[ModuleContext]:
+    """Parse ``clients/python/*.py`` (the stdlib caller library) as extra
+    client evidence. ``parse`` is ``core.parse_module`` (injected to ride
+    the shared parse cache). Missing directory → no extra modules."""
+    out: list[ModuleContext] = []
+    base = os.path.join(root, "clients", "python")
+    try:
+        names = sorted(os.listdir(base))
+    except OSError:
+        return out
+    for fname in names:
+        if not fname.endswith(".py"):
+            continue
+        abspath = os.path.join(base, fname)
+        rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+        mod = parse(abspath, rel)
+        if mod is not None:
+            out.append(mod)
+    return out

@@ -885,6 +885,7 @@ def main(argv=None) -> None:
     if args.component == "redrive":
         # Pure HTTP client — no jax, no platform assembly.
         import json as _json
+        import sys
         import urllib.error
         import urllib.request
 
@@ -903,7 +904,19 @@ def main(argv=None) -> None:
             with urllib.request.urlopen(req, timeout=30) as resp:
                 print(resp.read().decode())
         except urllib.error.HTTPError as exc:
-            print(exc.read().decode())
+            detail = exc.read().decode()
+            if exc.code == 409:
+                # The store evaluated the redrive and refused it: the
+                # task is not in a redrivable (dead-lettered) status.
+                print("redrive refused (409): task is not in a "
+                      "redrivable status", file=sys.stderr)
+            elif exc.code == 503:
+                after = exc.headers.get("Retry-After") if exc.headers else None
+                print("store refused the redrive (503"
+                      + (f", retry after {after}s" if after else "")
+                      + ") — standby or degraded; retry against the "
+                      "primary", file=sys.stderr)
+            print(detail)
             raise SystemExit(1)
         except OSError as exc:  # URLError/TimeoutError are OSErrors
             raise SystemExit(f"cannot reach {args.store}: {exc}")

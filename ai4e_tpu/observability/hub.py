@@ -127,7 +127,7 @@ class RequestObservability:
             return
         try:
             result = self.store.append_ledger(task_id, list(events))
-        except Exception:  # noqa: BLE001; ai4e: noqa[AIL005] — observability is fail-open: an evicted/failing-over task drops its stamp, serving is untouched
+        except Exception:  # noqa: BLE001 — observability is fail-open: an evicted/failing-over task drops its stamp, serving is untouched
             log.debug("ledger stamp dropped for task %s", task_id,
                       exc_info=True)
             return

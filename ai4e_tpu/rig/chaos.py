@@ -26,6 +26,7 @@ import json
 import logging
 import random
 import time
+import urllib.error
 import urllib.request
 
 from .supervisor import Supervisor
@@ -112,14 +113,31 @@ async def _fire(topo: Topology, sup: Supervisor, event: dict) -> None:
             req = urllib.request.Request(
                 url, data=body, method="POST",
                 headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=60) as resp:
-                return json.loads(resp.read())
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as exc:
+                # A refused move is a chaos OUTCOME, not a driver crash:
+                # 409 = the slot is already mid-handoff (fence held by a
+                # previous move), 503 = the source node is not primary /
+                # draining. Either way the schedule continues.
+                exc.read()
+                if exc.code in (409, 503):
+                    return {"refused": exc.code}
+                raise
 
         result = await asyncio.to_thread(post)
-        event["moved"] = result.get("moved")
-        log.warning("chaos: moved slot %d shard %d -> %d (%s tasks)",
-                    event["slot"], event["src"], event["dest"],
-                    result.get("moved"))
+        if "refused" in result:
+            event["refused"] = result["refused"]
+            log.warning("chaos: move_slot %d shard %d -> %d refused "
+                        "(HTTP %s); schedule continues",
+                        event["slot"], event["src"], event["dest"],
+                        result["refused"])
+        else:
+            event["moved"] = result.get("moved")
+            log.warning("chaos: moved slot %d shard %d -> %d (%s tasks)",
+                        event["slot"], event["src"], event["dest"],
+                        result.get("moved"))
     elif verb == "kill_shard_primary":
         pid = sup.kill(f"store{event['shard']}")
         log.warning("chaos: SIGKILLed shard %d primary (pid %d); replica "

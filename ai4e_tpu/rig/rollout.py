@@ -256,7 +256,7 @@ class RigFleet:
                 await self.ring.append_ledger(
                     self.marker_task_id,
                     [ledger_event(event, "rollout", reason=reason)])
-            except Exception:  # noqa: BLE001; ai4e: noqa[AIL005] — ledger evidence is fail-open telemetry, the rollout.json record above is authoritative
+            except Exception:  # noqa: BLE001 — ledger evidence is fail-open telemetry, the rollout.json record above is authoritative
                 log.debug("rollout ledger stamp dropped", exc_info=True)
 
 

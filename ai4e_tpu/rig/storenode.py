@@ -527,7 +527,7 @@ class StoreNode:
                             timeout=aiohttp.ClientTimeout(total=5)):
                         pass
                 except (aiohttp.ClientError, asyncio.TimeoutError,
-                        OSError) as exc:  # ai4e: noqa[AIL005] — best-effort propagation; a dead sibling missed the records too and the residual is documented
+                        OSError) as exc:  # best-effort propagation; a dead sibling missed the records too and the residual is documented
                     log.debug("fence propagation to %s failed: %s",
                               base, exc)
 
@@ -674,7 +674,7 @@ class StoreNode:
                         if (await resp.json()).get("role") == "primary":
                             return base
                 except (aiohttp.ClientError, asyncio.TimeoutError,
-                        OSError):  # ai4e: noqa[AIL005] — a dead elder is exactly the case the probe exists to rule out; fall through to the next candidate
+                        OSError):  # a dead elder is exactly the case the probe exists to rule out; fall through to the next candidate
                     continue
         return None
 

@@ -57,6 +57,23 @@ BROKER_POP_PATH = "/v1/rig/broker/pop"
 BROKER_DONE_PATH = "/v1/rig/broker/done"
 
 
+def _raise_refusal(resp) -> None:
+    """Typed refusals the routed transport can still hand back: a plain
+    503 is the owning store refusing load (journal-degraded / draining —
+    the X-Not-Primary flavor was already rotated inside ``_request``),
+    and a 409 carrying X-Not-Owner is a slot fence the ``_routed``
+    attempt budget could not resolve. Both map to the standby contract
+    (``NotPrimaryError`` → gateway 503 + Retry-After), never a raw 500;
+    a bare 409 (conditional-update precondition) passes through."""
+    if resp.status == 503:
+        after = resp.headers.get("Retry-After")
+        raise NotPrimaryError(
+            "shard store refused the request"
+            + (f" (retry after {after}s)" if after else ""))
+    if resp.status == 409 and resp.headers.get("X-Not-Owner"):
+        raise NotPrimaryError("slot fence unresolved for routed request")
+
+
 class RingStoreClient(TaskManagerBase):
     """Ring-routed task-store client over N shard store processes."""
 
@@ -181,8 +198,7 @@ class RingStoreClient(TaskManagerBase):
             # out: surface the standby contract, not a raw 500 — the
             # gateway answers 503 + Retry-After and the client re-POSTs.
             raise NotPrimaryError(str(exc)) from exc
-        if resp.status == 503:
-            raise NotPrimaryError("shard store refused the write")
+        _raise_refusal(resp)
         if resp.status != 200:
             raise RuntimeError(
                 f"upsert failed: HTTP {resp.status} "
@@ -209,6 +225,7 @@ class RingStoreClient(TaskManagerBase):
             task_id, "POST", "/v1/taskstore/result", params=params,
             check_miss=True,
             data=result, headers={"Content-Type": content_type})
+        _raise_refusal(resp)
         if resp.status == 404:
             raise TaskNotFound(task_id)
         if resp.status != 200:
@@ -263,6 +280,7 @@ class RingStoreClient(TaskManagerBase):
             resp, body = await self._routed(
                 task_id, "POST", "/v1/taskstore/ledger", check_miss=True,
                 data=json.dumps({"TaskId": task_id, "Events": events}))
+            _raise_refusal(resp)
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
                 NotPrimaryError):
             return 0
@@ -300,6 +318,7 @@ class RingStoreClient(TaskManagerBase):
         resp, body = await self._routed(
             task_id, "POST", "/v1/taskstore/update",
             check_miss=True, data=json.dumps(payload))
+        _raise_refusal(resp)
         if resp.status == 204:
             raise KeyError(f"task not found: {task_id}")
         if resp.status != 200:
@@ -317,6 +336,7 @@ class RingStoreClient(TaskManagerBase):
         resp, body = await self._routed(
             task_id, "POST", "/v1/taskstore/update",
             check_miss=True, data=json.dumps(payload))
+        _raise_refusal(resp)  # fence-409 is NOT the precondition branch
         if resp.status in (409, 204):
             return None
         if resp.status != 200:

@@ -350,7 +350,7 @@ class DecodeEngine:
                 return
             try:
                 await self._tick()
-            except Exception:  # noqa: BLE001; ai4e: noqa[AIL005] — a backend crash fails the affected sequences below, never the loop
+            except Exception:  # noqa: BLE001 — a backend crash fails the affected sequences below, never the loop
                 log.exception("decode tick failed; failing active sequences")
                 for seq in list(self._active.values()):
                     self._retire(seq, "failed",
@@ -524,7 +524,7 @@ class DecodeEngine:
         if seq.on_token is not None:
             try:
                 seq.on_token(len(seq.tokens) - 1, token)
-            except Exception:  # noqa: BLE001; ai4e: noqa[AIL005] — chunk fan-out is fail-open telemetry, never a decode error
+            except Exception:  # noqa: BLE001 — chunk fan-out is fail-open telemetry, never a decode error
                 log.debug("on_token callback failed", exc_info=True)
         eos = getattr(self.backend, "eos_id", None)
         if (len(seq.tokens) >= seq.max_new_tokens

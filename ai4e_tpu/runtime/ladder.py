@@ -445,7 +445,7 @@ class LadderManager:
         try:
             outcome = self.derive_now(name)
             log.debug("ladder derive %s: %s", name, outcome)
-        except Exception:  # noqa: BLE001; ai4e: noqa[AIL005] — counted outcome=failed below; a deriver crash must never reach serving
+        except Exception:  # noqa: BLE001 — counted outcome=failed below; a deriver crash must never reach serving
             self._derives_total.inc(model=name, outcome="failed")
             log.exception("ladder derivation failed for %s "
                           "(old ladder keeps serving)", name)

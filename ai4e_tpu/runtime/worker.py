@@ -682,7 +682,7 @@ class InferenceWorker:
             return
         try:
             await tm.append_ledger(task_id, events)
-        except Exception:  # noqa: BLE001; ai4e: noqa[AIL005] — observability is fail-open: a dropped flush loses a timeline, not a task
+        except Exception:  # noqa: BLE001 — observability is fail-open: a dropped flush loses a timeline, not a task
             log.debug("hop-ledger flush dropped for task %s", task_id,
                       exc_info=True)
 

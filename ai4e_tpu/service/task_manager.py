@@ -22,8 +22,40 @@ from urllib.parse import urlparse
 
 import aiohttp
 
-from ..taskstore import APITask, InMemoryTaskStore, TaskNotFound, TaskStatus
+from ..taskstore import (APITask, InMemoryTaskStore, NotPrimaryError,
+                         TaskNotFound, TaskStatus)
 from ..utils.http import SessionHolder
+
+
+class StoreRefusalError(NotPrimaryError):
+    """A typed store refusal a caller must not mistake for generic
+    failure: carries the refusing status and the store's Retry-After.
+    ``NotPrimaryError`` subclass so the service shell's standby mapping
+    (gateway answers 503 + Retry-After, client retries) applies — a
+    refused write is a backpressure signal, not a 500."""
+
+    def __init__(self, message: str, *, status: int,
+                 retry_after: str | None = None):
+        super().__init__(message)
+        self.status = status
+        self.retry_after = retry_after
+
+
+def _raise_refusal(resp) -> None:
+    """Distinguish the store's typed refusals BEFORE any generic
+    ``raise_for_status``: a plain 503 is the store refusing load
+    (journal-degraded / draining / overloaded — ``_request`` already
+    rotated the X-Not-Primary flavor), and a 409 carrying X-Not-Owner is
+    the hash-ring fence (this writer raced a rebalance handoff). A bare
+    409 (conditional-update precondition) passes through — that one IS
+    the caller's branch to take."""
+    if resp.status == 503:
+        reason = resp.headers.get("X-Shed-Reason") or "store unavailable"
+        raise StoreRefusalError(f"store refused: {reason}", status=503,
+                                retry_after=resp.headers.get("Retry-After"))
+    if resp.status == 409 and resp.headers.get("X-Not-Owner"):
+        raise StoreRefusalError(
+            "store is no longer the shard owner for this task", status=409)
 
 
 class TaskManagerBase:
@@ -275,6 +307,7 @@ class HttpTaskManager(_HttpStoreClient, TaskManagerBase):
         payload["PublishToGrid"] = task.publish
         resp, body = await self._request("POST", "/v1/taskstore/upsert",
                                          data=json.dumps(payload))
+        _raise_refusal(resp)
         resp.raise_for_status()
         return json.loads(body)
 
@@ -289,6 +322,7 @@ class HttpTaskManager(_HttpStoreClient, TaskManagerBase):
         }
         resp, body = await self._request("POST", "/v1/taskstore/update",
                                          data=json.dumps(payload))
+        _raise_refusal(resp)
         resp.raise_for_status()
         if resp.status != 200:  # 204 = task unknown to the store
             raise KeyError(f"task not found: {task_id}")
@@ -312,6 +346,7 @@ class HttpTaskManager(_HttpStoreClient, TaskManagerBase):
         }
         resp, body = await self._request("POST", "/v1/taskstore/update",
                                          data=json.dumps(payload))
+        _raise_refusal(resp)  # fence-409 is NOT the precondition branch
         if resp.status in (409, 204):
             return None
         resp.raise_for_status()
@@ -326,6 +361,11 @@ class HttpTaskManager(_HttpStoreClient, TaskManagerBase):
         payload = {"TaskId": task_id, "Events": events}
         resp, body = await self._request("POST", "/v1/taskstore/ledger",
                                          data=json.dumps(payload))
+        if resp.status in (409, 503):
+            # Typed refusal (ring fence / degraded journal): the stamp is
+            # dropped like any other miss — deliberately, the ledger never
+            # blocks serving — but not mistaken for a missing surface.
+            return 0
         if resp.status != 200:
             return 0
         try:
@@ -348,6 +388,7 @@ class HttpResultStore(_HttpStoreClient):
         resp, _body = await self._request(
             "POST", "/v1/taskstore/result", params=params,
             data=result, headers={"Content-Type": content_type})
+        _raise_refusal(resp)
         if resp.status == 404:
             # Store no longer knows the task (e.g. control plane
             # restarted without a journal) — surface the drop; the
@@ -368,6 +409,7 @@ class HttpResultStore(_HttpStoreClient):
             payload["Stage"] = stage
         resp, _body = await self._request("POST", "/v1/taskstore/result-ref",
                                           data=json.dumps(payload))
+        _raise_refusal(resp)
         if resp.status == 404:
             import logging
             logging.getLogger("ai4e_tpu.task_manager").warning(

@@ -380,6 +380,14 @@ class FencingProber:
                                 json=payload, timeout=timeout) as resp:
             if resp.status == 200:
                 self.fenced.set()
+            elif resp.status == 409:
+                # StaleEpochError from the peer: OUR epoch is not newer —
+                # this prober is the stale side of the split. Do not keep
+                # knocking as if the peer were merely unreachable; the
+                # next role probe will show the real epoch and stand down.
+                log.warning(
+                    "peer %s refused demotion (409): our epoch %s is the "
+                    "stale side", self.peer_url, self.store.epoch)
 
     async def _run(self) -> None:
         while not self._stopped.is_set():

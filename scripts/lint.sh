@@ -29,11 +29,23 @@ fi
 # every file with no rules and report a clean pass (the same silent-
 # disable failure mode --select validation closes for typo'd ids).
 rule_count=$(python -m ai4e_tpu.analysis --list-rules | grep -c '^AIL' || true)
-echo "lint: analyzer registry: ${rule_count} rule(s)"
 if [ "${rule_count}" -eq 0 ]; then
   echo "lint: analyzer rule registry is EMPTY — refusing to pass" >&2
   exit 3
 fi
-python -m ai4e_tpu.analysis ai4e_tpu/
+# --stats prints per-rule wall time to stderr; the total is surfaced next
+# to the rule count so a parse-cache or rule-cost regression shows up in
+# every CI log, not only when someone profiles by hand.
+set +e
+out=$(python -m ai4e_tpu.analysis ai4e_tpu/ --stats 2>&1)
+code=$?
+set -e
+printf '%s\n' "$out"
+total_ms=$(printf '%s\n' "$out" \
+  | sed -n 's/^stats: .*total \([0-9][0-9]*\) ms$/\1/p' | head -n 1)
+echo "lint: analyzer registry: ${rule_count} rule(s), whole-tree run ${total_ms:-?} ms"
+if [ "${code}" -ne 0 ]; then
+  exit "${code}"
+fi
 
 echo "lint: both gates clean"

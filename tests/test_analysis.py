@@ -1754,3 +1754,804 @@ class TestUnplacedDeviceTransfer:
         result = Analyzer([UnboundedMetricLabel()],
                           root=root).run(sorted(paths))
         assert [f.render() for f in result.findings] == []
+
+
+# -- the wire family (AIL016-AIL018) ------------------------------------------
+#
+# Project-rule fixtures: each test writes a tiny multi-module project
+# (server modules registering routes, client modules calling them, a
+# docs/API.md carrying the two marked tables) and runs exactly one wire
+# rule over it, so assertions never entangle the three rules' outputs.
+
+
+WIRE_DOC_SHELL = """\
+# API
+
+<!-- ai4e:routes -->
+| Method | Path | Registered in | Callers |
+|---|---|---|---|
+{routes}
+<!-- /ai4e:routes -->
+
+<!-- ai4e:headers -->
+| Header | Emitted by | Read by |
+|---|---|---|
+{headers}
+<!-- /ai4e:headers -->
+"""
+
+
+def wire_run(tmp_path, rule, files, routes="", headers="", doc=True):
+    """Write a fixture project under ``tmp_path`` and run one wire rule.
+    Returns the full AnalysisResult (tests need ``.suppressed`` too)."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    for rel, src in files.items():
+        f = tmp_path / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(src))
+    if doc:
+        d = tmp_path / "docs"
+        d.mkdir(exist_ok=True)
+        (d / "API.md").write_text(
+            WIRE_DOC_SHELL.format(routes=routes, headers=headers))
+    return Analyzer([rule], root=str(tmp_path)).run([str(pkg)])
+
+
+_ROUTES_SERVER = """
+    from aiohttp import web
+
+    async def upsert(request):
+        return web.json_response({})
+
+    async def ping(request):
+        return web.json_response({})
+
+    def attach(app):
+        app.router.add_post("/v1/store/upsert", upsert)
+        app.router.add_get("/v1/store/ping", ping)
+"""
+
+_ROUTES_CLIENT = """
+    async def save(session, body):
+        resp = await session.post("/v1/store/upsert", json=body)
+        return await resp.json()
+
+    async def check(session):
+        resp = await session.get("/v1/store/ping")
+        return resp.status
+"""
+
+_ROUTES_ROWS = (
+    "| `POST` | `/v1/store/upsert` | `pkg/server.py` | `pkg/client.py` |\n"
+    "| `GET` | `/v1/store/ping` | `pkg/server.py` | `pkg/client.py` |")
+
+_TYPO_CLIENT = _ROUTES_CLIENT + """
+    async def doomed(session):
+        resp = await session.post("/v1/store/upsrt")
+        return resp.status
+"""
+
+_SUPPRESSED_TYPO_CLIENT = _ROUTES_CLIENT + """
+    async def doomed(session):
+        resp = await session.post("/v1/store/upsrt")  # ai4e: noqa[AIL016] — exercised here as the rule's own fixture
+        return resp.status
+"""
+
+_PURGE_SERVER = _ROUTES_SERVER + """
+    async def purge(request):
+        return web.json_response({})
+
+    def attach_admin(app):
+        app.router.add_post("/v1/store/purge", purge)
+"""
+
+
+class TestClientRouteDrift:
+    def _rule(self):
+        from ai4e_tpu.analysis.rules.wire import ClientRouteDrift
+        return ClientRouteDrift()
+
+    def test_in_sync_surface_is_clean(self, tmp_path):
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": _ROUTES_SERVER,
+                           "pkg/client.py": _ROUTES_CLIENT},
+                          routes=_ROUTES_ROWS)
+        assert [f.render() for f in result.findings] == []
+
+    def test_typoed_client_path_can_only_404(self, tmp_path):
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": _ROUTES_SERVER,
+                           "pkg/client.py": _TYPO_CLIENT},
+                          routes=_ROUTES_ROWS)
+        assert len(result.findings) == 1
+        f = result.findings[0]
+        assert "no registered route matches" in f.message
+        assert f.fingerprint_key == "AIL016|client|POST /v1/store/upsrt"
+        assert f.symbol == "doomed"
+
+    def test_dead_route_without_external_row(self, tmp_path):
+        rows = _ROUTES_ROWS + (
+            "\n| `POST` | `/v1/store/purge` | `pkg/server.py` | — |")
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": _PURGE_SERVER,
+                           "pkg/client.py": _ROUTES_CLIENT},
+                          routes=rows)
+        assert len(result.findings) == 1
+        f = result.findings[0]
+        assert "no client call site" in f.message
+        assert f.fingerprint_key == "AIL016|dead-route|POST /v1/store/purge"
+
+    def test_external_caller_row_vouches_for_the_route(self, tmp_path):
+        rows = _ROUTES_ROWS + ("\n| `POST` | `/v1/store/purge` | "
+                               "`pkg/server.py` | external — operator "
+                               "runbook verb |")
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": _PURGE_SERVER,
+                           "pkg/client.py": _ROUTES_CLIENT},
+                          routes=rows)
+        assert [f.render() for f in result.findings] == []
+
+    def test_registered_route_absent_from_doc_table(self, tmp_path):
+        # ping is called (no dead-route) but its row is missing.
+        rows = "| `POST` | `/v1/store/upsert` | `pkg/server.py` | `pkg/client.py` |"
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": _ROUTES_SERVER,
+                           "pkg/client.py": _ROUTES_CLIENT},
+                          routes=rows)
+        assert len(result.findings) == 1
+        f = result.findings[0]
+        assert "absent from docs/API.md" in f.message
+        assert f.fingerprint_key == "AIL016|undocumented|GET /v1/store/ping"
+
+    def test_doc_row_nothing_registers_is_stale(self, tmp_path):
+        rows = _ROUTES_ROWS + (
+            "\n| `DELETE` | `/v1/store/gone` | `pkg/server.py` | — |")
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": _ROUTES_SERVER,
+                           "pkg/client.py": _ROUTES_CLIENT},
+                          routes=rows)
+        assert len(result.findings) == 1
+        f = result.findings[0]
+        assert f.path == "docs/API.md"
+        assert "nothing registers it" in f.message
+        assert f.fingerprint_key == "AIL016|stale-doc|DELETE /v1/store/gone"
+
+    def test_missing_table_is_one_finding_not_noise(self, tmp_path):
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": _ROUTES_SERVER,
+                           "pkg/client.py": _ROUTES_CLIENT},
+                          doc=False)
+        assert [f.fingerprint_key for f in result.findings] == [
+            "AIL016|no-table"]
+        assert "--dump-wire" in result.findings[0].message
+
+    def test_prefix_registration_matches_full_path_client(self, tmp_path):
+        # ``self.prefix + "/models/reload"`` registers as /{**}/models/
+        # reload; a client posting base + "/v1/svc/models/reload" must
+        # match it (the PR 18 reload verb is wired exactly like this).
+        server = """
+            from aiohttp import web
+
+            async def reload_weights(request):
+                return web.json_response({})
+
+            class Svc:
+                def __init__(self, prefix):
+                    self.prefix = prefix
+
+                def attach(self, app):
+                    app.router.add_post(self.prefix + "/models/reload",
+                                        reload_weights)
+        """
+        client = """
+            async def trigger(session, base):
+                resp = await session.post(base + "/v1/svc/models/reload")
+                return await resp.json()
+        """
+        rows = ("| `POST` | `/{**}/models/reload` | `pkg/server.py` | "
+                "`pkg/client.py` |")
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": server, "pkg/client.py": client},
+                          routes=rows)
+        assert [f.render() for f in result.findings] == []
+
+    def test_suppression_marker_counts_as_suppressed(self, tmp_path):
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": _ROUTES_SERVER,
+                           "pkg/client.py": _SUPPRESSED_TYPO_CLIENT},
+                          routes=_ROUTES_ROWS)
+        assert result.findings == []
+        assert result.suppressed == 1
+
+    def test_fingerprint_stable_when_registration_moves_files(self, tmp_path):
+        # The contract fingerprint names the CONTRACT, not the file: the
+        # same dead route registered from a different module must carry
+        # the SAME fingerprint, so refactors don't churn the baseline.
+        server = """
+            from aiohttp import web
+
+            async def purge(request):
+                return web.json_response({})
+
+            def attach(app):
+                app.router.add_post("/v1/store/purge", purge)
+        """
+        rows = "| `POST` | `/v1/store/purge` | `pkg/server.py` | — |"
+        a = tmp_path / "a"
+        a.mkdir()
+        before = wire_run(a, self._rule(), {"pkg/server.py": server},
+                          routes=rows)
+        b = tmp_path / "b"
+        b.mkdir()
+        after = wire_run(b, self._rule(), {"pkg/registry.py": server},
+                         routes=rows)
+        assert len(before.findings) == len(after.findings) == 1
+        assert before.findings[0].path != after.findings[0].path
+        assert before.findings[0].fingerprint == after.findings[0].fingerprint
+
+
+_HDR_EMIT = """
+    from aiohttp import web
+
+    async def shed(request):
+        return web.json_response(
+            {}, status=503, headers={"X-Shed-Reason": "quota"})
+"""
+
+_HDR_READ = """
+    async def watch(session):
+        resp = await session.get("http://svc/v1/x")
+        return resp.headers.get("X-Shed-Reason")
+"""
+
+_HDR_ROWS = "| `X-Shed-Reason` | `pkg/emit.py` | `pkg/read.py` |"
+
+
+class TestHeaderVocabularyDrift:
+    def _rule(self):
+        from ai4e_tpu.analysis.rules.wire import HeaderVocabularyDrift
+        return HeaderVocabularyDrift()
+
+    def test_round_tripped_header_is_clean(self, tmp_path):
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/emit.py": _HDR_EMIT,
+                           "pkg/read.py": _HDR_READ},
+                          headers=_HDR_ROWS)
+        assert [f.render() for f in result.findings] == []
+
+    def test_header_outside_vocabulary_is_typo_minted(self, tmp_path):
+        # Emitted AND read in code (so only the vocabulary check can
+        # fire) but absent from the table: the typo-minted shape.
+        emit = """
+            async def shed(request, web):
+                return web.json_response(
+                    {}, status=503, headers={"X-Shed-Reasn": "quota"})
+        """
+        read = """
+            async def watch(session):
+                resp = await session.get("http://svc/v1/x")
+                return resp.headers.get("X-Shed-Reasn")
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/emit.py": emit, "pkg/read.py": read},
+                          headers=_HDR_ROWS.replace(
+                              "X-Shed-Reason", "X-Other"))
+        keys = [f.fingerprint_key for f in result.findings]
+        assert "AIL017|vocab|X-Shed-Reasn" in keys
+        assert any("typo-minted" in f.message for f in result.findings)
+
+    def test_emit_without_reader_and_no_external_row(self, tmp_path):
+        rows = _HDR_ROWS + "\n| `X-Cost-Tier` | `pkg/price.py` | — |"
+        price = """
+            async def price(request, resp):
+                resp.headers["X-Cost-Tier"] = "batch"
+                return resp
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/emit.py": _HDR_EMIT, "pkg/read.py": _HDR_READ,
+                           "pkg/price.py": price},
+                          headers=rows)
+        assert [f.fingerprint_key for f in result.findings] == [
+            "AIL017|emit-no-reader|X-Cost-Tier"]
+
+    def test_documented_external_reader_vouches(self, tmp_path):
+        rows = _HDR_ROWS + ("\n| `X-Cost-Tier` | `pkg/price.py` | "
+                            "external — billing scraper |")
+        price = """
+            async def price(request, resp):
+                resp.headers["X-Cost-Tier"] = "batch"
+                return resp
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/emit.py": _HDR_EMIT, "pkg/read.py": _HDR_READ,
+                           "pkg/price.py": price},
+                          headers=rows)
+        assert [f.render() for f in result.findings] == []
+
+    def test_read_without_emitter_and_no_external_row(self, tmp_path):
+        rows = _HDR_ROWS + "\n| `X-Deadline-Ms` | — | `pkg/budget.py` |"
+        budget = """
+            async def deadline(request):
+                return request.headers.get("X-Deadline-Ms")
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/emit.py": _HDR_EMIT, "pkg/read.py": _HDR_READ,
+                           "pkg/budget.py": budget},
+                          headers=rows)
+        assert [f.fingerprint_key for f in result.findings] == [
+            "AIL017|read-no-emitter|X-Deadline-Ms"]
+
+    def test_documented_external_emitter_vouches(self, tmp_path):
+        rows = _HDR_ROWS + ("\n| `X-Deadline-Ms` | external — load "
+                            "clients set the budget | `pkg/budget.py` |")
+        budget = """
+            async def deadline(request):
+                return request.headers.get("X-Deadline-Ms")
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/emit.py": _HDR_EMIT, "pkg/read.py": _HDR_READ,
+                           "pkg/budget.py": budget},
+                          headers=rows)
+        assert [f.render() for f in result.findings] == []
+
+    def test_doc_row_nothing_uses_is_stale(self, tmp_path):
+        rows = _HDR_ROWS + "\n| `X-Gone` | `pkg/emit.py` | `pkg/read.py` |"
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/emit.py": _HDR_EMIT,
+                           "pkg/read.py": _HDR_READ},
+                          headers=rows)
+        assert [f.fingerprint_key for f in result.findings] == [
+            "AIL017|stale-doc|X-Gone"]
+        assert result.findings[0].path == "docs/API.md"
+
+    def test_constant_resolved_emit_round_trips(self, tmp_path):
+        # ``resp.headers[SHED_HEADER] = …`` resolves through the
+        # *_HEADER constant map; the defining assignment itself is a
+        # mention, not an emit obligation.
+        emit = """
+            SHED_HEADER = "X-Shed-Reason"
+
+            async def shed(request, resp):
+                resp.headers[SHED_HEADER] = "quota"
+                return resp
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/emit.py": emit, "pkg/read.py": _HDR_READ},
+                          headers=_HDR_ROWS)
+        assert [f.render() for f in result.findings] == []
+
+    def test_suppression_marker_counts_as_suppressed(self, tmp_path):
+        price = """
+            async def price(request, resp):
+                resp.headers["X-Cost-Tier"] = "batch"  # ai4e: noqa[AIL017] — fixture for this very test
+                return resp
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/emit.py": _HDR_EMIT, "pkg/read.py": _HDR_READ,
+                           "pkg/price.py": price},
+                          headers=_HDR_ROWS)
+        assert result.findings == []
+        assert result.suppressed >= 1
+
+
+_REFUSE_SERVER = """
+    from aiohttp import web
+
+    def _refuse():
+        return web.json_response({"error": "busy"}, status=503)
+
+    async def upsert(request):
+        if request.content_length and request.content_length > 1024:
+            return _refuse()
+        return web.json_response({})
+
+    def attach(app):
+        app.router.add_post("/v1/store/upsert", upsert)
+"""
+
+
+class TestUnhandledRefusalStatus:
+    def _rule(self):
+        from ai4e_tpu.analysis.rules.wire import UnhandledRefusalStatus
+        return UnhandledRefusalStatus()
+
+    def test_unbranched_503_is_a_finding(self, tmp_path):
+        client = """
+            async def save(session, body):
+                resp = await session.post("/v1/store/upsert", json=body)
+                if resp.status != 200:
+                    raise RuntimeError("save failed")
+                body = await resp.json()
+                return body
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": _REFUSE_SERVER,
+                           "pkg/client.py": client})
+        assert len(result.findings) == 1
+        f = result.findings[0]
+        assert "503" in f.message and "backpressure" in f.message
+        assert f.fingerprint_key == "AIL018|POST /v1/store/upsert|503|save"
+
+    def test_branching_on_the_status_is_clean(self, tmp_path):
+        client = """
+            async def save(session, body):
+                resp = await session.post("/v1/store/upsert", json=body)
+                if resp.status in (429, 503):
+                    raise TimeoutError("store shed the write; retry later")
+                if resp.status != 200:
+                    raise RuntimeError("save failed")
+                body = await resp.json()
+                return body
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": _REFUSE_SERVER,
+                           "pkg/client.py": client})
+        assert [f.render() for f in result.findings] == []
+
+    def test_module_helper_one_hop_counts_as_handled(self, tmp_path):
+        # The fix idiom this PR applied everywhere: a module-level
+        # ``_raise_refusal(resp)`` the response is passed to. Its
+        # compares count for the caller (one hop, symmetric with the
+        # server-side handler hop).
+        client = """
+            def _raise_refusal(resp):
+                if resp.status == 503:
+                    raise TimeoutError("store refused; retry later")
+
+            async def save(session, body):
+                resp = await session.post("/v1/store/upsert", json=body)
+                _raise_refusal(resp)
+                if resp.status != 200:
+                    raise RuntimeError("save failed")
+                body = await resp.json()
+                return body
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": _REFUSE_SERVER,
+                           "pkg/client.py": client})
+        assert [f.render() for f in result.findings] == []
+
+    def test_raise_for_status_does_not_distinguish(self, tmp_path):
+        # ``resp.raise_for_status()`` is generic failure, not a branch on
+        # the refusal contract — the exact bug class the first run caught
+        # in service/task_manager.py.
+        client = """
+            async def save(session, body):
+                resp = await session.post("/v1/store/upsert", json=body)
+                resp.raise_for_status()
+                body = await resp.json()
+                return body
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": _REFUSE_SERVER,
+                           "pkg/client.py": client})
+        assert [f.fingerprint_key for f in result.findings] == [
+            "AIL018|POST /v1/store/upsert|503|save"]
+
+    def test_propagating_transport_helper_is_exempt(self, tmp_path):
+        client = """
+            async def _request(session, body):
+                resp = await session.post("/v1/store/upsert", json=body)
+                return resp
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": _REFUSE_SERVER,
+                           "pkg/client.py": client})
+        assert [f.render() for f in result.findings] == []
+
+    def test_http_conflict_constructor_counts_as_409(self, tmp_path):
+        server = """
+            from aiohttp import web
+
+            async def reload_weights(request):
+                if request.app.get("draining"):
+                    raise web.HTTPConflict(text="draining")
+                return web.json_response({})
+
+            def attach(app):
+                app.router.add_post("/v1/models/reload", reload_weights)
+        """
+        client = """
+            async def trigger(session):
+                resp = await session.post("/v1/models/reload")
+                if resp.status != 200:
+                    raise RuntimeError("reload failed")
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": server,
+                           "pkg/client.py": client})
+        assert len(result.findings) == 1
+        assert "409" in result.findings[0].message
+        assert "conflict" in result.findings[0].message
+
+    def test_undistinguished_statuses_carry_no_obligation(self, tmp_path):
+        # 404 is not part of the refusal contract: no caller obligation.
+        server = """
+            from aiohttp import web
+
+            async def fetch(request):
+                if not request.query.get("id"):
+                    return web.json_response({}, status=404)
+                return web.json_response({})
+
+            def attach(app):
+                app.router.add_get("/v1/store/task", fetch)
+        """
+        client = """
+            async def load(session):
+                resp = await session.get("/v1/store/task")
+                body = await resp.json()
+                return body
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": server,
+                           "pkg/client.py": client})
+        assert [f.render() for f in result.findings] == []
+
+    def test_suppression_marker_counts_as_suppressed(self, tmp_path):
+        client = """
+            async def save(session, body):
+                resp = await session.post("/v1/store/upsert", json=body)  # ai4e: noqa[AIL018] — fixture for this very test
+                if resp.status != 200:
+                    raise RuntimeError("save failed")
+                body = await resp.json()
+                return body
+        """
+        result = wire_run(tmp_path, self._rule(),
+                          {"pkg/server.py": _REFUSE_SERVER,
+                           "pkg/client.py": client})
+        assert result.findings == []
+        assert result.suppressed == 1
+
+
+# -- AIL019 unused-suppression ------------------------------------------------
+
+
+class TestUnusedSuppression:
+    def _run(self, tmp_path, source, rules):
+        f = tmp_path / "m.py"
+        f.write_text(textwrap.dedent(source))
+        return Analyzer(rules, root=str(tmp_path)).run([str(f)])
+
+    def _rules(self):
+        from ai4e_tpu.analysis.rules.unused_noqa import UnusedSuppression
+        return [BlockingCallInAsync(), UnusedSuppression()]
+
+    def test_stale_marker_is_a_finding(self, tmp_path):
+        result = self._run(tmp_path, """
+            x = 1  # ai4e: noqa[AIL001] — the sleep this blessed is long gone
+        """, self._rules())
+        assert [f.rule for f in result.findings] == ["AIL019"]
+        assert "AIL001" in result.findings[0].message
+        assert "does not fire on" in result.findings[0].message
+
+    def test_live_marker_suppresses_and_is_not_flagged(self, tmp_path):
+        result = self._run(tmp_path, """
+            import time
+            async def h():
+                time.sleep(1)  # ai4e: noqa[AIL001] — fixture: rule genuinely fires here
+        """, self._rules())
+        assert result.findings == []
+        assert result.suppressed == 1
+
+    def test_marker_for_inactive_rule_is_unproven_not_unused(self, tmp_path):
+        # Under --select the suppressed rule never ran: flagging the
+        # marker as unused would be a lie.
+        from ai4e_tpu.analysis.rules.unused_noqa import UnusedSuppression
+        result = self._run(tmp_path, """
+            x = 1  # ai4e: noqa[AIL001] — AIL001 is not in this run
+        """, [UnusedSuppression()])
+        assert result.findings == []
+
+    def test_justified_keep_via_ail019_in_the_marker(self, tmp_path):
+        result = self._run(tmp_path, """
+            x = 1  # ai4e: noqa[AIL001,AIL019] — fires only under the py3.12 parser
+        """, self._rules())
+        assert result.findings == []
+        assert result.suppressed == 1
+
+
+# -- --sarif / --stats / --dump-wire / --list-rules ---------------------------
+
+
+class TestSarifOutput:
+    def test_findings_emit_sarif_with_matching_fingerprints(self, tmp_path,
+                                                            capsys):
+        import json as _json
+        from ai4e_tpu.analysis.cli import main
+        (tmp_path / "m.py").write_text(
+            "import time\nasync def h():\n    time.sleep(1)\n")
+        base = [str(tmp_path / "m.py"), "--root", str(tmp_path),
+                "--select", "AIL001"]
+        assert main(base + ["--json"]) == 1
+        fp = _json.loads(capsys.readouterr().out)["findings"][0]["fingerprint"]
+        assert main(base + ["--sarif"]) == 1
+        doc = _json.loads(capsys.readouterr().out)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "ai4e-lint"
+        assert any(r["id"] == "AIL001" for r in driver["rules"])
+        res = run["results"][0]
+        assert res["ruleId"] == "AIL001"
+        assert res["level"] == "error"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "m.py"
+        assert loc["region"]["startLine"] == 3
+        # Same identity as the baseline fingerprint: annotations survive
+        # pushes that merely move the finding, exactly like the baseline.
+        assert res["partialFingerprints"]["ai4eFingerprint/v1"] == fp
+
+    def test_clean_tree_exits_zero_with_empty_results(self, tmp_path, capsys):
+        import json as _json
+        from ai4e_tpu.analysis.cli import main
+        (tmp_path / "m.py").write_text("x = 1\n")
+        assert main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                     "--select", "AIL001", "--sarif"]) == 0
+        doc = _json.loads(capsys.readouterr().out)
+        assert doc["runs"][0]["results"] == []
+
+
+class TestStatsAndParseCache:
+    def test_stats_json_carries_per_rule_seconds(self, tmp_path, capsys):
+        import json as _json
+        from ai4e_tpu.analysis.cli import main
+        (tmp_path / "m.py").write_text("x = 1\n")
+        assert main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                     "--select", "AIL001", "--json", "--stats"]) == 0
+        stats = _json.loads(capsys.readouterr().out)["stats"]
+        assert set(stats) == {"parse_seconds", "total_seconds",
+                              "rule_seconds"}
+        assert "AIL001" in stats["rule_seconds"]
+        assert stats["total_seconds"] >= stats["parse_seconds"] >= 0
+
+    def test_stats_text_total_line_matches_the_lint_sh_scrape(self, tmp_path,
+                                                              capsys):
+        # scripts/lint.sh extracts the total with
+        # ``sed -n 's/^stats: .*total \([0-9][0-9]*\) ms$/\1/p'`` — the
+        # stderr format is load-bearing.
+        import re
+        from ai4e_tpu.analysis.cli import main
+        (tmp_path / "m.py").write_text("x = 1\n")
+        assert main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                     "--select", "AIL001", "--stats"]) == 0
+        err = capsys.readouterr().err
+        assert re.search(r"(?m)^stats: .*total \d+ ms$", err)
+        assert re.search(r"(?m)^stats: AIL001\s+[\d.]+ ms$", err)
+
+    def test_parse_cache_reuses_tree_until_content_changes(self, tmp_path):
+        from ai4e_tpu.analysis.core import parse_module
+        p = tmp_path / "m.py"
+        p.write_text("x = 1\n")
+        m1 = parse_module(str(p), "m.py")
+        m2 = parse_module(str(p), "m.py")
+        assert m2.tree is m1.tree and m2.source is m1.source
+        p.write_text("y = 22222\n")
+        st = os.stat(p)
+        os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 10**9))
+        m3 = parse_module(str(p), "m.py")
+        assert m3.tree is not m1.tree
+        assert "y = 22222" in m3.source
+
+    def test_parse_cache_invalidates_on_mtime_alone(self, tmp_path):
+        # Same byte length, newer mtime: the cache must re-read (size
+        # alone is not identity).
+        from ai4e_tpu.analysis.core import parse_module
+        p = tmp_path / "m.py"
+        p.write_text("x = 1\n")
+        m1 = parse_module(str(p), "m.py")
+        p.write_text("x = 2\n")
+        st = os.stat(p)
+        os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 10**9))
+        m3 = parse_module(str(p), "m.py")
+        assert "x = 2" in m3.source
+
+
+class TestDumpWire:
+    def test_prints_both_marked_tables_from_the_surface(self, tmp_path,
+                                                        capsys):
+        from ai4e_tpu.analysis.cli import main
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "server.py").write_text(textwrap.dedent(_ROUTES_SERVER))
+        (pkg / "client.py").write_text(textwrap.dedent(_ROUTES_CLIENT))
+        (pkg / "emit.py").write_text(textwrap.dedent(_HDR_EMIT))
+        (pkg / "read.py").write_text(textwrap.dedent(_HDR_READ))
+        assert main([str(pkg), "--root", str(tmp_path), "--dump-wire"]) == 0
+        out = capsys.readouterr().out
+        assert "<!-- ai4e:routes -->" in out and "<!-- /ai4e:routes -->" in out
+        assert "<!-- ai4e:headers -->" in out
+        assert "`/v1/store/upsert`" in out
+        assert "`X-Shed-Reason`" in out
+
+
+class TestListRulesFamilies:
+    def test_wire_family_is_grouped_and_banners_dodge_the_grep(self, capsys):
+        from ai4e_tpu.analysis.cli import main
+        assert main(["--list-rules"]) == 0
+        lines = capsys.readouterr().out.splitlines()
+        banners = [l for l in lines if l.startswith("#")]
+        assert "# wire contracts (cross-process)" in banners
+        # scripts/lint.sh counts rules with `grep -c '^AIL'`: exactly one
+        # line per registered rule, banners excluded.
+        ail_lines = [l for l in lines if l.startswith("AIL")]
+        assert len(ail_lines) == len(ALL_RULES)
+        wire_at = lines.index("# wire contracts (cross-process)")
+        first_wire = next(i for i, l in enumerate(lines)
+                          if l.startswith("AIL016"))
+        assert wire_at < first_wire
+
+
+# -- the wire gate ships armed ------------------------------------------------
+
+
+class TestWireGateRegistration:
+    def test_wire_and_hygiene_rules_are_registered(self):
+        ids = {cls.rule_id for cls in ALL_RULES}
+        assert {"AIL016", "AIL017", "AIL018", "AIL019"} <= ids
+        assert len(ids) >= 19
+
+    def test_checked_in_baseline_is_empty(self):
+        """ISSUE 19 acceptance: the wire family's first-run findings were
+        all FIXED in this PR, not baselined — the baseline ships empty."""
+        import json as _json
+        with open(os.path.join(REPO, "analysis_baseline.json")) as fh:
+            data = _json.load(fh)
+        assert data["findings"] == []
+
+
+# -- behavioral regressions for the refusal-contract fixes --------------------
+
+
+class _FakeResp:
+    def __init__(self, status, headers=None):
+        self.status = status
+        self.headers = headers or {}
+
+
+class TestTypedRefusalFixes:
+    """AIL018's first run flagged every store-client write path for
+    swallowing the 503 backpressure / fence-409 refusals; the fix routes
+    them through typed module helpers. Pin the helpers' contract."""
+
+    def test_task_manager_types_503_with_retry_after(self):
+        from ai4e_tpu.service.task_manager import (StoreRefusalError,
+                                                   _raise_refusal)
+        with pytest.raises(StoreRefusalError) as ei:
+            _raise_refusal(_FakeResp(503, {"Retry-After": "3",
+                                           "X-Shed-Reason": "journal-degraded"}))
+        assert ei.value.status == 503
+        assert ei.value.retry_after == "3"
+        assert "journal-degraded" in str(ei.value)
+
+    def test_task_manager_types_fence_409_only(self):
+        from ai4e_tpu.service.task_manager import (StoreRefusalError,
+                                                   _raise_refusal)
+        with pytest.raises(StoreRefusalError) as ei:
+            _raise_refusal(_FakeResp(409, {"X-Not-Owner": "1"}))
+        assert ei.value.status == 409
+        # A bare 409 is the conditional-update precondition branch, not
+        # the ring fence: it must pass through untyped.
+        _raise_refusal(_FakeResp(409))
+        _raise_refusal(_FakeResp(200))
+        _raise_refusal(_FakeResp(404))
+
+    def test_store_refusal_rides_the_not_primary_handling(self):
+        # The gateway's standby handling (503 + Retry-After) catches
+        # NotPrimaryError; the typed refusal must be a subclass so store
+        # refusals surface as retryable refusals, not 500s.
+        from ai4e_tpu.service.task_manager import StoreRefusalError
+        from ai4e_tpu.taskstore import NotPrimaryError
+        assert issubclass(StoreRefusalError, NotPrimaryError)
+
+    def test_rig_wire_refusal_helper(self):
+        from ai4e_tpu.rig.wire import _raise_refusal
+        from ai4e_tpu.taskstore import NotPrimaryError
+        with pytest.raises(NotPrimaryError) as ei:
+            _raise_refusal(_FakeResp(503, {"Retry-After": "2"}))
+        assert "retry after 2s" in str(ei.value)
+        with pytest.raises(NotPrimaryError):
+            _raise_refusal(_FakeResp(409, {"X-Not-Owner": "1"}))
+        _raise_refusal(_FakeResp(409))
+        _raise_refusal(_FakeResp(200))
