@@ -1,12 +1,19 @@
 # Contributor conveniences. Each target reproduces the matching CI job
 # with the SAME flags (the scripts are the single source of truth).
 
-.PHONY: lint test race-smoke chaos durability rig top timeline mesh upgrade
+.PHONY: lint lint-fast test race-smoke chaos durability rig top timeline mesh upgrade
 
 # Both lint gates CI runs (ruff correctness rules + ai4e-lint, see
 # scripts/lint.sh and docs/analysis.md).
 lint:
 	bash scripts/lint.sh
+
+# Pre-commit loop: analyzer scoped to .py files changed vs origin/main
+# (falls back to HEAD when no remote exists). Project-wide rules are
+# skipped — CI's `make lint` keeps the whole-repo gate armed.
+lint-fast:
+	@ref=origin/main; git rev-parse --verify -q "$$ref" >/dev/null || ref=HEAD; \
+	python -m ai4e_tpu.analysis ai4e_tpu/ --changed-only "$$ref"
 
 # Tier-1: the suite ROADMAP.md's verify line runs.
 test:

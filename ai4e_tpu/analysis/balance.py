@@ -1,0 +1,691 @@
+"""Paired-effect conservation engine (AIL020 — docs/analysis.md).
+
+The platform's worst recurring bug class is *imbalance*: a counted effect
+opened on one path and never closed on another — the PR 3 half-open
+probe-slot leak, the PR 7 sync-proxy inflight pairing, the PR 8 device
+failure raising out of ``batcher.submit`` past the buffered ledger flush,
+the PR 18 drain straggler retirement. Each pair of verbs below is one of
+those hand-found bugs turned into a declarative spec; the engine walks one
+function at a time on top of ``AwaitFlow`` (the PR 5 CFG-over-suspension-
+points) and asks: *does the close dominate every exit the open can reach —
+return, raise, and the suspension-abandonment path?*
+
+Scope is deliberately intra-function: an open whose close lives in a
+DIFFERENT function (``_reserve`` in the handler prologue, ``_release`` in
+the epilogue helper; ``begin_probe`` in admission, ``record_*`` in the
+response path) is a protocol endpoint the engine cannot see both sides
+of, so an open with no receiver-matched close anywhere in the same
+function is skipped, not flagged. What remains — both sides present, one
+frame — is exactly the shape every one of the past bugs had.
+
+Blessed idioms (never flagged):
+
+- the open is a context-manager entry (``with``/``async with`` item);
+- the open sits in (or immediately before) a ``try`` whose ``finally``
+  contains a matched close — the interpreter guarantees the close on
+  return, raise, AND task cancellation;
+- close-before-reraise: a matched close unconditionally preceding the
+  ``raise`` inside the same handler covers that exit;
+- ownership handoff: the open's result is stored into an attribute /
+  container (or returned) — the effect now has a new owner with its own
+  lifecycle (``seq.slot = slot; self._active[slot] = seq``);
+- callback handoff: a matched close inside a nested ``def``/``lambda``
+  (``task.add_done_callback(lambda _t: self._pending.dec())``) — the
+  close rides the task, not this frame.
+
+Everything else is an escape:
+
+- ``return`` / ``raise`` not covered by a close on that path;
+- falling off the end of the function (or of the open's enclosing loop
+  iteration) without an unconditional close;
+- **suspension abandonment**: an ``await`` between the open and its
+  path-close, with no ``finally``/CM protection — a cancelled task
+  abandons the frame at that await and the close never runs. This is the
+  leak mode reviews miss: every path LOOKS closed until the event loop
+  cancels you mid-flight.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+
+from .core import AwaitFlow, _pos
+
+__all__ = ["PairSpec", "PAIR_SPECS", "Escape", "check_all",
+           "check_function"]
+
+
+@dataclass(frozen=True)
+class PairSpec:
+    """One paired effect: ``opens`` must be balanced by ``closes``.
+
+    ``receiver`` (regex) constrains which attribute chains count as opens
+    — ``stamp`` is only a ledger-buffer open when called on something that
+    looks like a ledger. ``same_receiver`` demands the close ride the
+    exact same chain (gauges: ``x.inc()`` is only closed by ``x.dec()``,
+    not by some other gauge's dec). ``anchor`` names a module-path suffix
+    that defines the pair's home surface — AIL022 uses it to verify the
+    declared symbols still resolve to real code whenever that module is in
+    the scan (the AIL006 self-honesty trick: a rename must not silently
+    disarm the rule). Specs with no anchor use verbs too generic to
+    drift (``acquire``/``release``) and are exempt from AIL022."""
+
+    name: str
+    opens: tuple[str, ...]
+    closes: tuple[str, ...]
+    receiver: str = ""
+    same_receiver: bool = False
+    anchor: str = ""
+    description: str = ""
+
+
+#: The declarative pair table AIL020 enforces. Append-only by convention:
+#: every row names the real bug class it encodes (docs/analysis.md has
+#: the catalog row; docs/concurrency.md the conservation contract).
+PAIR_SPECS: tuple[PairSpec, ...] = (
+    PairSpec(
+        name="estimator-inflight",
+        opens=("begin",), closes=("end",),
+        receiver=r"(orch|estimator)",
+        anchor="orchestration/estimator.py",
+        description="orchestration begin/end inflight accounting "
+                    "(PR 7: RTTs observed without pairing)"),
+    PairSpec(
+        name="probe-slot",
+        opens=("begin_probe",),
+        closes=("record_success", "record_failure", "record_neutral"),
+        anchor="resilience/breaker.py",
+        description="breaker half-open probe slot take/settle "
+                    "(PR 3: a vanished probe ejected a backend forever)"),
+    PairSpec(
+        name="limiter-slot",
+        opens=("try_acquire", "acquire"), closes=("release",),
+        description="limiter/semaphore/slot-pool acquire must be "
+                    "released on every exit"),
+    PairSpec(
+        name="service-inflight",
+        opens=("_reserve",), closes=("_release",),
+        anchor="service/app.py",
+        description="per-spec in-flight reservation (the reference "
+                    "platform's concurrency accounting)"),
+    PairSpec(
+        name="gauge-updown",
+        opens=("inc",), closes=("dec",), same_receiver=True,
+        description="up-down gauge inc/dec — a leaked inc is permanent "
+                    "phantom load"),
+    PairSpec(
+        name="drain-interlock",
+        opens=("try_begin_reload",), closes=("end_reload",),
+        anchor="rollout/drain.py",
+        description="drain/reload interlock (PR 18: exactly-one-outcome "
+                    "straggler retirement)"),
+    PairSpec(
+        name="ledger-buffer-flush",
+        opens=("stamp",),
+        closes=("flush", "drain", "_flush_ledger"),
+        receiver=r"(buf|led)",
+        anchor="observability/ledger.py",
+        description="buffered hop-ledger stamps must flush on every "
+                    "exit (PR 8: device failure dropped exactly the "
+                    "failed tasks' stamps)"),
+)
+
+
+@dataclass(frozen=True)
+class Escape:
+    """One unbalanced open: ``kind`` is the exit class the close fails to
+    cover. ``at_line`` is the escaping exit / abandoning await."""
+
+    kind: str            # "return" | "raise" | "end" | "abandonment"
+    spec: PairSpec
+    open_line: int
+    open_col: int
+    open_snippet_node: ast.AST
+    at_line: int
+    receiver: str
+
+
+_NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+#: AST parents under which a node's execution is conditional even once
+#: the enclosing statement is reached (used by the coverage check: a
+#: close under one of these does not cover exits outside it).
+_COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.DictComp,
+                   ast.GeneratorExp)
+
+
+def _chain(node: ast.AST) -> str | None:
+    """Dotted receiver chain for Name/Attribute, else None (dynamic)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _walk_scope(node: ast.AST, top: bool = True):
+    """Walk ``node`` excluding nested function/lambda bodies — their
+    calls open/close effects in their OWN frame, not this one."""
+    if not top and isinstance(node, _NESTED):
+        return
+    yield node
+    for child in ast.iter_child_nodes(node):
+        yield from _walk_scope(child, top=False)
+
+
+def _match_call(node: ast.AST, verbs: tuple[str, ...]) -> str | None:
+    """Receiver chain when ``node`` is a call of one of ``verbs``; the
+    empty string for bare-name calls; None when it is not a match."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in verbs:
+        return _chain(f.value) or "<dynamic>"
+    if isinstance(f, ast.Name) and f.id in verbs:
+        return ""
+    return None
+
+
+def _close_matches(open_chain: str, close_call: ast.Call,
+                   close_chain: str, spec: PairSpec) -> bool:
+    """Same receiver chain, or (non-strict pairs) the open's receiver is
+    handed to the close as an argument — ``buf.stamp(...)`` is closed by
+    ``self._flush_ledger(tm, task_id, buf)``."""
+    if open_chain and close_chain == open_chain:
+        return True
+    if spec.same_receiver:
+        return False
+    if not open_chain and not close_chain:
+        return True  # both bare names — module-level helpers
+    root = open_chain.split(".")[0] if open_chain else ""
+    args = list(close_call.args) + [k.value for k in close_call.keywords]
+    for a in args:
+        ch = _chain(a)
+        if ch is None:
+            continue
+        if ch == open_chain or (root and root != "self" and ch == root):
+            return True
+    return False
+
+
+def _lca(flow: AwaitFlow, a: ast.AST, b: ast.AST) -> ast.AST:
+    bset = {id(n) for n in [b, *flow._ancestors(b)]}
+    for n in [a, *flow._ancestors(a)]:
+        if id(n) in bset:
+            return n
+    return flow.fn
+
+
+def _arm_disjoint(flow: AwaitFlow, a: ast.AST, b: ast.AST) -> bool:
+    """No single path executes both ``a`` and ``b``: different arms of an
+    ``if``, different handlers of a ``try``, or handler vs ``orelse``."""
+    for anc in flow._ancestors(a):
+        if isinstance(anc, ast.If) and flow.in_subtree(b, anc):
+            ba, bb = flow._branch_of(a, anc), flow._branch_of(b, anc)
+            if (ba in ("body", "orelse") and bb in ("body", "orelse")
+                    and ba != bb):
+                return True
+        if isinstance(anc, ast.Try) and flow.in_subtree(b, anc):
+            ba, bb = flow._branch_of(a, anc), flow._branch_of(b, anc)
+            if ba == "handlers" and bb == "handlers":
+                ha = next((h for h in anc.handlers
+                           if flow.in_subtree(a, h)), None)
+                hb = next((h for h in anc.handlers
+                           if flow.in_subtree(b, h)), None)
+                if ha is not None and hb is not None and ha is not hb:
+                    return True
+            if {ba, bb} == {"handlers", "orelse"}:
+                return True
+    return False
+
+
+def _unconditional_upto(flow: AwaitFlow, node: ast.AST,
+                        stop: ast.AST) -> bool:
+    """Once control enters ``stop``'s region on the straight-line path,
+    does ``node`` always execute? False if any step strictly below
+    ``stop`` is a branch arm, handler, loop body, short-circuit operand,
+    or comprehension — i.e. anything the path can skip."""
+    cur = node
+    while cur is not stop:
+        parent = flow._parent.get(cur)
+        if parent is None or parent is stop:
+            break
+        if isinstance(parent, ast.If) and cur is not parent.test:
+            return False
+        if isinstance(parent, ast.IfExp) and cur is not parent.test:
+            return False
+        if isinstance(parent, ast.Try):
+            branch = flow._branch_of(node, parent)
+            if branch != "finalbody":
+                return False  # body/handlers/orelse: skippable on the
+                # exception (or no-exception) path
+        if isinstance(parent, (ast.For, ast.AsyncFor, ast.While)):
+            branch = flow._branch_of(node, parent)
+            if branch in ("body", "orelse"):
+                return False  # zero iterations / break
+        if isinstance(parent, ast.BoolOp) and cur is not parent.values[0]:
+            return False
+        if isinstance(parent, _COMPREHENSIONS):
+            return False
+        cur = parent
+    return True
+
+
+def _covers_exit(flow: AwaitFlow, c: ast.AST, x: ast.AST) -> bool:
+    """Every path from the region both share that reaches exit ``x``
+    executed close ``c`` first. The walk checks ``c``'s side for
+    skippable steps strictly below the common ancestor; the final step
+    INTO the common ancestor is judged by which arms the two sit in
+    (same ``try`` body vs handler differ from same plain block)."""
+    lca = _lca(flow, c, x)
+    if not _unconditional_upto(flow, c, lca):
+        return False
+    if isinstance(lca, ast.Try):
+        bc, bx = flow._branch_of(c, lca), flow._branch_of(x, lca)
+        if bc == "body" and bx in ("handlers", "finalbody"):
+            return False  # the exception may fire before c runs
+        if bc == "orelse" and bx in ("handlers", "finalbody"):
+            return False
+        if bc == "handlers" and bx == "finalbody":
+            return False  # a different exception took a different arm
+    if isinstance(lca, (ast.For, ast.AsyncFor, ast.While)):
+        bc, bx = flow._branch_of(c, lca), flow._branch_of(x, lca)
+        if bc == "body" and bx == "orelse":
+            return False  # zero iterations reach orelse without c
+    return True
+
+
+def _reachable(flow: AwaitFlow, o: ast.AST, x: ast.AST) -> bool:
+    """Can control reach ``x`` after executing ``o``? Prunes exits sealed
+    off by a terminating tail: an except-handler that ends in ``return``
+    cannot fall through to exits after its ``try``. Exception jumps are
+    respected — an exit inside a handler or ``finally`` of an enclosing
+    ``try`` stays reachable from inside that try's body. Exits positioned
+    before the open (loop back edges) are out of scope here; the
+    per-iteration end-escape check owns that path."""
+    cur: ast.AST | None = _stmt_of(flow, o)
+    normal = True  # can control still fall through normally?
+    while cur is not None:
+        parent = flow._parent.get(cur)
+        if parent is None:
+            return False
+        if isinstance(parent, ast.Try):
+            br = flow._branch_of(cur, parent)
+            if br in ("body", "orelse") and any(
+                    flow.in_subtree(x, h) for h in parent.handlers):
+                return True  # an exception mid-tail jumps to the handler
+            if br != "finalbody" and any(
+                    flow.in_subtree(x, s) for s in parent.finalbody):
+                return True
+        block = _block_of(parent, cur) if isinstance(
+            cur, (ast.stmt, ast.ExceptHandler)) else None
+        if block is not None and normal:
+            idx = next(i for i, s in enumerate(block) if s is cur)
+            if any(flow.in_subtree(x, s) for s in block[idx + 1:]):
+                return True
+            if _terminates_block(block[idx:]):
+                normal = False  # only exception propagation from here up
+        cur = parent
+    return False
+
+
+def _reaches_fall_through(flow: AwaitFlow, o: ast.AST,
+                          region: ast.AST) -> bool:
+    """Whether the straight-line path from ``o`` can fall off the end of
+    ``region`` (the function, or the open's enclosing loop body)."""
+    cur: ast.AST | None = _stmt_of(flow, o)
+    while cur is not None and cur is not region:
+        parent = flow._parent.get(cur)
+        if parent is None:
+            break
+        block = _block_of(parent, cur) if isinstance(
+            cur, (ast.stmt, ast.ExceptHandler)) else None
+        if block is not None:
+            idx = next(i for i, s in enumerate(block) if s is cur)
+            if _terminates_block(block[idx:]):
+                return False
+        cur = parent
+    return True
+
+
+def _stmt_of(flow: AwaitFlow, node: ast.AST) -> ast.stmt | None:
+    """The innermost statement containing ``node`` whose parent is a
+    block-carrying construct (so siblings can be enumerated)."""
+    cur: ast.AST | None = node
+    while cur is not None:
+        parent = flow._parent.get(cur)
+        if isinstance(cur, ast.stmt) and isinstance(
+                parent, (ast.FunctionDef, ast.AsyncFunctionDef, ast.If,
+                         ast.For, ast.AsyncFor, ast.While, ast.With,
+                         ast.AsyncWith, ast.Try, ast.ExceptHandler,
+                         ast.Module)):
+            return cur
+        cur = parent
+    return None
+
+
+def _block_of(parent: ast.AST, stmt: ast.stmt) -> list[ast.stmt] | None:
+    for _fname, value in ast.iter_fields(parent):
+        if isinstance(value, list) and any(v is stmt for v in value):
+            return value
+    return None
+
+
+def _terminates_block(stmts: list[ast.stmt]) -> bool:
+    return bool(stmts) and _terminates(stmts[-1])
+
+
+def _terminates(stmt: ast.stmt) -> bool:
+    """Control cannot fall past ``stmt`` (syntactic approximation)."""
+    if isinstance(stmt, (ast.Return, ast.Raise, ast.Continue, ast.Break)):
+        return True
+    if isinstance(stmt, ast.If):
+        return (bool(stmt.orelse) and _terminates_block(stmt.body)
+                and _terminates_block(stmt.orelse))
+    if isinstance(stmt, ast.Try):
+        if stmt.finalbody and _terminates_block(stmt.finalbody):
+            return True
+        blocks = [stmt.orelse if stmt.orelse else stmt.body]
+        blocks += [h.body for h in stmt.handlers]
+        return all(_terminates_block(b) for b in blocks)
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return _terminates_block(stmt.body)
+    if isinstance(stmt, ast.While):
+        infinite = (isinstance(stmt.test, ast.Constant)
+                    and bool(stmt.test.value))
+        return infinite and not any(isinstance(n, ast.Break)
+                                    for n in ast.walk(stmt))
+    return False
+
+
+class _OpenAnalysis:
+    """All matching/bless/escape logic for one (function, spec) pair."""
+
+    def __init__(self, fn, spec: PairSpec, flow: AwaitFlow,
+                 opens, closes, nested_closes):
+        self.fn = fn
+        self.spec = spec
+        self.flow = flow
+        self.opens = opens
+        self.closes = closes
+        self.nested_closes = nested_closes
+
+    # -- blessed idioms ------------------------------------------------------
+
+    def _cm_blessed(self, o: ast.Call) -> bool:
+        return any(isinstance(a, ast.withitem)
+                   for a in self.flow._ancestors(o))
+
+    def _finally_blessed(self, o: ast.Call, matched: list[ast.Call]) -> bool:
+        flow = self.flow
+        for anc in flow._ancestors(o):
+            if (isinstance(anc, ast.Try)
+                    and flow._branch_of(o, anc) == "body"
+                    and any(flow._branch_of(c, anc) == "finalbody"
+                            for c in matched)):
+                return True
+        # Open immediately before a finally-protected try, separated only
+        # by plain assignments (no awaits / exits in the gap — the gap is
+        # where a cancellation would still leak). The anchor statement is
+        # lifted through guard ``if``s: the pervasive
+        #     if orch is not None: orch.begin(base)
+        #     try: ... finally:
+        #         if orch is not None: orch.end(base)
+        # shape pairs a conditional open with an identically-guarded
+        # close, and the interlock shape puts the open in the guard TEST
+        # (``if not state.try_begin_reload(): return refusal``) with the
+        # protected try as the next sibling.
+        stmt = _stmt_of(flow, o)
+        while stmt is not None:
+            parent = flow._parent.get(stmt)
+            block = _block_of(parent, stmt) if parent is not None else None
+            if block:
+                idx = next(i for i, s in enumerate(block) if s is stmt)
+                for nxt in block[idx + 1:]:
+                    if isinstance(nxt, ast.Try):
+                        return any(flow._branch_of(c, nxt) == "finalbody"
+                                   for c in matched)
+                    if not isinstance(nxt, (ast.Assign, ast.AnnAssign)):
+                        return False  # an exit/await in the gap leaks
+                    if any(isinstance(n, ast.Await)
+                           for n in ast.walk(nxt)):
+                        return False
+            if isinstance(parent, ast.If):
+                stmt = parent
+                continue
+            break
+        return False
+
+    def _handoff_blessed(self, o: ast.Call) -> bool:
+        flow = self.flow
+        parent = flow._parent.get(o)
+        if isinstance(parent, ast.Await):
+            parent = flow._parent.get(parent)
+        name = None
+        if (isinstance(parent, ast.Assign) and len(parent.targets) == 1
+                and isinstance(parent.targets[0], ast.Name)):
+            name = parent.targets[0].id
+        elif (isinstance(parent, ast.AnnAssign)
+                and isinstance(parent.target, ast.Name)):
+            name = parent.target.id
+        if not name:
+            return False
+
+        def _mentions(node: ast.AST) -> bool:
+            return any(isinstance(n, ast.Name) and n.id == name
+                       for n in ast.walk(node))
+
+        for node in _walk_scope(self.fn):
+            if _pos(node) <= _pos(o):
+                continue
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in node.targets):
+                if _mentions(node.value) or any(_mentions(t)
+                                                for t in node.targets):
+                    return True
+            if isinstance(node, ast.Return) and node.value is not None:
+                if _mentions(node.value):
+                    return True
+        return False
+
+    # -- escapes -------------------------------------------------------------
+
+    def _covering_close(self, o: ast.Call, x: ast.AST,
+                        matched: list[ast.Call]) -> ast.Call | None:
+        """A matched close that every path from ``o`` to exit ``x``
+        executes before leaving."""
+        flow = self.flow
+        for c in matched:
+            if not (_pos(o) < _pos(c) < _pos(x)):
+                continue
+            if _arm_disjoint(flow, c, x) or _arm_disjoint(flow, o, c):
+                continue
+            if _covers_exit(flow, c, x):
+                return c
+        # A finally containing a matched close covers every exit inside
+        # its try, even though the close is textually after the exit.
+        for anc in flow._ancestors(x):
+            if isinstance(anc, ast.Try) and flow._branch_of(x, anc) in (
+                    "body", "handlers", "orelse"):
+                for c in matched:
+                    if (flow._branch_of(c, anc) == "finalbody"
+                            and not _arm_disjoint(flow, o, c)):
+                        return c
+        return None
+
+    def escapes_for(self, o: ast.Call, oc: str) -> list[Escape]:
+        spec, flow = self.spec, self.flow
+        matched = [c for c, cc in self.closes
+                   if c is not o and _close_matches(oc, c, cc, spec)]
+        matched_nested = [c for c, cc in self.nested_closes
+                          if _close_matches(oc, c, cc, spec)]
+        if not matched and not matched_nested:
+            return []  # cross-function protocol endpoint — out of scope
+        if matched_nested:
+            return []  # callback handoff: the close rides another frame
+        if (self._cm_blessed(o) or self._finally_blessed(o, matched)
+                or self._handoff_blessed(o)):
+            return []
+
+        out: list[Escape] = []
+
+        def esc(kind: str, at: ast.AST) -> Escape:
+            return Escape(kind=kind, spec=spec, open_line=o.lineno,
+                          open_col=o.col_offset, open_snippet_node=o,
+                          at_line=getattr(at, "lineno", o.lineno),
+                          receiver=oc)
+
+        for node in _walk_scope(self.fn):
+            if not isinstance(node, (ast.Return, ast.Raise)):
+                continue
+            if _pos(node) <= _pos(o):
+                continue
+            if _arm_disjoint(flow, o, node):
+                continue
+            if not _reachable(flow, o, node):
+                continue
+            if self._covering_close(o, node, matched) is None:
+                kind = "return" if isinstance(node, ast.Return) else "raise"
+                out.append(esc(kind, node))
+
+        out.extend(self._end_escape(o, matched, esc))
+        if not out:
+            out.extend(self._abandonment(o, matched, esc))
+        return out
+
+    def _end_escape(self, o: ast.Call, matched: list[ast.Call],
+                    esc) -> list[Escape]:
+        """Falling off the end of the function — or, for an open inside a
+        loop, reaching the end of the iteration — without an
+        unconditional close."""
+        flow = self.flow
+        loops = flow._enclosing_loops(o)
+        if loops:
+            region = loops[0]  # innermost: the per-iteration lifecycle
+        else:
+            if _terminates_block(self.fn.body):
+                return []
+            region = self.fn
+        if not _reaches_fall_through(flow, o, region):
+            return []  # the open's own tail always exits explicitly
+        for c in matched:
+            if _pos(c) <= _pos(o):
+                continue
+            if not flow.in_subtree(c, region):
+                continue
+            if _arm_disjoint(flow, o, c):
+                continue
+            if _unconditional_upto(flow, c, region):
+                return []
+        # A finally-close anywhere up o's ancestry inside the region also
+        # closes the straight-line path.
+        for anc in flow._ancestors(o):
+            if not flow.in_subtree(anc, region):
+                break
+            if isinstance(anc, ast.Try) and any(
+                    flow._branch_of(c, anc) == "finalbody"
+                    for c in matched):
+                return []
+        tail = region.body[-1] if getattr(region, "body", None) else o
+        return [esc("end", tail)]
+
+    def _abandonment(self, o: ast.Call, matched: list[ast.Call],
+                     esc) -> list[Escape]:
+        """Every exit is covered by a plain (non-finally) close — but an
+        await between the open and that close abandons the frame on
+        cancellation, and the close never runs."""
+        if not isinstance(self.fn, ast.AsyncFunctionDef):
+            return []
+        flow = self.flow
+        candidates = sorted(
+            (c for c in matched
+             if _pos(c) > _pos(o) and not _arm_disjoint(flow, o, c)),
+            key=_pos)
+        if not candidates:
+            return []
+        first = candidates[0]
+        sus = flow.suspensions_between(flow.lift_to_await(o),
+                                       flow.lift_to_await(first))
+        if sus:
+            return [esc("abandonment", sus[0])]
+        return []
+
+
+#: Compiled receiver patterns, one per spec (module-load cost, not
+#: per-function).
+_RECEIVER_RX = {s.name: re.compile(s.receiver) if s.receiver else None
+                for s in PAIR_SPECS}
+_ALL_VERBS = frozenset(v for s in PAIR_SPECS for v in (*s.opens, *s.closes))
+
+
+def check_all(fn, specs: tuple[PairSpec, ...] = PAIR_SPECS
+              ) -> list[Escape]:
+    """All unbalanced opens of every spec inside ``fn`` (one frame only;
+    nested defs are separate frames the caller visits independently).
+    One AST walk collects every candidate call; the CFG is built only
+    when some spec has both sides present — the whole-repo scan's cost
+    is dominated by functions that open nothing."""
+    calls: list[tuple[ast.Call, str, str]] = []   # (node, verb, chain)
+    for node in _walk_scope(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        verb = (f.attr if isinstance(f, ast.Attribute)
+                else f.id if isinstance(f, ast.Name) else None)
+        if verb is None or verb not in _ALL_VERBS:
+            continue
+        chain = (_chain(f.value) or "<dynamic>"
+                 if isinstance(f, ast.Attribute) else "")
+        calls.append((node, verb, chain))
+    if not calls:
+        return []
+    nested: list[tuple[ast.Call, str, str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, _NESTED) and node is not fn:
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                verb = (f.attr if isinstance(f, ast.Attribute)
+                        else f.id if isinstance(f, ast.Name) else None)
+                if verb is None or verb not in _ALL_VERBS:
+                    continue
+                chain = (_chain(f.value) or "<dynamic>"
+                         if isinstance(f, ast.Attribute) else "")
+                nested.append((sub, verb, chain))
+
+    flow: AwaitFlow | None = None
+    out: list[Escape] = []
+    for spec in specs:
+        if fn.name in spec.opens or fn.name in spec.closes:
+            continue  # the pair's own shim/wrapper — it IS one side
+        rx = _RECEIVER_RX.get(spec.name)
+        if rx is None and spec.receiver:
+            rx = re.compile(spec.receiver)
+        opens = [(n, c) for n, v, c in calls
+                 if v in spec.opens and (rx is None or rx.search(c))]
+        if not opens:
+            continue
+        closes = [(n, c) for n, v, c in calls if v in spec.closes]
+        nested_closes = [(n, c) for n, v, c in nested
+                         if v in spec.closes]
+        if not closes and not nested_closes:
+            continue
+        if flow is None:
+            flow = AwaitFlow(fn)
+        analysis = _OpenAnalysis(fn, spec, flow, opens, closes,
+                                 nested_closes)
+        for o, oc in opens:
+            out.extend(analysis.escapes_for(o, oc))
+    return out
+
+
+def check_function(fn, spec: PairSpec,
+                   flow: AwaitFlow | None = None) -> list[Escape]:
+    """Single-spec entry point (tests, targeted audits)."""
+    return check_all(fn, (spec,))

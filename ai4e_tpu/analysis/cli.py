@@ -2,7 +2,9 @@
 
 Exit codes: 0 clean (baselined findings allowed), 1 non-baselined
 findings, 2 configuration error (unreadable baseline, entry without a
-justification). Stdlib-only: the gate runs without the JAX toolchain.
+justification, git failure under --changed-only), 4 wall-time budget
+exceeded (--budget-ms). Stdlib-only: the gate runs without the JAX
+toolchain.
 """
 
 from __future__ import annotations
@@ -10,9 +12,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
-from .core import Analyzer, Baseline, BaselineError
+from .core import Analyzer, Baseline, BaselineError, ProjectRule
 from .rules import ALL_RULES
 
 DEFAULT_BASELINE = "analysis_baseline.json"
@@ -20,6 +23,7 @@ DEFAULT_BASELINE = "analysis_baseline.json"
 _FAMILY_TITLES = {
     "invariants": "intra-process invariants",
     "wire": "wire contracts (cross-process)",
+    "balance": "paired-effect conservation",
     "hygiene": "analyzer hygiene",
 }
 
@@ -69,6 +73,51 @@ def _print_stats(result, stream) -> None:
     for rule_id, secs in sorted(result.rule_seconds.items(),
                                 key=lambda kv: -kv[1]):
         print(f"stats: {rule_id}  {secs * 1000:8.1f} ms", file=stream)
+
+
+def _over_budget(budget_ms, result) -> bool:
+    """True (and a loud stderr line) when the run blew its wall-time
+    budget. Exit 4 so CI distinguishes 'slow' from 'findings' (1) and
+    'misconfigured' (2)."""
+    if budget_ms is None:
+        return False
+    total = result.total_seconds * 1000
+    if total <= budget_ms:
+        return False
+    print(f"error: analyzer wall time {total:.0f} ms exceeds --budget-ms "
+          f"{budget_ms} — profile with --stats and trim the slowest "
+          "rules, or raise the documented budget in docs/analysis.md",
+          file=sys.stderr)
+    return True
+
+
+class ChangedOnlyError(RuntimeError):
+    """git could not produce the changed-file set. A configuration error
+    (exit 2): a broken ref in the pre-commit hook must fail loudly, not
+    silently scan nothing and pass."""
+
+
+def changed_py_files(root: str, ref: str) -> list[str]:
+    """Repo-root-relative ``.py`` paths changed vs ``ref`` plus untracked
+    ones — the pre-commit working set. Deleted files are filtered out
+    (nothing to scan)."""
+    def _git(*args: str) -> list[str]:
+        proc = subprocess.run(
+            ["git", *args], cwd=root, capture_output=True, text=True,
+            timeout=30)
+        if proc.returncode != 0:
+            raise ChangedOnlyError(
+                f"git {' '.join(args)} failed (exit {proc.returncode}): "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        return [ln for ln in proc.stdout.splitlines() if ln.strip()]
+
+    seen: dict[str, None] = {}
+    for rel in (_git("diff", "--name-only", ref, "--")
+                + _git("ls-files", "--others", "--exclude-standard")):
+        if rel.endswith(".py") and rel not in seen:
+            if os.path.exists(os.path.join(root, rel)):
+                seen[rel] = None
+    return list(seen)
 
 
 class UnknownRuleError(ValueError):
@@ -130,6 +179,17 @@ def main(argv: list[str] | None = None) -> int:
                         help="print per-rule wall time after the run "
                              "(stderr in text mode, `stats` key in "
                              "--json)")
+    parser.add_argument("--changed-only", nargs="?", const="origin/main",
+                        default=None, metavar="REF",
+                        help="scope the scan to .py files changed vs a "
+                             "git ref (default ref: origin/main) plus "
+                             "untracked ones; project-wide rules are "
+                             "skipped — CI keeps the whole-repo gate")
+    parser.add_argument("--budget-ms", type=int, default=None, metavar="N",
+                        help="fail with exit 4 if total analyzer wall "
+                             "time exceeds N milliseconds — keeps the "
+                             "blocking CI job from decaying as rules "
+                             "accumulate")
     parser.add_argument("--select", default=None, metavar="IDS",
                         help="comma-separated rule ids to run")
     parser.add_argument("--ignore", default=None, metavar="IDS",
@@ -187,6 +247,32 @@ def main(argv: list[str] | None = None) -> int:
     except UnknownRuleError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
+
+    if args.changed_only is not None:
+        try:
+            rels = changed_py_files(root, args.changed_only)
+        except (ChangedOnlyError, OSError,
+                subprocess.SubprocessError) as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        scoped = []
+        for rel in rels:
+            ap = os.path.join(root, rel)
+            if any(ap == base
+                   or ap.startswith(base.rstrip(os.sep) + os.sep)
+                   for base in abs_paths):
+                scoped.append(ap)
+        # Project-wide rules correlate the WHOLE tree (docs surfaces,
+        # wire contracts, journal round-trip); on a file slice they
+        # would report nonsense one-sided drift. CI's full run keeps
+        # them armed.
+        rules = [r for r in rules if not isinstance(r, ProjectRule)]
+        if not scoped:
+            print(f"ai4e-lint: no changed .py files vs "
+                  f"{args.changed_only} in scope; nothing to scan")
+            return 0
+        abs_paths = scoped
+
     analyzer = Analyzer(rules, root=root, baseline=baseline)
     result = analyzer.run(abs_paths)
 
@@ -200,6 +286,8 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(_sarif_document(result, rules), indent=2))
         if args.stats:
             _print_stats(result, sys.stderr)
+        if _over_budget(args.budget_ms, result):
+            return 4
         return 1 if result.findings else 0
 
     if args.as_json:
@@ -244,6 +332,8 @@ def main(argv: list[str] | None = None) -> int:
               f"{result.suppressed} suppressed")
         if args.stats:
             _print_stats(result, sys.stderr)
+    if _over_budget(args.budget_ms, result):
+        return 4
     return 1 if result.findings else 0
 
 

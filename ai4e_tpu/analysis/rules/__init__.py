@@ -1,5 +1,10 @@
 """Rule registry — one module per rule, ids are append-only stable."""
 
+from .balance import (
+    JournalReplayRoundTrip,
+    PairSpecDrift,
+    UnbalancedPairedEffect,
+)
 from .blocking import BlockingCallInAsync
 from .bucket_literal import StaticBucketLadder
 from .config_drift import ConfigDrift
@@ -37,6 +42,9 @@ ALL_RULES = [
     ClientRouteDrift,
     HeaderVocabularyDrift,
     UnhandledRefusalStatus,
+    UnbalancedPairedEffect,
+    JournalReplayRoundTrip,
+    PairSpecDrift,
     UnusedSuppression,
 ]
 

@@ -1,0 +1,379 @@
+"""AIL020/AIL021/AIL022 — the balance family (docs/analysis.md catalog;
+docs/concurrency.md "paired-effect conservation contract").
+
+AIL020 flags paired effects (``ai4e_tpu/analysis/balance.py`` holds the
+engine and the declarative pair table) whose close does not dominate
+every function exit. AIL021 applies the two-sided drift check (the
+AIL006/010/016 family) to durable truth: every journal record marker the
+task store writes must have a replay branch, and every replay branch must
+have a writer. AIL022 is the self-honesty rule: every declared pair
+symbol must still resolve to real code, so a rename cannot silently
+disarm AIL020.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..balance import PAIR_SPECS, check_all
+from ..core import Finding, ModuleContext, ProjectContext, ProjectRule, Rule
+
+_KIND_HINTS = {
+    "return": "the return at line {at} is not covered by a matched close "
+              "— close before returning or move the close to a finally",
+    "raise": "the raise at line {at} is not covered by a matched close — "
+             "close before re-raising or move the close to a finally",
+    "end": "the straight-line path reaches line {at} without an "
+           "unconditional close — close on every path or use a finally",
+    "abandonment": "a cancelled await at line {at} abandons the frame "
+                   "before the close runs — protect the span with "
+                   "try/finally or a context manager",
+}
+
+
+class UnbalancedPairedEffect(Rule):
+    rule_id = "AIL020"
+    name = "unbalanced-paired-effect"
+    description = ("a paired effect (probe slot, inflight count, limiter "
+                   "slot, gauge, ledger buffer) is opened on a path where "
+                   "its close does not cover every exit")
+    family = "balance"
+
+    def check_module(self, ctx: ModuleContext):
+        out: list[Finding] = []
+        stack: list[str] = []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, ast.ClassDef):
+                stack.append(node.name)
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                stack.pop()
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                symbol = ".".join([*stack, node.name])
+                for e in check_all(node):
+                    spec = e.spec
+                    verb = self._verb(e.open_snippet_node)
+                    recv = f"{e.receiver}.{verb}" if e.receiver else verb
+                    snippet = ctx.snippet(e.open_line)
+                    hint = _KIND_HINTS[e.kind].format(at=e.at_line)
+                    out.append(Finding(
+                        rule=self.rule_id, path=ctx.path,
+                        line=e.open_line, col=e.open_col,
+                        message=(f"paired effect '{spec.name}' opened "
+                                 f"by {recv}(...) leaks on the "
+                                 f"{e.kind} path: {hint} "
+                                 f"(closes: "
+                                 f"{'/'.join(spec.closes)})"),
+                        symbol=symbol, snippet=snippet,
+                        fingerprint_key=(
+                            f"AIL020|{spec.name}|{symbol}|{e.kind}|"
+                            f"{' '.join(snippet.split())}")))
+                stack.append(node.name)
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                stack.pop()
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(ctx.tree)
+        return out
+
+    @staticmethod
+    def _verb(call: ast.AST) -> str:
+        func = getattr(call, "func", None)
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        if isinstance(func, ast.Name):
+            return func.id
+        return "<call>"
+
+
+# -- AIL021 ------------------------------------------------------------------
+
+#: The durable-truth surface AIL021 audits. Path suffix so test fixtures
+#: can stand up their own store module under a tmp dir.
+_STORE_SUFFIX = "taskstore/store.py"
+_SINKS = frozenset({"_append", "_write_own_line", "emit"})
+_REPLAY_FN = "_apply_replay_record"
+
+
+def _const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _is_true(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+class _StoreIndex:
+    """Parent map + function table for one store module."""
+
+    def __init__(self, tree: ast.Module):
+        self.parents: dict[ast.AST, ast.AST] = {}
+        self.funcs: dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs.setdefault(node.name, node)
+
+    def enclosing_fn(self, node: ast.AST):
+        while node in self.parents:
+            node = self.parents[node]
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return node
+        return None
+
+    def symbol(self, node: ast.AST) -> str:
+        names: list[str] = []
+        while node in self.parents:
+            node = self.parents[node]
+            if isinstance(node, (ast.ClassDef, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                names.append(node.name)
+        return ".".join(reversed(names))
+
+
+class JournalReplayRoundTrip(ProjectRule):
+    rule_id = "AIL021"
+    name = "journal-replay-round-trip"
+    description = ("every journal record marker the task store writes "
+                   "must have a replay branch, and every replay branch "
+                   "must have a writer — one-sided protocol silently "
+                   "drops durable state at restart")
+    family = "balance"
+
+    def check_project(self, ctx: ProjectContext):
+        out: list[Finding] = []
+        for m in ctx.modules:
+            if m.path.endswith(_STORE_SUFFIX):
+                out.extend(self._check_store(m))
+        return out
+
+    # -- writer side ---------------------------------------------------------
+
+    def _record_keys(self, expr: ast.AST, fn, idx: _StoreIndex,
+                     depth: int, inline: bool,
+                     keys: dict[str, tuple[int, bool]]) -> None:
+        """Accumulate ``key -> (line, is_marker)`` from a record
+        expression: dict literals, locals (plus their subscript stores),
+        and one level of record-builder helpers. Unresolvable expressions
+        (``task.to_dict()``) contribute nothing — payload, not protocol."""
+        if depth > 2:
+            return
+        if isinstance(expr, ast.Dict):
+            small = inline and len(expr.keys) <= 2
+            for k, v in zip(expr.keys, expr.values):
+                key = _const_str(k) if k is not None else None
+                if key is None:
+                    continue
+                marker = _is_true(v) or small
+                prev = keys.get(key)
+                if prev is None or (marker and not prev[1]):
+                    keys[key] = (k.lineno, marker)
+            return
+        if isinstance(expr, ast.Name) and fn is not None:
+            name = expr.id
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == name
+                        for t in node.targets):
+                    self._record_keys(node.value, fn, idx, depth + 1,
+                                      False, keys)
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Subscript)
+                        and isinstance(node.targets[0].value, ast.Name)
+                        and node.targets[0].value.id == name):
+                    key = _const_str(node.targets[0].slice)
+                    if key is not None:
+                        marker = _is_true(node.value)
+                        prev = keys.get(key)
+                        if prev is None or (marker and not prev[1]):
+                            keys[key] = (node.lineno, marker)
+            return
+        if isinstance(expr, ast.Call):
+            callee = None
+            if isinstance(expr.func, ast.Attribute):
+                callee = expr.func.attr
+            elif isinstance(expr.func, ast.Name):
+                callee = expr.func.id
+            helper = idx.funcs.get(callee or "")
+            if helper is not None:
+                for node in ast.walk(helper):
+                    if isinstance(node, ast.Return) and node.value is not None:
+                        self._record_keys(node.value, helper, idx,
+                                          depth + 1, False, keys)
+
+    # -- replay side ---------------------------------------------------------
+
+    @staticmethod
+    def _replay_keys(replay, idx: _StoreIndex):
+        """(consulted, branch) key sets plus ``key -> line`` for branch
+        keys. Branch keys are discriminators consulted inside a test —
+        the keys that select which replay arm applies."""
+        rec_names = {a.arg for a in replay.args.args
+                     if a.arg not in ("self", "cls")}
+        test_ids: set[int] = set()
+        for node in ast.walk(replay):
+            tests = []
+            if isinstance(node, (ast.If, ast.While)):
+                tests.append(node.test)
+            elif isinstance(node, ast.IfExp):
+                tests.append(node.test)
+            for t in tests:
+                test_ids.update(id(n) for n in ast.walk(t))
+        consulted: set[str] = set()
+        branch: dict[str, int] = {}
+
+        def note(key: str, node: ast.AST) -> None:
+            consulted.add(key)
+            if id(node) in test_ids and key not in branch:
+                branch[key] = node.lineno
+
+        for node in ast.walk(replay):
+            if isinstance(node, ast.Compare):
+                key = _const_str(node.left)
+                if (key is not None
+                        and any(isinstance(op, (ast.In, ast.NotIn))
+                                for op in node.ops)
+                        and any(isinstance(c, ast.Name)
+                                and c.id in rec_names
+                                for c in node.comparators)):
+                    note(key, node)
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in rec_names and node.args):
+                key = _const_str(node.args[0])
+                if key is not None:
+                    note(key, node)
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in rec_names):
+                key = _const_str(node.slice)
+                if key is not None:
+                    note(key, node)
+        return consulted, branch
+
+    def _check_store(self, m: ModuleContext):
+        idx = _StoreIndex(m.tree)
+        written: dict[str, tuple[int, bool]] = {}
+        writer_syms: dict[str, str] = {}
+        sink_calls = 0
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = None
+            if isinstance(node.func, ast.Attribute):
+                callee = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                callee = node.func.id
+            if callee not in _SINKS:
+                continue
+            sink_calls += 1
+            fn = idx.enclosing_fn(node)
+            before = set(written)
+            for arg in node.args:
+                self._record_keys(arg, fn, idx, 0,
+                                  isinstance(arg, ast.Dict), written)
+            for key in set(written) - before:
+                writer_syms[key] = idx.symbol(node)
+
+        replay = idx.funcs.get(_REPLAY_FN)
+
+        def finding(line: int, message: str, symbol: str,
+                    fp: str) -> Finding:
+            return Finding(rule=self.rule_id, path=m.path, line=line,
+                           col=0, message=message, symbol=symbol,
+                           snippet=m.snippet(line), fingerprint_key=fp)
+
+        if replay is None:
+            if sink_calls:
+                yield finding(
+                    1, f"journal writers found but no {_REPLAY_FN}() — "
+                       "the replay entrypoint was renamed or removed; "
+                       "AIL021 cannot verify the round-trip", "",
+                    "AIL021|no-replay-entrypoint")
+            return
+        if not sink_calls:
+            yield finding(
+                replay.lineno,
+                f"{_REPLAY_FN}() exists but no journal writer calls "
+                "(_append/_write_own_line) were found — the writer "
+                "surface was renamed; AIL021 cannot verify the "
+                "round-trip", _REPLAY_FN, "AIL021|no-writer-surface")
+            return
+
+        consulted, branch = self._replay_keys(replay, idx)
+        for key, (line, marker) in sorted(written.items()):
+            if marker and key not in consulted:
+                yield finding(
+                    line,
+                    f"journal record marker '{key}' is written but "
+                    f"{_REPLAY_FN}() never consults it — this record "
+                    "type is silently dropped when the journal replays "
+                    "at restart", writer_syms.get(key, ""),
+                    f"AIL021|writer-without-replay|{key}")
+        for key, line in sorted(branch.items()):
+            if key not in written:
+                yield finding(
+                    line,
+                    f"replay branch consults '{key}' but no journal "
+                    "writer ever emits it — dead protocol, or the "
+                    "writer was renamed away", idx.symbol(replay),
+                    f"AIL021|replay-without-writer|{key}")
+
+
+# -- AIL022 ------------------------------------------------------------------
+
+
+class PairSpecDrift(ProjectRule):
+    rule_id = "AIL022"
+    name = "pair-spec-drift"
+    description = ("a declared AIL020 pair symbol no longer resolves to "
+                   "real code — a rename silently disarmed the "
+                   "conservation check")
+    family = "balance"
+
+    def check_project(self, ctx: ProjectContext):
+        anchored = [s for s in PAIR_SPECS if s.anchor]
+        if not anchored:
+            return
+        resolved: set[str] | None = None
+        for spec in anchored:
+            anchor = next((m for m in ctx.modules
+                           if m.path.endswith(spec.anchor)), None)
+            if anchor is None:
+                continue  # pair's home surface not in this scan
+            if resolved is None:
+                resolved = set()
+                for m in ctx.modules:
+                    for node in ast.walk(m.tree):
+                        if isinstance(node, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            resolved.add(node.name)
+                        elif isinstance(node, ast.Attribute):
+                            resolved.add(node.attr)
+                        elif (isinstance(node, ast.Call)
+                                and isinstance(node.func, ast.Name)):
+                            resolved.add(node.func.id)
+            for sym in (*spec.opens, *spec.closes):
+                if sym not in resolved:
+                    yield Finding(
+                        rule=self.rule_id, path=anchor.path, line=1,
+                        col=0,
+                        message=(f"pair spec '{spec.name}' names "
+                                 f"'{sym}' but it resolves to no "
+                                 "function or attribute in the scanned "
+                                 "tree — update PAIR_SPECS in "
+                                 "analysis/balance.py or AIL020 is "
+                                 "silently disarmed"),
+                        symbol="", snippet=anchor.snippet(1),
+                        fingerprint_key=f"AIL022|{spec.name}|{sym}")

@@ -35,9 +35,14 @@ if [ "${rule_count}" -eq 0 ]; then
 fi
 # --stats prints per-rule wall time to stderr; the total is surfaced next
 # to the rule count so a parse-cache or rule-cost regression shows up in
-# every CI log, not only when someone profiles by hand.
+# every CI log, not only when someone profiles by hand. --budget-ms is
+# the documented analyzer budget (docs/analysis.md "wall-time budget"):
+# the blocking gate FAILS (exit 4) if the whole-tree run exceeds it, so
+# rule-cost decay pages instead of silently eating the CI headroom.
+LINT_BUDGET_MS="${LINT_BUDGET_MS:-60000}"
 set +e
-out=$(python -m ai4e_tpu.analysis ai4e_tpu/ --stats 2>&1)
+out=$(python -m ai4e_tpu.analysis ai4e_tpu/ --stats \
+      --budget-ms "${LINT_BUDGET_MS}" 2>&1)
 code=$?
 set -e
 printf '%s\n' "$out"

@@ -2555,3 +2555,541 @@ class TestTypedRefusalFixes:
             _raise_refusal(_FakeResp(409, {"X-Not-Owner": "1"}))
         _raise_refusal(_FakeResp(409))
         _raise_refusal(_FakeResp(200))
+
+
+# -- the balance family (AIL020-AIL022) ---------------------------------------
+#
+# AIL020 per-rule fixtures follow the repo convention: at least one true
+# positive per escape class (return, raise, end, suspension-abandonment),
+# one near-miss per blessed idiom (finally, context manager,
+# close-before-reraise, guard-if, ownership handoff, callback handoff),
+# and one suppression case. The engine lives in analysis/balance.py; the
+# pair table is PAIR_SPECS (limiter-slot and gauge-updown carry the
+# fixtures — no anchor, no receiver constraint).
+
+
+def balance_run(tmp_path, source, filename="mod.py"):
+    from ai4e_tpu.analysis.rules.balance import UnbalancedPairedEffect
+    f = tmp_path / filename
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return Analyzer([UnbalancedPairedEffect()],
+                    root=str(tmp_path)).run([str(f)])
+
+
+class TestUnbalancedPairedEffect:
+    def test_true_positive_return_escape(self, tmp_path):
+        result = balance_run(tmp_path, """
+            class C:
+                async def h(self, ok):
+                    self.limiter.acquire()
+                    if ok:
+                        return 1
+                    self.limiter.release()
+        """)
+        assert [f.rule for f in result.findings] == ["AIL020"]
+        f = result.findings[0]
+        assert "limiter-slot" in f.message and "return path" in f.message
+        assert f.symbol == "C.h"
+
+    def test_true_positive_raise_escape_missing_close_before_reraise(
+            self, tmp_path):
+        result = balance_run(tmp_path, """
+            class C:
+                def h(self):
+                    self.limiter.acquire()
+                    try:
+                        work()
+                    except Exception:
+                        raise
+                    self.limiter.release()
+        """)
+        assert [f.rule for f in result.findings] == ["AIL020"]
+        assert "raise path" in result.findings[0].message
+
+    def test_true_positive_end_escape(self, tmp_path):
+        result = balance_run(tmp_path, """
+            class C:
+                def h(self, ok):
+                    self.limiter.acquire()
+                    if ok:
+                        self.limiter.release()
+        """)
+        assert [f.rule for f in result.findings] == ["AIL020"]
+        assert "unconditional close" in result.findings[0].message
+
+    def test_true_positive_suspension_abandonment(self, tmp_path):
+        """Every textual path closes — but the await between open and
+        close abandons the frame on cancellation. The leak mode reviews
+        miss; the reason finally/CM are the only full protections."""
+        result = balance_run(tmp_path, """
+            import asyncio
+            class C:
+                async def h(self):
+                    self.limiter.acquire()
+                    await asyncio.sleep(0)
+                    self.limiter.release()
+        """)
+        assert [f.rule for f in result.findings] == ["AIL020"]
+        assert "cancelled await" in result.findings[0].message
+
+    def test_near_miss_no_await_in_span_is_clean(self, tmp_path):
+        result = balance_run(tmp_path, """
+            class C:
+                async def h(self):
+                    self.limiter.acquire()
+                    x = compute()
+                    self.limiter.release()
+                    await publish(x)
+        """)
+        assert result.findings == []
+
+    def test_near_miss_finally_blessed(self, tmp_path):
+        result = balance_run(tmp_path, """
+            class C:
+                async def h(self):
+                    self.limiter.acquire()
+                    try:
+                        await work()
+                    finally:
+                        self.limiter.release()
+        """)
+        assert result.findings == []
+
+    def test_near_miss_guard_if_shape(self, tmp_path):
+        """The pervasive production shape: a conditional open paired
+        with an identically-guarded close in the finally (dispatcher /
+        router orchestration accounting)."""
+        result = balance_run(tmp_path, """
+            async def h(orch):
+                if orch is not None:
+                    orch.acquire()
+                try:
+                    await work()
+                finally:
+                    if orch is not None:
+                        orch.release()
+        """)
+        assert result.findings == []
+
+    def test_near_miss_close_before_reraise(self, tmp_path):
+        result = balance_run(tmp_path, """
+            class C:
+                def h(self):
+                    self.limiter.acquire()
+                    try:
+                        work()
+                    except Exception:
+                        self.limiter.release()
+                        raise
+                    self.limiter.release()
+        """)
+        assert result.findings == []
+
+    def test_near_miss_context_manager_blessed(self, tmp_path):
+        result = balance_run(tmp_path, """
+            class C:
+                async def h(self, ok):
+                    with self.pool.acquire() as conn:
+                        if ok:
+                            return conn
+                    slot = self.pool.acquire()
+                    try:
+                        await work(slot)
+                    finally:
+                        self.pool.release(slot)
+        """)
+        assert result.findings == []
+
+    def test_near_miss_ownership_handoff(self, tmp_path):
+        """decode.py's _admit shape: the open's result is stored into a
+        container — the effect has a new owner with its own lifecycle."""
+        result = balance_run(tmp_path, """
+            class C:
+                def h(self, busy):
+                    slot = self.pool.acquire()
+                    if busy:
+                        self.pool.release(slot)
+                        return None
+                    self._active[slot] = slot
+        """)
+        assert result.findings == []
+
+    def test_near_miss_callback_handoff(self, tmp_path):
+        """batcher.py's window shape: the close rides the task's done
+        callback, not this frame."""
+        result = balance_run(tmp_path, """
+            class C:
+                async def h(self, loop):
+                    await self._window.acquire()
+                    task = loop.create_task(run())
+                    def _done(t):
+                        self._window.release()
+                    task.add_done_callback(_done)
+        """)
+        assert result.findings == []
+
+    def test_near_miss_open_without_close_is_cross_function(self, tmp_path):
+        """An open whose close lives in a different function is a
+        protocol endpoint — out of scope, never flagged."""
+        result = balance_run(tmp_path, """
+            class C:
+                def prologue(self):
+                    self._gate._reserve()
+                    return True
+        """)
+        assert result.findings == []
+
+    def test_gauge_requires_same_receiver(self, tmp_path):
+        """gauge-updown is same_receiver: another gauge's dec() does not
+        close this gauge's inc()."""
+        result = balance_run(tmp_path, """
+            class C:
+                def h(self, ok):
+                    self._pending.inc()
+                    if ok:
+                        return 1
+                    self._pending.dec()
+        """)
+        assert [f.rule for f in result.findings] == ["AIL020"]
+        assert "gauge-updown" in result.findings[0].message
+
+    def test_suppression(self, tmp_path):
+        result = balance_run(tmp_path, """
+            class C:
+                def h(self, ok):
+                    self.limiter.acquire()  # ai4e: noqa[AIL020] — fixture for this very test
+                    if ok:
+                        return 1
+                    self.limiter.release()
+        """)
+        assert result.findings == []
+        assert result.suppressed == 1
+
+    def test_fingerprint_stable_under_file_move(self, tmp_path):
+        """The effect-identity fingerprint is pair name + enclosing
+        symbol + escape kind + open snippet — moving the file must not
+        churn the baseline."""
+        src = """
+            class C:
+                def h(self, ok):
+                    self.limiter.acquire()
+                    if ok:
+                        return 1
+                    self.limiter.release()
+        """
+        a = balance_run(tmp_path, src, filename="a.py").findings
+        b = balance_run(tmp_path, src, filename="moved/deep/b.py").findings
+        assert len(a) == len(b) == 1
+        assert a[0].path != b[0].path
+        assert a[0].fingerprint == b[0].fingerprint
+
+
+class TestVerbatimRevertCaught:
+    """ISSUE 20 acceptance: a verbatim pre-fix revert of a real,
+    hand-fixed production bug must be CAUGHT by AIL020. The PR 8 class:
+    the worker's DrainingError handler stamps RETRY into the request's
+    hop-ledger buffer and must flush before redelivering — deleting the
+    flush loses the draining timeline of exactly the retried task."""
+
+    WORKER = os.path.join(REPO, "ai4e_tpu", "runtime", "worker.py")
+
+    def _sources(self):
+        with open(self.WORKER) as fh:
+            src = fh.read()
+        anchor = src.index('reason="draining"')
+        cut = src.index("await self._flush_ledger", anchor)
+        line_start = src.rindex("\n", 0, cut)
+        line_end = src.index("\n", cut)
+        broken = src[:line_start] + src[line_end:]
+        assert broken != src
+        import ast as _ast
+        _ast.parse(broken)  # the surgery must leave valid syntax
+        return src, broken
+
+    def test_pristine_worker_is_clean(self, tmp_path):
+        src, _ = self._sources()
+        f = tmp_path / "worker.py"
+        f.write_text(src)
+        from ai4e_tpu.analysis.rules.balance import UnbalancedPairedEffect
+        result = Analyzer([UnbalancedPairedEffect()],
+                          root=str(tmp_path)).run([str(f)])
+        assert result.findings == []
+
+    def test_deleted_drain_flush_is_caught(self, tmp_path):
+        _, broken = self._sources()
+        f = tmp_path / "worker.py"
+        f.write_text(broken)
+        from ai4e_tpu.analysis.rules.balance import UnbalancedPairedEffect
+        result = Analyzer([UnbalancedPairedEffect()],
+                          root=str(tmp_path)).run([str(f)])
+        hits = [x for x in result.findings
+                if "ledger-buffer-flush" in x.message]
+        assert hits, "\n".join(x.render() for x in result.findings)
+        assert 'buf.stamp' in hits[0].snippet
+
+
+# -- AIL021 journal-replay-round-trip -----------------------------------------
+
+
+_STORE_CLEAN = """
+    class Store:
+        def __init__(self):
+            self._lines = []
+            self._results = {}
+
+        def _append(self, rec):
+            self._lines.append(rec)
+
+        def finish(self, task_id, status):
+            self._append({"taskId": task_id, "result": True,
+                          "status": status})
+
+        def evict(self, task_id):
+            self._append({"taskId": task_id, "evict": True,
+                          "status": "evicted"})
+
+        def _apply_replay_record(self, rec):
+            if rec.get("result"):
+                self._results[rec["taskId"]] = rec["status"]
+            if rec.get("evict"):
+                self._results.pop(rec["taskId"], None)
+"""
+
+
+def journal_run(tmp_path, source):
+    from ai4e_tpu.analysis.rules.balance import JournalReplayRoundTrip
+    f = tmp_path / "pkg" / "taskstore" / "store.py"
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return Analyzer([JournalReplayRoundTrip()],
+                    root=str(tmp_path)).run([str(tmp_path / "pkg")])
+
+
+class TestJournalReplayRoundTrip:
+    def test_clean_round_trip(self, tmp_path):
+        result = journal_run(tmp_path, _STORE_CLEAN)
+        assert result.findings == []
+
+    def test_writer_without_replay_branch(self, tmp_path):
+        """A record marker written but never consulted at replay: that
+        record type silently drops durable state at restart."""
+        src = _STORE_CLEAN.replace(
+            '            if rec.get("evict"):\n'
+            '                self._results.pop(rec["taskId"], None)\n', "")
+        assert src != _STORE_CLEAN
+        result = journal_run(tmp_path, src)
+        assert [f.rule for f in result.findings] == ["AIL021"]
+        f = result.findings[0]
+        assert "'evict' is written" in f.message
+        assert f.fingerprint_key == "AIL021|writer-without-replay|evict"
+        assert f.symbol == "Store.evict"
+
+    def test_replay_branch_without_writer(self, tmp_path):
+        src = _STORE_CLEAN + """
+        def _apply_ghost(self):
+            pass
+"""
+        src = src.replace(
+            'if rec.get("result"):',
+            'if rec.get("ghost"):\n'
+            '                pass\n'
+            '            if rec.get("result"):')
+        result = journal_run(tmp_path, src)
+        assert [f.rule for f in result.findings] == ["AIL021"]
+        f = result.findings[0]
+        assert "consults 'ghost'" in f.message
+        assert f.fingerprint_key == "AIL021|replay-without-writer|ghost"
+
+    def test_arming_no_replay_entrypoint(self, tmp_path):
+        """The self-honesty arm: renaming _apply_replay_record away must
+        fire, not silently disarm the round-trip check."""
+        src = _STORE_CLEAN.replace("_apply_replay_record", "_renamed_away")
+        result = journal_run(tmp_path, src)
+        assert [f.rule for f in result.findings] == ["AIL021"]
+        assert "no _apply_replay_record()" in result.findings[0].message
+
+    def test_arming_no_writer_surface(self, tmp_path):
+        src = _STORE_CLEAN.replace("self._append(", "self._renamed(")
+        result = journal_run(tmp_path, src)
+        assert [f.rule for f in result.findings] == ["AIL021"]
+        assert "no journal writer calls" in result.findings[0].message
+
+    def test_payload_keys_are_not_protocol(self, tmp_path):
+        """taskId/status are payload (not True-valued, dict > 2 keys):
+        consulting them outside a test is fine, and NOT consulting a
+        payload key is fine too — only markers select replay arms."""
+        src = _STORE_CLEAN.replace('"status": status})',
+                                   '"status": status, "extra": 1})')
+        result = journal_run(tmp_path, src)
+        assert result.findings == []
+
+    def test_real_store_round_trip_is_clean(self):
+        """The production journal protocol (Slim/Result/Offloaded/Evict/
+        KeepBlobs/Epoch) round-trips — the same surface AIL021 audits in
+        the repo gate."""
+        from ai4e_tpu.analysis.rules.balance import JournalReplayRoundTrip
+        result = Analyzer([JournalReplayRoundTrip()], root=REPO).run(
+            [os.path.join(REPO, "ai4e_tpu", "taskstore")])
+        assert result.findings == []
+
+
+# -- AIL022 pair-spec drift ---------------------------------------------------
+
+
+class TestPairSpecDrift:
+    def test_missing_close_symbol_fires(self, tmp_path):
+        """The anchor module is in the scan but a declared close no
+        longer resolves anywhere: the rename that would silently disarm
+        AIL020's probe-slot conservation."""
+        from ai4e_tpu.analysis.rules.balance import PairSpecDrift
+        f = tmp_path / "pkg" / "resilience" / "breaker.py"
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent("""
+            class CircuitBreaker:
+                def begin_probe(self):
+                    pass
+                def record_success(self):
+                    pass
+                def record_failure(self):
+                    pass
+        """))
+        result = Analyzer([PairSpecDrift()],
+                          root=str(tmp_path)).run([str(tmp_path / "pkg")])
+        assert [f.rule for f in result.findings] == ["AIL022"]
+        f0 = result.findings[0]
+        assert "'record_neutral'" in f0.message
+        assert f0.fingerprint_key == "AIL022|probe-slot|record_neutral"
+
+    def test_all_symbols_resolve_is_clean(self, tmp_path):
+        from ai4e_tpu.analysis.rules.balance import PairSpecDrift
+        f = tmp_path / "pkg" / "resilience" / "breaker.py"
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent("""
+            class CircuitBreaker:
+                def begin_probe(self):
+                    pass
+                def record_success(self):
+                    pass
+                def record_failure(self):
+                    pass
+                def record_neutral(self):
+                    pass
+        """))
+        result = Analyzer([PairSpecDrift()],
+                          root=str(tmp_path)).run([str(tmp_path / "pkg")])
+        assert result.findings == []
+
+    def test_anchor_not_in_scan_is_skipped(self, tmp_path):
+        """Scanning a slice that doesn't include the pair's home surface
+        must not produce drift noise (the --changed-only case is handled
+        separately: project rules are skipped entirely there)."""
+        from ai4e_tpu.analysis.rules.balance import PairSpecDrift
+        f = tmp_path / "pkg" / "other.py"
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text("x = 1\n")
+        result = Analyzer([PairSpecDrift()],
+                          root=str(tmp_path)).run([str(tmp_path / "pkg")])
+        assert result.findings == []
+
+
+# -- balance-family registration + CLI satellites -----------------------------
+
+
+class TestBalanceGateRegistration:
+    def test_balance_rules_are_registered(self):
+        ids = {cls.rule_id for cls in ALL_RULES}
+        assert {"AIL020", "AIL021", "AIL022"} <= ids
+        assert len(ids) >= 22
+
+    def test_list_rules_shows_balance_family(self, capsys):
+        from ai4e_tpu.analysis.cli import main
+        assert main(["--list-rules"]) == 0
+        lines = capsys.readouterr().out.splitlines()
+        assert "# paired-effect conservation" in lines
+        fam_at = lines.index("# paired-effect conservation")
+        first = next(i for i, l in enumerate(lines)
+                     if l.startswith("AIL020"))
+        assert fam_at < first
+
+    def test_checked_in_baseline_still_empty(self):
+        """ISSUE 20 acceptance: everything the balance family's first
+        run found was fixed (or was a blessed idiom the engine now
+        models), not baselined — the baseline ships empty."""
+        import json as _json
+        with open(os.path.join(REPO, "analysis_baseline.json")) as fh:
+            data = _json.load(fh)
+        assert data.get("findings", data if isinstance(data, list)
+                        else []) == []
+
+
+class TestChangedOnly:
+    def _git(self, cwd, *args):
+        import subprocess
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+            cwd=cwd, check=True, capture_output=True)
+
+    def test_scopes_to_changed_files_and_skips_project_rules(
+            self, tmp_path, capsys):
+        from ai4e_tpu.analysis.cli import main
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "clean.py").write_text(
+            "import time\nasync def old():\n    time.sleep(1)\n")
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "add", ".")
+        self._git(tmp_path, "commit", "-qm", "seed")
+        (pkg / "fresh.py").write_text(
+            "import time\nasync def h():\n    time.sleep(2)\n")
+        rc = main([str(pkg), "--root", str(tmp_path), "--no-baseline",
+                   "--changed-only", "HEAD"])
+        out = capsys.readouterr().out
+        # Only the changed file is scanned: the committed TP in clean.py
+        # does not gate the pre-commit loop (CI's full run still does).
+        assert rc == 1
+        assert "1 file(s)" in out
+        assert "fresh.py" in out and "clean.py" not in out
+
+    def test_no_changes_is_a_clean_pass(self, tmp_path, capsys):
+        from ai4e_tpu.analysis.cli import main
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "clean.py").write_text("x = 1\n")
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "add", ".")
+        self._git(tmp_path, "commit", "-qm", "seed")
+        rc = main([str(pkg), "--root", str(tmp_path), "--no-baseline",
+                   "--changed-only", "HEAD"])
+        assert rc == 0
+        assert "nothing to scan" in capsys.readouterr().out
+
+    def test_bad_ref_is_a_loud_config_error(self, tmp_path, capsys):
+        from ai4e_tpu.analysis.cli import main
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "m.py").write_text("x = 1\n")
+        self._git(tmp_path, "init", "-q")
+        rc = main([str(pkg), "--root", str(tmp_path), "--no-baseline",
+                   "--changed-only", "no-such-ref"])
+        assert rc == 2
+        assert "git" in capsys.readouterr().err
+
+
+class TestBudgetMs:
+    def test_over_budget_exits_4(self, tmp_path, capsys):
+        from ai4e_tpu.analysis.cli import main
+        (tmp_path / "m.py").write_text("x = 1\n")
+        rc = main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                   "--no-baseline", "--budget-ms", "0"])
+        assert rc == 4
+        assert "exceeds --budget-ms" in capsys.readouterr().err
+
+    def test_within_budget_keeps_findings_exit(self, tmp_path, capsys):
+        from ai4e_tpu.analysis.cli import main
+        (tmp_path / "m.py").write_text(
+            "import time\nasync def h():\n    time.sleep(1)\n")
+        rc = main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                   "--no-baseline", "--budget-ms", "600000"])
+        assert rc == 1
+        assert "exceeds" not in capsys.readouterr().err

@@ -1837,3 +1837,71 @@ class TestDrainFlipVsReload:
             "the scenario no longer models the admission or the budget "
             "is too small")
         assert "drained" in str(report.failures[0].error)
+
+
+# -- PR 20: the drain-handler flush (AIL020 ledger-buffer-flush) --------------
+
+
+class TestReplayDrainFlushLoss:
+    """The PR 8/PR 18 composite AIL020 now pins statically: the worker's
+    DrainingError handler stamps RETRY into the request's buffered
+    hop-ledger and must flush it before redelivering. The reverted
+    replica (stamp, redeliver, no flush) loses the draining timeline of
+    exactly the retried task — the flight recorder's 100%% guarantee is
+    about failed-and-retried requests above all. AIL020 catches the
+    deletion syntactically (tests/test_analysis.py
+    TestVerbatimRevertCaught); this replay shows the lost-timeline
+    behavior it encodes."""
+
+    def _scenario(self, flush_before_redeliver: bool):
+        from ai4e_tpu.observability.ledger import RETRY, HopLedger
+
+        def make():
+            store = InMemoryTaskStore()
+            tm = LocalTaskManager(store)
+            task = store.upsert(APITask(endpoint="/v1/x", body=b"{}"))
+            draining = {"on": False}
+            redelivered: list[str] = []
+
+            async def handler():
+                buf = HopLedger()
+                await yield_point()       # submit races the drain flip
+                if draining["on"]:
+                    buf.stamp(RETRY, "worker", reason="draining")
+                    if flush_before_redeliver:
+                        events = buf.drain()
+                        if events:
+                            await tm.append_ledger(task.task_id, events)
+                    redelivered.append(task.task_id)
+                    return
+
+            async def drain_flip():
+                await yield_point()
+                draining["on"] = True
+
+            def check():
+                if not redelivered:
+                    return  # this interleaving never saw the drain
+                events = store.get_ledger(task.task_id)
+                assert any(ev.get("e") == RETRY
+                           and ev.get("r") == "draining"
+                           for ev in events), (
+                    "draining timeline lost: the task was redelivered "
+                    "but its RETRY stamp never reached the store")
+
+            return [handler(), drain_flip()], check
+
+        return make
+
+    def test_fixed_handler_keeps_the_timeline(self):
+        report = explore_interleavings(self._scenario(True),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert report.ok, report.describe()
+
+    def test_reverted_flush_deletion_caught(self):
+        report = explore_interleavings(self._scenario(False),
+                                       schedules=SCHEDULES, seed=SEED)
+        assert not report.ok, (
+            "the drain flip never interleaved before the handler's "
+            "check — scenario no longer models the race")
+        assert "timeline lost" in str(report.failures[0].error)
